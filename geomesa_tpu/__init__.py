@@ -1,0 +1,28 @@
+"""geomesa-tpu: a TPU-native geospatial analytics framework.
+
+Re-imagines GeoMesa's capability set (spatio-temporal indexing over space-filling
+curves, CQL-filtered scans, pushdown aggregation: density heatmaps, stats sketches,
+BIN/Arrow export, kNN/joins) as a JAX/XLA-first system: feature collections are
+sharded, sorted columnar arrays in device HBM; curve encoding, predicate evaluation
+and aggregation are jit/vmap kernels; cross-device merges are XLA collectives.
+
+Reference behavior map: SURVEY.md (GeoMesa 3.2.x @ /root/reference).
+"""
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    "FeatureType": "geomesa_tpu.schema.feature_type",
+    "AttributeSpec": "geomesa_tpu.schema.feature_type",
+    "GeoDataset": "geomesa_tpu.api.dataset",
+    "Query": "geomesa_tpu.api.dataset",
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name])
+        return getattr(mod, name)
+    raise AttributeError(f"module 'geomesa_tpu' has no attribute {name!r}")

@@ -1,0 +1,161 @@
+"""Three-scope configuration system (system / store / query).
+
+Mirrors GeoMesa's ``SystemProperty`` pattern
+(reference: geomesa-utils/.../conf/GeoMesaSystemProperties.scala:19-60 and
+geomesa-index-api/.../conf/QueryProperties.scala:15-50): a named, typed tunable
+with a default, overridable by environment variable or a thread-local scope.
+
+Resolution order: thread-local override > environment variable > default.
+Environment variable name = property name with ``.``/``-`` replaced by ``_``,
+upper-cased (e.g. ``geomesa.scan.ranges.target`` -> ``GEOMESA_SCAN_RANGES_TARGET``).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Dict, Optional
+
+_local = threading.local()
+
+_REGISTRY: Dict[str, "SystemProperty"] = {}
+
+
+def _overrides() -> Dict[str, str]:
+    if not hasattr(_local, "overrides"):
+        _local.overrides = {}
+    return _local.overrides
+
+
+class SystemProperty:
+    """A named tunable with a default and typed accessors."""
+
+    def __init__(self, name: str, default: Optional[str] = None):
+        self.name = name
+        self.default = default
+        self.env_name = name.replace(".", "_").replace("-", "_").upper()
+        _REGISTRY[name] = self
+
+    def get(self) -> Optional[str]:
+        ov = _overrides()
+        if self.name in ov:
+            return ov[self.name]
+        if self.env_name in os.environ:
+            return os.environ[self.env_name]
+        return self.default
+
+    def set(self, value: Optional[Any]) -> None:
+        """Thread-local override (None clears)."""
+        ov = _overrides()
+        if value is None:
+            ov.pop(self.name, None)
+        else:
+            ov[self.name] = str(value)
+
+    class _Scope:
+        def __init__(self, prop: "SystemProperty", value: Any):
+            self.prop, self.value = prop, value
+
+        def __enter__(self):
+            ov = _overrides()
+            self.prev = ov.get(self.prop.name)
+            ov[self.prop.name] = str(self.value)
+            return self
+
+        def __exit__(self, *exc):
+            ov = _overrides()
+            if self.prev is None:
+                ov.pop(self.prop.name, None)
+            else:
+                ov[self.prop.name] = self.prev
+            return False
+
+    def scoped(self, value: Any) -> "SystemProperty._Scope":
+        """``with prop.scoped(123): ...`` — temporary thread-local override."""
+        return SystemProperty._Scope(self, value)
+
+    # typed accessors -----------------------------------------------------
+    def to_str(self) -> Optional[str]:
+        return self.get()
+
+    def to_int(self) -> Optional[int]:
+        v = self.get()
+        return None if v is None else int(v)
+
+    def to_float(self) -> Optional[float]:
+        v = self.get()
+        return None if v is None else float(v)
+
+    def to_bool(self) -> Optional[bool]:
+        v = self.get()
+        if v is None:
+            return None
+        return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+    def to_duration_ms(self) -> Optional[int]:
+        """Parse '100 ms', '10s', '5 minutes', '1h' etc. to milliseconds."""
+        v = self.get()
+        if v is None:
+            return None
+        s = str(v).strip().lower()
+        num = ""
+        for ch in s:
+            if ch.isdigit() or ch == ".":
+                num += ch
+            else:
+                break
+        unit = s[len(num):].strip()
+        if not num:
+            raise ValueError(f"invalid duration: {v!r}")
+        x = float(num)
+        factors = {
+            "": 1, "ms": 1, "millis": 1, "millisecond": 1, "milliseconds": 1,
+            "s": 1000, "sec": 1000, "second": 1000, "seconds": 1000,
+            "m": 60_000, "min": 60_000, "minute": 60_000, "minutes": 60_000,
+            "h": 3_600_000, "hour": 3_600_000, "hours": 3_600_000,
+            "d": 86_400_000, "day": 86_400_000, "days": 86_400_000,
+        }
+        if unit not in factors:
+            raise ValueError(f"invalid duration unit: {v!r}")
+        return int(x * factors[unit])
+
+
+def registry() -> Dict[str, SystemProperty]:
+    return dict(_REGISTRY)
+
+
+# ---------------------------------------------------------------------------
+# Query/scan tunables (names kept from the reference so operator docs carry
+# over; see geomesa-index-api/.../conf/QueryProperties.scala).
+# ---------------------------------------------------------------------------
+
+#: Soft budget of z-ranges produced by range cover (reference default 2000,
+#: QueryProperties.scala:24).
+SCAN_RANGES_TARGET = SystemProperty("geomesa.scan.ranges.target", "2000")
+
+#: Query timeout; None = unlimited.
+QUERY_TIMEOUT = SystemProperty("geomesa.query.timeout", None)
+
+#: Refuse full-table scans when set (FullTableScanQueryGuard analog).
+BLOCK_FULL_TABLE_SCANS = SystemProperty("geomesa.scan.block-full-table", "false")
+
+#: Force exact counts instead of estimates.
+FORCE_COUNT = SystemProperty("geomesa.force.count", "false")
+
+#: Parallel shard-scan width (AbstractBatchScan thread analog).
+QUERY_THREADS = SystemProperty("geomesa.query.threads", "8")
+
+#: Default number of logical shards per index (ShardStrategy analog).
+DEFAULT_SHARDS = SystemProperty("geomesa.index.shards", "4")
+
+#: Density scan row batch (reference DensityScan.scala:58).
+DENSITY_BATCH_SIZE = SystemProperty("geomesa.density.batch.size", "100000")
+
+#: Stats scan row batch (reference StatsScan.scala:47).
+STATS_BATCH_SIZE = SystemProperty("geomesa.stats.batch.size", "10000")
+
+#: Enable cost-based strategy selection (StrategyDecider analog).
+STRATEGY_DECIDER = SystemProperty("geomesa.strategy.decider", "cost")
+
+#: Max interval (days) accepted by the temporal query guard when configured.
+TEMPORAL_GUARD_MAX_DAYS = SystemProperty("geomesa.guard.temporal.max.days", None)

@@ -1,0 +1,11 @@
+"""Space-filling-curve substrate (L0).
+
+Capability parity with the reference's geomesa-z3 module (Z2SFC/Z3SFC/XZ2SFC/
+XZ3SFC + BinnedTime, see SURVEY.md §2.1) but implemented TPU-first: encoding is
+a vectorized numpy kernel on the host (ingest path) and an equivalent jnp kernel
+on device; range cover runs on the host at plan time (small, per-query).
+"""
+
+from geomesa_tpu.curves.binned_time import BinnedTime, TimePeriod  # noqa: F401
+from geomesa_tpu.curves.zorder import Z2SFC, Z3SFC, NormalizedDimension  # noqa: F401
+from geomesa_tpu.curves.xz import XZ2SFC, XZ3SFC  # noqa: F401

@@ -1,0 +1,95 @@
+"""Epoch time -> (bin, offset) decomposition.
+
+Capability parity with the reference's ``BinnedTime``
+(geomesa-z3/.../curve/BinnedTime.scala:48-283): timestamps are split into a
+coarse period bin (day/week/month/year since epoch) and a millisecond offset
+within the bin. The bin becomes the leading component of the Z3 sort key; the
+offset is the (normalized) time dimension of the Z3 curve.
+
+All conversions are vectorized numpy — they run over whole ingest batches.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Tuple
+
+import numpy as np
+
+DAY_MS = 86_400_000
+WEEK_MS = 7 * DAY_MS
+# Fixed maxima so the curve's time dimension has a static extent (the reference
+# uses the same trick: max month = 31 days, max year = 366 days).
+MONTH_MS = 31 * DAY_MS
+YEAR_MS = 366 * DAY_MS
+
+
+class TimePeriod(str, enum.Enum):
+    DAY = "day"
+    WEEK = "week"
+    MONTH = "month"
+    YEAR = "year"
+
+    @staticmethod
+    def parse(s: "str | TimePeriod") -> "TimePeriod":
+        if isinstance(s, TimePeriod):
+            return s
+        return TimePeriod(str(s).strip().lower())
+
+
+class BinnedTime:
+    """Vectorized epoch-ms <-> (bin, offset-ms) codec for a time period."""
+
+    def __init__(self, period: "str | TimePeriod" = TimePeriod.WEEK):
+        self.period = TimePeriod.parse(period)
+
+    @property
+    def max_offset_ms(self) -> int:
+        return {
+            TimePeriod.DAY: DAY_MS,
+            TimePeriod.WEEK: WEEK_MS,
+            TimePeriod.MONTH: MONTH_MS,
+            TimePeriod.YEAR: YEAR_MS,
+        }[self.period]
+
+    def to_bin_and_offset(self, epoch_ms: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """epoch_ms (int64) -> (bin int32, offset_ms int64). Vectorized."""
+        t = np.asarray(epoch_ms, dtype=np.int64)
+        if self.period == TimePeriod.DAY:
+            b = np.floor_divide(t, DAY_MS)
+            off = t - b * DAY_MS
+        elif self.period == TimePeriod.WEEK:
+            b = np.floor_divide(t, WEEK_MS)
+            off = t - b * WEEK_MS
+        elif self.period == TimePeriod.MONTH:
+            dt = t.view(np.int64).astype("datetime64[ms]")
+            months = dt.astype("datetime64[M]")
+            b = months.astype(np.int64)  # months since 1970-01
+            off = (dt - months).astype("timedelta64[ms]").astype(np.int64)
+        else:  # YEAR
+            dt = t.view(np.int64).astype("datetime64[ms]")
+            years = dt.astype("datetime64[Y]")
+            b = years.astype(np.int64)  # years since 1970
+            off = (dt - years).astype("timedelta64[ms]").astype(np.int64)
+        return b.astype(np.int32), off.astype(np.int64)
+
+    def bin_start_ms(self, b: np.ndarray) -> np.ndarray:
+        """bin -> epoch ms of the bin's start. Vectorized."""
+        b = np.asarray(b)
+        if self.period == TimePeriod.DAY:
+            return b.astype(np.int64) * DAY_MS
+        if self.period == TimePeriod.WEEK:
+            return b.astype(np.int64) * WEEK_MS
+        if self.period == TimePeriod.MONTH:
+            return b.astype("datetime64[M]").astype("datetime64[ms]").astype(np.int64)
+        return b.astype("datetime64[Y]").astype("datetime64[ms]").astype(np.int64)
+
+    def bin_of(self, epoch_ms: int) -> int:
+        b, _ = self.to_bin_and_offset(np.asarray([epoch_ms], dtype=np.int64))
+        return int(b[0])
+
+    def bins_between(self, lo_ms: int, hi_ms: int) -> np.ndarray:
+        """All bins touched by [lo_ms, hi_ms] inclusive."""
+        lo_b = self.bin_of(int(lo_ms))
+        hi_b = self.bin_of(int(hi_ms))
+        return np.arange(lo_b, hi_b + 1, dtype=np.int32)

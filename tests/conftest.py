@@ -1,0 +1,22 @@
+"""Test fixture: force an 8-virtual-device CPU backend before jax imports.
+
+This is the analog of the reference's in-process mini-clusters (SURVEY.md §4.3):
+the full planner/executor/sharding stack runs against fake devices with no real
+TPU, exactly as TestGeoMesaDataStore exercises the full planner with an
+in-memory adapter.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def rng():
+    return np.random.default_rng(42)
