@@ -1,0 +1,28 @@
+"""Mergeable statistics sketches (L0).
+
+Capability parity with the reference's stats package
+(geomesa-utils/.../stats/Stat.scala:31-86 and siblings; SURVEY.md §2.1):
+Count, MinMax, Enumeration, TopK, Histogram (binned), Frequency (count-min),
+DescriptiveStats, GroupBy, Z3Histogram — each a mergeable sketch.
+
+TPU-first design: every sketch's state is a small set of fixed-shape numpy
+arrays, so the same state can be produced by a jit'd device reduction
+(kernels/stats_scan.py), merged across shards with ``psum``/tree-map, and
+persisted for the cost-based query planner (the reference's
+StatsBasedEstimator role).
+"""
+
+from geomesa_tpu.stats.sketches import (  # noqa: F401
+    Stat,
+    SeqStat,
+    CountStat,
+    MinMax,
+    EnumerationStat,
+    TopK,
+    Histogram,
+    Frequency,
+    DescriptiveStats,
+    GroupBy,
+    Z3HistogramStat,
+)
+from geomesa_tpu.stats.parser import parse_stat  # noqa: F401
