@@ -52,6 +52,23 @@ class BinnedTime:
             TimePeriod.YEAR: YEAR_MS,
         }[self.period]
 
+    @property
+    def off_scale(self) -> int:
+        """Offset quantization (ms per unit) so a scaled offset fits int32 —
+        the device time representation (no 64-bit ints on the TPU fast path).
+        Day/week are exact (1 ms); month/year quantize to 4/16 ms."""
+        return {
+            TimePeriod.DAY: 1,
+            TimePeriod.WEEK: 1,
+            TimePeriod.MONTH: 4,
+            TimePeriod.YEAR: 16,
+        }[self.period]
+
+    def to_scaled(self, epoch_ms: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+        """epoch_ms -> (bin int32, scaled-offset int32) device columns."""
+        b, off = self.to_bin_and_offset(epoch_ms)
+        return b, (off // self.off_scale).astype(np.int32)
+
     def to_bin_and_offset(self, epoch_ms: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """epoch_ms (int64) -> (bin int32, offset_ms int64). Vectorized."""
         t = np.asarray(epoch_ms, dtype=np.int64)

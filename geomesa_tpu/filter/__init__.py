@@ -1,0 +1,14 @@
+"""Filter engine (L2).
+
+Capability parity with the reference's geomesa-filter module (SURVEY.md §2.3):
+ECQL text -> predicate IR -> (a) plan-time analysis (extract spatial/temporal
+bounds, the FilterHelper.extractGeometries/extractIntervals analog) and
+(b) a fused boolean-mask kernel over columnar arrays (the FastFilterFactory
+analog — but instead of per-row evaluators, one vectorized expression that XLA
+fuses into the scan).
+"""
+
+from geomesa_tpu.filter import ir  # noqa: F401
+from geomesa_tpu.filter.ecql import parse_ecql  # noqa: F401
+from geomesa_tpu.filter.compile import compile_filter  # noqa: F401
+from geomesa_tpu.filter.ir import extract_geometries, extract_intervals  # noqa: F401

@@ -1,0 +1,416 @@
+"""Predicate IR -> fused columnar mask kernel.
+
+The FastFilterFactory analog (reference
+geomesa-filter/.../factory/FastFilterFactory.scala:40,410): instead of
+rewriting a CQL tree into per-row fast evaluators, we compile it into ONE
+vectorized boolean expression over column arrays. The compiled function is
+backend-generic — pass ``numpy`` for the host path or ``jax.numpy`` inside a
+jit'd scan kernel; XLA fuses the whole mask into the surrounding aggregation.
+
+String predicates are resolved to dictionary codes at compile time (the device
+never sees strings). Geometry literals become captured numpy edge buffers; the
+point-in-polygon test is even-odd crossing parity, vectorized N points × E
+edges per polygon.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from geomesa_tpu.filter import ir
+from geomesa_tpu.schema.columns import DictionaryEncoder
+from geomesa_tpu.schema.feature_type import FeatureType
+from geomesa_tpu.utils import geometry as geo
+
+
+@dataclass
+class CompiledFilter:
+    """A compiled mask kernel. ``fn(cols, xp)`` -> bool mask array."""
+
+    fn: Callable
+    columns: List[str]
+    ecql: Optional[str] = None
+
+    def __call__(self, cols, xp=np):
+        return self.fn(cols, xp)
+
+
+def _geom_cols(ft: FeatureType, prop: str) -> Dict[str, str]:
+    a = ft.attr(prop)
+    if not a.is_geom:
+        raise ValueError(f"attribute {prop!r} is not a geometry")
+    if a.is_point:
+        return {"x": prop + "__x", "y": prop + "__y", "point": "1"}
+    return {
+        "x": prop + "__x", "y": prop + "__y",
+        "xmin": prop + "__xmin", "ymin": prop + "__ymin",
+        "xmax": prop + "__xmax", "ymax": prop + "__ymax",
+    }
+
+
+def _pip_fn(g: geo.Geometry, xcol: str, ycol: str):
+    """Point-in-(multi)polygon via even-odd crossing parity (holes included
+    naturally by the even-odd rule). Returns fn(cols, xp) -> mask."""
+    polys = g.polygons if isinstance(g, geo.MultiPolygon) else (g,)
+    # Fast path: single axis-aligned rectangle -> bbox compare (the loose-bbox
+    # trick; reference Z3IndexKeySpace.useFullFilter:235).
+    if len(polys) == 1 and isinstance(polys[0], geo.Polygon) and polys[0].is_rectangle():
+        xmin, ymin, xmax, ymax = polys[0].bounds()
+
+        def rect(cols, xp):
+            x, y = cols[xcol], cols[ycol]
+            return (x >= xmin) & (x <= xmax) & (y >= ymin) & (y <= ymax)
+
+        return rect
+
+    per_poly = []
+    for p in polys:
+        rings = [np.asarray(geo._close_ring(p.shell), np.float64)] + [
+            np.asarray(geo._close_ring(h), np.float64) for h in p.holes
+        ]
+        x1 = np.concatenate([r[:-1, 0] for r in rings])
+        y1 = np.concatenate([r[:-1, 1] for r in rings])
+        x2 = np.concatenate([r[1:, 0] for r in rings])
+        y2 = np.concatenate([r[1:, 1] for r in rings])
+        dy = np.where(y2 - y1 == 0.0, 1.0, y2 - y1)
+        per_poly.append((x1, y1, x2, y2, (x2 - x1) / dy))
+
+    def pip(cols, xp):
+        x = cols[xcol]
+        y = cols[ycol]
+        out = None
+        for (x1, y1, x2, y2, slope) in per_poly:
+            yb = y[:, None]
+            cond = (y1[None, :] > yb) != (y2[None, :] > yb)
+            xint = x1[None, :] + (yb - y1[None, :]) * slope[None, :]
+            crossings = (cond & (x[:, None] < xint)).sum(axis=1)
+            inside = (crossings % 2) == 1
+            out = inside if out is None else (out | inside)
+        return out
+
+    return pip
+
+
+def _like_codes(d: DictionaryEncoder, pattern: str, ci: bool) -> np.ndarray:
+    """Resolve a LIKE pattern against the dictionary vocab -> matching codes."""
+    rx = "".join(
+        ".*" if ch == "%" else "." if ch == "_" else re.escape(ch) for ch in pattern
+    )
+    flags = re.IGNORECASE if ci else 0
+    cre = re.compile("^" + rx + "$", flags)
+    return np.array(
+        [i for i, v in enumerate(d.values) if cre.match(v)], dtype=np.int32
+    )
+
+
+def _isin_fn(col: str, codes: np.ndarray):
+    codes = np.asarray(codes)
+
+    def fn(cols, xp):
+        c = cols[col]
+        if codes.size == 0:
+            return xp.zeros(c.shape, dtype=bool)
+        if codes.size <= 16:
+            m = c == codes[0]
+            for v in codes[1:]:
+                m = m | (c == v)
+            return m
+        return xp.isin(c, codes)
+
+    return fn
+
+
+def compile_filter(
+    f: ir.Filter,
+    ft: FeatureType,
+    dicts: Dict[str, DictionaryEncoder],
+) -> CompiledFilter:
+    """Compile a predicate IR tree into a columnar mask kernel."""
+    needed: List[str] = []
+
+    def need(*cols):
+        for c in cols:
+            if c not in needed:
+                needed.append(c)
+
+    def compile_node(node: ir.Filter) -> Callable:
+        if isinstance(node, ir.Include):
+            return lambda cols, xp: xp.ones(_first_len(cols, xp), dtype=bool)
+        if isinstance(node, ir.Exclude):
+            return lambda cols, xp: xp.zeros(_first_len(cols, xp), dtype=bool)
+        if isinstance(node, ir.And):
+            fns = [compile_node(c) for c in node.children]
+
+            def f_and(cols, xp):
+                m = fns[0](cols, xp)
+                for fn in fns[1:]:
+                    m = m & fn(cols, xp)
+                return m
+
+            return f_and
+        if isinstance(node, ir.Or):
+            fns = [compile_node(c) for c in node.children]
+
+            def f_or(cols, xp):
+                m = fns[0](cols, xp)
+                for fn in fns[1:]:
+                    m = m | fn(cols, xp)
+                return m
+
+            return f_or
+        if isinstance(node, ir.Not):
+            fn = compile_node(node.child)
+            return lambda cols, xp: ~fn(cols, xp)
+
+        if isinstance(node, ir.BBox):
+            gc = _geom_cols(ft, node.prop)
+            xmin, ymin, xmax, ymax = node.xmin, node.ymin, node.xmax, node.ymax
+            if "point" in gc:
+                need(gc["x"], gc["y"])
+                xc, yc = gc["x"], gc["y"]
+
+                def bbox_pt(cols, xp):
+                    x, y = cols[xc], cols[yc]
+                    return (x >= xmin) & (x <= xmax) & (y >= ymin) & (y <= ymax)
+
+                return bbox_pt
+            need(gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
+            ks = (gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
+
+            def bbox_ext(cols, xp):
+                return (
+                    (cols[ks[0]] <= xmax) & (cols[ks[2]] >= xmin)
+                    & (cols[ks[1]] <= ymax) & (cols[ks[3]] >= ymin)
+                )
+
+            return bbox_ext
+
+        if isinstance(node, ir.Spatial):
+            gc = _geom_cols(ft, node.prop)
+            b = node.geom.bounds()
+            if "point" in gc:
+                need(gc["x"], gc["y"])
+                if node.op in ("intersects", "within", "contains"):
+                    if isinstance(node.geom, (geo.Polygon, geo.MultiPolygon)):
+                        return _pip_fn(node.geom, gc["x"], gc["y"])
+                    # point/line literal: intersects ~= tiny-bbox test
+                    xc, yc = gc["x"], gc["y"]
+
+                    def near(cols, xp):
+                        x, y = cols[xc], cols[yc]
+                        return (x >= b[0]) & (x <= b[2]) & (y >= b[1]) & (y <= b[3])
+
+                    return near
+                if node.op == "disjoint":
+                    inner = compile_node(ir.Spatial("intersects", node.prop, node.geom))
+                    return lambda cols, xp: ~inner(cols, xp)
+            else:
+                # extent attribute: bbox-overlap approximation at key level;
+                # exact geometry refinement is a host post-pass (SURVEY §7
+                # hard part (a)).
+                need(gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
+                ks = (gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
+
+                def overlap(cols, xp):
+                    m = (
+                        (cols[ks[0]] <= b[2]) & (cols[ks[2]] >= b[0])
+                        & (cols[ks[1]] <= b[3]) & (cols[ks[3]] >= b[1])
+                    )
+                    return ~m if node.op == "disjoint" else m
+
+                return overlap
+
+        if isinstance(node, ir.DWithin):
+            gc = _geom_cols(ft, node.prop)
+            need(gc["x"], gc["y"])
+            xc, yc = gc["x"], gc["y"]
+            if isinstance(node.geom, geo.Point):
+                px, py, dist = node.geom.x, node.geom.y, node.distance_m
+
+                def dwithin(cols, xp):
+                    x, y = cols[xc], cols[yc]
+                    rx1, ry1 = xp.radians(x), xp.radians(y)
+                    rx2, ry2 = np.radians(px), np.radians(py)
+                    a = (
+                        xp.sin((ry2 - ry1) / 2) ** 2
+                        + xp.cos(ry1) * np.cos(ry2) * xp.sin((rx2 - rx1) / 2) ** 2
+                    )
+                    d = 2 * geo.EARTH_RADIUS_M * xp.arcsin(xp.sqrt(xp.clip(a, 0, 1)))
+                    return d <= dist
+
+                return dwithin
+            # non-point literal: expanded-bbox approximation
+            d_deg = node.distance_m / geo.METERS_PER_DEGREE
+            bb = node.geom.bounds()
+            maxlat = min(89.0, max(abs(bb[1]), abs(bb[3])))
+            dx = d_deg / max(np.cos(np.radians(maxlat)), 1e-3)
+            exp = (bb[0] - dx, bb[1] - d_deg, bb[2] + dx, bb[3] + d_deg)
+
+            def dwithin_box(cols, xp):
+                x, y = cols[xc], cols[yc]
+                return (x >= exp[0]) & (x <= exp[2]) & (y >= exp[1]) & (y <= exp[3])
+
+            return dwithin_box
+
+        if isinstance(node, ir.Compare):
+            a = ft.attr(node.prop)
+            col = node.prop
+            need(col)
+            if a.type == "string":
+                d = dicts.setdefault(node.prop, DictionaryEncoder())
+                if node.op == "=":
+                    code = d.code_of(str(node.value))
+                    return lambda cols, xp: cols[col] == code
+                if node.op == "<>":
+                    code = d.code_of(str(node.value))
+                    return lambda cols, xp: (cols[col] != code) & (cols[col] >= 0)
+                # ordering on strings: resolve against vocab on host
+                sval = str(node.value)
+                ops = {
+                    "<": lambda v: v < sval, "<=": lambda v: v <= sval,
+                    ">": lambda v: v > sval, ">=": lambda v: v >= sval,
+                }[node.op]
+                codes = np.array(
+                    [i for i, v in enumerate(d.values) if ops(v)], dtype=np.int32
+                )
+                return _isin_fn(col, codes)
+            if a.type == "bool":
+                bv = (
+                    node.value
+                    if isinstance(node.value, bool)
+                    else str(node.value).lower() == "true"
+                )
+                if node.op == "=":
+                    return lambda cols, xp: cols[col] == bv
+                if node.op == "<>":
+                    return lambda cols, xp: cols[col] != bv
+                raise ValueError(f"unsupported boolean comparison {node.op!r}")
+            val = node.value
+            if a.type == "date":
+                if not isinstance(val, (int, np.integer)):
+                    from geomesa_tpu.filter.ecql import parse_iso_ms
+
+                    val = parse_iso_ms(str(val))
+                v = int(val)
+                # rewrite to interval form -> (bin, off) pair compare
+                if node.op == "=":
+                    return compile_node(ir.During(node.prop, v, v))
+                if node.op == "<>":
+                    return compile_node(ir.Not(ir.During(node.prop, v, v)))
+                if node.op == "<":
+                    return compile_node(ir.During(node.prop, ir.MIN_MS, v - 1))
+                if node.op == "<=":
+                    return compile_node(ir.During(node.prop, ir.MIN_MS, v))
+                if node.op == ">":
+                    return compile_node(ir.During(node.prop, v + 1, ir.MAX_MS))
+                if node.op == ">=":
+                    return compile_node(ir.During(node.prop, v, ir.MAX_MS))
+            val = float(val) if a.type in ("float32", "float64") else int(val)
+            op = node.op
+            if op == "=":
+                return lambda cols, xp: cols[col] == val
+            if op == "<>":
+                return lambda cols, xp: cols[col] != val
+            if op == "<":
+                return lambda cols, xp: cols[col] < val
+            if op == "<=":
+                return lambda cols, xp: cols[col] <= val
+            if op == ">":
+                return lambda cols, xp: cols[col] > val
+            if op == ">=":
+                return lambda cols, xp: cols[col] >= val
+
+        if isinstance(node, ir.Between):
+            inner = ir.And(
+                (ir.Compare(node.prop, ">=", node.lo), ir.Compare(node.prop, "<=", node.hi))
+            )
+            return compile_node(inner)
+
+        if isinstance(node, ir.In):
+            a = ft.attr(node.prop)
+            need(node.prop)
+            if a.type == "string":
+                d = dicts.setdefault(node.prop, DictionaryEncoder())
+                codes = np.array(
+                    [d.code_of(str(v)) for v in node.values], dtype=np.int32
+                )
+                codes = codes[codes >= 0]
+                return _isin_fn(node.prop, codes)
+            vals = np.array(
+                [float(v) if a.type.startswith("float") else int(v) for v in node.values]
+            )
+            return _isin_fn(node.prop, vals)
+
+        if isinstance(node, ir.Like):
+            a = ft.attr(node.prop)
+            if a.type != "string":
+                raise ValueError(f"LIKE requires a string attribute, got {a.type}")
+            need(node.prop)
+            d = dicts.setdefault(node.prop, DictionaryEncoder())
+            return _isin_fn(node.prop, _like_codes(d, node.pattern, node.case_insensitive))
+
+        if isinstance(node, ir.IsNull):
+            a = ft.attr(node.prop)
+            need(node.prop)
+            col = node.prop
+            if a.type == "string":
+                fn = lambda cols, xp: cols[col] < 0  # noqa: E731
+            elif a.type.startswith("float"):
+                fn = lambda cols, xp: xp.isnan(cols[col])  # noqa: E731
+            else:
+                fn = lambda cols, xp: xp.zeros(cols[col].shape, dtype=bool)  # noqa: E731
+            if node.negate:
+                return lambda cols, xp: ~fn(cols, xp)
+            return fn
+
+        if isinstance(node, ir.During):
+            # Temporal predicates run on the (bin, scaled-offset) int32 pair —
+            # the device time representation. Lexicographic pair compare.
+            from geomesa_tpu.curves.binned_time import BinnedTime
+
+            bt = BinnedTime(ft.time_period)
+            scale = bt.off_scale
+            CLAMP = 2**45  # ~±1100 years; keeps bins in int32
+            lo = max(min(node.lo_ms, CLAMP), -CLAMP)
+            hi = max(min(node.hi_ms, CLAMP), -CLAMP)
+            lo_b, lo_o = (int(v[0]) for v in bt.to_bin_and_offset(np.asarray([lo])))
+            hi_b, hi_o = (int(v[0]) for v in bt.to_bin_and_offset(np.asarray([hi])))
+            # floor-quantize both sides; quantization fuzz is < scale ms
+            lo_o //= scale
+            hi_o //= scale
+            cb, co = node.prop + "__bin", node.prop + "__off"
+            need(cb, co)
+
+            def during(cols, xp):
+                b, o = cols[cb], cols[co]
+                ge = (b > lo_b) | ((b == lo_b) & (o >= lo_o))
+                le = (b < hi_b) | ((b == hi_b) & (o <= hi_o))
+                return ge & le
+
+            return during
+
+        if isinstance(node, ir.IdIn):
+            need("__fid__")
+            ids = set(node.ids)
+
+            def fid_mask(cols, xp):
+                fids = cols["__fid__"]
+                # host-only column (object dtype)
+                return np.array([f in ids for f in fids], dtype=bool)
+
+            return fid_mask
+
+        raise ValueError(f"cannot compile filter node: {node!r}")
+
+    fn = compile_node(f)
+    return CompiledFilter(fn, needed)
+
+
+def _first_len(cols, xp):
+    for v in cols.values():
+        return v.shape[0]
+    return 0

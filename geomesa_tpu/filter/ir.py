@@ -1,0 +1,322 @@
+"""Predicate intermediate representation + plan-time analysis.
+
+The IR is the common currency between the ECQL parser, the query planner
+(index selection from extracted bounds — FilterHelper.extractGeometries /
+extractIntervals analogs, reference filter/FilterHelper.scala), and the mask
+compiler.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.utils import geometry as geo
+
+MIN_MS = -(2**62)
+MAX_MS = 2**62
+
+
+class Filter:
+    def __and__(self, other):
+        return And([self, other])
+
+    def __or__(self, other):
+        return Or([self, other])
+
+    def __invert__(self):
+        return Not(self)
+
+
+@dataclass(frozen=True)
+class Include(Filter):
+    """Match everything (ECQL INCLUDE)."""
+
+
+@dataclass(frozen=True)
+class Exclude(Filter):
+    """Match nothing (ECQL EXCLUDE)."""
+
+
+@dataclass(frozen=True)
+class And(Filter):
+    children: Sequence[Filter]
+
+
+@dataclass(frozen=True)
+class Or(Filter):
+    children: Sequence[Filter]
+
+
+@dataclass(frozen=True)
+class Not(Filter):
+    child: Filter
+
+
+@dataclass(frozen=True)
+class BBox(Filter):
+    prop: str
+    xmin: float
+    ymin: float
+    xmax: float
+    ymax: float
+
+
+@dataclass(frozen=True)
+class Spatial(Filter):
+    """INTERSECTS / CONTAINS / WITHIN / DISJOINT / CROSSES(approx)."""
+
+    op: str  # intersects | contains | within | disjoint
+    prop: str
+    geom: geo.Geometry
+
+
+@dataclass(frozen=True)
+class DWithin(Filter):
+    prop: str
+    geom: geo.Geometry
+    distance_m: float
+
+
+@dataclass(frozen=True)
+class Compare(Filter):
+    """=, <>, <, <=, >, >= on a scalar attribute."""
+
+    prop: str
+    op: str
+    value: object  # float | int | str | np.int64 epoch-ms for dates
+
+
+@dataclass(frozen=True)
+class Between(Filter):
+    prop: str
+    lo: object
+    hi: object
+
+
+@dataclass(frozen=True)
+class In(Filter):
+    prop: str
+    values: Tuple[object, ...]
+
+
+@dataclass(frozen=True)
+class Like(Filter):
+    prop: str
+    pattern: str
+    case_insensitive: bool = False
+
+
+@dataclass(frozen=True)
+class IsNull(Filter):
+    prop: str
+    negate: bool = False
+
+
+@dataclass(frozen=True)
+class During(Filter):
+    """Temporal interval (also covers BEFORE/AFTER/TEQUALS via open bounds)."""
+
+    prop: str
+    lo_ms: int  # inclusive
+    hi_ms: int  # inclusive
+
+
+@dataclass(frozen=True)
+class IdIn(Filter):
+    """Feature-id filter (ECQL ``IN ('id1', 'id2')`` with no property)."""
+
+    ids: Tuple[str, ...]
+
+
+# ---------------------------------------------------------------------------
+# Analysis: pull spatial / temporal / attribute bounds out of a filter tree
+# (reference FilterHelper.extractGeometries:/.extractIntervals)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class FilterValues:
+    """Extracted values plus a 'disjoint' flag (provably-empty query)."""
+
+    values: list
+    disjoint: bool = False
+
+    @property
+    def is_empty(self):
+        return not self.values and not self.disjoint
+
+
+def extract_geometries(f: Filter, geom_prop: str) -> FilterValues:
+    """Extract the spatial query geometries constraining ``geom_prop``.
+
+    Returns geometries whose union bounds the query window (over-approximate
+    for Or, intersection-of-bboxes for And). Conservative: anything not
+    understood widens to unbounded (empty list).
+    """
+
+    def walk(node: Filter) -> Optional[List[geo.Geometry]]:
+        # None = unbounded
+        if isinstance(node, BBox) and node.prop == geom_prop:
+            return [geo.bbox_polygon(node.xmin, node.ymin, node.xmax, node.ymax)]
+        if isinstance(node, Spatial) and node.prop == geom_prop:
+            if node.op in ("intersects", "contains", "within"):
+                return [node.geom]
+            return None  # disjoint etc: unbounded
+        if isinstance(node, DWithin) and node.prop == geom_prop:
+            d = node.distance_m / geo.METERS_PER_DEGREE
+            b = node.geom.bounds()
+            # widen longitude by latitude-dependent factor (conservative)
+            maxlat = min(89.0, max(abs(b[1]), abs(b[3])))
+            dx = d / max(np.cos(np.radians(maxlat)), 1e-3)
+            return [geo.bbox_polygon(b[0] - dx, b[1] - d, b[2] + dx, b[3] + d)]
+        if isinstance(node, And):
+            bounds = None
+            geoms = None
+            for c in node.children:
+                g = walk(c)
+                if g is None:
+                    continue
+                if geoms is None:
+                    geoms, bounds = g, _union_bounds(g)
+                else:
+                    nb = _union_bounds(g)
+                    inter = _intersect_bounds(bounds, nb)
+                    if inter is None:
+                        return []  # provably disjoint
+                    # keep the more selective (smaller-area) geometry list
+                    if _area(nb) < _area(bounds):
+                        geoms = g
+                    bounds = inter
+            return geoms
+        if isinstance(node, Or):
+            out = []
+            for c in node.children:
+                g = walk(c)
+                if g is None:
+                    return None  # one unbounded arm -> unbounded
+                out.extend(g)
+            return out
+        if isinstance(node, Exclude):
+            return []
+        return None
+
+    g = walk(f)
+    if g is None:
+        return FilterValues([])
+    if g == []:
+        return FilterValues([], disjoint=True)
+    return FilterValues(g)
+
+
+def extract_intervals(f: Filter, dtg_prop: str) -> FilterValues:
+    """Extract temporal [lo_ms, hi_ms] intervals constraining ``dtg_prop``."""
+
+    def walk(node: Filter) -> Optional[List[Tuple[int, int]]]:
+        if isinstance(node, During) and node.prop == dtg_prop:
+            return [(node.lo_ms, node.hi_ms)]
+        if isinstance(node, Compare) and node.prop == dtg_prop:
+            v = int(node.value)
+            if node.op == "=":
+                return [(v, v)]
+            if node.op in ("<", "<="):
+                return [(MIN_MS, v)]
+            if node.op in (">", ">="):
+                return [(v, MAX_MS)]
+            return None
+        if isinstance(node, Between) and node.prop == dtg_prop:
+            return [(int(node.lo), int(node.hi))]
+        if isinstance(node, And):
+            acc = None
+            for c in node.children:
+                iv = walk(c)
+                if iv is None:
+                    continue
+                if acc is None:
+                    acc = iv
+                else:
+                    merged = []
+                    for (a0, a1) in acc:
+                        for (b0, b1) in iv:
+                            lo, hi = max(a0, b0), min(a1, b1)
+                            if lo <= hi:
+                                merged.append((lo, hi))
+                    if not merged:
+                        return []
+                    acc = merged
+            return acc
+        if isinstance(node, Or):
+            out = []
+            for c in node.children:
+                iv = walk(c)
+                if iv is None:
+                    return None
+                out.extend(iv)
+            return out
+        if isinstance(node, Exclude):
+            return []
+        return None
+
+    iv = walk(f)
+    if iv is None:
+        return FilterValues([])
+    if iv == []:
+        return FilterValues([], disjoint=True)
+    return FilterValues(_merge_intervals(iv))
+
+
+def extract_ids(f: Filter) -> Optional[Tuple[str, ...]]:
+    if isinstance(f, IdIn):
+        return f.ids
+    if isinstance(f, And):
+        for c in f.children:
+            ids = extract_ids(c)
+            if ids is not None:
+                return ids
+    return None
+
+
+def props_referenced(f: Filter) -> List[str]:
+    out: List[str] = []
+
+    def walk(node):
+        if isinstance(node, (And, Or)):
+            for c in node.children:
+                walk(c)
+        elif isinstance(node, Not):
+            walk(node.child)
+        elif hasattr(node, "prop"):
+            if node.prop not in out:
+                out.append(node.prop)
+
+    walk(f)
+    return out
+
+
+def _merge_intervals(iv: List[Tuple[int, int]]) -> List[Tuple[int, int]]:
+    iv = sorted(iv)
+    out = [iv[0]]
+    for lo, hi in iv[1:]:
+        if lo <= out[-1][1] + 1:
+            out[-1] = (out[-1][0], max(out[-1][1], hi))
+        else:
+            out.append((lo, hi))
+    return out
+
+
+def _union_bounds(geoms: List[geo.Geometry]):
+    bs = np.asarray([g.bounds() for g in geoms])
+    return (bs[:, 0].min(), bs[:, 1].min(), bs[:, 2].max(), bs[:, 3].max())
+
+
+def _intersect_bounds(a, b):
+    lo = (max(a[0], b[0]), max(a[1], b[1]))
+    hi = (min(a[2], b[2]), min(a[3], b[3]))
+    if lo[0] > hi[0] or lo[1] > hi[1]:
+        return None
+    return (lo[0], lo[1], hi[0], hi[1])
+
+
+def _area(b) -> float:
+    return max(b[2] - b[0], 0.0) * max(b[3] - b[1], 0.0)

@@ -1,0 +1,2 @@
+from geomesa_tpu.schema.feature_type import FeatureType, AttributeSpec  # noqa: F401
+from geomesa_tpu.schema.columns import ColumnBatch, DictionaryEncoder  # noqa: F401

@@ -1,0 +1,237 @@
+"""Columnar feature encoding.
+
+The TPU replacement for the reference's Kryo lazy row serialization
+(KryoFeatureSerializer / KryoBufferSimpleFeature, SURVEY.md §2.2): features
+are struct-of-arrays. Encoded column names:
+
+* scalar attribute ``a``     -> column ``a`` (int32/int64/float32/float64/bool)
+* string attribute ``s``     -> column ``s`` = int32 dictionary codes (-1 = null)
+* date attribute ``d``       -> column ``d`` = int64 epoch-ms
+* point geometry ``g``       -> columns ``g__x``, ``g__y`` (float64)
+* non-point geometry ``g``   -> ``g__xmin/__ymin/__xmax/__ymax`` (float64 bbox)
+                                plus host-side object column ``g__wkt``
+* feature id                 -> host-side object column ``__fid__``
+
+Device uploads additionally carry normalized/fixed-point views and curve keys
+(computed by the index layer, see geomesa_tpu/index/).
+"""
+
+from __future__ import annotations
+
+import uuid
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+from geomesa_tpu.schema.feature_type import FeatureType
+from geomesa_tpu.utils import geometry as geo
+
+
+class DictionaryEncoder:
+    """Growable string -> int32 code dictionary (Arrow-style).
+
+    The device never sees strings: equality/IN/LIKE predicates are resolved to
+    code comparisons at plan time (the analog of the reference's Arrow
+    dictionary encoding, geomesa-arrow/.../ArrowDictionary).
+    """
+
+    def __init__(self, values: Optional[List[str]] = None):
+        self.values: List[str] = list(values or [])
+        self._index: Dict[str, int] = {v: i for i, v in enumerate(self.values)}
+
+    def __len__(self):
+        return len(self.values)
+
+    def encode(self, vals: Sequence[Optional[str]]) -> np.ndarray:
+        out = np.empty(len(vals), dtype=np.int32)
+        idx = self._index
+        values = self.values
+        for i, v in enumerate(vals):
+            if v is None:
+                out[i] = -1
+                continue
+            v = str(v)
+            code = idx.get(v)
+            if code is None:
+                code = len(values)
+                values.append(v)
+                idx[v] = code
+            out[i] = code
+        return out
+
+    def code_of(self, v: str) -> int:
+        """Lookup without growing; -2 if absent (matches nothing, incl. nulls)."""
+        return self._index.get(str(v), -2)
+
+    def decode(self, codes: np.ndarray) -> List[Optional[str]]:
+        return [None if c < 0 else self.values[c] for c in codes.tolist()]
+
+    def to_list(self) -> List[str]:
+        return list(self.values)
+
+
+@dataclass
+class ColumnBatch:
+    """A batch of features as columns."""
+
+    columns: Dict[str, np.ndarray]
+    n: int
+
+    def __getitem__(self, k):
+        return self.columns[k]
+
+    def __contains__(self, k):
+        return k in self.columns
+
+    def select(self, mask: np.ndarray) -> "ColumnBatch":
+        return ColumnBatch(
+            {k: v[mask] for k, v in self.columns.items()}, int(np.sum(mask))
+        )
+
+    @staticmethod
+    def concat(batches: List["ColumnBatch"]) -> "ColumnBatch":
+        if not batches:
+            return ColumnBatch({}, 0)
+        keys = batches[0].columns.keys()
+        return ColumnBatch(
+            {k: np.concatenate([b.columns[k] for b in batches]) for k in keys},
+            sum(b.n for b in batches),
+        )
+
+
+def _to_epoch_ms(vals) -> np.ndarray:
+    a = np.asarray(vals)
+    if a.dtype.kind == "M":  # datetime64
+        return a.astype("datetime64[ms]").astype(np.int64)
+    if a.dtype.kind in "iuf":
+        return a.astype(np.int64)
+    # strings / datetimes / objects -> via numpy datetime parsing
+    return np.array(
+        [np.datetime64(v, "ms").astype(np.int64) for v in a], dtype=np.int64
+    )
+
+
+def encode_batch(
+    ft: FeatureType,
+    data: Dict[str, Any],
+    dicts: Dict[str, DictionaryEncoder],
+    fids: Optional[Sequence[str]] = None,
+) -> ColumnBatch:
+    """Encode raw attribute arrays into the columnar layout.
+
+    ``data`` maps attribute name -> array-like. Geometry attributes accept:
+    separate ``<name>__x``/``<name>__y`` arrays in ``data``, an array of
+    (x, y) pairs, Geometry objects, or WKT strings.
+    """
+    cols: Dict[str, np.ndarray] = {}
+    n = None
+
+    def set_n(m):
+        nonlocal n
+        if n is None:
+            n = m
+        elif n != m:
+            raise ValueError(f"ragged batch: {m} != {n}")
+
+    for a in ft.attributes:
+        if a.is_geom:
+            xk, yk = a.name + "__x", a.name + "__y"
+            if xk in data:
+                xs = np.asarray(data[xk], np.float64)
+                ys = np.asarray(data[yk], np.float64)
+                set_n(len(xs))
+                cols[xk], cols[yk] = xs, ys
+                continue
+            vals = data.get(a.name)
+            if vals is None:
+                raise KeyError(f"missing geometry attribute {a.name!r}")
+            vals = list(vals)
+            set_n(len(vals))
+            if a.is_point:
+                xs = np.empty(len(vals), np.float64)
+                ys = np.empty(len(vals), np.float64)
+                for i, v in enumerate(vals):
+                    if isinstance(v, geo.Point):
+                        xs[i], ys[i] = v.x, v.y
+                    elif isinstance(v, str):
+                        p = geo.parse_wkt(v)
+                        xs[i], ys[i] = p.x, p.y
+                    else:
+                        xs[i], ys[i] = float(v[0]), float(v[1])
+                cols[xk], cols[yk] = xs, ys
+            else:
+                geoms = [
+                    v if isinstance(v, geo.Geometry) else geo.parse_wkt(str(v))
+                    for v in vals
+                ]
+                b = np.asarray([g.bounds() for g in geoms], np.float64)
+                cols[a.name + "__xmin"] = b[:, 0]
+                cols[a.name + "__ymin"] = b[:, 1]
+                cols[a.name + "__xmax"] = b[:, 2]
+                cols[a.name + "__ymax"] = b[:, 3]
+                # centroid-ish reference point for distance/knn ops
+                cols[xk] = (b[:, 0] + b[:, 2]) / 2
+                cols[yk] = (b[:, 1] + b[:, 3]) / 2
+                cols[a.name + "__wkt"] = np.array([g.wkt() for g in geoms], dtype=object)
+        elif a.type == "date":
+            vals = data.get(a.name)
+            if vals is None:
+                raise KeyError(f"missing date attribute {a.name!r}")
+            enc = _to_epoch_ms(vals)
+            set_n(len(enc))
+            cols[a.name] = enc
+            # Device time representation: (bin, scaled offset) int32 pair —
+            # epoch-ms int64 can't ride the TPU int32 fast path (SURVEY §7
+            # hard part (g)); temporal predicates compile to pair compares.
+            from geomesa_tpu.curves.binned_time import BinnedTime
+
+            bt = BinnedTime(ft.time_period)
+            b, off = bt.to_scaled(enc)
+            cols[a.name + "__bin"] = b
+            cols[a.name + "__off"] = off
+        elif a.type == "string":
+            vals = data.get(a.name)
+            if vals is None:
+                raise KeyError(f"missing attribute {a.name!r}")
+            vals = list(vals)
+            set_n(len(vals))
+            d = dicts.setdefault(a.name, DictionaryEncoder())
+            cols[a.name] = d.encode(vals)
+        elif a.type == "bool":
+            vals = np.asarray(data[a.name]).astype(bool)
+            set_n(len(vals))
+            cols[a.name] = vals
+        else:
+            vals = np.asarray(data[a.name]).astype(np.dtype(a.type))
+            set_n(len(vals))
+            cols[a.name] = vals
+
+    if n is None:
+        raise ValueError("empty batch")
+    if fids is None:
+        fids = [uuid.uuid4().hex for _ in range(n)]
+    cols["__fid__"] = np.array(list(fids), dtype=object)
+    return ColumnBatch(cols, n)
+
+
+def decode_batch(
+    ft: FeatureType, batch: ColumnBatch, dicts: Dict[str, DictionaryEncoder]
+) -> Dict[str, Any]:
+    """Columns -> user-facing values (strings decoded, dates as datetime64)."""
+    out: Dict[str, Any] = {"__fid__": batch.columns["__fid__"].tolist()}
+    for a in ft.attributes:
+        if a.is_geom:
+            if a.name + "__wkt" in batch.columns:
+                out[a.name] = batch.columns[a.name + "__wkt"].tolist()
+            else:
+                xs = batch.columns[a.name + "__x"]
+                ys = batch.columns[a.name + "__y"]
+                out[a.name] = list(zip(xs.tolist(), ys.tolist()))
+        elif a.type == "date":
+            out[a.name] = batch.columns[a.name].astype("datetime64[ms]")
+        elif a.type == "string":
+            out[a.name] = dicts[a.name].decode(batch.columns[a.name])
+        else:
+            out[a.name] = batch.columns[a.name]
+    return out

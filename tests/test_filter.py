@@ -1,0 +1,182 @@
+"""Filter engine: ECQL parse -> IR -> compiled mask, vs numpy oracles.
+
+Host (numpy) and device (jnp under jit) paths must agree exactly.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.filter import compile_filter, extract_geometries, extract_intervals, parse_ecql
+from geomesa_tpu.filter import ir
+from geomesa_tpu.filter.ecql import parse_iso_ms
+from geomesa_tpu.schema import FeatureType
+from geomesa_tpu.schema.columns import encode_batch
+
+SPEC = "name:String,age:Integer,weight:Double,flag:Boolean,dtg:Date,*geom:Point"
+
+
+@pytest.fixture(scope="module")
+def setup():
+    rng = np.random.default_rng(7)
+    ft = FeatureType.from_spec("t", SPEC)
+    dicts = {}
+    n = 4000
+    data = {
+        "name": [f"n{i % 20}" for i in range(n)],
+        "age": rng.integers(0, 90, n),
+        "weight": rng.uniform(40, 100, n),
+        "flag": rng.integers(0, 2, n).astype(bool),
+        "dtg": rng.integers(
+            parse_iso_ms("2020-01-01"), parse_iso_ms("2020-03-01"), n
+        ).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-80, -70, n),
+        "geom__y": rng.uniform(35, 45, n),
+    }
+    batch = encode_batch(ft, data, dicts)
+    return ft, dicts, batch, data
+
+
+def run(ecql, setup, xp=np):
+    ft, dicts, batch, data = setup
+    f = parse_ecql(ecql)
+    cf = compile_filter(f, ft, dicts)
+    return np.asarray(cf(batch.columns, xp))
+
+
+def test_bbox_and_time(setup):
+    ft, dicts, batch, data = setup
+    got = run(
+        "BBOX(geom, -75, 39, -73, 41) AND dtg DURING 2020-01-10T00:00:00Z/2020-01-20T00:00:00Z",
+        setup,
+    )
+    x, y = data["geom__x"], data["geom__y"]
+    t = batch["dtg"]
+    want = (
+        (x >= -75) & (x <= -73) & (y >= 39) & (y <= 41)
+        & (t >= parse_iso_ms("2020-01-10")) & (t <= parse_iso_ms("2020-01-20"))
+    )
+    np.testing.assert_array_equal(got, want)
+    assert got.sum() > 0
+
+
+def test_attribute_predicates(setup):
+    ft, dicts, batch, data = setup
+    got = run("age >= 18 AND age < 65 AND weight <= 80.5", setup)
+    want = (data["age"] >= 18) & (data["age"] < 65) & (batch["weight"] <= 80.5)
+    np.testing.assert_array_equal(got, want)
+
+    got = run("name = 'n3' OR name IN ('n5', 'n7')", setup)
+    names = np.array([f"n{i % 20}" for i in range(batch.n)])
+    want = (names == "n3") | (names == "n5") | (names == "n7")
+    np.testing.assert_array_equal(got, want)
+
+    got = run("name LIKE 'n1%'", setup)
+    want = np.char.startswith(names, "n1")
+    np.testing.assert_array_equal(got, want)
+
+    got = run("flag = true", setup)
+    np.testing.assert_array_equal(got, data["flag"])
+
+    got = run("age BETWEEN 30 AND 40", setup)
+    want = (data["age"] >= 30) & (data["age"] <= 40)
+    np.testing.assert_array_equal(got, want)
+
+    got = run("NOT (age > 50)", setup)
+    np.testing.assert_array_equal(got, ~(data["age"] > 50))
+
+
+def test_intersects_polygon(setup):
+    ft, dicts, batch, data = setup
+    got = run(
+        "INTERSECTS(geom, POLYGON ((-76 36, -72 36, -72 42, -76 42, -76 36)))", setup
+    )
+    x, y = data["geom__x"], data["geom__y"]
+    want = (x >= -76) & (x <= -72) & (y >= 36) & (y <= 42)
+    np.testing.assert_array_equal(got, want)
+    # non-rectangular: triangle, compare against geometry oracle
+    from geomesa_tpu.utils import geometry as geo
+
+    tri = "POLYGON ((-78 36, -72 36, -75 44, -78 36))"
+    got = run(f"WITHIN(geom, {tri})", setup)
+    oracle = geo.parse_wkt(tri).contains_points(x, y)
+    assert np.mean(got == oracle) > 0.999
+
+
+def test_dwithin(setup):
+    ft, dicts, batch, data = setup
+    got = run("DWITHIN(geom, POINT (-75 40), 100000, meters)", setup)
+    from geomesa_tpu.utils import geometry as geo
+
+    d = geo.haversine_m(data["geom__x"], data["geom__y"], -75, 40)
+    np.testing.assert_array_equal(got, d <= 100000)
+
+
+def test_include_exclude_idin(setup):
+    ft, dicts, batch, data = setup
+    assert run("INCLUDE", setup).all()
+    assert not run("EXCLUDE", setup).any()
+    fid = batch["__fid__"][5]
+    f = parse_ecql(f"IN ('{fid}')")
+    assert isinstance(f, ir.IdIn)
+    cf = compile_filter(f, ft, dicts)
+    got = cf(batch.columns)
+    assert got.sum() == 1 and got[5]
+
+
+def test_device_mask_matches_host(setup):
+    import jax
+    import jax.numpy as jnp
+
+    ft, dicts, batch, data = setup
+    ecql = (
+        "BBOX(geom, -75, 39, -73, 41) AND age > 21 AND name = 'n3'"
+        " AND dtg AFTER 2020-01-15T00:00:00Z"
+    )
+    f = parse_ecql(ecql)
+    cf = compile_filter(f, ft, dicts)
+    host = cf(batch.columns, np)
+    dev_cols = {
+        k: jnp.asarray(v)
+        for k, v in batch.columns.items()
+        if k in cf.columns and v.dtype != object
+    }
+    dev = jax.jit(lambda c: cf(c, jnp))(dev_cols)
+    np.testing.assert_array_equal(np.asarray(dev), host)
+
+
+def test_extract_geometries_and_intervals():
+    f = parse_ecql(
+        "BBOX(geom, -75, 39, -73, 41) AND dtg DURING 2020-01-10T00:00:00Z/2020-01-20T00:00:00Z"
+        " AND age > 21"
+    )
+    g = extract_geometries(f, "geom")
+    assert len(g.values) == 1
+    assert g.values[0].bounds() == (-75, 39, -73, 41)
+    iv = extract_intervals(f, "dtg")
+    assert iv.values == [(parse_iso_ms("2020-01-10"), parse_iso_ms("2020-01-20"))]
+    # disjoint detection
+    f2 = parse_ecql("BBOX(geom, 0, 0, 1, 1) AND BBOX(geom, 5, 5, 6, 6)")
+    assert extract_geometries(f2, "geom").disjoint
+    f3 = parse_ecql(
+        "dtg DURING 2020-01-01T00:00:00Z/2020-01-02T00:00:00Z AND dtg AFTER 2021-01-01T00:00:00Z"
+    )
+    assert extract_intervals(f3, "dtg").disjoint
+    # OR of two windows
+    f4 = parse_ecql("BBOX(geom, 0, 0, 1, 1) OR BBOX(geom, 5, 5, 6, 6)")
+    assert len(extract_geometries(f4, "geom").values) == 2
+
+
+def test_parse_errors():
+    with pytest.raises(ValueError):
+        parse_ecql("age >")
+    with pytest.raises(ValueError):
+        parse_ecql("BBOX(geom, 1, 2)")
+    with pytest.raises(ValueError):
+        parse_ecql("age = 1 extra")
+
+
+def test_unknown_attribute_raises(setup):
+    ft, dicts, batch, data = setup
+    with pytest.raises(KeyError) as e:
+        compile_filter(parse_ecql("bogus = 1"), ft, dicts)
+    assert "bogus" in str(e.value)

@@ -1,0 +1,97 @@
+"""Geometry substrate tests (WKT, point-in-polygon, distance)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.utils import geometry as geo
+
+
+def test_wkt_roundtrip_point():
+    p = geo.parse_wkt("POINT (-73.98 40.75)")
+    assert isinstance(p, geo.Point)
+    assert p.x == -73.98 and p.y == 40.75
+    assert geo.parse_wkt(p.wkt()) == p
+
+
+def test_wkt_polygon_with_hole():
+    wkt = "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), (4 4, 6 4, 6 6, 4 6, 4 4))"
+    p = geo.parse_wkt(wkt)
+    assert isinstance(p, geo.Polygon)
+    assert len(p.holes) == 1
+    assert p.bounds() == (0, 0, 10, 10)
+    p2 = geo.parse_wkt(p.wkt())
+    assert p2.shell == p.shell and p2.holes == p.holes
+
+
+def test_wkt_multipolygon():
+    wkt = "MULTIPOLYGON (((0 0, 2 0, 2 2, 0 2, 0 0)), ((5 5, 7 5, 7 7, 5 7, 5 5)))"
+    m = geo.parse_wkt(wkt)
+    assert isinstance(m, geo.MultiPolygon)
+    assert len(m.polygons) == 2
+    assert geo.parse_wkt(m.wkt()).bounds() == m.bounds()
+
+
+def test_wkt_linestring_and_multipoint():
+    l = geo.parse_wkt("LINESTRING (0 0, 5 5, 10 0)")
+    assert isinstance(l, geo.LineString)
+    assert l.bounds() == (0, 0, 10, 5)
+    mp = geo.parse_wkt("MULTIPOINT ((1 2), (3 4))")
+    assert isinstance(mp, geo.MultiPoint)
+
+
+def test_wkt_errors():
+    with pytest.raises(ValueError):
+        geo.parse_wkt("FROB (1 2)")
+    with pytest.raises(ValueError):
+        geo.parse_wkt("POLYGON ")
+
+
+def test_pip_convex(rng):
+    # triangle
+    p = geo.parse_wkt("POLYGON ((0 0, 10 0, 5 10, 0 0))")
+    xs = rng.uniform(-2, 12, 2000)
+    ys = rng.uniform(-2, 12, 2000)
+    got = p.contains_points(xs, ys)
+    # barycentric oracle
+    def inside(x, y):
+        d1 = (x - 0) * (0 - 0) - (10 - 0) * (y - 0)
+        s = (10 - 0) * (y - 0) - (x - 0) * (0 - 0) >= 0  # left of base
+        a = (5 - 10) * (y - 0) - (x - 10) * (10 - 0) >= 0
+        b = (0 - 5) * (y - 10) - (x - 5) * (0 - 10) >= 0
+        return s and a and b
+    oracle = np.array([inside(x, y) for x, y in zip(xs, ys)])
+    assert np.mean(got == oracle) > 0.999  # allow boundary epsilon cases
+
+
+def test_pip_with_hole(rng):
+    p = geo.parse_wkt(
+        "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0), (4 4, 6 4, 6 6, 4 6, 4 4))"
+    )
+    assert p.contains_points(np.array([2.0]), np.array([2.0]))[0]
+    assert not p.contains_points(np.array([5.0]), np.array([5.0]))[0]  # in hole
+    assert not p.contains_points(np.array([11.0]), np.array([5.0]))[0]
+    # boundary of shell is inside; boundary of hole stays inside
+    assert p.contains_points(np.array([0.0]), np.array([5.0]))[0]
+    assert p.contains_points(np.array([4.0]), np.array([5.0]))[0]
+
+
+def test_is_rectangle():
+    assert geo.bbox_polygon(0, 0, 2, 3).is_rectangle()
+    assert not geo.parse_wkt("POLYGON ((0 0, 10 0, 5 10, 0 0))").is_rectangle()
+
+
+def test_haversine():
+    # JFK -> LAX ~ 3974 km
+    d = geo.haversine_m(-73.7781, 40.6413, -118.4085, 33.9416)
+    assert d == pytest.approx(3.974e6, rel=0.01)
+    assert geo.haversine_m(0, 0, 0, 0) == 0.0
+
+
+def test_edge_buffers_padding():
+    m = geo.parse_wkt(
+        "MULTIPOLYGON (((0 0, 2 0, 2 2, 0 2, 0 0)), ((5 5, 7 5, 7 7, 5 7, 5 5)))"
+    )
+    eb = geo.polygon_edge_buffers(m, pad_to=16)
+    assert len(eb["x1"]) == 16
+    assert eb["n_polys"] == 2
+    assert (eb["sign"][8:] == 0).all()

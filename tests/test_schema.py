@@ -1,0 +1,86 @@
+"""Schema spec parsing + columnar encoding tests."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.schema import ColumnBatch, DictionaryEncoder, FeatureType
+from geomesa_tpu.schema.columns import decode_batch, encode_batch
+
+SPEC = "name:String,age:Integer,weight:Double,dtg:Date,*geom:Point:srid=4326;geomesa.z3.interval='week'"
+
+
+def test_spec_parse_and_roundtrip():
+    ft = FeatureType.from_spec("people", SPEC)
+    assert [a.name for a in ft.attributes] == ["name", "age", "weight", "dtg", "geom"]
+    assert ft.attr("age").type == "int32"
+    assert ft.attr("geom").is_point and ft.attr("geom").default_geom
+    assert ft.geom_field == "geom"
+    assert ft.dtg_field == "dtg"
+    assert ft.time_period == "week"
+    ft2 = FeatureType.from_spec("people", ft.spec())
+    assert [a.type for a in ft2.attributes] == [a.type for a in ft.attributes]
+    assert ft2.user_data == ft.user_data
+
+
+def test_spec_errors():
+    with pytest.raises(ValueError):
+        FeatureType.from_spec("x", "a:Bogus")
+    with pytest.raises(ValueError):
+        FeatureType.from_spec("x", "a")
+    with pytest.raises(ValueError):
+        FeatureType.from_spec("x", "a:Int,a:Int")
+    with pytest.raises(KeyError):
+        FeatureType.from_spec("x", "a:Int").attr("b")
+
+
+def test_encode_decode_batch(rng):
+    ft = FeatureType.from_spec("t", SPEC)
+    dicts = {}
+    n = 100
+    data = {
+        "name": [f"n{i % 5}" for i in range(n)],
+        "age": rng.integers(0, 90, n),
+        "weight": rng.uniform(40, 100, n),
+        "dtg": np.array(["2020-01-01T12:00:00"] * n, dtype="datetime64[ms]"),
+        "geom__x": rng.uniform(-180, 180, n),
+        "geom__y": rng.uniform(-90, 90, n),
+    }
+    batch = encode_batch(ft, data, dicts)
+    assert batch.n == n
+    assert batch["name"].dtype == np.int32
+    assert len(dicts["name"]) == 5
+    assert batch["dtg"].dtype == np.int64
+    dec = decode_batch(ft, batch, dicts)
+    assert dec["name"][:3] == ["n0", "n1", "n2"]
+    np.testing.assert_allclose(dec["geom"][0][0], data["geom__x"][0])
+    assert str(dec["dtg"][0]).startswith("2020-01-01T12:00")
+
+
+def test_encode_wkt_points_and_nonpoint():
+    ft = FeatureType.from_spec("t", "label:String,*geom:Polygon")
+    dicts = {}
+    batch = encode_batch(
+        ft,
+        {
+            "label": ["a"],
+            "geom": ["POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))"],
+        },
+        dicts,
+    )
+    assert batch["geom__xmin"][0] == 0 and batch["geom__xmax"][0] == 4
+    assert batch["geom__x"][0] == 2  # centroid-ish
+
+
+def test_dictionary_encoder_null_and_lookup():
+    d = DictionaryEncoder()
+    codes = d.encode(["a", None, "b", "a"])
+    np.testing.assert_array_equal(codes, [0, -1, 1, 0])
+    assert d.code_of("a") == 0
+    assert d.code_of("zzz") == -2
+    assert d.decode(codes) == ["a", None, "b", "a"]
+
+
+def test_ragged_batch_rejected():
+    ft = FeatureType.from_spec("t", "a:Int,*geom:Point")
+    with pytest.raises(ValueError):
+        encode_batch(ft, {"a": [1, 2], "geom__x": [0.0], "geom__y": [0.0]}, {})
