@@ -1,0 +1,1 @@
+from geomesa_tpu.api.dataset import GeoDataset, Query  # noqa: F401

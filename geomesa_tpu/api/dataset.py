@@ -1,0 +1,348 @@
+"""GeoDataset: the datastore API surface.
+
+Role parity with the reference's GeoMesaDataStore + process layer
+(GeoMesaDataStore.scala:49: schema CRUD, feature writer/reader, query planner
+wiring, stats; geomesa-process: density/stats/unique/sampling/knn/proximity):
+one Python object owning the schema catalog, per-schema FeatureStores, the
+planner, and the executor.
+
+Queries accept ECQL text plus hints. Aggregations (density, stats, knn, ...)
+are first-class methods — the equivalent of GeoMesa's query-hint-driven
+pushdown scans.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.filter import ir, parse_ecql
+from geomesa_tpu.index.store import FeatureStore
+from geomesa_tpu.planning.executor import Executor
+from geomesa_tpu.planning.explain import Explainer
+from geomesa_tpu.planning.planner import QueryHints, QueryPlanner
+from geomesa_tpu.schema.columns import ColumnBatch, DictionaryEncoder, decode_batch
+from geomesa_tpu.schema.feature_type import FeatureType
+from geomesa_tpu.stats import parse_stat
+from geomesa_tpu.stats import sketches as sk
+
+
+@dataclass
+class Query:
+    """A query: ECQL + hints (the GeoTools Query analog)."""
+
+    ecql: str = "INCLUDE"
+    max_features: Optional[int] = None
+    properties: Optional[List[str]] = None
+    sort_by: Optional[List[Tuple[str, bool]]] = None  # (attr, descending)
+    sampling: Optional[int] = None
+    index: Optional[str] = None
+
+    def hints(self) -> QueryHints:
+        return QueryHints(
+            query_index=self.index,
+            sampling=self.sampling,
+            max_features=self.max_features,
+            properties=self.properties,
+            sort_by=self.sort_by,
+        )
+
+
+class FeatureCollection:
+    """Query result: host columns + decode helpers."""
+
+    def __init__(self, ft: FeatureType, batch: ColumnBatch,
+                 dicts: Dict[str, DictionaryEncoder]):
+        self.ft = ft
+        self.batch = batch
+        self.dicts = dicts
+
+    def __len__(self):
+        return self.batch.n
+
+    @property
+    def columns(self):
+        return self.batch.columns
+
+    def to_dict(self) -> Dict[str, Any]:
+        if self.batch.n == 0:
+            return {}
+        return decode_batch(self.ft, self.batch, self.dicts)
+
+    def to_pandas(self):
+        import pandas as pd
+
+        d = self.to_dict()
+        if not d:
+            return pd.DataFrame()
+        geom = self.ft.geom_field
+        if geom in d and isinstance(d[geom], list) and d[geom] and isinstance(d[geom][0], tuple):
+            xs, ys = zip(*d[geom])
+            d[geom + "_x"], d[geom + "_y"] = list(xs), list(ys)
+            del d[geom]
+        return pd.DataFrame(d)
+
+
+class GeoDataset:
+    """Schema catalog + per-schema stores + planner + executor."""
+
+    def __init__(self, mesh=None, n_shards: Optional[int] = None,
+                 prefer_device: bool = True):
+        self.mesh = mesh
+        self.n_shards = n_shards
+        self.prefer_device = prefer_device
+        self._stores: Dict[str, FeatureStore] = {}
+        self.metadata: Dict[str, Dict[str, str]] = {}
+
+    # -- schema CRUD (MetadataBackedDataStore analog) ----------------------
+    def create_schema(self, name_or_ft, spec: Optional[str] = None) -> FeatureType:
+        if isinstance(name_or_ft, FeatureType):
+            ft = name_or_ft
+        else:
+            ft = FeatureType.from_spec(name_or_ft, spec)
+        if ft.name in self._stores:
+            raise ValueError(f"schema {ft.name!r} already exists")
+        self._stores[ft.name] = FeatureStore(ft, self.n_shards)
+        self.metadata[ft.name] = {"spec": ft.spec()}
+        return ft
+
+    def get_schema(self, name: str) -> FeatureType:
+        return self._store(name).ft
+
+    def list_schemas(self) -> List[str]:
+        return sorted(self._stores)
+
+    def delete_schema(self, name: str):
+        self._store(name)  # raise if missing
+        del self._stores[name]
+        del self.metadata[name]
+
+    def describe(self, name: str) -> str:
+        st = self._store(name)
+        lines = [st.ft.describe(), f"  count: {st.count}"]
+        lines.append(f"  indices: {[ks.name for ks in st.keyspaces]}")
+        return "\n".join(lines)
+
+    def _store(self, name: str) -> FeatureStore:
+        st = self._stores.get(name)
+        if st is None:
+            raise KeyError(
+                f"no schema {name!r} (have: {', '.join(sorted(self._stores)) or 'none'})"
+            )
+        return st
+
+    # -- writes ------------------------------------------------------------
+    def insert(self, name: str, data: Dict[str, Any], fids=None) -> int:
+        """Append a batch of features. Call flush() (or query) to index."""
+        return self._store(name).append(data, fids)
+
+    def flush(self, name: Optional[str] = None):
+        for st in ([self._store(name)] if name else self._stores.values()):
+            st.flush()
+
+    def delete_features(self, name: str, ecql: str) -> int:
+        st = self._store(name)
+        f = parse_ecql(ecql)
+        from geomesa_tpu.filter.compile import compile_filter
+
+        cf = compile_filter(f, st.ft, st.dicts)
+        return st.delete(lambda cols: np.asarray(cf(cols, np)))
+
+    # -- planning ----------------------------------------------------------
+    def _plan(self, name: str, query: "str | Query", explain=None):
+        st = self._store(name)
+        st.flush()
+        q = Query(ecql=query) if isinstance(query, str) else query
+        planner = QueryPlanner(st)
+        plan = planner.plan(q.ecql, q.hints(), explain)
+        return st, q, plan
+
+    def explain(self, name: str, query: "str | Query") -> str:
+        exp = Explainer(enabled=True)
+        self._plan(name, query, exp)
+        return str(exp)
+
+    def _executor(self, st: FeatureStore) -> Executor:
+        return Executor(st, self.mesh, self.prefer_device)
+
+    # -- reads -------------------------------------------------------------
+    def query(self, name: str, query: "str | Query" = "INCLUDE") -> FeatureCollection:
+        st, q, plan = self._plan(name, query)
+        batch = self._executor(st).features(plan)
+        # post-processing: sort -> limit -> projection (QueryPlanner.runQuery
+        # order, reference QueryPlanner.scala:68-90)
+        if q.sort_by and batch.n:
+            # stable multi-key sort, least-significant key first
+            order = np.arange(batch.n)
+            for attr, desc in reversed(q.sort_by):
+                col = batch.columns[attr][order]
+                if desc:
+                    o2 = (batch.n - 1) - np.argsort(col[::-1], kind="stable")[::-1]
+                else:
+                    o2 = np.argsort(col, kind="stable")
+                order = order[o2]
+            batch = ColumnBatch(
+                {k: v[order] for k, v in batch.columns.items()}, batch.n
+            )
+        if q.max_features is not None and batch.n > q.max_features:
+            batch = ColumnBatch(
+                {k: v[: q.max_features] for k, v in batch.columns.items()},
+                q.max_features,
+            )
+        if q.properties:
+            keep = set(q.properties) | {"__fid__"}
+            pref = tuple(p + "__" for p in q.properties)
+            batch = ColumnBatch(
+                {
+                    k: v for k, v in batch.columns.items()
+                    if k in keep or k.startswith(pref)
+                },
+                batch.n,
+            )
+        return FeatureCollection(st.ft, batch, st.dicts)
+
+    def count(self, name: str, query: "str | Query" = "INCLUDE",
+              exact: bool = True) -> int:
+        st, q, plan = self._plan(name, query)
+        if not exact:
+            return int(plan.est_count)
+        return self._executor(st).count(plan)
+
+    def bounds(self, name: str) -> Optional[Tuple[float, float, float, float]]:
+        st = self._store(name)
+        st.flush()
+        mm = st.stats.get("bounds")
+        if not isinstance(mm, sk.MinMax) or mm.is_empty:
+            return None
+        return (mm.lo[0], mm.lo[1], mm.hi[0], mm.hi[1])
+
+    # -- analytics (geomesa-process parity) --------------------------------
+    def density(self, name: str, query: "str | Query" = "INCLUDE",
+                bbox=None, width: int = 256, height: int = 256,
+                weight: Optional[str] = None) -> np.ndarray:
+        """Heatmap grid (DensityProcess / DensityScan analog)."""
+        st, q, plan = self._plan(name, query)
+        if bbox is None:
+            bbox = self.bounds(name) or (-180, -90, 180, 90)
+            bbox = (bbox[0], bbox[1], bbox[2], bbox[3])
+        else:
+            bbox = tuple(bbox)
+        return self._executor(st).density(plan, bbox, width, height, weight)
+
+    def stats(self, name: str, stat_spec: str,
+              query: "str | Query" = "INCLUDE") -> sk.Stat:
+        """Exact stats over matching features (StatsProcess/StatsScan analog)."""
+        st, q, plan = self._plan(name, query)
+        stat = parse_stat(stat_spec)
+        return self._executor(st).stats(plan, stat)
+
+    def unique(self, name: str, attribute: str,
+               query: "str | Query" = "INCLUDE") -> List:
+        """Distinct values (UniqueProcess analog)."""
+        st = self._store(name)
+        stat = self.stats(name, f"Enumeration({attribute})", query)
+        vals = list(stat.value().keys())
+        return sorted(vals, key=lambda v: (v is None, v))
+
+    def min_max(self, name: str, attribute: str,
+                query: "str | Query" = "INCLUDE"):
+        """MinMaxProcess analog."""
+        return self.stats(name, f"MinMax({attribute})", query).value()
+
+    def knn(self, name: str, x: float, y: float, k: int = 10,
+            query: "str | Query" = "INCLUDE") -> FeatureCollection:
+        """K nearest neighbors (KNearestNeighborSearchProcess analog)."""
+        st, q, plan = self._plan(name, query)
+        idx, dists = self._executor(st).knn(plan, x, y, k)
+        table = st.tables[plan.index_name]
+        L = table.shard_len
+        mask = np.zeros(table.n_shards * L, dtype=bool)
+        mask[idx] = True
+        batch = table.host_gather(mask)
+        # order by distance
+        if batch.n:
+            xs = batch.columns[st.ft.geom_field + "__x"]
+            ys = batch.columns[st.ft.geom_field + "__y"]
+            from geomesa_tpu.utils.geometry import haversine_m
+
+            d = haversine_m(xs, ys, x, y)
+            order = np.argsort(d)
+            batch = ColumnBatch(
+                {k: v[order] for k, v in batch.columns.items()}, batch.n
+            )
+        return FeatureCollection(st.ft, batch, st.dicts)
+
+    def proximity(self, name: str, wkt_or_geom, distance_m: float,
+                  query: "str | Query" = "INCLUDE") -> FeatureCollection:
+        """ProximitySearchProcess analog: features within distance of a geometry."""
+        from geomesa_tpu.utils import geometry as geo
+
+        g = (
+            geo.parse_wkt(wkt_or_geom) if isinstance(wkt_or_geom, str) else wkt_or_geom
+        )
+        st = self._store(name)
+        base = query.ecql if isinstance(query, Query) else query
+        f = ir.And((
+            parse_ecql(base),
+            ir.DWithin(st.ft.geom_field, g, distance_m),
+        ))
+        planner = QueryPlanner(st)
+        st.flush()
+        plan = planner.plan(f, Query().hints())
+        batch = self._executor(st).features(plan)
+        return FeatureCollection(st.ft, batch, st.dicts)
+
+    # -- persistence (shard-manifest checkpoint, SURVEY.md §5) -------------
+    def save(self, path: str):
+        os.makedirs(path, exist_ok=True)
+        manifest = {"version": 1, "schemas": {}}
+        for name, st in self._stores.items():
+            st.flush()
+            manifest["schemas"][name] = {
+                "spec": st.ft.spec(),
+                "n_shards": st.n_shards,
+                "dicts": {k: d.to_list() for k, d in st.dicts.items()},
+                "stats": {k: v.to_json() for k, v in st.stats.items()},
+            }
+            if st._all is not None:
+                cols = {
+                    k: (v.astype("U") if v.dtype.kind == "O" else v)
+                    for k, v in st._all.columns.items()
+                }
+                np.savez_compressed(os.path.join(path, f"{name}.npz"), **cols)
+        with open(os.path.join(path, "manifest.json"), "w") as fh:
+            json.dump(manifest, fh, indent=2)
+
+    @staticmethod
+    def load(path: str, mesh=None, prefer_device: bool = True) -> "GeoDataset":
+        with open(os.path.join(path, "manifest.json")) as fh:
+            manifest = json.load(fh)
+        ds = GeoDataset(mesh=mesh, prefer_device=prefer_device)
+        for name, meta in manifest["schemas"].items():
+            ft = FeatureType.from_spec(name, meta["spec"])
+            ds.n_shards = meta["n_shards"]
+            ds.create_schema(ft)
+            st = ds._store(name)
+            st.dicts = {
+                k: DictionaryEncoder(v) for k, v in meta["dicts"].items()
+            }
+            st.stats = {k: sk.Stat.from_json(v) for k, v in meta["stats"].items()}
+            npz_path = os.path.join(path, f"{name}.npz")
+            if os.path.exists(npz_path):
+                with np.load(npz_path, allow_pickle=False) as z:
+                    cols = {}
+                    for k in z.files:
+                        v = z[k]
+                        cols[k] = v.astype(object) if v.dtype.kind == "U" else v
+                n = len(next(iter(cols.values()))) if cols else 0
+                st._all = ColumnBatch(cols, n)
+                key_cols = dict(cols)
+                for ks in st.keyspaces:
+                    key_cols.update(ks.index_keys(ft, st._all))
+                    st.tables[ks.name].rebuild(key_cols, st.dicts)
+        ds.n_shards = None
+        return ds

@@ -139,9 +139,10 @@ def compile_filter(
 
     def compile_node(node: ir.Filter) -> Callable:
         if isinstance(node, ir.Include):
-            return lambda cols, xp: xp.ones(_first_len(cols, xp), dtype=bool)
+            # scalar True broadcasts against the window/validity mask
+            return lambda cols, xp: xp.asarray(True)
         if isinstance(node, ir.Exclude):
-            return lambda cols, xp: xp.zeros(_first_len(cols, xp), dtype=bool)
+            return lambda cols, xp: xp.asarray(False)
         if isinstance(node, ir.And):
             fns = [compile_node(c) for c in node.children]
 
@@ -408,9 +409,3 @@ def compile_filter(
 
     fn = compile_node(f)
     return CompiledFilter(fn, needed)
-
-
-def _first_len(cols, xp):
-    for v in cols.values():
-        return v.shape[0]
-    return 0

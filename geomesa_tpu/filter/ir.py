@@ -320,3 +320,64 @@ def _intersect_bounds(a, b):
 
 def _area(b) -> float:
     return max(b[2] - b[0], 0.0) * max(b[3] - b[1], 0.0)
+
+
+def extract_attr_bounds(f: Filter, prop: str) -> FilterValues:
+    """Extract value bounds [(lo, hi)] constraining a scalar attribute — drives
+    the attribute index's range windows (reference: FilterHelper bounds algebra
+    over attribute predicates). Bounds are closed; None = open end."""
+
+    def walk(node: Filter):
+        if isinstance(node, Compare) and node.prop == prop:
+            v = node.value
+            if node.op == "=":
+                return [(v, v)]
+            if node.op in ("<", "<="):
+                return [(None, v)]
+            if node.op in (">", ">="):
+                return [(v, None)]
+            return None
+        if isinstance(node, Between) and node.prop == prop:
+            return [(node.lo, node.hi)]
+        if isinstance(node, In) and node.prop == prop:
+            return [(v, v) for v in node.values]
+        if isinstance(node, During) and node.prop == prop:
+            return [(node.lo_ms, node.hi_ms)]
+        if isinstance(node, And):
+            acc = None
+            for c in node.children:
+                b = walk(c)
+                if b is None:
+                    continue
+                if acc is None:
+                    acc = b
+                else:
+                    merged = []
+                    for (a0, a1) in acc:
+                        for (b0, b1) in b:
+                            lo = b0 if a0 is None else a0 if b0 is None else max(a0, b0)
+                            hi = b1 if a1 is None else a1 if b1 is None else min(a1, b1)
+                            if lo is None or hi is None or lo <= hi:
+                                merged.append((lo, hi))
+                    if not merged:
+                        return []
+                    acc = merged
+            return acc
+        if isinstance(node, Or):
+            out = []
+            for c in node.children:
+                b = walk(c)
+                if b is None:
+                    return None
+                out.extend(b)
+            return out
+        if isinstance(node, Exclude):
+            return []
+        return None
+
+    b = walk(f)
+    if b is None:
+        return FilterValues([])
+    if b == []:
+        return FilterValues([], disjoint=True)
+    return FilterValues(b)

@@ -1,0 +1,14 @@
+"""Index core (L3).
+
+Capability parity with geomesa-index-api (SURVEY.md §2.4): an index is a sort
+key function + shard assignment over columnar arrays. Key spaces encode
+feature batches into curve keys at ingest (vectorized) and turn filters into
+scan windows at plan time (the IndexKeySpace.getIndexValues/getRanges/
+getRangeBytes triple, reference index/api/IndexKeySpace.scala:23-110).
+"""
+
+from geomesa_tpu.index.keyspace import (  # noqa: F401
+    KeySpace, Z3KeySpace, Z2KeySpace, XZ3KeySpace, XZ2KeySpace,
+    IdKeySpace, AttributeKeySpace, keyspaces_for_schema,
+)
+from geomesa_tpu.index.store import FeatureStore, IndexTable  # noqa: F401

@@ -1,0 +1,523 @@
+"""Key spaces: feature batch -> sort keys (ingest) and filter -> scan windows
+(plan time).
+
+Reference parity (SURVEY.md §2.4):
+
+* ``Z3KeySpace``   ~ Z3IndexKeySpace (Z3Index): point geom + time
+* ``Z2KeySpace``   ~ Z2IndexKeySpace (Z2Index): point geom
+* ``XZ3KeySpace``  ~ XZ3IndexKeySpace: extent geom + time
+* ``XZ2KeySpace``  ~ XZ2IndexKeySpace: extent geom
+* ``IdKeySpace``   ~ IdIndex: feature id lookups
+* ``AttributeKeySpace`` ~ AttributeIndex: per-attribute sorted index
+
+The TPU translation of "byte ranges": each key space can compute, per shard
+and per query, a set of **(start, end) row windows** into that shard's sorted
+arrays via ``searchsorted`` — the slice-descriptor model (SURVEY.md §1). The
+fine-grained z-ranges additionally drive selectivity estimation.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu import config
+from geomesa_tpu.curves.binned_time import BinnedTime, TimePeriod
+from geomesa_tpu.curves.cover import ZRange
+from geomesa_tpu.curves.xz import XZ2SFC, XZ3SFC
+from geomesa_tpu.curves.zorder import Z2SFC, Z3SFC, split_u64
+from geomesa_tpu.filter import ir
+from geomesa_tpu.schema.columns import ColumnBatch
+from geomesa_tpu.schema.feature_type import FeatureType
+
+MAX_WINDOW_BINS = 64  # collapse per-bin windows beyond this many time bins
+
+
+@dataclass
+class KeyPlan:
+    """Plan-time product of a key space for one query (IndexValues+ranges
+    analog). ``windows(shard_cols)`` resolves to row windows per shard."""
+
+    keyspace: "KeySpace"
+    #: provably empty (disjoint bounds)
+    disjoint: bool = False
+    #: full scan (no key constraint)
+    full_scan: bool = False
+    #: z-ranges for selectivity estimation (may be empty for full scans)
+    ranges: List[ZRange] = field(default_factory=list)
+    #: time bins touched (z3/xz3)
+    bins: Optional[np.ndarray] = None
+    #: estimated fraction of key space covered (coarse; cost input)
+    coverage: float = 1.0
+
+    def windows(self, shard_cols: Dict[str, np.ndarray], n: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Resolve to (starts, ends) row windows for one shard's host key
+        columns (each sorted). ``n`` = row count of the shard."""
+        if self.disjoint:
+            return np.zeros(1, np.int64), np.zeros(1, np.int64)
+        if self.full_scan:
+            return np.zeros(1, np.int64), np.full(1, n, np.int64)
+        return self.keyspace.resolve_windows(self, shard_cols, n)
+
+
+class KeySpace:
+    name: str = "base"   # unique per instance (table key)
+    kind: str = "base"   # family (cost model / dispatch key)
+    key_cols: Sequence[str] = ()
+
+    def supports(self, ft: FeatureType) -> bool:
+        raise NotImplementedError
+
+    def index_keys(self, ft: FeatureType, batch: ColumnBatch) -> Dict[str, np.ndarray]:
+        """Vectorized key encode for an ingest batch (toIndexKey analog)."""
+        raise NotImplementedError
+
+    def sort_order(self, cols: Dict[str, np.ndarray]) -> np.ndarray:
+        """argsort for the table's global sort (primary last in lexsort)."""
+        raise NotImplementedError
+
+    def plan(self, ft: FeatureType, f: ir.Filter) -> Optional[KeyPlan]:
+        """None if this key space cannot serve the filter at all."""
+        raise NotImplementedError
+
+    def resolve_windows(self, plan: KeyPlan, shard_cols, n: int):
+        raise NotImplementedError
+
+
+def _z_envelope(ranges: List[ZRange]) -> Tuple[int, int]:
+    return (ranges[0].lo, ranges[-1].hi) if ranges else (0, 0)
+
+
+def _coverage(ranges: List[ZRange], total_bits: int) -> float:
+    span = sum(r.hi - r.lo + 1 for r in ranges)
+    return span / float(1 << total_bits)
+
+
+class Z3KeySpace(KeySpace):
+    """(bin, z3) keys over point geometry + time (reference
+    Z3IndexKeySpace.scala:64-233)."""
+
+    name = "z3"
+    kind = "z3"
+
+    def __init__(self, geom: str, dtg: str, period: "str | TimePeriod" = TimePeriod.WEEK):
+        self.geom = geom
+        self.dtg = dtg
+        self.sfc = Z3SFC(period)
+        self.binned = self.sfc.binned
+        self.key_cols = ("__z3_bin", "__z3")
+
+    def supports(self, ft):
+        return (
+            ft.has(self.geom) and ft.attr(self.geom).is_point
+            and ft.has(self.dtg) and ft.attr(self.dtg).type == "date"
+        )
+
+    def index_keys(self, ft, batch):
+        xs = batch[self.geom + "__x"]
+        ys = batch[self.geom + "__y"]
+        ts = batch[self.dtg]
+        b, off = self.binned.to_bin_and_offset(ts)
+        z = self.sfc.index(xs, ys, off)
+        return {"__z3_bin": b.astype(np.int32), "__z3": z}
+
+    def sort_order(self, cols):
+        return np.lexsort((cols["__z3"], cols["__z3_bin"]))
+
+    def plan(self, ft, f):
+        geoms = ir.extract_geometries(f, self.geom)
+        intervals = ir.extract_intervals(f, self.dtg)
+        if geoms.disjoint or intervals.disjoint:
+            return KeyPlan(self, disjoint=True)
+        if intervals.is_empty:
+            return None  # no temporal bound: z3 not applicable (reference same)
+        # Clamp intervals into representable time.
+        CLAMP = 2**45
+        iv = [(max(lo, -CLAMP), min(hi, CLAMP)) for lo, hi in intervals.values]
+        bins = np.unique(
+            np.concatenate([self.binned.bins_between(lo, hi) for lo, hi in iv])
+        )
+        if geoms.is_empty:
+            bbox = (-180.0, -90.0, 180.0, 90.0)
+        else:
+            bs = np.asarray([g.bounds() for g in geoms.values])
+            bbox = (bs[:, 0].min(), bs[:, 1].min(), bs[:, 2].max(), bs[:, 3].max())
+        # Offset window: conservative union across bins (per-bin tight windows
+        # refined at resolve_windows time for the edge bins).
+        max_off = float(self.binned.max_offset_ms)
+        ranges = self.sfc.ranges(
+            (bbox[0], bbox[2]), (bbox[1], bbox[3]), (0.0, max_off),
+        )
+        cov = _coverage(ranges, 63) * min(1.0, len(bins) / max(len(bins), 1))
+        plan = KeyPlan(self, ranges=ranges, bins=bins.astype(np.int32), coverage=cov)
+        plan._iv = iv  # retained for per-bin offset refinement
+        return plan
+
+    def resolve_windows(self, plan, shard_cols, n):
+        bins_col = shard_cols["__z3_bin"]
+        z_col = shard_cols["__z3"]
+        zlo, zhi = _z_envelope(plan.ranges)
+        bins = plan.bins
+        if len(bins) > MAX_WINDOW_BINS:
+            # collapse: one window spanning [first bin, last bin]
+            s = np.searchsorted(bins_col, bins[0], side="left")
+            e = np.searchsorted(bins_col, bins[-1], side="right")
+            return np.asarray([s], np.int64), np.asarray([e], np.int64)
+        starts, ends = [], []
+        for b in bins.tolist():
+            s = np.searchsorted(bins_col, b, side="left")
+            e = np.searchsorted(bins_col, b, side="right")
+            if e <= s:
+                continue
+            # z window within the bin segment
+            seg = z_col[s:e]
+            s2 = s + np.searchsorted(seg, np.uint64(zlo), side="left")
+            e2 = s + np.searchsorted(seg, np.uint64(zhi), side="right")
+            if e2 > s2:
+                starts.append(s2)
+                ends.append(e2)
+        if not starts:
+            return np.zeros(1, np.int64), np.zeros(1, np.int64)
+        return np.asarray(starts, np.int64), np.asarray(ends, np.int64)
+
+
+class Z2KeySpace(KeySpace):
+    """z2 keys over point geometry (reference Z2IndexKeySpace)."""
+
+    name = "z2"
+    kind = "z2"
+
+    def __init__(self, geom: str):
+        self.geom = geom
+        self.sfc = Z2SFC()
+        self.key_cols = ("__z2",)
+
+    def supports(self, ft):
+        return ft.has(self.geom) and ft.attr(self.geom).is_point
+
+    def index_keys(self, ft, batch):
+        return {"__z2": self.sfc.index(batch[self.geom + "__x"], batch[self.geom + "__y"])}
+
+    def sort_order(self, cols):
+        return np.argsort(cols["__z2"], kind="stable")
+
+    def plan(self, ft, f):
+        geoms = ir.extract_geometries(f, self.geom)
+        if geoms.disjoint:
+            return KeyPlan(self, disjoint=True)
+        if geoms.is_empty:
+            return KeyPlan(self, full_scan=True)
+        bs = np.asarray([g.bounds() for g in geoms.values])
+        bbox = (bs[:, 0].min(), bs[:, 1].min(), bs[:, 2].max(), bs[:, 3].max())
+        ranges = self.sfc.ranges(*bbox)
+        return KeyPlan(self, ranges=ranges, coverage=_coverage(ranges, 62))
+
+    def resolve_windows(self, plan, shard_cols, n):
+        z_col = shard_cols["__z2"]
+        zlo, zhi = _z_envelope(plan.ranges)
+        s = np.searchsorted(z_col, np.uint64(zlo), side="left")
+        e = np.searchsorted(z_col, np.uint64(zhi), side="right")
+        return np.asarray([s], np.int64), np.asarray([e], np.int64)
+
+
+class XZ2KeySpace(KeySpace):
+    """xz2 codes over extent geometries (reference XZ2IndexKeySpace)."""
+
+    name = "xz2"
+    kind = "xz2"
+
+    def __init__(self, geom: str, g: int = 12):
+        self.geom = geom
+        self.sfc = XZ2SFC(g=g)
+        self.key_cols = ("__xz2",)
+
+    def supports(self, ft):
+        a = ft.attr(self.geom) if ft.has(self.geom) else None
+        return a is not None and a.is_geom and not a.is_point
+
+    def index_keys(self, ft, batch):
+        return {
+            "__xz2": self.sfc.index(
+                batch[self.geom + "__xmin"], batch[self.geom + "__ymin"],
+                batch[self.geom + "__xmax"], batch[self.geom + "__ymax"],
+            )
+        }
+
+    def sort_order(self, cols):
+        return np.argsort(cols["__xz2"], kind="stable")
+
+    def plan(self, ft, f):
+        geoms = ir.extract_geometries(f, self.geom)
+        if geoms.disjoint:
+            return KeyPlan(self, disjoint=True)
+        if geoms.is_empty:
+            return KeyPlan(self, full_scan=True)
+        bs = np.asarray([g.bounds() for g in geoms.values])
+        bbox = (bs[:, 0].min(), bs[:, 1].min(), bs[:, 2].max(), bs[:, 3].max())
+        ranges = self.sfc.ranges(*bbox)
+        total = self.sfc.subtree_size[0]
+        span = sum(r.hi - r.lo + 1 for r in ranges)
+        return KeyPlan(self, ranges=ranges, coverage=span / total)
+
+    def resolve_windows(self, plan, shard_cols, n):
+        # XZ ranges are NOT contiguous-envelope friendly (singleton parent
+        # codes interleave) — resolve each merged range to a window.
+        col = shard_cols["__xz2"]
+        starts, ends = [], []
+        for r in plan.ranges:
+            s = np.searchsorted(col, r.lo, side="left")
+            e = np.searchsorted(col, r.hi, side="right")
+            if e > s:
+                starts.append(s)
+                ends.append(e)
+        if not starts:
+            return np.zeros(1, np.int64), np.zeros(1, np.int64)
+        # cap window count: merge down to MAX_WINDOW_BINS by unioning gaps
+        return _cap_windows(
+            np.asarray(starts, np.int64), np.asarray(ends, np.int64), MAX_WINDOW_BINS
+        )
+
+
+class XZ3KeySpace(KeySpace):
+    """(bin, xz3) codes over extent geometries + time (reference XZ3IndexKeySpace)."""
+
+    name = "xz3"
+    kind = "xz3"
+
+    def __init__(self, geom: str, dtg: str, period: "str | TimePeriod" = TimePeriod.WEEK, g: int = 12):
+        self.geom = geom
+        self.dtg = dtg
+        self.sfc = XZ3SFC(period, g=g)
+        self.binned = self.sfc.binned
+        self.key_cols = ("__xz3_bin", "__xz3")
+
+    def supports(self, ft):
+        a = ft.attr(self.geom) if ft.has(self.geom) else None
+        return (
+            a is not None and a.is_geom and not a.is_point
+            and ft.has(self.dtg) and ft.attr(self.dtg).type == "date"
+        )
+
+    def index_keys(self, ft, batch):
+        ts = batch[self.dtg]
+        b, off = self.binned.to_bin_and_offset(ts)
+        code = self.sfc.index(
+            batch[self.geom + "__xmin"], batch[self.geom + "__ymin"], off,
+            batch[self.geom + "__xmax"], batch[self.geom + "__ymax"], off,
+        )
+        return {"__xz3_bin": b.astype(np.int32), "__xz3": code}
+
+    def sort_order(self, cols):
+        return np.lexsort((cols["__xz3"], cols["__xz3_bin"]))
+
+    def plan(self, ft, f):
+        geoms = ir.extract_geometries(f, self.geom)
+        intervals = ir.extract_intervals(f, self.dtg)
+        if geoms.disjoint or intervals.disjoint:
+            return KeyPlan(self, disjoint=True)
+        if intervals.is_empty:
+            return None
+        CLAMP = 2**45
+        iv = [(max(lo, -CLAMP), min(hi, CLAMP)) for lo, hi in intervals.values]
+        bins = np.unique(
+            np.concatenate([self.binned.bins_between(lo, hi) for lo, hi in iv])
+        )
+        if geoms.is_empty:
+            bbox = (-180.0, -90.0, 180.0, 90.0)
+        else:
+            bs = np.asarray([g.bounds() for g in geoms.values])
+            bbox = (bs[:, 0].min(), bs[:, 1].min(), bs[:, 2].max(), bs[:, 3].max())
+        ranges = self.sfc.ranges(
+            (bbox[0], bbox[2]), (bbox[1], bbox[3]),
+            (0.0, float(self.binned.max_offset_ms)),
+        )
+        total = self.sfc.subtree_size[0]
+        span = sum(r.hi - r.lo + 1 for r in ranges)
+        return KeyPlan(self, ranges=ranges, bins=bins.astype(np.int32), coverage=span / total)
+
+    def resolve_windows(self, plan, shard_cols, n):
+        bins_col = shard_cols["__xz3_bin"]
+        code_col = shard_cols["__xz3"]
+        bins = plan.bins
+        if len(bins) > 8:  # xz windows multiply per bin; collapse earlier
+            s = np.searchsorted(bins_col, bins[0], side="left")
+            e = np.searchsorted(bins_col, bins[-1], side="right")
+            return np.asarray([s], np.int64), np.asarray([e], np.int64)
+        starts, ends = [], []
+        for b in bins.tolist():
+            s = np.searchsorted(bins_col, b, side="left")
+            e = np.searchsorted(bins_col, b, side="right")
+            if e <= s:
+                continue
+            seg = code_col[s:e]
+            for r in plan.ranges:
+                s2 = s + np.searchsorted(seg, r.lo, side="left")
+                e2 = s + np.searchsorted(seg, r.hi, side="right")
+                if e2 > s2:
+                    starts.append(s2)
+                    ends.append(e2)
+        if not starts:
+            return np.zeros(1, np.int64), np.zeros(1, np.int64)
+        return _cap_windows(
+            np.asarray(starts, np.int64), np.asarray(ends, np.int64), MAX_WINDOW_BINS
+        )
+
+
+class IdKeySpace(KeySpace):
+    """Feature-id index (reference IdIndex): host-sorted fid strings."""
+
+    name = "id"
+    kind = "id"
+    key_cols = ("__fid_rank",)
+
+    def supports(self, ft):
+        return True
+
+    def index_keys(self, ft, batch):
+        # rank assigned at table build time (host sort of fids); here a
+        # placeholder (store re-sorts by fid directly).
+        return {}
+
+    def sort_order(self, cols):
+        return np.argsort(cols["__fid__"], kind="stable")
+
+    def plan(self, ft, f):
+        ids = ir.extract_ids(f)
+        if ids is None:
+            return None
+        plan = KeyPlan(self, coverage=0.0)
+        plan._ids = sorted(ids)
+        return plan
+
+    def resolve_windows(self, plan, shard_cols, n):
+        fids = shard_cols["__fid__"]  # sorted object array
+        starts, ends = [], []
+        for fid in plan._ids:
+            s = np.searchsorted(fids, fid, side="left")
+            e = np.searchsorted(fids, fid, side="right")
+            if e > s:
+                starts.append(s)
+                ends.append(e)
+        if not starts:
+            return np.zeros(1, np.int64), np.zeros(1, np.int64)
+        return np.asarray(starts, np.int64), np.asarray(ends, np.int64)
+
+
+class AttributeKeySpace(KeySpace):
+    """Per-attribute sorted index (reference AttributeIndex + tiered keyspace;
+    the z-curve tiebreak plays the reference's secondary-tier role)."""
+
+    kind = "attr"
+
+    def __init__(self, attr: str, geom: Optional[str] = None):
+        self.attr = attr
+        self.geom = geom
+        self.name = f"attr:{attr}"
+        self.key_cols = (f"__attr_{attr}",)
+
+    @property
+    def sort_col(self) -> str:
+        return f"__attr_{self.attr}"
+
+    def supports(self, ft):
+        return ft.has(self.attr) and not ft.attr(self.attr).is_geom
+
+    def index_keys(self, ft, batch):
+        a = ft.attr(self.attr)
+        vals = batch[self.attr]
+        if a.type == "string":
+            # codes are re-ranked to value order at table build (store step);
+            # raw codes stored here, rank column computed on flush.
+            return {self.sort_col: vals.astype(np.int64)}
+        return {self.sort_col: vals}
+
+    def sort_order(self, cols):
+        if self.geom and "__z2" in cols:
+            return np.lexsort((cols["__z2"], cols[self.sort_col]))
+        return np.argsort(cols[self.sort_col], kind="stable")
+
+    def plan(self, ft, f):
+        bounds = ir.extract_attr_bounds(f, self.attr)
+        if bounds.disjoint:
+            return KeyPlan(self, disjoint=True)
+        if bounds.is_empty:
+            return None
+        plan = KeyPlan(self, coverage=0.1)  # refined by stats in the decider
+        plan._bounds = bounds.values
+        plan._ft = ft
+        return plan
+
+    def resolve_windows(self, plan, shard_cols, n):
+        col = shard_cols[self.sort_col]
+        a = plan._ft.attr(self.attr)
+        starts, ends = [], []
+        for lo, hi in plan._bounds:
+            if a.type == "string":
+                # bounds are raw strings; map through the rank dictionary
+                # attached by the store at resolve time
+                rank = shard_cols.get("__rank_lookup__")
+                if rank is None:
+                    return np.zeros(1, np.int64), np.full(1, n, np.int64)
+                lo2 = rank(lo, "lo") if lo is not None else None
+                hi2 = rank(hi, "hi") if hi is not None else None
+            else:
+                lo2, hi2 = lo, hi
+                if a.type == "date":
+                    lo2 = None if lo is None else np.int64(lo)
+                    hi2 = None if hi is None else np.int64(hi)
+            s = 0 if lo2 is None else int(np.searchsorted(col, lo2, side="left"))
+            e = n if hi2 is None else int(np.searchsorted(col, hi2, side="right"))
+            if e > s:
+                starts.append(s)
+                ends.append(e)
+        if not starts:
+            return np.zeros(1, np.int64), np.zeros(1, np.int64)
+        return _cap_windows(
+            np.asarray(starts, np.int64), np.asarray(ends, np.int64), MAX_WINDOW_BINS
+        )
+
+
+def _cap_windows(starts: np.ndarray, ends: np.ndarray, cap: int):
+    """Merge overlapping windows; if more than ``cap`` remain, union gaps to
+    fit (over-covering; fine filter restores exactness)."""
+    order = np.argsort(starts)
+    starts, ends = starts[order], ends[order]
+    ms, me = [int(starts[0])], [int(ends[0])]
+    for s, e in zip(starts[1:].tolist(), ends[1:].tolist()):
+        if s <= me[-1]:
+            me[-1] = max(me[-1], e)
+        else:
+            ms.append(s)
+            me.append(e)
+    while len(ms) > cap:
+        # merge the pair with the smallest gap
+        gaps = [ms[i + 1] - me[i] for i in range(len(ms) - 1)]
+        i = int(np.argmin(gaps))
+        me[i] = me[i + 1]
+        del ms[i + 1], me[i + 1]
+    return np.asarray(ms, np.int64), np.asarray(me, np.int64)
+
+
+def keyspaces_for_schema(ft: FeatureType) -> List[KeySpace]:
+    """Pick indices from the schema shape (GeoMesaFeatureIndexFactory.indices
+    analog, reference GeoMesaDataStore.preSchemaCreate:116)."""
+    out: List[KeySpace] = []
+    geom = ft.geom_field
+    dtg = ft.dtg_field
+    period = ft.time_period
+    if geom is not None:
+        if ft.attr(geom).is_point:
+            if dtg is not None:
+                out.append(Z3KeySpace(geom, dtg, period))
+            out.append(Z2KeySpace(geom))
+        else:
+            if dtg is not None:
+                out.append(XZ3KeySpace(geom, dtg, period))
+            out.append(XZ2KeySpace(geom))
+    out.append(IdKeySpace())
+    for a in ft.attributes:
+        if a.indexed and not a.is_geom:
+            out.append(AttributeKeySpace(a.name, geom))
+    return out

@@ -1,0 +1,285 @@
+"""Sharded, sorted columnar feature store — the storage substrate (L3/L4).
+
+The TPU analog of a backend adapter (SURVEY.md §2.5): instead of rowkey tables
+in Accumulo/HBase, each index is a set of **sorted columnar shards**. A shard
+is a contiguous slab of the index's global sort order (so per-shard
+``searchsorted`` row windows play the role of rowkey range scans), padded to a
+common length so the stacked [n_shards, shard_len] arrays pjit cleanly over a
+device mesh.
+
+Write path parity (GeoMesaFeatureWriter/IndexAdapter.BaseIndexWriter,
+reference IndexAdapter.scala:132-190): an ingest batch computes ALL index keys
+in one vectorized pass before any table is touched; tables rebuild their sort
+on flush (LSM-style delta buffers are a later optimization — the write buffer
+is the memtable).
+
+Write-time stats parity (MetadataBackedStats.scala:36-100): flush updates the
+persisted sketches (count, geometry/time bounds, Z3 histogram, per-indexed-
+attribute sketches) that drive the cost-based strategy decider.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu import config
+from geomesa_tpu.index.keyspace import (
+    AttributeKeySpace, KeyPlan, KeySpace, keyspaces_for_schema,
+)
+from geomesa_tpu.schema.columns import ColumnBatch, DictionaryEncoder, encode_batch
+from geomesa_tpu.schema.feature_type import FeatureType
+from geomesa_tpu.stats import sketches as sk
+
+# Columns that live host-side only (object dtype or 64-bit keys).
+_HOST_ONLY_DTYPES = ("O", "U")
+
+
+def _device_view(a: np.ndarray) -> Optional[np.ndarray]:
+    """Host column -> device-eligible array (int32/float32/bool), or None."""
+    if a.dtype.kind in _HOST_ONLY_DTYPES:
+        return None
+    if a.dtype == np.float64:
+        return a.astype(np.float32)
+    if a.dtype == np.int64:
+        # raw epoch-ms / z keys stay host-side; generic int64 attribute
+        # columns ride as float32 (documented precision tradeoff)
+        return a.astype(np.float32)
+    if a.dtype == np.uint64:
+        return None
+    return a
+
+
+class IndexTable:
+    """One index = one globally sorted, sharded column set."""
+
+    def __init__(self, keyspace: KeySpace, ft: FeatureType, n_shards: int):
+        self.keyspace = keyspace
+        self.ft = ft
+        self.n_shards = n_shards
+        self.columns: Dict[str, np.ndarray] = {}
+        self.n = 0
+        self.shard_bounds = np.zeros(n_shards + 1, np.int64)
+        self._device_cache: Dict[tuple, dict] = {}
+        self._rank_vocab: Optional[np.ndarray] = None  # for string attr index
+
+    # -- build ------------------------------------------------------------
+    def rebuild(self, columns: Dict[str, np.ndarray], dicts: Dict[str, DictionaryEncoder]):
+        """Re-sort the full column set by this index's key and re-shard."""
+        cols = dict(columns)
+        ks = self.keyspace
+        if isinstance(ks, AttributeKeySpace) and self.ft.attr(ks.attr).type == "string":
+            # dictionary codes are insertion-ordered; build a value-ordered
+            # rank column so searchsorted windows work for string ranges
+            vocab = np.array(dicts[ks.attr].values, dtype=object)
+            order = np.argsort(vocab)
+            rank_of_code = np.empty(len(vocab), np.int64)
+            rank_of_code[order] = np.arange(len(vocab))
+            codes = columns[ks.attr]
+            ranks = np.where(codes >= 0, rank_of_code[np.clip(codes, 0, None)], -1)
+            cols[ks.sort_col] = ranks
+            self._rank_vocab = vocab[order]
+        order = ks.sort_order(cols)
+        self.columns = {k: v[order] for k, v in cols.items()}
+        self.n = len(order)
+        self.shard_bounds = np.linspace(0, self.n, self.n_shards + 1).astype(np.int64)
+        self._device_cache.clear()
+
+    @property
+    def shard_len(self) -> int:
+        """Padded per-shard length (static shape for the device)."""
+        if self.n == 0:
+            return 0
+        return int(np.max(np.diff(self.shard_bounds)))
+
+    def shard_slice(self, s: int) -> slice:
+        return slice(int(self.shard_bounds[s]), int(self.shard_bounds[s + 1]))
+
+    # -- device layout ----------------------------------------------------
+    def device_columns(self, names: Sequence[str], sharding=None):
+        """Stacked padded [n_shards, shard_len] jnp arrays for ``names``
+        (cached). With a ``NamedSharding``, columns are placed sharded over
+        the mesh's 'shard' axis. Host-only columns are silently skipped —
+        callers must route predicates on those through the host path."""
+        import jax
+
+        key = (tuple(sorted(set(names))), id(sharding))
+        cached = self._device_cache.get(key)
+        if cached is not None:
+            return cached
+        L = self.shard_len
+        out = {}
+        for name in key[0]:
+            col = self.columns.get(name)
+            if col is None:
+                continue
+            dv = _device_view(col)
+            if dv is None:
+                continue
+            stacked = np.zeros((self.n_shards, L), dtype=dv.dtype)
+            for s in range(self.n_shards):
+                sl = self.shard_slice(s)
+                stacked[s, : sl.stop - sl.start] = dv[sl]
+            out[name] = (
+                jax.device_put(stacked, sharding)
+                if sharding is not None
+                else jax.device_put(stacked)
+            )
+        self._device_cache[key] = out
+        return out
+
+    # -- scan windows ------------------------------------------------------
+    def windows(self, plan: KeyPlan) -> Tuple[np.ndarray, np.ndarray]:
+        """Resolve the key plan to per-shard row windows, padded to a common
+        window count: (starts [S, K], ends [S, K]) in *local* shard rows."""
+        per_shard = []
+        for s in range(self.n_shards):
+            sl = self.shard_slice(s)
+            n = sl.stop - sl.start
+            shard_cols = {k: v[sl] for k, v in self.columns.items()}
+            if self._rank_vocab is not None:
+                vocab = self._rank_vocab
+
+                def rank_lookup(value, side):
+                    if side == "lo":
+                        return int(np.searchsorted(vocab, value, side="left"))
+                    return int(np.searchsorted(vocab, value, side="right")) - 1
+
+                shard_cols["__rank_lookup__"] = rank_lookup
+            starts, ends = plan.windows(shard_cols, n)
+            per_shard.append((starts, ends))
+        K = max(len(s) for s, _ in per_shard)
+        S = self.n_shards
+        starts = np.zeros((S, K), np.int32)
+        ends = np.zeros((S, K), np.int32)
+        for i, (s, e) in enumerate(per_shard):
+            starts[i, : len(s)] = s
+            ends[i, : len(e)] = e
+        return starts, ends
+
+    def host_gather(self, global_mask: np.ndarray) -> ColumnBatch:
+        """Select matching rows from the host master copy.
+
+        ``global_mask`` is over the padded [S, L] layout (flattened)."""
+        L = self.shard_len
+        idx = []
+        for s in range(self.n_shards):
+            sl = self.shard_slice(s)
+            local = global_mask[s * L : s * L + (sl.stop - sl.start)]
+            idx.append(np.nonzero(local)[0] + sl.start)
+        sel = np.concatenate(idx) if idx else np.zeros(0, np.int64)
+        return ColumnBatch({k: v[sel] for k, v in self.columns.items()}, len(sel))
+
+    def host_mask_layout(self, fn) -> np.ndarray:
+        """Evaluate ``fn(cols)`` per shard on the host and return a padded
+        [S*L] mask (the host fallback path for object-typed predicates)."""
+        L = self.shard_len
+        out = np.zeros(self.n_shards * L, dtype=bool)
+        for s in range(self.n_shards):
+            sl = self.shard_slice(s)
+            cols = {k: v[sl] for k, v in self.columns.items()}
+            out[s * L : s * L + (sl.stop - sl.start)] = fn(cols)
+        return out
+
+
+class FeatureStore:
+    """All index tables + write buffer + persisted stats for one schema.
+
+    The GeoMesaDataStore-per-type analog: schema, writer, tables, stats
+    (reference GeoMesaDataStore.scala:49, MetadataBackedStats)."""
+
+    def __init__(self, ft: FeatureType, n_shards: Optional[int] = None):
+        self.ft = ft
+        self.n_shards = n_shards or ft.shards or config.DEFAULT_SHARDS.to_int()
+        self.dicts: Dict[str, DictionaryEncoder] = {}
+        self.keyspaces = keyspaces_for_schema(ft)
+        self.tables: Dict[str, IndexTable] = {
+            ks.name: IndexTable(ks, ft, self.n_shards) for ks in self.keyspaces
+        }
+        self._buffer: List[ColumnBatch] = []
+        self._all: Optional[ColumnBatch] = None
+        self._lock = threading.Lock()
+        self.stats = self._init_stats()
+
+    def _init_stats(self) -> Dict[str, sk.Stat]:
+        ft = self.ft
+        out: Dict[str, sk.Stat] = {"count": sk.CountStat()}
+        if ft.geom_field:
+            out["bounds"] = sk.MinMax(ft.geom_field)
+        if ft.dtg_field:
+            out["time-bounds"] = sk.MinMax(ft.dtg_field)
+        if ft.geom_field and ft.attr(ft.geom_field).is_point:
+            out["z2-histogram"] = sk.Z2HistogramStat(ft.geom_field, 1024)
+        if ft.geom_field and ft.dtg_field and ft.attr(ft.geom_field).is_point:
+            out["z3-histogram"] = sk.Z3HistogramStat(
+                ft.geom_field, ft.dtg_field, ft.time_period, 1024
+            )
+        for a in ft.attributes:
+            if a.indexed and not a.is_geom:
+                if a.type == "string":
+                    out[f"enum-{a.name}"] = sk.EnumerationStat(a.name)
+                else:
+                    out[f"minmax-{a.name}"] = sk.MinMax(a.name)
+        return out
+
+    # -- write path --------------------------------------------------------
+    def append(self, data: Dict, fids=None) -> int:
+        """Buffer an ingest batch (encoded immediately; keys at flush)."""
+        batch = encode_batch(self.ft, data, self.dicts, fids)
+        with self._lock:
+            self._buffer.append(batch)
+        return batch.n
+
+    @property
+    def pending(self) -> int:
+        return sum(b.n for b in self._buffer)
+
+    @property
+    def count(self) -> int:
+        return (self._all.n if self._all else 0) + self.pending
+
+    def flush(self):
+        """Merge buffer into tables: compute all index keys in one vectorized
+        pass, then rebuild each table's sort (atomic mutation batch parity,
+        reference IndexAdapter.scala:140-154)."""
+        with self._lock:
+            if not self._buffer:
+                return
+            fresh = ColumnBatch.concat(self._buffer)
+            self._buffer = []
+        # write-time stats on the fresh rows only
+        for st in self.stats.values():
+            st.observe(fresh.columns)
+        merged = (
+            fresh if self._all is None else ColumnBatch.concat([self._all, fresh])
+        )
+        # one pass: every key space's keys for the merged set
+        key_cols: Dict[str, np.ndarray] = dict(merged.columns)
+        for ks in self.keyspaces:
+            key_cols.update(ks.index_keys(self.ft, merged))
+        self._all = ColumnBatch(
+            {k: key_cols[k] for k in merged.columns}, merged.n
+        )
+        for ks in self.keyspaces:
+            self.tables[ks.name].rebuild(key_cols, self.dicts)
+
+    def delete(self, mask_fn) -> int:
+        """Remove rows matching ``mask_fn(columns) -> bool mask`` (host)."""
+        self.flush()
+        if self._all is None or self._all.n == 0:
+            return 0
+        mask = mask_fn(self._all.columns)
+        removed = int(mask.sum())
+        if removed == 0:
+            return 0
+        keep = self._all.select(~mask)
+        self._all = keep
+        self.stats["count"] = sk.CountStat(keep.n)
+        key_cols: Dict[str, np.ndarray] = dict(keep.columns)
+        for ks in self.keyspaces:
+            key_cols.update(ks.index_keys(self.ft, keep))
+            self.tables[ks.name].rebuild(key_cols, self.dicts)
+        return removed

@@ -1,0 +1,3 @@
+"""Device scan/aggregation kernels (the reference's server-side iterators,
+SURVEY.md §2.4 'Aggregating scans' — reborn as jit kernels over sharded
+columns)."""

@@ -1,0 +1,38 @@
+"""Density (heatmap) kernel.
+
+Parity with the reference's DensityScan (index/iterators/DensityScan.scala:
+29-136: per-row RenderingGrid scatter in tablet servers, sparse grids merged
+client-side): here ONE scatter-add over the full sharded column set — XLA
+partitions the scatter per device and all-reduces the grid (the
+``StatsCombiner``/reducer role, played by the XLA collective).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def density_grid(x, y, mask, bbox, width: int, height: int, weight=None, xp=None):
+    """Masked 2D histogram: points -> (height, width) float32 grid.
+
+    ``x``/``y``/``mask`` may be [S, L] or flat; backend-generic (np or jnp).
+    Cells follow the reference's RenderingGrid convention: row 0 = ymin edge.
+    """
+    if xp is None:
+        xp = np
+    xmin, ymin, xmax, ymax = bbox
+    fx = x.reshape(-1)
+    fy = y.reshape(-1)
+    fm = mask.reshape(-1)
+    px = xp.clip(((fx - xmin) / (xmax - xmin) * width).astype(xp.int32), 0, width - 1)
+    py = xp.clip(((fy - ymin) / (ymax - ymin) * height).astype(xp.int32), 0, height - 1)
+    w = fm.astype(xp.float32) if weight is None else xp.where(
+        fm, weight.reshape(-1).astype(xp.float32), xp.float32(0)
+    )
+    flat_idx = py * width + px
+    if xp is np:
+        grid = np.zeros(height * width, np.float32)
+        np.add.at(grid, flat_idx, w)
+    else:
+        grid = xp.zeros(height * width, xp.float32).at[flat_idx].add(w)
+    return grid.reshape(height, width)

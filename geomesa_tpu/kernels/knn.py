@@ -1,0 +1,35 @@
+"""k-nearest-neighbor kernel (KNearestNeighborSearchProcess analog,
+reference geomesa-process/.../query/KNearestNeighborSearchProcess.scala —
+there an iterative expanding-radius search; here one masked distance +
+``lax.top_k`` pass, which is the TPU-shaped formulation)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from geomesa_tpu.utils.geometry import EARTH_RADIUS_M
+
+
+def knn_indices(x, y, mask, qx: float, qy: float, k: int, xp=None):
+    """Indices (into the flattened [S*L] layout) and distances (meters) of the
+    k nearest masked points to (qx, qy). Backend-generic."""
+    if xp is None:
+        xp = np
+    fx = x.reshape(-1)
+    fy = y.reshape(-1)
+    fm = mask.reshape(-1)
+    rx1, ry1 = xp.radians(fx), xp.radians(fy)
+    rx2, ry2 = np.radians(qx), np.radians(qy)
+    a = (
+        xp.sin((ry2 - ry1) / 2) ** 2
+        + xp.cos(ry1) * np.cos(ry2) * xp.sin((rx2 - rx1) / 2) ** 2
+    )
+    d = 2 * EARTH_RADIUS_M * xp.arcsin(xp.sqrt(xp.clip(a, 0, 1)))
+    d = xp.where(fm, d, xp.inf)
+    if xp is np:
+        idx = np.argsort(d)[:k]
+        return idx, d[idx]
+    import jax.lax
+
+    neg, idx = jax.lax.top_k(-d, k)
+    return idx, -neg

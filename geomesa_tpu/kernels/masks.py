@@ -1,0 +1,53 @@
+"""Scan-mask construction on device.
+
+The coarse key filter (reference Z3Filter/Z2Filter on raw key bytes,
+index/filters/Z3Filter.scala:18-62) becomes: per-shard row windows (resolved
+host-side by searchsorted against the sorted key columns) turned into a
+boolean mask via a +1/-1 scatter and cumsum — O(S*L), no N×K blowup — ANDed
+with the compiled fine predicate and the padding-validity mask.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def window_mask(starts, ends, counts, L: int):
+    """[S,K] local-row windows + [S] shard row counts -> [S,L] bool mask.
+
+    Windows within a shard must be non-overlapping (planner merges them).
+    Padded windows are (0, 0) and contribute nothing.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    def one(s, e):
+        d = jnp.zeros(L + 1, jnp.int32)
+        d = d.at[s].add(1)
+        d = d.at[e].add(-1)
+        return jnp.cumsum(d)[:L] > 0
+
+    wm = jax.vmap(one)(starts, ends)
+    iota = jnp.arange(L, dtype=jnp.int32)
+    return wm & (iota[None, :] < counts[:, None])
+
+
+def window_mask_np(starts, ends, counts, L: int) -> np.ndarray:
+    """Host twin of :func:`window_mask` (numpy)."""
+    S = starts.shape[0]
+    out = np.zeros((S, L), dtype=bool)
+    for s in range(S):
+        for a, b in zip(starts[s], ends[s]):
+            if b > a:
+                out[s, a:b] = True
+        out[s, counts[s]:] = False
+    return out
+
+
+def sampling_mask(mask, n: int, xp):
+    """Keep ~1-in-n of the masked rows (SamplingIterator analog): deterministic
+    modulo on the running match index so the sample is stable."""
+    flat = mask.reshape(-1)
+    seq = xp.cumsum(flat.astype(xp.int32)) - 1
+    keep = (seq % n) == 0
+    return (flat & keep).reshape(mask.shape)

@@ -1,0 +1,1 @@
+from geomesa_tpu.parallel.mesh import shard_mesh, device_count  # noqa: F401

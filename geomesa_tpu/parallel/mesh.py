@@ -1,0 +1,35 @@
+"""Device mesh helpers — the distributed substrate.
+
+The reference's horizontal partitioning + scatter-gather fan-out
+(SURVEY.md §2.9: rowkey splits across tablet servers, client batch scans,
+server-side partial aggregates merged by reducers) maps to SPMD: shard axis
+over devices, one jit'd scan, XLA-inserted collectives for the merge (psum
+over ICI within a slice; DCN across slices is handled by jax's global mesh on
+multi-host deployments).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def device_count() -> int:
+    import jax
+
+    return jax.device_count()
+
+
+def shard_mesh(n: Optional[int] = None):
+    """A 1-D mesh over ``n`` (default: all) devices with axis name 'shard'."""
+    import jax
+    from jax.sharding import Mesh
+    import numpy as np
+
+    devs = jax.devices()[: (n or len(jax.devices()))]
+    return Mesh(np.array(devs), axis_names=("shard",))
+
+
+def shard_spec():
+    from jax.sharding import PartitionSpec
+
+    return PartitionSpec("shard", None)

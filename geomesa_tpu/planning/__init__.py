@@ -1,0 +1,2 @@
+from geomesa_tpu.planning.planner import QueryPlanner, QueryPlan, QueryHints  # noqa: F401
+from geomesa_tpu.planning.explain import Explainer  # noqa: F401

@@ -1,0 +1,217 @@
+"""Query executor: run a QueryPlan against an IndexTable.
+
+The runtime role of the reference's scan/reduce pipeline
+(QueryPlanner.runQuery -> plan.scan -> resultsToFeatures -> reducer,
+QueryPlan.scala:30-94): resolve scan windows, build the fused mask (coarse
+window mask & compiled predicate & validity), and run the aggregation kernel —
+all inside one jit when the predicate's columns are device-resident, falling
+back to vectorized numpy when the filter needs host-only columns (feature-id
+strings, exact 64-bit values).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from geomesa_tpu.index.store import FeatureStore, IndexTable
+from geomesa_tpu.kernels import density as kdensity
+from geomesa_tpu.kernels import knn as kknn
+from geomesa_tpu.kernels import masks as kmasks
+from geomesa_tpu.kernels import stats_scan as kstats
+from geomesa_tpu.planning.planner import QueryPlan
+from geomesa_tpu.schema.columns import ColumnBatch
+from geomesa_tpu.stats import sketches as sk
+
+
+class Executor:
+    def __init__(self, store: FeatureStore, mesh=None, prefer_device: bool = True):
+        self.store = store
+        self.mesh = mesh
+        self.prefer_device = prefer_device
+
+    # -- helpers -----------------------------------------------------------
+    def _table(self, plan: QueryPlan) -> IndexTable:
+        return self.store.tables[plan.index_name]
+
+    def _scan_setup(self, plan: QueryPlan, extra_cols=()):
+        """Resolve windows + choose device/host path. Returns a dict bundle."""
+        table = self._table(plan)
+        if table.n == 0 or plan.is_empty:
+            return None
+        starts, ends = table.windows(plan.key_plan)
+        counts = np.diff(table.shard_bounds).astype(np.int32)
+        L = table.shard_len
+        needed = list(dict.fromkeys(list(plan.compiled.columns) + list(extra_cols)))
+        host_only = [
+            c for c in needed
+            if c not in table.columns
+            or table.columns[c].dtype.kind in ("O", "U")
+        ]
+        use_device = self.prefer_device and not host_only
+        return {
+            "table": table, "starts": starts, "ends": ends, "counts": counts,
+            "L": L, "needed": needed, "use_device": use_device,
+        }
+
+    def _host_mask(self, plan: QueryPlan, setup) -> np.ndarray:
+        """[S, L] mask on the host (numpy)."""
+        table = setup["table"]
+        wm = kmasks.window_mask_np(setup["starts"], setup["ends"], setup["counts"], setup["L"])
+        S, L = wm.shape
+        pm = np.zeros((S, L), dtype=bool)
+        for s in range(table.n_shards):
+            sl = table.shard_slice(s)
+            cols = {k: v[sl] for k, v in table.columns.items()}
+            pm[s, : sl.stop - sl.start] = np.asarray(plan.compiled(cols, np))
+        mask = wm & pm
+        if plan.hints.sampling:
+            mask = kmasks.sampling_mask(mask, plan.hints.sampling, np)
+        return mask
+
+    def _device_mask_and_agg(self, plan: QueryPlan, setup, agg_fn, agg_cols=()):
+        """Run mask + aggregation in one jit. ``agg_fn(cols, mask, xp)``."""
+        import jax
+        import jax.numpy as jnp
+
+        table = setup["table"]
+        dev_cols = table.device_columns(
+            tuple(setup["needed"]) + tuple(agg_cols), self._sharding()
+        )
+        L = setup["L"]
+        compiled = plan.compiled
+        sampling = plan.hints.sampling
+
+        @jax.jit
+        def go(cols, starts, ends, counts):
+            m = kmasks.window_mask(starts, ends, counts, L)
+            m = m & compiled(cols, jnp)
+            if sampling:
+                m = kmasks.sampling_mask(m, sampling, jnp)
+            return agg_fn(cols, m, jnp)
+
+        return go(dev_cols, setup["starts"], setup["ends"], setup["counts"])
+
+    def _sharding(self):
+        if self.mesh is None:
+            return None
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return NamedSharding(self.mesh, PartitionSpec("shard", None))
+
+    def _run(self, plan: QueryPlan, agg_fn_dev, agg_fn_host, agg_cols=()):
+        setup = self._scan_setup(plan, agg_cols)
+        if setup is None:
+            return None
+        if setup["use_device"]:
+            try:
+                return self._device_mask_and_agg(plan, setup, agg_fn_dev, agg_cols)
+            except Exception:
+                if not self.prefer_device:
+                    raise
+                # graceful degradation (the reference's remoteFilter=false /
+                # Bigtable path): fall back to the host runner
+        mask = self._host_mask(plan, setup)
+        table = setup["table"]
+        cols = {}
+        for c in set(list(setup["needed"]) + list(agg_cols)):
+            if c in table.columns:
+                L = setup["L"]
+                stacked = np.zeros((table.n_shards, L), dtype=table.columns[c].dtype)
+                for s in range(table.n_shards):
+                    sl = table.shard_slice(s)
+                    stacked[s, : sl.stop - sl.start] = table.columns[c][sl]
+                cols[c] = stacked
+        return agg_fn_host(cols, mask, np)
+
+    # -- public operations --------------------------------------------------
+    def count(self, plan: QueryPlan) -> int:
+        out = self._run(
+            plan,
+            lambda cols, m, xp: m.sum(),
+            lambda cols, m, xp: m.sum(),
+        )
+        return 0 if out is None else int(out)
+
+    def features(self, plan: QueryPlan) -> ColumnBatch:
+        """Matching rows as a host ColumnBatch (sort/limit applied by caller)."""
+        setup = self._scan_setup(plan)
+        if setup is None:
+            return ColumnBatch({}, 0)
+        if setup["use_device"]:
+            mask = np.asarray(
+                self._device_mask_and_agg(plan, setup, lambda cols, m, xp: m)
+            )
+        else:
+            mask = self._host_mask(plan, setup)
+        return setup["table"].host_gather(mask.reshape(-1))
+
+    def density(self, plan: QueryPlan, bbox, width: int, height: int,
+                weight: Optional[str] = None) -> np.ndarray:
+        geom = self.store.ft.geom_field
+        xc, yc = geom + "__x", geom + "__y"
+        agg_cols = [xc, yc] + ([weight] if weight else [])
+
+        def agg(cols, m, xp):
+            w = cols.get(weight) if weight else None
+            return kdensity.density_grid(
+                cols[xc], cols[yc], m, bbox, width, height, w, xp
+            )
+
+        out = self._run(plan, agg, agg, agg_cols)
+        return (
+            np.zeros((height, width), np.float32) if out is None else np.asarray(out)
+        )
+
+    def stats(self, plan: QueryPlan, stat: sk.Stat) -> sk.Stat:
+        table = self._table(plan)
+        host_only = {
+            c for c in table.columns if table.columns[c].dtype.kind in ("O", "U")
+        }
+        vocab_sizes = {a: max(len(d), 1) for a, d in self.store.dicts.items()}
+        leaf_attrs = []
+        for leaf in kstats._leaf_stats(stat):
+            if isinstance(leaf, sk.DescriptiveStats):
+                leaf_attrs.extend(leaf.attributes)
+            elif getattr(leaf, "attribute", None) is not None:
+                leaf_attrs.append(leaf.attribute)
+        agg_cols = []
+        for a in leaf_attrs:
+            if a + "__x" in table.columns:
+                agg_cols += [a + "__x", a + "__y"]
+            elif a in table.columns:
+                agg_cols.append(a)
+        enum_ok = all(
+            leaf.attribute in self.store.dicts
+            for leaf in kstats._leaf_stats(stat)
+            if leaf.kind in ("enumeration", "topk")
+        )
+        if kstats.device_supported(stat, host_only) and enum_ok:
+            partials = self._run(
+                plan,
+                lambda cols, m, xp: kstats.device_update(stat, cols, m, xp, vocab_sizes),
+                lambda cols, m, xp: kstats.device_update(stat, cols, m, xp, vocab_sizes),
+                agg_cols,
+            )
+            if partials is not None:
+                kstats.absorb_partials(stat, partials, self.store.dicts)
+            return stat
+        batch = self.features(plan)
+        if batch.n:
+            stat.observe(batch.columns)
+        return stat
+
+    def knn(self, plan: QueryPlan, qx: float, qy: float, k: int):
+        geom = self.store.ft.geom_field
+        xc, yc = geom + "__x", geom + "__y"
+
+        def agg(cols, m, xp):
+            return kknn.knn_indices(cols[xc], cols[yc], m, qx, qy, k, xp)
+
+        out = self._run(plan, agg, agg, [xc, yc])
+        if out is None:
+            return np.zeros(0, np.int64), np.zeros(0)
+        idx, d = np.asarray(out[0]), np.asarray(out[1])
+        keep = np.isfinite(d)
+        return idx[keep], d[keep]

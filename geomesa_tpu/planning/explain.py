@@ -1,0 +1,34 @@
+"""Explain tree — the query-debugging UX.
+
+Parity with the reference's ``Explainer`` (geomesa-index-api/.../utils/
+Explainer.scala:16-50): an indented push/pop log emitted during planning,
+surfaced by ``GeoDataset.explain`` and the CLI ``explain`` command.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+
+class Explainer:
+    def __init__(self, enabled: bool = True):
+        self.enabled = enabled
+        self._lines: List[str] = []
+        self._depth = 0
+
+    def line(self, msg: str) -> "Explainer":
+        if self.enabled:
+            self._lines.append("  " * self._depth + str(msg))
+        return self
+
+    def push(self, msg: str) -> "Explainer":
+        self.line(msg)
+        self._depth += 1
+        return self
+
+    def pop(self) -> "Explainer":
+        self._depth = max(0, self._depth - 1)
+        return self
+
+    def __str__(self) -> str:
+        return "\n".join(self._lines)

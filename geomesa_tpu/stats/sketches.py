@@ -578,6 +578,67 @@ class Z3HistogramStat(Stat):
                    {k: _arr_from_b64(v) for k, v in d["bins"]})
 
 
+class Z2HistogramStat(Stat):
+    """Spatial histogram over coarse z2 cells — the z2 index's selectivity
+    estimator (pairs with Z3HistogramStat so the cost decider compares both
+    spatial indexes on the same data distribution)."""
+
+    kind = "z2histogram"
+
+    def __init__(self, geom: str, length: int = 1024, counts: Optional[np.ndarray] = None):
+        from geomesa_tpu.curves.zorder import Z2SFC
+
+        self.geom = geom
+        self.length = int(length)
+        self.sfc = Z2SFC()
+        self.shift = 62 - int(np.log2(self.length))
+        self.counts = (
+            np.zeros(self.length, dtype=np.int64) if counts is None
+            else np.asarray(counts, np.int64)
+        )
+
+    def observe(self, columns, mask=None):
+        xs = _masked(np.asarray(columns[self.geom + "__x"]), mask)
+        ys = _masked(np.asarray(columns[self.geom + "__y"]), mask)
+        if xs.size == 0:
+            return
+        z = self.sfc.index(xs, ys)
+        bucket = (z >> np.uint64(self.shift)).astype(np.int64)
+        self.counts += np.bincount(bucket, minlength=self.length).astype(np.int64)
+
+    def merge(self, other: "Z2HistogramStat"):
+        self.counts += other.counts
+
+    @property
+    def is_empty(self):
+        return int(self.counts.sum()) == 0
+
+    def value(self):
+        return {"total": int(self.counts.sum()), "length": self.length}
+
+    def estimate_count(self, zranges) -> float:
+        total = 0.0
+        bucket_span = 1 << self.shift
+        for r in zranges:
+            b0, b1 = r.lo >> self.shift, r.hi >> self.shift
+            if b0 == b1:
+                total += self.counts[b0] * (r.hi - r.lo + 1) / bucket_span
+            else:
+                total += self.counts[b0] * ((b0 + 1) * bucket_span - r.lo) / bucket_span
+                total += self.counts[b1] * (r.hi - b1 * bucket_span + 1) / bucket_span
+                if b1 > b0 + 1:
+                    total += float(self.counts[b0 + 1 : b1].sum())
+        return total
+
+    def _state(self):
+        return {"geom": self.geom, "length": self.length,
+                "counts": _arr_to_b64(self.counts)}
+
+    @classmethod
+    def _from_state(cls, d):
+        return cls(d["geom"], d["length"], _arr_from_b64(d["counts"]))
+
+
 class SeqStat(Stat):
     """Multiple sketches observed together ('Stat1;Stat2' in the DSL)."""
 
@@ -617,6 +678,6 @@ _KINDS = {
     c.kind: c
     for c in (
         CountStat, MinMax, EnumerationStat, TopK, Histogram, Frequency,
-        DescriptiveStats, GroupBy, Z3HistogramStat, SeqStat,
+        DescriptiveStats, GroupBy, Z3HistogramStat, Z2HistogramStat, SeqStat,
     )
 }

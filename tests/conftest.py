@@ -1,17 +1,24 @@
-"""Test fixture: force an 8-virtual-device CPU backend before jax imports.
+"""Test fixture: force an 8-virtual-device CPU backend.
 
 This is the analog of the reference's in-process mini-clusters (SURVEY.md §4.3):
 the full planner/executor/sharding stack runs against fake devices with no real
 TPU, exactly as TestGeoMesaDataStore exercises the full planner with an
 in-memory adapter.
+
+Note: env vars are not enough here — the axon TPU plugin's sitecustomize calls
+``jax.config.update("jax_platforms", ...)`` at interpreter startup, which
+overrides JAX_PLATFORMS. We update jax.config back before any backend is
+initialized.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,295 @@
+"""End-to-end GeoDataset tests — the TestGeoMesaDataStore analog
+(SURVEY.md §4.2): the full planner/keyspace/executor stack vs brute-force
+numpy oracles, on the 8-virtual-device CPU backend.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, Query
+from geomesa_tpu.filter.ecql import parse_iso_ms
+
+SPEC = (
+    "name:String:index=true,age:Integer:index=true,weight:Double,"
+    "dtg:Date,*geom:Point;geomesa.z3.interval='week'"
+)
+N = 20_000
+
+
+@pytest.fixture(scope="module")
+def ds_and_data():
+    rng = np.random.default_rng(123)
+    ds = GeoDataset(n_shards=8)
+    ds.create_schema("gdelt", SPEC)
+    data = {
+        "name": [f"actor{i % 50}" for i in range(N)],
+        "age": rng.integers(0, 100, N).astype(np.int32),
+        "weight": rng.uniform(0, 10, N),
+        "dtg": rng.integers(
+            parse_iso_ms("2020-01-01"), parse_iso_ms("2020-02-01"), N
+        ).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-120, -70, N),
+        "geom__y": rng.uniform(25, 50, N),
+    }
+    ds.insert("gdelt", data)
+    ds.flush()
+    return ds, data
+
+
+BBOX_TIME = (
+    "BBOX(geom, -100, 30, -80, 45) AND "
+    "dtg DURING 2020-01-05T00:00:00Z/2020-01-15T00:00:00Z"
+)
+
+
+def oracle_mask(data):
+    x, y = data["geom__x"], data["geom__y"]
+    t = data["dtg"].astype(np.int64)
+    return (
+        (x >= -100) & (x <= -80) & (y >= 30) & (y <= 45)
+        & (t >= parse_iso_ms("2020-01-05")) & (t <= parse_iso_ms("2020-01-15"))
+    )
+
+
+def test_count_matches_oracle(ds_and_data):
+    ds, data = ds_and_data
+    got = ds.count("gdelt", BBOX_TIME)
+    assert got == int(oracle_mask(data).sum())
+    assert ds.count("gdelt") == N
+
+
+def test_query_features_match_oracle(ds_and_data):
+    ds, data = ds_and_data
+    fc = ds.query("gdelt", BBOX_TIME)
+    want = oracle_mask(data)
+    assert len(fc) == int(want.sum())
+    # every returned point satisfies the predicate
+    xs = fc.columns["geom__x"]
+    ys = fc.columns["geom__y"]
+    assert ((xs >= -100) & (xs <= -80)).all()
+    assert ((ys >= 30) & (ys <= 45)).all()
+    ts = fc.columns["dtg"]
+    assert (ts >= parse_iso_ms("2020-01-05")).all()
+    assert (ts <= parse_iso_ms("2020-01-15")).all()
+
+
+def test_host_and_device_paths_agree(ds_and_data):
+    ds, data = ds_and_data
+    ds_host = GeoDataset(n_shards=8, prefer_device=False)
+    ds_host._stores = ds._stores  # share store
+    assert ds.count("gdelt", BBOX_TIME) == ds_host.count("gdelt", BBOX_TIME)
+
+
+def test_z3_windows_prune(ds_and_data):
+    """The chosen z3 window must cover fewer rows than the table (coarse prune)."""
+    ds, data = ds_and_data
+    st = ds._store("gdelt")
+    from geomesa_tpu.planning.planner import QueryPlanner
+
+    plan = QueryPlanner(st).plan(BBOX_TIME)
+    assert plan.index_name == "z3"
+    table = st.tables["z3"]
+    starts, ends = table.windows(plan.key_plan)
+    window_rows = int((ends - starts).sum())
+    assert 0 < window_rows < table.n
+
+
+def test_density_grid(ds_and_data):
+    ds, data = ds_and_data
+    bbox = (-100, 30, -80, 45)
+    grid = ds.density("gdelt", BBOX_TIME, bbox=bbox, width=64, height=32)
+    assert grid.shape == (32, 64)
+    assert int(grid.sum()) == int(oracle_mask(data).sum())
+    # mass is where the points are: compare a coarse 2x2 split against numpy
+    m = oracle_mask(data)
+    x, y = data["geom__x"][m], data["geom__y"][m]
+    left = int((x < -90).sum())
+    got_left = grid[:, :32].sum()
+    assert abs(got_left - left) / max(left, 1) < 0.02
+
+
+def test_density_weighted(ds_and_data):
+    ds, data = ds_and_data
+    bbox = (-100, 30, -80, 45)
+    grid = ds.density("gdelt", BBOX_TIME, bbox=bbox, width=16, height=16, weight="weight")
+    m = oracle_mask(data)
+    assert grid.sum() == pytest.approx(data["weight"][m].sum(), rel=1e-3)
+
+
+def test_stats_scan(ds_and_data):
+    ds, data = ds_and_data
+    m = oracle_mask(data)
+    st = ds.stats("gdelt", "Count();MinMax(age);DescriptiveStats(weight)", BBOX_TIME)
+    vals = st.value()
+    assert vals[0] == int(m.sum())
+    assert vals[1]["min"] == data["age"][m].min()
+    assert vals[1]["max"] == data["age"][m].max()
+    assert vals[2]["mean"][0] == pytest.approx(data["weight"][m].mean(), rel=1e-5)
+
+
+def test_stats_enumeration_and_histogram(ds_and_data):
+    ds, data = ds_and_data
+    m = oracle_mask(data)
+    names = np.array([f"actor{i % 50}" for i in range(N)])
+    st = ds.stats("gdelt", "Enumeration(name)", BBOX_TIME)
+    counts = st.value()
+    assert counts["actor0"] == int((names[m] == "actor0").sum())
+    h = ds.stats("gdelt", "Histogram(age,10,0,100)", BBOX_TIME)
+    assert int(np.sum(h.value()["counts"])) == int(m.sum())
+
+
+def test_unique_and_minmax(ds_and_data):
+    ds, data = ds_and_data
+    u = ds.unique("gdelt", "name", "age < 5")
+    names = np.array([f"actor{i % 50}" for i in range(N)])
+    want = sorted(set(names[data["age"] < 5]))
+    assert u == want
+    mm = ds.min_max("gdelt", "weight")
+    assert mm["min"] == pytest.approx(data["weight"].min())
+
+
+def test_attribute_index_used_for_equality(ds_and_data):
+    ds, data = ds_and_data
+    exp = ds.explain("gdelt", "name = 'actor7'")
+    assert "attr" in exp and "Chosen index: attr" in exp
+    got = ds.count("gdelt", "name = 'actor7'")
+    names = np.array([f"actor{i % 50}" for i in range(N)])
+    assert got == int((names == "actor7").sum())
+
+
+def test_attribute_range_query(ds_and_data):
+    ds, data = ds_and_data
+    got = ds.count("gdelt", "age BETWEEN 20 AND 30")
+    assert got == int(((data["age"] >= 20) & (data["age"] <= 30)).sum())
+
+
+def test_id_index(ds_and_data):
+    ds, data = ds_and_data
+    fc = ds.query("gdelt", Query(ecql="INCLUDE", max_features=3))
+    fids = fc.columns["__fid__"][:2].tolist()
+    q = "IN (" + ", ".join(f"'{f}'" for f in fids) + ")"
+    exp = ds.explain("gdelt", q)
+    assert "Chosen index: id" in exp
+    fc2 = ds.query("gdelt", q)
+    assert sorted(fc2.columns["__fid__"].tolist()) == sorted(fids)
+
+
+def test_sampling_and_limit(ds_and_data):
+    ds, data = ds_and_data
+    full = ds.count("gdelt", BBOX_TIME)
+    sampled = ds.count("gdelt", Query(ecql=BBOX_TIME, sampling=4))
+    assert sampled == pytest.approx(full / 4, abs=2)
+    fc = ds.query("gdelt", Query(ecql=BBOX_TIME, max_features=7))
+    assert len(fc) == 7
+
+
+def test_sort_and_projection(ds_and_data):
+    ds, data = ds_and_data
+    fc = ds.query(
+        "gdelt",
+        Query(ecql="age < 10", sort_by=[("age", False)], properties=["age"],
+              max_features=50),
+    )
+    ages = fc.columns["age"]
+    assert (np.diff(ages) >= 0).all()
+    assert "weight" not in fc.columns
+    assert "__fid__" in fc.columns
+
+
+def test_knn(ds_and_data):
+    ds, data = ds_and_data
+    from geomesa_tpu.utils.geometry import haversine_m
+
+    fc = ds.knn("gdelt", -90.0, 38.0, k=15)
+    assert len(fc) == 15
+    d_all = haversine_m(data["geom__x"], data["geom__y"], -90.0, 38.0)
+    want = np.sort(d_all)[:15]
+    got = haversine_m(fc.columns["geom__x"], fc.columns["geom__y"], -90.0, 38.0)
+    np.testing.assert_allclose(np.sort(got), want, rtol=1e-6)
+
+
+def test_proximity(ds_and_data):
+    ds, data = ds_and_data
+    fc = ds.proximity("gdelt", "POINT (-90 38)", 50_000)
+    from geomesa_tpu.utils.geometry import haversine_m
+
+    d_all = haversine_m(data["geom__x"], data["geom__y"], -90.0, 38.0)
+    assert len(fc) == int((d_all <= 50_000).sum())
+
+
+def test_explain_tree(ds_and_data):
+    ds, _ = ds_and_data
+    exp = ds.explain("gdelt", BBOX_TIME)
+    assert "Chosen index: z3" in exp
+    assert "ranges" in exp and "Candidate indices" in exp
+
+
+def test_delete_features(ds_and_data):
+    ds, data = ds_and_data
+    rng = np.random.default_rng(5)
+    ds2 = GeoDataset(n_shards=4)
+    ds2.create_schema("tmp", SPEC)
+    n = 1000
+    ds2.insert("tmp", {
+        "name": ["a"] * n,
+        "age": rng.integers(0, 100, n).astype(np.int32),
+        "weight": rng.uniform(0, 1, n),
+        "dtg": np.full(n, parse_iso_ms("2021-06-01")).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-10, 10, n),
+        "geom__y": rng.uniform(-10, 10, n),
+    })
+    before = ds2.count("tmp")
+    removed = ds2.delete_features("tmp", "age < 50")
+    assert before == n
+    assert ds2.count("tmp") == n - removed
+    assert ds2.count("tmp", "age < 50") == 0
+
+
+def test_save_load_roundtrip(tmp_path, ds_and_data):
+    ds, data = ds_and_data
+    p = str(tmp_path / "ckpt")
+    ds.save(p)
+    ds2 = GeoDataset.load(p)
+    assert ds2.count("gdelt", BBOX_TIME) == ds.count("gdelt", BBOX_TIME)
+    assert ds2.bounds("gdelt") == ds.bounds("gdelt")
+    st = ds2.stats("gdelt", "TopK(name,3)")
+    assert len(st.value()) == 3
+
+
+def test_multi_device_mesh(ds_and_data):
+    """pjit path over the 8-virtual-device CPU mesh."""
+    import jax
+
+    from geomesa_tpu.parallel import shard_mesh
+
+    assert jax.device_count() == 8
+    ds, data = ds_and_data
+    mesh = shard_mesh(8)
+    ds_mesh = GeoDataset(mesh=mesh)
+    ds_mesh._stores = ds._stores
+    assert ds_mesh.count("gdelt", BBOX_TIME) == ds.count("gdelt", BBOX_TIME)
+    grid = ds_mesh.density("gdelt", BBOX_TIME, bbox=(-100, 30, -80, 45), width=32, height=32)
+    assert int(grid.sum()) == int(oracle_mask(data).sum())
+
+
+def test_empty_and_disjoint_queries(ds_and_data):
+    ds, _ = ds_and_data
+    assert ds.count("gdelt", "EXCLUDE") == 0
+    assert ds.count("gdelt", "BBOX(geom, 0, 0, 1, 1) AND BBOX(geom, 5, 5, 6, 6)") == 0
+    assert len(ds.query("gdelt", "age > 1000")) == 0
+
+
+def test_guards(ds_and_data):
+    ds, _ = ds_and_data
+    from geomesa_tpu import config
+
+    with config.BLOCK_FULL_TABLE_SCANS.scoped("true"):
+        with pytest.raises(ValueError, match="full-table"):
+            ds.count("gdelt", "INCLUDE")
+    with config.TEMPORAL_GUARD_MAX_DAYS.scoped(3):
+        with pytest.raises(ValueError, match="temporal guard"):
+            ds.count("gdelt", BBOX_TIME)  # 10-day span > 3
+        assert ds.count(
+            "gdelt",
+            "dtg DURING 2020-01-05T00:00:00Z/2020-01-06T00:00:00Z",
+        ) >= 0
