@@ -1,0 +1,104 @@
+"""Benchmark: bbox+time CQL filter + density heatmap throughput.
+
+The north-star configuration (BASELINE.md): features/sec on a spatio-temporal
+filter + density aggregation, device vs single-threaded-process numpy CPU
+baseline (the reference provides no published numbers; the CPU path here IS
+the measured baseline, per BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs: GEOMESA_BENCH_N (points, default 20M), GEOMESA_BENCH_ITERS.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    n = int(os.environ.get("GEOMESA_BENCH_N", 20_000_000))
+    iters = int(os.environ.get("GEOMESA_BENCH_ITERS", 10))
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from geomesa_tpu import GeoDataset
+    from geomesa_tpu.filter.ecql import parse_iso_ms
+
+    rng = np.random.default_rng(7)
+    t0 = time.time()
+    # GDELT-like point events across CONUS over one month
+    data = {
+        "geom__x": rng.uniform(-125, -66, n),
+        "geom__y": rng.uniform(24, 49, n),
+        "dtg": rng.integers(
+            parse_iso_ms("2020-01-01"), parse_iso_ms("2020-02-01"), n
+        ).astype("datetime64[ms]"),
+        "weight": rng.uniform(0, 1, n).astype(np.float32),
+    }
+    gen_s = time.time() - t0
+
+    ds = GeoDataset(n_shards=8)
+    ds.create_schema("gdelt", "weight:Float,dtg:Date,*geom:Point")
+    t0 = time.time()
+    ds.insert("gdelt", data, fids=np.arange(n).astype(str))
+    ds.flush("gdelt")
+    ingest_s = time.time() - t0
+
+    ecql = (
+        "BBOX(geom, -100, 30, -80, 45) AND "
+        "dtg DURING 2020-01-05T00:00:00Z/2020-01-15T00:00:00Z"
+    )
+    bbox = (-100.0, 30.0, -80.0, 45.0)
+    W = H = 512
+
+    # plan once; executor caches the jitted kernel on the plan
+    st, _, plan = ds._plan("gdelt", ecql)
+    ex = ds._executor(st)
+
+    # device path: warmup (compile) then steady-state
+    grid = ex.density(plan, bbox, W, H)
+    t0 = time.time()
+    for _ in range(iters):
+        grid = ex.density(plan, bbox, W, H)
+    dev_s = (time.time() - t0) / iters
+    matched = float(grid.sum())
+
+    # CPU baseline: vectorized numpy over the same raw arrays (filter + 2D hist)
+    x, y = data["geom__x"], data["geom__y"]
+    t = data["dtg"].astype(np.int64)
+    lo, hi = parse_iso_ms("2020-01-05"), parse_iso_ms("2020-01-15")
+    t0 = time.time()
+    cpu_iters = max(1, min(3, iters))
+    for _ in range(cpu_iters):
+        m = (
+            (x >= bbox[0]) & (x <= bbox[2]) & (y >= bbox[1]) & (y <= bbox[3])
+            & (t >= lo) & (t <= hi)
+        )
+        px = np.clip(((x[m] - bbox[0]) / (bbox[2] - bbox[0]) * W).astype(np.int64), 0, W - 1)
+        py = np.clip(((y[m] - bbox[1]) / (bbox[3] - bbox[1]) * H).astype(np.int64), 0, H - 1)
+        cpu_grid = np.zeros(H * W, np.float32)
+        np.add.at(cpu_grid, py * W + px, 1.0)
+    cpu_s = (time.time() - t0) / cpu_iters
+
+    assert abs(matched - float(m.sum())) <= max(1.0, 1e-5 * n), (
+        f"device {matched} vs cpu {float(m.sum())}"
+    )
+
+    feats_per_sec = n / dev_s
+    speedup = cpu_s / dev_s
+    sys.stderr.write(
+        f"n={n} gen={gen_s:.1f}s ingest={ingest_s:.1f}s matched={matched:.0f} "
+        f"device={dev_s*1e3:.1f}ms cpu={cpu_s*1e3:.1f}ms speedup={speedup:.1f}x\n"
+    )
+    print(json.dumps({
+        "metric": "bbox_time_density_scan_throughput",
+        "value": round(feats_per_sec, 1),
+        "unit": "features/sec",
+        "vs_baseline": round(speedup, 2),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -11,6 +11,8 @@ strings, exact 64-bit values).
 
 from __future__ import annotations
 
+import logging
+import os
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -70,8 +72,12 @@ class Executor:
             mask = kmasks.sampling_mask(mask, plan.hints.sampling, np)
         return mask
 
-    def _device_mask_and_agg(self, plan: QueryPlan, setup, agg_fn, agg_cols=()):
-        """Run mask + aggregation in one jit. ``agg_fn(cols, mask, xp)``."""
+    def _device_mask_and_agg(self, plan: QueryPlan, setup, agg_fn, agg_cols=(),
+                             cache_key=None):
+        """Run mask + aggregation in one jit. ``agg_fn(cols, mask, xp)``.
+
+        ``cache_key`` caches the jitted kernel on the plan so re-running the
+        same plan (benchmarks, pagination) skips retracing."""
         import jax
         import jax.numpy as jnp
 
@@ -83,14 +89,25 @@ class Executor:
         compiled = plan.compiled
         sampling = plan.hints.sampling
 
-        @jax.jit
-        def go(cols, starts, ends, counts):
-            m = kmasks.window_mask(starts, ends, counts, L)
-            m = m & compiled(cols, jnp)
-            if sampling:
-                m = kmasks.sampling_mask(m, sampling, jnp)
-            return agg_fn(cols, m, jnp)
+        cache = plan.__dict__.setdefault("_kernel_cache", {})
+        # L keys the cache too: a table rebuild changes shard_len and the
+        # kernel closes over it
+        full_key = (cache_key, L) if cache_key is not None else None
+        go = cache.get(full_key) if full_key is not None else None
+        if go is None:
 
+            @jax.jit
+            def go(cols, starts, ends, counts):
+                m = kmasks.window_mask(starts, ends, counts, L)
+                m = m & compiled(cols, jnp)
+                if sampling:
+                    m = kmasks.sampling_mask(m, sampling, jnp)
+                return agg_fn(cols, m, jnp)
+
+            if full_key is not None:
+                if len(cache) >= 16:  # bound per-plan compiled-kernel growth
+                    cache.clear()
+                cache[full_key] = go
         return go(dev_cols, setup["starts"], setup["ends"], setup["counts"])
 
     def _sharding(self):
@@ -100,18 +117,25 @@ class Executor:
 
         return NamedSharding(self.mesh, PartitionSpec("shard", None))
 
-    def _run(self, plan: QueryPlan, agg_fn_dev, agg_fn_host, agg_cols=()):
+    def _run(self, plan: QueryPlan, agg_fn_dev, agg_fn_host, agg_cols=(),
+             cache_key=None):
         setup = self._scan_setup(plan, agg_cols)
         if setup is None:
             return None
         if setup["use_device"]:
             try:
-                return self._device_mask_and_agg(plan, setup, agg_fn_dev, agg_cols)
-            except Exception:
-                if not self.prefer_device:
+                return self._device_mask_and_agg(
+                    plan, setup, agg_fn_dev, agg_cols, cache_key
+                )
+            except Exception as e:
+                if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
                     raise
                 # graceful degradation (the reference's remoteFilter=false /
-                # Bigtable path): fall back to the host runner
+                # Bigtable path): fall back to the host runner — loudly, so a
+                # permanent fallback is never an invisible perf cliff
+                logging.getLogger(__name__).warning(
+                    "device scan failed, falling back to host: %r", e
+                )
         mask = self._host_mask(plan, setup)
         table = setup["table"]
         cols = {}
@@ -131,6 +155,7 @@ class Executor:
             plan,
             lambda cols, m, xp: m.sum(),
             lambda cols, m, xp: m.sum(),
+            cache_key=("count",),
         )
         return 0 if out is None else int(out)
 
@@ -141,7 +166,9 @@ class Executor:
             return ColumnBatch({}, 0)
         if setup["use_device"]:
             mask = np.asarray(
-                self._device_mask_and_agg(plan, setup, lambda cols, m, xp: m)
+                self._device_mask_and_agg(
+                    plan, setup, lambda cols, m, xp: m, cache_key=("mask",)
+                )
             )
         else:
             mask = self._host_mask(plan, setup)
@@ -159,7 +186,10 @@ class Executor:
                 cols[xc], cols[yc], m, bbox, width, height, w, xp
             )
 
-        out = self._run(plan, agg, agg, agg_cols)
+        out = self._run(
+            plan, agg, agg, agg_cols,
+            cache_key=("density", tuple(bbox), width, height, weight),
+        )
         return (
             np.zeros((height, width), np.float32) if out is None else np.asarray(out)
         )

@@ -558,11 +558,11 @@ class Z3HistogramStat(Stat):
             for r in zranges:
                 b0, b1 = r.lo >> self.shift, r.hi >> self.shift
                 if b0 == b1:
-                    total += counts[b0] * (r.hi - r.lo + 1) / bucket_span
+                    total += float(counts[b0]) * ((r.hi - r.lo + 1) / bucket_span)
                 else:
                     # fractional edge buckets + whole middle buckets
-                    total += counts[b0] * ((b0 + 1) * bucket_span - r.lo) / bucket_span
-                    total += counts[b1] * (r.hi - b1 * bucket_span + 1) / bucket_span
+                    total += float(counts[b0]) * (((b0 + 1) * bucket_span - r.lo) / bucket_span)
+                    total += float(counts[b1]) * ((r.hi - b1 * bucket_span + 1) / bucket_span)
                     if b1 > b0 + 1:
                         total += float(counts[b0 + 1 : b1].sum())
         return total
@@ -622,10 +622,10 @@ class Z2HistogramStat(Stat):
         for r in zranges:
             b0, b1 = r.lo >> self.shift, r.hi >> self.shift
             if b0 == b1:
-                total += self.counts[b0] * (r.hi - r.lo + 1) / bucket_span
+                total += float(self.counts[b0]) * ((r.hi - r.lo + 1) / bucket_span)
             else:
-                total += self.counts[b0] * ((b0 + 1) * bucket_span - r.lo) / bucket_span
-                total += self.counts[b1] * (r.hi - b1 * bucket_span + 1) / bucket_span
+                total += float(self.counts[b0]) * (((b0 + 1) * bucket_span - r.lo) / bucket_span)
+                total += float(self.counts[b1]) * ((r.hi - b1 * bucket_span + 1) / bucket_span)
                 if b1 > b0 + 1:
                     total += float(self.counts[b0 + 1 : b1].sum())
         return total
