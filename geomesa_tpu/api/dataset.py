@@ -296,6 +296,56 @@ class GeoDataset:
         batch = self._executor(st).features(plan)
         return FeatureCollection(st.ft, batch, st.dicts)
 
+    # -- Arrow interchange (geomesa-arrow / ArrowScan analog) --------------
+    def to_arrow(self, name: str, query: "str | Query" = "INCLUDE",
+                 properties=None):
+        """Query results as an Arrow table (dictionary-encoded strings)."""
+        import pyarrow as pa
+
+        from geomesa_tpu.io import arrow_io
+
+        if isinstance(query, str):
+            q = Query(ecql=query)
+        else:
+            import dataclasses
+
+            q = dataclasses.replace(query)
+        if properties is not None:
+            q.properties = list(properties)
+        fc = self.query(name, q)
+        st = self._store(name)
+        if fc.batch.n == 0:
+            # schema of the empty table must match non-empty results: a
+            # non-point geometry is utf8 WKT iff the store carries __wkt
+            wkt = [
+                a.name for a in st.ft.attributes
+                if a.is_geom and st._all is not None
+                and a.name + "__wkt" in st._all.columns
+            ]
+            return arrow_io.arrow_schema(st.ft, q.properties, wkt).empty_table()
+        rb = arrow_io.batch_to_arrow(st.ft, fc.batch, st.dicts, q.properties)
+        return pa.Table.from_batches([rb])
+
+    def export_arrow(self, name: str, path: str,
+                     query: "str | Query" = "INCLUDE", properties=None):
+        """Write query results to an Arrow IPC file."""
+        from geomesa_tpu.io import arrow_io
+
+        table = self.to_arrow(name, query, properties)
+        arrow_io.write_ipc(path, table.to_batches(), table.schema)
+
+    def ingest_arrow(self, name: str, source) -> int:
+        """Ingest an Arrow table / record batch / IPC file path."""
+        import pyarrow as pa
+
+        from geomesa_tpu.io import arrow_io
+
+        if isinstance(source, str):
+            source = arrow_io.read_ipc(source)
+        st = self._store(name)
+        data, fids = arrow_io.table_to_data(st.ft, source)
+        return self.insert(name, data, fids)
+
     # -- persistence (shard-manifest checkpoint, SURVEY.md §5) -------------
     def save(self, path: str):
         os.makedirs(path, exist_ok=True)

@@ -1,0 +1,6 @@
+"""FileSystem storage (FSDS analog): partitioned Parquet datasets."""
+
+from geomesa_tpu.fs.storage import (  # noqa: F401
+    AttributeScheme, CompositeScheme, DateTimeScheme, FileSystemStorage,
+    PartitionScheme, Z2Scheme, scheme_from_config,
+)

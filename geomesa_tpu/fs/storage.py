@@ -1,0 +1,459 @@
+"""Partitioned Parquet storage — the FileSystem datastore (FSDS) analog.
+
+Reference parity (SURVEY.md §2.5 FileSystem row): partitioned Parquet files
+with a `PartitionScheme` (fs/storage/api/PartitionScheme.scala; impls
+DateTimeScheme, Z2Scheme/XZ2Scheme, AttributeScheme, composite at
+storage/common/partitions/*), filter -> partition pruning (FilterConverter's
+Parquet predicate pushdown), file-backed metadata, and compaction.
+
+This is the cold tier of the TPU framework: partitions on disk -> Arrow ->
+HBM shards. Partition names are directory paths; pruning intersects each
+existing partition's bounds with the query's extracted spatial/temporal/
+attribute bounds (the planning-time analog of Parquet row-group pushdown —
+actual row filtering happens in the compiled predicate after load).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from geomesa_tpu.curves.zorder import NormalizedDimension, deinterleave2, interleave2
+from geomesa_tpu.filter import ir, parse_ecql
+from geomesa_tpu.io import arrow_io
+from geomesa_tpu.schema.columns import ColumnBatch, DictionaryEncoder, encode_batch
+from geomesa_tpu.schema.feature_type import FeatureType
+
+
+class PartitionScheme:
+    """Maps features -> partition names and query bounds -> partition subset."""
+
+    kind = "base"
+
+    def names(self, ft: FeatureType, batch: ColumnBatch,
+              dicts: Dict[str, DictionaryEncoder]) -> np.ndarray:
+        """Partition name per row (object array)."""
+        raise NotImplementedError
+
+    def keep(self, ft: FeatureType, name: str, f: ir.Filter) -> bool:
+        """May partition ``name`` contain rows matching ``f``?"""
+        raise NotImplementedError
+
+    def name_depth(self) -> int:
+        """Path segments per partition name (CompositeScheme splitting)."""
+        return 1
+
+    def config(self) -> Dict:
+        raise NotImplementedError
+
+
+class DateTimeScheme(PartitionScheme):
+    """Time-partitioned directories (DateTimeScheme analog). ``step`` in
+    {year, month, day, hour}; names like 2020/01/05 (day)."""
+
+    kind = "datetime"
+    _FMT = {"year": "%Y", "month": "%Y/%m", "day": "%Y/%m/%d", "hour": "%Y/%m/%d/%H"}
+
+    def __init__(self, step: str = "day"):
+        if step not in self._FMT:
+            raise ValueError(f"unknown datetime step {step!r}")
+        self.step = step
+
+    def names(self, ft, batch, dicts):
+        dtg = ft.dtg_field
+        if dtg is None:
+            raise ValueError("DateTimeScheme requires a date attribute")
+        ts = batch.columns[dtg].astype("datetime64[ms]")
+        unit = {"year": "Y", "month": "M", "day": "D", "hour": "h"}[self.step]
+        # numpy ISO strings: 2020-01-05T13 -> 2020/01/05/13 path segments
+        iso = np.datetime_as_string(ts.astype(f"datetime64[{unit}]"))
+        return np.array(
+            [s.replace("-", "/").replace("T", "/") for s in iso], dtype=object
+        )
+
+    def name_depth(self) -> int:
+        return len(self._FMT[self.step].split("/"))
+
+    def _bounds_ms(self, name: str) -> Tuple[int, int]:
+        from datetime import datetime, timezone
+
+        parts = [int(p) for p in name.split("/")]
+        y = parts[0]
+        mo = parts[1] if len(parts) > 1 else 1
+        d = parts[2] if len(parts) > 2 else 1
+        h = parts[3] if len(parts) > 3 else 0
+        lo = datetime(y, mo, d, h, tzinfo=timezone.utc)
+        if self.step == "year":
+            hi = datetime(y + 1, 1, 1, tzinfo=timezone.utc)
+        elif self.step == "month":
+            hi = (datetime(y + 1, 1, 1, tzinfo=timezone.utc)
+                  if mo == 12 else datetime(y, mo + 1, 1, tzinfo=timezone.utc))
+        else:
+            from datetime import timedelta
+
+            hi = lo + (timedelta(days=1) if self.step == "day" else timedelta(hours=1))
+        to_ms = lambda t: int(t.timestamp() * 1000)  # noqa: E731
+        return to_ms(lo), to_ms(hi)
+
+    def keep(self, ft, name, f):
+        dtg = ft.dtg_field
+        if dtg is None:
+            return True
+        iv = ir.extract_intervals(f, dtg)
+        if iv.disjoint:
+            return False
+        if iv.is_empty:
+            return True  # unconstrained
+        lo, hi = self._bounds_ms(name)
+        return any(qlo < hi and lo <= qhi for qlo, qhi in iv.values)
+
+    def config(self):
+        return {"kind": self.kind, "step": self.step}
+
+
+class Z2Scheme(PartitionScheme):
+    """Spatial partitions by coarse Z2 cell of the point/centroid
+    (Z2Scheme/XZ2Scheme analog). ``bits`` per dimension (2 => 16 cells)."""
+
+    kind = "z2"
+
+    def __init__(self, bits: int = 2):
+        self.bits = bits
+        self._nx = NormalizedDimension(-180.0, 180.0, bits)
+        self._ny = NormalizedDimension(-90.0, 90.0, bits)
+
+    def names(self, ft, batch, dicts):
+        g = ft.geom_field
+        ix = self._nx.normalize(batch.columns[g + "__x"])
+        iy = self._ny.normalize(batch.columns[g + "__y"])
+        z = interleave2(ix, iy)
+        width = max(1, (2 * self.bits + 3) // 4)
+        return np.array([f"z2_{int(v):0{width}x}" for v in z], dtype=object)
+
+    def _cell_bbox(self, name: str):
+        z = int(name[3:], 16)
+        ix, iy = deinterleave2(np.array([z], np.uint64))
+        dx = 360.0 / (1 << self.bits)
+        dy = 180.0 / (1 << self.bits)
+        x0 = -180.0 + float(ix[0]) * dx
+        y0 = -90.0 + float(iy[0]) * dy
+        return (x0, y0, x0 + dx, y0 + dy)
+
+    def keep(self, ft, name, f):
+        g = ft.geom_field
+        if g is None:
+            return True
+        fv = ir.extract_geometries(f, g)
+        if fv.disjoint:
+            return False
+        if fv.is_empty:
+            return True  # unconstrained
+        xmin, ymin, xmax, ymax = self._cell_bbox(name)
+        eps = 1e-9
+        for geom in fv.values:
+            gx0, gy0, gx1, gy1 = geom.bounds()
+            if gx0 <= xmax + eps and gx1 >= xmin - eps and gy0 <= ymax + eps and gy1 >= ymin - eps:
+                return True
+        return False
+
+    def config(self):
+        return {"kind": self.kind, "bits": self.bits}
+
+
+class AttributeScheme(PartitionScheme):
+    """One partition per attribute value (AttributeScheme analog).
+
+    Values become directory names ``v_<percent-encoded>`` — the ``v_`` prefix
+    guarantees a name can never be '.', '..', or the null sentinel, and
+    percent-encoding removes '/', so values cannot cross directory
+    boundaries or escape the dataset root."""
+
+    kind = "attribute"
+
+    def __init__(self, attr: str):
+        self.attr = attr
+
+    @staticmethod
+    def _encode(v: Optional[str]) -> str:
+        from urllib.parse import quote
+
+        if v is None:
+            return "__null__"
+        return "v_" + quote(str(v), safe="")
+
+    @staticmethod
+    def _decode(name: str) -> Optional[str]:
+        from urllib.parse import unquote
+
+        if name == "__null__":
+            return None
+        return unquote(name[2:])
+
+    def names(self, ft, batch, dicts):
+        a = ft.attr(self.attr)
+        col = batch.columns[self.attr]
+        if a.type == "string":
+            vocab = dicts[self.attr].values
+            raw = [None if c < 0 else vocab[c] for c in col]
+        else:
+            raw = [str(v) for v in col]
+        return np.array([self._encode(v) for v in raw], dtype=object)
+
+    def keep(self, ft, name, f):
+        fv = ir.extract_attr_bounds(f, self.attr)
+        if fv.disjoint:
+            return False
+        if fv.is_empty:
+            return True  # unconstrained
+        raw = self._decode(name)
+        if raw is None:
+            return False  # nulls match no equality/range predicate
+        a = ft.attr(self.attr)
+        for lo, hi in fv.values:
+            if a.type not in ("string", "date"):
+                try:
+                    v = float(raw)
+                except ValueError:
+                    return True
+                lo2 = -np.inf if lo is None else float(lo)
+                hi2 = np.inf if hi is None else float(hi)
+                if lo2 <= v <= hi2:
+                    return True
+            elif lo is not None and hi is not None and str(lo) == str(hi):
+                if raw == str(lo):
+                    return True
+            else:
+                # string range: conservative (partition may match)
+                return True
+        return False
+
+    def config(self):
+        return {"kind": self.kind, "attr": self.attr}
+
+
+class CompositeScheme(PartitionScheme):
+    """Nested partitioning a/b (composite scheme analog)."""
+
+    kind = "composite"
+
+    def __init__(self, schemes: Sequence[PartitionScheme]):
+        self.schemes = list(schemes)
+
+    def names(self, ft, batch, dicts):
+        parts = [s.names(ft, batch, dicts) for s in self.schemes]
+        return np.array(["/".join(p) for p in zip(*parts)], dtype=object)
+
+    def keep(self, ft, name, f):
+        pieces = name.split("/")
+        i = 0
+        for s in self.schemes:
+            depth = s.name_depth()
+            sub = "/".join(pieces[i : i + depth])
+            if not s.keep(ft, sub, f):
+                return False
+            i += depth
+        return True
+
+    def name_depth(self) -> int:
+        return sum(s.name_depth() for s in self.schemes)
+
+    def config(self):
+        return {"kind": self.kind, "schemes": [s.config() for s in self.schemes]}
+
+
+def scheme_from_config(cfg: Dict) -> PartitionScheme:
+    kind = cfg["kind"]
+    if kind == "datetime":
+        return DateTimeScheme(cfg.get("step", "day"))
+    if kind == "z2":
+        return Z2Scheme(int(cfg.get("bits", 2)))
+    if kind == "attribute":
+        return AttributeScheme(cfg["attr"])
+    if kind == "composite":
+        return CompositeScheme([scheme_from_config(c) for c in cfg["schemes"]])
+    raise ValueError(f"unknown partition scheme {kind!r}")
+
+
+class FileSystemStorage:
+    """A directory of partitioned Parquet files + JSON metadata per type.
+
+    Layout::
+
+        root/<type>/metadata.json
+        root/<type>/data/<partition>/<uuid>.parquet
+    """
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+
+    # -- metadata ----------------------------------------------------------
+    def _meta_path(self, name: str) -> str:
+        return os.path.join(self.root, name, "metadata.json")
+
+    def _load_meta(self, name: str) -> Dict:
+        try:
+            with open(self._meta_path(name)) as fh:
+                return json.load(fh)
+        except FileNotFoundError:
+            raise KeyError(f"no filesystem type {name!r} under {self.root}")
+
+    def _save_meta(self, name: str, meta: Dict):
+        path = self._meta_path(name)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(meta, fh, indent=2)
+        os.replace(tmp, path)
+
+    def list_types(self) -> List[str]:
+        out = []
+        for d in sorted(os.listdir(self.root)):
+            if os.path.exists(self._meta_path(d)):
+                out.append(d)
+        return out
+
+    def create(self, ft: FeatureType, scheme: Optional[PartitionScheme] = None):
+        if os.path.exists(self._meta_path(ft.name)):
+            raise ValueError(f"type {ft.name!r} already exists")
+        scheme = scheme or (
+            DateTimeScheme("day") if ft.dtg_field else Z2Scheme(2)
+        )
+        os.makedirs(os.path.join(self.root, ft.name, "data"), exist_ok=True)
+        self._save_meta(ft.name, {
+            "spec": ft.spec(),
+            "scheme": scheme.config(),
+            "partitions": {},   # name -> [file names]
+            "count": 0,
+        })
+
+    def schema(self, name: str) -> FeatureType:
+        return FeatureType.from_spec(name, self._load_meta(name)["spec"])
+
+    def scheme(self, name: str) -> PartitionScheme:
+        return scheme_from_config(self._load_meta(name)["scheme"])
+
+    def partitions(self, name: str) -> List[str]:
+        return sorted(self._load_meta(name)["partitions"])
+
+    def count(self, name: str) -> int:
+        return int(self._load_meta(name).get("count", 0))
+
+    # -- write -------------------------------------------------------------
+    def write(self, name: str, data: Dict, fids=None) -> int:
+        """Append a batch, splitting rows across partitions."""
+        with self._lock:
+            meta = self._load_meta(name)
+            ft = FeatureType.from_spec(name, meta["spec"])
+            scheme = scheme_from_config(meta["scheme"])
+            dicts: Dict[str, DictionaryEncoder] = {}
+            batch = encode_batch(ft, data, dicts, fids)
+            pnames = scheme.names(ft, batch, dicts)
+            for p in np.unique(pnames):
+                sel = batch.select(pnames == p)
+                rb = arrow_io.batch_to_arrow(ft, sel, dicts)
+                pdir = os.path.join(self.root, name, "data", str(p))
+                os.makedirs(pdir, exist_ok=True)
+                fname = uuid.uuid4().hex[:16] + ".parquet"
+                pq.write_table(pa.Table.from_batches([rb]), os.path.join(pdir, fname))
+                meta["partitions"].setdefault(str(p), []).append(fname)
+            meta["count"] = meta.get("count", 0) + batch.n
+            self._save_meta(name, meta)
+            return batch.n
+
+    # -- read --------------------------------------------------------------
+    def prune(self, name: str, ecql: "str | ir.Filter" = "INCLUDE") -> List[str]:
+        """Partitions that may match the filter (pushdown pruning)."""
+        meta = self._load_meta(name)
+        ft = FeatureType.from_spec(name, meta["spec"])
+        scheme = scheme_from_config(meta["scheme"])
+        f = parse_ecql(ecql) if isinstance(ecql, str) else ecql
+        return [p for p in sorted(meta["partitions"]) if scheme.keep(ft, p, f)]
+
+    def read(self, name: str, ecql: "str | ir.Filter" = "INCLUDE",
+             columns: Optional[Sequence[str]] = None) -> pa.Table:
+        """Read all (pruned) partitions as one Arrow table. Row-level
+        filtering is left to the caller's compiled predicate."""
+        meta = self._load_meta(name)
+        tables = []
+        for p in self.prune(name, ecql):
+            pdir = os.path.join(self.root, name, "data", p)
+            for fname in meta["partitions"][p]:
+                tables.append(pq.read_table(os.path.join(pdir, fname), columns=columns))
+        if not tables:
+            # match the schema of existing files if any (WKT vs point geometry)
+            for p in sorted(meta["partitions"]):
+                files = meta["partitions"][p]
+                if files:
+                    path = os.path.join(self.root, name, "data", p, files[0])
+                    return pq.read_schema(path).empty_table()
+            ft = FeatureType.from_spec(name, meta["spec"])
+            return arrow_io.arrow_schema(ft).empty_table()
+        schema = pa.unify_schemas([t.schema for t in tables], promote_options="permissive")
+        return pa.concat_tables([t.cast(schema) for t in tables]).unify_dictionaries()
+
+    def read_partition(self, name: str, partition: str) -> pa.Table:
+        meta = self._load_meta(name)
+        pdir = os.path.join(self.root, name, "data", partition)
+        tables = [
+            pq.read_table(os.path.join(pdir, f)) for f in meta["partitions"][partition]
+        ]
+        schema = pa.unify_schemas([t.schema for t in tables], promote_options="permissive")
+        return pa.concat_tables([t.cast(schema) for t in tables]).unify_dictionaries()
+
+    # -- maintenance -------------------------------------------------------
+    def compact(self, name: str, partition: Optional[str] = None) -> int:
+        """Merge each partition's files into one (compaction analog).
+        Returns number of files removed."""
+        with self._lock:
+            meta = self._load_meta(name)
+            removed = 0
+            targets = [partition] if partition else list(meta["partitions"])
+            for p in targets:
+                files = meta["partitions"].get(p, [])
+                if len(files) <= 1:
+                    continue
+                pdir = os.path.join(self.root, name, "data", p)
+                tables = [pq.read_table(os.path.join(pdir, f)) for f in files]
+                schema = pa.unify_schemas(
+                    [t.schema for t in tables], promote_options="permissive"
+                )
+                merged = pa.concat_tables(
+                    [t.cast(schema) for t in tables]
+                ).unify_dictionaries()
+                fname = uuid.uuid4().hex[:16] + ".parquet"
+                pq.write_table(merged, os.path.join(pdir, fname))
+                for f in files:
+                    os.remove(os.path.join(pdir, f))
+                    removed += 1
+                meta["partitions"][p] = [fname]
+            self._save_meta(name, meta)
+            return removed
+
+    def delete_type(self, name: str):
+        import shutil
+
+        self._load_meta(name)
+        shutil.rmtree(os.path.join(self.root, name))
+
+    # -- bulk load into the device store ------------------------------------
+    def load_into(self, dataset, name: str, ecql: "str | ir.Filter" = "INCLUDE") -> int:
+        """Ingest (pruned) partitions into a GeoDataset store."""
+        ft = self.schema(name)
+        if name not in dataset.list_schemas():
+            dataset.create_schema(FeatureType.from_spec(name, ft.spec()))
+        table = self.read(name, ecql)
+        if table.num_rows == 0:
+            return 0
+        data, fids = arrow_io.table_to_data(ft, table)
+        n = dataset.insert(name, data, fids)
+        dataset.flush(name)
+        return n

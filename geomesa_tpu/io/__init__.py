@@ -1,0 +1,1 @@
+"""IO: Arrow interchange, Parquet storage, BIN format, export formats."""

@@ -1,0 +1,261 @@
+"""Arrow interchange: columnar batches <-> Arrow record batches / IPC.
+
+Role parity with geomesa-arrow (SURVEY.md §2.6): `SimpleFeatureVector`
+(schema -> vectors including geometry vectors, vector/SimpleFeatureVector.scala:42),
+`ArrowDictionary` (dictionary-encoded string attributes), file/stream
+reader-writers, and `DeltaWriter` (io/DeltaWriter.scala:53 — incremental
+batches with inline dictionary deltas merged client-side).
+
+Mapping (one Arrow field per attribute):
+
+* point geometry  -> FixedSizeList<float64>[2]  (x, y)   [like the reference's
+                     fixed-size point vectors in geomesa-arrow-jts]
+* other geometry  -> utf8 WKT
+* date            -> timestamp[ms]
+* string          -> dictionary<int32, utf8>   (codes shared with the store)
+* numerics/bool   -> their arrow type
+* feature id      -> utf8 field "__fid__"
+
+The in-memory dictionary codes ARE the Arrow dictionary codes — export is
+zero-re-encode, and the device layout is by construction Arrow-compatible.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from geomesa_tpu.schema.columns import ColumnBatch, DictionaryEncoder, encode_batch
+from geomesa_tpu.schema.feature_type import FeatureType
+
+FID = "__fid__"
+
+
+def point_type() -> pa.DataType:
+    return pa.list_(pa.float64(), 2)
+
+
+def arrow_field(ft: FeatureType, name: str, wkt_geoms: Sequence[str] = ()) -> pa.Field:
+    """``wkt_geoms`` names non-point geometry attributes stored WITH a WKT
+    column (-> utf8); non-point geometries ingested as x/y reference points
+    only are FixedSizeList like points, keeping empty- and non-empty-result
+    schemas identical for the same dataset."""
+    a = ft.attr(name)
+    if a.is_geom:
+        t = pa.utf8() if (not a.is_point and name in wkt_geoms) else point_type()
+    elif a.type == "date":
+        t = pa.timestamp("ms")
+    elif a.type == "string":
+        t = pa.dictionary(pa.int32(), pa.utf8())
+    elif a.type == "bool":
+        t = pa.bool_()
+    else:
+        t = pa.from_numpy_dtype(np.dtype(a.type))
+    return pa.field(name, t)
+
+
+def arrow_schema(ft: FeatureType, properties: Optional[Sequence[str]] = None,
+                 wkt_geoms: Sequence[str] = ()) -> pa.Schema:
+    names = properties or [a.name for a in ft.attributes]
+    fields = [pa.field(FID, pa.utf8())] + [
+        arrow_field(ft, n, wkt_geoms) for n in names
+    ]
+    return pa.schema(fields, metadata={b"geomesa:spec": ft.spec().encode()})
+
+
+def batch_to_arrow(
+    ft: FeatureType,
+    batch: ColumnBatch,
+    dicts: Dict[str, DictionaryEncoder],
+    properties: Optional[Sequence[str]] = None,
+) -> pa.RecordBatch:
+    """Encoded columns -> Arrow record batch (strings stay dictionary codes).
+
+    Non-point geometries are emitted as utf8 WKT when the batch carries the
+    ``__wkt`` column, else as their x/y reference point (FixedSizeList) —
+    the field type always matches the emitted array.
+    """
+    names = properties or [a.name for a in ft.attributes]
+    arrays: List[pa.Array] = [None]  # fid placeholder
+    fields: List[pa.Field] = [pa.field(FID, pa.utf8())]
+    fids = batch.columns.get(FID)
+    if fids is None:
+        fids = np.array([str(i) for i in range(batch.n)], dtype=object)
+    arrays[0] = pa.array([str(f) for f in fids], pa.utf8())
+    for name in names:
+        a = ft.attr(name)
+        if a.is_geom:
+            if a.is_point or name + "__wkt" not in batch.columns:
+                xs = batch.columns[name + "__x"]
+                ys = batch.columns[name + "__y"]
+                flat = np.empty(2 * len(xs), np.float64)
+                flat[0::2], flat[1::2] = xs, ys
+                arrays.append(
+                    pa.FixedSizeListArray.from_arrays(pa.array(flat), 2)
+                )
+                fields.append(pa.field(name, point_type()))
+            else:
+                arrays.append(
+                    pa.array([str(w) for w in batch.columns[name + "__wkt"]], pa.utf8())
+                )
+                fields.append(pa.field(name, pa.utf8()))
+        elif a.type == "date":
+            arrays.append(pa.array(batch.columns[name], pa.timestamp("ms")))
+            fields.append(pa.field(name, pa.timestamp("ms")))
+        elif a.type == "string":
+            codes = batch.columns[name]
+            vocab = dicts.get(name, DictionaryEncoder()).values
+            mask = codes < 0
+            arrays.append(
+                pa.DictionaryArray.from_arrays(
+                    pa.array(np.where(mask, 0, codes).astype(np.int32),
+                             mask=mask),
+                    pa.array(vocab if vocab else [""], pa.utf8()),
+                )
+            )
+            fields.append(pa.field(name, pa.dictionary(pa.int32(), pa.utf8())))
+        else:
+            arr = pa.array(batch.columns[name])
+            arrays.append(arr)
+            fields.append(pa.field(name, arr.type))
+    schema = pa.schema(fields, metadata={b"geomesa:spec": ft.spec().encode()})
+    return pa.RecordBatch.from_arrays(arrays, schema=schema)
+
+
+def table_to_data(ft: FeatureType, table: "pa.Table | pa.RecordBatch") -> Tuple[Dict, List[str]]:
+    """Arrow -> (data dict for encode_batch, fids). Inverse of batch_to_arrow;
+    also accepts 'plain' layouts (x/y columns, utf8 strings, int64 dates)."""
+    if isinstance(table, pa.RecordBatch):
+        table = pa.Table.from_batches([table])
+    cols = {c: table.column(c) for c in table.column_names}
+    data: Dict[str, object] = {}
+    fids = None
+    if FID in cols:
+        fids = cols[FID].to_pylist()
+    for a in ft.attributes:
+        name = a.name
+        if a.is_geom:
+            if name in cols:
+                col = cols[name]
+            elif name + "__x" in cols:
+                data[name + "__x"] = np.asarray(cols[name + "__x"].to_numpy(zero_copy_only=False))
+                data[name + "__y"] = np.asarray(cols[name + "__y"].to_numpy(zero_copy_only=False))
+                continue
+            else:
+                raise KeyError(f"arrow input missing geometry column {name!r}")
+            t = col.type
+            if pa.types.is_fixed_size_list(t) or pa.types.is_list(t):
+                arr = col.combine_chunks()
+                if isinstance(arr, pa.ChunkedArray):
+                    arr = arr.chunk(0)
+                flat = np.asarray(arr.flatten().to_numpy(zero_copy_only=False), np.float64)
+                data[name + "__x"] = flat[0::2].copy()
+                data[name + "__y"] = flat[1::2].copy()
+            else:
+                data[name] = col.to_pylist()  # WKT strings
+        elif a.type == "date":
+            col = cols[name]
+            if pa.types.is_timestamp(col.type):
+                data[name] = col.cast(pa.timestamp("ms")).to_numpy(zero_copy_only=False).astype("datetime64[ms]")
+            else:
+                data[name] = np.asarray(col.to_numpy(zero_copy_only=False), np.int64)
+        elif a.type == "string":
+            col = cols[name]
+            if pa.types.is_dictionary(col.type):
+                col = col.cast(pa.utf8())
+            data[name] = col.to_pylist()
+        else:
+            data[name] = np.asarray(
+                cols[name].to_numpy(zero_copy_only=False)
+            )
+    return data, fids
+
+
+# -- IPC files / streams ----------------------------------------------------
+
+def write_ipc(path_or_buf, batches: Iterable[pa.RecordBatch], schema: pa.Schema):
+    with pa.OSFile(path_or_buf, "wb") if isinstance(path_or_buf, str) else path_or_buf as sink:
+        with pa.ipc.new_file(sink, schema) as writer:
+            for b in batches:
+                writer.write_batch(b)
+
+
+def read_ipc(path_or_buf) -> pa.Table:
+    src = pa.memory_map(path_or_buf) if isinstance(path_or_buf, str) else path_or_buf
+    with pa.ipc.open_file(src) as reader:
+        return reader.read_all()
+
+
+class _ChunkSink:
+    """File-like sink that lets the writer snapshot bytes appended per batch."""
+
+    def __init__(self):
+        self._buf = bytearray()
+
+    def write(self, data) -> int:
+        b = bytes(data)
+        self._buf += b
+        return len(b)
+
+    def take(self) -> bytes:
+        out = bytes(self._buf)
+        self._buf.clear()
+        return out
+
+    def flush(self):
+        pass
+
+    @property
+    def closed(self) -> bool:
+        return False
+
+    def close(self):
+        pass
+
+
+class DeltaWriter:
+    """Incremental Arrow stream with dictionary deltas (DeltaWriter.scala:53
+    analog): one long-lived IPC stream; each ``write`` returns the bytes
+    appended for that batch — the first chunk carries the schema + initial
+    dictionaries, later chunks carry only dictionary *deltas* (new entries)
+    plus the record batch. Chunks are order-dependent; ``merge`` concatenates
+    and decodes them client-side (the reference merges delta batches the same
+    way, ArrowScan.scala:38-79)."""
+
+    def __init__(self, ft: FeatureType, dicts: Dict[str, DictionaryEncoder],
+                 properties: Optional[Sequence[str]] = None):
+        self.ft = ft
+        self.dicts = dicts
+        self.properties = properties
+        self._sink = _ChunkSink()
+        self._writer = None
+
+    def write(self, batch: ColumnBatch) -> bytes:
+        rb = batch_to_arrow(self.ft, batch, self.dicts, self.properties)
+        if self._writer is None:
+            opts = pa.ipc.IpcWriteOptions(emit_dictionary_deltas=True)
+            self._writer = pa.ipc.new_stream(self._sink, rb.schema, options=opts)
+        self._writer.write_batch(rb)
+        return self._sink.take()
+
+    def close(self) -> bytes:
+        """End the stream; returns any trailing bytes (EOS marker)."""
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+        return self._sink.take()
+
+    @staticmethod
+    def merge(chunks: Sequence[bytes]) -> pa.Table:
+        if not chunks:
+            return pa.table({})
+        with pa.ipc.open_stream(pa.BufferReader(b"".join(chunks))) as r:
+            batches = []
+            while True:
+                try:
+                    batches.append(r.read_next_batch())
+                except StopIteration:
+                    break
+        return pa.Table.from_batches(batches).unify_dictionaries()

@@ -164,13 +164,22 @@ class Executor:
         setup = self._scan_setup(plan)
         if setup is None:
             return ColumnBatch({}, 0)
+        mask = None
         if setup["use_device"]:
-            mask = np.asarray(
-                self._device_mask_and_agg(
-                    plan, setup, lambda cols, m, xp: m, cache_key=("mask",)
+            try:
+                mask = np.asarray(
+                    self._device_mask_and_agg(
+                        plan, setup, lambda cols, m, xp: m, cache_key=("mask",)
+                    )
                 )
-            )
-        else:
+            except Exception as e:
+                if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
+                    raise
+                # same graceful degradation as _run(): loud host fallback
+                logging.getLogger(__name__).warning(
+                    "device scan failed, falling back to host: %r", e
+                )
+        if mask is None:
             mask = self._host_mask(plan, setup)
         return setup["table"].host_gather(mask.reshape(-1))
 

@@ -1,0 +1,224 @@
+"""Arrow interchange + FileSystem (Parquet) storage tests.
+
+Mirrors the reference's arrow/fs coverage (SimpleFeatureVectorTest,
+DeltaWriter round-trips, ParquetFileSystemStorage + partition scheme tests).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.api.dataset import Query
+from geomesa_tpu.filter.ecql import parse_iso_ms
+from geomesa_tpu.fs import (
+    AttributeScheme, CompositeScheme, DateTimeScheme, FileSystemStorage,
+    Z2Scheme, scheme_from_config,
+)
+from geomesa_tpu.io import arrow_io
+from geomesa_tpu.schema.columns import DictionaryEncoder, encode_batch
+from geomesa_tpu.schema.feature_type import FeatureType
+
+SPEC = "name:String,age:Integer,dtg:Date,*geom:Point"
+
+
+def _data(n=100, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "name": [f"n{i % 7}" for i in range(n)],
+        "age": rng.integers(0, 90, n).astype(np.int32),
+        "dtg": rng.integers(
+            parse_iso_ms("2020-01-01"), parse_iso_ms("2020-01-20"), n
+        ).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+    }
+
+
+def test_arrow_round_trip():
+    ft = FeatureType.from_spec("t", SPEC)
+    dicts = {}
+    data = _data(50)
+    batch = encode_batch(ft, data, dicts, fids=[f"f{i}" for i in range(50)])
+    rb = arrow_io.batch_to_arrow(ft, batch, dicts)
+    assert rb.num_rows == 50
+    assert pa.types.is_dictionary(rb.schema.field("name").type)
+    assert pa.types.is_timestamp(rb.schema.field("dtg").type)
+    data2, fids2 = arrow_io.table_to_data(ft, rb)
+    assert fids2 == [f"f{i}" for i in range(50)]
+    np.testing.assert_allclose(data2["geom__x"], data["geom__x"])
+    assert data2["name"] == data["name"]
+    np.testing.assert_array_equal(
+        data2["dtg"].astype("datetime64[ms]"), data["dtg"]
+    )
+
+
+def test_arrow_ipc_file_and_dataset_export(tmp_path):
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", SPEC)
+    ds.insert("t", _data(80))
+    path = str(tmp_path / "out.arrow")
+    ds.export_arrow("t", path, "age >= 30")
+    table = arrow_io.read_ipc(path)
+    expect = ds.count("t", "age >= 30")
+    assert table.num_rows == expect
+    assert table.schema.metadata[b"geomesa:spec"].decode().startswith("name:String")
+
+    # re-ingest into a second dataset
+    ds2 = GeoDataset(n_shards=2)
+    ds2.create_schema("t", SPEC)
+    assert ds2.ingest_arrow("t", path) == expect
+    assert ds2.count("t") == expect
+    assert sorted(ds2.unique("t", "name")) == sorted(
+        v for v in set(ds.query("t", "age >= 30").to_dict()["name"])
+    )
+
+
+def test_delta_writer_merge():
+    ft = FeatureType.from_spec("t", SPEC)
+    dicts = {}
+    w = arrow_io.DeltaWriter(ft, dicts)
+    chunks = []
+    for seed in range(3):
+        data = _data(20, seed)
+        data["name"] = [f"batch{seed}_{i % 3}" for i in range(20)]
+        batch = encode_batch(ft, data, dicts)
+        chunks.append(w.write(batch))
+    chunks.append(w.close())
+    merged = arrow_io.DeltaWriter.merge(chunks)
+    assert merged.num_rows == 60
+    names = merged.column("name").to_pylist()
+    assert "batch0_0" in names and "batch2_2" in names
+    # later chunks carry only dictionary deltas, not the full vocab: chunk 2's
+    # payload must not re-ship chunk 0's entries
+    assert b"batch0_0" in chunks[0]
+    assert b"batch0_0" not in chunks[2]
+
+
+def test_arrow_polygon_without_wkt_roundtrip():
+    # ingest path that produces only x/y reference points (no __wkt column)
+    ft = FeatureType.from_spec("t", "name:String,*geom:Polygon")
+    dicts = {}
+    batch = encode_batch(
+        ft, {"name": ["a", "b"], "geom__x": [1.0, 2.0], "geom__y": [3.0, 4.0]}, dicts
+    )
+    rb = arrow_io.batch_to_arrow(ft, batch, dicts)  # must not raise
+    assert rb.num_rows == 2
+    data2, _ = arrow_io.table_to_data(ft, rb)
+    np.testing.assert_allclose(data2["geom__x"], [1.0, 2.0])
+
+
+def test_fs_attribute_value_with_slash(tmp_path):
+    fs = FileSystemStorage(str(tmp_path))
+    ft = FeatureType.from_spec("t", "name:String,dtg:Date,*geom:Point")
+    fs.create(ft, CompositeScheme([DateTimeScheme("day"), AttributeScheme("name")]))
+    fs.write("t", {
+        "name": ["a/b", "../../evil", "ok"],
+        "dtg": np.array(["2020-01-05"] * 3, "datetime64[ms]"),
+        "geom__x": [1.0, 2.0, 3.0],
+        "geom__y": [1.0, 2.0, 3.0],
+    })
+    # no files escape the dataset tree
+    import os
+
+    for root, _, files in [(r, d, f) for r, d, f in __import__("os").walk(str(tmp_path))]:
+        assert os.path.realpath(root).startswith(os.path.realpath(str(tmp_path)))
+    assert fs.read("t").num_rows == 3
+    assert fs.read("t", "name = 'a/b'").num_rows >= 1
+    pruned = fs.prune("t", "name = 'a/b'")
+    assert len(pruned) == 1
+
+
+@pytest.mark.parametrize("scheme_cfg", [
+    {"kind": "datetime", "step": "day"},
+    {"kind": "z2", "bits": 3},
+    {"kind": "attribute", "attr": "name"},
+    {"kind": "composite", "schemes": [
+        {"kind": "datetime", "step": "day"}, {"kind": "attribute", "attr": "name"},
+    ]},
+])
+def test_fs_storage_round_trip(tmp_path, scheme_cfg):
+    fs = FileSystemStorage(str(tmp_path))
+    ft = FeatureType.from_spec("t", SPEC)
+    fs.create(ft, scheme_from_config(scheme_cfg))
+    data = _data(200)
+    fs.write("t", data, fids=[f"f{i}" for i in range(200)])
+    assert fs.count("t") == 200
+    assert len(fs.partitions("t")) > 1
+
+    table = fs.read("t")
+    assert table.num_rows == 200
+
+    ds = GeoDataset(n_shards=2)
+    n = fs.load_into(ds, "t")
+    assert n == 200
+    assert ds.count("t", "age < 30") == int((data["age"] < 30).sum())
+
+
+def test_fs_partition_pruning_datetime(tmp_path):
+    fs = FileSystemStorage(str(tmp_path))
+    ft = FeatureType.from_spec("t", SPEC)
+    fs.create(ft, DateTimeScheme("day"))
+    fs.write("t", _data(300))
+    all_parts = fs.partitions("t")
+    pruned = fs.prune("t", "dtg DURING 2020-01-05T00:00:00Z/2020-01-07T00:00:00Z")
+    assert 0 < len(pruned) < len(all_parts)
+    assert set(pruned) <= set(all_parts)
+    # pruned read still returns every matching row
+    table = fs.read("t", "dtg DURING 2020-01-05T00:00:00Z/2020-01-07T00:00:00Z")
+    dtg = _data(300)["dtg"].astype(np.int64)
+    lo, hi = parse_iso_ms("2020-01-05"), parse_iso_ms("2020-01-07")
+    assert table.num_rows >= int(((dtg >= lo) & (dtg <= hi)).sum())
+
+
+def test_fs_partition_pruning_z2_and_compact(tmp_path):
+    fs = FileSystemStorage(str(tmp_path))
+    ft = FeatureType.from_spec("t", SPEC)
+    fs.create(ft, Z2Scheme(3))
+    for seed in range(3):  # several files per partition
+        fs.write("t", _data(100, seed))
+    pruned = fs.prune("t", "BBOX(geom, -100, 30, -95, 35)")
+    assert 0 < len(pruned) < len(fs.partitions("t"))
+    n_before = fs.read("t").num_rows
+    removed = fs.compact("t")
+    assert removed > 0
+    assert fs.read("t").num_rows == n_before
+    for p in fs.partitions("t"):
+        assert len(fs._load_meta("t")["partitions"][p]) == 1
+
+
+def test_fs_attribute_pruning(tmp_path):
+    fs = FileSystemStorage(str(tmp_path))
+    ft = FeatureType.from_spec("t", SPEC)
+    fs.create(ft, AttributeScheme("name"))
+    fs.write("t", _data(100))
+    pruned = fs.prune("t", "name = 'n3'")
+    assert pruned == ["v_n3"]
+    assert fs.read("t", "name = 'n3'").num_rows == sum(
+        1 for i in range(100) if i % 7 == 3
+    )
+
+
+def test_fs_attribute_hostile_values(tmp_path):
+    # values that collide with sentinels or look like path traversal
+    fs = FileSystemStorage(str(tmp_path))
+    ft = FeatureType.from_spec("t", "name:String,dtg:Date,*geom:Point")
+    fs.create(ft, AttributeScheme("name"))
+    fs.write("t", {
+        "name": ["__null__", "..", ".", "", "normal"],
+        "dtg": np.array(["2020-01-05"] * 5, "datetime64[ms]"),
+        "geom__x": [1.0] * 5,
+        "geom__y": [2.0] * 5,
+    })
+    import os
+
+    data_dir = os.path.join(str(tmp_path), "t", "data")
+    # every partition dir is a direct, non-traversing child of data/
+    for p in fs.partitions("t"):
+        full = os.path.realpath(os.path.join(data_dir, p))
+        assert os.path.dirname(full) == os.path.realpath(data_dir)
+    assert fs.read("t").num_rows == 5
+    # literal '__null__' value is distinct from the null sentinel
+    assert fs.read("t", "name = '__null__'").num_rows == 1
+    assert fs.read("t", "name = '..'").num_rows == 1
+    assert fs.read("t", "name = ''").num_rows == 1
