@@ -144,6 +144,21 @@ class GeoDataset:
         for st in ([self._store(name)] if name else self._stores.values()):
             st.flush()
 
+    def ingest(self, name: str, source, converter_config) -> "Any":
+        """Converter-driven ingest (geomesa-convert analog). ``source`` is
+        text / a file object / parsed JSON; returns the EvaluationContext
+        with success/failure counts."""
+        from geomesa_tpu.convert import EvaluationContext, converter_for
+
+        st = self._store(name)
+        conv = converter_for(st.ft, converter_config)
+        ctx = EvaluationContext()
+        for data, fids in conv.convert(source, ctx):
+            if data and len(next(iter(data.values()), ())) > 0:
+                self.insert(name, data, fids)
+        self.flush(name)
+        return ctx
+
     def delete_features(self, name: str, ecql: str) -> int:
         st = self._store(name)
         f = parse_ecql(ecql)
@@ -295,6 +310,19 @@ class GeoDataset:
         plan = planner.plan(f, Query().hints())
         batch = self._executor(st).features(plan)
         return FeatureCollection(st.ft, batch, st.dicts)
+
+    def export_bin(self, name: str, query: "str | Query" = "INCLUDE",
+                   track: Optional[str] = None, label: Optional[str] = None,
+                   sort: bool = True) -> bytes:
+        """Query results as packed BIN records (BinAggregatingScan /
+        BinConversionProcess analog): 16 bytes/record, 24 with a label."""
+        from geomesa_tpu.io import bin_format
+
+        fc = self.query(name, query)
+        st = self._store(name)
+        if fc.batch.n == 0:
+            return b""
+        return bin_format.pack_batch(st.ft, fc.batch, st.dicts, track, label, sort)
 
     # -- Arrow interchange (geomesa-arrow / ArrowScan analog) --------------
     def to_arrow(self, name: str, query: "str | Query" = "INCLUDE",

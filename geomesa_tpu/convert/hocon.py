@@ -1,0 +1,187 @@
+"""Minimal HOCON-subset parser for converter configs.
+
+The reference's converter definitions are HOCON (typesafe-config). This
+parses the subset those configs actually use — nested objects, arrays,
+``key = value`` / ``key { ... }``, quoted and unquoted scalars, ``//`` and
+``#`` comments — into plain dicts. Full HOCON substitution/include is out of
+scope; JSON is accepted as-is (HOCON is a superset of JSON).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Dict, List, Tuple
+
+
+def loads(text: str) -> Dict[str, Any]:
+    try:
+        return json.loads(text)
+    except json.JSONDecodeError:
+        pass
+    p = _Parser(text)
+    return p.parse_root()
+
+
+class _Parser:
+    def __init__(self, text: str):
+        self.text = text
+        self.i = 0
+        self.n = len(text)
+
+    # -- lexing helpers ----------------------------------------------------
+    def _skip_ws(self):
+        while self.i < self.n:
+            c = self.text[self.i]
+            if c in " \t\r\n,":
+                self.i += 1
+            elif c == "#" or self.text.startswith("//", self.i):
+                while self.i < self.n and self.text[self.i] != "\n":
+                    self.i += 1
+            else:
+                return
+
+    def _error(self, msg: str):
+        line = self.text.count("\n", 0, self.i) + 1
+        raise ValueError(f"HOCON parse error line {line}: {msg}")
+
+    def _key(self) -> str:
+        self._skip_ws()
+        if self.i < self.n and self.text[self.i] in "\"'":
+            return self._quoted()
+        m = re.match(r"[A-Za-z0-9_.\-$]+", self.text[self.i:])
+        if not m:
+            self._error(f"expected key at {self.text[self.i:self.i+20]!r}")
+        self.i += m.end()
+        return m.group(0)
+
+    def _quoted(self) -> str:
+        q = self.text[self.i]
+        self.i += 1
+        # triple-quoted
+        if self.text.startswith(q * 2, self.i):
+            self.i += 2
+            end = self.text.find(q * 3, self.i)
+            if end < 0:
+                self._error("unterminated triple-quoted string")
+            s = self.text[self.i:end]
+            self.i = end + 3
+            return s
+        out = []
+        while self.i < self.n:
+            c = self.text[self.i]
+            if c == "\\" and self.i + 1 < self.n:
+                nxt = self.text[self.i + 1]
+                out.append({"n": "\n", "t": "\t", '"': '"', "'": "'", "\\": "\\"}.get(nxt, nxt))
+                self.i += 2
+            elif c == q:
+                self.i += 1
+                return "".join(out)
+            else:
+                out.append(c)
+                self.i += 1
+        self._error("unterminated string")
+
+    def _scalar(self) -> Any:
+        # unquoted value up to newline/},] or an end-of-line comment
+        start = self.i
+        while self.i < self.n and self.text[self.i] not in "\n,}]":
+            if self.text[self.i] == "#" or self.text.startswith("//", self.i):
+                break
+            self.i += 1
+        raw = self.text[start:self.i].strip()
+        if raw == "true":
+            return True
+        if raw == "false":
+            return False
+        if raw == "null":
+            return None
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+        try:
+            return float(raw)
+        except ValueError:
+            pass
+        return raw
+
+    # -- grammar -----------------------------------------------------------
+    def parse_root(self) -> Dict[str, Any]:
+        self._skip_ws()
+        if self.i < self.n and self.text[self.i] == "{":
+            return self._object()
+        # braceless root object
+        obj: Dict[str, Any] = {}
+        while True:
+            self._skip_ws()
+            if self.i >= self.n:
+                return obj
+            self._entry(obj)
+
+    def _entry(self, obj: Dict[str, Any]):
+        key = self._key()
+        # dotted keys create nested objects (HOCON path expressions)
+        parts = key.split(".") if not key.startswith('"') else [key]
+        for p in parts[:-1]:
+            nxt = obj.get(p)
+            if not isinstance(nxt, dict):
+                nxt = obj[p] = {}
+            obj = nxt
+        key = parts[-1]
+        self._skip_ws()
+        if self.i < self.n and self.text[self.i] == "{":
+            val = self._object()
+            # key { } merges into existing object at key (HOCON semantics)
+            if isinstance(obj.get(key), dict):
+                obj[key].update(val)
+            else:
+                obj[key] = val
+            return
+        if self.i < self.n and self.text[self.i] in "=:":
+            self.i += 1
+            self._skip_ws()
+            val = self._value()
+            if isinstance(obj.get(key), dict) and isinstance(val, dict):
+                obj[key].update(val)
+            else:
+                obj[key] = val
+            return
+        self._error(f"expected '=' or '{{' after key {key!r}")
+
+    def _value(self) -> Any:
+        self._skip_ws()
+        c = self.text[self.i] if self.i < self.n else ""
+        if c == "{":
+            return self._object()
+        if c == "[":
+            return self._array()
+        if c in "\"'":
+            return self._quoted()
+        return self._scalar()
+
+    def _object(self) -> Dict[str, Any]:
+        assert self.text[self.i] == "{"
+        self.i += 1
+        obj: Dict[str, Any] = {}
+        while True:
+            self._skip_ws()
+            if self.i >= self.n:
+                self._error("unterminated object")
+            if self.text[self.i] == "}":
+                self.i += 1
+                return obj
+            self._entry(obj)
+
+    def _array(self) -> List[Any]:
+        assert self.text[self.i] == "["
+        self.i += 1
+        out: List[Any] = []
+        while True:
+            self._skip_ws()
+            if self.i >= self.n:
+                self._error("unterminated array")
+            if self.text[self.i] == "]":
+                self.i += 1
+                return out
+            out.append(self._value())

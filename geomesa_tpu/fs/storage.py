@@ -390,13 +390,21 @@ class FileSystemStorage:
                 tables.append(pq.read_table(os.path.join(pdir, fname), columns=columns))
         if not tables:
             # match the schema of existing files if any (WKT vs point geometry)
+            schema = None
             for p in sorted(meta["partitions"]):
                 files = meta["partitions"][p]
                 if files:
                     path = os.path.join(self.root, name, "data", p, files[0])
-                    return pq.read_schema(path).empty_table()
-            ft = FeatureType.from_spec(name, meta["spec"])
-            return arrow_io.arrow_schema(ft).empty_table()
+                    schema = pq.read_schema(path)
+                    break
+            if schema is None:
+                ft = FeatureType.from_spec(name, meta["spec"])
+                schema = arrow_io.arrow_schema(ft)
+            if columns is not None:
+                schema = pa.schema(
+                    [schema.field(c) for c in columns if schema.get_field_index(c) >= 0]
+                )
+            return schema.empty_table()
         schema = pa.unify_schemas([t.schema for t in tables], promote_options="permissive")
         return pa.concat_tables([t.cast(schema) for t in tables]).unify_dictionaries()
 

@@ -1,0 +1,87 @@
+"""GeoJSON export/import (tools export -F geojson + geomesa-geojson analog)."""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from geomesa_tpu.schema.columns import ColumnBatch, DictionaryEncoder
+from geomesa_tpu.schema.feature_type import FeatureType
+from geomesa_tpu.utils import geometry as geo
+
+
+def _geom_json(ft: FeatureType, name: str, batch: ColumnBatch, i: int):
+    wkt_col = batch.columns.get(name + "__wkt")
+    if wkt_col is not None:
+        g = geo.parse_wkt(str(wkt_col[i]))
+        return _shape_to_json(g)
+    xs = batch.columns.get(name + "__x")
+    if xs is None:  # geometry projected out of the result
+        return None
+    x = float(xs[i])
+    y = float(batch.columns[name + "__y"][i])
+    return {"type": "Point", "coordinates": [x, y]}
+
+
+def _shape_to_json(g: geo.Geometry) -> Dict:
+    if isinstance(g, geo.Point):
+        return {"type": "Point", "coordinates": [g.x, g.y]}
+    if isinstance(g, geo.LineString):
+        return {"type": "LineString", "coordinates": [list(p) for p in g.coords]}
+    if isinstance(g, geo.Polygon):
+        rings = [g.shell] + list(g.holes)
+        return {
+            "type": "Polygon",
+            "coordinates": [[list(p) for p in r] for r in rings],
+        }
+    if isinstance(g, geo.MultiPoint):
+        return {"type": "MultiPoint", "coordinates": [[p.x, p.y] for p in g.points]}
+    if isinstance(g, geo.MultiPolygon):
+        return {
+            "type": "MultiPolygon",
+            "coordinates": [
+                [[list(p) for p in ring] for ring in [poly.shell] + list(poly.holes)]
+                for poly in g.polygons
+            ],
+        }
+    raise ValueError(f"cannot encode {type(g).__name__} as GeoJSON")
+
+
+def to_geojson(ft: FeatureType, batch: ColumnBatch,
+               dicts: Dict[str, DictionaryEncoder]) -> Dict:
+    """ColumnBatch -> GeoJSON FeatureCollection dict."""
+    gname = ft.geom_field
+    features: List[Dict] = []
+    decoded: Dict[str, list] = {}
+    for a in ft.attributes:
+        if a.is_geom:
+            continue
+        col = batch.columns.get(a.name)
+        if col is None:
+            continue
+        if a.type == "string":
+            decoded[a.name] = dicts[a.name].decode(col)
+        elif a.type == "date":
+            decoded[a.name] = [
+                None if v is None else str(np.datetime64(int(v), "ms")) + "Z"
+                for v in col.tolist()
+            ]
+        else:
+            decoded[a.name] = col.tolist()
+    fids = batch.columns.get("__fid__")
+    for i in range(batch.n):
+        props = {k: v[i] for k, v in decoded.items()}
+        features.append({
+            "type": "Feature",
+            "id": str(fids[i]) if fids is not None else str(i),
+            "geometry": _geom_json(ft, gname, batch, i) if gname else None,
+            "properties": props,
+        })
+    return {"type": "FeatureCollection", "features": features}
+
+
+def dumps(ft: FeatureType, batch: ColumnBatch,
+          dicts: Dict[str, DictionaryEncoder]) -> str:
+    return json.dumps(to_geojson(ft, batch, dicts))

@@ -1,0 +1,109 @@
+"""BIN format tests (BinaryOutputEncoder / BinSorter parity)."""
+
+import struct
+
+import numpy as np
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.filter.ecql import parse_iso_ms
+from geomesa_tpu.io import bin_format
+
+
+def test_java_string_hash():
+    # oracle values from Java String.hashCode
+    assert bin_format.java_string_hash("") == 0
+    assert bin_format.java_string_hash("a") == 97
+    assert bin_format.java_string_hash("abc") == 96354
+    assert bin_format.java_string_hash("hello world") == 1794106052
+    # int32 wraparound ("polygenelubricants" hashes to Integer.MIN_VALUE)
+    assert bin_format.java_string_hash("polygenelubricants") == -2147483648
+
+
+def test_pack_unpack_16():
+    b = bin_format.pack(
+        np.array([1, 2], np.int32),
+        np.array([5000, 1000], np.int64),  # ms
+        np.array([10.5, 20.5]),
+        np.array([-100.0, -90.0]),
+    )
+    assert len(b) == 32
+    out = bin_format.unpack(b)
+    # sorted by time
+    np.testing.assert_array_equal(out["track"], [2, 1])
+    np.testing.assert_array_equal(out["dtg_s"], [1, 5])
+    np.testing.assert_allclose(out["lat"], [20.5, 10.5])
+    # wire layout: little-endian i4 i4 f4 f4
+    track0, dtg0, lat0, lon0 = struct.unpack("<iiff", b[:16])
+    assert (track0, dtg0) == (2, 1)
+    assert abs(lat0 - 20.5) < 1e-6 and abs(lon0 + 90.0) < 1e-6
+
+
+def test_pack_label_24():
+    b = bin_format.pack(
+        np.array([7], np.int32), np.array([1000], np.int64),
+        np.array([1.0]), np.array([2.0]),
+        labels=bin_format.label_to_i64(["ab"]),
+    )
+    assert len(b) == 24
+    out = bin_format.unpack(b, label=True)
+    assert out["label"][0] == int.from_bytes(b"ab".ljust(8, b"\0"), "little", signed=True)
+    assert bin_format.record_size(b) == 24
+
+
+def test_merge_sorted():
+    def mk(ts):
+        return bin_format.pack(
+            np.zeros(len(ts), np.int32), np.array(ts, np.int64) * 1000,
+            np.zeros(len(ts)), np.zeros(len(ts)),
+        )
+
+    merged = bin_format.merge_sorted([mk([1, 5, 9]), mk([2, 3, 8]), mk([4])])
+    out = bin_format.unpack(merged)
+    np.testing.assert_array_equal(out["dtg_s"], [1, 2, 3, 4, 5, 8, 9])
+
+
+def test_dataset_export_bin():
+    rng = np.random.default_rng(3)
+    n = 500
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+    data = {
+        "name": [f"trk{i % 5}" for i in range(n)],
+        "dtg": rng.integers(
+            parse_iso_ms("2020-01-01"), parse_iso_ms("2020-01-10"), n
+        ).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+    }
+    ds.insert("t", data)
+    payload = ds.export_bin("t", "BBOX(geom, -120, 25, -70, 50)", track="name")
+    k = ds.count("t")
+    assert len(payload) == 16 * k
+    out = bin_format.unpack(payload)
+    assert np.all(np.diff(out["dtg_s"]) >= 0)  # time-sorted
+    assert set(out["track"]) == {
+        bin_format.java_string_hash(f"trk{i}") for i in range(5)
+    }
+    # labeled export
+    payload = ds.export_bin("t", track="name", label="name")
+    assert len(payload) == 24 * k
+
+
+def test_export_bin_all_null_string_attr():
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "lab:String,dtg:Date,*geom:Point")
+    ds.insert("t", {
+        "lab": [None, None],
+        "dtg": np.array(["2020-01-01", "2020-01-02"], "datetime64[ms]"),
+        "geom__x": [1.0, 2.0], "geom__y": [3.0, 4.0],
+    })
+    payload = ds.export_bin("t", track="lab", label="lab")
+    assert len(payload) == 2 * 24
+    out = bin_format.unpack(payload, label=True)
+    assert list(out["track"]) == [0, 0] and list(out["label"]) == [0, 0]
+
+
+def test_java_hash_astral():
+    # non-BMP char must hash as its UTF-16 surrogate pair (Java semantics):
+    # for U+1D11E: h = 0xD834*31 + 0xDD1E
+    assert bin_format.java_string_hash("\U0001D11E") == 0xD834 * 31 + 0xDD1E

@@ -1,0 +1,119 @@
+"""CLI tests (geomesa-tools command parity)."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import cli
+
+CSV = """id,name,age,date,lon,lat
+a1,alice,30,2020-01-05,-100.0,40.0
+a2,bob,25,2020-01-06,-99.0,41.0
+a3,carol,45,2020-01-07,-98.0,42.0
+"""
+
+CONV = json.dumps({
+    "type": "delimited-text",
+    "format": "CSV",
+    "id-field": "$1",
+    "options": {"skip-lines": 1},
+    "fields": [
+        {"name": "name", "transform": "$2"},
+        {"name": "age", "transform": "toInt($3)"},
+        {"name": "dtg", "transform": "date('yyyy-MM-dd', $4)"},
+        {"name": "geom", "transform": "point(toDouble($5), toDouble($6))"},
+    ],
+})
+
+
+@pytest.fixture
+def catalog(tmp_path):
+    cat = str(tmp_path / "cat")
+    csv_path = str(tmp_path / "data.csv")
+    conv_path = str(tmp_path / "conv.conf")
+    with open(csv_path, "w") as fh:
+        fh.write(CSV)
+    with open(conv_path, "w") as fh:
+        fh.write(CONV)
+    rc = cli.main([
+        "create-schema", "-c", cat, "-f", "people",
+        "-s", "name:String,age:Integer,dtg:Date,*geom:Point",
+    ])
+    assert rc == 0
+    rc = cli.main(["ingest", "-c", cat, "-f", "people", "-C", conv_path, csv_path])
+    assert rc == 0
+    return cat, str(tmp_path)
+
+
+def test_schema_commands(catalog, capsys):
+    cat, _ = catalog
+    assert cli.main(["get-type-names", "-c", cat]) == 0
+    assert "people" in capsys.readouterr().out
+    assert cli.main(["describe-schema", "-c", cat, "-f", "people"]) == 0
+    out = capsys.readouterr().out
+    assert "age: int32" in out and "count: 3" in out
+    # duplicate create fails cleanly
+    assert cli.main(["create-schema", "-c", cat, "-f", "people", "-s", "a:String"]) == 1
+
+
+def test_stats_commands(catalog, capsys):
+    cat, _ = catalog
+    assert cli.main(["stats-count", "-c", cat, "-f", "people", "-q", "age > 26"]) == 0
+    assert capsys.readouterr().out.strip() == "2"
+    assert cli.main(["stats-bounds", "-c", cat, "-f", "people"]) == 0
+    assert "-100" in capsys.readouterr().out
+    assert cli.main(["stats-top-k", "-c", cat, "-f", "people", "-a", "name"]) == 0
+    assert "alice" in capsys.readouterr().out
+    assert cli.main(["stats-histogram", "-c", cat, "-f", "people", "-a", "age",
+                     "--bins", "5"]) == 0
+    assert "histogram" in capsys.readouterr().out
+    assert cli.main(["stats-analyze", "-c", cat, "-f", "people"]) == 0
+    assert "count: 3" in capsys.readouterr().out
+
+
+def test_explain(catalog, capsys):
+    cat, _ = catalog
+    assert cli.main(["explain", "-c", cat, "-f", "people",
+                     "-q", "BBOX(geom,-101,39,-98,42)"]) == 0
+    out = capsys.readouterr().out
+    assert "Chosen index" in out
+
+
+def test_export_formats(catalog, capsys, tmp_path):
+    cat, base = catalog
+    # csv to stdout
+    assert cli.main(["export", "-c", cat, "-f", "people", "-F", "csv",
+                     "-q", "age > 26"]) == 0
+    out = capsys.readouterr().out
+    assert "alice" in out and "bob" not in out
+    # geojson
+    gj = str(tmp_path / "o.json")
+    assert cli.main(["export", "-c", cat, "-f", "people", "-F", "geojson",
+                     "-o", gj]) == 0
+    doc = json.load(open(gj))
+    assert doc["type"] == "FeatureCollection" and len(doc["features"]) == 3
+    assert doc["features"][0]["geometry"]["type"] == "Point"
+    # arrow + parquet + bin + leaflet
+    for fmt, name in [("arrow", "o.arrow"), ("parquet", "o.parquet"),
+                      ("bin", "o.bin"), ("leaflet", "o.html")]:
+        path = str(tmp_path / name)
+        assert cli.main(["export", "-c", cat, "-f", "people", "-F", fmt,
+                         "-o", path]) == 0
+        assert os.path.getsize(path) > 0
+    assert os.path.getsize(str(tmp_path / "o.bin")) == 3 * 16
+
+
+def test_delete_schema(catalog, capsys):
+    cat, _ = catalog
+    assert cli.main(["delete-schema", "-c", cat, "-f", "people"]) == 0
+    capsys.readouterr()
+    assert cli.main(["get-type-names", "-c", cat]) == 0
+    assert "people" not in capsys.readouterr().out
+    assert not os.path.exists(os.path.join(cat, "people.npz"))
+
+
+def test_version(capsys):
+    assert cli.main(["version"]) == 0
+    assert "geomesa-tpu" in capsys.readouterr().out

@@ -1,0 +1,214 @@
+"""Converter framework tests (geomesa-convert parity: expressions, delimited
+text, JSON, validation modes, type inference, HOCON configs)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.convert import (
+    ConverterConfig, DelimitedTextConverter, EvaluationContext, JsonConverter,
+    converter_for, infer_schema,
+)
+from geomesa_tpu.convert import expressions as ex
+from geomesa_tpu.convert import hocon
+from geomesa_tpu.schema.feature_type import FeatureType
+
+
+# -- expressions -------------------------------------------------------------
+
+def _ev(text, raw=None, fields=None, n=1):
+    raw = raw or [np.array([""], dtype=object)]
+    ctx = ex.Context(raw=raw, fields=fields or {}, n=n)
+    return ex.parse(text).eval(ctx)
+
+
+def test_expression_basics():
+    a = np.array([" Hello "], dtype=object)
+    assert _ev("trim($1)", [None, a])[0] == "Hello"
+    assert _ev("lowercase(trim($1))", [None, a])[0] == "hello"
+    assert _ev("concat('a', 'b', $1)", [None, a])[0] == "ab Hello "
+    assert _ev("toInt('42')")[0] == 42
+    assert _ev("toDouble('4.5')")[0] == 4.5
+    assert _ev("add(toInt('2'), toInt('3'))")[0] == 5.0
+    assert _ev("substr('abcdef', 1, 3)")[0] == "bc"
+    assert _ev("regexReplace('l+', 'L', 'hello')")[0] == "heLo"
+
+
+def test_expression_dates():
+    out = _ev("date('yyyy-MM-dd HH:mm:ss', '2020-03-04 05:06:07')")
+    assert out[0] == np.datetime64("2020-03-04T05:06:07", "ms")
+    out = _ev("isoDate('2020-03-04T05:06:07Z')")
+    assert out[0] == np.datetime64("2020-03-04T05:06:07", "ms")
+    out = _ev("secsToDate(1583298367)")
+    assert out[0] == np.datetime64(1583298367000, "ms")
+
+
+def test_expression_point_and_id():
+    out = _ev("point(toDouble('-100.5'), toDouble('45.25'))")
+    assert out[0] == (-100.5, 45.25)
+    assert _ev("md5('abc')")[0] == "900150983cd24fb0d6963f7d28e17f72"
+    assert len(_ev("uuid()")[0]) == 32
+
+
+def test_expression_try_and_default():
+    assert _ev("try(toInt('nope'), 0)")[0] == 0
+    assert _ev("withDefault(emptyToNull(''), 'dflt')")[0] == "dflt"
+    with pytest.raises(ex.EvalError):
+        _ev("nosuchfn(1)")
+
+
+def test_field_chaining():
+    f = {"a": np.array([7], dtype=object)}
+    assert _ev("add($a, 1)", fields=f)[0] == 8.0
+    with pytest.raises(ex.EvalError):
+        _ev("$notyet")
+
+
+# -- HOCON -------------------------------------------------------------------
+
+def test_hocon_parse():
+    cfg = hocon.loads("""
+    // a comment
+    geomesa.converters.mydata = {
+      type = "delimited-text"
+      format = CSV
+      id-field = "md5($0)"
+      options { skip-lines = 1 }
+      fields = [
+        { name = "dtg", transform = "date('yyyy-MM-dd', $1)" }
+        { name = "geom", transform = "point(toDouble($2), toDouble($3))" }
+      ]
+    }
+    """)
+    c = ConverterConfig.parse(cfg)
+    assert c.type == "delimited-text"
+    assert c.options["skip-lines"] == 1
+    assert len(c.fields) == 2
+    # plain JSON also accepted
+    assert hocon.loads('{"a": 1}') == {"a": 1}
+
+
+# -- delimited text ----------------------------------------------------------
+
+CSV_CONFIG = {
+    "type": "delimited-text",
+    "format": "CSV",
+    "id-field": "$1",
+    "options": {"skip-lines": 1, "error-mode": "skip-bad-records"},
+    "fields": [
+        {"name": "name", "transform": "trim($2)"},
+        {"name": "age", "transform": "toInt($3)"},
+        {"name": "dtg", "transform": "date('yyyy-MM-dd', $4)"},
+        {"name": "geom", "transform": "point(toDouble($5), toDouble($6))"},
+    ],
+}
+
+CSV_DATA = """id,name,age,date,lon,lat
+a1, alice ,30,2020-01-05,-100.0,40.0
+a2,bob,25,2020-01-06,-99.0,41.0
+a3,carol,bad_age,2020-01-07,-98.0,42.0
+a4,dan,40,2020-01-08,-300.0,42.0
+a5,eve,35,2020-01-09,-97.0,43.0
+"""
+
+
+def test_delimited_converter():
+    ft = FeatureType.from_spec("people", "name:String,age:Integer,dtg:Date,*geom:Point")
+    conv = converter_for(ft, CSV_CONFIG)
+    assert isinstance(conv, DelimitedTextConverter)
+    ctx = EvaluationContext()
+    batches = list(conv.convert(CSV_DATA, ctx))
+    assert len(batches) == 1
+    data, fids = batches[0]
+    # row a3 (bad age) and a4 (lon out of range) dropped
+    assert ctx.success == 3 and ctx.failure >= 2
+    assert list(fids) == ["a1", "a2", "a5"]
+    assert list(data["name"]) == ["alice", "bob", "eve"]
+
+
+def test_delimited_raise_mode():
+    cfg = dict(CSV_CONFIG)
+    cfg["options"] = {"skip-lines": 1, "error-mode": "raise-errors"}
+    ft = FeatureType.from_spec("people", "name:String,age:Integer,dtg:Date,*geom:Point")
+    conv = converter_for(ft, cfg)
+    with pytest.raises(ValueError):
+        list(conv.convert(CSV_DATA))
+
+
+def test_dataset_ingest_csv():
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("people", "name:String,age:Integer,dtg:Date,*geom:Point")
+    ctx = ds.ingest("people", CSV_DATA, CSV_CONFIG)
+    assert ctx.success == 3
+    assert ds.count("people") == 3
+    assert ds.count("people", "age > 30") == 1
+
+
+# -- JSON --------------------------------------------------------------------
+
+JSON_CONFIG = {
+    "type": "json",
+    "feature-path": "$.features[*]",
+    "id-field": "$id",
+    "fields": [
+        {"name": "id", "path": "$.properties.id"},
+        {"name": "name", "path": "$.properties.name"},
+        {"name": "lon", "path": "$.geometry.coordinates[0]"},
+        {"name": "lat", "path": "$.geometry.coordinates[1]"},
+        {"name": "geom", "transform": "point($lon, $lat)"},
+    ],
+}
+
+JSON_DATA = """
+{"features": [
+  {"properties": {"id": "j1", "name": "x"}, "geometry": {"coordinates": [-100.0, 40.0]}},
+  {"properties": {"id": "j2", "name": "y"}, "geometry": {"coordinates": [-99.0, 41.0]}}
+]}
+"""
+
+
+def test_json_converter():
+    ft = FeatureType.from_spec("pts", "name:String,*geom:Point")
+    conv = converter_for(ft, JSON_CONFIG)
+    assert isinstance(conv, JsonConverter)
+    ctx = EvaluationContext()
+    (data, fids), = conv.convert(JSON_DATA, ctx)
+    assert ctx.success == 2
+    assert list(fids) == ["j1", "j2"]
+    assert list(data["name"]) == ["x", "y"]
+    assert data["geom"][0] == (-100.0, 40.0)
+
+
+def test_failure_counted_once_with_physical_lines():
+    ft = FeatureType.from_spec("people", "name:String,age:Integer,dtg:Date,*geom:Point")
+    conv = converter_for(ft, CSV_CONFIG)
+    ctx = EvaluationContext()
+    list(conv.convert(CSV_DATA, ctx))
+    # a3 (bad age) and a4 (out-of-range lon): exactly one failure each
+    assert ctx.failure == 2
+    # physical 1-based line numbers (header is line 1)
+    assert any("line 4" in e for e in ctx.errors), ctx.errors
+    assert any("line 5" in e for e in ctx.errors), ctx.errors
+
+
+def test_hocon_eol_comments():
+    cfg = hocon.loads("type = json // trailing\nformat = CSV # another\n")
+    assert cfg == {"type": "json", "format": "CSV"}
+
+
+# -- type inference ----------------------------------------------------------
+
+def test_infer_schema():
+    sample = "id,name,value,date,lon,lat\n1,abc,2.5,2020-01-01,-100.0,40.0\n2,def,3.5,2020-01-02,-99.0,41.0\n"
+    ft, cfg = infer_schema(sample)
+    types = {a.name: a.type for a in ft.attributes}
+    assert types["geom"] == "point"
+    assert types["value"] == "float64"
+    assert types["date"] == "date"
+    assert types["id"] == "int64"
+    # inferred config actually ingests
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema(ft)
+    ctx = ds.ingest(ft.name, sample, cfg)
+    assert ctx.success == 2
+    assert ds.count(ft.name) == 2
