@@ -83,6 +83,23 @@ class ConverterConfig:
         )
 
 
+class _LineTee:
+    """Iterator wrapper capturing the raw lines csv.reader consumes, so $0
+    can be the verbatim input record (multi-line quoted rows included)."""
+
+    def __init__(self, it):
+        self._it = iter(it)
+        self.consumed: List[str] = []
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        line = next(self._it)
+        self.consumed.append(line)
+        return line
+
+
 class BaseConverter:
     """Shared transform-evaluation pipeline."""
 
@@ -220,29 +237,42 @@ class DelimitedTextConverter(BaseConverter):
         else:
             lines = source
         skip = int(self.config.options.get("skip-lines", 0))
-        reader = csv.reader(lines, delimiter=delim)
+        tee = _LineTee(lines)
+        reader = csv.reader(tee, delimiter=delim)
         rows: List[List[str]] = []
+        raws: List[str] = []  # raw input per record ($0 must be verbatim)
         batch_start = None  # physical 1-based line of the batch's first row
-        for i, row in enumerate(reader):
+        i = 0
+        while True:
+            mark = len(tee.consumed)
+            try:
+                row = next(reader)
+            except StopIteration:
+                break
+            raw = "".join(tee.consumed[mark:]).rstrip("\r\n")
+            tee.consumed[mark:] = []  # bound memory
             if i < skip:
+                i += 1
                 continue
             if batch_start is None:
                 batch_start = i + 1
             rows.append(row)
+            raws.append(raw)
+            i += 1
             if len(rows) >= batch_size:
-                yield self._convert_rows(rows, batch_start, ctx)
+                yield self._convert_rows(rows, raws, batch_start, ctx)
                 batch_start = None
-                rows = []
+                rows, raws = [], []
         if rows:
-            yield self._convert_rows(rows, batch_start, ctx)
+            yield self._convert_rows(rows, raws, batch_start, ctx)
 
-    def _convert_rows(self, rows: List[List[str]], line_offset: int,
-                      ctx: EvaluationContext):
+    def _convert_rows(self, rows: List[List[str]], raws: List[str],
+                      line_offset: int, ctx: EvaluationContext):
         n = len(rows)
         width = max(len(r) for r in rows)
         raw: List[np.ndarray] = [np.empty(n, dtype=object) for _ in range(width + 1)]
         for i, r in enumerate(rows):
-            raw[0][i] = ",".join(r)
+            raw[0][i] = raws[i]
             for j in range(width):
                 raw[j + 1][i] = r[j] if j < len(r) else None
         data, fids, keep = self._transform(raw, n, line_offset, ctx)

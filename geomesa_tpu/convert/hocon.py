@@ -45,15 +45,16 @@ class _Parser:
         line = self.text.count("\n", 0, self.i) + 1
         raise ValueError(f"HOCON parse error line {line}: {msg}")
 
-    def _key(self) -> str:
+    def _key(self) -> Tuple[str, bool]:
+        """Returns (key, quoted) — quoted keys are literal, never path-split."""
         self._skip_ws()
         if self.i < self.n and self.text[self.i] in "\"'":
-            return self._quoted()
+            return self._quoted(), True
         m = re.match(r"[A-Za-z0-9_.\-$]+", self.text[self.i:])
         if not m:
             self._error(f"expected key at {self.text[self.i:self.i+20]!r}")
         self.i += m.end()
-        return m.group(0)
+        return m.group(0), False
 
     def _quoted(self) -> str:
         q = self.text[self.i]
@@ -120,9 +121,9 @@ class _Parser:
             self._entry(obj)
 
     def _entry(self, obj: Dict[str, Any]):
-        key = self._key()
-        # dotted keys create nested objects (HOCON path expressions)
-        parts = key.split(".") if not key.startswith('"') else [key]
+        key, quoted = self._key()
+        # dotted unquoted keys create nested objects (HOCON path expressions)
+        parts = [key] if quoted else key.split(".")
         for p in parts[:-1]:
             nxt = obj.get(p)
             if not isinstance(nxt, dict):

@@ -103,6 +103,29 @@ def device_update(stat: sk.Stat, cols: Dict, mask, xp, vocab_sizes: Dict[str, in
     return out
 
 
+def decode_enum_keys(stat: sk.Stat, dicts) -> sk.Stat:
+    """Map enumeration/topk count keys from dictionary codes to their string
+    values (the host-observe path counts raw code columns; the device path
+    decodes in absorb_partials — results must agree)."""
+    for leaf in _leaf_stats(stat):
+        if leaf.kind in ("enumeration", "topk"):
+            d = dicts.get(leaf.attribute)
+            if d is None:
+                continue
+            enum = leaf if leaf.kind == "enumeration" else leaf._enum
+            new = {}
+            for k, c in enum.counts.items():
+                if isinstance(k, (int, np.integer)):
+                    if k < 0:
+                        continue  # null codes: dropped (device path parity)
+                    key = d.values[k] if k < len(d.values) else int(k)
+                else:
+                    key = k
+                new[key] = new.get(key, 0) + c
+            enum.counts = new
+    return stat
+
+
 def absorb_partials(stat: sk.Stat, partials, dicts) -> sk.Stat:
     """Fold device partial states back into host Stat objects."""
     for leaf, p in zip(_leaf_stats(stat), partials):

@@ -239,6 +239,7 @@ class Executor:
         batch = self.features(plan)
         if batch.n:
             stat.observe(batch.columns)
+            kstats.decode_enum_keys(stat, self.store.dicts)
         return stat
 
     def knn(self, plan: QueryPlan, qx: float, qy: float, k: int):

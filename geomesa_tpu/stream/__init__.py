@@ -1,0 +1,6 @@
+"""Streaming layer: live feature caches over message topics (Kafka analog)
+and hot/cold tiering (Lambda analog)."""
+
+from geomesa_tpu.stream.messages import GeoMessage, MessageBus, Topic  # noqa: F401
+from geomesa_tpu.stream.live import LiveFeatureCache, StreamingDataset  # noqa: F401
+from geomesa_tpu.stream.lambda_store import LambdaDataset  # noqa: F401

@@ -1,0 +1,165 @@
+"""Lambda store: hot streaming window + cold persisted tier, queried as one.
+
+Reference parity (geomesa-lambda, SURVEY.md §2.5): writes land in the
+transient (Kafka) tier immediately and migrate to the persistent delegate
+store once older than an age threshold (DataStorePersistence.scala:45);
+queries merge transient + persistent with the transient copy winning
+(LambdaQueryRunner); stats merge across tiers (LambdaStats).
+
+This is the architecture for 'live window in HBM + historical tier on
+Parquet' — the persistent tier is a GeoDataset (device store) which can
+itself be backed by FileSystemStorage.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from geomesa_tpu.api.dataset import GeoDataset, Query
+from geomesa_tpu.schema.columns import ColumnBatch
+from geomesa_tpu.schema.feature_type import FeatureType
+from geomesa_tpu.stream.live import StreamingDataset
+
+
+class LambdaDataset:
+    """Hot/cold hybrid datastore (LambdaDataStore analog)."""
+
+    def __init__(self, persistent: Optional[GeoDataset] = None,
+                 transient: Optional[StreamingDataset] = None,
+                 persist_age_ms: int = 60_000):
+        self.persistent = persistent or GeoDataset()
+        self.transient = transient or StreamingDataset()
+        self.persist_age_ms = persist_age_ms
+
+    # -- schema ------------------------------------------------------------
+    def create_schema(self, name_or_ft, spec: Optional[str] = None) -> FeatureType:
+        ft = self.transient.create_schema(name_or_ft, spec)
+        self.persistent.create_schema(FeatureType.from_spec(ft.name, ft.spec()))
+        return ft
+
+    def list_schemas(self) -> List[str]:
+        return self.transient.list_schemas()
+
+    # -- writes (always to the transient tier first) ------------------------
+    def write(self, name: str, data: Dict[str, Sequence], fids: Sequence[str],
+              ts_ms: Optional[Sequence[int]] = None):
+        self.transient.write(name, data, fids, ts_ms)
+
+    # -- tier migration (DataStorePersistence analog) ------------------------
+    def run_persistence(self, name: Optional[str] = None,
+                        now_ms: Optional[int] = None) -> int:
+        """Move transient features older than the age threshold into the
+        persistent store. Returns the number migrated."""
+        now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        cutoff = now_ms - self.persist_age_ms
+        moved = 0
+        for nm in [name] if name else self.transient.list_schemas():
+            self.transient.poll(nm)
+            cache = self.transient.cache(nm)
+            with cache._lock:
+                old = [
+                    (fid, ts, attrs)
+                    for fid, (ts, attrs) in cache._state.items()
+                    if ts <= cutoff
+                ]
+            if not old:
+                continue
+            ft = self.transient.get_schema(nm)
+            keys = [a.name for a in ft.attributes]
+            data = {k: [attrs.get(k) for _, _, attrs in old] for k in keys}
+            # point geometries arrive as [x, y] pairs
+            g = ft.geom_field
+            if g is not None and ft.attr(g).is_point:
+                pairs = data.pop(g)
+                data[g + "__x"] = np.array([p[0] for p in pairs], np.float64)
+                data[g + "__y"] = np.array([p[1] for p in pairs], np.float64)
+            self.persistent.insert(nm, data, [fid for fid, _, _ in old])
+            self.persistent.flush(nm)
+            # evict only if the entry is still the snapshot we persisted —
+            # a concurrent newer update must survive in the hot tier
+            with cache._lock:
+                for fid, ts, _ in old:
+                    cur = cache._state.get(fid)
+                    if cur is not None and cur[0] == ts:
+                        del cache._state[fid]
+                        cache._invalidate()
+            moved += len(old)
+        return moved
+
+    # -- merged reads (LambdaQueryRunner analog) ----------------------------
+    def dicts(self, name: str):
+        """The merged result's dictionary space = the transient tier's."""
+        return self.transient.cache(name).dicts
+
+    def _recode_cold(self, name: str, cold: ColumnBatch) -> ColumnBatch:
+        """Re-encode the persistent tier's string codes into the transient
+        dictionary space so merged columns share one vocabulary."""
+        ft = self.transient.get_schema(name)
+        cold_dicts = self.persistent._store(name).dicts
+        hot_dicts = self.dicts(name)
+        cols = dict(cold.columns)
+        for a in ft.attributes:
+            if a.type == "string" and a.name in cols:
+                d_cold = cold_dicts.get(a.name)
+                if d_cold is None:
+                    continue
+                decoded = d_cold.decode(cols[a.name])
+                d_hot = hot_dicts.setdefault(a.name, type(d_cold)())
+                cols[a.name] = d_hot.encode(decoded)
+        return ColumnBatch(cols, cold.n)
+
+    def query(self, name: str, ecql: str = "INCLUDE") -> ColumnBatch:
+        """Transient + persistent results; transient wins on duplicate fid."""
+        hot = self.transient.query(name, ecql)
+        cold = self._recode_cold(name, self.persistent.query(name, ecql).batch)
+        if hot.n == 0:
+            return cold
+        if cold.n == 0:
+            return hot
+        hot_fids = set(hot.columns["__fid__"].tolist())
+        keep = np.array(
+            [f not in hot_fids for f in cold.columns["__fid__"]], dtype=bool
+        )
+        cold = cold.select(keep)
+        # align to the shared column set (key columns may differ per tier)
+        common = [k for k in hot.columns if k in cold.columns]
+        return ColumnBatch.concat([
+            ColumnBatch({k: hot.columns[k] for k in common}, hot.n),
+            ColumnBatch({k: cold.columns[k] for k in common}, cold.n),
+        ])
+
+    def count(self, name: str, ecql: str = "INCLUDE") -> int:
+        return int(self.query(name, ecql).n)
+
+    def density(self, name: str, ecql: str = "INCLUDE",
+                bbox=(-180, -90, 180, 90), width: int = 256,
+                height: int = 256) -> np.ndarray:
+        """Merged density over both tiers with the same duplicate resolution
+        as query(): hot wins. One grid kernel over the merged columns keeps
+        feature results and map overlays consistent."""
+        from geomesa_tpu.kernels import density as kdensity
+
+        merged = self.query(name, ecql)
+        if merged.n == 0:
+            return np.zeros((height, width), np.float32)
+        g = self.transient.get_schema(name).geom_field
+        return np.asarray(kdensity.density_grid(
+            merged.columns[g + "__x"], merged.columns[g + "__y"],
+            np.ones(merged.n, dtype=bool), tuple(bbox), width, height,
+            None, np,
+        ))
+
+    def stats(self, name: str, stat_spec: str, ecql: str = "INCLUDE"):
+        """Merged stats: observe both tiers into one sketch (LambdaStats)."""
+        from geomesa_tpu.kernels.stats_scan import decode_enum_keys
+        from geomesa_tpu.stats import parse_stat
+
+        stat = parse_stat(stat_spec)
+        merged = self.query(name, ecql)
+        if merged.n:
+            stat.observe(merged.columns)
+            decode_enum_keys(stat, self.dicts(name))
+        return stat

@@ -1,0 +1,391 @@
+"""Live feature cache + streaming dataset (Kafka datastore analog).
+
+Reference parity (SURVEY.md §2.5 Kafka row, §3.5 call stack):
+
+* ``LiveFeatureCache`` ~ KafkaFeatureCacheImpl over BucketIndexSupport: the
+  current state of each feature id, with event-time ordering (stale updates
+  dropped), optional event-time expiry, and a uniform grid bucket index for
+  spatial candidate pruning.
+* ``StreamingDataset`` ~ KafkaDataStore: schemas map to topics; writers
+  produce GeoMessages; ``poll()`` is the micro-batch consumer populating the
+  cache; queries run the local pipeline (compiled ECQL mask + aggregation
+  kernels) over the live window — KafkaQueryRunner/LocalQueryRunner.
+* feature listeners ~ GeoMesaFeatureListener events.
+
+The live window is columnar: the cache rebuilds (and caches) a ColumnBatch
+on demand, so density/stats over the window use the same kernels as the
+batch store, and the window can be device_put as a whole (the double-buffer
+ring of SURVEY.md §2.9.5).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.filter import ir, parse_ecql
+from geomesa_tpu.filter.compile import compile_filter
+from geomesa_tpu.kernels import density as kdensity
+from geomesa_tpu.schema.columns import (
+    ColumnBatch, DictionaryEncoder, encode_batch,
+)
+from geomesa_tpu.schema.feature_type import FeatureType
+from geomesa_tpu.stream.messages import (
+    CHANGE, CLEAR, DELETE, GeoMessage, MessageBus, Topic,
+)
+
+
+def _full_mask(m, n: int) -> np.ndarray:
+    """Compiled predicates may return a scalar (e.g. INCLUDE) — broadcast."""
+    m = np.asarray(m, dtype=bool)
+    if m.ndim == 0:
+        return np.full(n, bool(m))
+    return m
+
+
+class LiveFeatureCache:
+    """Current feature state keyed by fid (KafkaFeatureCache analog)."""
+
+    def __init__(self, ft: FeatureType, expiry_ms: Optional[int] = None,
+                 grid_bins: int = 64):
+        self.ft = ft
+        self.expiry_ms = expiry_ms
+        self.grid_bins = grid_bins
+        self.dicts: Dict[str, DictionaryEncoder] = {}
+        self._state: Dict[str, Tuple[int, Dict[str, Any]]] = {}  # fid -> (ts, attrs)
+        self._lock = threading.Lock()
+        self._batch: Optional[ColumnBatch] = None  # columnar view cache
+        self._grid: Optional[Dict[int, List[str]]] = None
+
+    def __len__(self):
+        return len(self._state)
+
+    # -- mutation ----------------------------------------------------------
+    def put(self, fid: str, attrs: Dict[str, Any], ts_ms: int):
+        with self._lock:
+            cur = self._state.get(fid)
+            if cur is not None and cur[0] > ts_ms:
+                return  # event-time ordering: drop stale update
+            self._state[fid] = (ts_ms, attrs)
+            self._invalidate()
+
+    def remove(self, fid: str):
+        with self._lock:
+            if self._state.pop(fid, None) is not None:
+                self._invalidate()
+
+    def clear(self):
+        with self._lock:
+            self._state.clear()
+            self._invalidate()
+
+    def expire(self, now_ms: Optional[int] = None) -> int:
+        """Drop features older than the event-time expiry. Returns #dropped."""
+        if self.expiry_ms is None:
+            return 0
+        now_ms = int(time.time() * 1000) if now_ms is None else now_ms
+        cutoff = now_ms - self.expiry_ms
+        with self._lock:
+            stale = [f for f, (ts, _) in self._state.items() if ts < cutoff]
+            for f in stale:
+                del self._state[f]
+            if stale:
+                self._invalidate()
+        return len(stale)
+
+    def _invalidate(self):
+        self._batch = None
+        self._grid = None
+
+    # -- columnar view ------------------------------------------------------
+    def batch(self) -> ColumnBatch:
+        """The live window as encoded columns (rebuilt lazily)."""
+        with self._lock:
+            if self._batch is not None:
+                return self._batch
+            if not self._state:
+                self._batch = ColumnBatch({}, 0)
+                return self._batch
+            fids = list(self._state)
+            rows = [self._state[f][1] for f in fids]
+            data: Dict[str, list] = {}
+            for a in self.ft.attributes:
+                if a.is_geom and not a.is_point:
+                    data[a.name] = [r.get(a.name) for r in rows]
+                elif a.is_geom:
+                    # points arrive as (x, y) / [x, y]; null/missing geometry
+                    # rides as NaN and is excluded by the query validity mask
+                    xs, ys = [], []
+                    for r in rows:
+                        v = r.get(a.name)
+                        if v is None:
+                            xs.append(np.nan)
+                            ys.append(np.nan)
+                        else:
+                            xs.append(float(v[0]))
+                            ys.append(float(v[1]))
+                    data[a.name + "__x"] = np.array(xs)
+                    data[a.name + "__y"] = np.array(ys)
+                else:
+                    data[a.name] = [r.get(a.name) for r in rows]
+            self._batch = encode_batch(self.ft, data, self.dicts, fids)
+            return self._batch
+
+    def grid_index(self) -> Dict[int, np.ndarray]:
+        """Uniform grid bucket index over the window (BucketIndex analog):
+        cell id -> row indices. Used for coarse spatial candidate pruning."""
+        with self._lock:
+            if self._grid is not None:
+                return self._grid
+        b = self.batch()
+        g = self.ft.geom_field
+        out: Dict[int, np.ndarray] = {}
+        if b.n and g is not None and g + "__x" in b.columns:
+            n = self.grid_bins
+            cx = np.clip(((b.columns[g + "__x"] + 180.0) / 360.0 * n).astype(np.int64), 0, n - 1)
+            cy = np.clip(((b.columns[g + "__y"] + 90.0) / 180.0 * n).astype(np.int64), 0, n - 1)
+            cell = cy * n + cx
+            order = np.argsort(cell, kind="stable")
+            cells, starts = np.unique(cell[order], return_index=True)
+            bounds = np.append(starts, len(order))
+            for i, c in enumerate(cells):
+                out[int(c)] = order[bounds[i]: bounds[i + 1]]
+        with self._lock:
+            self._grid = out
+        return out
+
+    def candidate_rows(self, f: ir.Filter) -> Optional[np.ndarray]:
+        """Row candidates from the grid index for the filter's bbox, or None
+        for 'all rows'."""
+        g = self.ft.geom_field
+        if g is None:
+            return None
+        fv = ir.extract_geometries(f, g)
+        if fv.is_empty or fv.disjoint:
+            return None
+        n = self.grid_bins
+        idx = self.grid_index()
+        rows: List[np.ndarray] = []
+        for geom in fv.values:
+            xmin, ymin, xmax, ymax = geom.bounds()
+            x0 = max(0, int((xmin + 180.0) / 360.0 * n))
+            x1 = min(n - 1, int((xmax + 180.0) / 360.0 * n))
+            y0 = max(0, int((ymin + 90.0) / 180.0 * n))
+            y1 = min(n - 1, int((ymax + 90.0) / 180.0 * n))
+            for cy in range(y0, y1 + 1):
+                for cx in range(x0, x1 + 1):
+                    got = idx.get(cy * n + cx)
+                    if got is not None:
+                        rows.append(got)
+        if not rows:
+            return np.zeros(0, np.int64)
+        return np.unique(np.concatenate(rows))
+
+
+class StreamingDataset:
+    """Topic-backed streaming datastore (KafkaDataStore analog)."""
+
+    def __init__(self, bus: Optional[MessageBus] = None,
+                 expiry_ms: Optional[int] = None, partitions: int = 4,
+                 prefer_device: bool = False):
+        self.bus = bus or MessageBus()
+        self.expiry_ms = expiry_ms
+        self.partitions = partitions
+        self.prefer_device = prefer_device
+        self._schemas: Dict[str, FeatureType] = {}
+        self._topics: Dict[str, Topic] = {}
+        self._caches: Dict[str, LiveFeatureCache] = {}
+        self._offsets: Dict[str, List[int]] = {}
+        self._listeners: Dict[str, List[Callable[[GeoMessage], None]]] = {}
+
+    # -- schema CRUD -------------------------------------------------------
+    def create_schema(self, name_or_ft, spec: Optional[str] = None) -> FeatureType:
+        ft = (
+            name_or_ft if isinstance(name_or_ft, FeatureType)
+            else FeatureType.from_spec(name_or_ft, spec)
+        )
+        if ft.name in self._schemas:
+            raise ValueError(f"schema {ft.name!r} already exists")
+        self._schemas[ft.name] = ft
+        self._topics[ft.name] = self.bus.create(f"geomesa-{ft.name}", self.partitions)
+        self._caches[ft.name] = LiveFeatureCache(ft, self.expiry_ms)
+        self._offsets[ft.name] = [0] * self.partitions
+        self._listeners[ft.name] = []
+        return ft
+
+    def get_schema(self, name: str) -> FeatureType:
+        return self._schemas[name]
+
+    def list_schemas(self) -> List[str]:
+        return sorted(self._schemas)
+
+    def cache(self, name: str) -> LiveFeatureCache:
+        return self._caches[name]
+
+    def add_listener(self, name: str, fn: Callable[[GeoMessage], None]):
+        self._listeners[name].append(fn)
+
+    # -- producer ----------------------------------------------------------
+    def write(self, name: str, data: Dict[str, Sequence], fids: Sequence[str],
+              ts_ms: Optional[Sequence[int]] = None):
+        """Produce Change messages for a batch of features."""
+        ft = self._schemas[name]
+        topic = self._topics[name]
+        keys = list(data)
+        n = len(fids)
+        now = int(time.time() * 1000)
+        dtg = ft.dtg_field
+        for i in range(n):
+            attrs: Dict[str, Any] = {}
+            for k in keys:
+                v = data[k][i]
+                if isinstance(v, np.datetime64):
+                    v = int(v.astype("datetime64[ms]").astype(np.int64))
+                elif isinstance(v, np.generic):
+                    v = v.item()
+                elif isinstance(v, tuple):
+                    v = list(v)
+                attrs[k] = v
+            if ts_ms is not None:
+                ts = int(ts_ms[i])
+            elif dtg is not None and dtg in attrs and attrs[dtg] is not None:
+                ts = int(attrs[dtg])
+            else:
+                ts = now
+            topic.send(GeoMessage.change(str(fids[i]), attrs, ts))
+
+    def delete(self, name: str, fid: str):
+        self._topics[name].send(GeoMessage.delete(fid, int(time.time() * 1000)))
+
+    def clear(self, name: str):
+        self._topics[name].send(GeoMessage.clear(int(time.time() * 1000)))
+
+    # -- consumer (micro-batch) --------------------------------------------
+    def poll(self, name: Optional[str] = None, max_messages: int = 100_000) -> int:
+        """Consume pending messages into the live cache(s). Returns #consumed."""
+        names = [name] if name else list(self._schemas)
+        total = 0
+        for nm in names:
+            msgs, self._offsets[nm] = self._topics[nm].poll(
+                self._offsets[nm], max_messages
+            )
+            cache = self._caches[nm]
+            listeners = self._listeners[nm]
+            for m in msgs:
+                if m.kind == CHANGE:
+                    cache.put(m.fid, m.payload or {}, m.ts_ms)
+                elif m.kind == DELETE:
+                    cache.remove(m.fid)
+                elif m.kind == CLEAR:
+                    cache.clear()
+                for fn in listeners:
+                    fn(m)
+            cache.expire()
+            total += len(msgs)
+        return total
+
+    # -- local query runner (KafkaQueryRunner analog) ----------------------
+    def _masked(self, name: str, ecql: "str | ir.Filter"):
+        ft = self._schemas[name]
+        cache = self._caches[name]
+        batch = cache.batch()
+        if batch.n == 0:
+            return ft, cache, batch, np.zeros(0, dtype=bool)
+        f = parse_ecql(ecql) if isinstance(ecql, str) else ecql
+        cf = compile_filter(f, ft, cache.dicts)
+        # validity: features with null geometry are invisible to queries
+        # (the reference's cache requires a geometry; we tolerate and mask)
+        valid = np.ones(batch.n, dtype=bool)
+        g = ft.geom_field
+        if g is not None and g + "__x" in batch.columns:
+            valid &= np.isfinite(batch.columns[g + "__x"])
+        cand = cache.candidate_rows(f)
+        if cand is not None and len(cand) < batch.n:
+            sub = ColumnBatch(
+                {k: v[cand] for k, v in batch.columns.items()}, len(cand)
+            )
+            sub_mask = _full_mask(cf(sub.columns, np), len(cand))
+            mask = np.zeros(batch.n, dtype=bool)
+            mask[cand[sub_mask]] = True
+        else:
+            mask = _full_mask(cf(batch.columns, np), batch.n)
+        return ft, cache, batch, mask & valid
+
+    def query(self, name: str, ecql: "str | ir.Filter" = "INCLUDE") -> ColumnBatch:
+        self.poll(name)
+        _, _, batch, mask = self._masked(name, ecql)
+        if batch.n == 0:
+            return batch
+        return batch.select(mask)
+
+    def count(self, name: str, ecql: "str | ir.Filter" = "INCLUDE") -> int:
+        self.poll(name)
+        _, _, _, mask = self._masked(name, ecql)
+        return int(mask.sum())
+
+    def density(self, name: str, ecql: "str | ir.Filter" = "INCLUDE",
+                bbox=(-180, -90, 180, 90), width: int = 256,
+                height: int = 256) -> np.ndarray:
+        """Density over the live window (DensityScan on the stream)."""
+        self.poll(name)
+        ft, _, batch, mask = self._masked(name, ecql)
+        g = ft.geom_field
+        if batch.n == 0:
+            return np.zeros((height, width), np.float32)
+        xs = batch.columns[g + "__x"]
+        ys = batch.columns[g + "__y"]
+        if self.prefer_device:
+            import jax.numpy as jnp
+
+            grid = kdensity.density_grid(
+                jnp.asarray(xs), jnp.asarray(ys), jnp.asarray(mask),
+                tuple(bbox), width, height, None, jnp,
+            )
+            return np.asarray(grid)
+        return np.asarray(kdensity.density_grid(
+            xs, ys, mask, tuple(bbox), width, height, None, np
+        ))
+
+    def stats(self, name: str, stat_spec: str,
+              ecql: "str | ir.Filter" = "INCLUDE"):
+        from geomesa_tpu.kernels.stats_scan import decode_enum_keys
+        from geomesa_tpu.stats import parse_stat
+
+        self.poll(name)
+        _, cache, batch, mask = self._masked(name, ecql)
+        stat = parse_stat(stat_spec)
+        if batch.n:
+            sel = batch.select(mask)
+            if sel.n:
+                stat.observe(sel.columns)
+                decode_enum_keys(stat, cache.dicts)
+        return stat
+
+
+def playback(ds: "StreamingDataset", name: str, data: Dict[str, Sequence],
+             fids: Sequence[str], dtg_ms: Sequence[int], rate: float = 10.0,
+             batch_ms: int = 1000, sleep: bool = False):
+    """Replay a dtg-ordered dataset onto the stream (tools `playback`):
+    batches of ``batch_ms`` event-time are produced at ``rate``x speed."""
+    order = np.argsort(np.asarray(dtg_ms, np.int64), kind="stable")
+    ts = np.asarray(dtg_ms, np.int64)[order]
+    keys = list(data)
+    start = 0
+    while start < len(order):
+        end = start
+        t0 = ts[start]
+        while end < len(order) and ts[end] - t0 < batch_ms:
+            end += 1
+        rows = order[start:end]
+        ds.write(
+            name,
+            {k: [data[k][i] for i in rows] for k in keys},
+            [fids[i] for i in rows],
+            ts_ms=ts[start:end],
+        )
+        if sleep and rate > 0:
+            time.sleep(batch_ms / 1000.0 / rate)
+        start = end

@@ -196,6 +196,27 @@ def test_hocon_eol_comments():
     assert cfg == {"type": "json", "format": "CSV"}
 
 
+def test_hocon_quoted_key_literal():
+    assert hocon.loads('{ "a.b" = 1 }') == {"a.b": 1}
+    assert hocon.loads("a.b = 1") == {"a": {"b": 1}}
+
+
+def test_raw_record_dollar_zero():
+    # $0 must be the verbatim input record, not a comma re-join
+    ft = FeatureType.from_spec("t", "rec:String,v:String")
+    cfg = {
+        "type": "delimited-text", "format": {"delimiter": "|"},
+        "fields": [
+            {"name": "rec", "transform": "$0"},
+            {"name": "v", "transform": "$1"},
+        ],
+    }
+    conv = converter_for(ft, cfg)
+    (data, _), = conv.convert("x,y|z\nx|y,z\n")
+    assert list(data["rec"]) == ["x,y|z", "x|y,z"]
+    assert list(data["v"]) == ["x,y", "x"]
+
+
 # -- type inference ----------------------------------------------------------
 
 def test_infer_schema():

@@ -1,0 +1,206 @@
+"""Streaming layer tests (Kafka datastore / live cache / Lambda parity)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.filter.ecql import parse_iso_ms
+from geomesa_tpu.stream import (
+    GeoMessage, LambdaDataset, LiveFeatureCache, MessageBus, StreamingDataset,
+)
+from geomesa_tpu.stream.live import playback
+from geomesa_tpu.stream.messages import CHANGE, CLEAR, DELETE
+from geomesa_tpu.schema.feature_type import FeatureType
+
+SPEC = "name:String,speed:Double,dtg:Date,*geom:Point"
+
+
+def test_geomessage_wire_round_trip():
+    m = GeoMessage.change("fid-1", {"name": "x", "speed": 4.5, "geom": [1.0, 2.0]}, 123456)
+    m2 = GeoMessage.deserialize(m.serialize())
+    assert m2 == m
+    d = GeoMessage.delete("fid-2", 99)
+    assert GeoMessage.deserialize(d.serialize()) == d
+    c = GeoMessage.clear(5)
+    assert GeoMessage.deserialize(c.serialize()) == c
+
+
+def test_topic_partitioning_and_offsets():
+    bus = MessageBus()
+    t = bus.create("x", partitions=4)
+    for i in range(20):
+        t.send(GeoMessage.change(f"f{i}", {}, i))
+    msgs, offs = t.poll([0, 0, 0, 0])
+    assert len(msgs) == 20
+    assert sum(offs) == 20
+    # same fid -> same partition (ordering per feature)
+    t2 = bus.create("y", partitions=4)
+    t2.send(GeoMessage.change("abc", {}, 1))
+    t2.send(GeoMessage.change("abc", {}, 2))
+    ends = t2.end_offsets()
+    assert sorted(ends) == [0, 0, 0, 2]
+    # incremental poll
+    msgs2, offs2 = t.poll(offs)
+    assert msgs2 == [] and offs2 == offs
+
+
+def _write_points(ds, name, n=50, t0="2020-01-01", seed=0):
+    rng = np.random.default_rng(seed)
+    ts = parse_iso_ms(t0) + np.arange(n) * 1000
+    data = {
+        "name": [f"n{i % 3}" for i in range(n)],
+        "speed": rng.uniform(0, 30, n),
+        "dtg": ts,
+        "geom": [(float(x), float(y)) for x, y in
+                 zip(rng.uniform(-120, -70, n), rng.uniform(25, 50, n))],
+    }
+    ds.write(name, data, [f"f{i}" for i in range(n)], ts_ms=ts)
+    return data
+
+
+def test_streaming_dataset_query_count_density():
+    ds = StreamingDataset()
+    ds.create_schema("track", SPEC)
+    data = _write_points(ds, "track", 100)
+    assert ds.count("track") == 100
+    xs = np.array([p[0] for p in data["geom"]])
+    ys = np.array([p[1] for p in data["geom"]])
+    expect = int(((xs >= -100) & (xs <= -80) & (ys >= 30) & (ys <= 45)).sum())
+    assert ds.count("track", "BBOX(geom, -100, 30, -80, 45)") == expect
+    grid = ds.density("track", "BBOX(geom, -100, 30, -80, 45)",
+                      bbox=(-100, 30, -80, 45), width=32, height=32)
+    assert abs(float(grid.sum()) - expect) < 1e-3
+    # attribute predicate over live window
+    assert ds.count("track", "name = 'n0'") == sum(
+        1 for i in range(100) if i % 3 == 0
+    )
+    st = ds.stats("track", "Enumeration(name)")
+    assert set(st.value()) == {"n0", "n1", "n2"}
+
+
+def test_live_update_delete_clear_and_events():
+    ds = StreamingDataset()
+    ds.create_schema("t", SPEC)
+    events = []
+    ds.add_listener("t", lambda m: events.append(m.kind))
+    ts = parse_iso_ms("2020-01-01")
+    ds.write("t", {"name": ["a"], "speed": [1.0], "dtg": [ts], "geom": [(0.0, 0.0)]},
+             ["f1"], ts_ms=[ts])
+    assert ds.count("t") == 1
+    # update same fid (newer ts) replaces
+    ds.write("t", {"name": ["b"], "speed": [2.0], "dtg": [ts + 1000], "geom": [(1.0, 1.0)]},
+             ["f1"], ts_ms=[ts + 1000])
+    assert ds.count("t") == 1
+    batch = ds.query("t")
+    assert ds.cache("t").dicts["name"].decode(batch.columns["name"]) == ["b"]
+    # stale update (older ts) is dropped (event-time ordering)
+    ds.write("t", {"name": ["zzz"], "speed": [0.0], "dtg": [ts], "geom": [(9.0, 9.0)]},
+             ["f1"], ts_ms=[ts])
+    batch = ds.query("t")
+    assert ds.cache("t").dicts["name"].decode(batch.columns["name"]) == ["b"]
+    ds.delete("t", "f1")
+    assert ds.count("t") == 0
+    ds.write("t", {"name": ["c"], "speed": [1.0], "dtg": [ts], "geom": [(0.0, 0.0)]},
+             ["f2"], ts_ms=[ts])
+    ds.clear("t")
+    assert ds.count("t") == 0
+    assert CHANGE in events and DELETE in events and CLEAR in events
+
+
+def test_clear_delivered_once():
+    ds = StreamingDataset()
+    ds.create_schema("t", SPEC)
+    events = []
+    ds.add_listener("t", lambda m: events.append(m.kind))
+    ds.clear("t")
+    ds.poll()
+    assert events.count(CLEAR) == 1
+
+
+def test_null_geometry_tolerated():
+    ds = StreamingDataset()
+    ds.create_schema("t", SPEC)
+    ts = parse_iso_ms("2020-01-01")
+    ds.write("t", {"name": ["a", "b"], "speed": [1.0, 2.0], "dtg": [ts, ts],
+                   "geom": [(1.0, 2.0), None]}, ["f1", "f2"], ts_ms=[ts, ts])
+    # feature with null geometry is invisible to queries, no crash
+    assert ds.count("t") == 1
+    assert ds.count("t", "speed > 0") == 1
+    batch = ds.query("t")
+    assert batch.columns["__fid__"].tolist() == ["f1"]
+
+
+def test_event_time_expiry():
+    cache = LiveFeatureCache(FeatureType.from_spec("t", SPEC), expiry_ms=10_000)
+    cache.put("a", {"geom": [0.0, 0.0]}, 0)
+    cache.put("b", {"geom": [0.0, 0.0]}, 95_000)
+    dropped = cache.expire(now_ms=100_000)
+    assert dropped == 1 and len(cache) == 1
+
+
+def test_grid_index_pruning_matches_full_scan():
+    ds = StreamingDataset()
+    ds.create_schema("t", SPEC)
+    _write_points(ds, "t", 300, seed=5)
+    ds.poll()
+    cache = ds.cache("t")
+    from geomesa_tpu.filter import parse_ecql
+
+    f = parse_ecql("BBOX(geom, -95, 30, -85, 40)")
+    cand = cache.candidate_rows(f)
+    assert cand is not None and 0 < len(cand) < 300
+    # pruned path returns identical results to an unpruned evaluation
+    n_pruned = ds.count("t", "BBOX(geom, -95, 30, -85, 40)")
+    batch = cache.batch()
+    xs, ys = batch.columns["geom__x"], batch.columns["geom__y"]
+    expect = int(((xs >= -95) & (xs <= -85) & (ys >= 30) & (ys <= 40)).sum())
+    assert n_pruned == expect
+
+
+def test_playback():
+    ds = StreamingDataset()
+    ds.create_schema("t", SPEC)
+    n = 30
+    ts = parse_iso_ms("2020-01-01") + np.arange(n) * 500
+    rng = np.random.default_rng(0)
+    data = {
+        "name": ["a"] * n,
+        "speed": rng.uniform(0, 1, n),
+        "dtg": ts,
+        "geom": [(0.0, 0.0)] * n,
+    }
+    playback(ds, "t", data, [f"f{i}" for i in range(n)], ts, sleep=False)
+    assert ds.count("t") == n
+
+
+def test_lambda_tiering():
+    lam = LambdaDataset(GeoDataset(n_shards=2), persist_age_ms=60_000)
+    lam.create_schema("t", SPEC)
+    t0 = parse_iso_ms("2020-01-01")
+    # old features (will persist) + recent (stay hot)
+    rng = np.random.default_rng(1)
+    for start, base in ((0, t0), (50, t0 + 10_000_000)):
+        ts = base + np.arange(50) * 1000
+        lam.write("t", {
+            "name": [f"n{i % 3}" for i in range(50)],
+            "speed": rng.uniform(0, 30, 50),
+            "dtg": ts,
+            "geom": [(float(x), float(y)) for x, y in
+                     zip(rng.uniform(-120, -70, 50), rng.uniform(25, 50, 50))],
+        }, [f"f{start + i}" for i in range(50)], ts_ms=ts)
+    now = t0 + 10_000_000 + 49_000 + 1
+    moved = lam.run_persistence(now_ms=now)
+    assert moved == 50  # the old batch migrated
+    assert len(lam.transient.cache("t")) == 50
+    assert lam.persistent.count("t") == 50
+    # merged query sees both tiers
+    assert lam.count("t") == 100
+    # merged stats decode strings across tiers
+    st = lam.stats("t", "Enumeration(name)")
+    assert set(st.value()) == {"n0", "n1", "n2"}
+    assert sum(st.value().values()) == 100
+    # density merges without double counting
+    grid = lam.density("t", bbox=(-120, 25, -70, 50), width=16, height=16)
+    assert abs(float(grid.sum()) - 100) < 1e-3
+    # second persistence run is a no-op at same cutoff
+    assert lam.run_persistence(now_ms=now) == 0
