@@ -15,12 +15,16 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from geomesa_tpu import metrics, security
+from geomesa_tpu.audit import AuditWriter
 from geomesa_tpu.filter import ir, parse_ecql
+from geomesa_tpu.filter.compile import CompiledFilter
 from geomesa_tpu.index.store import FeatureStore
 from geomesa_tpu.planning.executor import Executor
 from geomesa_tpu.planning.explain import Explainer
@@ -41,6 +45,8 @@ class Query:
     sort_by: Optional[List[Tuple[str, bool]]] = None  # (attr, descending)
     sampling: Optional[int] = None
     index: Optional[str] = None
+    #: visibility authorizations for this query (None = dataset default)
+    auths: Optional[List[str]] = None
 
     def hints(self) -> QueryHints:
         return QueryHints(
@@ -91,10 +97,15 @@ class GeoDataset:
     """Schema catalog + per-schema stores + planner + executor."""
 
     def __init__(self, mesh=None, n_shards: Optional[int] = None,
-                 prefer_device: bool = True):
+                 prefer_device: bool = True,
+                 auths: Optional[Sequence[str]] = None):
         self.mesh = mesh
         self.n_shards = n_shards
         self.prefer_device = prefer_device
+        #: dataset-level authorizations (None = geomesa.security.auths or
+        #: unrestricted; per-query ``Query.auths`` overrides)
+        self.auths = list(auths) if auths is not None else None
+        self.audit = AuditWriter()
         self._stores: Dict[str, FeatureStore] = {}
         self.metadata: Dict[str, Dict[str, str]] = {}
 
@@ -136,9 +147,15 @@ class GeoDataset:
         return st
 
     # -- writes ------------------------------------------------------------
-    def insert(self, name: str, data: Dict[str, Any], fids=None) -> int:
-        """Append a batch of features. Call flush() (or query) to index."""
-        return self._store(name).append(data, fids)
+    def insert(self, name: str, data: Dict[str, Any], fids=None,
+               visibilities=None) -> int:
+        """Append a batch of features. Call flush() (or query) to index.
+
+        ``visibilities``: per-feature visibility expression(s) (one string or
+        a sequence), enforced at query time against ``Query.auths``."""
+        n = self._store(name).append(data, fids, visibilities)
+        metrics.registry().counter("ingest.features").inc(n)
+        return n
 
     def flush(self, name: Optional[str] = None):
         for st in ([self._store(name)] if name else self._stores.values()):
@@ -159,22 +176,72 @@ class GeoDataset:
         self.flush(name)
         return ctx
 
-    def delete_features(self, name: str, ecql: str) -> int:
+    def delete_features(self, name: str, ecql: str,
+                        auths: Optional[Sequence[str]] = None) -> int:
+        """Delete matching features. A caller with restricted auths can only
+        delete rows their auths permit them to see."""
         st = self._store(name)
         f = parse_ecql(ecql)
         from geomesa_tpu.filter.compile import compile_filter
 
         cf = compile_filter(f, st.ft, st.dicts)
+        cf = self._vis_wrap(st, cf, self._effective_auths(Query(auths=auths)))
         return st.delete(lambda cols: np.asarray(cf(cols, np)))
 
     # -- planning ----------------------------------------------------------
+    def _effective_auths(self, q: Query) -> Optional[List[str]]:
+        if q.auths is not None:
+            return list(q.auths)
+        if self.auths is not None:
+            return self.auths
+        return security.DefaultAuthorizationsProvider().auths()
+
+    def _vis_wrap(self, st: FeatureStore, compiled: CompiledFilter,
+                  auths) -> CompiledFilter:
+        """Fuse the row-visibility check into a predicate mask
+        (LocalQueryRunner.visible:133 analog, but in the scan kernel)."""
+        if auths is None:
+            return compiled
+        vd = st.dicts.get(security.VIS_COLUMN)
+        if vd is None:
+            return compiled  # no feature has ever carried a visibility
+        lut = security.allowed_lut(vd.values, auths)
+        if lut.all():
+            return compiled
+        inner = compiled
+
+        def fn(cols, xp):
+            allowed = xp.asarray(lut)[cols[security.VIS_COLUMN]]
+            return inner.fn(cols, xp) & allowed
+
+        return CompiledFilter(
+            fn, list(inner.columns) + [security.VIS_COLUMN], inner.ecql
+        )
+
+    def _apply_visibility(self, st: FeatureStore, plan, auths) -> None:
+        plan.compiled = self._vis_wrap(st, plan.compiled, auths)
+
     def _plan(self, name: str, query: "str | Query", explain=None):
         st = self._store(name)
         st.flush()
         q = Query(ecql=query) if isinstance(query, str) else query
         planner = QueryPlanner(st)
-        plan = planner.plan(q.ecql, q.hints(), explain)
+        t0 = time.perf_counter()
+        with metrics.registry().timer("query.plan").time():
+            plan = planner.plan(q.ecql, q.hints(), explain)
+        self._apply_visibility(st, plan, self._effective_auths(q))
+        plan.__dict__["plan_time_ms"] = (time.perf_counter() - t0) * 1e3
         return st, q, plan
+
+    def _audit(self, name: str, q: Query, plan, t_scan0: float, hits: int,
+               op: str = "query"):
+        self.audit.record(
+            name, plan.ecql,
+            {"op": op, "index": plan.index_name,
+             "max_features": q.max_features, "sampling": q.sampling},
+            plan.__dict__.get("plan_time_ms", 0.0),
+            (time.perf_counter() - t_scan0) * 1e3, hits,
+        )
 
     def explain(self, name: str, query: "str | Query") -> str:
         exp = Explainer(enabled=True)
@@ -187,7 +254,10 @@ class GeoDataset:
     # -- reads -------------------------------------------------------------
     def query(self, name: str, query: "str | Query" = "INCLUDE") -> FeatureCollection:
         st, q, plan = self._plan(name, query)
-        batch = self._executor(st).features(plan)
+        t0 = time.perf_counter()
+        with metrics.registry().timer("query.scan").time():
+            batch = self._executor(st).features(plan)
+        self._audit(name, q, plan, t0, batch.n)
         # post-processing: sort -> limit -> projection (QueryPlanner.runQuery
         # order, reference QueryPlanner.scala:68-90)
         if q.sort_by and batch.n:
@@ -225,7 +295,10 @@ class GeoDataset:
         st, q, plan = self._plan(name, query)
         if not exact:
             return int(plan.est_count)
-        return self._executor(st).count(plan)
+        t0 = time.perf_counter()
+        n = self._executor(st).count(plan)
+        self._audit(name, q, plan, t0, n, op="count")
+        return n
 
     def bounds(self, name: str) -> Optional[Tuple[float, float, float, float]]:
         st = self._store(name)
@@ -246,14 +319,22 @@ class GeoDataset:
             bbox = (bbox[0], bbox[1], bbox[2], bbox[3])
         else:
             bbox = tuple(bbox)
-        return self._executor(st).density(plan, bbox, width, height, weight)
+        t0 = time.perf_counter()
+        with metrics.registry().timer("query.density").time():
+            grid = self._executor(st).density(plan, bbox, width, height, weight)
+        self._audit(name, q, plan, t0, int(np.count_nonzero(grid)), op="density")
+        return grid
 
     def stats(self, name: str, stat_spec: str,
               query: "str | Query" = "INCLUDE") -> sk.Stat:
         """Exact stats over matching features (StatsProcess/StatsScan analog)."""
         st, q, plan = self._plan(name, query)
         stat = parse_stat(stat_spec)
-        return self._executor(st).stats(plan, stat)
+        t0 = time.perf_counter()
+        with metrics.registry().timer("query.stats").time():
+            out = self._executor(st).stats(plan, stat)
+        self._audit(name, q, plan, t0, 0, op="stats")
+        return out
 
     def unique(self, name: str, attribute: str,
                query: "str | Query" = "INCLUDE") -> List:
@@ -307,7 +388,9 @@ class GeoDataset:
         ))
         planner = QueryPlanner(st)
         st.flush()
-        plan = planner.plan(f, Query().hints())
+        q = query if isinstance(query, Query) else Query()
+        plan = planner.plan(f, q.hints())
+        self._apply_visibility(st, plan, self._effective_auths(q))
         batch = self._executor(st).features(plan)
         return FeatureCollection(st.ft, batch, st.dicts)
 

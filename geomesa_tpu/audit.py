@@ -1,0 +1,78 @@
+"""Query audit log (reference index/audit/QueryEvent.scala:14,
+utils/audit/AuditWriter; wired in GeoMesaFeatureReader.scala:56-71).
+
+Each completed query produces a structured ``QueryEvent`` — store, type name,
+user, filter, hints, planTime, scanTime, hits — appended to an in-memory ring
+and (when ``geomesa.audit.path`` is set) to a JSONL file, the analog of
+Accumulo's ``_queries`` audit table.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+from geomesa_tpu import config
+
+
+@dataclass
+class QueryEvent:
+    """One audited query (QueryEvent.scala:14 field parity)."""
+
+    store: str
+    type_name: str
+    user: str
+    filter: str
+    hints: Dict[str, Any] = field(default_factory=dict)
+    date: float = 0.0          # epoch seconds
+    plan_time_ms: float = 0.0
+    scan_time_ms: float = 0.0
+    hits: int = 0
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), default=str)
+
+
+class AuditWriter:
+    """Collects QueryEvents; optionally appends JSONL to a file."""
+
+    def __init__(self, store_name: str = "geomesa-tpu", max_events: int = 10_000):
+        self.store_name = store_name
+        self.events: deque = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+
+    @property
+    def enabled(self) -> bool:
+        return config.AUDIT_ENABLED.to_bool()
+
+    def write(self, event: QueryEvent):
+        if not self.enabled:
+            return
+        event.store = event.store or self.store_name
+        if not event.date:
+            event.date = time.time()
+        with self._lock:
+            self.events.append(event)
+            path = config.AUDIT_PATH.get()
+            if path:
+                with open(path, "a") as fh:
+                    fh.write(event.to_json() + "\n")
+
+    def record(self, type_name: str, filter_text: str, hints: Dict[str, Any],
+               plan_time_ms: float, scan_time_ms: float, hits: int,
+               user: str = ""):
+        self.write(
+            QueryEvent(
+                store=self.store_name, type_name=type_name, user=user,
+                filter=filter_text, hints=hints, plan_time_ms=plan_time_ms,
+                scan_time_ms=scan_time_ms, hits=hits,
+            )
+        )
+
+    def recent(self, n: int = 100) -> List[QueryEvent]:
+        with self._lock:
+            return list(self.events)[-n:]

@@ -159,3 +159,14 @@ STRATEGY_DECIDER = SystemProperty("geomesa.strategy.decider", "cost")
 
 #: Max interval (days) accepted by the temporal query guard when configured.
 TEMPORAL_GUARD_MAX_DAYS = SystemProperty("geomesa.guard.temporal.max.days", None)
+
+#: Default authorization set, comma-separated (geomesa-security analog).
+#: Unset = unrestricted access; set (possibly empty auth list via per-query
+#: auths) = visibility enforcement on.
+SECURITY_AUTHS = SystemProperty("geomesa.security.auths", None)
+
+#: Audit log destination: a JSONL file path, or unset for in-memory only.
+AUDIT_PATH = SystemProperty("geomesa.audit.path", None)
+
+#: Enable query auditing (QueryEvent records; reference index/audit/).
+AUDIT_ENABLED = SystemProperty("geomesa.audit.enabled", "true")

@@ -226,9 +226,28 @@ class FeatureStore:
         return out
 
     # -- write path --------------------------------------------------------
-    def append(self, data: Dict, fids=None) -> int:
-        """Buffer an ingest batch (encoded immediately; keys at flush)."""
+    def append(self, data: Dict, fids=None, visibilities=None) -> int:
+        """Buffer an ingest batch (encoded immediately; keys at flush).
+
+        ``visibilities``: per-feature visibility expression(s) — one string
+        for the whole batch or a sequence per feature (geomesa-security
+        analog; dictionary-encoded into the ``__vis__`` code column)."""
+        from geomesa_tpu.security import VIS_COLUMN, parse_visibility
+
         batch = encode_batch(self.ft, data, self.dicts, fids)
+        vd = self.dicts.get(VIS_COLUMN)
+        if vd is None:
+            vd = self.dicts[VIS_COLUMN] = DictionaryEncoder([""])
+        if visibilities is None:
+            vis = np.zeros(batch.n, np.int32)
+        else:
+            if isinstance(visibilities, str):
+                visibilities = [visibilities] * batch.n
+            exprs = [v or "" for v in visibilities]
+            for v in set(exprs):
+                parse_visibility(v)  # validate at write time
+            vis = vd.encode(exprs)
+        batch.columns[VIS_COLUMN] = vis
         with self._lock:
             self._buffer.append(batch)
         return batch.n
@@ -253,6 +272,12 @@ class FeatureStore:
         # write-time stats on the fresh rows only
         for st in self.stats.values():
             st.observe(fresh.columns)
+        if self._all is not None:
+            # datasets persisted before visibility support lack __vis__
+            from geomesa_tpu.security import VIS_COLUMN
+
+            if VIS_COLUMN in fresh.columns and VIS_COLUMN not in self._all.columns:
+                self._all.columns[VIS_COLUMN] = np.zeros(self._all.n, np.int32)
         merged = (
             fresh if self._all is None else ColumnBatch.concat([self._all, fresh])
         )

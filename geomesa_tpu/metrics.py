@@ -1,0 +1,149 @@
+"""Metrics registry (geomesa-metrics analog, SURVEY.md §2.8).
+
+The reference uses a Dropwizard ``MetricRegistry`` with pluggable reporters
+(GeoMesaMetrics.scala:26); consumers are the Kafka live cache and converter
+``EvaluationContext`` counters. Here: a process-wide registry of counters,
+gauges, and timers with a prometheus-text dump — attached to ingest, query
+execution, and the streaming layer.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+
+class Counter:
+    __slots__ = ("value", "_lock")
+
+    def __init__(self):
+        self.value = 0
+        self._lock = threading.Lock()
+
+    def inc(self, n: int = 1):
+        with self._lock:
+            self.value += n
+
+
+class Gauge:
+    """A sampled value; either set explicitly or backed by a callable."""
+
+    def __init__(self, fn: Optional[Callable[[], float]] = None):
+        self.fn = fn
+        self._value = 0.0
+
+    def set(self, v: float):
+        self._value = float(v)
+
+    @property
+    def value(self) -> float:
+        return float(self.fn()) if self.fn is not None else self._value
+
+
+class Timer:
+    """Count + total/max duration. Use as a context manager."""
+
+    __slots__ = ("count", "total_s", "max_s", "_lock")
+
+    def __init__(self):
+        self.count = 0
+        self.total_s = 0.0
+        self.max_s = 0.0
+        self._lock = threading.Lock()
+
+    def update(self, seconds: float):
+        with self._lock:
+            self.count += 1
+            self.total_s += seconds
+            self.max_s = max(self.max_s, seconds)
+
+    def time(self):
+        return _TimerContext(self)
+
+    @property
+    def mean_s(self) -> float:
+        return self.total_s / self.count if self.count else 0.0
+
+
+class _TimerContext:
+    def __init__(self, timer: Timer):
+        self.timer = timer
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.timer.update(time.perf_counter() - self._t0)
+        return False
+
+
+class MetricRegistry:
+    def __init__(self, prefix: str = "geomesa"):
+        self.prefix = prefix
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()
+
+    def _get(self, name: str, cls, *args):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(*args)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name!r} already registered as {type(m).__name__}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str, fn: Optional[Callable[[], float]] = None) -> Gauge:
+        g = self._get(name, Gauge, fn)
+        if fn is not None:
+            g.fn = fn
+        return g
+
+    def timer(self, name: str) -> Timer:
+        return self._get(name, Timer)
+
+    def report(self) -> Dict[str, object]:
+        out: Dict[str, object] = {}
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in items:
+            if isinstance(m, Counter):
+                out[name] = m.value
+            elif isinstance(m, Gauge):
+                out[name] = m.value
+            elif isinstance(m, Timer):
+                out[name] = {
+                    "count": m.count, "total_s": m.total_s,
+                    "mean_s": m.mean_s, "max_s": m.max_s,
+                }
+        return out
+
+    def prometheus(self) -> str:
+        """Prometheus text exposition of all metrics."""
+        lines: List[str] = []
+        p = self.prefix
+        for name, v in self.report().items():
+            metric = f"{p}_{name}".replace(".", "_").replace("-", "_")
+            if isinstance(v, dict):  # timer
+                lines.append(f"{metric}_count {v['count']}")
+                lines.append(f"{metric}_seconds_total {v['total_s']:.6f}")
+                lines.append(f"{metric}_seconds_max {v['max_s']:.6f}")
+            else:
+                lines.append(f"{metric} {v}")
+        return "\n".join(lines) + "\n"
+
+    def clear(self):
+        with self._lock:
+            self._metrics.clear()
+
+
+_REGISTRY = MetricRegistry()
+
+
+def registry() -> MetricRegistry:
+    return _REGISTRY

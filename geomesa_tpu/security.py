@@ -1,0 +1,199 @@
+"""Visibility-label security (geomesa-security analog).
+
+Role parity (SURVEY.md §2.8): per-feature visibility expressions — boolean
+combinations of labels like ``admin&(user|system)`` — parsed by a
+``VisibilityEvaluator`` (reference
+geomesa-security/.../VisibilityEvaluator.scala:22,156) and checked against a
+user's authorization set (``AuthorizationsProvider``).
+
+TPU translation: visibility strings are dictionary-encoded at ingest into an
+int32 ``__vis__`` code column. At plan time the *distinct expressions* (the
+dictionary) are evaluated once against the query's auths, producing a boolean
+lookup table per code; the query-time check is then a single device gather
+``lut[vis_code]`` fused into the predicate mask — row-level enforcement in
+the scan kernel, the analog of Accumulo cell-level security.
+
+Grammar (Accumulo-compatible): labels are ``[A-Za-z0-9_.:/-]+`` or quoted
+``"..."``; operators ``&`` (and) and ``|`` (or) with parentheses; ``&`` binds
+tighter than ``|``. The empty expression means "visible to everyone".
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import FrozenSet, Iterable, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from geomesa_tpu import config
+
+VIS_COLUMN = "__vis__"
+
+_LABEL_RE = re.compile(r"[A-Za-z0-9_.:/\-]+")
+
+
+# -- expression AST ----------------------------------------------------------
+
+@dataclass(frozen=True)
+class VisLabel:
+    name: str
+
+    def evaluate(self, auths: FrozenSet[str]) -> bool:
+        return self.name in auths
+
+
+@dataclass(frozen=True)
+class VisAnd:
+    parts: Tuple["VisExpr", ...]
+
+    def evaluate(self, auths: FrozenSet[str]) -> bool:
+        return all(p.evaluate(auths) for p in self.parts)
+
+
+@dataclass(frozen=True)
+class VisOr:
+    parts: Tuple["VisExpr", ...]
+
+    def evaluate(self, auths: FrozenSet[str]) -> bool:
+        return any(p.evaluate(auths) for p in self.parts)
+
+
+VisExpr = Union[VisLabel, VisAnd, VisOr]
+
+
+class VisibilityError(ValueError):
+    pass
+
+
+def parse_visibility(expr: str) -> Optional[VisExpr]:
+    """Parse a visibility expression; ``None`` for the empty (public) one."""
+    s = expr.strip()
+    if not s:
+        return None
+    tokens = _tokenize(s)
+    node, pos = _parse_or(tokens, 0)
+    if pos != len(tokens):
+        raise VisibilityError(f"trailing tokens in visibility {expr!r}")
+    return node
+
+
+def _tokenize(s: str) -> List[str]:
+    out, i = [], 0
+    while i < len(s):
+        c = s[i]
+        if c.isspace():
+            i += 1
+        elif c in "&|()":
+            out.append(c)
+            i += 1
+        elif c == '"':
+            j = s.find('"', i + 1)
+            if j < 0:
+                raise VisibilityError(f"unterminated quote in {s!r}")
+            out.append("L" + s[i + 1 : j])
+            i = j + 1
+        else:
+            m = _LABEL_RE.match(s, i)
+            if not m:
+                raise VisibilityError(f"bad character {c!r} in visibility {s!r}")
+            out.append("L" + m.group(0))
+            i = m.end()
+    return out
+
+
+def _parse_or(tokens: List[str], pos: int) -> Tuple[VisExpr, int]:
+    parts = []
+    node, pos = _parse_and(tokens, pos)
+    parts.append(node)
+    while pos < len(tokens) and tokens[pos] == "|":
+        node, pos = _parse_and(tokens, pos + 1)
+        parts.append(node)
+    return (parts[0] if len(parts) == 1 else VisOr(tuple(parts))), pos
+
+
+def _parse_and(tokens: List[str], pos: int) -> Tuple[VisExpr, int]:
+    parts = []
+    node, pos = _parse_atom(tokens, pos)
+    parts.append(node)
+    while pos < len(tokens) and tokens[pos] == "&":
+        node, pos = _parse_atom(tokens, pos + 1)
+        parts.append(node)
+    return (parts[0] if len(parts) == 1 else VisAnd(tuple(parts))), pos
+
+
+def _parse_atom(tokens: List[str], pos: int) -> Tuple[VisExpr, int]:
+    if pos >= len(tokens):
+        raise VisibilityError("unexpected end of visibility expression")
+    t = tokens[pos]
+    if t == "(":
+        node, pos = _parse_or(tokens, pos + 1)
+        if pos >= len(tokens) or tokens[pos] != ")":
+            raise VisibilityError("unbalanced parentheses in visibility")
+        return node, pos + 1
+    if t.startswith("L"):
+        return VisLabel(t[1:]), pos + 1
+    raise VisibilityError(f"unexpected token {t!r} in visibility")
+
+
+# -- evaluation --------------------------------------------------------------
+
+class VisibilityEvaluator:
+    """Caches parsed expressions (the reference caches via parse-once
+    VisibilityExpression objects)."""
+
+    def __init__(self):
+        self._cache: dict = {}
+
+    def parse(self, expr: str) -> Optional[VisExpr]:
+        node = self._cache.get(expr, False)
+        if node is False:
+            node = parse_visibility(expr)
+            self._cache[expr] = node
+        return node
+
+    def can_see(self, expr: str, auths: Iterable[str]) -> bool:
+        node = self.parse(expr)
+        if node is None:
+            return True
+        return node.evaluate(frozenset(auths))
+
+
+_EVALUATOR = VisibilityEvaluator()
+
+
+def can_see(expr: str, auths: Iterable[str]) -> bool:
+    return _EVALUATOR.can_see(expr, auths)
+
+
+def allowed_lut(vis_values: Sequence[str], auths: Iterable[str]) -> np.ndarray:
+    """Boolean lookup table over the visibility dictionary: lut[code] = the
+    auths satisfy expression ``vis_values[code]``. The device-side check is
+    ``lut[vis_code_column]``."""
+    a = frozenset(auths)
+    lut = np.empty(max(len(vis_values), 1), dtype=bool)
+    lut[:] = True
+    for i, expr in enumerate(vis_values):
+        lut[i] = _EVALUATOR.can_see(expr, a)
+    return lut
+
+
+# -- auth providers ----------------------------------------------------------
+
+class AuthorizationsProvider:
+    """Supplies the effective auth set for a query (reference
+    geomesa-security AuthorizationsProvider SPI)."""
+
+    def auths(self) -> Optional[List[str]]:
+        raise NotImplementedError
+
+
+class DefaultAuthorizationsProvider(AuthorizationsProvider):
+    """Reads ``geomesa.security.auths`` (comma-separated). Returns None
+    (= unrestricted) when the property is unset."""
+
+    def auths(self) -> Optional[List[str]]:
+        raw = config.SECURITY_AUTHS.get()
+        if raw is None or raw == "":
+            return None
+        return [a.strip() for a in raw.split(",") if a.strip()]

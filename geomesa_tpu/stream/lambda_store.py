@@ -70,13 +70,26 @@ class LambdaDataset:
             ft = self.transient.get_schema(nm)
             keys = [a.name for a in ft.attributes]
             data = {k: [attrs.get(k) for _, _, attrs in old] for k in keys}
-            # point geometries arrive as [x, y] pairs
+            # point geometries arrive as [x, y] pairs; null geometry -> NaN
             g = ft.geom_field
             if g is not None and ft.attr(g).is_point:
                 pairs = data.pop(g)
-                data[g + "__x"] = np.array([p[0] for p in pairs], np.float64)
-                data[g + "__y"] = np.array([p[1] for p in pairs], np.float64)
-            self.persistent.insert(nm, data, [fid for fid, _, _ in old])
+                data[g + "__x"] = np.array(
+                    [np.nan if p is None else float(p[0]) for p in pairs], np.float64
+                )
+                data[g + "__y"] = np.array(
+                    [np.nan if p is None else float(p[1]) for p in pairs], np.float64
+                )
+            fids = [fid for fid, _, _ in old]
+            # an updated feature may age out again: replace, don't duplicate
+            pst = self.persistent._store(nm)
+            if pst.count:
+                from geomesa_tpu.filter import ir as fir
+                from geomesa_tpu.filter.compile import compile_filter
+
+                cf = compile_filter(fir.IdIn(tuple(fids)), pst.ft, pst.dicts)
+                pst.delete(lambda cols: np.asarray(cf(cols, np)))
+            self.persistent.insert(nm, data, fids)
             self.persistent.flush(nm)
             # evict only if the entry is still the snapshot we persisted —
             # a concurrent newer update must survive in the hot tier

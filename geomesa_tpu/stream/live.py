@@ -134,13 +134,16 @@ class LiveFeatureCache:
             self._batch = encode_batch(self.ft, data, self.dicts, fids)
             return self._batch
 
-    def grid_index(self) -> Dict[int, np.ndarray]:
+    def grid_index(self, b: Optional[ColumnBatch] = None) -> Dict[int, np.ndarray]:
         """Uniform grid bucket index over the window (BucketIndex analog):
-        cell id -> row indices. Used for coarse spatial candidate pruning."""
+        cell id -> row indices. The cached grid is tied to the batch snapshot
+        it was built from, so row indices can never point into a different
+        (concurrently rebuilt) batch."""
+        if b is None:
+            b = self.batch()
         with self._lock:
-            if self._grid is not None:
-                return self._grid
-        b = self.batch()
+            if self._grid is not None and self._grid[0] is b:
+                return self._grid[1]
         g = self.ft.geom_field
         out: Dict[int, np.ndarray] = {}
         if b.n and g is not None and g + "__x" in b.columns:
@@ -154,12 +157,14 @@ class LiveFeatureCache:
             for i, c in enumerate(cells):
                 out[int(c)] = order[bounds[i]: bounds[i + 1]]
         with self._lock:
-            self._grid = out
+            self._grid = (b, out)
         return out
 
-    def candidate_rows(self, f: ir.Filter) -> Optional[np.ndarray]:
+    def candidate_rows(self, f: ir.Filter,
+                       b: Optional[ColumnBatch] = None) -> Optional[np.ndarray]:
         """Row candidates from the grid index for the filter's bbox, or None
-        for 'all rows'."""
+        for 'all rows'. Pass the batch snapshot the caller is masking so grid
+        rows and batch rows stay coherent under concurrent writes."""
         g = self.ft.geom_field
         if g is None:
             return None
@@ -167,7 +172,7 @@ class LiveFeatureCache:
         if fv.is_empty or fv.disjoint:
             return None
         n = self.grid_bins
-        idx = self.grid_index()
+        idx = self.grid_index(b)
         rows: List[np.ndarray] = []
         for geom in fv.values:
             xmin, ymin, xmax, ymax = geom.bounds()
@@ -302,7 +307,7 @@ class StreamingDataset:
         g = ft.geom_field
         if g is not None and g + "__x" in batch.columns:
             valid &= np.isfinite(batch.columns[g + "__x"])
-        cand = cache.candidate_rows(f)
+        cand = cache.candidate_rows(f, batch)
         if cand is not None and len(cand) < batch.n:
             sub = ColumnBatch(
                 {k: v[cand] for k, v in batch.columns.items()}, len(cand)

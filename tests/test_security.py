@@ -1,0 +1,207 @@
+"""Visibility security, audit log, and metrics registry tests
+(geomesa-security / index/audit / geomesa-metrics parity)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import config, metrics, security
+from geomesa_tpu.api.dataset import GeoDataset, Query
+from geomesa_tpu.security import (
+    VisibilityError, allowed_lut, can_see, parse_visibility,
+)
+
+
+class TestVisibilityEvaluator:
+    def test_empty_is_public(self):
+        assert can_see("", []) is True
+        assert can_see("", ["admin"]) is True
+
+    def test_single_label(self):
+        assert can_see("admin", ["admin"])
+        assert not can_see("admin", ["user"])
+        assert not can_see("admin", [])
+
+    def test_and(self):
+        assert can_see("admin&user", ["admin", "user"])
+        assert not can_see("admin&user", ["admin"])
+
+    def test_or(self):
+        assert can_see("admin|user", ["user"])
+        assert not can_see("admin|user", ["other"])
+
+    def test_precedence_and_parens(self):
+        # & binds tighter than |
+        assert can_see("a&b|c", ["c"])
+        assert can_see("a&b|c", ["a", "b"])
+        assert not can_see("a&b|c", ["a"])
+        assert not can_see("a&(b|c)", ["b", "c"])
+        assert can_see("a&(b|c)", ["a", "c"])
+
+    def test_quoted_labels(self):
+        assert can_see('"label with:odd/chars"', ["label with:odd/chars"])
+
+    def test_parse_errors(self):
+        for bad in ("a&", "(a", "a)b", "a &| b", "a!!b"):
+            with pytest.raises(VisibilityError):
+                parse_visibility(bad)
+
+    def test_lut(self):
+        lut = allowed_lut(["", "admin", "admin&user", "user|admin"], ["admin"])
+        assert lut.tolist() == [True, True, False, True]
+
+
+def _vis_dataset():
+    ds = GeoDataset(n_shards=2, prefer_device=False)
+    ds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+    n = 100
+    rng = np.random.default_rng(0)
+    data = {
+        "name": [f"n{i}" for i in range(n)],
+        "dtg": np.full(n, np.datetime64("2024-06-01", "ms")),
+        "geom": [(float(x), float(y)) for x, y in
+                 zip(rng.uniform(-10, 10, n), rng.uniform(-10, 10, n))],
+    }
+    # first half admin-only, second half public
+    vis = ["admin"] * 50 + [""] * 50
+    ds.insert("t", data, fids=[str(i) for i in range(n)], visibilities=vis)
+    return ds
+
+
+class TestVisibilityEnforcement:
+    def test_unrestricted_sees_all(self):
+        ds = _vis_dataset()
+        assert ds.count("t") == 100
+
+    def test_no_auths_sees_public_only(self):
+        ds = _vis_dataset()
+        assert ds.count("t", Query(auths=[])) == 50
+
+    def test_admin_sees_all(self):
+        ds = _vis_dataset()
+        assert ds.count("t", Query(auths=["admin"])) == 100
+
+    def test_dataset_level_auths(self):
+        ds = _vis_dataset()
+        ds.auths = []
+        assert ds.count("t") == 50
+        assert len(ds.query("t")) == 50
+        # per-query override wins
+        assert ds.count("t", Query(auths=["admin"])) == 100
+
+    def test_visibility_composes_with_filter(self):
+        ds = _vis_dataset()
+        n_all = ds.count("t", "BBOX(geom, -10, -10, 10, 0)")
+        n_pub = ds.count("t", Query(ecql="BBOX(geom, -10, -10, 10, 0)", auths=[]))
+        assert 0 < n_pub < n_all
+
+    def test_density_respects_auths(self):
+        ds = _vis_dataset()
+        g_all = ds.density("t", bbox=(-10, -10, 10, 10), width=16, height=16)
+        g_pub = ds.density("t", Query(auths=[]), bbox=(-10, -10, 10, 10),
+                           width=16, height=16)
+        assert g_all.sum() == pytest.approx(100)
+        assert g_pub.sum() == pytest.approx(50)
+
+    def test_proximity_respects_auths(self):
+        ds = _vis_dataset()
+        ds.auths = []
+        fc = ds.proximity("t", "POINT (0 0)", 3_000_000)
+        assert 0 < len(fc) < 100
+        vis = fc.batch.columns["__vis__"]
+        assert (vis == 0).all()  # only public rows
+
+    def test_delete_respects_auths(self):
+        ds = _vis_dataset()
+        ds.auths = []
+        removed = ds.delete_features("t", "INCLUDE")
+        assert removed == 50  # only the public half
+        ds.auths = None
+        assert ds.count("t") == 50  # admin rows survived
+
+    def test_mixed_none_visibilities(self):
+        ds = GeoDataset(n_shards=2, prefer_device=False)
+        ds.create_schema("t", "name:String,*geom:Point")
+        ds.insert("t", {"name": ["a", "b"], "geom": [(0.0, 0.0), (1.0, 1.0)]},
+                  visibilities=["admin", None])
+        assert ds.count("t", Query(auths=[])) == 1
+
+    def test_config_scoped_auths(self):
+        ds = _vis_dataset()
+        with config.SECURITY_AUTHS.scoped("admin"):
+            assert ds.count("t") == 100
+
+    def test_invalid_write_visibility_rejected(self):
+        ds = GeoDataset(n_shards=2, prefer_device=False)
+        ds.create_schema("t", "name:String,*geom:Point")
+        with pytest.raises(VisibilityError):
+            ds.insert("t", {"name": ["a"], "geom": [(0.0, 0.0)]},
+                      visibilities="admin&")
+
+    def test_device_path_visibility(self):
+        # same enforcement through the jit'd device kernel
+        ds = GeoDataset(n_shards=2, prefer_device=True)
+        ds.create_schema("t", "name:String,*geom:Point")
+        n = 64
+        data = {
+            "name": [f"n{i}" for i in range(n)],
+            "geom": [(float(i % 10), 0.0) for i in range(n)],
+        }
+        ds.insert("t", data, visibilities=["secret"] * 32 + [""] * 32)
+        assert ds.count("t", Query(auths=[])) == 32
+        assert ds.count("t", Query(auths=["secret"])) == 64
+
+
+class TestAudit:
+    def test_query_events_recorded(self):
+        ds = _vis_dataset()
+        ds.count("t", "BBOX(geom, -10, -10, 10, 10)")
+        ds.query("t")
+        evs = ds.audit.recent()
+        assert len(evs) == 2
+        assert evs[0].hints["op"] == "count"
+        assert evs[0].type_name == "t"
+        assert "BBOX" in evs[0].filter
+        assert evs[0].plan_time_ms >= 0
+        assert evs[1].hits == 100
+
+    def test_audit_jsonl_file(self, tmp_path):
+        path = tmp_path / "audit.jsonl"
+        ds = _vis_dataset()
+        with config.AUDIT_PATH.scoped(str(path)):
+            ds.count("t")
+        import json
+
+        lines = path.read_text().strip().splitlines()
+        assert len(lines) == 1
+        rec = json.loads(lines[0])
+        assert rec["type_name"] == "t" and rec["hits"] == 100
+
+    def test_audit_disabled(self):
+        ds = _vis_dataset()
+        with config.AUDIT_ENABLED.scoped("false"):
+            ds.count("t")
+        assert ds.audit.recent() == []
+
+
+class TestMetrics:
+    def test_counters_and_timers(self):
+        reg = metrics.MetricRegistry()
+        reg.counter("a").inc(3)
+        reg.counter("a").inc()
+        with reg.timer("t").time():
+            pass
+        rep = reg.report()
+        assert rep["a"] == 4
+        assert rep["t"]["count"] == 1
+
+    def test_prometheus_text(self):
+        reg = metrics.MetricRegistry(prefix="gm")
+        reg.counter("ingest.features").inc(7)
+        text = reg.prometheus()
+        assert "gm_ingest_features 7" in text
+
+    def test_dataset_wiring(self):
+        before = metrics.registry().counter("ingest.features").value
+        _vis_dataset()
+        after = metrics.registry().counter("ingest.features").value
+        assert after - before == 100

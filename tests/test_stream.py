@@ -204,3 +204,33 @@ def test_lambda_tiering():
     assert abs(float(grid.sum()) - 100) < 1e-3
     # second persistence run is a no-op at same cutoff
     assert lam.run_persistence(now_ms=now) == 0
+
+
+def test_lambda_repersist_update_no_duplicate():
+    # a feature updated between persistence runs must be replaced in the
+    # cold tier, not duplicated
+    lam = LambdaDataset(GeoDataset(n_shards=2), persist_age_ms=1_000)
+    lam.create_schema("t", SPEC)
+    t0 = parse_iso_ms("2020-01-01")
+    row = {"name": ["a"], "speed": [1.0], "dtg": [t0], "geom": [(0.0, 0.0)]}
+    lam.write("t", row, ["f1"], ts_ms=[t0])
+    assert lam.run_persistence(now_ms=t0 + 2_000) == 1
+    # update arrives later with a new position, then ages out too
+    row2 = {"name": ["a"], "speed": [2.0], "dtg": [t0 + 5_000], "geom": [(1.0, 1.0)]}
+    lam.write("t", row2, ["f1"], ts_ms=[t0 + 5_000])
+    assert lam.run_persistence(now_ms=t0 + 10_000) == 1
+    assert lam.persistent.count("t") == 1  # replaced, not appended
+    assert lam.count("t") == 1
+    got = lam.persistent.query("t").to_dict()
+    assert got["speed"][0] == pytest.approx(2.0)
+
+
+def test_lambda_persist_null_geometry():
+    lam = LambdaDataset(GeoDataset(n_shards=2), persist_age_ms=1_000)
+    lam.create_schema("t", SPEC)
+    t0 = parse_iso_ms("2020-01-01")
+    lam.write("t", {"name": ["a", "b"], "speed": [1.0, 2.0],
+                    "dtg": [t0, t0], "geom": [None, (3.0, 4.0)]},
+              ["f1", "f2"], ts_ms=[t0, t0])
+    assert lam.run_persistence(now_ms=t0 + 2_000) == 2
+    assert lam.persistent.count("t", "BBOX(geom, 0, 0, 10, 10)") == 1
