@@ -229,7 +229,14 @@ class GeoDataset:
         t0 = time.perf_counter()
         with metrics.registry().timer("query.plan").time():
             plan = planner.plan(q.ecql, q.hints(), explain)
-        self._apply_visibility(st, plan, self._effective_auths(q))
+        auths = self._effective_auths(q)
+        self._apply_visibility(st, plan, auths)
+        if isinstance(q.ecql, str):
+            # the predicate is reproducible from text + auths: allow the
+            # executor to reuse jitted kernels across API calls
+            plan.__dict__["cache_token"] = (
+                q.ecql, None if auths is None else tuple(auths)
+            )
         plan.__dict__["plan_time_ms"] = (time.perf_counter() - t0) * 1e3
         return st, q, plan
 
@@ -393,6 +400,55 @@ class GeoDataset:
         self._apply_visibility(st, plan, self._effective_auths(q))
         batch = self._executor(st).features(plan)
         return FeatureCollection(st.ft, batch, st.dicts)
+
+    # -- process library delegates (geomesa-process parity) ----------------
+    def tube_select(self, name: str, tube_xy, tube_times_ms, buffer_m: float,
+                    query: "str | Query" = "INCLUDE", **kw) -> FeatureCollection:
+        from geomesa_tpu import processes
+
+        return processes.tube_select(
+            self, name, tube_xy, tube_times_ms, buffer_m, query, **kw
+        )
+
+    def spatial_join(self, points: str, polygons,
+                     query: "str | Query" = "INCLUDE",
+                     weight: Optional[str] = None):
+        from geomesa_tpu import processes
+
+        return processes.spatial_join(self, points, polygons, query, weight)
+
+    def join(self, left: str, right: str, left_attr: str, right_attr: str,
+             left_query: "str | Query" = "INCLUDE",
+             right_query: "str | Query" = "INCLUDE"):
+        from geomesa_tpu import processes
+
+        return processes.join(
+            self, left, right, left_attr, right_attr, left_query, right_query
+        )
+
+    def sample(self, name: str, one_in_n: int,
+               query: "str | Query" = "INCLUDE") -> FeatureCollection:
+        from geomesa_tpu import processes
+
+        return processes.sample(self, name, one_in_n, query)
+
+    def point2point(self, name: str, group_by: str,
+                    query: "str | Query" = "INCLUDE", break_on_day=False):
+        from geomesa_tpu import processes
+
+        return processes.point2point(self, name, group_by, query, break_on_day)
+
+    def track_label(self, name: str, track_attr: str,
+                    query: "str | Query" = "INCLUDE") -> FeatureCollection:
+        from geomesa_tpu import processes
+
+        return processes.track_label(self, name, track_attr, query)
+
+    def route_search(self, name: str, route, buffer_m: float,
+                     query: "str | Query" = "INCLUDE", **kw) -> FeatureCollection:
+        from geomesa_tpu import processes
+
+        return processes.route_search(self, name, route, buffer_m, query, **kw)
 
     def export_bin(self, name: str, query: "str | Query" = "INCLUDE",
                    track: Optional[str] = None, label: Optional[str] = None,

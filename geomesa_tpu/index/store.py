@@ -203,6 +203,8 @@ class FeatureStore:
         self._all: Optional[ColumnBatch] = None
         self._lock = threading.Lock()
         self.stats = self._init_stats()
+        #: bumped on every data mutation; keys cross-query kernel caches
+        self.version = 0
 
     def _init_stats(self) -> Dict[str, sk.Stat]:
         ft = self.ft
@@ -290,6 +292,7 @@ class FeatureStore:
         )
         for ks in self.keyspaces:
             self.tables[ks.name].rebuild(key_cols, self.dicts)
+        self.version += 1
 
     def delete(self, mask_fn) -> int:
         """Remove rows matching ``mask_fn(columns) -> bool mask`` (host)."""
@@ -307,4 +310,5 @@ class FeatureStore:
         for ks in self.keyspaces:
             key_cols.update(ks.index_keys(self.ft, keep))
             self.tables[ks.name].rebuild(key_cols, self.dicts)
+        self.version += 1
         return removed

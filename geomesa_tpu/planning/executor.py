@@ -89,10 +89,21 @@ class Executor:
         compiled = plan.compiled
         sampling = plan.hints.sampling
 
-        cache = plan.__dict__.setdefault("_kernel_cache", {})
+        # Cross-call kernel cache: plans carry a cache_token (ecql text +
+        # auth set) when their predicate is reproducible from text; combined
+        # with the store's mutation version this lets repeated queries reuse
+        # the jitted kernel across API calls. Plans without a token (raw IR
+        # filters) fall back to a per-plan cache.
+        token = plan.__dict__.get("cache_token")
+        if token is not None:
+            cache = self.store.__dict__.setdefault("_kernel_cache", {})
+            extra = (token, plan.index_name, sampling, self.store.version)
+        else:
+            cache = plan.__dict__.setdefault("_kernel_cache", {})
+            extra = ()
         # L keys the cache too: a table rebuild changes shard_len and the
         # kernel closes over it
-        full_key = (cache_key, L) if cache_key is not None else None
+        full_key = (cache_key, L) + extra if cache_key is not None else None
         go = cache.get(full_key) if full_key is not None else None
         if go is None:
 
@@ -105,7 +116,7 @@ class Executor:
                 return agg_fn(cols, m, jnp)
 
             if full_key is not None:
-                if len(cache) >= 16:  # bound per-plan compiled-kernel growth
+                if len(cache) >= 64:  # bound compiled-kernel growth
                     cache.clear()
                 cache[full_key] = go
         return go(dev_cols, setup["starts"], setup["ends"], setup["counts"])

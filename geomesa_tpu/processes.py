@@ -1,0 +1,438 @@
+"""Analytic process library (geomesa-process parity, SURVEY.md §2.6).
+
+The reference exposes GeoServer WPS processes that push down into scans
+(geomesa-process-vector: TubeSelectProcess, Point2PointProcess,
+TrackLabelProcess, DateOffsetProcess, HashAttributeProcess,
+RouteSearchProcess, JoinProcess, SamplingProcess...). Here each is a library
+function over a GeoDataset: a planner-backed prefilter (ECQL derived from the
+process geometry/time envelope) followed by a vectorized refine — the same
+coarse-scan→fine-kernel split as the query path.
+
+Density / stats / unique / min-max / kNN / proximity / arrow / bin live on
+GeoDataset itself; the point-in-polygon spatial join kernel is
+``geomesa_tpu.kernels.join`` (exposed here via ``spatial_join``).
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.api.dataset import FeatureCollection, GeoDataset, Query
+from geomesa_tpu.kernels import join as kjoin
+from geomesa_tpu.schema.columns import ColumnBatch
+from geomesa_tpu.utils import geometry as geo
+from geomesa_tpu.utils.geometry import METERS_PER_DEGREE, haversine_m
+
+
+def _as_query(query) -> Query:
+    return Query(ecql=query) if isinstance(query, str) else query
+
+
+def _and_ecql(base: str, extra: str) -> str:
+    if not base or base.strip().upper() == "INCLUDE":
+        return extra
+    return f"({base}) AND {extra}"
+
+
+def _xy(fc: FeatureCollection) -> Tuple[np.ndarray, np.ndarray]:
+    g = fc.ft.geom_field
+    return fc.batch.columns[g + "__x"], fc.batch.columns[g + "__y"]
+
+
+def _select(fc: FeatureCollection, mask_or_idx) -> FeatureCollection:
+    cols = {k: v[mask_or_idx] for k, v in fc.batch.columns.items()}
+    n = len(next(iter(cols.values()))) if cols else 0
+    return FeatureCollection(fc.ft, ColumnBatch(cols, n), fc.dicts)
+
+
+# ---------------------------------------------------------------------------
+# Tube select (TubeSelectProcess / TubeBuilder analog)
+# ---------------------------------------------------------------------------
+
+def tube_select(
+    ds: GeoDataset,
+    name: str,
+    tube_xy: Sequence[Tuple[float, float]],
+    tube_times_ms: Sequence[int],
+    buffer_m: float,
+    query: "str | Query" = "INCLUDE",
+    gap_fill: str = "line",
+    max_speed_mps: Optional[float] = None,
+) -> FeatureCollection:
+    """Features inside the spatio-temporal corridor around a track.
+
+    ``gap_fill='line'`` interpolates the track position linearly between
+    waypoints (the reference's LineGapFill); ``'none'`` matches only within
+    ``buffer_m`` of a waypoint at +/- the waypoint's segment time span
+    (NoGapFill). ``max_speed_mps`` widens the buffer by speed * time-gap,
+    mirroring the reference's speed-based tube growth.
+    """
+    pts = np.asarray(tube_xy, np.float64)
+    ts = np.asarray(tube_times_ms, np.int64)
+    if pts.shape[0] != ts.shape[0] or pts.shape[0] < 1:
+        raise ValueError("tube needs equal-length xy and time sequences")
+    order = np.argsort(ts, kind="stable")
+    pts, ts = pts[order], ts[order]
+
+    q = _as_query(query)
+    ft = ds.get_schema(name)
+    g, dtg = ft.geom_field, ft.dtg_field
+    if g is None or dtg is None:
+        raise ValueError("tube_select needs a point geometry and a time field")
+    # coarse prefilter: buffered track bbox + time envelope
+    pad = buffer_m / METERS_PER_DEGREE * 2
+    xmin, ymin = pts.min(axis=0) - pad
+    xmax, ymax = pts.max(axis=0) + pad
+    import dataclasses
+
+    # second-truncated endpoints, padded outward so the refine sees everything
+    t0 = np.datetime_as_string(ts.min().astype("datetime64[ms]"), unit="s") + "Z"
+    t1 = (
+        np.datetime_as_string(
+            (ts.max() + 1000).astype("datetime64[ms]"), unit="s"
+        )
+        + "Z"
+    )
+    pre = _and_ecql(
+        q.ecql,
+        f"BBOX({g}, {xmin}, {ymin}, {xmax}, {ymax}) AND "
+        f"{dtg} DURING {t0}/{t1}",
+    )
+    fc = ds.query(name, dataclasses.replace(q, ecql=pre))
+    if fc.batch.n == 0:
+        return fc
+    x, y = _xy(fc)
+    t = fc.batch.columns[dtg].astype(np.int64)
+
+    if len(pts) == 1:
+        d = haversine_m(x, y, pts[0, 0], pts[0, 1])
+        return _select(fc, d <= buffer_m)
+
+    # segment-wise refine: N features x M segments
+    x1, y1, t1s = pts[:-1, 0][None], pts[:-1, 1][None], ts[:-1][None]
+    x2, y2, t2s = pts[1:, 0][None], pts[1:, 1][None], ts[1:][None]
+    tc = t[:, None]
+    span = np.maximum(t2s - t1s, 1)
+    in_time = (tc >= t1s) & (tc <= t2s)
+    if gap_fill == "none":
+        near_a = haversine_m(x[:, None], y[:, None], x1, y1) <= buffer_m
+        near_b = haversine_m(x[:, None], y[:, None], x2, y2) <= buffer_m
+        ok = in_time & (near_a | near_b)
+    else:
+        frac = np.clip((tc - t1s) / span, 0.0, 1.0)
+        ix = x1 + frac * (x2 - x1)
+        iy = y1 + frac * (y2 - y1)
+        buf = buffer_m
+        if max_speed_mps:
+            buf = buffer_m + max_speed_mps * (span[0] / 1000.0)[None, :] * 0.5
+        ok = in_time & (haversine_m(x[:, None], y[:, None], ix, iy) <= buf)
+    return _select(fc, ok.any(axis=1))
+
+
+# ---------------------------------------------------------------------------
+# Track processes
+# ---------------------------------------------------------------------------
+
+def point2point(
+    ds: GeoDataset,
+    name: str,
+    group_by: str,
+    query: "str | Query" = "INCLUDE",
+    break_on_day: bool = False,
+) -> Dict[str, geo.LineString]:
+    """Connect each group's points into time-ordered LineStrings
+    (Point2PointProcess analog). Returns {track-id: LineString} (tracks with
+    < 2 points are dropped; ``break_on_day`` splits tracks at UTC-day
+    boundaries into '<id>#<day>' entries)."""
+    ft = ds.get_schema(name)
+    dtg = ft.dtg_field
+    if dtg is None:
+        raise ValueError("point2point needs a time field for ordering")
+    fc = ds.query(name, query)
+    if fc.batch.n == 0:
+        return {}
+    x, y = _xy(fc)
+    t = fc.batch.columns[dtg].astype(np.int64)
+    keys = fc.batch.columns[group_by]
+    d = fc.dicts.get(group_by)
+    out: Dict[str, geo.LineString] = {}
+    for code in np.unique(keys):
+        m = keys == code
+        order = np.argsort(t[m], kind="stable")
+        gx, gy, gt = x[m][order], y[m][order], t[m][order]
+        label = d.decode(np.asarray([code]))[0] if d is not None else str(code)
+        if break_on_day:
+            days = gt // 86_400_000
+            for day in np.unique(days):
+                dm = days == day
+                if dm.sum() >= 2:
+                    out[f"{label}#{int(day)}"] = geo.LineString(
+                        list(zip(gx[dm], gy[dm]))
+                    )
+        elif len(gx) >= 2:
+            out[label] = geo.LineString(list(zip(gx, gy)))
+    return out
+
+
+def track_label(
+    ds: GeoDataset,
+    name: str,
+    track_attr: str,
+    query: "str | Query" = "INCLUDE",
+) -> FeatureCollection:
+    """Most recent feature per track (TrackLabelProcess analog)."""
+    ft = ds.get_schema(name)
+    dtg = ft.dtg_field
+    if dtg is None:
+        raise ValueError("track_label needs a time field")
+    fc = ds.query(name, query)
+    if fc.batch.n == 0:
+        return fc
+    t = fc.batch.columns[dtg].astype(np.int64)
+    keys = fc.batch.columns[track_attr]
+    # stable sort by time then take the last row per key
+    order = np.argsort(t, kind="stable")
+    last: Dict[object, int] = {}
+    for i in order:
+        last[keys[i]] = int(i)
+    return _select(fc, np.array(sorted(last.values()), np.int64))
+
+
+def date_offset(
+    ds: GeoDataset,
+    name: str,
+    offset_ms: int,
+    query: "str | Query" = "INCLUDE",
+) -> FeatureCollection:
+    """Query results with the time attribute shifted (DateOffsetProcess)."""
+    ft = ds.get_schema(name)
+    dtg = ft.dtg_field
+    if dtg is None:
+        raise ValueError("date_offset needs a time field")
+    fc = ds.query(name, query)
+    if fc.batch.n:
+        cols = dict(fc.batch.columns)
+        cols[dtg] = cols[dtg] + np.int64(offset_ms)
+        fc = FeatureCollection(fc.ft, ColumnBatch(cols, fc.batch.n), fc.dicts)
+    return fc
+
+
+def hash_attribute(
+    ds: GeoDataset,
+    name: str,
+    attribute: str,
+    modulo: int,
+    query: "str | Query" = "INCLUDE",
+) -> np.ndarray:
+    """Stable per-feature hash of an attribute, mod N (HashAttributeProcess —
+    used for consistent styling colors). Returns int32 [n]."""
+    fc = ds.query(name, query)
+    if fc.batch.n == 0:
+        return np.zeros(0, np.int32)
+    col = fc.batch.columns[attribute]
+    d = fc.dicts.get(attribute)
+    if d is not None:
+        values = np.array(
+            [zlib.crc32(v.encode()) if v is not None else 0 for v in d.values],
+            np.uint32,
+        )
+        codes = np.clip(col, 0, None)
+        h = np.where(col >= 0, values[codes], 0)
+    else:
+        h = np.array([zlib.crc32(str(v).encode()) for v in col], np.uint32)
+    return (h % np.uint32(modulo)).astype(np.int32)
+
+
+# ---------------------------------------------------------------------------
+# Route search (RouteSearchProcess analog)
+# ---------------------------------------------------------------------------
+
+def route_search(
+    ds: GeoDataset,
+    name: str,
+    route: "str | geo.LineString",
+    buffer_m: float,
+    query: "str | Query" = "INCLUDE",
+    heading_attr: Optional[str] = None,
+    heading_tolerance_deg: float = 45.0,
+    bidirectional: bool = True,
+) -> FeatureCollection:
+    """Features within ``buffer_m`` of a route line, optionally requiring the
+    feature's heading to align with the local route bearing."""
+    line = geo.parse_wkt(route) if isinstance(route, str) else route
+    coords = np.asarray(line.coords, np.float64)
+    if coords.shape[0] < 2:
+        raise ValueError("route needs >= 2 vertices")
+    q = _as_query(query)
+    ft = ds.get_schema(name)
+    g = ft.geom_field
+    pad = buffer_m / METERS_PER_DEGREE * 2
+    xmin, ymin = coords.min(axis=0) - pad
+    xmax, ymax = coords.max(axis=0) + pad
+    import dataclasses
+
+    pre = _and_ecql(q.ecql, f"BBOX({g}, {xmin}, {ymin}, {xmax}, {ymax})")
+    fc = ds.query(name, dataclasses.replace(q, ecql=pre))
+    if fc.batch.n == 0:
+        return fc
+    x, y = _xy(fc)
+    # planar point-to-segment distance in meter space (local equirectangular)
+    lat0 = float(coords[:, 1].mean())
+    kx = METERS_PER_DEGREE * np.cos(np.radians(lat0))
+    ky = METERS_PER_DEGREE
+    px, py = x * kx, y * ky
+    ax, ay = coords[:-1, 0] * kx, coords[:-1, 1] * ky
+    bx, by = coords[1:, 0] * kx, coords[1:, 1] * ky
+    dx, dy = bx - ax, by - ay
+    seg_len2 = np.maximum(dx * dx + dy * dy, 1e-9)
+    tpar = np.clip(
+        ((px[:, None] - ax) * dx + (py[:, None] - ay) * dy) / seg_len2, 0.0, 1.0
+    )
+    cx = ax + tpar * dx
+    cy = ay + tpar * dy
+    dist = np.hypot(px[:, None] - cx, py[:, None] - cy)  # [N, M]
+    near = dist <= buffer_m
+    ok = near.any(axis=1)
+    if heading_attr is not None:
+        bearing = (np.degrees(np.arctan2(dx, dy)) + 360.0) % 360.0  # [M]
+        hd = fc.batch.columns[heading_attr].astype(np.float64)
+        diff = np.abs((hd[:, None] - bearing[None, :] + 180.0) % 360.0 - 180.0)
+        if bidirectional:
+            diff = np.minimum(diff, 180.0 - diff)
+        ok &= (near & (diff <= heading_tolerance_deg)).any(axis=1)
+    return _select(fc, ok)
+
+
+# ---------------------------------------------------------------------------
+# Joins
+# ---------------------------------------------------------------------------
+
+def join(
+    ds: GeoDataset,
+    left: str,
+    right: str,
+    left_attr: str,
+    right_attr: str,
+    left_query: "str | Query" = "INCLUDE",
+    right_query: "str | Query" = "INCLUDE",
+) -> ColumnBatch:
+    """Attribute equi-join of two schemas (JoinProcess analog). Right columns
+    are prefixed ``right.``; string joins resolve through both dictionaries."""
+    lfc = ds.query(left, left_query)
+    rfc = ds.query(right, right_query)
+    if lfc.batch.n == 0 or rfc.batch.n == 0:
+        return ColumnBatch({}, 0)
+    lcol = lfc.batch.columns[left_attr]
+    rcol = rfc.batch.columns[right_attr]
+    ld, rd = lfc.dicts.get(left_attr), rfc.dicts.get(right_attr)
+    if ld is not None or rd is not None:
+        if ld is None or rd is None:
+            raise ValueError("join attribute types differ (string vs non-string)")
+        lcol = np.array(ld.decode(lcol), dtype=object)
+        rcol = np.array(rd.decode(rcol), dtype=object)
+    rmap: Dict[object, List[int]] = {}
+    for j, v in enumerate(rcol):
+        rmap.setdefault(v, []).append(j)
+    li, rj = [], []
+    for i, v in enumerate(lcol):
+        for j in rmap.get(v, ()):
+            li.append(i)
+            rj.append(j)
+    li = np.asarray(li, np.int64)
+    rj = np.asarray(rj, np.int64)
+    cols = {k: v[li] for k, v in lfc.batch.columns.items()}
+    for k, v in rfc.batch.columns.items():
+        cols["right." + k] = v[rj]
+    return ColumnBatch(cols, len(li))
+
+
+def spatial_join(
+    ds: GeoDataset,
+    points: str,
+    polygons: "Sequence[str] | Sequence[geo.Geometry]",
+    query: "str | Query" = "INCLUDE",
+    weight: Optional[str] = None,
+) -> Tuple[np.ndarray, np.ndarray]:
+    """Point-in-polygon join (BASELINE config #4; GeoMesaJoinRelation /
+    st_contains join analog): assign each matching point its first containing
+    polygon and count points (or sum ``weight``) per polygon.
+
+    ``polygons``: WKT strings or parsed geometries. Returns
+    (assign int32 [n]  — polygon index or -1, counts float32 [P]).
+    Runs as one device kernel over the scan (crossing matrix + segment-sum)
+    when the store prefers the device path.
+    """
+    geoms = [geo.parse_wkt(p) if isinstance(p, str) else p for p in polygons]
+    edges = geo.polygon_edge_buffers(
+        geo.MultiPolygon(
+            tuple(
+                poly
+                for gm in geoms
+                for poly in (gm.polygons if isinstance(gm, geo.MultiPolygon) else (gm,))
+            )
+        )
+    )
+    # poly ids above refer to flattened polygons; remap to input indices
+    flat_to_input = []
+    for i, gm in enumerate(geoms):
+        k = len(gm.polygons) if isinstance(gm, geo.MultiPolygon) else 1
+        flat_to_input += [i] * k
+    remap = np.asarray(flat_to_input, np.int32)
+
+    st, q, plan = ds._plan(points, query)
+    g = st.ft.geom_field
+    xc, yc = g + "__x", g + "__y"
+    agg_cols = [xc, yc] + ([weight] if weight else [])
+    edges_f32 = {
+        k: (v.astype(np.float32) if k in ("x1", "y1", "x2", "y2") else v)
+        for k, v in edges.items()
+    }
+
+    def agg(cols, m, xp):
+        return kjoin.pip_assign(cols[xc], cols[yc], m, edges_f32, xp)
+
+    ex = ds._executor(st)
+    # cache the jitted kernel per polygon-set signature (re-join with the
+    # same polygons skips retracing)
+    sig = hash((edges["x1"].tobytes(), edges["poly_id"].tobytes()))
+    out = ex._run(plan, agg, agg, agg_cols, cache_key=("pip_join", sig))
+    if out is None:
+        return np.zeros(0, np.int32), np.zeros(len(geoms), np.float32)
+    assign_flat = np.asarray(out)
+    assign_input = np.where(assign_flat >= 0, remap[np.clip(assign_flat, 0, None)], -1)
+
+    table = st.tables[plan.index_name]
+    L = table.shard_len
+    # compress the padded [S*L] assignment down to real rows
+    valid = np.zeros(table.n_shards * L, dtype=bool)
+    for s in range(table.n_shards):
+        sl = table.shard_slice(s)
+        valid[s * L : s * L + (sl.stop - sl.start)] = True
+    assign_rows = assign_input[valid]
+    counts = np.zeros(len(geoms), np.float32)
+    if weight:
+        w = table.columns[weight].astype(np.float32)
+    else:
+        w = np.ones(table.n, np.float32)
+    hit = assign_rows >= 0
+    np.add.at(counts, assign_rows[hit], w[hit])
+    return assign_rows, counts
+
+
+# ---------------------------------------------------------------------------
+# Sampling (SamplingProcess analog; thin wrapper over the SAMPLING hint)
+# ---------------------------------------------------------------------------
+
+def sample(
+    ds: GeoDataset,
+    name: str,
+    one_in_n: int,
+    query: "str | Query" = "INCLUDE",
+) -> FeatureCollection:
+    import dataclasses
+
+    q = _as_query(query)
+    return ds.query(name, dataclasses.replace(q, sampling=one_in_n))

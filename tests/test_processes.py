@@ -1,0 +1,200 @@
+"""Analytic process library tests (geomesa-process parity: tube select,
+track ops, route search, joins, sampling)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import processes
+from geomesa_tpu.api.dataset import GeoDataset, Query
+from geomesa_tpu.filter.ecql import parse_iso_ms
+from geomesa_tpu.utils import geometry as geo
+
+T0 = parse_iso_ms("2024-01-01T00:00:00Z")
+
+
+def _tracks_dataset(prefer_device=False):
+    """Two vehicles moving east along different latitudes, 1 point/minute."""
+    ds = GeoDataset(n_shards=2, prefer_device=prefer_device)
+    ds.create_schema(
+        "tracks", "vessel:String:index=true,heading:Float,dtg:Date,*geom:Point"
+    )
+    n = 60
+    t = T0 + np.arange(n) * 60_000
+    rows = {
+        "vessel": ["a"] * n + ["b"] * n,
+        "heading": [90.0] * n + [0.0] * n,
+        "dtg": np.concatenate([t, t]).astype("datetime64[ms]"),
+        # a: lat 10, lon 0..5.9; b: lat 20 (northbound), lon 50
+        "geom": [(i * 0.1, 10.0) for i in range(n)]
+        + [(50.0, 20.0 + i * 0.1) for i in range(n)],
+    }
+    ds.insert("tracks", rows, fids=[f"f{i}" for i in range(2 * n)])
+    return ds
+
+
+class TestTubeSelect:
+    def test_line_gap_fill_follows_track(self):
+        ds = _tracks_dataset()
+        # tube follows vehicle a exactly
+        tube_xy = [(0.0, 10.0), (5.9, 10.0)]
+        tube_t = [T0, T0 + 59 * 60_000]
+        fc = ds.tube_select("tracks", tube_xy, tube_t, buffer_m=20_000)
+        assert len(fc) == 60
+        d = fc.to_dict()
+        assert set(d["vessel"]) == {"a"}
+
+    def test_tube_excludes_wrong_time(self):
+        ds = _tracks_dataset()
+        # same corridor but time-shifted by 10 hours -> no matches
+        tube_xy = [(0.0, 10.0), (5.9, 10.0)]
+        shift = 36_000_000
+        fc = ds.tube_select(
+            "tracks", tube_xy, [T0 + shift, T0 + shift + 59 * 60_000], 20_000
+        )
+        assert len(fc) == 0
+
+    def test_gap_fill_none_only_near_waypoints(self):
+        ds = _tracks_dataset()
+        tube_xy = [(0.0, 10.0), (5.9, 10.0)]
+        tube_t = [T0, T0 + 59 * 60_000]
+        fc = ds.tube_select(
+            "tracks", tube_xy, tube_t, buffer_m=20_000, gap_fill="none"
+        )
+        # only points spatially near the two waypoints qualify
+        assert 0 < len(fc) < 60
+
+    def test_single_waypoint(self):
+        ds = _tracks_dataset()
+        fc = ds.tube_select(
+            "tracks", [(3.0, 10.0)], [T0 + 30 * 60_000], buffer_m=30_000
+        )
+        assert len(fc) >= 1
+        assert set(fc.to_dict()["vessel"]) == {"a"}
+
+    def test_validation(self):
+        ds = _tracks_dataset()
+        with pytest.raises(ValueError):
+            ds.tube_select("tracks", [(0, 0)], [T0, T0 + 1], 100)
+
+
+class TestTrackProcesses:
+    def test_point2point(self):
+        ds = _tracks_dataset()
+        lines = ds.point2point("tracks", "vessel")
+        assert set(lines) == {"a", "b"}
+        a = np.asarray(lines["a"].coords)
+        assert len(a) == 60
+        # time-ordered west -> east
+        assert (np.diff(a[:, 0]) > 0).all()
+
+    def test_point2point_break_on_day(self):
+        ds = GeoDataset(n_shards=2, prefer_device=False)
+        ds.create_schema("t", "v:String,dtg:Date,*geom:Point")
+        t = np.array([T0, T0 + 3_600_000, T0 + 90_000_000, T0 + 93_600_000])
+        ds.insert("t", {
+            "v": ["a"] * 4,
+            "dtg": t.astype("datetime64[ms]"),
+            "geom": [(float(i), 0.0) for i in range(4)],
+        })
+        lines = ds.point2point("t", "v", break_on_day=True)
+        assert len(lines) == 2  # split at the UTC day boundary
+
+    def test_track_label_latest_point(self):
+        ds = _tracks_dataset()
+        fc = ds.track_label("tracks", "vessel")
+        assert len(fc) == 2
+        d = fc.to_dict()
+        by_vessel = dict(zip(d["vessel"], d["geom"]))
+        assert by_vessel["a"][0] == pytest.approx(5.9)
+        assert by_vessel["b"][1] == pytest.approx(25.9)
+
+    def test_date_offset(self):
+        ds = _tracks_dataset()
+        fc = processes.date_offset(ds, "tracks", 86_400_000, "vessel = 'a'")
+        t = fc.batch.columns["dtg"].astype(np.int64)
+        assert t.min() == T0 + 86_400_000
+
+    def test_hash_attribute_stable(self):
+        ds = _tracks_dataset()
+        h1 = processes.hash_attribute(ds, "tracks", "vessel", 7)
+        h2 = processes.hash_attribute(ds, "tracks", "vessel", 7)
+        assert (h1 == h2).all()
+        assert ((h1 >= 0) & (h1 < 7)).all()
+        # same vessel -> same hash
+        v = ds.query("tracks").to_dict()["vessel"]
+        codes = {}
+        for vi, hi in zip(v, h1):
+            codes.setdefault(vi, set()).add(int(hi))
+        assert all(len(s) == 1 for s in codes.values())
+
+
+class TestRouteSearch:
+    def test_route_buffer(self):
+        ds = _tracks_dataset()
+        fc = ds.route_search("tracks", "LINESTRING (0 10, 6 10)", 15_000)
+        assert set(fc.to_dict()["vessel"]) == {"a"}
+        assert len(fc) == 60
+
+    def test_route_heading_filter(self):
+        ds = _tracks_dataset()
+        # vehicle a heads east (90); route bearing is east -> matches
+        fc = ds.route_search(
+            "tracks", "LINESTRING (0 10, 6 10)", 15_000,
+            heading_attr="heading", heading_tolerance_deg=30,
+        )
+        assert len(fc) == 60
+        # a north-south route near vessel a matches nothing with heading filter
+        fc2 = ds.route_search(
+            "tracks", "LINESTRING (3 9.99, 3 10.01)", 2_000,
+            heading_attr="heading", heading_tolerance_deg=10,
+            bidirectional=False,
+        )
+        assert len(fc2) == 0
+
+
+class TestJoins:
+    def test_attribute_join(self):
+        ds = _tracks_dataset()
+        ds.create_schema("meta", "vessel:String,flag:String")
+        ds.insert("meta", {"vessel": ["a", "c"], "flag": ["US", "FR"]})
+        out = ds.join("tracks", "meta", "vessel", "vessel")
+        assert out.n == 60  # only vessel a matches
+        assert (out.columns["right.flag"] == 0).all()  # dict code for 'US'
+
+    def test_spatial_join_assign_and_counts(self):
+        ds = _tracks_dataset()
+        polys = [
+            "POLYGON ((-1 9, 3.05 9, 3.05 11, -1 11, -1 9))",   # first 31 a-points
+            "POLYGON ((49 19, 51 31, 51 19, 49 19))",            # some b-points
+            "POLYGON ((100 0, 101 0, 101 1, 100 1, 100 0))",    # empty
+        ]
+        assign, counts = ds.spatial_join("tracks", polys)
+        assert counts.shape == (3,)
+        assert counts[0] == 31
+        assert counts[2] == 0
+        assert counts.sum() == (assign >= 0).sum()
+
+    def test_spatial_join_device_matches_host(self):
+        polys = ["POLYGON ((0.55 9, 3.05 9, 3.05 11, 0.55 11, 0.55 9))"]
+        a1, c1 = _tracks_dataset(prefer_device=False).spatial_join("tracks", polys)
+        a2, c2 = _tracks_dataset(prefer_device=True).spatial_join("tracks", polys)
+        assert c1.tolist() == c2.tolist()
+        assert (a1 == a2).all()
+
+    def test_spatial_join_with_holes(self):
+        ds = GeoDataset(n_shards=2, prefer_device=False)
+        ds.create_schema("p", "*geom:Point")
+        ds.insert("p", {"geom": [(0.5, 0.5), (0.05, 0.05)]})
+        donut = "POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0), (0.2 0.2, 0.8 0.2, 0.8 0.8, 0.2 0.8, 0.2 0.2))"
+        assign, counts = ds.spatial_join("p", [donut])
+        assert counts[0] == 1  # center point is in the hole
+        assert assign.tolist().count(-1) == 1
+
+
+class TestSampling:
+    def test_one_in_n(self):
+        ds = _tracks_dataset()
+        fc = ds.sample("tracks", 10)
+        assert len(fc) == pytest.approx(12, abs=2)
+        fc2 = ds.sample("tracks", 10)
+        assert len(fc) == len(fc2)  # deterministic
