@@ -101,6 +101,29 @@ class LineString(Geometry):
 
 
 @dataclass(frozen=True)
+class MultiLineString(Geometry):
+    lines: Tuple["LineString", ...]
+    kind = "multilinestring"
+
+    def bounds(self):
+        bs = np.asarray([ls.bounds() for ls in self.lines])
+        return (float(bs[:, 0].min()), float(bs[:, 1].min()),
+                float(bs[:, 2].max()), float(bs[:, 3].max()))
+
+    def wkt(self):
+        def seg(ls: "LineString"):
+            return "(" + ", ".join(f"{_fmt(x)} {_fmt(y)}" for x, y in ls.coords) + ")"
+
+        return "MULTILINESTRING (" + ", ".join(seg(ls) for ls in self.lines) + ")"
+
+    def contains_points(self, xs, ys):
+        m = np.zeros(np.asarray(xs).shape, dtype=bool)
+        for ls in self.lines:
+            m |= ls.contains_points(xs, ys)
+        return m
+
+
+@dataclass(frozen=True)
 class Polygon(Geometry):
     shell: Tuple[Tuple[float, float], ...]  # closed or open ring
     holes: Tuple[Tuple[Tuple[float, float], ...], ...] = ()
@@ -320,6 +343,8 @@ def parse_wkt(text: str) -> Geometry:
         return MultiPoint(tuple(Point(x, y) for x, y in pts))
     if tag == "LINESTRING":
         return LineString(coords(body.strip("() ")))
+    if tag == "MULTILINESTRING":
+        return MultiLineString(tuple(LineString(r) for r in rings(body)))
     if tag == "POLYGON":
         rs = rings(body)
         if not rs:

@@ -1,0 +1,293 @@
+"""st_* geo-function library tests (geomesa-spark-jts UDF parity)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import geofn as gf
+from geomesa_tpu.utils import geometry as geo
+
+SQ = "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))"
+TRI = "POLYGON ((2 2, 8 2, 5 8, 2 2))"
+LINE = "LINESTRING (0 0, 10 10)"
+
+
+class TestConstructorsAndOutputs:
+    def test_make_point_and_text(self):
+        p = gf.st_makePoint(1.5, 2.5)
+        assert (p.x, p.y) == (1.5, 2.5)
+        assert gf.st_asText(p) == "POINT (1.5 2.5)"
+        assert gf.st_pointFromText("POINT (3 4)").y == 4
+
+    def test_make_line_polygon_bbox(self):
+        l = gf.st_makeLine([gf.st_makePoint(0, 0), gf.st_makePoint(1, 1)])
+        assert l.kind == "linestring"
+        poly = gf.st_makePolygon("LINESTRING (0 0, 1 0, 1 1, 0 0)")
+        assert poly.kind == "polygon"
+        bb = gf.st_makeBBOX(0, 0, 2, 3)
+        assert bb.bounds() == (0, 0, 2, 3)
+        assert gf.st_makeBox2D("POINT (0 0)", "POINT (2 3)").bounds() == (0, 0, 2, 3)
+
+    def test_typed_from_text_rejects(self):
+        with pytest.raises(ValueError):
+            gf.st_pointFromText(LINE)
+
+    def test_multilinestring_round_trip(self):
+        mls = gf.st_mLineFromText("MULTILINESTRING ((0 0, 1 1), (2 2, 3 3))")
+        assert len(mls.lines) == 2
+        assert gf.st_geomFromText(mls.wkt()).wkt() == mls.wkt()
+
+    def test_geojson_round_trip(self):
+        for wkt in ("POINT (1 2)", LINE, SQ,
+                    "MULTIPOLYGON (((0 0, 1 0, 1 1, 0 0)))"):
+            g = gf.st_geomFromText(wkt)
+            back = gf.st_geomFromGeoJSON(gf.st_asGeoJSON(g))
+            assert back.wkt() == g.wkt()
+
+    def test_wkb_round_trip(self):
+        for wkt in (
+            "POINT (1.5 -2.25)", LINE, SQ,
+            "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 2 1, 2 2, 1 2, 1 1))",
+            "MULTIPOINT ((0 0), (1 1))",
+            "MULTILINESTRING ((0 0, 1 1), (2 2, 3 3))",
+            "MULTIPOLYGON (((0 0, 1 0, 1 1, 0 0)), ((5 5, 6 5, 6 6, 5 5)))",
+        ):
+            g = gf.st_geomFromText(wkt)
+            assert gf.st_geomFromWKB(gf.st_asBinary(g)).wkt() == g.wkt()
+
+    def test_wkb_shapely_compat(self):
+        # cross-check the wire format against a known-good WKB blob
+        # (POINT(1 2) little-endian) so external readers can consume it
+        import binascii
+
+        expect = binascii.unhexlify(
+            "0101000000000000000000f03f0000000000000040"
+        )
+        assert gf.st_asBinary("POINT (1 2)") == expect
+
+    def test_lat_lon_text(self):
+        s = gf.st_asLatLonText("POINT (-122.5 37.75)")
+        assert s.startswith("37°45'") and s.endswith("W")
+
+
+class TestGeoHash:
+    def test_known_geohash(self):
+        # canonical example: (-5.6, 42.6) -> ezs42
+        h = gf.st_geoHash(gf.st_makePoint(-5.6, 42.6), 25)
+        assert h == "ezs42"
+
+    def test_round_trip_center(self):
+        p = gf.st_pointFromGeoHash("ezs42")
+        assert p.x == pytest.approx(-5.6, abs=0.05)
+        assert p.y == pytest.approx(42.6, abs=0.05)
+        box = gf.st_box2DFromGeoHash("ezs42")
+        assert gf.st_contains(box, p)
+
+    def test_array_form(self):
+        hs = gf.st_geoHash((np.array([-5.6, 0.0]), np.array([42.6, 0.0])), 25)
+        assert hs[0] == "ezs42"
+        assert len(hs[1]) == 5
+
+
+class TestAccessors:
+    def test_xy(self):
+        assert gf.st_x("POINT (3 4)") == 3
+        assert gf.st_y("POINT (3 4)") == 4
+        assert gf.st_x(LINE) is None
+
+    def test_envelope_and_boundary(self):
+        env = gf.st_envelope(TRI)
+        assert env.bounds() == (2, 2, 8, 8)
+        b = gf.st_boundary(SQ)
+        assert b.kind == "linestring"
+        assert gf.st_boundary(LINE).kind == "multipoint"
+
+    def test_rings_and_points(self):
+        donut = "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 2 1, 2 2, 1 2, 1 1))"
+        assert gf.st_exteriorRing(donut).kind == "linestring"
+        assert gf.st_interiorRingN(donut, 0) is not None
+        assert gf.st_interiorRingN(donut, 1) is None
+        assert gf.st_numPoints("LINESTRING (0 0, 1 1, 2 2)") == 3
+        assert gf.st_pointN(LINE, 1).x == 10
+        assert gf.st_pointN(LINE, -1).x == 10
+
+    def test_geometry_n(self):
+        mp = "MULTIPOINT ((0 0), (1 1), (2 2))"
+        assert gf.st_numGeometries(mp) == 3
+        assert gf.st_geometryN(mp, 2).x == 2
+        assert gf.st_numGeometries(SQ) == 1
+
+    def test_type_dims_flags(self):
+        assert gf.st_geometryType(SQ) == "Polygon"
+        assert gf.st_dimension(SQ) == 2
+        assert gf.st_dimension(LINE) == 1
+        assert gf.st_dimension("POINT (0 0)") == 0
+        assert gf.st_coordDim(SQ) == 2
+        assert gf.st_isCollection("MULTIPOINT ((0 0))")
+        assert not gf.st_isCollection(SQ)
+        assert gf.st_isClosed("LINESTRING (0 0, 1 0, 1 1, 0 0)")
+        assert not gf.st_isClosed(LINE)
+        assert gf.st_isRing("LINESTRING (0 0, 1 0, 1 1, 0 0)")
+        assert gf.st_isValid(SQ)
+        # bowtie is invalid
+        assert not gf.st_isValid("POLYGON ((0 0, 2 2, 2 0, 0 2, 0 0))")
+        assert gf.st_isSimple(LINE)
+        assert not gf.st_isSimple("LINESTRING (0 0, 2 2, 2 0, 0 2)")
+
+    def test_casts(self):
+        assert gf.st_castToPoint("POINT (0 0)").kind == "point"
+        with pytest.raises(ValueError):
+            gf.st_castToPolygon("POINT (0 0)")
+        assert gf.st_castToGeometry(SQ).kind == "polygon"
+
+
+class TestRelations:
+    def test_contains_within(self):
+        assert gf.st_contains(SQ, TRI)
+        assert gf.st_within(TRI, SQ)
+        assert not gf.st_contains(TRI, SQ)
+        assert gf.st_contains(SQ, "POINT (5 5)")
+        assert not gf.st_contains(SQ, "POINT (15 5)")
+
+    def test_intersects_disjoint(self):
+        assert gf.st_intersects(SQ, TRI)
+        far = "POLYGON ((20 20, 30 20, 30 30, 20 30, 20 20))"
+        assert gf.st_disjoint(SQ, far)
+        # overlapping but no vertex containment either way
+        cross1 = "POLYGON ((-1 4, 11 4, 11 6, -1 6, -1 4))"
+        assert gf.st_intersects(SQ, cross1)
+        assert gf.st_intersects(LINE, "LINESTRING (0 10, 10 0)")
+
+    def test_array_fast_path(self):
+        xs = np.array([5.0, 15.0, 0.0])
+        ys = np.array([5.0, 5.0, 0.0])
+        m = gf.st_contains(SQ, (xs, ys))
+        assert m.tolist() == [True, False, True]
+        assert gf.st_disjoint(SQ, (xs, ys)).tolist() == [False, True, False]
+
+    def test_overlaps(self):
+        a = "POLYGON ((0 0, 6 0, 6 6, 0 6, 0 0))"
+        b = "POLYGON ((3 3, 9 3, 9 9, 3 9, 3 3))"
+        assert gf.st_overlaps(a, b)
+        assert not gf.st_overlaps(SQ, TRI)  # containment is not overlap
+        assert not gf.st_overlaps(SQ, LINE)  # dim mismatch
+
+    def test_touches(self):
+        a = "POLYGON ((0 0, 5 0, 5 5, 0 5, 0 0))"
+        b = "POLYGON ((5 0, 10 0, 10 5, 5 5, 5 0))"
+        assert gf.st_touches(a, b)
+        assert gf.st_touches(a, "POINT (5 2)")
+        assert not gf.st_touches(a, "POINT (2 2)")
+
+    def test_crosses(self):
+        assert gf.st_crosses(LINE, "LINESTRING (0 10, 10 0)")
+        assert gf.st_crosses("LINESTRING (-5 5, 15 5)", SQ)
+        assert not gf.st_crosses(SQ, TRI)
+
+    def test_equals(self):
+        # same ring, rotated start + reversed direction
+        a = "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))"
+        b = "POLYGON ((10 10, 10 0, 0 0, 0 10, 10 10))"
+        assert gf.st_equals(a, b)
+        assert not gf.st_equals(a, TRI)
+
+    def test_covers(self):
+        assert gf.st_covers(SQ, "POINT (0 0)")  # boundary point
+
+    def test_relate(self):
+        m = gf.st_relate(SQ, TRI)
+        assert len(m) == 9
+        assert m[0] == "2"  # interiors intersect with area
+        assert gf.st_relateBool(SQ, TRI, "T*****FF*")  # contains pattern
+
+
+class TestProcessing:
+    def test_area(self):
+        assert gf.st_area(SQ) == 100
+        assert gf.st_area(TRI) == pytest.approx(18)
+        donut = "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 2 1, 2 2, 1 2, 1 1))"
+        assert gf.st_area(donut) == pytest.approx(15)
+        assert gf.st_area(LINE) == 0
+
+    def test_length(self):
+        assert gf.st_length(LINE) == pytest.approx(np.sqrt(200))
+        assert gf.st_length(SQ) == 0
+        assert gf.st_perimeter(SQ) == 40
+        # one degree of longitude at the equator ~ 111.32 km
+        m = gf.st_lengthSphere("LINESTRING (0 0, 1 0)")
+        assert m == pytest.approx(111_319, rel=0.01)
+
+    def test_centroid(self):
+        c = gf.st_centroid(SQ)
+        assert (c.x, c.y) == (5, 5)
+        c2 = gf.st_centroid("LINESTRING (0 0, 10 0)")
+        assert (c2.x, c2.y) == (5, 0)
+        c3 = gf.st_centroid("MULTIPOINT ((0 0), (2 0))")
+        assert c3.x == 1
+
+    def test_distance(self):
+        assert gf.st_distance("POINT (0 0)", "POINT (3 4)") == 5
+        assert gf.st_distance(SQ, "POINT (13 10)") == 3
+        assert gf.st_distance(SQ, "POINT (5 5)") == 0
+        d = gf.st_distance(SQ, (np.array([13.0, 5.0]), np.array([10.0, 5.0])))
+        assert d.tolist() == [3.0, 0.0]
+
+    def test_distance_sphere(self):
+        m = gf.st_distanceSphere("POINT (0 0)", "POINT (1 0)")
+        assert m == pytest.approx(111_319, rel=0.01)
+
+    def test_closest_point(self):
+        p = gf.st_closestPoint(SQ, "POINT (15 5)")
+        assert (p.x, p.y) == (10, 5)
+
+    def test_buffer_point(self):
+        b = gf.st_bufferPoint("POINT (0 45)", 10_000)
+        assert b.kind == "polygon"
+        # contains the center, excludes a point 20km away
+        assert gf.st_contains(b, "POINT (0 45)")
+        assert not gf.st_contains(b, "POINT (0 45.3)")
+        # radius sanity: boundary vertex ~10km from center
+        vx, vy = b.shell[0]
+        assert geo.haversine_m(vx, vy, 0, 45) == pytest.approx(10_000, rel=0.01)
+
+    def test_convexhull(self):
+        h = gf.st_convexhull("MULTIPOINT ((0 0), (4 0), (4 4), (0 4), (2 2))")
+        assert h.kind == "polygon"
+        assert gf.st_area(h) == 16
+        # aggregate over an object array of geometries
+        arr = np.array(["POINT (0 0)", "POINT (1 0)", "POINT (0 1)"], dtype=object)
+        h2 = gf.st_convexhull(arr)
+        assert gf.st_area(h2) == pytest.approx(0.5)
+
+    def test_translate(self):
+        t = gf.st_translate(SQ, 5, -5)
+        assert t.bounds() == (5, -5, 15, 5)
+
+    def test_intersection(self):
+        got = gf.st_intersection(SQ, "POLYGON ((5 5, 15 5, 15 15, 5 15, 5 5))")
+        assert gf.st_area(got) == pytest.approx(25)
+        assert gf.st_intersection(SQ, "POINT (5 5)").kind == "point"
+        assert gf.st_intersection(SQ, "POLYGON ((20 20, 21 20, 21 21, 20 20))") is None
+
+    def test_difference(self):
+        hole = "POLYGON ((4 4, 6 4, 6 6, 4 6, 4 4))"
+        d = gf.st_difference(SQ, hole)
+        assert gf.st_area(d) == pytest.approx(96)
+        far = "POLYGON ((20 20, 21 20, 21 21, 20 20))"
+        assert gf.st_difference(SQ, far).wkt() == gf.st_geomFromText(SQ).wkt()
+
+    def test_antimeridian_safe(self):
+        g = gf.st_antimeridianSafeGeom(
+            "POLYGON ((170 0, 190 0, 190 10, 170 10, 170 0))"
+        )
+        assert g.kind == "multipolygon"
+        bs = [p.bounds() for p in g.polygons]
+        assert any(b[2] <= 180 for b in bs) and any(b[0] >= -180 for b in bs)
+        # in-range geometry unchanged
+        same = gf.st_antimeridianSafeGeom(SQ)
+        assert same.wkt() == gf.st_geomFromText(SQ).wkt()
+
+    def test_aggregate_distance_sphere(self):
+        d = gf.st_aggregateDistanceSphere(
+            ["POINT (0 0)", "POINT (1 0)", "POINT (2 0)"]
+        )
+        assert d == pytest.approx(2 * 111_319, rel=0.01)
