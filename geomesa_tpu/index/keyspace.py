@@ -365,6 +365,140 @@ class XZ3KeySpace(KeySpace):
         )
 
 
+class S2KeySpace(KeySpace):
+    """S2 cell-id keys over point geometry (reference S2Index / S2SFC.scala:17,
+    which wraps Google S2; cell math in geomesa_tpu.curves.s2)."""
+
+    name = "s2"
+    kind = "s2"
+
+    def __init__(self, geom: str):
+        self.geom = geom
+        from geomesa_tpu.curves.s2 import S2SFC
+
+        self.sfc = S2SFC(max_cells=64)
+        self.key_cols = ("__s2",)
+
+    def supports(self, ft):
+        return ft.has(self.geom) and ft.attr(self.geom).is_point
+
+    def index_keys(self, ft, batch):
+        return {
+            "__s2": self.sfc.index(batch[self.geom + "__x"], batch[self.geom + "__y"])
+        }
+
+    def sort_order(self, cols):
+        return np.argsort(cols["__s2"], kind="stable")
+
+    def plan(self, ft, f):
+        geoms = ir.extract_geometries(f, self.geom)
+        if geoms.disjoint:
+            return KeyPlan(self, disjoint=True)
+        if geoms.is_empty:
+            return KeyPlan(self, full_scan=True)
+        bs = np.asarray([g.bounds() for g in geoms.values])
+        bbox = (bs[:, 0].min(), bs[:, 1].min(), bs[:, 2].max(), bs[:, 3].max())
+        ranges = self.sfc.ranges(*bbox)
+        span = sum(r.hi - r.lo + 1 for r in ranges)
+        return KeyPlan(self, ranges=ranges, coverage=span / float(6 << 60))
+
+    def resolve_windows(self, plan, shard_cols, n):
+        col = shard_cols["__s2"]
+        starts, ends = [], []
+        for r in plan.ranges:
+            s = np.searchsorted(col, np.uint64(r.lo), side="left")
+            e = np.searchsorted(col, np.uint64(r.hi), side="right")
+            if e > s:
+                starts.append(s)
+                ends.append(e)
+        if not starts:
+            return np.zeros(1, np.int64), np.zeros(1, np.int64)
+        return _cap_windows(
+            np.asarray(starts, np.int64), np.asarray(ends, np.int64), MAX_WINDOW_BINS
+        )
+
+
+class S3KeySpace(KeySpace):
+    """(time bin, S2 cell id) keys: the reference's S3Index (S2 space +
+    BinnedTime period bins)."""
+
+    name = "s3"
+    kind = "s3"
+
+    def __init__(self, geom: str, dtg: str, period: "str | TimePeriod" = TimePeriod.WEEK):
+        self.geom = geom
+        self.dtg = dtg
+        from geomesa_tpu.curves.s2 import S2SFC
+
+        self.sfc = S2SFC(max_cells=64)
+        self.binned = BinnedTime(period)
+        self.key_cols = ("__s3_bin", "__s3")
+
+    def supports(self, ft):
+        return (
+            ft.has(self.geom) and ft.attr(self.geom).is_point
+            and ft.has(self.dtg) and ft.attr(self.dtg).type == "date"
+        )
+
+    def index_keys(self, ft, batch):
+        b, _ = self.binned.to_bin_and_offset(batch[self.dtg])
+        return {
+            "__s3_bin": b.astype(np.int32),
+            "__s3": self.sfc.index(batch[self.geom + "__x"], batch[self.geom + "__y"]),
+        }
+
+    def sort_order(self, cols):
+        return np.lexsort((cols["__s3"], cols["__s3_bin"]))
+
+    def plan(self, ft, f):
+        geoms = ir.extract_geometries(f, self.geom)
+        intervals = ir.extract_intervals(f, self.dtg)
+        if geoms.disjoint or intervals.disjoint:
+            return KeyPlan(self, disjoint=True)
+        if intervals.is_empty:
+            return None
+        CLAMP = 2**45
+        iv = [(max(lo, -CLAMP), min(hi, CLAMP)) for lo, hi in intervals.values]
+        bins = np.unique(
+            np.concatenate([self.binned.bins_between(lo, hi) for lo, hi in iv])
+        )
+        if geoms.is_empty:
+            return KeyPlan(self, ranges=[], bins=bins.astype(np.int32), coverage=1.0)
+        bs = np.asarray([g.bounds() for g in geoms.values])
+        bbox = (bs[:, 0].min(), bs[:, 1].min(), bs[:, 2].max(), bs[:, 3].max())
+        ranges = self.sfc.ranges(*bbox)
+        span = sum(r.hi - r.lo + 1 for r in ranges)
+        cov = span / float(6 << 60)
+        return KeyPlan(self, ranges=ranges, bins=bins.astype(np.int32), coverage=cov)
+
+    def resolve_windows(self, plan, shard_cols, n):
+        bins_col = shard_cols["__s3_bin"]
+        col = shard_cols["__s3"]
+        bins = plan.bins
+        if len(bins) > 8 or not plan.ranges:
+            s = np.searchsorted(bins_col, bins[0], side="left")
+            e = np.searchsorted(bins_col, bins[-1], side="right")
+            return np.asarray([s], np.int64), np.asarray([e], np.int64)
+        starts, ends = [], []
+        for b in bins.tolist():
+            s = np.searchsorted(bins_col, b, side="left")
+            e = np.searchsorted(bins_col, b, side="right")
+            if e <= s:
+                continue
+            seg = col[s:e]
+            for r in plan.ranges:
+                s2_ = s + np.searchsorted(seg, np.uint64(r.lo), side="left")
+                e2_ = s + np.searchsorted(seg, np.uint64(r.hi), side="right")
+                if e2_ > s2_:
+                    starts.append(s2_)
+                    ends.append(e2_)
+        if not starts:
+            return np.zeros(1, np.int64), np.zeros(1, np.int64)
+        return _cap_windows(
+            np.asarray(starts, np.int64), np.asarray(ends, np.int64), MAX_WINDOW_BINS
+        )
+
+
 class IdKeySpace(KeySpace):
     """Feature-id index (reference IdIndex): host-sorted fid strings."""
 
@@ -502,22 +636,49 @@ def _cap_windows(starts: np.ndarray, ends: np.ndarray, cap: int):
 
 def keyspaces_for_schema(ft: FeatureType) -> List[KeySpace]:
     """Pick indices from the schema shape (GeoMesaFeatureIndexFactory.indices
-    analog, reference GeoMesaDataStore.preSchemaCreate:116)."""
-    out: List[KeySpace] = []
+    analog, reference GeoMesaDataStore.preSchemaCreate:116). The
+    ``geomesa.indices`` user-data key overrides the defaults with an explicit
+    comma-separated list of index kinds (z3,z2,xz3,xz2,s2,s3,id,attr)."""
     geom = ft.geom_field
     dtg = ft.dtg_field
     period = ft.time_period
-    if geom is not None:
-        if ft.attr(geom).is_point:
-            if dtg is not None:
-                out.append(Z3KeySpace(geom, dtg, period))
+
+    explicit = ft.user_data.get("geomesa.indices")
+    if explicit:
+        wanted = [k.strip().lower() for k in explicit.split(",") if k.strip()]
+    else:
+        wanted = []
+        if geom is not None:
+            if ft.attr(geom).is_point:
+                if dtg is not None:
+                    wanted.append("z3")
+                wanted.append("z2")
+            else:
+                if dtg is not None:
+                    wanted.append("xz3")
+                wanted.append("xz2")
+        wanted += ["id", "attr"]
+
+    out: List[KeySpace] = []
+    for kind in wanted:
+        if kind == "z3" and geom and dtg:
+            out.append(Z3KeySpace(geom, dtg, period))
+        elif kind == "z2" and geom:
             out.append(Z2KeySpace(geom))
-        else:
-            if dtg is not None:
-                out.append(XZ3KeySpace(geom, dtg, period))
+        elif kind == "xz3" and geom and dtg:
+            out.append(XZ3KeySpace(geom, dtg, period))
+        elif kind == "xz2" and geom:
             out.append(XZ2KeySpace(geom))
-    out.append(IdKeySpace())
-    for a in ft.attributes:
-        if a.indexed and not a.is_geom:
-            out.append(AttributeKeySpace(a.name, geom))
-    return out
+        elif kind == "s2" and geom:
+            out.append(S2KeySpace(geom))
+        elif kind == "s3" and geom and dtg:
+            out.append(S3KeySpace(geom, dtg, period))
+        elif kind == "id":
+            out.append(IdKeySpace())
+        elif kind == "attr":
+            for a in ft.attributes:
+                if a.indexed and not a.is_geom:
+                    out.append(AttributeKeySpace(a.name, geom))
+    if not any(isinstance(k, IdKeySpace) for k in out):
+        out.append(IdKeySpace())
+    return [k for k in out if k.supports(ft)]

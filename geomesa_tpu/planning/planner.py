@@ -140,8 +140,8 @@ class QueryPlanner:
             # temporal+spatial, spatial, attribute (mirrors the reference's
             # per-index cost multipliers)
             mult = {
-                "id": 0.5, "z3": 1.0, "xz3": 1.0, "z2": 1.5, "xz2": 1.5,
-                "attr": 2.0,
+                "id": 0.5, "z3": 1.0, "xz3": 1.0, "s3": 1.0,
+                "z2": 1.5, "xz2": 1.5, "s2": 1.5, "attr": 2.0,
             }.get(kp.keyspace.kind, 2.0)
             weighted = cost * mult if not kp.disjoint else -1.0
             exp.line(f"{kp.keyspace.name}: estimated {cost:.0f} (weighted {weighted:.0f})")

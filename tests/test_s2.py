@@ -1,0 +1,179 @@
+"""S2 curve and S2/S3 index tests (reference S2SFC.scala / S2Index /
+S3Index; cell math validated structurally against the published S2 cell-id
+layout: face tokens, hierarchy, Hilbert locality, coverer soundness)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu.api.dataset import GeoDataset
+from geomesa_tpu.curves import s2
+
+
+class TestS2CellMath:
+    def test_face_cell_tokens(self):
+        # face centers land on the six level-0 cells: tokens 1,3,5,7,9,b
+        centers = [(0, 0), (90, 0), (0, 90), (180, 0), (-90, 0), (0, -90)]
+        toks = []
+        for lon, lat in centers:
+            cid = s2.lnglat_to_id([lon], [lat])[0]
+            toks.append(s2.token(int(s2.parent(cid, 0))))
+        assert toks == ["1", "3", "5", "7", "9", "b"]
+
+    def test_leaf_round_trip(self):
+        rng = np.random.default_rng(1)
+        x = rng.uniform(-180, 180, 500)
+        y = rng.uniform(-89.9, 89.9, 500)
+        ids = s2.lnglat_to_id(x, y)
+        assert (s2.level_of(ids) == 30).all()
+        x2, y2 = s2.id_to_lnglat(ids)
+        dx = np.minimum(np.abs(x2 - x), 360 - np.abs(x2 - x))
+        assert float(np.hypot(dx, y2 - y).max()) < 1e-5
+
+    def test_hierarchy(self):
+        ids = s2.lnglat_to_id([12.34], [56.78])
+        for level in range(30):
+            p = s2.parent(ids, level)
+            assert s2.level_of(p)[0] == level
+            assert s2.contains(p, ids)[0]
+            # parent's range nests inside grandparent's
+            if level:
+                gp = s2.parent(ids, level - 1)
+                assert int(s2.range_min(gp)[0]) <= int(s2.range_min(p)[0])
+                assert int(s2.range_max(p)[0]) <= int(s2.range_max(gp)[0])
+
+    def test_children_partition_parent(self):
+        cid = int(s2.parent(s2.lnglat_to_id([10.0], [20.0]), 5)[0])
+        ch = s2.children(cid)
+        assert len(ch) == 4
+        assert all(s2.level_of([c])[0] == 6 for c in ch)
+        los = sorted(int(s2.range_min(c)) for c in ch)
+        his = sorted(int(s2.range_max(c)) for c in ch)
+        assert los[0] == int(s2.range_min(cid))
+        assert his[-1] == int(s2.range_max(cid))
+        # non-overlapping; the single id between sibling ranges is even
+        # (never a leaf key — leaf ids are odd), so no leaf falls in a gap
+        for a, b in zip(his[:-1], los[1:]):
+            assert b == a + 2
+            assert (a + 1) % 2 == 0
+
+    def test_hilbert_locality(self):
+        a = s2.lnglat_to_id([10.0], [45.0])
+        b = s2.lnglat_to_id([10.0001], [45.0001])
+        common = 0
+        for level in range(30, -1, -1):
+            if int(s2.parent(a, level)[0]) == int(s2.parent(b, level)[0]):
+                common = level
+                break
+        assert common >= 12
+
+    def test_token_round_trip(self):
+        cid = int(s2.lnglat_to_id([5.0], [5.0])[0])
+        assert s2.from_token(s2.token(cid)) == cid
+        p3 = int(s2.parent(np.asarray([cid], np.uint64), 3)[0])
+        assert s2.from_token(s2.token(p3)) == p3
+
+    def test_latitude_validation(self):
+        with pytest.raises(ValueError):
+            s2.S2SFC().index([0.0], [91.0])
+
+
+class TestS2Cover:
+    def test_cover_soundness_random(self):
+        rng = np.random.default_rng(2)
+        sfc = s2.S2SFC(max_cells=64)
+        for _ in range(10):
+            x0 = rng.uniform(-180, 170)
+            y0 = rng.uniform(-90, 80)
+            bbox = (
+                x0, y0,
+                min(x0 + rng.uniform(0.5, 40), 180),
+                min(y0 + rng.uniform(0.5, 40), 90),
+            )
+            px = rng.uniform(bbox[0], bbox[2], 200)
+            py = rng.uniform(bbox[1], bbox[3], 200)
+            pids = s2.lnglat_to_id(px, py)
+            rs = sfc.ranges(*bbox)
+            lo = np.array([r.lo for r in rs], np.uint64)
+            hi = np.array([r.hi for r in rs], np.uint64)
+            idx = np.searchsorted(lo, pids, side="right") - 1
+            ok = (idx >= 0) & (pids <= hi[np.clip(idx, 0, len(hi) - 1)])
+            assert ok.all(), f"under-cover for {bbox}"
+
+    def test_cover_selectivity(self):
+        sfc = s2.S2SFC(max_cells=64)
+        rs = sfc.ranges(0, 40, 10, 50)
+        span = sum(int(r.hi) - int(r.lo) + 1 for r in rs)
+        assert span / float(6 << 60) < 0.05  # small fraction of the keyspace
+
+    def test_polar_and_antimeridian(self):
+        sfc = s2.S2SFC(max_cells=64)
+        pole = int(s2.lnglat_to_id([3.0], [89.9])[0])
+        assert any(r.lo <= pole <= r.hi for r in sfc.ranges(-10, 85, 10, 90))
+        am = int(s2.lnglat_to_id([179.99], [10.0])[0])
+        assert any(r.lo <= am <= r.hi for r in sfc.ranges(179, 5, 180, 15))
+
+
+class TestS2S3Indices:
+    def _ds(self, indices: str):
+        ds = GeoDataset(n_shards=2, prefer_device=False)
+        ds.create_schema(
+            "t", f"name:String,dtg:Date,*geom:Point;geomesa.indices='{indices}'"
+        )
+        n = 500
+        rng = np.random.default_rng(3)
+        ds.insert("t", {
+            "name": [f"n{i % 5}" for i in range(n)],
+            "dtg": (np.datetime64("2024-03-01", "ms")
+                    + rng.integers(0, 30 * 86_400_000, n)),
+            "geom": [(float(x), float(y)) for x, y in
+                     zip(rng.uniform(-60, 60, n), rng.uniform(-60, 60, n))],
+        })
+        return ds
+
+    def test_s2_index_query(self):
+        ds = self._ds("s2,id")
+        st = ds._store("t")
+        assert [k.name for k in st.keyspaces] == ["s2", "id"]
+        got = ds.count("t", "BBOX(geom, -10, -10, 10, 10)")
+        # oracle: host recount
+        fc = ds.query("t")
+        x = fc.batch.columns["geom__x"]
+        y = fc.batch.columns["geom__y"]
+        expect = int(((x >= -10) & (x <= 10) & (y >= -10) & (y <= 10)).sum())
+        assert got == expect > 0
+
+    def test_s3_index_query(self):
+        ds = self._ds("s3,id")
+        st = ds._store("t")
+        assert [k.name for k in st.keyspaces] == ["s3", "id"]
+        q = ("BBOX(geom, -10, -10, 10, 10) AND "
+             "dtg DURING 2024-03-05T00:00:00Z/2024-03-12T00:00:00Z")
+        got = ds.count("t", q)
+        fc = ds.query("t")
+        x = fc.batch.columns["geom__x"]
+        y = fc.batch.columns["geom__y"]
+        t = fc.batch.columns["dtg"].astype("datetime64[ms]")
+        m = (
+            (x >= -10) & (x <= 10) & (y >= -10) & (y <= 10)
+            & (t >= np.datetime64("2024-03-05"))
+            & (t <= np.datetime64("2024-03-12"))
+        )
+        assert got == int(m.sum()) > 0
+
+    def test_s3_plan_uses_s3(self):
+        ds = self._ds("s3,id")
+        exp = ds.explain(
+            "t",
+            "BBOX(geom, -10, -10, 10, 10) AND "
+            "dtg DURING 2024-03-05T00:00:00Z/2024-03-12T00:00:00Z",
+        )
+        assert "s3" in exp
+
+    def test_explicit_index_list_round_trips_through_save(self, tmp_path):
+        ds = self._ds("s2,id")
+        ds.save(str(tmp_path / "d"))
+        ds2 = GeoDataset.load(str(tmp_path / "d"))
+        assert [k.name for k in ds2._store("t").keyspaces] == ["s2", "id"]
+        assert ds2.count("t", "BBOX(geom, -10, -10, 10, 10)") == ds.count(
+            "t", "BBOX(geom, -10, -10, 10, 10)"
+        )
