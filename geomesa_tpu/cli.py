@@ -254,6 +254,23 @@ def cmd_compact(args):
     print(f"compacted: removed {removed} files")
 
 
+def cmd_serve(args):
+    """Run the Arrow Flight sidecar over a catalog (SURVEY.md §5 comm
+    backend; the coprocessor-endpoint analog)."""
+    from geomesa_tpu.sidecar import GeoFlightServer
+
+    ds = _load(args.catalog)
+    srv = GeoFlightServer(ds, f"grpc+tcp://{args.host}:{args.port}")
+    print(f"geomesa-tpu sidecar listening on grpc+tcp://{args.host}:{srv.port}")
+    try:
+        srv.serve()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if args.persist:
+            _save(ds, args.catalog)
+
+
 def cmd_version(args):
     print(f"geomesa-tpu {__version__}")
 
@@ -356,6 +373,14 @@ def build_parser() -> argparse.ArgumentParser:
     sp = sub.add_parser("compact", help="compact filesystem partitions")
     common(sp)
     sp.set_defaults(fn=cmd_compact)
+
+    sp = sub.add_parser("serve", help="run the Arrow Flight sidecar")
+    common(sp)
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8815)
+    sp.add_argument("--persist", action="store_true",
+                    help="save the catalog on shutdown")
+    sp.set_defaults(fn=cmd_serve)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

@@ -1,0 +1,16 @@
+"""Arrow Flight sidecar: the RPC boundary of the framework.
+
+The reference ships aggregation programs to remote compute as a serialized
+option map over a custom protobuf coprocessor protocol and streams partial
+results back (HBase: GeoMesaCoprocessor.scala:29-70 client loop +
+CoprocessorScan.scala:35 server; SURVEY.md §5 "distributed communication
+backend"). Here that role is played by Arrow Flight gRPC: tickets/actions
+carry a JSON option map, results stream back as Arrow record batches —
+the transport a JVM/GeoTools front-end (or any Arrow client) uses to reach
+the TPU-resident dataset.
+"""
+
+from geomesa_tpu.sidecar.service import GeoFlightServer, serve
+from geomesa_tpu.sidecar.client import GeoFlightClient
+
+__all__ = ["GeoFlightServer", "GeoFlightClient", "serve"]

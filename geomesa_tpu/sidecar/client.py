@@ -1,0 +1,136 @@
+"""Flight client for the sidecar: a GeoDataset-shaped remote API.
+
+The thin-adapter role of the reference's client-side coprocessor wrapper
+(GeoMesaCoprocessor.scala:29 — serialize options, stream results, merge):
+callers get the same operations a local GeoDataset offers, executed in the
+sidecar process, with Arrow as the interchange.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.flight as fl
+
+from geomesa_tpu.stats import sketches as sk
+
+
+class GeoFlightClient:
+    def __init__(self, location: str, **kw):
+        self._client = fl.FlightClient(location, **kw)
+
+    def close(self):
+        self._client.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- actions -----------------------------------------------------------
+    def _action(self, kind: str, body: Optional[Dict] = None) -> Dict:
+        action = fl.Action(kind, json.dumps(body or {}).encode())
+        results = list(self._client.do_action(action))
+        return json.loads(results[0].body.to_pybytes().decode()) if results else {}
+
+    def create_schema(self, name: str, spec: str) -> str:
+        return self._action("create-schema", {"name": name, "spec": spec})["created"]
+
+    def delete_schema(self, name: str):
+        self._action("delete-schema", {"name": name})
+
+    def list_schemas(self) -> List[str]:
+        return self._action("list-schemas")["schemas"]
+
+    def describe(self, name: str) -> str:
+        return self._action("describe", {"name": name})["describe"]
+
+    def explain(self, name: str, ecql: str = "INCLUDE") -> str:
+        return self._action("explain", {"name": name, "ecql": ecql})["explain"]
+
+    def count(self, name: str, ecql: str = "INCLUDE", exact: bool = True,
+              auths: Optional[Sequence[str]] = None) -> int:
+        body = {"name": name, "ecql": ecql, "exact": exact}
+        if auths is not None:
+            body["auths"] = list(auths)
+        return self._action("count", body)["count"]
+
+    def audit(self, n: int = 100) -> List[Dict]:
+        return self._action("audit", {"n": n})["events"]
+
+    def metrics(self) -> Dict:
+        return self._action("metrics")["metrics"]
+
+    # -- reads -------------------------------------------------------------
+    def _get(self, opts: Dict) -> pa.Table:
+        ticket = fl.Ticket(json.dumps(opts).encode())
+        return self._client.do_get(ticket).read_all()
+
+    def query(self, name: str, ecql: str = "INCLUDE", properties=None,
+              max_features=None, sampling=None,
+              auths: Optional[Sequence[str]] = None) -> pa.Table:
+        opts = {"op": "query", "schema": name, "ecql": ecql}
+        if properties is not None:
+            opts["properties"] = list(properties)
+        if max_features is not None:
+            opts["max_features"] = max_features
+        if sampling is not None:
+            opts["sampling"] = sampling
+        if auths is not None:
+            opts["auths"] = list(auths)
+        return self._get(opts)
+
+    def density(self, name: str, ecql: str = "INCLUDE", bbox=None,
+                width: int = 256, height: int = 256,
+                weight: Optional[str] = None,
+                auths: Optional[Sequence[str]] = None) -> np.ndarray:
+        opts = {
+            "op": "density", "schema": name, "ecql": ecql,
+            "width": width, "height": height,
+        }
+        if bbox is not None:
+            opts["bbox"] = list(bbox)
+        if weight is not None:
+            opts["weight"] = weight
+        if auths is not None:
+            opts["auths"] = list(auths)
+        t = self._get(opts)
+        grid = np.zeros((height, width), np.float32)
+        if t.num_rows:
+            grid[t["row"].to_numpy(), t["col"].to_numpy()] = t["weight"].to_numpy()
+        return grid
+
+    def stats(self, name: str, stat_spec: str, ecql: str = "INCLUDE",
+              auths: Optional[Sequence[str]] = None) -> sk.Stat:
+        opts = {"op": "stats", "schema": name, "ecql": ecql, "stat": stat_spec}
+        if auths is not None:
+            opts["auths"] = list(auths)
+        t = self._get(opts)
+        return sk.Stat.from_json(t["value"][0].as_py())
+
+    def export_bin(self, name: str, ecql: str = "INCLUDE",
+                   track: Optional[str] = None,
+                   label: Optional[str] = None) -> bytes:
+        opts = {"op": "bin", "schema": name, "ecql": ecql}
+        if track:
+            opts["track"] = track
+        if label:
+            opts["label"] = label
+        t = self._get(opts)
+        return t["bin"][0].as_py() if t.num_rows else b""
+
+    # -- writes ------------------------------------------------------------
+    def insert_arrow(self, name: str, table: "pa.Table | pa.RecordBatch"):
+        if isinstance(table, pa.RecordBatch):
+            table = pa.Table.from_batches([table])
+        descriptor = fl.FlightDescriptor.for_command(
+            json.dumps({"schema": name}).encode()
+        )
+        writer, _ = self._client.do_put(descriptor, table.schema)
+        writer.write_table(table)
+        writer.close()

@@ -1,0 +1,192 @@
+"""Arrow Flight service over a GeoDataset.
+
+Protocol (coprocessor option-map analog, reference
+GeoMesaCoprocessor.scala:44-61 serialized scan options):
+
+* ``do_get(ticket)`` — ticket bytes are a JSON object:
+    {"op": "query",   "schema": s, "ecql": e, "properties": [...],
+     "auths": [...], "max_features": n, "sampling": n}
+    {"op": "density", "schema": s, "ecql": e, "bbox": [xmin,ymin,xmax,ymax],
+     "width": w, "height": h, "weight": attr}   -> sparse (row,col,weight)
+    {"op": "stats",   "schema": s, "ecql": e, "stat": "MinMax(a);..."}
+    {"op": "bin",     "schema": s, "ecql": e, "track": attr, "label": attr}
+* ``do_put`` — ingest an Arrow stream into the descriptor's schema.
+* ``do_action`` — JSON body actions: create-schema, delete-schema,
+  describe, explain, count, list-schemas, audit, metrics.
+* ``list_flights`` — one FlightInfo per schema.
+
+Every response that is not a feature stream is a single record batch whose
+schema documents its payload (density = row/col/weight like the reference's
+sparse DensityScan encoding, DensityScan.scala:95-106).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.flight as fl
+
+from geomesa_tpu.api.dataset import GeoDataset, Query
+
+
+def _query_from(opts: Dict) -> Query:
+    return Query(
+        ecql=opts.get("ecql", "INCLUDE"),
+        max_features=opts.get("max_features"),
+        properties=opts.get("properties"),
+        sampling=opts.get("sampling"),
+        index=opts.get("index"),
+        auths=opts.get("auths"),
+        sort_by=[tuple(s) for s in opts["sort_by"]] if opts.get("sort_by") else None,
+    )
+
+
+class GeoFlightServer(fl.FlightServerBase):
+    def __init__(self, dataset: Optional[GeoDataset] = None,
+                 location: str = "grpc+tcp://127.0.0.1:0", **kw):
+        super().__init__(location, **kw)
+        self.dataset = dataset if dataset is not None else GeoDataset()
+        self._lock = threading.Lock()
+
+    # -- reads -------------------------------------------------------------
+    def do_get(self, context, ticket: fl.Ticket) -> fl.RecordBatchStream:
+        opts = json.loads(ticket.ticket.decode())
+        op = opts.get("op", "query")
+        name = opts["schema"]
+        ds = self.dataset
+        if op == "query":
+            table = ds.to_arrow(name, _query_from(opts))
+            return fl.RecordBatchStream(table)
+        if op == "density":
+            q = _query_from(opts)
+            grid = ds.density(
+                name, q, bbox=opts.get("bbox"),
+                width=opts.get("width", 256), height=opts.get("height", 256),
+                weight=opts.get("weight"),
+            )
+            rows, cols = np.nonzero(grid)
+            batch = pa.record_batch(
+                [
+                    pa.array(rows.astype(np.int32)),
+                    pa.array(cols.astype(np.int32)),
+                    pa.array(grid[rows, cols].astype(np.float32)),
+                ],
+                names=["row", "col", "weight"],
+            )
+            return fl.RecordBatchStream(pa.Table.from_batches([batch]))
+        if op == "stats":
+            q = _query_from(opts)
+            stat = ds.stats(name, opts["stat"], q)
+            batch = pa.record_batch(
+                [pa.array([opts["stat"]]), pa.array([stat.to_json()])],
+                names=["stat", "value"],
+            )
+            return fl.RecordBatchStream(pa.Table.from_batches([batch]))
+        if op == "bin":
+            q = _query_from(opts)
+            blob = ds.export_bin(
+                name, q, track=opts.get("track"), label=opts.get("label"),
+                sort=opts.get("sort", True),
+            )
+            batch = pa.record_batch([pa.array([blob], pa.binary())], names=["bin"])
+            return fl.RecordBatchStream(pa.Table.from_batches([batch]))
+        raise fl.FlightServerError(f"unknown op {op!r}")
+
+    # -- writes ------------------------------------------------------------
+    def do_put(self, context, descriptor, reader, writer):
+        opts = json.loads(descriptor.command.decode()) if descriptor.command else {}
+        name = opts.get("schema")
+        if not name and descriptor.path:
+            name = descriptor.path[0].decode()
+        if not name:
+            raise fl.FlightServerError("do_put needs a schema name")
+        table = reader.read_all()
+        with self._lock:
+            n = self.dataset.ingest_arrow(name, table)
+            self.dataset.flush(name)
+        # respond with the ingested count as app metadata
+        writer  # (no app-metadata channel needed; count via describe/count)
+        return n
+
+    # -- actions -----------------------------------------------------------
+    def do_action(self, context, action: fl.Action) -> Iterator[fl.Result]:
+        body = json.loads(action.body.to_pybytes().decode()) if action.body else {}
+        ds = self.dataset
+        kind = action.type
+
+        def ok(payload) -> Iterator[fl.Result]:
+            yield fl.Result(json.dumps(payload).encode())
+
+        if kind == "create-schema":
+            with self._lock:
+                ft = ds.create_schema(body["name"], body["spec"])
+            return ok({"created": ft.name, "spec": ft.spec()})
+        if kind == "delete-schema":
+            with self._lock:
+                ds.delete_schema(body["name"])
+            return ok({"deleted": body["name"]})
+        if kind == "list-schemas":
+            return ok({"schemas": ds.list_schemas()})
+        if kind == "describe":
+            return ok({"describe": ds.describe(body["name"])})
+        if kind == "explain":
+            return ok({"explain": ds.explain(body["name"], _query_from(body))})
+        if kind == "count":
+            n = ds.count(body["name"], _query_from(body),
+                         exact=body.get("exact", True))
+            return ok({"count": int(n)})
+        if kind == "audit":
+            evs = ds.audit.recent(body.get("n", 100))
+            return ok({"events": [json.loads(e.to_json()) for e in evs]})
+        if kind == "metrics":
+            from geomesa_tpu import metrics
+
+            return ok({"metrics": metrics.registry().report()})
+        raise fl.FlightServerError(f"unknown action {kind!r}")
+
+    def list_actions(self, context):
+        return [
+            ("create-schema", "register a feature type: {name, spec}"),
+            ("delete-schema", "drop a feature type: {name}"),
+            ("list-schemas", "type names"),
+            ("describe", "schema details: {name}"),
+            ("explain", "query plan: {name, ecql}"),
+            ("count", "feature count: {name, ecql, exact}"),
+            ("audit", "recent query events: {n}"),
+            ("metrics", "metrics registry snapshot"),
+        ]
+
+    # -- discovery ---------------------------------------------------------
+    def list_flights(self, context, criteria):
+        from geomesa_tpu.io import arrow_io
+
+        for name in self.dataset.list_schemas():
+            ft = self.dataset.get_schema(name)
+            descriptor = fl.FlightDescriptor.for_path(name.encode())
+            ticket = fl.Ticket(json.dumps({"op": "query", "schema": name}).encode())
+            yield fl.FlightInfo(
+                arrow_io.arrow_schema(ft), descriptor,
+                [fl.FlightEndpoint(ticket, [])], -1, -1,
+            )
+
+    def get_flight_info(self, context, descriptor):
+        from geomesa_tpu.io import arrow_io
+
+        name = descriptor.path[0].decode()
+        ft = self.dataset.get_schema(name)
+        ticket = fl.Ticket(json.dumps({"op": "query", "schema": name}).encode())
+        return fl.FlightInfo(
+            arrow_io.arrow_schema(ft), descriptor,
+            [fl.FlightEndpoint(ticket, [])], -1, -1,
+        )
+
+
+def serve(dataset: Optional[GeoDataset] = None, port: int = 8815,
+          host: str = "127.0.0.1") -> GeoFlightServer:
+    """Start a sidecar (blocking ``server.serve()`` is up to the caller;
+    the server is already listening when this returns)."""
+    return GeoFlightServer(dataset, f"grpc+tcp://{host}:{port}")

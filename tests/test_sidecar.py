@@ -1,0 +1,145 @@
+"""Arrow Flight sidecar tests: in-process server + client round trips
+(the coprocessor-protocol analog, SURVEY.md §5 distributed comm backend)."""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from geomesa_tpu.api.dataset import GeoDataset
+from geomesa_tpu.io import bin_format
+from geomesa_tpu.sidecar import GeoFlightClient, GeoFlightServer
+
+SPEC = "name:String:index=true,speed:Float,dtg:Date,*geom:Point"
+
+
+@pytest.fixture()
+def server():
+    srv = GeoFlightServer(GeoDataset(n_shards=2, prefer_device=False))
+    yield srv
+    srv.shutdown()
+
+
+@pytest.fixture()
+def client(server):
+    with GeoFlightClient(f"grpc+tcp://127.0.0.1:{server.port}") as c:
+        yield c
+
+
+def _feature_table(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    ds = GeoDataset(n_shards=1, prefer_device=False)
+    ds.create_schema("tmp", SPEC)
+    ds.insert("tmp", {
+        "name": [f"n{i % 3}" for i in range(n)],
+        "speed": rng.uniform(0, 30, n).astype(np.float32),
+        "dtg": (np.datetime64("2024-05-01", "ms")
+                + rng.integers(0, 20 * 86_400_000, n)),
+        "geom": [(float(x), float(y)) for x, y in
+                 zip(rng.uniform(-20, 20, n), rng.uniform(-20, 20, n))],
+    }, fids=[f"f{i}" for i in range(n)])
+    return ds.to_arrow("tmp")
+
+
+def test_schema_lifecycle(client):
+    client.create_schema("t", SPEC)
+    assert client.list_schemas() == ["t"]
+    assert "name" in client.describe("t")
+    client.delete_schema("t")
+    assert client.list_schemas() == []
+
+
+def test_put_query_roundtrip(client):
+    client.create_schema("t", SPEC)
+    table = _feature_table()
+    client.insert_arrow("t", table)
+    assert client.count("t") == 200
+    got = client.query("t", "BBOX(geom, 0, 0, 20, 20) AND name = 'n1'")
+    assert 0 < got.num_rows < 200
+    names = set(got["name"].to_pylist())
+    assert names == {"n1"}
+    # projection
+    got2 = client.query("t", properties=["speed"])
+    assert "speed" in got2.column_names and "name" not in got2.column_names
+    # limit
+    assert client.query("t", max_features=7).num_rows == 7
+
+
+def test_density_stream(client):
+    client.create_schema("t", SPEC)
+    client.insert_arrow("t", _feature_table())
+    grid = client.density("t", bbox=(-20, -20, 20, 20), width=32, height=32)
+    assert grid.shape == (32, 32)
+    assert grid.sum() == pytest.approx(200)
+
+
+def test_stats_sketch_over_wire(client):
+    client.create_schema("t", SPEC)
+    client.insert_arrow("t", _feature_table())
+    st = client.stats("t", "MinMax(speed)")
+    v = st.value()
+    assert 0 <= v["min"] <= v["max"] <= 30
+    enum = client.stats("t", "Enumeration(name)")
+    assert sum(enum.value().values()) == 200
+
+
+def test_bin_export_over_wire(client):
+    client.create_schema("t", SPEC)
+    client.insert_arrow("t", _feature_table())
+    blob = client.export_bin("t", track="name")
+    assert len(blob) == 200 * bin_format.RECORD.itemsize
+    recs = bin_format.unpack(blob)
+    assert len(recs["lat"]) == 200
+
+
+def test_explain_and_count_estimate(client):
+    client.create_schema("t", SPEC)
+    client.insert_arrow("t", _feature_table())
+    exp = client.explain("t", "BBOX(geom, 0, 0, 10, 10)")
+    assert "Chosen index" in exp
+    est = client.count("t", "BBOX(geom, 0, 0, 10, 10)", exact=False)
+    assert est >= 0
+
+
+def test_visibility_auths_over_wire(server):
+    # visibilities enforced through the ticket's auths
+    ds = server.dataset
+    ds.create_schema("v", "name:String,*geom:Point")
+    ds.insert("v", {"name": ["a", "b"], "geom": [(0.0, 0.0), (1.0, 1.0)]},
+              visibilities=["secret", ""])
+    with GeoFlightClient(f"grpc+tcp://127.0.0.1:{server.port}") as c:
+        assert c.count("v") == 2
+        assert c.count("v", auths=[]) == 1
+        assert c.query("v", auths=[]).num_rows == 1
+        assert c.count("v", auths=["secret"]) == 2
+
+
+def test_audit_and_metrics_actions(client):
+    client.create_schema("t", SPEC)
+    client.insert_arrow("t", _feature_table())
+    client.count("t")
+    events = client.audit()
+    assert events and events[-1]["type_name"] == "t"
+    m = client.metrics()
+    assert m.get("ingest.features", 0) >= 200
+
+
+def test_flight_info_discovery(server, client):
+    client.create_schema("t", SPEC)
+    infos = list(server.dataset and client._client.list_flights())
+    assert len(infos) == 1
+    # the advertised ticket streams the full schema
+    client.insert_arrow("t", _feature_table())
+    table = client._client.do_get(infos[0].endpoints[0].ticket).read_all()
+    assert table.num_rows == 200
+
+
+def test_unknown_op_errors(client):
+    client.create_schema("t", SPEC)
+    import json
+
+    import pyarrow.flight as fl
+
+    with pytest.raises(fl.FlightServerError):
+        client._client.do_get(
+            fl.Ticket(json.dumps({"op": "nope", "schema": "t"}).encode())
+        ).read_all()
