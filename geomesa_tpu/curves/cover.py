@@ -42,6 +42,23 @@ def _merge(ranges: List[ZRange]) -> List[ZRange]:
     return out
 
 
+def zcover_fast(
+    lo: Sequence[int],
+    hi: Sequence[int],
+    bits: int,
+    dims: int,
+    max_ranges: int = 2000,
+) -> List[ZRange]:
+    """Cover via the native runtime when built, else the Python BFS below.
+
+    Semantics are identical (parity enforced by tests/test_native.py); the
+    native path exists because cover is the one per-query host loop whose cost
+    grows with range budget (SURVEY.md §3.1 'pathological polygons')."""
+    from geomesa_tpu import native
+
+    return native.zcover(lo, hi, bits, dims, max_ranges)
+
+
 def zcover(
     lo: Sequence[int],
     hi: Sequence[int],

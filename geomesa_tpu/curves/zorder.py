@@ -30,7 +30,7 @@ import numpy as np
 
 from geomesa_tpu import config
 from geomesa_tpu.curves.binned_time import BinnedTime, TimePeriod
-from geomesa_tpu.curves.cover import zcover, ZRange
+from geomesa_tpu.curves.cover import zcover_fast, ZRange
 
 
 # ---------------------------------------------------------------------------
@@ -216,7 +216,7 @@ class Z2SFC:
             max_ranges = config.SCAN_RANGES_TARGET.to_int()
         lo = (int(self.lon.normalize(xmin)), int(self.lat.normalize(ymin)))
         hi = (int(self.lon.normalize(xmax)), int(self.lat.normalize(ymax)))
-        return zcover(lo, hi, bits=self.BITS, dims=2, max_ranges=max_ranges)
+        return zcover_fast(lo, hi, bits=self.BITS, dims=2, max_ranges=max_ranges)
 
 
 class Z3SFC:
@@ -268,4 +268,4 @@ class Z3SFC:
             int(self.lat.normalize(ybounds[1])),
             int(self.time.normalize(tbounds_ms[1])),
         )
-        return zcover(lo, hi, bits=self.BITS, dims=3, max_ranges=max_ranges)
+        return zcover_fast(lo, hi, bits=self.BITS, dims=3, max_ranges=max_ranges)

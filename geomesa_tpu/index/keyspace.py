@@ -165,22 +165,12 @@ class Z3KeySpace(KeySpace):
             s = np.searchsorted(bins_col, bins[0], side="left")
             e = np.searchsorted(bins_col, bins[-1], side="right")
             return np.asarray([s], np.int64), np.asarray([e], np.int64)
-        starts, ends = [], []
-        for b in bins.tolist():
-            s = np.searchsorted(bins_col, b, side="left")
-            e = np.searchsorted(bins_col, b, side="right")
-            if e <= s:
-                continue
-            # z window within the bin segment
-            seg = z_col[s:e]
-            s2 = s + np.searchsorted(seg, np.uint64(zlo), side="left")
-            e2 = s + np.searchsorted(seg, np.uint64(zhi), side="right")
-            if e2 > s2:
-                starts.append(s2)
-                ends.append(e2)
-        if not starts:
+        from geomesa_tpu import native
+
+        starts, ends = native.bin_windows(bins_col, z_col, bins, zlo, zhi)
+        if not len(starts):
             return np.zeros(1, np.int64), np.zeros(1, np.int64)
-        return np.asarray(starts, np.int64), np.asarray(ends, np.int64)
+        return starts, ends
 
 
 class Z2KeySpace(KeySpace):

@@ -42,7 +42,9 @@ def java_string_hash(s: str) -> int:
 
 
 def _hash_values(vals: Sequence) -> np.ndarray:
-    return np.array([java_string_hash(str(v)) for v in vals], np.int32)
+    from geomesa_tpu import native
+
+    return native.java_hash(vals)
 
 
 def label_to_i64(vals: Sequence) -> np.ndarray:

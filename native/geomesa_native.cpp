@@ -1,0 +1,265 @@
+// geomesa_tpu native runtime — host-side hot-path kernels.
+//
+// The TPU compute path is JAX/XLA/Pallas; this library covers the *host*
+// runtime work that sits around it (the role the reference delegates to the
+// JVM/sfcurve: geomesa-z3/pom.xml:21 bit-interleave, Z3SFC.scala:54 zranges,
+// BinaryOutputEncoder.scala:36 track hashing, and the searchsorted window
+// resolution of the scan path). Exposed with a C ABI and loaded from Python
+// via ctypes (geomesa_tpu/native.py); every entry point has a NumPy fallback
+// so the framework runs without a toolchain.
+//
+// Semantics are bit-exact mirrors of the Python implementations in
+// geomesa_tpu/curves/zorder.py, curves/cover.py, io/bin_format.py — parity is
+// enforced by tests/test_native.py.
+
+#include <cstdint>
+#include <cstring>
+#include <algorithm>
+#include <deque>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Morton bit spread / gather (zorder.py:_split2/_combine2/_split3/_combine3)
+// ---------------------------------------------------------------------------
+
+inline uint64_t split2(uint64_t x) {
+  x &= 0x7FFFFFFFull;
+  x = (x | (x << 16)) & 0x0000FFFF0000FFFFull;
+  x = (x | (x << 8)) & 0x00FF00FF00FF00FFull;
+  x = (x | (x << 4)) & 0x0F0F0F0F0F0F0F0Full;
+  x = (x | (x << 2)) & 0x3333333333333333ull;
+  x = (x | (x << 1)) & 0x5555555555555555ull;
+  return x;
+}
+
+inline uint64_t combine2(uint64_t z) {
+  z &= 0x5555555555555555ull;
+  z = (z | (z >> 1)) & 0x3333333333333333ull;
+  z = (z | (z >> 2)) & 0x0F0F0F0F0F0F0F0Full;
+  z = (z | (z >> 4)) & 0x00FF00FF00FF00FFull;
+  z = (z | (z >> 8)) & 0x0000FFFF0000FFFFull;
+  z = (z | (z >> 16)) & 0x00000000FFFFFFFFull;
+  return z;
+}
+
+inline uint64_t split3(uint64_t x) {
+  x &= 0x1FFFFFull;
+  x = (x | (x << 32)) & 0x1F00000000FFFFull;
+  x = (x | (x << 16)) & 0x1F0000FF0000FFull;
+  x = (x | (x << 8)) & 0x100F00F00F00F00Full;
+  x = (x | (x << 4)) & 0x10C30C30C30C30C3ull;
+  x = (x | (x << 2)) & 0x1249249249249249ull;
+  return x;
+}
+
+inline uint64_t combine3(uint64_t z) {
+  z &= 0x1249249249249249ull;
+  z = (z | (z >> 2)) & 0x10C30C30C30C30C3ull;
+  z = (z | (z >> 4)) & 0x100F00F00F00F00Full;
+  z = (z | (z >> 8)) & 0x1F0000FF0000FFull;
+  z = (z | (z >> 16)) & 0x1F00000000FFFFull;
+  z = (z | (z >> 32)) & 0x1FFFFFull;
+  return z;
+}
+
+}  // namespace
+
+extern "C" {
+
+void gm_interleave2(const uint64_t* x, const uint64_t* y, uint64_t* out,
+                    int64_t n) {
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = (split2(x[i]) << 1) | split2(y[i]);
+}
+
+void gm_deinterleave2(const uint64_t* z, uint64_t* x, uint64_t* y, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    x[i] = combine2(z[i] >> 1);
+    y[i] = combine2(z[i]);
+  }
+}
+
+void gm_interleave3(const uint64_t* x, const uint64_t* y, const uint64_t* t,
+                    uint64_t* out, int64_t n) {
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = (split3(x[i]) << 2) | (split3(y[i]) << 1) | split3(t[i]);
+}
+
+void gm_deinterleave3(const uint64_t* z, uint64_t* x, uint64_t* y, uint64_t* t,
+                      int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    x[i] = combine3(z[i] >> 2);
+    y[i] = combine3(z[i] >> 1);
+    t[i] = combine3(z[i]);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Z-range cover (curves/cover.py:zcover — identical BFS + budget + merge)
+// ---------------------------------------------------------------------------
+
+int64_t gm_zcover(const uint64_t* qlo, const uint64_t* qhi, int32_t bits,
+                  int32_t dims, int64_t max_ranges, uint64_t* out_lo,
+                  uint64_t* out_hi, int64_t cap) {
+  if (dims < 1 || dims > 3 || bits < 1 || bits * dims > 63) return -2;
+  const int d = dims;
+  for (int k = 0; k < d; ++k)
+    if (qlo[k] > qhi[k]) return -2;
+
+  struct Cell {
+    uint64_t zmin;
+    int32_t level;
+    uint64_t mins[3];
+    uint64_t maxs[3];
+  };
+
+  const uint64_t full = (1ull << bits) - 1;
+  std::deque<Cell> frontier;
+  {
+    Cell root{};
+    root.zmin = 0;
+    root.level = 0;
+    for (int k = 0; k < d; ++k) {
+      root.mins[k] = 0;
+      root.maxs[k] = full;
+    }
+    frontier.push_back(root);
+  }
+  std::vector<std::pair<uint64_t, uint64_t>> out;
+
+  auto cell_span = [&](int32_t level) -> uint64_t {
+    return (1ull << (uint64_t)(d * (bits - level))) - 1;
+  };
+  auto disjoint = [&](const uint64_t* mins, const uint64_t* maxs) {
+    for (int k = 0; k < d; ++k)
+      if (maxs[k] < qlo[k] || mins[k] > qhi[k]) return true;
+    return false;
+  };
+
+  while (!frontier.empty()) {
+    Cell c = frontier.front();
+    frontier.pop_front();
+    if (disjoint(c.mins, c.maxs)) continue;
+    bool contained = true;
+    for (int k = 0; k < d; ++k)
+      if (!(qlo[k] <= c.mins[k] && c.maxs[k] <= qhi[k])) {
+        contained = false;
+        break;
+      }
+    if (contained) {
+      out.emplace_back(c.zmin, c.zmin + cell_span(c.level));
+      continue;
+    }
+    if (c.level == bits) {
+      out.emplace_back(c.zmin, c.zmin);
+      continue;
+    }
+    if ((int64_t)(out.size() + frontier.size() + (1u << d)) > max_ranges) {
+      out.emplace_back(c.zmin, c.zmin + cell_span(c.level));
+      while (!frontier.empty()) {
+        Cell f = frontier.front();
+        frontier.pop_front();
+        if (disjoint(f.mins, f.maxs)) continue;
+        out.emplace_back(f.zmin, f.zmin + cell_span(f.level));
+      }
+      break;
+    }
+    const int b = bits - 1 - c.level;
+    const uint64_t half = 1ull << b;
+    const int group_shift = d * b;
+    for (uint32_t combo = 0; combo < (1u << d); ++combo) {
+      Cell child{};
+      child.level = c.level + 1;
+      uint64_t zadd = 0;
+      for (int k = 0; k < d; ++k) {
+        const uint32_t bit = (combo >> (d - 1 - k)) & 1u;
+        if (bit) {
+          child.mins[k] = c.mins[k] + half;
+          child.maxs[k] = c.maxs[k];
+          zadd |= 1ull << (group_shift + (d - 1 - k));
+        } else {
+          child.mins[k] = c.mins[k];
+          child.maxs[k] = c.maxs[k] - half;
+        }
+      }
+      child.zmin = c.zmin + zadd;
+      frontier.push_back(child);
+    }
+  }
+
+  // merge adjacent/overlapping (cover.py:_merge)
+  std::sort(out.begin(), out.end());
+  int64_t m = 0;
+  for (size_t i = 0; i < out.size(); ++i) {
+    if (m > 0 && out[i].first <= out_hi[m - 1] + 1) {
+      if (out[i].second > out_hi[m - 1]) out_hi[m - 1] = out[i].second;
+    } else {
+      if (m >= cap) return -1;
+      out_lo[m] = out[i].first;
+      out_hi[m] = out[i].second;
+      ++m;
+    }
+  }
+  return m;
+}
+
+// ---------------------------------------------------------------------------
+// Java String.hashCode over UTF-16 code units (io/bin_format.py)
+// ---------------------------------------------------------------------------
+
+void gm_java_hash_utf16(const uint16_t* units, const int64_t* offsets,
+                        int64_t n, int32_t* out) {
+  for (int64_t i = 0; i < n; ++i) {
+    uint32_t h = 0;
+    for (int64_t j = offsets[i]; j < offsets[i + 1]; ++j)
+      h = h * 31u + units[j];
+    out[i] = (int32_t)h;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Batched searchsorted window resolution (the "scan" of the slice model)
+// ---------------------------------------------------------------------------
+
+// Per-range [lo, hi] windows over one sorted u64 key column:
+// start = lower_bound(lo), end = upper_bound(hi).
+void gm_windows_u64(const uint64_t* keys, int64_t n, const uint64_t* lo,
+                    const uint64_t* hi, int64_t k, int64_t* starts,
+                    int64_t* ends) {
+  for (int64_t i = 0; i < k; ++i) {
+    starts[i] = std::lower_bound(keys, keys + n, lo[i]) - keys;
+    ends[i] = std::upper_bound(keys, keys + n, hi[i]) - keys;
+  }
+}
+
+// Z3-style windows: rows sorted by (bin, z); for each requested bin emit the
+// [zlo, zhi] window inside that bin's segment. Returns number of non-empty
+// windows (mirrors Z3KeySpace.resolve_windows inner loop).
+int64_t gm_bin_windows(const int32_t* bins_col, const uint64_t* z_col,
+                       int64_t n, const int32_t* bins, int64_t nbins,
+                       uint64_t zlo, uint64_t zhi, int64_t* starts,
+                       int64_t* ends) {
+  int64_t m = 0;
+  for (int64_t i = 0; i < nbins; ++i) {
+    const int32_t b = bins[i];
+    const int64_t s = std::lower_bound(bins_col, bins_col + n, b) - bins_col;
+    const int64_t e = std::upper_bound(bins_col, bins_col + n, b) - bins_col;
+    if (e <= s) continue;
+    const int64_t s2 =
+        s + (std::lower_bound(z_col + s, z_col + e, zlo) - (z_col + s));
+    const int64_t e2 =
+        s + (std::upper_bound(z_col + s, z_col + e, zhi) - (z_col + s));
+    if (e2 > s2) {
+      starts[m] = s2;
+      ends[m] = e2;
+      ++m;
+    }
+  }
+  return m;
+}
+
+int32_t gm_abi_version() { return 1; }
+
+}  // extern "C"
