@@ -67,27 +67,32 @@ def _pip_fn(g: geo.Geometry, xcol: str, ycol: str):
 
         return rect
 
-    per_poly = []
-    for p in polys:
-        rings = [np.asarray(geo._close_ring(p.shell), np.float64)] + [
-            np.asarray(geo._close_ring(h), np.float64) for h in p.holes
-        ]
-        x1 = np.concatenate([r[:-1, 0] for r in rings])
-        y1 = np.concatenate([r[:-1, 1] for r in rings])
-        x2 = np.concatenate([r[1:, 0] for r in rings])
-        y2 = np.concatenate([r[1:, 1] for r in rings])
-        dy = np.where(y2 - y1 == 0.0, 1.0, y2 - y1)
-        per_poly.append((x1, y1, x2, y2, (x2 - x1) / dy))
+    from geomesa_tpu.kernels import pallas_kernels as pk
+
+    tables = [pk.polygon_edge_tables(p) for p in polys]
+    pallas_ok = all(pk.edges_fit(packed.shape[1]) for _, packed in tables)
 
     def pip(cols, xp):
         x = cols[xcol]
         y = cols[ycol]
+        if xp is not np and pallas_ok and pk.use_pallas():
+            # TPU: edge table pinned in VMEM, point blocks streamed through
+            # the VPU — the [block, E] intermediate never touches HBM
+            out = None
+            for _, packed in tables:
+                inside = pk.pip_mask(x, y, packed)
+                out = inside if out is None else (out | inside)
+            return out
+        # backend-generic broadcast path: trailing-axis broadcast handles
+        # 1-D host shards and [S, L] device layouts alike
         out = None
-        for (x1, y1, x2, y2, slope) in per_poly:
-            yb = y[:, None]
-            cond = (y1[None, :] > yb) != (y2[None, :] > yb)
-            xint = x1[None, :] + (yb - y1[None, :]) * slope[None, :]
-            crossings = (cond & (x[:, None] < xint)).sum(axis=1)
+        for (x1, y1, x2, y2, slope), packed in tables:
+            if xp is not np:  # device: reuse the f32 rows already packed
+                x1, y1, y2, slope = (xp.asarray(packed[i]) for i in range(4))
+            yb = y[..., None]
+            cond = (y1 > yb) != (y2 > yb)
+            xint = x1 + (yb - y1) * slope
+            crossings = (cond & (x[..., None] < xint)).sum(axis=-1)
             inside = (crossings % 2) == 1
             out = inside if out is None else (out | inside)
         return out

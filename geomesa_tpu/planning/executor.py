@@ -119,7 +119,12 @@ class Executor:
                 if len(cache) >= 64:  # bound compiled-kernel growth
                     cache.clear()
                 cache[full_key] = go
-        return go(dev_cols, setup["starts"], setup["ends"], setup["counts"])
+        from geomesa_tpu.kernels import pallas_kernels as pk
+
+        # trace-time flag: pallas dispatch must not fire under a sharded mesh
+        # (pallas_call has no GSPMD partitioning rule)
+        with pk.sharded_execution(self.mesh is not None):
+            return go(dev_cols, setup["starts"], setup["ends"], setup["counts"])
 
     def _sharding(self):
         if self.mesh is None:
