@@ -107,6 +107,7 @@ class GeoDataset:
         self.auths = list(auths) if auths is not None else None
         self.audit = AuditWriter()
         self._stores: Dict[str, FeatureStore] = {}
+        self._executors: Dict[str, Executor] = {}
         self.metadata: Dict[str, Dict[str, str]] = {}
 
     # -- schema CRUD (MetadataBackedDataStore analog) ----------------------
@@ -256,7 +257,14 @@ class GeoDataset:
         return str(exp)
 
     def _executor(self, st: FeatureStore) -> Executor:
-        return Executor(st, self.mesh, self.prefer_device)
+        # one executor per store: executors cache NamedSharding objects, and
+        # device_columns keys its upload cache by id(sharding) — a fresh
+        # executor per query would re-upload every column on meshed datasets
+        ex = self._executors.get(st.ft.name)
+        if ex is None or ex.store is not st:
+            ex = Executor(st, self.mesh, self.prefer_device)
+            self._executors[st.ft.name] = ex
+        return ex
 
     # -- reads -------------------------------------------------------------
     def query(self, name: str, query: "str | Query" = "INCLUDE") -> FeatureCollection:

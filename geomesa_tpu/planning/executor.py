@@ -129,16 +129,99 @@ class Executor:
     def _sharding(self):
         if self.mesh is None:
             return None
-        from jax.sharding import NamedSharding, PartitionSpec
+        # cached: device_columns keys its upload cache by id(sharding), so a
+        # fresh NamedSharding per call would re-upload every column per query
+        sh = self.__dict__.get("_sharding_cache")
+        if sh is None:
+            from jax.sharding import NamedSharding, PartitionSpec
 
-        return NamedSharding(self.mesh, PartitionSpec("shard", None))
+            sh = NamedSharding(self.mesh, PartitionSpec("shard", None))
+            self.__dict__["_sharding_cache"] = sh
+        return sh
+
+    # -- bin-space (sequence) parallelism ---------------------------------
+    def _binspace_mesh(self):
+        """The mesh, when it has a 'bin' axis (time-bin sequence axis)."""
+        m = self.mesh
+        if m is not None and "bin" in m.axis_names and "shard" in m.axis_names:
+            return m
+        return None
+
+    def _binspace_run(self, plan: QueryPlan, setup, agg_fn, agg_cols,
+                      cache_key):
+        """Additive aggregate over the 2-D (shard, bin) mesh; None if the
+        layout does not fit (caller falls through to the GSPMD path)."""
+        from geomesa_tpu.parallel import binspace
+
+        mesh = self._binspace_mesh()
+        table = setup["table"]
+        if (
+            mesh is None
+            or plan.hints.sampling  # sampling's running index is global
+            or table.n_shards % mesh.shape["shard"] != 0
+        ):
+            return None
+        import jax
+
+        stream = int(os.environ.get("GEOMESA_BIN_STREAM_CHUNKS", "1"))
+        n_bin = mesh.shape["bin"]
+        starts, ends = binspace.pad_windows(
+            setup["starts"], setup["ends"], n_bin * stream
+        )
+        # cached shardings: device_columns keys its upload cache by
+        # id(sharding) — fresh NamedShardings would re-upload per query
+        sh = self.__dict__.get("_binspace_placements")
+        if sh is None:
+            sh = binspace.placements(mesh)
+            self.__dict__["_binspace_placements"] = sh
+        col_sh, win_sh, cnt_sh = sh
+        names = tuple(dict.fromkeys(list(setup["needed"]) + list(agg_cols)))
+        dev_cols = table.device_columns(names, col_sh)
+        L = setup["L"]
+        token = plan.__dict__.get("cache_token")
+        if token is not None and cache_key is not None:
+            cache = self.store.__dict__.setdefault("_kernel_cache", {})
+            key = ("binspace", cache_key, L, starts.shape[1], stream, token,
+                   plan.index_name, self.store.version)
+        else:  # token-less plan: cache on the plan (pagination, benchmarks)
+            cache = plan.__dict__.setdefault("_kernel_cache", {})
+            key = ("binspace", cache_key, L, starts.shape[1], stream)
+        fn = cache.get(key)
+        if fn is None:
+            fn = binspace.build_bin_parallel(
+                mesh, sorted(dev_cols), L, plan.compiled, agg_fn, stream
+            )
+            if len(cache) >= 64:
+                cache.clear()
+            cache[key] = fn
+        return fn(
+            {k: dev_cols[k] for k in sorted(dev_cols)},
+            jax.device_put(starts.astype(np.int32), win_sh),
+            jax.device_put(ends.astype(np.int32), win_sh),
+            jax.device_put(setup["counts"].astype(np.int32), cnt_sh),
+        )
 
     def _run(self, plan: QueryPlan, agg_fn_dev, agg_fn_host, agg_cols=(),
-             cache_key=None):
+             cache_key=None, additive=False):
         setup = self._scan_setup(plan, agg_cols)
         if setup is None:
             return None
         if setup["use_device"]:
+            if additive:
+                try:
+                    out = self._binspace_run(
+                        plan, setup, agg_fn_dev, agg_cols, cache_key
+                    )
+                    if out is not None:
+                        return out
+                except Exception as e:
+                    if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
+                        raise
+                    # binspace-specific failure: the 1-D GSPMD device path
+                    # below is still viable — don't drop to the host runner
+                    logging.getLogger(__name__).warning(
+                        "binspace scan failed, trying GSPMD path: %r", e
+                    )
             try:
                 return self._device_mask_and_agg(
                     plan, setup, agg_fn_dev, agg_cols, cache_key
@@ -172,6 +255,7 @@ class Executor:
             lambda cols, m, xp: m.sum(),
             lambda cols, m, xp: m.sum(),
             cache_key=("count",),
+            additive=True,
         )
         return 0 if out is None else int(out)
 
@@ -214,6 +298,7 @@ class Executor:
         out = self._run(
             plan, agg, agg, agg_cols,
             cache_key=("density", tuple(bbox), width, height, weight),
+            additive=True,
         )
         return (
             np.zeros((height, width), np.float32) if out is None else np.asarray(out)
