@@ -21,12 +21,12 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from geomesa_tpu import metrics, security
+from geomesa_tpu import config, metrics, security
 from geomesa_tpu.audit import AuditWriter
 from geomesa_tpu.filter import ir, parse_ecql
 from geomesa_tpu.filter.compile import CompiledFilter
 from geomesa_tpu.index.store import FeatureStore
-from geomesa_tpu.planning.executor import Executor
+from geomesa_tpu.planning.executor import Executor, query_deadline
 from geomesa_tpu.planning.explain import Explainer
 from geomesa_tpu.planning.planner import QueryHints, QueryPlanner
 from geomesa_tpu.schema.columns import ColumnBatch, DictionaryEncoder, decode_batch
@@ -177,6 +177,79 @@ class GeoDataset:
         self.flush(name)
         return ctx
 
+    def update_schema(self, name: str, add_spec: str) -> FeatureType:
+        """Add attributes to an existing schema, keeping data (the reference's
+        ``updateSchema`` supports append-only attribute changes; GeoMesaData
+        Store.scala:288-336 validates transitions the same way).
+
+        Existing columns — including visibility labels and derived geometry/
+        time columns — are carried over verbatim. Added columns are filled
+        with this layout's null representation: string -> null code (-1),
+        float -> NaN, int/long -> 0, bool -> False, date -> epoch 0 (the
+        fixed-width columnar model has no validity bitmap for those)."""
+        from geomesa_tpu.curves.binned_time import BinnedTime
+        from geomesa_tpu.schema.columns import DictionaryEncoder
+
+        st = self._store(name)
+        st.flush()
+        old = st.ft
+        new_ft = FeatureType.from_spec(name, old.spec() + "," + add_spec)
+        added = [a for a in new_ft.attributes if not old.has(a.name)]
+        for a in added:
+            if a.is_geom:
+                raise ValueError("cannot add geometry attributes to a schema")
+        new_store = FeatureStore(new_ft, self.n_shards)
+        # copy dictionaries (fresh encoders so the old store stays untouched)
+        new_store.dicts = {
+            k: DictionaryEncoder(list(d.values)) for k, d in st.dicts.items()
+        }
+        if st._all is not None and st._all.n:
+            n = st._all.n
+            cols = {k: v.copy() for k, v in st._all.columns.items()}
+            for a in added:
+                if a.type == "string":
+                    cols[a.name] = np.full(n, -1, np.int32)
+                    new_store.dicts.setdefault(a.name, DictionaryEncoder())
+                elif a.type == "date":
+                    cols[a.name] = np.zeros(n, np.int64)
+                    bt = BinnedTime(new_ft.time_period)
+                    b, off = bt.to_scaled(cols[a.name])
+                    cols[a.name + "__bin"] = b
+                    cols[a.name + "__off"] = off
+                elif a.type == "bool":
+                    cols[a.name] = np.zeros(n, bool)
+                elif a.type in ("float", "double"):
+                    cols[a.name] = np.full(n, np.nan, np.dtype(a.type))
+                else:
+                    cols[a.name] = np.zeros(n, np.dtype(a.type))
+            from geomesa_tpu.schema.columns import ColumnBatch
+
+            new_store._buffer = [ColumnBatch(cols, n)]
+            new_store.flush()
+        self._stores[name] = new_store
+        self._executors.pop(name, None)
+        self.metadata[name]["spec"] = new_ft.spec()
+        return new_ft
+
+    def age_off(self, name: str, older_than) -> int:
+        """Drop features older than a cutoff (AgeOffFilter/DtgAgeOffFilter
+        analog, reference index/filters/AgeOffFilter.scala). ``older_than``:
+        epoch-ms int, numpy datetime64, or ISO string. Returns rows removed."""
+        st = self._store(name)
+        dtg = st.ft.dtg_field
+        if dtg is None:
+            raise ValueError(f"schema {name!r} has no date attribute")
+        if isinstance(older_than, str):
+            from geomesa_tpu.filter.ecql import parse_iso_ms
+
+            cutoff = parse_iso_ms(older_than)
+        elif isinstance(older_than, np.datetime64):
+            cutoff = int(older_than.astype("datetime64[ms]").astype(np.int64))
+        else:
+            cutoff = int(older_than)
+        st.flush()
+        return st.delete(lambda cols: cols[dtg] < cutoff)
+
     def delete_features(self, name: str, ecql: str,
                         auths: Optional[Sequence[str]] = None) -> int:
         """Delete matching features. A caller with restricted auths can only
@@ -267,10 +340,16 @@ class GeoDataset:
         return ex
 
     # -- reads -------------------------------------------------------------
+    @staticmethod
+    def _timeout_s() -> Optional[float]:
+        ms = config.QUERY_TIMEOUT.to_duration_ms()
+        return ms / 1000.0 if ms is not None else None
+
     def query(self, name: str, query: "str | Query" = "INCLUDE") -> FeatureCollection:
         st, q, plan = self._plan(name, query)
         t0 = time.perf_counter()
-        with metrics.registry().timer("query.scan").time():
+        with metrics.registry().timer("query.scan").time(), \
+                query_deadline(self._timeout_s()):
             batch = self._executor(st).features(plan)
         self._audit(name, q, plan, t0, batch.n)
         # post-processing: sort -> limit -> projection (QueryPlanner.runQuery
@@ -311,7 +390,8 @@ class GeoDataset:
         if not exact:
             return int(plan.est_count)
         t0 = time.perf_counter()
-        n = self._executor(st).count(plan)
+        with query_deadline(self._timeout_s()):
+            n = self._executor(st).count(plan)
         self._audit(name, q, plan, t0, n, op="count")
         return n
 
@@ -335,7 +415,8 @@ class GeoDataset:
         else:
             bbox = tuple(bbox)
         t0 = time.perf_counter()
-        with metrics.registry().timer("query.density").time():
+        with metrics.registry().timer("query.density").time(), \
+                query_deadline(self._timeout_s()):
             grid = self._executor(st).density(plan, bbox, width, height, weight)
         self._audit(name, q, plan, t0, int(np.count_nonzero(grid)), op="density")
         return grid
@@ -346,7 +427,8 @@ class GeoDataset:
         st, q, plan = self._plan(name, query)
         stat = parse_stat(stat_spec)
         t0 = time.perf_counter()
-        with metrics.registry().timer("query.stats").time():
+        with metrics.registry().timer("query.stats").time(), \
+                query_deadline(self._timeout_s()):
             out = self._executor(st).stats(plan, stat)
         self._audit(name, q, plan, t0, 0, op="stats")
         return out
@@ -360,9 +442,55 @@ class GeoDataset:
         return sorted(vals, key=lambda v: (v is None, v))
 
     def min_max(self, name: str, attribute: str,
-                query: "str | Query" = "INCLUDE"):
-        """MinMaxProcess analog."""
+                query: "str | Query" = "INCLUDE", exact: bool = True):
+        """MinMaxProcess / GeoMesaStats.getMinMax analog. ``exact=False``
+        reads the persisted write-time sketch (no scan)."""
+        if not exact:
+            st = self._store(name)
+            st.flush()
+            mm = st.stats.get(f"minmax-{attribute}")
+            if isinstance(mm, sk.MinMax) and not mm.is_empty:
+                return mm.value()
+            # no persisted sketch for this attribute: fall through to exact
         return self.stats(name, f"MinMax({attribute})", query).value()
+
+    # -- stats sketch surface (GeoMesaStats.scala:39-230 parity) -----------
+    def histogram(self, name: str, attribute: str, bins: int = 20,
+                  bounds: Optional[Tuple[float, float]] = None,
+                  query: "str | Query" = "INCLUDE") -> sk.Histogram:
+        """Binned histogram (getHistogram). ``bounds`` defaults to the
+        attribute's (exact or persisted) min/max."""
+        if bounds is None:
+            # persisted write-time sketch when available (no extra scan)
+            mm = self.min_max(name, attribute, query, exact=False)
+            if not mm or mm.get("min") is None:
+                raise ValueError(f"no data to bound histogram on {attribute!r}")
+            bounds = (float(mm["min"]), float(mm["max"]))
+        lo, hi = bounds
+        if hi <= lo:
+            hi = lo + 1.0
+        return self.stats(
+            name, f"Histogram({attribute},{bins},{lo},{hi})", query
+        )
+
+    def frequency(self, name: str, attribute: str, width: int = 256,
+                  query: "str | Query" = "INCLUDE") -> sk.Frequency:
+        """Count-min frequency sketch (getFrequency)."""
+        return self.stats(name, f"Frequency({attribute},{width})", query)
+
+    def top_k(self, name: str, attribute: str, k: int = 10,
+              query: "str | Query" = "INCLUDE") -> List:
+        """Top-k values with counts (getTopK)."""
+        stat = self.stats(name, f"TopK({attribute},{k})", query)
+        return stat.value()
+
+    def z3_histogram(self, name: str) -> Optional[sk.Z3HistogramStat]:
+        """The persisted spatio-temporal histogram driving the cost model
+        (getZ3Histogram; write-time, no scan)."""
+        st = self._store(name)
+        st.flush()
+        z = st.stats.get("z3-histogram")
+        return z if isinstance(z, sk.Z3HistogramStat) and not z.is_empty else None
 
     def knn(self, name: str, x: float, y: float, k: int = 10,
             query: "str | Query" = "INCLUDE") -> FeatureCollection:

@@ -11,8 +11,11 @@ strings, exact 64-bit values).
 
 from __future__ import annotations
 
+import contextlib
 import logging
 import os
+import threading
+import time
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -25,6 +28,40 @@ from geomesa_tpu.kernels import stats_scan as kstats
 from geomesa_tpu.planning.planner import QueryPlan
 from geomesa_tpu.schema.columns import ColumnBatch
 from geomesa_tpu.stats import sketches as sk
+
+
+class QueryTimeoutError(RuntimeError):
+    """Raised when a scan exceeds ``geomesa.query.timeout`` (the reference's
+    ThreadManagement query killer, index/utils/ThreadManagement.scala:28-80)."""
+
+
+_deadline = threading.local()
+
+
+@contextlib.contextmanager
+def query_deadline(timeout_s: "Optional[float]"):
+    """Scope a wall-clock deadline over a query's scan phases. Checked
+    between per-shard host passes and around device dispatches — kernels
+    themselves are not interruptible, so enforcement is at phase granularity
+    (the same guarantee the reference's killer thread gives a blocking scan)."""
+    if timeout_s is None:
+        yield
+        return
+    prev = getattr(_deadline, "t", None)
+    _deadline.t = time.monotonic() + timeout_s
+    try:
+        yield
+    finally:
+        _deadline.t = prev
+
+
+def check_deadline():
+    t = getattr(_deadline, "t", None)
+    if t is not None and time.monotonic() > t:
+        raise QueryTimeoutError(
+            "query exceeded geomesa.query.timeout; narrow the filter or "
+            "raise the timeout"
+        )
 
 
 class Executor:
@@ -64,6 +101,7 @@ class Executor:
         S, L = wm.shape
         pm = np.zeros((S, L), dtype=bool)
         for s in range(table.n_shards):
+            check_deadline()
             sl = table.shard_slice(s)
             cols = {k: v[sl] for k, v in table.columns.items()}
             pm[s, : sl.stop - sl.start] = np.asarray(plan.compiled(cols, np))
@@ -203,6 +241,7 @@ class Executor:
 
     def _run(self, plan: QueryPlan, agg_fn_dev, agg_fn_host, agg_cols=(),
              cache_key=None, additive=False):
+        check_deadline()
         setup = self._scan_setup(plan, agg_cols)
         if setup is None:
             return None

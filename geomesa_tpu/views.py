@@ -1,0 +1,242 @@
+"""Merged and routed dataset views — federation over multiple datasets.
+
+Parity with the reference's view stores (index/view/MergedDataStoreView.
+scala:33, MergedQueryRunner.scala:41 for merged sort/dedupe;
+RoutedDataStoreView + RouteSelectorByAttribute for routing): a *merged* view
+fans a query out to every underlying dataset and combines results (concat +
+merged sort + dedupe + limit; additive grids/sketches merge by ``+``); a
+*routed* view picks exactly one dataset per query. The canonical use is
+hot(HBM)/cold(Parquet) tiering routed/merged by time predicate.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu.api.dataset import FeatureCollection, GeoDataset, Query
+from geomesa_tpu.filter import ir, parse_ecql
+from geomesa_tpu.schema.columns import ColumnBatch
+from geomesa_tpu.stats import sketches as sk
+
+
+def _as_query(query) -> Query:
+    return query if isinstance(query, Query) else Query(ecql=query)
+
+
+class MergedDatasetView:
+    """Query N datasets holding the same schema as one (MergedDataStoreView).
+
+    Reads fan out to every member; writes are not supported through the view
+    (write to a member directly — same contract as the reference).
+    """
+
+    def __init__(self, datasets: Sequence[GeoDataset]):
+        if not datasets:
+            raise ValueError("merged view needs at least one dataset")
+        self.datasets = list(datasets)
+
+    def get_schema(self, name: str):
+        return self.datasets[0].get_schema(name)
+
+    def list_schemas(self) -> List[str]:
+        names: List[str] = []
+        for ds in self.datasets:
+            for n in ds.list_schemas():
+                if n not in names:
+                    names.append(n)
+        return names
+
+    def _members_with(self, name: str) -> List[GeoDataset]:
+        return [ds for ds in self.datasets if name in ds.list_schemas()]
+
+    def query(self, name: str, query: "str | Query" = "INCLUDE") -> FeatureCollection:
+        """Concatenated results with merged sort, de-dupe by fid, limit
+        (MergedQueryRunner semantics)."""
+        q = _as_query(query)
+        members = self._members_with(name)
+        if not members:
+            raise KeyError(f"no member dataset has schema {name!r}")
+        ft = members[0].get_schema(name)
+        # fan out WITHOUT per-member limit-sensitive ops; merge client-side
+        sub = Query(
+            ecql=q.ecql, properties=None, sort_by=q.sort_by, auths=q.auths,
+        )
+        batches = []
+        for ds in members:
+            fc = ds.query(name, sub)
+            st = ds._store(name)
+            # decode per-member dictionary codes to values so codes from
+            # different members never collide
+            cols = dict(fc.batch.columns)
+            for a in ft.attributes:
+                if a.type == "string" and a.name in cols:
+                    d = st.dicts.get(a.name)
+                    if d is not None:
+                        codes = cols[a.name]
+                        vocab = np.array(list(d.values) + [None], dtype=object)
+                        cols[a.name] = vocab[
+                            np.where(codes >= 0, codes, len(vocab) - 1)
+                        ]
+            batches.append(ColumnBatch(cols, fc.batch.n))
+        merged = ColumnBatch.concat(batches) if batches else ColumnBatch({}, 0)
+        # de-dupe by feature id, first member wins (reference dedupes merged
+        # stores by id)
+        if "__fid__" in merged.columns and merged.n:
+            _, first = np.unique(merged.columns["__fid__"], return_index=True)
+            keep = np.zeros(merged.n, bool)
+            keep[first] = True
+            merged = merged.select(keep)
+        # merged sort + limit — while strings are still decoded values, so
+        # the order is lexicographic, not dictionary-code order
+        if q.sort_by and merged.n:
+            order = np.arange(merged.n)
+            for attr, desc in reversed(list(q.sort_by)):
+                col = merged.columns.get(attr)
+                if col is None:
+                    continue
+                col = np.asarray(col)
+                if col.dtype.kind == "O":  # decoded strings; nulls sort first
+                    col = np.array(
+                        ["" if v is None else str(v) for v in col.tolist()]
+                    )
+                idx = np.argsort(col[order], kind="stable")
+                if desc:
+                    idx = idx[::-1]
+                order = order[idx]
+            merged = ColumnBatch(
+                {k: v[order] for k, v in merged.columns.items()}, merged.n
+            )
+        # re-encode decoded strings against a fresh view-local dictionary so
+        # the FeatureCollection contract (codes + dicts) holds
+        from geomesa_tpu.schema.columns import DictionaryEncoder
+
+        dicts: Dict[str, DictionaryEncoder] = {}
+        for a in ft.attributes:
+            if a.type == "string" and a.name in merged.columns:
+                enc = DictionaryEncoder()
+                vals = [
+                    None if v is None else str(v)
+                    for v in merged.columns[a.name].tolist()
+                ]
+                merged.columns[a.name] = enc.encode(vals)
+                dicts[a.name] = enc
+        if q.max_features is not None and merged.n > q.max_features:
+            merged = ColumnBatch(
+                {k: v[: q.max_features] for k, v in merged.columns.items()},
+                q.max_features,
+            )
+        if q.properties:
+            keep = set(q.properties) | {"__fid__"}
+            pref = tuple(p + "__" for p in q.properties)
+            merged = ColumnBatch(
+                {
+                    k: v for k, v in merged.columns.items()
+                    if k in keep or k.startswith(pref)
+                },
+                merged.n,
+            )
+        return FeatureCollection(ft, merged, dicts or {})
+
+    def count(self, name: str, query: "str | Query" = "INCLUDE",
+              exact: bool = True) -> int:
+        return sum(
+            ds.count(name, query, exact=exact)
+            for ds in self._members_with(name)
+        )
+
+    def bounds(self, name: str) -> Optional[Tuple[float, float, float, float]]:
+        bs = [b for b in (
+            ds.bounds(name) for ds in self._members_with(name)
+        ) if b is not None]
+        if not bs:
+            return None
+        a = np.asarray(bs)
+        return (
+            float(a[:, 0].min()), float(a[:, 1].min()),
+            float(a[:, 2].max()), float(a[:, 3].max()),
+        )
+
+    def density(self, name: str, query: "str | Query" = "INCLUDE",
+                bbox=None, width: int = 256, height: int = 256,
+                weight: Optional[str] = None) -> np.ndarray:
+        if bbox is None:
+            bbox = self.bounds(name) or (-180, -90, 180, 90)
+        grid = np.zeros((height, width), np.float32)
+        for ds in self._members_with(name):
+            grid = grid + ds.density(name, query, bbox=bbox, width=width,
+                                     height=height, weight=weight)
+        return grid
+
+    def stats(self, name: str, stat_spec: str,
+              query: "str | Query" = "INCLUDE") -> sk.Stat:
+        """Cross-member sketch merge (the LambdaStats/StatsCombiner role)."""
+        out: Optional[sk.Stat] = None
+        for ds in self._members_with(name):
+            s = ds.stats(name, stat_spec, query)
+            if out is None:
+                out = s
+            else:
+                out.merge(s)
+        if out is None:
+            raise KeyError(f"no member dataset has schema {name!r}")
+        return out
+
+    def unique(self, name: str, attribute: str,
+               query: "str | Query" = "INCLUDE") -> List:
+        vals = set()
+        for ds in self._members_with(name):
+            vals.update(ds.unique(name, attribute, query))
+        return sorted(vals, key=lambda v: (v is None, v))
+
+
+class RoutedDatasetView:
+    """Route each query to exactly ONE member dataset (RoutedDataStoreView).
+
+    ``routes``: ordered list of ``(selector, dataset)``. A selector is either
+    a set of attribute names — the route matches when the query filter
+    references a subset of them (RouteSelectorByAttribute) — or a callable
+    ``(ir.Filter) -> bool``. First match wins; an empty attribute set is the
+    default route.
+    """
+
+    def __init__(self, routes: Sequence[Tuple[object, GeoDataset]]):
+        if not routes:
+            raise ValueError("routed view needs at least one route")
+        self.routes = list(routes)
+
+    def route(self, name: str, query: "str | Query" = "INCLUDE") -> GeoDataset:
+        q = _as_query(query)
+        f = parse_ecql(q.ecql or "INCLUDE")
+        props = set(ir.props_referenced(f))
+        default = None
+        for selector, ds in self.routes:
+            if callable(selector):
+                if selector(f):
+                    return ds
+            else:
+                attrs = set(selector)
+                if not attrs:
+                    default = default or ds
+                elif props and props <= attrs:
+                    return ds
+        if default is not None:
+            return default
+        raise ValueError(
+            f"no route matches query attributes {sorted(props)}"
+        )
+
+    def query(self, name: str, query: "str | Query" = "INCLUDE"):
+        return self.route(name, query).query(name, query)
+
+    def count(self, name: str, query: "str | Query" = "INCLUDE",
+              exact: bool = True) -> int:
+        return self.route(name, query).count(name, query, exact=exact)
+
+    def density(self, name: str, query: "str | Query" = "INCLUDE", **kw):
+        return self.route(name, query).density(name, query, **kw)
+
+    def stats(self, name: str, stat_spec: str,
+              query: "str | Query" = "INCLUDE"):
+        return self.route(name, query).stats(name, stat_spec, query)

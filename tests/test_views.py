@@ -1,0 +1,236 @@
+"""Merged/routed dataset views, age-off, schema update, query timeout,
+and the GeoMesaStats API surface."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.api.dataset import Query
+from geomesa_tpu.views import MergedDatasetView, RoutedDatasetView
+
+SPEC = "name:String:index=true,v:Integer,dtg:Date,*geom:Point"
+
+
+def _make(seed, n=2000, t0="2020-01-01", t1="2020-02-01"):
+    rng = np.random.default_rng(seed)
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", SPEC)
+    lo = np.datetime64(t0).astype("datetime64[ms]").astype(np.int64)
+    hi = np.datetime64(t1).astype("datetime64[ms]").astype(np.int64)
+    data = {
+        "geom__x": rng.uniform(-20, 20, n),
+        "geom__y": rng.uniform(-20, 20, n),
+        "dtg": rng.integers(lo, hi, n).astype("datetime64[ms]"),
+        "name": rng.choice(["a", "b", "c"], n),
+        "v": rng.integers(0, 100, n),
+    }
+    ds.insert("t", data, fids=np.array([f"{seed}-{i}" for i in range(n)]))
+    ds.flush("t")
+    return ds, data
+
+
+def test_merged_count_density_stats():
+    ds1, d1 = _make(1)
+    ds2, d2 = _make(2)
+    view = MergedDatasetView([ds1, ds2])
+    ecql = "BBOX(geom, -10, -10, 10, 10)"
+    want = sum(
+        int((
+            (d["geom__x"] >= -10) & (d["geom__x"] <= 10)
+            & (d["geom__y"] >= -10) & (d["geom__y"] <= 10)
+        ).sum())
+        for d in (d1, d2)
+    )
+    assert view.count("t", ecql) == want
+    grid = view.density("t", ecql, bbox=(-10, -10, 10, 10), width=32, height=32)
+    assert abs(float(grid.sum()) - want) < 1e-2
+    mm = view.stats("t", "MinMax(v)").value()
+    allv = np.concatenate([d1["v"], d2["v"]])
+    assert (mm["min"], mm["max"]) == (allv.min(), allv.max())
+    assert view.unique("t", "name") == ["a", "b", "c"]
+    b = view.bounds("t")
+    assert b[0] <= -19 and b[2] >= 19
+
+
+def test_merged_query_dedupe_sort_limit():
+    ds1, _ = _make(1, n=500)
+    ds2, _ = _make(1, n=500)  # identical fids -> full dedupe
+    view = MergedDatasetView([ds1, ds2])
+    fc = view.query("t", Query(ecql="INCLUDE"))
+    assert len(fc) == 500  # deduped by fid
+    fc = view.query("t", Query(ecql="INCLUDE", sort_by=[("v", False)],
+                               max_features=50))
+    assert len(fc) == 50
+    v = fc.to_dict()["v"]
+    assert list(v) == sorted(v)
+
+
+def test_merged_string_columns_decoded():
+    ds1, _ = _make(1, n=300)
+    ds2, _ = _make(2, n=300)
+    view = MergedDatasetView([ds1, ds2])
+    fc = view.query("t", "name = 'a'")
+    names = set(fc.to_dict()["name"])
+    assert names == {"a"}
+
+
+def test_routed_view_by_attribute():
+    ds1, _ = _make(1)
+    ds2, _ = _make(2)
+    view = RoutedDatasetView([
+        ({"name", "v"}, ds1),   # attribute queries -> ds1
+        (set(), ds2),           # default route -> ds2
+    ])
+    assert view.route("t", "name = 'a'") is ds1
+    assert view.route("t", "BBOX(geom, 0, 0, 5, 5)") is ds2
+    assert view.count("t", "name = 'a'") == ds1.count("t", "name = 'a'")
+
+
+def test_routed_view_by_callable():
+    from geomesa_tpu.filter import ir
+
+    hot, _ = _make(1, t0="2020-06-01", t1="2020-07-01")
+    cold, _ = _make(2, t0="2020-01-01", t1="2020-02-01")
+
+    def is_recent(f):
+        iv = ir.extract_intervals(f, "dtg")
+        june = np.datetime64("2020-06-01").astype("datetime64[ms]").astype(np.int64)
+        return not iv.is_empty and all(lo >= june for lo, hi in iv.values)
+
+    view = RoutedDatasetView([(is_recent, hot), (set(), cold)])
+    q = "dtg DURING 2020-06-10T00:00:00Z/2020-06-20T00:00:00Z"
+    assert view.route("t", q) is hot
+    assert view.route("t", "v > 5") is cold
+
+
+def test_age_off():
+    ds, data = _make(3)
+    cutoff = "2020-01-15T00:00:00Z"
+    want_removed = int(
+        (data["dtg"] < np.datetime64("2020-01-15")).sum()
+    )
+    removed = ds.age_off("t", cutoff)
+    assert removed == want_removed
+    assert ds.count("t") == len(data["dtg"]) - want_removed
+    # no survivors older than the cutoff
+    assert ds.count("t", "dtg BEFORE 2020-01-15T00:00:00Z") == 0
+
+
+def test_update_schema_add_attribute():
+    ds, data = _make(4, n=400)
+    before = ds.count("t")
+    ft = ds.update_schema("t", "score:Float")
+    assert ft.has("score")
+    assert ds.count("t") == before  # data retained
+    fc = ds.query("t", Query(max_features=5))
+    assert "score" in fc.to_dict()
+    # old attribute queries still work
+    assert ds.count("t", "name = 'a'") > 0
+    # new data can use the new attribute
+    ds.insert("t", {
+        "geom__x": np.array([1.0]), "geom__y": np.array([2.0]),
+        "dtg": np.array(["2020-03-01"], "datetime64[ms]"),
+        "name": np.array(["a"], object), "v": np.array([1]),
+        "score": np.array([0.5], np.float32),
+    }, fids=np.array(["new-1"]))
+    ds.flush("t")
+    assert ds.count("t") == before + 1
+
+
+def test_update_schema_rejects_geometry():
+    ds, _ = _make(5, n=50)
+    with pytest.raises(ValueError):
+        ds.update_schema("t", "geom2:Point")
+
+
+def test_update_schema_integer_add_and_visibility_preserved():
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", SPEC)
+    base = {
+        "geom__x": np.array([1.0, 2.0]), "geom__y": np.array([3.0, 4.0]),
+        "dtg": np.array(["2020-01-01", "2020-01-02"], "datetime64[ms]"),
+        "name": np.array(["a", "b"], object), "v": np.array([1, 2]),
+    }
+    ds.insert("t", base, fids=np.array(["f1", "f2"]),
+              visibilities=["admin", ""])
+    ds.flush("t")
+    assert ds.count("t", Query(auths=[])) == 1  # only the unlabelled row
+    ds.update_schema("t", "age:Integer")
+    # visibility labels survive the migration
+    assert ds.count("t", Query(auths=[])) == 1
+    assert ds.count("t", Query(auths=["admin"])) == 2
+    # integer null-fill: zeros (documented fixed-width null representation)
+    fc = ds.query("t", Query(auths=["admin"]))
+    assert list(fc.to_dict()["age"]) == [0, 0]
+
+
+def test_update_schema_polygon_geometry():
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("p", "dtg:Date,*geom:Polygon")
+    ds.insert("p", {
+        "geom": np.array(["POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))"], object),
+        "dtg": np.array(["2020-01-01"], "datetime64[ms]"),
+    }, fids=np.array(["p1"]))
+    ds.flush("p")
+    ds.update_schema("p", "score:Float")
+    assert ds.count("p") == 1
+    # extent predicates still work after migration
+    assert ds.count("p", "BBOX(geom, 1, 1, 2, 2)") == 1
+    assert ds.count("p", "BBOX(geom, 10, 10, 12, 12)") == 0
+
+
+def test_merged_query_unknown_schema():
+    ds, _ = _make(9, n=10)
+    view = MergedDatasetView([ds])
+    with pytest.raises(KeyError):
+        view.query("nope")
+
+
+def test_merged_sort_is_lexicographic():
+    a = GeoDataset(n_shards=2)
+    a.create_schema("t", SPEC)
+    a.insert("t", {
+        "geom__x": np.array([0.0, 0.0]), "geom__y": np.array([0.0, 0.0]),
+        "dtg": np.array(["2020-01-01", "2020-01-01"], "datetime64[ms]"),
+        "name": np.array(["zeta", "alpha"], object), "v": np.array([1, 2]),
+    }, fids=np.array(["a1", "a2"]))
+    a.flush("t")
+    b = GeoDataset(n_shards=2)
+    b.create_schema("t", SPEC)
+    b.insert("t", {
+        "geom__x": np.array([0.0, 0.0]), "geom__y": np.array([0.0, 0.0]),
+        "dtg": np.array(["2020-01-01", "2020-01-01"], "datetime64[ms]"),
+        "name": np.array(["mike", "beta"], object), "v": np.array([3, 4]),
+    }, fids=np.array(["b1", "b2"]))
+    b.flush("t")
+    view = MergedDatasetView([a, b])
+    fc = view.query("t", Query(sort_by=[("name", False)]))
+    assert fc.to_dict()["name"] == ["alpha", "beta", "mike", "zeta"]
+
+
+def test_query_timeout(monkeypatch):
+    from geomesa_tpu.planning.executor import QueryTimeoutError
+
+    ds, _ = _make(6, n=5000)
+    monkeypatch.setenv("GEOMESA_QUERY_TIMEOUT", "0ms")
+    # force the host path so the per-shard deadline check runs
+    ds.prefer_device = False
+    ds._executors.clear()
+    with pytest.raises(QueryTimeoutError):
+        ds.count("t", "BBOX(geom, -10, -10, 10, 10)")
+    monkeypatch.delenv("GEOMESA_QUERY_TIMEOUT")
+    assert ds.count("t", "BBOX(geom, -10, -10, 10, 10)") > 0
+
+
+def test_stats_api_surface():
+    ds, data = _make(7)
+    h = ds.histogram("t", "v", bins=10)
+    assert h.counts.sum() == len(data["v"])
+    f = ds.frequency("t", "v", width=1024)
+    assert f.count(5) >= int((data["v"] == 5).sum())  # count-min overestimates
+    tk = ds.top_k("t", "name", k=2)
+    assert len(tk) == 2 and tk[0][1] >= tk[1][1]
+    mm = ds.min_max("t", "v", exact=False)  # persisted sketch path
+    assert (mm["min"], mm["max"]) == (data["v"].min(), data["v"].max())
+    z = ds.z3_histogram("t")
+    assert z is not None and not z.is_empty
