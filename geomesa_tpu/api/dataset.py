@@ -193,7 +193,12 @@ class GeoDataset:
         st = self._store(name)
         st.flush()
         old = st.ft
-        new_ft = FeatureType.from_spec(name, old.spec() + "," + add_spec)
+        # insert new attributes before the ';user-data' section, if any
+        spec = old.spec()
+        attrs_part, sep, ud_part = spec.partition(";")
+        new_ft = FeatureType.from_spec(
+            name, attrs_part + "," + add_spec + sep + ud_part
+        )
         added = [a for a in new_ft.attributes if not old.has(a.name)]
         for a in added:
             if a.is_geom:
@@ -218,7 +223,7 @@ class GeoDataset:
                     cols[a.name + "__off"] = off
                 elif a.type == "bool":
                     cols[a.name] = np.zeros(n, bool)
-                elif a.type in ("float", "double"):
+                elif a.type in ("float32", "float64"):
                     cols[a.name] = np.full(n, np.nan, np.dtype(a.type))
                 else:
                     cols[a.name] = np.zeros(n, np.dtype(a.type))

@@ -174,6 +174,46 @@ def cmd_export(args):
         gj = geojson.dumps(st.ft, fc.batch, st.dicts)
         _write_text(out, _LEAFLET_TMPL.replace("__GEOJSON__", gj))
         return
+    if fmt == "gml":
+        from geomesa_tpu.io import gml
+
+        st = ds._store(args.feature_name)
+        _write_text(out, gml.dumps(st.ft, fc.batch, st.dicts))
+        return
+    if fmt == "shp":
+        from geomesa_tpu.io import shapefile
+
+        st = ds._store(args.feature_name)
+        base = shapefile.write_shapefile(
+            out or "export.shp", st.ft, fc.batch, st.dicts
+        )
+        print(f"wrote {base}.shp/.shx/.dbf ({fc.batch.n} features)")
+        return
+    if fmt == "avro":
+        from geomesa_tpu.io import avro_io
+
+        st = ds._store(args.feature_name)
+        path = out or "export.avro"
+        avro_io.write_avro(path, st.ft, fc.batch, st.dicts)
+        print(f"wrote {path} ({fc.batch.n} features)")
+        return
+    if fmt == "orc":
+        import pyarrow as pa
+        import pyarrow.orc as orc
+
+        table = ds.to_arrow(args.feature_name, q)
+        # ORC has no dictionary type: decode dictionary-encoded strings
+        cols = []
+        for i, f in enumerate(table.schema):
+            col = table.column(i)
+            if pa.types.is_dictionary(f.type):
+                col = col.cast(f.type.value_type)
+            cols.append(col)
+        table = pa.table(cols, names=table.schema.names)
+        path = out or "export.orc"
+        orc.write_table(table, path)
+        print(f"wrote {path} ({table.num_rows} rows)")
+        return
     raise SystemExit(f"unknown export format {args.format!r}")
 
 
@@ -252,6 +292,15 @@ def cmd_compact(args):
     fs = FileSystemStorage(args.catalog)
     removed = fs.compact(args.feature_name)
     print(f"compacted: removed {removed} files")
+
+
+def cmd_web(args):
+    """Run the REST endpoint (geomesa-web GeoMesaStatsEndpoint analog)."""
+    from geomesa_tpu import web
+
+    ds = _load(args.catalog)
+    print(f"geomesa-tpu web listening on http://{args.host}:{args.port}/api")
+    web.serve(ds, args.host, args.port)
 
 
 def cmd_serve(args):
@@ -381,6 +430,12 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--persist", action="store_true",
                     help="save the catalog on shutdown")
     sp.set_defaults(fn=cmd_serve)
+
+    sp = sub.add_parser("web", help="run the REST endpoint (geomesa-web analog)")
+    common(sp)
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8081)
+    sp.set_defaults(fn=cmd_web)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

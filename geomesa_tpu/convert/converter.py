@@ -352,12 +352,190 @@ class JsonConverter(BaseConverter):
         return self._finish(data, fids, keep, ctx)
 
 
+class XmlConverter(BaseConverter):
+    """XML converter: feature-path selects elements, per-field ``path`` is a
+    relative child path (``a/b``, ``@attr``, or ``a/b/@attr``) — the
+    XPath-subset model of geomesa-convert-xml."""
+
+    def convert(self, source: "str | bytes",
+                ctx: Optional[EvaluationContext] = None,
+                batch_size: int = 100_000) -> Iterator[Tuple[Dict, Optional[np.ndarray]]]:
+        import xml.etree.ElementTree as ET
+
+        ctx = ctx if ctx is not None else EvaluationContext()
+        if hasattr(source, "read"):
+            source = source.read()
+        root = ET.fromstring(
+            source.decode() if isinstance(source, bytes) else source
+        )
+        fp = (self.config.feature_path or ".").strip("/")
+        elems = root.findall(f".//{fp}") if fp not in (".", "") else [root]
+        for start in range(0, len(elems), batch_size):
+            chunk = elems[start:start + batch_size]
+            yield self._convert_elems(chunk, start, ctx)
+
+    @staticmethod
+    def _xml_get(elem, path: str):
+        if path.startswith("@"):
+            return elem.get(path[1:])
+        if "/@" in path:
+            epath, attr = path.rsplit("/@", 1)
+            child = elem.find(epath)
+            return None if child is None else child.get(attr)
+        child = elem.find(path)
+        if child is None:
+            return None
+        return (child.text or "").strip() or None
+
+    def _convert_elems(self, elems, line_offset: int, ctx: EvaluationContext):
+        import xml.etree.ElementTree as ET
+
+        n = len(elems)
+        raw = [np.empty(n, dtype=object)]
+        for i, e in enumerate(elems):
+            raw[0][i] = ET.tostring(e, encoding="unicode")
+        preset: Dict[str, np.ndarray] = {}
+        for f in self.config.fields:
+            if "path" in f:
+                vals = np.empty(n, dtype=object)
+                for i, e in enumerate(elems):
+                    vals[i] = self._xml_get(e, f["path"])
+                preset[f["name"]] = vals
+        data, fids, keep = self._transform(raw, n, line_offset, ctx, preset)
+        for f in self.config.fields:
+            name = f["name"]
+            if "path" in f and "transform" not in f and self.ft.has(name):
+                data.setdefault(name, preset[name])
+        return self._finish(data, fids, keep, ctx)
+
+
+class FixedWidthConverter(BaseConverter):
+    """Fixed-width text: per-field ``start``/``width`` character offsets
+    (geomesa-convert-fixedwidth analog); transforms see the slice as $name."""
+
+    def convert(self, source: "str | io.TextIOBase | Iterable[str]",
+                ctx: Optional[EvaluationContext] = None,
+                batch_size: int = 100_000) -> Iterator[Tuple[Dict, Optional[np.ndarray]]]:
+        ctx = ctx if ctx is not None else EvaluationContext()
+        lines = io.StringIO(source) if isinstance(source, str) else source
+        skip = int(self.config.options.get("skip-lines", 0))
+        buf: List[str] = []
+        offset = 0
+        for i, line in enumerate(lines):
+            if i < skip:
+                continue
+            line = line.rstrip("\r\n")
+            if line:
+                buf.append(line)
+            if len(buf) >= batch_size:
+                yield self._convert_lines(buf, offset, ctx)
+                offset += len(buf)
+                buf = []
+        if buf:
+            yield self._convert_lines(buf, offset, ctx)
+
+    def _convert_lines(self, lines: List[str], line_offset: int,
+                       ctx: EvaluationContext):
+        n = len(lines)
+        raw = [np.array(lines, dtype=object)]
+        preset: Dict[str, np.ndarray] = {}
+        for f in self.config.fields:
+            if "start" in f:
+                s = int(f["start"])
+                e = s + int(f["width"])
+                vals = np.empty(n, dtype=object)
+                for i, line in enumerate(lines):
+                    piece = line[s:e].strip()
+                    vals[i] = piece or None
+                preset[f["name"]] = vals
+        data, fids, keep = self._transform(raw, n, line_offset, ctx, preset)
+        for f in self.config.fields:
+            name = f["name"]
+            if "start" in f and "transform" not in f and self.ft.has(name):
+                data.setdefault(name, preset[name])
+        return self._finish(data, fids, keep, ctx)
+
+
+class _ColumnarConverter(BaseConverter):
+    """Shared path for columnar inputs (Parquet/Avro): every input column is
+    preset as $name; fields without transforms pass straight through."""
+
+    def _convert_table(self, columns: Dict[str, np.ndarray], n: int,
+                       ctx: EvaluationContext, line_offset: int = 0):
+        raw = [np.empty(n, dtype=object)]  # $0 unused for columnar input
+        raw[0][:] = ""
+        preset = {k: v for k, v in columns.items()}
+        data, fids, keep = self._transform(raw, n, line_offset, ctx, preset)
+        declared = {f["name"] for f in self.config.fields}
+        for a in self.ft.attributes:
+            if a.name in data:
+                continue
+            src = a.name
+            if src in preset and (src not in declared):
+                data[src] = preset[src]
+        for f in self.config.fields:
+            name = f["name"]
+            if "transform" not in f and self.ft.has(name) and name in preset:
+                data.setdefault(name, preset[name])
+        return self._finish(data, fids, keep, ctx)
+
+
+class ParquetConverter(_ColumnarConverter):
+    """Parquet ingest (geomesa-convert-parquet analog) via pyarrow."""
+
+    def convert(self, source, ctx: Optional[EvaluationContext] = None,
+                batch_size: int = 1_000_000) -> Iterator[Tuple[Dict, Optional[np.ndarray]]]:
+        import pyarrow.parquet as pq
+
+        ctx = ctx if ctx is not None else EvaluationContext()
+        table = pq.read_table(source)
+        for start in range(0, max(table.num_rows, 1), batch_size):
+            chunk = table.slice(start, batch_size)
+            if chunk.num_rows == 0:
+                continue
+            cols = {
+                name: np.asarray(chunk.column(name).to_pylist(), dtype=object)
+                for name in chunk.schema.names
+            }
+            yield self._convert_table(cols, chunk.num_rows, ctx, start)
+
+
+class AvroConverter(_ColumnarConverter):
+    """Avro container ingest (geomesa-convert-avro analog) via the built-in
+    codec (io/avro_io.py)."""
+
+    def convert(self, source, ctx: Optional[EvaluationContext] = None,
+                batch_size: int = 1_000_000) -> Iterator[Tuple[Dict, Optional[np.ndarray]]]:
+        from geomesa_tpu.io import avro_io
+
+        ctx = ctx if ctx is not None else EvaluationContext()
+        _, records = avro_io.read_avro(source)
+        for start in range(0, len(records), batch_size):
+            chunk = records[start:start + batch_size]
+            if not chunk:
+                continue
+            names = list(chunk[0].keys())
+            cols = {
+                name: np.array([r.get(name) for r in chunk], dtype=object)
+                for name in names
+            }
+            yield self._convert_table(cols, len(chunk), ctx, start)
+
+
 def converter_for(ft: FeatureType, config: "str | Dict | ConverterConfig"):
     cfg = config if isinstance(config, ConverterConfig) else ConverterConfig.parse(config)
     if cfg.type in ("delimited-text", "csv", "tsv"):
         return DelimitedTextConverter(ft, cfg)
     if cfg.type == "json":
         return JsonConverter(ft, cfg)
+    if cfg.type == "xml":
+        return XmlConverter(ft, cfg)
+    if cfg.type in ("fixed-width", "fixedwidth"):
+        return FixedWidthConverter(ft, cfg)
+    if cfg.type == "parquet":
+        return ParquetConverter(ft, cfg)
+    if cfg.type == "avro":
+        return AvroConverter(ft, cfg)
     raise ValueError(f"unknown converter type {cfg.type!r}")
 
 

@@ -1,0 +1,202 @@
+"""ESRI Shapefile export (.shp/.shx/.dbf) — CLI `export -F shp` parity.
+
+The reference exports shapefiles through GeoTools' shapefile datastore
+(geomesa-tools/.../export/formats/ShapefileExporter.scala); here the three
+files are written directly: Point (type 1), PolyLine (3), Polygon (5).
+Attributes land in the DBF as C(254) strings / N(18,x) numerics / D dates —
+the standard dBASE III subset every GIS reads.
+"""
+
+from __future__ import annotations
+
+import os
+import struct
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.utils import geometry as geo
+
+SHP_POINT = 1
+SHP_POLYLINE = 3
+SHP_POLYGON = 5
+
+
+def _geom_parts(g) -> Tuple[int, List[np.ndarray]]:
+    """Geometry -> (shape type, list of part vertex arrays [n, 2])."""
+    if isinstance(g, geo.Point):
+        return SHP_POINT, [np.array([[g.x, g.y]])]
+    if isinstance(g, geo.MultiPoint):
+        p = g.points[0]
+        return SHP_POINT, [np.array([[p.x, p.y]])]
+    if isinstance(g, geo.LineString):
+        return SHP_POLYLINE, [np.asarray(g.coords)]
+    if isinstance(g, geo.MultiLineString):
+        return SHP_POLYLINE, [np.asarray(ls.coords) for ls in g.lines]
+    if isinstance(g, geo.Polygon):
+        rings = [np.asarray(geo._close_ring(g.shell))] + [
+            np.asarray(geo._close_ring(h)) for h in g.holes
+        ]
+        return SHP_POLYGON, rings
+    if isinstance(g, geo.MultiPolygon):
+        rings: List[np.ndarray] = []
+        for p in g.polygons:
+            rings.append(np.asarray(geo._close_ring(p.shell)))
+            rings += [np.asarray(geo._close_ring(h)) for h in p.holes]
+        return SHP_POLYGON, rings
+    raise ValueError(f"unsupported geometry {type(g).__name__}")
+
+
+def _record_bytes(shape_type: int, parts: List[np.ndarray]) -> bytes:
+    if shape_type == SHP_POINT:
+        x, y = float(parts[0][0, 0]), float(parts[0][0, 1])
+        return struct.pack("<idd", SHP_POINT, x, y)
+    pts = np.concatenate(parts)
+    xmin, ymin = pts.min(axis=0)
+    xmax, ymax = pts.max(axis=0)
+    out = struct.pack(
+        "<i4dii", shape_type, xmin, ymin, xmax, ymax, len(parts), len(pts)
+    )
+    off = 0
+    for p in parts:
+        out += struct.pack("<i", off)
+        off += len(p)
+    out += pts.astype("<f8").tobytes()
+    return out
+
+
+def write_shapefile(path: str, ft, batch, dicts):
+    """Write ``path``(.shp/.shx/.dbf) from a feature batch."""
+    from geomesa_tpu.schema.columns import decode_batch
+
+    base = path[:-4] if path.lower().endswith(".shp") else path
+    d = decode_batch(ft, batch, dicts)
+    gname = ft.geom_field
+    if gname is None or gname not in d:
+        raise ValueError(
+            "shapefile export requires the geometry attribute "
+            "(include it in the projection)"
+        )
+    geoms = []
+    for v in d[gname]:
+        if isinstance(v, str):
+            geoms.append(geo.parse_wkt(v))
+        elif isinstance(v, geo.Geometry):
+            geoms.append(v)
+        else:  # (x, y) pair
+            geoms.append(geo.Point(float(v[0]), float(v[1])))
+
+    recs = [_geom_parts(g) for g in geoms]
+    shape_type = recs[0][0] if recs else SHP_POINT
+    if any(t != shape_type for t, _ in recs):
+        raise ValueError("shapefiles hold a single geometry type")
+
+    # .shp + .shx
+    contents = [_record_bytes(t, p) for t, p in recs]
+    shp_len = 100 + sum(8 + len(c) for c in contents)
+    shx_len = 100 + 8 * len(contents)
+    allpts = (
+        np.concatenate([np.concatenate(p) for _, p in recs])
+        if recs else np.zeros((0, 2))
+    )
+    bbox = (
+        (allpts[:, 0].min(), allpts[:, 1].min(),
+         allpts[:, 0].max(), allpts[:, 1].max())
+        if len(allpts) else (0.0, 0.0, 0.0, 0.0)
+    )
+
+    def header(total_words: int) -> bytes:
+        return (
+            struct.pack(">i20x2i", 9994, total_words, 0)[:28]
+            + struct.pack("<2i", 1000, shape_type)
+            + struct.pack("<4d", *bbox)
+            + struct.pack("<4d", 0, 0, 0, 0)
+        )
+
+    with open(base + ".shp", "wb") as f:
+        f.write(header(shp_len // 2))
+        for i, c in enumerate(contents):
+            f.write(struct.pack(">2i", i + 1, len(c) // 2))
+            f.write(c)
+    with open(base + ".shx", "wb") as f:
+        f.write(header(shx_len // 2))
+        off = 50
+        for c in contents:
+            f.write(struct.pack(">2i", off, len(c) // 2))
+            off += 4 + len(c) // 2
+
+    # .dbf (projected-out attributes are skipped)
+    attrs = [a for a in ft.attributes if not a.is_geom and a.name in d]
+    _write_dbf(base + ".dbf", attrs, d, batch.n)
+    return base
+
+
+def _write_dbf(path: str, attrs, d: Dict[str, Any], n: int):
+    fields = []
+    for a in attrs:
+        if a.type == "date":
+            fields.append((a.name[:10], b"D", 8, 0))
+        elif a.type in ("int32", "int64"):
+            fields.append((a.name[:10], b"N", 18, 0))
+        elif a.type in ("float32", "float64"):
+            fields.append((a.name[:10], b"N", 18, 6))
+        else:
+            fields.append((a.name[:10], b"C", 254, 0))
+    header_len = 32 + 32 * len(fields) + 1
+    rec_len = 1 + sum(w for _, _, w, _ in fields)
+    with open(path, "wb") as f:
+        f.write(struct.pack("<B3BIHH20x", 3, 24, 1, 1, n, header_len, rec_len))
+        for name, typ, width, dec in fields:
+            f.write(struct.pack(
+                "<11s1s4xBB14x", name.encode()[:11], typ, width, dec
+            ))
+        f.write(b"\x0d")
+        for i in range(n):
+            f.write(b" ")
+            for (name, typ, width, dec), a in zip(fields, attrs):
+                v = d[a.name][i]
+                if typ == b"D":
+                    s = (
+                        "        " if v is None
+                        else str(np.datetime64(v, "D")).replace("-", "")
+                    )
+                elif typ == b"N":
+                    if v is None or (isinstance(v, float) and np.isnan(v)):
+                        s = " " * width
+                    elif dec:
+                        s = f"{float(v):.{dec}f}".rjust(width)
+                    else:
+                        s = str(int(v)).rjust(width)
+                else:
+                    s = ("" if v is None else str(v))[:width].ljust(width)
+                f.write(s[:width].ljust(width).encode("utf-8", "replace")[:width].ljust(width, b" "))
+        f.write(b"\x1a")
+
+
+def read_shapefile(path: str) -> List[Tuple[int, List[np.ndarray]]]:
+    """Minimal .shp reader (round-trip tests): [(shape_type, parts)]."""
+    base = path[:-4] if path.lower().endswith(".shp") else path
+    out = []
+    with open(base + ".shp", "rb") as f:
+        data = f.read()
+    pos = 100
+    while pos < len(data):
+        (_, words) = struct.unpack(">2i", data[pos:pos + 8])
+        body = data[pos + 8:pos + 8 + words * 2]
+        pos += 8 + words * 2
+        (stype,) = struct.unpack("<i", body[:4])
+        if stype == SHP_POINT:
+            x, y = struct.unpack("<2d", body[4:20])
+            out.append((stype, [np.array([[x, y]])]))
+        else:
+            nparts, npts = struct.unpack("<2i", body[36:44])
+            part_idx = list(struct.unpack(f"<{nparts}i", body[44:44 + 4 * nparts]))
+            pts = np.frombuffer(
+                body[44 + 4 * nparts:44 + 4 * nparts + 16 * npts], "<f8"
+            ).reshape(-1, 2)
+            bounds = part_idx + [npts]
+            parts = [
+                pts[bounds[i]:bounds[i + 1]].copy() for i in range(nparts)
+            ]
+            out.append((stype, parts))
+    return out

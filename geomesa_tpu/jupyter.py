@@ -1,0 +1,86 @@
+"""Notebook map display — geomesa-jupyter Leaflet parity
+(reference geomesa-jupyter/.../Leaflet.scala: render query results /
+density grids on a Leaflet map inside a notebook cell)."""
+
+from __future__ import annotations
+
+import json
+from typing import Optional, Sequence
+
+import numpy as np
+
+_PAGE = """<!DOCTYPE html>
+<html><head>
+<link rel="stylesheet" href="https://unpkg.com/leaflet@1.9.4/dist/leaflet.css"/>
+<script src="https://unpkg.com/leaflet@1.9.4/dist/leaflet.js"></script>
+<style>#map{{height:{height}px;}}</style>
+</head><body><div id="map"></div>
+<script>
+var map = L.map('map');
+L.tileLayer('https://{{s}}.tile.openstreetmap.org/{{z}}/{{x}}/{{y}}.png',
+  {{attribution: '&copy; OpenStreetMap contributors'}}).addTo(map);
+{layers}
+</script></body></html>"""
+
+
+def _fc_layer(geojson_text: str) -> str:
+    return (
+        f"var gj = L.geoJSON({geojson_text});\n"
+        "gj.addTo(map);\nmap.fitBounds(gj.getBounds());\n"
+    )
+
+
+def _density_layer(grid: np.ndarray, bbox) -> str:
+    xmin, ymin, xmax, ymax = bbox
+    h, w = grid.shape
+    top = float(grid.max()) or 1.0
+    rects = []
+    ys, xs = np.nonzero(grid)
+    for r, c in zip(ys.tolist(), xs.tolist()):
+        a = float(grid[r, c]) / top
+        x0 = xmin + c * (xmax - xmin) / w
+        y0 = ymin + r * (ymax - ymin) / h
+        x1 = xmin + (c + 1) * (xmax - xmin) / w
+        y1 = ymin + (r + 1) * (ymax - ymin) / h
+        rects.append(
+            f"L.rectangle([[{y0:.6f},{x0:.6f}],[{y1:.6f},{x1:.6f}]],"
+            f"{{stroke:false,fillOpacity:{min(0.85, 0.15 + 0.7 * a):.2f},"
+            f"fillColor:'#d7301f'}}).addTo(map);"
+        )
+    fit = f"map.fitBounds([[{ymin},{xmin}],[{ymax},{xmax}]]);"
+    return "\n".join(rects + [fit])
+
+
+def render_features(dataset, name: str, query="INCLUDE",
+                    height: int = 500) -> str:
+    """Query -> standalone Leaflet HTML (display with IPython.display.HTML
+    or write to a file)."""
+    fc = dataset.query(name, query)
+    st = dataset._store(name)
+    from geomesa_tpu.io import geojson
+
+    return _PAGE.format(
+        height=height, layers=_fc_layer(geojson.dumps(st.ft, fc.batch, st.dicts))
+    )
+
+
+def render_density(dataset, name: str, query="INCLUDE", bbox=None,
+                   width: int = 128, height_cells: int = 128,
+                   height: int = 500) -> str:
+    """Density heatmap -> standalone Leaflet HTML."""
+    if bbox is None:
+        bbox = dataset.bounds(name) or (-180, -90, 180, 90)
+    grid = dataset.density(
+        name, query, bbox=bbox, width=width, height=height_cells
+    )
+    return _PAGE.format(height=height, layers=_density_layer(grid, bbox))
+
+
+def show(html: str):
+    """Display in a notebook (no-op fallback outside IPython)."""
+    try:
+        from IPython.display import HTML, display  # type: ignore
+
+        display(HTML(html))
+    except Exception:
+        return html

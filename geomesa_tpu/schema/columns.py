@@ -218,13 +218,17 @@ def encode_batch(
 def decode_batch(
     ft: FeatureType, batch: ColumnBatch, dicts: Dict[str, DictionaryEncoder]
 ) -> Dict[str, Any]:
-    """Columns -> user-facing values (strings decoded, dates as datetime64)."""
+    """Columns -> user-facing values (strings decoded, dates as datetime64).
+
+    Attributes projected out of the batch (Query.properties) are skipped."""
     out: Dict[str, Any] = {"__fid__": batch.columns["__fid__"].tolist()}
     for a in ft.attributes:
+        if not a.is_geom and a.name not in batch.columns:
+            continue
         if a.is_geom:
             if a.name + "__wkt" in batch.columns:
                 out[a.name] = batch.columns[a.name + "__wkt"].tolist()
-            else:
+            elif a.name + "__x" in batch.columns:
                 xs = batch.columns[a.name + "__x"]
                 ys = batch.columns[a.name + "__y"]
                 out[a.name] = list(zip(xs.tolist(), ys.tolist()))

@@ -59,10 +59,9 @@ class MergedDatasetView:
         if not members:
             raise KeyError(f"no member dataset has schema {name!r}")
         ft = members[0].get_schema(name)
-        # fan out WITHOUT per-member limit-sensitive ops; merge client-side
-        sub = Query(
-            ecql=q.ecql, properties=None, sort_by=q.sort_by, auths=q.auths,
-        )
+        # fan out WITHOUT per-member limit/sort/projection; merge client-side
+        # (per-member sorts would be discarded by the merged re-sort anyway)
+        sub = Query(ecql=q.ecql, auths=q.auths)
         batches = []
         for ds in members:
             fc = ds.query(name, sub)
@@ -101,9 +100,11 @@ class MergedDatasetView:
                     col = np.array(
                         ["" if v is None else str(v) for v in col.tolist()]
                     )
-                idx = np.argsort(col[order], kind="stable")
-                if desc:
-                    idx = idx[::-1]
+                col = col[order]
+                if desc:  # stable descending (keeps prior-key tie order)
+                    idx = (len(col) - 1) - np.argsort(col[::-1], kind="stable")[::-1]
+                else:
+                    idx = np.argsort(col, kind="stable")
                 order = order[idx]
             merged = ColumnBatch(
                 {k: v[order] for k, v in merged.columns.items()}, merged.n

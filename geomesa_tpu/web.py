@@ -1,0 +1,174 @@
+"""REST endpoints — geomesa-web parity (GeoMesaStatsEndpoint + catalog).
+
+The reference exposes stats/catalog over Scalatra servlets
+(geomesa-web/.../GeoMesaStatsEndpoint); here a stdlib ThreadingHTTPServer
+serves the same surface as JSON:
+
+    GET /api/version
+    GET /api/schemas                                 -> ["name", ...]
+    GET /api/schemas/<name>                          -> spec + count + indices
+    GET /api/schemas/<name>/count?cql=...            -> {"count": N}
+    GET /api/schemas/<name>/bounds                   -> [xmin, ymin, xmax, ymax]
+    GET /api/schemas/<name>/stats?stat=...&cql=...   -> stat JSON
+    GET /api/schemas/<name>/histogram?attribute=&bins=&cql=
+    GET /api/schemas/<name>/density?bbox=&width=&height=&cql=
+    GET /api/schemas/<name>/features?cql=&max=       -> GeoJSON
+
+Queries pass auths via the ``X-Geomesa-Auths`` header (visibility parity).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+
+def _version() -> str:
+    try:
+        import geomesa_tpu
+
+        return getattr(geomesa_tpu, "__version__", "0.1.0")
+    except Exception:
+        return "0.1.0"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    dataset = None  # injected by serve()
+
+    # quiet the default stderr chatter
+    def log_message(self, fmt, *args):  # noqa: D102
+        pass
+
+    def _send(self, obj, code: int = 200, content_type="application/json"):
+        body = (
+            obj if isinstance(obj, bytes)
+            else json.dumps(obj, default=_jsonable).encode()
+        )
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, code: int, msg: str):
+        self._send({"error": msg}, code)
+
+    def do_GET(self):  # noqa: N802
+        from geomesa_tpu.api.dataset import Query
+
+        ds = self.dataset
+        parsed = urllib.parse.urlparse(self.path)
+        q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        parts = [p for p in parsed.path.split("/") if p]
+        auths_hdr = self.headers.get("X-Geomesa-Auths")
+        auths = auths_hdr.split(",") if auths_hdr is not None else None
+        try:
+            if parts == ["api", "version"]:
+                return self._send({"version": _version()})
+            if parts == ["api", "schemas"]:
+                return self._send(ds.list_schemas())
+            if len(parts) >= 3 and parts[:2] == ["api", "schemas"]:
+                name = urllib.parse.unquote(parts[2])
+                rest = parts[3:]
+                cql = q.get("cql", "INCLUDE")
+                if not rest:
+                    ft = ds.get_schema(name)
+                    st = ds._store(name)
+                    return self._send({
+                        "name": name,
+                        "spec": ft.spec(),
+                        "count": st.count,
+                        "indices": [ks.name for ks in st.keyspaces],
+                    })
+                op = rest[0]
+                if op == "count":
+                    exact = q.get("exact", "true").lower() != "false"
+                    n = ds.count(name, Query(ecql=cql, auths=auths), exact=exact)
+                    return self._send({"count": int(n)})
+                if op == "bounds":
+                    return self._send(ds.bounds(name))
+                if op == "stats":
+                    stat = q.get("stat")
+                    if not stat:
+                        return self._error(400, "missing ?stat=")
+                    s = ds.stats(name, stat, Query(ecql=cql, auths=auths))
+                    return self._send(json.loads(s.to_json()))
+                if op == "histogram":
+                    attr = q.get("attribute")
+                    if not attr:
+                        return self._error(400, "missing ?attribute=")
+                    h = ds.histogram(
+                        name, attr, bins=int(q.get("bins", "20")),
+                        query=Query(ecql=cql, auths=auths),
+                    )
+                    return self._send(json.loads(h.to_json()))
+                if op == "density":
+                    bbox = (
+                        tuple(float(v) for v in q["bbox"].split(","))
+                        if "bbox" in q else None
+                    )
+                    grid = ds.density(
+                        name, Query(ecql=cql, auths=auths), bbox=bbox,
+                        width=int(q.get("width", "256")),
+                        height=int(q.get("height", "256")),
+                    )
+                    return self._send({
+                        "width": grid.shape[1], "height": grid.shape[0],
+                        "nonzero": int(np.count_nonzero(grid)),
+                        "grid": grid.tolist(),
+                    })
+                if op == "features":
+                    from geomesa_tpu.io import geojson
+
+                    fc = ds.query(name, Query(
+                        ecql=cql, auths=auths,
+                        max_features=int(q["max"]) if "max" in q else None,
+                    ))
+                    st = ds._store(name)
+                    text = geojson.dumps(st.ft, fc.batch, st.dicts)
+                    return self._send(
+                        text.encode(), content_type="application/geo+json"
+                    )
+            return self._error(404, f"unknown path {parsed.path!r}")
+        except KeyError as e:
+            return self._error(404, str(e))
+        except ValueError as e:
+            return self._error(400, str(e))
+        except Exception as e:  # pragma: no cover - defensive
+            return self._error(500, f"{type(e).__name__}: {e}")
+
+
+def _jsonable(o):
+    if isinstance(o, (np.integer,)):
+        return int(o)
+    if isinstance(o, (np.floating,)):
+        return float(o)
+    if isinstance(o, np.ndarray):
+        return o.tolist()
+    if isinstance(o, np.datetime64):
+        return str(o)
+    return str(o)
+
+
+def serve(dataset, host: str = "127.0.0.1", port: int = 8080,
+          background: bool = False) -> ThreadingHTTPServer:
+    """Serve the REST surface for a GeoDataset. ``background=True`` runs the
+    server in a daemon thread and returns it (tests / notebooks)."""
+    handler = type("Handler", (_Handler,), {"dataset": dataset})
+    server = ThreadingHTTPServer((host, port), handler)
+    if background:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        return server
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return server

@@ -1,0 +1,300 @@
+"""Export formats (Avro/SHP/GML/ORC) and converter inputs
+(XML / fixed-width / Parquet / Avro)."""
+
+import io
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+
+SPEC = "name:String,v:Integer,w:Float,dtg:Date,*geom:Point"
+
+
+def _ds(n=50, seed=0):
+    rng = np.random.default_rng(seed)
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", SPEC)
+    ds.insert("t", {
+        "geom__x": rng.uniform(-10, 10, n),
+        "geom__y": rng.uniform(-10, 10, n),
+        "dtg": rng.integers(1577836800000, 1580515200000, n).astype("datetime64[ms]"),
+        "name": rng.choice(["a", "b", None], n),
+        "v": rng.integers(0, 100, n),
+        "w": rng.uniform(0, 1, n),
+    }, fids=np.array([f"f{i}" for i in range(n)]))
+    ds.flush("t")
+    return ds
+
+
+# -- avro ---------------------------------------------------------------------
+
+def test_avro_round_trip(tmp_path):
+    from geomesa_tpu.io import avro_io
+
+    ds = _ds()
+    st = ds._store("t")
+    path = str(tmp_path / "x.avro")
+    avro_io.write_avro(path, st.ft, st._all, st.dicts)
+    schema, records = avro_io.read_avro(path)
+    assert schema["name"] == "t"
+    assert len(records) == 50
+    r0 = next(r for r in records if r["__fid__"] == "f0")
+    d = ds.query("t").to_dict()
+    i = d["__fid__"].index("f0") if isinstance(d["__fid__"], list) else list(d["__fid__"]).index("f0")
+    assert r0["v"] == d["v"][i]
+    assert r0["geom"].startswith("POINT")
+    assert abs(r0["w"] - float(d["w"][i])) < 1e-6
+
+
+def test_avro_none_string(tmp_path):
+    from geomesa_tpu.io import avro_io
+
+    ds = _ds()
+    st = ds._store("t")
+    buf = io.BytesIO()
+    avro_io.write_avro(buf, st.ft, st._all, st.dicts)
+    buf.seek(0)
+    _, records = avro_io.read_avro(buf)
+    names = [r["name"] for r in records]
+    assert None in names and "a" in names
+
+
+def test_avro_converter_ingest(tmp_path):
+    from geomesa_tpu.io import avro_io
+
+    src = _ds()
+    st = src._store("t")
+    path = str(tmp_path / "x.avro")
+    avro_io.write_avro(path, st.ft, st._all, st.dicts)
+
+    dst = GeoDataset(n_shards=2)
+    dst.create_schema("t", SPEC)
+    ctx = dst.ingest("t", path, {
+        "type": "avro",
+        "id-field": "$__fid__",
+        "fields": [
+            {"name": "geom", "transform": "point($geom)"},
+        ],
+    })
+    assert ctx.success == 50
+    assert dst.count("t") == 50
+    assert sorted(dst.unique("t", "name"), key=str) == sorted(
+        src.unique("t", "name"), key=str
+    )
+
+
+# -- shapefile ----------------------------------------------------------------
+
+def test_shapefile_points(tmp_path):
+    from geomesa_tpu.io import shapefile
+
+    ds = _ds(n=20)
+    st = ds._store("t")
+    base = shapefile.write_shapefile(
+        str(tmp_path / "pts.shp"), st.ft, st._all, st.dicts
+    )
+    for ext in (".shp", ".shx", ".dbf"):
+        assert os.path.exists(base + ext)
+    recs = shapefile.read_shapefile(base)
+    assert len(recs) == 20
+    assert all(t == shapefile.SHP_POINT for t, _ in recs)
+    xs = sorted(p[0][0, 0] for _, p in recs)
+    want = sorted(st._all.columns["geom__x"])
+    np.testing.assert_allclose(xs, want, rtol=1e-12)
+
+
+def test_shapefile_polygons(tmp_path):
+    from geomesa_tpu.io import shapefile
+
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("p", "v:Integer,dtg:Date,*geom:Polygon")
+    ds.insert("p", {
+        "geom": np.array([
+            "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))",
+            "POLYGON ((10 10, 14 10, 14 14, 10 14, 10 10), (11 11, 12 11, 12 12, 11 12, 11 11))",
+        ], object),
+        "dtg": np.array(["2020-01-01", "2020-01-02"], "datetime64[ms]"),
+        "v": np.array([1, 2]),
+    }, fids=np.array(["p1", "p2"]))
+    ds.flush("p")
+    st = ds._store("p")
+    base = shapefile.write_shapefile(
+        str(tmp_path / "polys.shp"), st.ft, st._all, st.dicts
+    )
+    recs = shapefile.read_shapefile(base)
+    assert len(recs) == 2
+    assert all(t == shapefile.SHP_POLYGON for t, _ in recs)
+    donut = next(p for _, p in recs if len(p) == 2)  # shell + hole
+    assert len(donut[0]) == 5
+
+
+# -- gml ----------------------------------------------------------------------
+
+def test_gml_export():
+    import xml.etree.ElementTree as ET
+
+    from geomesa_tpu.io import gml
+
+    ds = _ds(n=5)
+    st = ds._store("t")
+    text = gml.dumps(st.ft, st._all, st.dicts)
+    root = ET.fromstring(text)  # well-formed
+    ns = {"gml": "http://www.opengis.net/gml", "geomesa": "http://geomesa.org"}
+    members = root.findall("gml:featureMember", ns)
+    assert len(members) == 5
+    pos = members[0].find(".//gml:pos", ns)
+    assert pos is not None and len(pos.text.split()) == 2
+
+
+# -- CLI orc ------------------------------------------------------------------
+
+def test_cli_export_orc_and_gml(tmp_path, monkeypatch):
+    import pyarrow.orc as orc
+
+    from geomesa_tpu import cli
+
+    ds = _ds(n=10)
+    cat = str(tmp_path / "cat")
+    ds.save(cat)
+    out = str(tmp_path / "x.orc")
+    cli.main(["export", "-c", cat, "-f", "t", "-F", "orc", "-o", out])
+    assert orc.read_table(out).num_rows == 10
+    gml_out = str(tmp_path / "x.gml")
+    cli.main(["export", "-c", cat, "-f", "t", "-F", "gml", "-o", gml_out])
+    assert "FeatureCollection" in open(gml_out).read()
+
+
+# -- converters ---------------------------------------------------------------
+
+def test_xml_converter():
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+    xml = """
+    <root>
+      <obs id="a1"><who>alice</who><when>2020-01-05T00:00:00Z</when>
+        <loc lon="1.5" lat="2.5"/></obs>
+      <obs id="a2"><who>bob</who><when>2020-01-06T00:00:00Z</when>
+        <loc lon="3.5" lat="4.5"/></obs>
+    </root>
+    """
+    ctx = ds.ingest("t", xml, {
+        "type": "xml",
+        "feature-path": "obs",
+        "id-field": "$id",
+        "fields": [
+            {"name": "id", "path": "@id"},
+            {"name": "name", "path": "who"},
+            {"name": "when_s", "path": "when"},
+            {"name": "dtg", "transform": "isoDateTime($when_s)"},
+            {"name": "lon", "path": "loc/@lon"},
+            {"name": "lat", "path": "loc/@lat"},
+            {"name": "geom", "transform": "point(toDouble($lon), toDouble($lat))"},
+        ],
+    })
+    assert ctx.success == 2, ctx.errors
+    d = ds.query("t").to_dict()
+    assert sorted(d["name"]) == ["alice", "bob"]
+    assert sorted(d["__fid__"]) == ["a1", "a2"]
+
+
+def test_fixed_width_converter():
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+    #       0123456789012345678901234567890
+    lines = (
+        "alice 2020-01-05  1.50  2.50\n"
+        "bob   2020-01-06  3.50  4.50\n"
+    )
+    ctx = ds.ingest("t", lines, {
+        "type": "fixed-width",
+        "fields": [
+            {"name": "name", "start": 0, "width": 6},
+            {"name": "d", "start": 6, "width": 12},
+            {"name": "dtg", "transform": "date('yyyy-MM-dd', $d)"},
+            {"name": "xs", "start": 18, "width": 6},
+            {"name": "ys", "start": 24, "width": 6},
+            {"name": "geom", "transform": "point(toDouble($xs), toDouble($ys))"},
+        ],
+    })
+    assert ctx.success == 2, ctx.errors
+    d = ds.query("t").to_dict()
+    assert sorted(d["name"]) == ["alice", "bob"]
+    assert sorted(x for x, y in d["geom"]) == [1.5, 3.5]
+
+
+def test_gml_avro_export_with_projection(tmp_path):
+    """Projected queries (Query.properties) export without the dropped
+    columns instead of crashing."""
+    from geomesa_tpu.api.dataset import Query
+    from geomesa_tpu.io import avro_io, gml
+
+    ds = _ds(n=8)
+    st = ds._store("t")
+    fc = ds.query("t", Query(properties=["name", "geom"]))
+    text = gml.dumps(st.ft, fc.batch, st.dicts)
+    assert "geomesa:name" in text and "geomesa:v" not in text
+    buf = io.BytesIO()
+    avro_io.write_avro(buf, st.ft, fc.batch, st.dicts)
+    buf.seek(0)
+    schema, records = avro_io.read_avro(buf)
+    names = {f["name"] for f in schema["fields"]}
+    assert names == {"__fid__", "name", "geom"}
+    assert len(records) == 8
+
+
+def test_parquet_converter_line_offsets(tmp_path):
+    """Chunked columnar ingest must thread the batch offset so
+    lineNo()-derived ids stay unique across chunks."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from geomesa_tpu.convert import EvaluationContext, converter_for
+
+    path = str(tmp_path / "in.parquet")
+    pq.write_table(pa.table({
+        "lon": [1.0, 2.0, 3.0], "lat": [1.0, 2.0, 3.0],
+        "ts": np.array(["2020-01-01"] * 3, "datetime64[ms]"),
+    }), path)
+    ds = GeoDataset(n_shards=2)
+    ft = ds.create_schema("t", "dtg:Date,*geom:Point")
+    conv = converter_for(ft, {
+        "type": "parquet",
+        "id-field": "toString(lineNo())",
+        "fields": [
+            {"name": "dtg", "transform": "$ts"},
+            {"name": "geom", "transform": "point($lon, $lat)"},
+        ],
+    })
+    ctx = EvaluationContext()
+    fids = []
+    for data, f in conv.convert(path, ctx, batch_size=1):  # 1-row chunks
+        fids.extend(f.tolist() if hasattr(f, "tolist") else list(f))
+    assert len(set(fids)) == 3, fids
+
+
+def test_parquet_converter(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    path = str(tmp_path / "in.parquet")
+    pq.write_table(pa.table({
+        "who": ["alice", "bob"],
+        "ts": np.array(["2020-01-05", "2020-01-06"], "datetime64[ms]"),
+        "lon": [1.5, 3.5],
+        "lat": [2.5, 4.5],
+    }), path)
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "name:String,dtg:Date,*geom:Point")
+    ctx = ds.ingest("t", path, {
+        "type": "parquet",
+        "fields": [
+            {"name": "name", "transform": "toString($who)"},
+            {"name": "dtg", "transform": "$ts"},
+            {"name": "geom", "transform": "point($lon, $lat)"},
+        ],
+    })
+    assert ctx.success == 2, ctx.errors
+    assert ds.count("t") == 2
+    assert sorted(ds.query("t").to_dict()["name"]) == ["alice", "bob"]

@@ -179,6 +179,42 @@ def test_update_schema_polygon_geometry():
     assert ds.count("p", "BBOX(geom, 10, 10, 12, 12)") == 0
 
 
+def test_update_schema_with_user_data():
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema(
+        "u", "v:Integer,dtg:Date,*geom:Point;geomesa.z3.interval='day'"
+    )
+    ds.insert("u", {
+        "geom__x": np.array([1.0]), "geom__y": np.array([2.0]),
+        "dtg": np.array(["2020-01-01"], "datetime64[ms]"),
+        "v": np.array([7]),
+    }, fids=np.array(["x1"]))
+    ds.flush("u")
+    ft = ds.update_schema("u", "score:Float")
+    assert ft.has("score")
+    assert ds.count("u") == 1
+    assert ft.time_period == ds.get_schema("u").time_period
+
+
+def test_merged_sort_descending_stable():
+    """Descending primary key must not reverse the secondary key's order."""
+    a = GeoDataset(n_shards=2)
+    a.create_schema("t", SPEC)
+    a.insert("t", {
+        "geom__x": np.zeros(4), "geom__y": np.zeros(4),
+        "dtg": np.array(["2020-01-01"] * 4, "datetime64[ms]"),
+        "name": np.array(["b", "b", "a", "a"], object),
+        "v": np.array([4, 2, 3, 1]),
+    }, fids=np.array(["r1", "r2", "r3", "r4"]))
+    a.flush("t")
+    view = MergedDatasetView([a])
+    fc = view.query("t", Query(sort_by=[("name", True), ("v", False)]))
+    d = fc.to_dict()
+    assert list(zip(d["name"], [int(x) for x in d["v"]])) == [
+        ("b", 2), ("b", 4), ("a", 1), ("a", 3),
+    ]
+
+
 def test_merged_query_unknown_schema():
     ds, _ = _make(9, n=10)
     view = MergedDatasetView([ds])

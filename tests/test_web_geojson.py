@@ -1,0 +1,133 @@
+"""REST endpoint (geomesa-web analog), GeoJSON façade, Leaflet helper."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.geojson_api import GeoJsonIndex
+
+
+def _ds(n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "name:String,v:Integer,dtg:Date,*geom:Point")
+    ds.insert("t", {
+        "geom__x": rng.uniform(-10, 10, n),
+        "geom__y": rng.uniform(-10, 10, n),
+        "dtg": rng.integers(1577836800000, 1580515200000, n).astype("datetime64[ms]"),
+        "name": rng.choice(["a", "b"], n),
+        "v": rng.integers(0, 100, n),
+    }, fids=np.array([f"f{i}" for i in range(n)]))
+    ds.flush("t")
+    return ds
+
+
+@pytest.fixture(scope="module")
+def server():
+    from geomesa_tpu import web
+
+    ds = _ds()
+    srv = web.serve(ds, "127.0.0.1", 0, background=True)
+    port = srv.server_address[1]
+    yield f"http://127.0.0.1:{port}", ds
+    srv.shutdown()
+
+
+def _get(base, path, headers=None):
+    req = urllib.request.Request(base + path, headers=headers or {})
+    with urllib.request.urlopen(req) as r:
+        body = r.read()
+        ct = r.headers.get("Content-Type", "")
+    return json.loads(body), ct
+
+
+def test_rest_endpoints(server):
+    base, ds = server
+    v, _ = _get(base, "/api/version")
+    assert "version" in v
+    schemas, _ = _get(base, "/api/schemas")
+    assert schemas == ["t"]
+    info, _ = _get(base, "/api/schemas/t")
+    assert info["count"] == 200 and "z3" in info["indices"]
+    cnt, _ = _get(base, "/api/schemas/t/count?cql=" +
+                  urllib.parse.quote("BBOX(geom, 0, 0, 10, 10)"))
+    assert cnt["count"] == ds.count("t", "BBOX(geom, 0, 0, 10, 10)")
+    b, _ = _get(base, "/api/schemas/t/bounds")
+    assert len(b) == 4
+    st, _ = _get(base, "/api/schemas/t/stats?stat=" +
+                 urllib.parse.quote("MinMax(v)"))
+    assert st["kind"] == "minmax"
+    h, _ = _get(base, "/api/schemas/t/histogram?attribute=v&bins=10")
+    assert h["kind"] == "histogram"
+    dmap, _ = _get(base, "/api/schemas/t/density?bbox=-10,-10,10,10&width=16&height=16")
+    assert dmap["width"] == 16
+    assert abs(sum(map(sum, dmap["grid"])) - 200) < 1e-2
+    fc, ct = _get(base, "/api/schemas/t/features?max=5")
+    assert ct.startswith("application/geo+json")
+    assert len(fc["features"]) == 5
+
+
+def test_rest_errors(server):
+    base, _ = server
+    with pytest.raises(urllib.request.HTTPError) as ei:
+        _get(base, "/api/schemas/nope")
+    assert ei.value.code == 404
+    with pytest.raises(urllib.request.HTTPError) as ei:
+        _get(base, "/api/schemas/t/stats")
+    assert ei.value.code == 400
+
+
+import urllib.parse  # noqa: E402  (used above in f-strings)
+
+
+def test_geojson_index_roundtrip():
+    ds = GeoDataset(n_shards=2)
+    api = GeoJsonIndex(ds)
+    api.create_index("pts")
+    fc = {
+        "type": "FeatureCollection",
+        "features": [
+            {"type": "Feature", "id": "a",
+             "geometry": {"type": "Point", "coordinates": [1.0, 2.0]},
+             "properties": {"name": "alice", "score": 10}},
+            {"type": "Feature", "id": "b",
+             "geometry": {"type": "Point", "coordinates": [5.0, 6.0]},
+             "properties": {"name": "bob", "score": 30}},
+        ],
+    }
+    ids = api.add("pts", fc)
+    assert ids == ["a", "b"]
+    # bbox query
+    got = api.query("pts", {"bbox": [0, 0, 3, 3]})
+    assert len(got) == 1 and got[0]["properties"]["name"] == "alice"
+    # property equality
+    got = api.query("pts", {"properties.name": "bob"})
+    assert len(got) == 1 and got[0]["id"] == "b"
+    # comparison
+    got = api.query("pts", {"properties.score": {"$gt": 20}})
+    assert [d["id"] for d in got] == ["b"]
+    # $or
+    got = api.query("pts", {"$or": [
+        {"properties.name": "alice"}, {"properties.name": "bob"},
+    ]})
+    assert len(got) == 2
+    # intersects with polygon
+    got = api.query("pts", {"intersects": {
+        "type": "Polygon",
+        "coordinates": [[[0, 0], [2, 0], [2, 3], [0, 3], [0, 0]]],
+    }})
+    assert len(got) == 1 and got[0]["id"] == "a"
+
+
+def test_leaflet_render():
+    from geomesa_tpu import jupyter
+
+    ds = _ds(n=20)
+    html = jupyter.render_features(ds, "t")
+    assert "L.geoJSON" in html and "leaflet" in html
+    html = jupyter.render_density(ds, "t", bbox=(-10, -10, 10, 10),
+                                  width=16, height_cells=16)
+    assert "L.rectangle" in html and "fitBounds" in html
