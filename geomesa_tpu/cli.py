@@ -146,6 +146,23 @@ def cmd_export(args):
         pq.write_table(table, path)
         print(f"wrote {path} ({table.num_rows} rows)")
         return
+    if fmt == "orc":
+        import pyarrow as pa
+        import pyarrow.orc as orc
+
+        table = ds.to_arrow(args.feature_name, q)
+        # ORC has no dictionary type: decode dictionary-encoded strings
+        cols = []
+        for i, f in enumerate(table.schema):
+            col = table.column(i)
+            if pa.types.is_dictionary(f.type):
+                col = col.cast(f.type.value_type)
+            cols.append(col)
+        table = pa.table(cols, names=table.schema.names)
+        path = out or "export.orc"
+        orc.write_table(table, path)
+        print(f"wrote {path} ({table.num_rows} rows)")
+        return
     fc = ds.query(args.feature_name, q)
     if fmt in ("geojson", "json"):
         from geomesa_tpu.io import geojson
@@ -196,23 +213,6 @@ def cmd_export(args):
         path = out or "export.avro"
         avro_io.write_avro(path, st.ft, fc.batch, st.dicts)
         print(f"wrote {path} ({fc.batch.n} features)")
-        return
-    if fmt == "orc":
-        import pyarrow as pa
-        import pyarrow.orc as orc
-
-        table = ds.to_arrow(args.feature_name, q)
-        # ORC has no dictionary type: decode dictionary-encoded strings
-        cols = []
-        for i, f in enumerate(table.schema):
-            col = table.column(i)
-            if pa.types.is_dictionary(f.type):
-                col = col.cast(f.type.value_type)
-            cols.append(col)
-        table = pa.table(cols, names=table.schema.names)
-        path = out or "export.orc"
-        orc.write_table(table, path)
-        print(f"wrote {path} ({table.num_rows} rows)")
         return
     raise SystemExit(f"unknown export format {args.format!r}")
 

@@ -93,17 +93,21 @@ class GeoJsonIndex:
         self.ds.flush(name)
         return fids
 
-    # -- query translation (JSON query -> CQL) -----------------------------
-    def _to_cql(self, q: "Dict | None") -> str:
+    # -- query translation (JSON query -> coarse CQL + exact doc filter) ---
+    #
+    # CQL is only the *index acceleration*: clauses that can't be translated
+    # safely (properties.*, anything under $or) coarsen to INCLUDE. The
+    # exact semantics come from `_doc_match`, which is always applied to the
+    # returned documents — so $or nesting and quoting in values cannot
+    # change the result set, only the amount scanned.
+    @classmethod
+    def _to_cql(cls, q: "Dict | None") -> str:
         if not q:
             return "INCLUDE"
         clauses = []
         for k, v in q.items():
-            if k == "$or":
-                parts = [self._to_cql(sub) for sub in v]
-                clauses.append("(" + " OR ".join(parts) + ")")
-            elif k == "bbox":
-                xmin, ymin, xmax, ymax = v
+            if k == "bbox":
+                xmin, ymin, xmax, ymax = (float(t) for t in v)
                 clauses.append(f"BBOX(geom, {xmin}, {ymin}, {xmax}, {ymax})")
             elif k == "intersects":
                 clauses.append(f"INTERSECTS(geom, {_geom_to_wkt(v)})")
@@ -112,16 +116,8 @@ class GeoJsonIndex:
                 clauses.append(
                     f"DWITHIN(geom, {_geom_to_wkt(g)}, {meters}, meters)"
                 )
-            elif k.startswith("properties."):
-                # property predicates evaluate host-side on the JSON column
-                clauses.append(("__PROP__", k[len("properties."):], v))
-            elif k == "id":
-                clauses.append(f"IN ('{v}')")
-            else:
-                raise ValueError(f"unsupported query key {k!r}")
-        cql_parts = [c for c in clauses if isinstance(c, str)]
-        self._prop_filters = [c for c in clauses if not isinstance(c, str)]
-        return " AND ".join(cql_parts) if cql_parts else "INCLUDE"
+            # properties.* / id / $or: host-side exact filter only
+        return " AND ".join(clauses) if clauses else "INCLUDE"
 
     def query(self, name: str, q: "Dict | str | None" = None,
               max_features: Optional[int] = None) -> List[Dict]:
@@ -130,7 +126,7 @@ class GeoJsonIndex:
 
         if isinstance(q, str):
             q = json.loads(q) if q.strip() else None
-        self._prop_filters = []
+        _validate_query(q)
         cql = self._to_cql(q)
         fc = self.ds.query(name, Query(ecql=cql, max_features=None))
         st = self.ds._store(name)
@@ -139,11 +135,73 @@ class GeoJsonIndex:
             return []
         texts = st.dicts["json"].decode(codes)
         docs = [json.loads(t) for t in texts if t is not None]
-        for _, prop, cond in self._prop_filters:
-            docs = [d for d in docs if _prop_match(d, prop, cond)]
+        docs = [d for d in docs if _doc_match(d, q)]
         if max_features is not None:
             docs = docs[:max_features]
         return docs
+
+
+_KNOWN_KEYS = {"bbox", "intersects", "dwithin", "id", "$or"}
+
+
+def _validate_query(q: "Dict | None"):
+    if not q:
+        return
+    for k, v in q.items():
+        if k == "$or":
+            for sub in v:
+                _validate_query(sub)
+        elif k not in _KNOWN_KEYS and not k.startswith("properties."):
+            raise ValueError(f"unsupported query key {k!r}")
+
+
+def _point_of(doc: Dict):
+    c = (doc.get("geometry") or {}).get("coordinates") or (0.0, 0.0)
+    return float(c[0]), float(c[1])
+
+
+def _doc_match(doc: Dict, q: "Dict | None") -> bool:
+    """Exact host-side evaluation of the JSON query against one document."""
+    if not q:
+        return True
+    from geomesa_tpu.utils import geometry as geo
+    from geomesa_tpu.utils.geometry import haversine_m, parse_wkt
+
+    for k, v in q.items():
+        if k == "$or":
+            if not any(_doc_match(doc, sub) for sub in v):
+                return False
+        elif k == "bbox":
+            x, y = _point_of(doc)
+            xmin, ymin, xmax, ymax = (float(t) for t in v)
+            if not (xmin <= x <= xmax and ymin <= y <= ymax):
+                return False
+        elif k == "intersects":
+            x, y = _point_of(doc)
+            g = parse_wkt(_geom_to_wkt(v))
+            if not bool(np.asarray(g.contains_points([x], [y]))[0]):
+                return False
+        elif k == "dwithin":
+            x, y = _point_of(doc)
+            g = parse_wkt(_geom_to_wkt(v["geometry"]))
+            if isinstance(g, geo.Point):
+                d = haversine_m(x, y, g.x, g.y)
+            else:  # nearest-vertex approximation for non-point targets
+                b = g.bounds()
+                verts = [(b[0], b[1]), (b[0], b[3]), (b[2], b[1]), (b[2], b[3])]
+                d = min(haversine_m(x, y, vx, vy) for vx, vy in verts)
+                if bool(np.asarray(g.contains_points([x], [y]))[0]):
+                    d = 0.0
+            if d > float(v["distance"]):
+                return False
+        elif k == "id":
+            did = doc.get("id") or (doc.get("properties") or {}).get("id")
+            if str(did) != str(v):
+                return False
+        elif k.startswith("properties."):
+            if not _prop_match(doc, k[len("properties."):], v):
+                return False
+    return True
 
 
 def _prop_match(doc: Dict, prop: str, cond: Any) -> bool:

@@ -109,15 +109,14 @@ def avro_schema(ft, attrs: Optional[List[str]] = None) -> Dict[str, Any]:
     }
 
 
-def _rows(ft, batch, dicts, names) -> Iterator[Tuple[Any, ...]]:
-    from geomesa_tpu.schema.columns import decode_batch
-
-    d = decode_batch(ft, batch, dicts)
+def _rows(ft, d, names) -> Iterator[Tuple[Any, ...]]:
+    """Iterate already-decoded columns ``d`` in schema order over ``names``."""
     geom_names = {a.name for a in ft.attributes if a.is_geom}
     point_names = {
         a.name for a in ft.attributes if a.is_geom and a.is_point
     }
-    for i in range(batch.n):
+    n = len(d["__fid__"])
+    for i in range(n):
         row: List[Any] = [str(d["__fid__"][i])]
         for name in names:
             v = d[name][i]
@@ -134,8 +133,8 @@ def write_avro(path_or_buf, ft, batch, dicts, sync: Optional[bytes] = None):
     (missing columns) produce a correspondingly reduced schema."""
     from geomesa_tpu.schema.columns import decode_batch
 
-    present = set(decode_batch(ft, batch, dicts))
-    attrs = [a.name for a in ft.attributes if a.name in present]
+    d = decode_batch(ft, batch, dicts)
+    attrs = [a.name for a in ft.attributes if a.name in d]
     schema = avro_schema(ft, attrs)
     types = [f["type"] for f in schema["fields"]]
     sync = sync or os.urandom(16)
@@ -155,7 +154,7 @@ def write_avro(path_or_buf, ft, batch, dicts, sync: Optional[bytes] = None):
 
         block = io.BytesIO()
         n = 0
-        for row in _rows(ft, batch, dicts, attrs):
+        for row in _rows(ft, d, attrs):
             _write_row(block, row, types)
             n += 1
         if n:
@@ -172,7 +171,9 @@ def write_avro(path_or_buf, ft, batch, dicts, sync: Optional[bytes] = None):
 def _write_row(buf: io.BytesIO, row, types):
     for v, t in zip(row, types):
         if isinstance(t, list):  # nullable union
-            if v is None or (isinstance(v, float) and np.isnan(v)):
+            if v is None or (
+                isinstance(v, (float, np.floating)) and np.isnan(v)
+            ):
                 write_long(buf, 0)
                 continue
             write_long(buf, 1)

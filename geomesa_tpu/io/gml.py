@@ -9,7 +9,7 @@ posLists, srsDimension 2).
 from __future__ import annotations
 
 from typing import Dict
-from xml.sax.saxutils import escape
+from xml.sax.saxutils import escape, quoteattr
 
 import numpy as np
 
@@ -81,12 +81,14 @@ def dumps(ft, batch, dicts: Dict) -> str:
     out = [_HEADER]
     for i in range(batch.n):
         out.append("<gml:featureMember>")
-        out.append(f'<geomesa:{tn} gml:id="{escape(str(d["__fid__"][i]))}">')
+        out.append(f'<geomesa:{tn} gml:id={quoteattr(str(d["__fid__"][i]))}>')
         for a in ft.attributes:
             if a.name not in d:  # projected out
                 continue
             v = d[a.name][i]
-            if v is None or (isinstance(v, float) and np.isnan(v)):
+            if v is None or (
+                isinstance(v, (float, np.floating)) and np.isnan(v)
+            ):
                 continue
             if a.is_geom:
                 if isinstance(v, str):

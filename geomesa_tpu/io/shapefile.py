@@ -20,6 +20,13 @@ from geomesa_tpu.utils import geometry as geo
 SHP_POINT = 1
 SHP_POLYLINE = 3
 SHP_POLYGON = 5
+SHP_MULTIPOINT = 8
+
+
+def _is_null(v) -> bool:
+    return v is None or (
+        isinstance(v, (float, np.floating)) and np.isnan(v)
+    )
 
 
 def _geom_parts(g) -> Tuple[int, List[np.ndarray]]:
@@ -27,8 +34,9 @@ def _geom_parts(g) -> Tuple[int, List[np.ndarray]]:
     if isinstance(g, geo.Point):
         return SHP_POINT, [np.array([[g.x, g.y]])]
     if isinstance(g, geo.MultiPoint):
-        p = g.points[0]
-        return SHP_POINT, [np.array([[p.x, p.y]])]
+        return SHP_MULTIPOINT, [
+            np.array([[p.x, p.y] for p in g.points])
+        ]
     if isinstance(g, geo.LineString):
         return SHP_POLYLINE, [np.asarray(g.coords)]
     if isinstance(g, geo.MultiLineString):
@@ -54,6 +62,11 @@ def _record_bytes(shape_type: int, parts: List[np.ndarray]) -> bytes:
     pts = np.concatenate(parts)
     xmin, ymin = pts.min(axis=0)
     xmax, ymax = pts.max(axis=0)
+    if shape_type == SHP_MULTIPOINT:  # no parts array in the record
+        return (
+            struct.pack("<i4di", SHP_MULTIPOINT, xmin, ymin, xmax, ymax, len(pts))
+            + pts.astype("<f8").tobytes()
+        )
     out = struct.pack(
         "<i4dii", shape_type, xmin, ymin, xmax, ymax, len(parts), len(pts)
     )
@@ -157,11 +170,11 @@ def _write_dbf(path: str, attrs, d: Dict[str, Any], n: int):
                 v = d[a.name][i]
                 if typ == b"D":
                     s = (
-                        "        " if v is None
+                        "        " if _is_null(v)
                         else str(np.datetime64(v, "D")).replace("-", "")
                     )
                 elif typ == b"N":
-                    if v is None or (isinstance(v, float) and np.isnan(v)):
+                    if _is_null(v):
                         s = " " * width
                     elif dec:
                         s = f"{float(v):.{dec}f}".rjust(width)
@@ -188,6 +201,10 @@ def read_shapefile(path: str) -> List[Tuple[int, List[np.ndarray]]]:
         if stype == SHP_POINT:
             x, y = struct.unpack("<2d", body[4:20])
             out.append((stype, [np.array([[x, y]])]))
+        elif stype == SHP_MULTIPOINT:
+            (npts,) = struct.unpack("<i", body[36:40])
+            pts = np.frombuffer(body[40:40 + 16 * npts], "<f8").reshape(-1, 2)
+            out.append((stype, [pts.copy()]))
         else:
             nparts, npts = struct.unpack("<2i", body[36:44])
             part_idx = list(struct.unpack(f"<{nparts}i", body[44:44 + 4 * nparts]))

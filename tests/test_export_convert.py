@@ -132,6 +132,42 @@ def test_shapefile_polygons(tmp_path):
 
 # -- gml ----------------------------------------------------------------------
 
+def test_shapefile_multipoint(tmp_path):
+    from geomesa_tpu.io import shapefile
+
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("mp", "dtg:Date,*geom:MultiPoint")
+    ds.insert("mp", {
+        "geom": np.array(["MULTIPOINT ((1 1), (2 2), (3 3))"], object),
+        "dtg": np.array(["2020-01-01"], "datetime64[ms]"),
+    }, fids=np.array(["m1"]))
+    ds.flush("mp")
+    st = ds._store("mp")
+    base = shapefile.write_shapefile(
+        str(tmp_path / "mp.shp"), st.ft, st._all, st.dicts
+    )
+    recs = shapefile.read_shapefile(base)
+    assert recs[0][0] == shapefile.SHP_MULTIPOINT
+    assert len(recs[0][1][0]) == 3  # all three points survive
+
+
+def test_gml_quote_in_fid():
+    import xml.etree.ElementTree as ET
+
+    from geomesa_tpu.io import gml
+
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "dtg:Date,*geom:Point")
+    ds.insert("t", {
+        "geom__x": np.array([1.0]), "geom__y": np.array([2.0]),
+        "dtg": np.array(["2020-01-01"], "datetime64[ms]"),
+    }, fids=np.array(['my"fid']))
+    ds.flush("t")
+    st = ds._store("t")
+    text = gml.dumps(st.ft, st._all, st.dicts)
+    ET.fromstring(text)  # must stay well-formed
+
+
 def test_gml_export():
     import xml.etree.ElementTree as ET
 

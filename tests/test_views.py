@@ -270,3 +270,28 @@ def test_stats_api_surface():
     assert (mm["min"], mm["max"]) == (data["v"].min(), data["v"].max())
     z = ds.z3_histogram("t")
     assert z is not None and not z.is_empty
+
+
+def test_tokenless_plan_windows_not_stale():
+    """Reusing a raw-IR plan object across a mutation must see new rows
+    (regression: cached device window arrays outliving store.version)."""
+    from geomesa_tpu.filter import parse_ecql
+
+    ds, _ = _make(11, n=1000)
+    st = ds._store("t")
+    ex = ds._executor(st)
+    from geomesa_tpu.planning.planner import QueryPlanner
+
+    plan = QueryPlanner(st).plan(parse_ecql("BBOX(geom, -20, -20, 20, 20)"))
+    assert plan.__dict__.get("cache_token") is None
+    c1 = ex.count(plan)
+    ds.insert("t", {
+        "geom__x": np.array([0.0]), "geom__y": np.array([0.0]),
+        "dtg": np.array(["2020-01-15"], "datetime64[ms]"),
+        "name": np.array(["a"], object), "v": np.array([1]),
+    }, fids=np.array(["fresh"]))
+    ds.flush("t")
+    plan2 = QueryPlanner(st).plan(parse_ecql("BBOX(geom, -20, -20, 20, 20)"))
+    assert ex.count(plan2) == c1 + 1
+    # the ORIGINAL plan object, re-executed, must also see the new row
+    assert ex.count(plan) == c1 + 1

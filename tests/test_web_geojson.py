@@ -122,6 +122,35 @@ def test_geojson_index_roundtrip():
     assert len(got) == 1 and got[0]["id"] == "a"
 
 
+def test_geojson_or_is_exact():
+    """$or with property predicates must not over-return (regression: prop
+    clauses inside $or were dropped, matching everything)."""
+    ds = GeoDataset(n_shards=2)
+    api = GeoJsonIndex(ds)
+    api.create_index("pts")
+    api.add("pts", {"type": "FeatureCollection", "features": [
+        {"type": "Feature", "id": i,
+         "geometry": {"type": "Point", "coordinates": [float(i), 0.0]},
+         "properties": {"name": n}}
+        for i, n in enumerate(["alice", "bob", "carol", "dave"])
+    ]})
+    got = api.query("pts", {"$or": [
+        {"properties.name": "alice"}, {"properties.name": "bob"},
+    ]})
+    assert sorted(d["properties"]["name"] for d in got) == ["alice", "bob"]
+    # mixed spatial + property inside $or
+    got = api.query("pts", {"$or": [
+        {"bbox": [2.5, -1, 3.5, 1]},          # dave's point only
+        {"properties.name": "alice"},
+    ]})
+    assert sorted(d["properties"]["name"] for d in got) == ["alice", "dave"]
+    # quoting in values cannot break the filter
+    got = api.query("pts", {"id": "o'brien"})
+    assert got == []
+    with pytest.raises(ValueError):
+        api.query("pts", {"$where": "1=1"})
+
+
 def test_leaflet_render():
     from geomesa_tpu import jupyter
 
