@@ -44,6 +44,8 @@ class Query:
     properties: Optional[List[str]] = None
     sort_by: Optional[List[Tuple[str, bool]]] = None  # (attr, descending)
     sampling: Optional[int] = None
+    #: per-key sampling attribute: 1-in-``sampling`` per distinct value
+    sample_by: Optional[str] = None
     index: Optional[str] = None
     #: visibility authorizations for this query (None = dataset default)
     auths: Optional[List[str]] = None
@@ -52,6 +54,7 @@ class Query:
         return QueryHints(
             query_index=self.index,
             sampling=self.sampling,
+            sample_by=self.sample_by,
             max_features=self.max_features,
             properties=self.properties,
             sort_by=self.sort_by,

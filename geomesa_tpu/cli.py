@@ -324,6 +324,107 @@ def cmd_version(args):
     print(f"geomesa-tpu {__version__}")
 
 
+def cmd_version_remote(args):
+    """Query a running sidecar's version (tools `version-remote`)."""
+    from geomesa_tpu.sidecar import GeoFlightClient
+
+    with GeoFlightClient(f"grpc+tcp://{args.host}:{args.port}") as c:
+        info = c.check_version()
+    print(f"remote geomesa-tpu {info['version']} (protocol {info['protocol']})")
+
+
+def cmd_env(args):
+    """Print every config tunable with its effective value (tools `env`)."""
+    import os
+
+    from geomesa_tpu import config
+
+    for name, prop in sorted(config.registry().items()):
+        val = prop.get()
+        if name in config._overrides():
+            src = "override"
+        elif prop.env_name in os.environ:
+            src = "env"
+        else:
+            src = "default"
+        print(f"{name} = {val!r}  [{src}]")
+
+
+def cmd_convert(args):
+    """Dry-run a converter config against input (tools `convert`): parse,
+    transform, validate, and print the first rows — nothing is ingested."""
+    import json as _json
+
+    from geomesa_tpu.convert import EvaluationContext, converter_for
+    from geomesa_tpu.schema.feature_type import FeatureType
+
+    from geomesa_tpu.convert.converter import ConverterConfig
+
+    ft = FeatureType.from_spec(args.feature_name, args.spec)
+    with open(args.config) as fh:
+        conf = fh.read()
+    cfg = ConverterConfig.parse(conf)
+    conv = converter_for(ft, cfg)
+    if cfg.type in ("parquet", "avro"):
+        source: "str | bytes" = args.input  # binary formats take the path
+    else:
+        with open(args.input) as fh:
+            source = fh.read()
+    ctx = EvaluationContext()
+    shown = 0
+    for data, fids in conv.convert(source, ctx):
+        n = len(next(iter(data.values()), ()))
+        for i in range(n):
+            if shown >= args.max:
+                break
+            row = {k: _to_py(v[i]) for k, v in data.items()}
+            if fids is not None:
+                row["__fid__"] = str(fids[i])
+            print(_json.dumps(row, default=str))
+            shown += 1
+    print(f"converted: {ctx.success} ok, {ctx.failure} failed", file=sys.stderr)
+    for e in ctx.errors[:10]:
+        print(f"  error: {e}", file=sys.stderr)
+
+
+def _to_py(v):
+    import numpy as _np
+
+    if isinstance(v, _np.generic):
+        return v.item()
+    return v
+
+
+def cmd_playback(args):
+    """Replay a catalog dataset in dtg order onto a live streaming window
+    (tools `playback`)."""
+    from geomesa_tpu.schema.columns import decode_batch
+    from geomesa_tpu.stream.live import StreamingDataset, playback
+
+    ds = _load(args.catalog)
+    st = ds._store(args.feature_name)
+    st.flush()
+    if st._all is None or st._all.n == 0:
+        raise SystemExit("nothing to play back")
+    d = decode_batch(st.ft, st._all, st.dicts)
+    dtg = st.ft.dtg_field
+    if dtg is None:
+        raise SystemExit("playback requires a date attribute")
+    sds = StreamingDataset()
+    sds.create_schema(st.ft.name, st.ft.spec())
+    data = {
+        a.name: d[a.name] for a in st.ft.attributes if a.name in d
+    }
+    fids = [str(v) for v in d["__fid__"]]
+    dtg_ms = np.asarray(st._all.columns[dtg], np.int64)
+    playback(
+        sds, st.ft.name, data, fids, dtg_ms,
+        rate=args.rate, batch_ms=args.batch_ms, sleep=not args.fast,
+    )
+    n = sds.count(st.ft.name)
+    print(f"played back {n} features at {args.rate}x")
+
+
 _LEAFLET_TMPL = """<!DOCTYPE html>
 <html><head>
 <link rel="stylesheet" href="https://unpkg.com/leaflet@1.9.4/dist/leaflet.css"/>
@@ -439,6 +540,29 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)
+
+    sp = sub.add_parser("version-remote", help="query a sidecar's version")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8815)
+    sp.set_defaults(fn=cmd_version_remote)
+
+    sp = sub.add_parser("env", help="print config tunables + effective values")
+    sp.set_defaults(fn=cmd_env)
+
+    sp = sub.add_parser("convert", help="dry-run a converter config")
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("-s", "--spec", required=True)
+    sp.add_argument("-C", "--config", required=True, help="converter config file")
+    sp.add_argument("-i", "--input", required=True)
+    sp.add_argument("--max", type=int, default=10, help="rows to print")
+    sp.set_defaults(fn=cmd_convert)
+
+    sp = sub.add_parser("playback", help="replay a dataset onto a live stream")
+    common(sp)
+    sp.add_argument("--rate", type=float, default=10.0)
+    sp.add_argument("--batch-ms", type=int, default=1000)
+    sp.add_argument("--fast", action="store_true", help="no real-time sleeps")
+    sp.set_defaults(fn=cmd_playback)
 
     return p
 

@@ -1,0 +1,176 @@
+"""TWKB (Tiny Well-Known Binary) geometry codec.
+
+Wire-format parity with the reference's compressed geometry encoding inside
+Kryo row values (geomesa-feature-common/.../serialization/TwkbSerialization.
+scala): type+precision header byte, metadata byte, zigzag-varint delta-coded
+coordinates. Subset: Point, LineString, Polygon, MultiPoint, MultiLineString,
+MultiPolygon; optional empty flag; no bbox/size/id-list extensions (the
+reference doesn't emit them either).
+"""
+
+from __future__ import annotations
+
+import io
+from typing import List, Tuple
+
+import numpy as np
+
+from geomesa_tpu.utils import geometry as geo
+
+_TYPE = {
+    "point": 1, "linestring": 2, "polygon": 3,
+    "multipoint": 4, "multilinestring": 5, "multipolygon": 6,
+}
+
+
+def _zz(v: int) -> int:
+    return (v << 1) ^ (v >> 63)
+
+
+def _unzz(v: int) -> int:
+    return (v >> 1) ^ -(v & 1)
+
+
+def _wv(buf: io.BytesIO, v: int):
+    v &= (1 << 64) - 1
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            buf.write(bytes([b | 0x80]))
+        else:
+            buf.write(bytes([b]))
+            return
+
+
+def _rv(buf: io.BytesIO) -> int:
+    shift = acc = 0
+    while True:
+        (b,) = buf.read(1)
+        acc |= (b & 0x7F) << shift
+        if not (b & 0x80):
+            if acc >= 1 << 63:
+                acc -= 1 << 64
+            return acc
+        shift += 7
+
+
+class _Writer:
+    def __init__(self, precision: int):
+        self.scale = 10 ** precision
+        self.px = 0
+        self.py = 0
+
+    def coords(self, buf: io.BytesIO, pts) -> None:
+        for x, y in pts:
+            ix, iy = round(float(x) * self.scale), round(float(y) * self.scale)
+            _wv(buf, _zz(ix - self.px))
+            _wv(buf, _zz(iy - self.py))
+            self.px, self.py = ix, iy
+
+
+class _Reader:
+    def __init__(self, precision: int):
+        self.scale = 10 ** precision
+        self.px = 0
+        self.py = 0
+
+    def coords(self, buf: io.BytesIO, n: int) -> List[Tuple[float, float]]:
+        out = []
+        for _ in range(n):
+            self.px += _unzz(_rv(buf))
+            self.py += _unzz(_rv(buf))
+            out.append((self.px / self.scale, self.py / self.scale))
+        return out
+
+
+def encode(g: geo.Geometry, precision: int = 7) -> bytes:
+    """Geometry -> TWKB bytes (default precision 7 ≈ 1 cm at the equator,
+    the reference's default). Precision must fit the header's zigzag
+    nibble: -8..7 (the TWKB spec range)."""
+    if not -8 <= precision <= 7:
+        raise ValueError(f"TWKB precision must be in [-8, 7], got {precision}")
+    buf = io.BytesIO()
+    t = _TYPE[g.kind]
+    buf.write(bytes([(_zz_p(precision) << 4) | t]))
+    buf.write(b"\x00")  # metadata: no bbox/size/ids/extended/empty
+    w = _Writer(precision)
+    if isinstance(g, geo.Point):
+        w.coords(buf, [(g.x, g.y)])
+    elif isinstance(g, geo.LineString):
+        _wv(buf, len(g.coords))
+        w.coords(buf, g.coords)
+    elif isinstance(g, geo.Polygon):
+        rings = [geo._close_ring(g.shell)] + [geo._close_ring(h) for h in g.holes]
+        _wv(buf, len(rings))
+        for r in rings:
+            _wv(buf, len(r))
+            w.coords(buf, r)
+    elif isinstance(g, geo.MultiPoint):
+        _wv(buf, len(g.points))
+        w.coords(buf, [(p.x, p.y) for p in g.points])
+    elif isinstance(g, geo.MultiLineString):
+        _wv(buf, len(g.lines))
+        for ls in g.lines:
+            _wv(buf, len(ls.coords))
+            w.coords(buf, ls.coords)
+    elif isinstance(g, geo.MultiPolygon):
+        _wv(buf, len(g.polygons))
+        for p in g.polygons:
+            rings = [geo._close_ring(p.shell)] + [
+                geo._close_ring(h) for h in p.holes
+            ]
+            _wv(buf, len(rings))
+            for r in rings:
+                _wv(buf, len(r))
+                w.coords(buf, r)
+    else:
+        raise ValueError(f"unsupported geometry {g.kind!r}")
+    return buf.getvalue()
+
+
+def _zz_p(p: int) -> int:
+    return ((p << 1) ^ (p >> 31)) & 0xF
+
+
+def _unzz_p(n: int) -> int:
+    return (n >> 1) ^ -(n & 1)
+
+
+def decode(data: bytes) -> geo.Geometry:
+    buf = io.BytesIO(data)
+    (head,) = buf.read(1)
+    t = head & 0x0F
+    precision = _unzz_p(head >> 4)
+    (meta,) = buf.read(1)
+    if meta & 0x10:  # empty flag
+        raise ValueError("empty TWKB geometries are not supported")
+    if meta & 0x0F:
+        raise ValueError("TWKB bbox/size/id extensions are not supported")
+    r = _Reader(precision)
+    if t == 1:
+        (xy,) = r.coords(buf, 1)
+        return geo.Point(*xy)
+    if t == 2:
+        return geo.LineString(tuple(r.coords(buf, _rv(buf))))
+    if t == 3:
+        nrings = _rv(buf)
+        rings = [tuple(r.coords(buf, _rv(buf))) for _ in range(nrings)]
+        return geo.Polygon(rings[0], tuple(rings[1:]))
+    if t == 4:
+        pts = r.coords(buf, _rv(buf))
+        return geo.MultiPoint(tuple(geo.Point(*xy) for xy in pts))
+    if t == 5:
+        n = _rv(buf)
+        return geo.MultiLineString(tuple(
+            geo.LineString(tuple(r.coords(buf, _rv(buf)))) for _ in range(n)
+        ))
+    if t == 6:
+        n = _rv(buf)
+        polys = []
+        for _ in range(n):
+            nrings = _rv(buf)
+            rings = [tuple(r.coords(buf, _rv(buf))) for _ in range(nrings)]
+            polys.append(geo.Polygon(rings[0], tuple(rings[1:])))
+        return geo.MultiPolygon(tuple(polys))
+    raise ValueError(f"unknown TWKB type {t}")

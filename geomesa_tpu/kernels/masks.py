@@ -44,6 +44,31 @@ def window_mask_np(starts, ends, counts, L: int) -> np.ndarray:
     return out
 
 
+def sampling_mask_by_key(mask: np.ndarray, n: int, key_codes: np.ndarray) -> np.ndarray:
+    """Keep every nth matched row *per key value* (SamplingIterator's
+    per-key mode): deterministic counter per key, host-side (numpy).
+
+    ``key_codes``: int codes aligned with ``mask`` (same shape)."""
+    flat = mask.reshape(-1)
+    keys = np.asarray(key_codes).reshape(-1)
+    out = np.zeros_like(flat)
+    idx = np.nonzero(flat)[0]
+    if idx.size == 0:
+        return out.reshape(mask.shape)
+    k = keys[idx]
+    # running index within key: stable sort by key, position - first-position
+    order = np.argsort(k, kind="stable")
+    ks = k[order]
+    first = np.concatenate(([True], ks[1:] != ks[:-1]))
+    group_start = np.maximum.accumulate(np.where(first, np.arange(ks.size), 0))
+    within = np.arange(ks.size) - group_start
+    keep_sorted = (within % n) == 0
+    keep = np.zeros(ks.size, bool)
+    keep[order] = keep_sorted
+    out[idx[keep]] = True
+    return out.reshape(mask.shape)
+
+
 def sampling_mask(mask, n: int, xp):
     """Keep ~1-in-n of the masked rows (SamplingIterator analog): deterministic
     modulo on the running match index so the sample is stable."""

@@ -88,7 +88,15 @@ class Executor:
             if c not in table.columns
             or table.columns[c].dtype.kind in ("O", "U")
         ]
-        use_device = self.prefer_device and not host_only
+        # per-key sampling needs an exact running counter per key value —
+        # host path only (the reference runs it inside the iterator loop).
+        # sample_by is meaningless without a sampling rate.
+        if plan.hints.sample_by and not plan.hints.sampling:
+            raise ValueError("sample_by requires sampling (the 1-in-n rate)")
+        use_device = (
+            self.prefer_device and not host_only
+            and not plan.hints.sample_by
+        )
         return {
             "table": table, "starts": starts, "ends": ends, "counts": counts,
             "L": L, "needed": needed, "use_device": use_device,
@@ -106,7 +114,22 @@ class Executor:
             cols = {k: v[sl] for k, v in table.columns.items()}
             pm[s, : sl.stop - sl.start] = np.asarray(plan.compiled(cols, np))
         mask = wm & pm
-        if plan.hints.sampling:
+        if plan.hints.sampling and plan.hints.sample_by:
+            key = plan.hints.sample_by
+            col = table.columns.get(key)
+            if col is None:
+                raise KeyError(f"sample-by attribute {key!r} not found")
+            # exact distinct-value codes for ANY dtype (float truncation or
+            # object hashing would merge distinct keys)
+            _, codes = np.unique(col, return_inverse=True)
+            stacked = np.zeros((S, L), dtype=np.int64)
+            for s in range(table.n_shards):
+                sl = table.shard_slice(s)
+                stacked[s, : sl.stop - sl.start] = codes[sl]
+            mask = kmasks.sampling_mask_by_key(
+                mask, plan.hints.sampling, stacked
+            )
+        elif plan.hints.sampling:
             mask = kmasks.sampling_mask(mask, plan.hints.sampling, np)
         return mask
 

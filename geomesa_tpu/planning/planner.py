@@ -37,6 +37,8 @@ class QueryHints:
     loose_bbox: bool = False
     #: 1-in-n sampling (SAMPLING hint)
     sampling: Optional[int] = None
+    #: per-key sampling attribute (SAMPLE_BY hint): 1-in-n per key value
+    sample_by: Optional[str] = None
     #: max features
     max_features: Optional[int] = None
     #: attribute projection
@@ -89,6 +91,14 @@ class QueryPlanner:
         exp.push(f"Planning '{ft.name}' query")
         exp.line(f"Filter: {text}")
 
+        # pluggable rewrite hooks (QueryInterceptor.scala:51 analog)
+        from geomesa_tpu.planning import interceptors
+
+        f2 = interceptors.apply_rewrite(ft, f)
+        if f2 is not f:
+            exp.line("Filter rewritten by interceptor")
+            f = f2
+
         # candidate key plans (FilterSplitter.getQueryOptions analog)
         candidates = []
         for ks in store.keyspaces:
@@ -121,11 +131,14 @@ class QueryPlanner:
         compiled = compile_filter(f, ft, store.dicts)
         exp.line(f"Predicate columns: {compiled.columns}")
         exp.pop()
-        return QueryPlan(
+        plan = QueryPlan(
             schema=ft.name, filter=f, ecql=text, compiled=compiled,
             key_plan=chosen, index_name=chosen.keyspace.name, hints=hints,
             explain=exp, est_count=cost,
         )
+        # pluggable guard hooks may veto the chosen plan (raise)
+        interceptors.apply_guards(ft, plan)
+        return plan
 
     # -- cost-based decider (StrategyDecider.scala:148-191 analog) ---------
     def _decide(self, candidates: List[KeyPlan], f: ir.Filter, exp: Explainer):

@@ -10,7 +10,7 @@ the transport a JVM/GeoTools front-end (or any Arrow client) uses to reach
 the TPU-resident dataset.
 """
 
-from geomesa_tpu.sidecar.service import GeoFlightServer, serve
+from geomesa_tpu.sidecar.service import GeoFlightServer, PROTOCOL_VERSION, serve
 from geomesa_tpu.sidecar.client import GeoFlightClient
 
-__all__ = ["GeoFlightServer", "GeoFlightClient", "serve"]
+__all__ = ["GeoFlightServer", "GeoFlightClient", "PROTOCOL_VERSION", "serve"]

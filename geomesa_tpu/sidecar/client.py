@@ -38,6 +38,24 @@ class GeoFlightClient:
         results = list(self._client.do_action(action))
         return json.loads(results[0].body.to_pybytes().decode()) if results else {}
 
+    def version(self) -> Dict:
+        """Server library + protocol version."""
+        return self._action("version")
+
+    def check_version(self) -> Dict:
+        """Handshake (GeoMesaDataStore distributed-version check analog):
+        raises if the server speaks an incompatible protocol."""
+        from geomesa_tpu.sidecar.service import PROTOCOL_VERSION
+
+        info = self.version()
+        server = int(info.get("protocol", -1))
+        if server != PROTOCOL_VERSION:
+            raise RuntimeError(
+                f"sidecar protocol mismatch: server={server} "
+                f"client={PROTOCOL_VERSION}; upgrade the older side"
+            )
+        return info
+
     def create_schema(self, name: str, spec: str) -> str:
         return self._action("create-schema", {"name": name, "spec": spec})["created"]
 
@@ -72,7 +90,7 @@ class GeoFlightClient:
         return self._client.do_get(ticket).read_all()
 
     def query(self, name: str, ecql: str = "INCLUDE", properties=None,
-              max_features=None, sampling=None,
+              max_features=None, sampling=None, sample_by=None,
               auths: Optional[Sequence[str]] = None) -> pa.Table:
         opts = {"op": "query", "schema": name, "ecql": ecql}
         if properties is not None:
@@ -81,6 +99,8 @@ class GeoFlightClient:
             opts["max_features"] = max_features
         if sampling is not None:
             opts["sampling"] = sampling
+        if sample_by is not None:
+            opts["sample_by"] = sample_by
         if auths is not None:
             opts["auths"] = list(auths)
         return self._get(opts)

@@ -33,12 +33,27 @@ import pyarrow.flight as fl
 from geomesa_tpu.api.dataset import GeoDataset, Query
 
 
+#: RPC protocol version; clients refuse pushdown when the major differs
+#: (the reference's server-side iterator-version compatibility contract)
+PROTOCOL_VERSION = 1
+
+
+def _lib_version() -> str:
+    try:
+        import geomesa_tpu
+
+        return getattr(geomesa_tpu, "__version__", "0.1.0")
+    except Exception:
+        return "0.1.0"
+
+
 def _query_from(opts: Dict) -> Query:
     return Query(
         ecql=opts.get("ecql", "INCLUDE"),
         max_features=opts.get("max_features"),
         properties=opts.get("properties"),
         sampling=opts.get("sampling"),
+        sample_by=opts.get("sample_by"),
         index=opts.get("index"),
         auths=opts.get("auths"),
         sort_by=[tuple(s) for s in opts["sort_by"]] if opts.get("sort_by") else None,
@@ -146,10 +161,18 @@ class GeoFlightServer(fl.FlightServerBase):
             from geomesa_tpu import metrics
 
             return ok({"metrics": metrics.registry().report()})
+        if kind == "version":
+            # the distributed-version handshake (GeoMesaDataStore.scala:
+            # 498-503, 615-667: client checks the server-side iterator
+            # version before planning pushdown scans)
+            return ok({
+                "version": _lib_version(), "protocol": PROTOCOL_VERSION,
+            })
         raise fl.FlightServerError(f"unknown action {kind!r}")
 
     def list_actions(self, context):
         return [
+            ("version", "server library + protocol version handshake"),
             ("create-schema", "register a feature type: {name, spec}"),
             ("delete-schema", "drop a feature type: {name}"),
             ("list-schemas", "type names"),

@@ -115,6 +115,11 @@ def _build(name: str, args: List[Any]) -> sk.Stat:
         period = _val(args[2]) if len(args) > 2 else "week"
         length = int(_val(args[3])) if len(args) > 3 else 1024
         return sk.Z3HistogramStat(geom, dtg, period, length)
+    if n == "z3frequency":
+        geom, dtg = _val(args[0]), _val(args[1])
+        period = _val(args[2]) if len(args) > 2 else "week"
+        precision = int(_val(args[3])) if len(args) > 3 else 10
+        return sk.Z3FrequencyStat(geom, dtg, period, precision)
     raise ValueError(f"unknown stat function: {name!r}")
 
 

@@ -674,10 +674,95 @@ class SeqStat(Stat):
         return cls([Stat.from_json(s) for s in d["stats"]])
 
 
+class Z3FrequencyStat(Stat):
+    """Count-min sketch keyed by (time bin, coarse z3 cell) — approximate
+    per-cell frequencies for spatio-temporal values (reference Z3Frequency,
+    geomesa-utils/.../stats/Z3Frequency.scala): per time bin, a Frequency
+    sketch over the truncated z value."""
+
+    kind = "z3frequency"
+
+    def __init__(self, geom: str, dtg: str, period: "str | TimePeriod" = TimePeriod.WEEK,
+                 precision: int = 10, width: int = 1024,
+                 bins: "Optional[Dict[int, Frequency]]" = None):
+        self.geom = geom
+        self.dtg = dtg
+        self.period = TimePeriod.parse(period)
+        self.precision = int(precision)  # bits of z kept (top 3*precision)
+        self.width = int(width)
+        self.sfc = Z3SFC(self.period)
+        self.binned = BinnedTime(self.period)
+        self.shift = 63 - 3 * self.precision
+        self.bins: Dict[int, Frequency] = dict(bins or {})
+
+    def _key(self, xs, ys, off) -> np.ndarray:
+        z = self.sfc.index(xs, ys, off)
+        return (z >> np.uint64(self.shift)).astype(np.int64)
+
+    def observe(self, columns, mask=None):
+        xs = _masked(np.asarray(columns[self.geom + "__x"]), mask)
+        ys = _masked(np.asarray(columns[self.geom + "__y"]), mask)
+        ts = _masked(np.asarray(columns[self.dtg]), mask)
+        if xs.size == 0:
+            return
+        b, off = self.binned.to_bin_and_offset(ts)
+        keys = self._key(xs, ys, off)
+        for bb in np.unique(b).tolist():
+            sel = b == bb
+            fq = self.bins.get(int(bb))
+            if fq is None:
+                fq = self.bins[int(bb)] = Frequency("__z3__", width=self.width)
+            fq.observe({"__z3__": keys[sel]})
+
+    def merge(self, other: "Z3FrequencyStat"):
+        for k, v in other.bins.items():
+            if k in self.bins:
+                self.bins[k].merge(v)
+            else:
+                self.bins[k] = Frequency(
+                    "__z3__", width=v.width, counts=v.counts.copy()
+                )
+
+    @property
+    def is_empty(self):
+        return not self.bins
+
+    def count(self, time_bin: int, x: float, y: float, offset_ms: float) -> int:
+        """Approximate (over-)count of points in the cell containing
+        (x, y, offset) within the given time bin."""
+        fq = self.bins.get(int(time_bin))
+        if fq is None:
+            return 0
+        key = self._key(
+            np.asarray([x]), np.asarray([y]), np.asarray([offset_ms])
+        )
+        return fq.count(int(key[0]))
+
+    def value(self):
+        return {int(k): int(v.counts[0].sum()) for k, v in self.bins.items()}
+
+    def _state(self):
+        return {
+            "geom": self.geom, "dtg": self.dtg, "period": self.period.value,
+            "precision": self.precision, "width": self.width,
+            "bins": {str(k): _arr_to_b64(v.counts) for k, v in self.bins.items()},
+        }
+
+    @classmethod
+    def _from_state(cls, d):
+        out = cls(d["geom"], d["dtg"], d["period"], d["precision"], d["width"])
+        for k, v in d["bins"].items():
+            fq = Frequency("__z3__", width=out.width)
+            fq.counts = _arr_from_b64(v).reshape(fq.counts.shape)
+            out.bins[int(k)] = fq
+        return out
+
+
 _KINDS = {
     c.kind: c
     for c in (
         CountStat, MinMax, EnumerationStat, TopK, Histogram, Frequency,
-        DescriptiveStats, GroupBy, Z3HistogramStat, Z2HistogramStat, SeqStat,
+        DescriptiveStats, GroupBy, Z3HistogramStat, Z2HistogramStat,
+        Z3FrequencyStat, SeqStat,
     )
 }

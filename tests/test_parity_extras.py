@@ -1,0 +1,253 @@
+"""TWKB codec, Z3Frequency sketch, per-key sampling, query interceptors,
+sidecar version handshake, and the new CLI commands."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.api.dataset import Query
+
+
+# -- twkb ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("wkt", [
+    "POINT (12.3456789 -45.6789012)",
+    "LINESTRING (0 0, 1.5 2.5, -3 4)",
+    "POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0), (1 1, 2 1, 2 2, 1 2, 1 1))",
+    "MULTIPOINT ((1 1), (2 2))",
+    "MULTILINESTRING ((0 0, 1 1), (2 2, 3 3))",
+    "MULTIPOLYGON (((0 0, 1 0, 1 1, 0 1, 0 0)), ((5 5, 6 5, 6 6, 5 6, 5 5)))",
+])
+def test_twkb_round_trip(wkt):
+    from geomesa_tpu.io import twkb
+    from geomesa_tpu.utils.geometry import parse_wkt
+
+    g = parse_wkt(wkt)
+    data = twkb.encode(g, precision=7)
+    g2 = twkb.decode(data)
+    assert g2.kind == g.kind
+    np.testing.assert_allclose(
+        np.asarray(g2.bounds()), np.asarray(g.bounds()), atol=1e-6
+    )
+    # delta+varint coding should beat WKT text for typical geometries
+    assert len(data) < len(wkt)
+
+
+def test_twkb_precision():
+    from geomesa_tpu.io import twkb
+    from geomesa_tpu.utils.geometry import parse_wkt
+
+    g = parse_wkt("POINT (12.123456789 45.987654321)")
+    lo = twkb.decode(twkb.encode(g, precision=2))
+    hi = twkb.decode(twkb.encode(g, precision=7))
+    assert abs(lo.x - g.x) < 0.01
+    assert abs(hi.x - g.x) < 1e-6
+    assert len(twkb.encode(g, 2)) < len(twkb.encode(g, 7))
+    with pytest.raises(ValueError):
+        twkb.encode(g, 9)
+
+
+# -- z3 frequency -------------------------------------------------------------
+
+def test_z3_frequency_sketch():
+    from geomesa_tpu.stats import parse_stat
+    from geomesa_tpu.stats.sketches import Stat, Z3FrequencyStat
+
+    st = parse_stat("Z3Frequency(geom,dtg,week,8)")
+    assert isinstance(st, Z3FrequencyStat)
+    rng = np.random.default_rng(0)
+    n = 5000
+    t0 = 1577836800000
+    cols = {
+        "geom__x": np.full(n, -90.0) + rng.normal(0, 0.001, n),
+        "geom__y": np.full(n, 40.0) + rng.normal(0, 0.001, n),
+        "dtg": t0 + rng.integers(0, 86_400_000, n),
+    }
+    st.observe(cols)
+    assert not st.is_empty
+    # query a specific point: the sketch must not under-count its cell
+    qt = t0 + 1000
+    b, off = st.binned.to_bin_and_offset(np.asarray([qt]))
+    ab, aoff = st.binned.to_bin_and_offset(cols["dtg"])
+    qkey = st._key(np.asarray([-90.0]), np.asarray([40.0]), off)[0]
+    akeys = st._key(cols["geom__x"], cols["geom__y"], aoff)
+    exact = int(((akeys == qkey) & (ab == b[0])).sum())
+    got = st.count(int(b[0]), -90.0, 40.0, float(off[0]))
+    assert got >= exact > 0  # count-min only over-counts
+    # merge doubles counts; serialization round-trips
+    st2 = parse_stat("Z3Frequency(geom,dtg,week,8)")
+    st2.observe(cols)
+    st2.merge(st)
+    assert st2.count(int(b[0]), -90.0, 40.0, float(off[0])) >= 2 * exact
+    st3 = Stat.from_json(st2.to_json())
+    assert isinstance(st3, Z3FrequencyStat)
+    assert st3.count(int(b[0]), -90.0, 40.0, float(off[0])) == st2.count(
+        int(b[0]), -90.0, 40.0, float(off[0])
+    )
+
+
+# -- per-key sampling ---------------------------------------------------------
+
+def test_sampling_mask_by_key():
+    from geomesa_tpu.kernels.masks import sampling_mask_by_key
+
+    keys = np.array([1, 1, 1, 1, 2, 2, 2, 3, 3, 3, 3, 3])
+    mask = np.ones(len(keys), bool)
+    out = sampling_mask_by_key(mask, 2, keys)
+    # every key keeps ceil(count/2) rows: 2, 2 (of 3... wait 4->2, 3->2, 5->3)
+    for k, want in ((1, 2), (2, 2), (3, 3)):
+        assert out[keys == k].sum() == want
+    # masked-out rows never sampled
+    mask2 = mask.copy()
+    mask2[:4] = False
+    out2 = sampling_mask_by_key(mask2, 2, keys)
+    assert out2[:4].sum() == 0
+
+
+def test_query_sample_by():
+    rng = np.random.default_rng(1)
+    n = 3000
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "track:String,dtg:Date,*geom:Point")
+    ds.insert("t", {
+        "geom__x": rng.uniform(-10, 10, n), "geom__y": rng.uniform(-10, 10, n),
+        "dtg": np.full(n, 1577836800000, "datetime64[ms]"),
+        "track": rng.choice(["a", "b", "c"], n),
+    }, fids=np.arange(n).astype(str))
+    ds.flush("t")
+    fc = ds.query("t", Query(sampling=10, sample_by="track"))
+    d = fc.to_dict()
+    names, counts = np.unique(np.asarray(d["track"]), return_counts=True)
+    full = {k: int((np.asarray(ds.query("t").to_dict()["track"]) == k).sum())
+            for k in names}
+    for k, c in zip(names, counts):
+        want = -(-full[k] // 10)  # ceil
+        assert c == want, (k, c, want)
+
+
+# -- interceptors -------------------------------------------------------------
+
+class _BBoxNarrower:
+    """Rewrite INCLUDE queries to a bbox; veto huge grids via guard."""
+
+    def rewrite(self, f, ft):
+        from geomesa_tpu.filter import ir, parse_ecql
+
+        if isinstance(f, ir.Include):
+            return parse_ecql("BBOX(geom, -5, -5, 5, 5)")
+        return f
+
+    def guard(self, plan):
+        if plan.est_count > 10_000_000:
+            raise ValueError("too big")
+
+
+def test_query_interceptor_rewrite_and_guard():
+    from geomesa_tpu.planning import interceptors
+
+    rng = np.random.default_rng(2)
+    n = 2000
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "dtg:Date,*geom:Point")
+    ds.insert("t", {
+        "geom__x": rng.uniform(-10, 10, n), "geom__y": rng.uniform(-10, 10, n),
+        "dtg": np.full(n, 1577836800000, "datetime64[ms]"),
+    }, fids=np.arange(n).astype(str))
+    ds.flush("t")
+    try:
+        interceptors.register("t", _BBoxNarrower())
+        got = ds.count("t", "INCLUDE")
+        want = ds.count("t", "BBOX(geom, -5, -5, 5, 5)")
+        assert got == want < n
+    finally:
+        interceptors.clear("t")
+    assert ds.count("t", "INCLUDE") == n  # cleared
+
+
+def test_interceptor_from_user_data():
+    from geomesa_tpu.planning import interceptors
+
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema(
+        "u",
+        "dtg:Date,*geom:Point;"
+        f"geomesa.query.interceptors='{__name__}._BBoxNarrower'",
+    )
+    ds.insert("u", {
+        "geom__x": np.array([0.0, 8.0]), "geom__y": np.array([0.0, 8.0]),
+        "dtg": np.array(["2020-01-01"] * 2, "datetime64[ms]"),
+    }, fids=np.array(["a", "b"]))
+    ds.flush("u")
+    assert ds.count("u", "INCLUDE") == 1  # rewritten to the small bbox
+
+
+# -- sidecar version handshake ------------------------------------------------
+
+def test_sidecar_version_handshake():
+    fl = pytest.importorskip("pyarrow.flight")  # noqa: F841
+    from geomesa_tpu.sidecar import GeoFlightClient, GeoFlightServer, PROTOCOL_VERSION
+
+    ds = GeoDataset(n_shards=2)
+    srv = GeoFlightServer(ds, "grpc+tcp://127.0.0.1:0")
+    import threading
+
+    t = threading.Thread(target=srv.serve, daemon=True)
+    t.start()
+    try:
+        with GeoFlightClient(f"grpc+tcp://127.0.0.1:{srv.port}") as c:
+            info = c.check_version()
+            assert info["protocol"] == PROTOCOL_VERSION
+            assert "version" in info
+    finally:
+        srv.shutdown()
+
+
+# -- CLI ----------------------------------------------------------------------
+
+def test_cli_env(capsys):
+    from geomesa_tpu import cli
+
+    cli.main(["env"])
+    out = capsys.readouterr().out
+    assert "geomesa.scan.ranges.target" in out
+    assert "geomesa.query.timeout" in out
+
+
+def test_cli_convert(tmp_path, capsys):
+    from geomesa_tpu import cli
+
+    conf = tmp_path / "conv.conf"
+    conf.write_text(
+        '{"type": "delimited-text", "format": "CSV", "id-field": "$1",'
+        ' "fields": ['
+        '{"name": "dtg", "transform": "date(\'yyyy-MM-dd\', $2)"},'
+        '{"name": "geom", "transform": "point(toDouble($3), toDouble($4))"}'
+        "]}"
+    )
+    data = tmp_path / "in.csv"
+    data.write_text("a,2020-01-01,1.5,2.5\nb,2020-01-02,3.5,4.5\n")
+    cli.main([
+        "convert", "-f", "t", "-s", "dtg:Date,*geom:Point",
+        "-C", str(conf), "-i", str(data),
+    ])
+    out = capsys.readouterr().out
+    assert out.count("\n") == 2 and "geom" in out
+
+
+def test_cli_playback(tmp_path, capsys):
+    from geomesa_tpu import cli
+
+    rng = np.random.default_rng(3)
+    n = 50
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "dtg:Date,*geom:Point")
+    ds.insert("t", {
+        "geom__x": rng.uniform(-10, 10, n), "geom__y": rng.uniform(-10, 10, n),
+        "dtg": (1577836800000 + np.arange(n) * 1000).astype("datetime64[ms]"),
+    }, fids=np.arange(n).astype(str))
+    ds.flush("t")
+    cat = str(tmp_path / "cat")
+    ds.save(cat)
+    cli.main(["playback", "-c", cat, "-f", "t", "--fast"])
+    out = capsys.readouterr().out
+    assert f"played back {n} features" in out
