@@ -494,7 +494,7 @@ class IdKeySpace(KeySpace):
 
     name = "id"
     kind = "id"
-    key_cols = ("__fid_rank",)
+    key_cols = ("__fid__",)  # the sort key IS the fid string column
 
     def supports(self, ft):
         return True

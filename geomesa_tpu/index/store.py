@@ -54,13 +54,23 @@ def _device_view(a: np.ndarray) -> Optional[np.ndarray]:
 
 
 class IndexTable:
-    """One index = one globally sorted, sharded column set."""
+    """One index = a sort permutation + sorted KEY columns over the store's
+    single master column set.
+
+    Attribute columns are NOT duplicated per index (the pre-refactor layout
+    held a full sorted copy of every column in every table — 8x memory at 8
+    indices); they are gathered through ``order`` on demand: once per device
+    upload (cached), per-query on the host fallback path."""
 
     def __init__(self, keyspace: KeySpace, ft: FeatureType, n_shards: int):
         self.keyspace = keyspace
         self.ft = ft
         self.n_shards = n_shards
-        self.columns: Dict[str, np.ndarray] = {}
+        #: sorted-row -> master-row permutation
+        self.order = np.zeros(0, np.int64)
+        #: this index's sort-key columns, already in sorted order
+        self.key_columns: Dict[str, np.ndarray] = {}
+        self._master: Dict[str, np.ndarray] = {}
         self.n = 0
         self.shard_bounds = np.zeros(n_shards + 1, np.int64)
         self._device_cache: Dict[tuple, dict] = {}
@@ -68,7 +78,9 @@ class IndexTable:
 
     # -- build ------------------------------------------------------------
     def rebuild(self, columns: Dict[str, np.ndarray], dicts: Dict[str, DictionaryEncoder]):
-        """Re-sort the full column set by this index's key and re-shard."""
+        """Re-sort by this index's key and re-shard. ``columns`` is the
+        master column dict (attributes + every index's key columns); the
+        table keeps a reference plus its own sorted key columns."""
         cols = dict(columns)
         ks = self.keyspace
         if isinstance(ks, AttributeKeySpace) and self.ft.attr(ks.attr).type == "string":
@@ -83,10 +95,55 @@ class IndexTable:
             cols[ks.sort_col] = ranks
             self._rank_vocab = vocab[order]
         order = ks.sort_order(cols)
-        self.columns = {k: v[order] for k, v in cols.items()}
+        self.order = np.asarray(order, np.int64)
+        self._master = cols
+        key_names = (set(ks.key_cols) | {getattr(ks, "sort_col", None)}) - {None}
+        self.key_columns = {
+            k: cols[k][order] for k in key_names if k in cols
+        }
         self.n = len(order)
         self.shard_bounds = np.linspace(0, self.n, self.n_shards + 1).astype(np.int64)
         self._device_cache.clear()
+
+    # -- column access -----------------------------------------------------
+    def has_column(self, name: str) -> bool:
+        return name in self.key_columns or name in self._master
+
+    def dtype_of(self, name: str):
+        col = self.key_columns.get(name)
+        if col is None:
+            col = self._master.get(name)
+        return None if col is None else col.dtype
+
+    def is_host_only(self, name: str) -> bool:
+        dt = self.dtype_of(name)
+        return dt is None or dt.kind in _HOST_ONLY_DTYPES
+
+    def column_names(self):
+        names = dict.fromkeys(self._master)
+        names.update(dict.fromkeys(self.key_columns))
+        return list(names)
+
+    def col_sorted(self, name: str) -> np.ndarray:
+        """Full column in this index's sort order (key cols are stored
+        sorted; attribute cols gather through the permutation)."""
+        col = self.key_columns.get(name)
+        if col is not None:
+            return col
+        return self._master[name][self.order]
+
+    def shard_cols(self, names, s: int) -> Dict[str, np.ndarray]:
+        """Selected columns for one shard, in sorted order."""
+        sl = self.shard_slice(s)
+        rows = self.order[sl]
+        out = {}
+        for k in names:
+            kc = self.key_columns.get(k)
+            if kc is not None:
+                out[k] = kc[sl]
+            elif k in self._master:
+                out[k] = self._master[k][rows]
+        return out
 
     @property
     def shard_len(self) -> int:
@@ -113,10 +170,9 @@ class IndexTable:
         L = self.shard_len
         out = {}
         for name in key[0]:
-            col = self.columns.get(name)
-            if col is None:
+            if not self.has_column(name):
                 continue
-            dv = _device_view(col)
+            dv = _device_view(self.col_sorted(name))
             if dv is None:
                 continue
             stacked = np.zeros((self.n_shards, L), dtype=dv.dtype)
@@ -139,7 +195,8 @@ class IndexTable:
         for s in range(self.n_shards):
             sl = self.shard_slice(s)
             n = sl.stop - sl.start
-            shard_cols = {k: v[sl] for k, v in self.columns.items()}
+            # window resolution only ever touches the sort-key columns
+            shard_cols = {k: v[sl] for k, v in self.key_columns.items()}
             if self._rank_vocab is not None:
                 vocab = self._rank_vocab
 
@@ -171,18 +228,13 @@ class IndexTable:
             local = global_mask[s * L : s * L + (sl.stop - sl.start)]
             idx.append(np.nonzero(local)[0] + sl.start)
         sel = np.concatenate(idx) if idx else np.zeros(0, np.int64)
-        return ColumnBatch({k: v[sel] for k, v in self.columns.items()}, len(sel))
-
-    def host_mask_layout(self, fn) -> np.ndarray:
-        """Evaluate ``fn(cols)`` per shard on the host and return a padded
-        [S*L] mask (the host fallback path for object-typed predicates)."""
-        L = self.shard_len
-        out = np.zeros(self.n_shards * L, dtype=bool)
-        for s in range(self.n_shards):
-            sl = self.shard_slice(s)
-            cols = {k: v[sl] for k, v in self.columns.items()}
-            out[s * L : s * L + (sl.stop - sl.start)] = fn(cols)
-        return out
+        rows = self.order[sel]
+        out = {k: v[rows] for k, v in self._master.items()}
+        # include this index's extra key columns not present on the master
+        for k, v in self.key_columns.items():
+            if k not in out:
+                out[k] = v[sel]
+        return ColumnBatch(out, len(sel))
 
 
 class FeatureStore:

@@ -85,8 +85,7 @@ class Executor:
         needed = list(dict.fromkeys(list(plan.compiled.columns) + list(extra_cols)))
         host_only = [
             c for c in needed
-            if c not in table.columns
-            or table.columns[c].dtype.kind in ("O", "U")
+            if not table.has_column(c) or table.is_host_only(c)
         ]
         # per-key sampling needs an exact running counter per key value —
         # host path only (the reference runs it inside the iterator loop).
@@ -108,17 +107,18 @@ class Executor:
         wm = kmasks.window_mask_np(setup["starts"], setup["ends"], setup["counts"], setup["L"])
         S, L = wm.shape
         pm = np.zeros((S, L), dtype=bool)
+        needed = setup["needed"]
         for s in range(table.n_shards):
             check_deadline()
             sl = table.shard_slice(s)
-            cols = {k: v[sl] for k, v in table.columns.items()}
+            cols = table.shard_cols(needed, s)
             pm[s, : sl.stop - sl.start] = np.asarray(plan.compiled(cols, np))
         mask = wm & pm
         if plan.hints.sampling and plan.hints.sample_by:
             key = plan.hints.sample_by
-            col = table.columns.get(key)
-            if col is None:
+            if not table.has_column(key):
                 raise KeyError(f"sample-by attribute {key!r} not found")
+            col = table.col_sorted(key)
             # exact distinct-value codes for ANY dtype (float truncation or
             # object hashing would merge distinct keys)
             _, codes = np.unique(col, return_inverse=True)
@@ -318,12 +318,13 @@ class Executor:
         table = setup["table"]
         cols = {}
         for c in set(list(setup["needed"]) + list(agg_cols)):
-            if c in table.columns:
+            if table.has_column(c):
                 L = setup["L"]
-                stacked = np.zeros((table.n_shards, L), dtype=table.columns[c].dtype)
+                full = table.col_sorted(c)
+                stacked = np.zeros((table.n_shards, L), dtype=full.dtype)
                 for s in range(table.n_shards):
                     sl = table.shard_slice(s)
-                    stacked[s, : sl.stop - sl.start] = table.columns[c][sl]
+                    stacked[s, : sl.stop - sl.start] = full[sl]
                 cols[c] = stacked
         return agg_fn_host(cols, mask, np)
 
@@ -388,7 +389,7 @@ class Executor:
     def stats(self, plan: QueryPlan, stat: sk.Stat) -> sk.Stat:
         table = self._table(plan)
         host_only = {
-            c for c in table.columns if table.columns[c].dtype.kind in ("O", "U")
+            c for c in table.column_names() if table.is_host_only(c)
         }
         vocab_sizes = {a: max(len(d), 1) for a, d in self.store.dicts.items()}
         leaf_attrs = []
@@ -399,9 +400,9 @@ class Executor:
                 leaf_attrs.append(leaf.attribute)
         agg_cols = []
         for a in leaf_attrs:
-            if a + "__x" in table.columns:
+            if table.has_column(a + "__x"):
                 agg_cols += [a + "__x", a + "__y"]
-            elif a in table.columns:
+            elif table.has_column(a):
                 agg_cols.append(a)
         enum_ok = all(
             leaf.attribute in self.store.dicts

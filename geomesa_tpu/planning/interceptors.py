@@ -59,9 +59,21 @@ def for_schema(ft) -> List[Any]:
         with _lock:
             cached = _loaded_userdata.get(key)
         if cached is None:
-            cached = [
-                _load_path(p.strip()) for p in key.split(",") if p.strip()
-            ]
+            cached = []
+            for p in key.split(","):
+                p = p.strip()
+                if not p:
+                    continue
+                try:
+                    cached.append(_load_path(p))
+                except Exception as e:
+                    # a typo'd path must not brick the schema (the reference's
+                    # QueryInterceptorFactory logs and continues the same way)
+                    import logging
+
+                    logging.getLogger(__name__).warning(
+                        "failed to load query interceptor %r: %r", p, e
+                    )
             with _lock:
                 if len(_loaded_userdata) >= 256:
                     _loaded_userdata.clear()

@@ -414,7 +414,7 @@ def spatial_join(
     assign_rows = assign_input[valid]
     counts = np.zeros(len(geoms), np.float32)
     if weight:
-        w = table.columns[weight].astype(np.float32)
+        w = table.col_sorted(weight).astype(np.float32)
     else:
         w = np.ones(table.n, np.float32)
     hit = assign_rows >= 0

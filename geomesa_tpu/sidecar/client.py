@@ -47,7 +47,16 @@ class GeoFlightClient:
         raises if the server speaks an incompatible protocol."""
         from geomesa_tpu.sidecar.service import PROTOCOL_VERSION
 
-        info = self.version()
+        try:
+            info = self.version()
+        except fl.FlightServerError as e:
+            if "unknown action" in str(e):
+                # pre-handshake server: the exact case this check exists for
+                raise RuntimeError(
+                    "sidecar protocol mismatch: server predates the version "
+                    f"handshake, client={PROTOCOL_VERSION}; upgrade the server"
+                ) from None
+            raise
         server = int(info.get("protocol", -1))
         if server != PROTOCOL_VERSION:
             raise RuntimeError(

@@ -689,6 +689,10 @@ class Z3FrequencyStat(Stat):
         self.dtg = dtg
         self.period = TimePeriod.parse(period)
         self.precision = int(precision)  # bits of z kept (top 3*precision)
+        if not 1 <= self.precision <= 21:
+            raise ValueError(
+                f"Z3Frequency precision must be in [1, 21], got {self.precision}"
+            )
         self.width = int(width)
         self.sfc = Z3SFC(self.period)
         self.binned = BinnedTime(self.period)
@@ -715,6 +719,17 @@ class Z3FrequencyStat(Stat):
             fq.observe({"__z3__": keys[sel]})
 
     def merge(self, other: "Z3FrequencyStat"):
+        if (
+            self.period != other.period
+            or self.precision != other.precision
+            or self.width != other.width
+        ):
+            raise ValueError(
+                "cannot merge Z3Frequency sketches with different "
+                f"period/precision/width: {self.period.value}/{self.precision}"
+                f"/{self.width} vs {other.period.value}/{other.precision}"
+                f"/{other.width}"
+            )
         for k, v in other.bins.items():
             if k in self.bins:
                 self.bins[k].merge(v)
