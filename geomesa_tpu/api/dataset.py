@@ -705,5 +705,9 @@ class GeoDataset:
                 for ks in st.keyspaces:
                     key_cols.update(ks.index_keys(ft, st._all))
                     st.tables[ks.name].rebuild(key_cols, st.dicts)
+                # seed the key cache so the next flush appends incrementally
+                st._key_cols = {
+                    k: v for k, v in key_cols.items() if k not in cols
+                }
         ds.n_shards = None
         return ds

@@ -108,27 +108,77 @@ def _combine3(z: np.ndarray) -> np.ndarray:
     return z
 
 
-def interleave2(x: np.ndarray, y: np.ndarray) -> np.ndarray:
-    """Morton-interleave two 31-bit indices; x occupies the higher bit of each pair."""
+def _interleave2_np(x: np.ndarray, y: np.ndarray) -> np.ndarray:
     return (_split2(x) << np.uint64(1)) | _split2(y)
 
 
-def deinterleave2(z: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+def _deinterleave2_np(z: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     return _combine2(np.asarray(z, np.uint64) >> np.uint64(1)), _combine2(z)
 
 
-def interleave3(x: np.ndarray, y: np.ndarray, t: np.ndarray) -> np.ndarray:
-    """Morton-interleave three 21-bit indices; x highest within each triple."""
+def _interleave3_np(x: np.ndarray, y: np.ndarray, t: np.ndarray) -> np.ndarray:
     return (_split3(x) << np.uint64(2)) | (_split3(y) << np.uint64(1)) | _split3(t)
 
 
-def deinterleave3(z: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+def _deinterleave3_np(z: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     z = np.asarray(z, np.uint64)
     return (
         _combine3(z >> np.uint64(2)),
         _combine3(z >> np.uint64(1)),
         _combine3(z),
     )
+
+
+# Native-dispatch threshold: below this the ctypes call overhead dominates.
+_NATIVE_MIN = 8192
+
+
+def _use_native(n: int) -> bool:
+    if n < _NATIVE_MIN:
+        return False
+    from geomesa_tpu import native
+
+    return native.available()
+
+
+def interleave2(x: np.ndarray, y: np.ndarray) -> np.ndarray:
+    """Morton-interleave two 31-bit indices; x occupies the higher bit of
+    each pair. Bulk batches go through the native runtime (ingest hot path:
+    the numpy spread is 6 full passes with temporaries; C++ does one)."""
+    x = np.asarray(x, np.uint64)
+    if _use_native(len(x)):
+        from geomesa_tpu import native
+
+        return native.interleave2(x, y)
+    return _interleave2_np(x, np.asarray(y, np.uint64))
+
+
+def deinterleave2(z: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
+    z = np.asarray(z, np.uint64)
+    if _use_native(len(z)):
+        from geomesa_tpu import native
+
+        return native.deinterleave2(z)
+    return _deinterleave2_np(z)
+
+
+def interleave3(x: np.ndarray, y: np.ndarray, t: np.ndarray) -> np.ndarray:
+    """Morton-interleave three 21-bit indices; x highest within each triple."""
+    x = np.asarray(x, np.uint64)
+    if _use_native(len(x)):
+        from geomesa_tpu import native
+
+        return native.interleave3(x, y, t)
+    return _interleave3_np(x, np.asarray(y, np.uint64), np.asarray(t, np.uint64))
+
+
+def deinterleave3(z: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    z = np.asarray(z, np.uint64)
+    if _use_native(len(z)):
+        from geomesa_tpu import native
+
+        return native.deinterleave3(z)
+    return _deinterleave3_np(z)
 
 
 # ---------------------------------------------------------------------------

@@ -85,6 +85,43 @@ class KeySpace:
     def resolve_windows(self, plan: KeyPlan, shard_cols, n: int):
         raise NotImplementedError
 
+    #: False when appends must always fully rebuild (checked BEFORE any
+    #: fresh-batch sorting so the probe costs nothing)
+    can_insert = True
+
+    def insert_positions(
+        self,
+        sorted_key_cols: Dict[str, np.ndarray],
+        fresh_sorted: Dict[str, np.ndarray],
+    ) -> Optional[np.ndarray]:
+        """Merge positions of already-sorted fresh keys into the existing
+        sorted key columns — the LSM append path (O(old + fresh) instead of a
+        full re-sort). Generic: single key column -> one searchsorted;
+        (bin, key) pairs -> per-bin two-level searchsorted. Returns None when
+        this key space needs a full rebuild (e.g. rank vocabularies)."""
+        cols = list(self.key_cols)
+        if len(cols) == 1:
+            k = cols[0]
+            return np.searchsorted(
+                sorted_key_cols[k], fresh_sorted[k], side="right"
+            ).astype(np.int64)
+        if len(cols) == 2:  # (bin, key): z3/xz3/s3 layouts
+            bc, kc = cols
+            bins_col = sorted_key_cols[bc]
+            key_col = sorted_key_cols[kc]
+            fb = fresh_sorted[bc]
+            fk = fresh_sorted[kc]
+            p = np.empty(len(fb), np.int64)
+            for b in np.unique(fb):
+                sel = fb == b
+                s = int(np.searchsorted(bins_col, b, side="left"))
+                e = int(np.searchsorted(bins_col, b, side="right"))
+                p[sel] = s + np.searchsorted(
+                    key_col[s:e], fk[sel], side="right"
+                )
+            return p
+        return None
+
 
 def _z_envelope(ranges: List[ZRange]) -> Tuple[int, int]:
     return (ranges[0].lo, ranges[-1].hi) if ranges else (0, 0)
@@ -500,8 +537,7 @@ class IdKeySpace(KeySpace):
         return True
 
     def index_keys(self, ft, batch):
-        # rank assigned at table build time (host sort of fids); here a
-        # placeholder (store re-sorts by fid directly).
+        # no derived key column: the table sorts the __fid__ strings directly
         return {}
 
     def sort_order(self, cols):
@@ -561,6 +597,10 @@ class AttributeKeySpace(KeySpace):
         if self.geom and "__z2" in cols:
             return np.lexsort((cols["__z2"], cols[self.sort_col]))
         return np.argsort(cols[self.sort_col], kind="stable")
+
+    # string attrs re-rank their dictionary on growth and the z2 tiebreak
+    # is a second sort key: appends always fully rebuild
+    can_insert = False
 
     def plan(self, ft, f):
         bounds = ir.extract_attr_bounds(f, self.attr)

@@ -105,6 +105,46 @@ class IndexTable:
         self.shard_bounds = np.linspace(0, self.n, self.n_shards + 1).astype(np.int64)
         self._device_cache.clear()
 
+    def append_rows(
+        self,
+        columns: Dict[str, np.ndarray],
+        dicts: Dict[str, DictionaryEncoder],
+        fresh_cols: Dict[str, np.ndarray],
+        n_fresh: int,
+    ):
+        """LSM append: sort the fresh rows locally and MERGE them into the
+        existing order via searchsorted insertion positions — O(old + fresh)
+        instead of the full O(n log n) re-sort (SURVEY.md §7 hard part (c)).
+        Falls back to :meth:`rebuild` when the key space requires it."""
+        ks = self.keyspace
+        if self.n == 0 or not ks.can_insert:
+            return self.rebuild(columns, dicts)
+        key_names = list(self.key_columns)
+        if any(k not in fresh_cols for k in key_names):
+            return self.rebuild(columns, dicts)
+        fresh_order = np.asarray(ks.sort_order(fresh_cols), np.int64)
+        fresh_sorted = {k: fresh_cols[k][fresh_order] for k in key_names}
+        p = ks.insert_positions(self.key_columns, fresh_sorted)
+        if p is None:
+            return self.rebuild(columns, dicts)
+        old_n = self.n
+        master_base = old_n  # master rows are [old | fresh]
+        final = np.empty(old_n + n_fresh, np.int64)
+        at = p + np.arange(n_fresh)
+        is_fresh = np.zeros(old_n + n_fresh, bool)
+        is_fresh[at] = True
+        final[is_fresh] = master_base + fresh_order
+        final[~is_fresh] = self.order
+        self.order = final
+        self._master = columns
+        self.key_columns = {
+            k: np.insert(self.key_columns[k], p, fresh_sorted[k])
+            for k in key_names
+        }
+        self.n = old_n + n_fresh
+        self.shard_bounds = np.linspace(0, self.n, self.n_shards + 1).astype(np.int64)
+        self._device_cache.clear()
+
     # -- column access -----------------------------------------------------
     def has_column(self, name: str) -> bool:
         return name in self.key_columns or name in self._master
@@ -253,6 +293,8 @@ class FeatureStore:
         }
         self._buffer: List[ColumnBatch] = []
         self._all: Optional[ColumnBatch] = None
+        #: cached index-key columns for the current master rows
+        self._key_cols: Dict[str, np.ndarray] = {}
         self._lock = threading.Lock()
         self.stats = self._init_stats()
         #: bumped on every data mutation; keys cross-query kernel caches
@@ -323,27 +365,54 @@ class FeatureStore:
                 return
             fresh = ColumnBatch.concat(self._buffer)
             self._buffer = []
-        # write-time stats on the fresh rows only
+        # index keys for the FRESH rows only (per-row functions — old rows'
+        # keys are cached in self._key_cols and just concatenated)
+        fresh_keys: Dict[str, np.ndarray] = {}
+        for ks in self.keyspaces:
+            fresh_keys.update(ks.index_keys(self.ft, fresh))
+        # write-time stats on the fresh rows; include the freshly-computed
+        # key columns so Z-histograms reuse them instead of re-encoding
+        # (the period marker tells Z3 sketches the keys match their config)
+        stat_cols = {**fresh.columns, **fresh_keys}
+        if "__z3" in fresh_keys:
+            stat_cols["__z3_period"] = self.ft.time_period
         for st in self.stats.values():
-            st.observe(fresh.columns)
+            st.observe(stat_cols)
         if self._all is not None:
             # datasets persisted before visibility support lack __vis__
             from geomesa_tpu.security import VIS_COLUMN
 
             if VIS_COLUMN in fresh.columns and VIS_COLUMN not in self._all.columns:
                 self._all.columns[VIS_COLUMN] = np.zeros(self._all.n, np.int32)
-        merged = (
-            fresh if self._all is None else ColumnBatch.concat([self._all, fresh])
-        )
-        # one pass: every key space's keys for the merged set
-        key_cols: Dict[str, np.ndarray] = dict(merged.columns)
-        for ks in self.keyspaces:
-            key_cols.update(ks.index_keys(self.ft, merged))
+        if self._all is None:
+            merged = fresh
+            key_cols: Dict[str, np.ndarray] = {**fresh.columns, **fresh_keys}
+        else:
+            merged = ColumnBatch.concat([self._all, fresh])
+            key_cols = dict(merged.columns)
+            old_keys = self._key_cols
+            recomputed = set()
+            for k, fv in fresh_keys.items():
+                ov = old_keys.get(k)
+                if ov is None:  # cold cache (load()): recompute, once per ks
+                    for ks in self.keyspaces:
+                        if k in ks.key_cols and ks.name not in recomputed:
+                            key_cols.update(ks.index_keys(self.ft, merged))
+                            recomputed.add(ks.name)
+                            break
+                else:
+                    key_cols[k] = np.concatenate([ov, fv])
         self._all = ColumnBatch(
             {k: key_cols[k] for k in merged.columns}, merged.n
         )
+        self._key_cols = {
+            k: v for k, v in key_cols.items() if k not in merged.columns
+        }
+        fresh_all = {**fresh.columns, **fresh_keys}
         for ks in self.keyspaces:
-            self.tables[ks.name].rebuild(key_cols, self.dicts)
+            self.tables[ks.name].append_rows(
+                key_cols, self.dicts, fresh_all, fresh.n
+            )
         self.version += 1
 
     def delete(self, mask_fn) -> int:
@@ -355,12 +424,23 @@ class FeatureStore:
         removed = int(mask.sum())
         if removed == 0:
             return 0
-        keep = self._all.select(~mask)
+        keep_mask = ~mask
+        keep = self._all.select(keep_mask)
         self._all = keep
         self.stats["count"] = sk.CountStat(keep.n)
         key_cols: Dict[str, np.ndarray] = dict(keep.columns)
+        # filter the cached key columns with the same mask (per-row values)
+        self._key_cols = {k: v[keep_mask] for k, v in self._key_cols.items()}
+        key_cols.update(self._key_cols)
         for ks in self.keyspaces:
-            key_cols.update(ks.index_keys(self.ft, keep))
+            for k in ks.key_cols:
+                if k not in key_cols:
+                    key_cols.update(ks.index_keys(self.ft, keep))
+                    self._key_cols.update({
+                        kk: vv for kk, vv in key_cols.items()
+                        if kk not in keep.columns
+                    })
+                    break
             self.tables[ks.name].rebuild(key_cols, self.dicts)
         self.version += 1
         return removed

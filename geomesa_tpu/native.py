@@ -119,7 +119,7 @@ def interleave2(x: np.ndarray, y: np.ndarray) -> np.ndarray:
     if L is None:
         from geomesa_tpu.curves import zorder
 
-        return zorder.interleave2(x, y)
+        return zorder._interleave2_np(x, y)
     out = np.empty(len(x), np.uint64)
     L.gm_interleave2(x, y, out, len(x))
     return out
@@ -131,7 +131,7 @@ def deinterleave2(z: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
     if L is None:
         from geomesa_tpu.curves import zorder
 
-        return zorder.deinterleave2(z)
+        return zorder._deinterleave2_np(z)
     x = np.empty(len(z), np.uint64)
     y = np.empty(len(z), np.uint64)
     L.gm_deinterleave2(z, x, y, len(z))
@@ -146,7 +146,7 @@ def interleave3(x: np.ndarray, y: np.ndarray, t: np.ndarray) -> np.ndarray:
     if L is None:
         from geomesa_tpu.curves import zorder
 
-        return zorder.interleave3(x, y, t)
+        return zorder._interleave3_np(x, y, t)
     out = np.empty(len(x), np.uint64)
     L.gm_interleave3(x, y, t, out, len(x))
     return out
@@ -158,7 +158,7 @@ def deinterleave3(z: np.ndarray) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     if L is None:
         from geomesa_tpu.curves import zorder
 
-        return zorder.deinterleave3(z)
+        return zorder._deinterleave3_np(z)
     x = np.empty(len(z), np.uint64)
     y = np.empty(len(z), np.uint64)
     t = np.empty(len(z), np.uint64)

@@ -518,13 +518,25 @@ class Z3HistogramStat(Stat):
         }
 
     def observe(self, columns, mask=None):
-        xs = _masked(np.asarray(columns[self.geom + "__x"]), mask)
-        ys = _masked(np.asarray(columns[self.geom + "__y"]), mask)
-        ts = _masked(np.asarray(columns[self.dtg]), mask)  # epoch ms
-        if xs.size == 0:
+        # reuse ingest-computed (bin, z3) keys — but only when the ingest
+        # marker confirms they were built with THIS sketch's time period
+        # (a DSL-requested sketch may use a different period than the schema)
+        if (
+            "__z3" in columns
+            and columns.get("__z3_period") == self.period.value
+        ):
+            b = _masked(np.asarray(columns["__z3_bin"]), mask)
+            z = _masked(np.asarray(columns["__z3"], np.uint64), mask)
+        else:
+            xs = _masked(np.asarray(columns[self.geom + "__x"]), mask)
+            ys = _masked(np.asarray(columns[self.geom + "__y"]), mask)
+            ts = _masked(np.asarray(columns[self.dtg]), mask)  # epoch ms
+            if xs.size == 0:
+                return
+            b, off = self.binned.to_bin_and_offset(ts)
+            z = self.sfc.index(xs, ys, off)
+        if z.size == 0:
             return
-        b, off = self.binned.to_bin_and_offset(ts)
-        z = self.sfc.index(xs, ys, off)
         bucket = (z >> np.uint64(self.shift)).astype(np.int64)
         for bb in np.unique(b).tolist():
             sel = b == bb
@@ -598,11 +610,16 @@ class Z2HistogramStat(Stat):
         )
 
     def observe(self, columns, mask=None):
-        xs = _masked(np.asarray(columns[self.geom + "__x"]), mask)
-        ys = _masked(np.asarray(columns[self.geom + "__y"]), mask)
-        if xs.size == 0:
+        if "__z2" in columns:  # ingest already computed the key column
+            z = _masked(np.asarray(columns["__z2"], np.uint64), mask)
+        else:
+            xs = _masked(np.asarray(columns[self.geom + "__x"]), mask)
+            ys = _masked(np.asarray(columns[self.geom + "__y"]), mask)
+            if xs.size == 0:
+                return
+            z = self.sfc.index(xs, ys)
+        if z.size == 0:
             return
-        z = self.sfc.index(xs, ys)
         bucket = (z >> np.uint64(self.shift)).astype(np.int64)
         self.counts += np.bincount(bucket, minlength=self.length).astype(np.int64)
 
