@@ -268,7 +268,11 @@ class GeoDataset:
 
         cf = compile_filter(f, st.ft, st.dicts)
         cf = self._vis_wrap(st, cf, self._effective_auths(Query(auths=auths)))
-        return st.delete(lambda cols: np.asarray(cf(cols, np)))
+        # exact_mask applies the extent-geometry refinement pass — deletes
+        # must never act on the coarse bbox superset
+        return st.delete(
+            lambda cols: cf.exact_mask(cols, len(cols["__fid__"]))
+        )
 
     # -- planning ----------------------------------------------------------
     def _effective_auths(self, q: Query) -> Optional[List[str]]:
@@ -297,7 +301,8 @@ class GeoDataset:
             return inner.fn(cols, xp) & allowed
 
         return CompiledFilter(
-            fn, list(inner.columns) + [security.VIS_COLUMN], inner.ecql
+            fn, list(inner.columns) + [security.VIS_COLUMN], inner.ecql,
+            refine=inner.refine, refine_columns=inner.refine_columns,
         )
 
     def _apply_visibility(self, st: FeatureStore, plan, auths) -> None:

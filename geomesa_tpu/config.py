@@ -142,6 +142,11 @@ BLOCK_FULL_TABLE_SCANS = SystemProperty("geomesa.scan.block-full-table", "false"
 #: Force exact counts instead of estimates.
 FORCE_COUNT = SystemProperty("geomesa.force.count", "false")
 
+#: Loose BBOX semantics: evaluate BBOX on extent geometries as envelope
+#: overlap only, skipping the exact-intersection refinement pass (the
+#: reference's loose-bbox query option; default is exact).
+LOOSE_BBOX = SystemProperty("geomesa.loose.bbox", "false")
+
 #: Parallel shard-scan width (AbstractBatchScan thread analog).
 QUERY_THREADS = SystemProperty("geomesa.query.threads", "8")
 

@@ -29,14 +29,52 @@ from geomesa_tpu.utils import geometry as geo
 
 @dataclass
 class CompiledFilter:
-    """A compiled mask kernel. ``fn(cols, xp)`` -> bool mask array."""
+    """A compiled mask kernel. ``fn(cols, xp)`` -> bool mask array.
+
+    When the filter contains spatial predicates over extent (line/polygon)
+    columns, ``fn`` is a *coarse* mask — a guaranteed superset of the exact
+    matches (polarity-corrected through NOT) — and ``refine`` holds the
+    exact host evaluator: ``refine(cols) -> bool mask`` over candidate rows,
+    needing ``refine_columns`` (the ``<geom>__wkt`` host columns) in
+    addition to ``columns``. The executor applies refine to coarse-true
+    rows only; it may clear bits, never set them. ``refine is None`` means
+    ``fn`` is already exact (the reference evaluates exact JTS predicates
+    everywhere — FastFilterFactory.scala:395; here the split keeps the
+    device kernel dense while candidates are refined on host).
+    """
 
     fn: Callable
     columns: List[str]
     ecql: Optional[str] = None
+    refine: Optional[Callable] = None
+    refine_columns: Optional[List[str]] = None
 
     def __call__(self, cols, xp=np):
         return self.fn(cols, xp)
+
+    def exact_mask(self, cols: Dict[str, np.ndarray], n: int) -> np.ndarray:
+        """Full exact 1-D host mask over ``n`` rows: coarse mask, then the
+        refinement tree on coarse-true candidates. ``cols`` must include
+        ``refine_columns`` when refinement is present."""
+        m = np.asarray(self.fn(cols, np))
+        if m.ndim == 0:
+            m = np.full(n, bool(m))
+        else:
+            m = m.astype(bool, copy=True)
+        if self.refine is not None:
+            idx = np.nonzero(m)[0]
+            if len(idx):
+                keep = self.refine_rows({k: v[idx] for k, v in cols.items()}, len(idx))
+                m[idx[~keep]] = False
+        return m
+
+    def refine_rows(self, cols_rows: Dict[str, np.ndarray], n: int) -> np.ndarray:
+        """Run the exact refinement tree over already-subset candidate rows.
+        Returns the keep mask (bool, length ``n``)."""
+        keep = np.asarray(self.refine(cols_rows, np))
+        if keep.ndim == 0:
+            return np.full(n, bool(keep))
+        return keep.astype(bool)
 
 
 def _geom_cols(ft: FeatureType, prop: str) -> Dict[str, str]:
@@ -100,6 +138,235 @@ def _pip_fn(g: geo.Geometry, xcol: str, ycol: str):
     return pip
 
 
+def _edges_of(g: geo.Geometry) -> np.ndarray:
+    """[E, 4] boundary segments of a line/polygon literal."""
+    from geomesa_tpu import geofn
+
+    return geofn._edges(g).astype(np.float64)
+
+
+def _boundary_endpoints(g: geo.Geometry) -> np.ndarray:
+    """[K, 2] mod-2 boundary points of a (multi)linestring literal."""
+    lines = g.lines if isinstance(g, geo.MultiLineString) else [g]
+    counts: Dict[tuple, int] = {}
+    for ls in lines:
+        for pt in (tuple(ls.coords[0]), tuple(ls.coords[-1])):
+            counts[pt] = counts.get(pt, 0) + 1
+    pts = [p for p, c in counts.items() if c % 2 == 1]
+    return np.asarray(pts, np.float64).reshape(-1, 2)
+
+
+def _on_segments_fn(E: np.ndarray, xcol: str, ycol: str):
+    """Coarse vectorized point-on-any-segment test (backend-generic).
+
+    The collinearity threshold is relative to the f32 rounding error of the
+    cross product (~1e-5 of the term magnitudes ≈ 80 f32 ulps), so on an
+    f32 device path this is a guaranteed *superset* of the exact f64 test —
+    near-misses are cleared by the host refinement pass. Broadcast is
+    [..., 1] x [E] so the kernel stays dense on device."""
+    x1, y1, x2, y2 = E[:, 0], E[:, 1], E[:, 2], E[:, 3]
+    dx, dy = x2 - x1, y2 - y1
+    pad = 1e-5 * np.maximum(np.abs(E).max(), 1.0)
+    lox, hix = np.minimum(x1, x2) - pad, np.maximum(x1, x2) + pad
+    loy, hiy = np.minimum(y1, y2) - pad, np.maximum(y1, y2) + pad
+
+    def fn(cols, xp):
+        x, y = cols[xcol][..., None], cols[ycol][..., None]
+        cross = dx * (y - y1) - dy * (x - x1)
+        err = 1e-5 * (
+            xp.abs(dx) * (xp.abs(y) + np.abs(y1) + 1.0)
+            + xp.abs(dy) * (xp.abs(x) + np.abs(x1) + 1.0)
+        )
+        inb = (x >= lox) & (x <= hix) & (y >= loy) & (y <= hiy)
+        return ((xp.abs(cross) <= err) & inb).any(axis=-1)
+
+    return fn
+
+
+def _point_eq_fn(pts: np.ndarray, xcol: str, ycol: str):
+    """Point-column equality against a set of literal coordinates."""
+
+    def fn(cols, xp):
+        x, y = cols[xcol], cols[ycol]
+        out = None
+        for px, py in pts:
+            m = (x == px) & (y == py)
+            out = m if out is None else (out | m)
+        if out is None:
+            return xp.asarray(False)
+        return out
+
+    return fn
+
+
+_FALSE = lambda cols, xp: np.False_  # noqa: E731  broadcasts like a scalar
+_TRUE = lambda cols, xp: np.True_  # noqa: E731
+
+
+def _point_exact_fns(g: geo.Geometry, dim: int, xc: str, yc: str):
+    """Exact host (f64) evaluators for a point column vs a literal, keyed by
+    op — the refinement-side counterparts of the coarse kernels below."""
+    from geomesa_tpu import geofn
+
+    def pip(cols, xp=np):
+        return g.contains_points(
+            np.asarray(cols[xc], np.float64), np.asarray(cols[yc], np.float64)
+        )
+
+    if dim == 0:
+        pts = (
+            np.asarray([[g.x, g.y]])
+            if isinstance(g, geo.Point)
+            else np.asarray([[p.x, p.y] for p in g.points])
+        )
+        eq = _point_eq_fn(pts, xc, yc)
+        return {
+            "eq": eq,
+            "disjoint": lambda cols, xp=np: ~eq(cols, np),
+        }
+    if dim == 1:
+        ends = _boundary_endpoints(g)
+        at_end = _point_eq_fn(ends, xc, yc) if len(ends) else _FALSE
+        return {
+            "intersects": pip,  # LineString.contains_points = exact on-segment
+            "disjoint": lambda cols, xp=np: ~pip(cols, np),
+            "within": lambda cols, xp=np: pip(cols, np) & ~np.asarray(at_end(cols, np)),
+            "touches": at_end,
+        }
+
+    def on_bnd(cols, xp=np):
+        return geofn._on_boundary_of(
+            g, np.asarray(cols[xc], np.float64), np.asarray(cols[yc], np.float64)
+        )
+
+    return {
+        "intersects": pip,  # boundary-inclusive ring containment
+        "disjoint": lambda cols, xp=np: ~pip(cols, np),
+        "within": lambda cols, xp=np: pip(cols, np) & ~on_bnd(cols, np),
+        "touches": on_bnd,
+    }
+
+
+def _point_spatial_fn(node, xc: str, yc: str, exact: bool, neg: bool,
+                      need_refine) -> Callable:
+    """Spatial predicate for a POINT column vs a geometry literal.
+
+    A point's interior is the point itself, so every DE-9IM predicate
+    reduces to membership / boundary tests (SpatialRelationFunctions.scala
+    semantics, evaluated columnar). Polygon-literal interior tests
+    (intersects/disjoint) run fully in the scan kernel; boundary- and
+    coincidence-sensitive ops (line/point literals, touches, within) are
+    not robust at f32 device precision, so they emit a relaxed-epsilon
+    coarse superset plus an exact f64 host refinement."""
+    g, op = node.geom, node.op
+    dim = (
+        0 if isinstance(g, (geo.Point, geo.MultiPoint))
+        else 1 if isinstance(g, (geo.LineString, geo.MultiLineString))
+        else 2
+    )
+    if dim == 0:
+        if op in ("touches", "crosses", "overlaps"):
+            return _FALSE  # empty boundaries / dimension rules
+        if op in ("contains", "equals") and not isinstance(g, geo.Point):
+            # a single point can only contain/equal a single distinct point
+            distinct = {(p.x, p.y) for p in g.points}
+            if len(distinct) > 1:
+                return _FALSE
+        ex = _point_exact_fns(g, dim, xc, yc)
+        if exact:
+            return ex["disjoint"] if op == "disjoint" else ex["eq"]
+        need_refine(None)  # f32 equality can collide distinct f64 values
+        if neg:
+            return _FALSE
+        if op == "disjoint":
+            return _TRUE
+        pts = (
+            np.asarray([[g.x, g.y]])
+            if isinstance(g, geo.Point)
+            else np.asarray([[p.x, p.y] for p in g.points])
+        )
+        return _point_eq_fn(pts, xc, yc)  # f32 eq is a superset of f64 eq
+    if dim == 1:
+        if op in ("contains", "crosses", "overlaps", "equals"):
+            return _FALSE  # dimension rules for a single point
+        ex = _point_exact_fns(g, dim, xc, yc)
+        if exact:
+            return ex[op]
+        need_refine(None)
+        if neg:
+            return _FALSE
+        if op == "disjoint":
+            return _TRUE
+        # intersects/within/touches: all lie on the (relaxed) segments
+        return _on_segments_fn(_edges_of(g), xc, yc)
+    # dim == 2: polygon / multipolygon literal
+    if op in ("contains", "crosses", "overlaps", "equals"):
+        return _FALSE
+    pip = _pip_fn(g, xc, yc)  # boundary-inclusive membership, device-exact
+    if op == "intersects":
+        return pip
+    if op == "disjoint":
+        return lambda cols, xp: ~pip(cols, xp)
+    # within/touches: boundary-sensitive -> coarse + refine
+    ex = _point_exact_fns(g, dim, xc, yc)
+    if exact:
+        return ex[op]
+    need_refine(None)
+    if neg:
+        return _FALSE
+    if op == "within":
+        return pip  # superset of the interior
+    return _on_segments_fn(_edges_of(g), xc, yc)  # touches: relaxed boundary
+
+
+def _exact_extent_fn(op: str, prop: str, literal: geo.Geometry):
+    """Exact host evaluator for an extent column: parse each candidate
+    row's WKT and run the scalar geofn predicate (the JTS-parity path)."""
+    from geomesa_tpu import geofn
+
+    wcol = prop + "__wkt"
+    ops = {
+        "intersects": geofn.st_intersects,
+        "within": geofn.st_within,
+        "contains": geofn.st_contains,
+        "crosses": geofn.st_crosses,
+        "overlaps": geofn.st_overlaps,
+        "touches": geofn.st_touches,
+        "equals": geofn.st_equals,
+    }
+
+    def fn(cols, xp=np):
+        wkts = cols[wcol]
+        out = np.zeros(len(wkts), bool)
+        for i, w in enumerate(wkts):
+            g = w if isinstance(w, geo.Geometry) else geo.parse_wkt(str(w))
+            if op == "disjoint":
+                out[i] = not geofn.st_intersects(g, literal)
+            else:
+                out[i] = bool(ops[op](g, literal))
+        return out
+
+    return fn
+
+
+def _exact_extent_dwithin_fn(prop: str, literal: geo.Geometry, dist_m: float):
+    """Exact host DWITHIN for an extent column: geodesic distance from the
+    literal to the row geometry's closest point."""
+    from geomesa_tpu import geofn
+
+    wcol = prop + "__wkt"
+
+    def fn(cols, xp=np):
+        wkts = cols[wcol]
+        out = np.zeros(len(wkts), bool)
+        for i, w in enumerate(wkts):
+            g = w if isinstance(w, geo.Geometry) else geo.parse_wkt(str(w))
+            out[i] = float(geofn.st_distanceSphere(g, literal)) <= dist_m
+        return out
+
+    return fn
+
+
 def _like_codes(d: DictionaryEncoder, pattern: str, ci: bool) -> np.ndarray:
     """Resolve a LIKE pattern against the dictionary vocab -> matching codes."""
     rx = "".join(
@@ -134,22 +401,37 @@ def compile_filter(
     ft: FeatureType,
     dicts: Dict[str, DictionaryEncoder],
 ) -> CompiledFilter:
-    """Compile a predicate IR tree into a columnar mask kernel."""
+    """Compile a predicate IR tree into a columnar mask kernel.
+
+    Spatial predicates over extent columns compile twice: a *coarse* bbox
+    mask for the dense scan (``neg`` tracks NOT-polarity so the coarse mask
+    stays a superset of the exact matches — under odd negations the node
+    emits its certain-match subset instead), and an *exact* host tree
+    (``exact=True``) over the ``__wkt`` columns used as the refinement
+    pass on coarse-true candidates."""
     needed: List[str] = []
+    refine_needed: List[str] = []
 
     def need(*cols):
         for c in cols:
             if c not in needed:
                 needed.append(c)
 
-    def compile_node(node: ir.Filter) -> Callable:
+    has_refine = [False]
+
+    def need_refine(c):
+        has_refine[0] = True
+        if c is not None and c not in refine_needed:
+            refine_needed.append(c)
+
+    def compile_node(node: ir.Filter, neg: bool = False, exact: bool = False) -> Callable:
         if isinstance(node, ir.Include):
             # scalar True broadcasts against the window/validity mask
             return lambda cols, xp: xp.asarray(True)
         if isinstance(node, ir.Exclude):
             return lambda cols, xp: xp.asarray(False)
         if isinstance(node, ir.And):
-            fns = [compile_node(c) for c in node.children]
+            fns = [compile_node(c, neg, exact) for c in node.children]
 
             def f_and(cols, xp):
                 m = fns[0](cols, xp)
@@ -159,7 +441,7 @@ def compile_filter(
 
             return f_and
         if isinstance(node, ir.Or):
-            fns = [compile_node(c) for c in node.children]
+            fns = [compile_node(c, neg, exact) for c in node.children]
 
             def f_or(cols, xp):
                 m = fns[0](cols, xp)
@@ -169,7 +451,7 @@ def compile_filter(
 
             return f_or
         if isinstance(node, ir.Not):
-            fn = compile_node(node.child)
+            fn = compile_node(node.child, not neg, exact)
             return lambda cols, xp: ~fn(cols, xp)
 
         if isinstance(node, ir.BBox):
@@ -184,83 +466,152 @@ def compile_filter(
                     return (x >= xmin) & (x <= xmax) & (y >= ymin) & (y <= ymax)
 
                 return bbox_pt
-            need(gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
-            ks = (gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
+            from geomesa_tpu import config
 
-            def bbox_ext(cols, xp):
-                return (
-                    (cols[ks[0]] <= xmax) & (cols[ks[2]] >= xmin)
-                    & (cols[ks[1]] <= ymax) & (cols[ks[3]] >= ymin)
-                )
+            if config.LOOSE_BBOX.to_bool():
+                # loose-bbox: envelope overlap only, no refinement (exact
+                # either way when the stored geometry IS its envelope)
+                need(gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
+                ks = (gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
 
-            return bbox_ext
+                def bbox_ext(cols, xp):
+                    return (
+                        (cols[ks[0]] <= xmax) & (cols[ks[2]] >= xmin)
+                        & (cols[ks[1]] <= ymax) & (cols[ks[3]] >= ymin)
+                    )
+
+                return bbox_ext
+            # exact semantics: BBOX == intersects with the box polygon, so
+            # delegate to the Spatial machinery (polarity + refinement)
+            return compile_node(
+                ir.Spatial(
+                    "intersects", node.prop,
+                    geo.bbox_polygon(xmin, ymin, xmax, ymax),
+                ),
+                neg, exact,
+            )
 
         if isinstance(node, ir.Spatial):
             gc = _geom_cols(ft, node.prop)
             b = node.geom.bounds()
             if "point" in gc:
                 need(gc["x"], gc["y"])
-                if node.op in ("intersects", "within", "contains"):
-                    if isinstance(node.geom, (geo.Polygon, geo.MultiPolygon)):
-                        return _pip_fn(node.geom, gc["x"], gc["y"])
-                    # point/line literal: intersects ~= tiny-bbox test
-                    xc, yc = gc["x"], gc["y"]
+                return _point_spatial_fn(
+                    node, gc["x"], gc["y"], exact, neg, need_refine
+                )
+            # extent (line/polygon) column
+            if exact:
+                need_refine(node.prop + "__wkt")
+                return _exact_extent_fn(node.op, node.prop, node.geom)
+            need(gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
+            ks = (gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
+            need_refine(node.prop + "__wkt")
 
-                    def near(cols, xp):
-                        x, y = cols[xc], cols[yc]
-                        return (x >= b[0]) & (x <= b[2]) & (y >= b[1]) & (y <= b[3])
+            def overlap(cols, xp):
+                return (
+                    (cols[ks[0]] <= b[2]) & (cols[ks[2]] >= b[0])
+                    & (cols[ks[1]] <= b[3]) & (cols[ks[3]] >= b[1])
+                )
 
-                    return near
-                if node.op == "disjoint":
-                    inner = compile_node(ir.Spatial("intersects", node.prop, node.geom))
-                    return lambda cols, xp: ~inner(cols, xp)
-            else:
-                # extent attribute: bbox-overlap approximation at key level;
-                # exact geometry refinement is a host post-pass (SURVEY §7
-                # hard part (a)).
-                need(gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
-                ks = (gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
-
-                def overlap(cols, xp):
-                    m = (
-                        (cols[ks[0]] <= b[2]) & (cols[ks[2]] >= b[0])
-                        & (cols[ks[1]] <= b[3]) & (cols[ks[3]] >= b[1])
+            op = node.op
+            if not neg:
+                # superset-of-exact ("maybe") masks
+                if op == "disjoint":
+                    return _TRUE  # bbox overlap can't prove intersection
+                if op == "within":
+                    # row within literal => row bbox inside literal bbox
+                    return lambda cols, xp: (
+                        (cols[ks[0]] >= b[0]) & (cols[ks[2]] <= b[2])
+                        & (cols[ks[1]] >= b[1]) & (cols[ks[3]] <= b[3])
                     )
-                    return ~m if node.op == "disjoint" else m
-
-                return overlap
+                if op == "contains":
+                    return lambda cols, xp: (
+                        (cols[ks[0]] <= b[0]) & (cols[ks[2]] >= b[2])
+                        & (cols[ks[1]] <= b[1]) & (cols[ks[3]] >= b[3])
+                    )
+                if op == "equals":
+                    return lambda cols, xp: (
+                        (xp.abs(cols[ks[0]] - b[0]) <= 1e-9)
+                        & (xp.abs(cols[ks[1]] - b[1]) <= 1e-9)
+                        & (xp.abs(cols[ks[2]] - b[2]) <= 1e-9)
+                        & (xp.abs(cols[ks[3]] - b[3]) <= 1e-9)
+                    )
+                return overlap  # intersects/crosses/overlaps/touches
+            # negated context: emit the certain-match subset so the
+            # enclosing NOT yields a superset
+            if op == "disjoint":
+                return lambda cols, xp: ~overlap(cols, xp)
+            return _FALSE
 
         if isinstance(node, ir.DWithin):
             gc = _geom_cols(ft, node.prop)
-            need(gc["x"], gc["y"])
-            xc, yc = gc["x"], gc["y"]
-            if isinstance(node.geom, geo.Point):
-                px, py, dist = node.geom.x, node.geom.y, node.distance_m
-
-                def dwithin(cols, xp):
-                    x, y = cols[xc], cols[yc]
-                    rx1, ry1 = xp.radians(x), xp.radians(y)
-                    rx2, ry2 = np.radians(px), np.radians(py)
-                    a = (
-                        xp.sin((ry2 - ry1) / 2) ** 2
-                        + xp.cos(ry1) * np.cos(ry2) * xp.sin((rx2 - rx1) / 2) ** 2
-                    )
-                    d = 2 * geo.EARTH_RADIUS_M * xp.arcsin(xp.sqrt(xp.clip(a, 0, 1)))
-                    return d <= dist
-
-                return dwithin
-            # non-point literal: expanded-bbox approximation
+            # expanded literal bbox, used by every coarse path below
             d_deg = node.distance_m / geo.METERS_PER_DEGREE
             bb = node.geom.bounds()
             maxlat = min(89.0, max(abs(bb[1]), abs(bb[3])))
-            dx = d_deg / max(np.cos(np.radians(maxlat)), 1e-3)
-            exp = (bb[0] - dx, bb[1] - d_deg, bb[2] + dx, bb[3] + d_deg)
+            dxp = d_deg / max(np.cos(np.radians(maxlat)), 1e-3)
+            exp = (bb[0] - dxp, bb[1] - d_deg, bb[2] + dxp, bb[3] + d_deg)
+            if "point" in gc:
+                need(gc["x"], gc["y"])
+                xc, yc = gc["x"], gc["y"]
+                if isinstance(node.geom, geo.Point):
+                    # exact great-circle test, fused into the kernel
+                    px, py, dist = node.geom.x, node.geom.y, node.distance_m
 
-            def dwithin_box(cols, xp):
-                x, y = cols[xc], cols[yc]
-                return (x >= exp[0]) & (x <= exp[2]) & (y >= exp[1]) & (y <= exp[3])
+                    def dwithin(cols, xp):
+                        x, y = cols[xc], cols[yc]
+                        rx1, ry1 = xp.radians(x), xp.radians(y)
+                        rx2, ry2 = np.radians(px), np.radians(py)
+                        a = (
+                            xp.sin((ry2 - ry1) / 2) ** 2
+                            + xp.cos(ry1) * np.cos(ry2) * xp.sin((rx2 - rx1) / 2) ** 2
+                        )
+                        d = 2 * geo.EARTH_RADIUS_M * xp.arcsin(xp.sqrt(xp.clip(a, 0, 1)))
+                        return d <= dist
 
-            return dwithin_box
+                    return dwithin
+                # non-point literal: coarse expanded bbox + exact geodesic
+                # distance-to-geometry refinement on host candidates
+                if exact:
+                    from geomesa_tpu import geofn
+
+                    lit, dist = node.geom, node.distance_m
+
+                    def dw_exact(cols, xp=np):
+                        d = geofn.st_distanceSphere(
+                            lit, (np.asarray(cols[xc], np.float64),
+                                  np.asarray(cols[yc], np.float64))
+                        )
+                        return np.asarray(d) <= dist
+
+                    return dw_exact
+                need_refine(None)  # mark refinement required (no extra cols)
+                if neg:
+                    return _FALSE
+
+                def dwithin_box(cols, xp):
+                    x, y = cols[xc], cols[yc]
+                    return (x >= exp[0]) & (x <= exp[2]) & (y >= exp[1]) & (y <= exp[3])
+
+                return dwithin_box
+            # extent column: coarse expanded-bbox overlap on the row bbox +
+            # exact geodesic refinement over the __wkt host column
+            if exact:
+                need_refine(node.prop + "__wkt")
+                return _exact_extent_dwithin_fn(node.prop, node.geom, node.distance_m)
+            need(gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
+            ks = (gc["xmin"], gc["ymin"], gc["xmax"], gc["ymax"])
+            need_refine(node.prop + "__wkt")
+            if neg:
+                return _FALSE
+
+            def dwithin_ext(cols, xp):
+                return (
+                    (cols[ks[0]] <= exp[2]) & (cols[ks[2]] >= exp[0])
+                    & (cols[ks[1]] <= exp[3]) & (cols[ks[3]] >= exp[1])
+                )
+
+            return dwithin_ext
 
         if isinstance(node, ir.Compare):
             a = ft.attr(node.prop)
@@ -413,4 +764,9 @@ def compile_filter(
         raise ValueError(f"cannot compile filter node: {node!r}")
 
     fn = compile_node(f)
-    return CompiledFilter(fn, needed)
+    refine = None
+    if has_refine[0]:
+        # exact host tree over candidate rows (same scalar columns + the
+        # __wkt host columns); applied by the executor to coarse-true rows
+        refine = compile_node(f, exact=True)
+    return CompiledFilter(fn, needed, refine=refine, refine_columns=refine_needed)

@@ -216,16 +216,14 @@ class _Parser:
                 self.expect("sym", ")")
                 return ir.BBox(prop, nums[0], nums[1], nums[2], nums[3])
             if kw in ("INTERSECTS", "CONTAINS", "WITHIN", "DISJOINT", "CROSSES",
-                      "OVERLAPS", "EQUALS"):
+                      "OVERLAPS", "TOUCHES", "EQUALS"):
                 self.next()
                 self.expect("sym", "(")
                 prop = self.expect("id").text
                 self.expect("sym", ",")
                 g = self.wkt_literal()
                 self.expect("sym", ")")
-                op = {"CROSSES": "intersects", "OVERLAPS": "intersects",
-                      "EQUALS": "within"}.get(kw, kw.lower())
-                return ir.Spatial(op, prop, g)
+                return ir.Spatial(kw.lower(), prop, g)
             if kw in ("DWITHIN", "BEYOND"):
                 self.next()
                 self.expect("sym", "(")

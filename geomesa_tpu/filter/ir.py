@@ -66,9 +66,12 @@ class BBox(Filter):
 
 @dataclass(frozen=True)
 class Spatial(Filter):
-    """INTERSECTS / CONTAINS / WITHIN / DISJOINT / CROSSES(approx)."""
+    """INTERSECTS / CONTAINS / WITHIN / DISJOINT / CROSSES / OVERLAPS /
+    TOUCHES / EQUALS — exact semantics (FastFilterFactory.scala:395):
+    point columns evaluate exactly in the scan kernel; extent columns get a
+    bbox coarse mask plus an exact host refinement pass."""
 
-    op: str  # intersects | contains | within | disjoint
+    op: str  # intersects|contains|within|disjoint|crosses|overlaps|touches|equals
     prop: str
     geom: geo.Geometry
 
@@ -161,9 +164,11 @@ def extract_geometries(f: Filter, geom_prop: str) -> FilterValues:
         if isinstance(node, BBox) and node.prop == geom_prop:
             return [geo.bbox_polygon(node.xmin, node.ymin, node.xmax, node.ymax)]
         if isinstance(node, Spatial) and node.prop == geom_prop:
-            if node.op in ("intersects", "contains", "within"):
+            if node.op != "disjoint":
+                # every non-disjoint relation implies bbox interaction with
+                # the literal, so its bounds constrain the scan window
                 return [node.geom]
-            return None  # disjoint etc: unbounded
+            return None  # disjoint: unbounded
         if isinstance(node, DWithin) and node.prop == geom_prop:
             d = node.distance_m / geo.METERS_PER_DEGREE
             b = node.geom.bounds()

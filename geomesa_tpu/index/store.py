@@ -185,6 +185,21 @@ class IndexTable:
                 out[k] = self._master[k][rows]
         return out
 
+    def shard_rows_cols(self, names, s: int, idx: np.ndarray) -> Dict[str, np.ndarray]:
+        """Selected columns for specific sorted-order row positions of one
+        shard — gathers only ``idx`` rows (the refinement-candidate path),
+        avoiding a full-shard copy."""
+        sl = self.shard_slice(s)
+        rows = self.order[sl.start + idx]
+        out = {}
+        for k in names:
+            kc = self.key_columns.get(k)
+            if kc is not None:
+                out[k] = kc[sl.start + idx]
+            elif k in self._master:
+                out[k] = self._master[k][rows]
+        return out
+
     @property
     def shard_len(self) -> int:
         """Padded per-shard length (static shape for the device)."""

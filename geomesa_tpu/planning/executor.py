@@ -92,9 +92,13 @@ class Executor:
         # sample_by is meaningless without a sampling rate.
         if plan.hints.sample_by and not plan.hints.sampling:
             raise ValueError("sample_by requires sampling (the 1-in-n rate)")
+        # extent-geometry refinement (exact spatial predicates) runs on the
+        # host __wkt columns, so the whole mask must be host-resident before
+        # aggregation — route such plans through the host path
         use_device = (
             self.prefer_device and not host_only
             and not plan.hints.sample_by
+            and plan.compiled.refine is None
         )
         return {
             "table": table, "starts": starts, "ends": ends, "counts": counts,
@@ -114,6 +118,7 @@ class Executor:
             cols = table.shard_cols(needed, s)
             pm[s, : sl.stop - sl.start] = np.asarray(plan.compiled(cols, np))
         mask = wm & pm
+        mask = self._apply_refine(plan, setup, mask)
         if plan.hints.sampling and plan.hints.sample_by:
             key = plan.hints.sample_by
             if not table.has_column(key):
@@ -131,6 +136,31 @@ class Executor:
             )
         elif plan.hints.sampling:
             mask = kmasks.sampling_mask(mask, plan.hints.sampling, np)
+        return mask
+
+    def _apply_refine(self, plan: QueryPlan, setup, mask: np.ndarray) -> np.ndarray:
+        """Exact-predicate refinement pass (FastFilterFactory.scala:395
+        parity): re-evaluate the exact filter tree on coarse-true candidate
+        rows using the host ``__wkt`` columns. Only clears mask bits, so
+        fused visibility/window masks are preserved. Runs before sampling —
+        the 1-in-n counter must see exact matches only."""
+        ref = plan.compiled.refine
+        if ref is None:
+            return mask
+        table = setup["table"]
+        names = list(dict.fromkeys(
+            list(plan.compiled.columns) + list(plan.compiled.refine_columns or [])
+        ))
+        for s in range(table.n_shards):
+            check_deadline()
+            sl = table.shard_slice(s)
+            row = mask[s, : sl.stop - sl.start]
+            if not row.any():
+                continue
+            idx = np.nonzero(row)[0]
+            cols = table.shard_rows_cols(names, s, idx)
+            keep = plan.compiled.refine_rows(cols, len(idx))
+            row[idx[~keep]] = False
         return mask
 
     def _device_mask_and_agg(self, plan: QueryPlan, setup, agg_fn, agg_cols=(),

@@ -38,12 +38,14 @@ from geomesa_tpu.stream.messages import (
 )
 
 
-def _full_mask(m, n: int) -> np.ndarray:
-    """Compiled predicates may return a scalar (e.g. INCLUDE) — broadcast."""
-    m = np.asarray(m, dtype=bool)
-    if m.ndim == 0:
-        return np.full(n, bool(m))
-    return m
+def _cell_of(v: np.ndarray, off: float, span: float, n: int) -> np.ndarray:
+    """Grid cell index along one axis (NaN-safe: NaN clamps to cell 0; null
+    geometries are excluded by the caller's validity mask anyway)."""
+    with np.errstate(invalid="ignore"):
+        return np.clip(
+            np.nan_to_num((np.asarray(v) + off) / span * n).astype(np.int64),
+            0, n - 1,
+        )
 
 
 class LiveFeatureCache:
@@ -148,14 +150,36 @@ class LiveFeatureCache:
         out: Dict[int, np.ndarray] = {}
         if b.n and g is not None and g + "__x" in b.columns:
             n = self.grid_bins
-            cx = np.clip(((b.columns[g + "__x"] + 180.0) / 360.0 * n).astype(np.int64), 0, n - 1)
-            cy = np.clip(((b.columns[g + "__y"] + 90.0) / 180.0 * n).astype(np.int64), 0, n - 1)
-            cell = cy * n + cx
+            if g + "__xmin" in b.columns:
+                # extent geometries: bucket every cell the row bbox overlaps
+                # (a centroid-only bucket would hide rows from queries that
+                # hit the geometry far from its centroid)
+                x0 = _cell_of(b.columns[g + "__xmin"], 180.0, 360.0, n)
+                x1 = _cell_of(b.columns[g + "__xmax"], 180.0, 360.0, n)
+                y0 = _cell_of(b.columns[g + "__ymin"], 90.0, 180.0, n)
+                y1 = _cell_of(b.columns[g + "__ymax"], 90.0, 180.0, n)
+                ok = np.isfinite(b.columns[g + "__x"])
+                cell_l: List[int] = []
+                row_l: List[int] = []
+                for i in np.nonzero(ok)[0]:
+                    for cy in range(y0[i], y1[i] + 1):
+                        base = cy * n
+                        for cx in range(x0[i], x1[i] + 1):
+                            cell_l.append(base + cx)
+                            row_l.append(i)
+                cell = np.asarray(cell_l, np.int64)
+                order_rows = np.asarray(row_l, np.int64)
+            else:
+                cell = (
+                    _cell_of(b.columns[g + "__y"], 90.0, 180.0, n) * n
+                    + _cell_of(b.columns[g + "__x"], 180.0, 360.0, n)
+                )
+                order_rows = np.arange(b.n, dtype=np.int64)
             order = np.argsort(cell, kind="stable")
             cells, starts = np.unique(cell[order], return_index=True)
             bounds = np.append(starts, len(order))
             for i, c in enumerate(cells):
-                out[int(c)] = order[bounds[i]: bounds[i + 1]]
+                out[int(c)] = order_rows[order[bounds[i]: bounds[i + 1]]]
         with self._lock:
             self._grid = (b, out)
         return out
@@ -312,11 +336,11 @@ class StreamingDataset:
             sub = ColumnBatch(
                 {k: v[cand] for k, v in batch.columns.items()}, len(cand)
             )
-            sub_mask = _full_mask(cf(sub.columns, np), len(cand))
+            sub_mask = cf.exact_mask(sub.columns, len(cand))
             mask = np.zeros(batch.n, dtype=bool)
             mask[cand[sub_mask]] = True
         else:
-            mask = _full_mask(cf(batch.columns, np), batch.n)
+            mask = cf.exact_mask(batch.columns, batch.n)
         return ft, cache, batch, mask & valid
 
     def query(self, name: str, ecql: "str | ir.Filter" = "INCLUDE") -> ColumnBatch:

@@ -305,7 +305,10 @@ def haversine_m(x1, y1, x2, y2):
 # ---------------------------------------------------------------------------
 
 def _fmt(v: float) -> str:
-    return f"{v:.10g}"
+    # shortest round-trip representation: WKT is the master store for
+    # extent geometries, so formatting must never lose f64 precision
+    # (exact-predicate refinement parses it back)
+    return repr(float(v))
 
 
 _NUM = r"[-+]?\d+(?:\.\d+)?(?:[eE][-+]?\d+)?"

@@ -1,0 +1,311 @@
+"""Exact spatial predicate parity over extent (line/polygon) columns.
+
+The reference evaluates exact JTS predicates everywhere
+(geomesa-filter/.../factory/FastFilterFactory.scala:395, relation ops in
+geomesa-spark-jts/.../udf/SpatialRelationFunctions.scala). Here the dense
+scan uses a coarse bbox mask and the executor refines coarse-true rows
+against the host __wkt columns — these tests assert the end result matches
+a brute-force geofn oracle exactly (no over- or under-selection).
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, geofn
+from geomesa_tpu.utils import geometry as geo
+
+N = 600
+
+
+def _rand_lines(rng, n):
+    """Short 3-vertex polylines around the test region."""
+    out = []
+    for _ in range(n):
+        x0 = rng.uniform(-10, 10)
+        y0 = rng.uniform(-10, 10)
+        steps = rng.uniform(-1.5, 1.5, (2, 2))
+        pts = np.cumsum(np.vstack([[x0, y0], steps]), axis=0)
+        out.append(geo.LineString(pts))
+    return out
+
+
+def _rand_polys(rng, n):
+    """Small random triangles/quads (star-convex, non-self-intersecting)."""
+    out = []
+    for _ in range(n):
+        cx, cy = rng.uniform(-10, 10, 2)
+        k = int(rng.integers(3, 6))
+        ang = np.sort(rng.uniform(0, 2 * np.pi, k))
+        r = rng.uniform(0.3, 1.6, k)
+        xs = cx + r * np.cos(ang)
+        ys = cy + r * np.sin(ang)
+        ring = [(float(x), float(y)) for x, y in zip(xs, ys)]
+        ring.append(ring[0])
+        out.append(geo.Polygon(tuple(ring)))
+    return out
+
+
+def _mk_ds(geoms, typ):
+    ds = GeoDataset(n_shards=4)
+    ds.create_schema("t", f"tag:String,dtg:Date,*geom:{typ}")
+    n = len(geoms)
+    ds.insert(
+        "t",
+        {
+            "tag": [f"r{i}" for i in range(n)],
+            "dtg": np.full(n, np.datetime64("2021-06-01", "ms")),
+            "geom": [g.wkt() for g in geoms],
+        },
+        fids=[f"f{i}" for i in range(n)],
+    )
+    ds.flush()
+    return ds
+
+
+LIT_POLY = "POLYGON ((-2 -2, 4 -1, 5 4, -1 5, -3 1, -2 -2))"
+LIT_LINE = "LINESTRING (-8 -8, 0 0, 3 6, 9 2)"
+OPS = {
+    "INTERSECTS": lambda g, lit: geofn.st_intersects(g, lit),
+    "DISJOINT": lambda g, lit: not geofn.st_intersects(g, lit),
+    "WITHIN": lambda g, lit: geofn.st_within(g, lit),
+    "CONTAINS": lambda g, lit: geofn.st_contains(g, lit),
+    "CROSSES": lambda g, lit: geofn.st_crosses(g, lit),
+    "OVERLAPS": lambda g, lit: geofn.st_overlaps(g, lit),
+    "TOUCHES": lambda g, lit: geofn.st_touches(g, lit),
+}
+
+
+def _oracle_fids(geoms, op, lit):
+    pred = OPS[op]
+    return {f"f{i}" for i, g in enumerate(geoms) if bool(pred(g, lit))}
+
+
+def _query_fids(ds, ecql):
+    fc = ds.query("t", ecql)
+    return set(fc.columns["__fid__"]) if len(fc) else set()
+
+
+@pytest.fixture(scope="module")
+def line_ds():
+    rng = np.random.default_rng(7)
+    geoms = _rand_lines(rng, N)
+    return _mk_ds(geoms, "LineString"), geoms
+
+
+@pytest.fixture(scope="module")
+def poly_ds():
+    rng = np.random.default_rng(11)
+    geoms = _rand_polys(rng, N)
+    return _mk_ds(geoms, "Polygon"), geoms
+
+
+@pytest.mark.parametrize("op", ["INTERSECTS", "DISJOINT", "WITHIN", "CROSSES"])
+@pytest.mark.parametrize("lit_wkt", [LIT_POLY, LIT_LINE])
+def test_line_column_exact(line_ds, op, lit_wkt):
+    ds, geoms = line_ds
+    lit = geo.parse_wkt(lit_wkt)
+    got = _query_fids(ds, f"{op}(geom, {lit_wkt})")
+    want = _oracle_fids(geoms, op, lit)
+    assert got == want, (op, len(got), len(want))
+    assert ds.count("t", f"{op}(geom, {lit_wkt})") == len(want)
+
+
+@pytest.mark.parametrize(
+    "op", ["INTERSECTS", "DISJOINT", "WITHIN", "CONTAINS", "OVERLAPS"]
+)
+def test_polygon_column_exact(poly_ds, op):
+    ds, geoms = poly_ds
+    lit = geo.parse_wkt(LIT_POLY)
+    got = _query_fids(ds, f"{op}(geom, {LIT_POLY})")
+    want = _oracle_fids(geoms, op, lit)
+    assert got == want, (op, len(got), len(want))
+
+
+def test_polygon_contains_point_literal(poly_ds):
+    ds, geoms = poly_ds
+    lit_wkt = "POINT (1 1)"
+    lit = geo.parse_wkt(lit_wkt)
+    got = _query_fids(ds, f"CONTAINS(geom, {lit_wkt})")
+    want = _oracle_fids(geoms, "CONTAINS", lit)
+    assert got == want
+    assert want  # some triangle around origin should contain it
+
+
+def test_negated_intersects_polarity(poly_ds):
+    """NOT INTERSECTS == DISJOINT: the coarse mask must stay a superset
+    under negation (subset/certain masks inside NOT)."""
+    ds, geoms = poly_ds
+    a = _query_fids(ds, f"NOT (INTERSECTS(geom, {LIT_POLY}))")
+    b = _query_fids(ds, f"DISJOINT(geom, {LIT_POLY})")
+    lit = geo.parse_wkt(LIT_POLY)
+    want = _oracle_fids(geoms, "DISJOINT", lit)
+    assert a == b == want
+
+
+def test_compound_filter_with_refinement(line_ds):
+    """Attribute predicate AND exact spatial over an extent column."""
+    ds, geoms = line_ds
+    lit = geo.parse_wkt(LIT_POLY)
+    got = _query_fids(ds, f"tag = 'r5' AND INTERSECTS(geom, {LIT_POLY})")
+    inter = _oracle_fids(geoms, "INTERSECTS", lit)
+    assert got == ({"f5"} & inter)
+
+
+def test_extent_dwithin_exact(line_ds):
+    ds, geoms = line_ds
+    ecql = "DWITHIN(geom, POINT(0 0), 200000, meters)"
+    got = _query_fids(ds, ecql)
+    want = {
+        f"f{i}"
+        for i, g in enumerate(geoms)
+        if float(geofn.st_distanceSphere(g, geo.Point(0.0, 0.0))) <= 200000
+    }
+    assert got == want
+    assert want and len(want) < N
+
+
+def test_point_column_line_literal_exact():
+    """INTERSECTS(point column, LINESTRING) is exact on-segment, not bbox."""
+    rng = np.random.default_rng(3)
+    n = 400
+    xs = rng.uniform(-10, 10, n)
+    ys = rng.uniform(-10, 10, n)
+    # plant points exactly on the segment (0,0)->(6,6)
+    on = rng.integers(0, n, 25)
+    t = rng.uniform(0, 1, 25)
+    xs[on] = 6 * t
+    ys[on] = 6 * t
+    ds = GeoDataset(n_shards=4)
+    ds.create_schema("t", "dtg:Date,*geom:Point")
+    ds.insert(
+        "t",
+        {
+            "dtg": np.full(n, np.datetime64("2021-06-01", "ms")),
+            "geom__x": xs,
+            "geom__y": ys,
+        },
+        fids=[f"f{i}" for i in range(n)],
+    )
+    ds.flush()
+    lit_wkt = "LINESTRING (0 0, 6 6)"
+    got = _query_fids(ds, f"INTERSECTS(geom, {lit_wkt})")
+    lit = geo.parse_wkt(lit_wkt)
+    want = {
+        f"f{i}"
+        for i in range(n)
+        if bool(geofn.st_intersects(lit, (np.array([xs[i]]), np.array([ys[i]])))[0])
+    }
+    assert got == want
+    assert len(want) >= 25  # the planted points (bbox-only would over-select)
+    bbox_count = int(((xs >= 0) & (xs <= 6) & (ys >= 0) & (ys <= 6)).sum())
+    assert len(want) < bbox_count
+
+
+def test_point_column_touches_polygon_boundary():
+    """TOUCHES(point column, polygon) selects boundary points only."""
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "dtg:Date,*geom:Point")
+    xs = np.array([0.5, 0.0, 2.0, 1.0])  # inside, on edge, outside, on vertex
+    ys = np.array([0.5, 0.5, 2.0, 1.0])
+    ds.insert(
+        "t",
+        {
+            "dtg": np.full(4, np.datetime64("2021-06-01", "ms")),
+            "geom__x": xs,
+            "geom__y": ys,
+        },
+        fids=["in", "edge", "out", "vertex"],
+    )
+    ds.flush()
+    poly = "POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))"
+    assert _query_fids(ds, f"TOUCHES(geom, {poly})") == {"edge", "vertex"}
+    assert _query_fids(ds, f"WITHIN(geom, {poly})") == {"in"}
+    assert _query_fids(ds, f"INTERSECTS(geom, {poly})") == {"in", "edge", "vertex"}
+
+
+def test_density_respects_refinement(line_ds):
+    """Aggregations must run on the refined mask, not the coarse superset."""
+    ds, geoms = line_ds
+    lit = geo.parse_wkt(LIT_POLY)
+    want = len(_oracle_fids(geoms, "INTERSECTS", lit))
+    grid = ds.density(
+        "t", f"INTERSECTS(geom, {LIT_POLY})",
+        bbox=(-12, -12, 12, 12), width=32, height=32,
+    )
+    assert int(round(float(grid.sum()))) == want
+
+
+def test_wkt_full_precision_round_trip():
+    """WKT is the master store for extents — formatting must round-trip f64
+    exactly (the refinement pass parses it back)."""
+    x = 100.12345678901234
+    p = geo.Polygon(((x, 0.0), (x + 1, 0.0), (x + 1, 1.0), (x, 1.0), (x, 0.0)))
+    q = geo.parse_wkt(p.wkt())
+    assert q.bounds()[0] == x
+
+
+def test_polygon_equals_self():
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "dtg:Date,*geom:Polygon")
+    wkt = "POLYGON ((100.12345678901 0, 101.2 0, 101.2 1.5, 100.12345678901 0))"
+    ds.insert(
+        "t",
+        {"dtg": [np.datetime64("2021-06-01", "ms")], "geom": [wkt]},
+        fids=["a"],
+    )
+    ds.flush()
+    assert _query_fids(ds, f"EQUALS(geom, {wkt})") == {"a"}
+
+
+def test_not_bbox_matches_not_intersects(line_ds):
+    """NOT BBOX must agree with NOT INTERSECTS of the box polygon (exact
+    BBOX semantics; loose-bbox is the opt-out)."""
+    ds, geoms = line_ds
+    box = "BBOX(geom, -2, -2, 3, 3)"
+    poly = "POLYGON ((-2 -2, 3 -2, 3 3, -2 3, -2 -2))"
+    assert _query_fids(ds, f"NOT ({box})") == _query_fids(
+        ds, f"NOT (INTERSECTS(geom, {poly}))"
+    )
+    # positive direction too
+    assert _query_fids(ds, box) == _query_fids(ds, f"INTERSECTS(geom, {poly})")
+
+
+def test_point_contains_multipoint_literal():
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "dtg:Date,*geom:Point")
+    ds.insert(
+        "t",
+        {
+            "dtg": np.full(2, np.datetime64("2021-06-01", "ms")),
+            "geom__x": np.array([1.0, 2.0]),
+            "geom__y": np.array([1.0, 2.0]),
+        },
+        fids=["a", "b"],
+    )
+    ds.flush()
+    # a single point cannot contain two distinct points
+    assert _query_fids(ds, "CONTAINS(geom, MULTIPOINT (1 1, 2 2))") == set()
+    # but a degenerate single-point multipoint is fine
+    assert _query_fids(ds, "CONTAINS(geom, MULTIPOINT (1 1))") == {"a"}
+    assert _query_fids(ds, "EQUALS(geom, POINT (2 2))") == {"b"}
+
+
+def test_stream_extent_geometry_query():
+    """Streaming grid index must bucket extents by bbox, not centroid."""
+    from geomesa_tpu.stream.live import StreamingDataset
+
+    sd = StreamingDataset()
+    sd.create_schema("s", "dtg:Date,*geom:Polygon")
+    sd.write(
+        "s",
+        {
+            "dtg": [np.datetime64("2021-06-01", "ms")],
+            "geom": ["POLYGON ((0 0, 40 0, 40 40, 0 40, 0 0))"],
+        },
+        fids=["big"],
+    )
+    got = sd.query(
+        "s",
+        "INTERSECTS(geom, POLYGON ((0.5 0.5, 1.5 0.5, 1.5 1.5, 0.5 1.5, 0.5 0.5)))",
+    )
+    assert got.n == 1
