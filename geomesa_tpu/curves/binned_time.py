@@ -66,12 +66,30 @@ class BinnedTime:
 
     def to_scaled(self, epoch_ms: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
         """epoch_ms -> (bin int32, scaled-offset int32) device columns."""
+        if self.period in (TimePeriod.DAY, TimePeriod.WEEK):
+            from geomesa_tpu import native
+
+            P = DAY_MS if self.period == TimePeriod.DAY else WEEK_MS
+            out = native.time_split(
+                np.asarray(epoch_ms, np.int64), P, self.off_scale,
+                want_off_ms=False, want_scaled=True,
+            )
+            if out is not None:
+                return out[0], out[2]
         b, off = self.to_bin_and_offset(epoch_ms)
         return b, (off // self.off_scale).astype(np.int32)
 
     def to_bin_and_offset(self, epoch_ms: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
-        """epoch_ms (int64) -> (bin int32, offset_ms int64). Vectorized."""
+        """epoch_ms (int64) -> (bin int32, offset_ms int64). Vectorized
+        (one native pass for the fixed-width periods)."""
         t = np.asarray(epoch_ms, dtype=np.int64)
+        if self.period in (TimePeriod.DAY, TimePeriod.WEEK):
+            from geomesa_tpu import native
+
+            P = DAY_MS if self.period == TimePeriod.DAY else WEEK_MS
+            out = native.time_split(t, P, 1, want_off_ms=True)
+            if out is not None:
+                return out[0], out[1]
         if self.period == TimePeriod.DAY:
             b = np.floor_divide(t, DAY_MS)
             off = t - b * DAY_MS

@@ -246,7 +246,13 @@ class Z2SFC:
         self.lat = NormalizedDimension(-90.0, 90.0, self.BITS)
 
     def index(self, x: np.ndarray, y: np.ndarray) -> np.ndarray:
-        """(lon, lat) -> z (uint64). Vectorized."""
+        """(lon, lat) -> z (uint64). Vectorized (fused native single pass
+        when the library is built; numpy normalize+interleave otherwise)."""
+        from geomesa_tpu import native
+
+        out = native.z2_encode(np.asarray(x, np.float64), np.asarray(y, np.float64))
+        if out is not None:
+            return out
         return interleave2(self.lon.normalize(x), self.lat.normalize(y))
 
     def invert(self, z: np.ndarray) -> Tuple[np.ndarray, np.ndarray]:
@@ -285,7 +291,18 @@ class Z3SFC:
         self.time = NormalizedDimension(0.0, float(self.binned.max_offset_ms), self.BITS)
 
     def index(self, x: np.ndarray, y: np.ndarray, t_offset_ms: np.ndarray) -> np.ndarray:
-        """(lon, lat, offset-ms-within-bin) -> z (uint64). Vectorized."""
+        """(lon, lat, offset-ms-within-bin) -> z (uint64). Vectorized (fused
+        native single pass when available)."""
+        from geomesa_tpu import native
+
+        t = np.asarray(t_offset_ms)
+        if t.dtype.kind in "iu":
+            out = native.z3_encode(
+                np.asarray(x, np.float64), np.asarray(y, np.float64),
+                t.astype(np.int64, copy=False), float(self.binned.max_offset_ms),
+            )
+            if out is not None:
+                return out
         return interleave3(
             self.lon.normalize(x), self.lat.normalize(y), self.time.normalize(t_offset_ms)
         )

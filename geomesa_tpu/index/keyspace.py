@@ -29,6 +29,7 @@ from geomesa_tpu.curves.cover import ZRange
 from geomesa_tpu.curves.xz import XZ2SFC, XZ3SFC
 from geomesa_tpu.curves.zorder import Z2SFC, Z3SFC, split_u64
 from geomesa_tpu.filter import ir
+from geomesa_tpu.index import packsort
 from geomesa_tpu.schema.columns import ColumnBatch
 from geomesa_tpu.schema.feature_type import FeatureType
 
@@ -77,6 +78,18 @@ class KeySpace:
     def sort_order(self, cols: Dict[str, np.ndarray]) -> np.ndarray:
         """argsort for the table's global sort (primary last in lexsort)."""
         raise NotImplementedError
+
+    def fast_build(
+        self,
+        cols: Dict[str, np.ndarray],
+        force_shifts: Optional[Dict[str, int]] = None,
+    ) -> Optional[tuple]:
+        """Radix pack-sort build (packsort module): returns
+        (order, sorted_key_columns, shifts) — key columns QUANTIZED by
+        ``shifts`` — or None to fall back to :meth:`sort_order` + gather.
+        ``force_shifts`` pins the quantization to an existing table's
+        (LSM-append compatibility)."""
+        return None
 
     def plan(self, ft: FeatureType, f: ir.Filter) -> Optional[KeyPlan]:
         """None if this key space cannot serve the filter at all."""
@@ -127,6 +140,15 @@ def _z_envelope(ranges: List[ZRange]) -> Tuple[int, int]:
     return (ranges[0].lo, ranges[-1].hi) if ranges else (0, 0)
 
 
+def _shift_of(shard_cols: Dict, col: str) -> int:
+    """Quantization shift of a stored key column (0 on the argsort path).
+    Bounds must be shifted identically before searchsorted — floor on both
+    sides keeps windows supersets (side='right' then covers the whole
+    quantized cell of the upper bound)."""
+    shifts = shard_cols.get("__shifts__")
+    return 0 if shifts is None else shifts.get(col, 0)
+
+
 def _coverage(ranges: List[ZRange], total_bits: int) -> float:
     span = sum(r.hi - r.lo + 1 for r in ranges)
     return span / float(1 << total_bits)
@@ -158,10 +180,20 @@ class Z3KeySpace(KeySpace):
         ts = batch[self.dtg]
         b, off = self.binned.to_bin_and_offset(ts)
         z = self.sfc.index(xs, ys, off)
-        return {"__z3_bin": b.astype(np.int32), "__z3": z}
+        return {"__z3_bin": np.asarray(b, np.int32), "__z3": z}
 
     def sort_order(self, cols):
         return np.lexsort((cols["__z3"], cols["__z3_bin"]))
+
+    def fast_build(self, cols, force_shifts=None):
+        fs = None if force_shifts is None else force_shifts.get("__z3")
+        out = packsort.pack_sort(
+            cols["__z3"], 63, prefix=cols["__z3_bin"], force_shift=fs
+        )
+        if out is None:
+            return None
+        perm, zq, bins_sorted, shift = out
+        return perm, {"__z3_bin": bins_sorted, "__z3": zq}, {"__z3": shift}
 
     def plan(self, ft, f):
         geoms = ir.extract_geometries(f, self.geom)
@@ -196,6 +228,8 @@ class Z3KeySpace(KeySpace):
         bins_col = shard_cols["__z3_bin"]
         z_col = shard_cols["__z3"]
         zlo, zhi = _z_envelope(plan.ranges)
+        sh = _shift_of(shard_cols, "__z3")
+        zlo, zhi = zlo >> sh, zhi >> sh
         bins = plan.bins
         if len(bins) > MAX_WINDOW_BINS:
             # collapse: one window spanning [first bin, last bin]
@@ -230,6 +264,14 @@ class Z2KeySpace(KeySpace):
     def sort_order(self, cols):
         return np.argsort(cols["__z2"], kind="stable")
 
+    def fast_build(self, cols, force_shifts=None):
+        fs = None if force_shifts is None else force_shifts.get("__z2")
+        out = packsort.pack_sort(cols["__z2"], 62, force_shift=fs)
+        if out is None:
+            return None
+        perm, zq, _, shift = out
+        return perm, {"__z2": zq}, {"__z2": shift}
+
     def plan(self, ft, f):
         geoms = ir.extract_geometries(f, self.geom)
         if geoms.disjoint:
@@ -244,8 +286,9 @@ class Z2KeySpace(KeySpace):
     def resolve_windows(self, plan, shard_cols, n):
         z_col = shard_cols["__z2"]
         zlo, zhi = _z_envelope(plan.ranges)
-        s = np.searchsorted(z_col, np.uint64(zlo), side="left")
-        e = np.searchsorted(z_col, np.uint64(zhi), side="right")
+        sh = _shift_of(shard_cols, "__z2")
+        s = np.searchsorted(z_col, np.uint64(zlo >> sh), side="left")
+        e = np.searchsorted(z_col, np.uint64(zhi >> sh), side="right")
         return np.asarray([s], np.int64), np.asarray([e], np.int64)
 
 
@@ -275,6 +318,16 @@ class XZ2KeySpace(KeySpace):
     def sort_order(self, cols):
         return np.argsort(cols["__xz2"], kind="stable")
 
+    def fast_build(self, cols, force_shifts=None):
+        fs = None if force_shifts is None else force_shifts.get("__xz2")
+        code = cols["__xz2"].astype(np.uint64)  # sequence codes, nonnegative
+        bits = int(self.sfc.subtree_size[0]).bit_length()
+        out = packsort.pack_sort(code, bits, force_shift=fs)
+        if out is None:
+            return None
+        perm, cq, _, shift = out
+        return perm, {"__xz2": cq}, {"__xz2": shift}
+
     def plan(self, ft, f):
         geoms = ir.extract_geometries(f, self.geom)
         if geoms.disjoint:
@@ -292,10 +345,11 @@ class XZ2KeySpace(KeySpace):
         # XZ ranges are NOT contiguous-envelope friendly (singleton parent
         # codes interleave) — resolve each merged range to a window.
         col = shard_cols["__xz2"]
+        sh = _shift_of(shard_cols, "__xz2")
         starts, ends = [], []
         for r in plan.ranges:
-            s = np.searchsorted(col, r.lo, side="left")
-            e = np.searchsorted(col, r.hi, side="right")
+            s = np.searchsorted(col, r.lo >> sh, side="left")
+            e = np.searchsorted(col, r.hi >> sh, side="right")
             if e > s:
                 starts.append(s)
                 ends.append(e)
@@ -334,10 +388,22 @@ class XZ3KeySpace(KeySpace):
             batch[self.geom + "__xmin"], batch[self.geom + "__ymin"], off,
             batch[self.geom + "__xmax"], batch[self.geom + "__ymax"], off,
         )
-        return {"__xz3_bin": b.astype(np.int32), "__xz3": code}
+        return {"__xz3_bin": np.asarray(b, np.int32), "__xz3": code}
 
     def sort_order(self, cols):
         return np.lexsort((cols["__xz3"], cols["__xz3_bin"]))
+
+    def fast_build(self, cols, force_shifts=None):
+        fs = None if force_shifts is None else force_shifts.get("__xz3")
+        bits = int(self.sfc.subtree_size[0]).bit_length()
+        out = packsort.pack_sort(
+            cols["__xz3"].astype(np.uint64), bits,
+            prefix=cols["__xz3_bin"], force_shift=fs,
+        )
+        if out is None:
+            return None
+        perm, cq, bins_sorted, shift = out
+        return perm, {"__xz3_bin": bins_sorted, "__xz3": cq}, {"__xz3": shift}
 
     def plan(self, ft, f):
         geoms = ir.extract_geometries(f, self.geom)
@@ -367,6 +433,7 @@ class XZ3KeySpace(KeySpace):
     def resolve_windows(self, plan, shard_cols, n):
         bins_col = shard_cols["__xz3_bin"]
         code_col = shard_cols["__xz3"]
+        sh = _shift_of(shard_cols, "__xz3")
         bins = plan.bins
         if len(bins) > 8:  # xz windows multiply per bin; collapse earlier
             s = np.searchsorted(bins_col, bins[0], side="left")
@@ -380,8 +447,8 @@ class XZ3KeySpace(KeySpace):
                 continue
             seg = code_col[s:e]
             for r in plan.ranges:
-                s2 = s + np.searchsorted(seg, r.lo, side="left")
-                e2 = s + np.searchsorted(seg, r.hi, side="right")
+                s2 = s + np.searchsorted(seg, r.lo >> sh, side="left")
+                e2 = s + np.searchsorted(seg, r.hi >> sh, side="right")
                 if e2 > s2:
                     starts.append(s2)
                     ends.append(e2)
@@ -417,6 +484,14 @@ class S2KeySpace(KeySpace):
     def sort_order(self, cols):
         return np.argsort(cols["__s2"], kind="stable")
 
+    def fast_build(self, cols, force_shifts=None):
+        fs = None if force_shifts is None else force_shifts.get("__s2")
+        out = packsort.pack_sort(cols["__s2"], 64, force_shift=fs)
+        if out is None:
+            return None
+        perm, cq, _, shift = out
+        return perm, {"__s2": cq}, {"__s2": shift}
+
     def plan(self, ft, f):
         geoms = ir.extract_geometries(f, self.geom)
         if geoms.disjoint:
@@ -431,10 +506,11 @@ class S2KeySpace(KeySpace):
 
     def resolve_windows(self, plan, shard_cols, n):
         col = shard_cols["__s2"]
+        sh = _shift_of(shard_cols, "__s2")
         starts, ends = [], []
         for r in plan.ranges:
-            s = np.searchsorted(col, np.uint64(r.lo), side="left")
-            e = np.searchsorted(col, np.uint64(r.hi), side="right")
+            s = np.searchsorted(col, np.uint64(r.lo >> sh), side="left")
+            e = np.searchsorted(col, np.uint64(r.hi >> sh), side="right")
             if e > s:
                 starts.append(s)
                 ends.append(e)
@@ -470,12 +546,22 @@ class S3KeySpace(KeySpace):
     def index_keys(self, ft, batch):
         b, _ = self.binned.to_bin_and_offset(batch[self.dtg])
         return {
-            "__s3_bin": b.astype(np.int32),
+            "__s3_bin": np.asarray(b, np.int32),
             "__s3": self.sfc.index(batch[self.geom + "__x"], batch[self.geom + "__y"]),
         }
 
     def sort_order(self, cols):
         return np.lexsort((cols["__s3"], cols["__s3_bin"]))
+
+    def fast_build(self, cols, force_shifts=None):
+        fs = None if force_shifts is None else force_shifts.get("__s3")
+        out = packsort.pack_sort(
+            cols["__s3"], 64, prefix=cols["__s3_bin"], force_shift=fs
+        )
+        if out is None:
+            return None
+        perm, cq, bins_sorted, shift = out
+        return perm, {"__s3_bin": bins_sorted, "__s3": cq}, {"__s3": shift}
 
     def plan(self, ft, f):
         geoms = ir.extract_geometries(f, self.geom)
@@ -501,6 +587,7 @@ class S3KeySpace(KeySpace):
     def resolve_windows(self, plan, shard_cols, n):
         bins_col = shard_cols["__s3_bin"]
         col = shard_cols["__s3"]
+        sh = _shift_of(shard_cols, "__s3")
         bins = plan.bins
         if len(bins) > 8 or not plan.ranges:
             s = np.searchsorted(bins_col, bins[0], side="left")
@@ -514,8 +601,8 @@ class S3KeySpace(KeySpace):
                 continue
             seg = col[s:e]
             for r in plan.ranges:
-                s2_ = s + np.searchsorted(seg, np.uint64(r.lo), side="left")
-                e2_ = s + np.searchsorted(seg, np.uint64(r.hi), side="right")
+                s2_ = s + np.searchsorted(seg, np.uint64(r.lo >> sh), side="left")
+                e2_ = s + np.searchsorted(seg, np.uint64(r.hi >> sh), side="right")
                 if e2_ > s2_:
                     starts.append(s2_)
                     ends.append(e2_)
@@ -527,21 +614,32 @@ class S3KeySpace(KeySpace):
 
 
 class IdKeySpace(KeySpace):
-    """Feature-id index (reference IdIndex): host-sorted fid strings."""
+    """Feature-id index (reference IdIndex), hash-keyed: rows sort by a
+    64-bit hash of the fid instead of the string bytes — string argsorts
+    don't scale to bulk loads, and id lookups only need *locatable* rows:
+    the window for hash(fid) is a superset (collisions included) and the
+    IdIn mask applies exact fid equality on the window rows."""
 
     name = "id"
     kind = "id"
-    key_cols = ("__fid__",)  # the sort key IS the fid string column
+    key_cols = ("__idhash",)
 
     def supports(self, ft):
         return True
 
     def index_keys(self, ft, batch):
-        # no derived key column: the table sorts the __fid__ strings directly
-        return {}
+        return {"__idhash": packsort.fid_hash64(batch["__fid__"])}
 
     def sort_order(self, cols):
-        return np.argsort(cols["__fid__"], kind="stable")
+        return np.argsort(cols["__idhash"], kind="stable")
+
+    def fast_build(self, cols, force_shifts=None):
+        fs = None if force_shifts is None else force_shifts.get("__idhash")
+        out = packsort.pack_sort(cols["__idhash"], 64, force_shift=fs)
+        if out is None:
+            return None
+        perm, hq, _, shift = out
+        return perm, {"__idhash": hq}, {"__idhash": shift}
 
     def plan(self, ft, f):
         ids = ir.extract_ids(f)
@@ -552,11 +650,13 @@ class IdKeySpace(KeySpace):
         return plan
 
     def resolve_windows(self, plan, shard_cols, n):
-        fids = shard_cols["__fid__"]  # sorted object array
+        col = shard_cols["__idhash"]
+        sh = _shift_of(shard_cols, "__idhash")
         starts, ends = [], []
         for fid in plan._ids:
-            s = np.searchsorted(fids, fid, side="left")
-            e = np.searchsorted(fids, fid, side="right")
+            h = packsort.fid_hash64_one(fid) >> sh
+            s = np.searchsorted(col, np.uint64(h), side="left")
+            e = np.searchsorted(col, np.uint64(h), side="right")
             if e > s:
                 starts.append(s)
                 ends.append(e)
@@ -571,9 +671,17 @@ class AttributeKeySpace(KeySpace):
 
     kind = "attr"
 
-    def __init__(self, attr: str, geom: Optional[str] = None):
+    #: attribute-type name -> numpy dtype of the stored column
+    _NP_TYPES = {
+        "int32": np.int32, "int64": np.int64, "float32": np.float32,
+        "float64": np.float64, "date": np.int64, "bool": np.bool_,
+    }
+
+    def __init__(self, attr: str, geom: Optional[str] = None,
+                 attr_type: Optional[str] = None):
         self.attr = attr
         self.geom = geom
+        self.attr_type = attr_type
         self.name = f"attr:{attr}"
         self.key_cols = (f"__attr_{attr}",)
 
@@ -598,6 +706,30 @@ class AttributeKeySpace(KeySpace):
             return np.lexsort((cols["__z2"], cols[self.sort_col]))
         return np.argsort(cols[self.sort_col], kind="stable")
 
+    def fast_build(self, cols, force_shifts=None):
+        col = cols[self.sort_col]
+        if self.attr_type == "string":
+            # rank column (small ints; -1 = null sorts first as 0)
+            key = (col.astype(np.int64) + 1).astype(np.uint64)
+            bits = packsort.bits_for(int(key.max()) + 1) if len(key) else 1
+        else:
+            try:
+                key, bits = packsort.to_ordered_u64(col)
+            except TypeError:
+                return None
+        tb, tb_bits = None, 0
+        if self.geom and "__z2" in cols:
+            tb = cols["__z2"].astype(np.uint64) << np.uint64(2)  # 62 bits -> top
+            tb_bits = 16  # spatial-locality tiebreak, best-effort
+        fs = None if force_shifts is None else force_shifts.get(self.sort_col)
+        out = packsort.pack_sort(
+            key, bits, tiebreak=tb, tiebreak_bits=tb_bits, force_shift=fs
+        )
+        if out is None:
+            return None
+        perm, kq, _, shift = out
+        return perm, {self.sort_col: kq}, {self.sort_col: shift}
+
     # string attrs re-rank their dictionary on growth and the z2 tiebreak
     # is a second sort key: appends always fully rebuild
     can_insert = False
@@ -616,6 +748,13 @@ class AttributeKeySpace(KeySpace):
     def resolve_windows(self, plan, shard_cols, n):
         col = shard_cols[self.sort_col]
         a = plan._ft.attr(self.attr)
+        shifts = shard_cols.get("__shifts__") or {}
+        # fast-built tables store the ordered-u64 QUANTIZED key; bounds go
+        # through the same transform (presence in shifts marks the path,
+        # since shift can legitimately be 0)
+        fastq = self.sort_col in shifts
+        sh = shifts.get(self.sort_col, 0)
+        np_type = self._NP_TYPES.get(a.type)
         starts, ends = [], []
         for lo, hi in plan._bounds:
             if a.type == "string":
@@ -626,6 +765,18 @@ class AttributeKeySpace(KeySpace):
                     return np.zeros(1, np.int64), np.full(1, n, np.int64)
                 lo2 = rank(lo, "lo") if lo is not None else None
                 hi2 = rank(hi, "hi") if hi is not None else None
+                if fastq:
+                    lo2 = None if lo2 is None else np.uint64((lo2 + 1) >> sh)
+                    hi2 = None if hi2 is None else np.uint64((hi2 + 1) >> sh)
+            elif fastq:
+                lo2 = (
+                    None if lo is None
+                    else np.uint64(packsort.ordered_u64_scalar(lo, np_type) >> sh)
+                )
+                hi2 = (
+                    None if hi is None
+                    else np.uint64(packsort.ordered_u64_scalar(hi, np_type) >> sh)
+                )
             else:
                 lo2, hi2 = lo, hi
                 if a.type == "date":
@@ -708,7 +859,7 @@ def keyspaces_for_schema(ft: FeatureType) -> List[KeySpace]:
         elif kind == "attr":
             for a in ft.attributes:
                 if a.indexed and not a.is_geom:
-                    out.append(AttributeKeySpace(a.name, geom))
+                    out.append(AttributeKeySpace(a.name, geom, a.type))
     if not any(isinstance(k, IdKeySpace) for k in out):
         out.append(IdKeySpace())
     return [k for k in out if k.supports(ft)]

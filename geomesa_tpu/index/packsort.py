@@ -1,0 +1,244 @@
+"""Radix pack-sort: the bulk-ingest sort engine.
+
+Building a sorted index table needs an argsort of n keys per index — numpy's
+``argsort`` runs at ~5-9M keys/s on one core, which caps ingest far below the
+1B-point target (SURVEY.md §7(c)). But numpy's *value-only* ``np.sort`` on
+uint64 is a radix sort at ~70M keys/s. This module exploits that by packing
+
+    [ key bits (quantized) | row index bits ]
+
+into a single uint64, value-sorting, then unpacking both the permutation and
+the sorted (quantized) key column from the same array — no argsort, no
+key-column gather. The stored key column is the *quantized* key; window
+resolution quantizes its query bounds with the same shift, so searchsorted
+windows stay supersets of the exact matches (the fine mask kernel restores
+exactness — same contract as the reference's coarse row filters,
+index/filters/Z3Filter.scala:18-62).
+
+The trade: key precision is whatever fits in 64 bits after the row-index
+bits (28 bits at 200M rows). A z3 key keeps ~11 bits/dim — cell occupancy at
+that depth is a handful of rows, so the windows widen only at range edges.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+#: refuse to quantize a key below this many bits (fall back to argsort)
+MIN_KEY_BITS = 16
+
+
+def bits_for(n: int) -> int:
+    """Bits needed to represent values 0..n-1 (at least 1)."""
+    return max(1, int(n - 1).bit_length()) if n > 1 else 1
+
+
+def to_ordered_u64(a: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Order-preserving map of a numeric column into uint64.
+
+    Returns (u64 array, significant bits). int32/float32 map losslessly in
+    32 bits; 64-bit types use their full width (callers quantize by
+    shifting, which stays order-preserving / superset-safe)."""
+    k = a.dtype.kind
+    if a.dtype == np.int32:
+        return (a.astype(np.int64) + 2**31).astype(np.uint64), 32
+    if a.dtype == np.uint32:
+        return a.astype(np.uint64), 32
+    if a.dtype == np.int64:
+        return (a.astype(np.uint64) + np.uint64(2**63)), 64
+    if a.dtype == np.uint64:
+        return a, 64
+    if a.dtype == np.float32:
+        b = a.view(np.uint32).astype(np.uint64)
+        sign = (b >> np.uint64(31)).astype(bool)
+        return np.where(sign, np.uint64(2**32 - 1) - b, b + np.uint64(2**31)), 33
+    if a.dtype == np.float64:
+        b = a.view(np.uint64)
+        sign = (b >> np.uint64(63)).astype(bool)
+        return np.where(sign, ~b, b | np.uint64(2**63)), 64
+    if k == "b":
+        return a.astype(np.uint64), 1
+    if a.dtype == np.int16 or a.dtype == np.int8:
+        return (a.astype(np.int64) + 2**15).astype(np.uint64), 16
+    raise TypeError(f"no u64 ordering for dtype {a.dtype}")
+
+
+def ordered_u64_scalar(v, dtype) -> int:
+    """``to_ordered_u64`` for one query-bound scalar (window resolution).
+    Out-of-range integer bounds clamp to the dtype's limits (still a
+    superset: the fine filter applies the exact comparison)."""
+    dt = np.dtype(dtype)
+    if dt.kind in "iu" and not isinstance(v, float):
+        info = np.iinfo(dt)
+        v = min(max(int(v), info.min), info.max)
+    out, _ = to_ordered_u64(np.asarray([v], dtype=dt))
+    return int(out[0])
+
+
+def pack_sort(
+    key: np.ndarray,
+    key_bits: int,
+    prefix: Optional[np.ndarray] = None,
+    tiebreak: Optional[np.ndarray] = None,
+    tiebreak_bits: int = 0,
+    force_shift: Optional[int] = None,
+) -> Optional[Tuple[np.ndarray, np.ndarray, Optional[np.ndarray], int]]:
+    """Sort rows by (prefix, key[, tiebreak]) via one packed radix sort.
+
+    ``key``: uint64 with ``key_bits`` significant low bits (already
+    order-mapped). ``prefix``: optional small int column (e.g. time bin)
+    that sorts ahead of the key. ``tiebreak``: optional uint64 whose top
+    bits order equal keys (locality only — not stored, not resolvable).
+    ``force_shift`` pins the key quantization (LSM appends must match the
+    existing table's stored keys); None picks the finest shift that fits.
+
+    Returns (perm int32|int64, key_quantized_sorted uint64, prefix_sorted
+    or None, key_shift) — or None when the bit budget leaves the key too
+    coarse (< MIN_KEY_BITS), in which case the caller argsorts.
+    """
+    n = len(key)
+    if n == 0:
+        return None
+    idx_bits = bits_for(n)
+    if prefix is not None:
+        pmin = int(prefix.min())
+        pspan = int(prefix.max()) - pmin + 1
+        prefix_bits = bits_for(pspan)
+    else:
+        pmin = 0
+        prefix_bits = 0
+    avail = 64 - idx_bits - prefix_bits
+    if avail <= 0:
+        return None
+    shift = max(0, key_bits - avail) if force_shift is None else force_shift
+    kq_bits = key_bits - shift
+    if kq_bits < min(MIN_KEY_BITS, key_bits) or kq_bits > avail or kq_bits <= 0:
+        return None
+    spare = avail - kq_bits
+    tb_bits = min(tiebreak_bits, spare) if tiebreak is not None else 0
+
+    from geomesa_tpu import native
+
+    L = native.lib()
+    if L is not None:
+        key = np.ascontiguousarray(key, np.uint64)
+        packed = np.empty(n, np.uint64)
+        tb = (
+            np.ascontiguousarray(tiebreak, np.uint64) if tb_bits else None
+        )
+        pfx = (
+            np.ascontiguousarray(prefix, np.int32) if prefix is not None else None
+        )
+        L.gm_pack_idx(
+            key, n, shift, idx_bits, tb_bits,
+            tb.ctypes.data if tb is not None else None,
+            pfx.ctypes.data if pfx is not None else None,
+            prefix_bits, pmin, packed,
+        )
+        # packed values are unique (row index in the low bits), so stability
+        # is irrelevant. numpy's default introsort is AVX-vectorized and
+        # beats scalar std::sort on one thread; the native parallel
+        # mergesort wins when the host has cores to spare.
+        if n > 2_000_000 and L.gm_num_threads() >= 4:
+            L.gm_sort_u64(packed, n)
+        else:
+            packed.sort()
+        small = n < 2**31
+        perm = np.empty(n, np.int32 if small else np.int64)
+        key_sorted = np.empty(n, np.uint64)
+        prefix_sorted = (
+            np.empty(n, np.int32) if prefix is not None else None
+        )
+        L.gm_unpack_idx(
+            packed, n, kq_bits, idx_bits, tb_bits, prefix_bits, pmin,
+            perm.ctypes.data if small else None,
+            perm.ctypes.data if not small else None,
+            key_sorted,
+            prefix_sorted.ctypes.data if prefix_sorted is not None else None,
+        )
+        if prefix_sorted is not None:
+            prefix_sorted = prefix_sorted.astype(prefix.dtype, copy=False)
+        return perm, key_sorted, prefix_sorted, shift
+
+    if prefix is not None:
+        # subtract in int64 then reinterpret as u64 (values nonnegative)
+        p64 = (prefix.astype(np.int64, copy=False) - np.int64(pmin)).view(np.uint64)
+    else:
+        p64 = None
+    kq = key >> np.uint64(shift) if shift else key
+    packed = kq << np.uint64(idx_bits + tb_bits)
+    if tb_bits:
+        packed |= (tiebreak >> np.uint64(64 - tb_bits)) << np.uint64(idx_bits)
+    if p64 is not None:
+        packed |= p64 << np.uint64(64 - prefix_bits)
+    packed |= np.arange(n, dtype=np.uint64)
+    packed.sort()
+    perm = (packed & np.uint64((1 << idx_bits) - 1)).astype(
+        np.int32 if n < 2**31 else np.int64
+    )
+    key_sorted = (packed >> np.uint64(idx_bits + tb_bits)) & np.uint64(
+        (1 << kq_bits) - 1
+    )
+    if p64 is not None:
+        prefix_sorted = (
+            (packed >> np.uint64(64 - prefix_bits)).view(np.int64) + np.int64(pmin)
+        ).astype(prefix.dtype, copy=False)
+    else:
+        prefix_sorted = None
+    return perm, key_sorted, prefix_sorted, shift
+
+
+_HASH_PRIMES = np.array(
+    [
+        0x9E3779B97F4A7C15, 0xC2B2AE3D27D4EB4F, 0x165667B19E3779F9,
+        0x27D4EB2F165667C5, 0x85EBCA77C2B2AE63, 0xFF51AFD7ED558CCD,
+        0xC4CEB9FE1A85EC53, 0x2545F4914F6CDD1D,
+    ],
+    dtype=np.uint64,
+)
+
+
+def fid_hash64(fids: np.ndarray) -> np.ndarray:
+    """Vectorized order-free 64-bit hash of a string/bytes column.
+
+    Character bytes are NUL-padded to 8-byte chunks and mixed as
+    ``XOR_j(chunk_j * prime_j)`` + avalanche — a handful of vector passes
+    regardless of string width, and width-independent (zero chunks
+    contribute zero, so the same fid hashes identically from U7 and U32
+    columns). Used as the id-index sort key: lookups hash the query ids the
+    same way; collisions are resolved by the exact fid equality mask (IdIn)
+    on the window rows."""
+    a = np.asarray(fids)
+    if a.dtype.kind == "O":
+        a = a.astype(str)
+    if a.dtype.kind == "U":
+        w = a.dtype.itemsize  # UCS4 codepoints, little-endian
+    elif a.dtype.kind == "S":
+        w = a.dtype.itemsize
+    else:
+        raise TypeError(f"fid hash needs a string column, got {a.dtype}")
+    from geomesa_tpu import native
+
+    out = native.fid_hash64(a)
+    if out is not None:
+        return out
+    n = len(a)
+    k = (w + 7) // 8
+    m = np.zeros((n, k * 8), np.uint8)
+    m[:, :w] = np.frombuffer(a.tobytes(), dtype=np.uint8).reshape(n, w)
+    q = m.view(np.uint64)
+    h = np.zeros(n, np.uint64)
+    for j in range(k):
+        h ^= q[:, j] * _HASH_PRIMES[j % 8]
+    # avalanche so quantized top bits spread (the table stores h >> shift)
+    h ^= h >> np.uint64(33)
+    h *= np.uint64(0xFF51AFD7ED558CCD)
+    h ^= h >> np.uint64(29)
+    return h
+
+
+def fid_hash64_one(fid: str) -> int:
+    """Scalar counterpart of :func:`fid_hash64` (query-time lookups)."""
+    return int(fid_hash64(np.asarray([fid]))[0])

@@ -34,8 +34,8 @@ from geomesa_tpu.schema.columns import ColumnBatch, DictionaryEncoder, encode_ba
 from geomesa_tpu.schema.feature_type import FeatureType
 from geomesa_tpu.stats import sketches as sk
 
-# Columns that live host-side only (object dtype or 64-bit keys).
-_HOST_ONLY_DTYPES = ("O", "U")
+# Columns that live host-side only (string dtypes or 64-bit keys).
+_HOST_ONLY_DTYPES = ("O", "U", "S")
 
 
 def _device_view(a: np.ndarray) -> Optional[np.ndarray]:
@@ -75,6 +75,9 @@ class IndexTable:
         self.shard_bounds = np.zeros(n_shards + 1, np.int64)
         self._device_cache: Dict[tuple, dict] = {}
         self._rank_vocab: Optional[np.ndarray] = None  # for string attr index
+        #: key-column quantization shifts when the radix pack-sort built
+        #: this table (None = argsort path, raw keys stored)
+        self.key_shifts: Optional[Dict[str, int]] = None
 
     # -- build ------------------------------------------------------------
     def rebuild(self, columns: Dict[str, np.ndarray], dicts: Dict[str, DictionaryEncoder]):
@@ -94,14 +97,25 @@ class IndexTable:
             ranks = np.where(codes >= 0, rank_of_code[np.clip(codes, 0, None)], -1)
             cols[ks.sort_col] = ranks
             self._rank_vocab = vocab[order]
-        order = ks.sort_order(cols)
-        self.order = np.asarray(order, np.int64)
-        self._master = cols
-        key_names = (set(ks.key_cols) | {getattr(ks, "sort_col", None)}) - {None}
-        self.key_columns = {
-            k: cols[k][order] for k in key_names if k in cols
-        }
-        self.n = len(order)
+        fb = ks.fast_build(cols)
+        if fb is not None:
+            # radix pack-sort: permutation + quantized sorted keys in one
+            # value-sort, no argsort / key gather (packsort module)
+            self.order, self.key_columns, self.key_shifts = fb
+            self._master = cols
+            self.n = len(self.order)
+        else:
+            order = ks.sort_order(cols)
+            self.order = np.asarray(
+                order, np.int32 if len(order) < 2**31 else np.int64
+            )
+            self._master = cols
+            key_names = (set(ks.key_cols) | {getattr(ks, "sort_col", None)}) - {None}
+            self.key_columns = {
+                k: cols[k][order] for k in key_names if k in cols
+            }
+            self.key_shifts = None
+            self.n = len(order)
         self.shard_bounds = np.linspace(0, self.n, self.n_shards + 1).astype(np.int64)
         self._device_cache.clear()
 
@@ -122,26 +136,42 @@ class IndexTable:
         key_names = list(self.key_columns)
         if any(k not in fresh_cols for k in key_names):
             return self.rebuild(columns, dicts)
-        fresh_order = np.asarray(ks.sort_order(fresh_cols), np.int64)
-        fresh_sorted = {k: fresh_cols[k][fresh_order] for k in key_names}
+        if self.key_shifts is not None:
+            # quantized table: fresh keys must be quantized with the SAME
+            # shifts or the merged column would not be sorted
+            fb = ks.fast_build(fresh_cols, force_shifts=self.key_shifts)
+            if fb is None or fb[2] != self.key_shifts:
+                return self.rebuild(columns, dicts)
+            fresh_order, fresh_sorted, _ = fb
+            fresh_order = fresh_order.astype(np.int64, copy=False)
+        else:
+            fresh_order = np.asarray(ks.sort_order(fresh_cols), np.int64)
+            fresh_sorted = {k: fresh_cols[k][fresh_order] for k in key_names}
         p = ks.insert_positions(self.key_columns, fresh_sorted)
         if p is None:
             return self.rebuild(columns, dicts)
         old_n = self.n
         master_base = old_n  # master rows are [old | fresh]
-        final = np.empty(old_n + n_fresh, np.int64)
+        total = old_n + n_fresh
+        final = np.empty(total, np.int32 if total < 2**31 else np.int64)
         at = p + np.arange(n_fresh)
-        is_fresh = np.zeros(old_n + n_fresh, bool)
+        is_fresh = np.zeros(total, bool)
         is_fresh[at] = True
         final[is_fresh] = master_base + fresh_order
         final[~is_fresh] = self.order
         self.order = final
         self._master = columns
-        self.key_columns = {
-            k: np.insert(self.key_columns[k], p, fresh_sorted[k])
-            for k in key_names
-        }
-        self.n = old_n + n_fresh
+        # masked scatter-merge of the sorted key columns (np.insert's
+        # generality made it the per-flush hotspot)
+        merged_keys = {}
+        for k in key_names:
+            old = self.key_columns[k]
+            m = np.empty(total, old.dtype)
+            m[at] = fresh_sorted[k].astype(old.dtype, copy=False)
+            m[~is_fresh] = old
+            merged_keys[k] = m
+        self.key_columns = merged_keys
+        self.n = total
         self.shard_bounds = np.linspace(0, self.n, self.n_shards + 1).astype(np.int64)
         self._device_cache.clear()
 
@@ -252,6 +282,8 @@ class IndexTable:
             n = sl.stop - sl.start
             # window resolution only ever touches the sort-key columns
             shard_cols = {k: v[sl] for k, v in self.key_columns.items()}
+            if self.key_shifts is not None:
+                shard_cols["__shifts__"] = self.key_shifts
             if self._rank_vocab is not None:
                 vocab = self._rank_vocab
 

@@ -34,6 +34,8 @@ _u64p = np.ctypeslib.ndpointer(np.uint64, flags="C_CONTIGUOUS")
 _i64p = np.ctypeslib.ndpointer(np.int64, flags="C_CONTIGUOUS")
 _i32p = np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS")
 _u16p = np.ctypeslib.ndpointer(np.uint16, flags="C_CONTIGUOUS")
+_u8p = np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+_f64p = np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS")
 
 
 def _build() -> bool:
@@ -43,20 +45,18 @@ def _build() -> bool:
     # build to a temp name and rename: concurrent first-callers (sidecar +
     # CLI, pytest workers) must never dlopen a half-written .so
     tmp = f"{_SO_PATH}.{os.getpid()}.tmp"
-    try:
-        subprocess.run(
-            ["g++", "-O3", "-fPIC", "-std=c++17", "-shared",
-             "-o", tmp, _SRC_PATH],
-            check=True, capture_output=True, timeout=120,
-        )
-        os.replace(tmp, _SO_PATH)
-        return True
-    except Exception:
+    base = ["g++", "-O3", "-fPIC", "-std=c++17", "-shared", "-o", tmp, _SRC_PATH]
+    for cmd in (base[:1] + ["-fopenmp"] + base[1:], base):  # openmp optional
         try:
-            os.unlink(tmp)
-        except OSError:
-            pass
-        return False
+            subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+            os.replace(tmp, _SO_PATH)
+            return True
+        except Exception:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+    return False
 
 
 def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
@@ -75,6 +75,23 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _i32p, _u64p, c64, _i32p, c64, cu64, cu64, _i64p, _i64p,
     ]
     lib.gm_bin_windows.restype = c64
+    lib.gm_z2_encode.argtypes = [_f64p, _f64p, c64, _u64p]
+    lib.gm_z3_encode.argtypes = [_f64p, _f64p, _i64p, ctypes.c_double, c64, _u64p]
+    lib.gm_fid_hash64.argtypes = [_u8p, c64, c64, _u64p]
+    lib.gm_time_split.argtypes = [
+        _i64p, c64, c64, c32,
+        _i32p, ctypes.c_void_p, ctypes.c_void_p,
+    ]
+    lib.gm_pack_idx.argtypes = [
+        _u64p, c64, c32, c32, c32, ctypes.c_void_p,
+        ctypes.c_void_p, c32, c64, _u64p,
+    ]
+    lib.gm_unpack_idx.argtypes = [
+        _u64p, c64, c32, c32, c32, c32, c64,
+        ctypes.c_void_p, ctypes.c_void_p, _u64p, ctypes.c_void_p,
+    ]
+    lib.gm_sort_u64.argtypes = [_u64p, c64]
+    lib.gm_num_threads.restype = c32
     return lib
 
 
@@ -96,10 +113,14 @@ def lib() -> "Optional[ctypes.CDLL]":
             if not _build():
                 return None
         try:
-            candidate = _bind(ctypes.CDLL(_SO_PATH))
-            if candidate.gm_abi_version() == 1:
-                _lib = candidate
-        except OSError:
+            candidate = ctypes.CDLL(_SO_PATH)
+            if candidate.gm_abi_version() != 2:
+                # stale .so from an older source tree: rebuild once
+                if _build():
+                    candidate = ctypes.CDLL(_SO_PATH)
+            if candidate.gm_abi_version() == 2:
+                _lib = _bind(candidate)
+        except (OSError, AttributeError):
             _lib = None
     return _lib
 
@@ -266,3 +287,65 @@ def bin_windows(
         np.uint64(zlo), np.uint64(zhi), starts, ends,
     )
     return starts[:m], ends[:m]
+
+
+def z2_encode(x: np.ndarray, y: np.ndarray) -> Optional[np.ndarray]:
+    """Fused normalize+interleave z2 encode; None -> numpy fallback path."""
+    L = lib()
+    if L is None:
+        return None
+    x = np.ascontiguousarray(x, np.float64)
+    y = np.ascontiguousarray(y, np.float64)
+    out = np.empty(len(x), np.uint64)
+    L.gm_z2_encode(x, y, len(x), out)
+    return out
+
+
+def z3_encode(
+    x: np.ndarray, y: np.ndarray, off_ms: np.ndarray, off_max: float
+) -> Optional[np.ndarray]:
+    """Fused normalize+interleave z3 encode; None -> numpy fallback path."""
+    L = lib()
+    if L is None:
+        return None
+    x = np.ascontiguousarray(x, np.float64)
+    y = np.ascontiguousarray(y, np.float64)
+    off_ms = np.ascontiguousarray(off_ms, np.int64)
+    out = np.empty(len(x), np.uint64)
+    L.gm_z3_encode(x, y, off_ms, float(off_max), len(x), out)
+    return out
+
+
+def fid_hash64(a: np.ndarray) -> Optional[np.ndarray]:
+    """Single-pass feature-id hash over a U/S string column; None ->
+    numpy fallback (packsort.fid_hash64 python path, bit-identical)."""
+    L = lib()
+    if L is None:
+        return None
+    a = np.ascontiguousarray(a)
+    u8 = a.view(np.uint8)
+    out = np.empty(len(a), np.uint64)
+    L.gm_fid_hash64(u8, len(a), a.dtype.itemsize, out)
+    return out
+
+
+def time_split(
+    t: np.ndarray, period_ms: int, scale: int,
+    want_off_ms: bool = True, want_scaled: bool = False,
+):
+    """epoch_ms -> (bin i32, off_ms i64 | None, off_scaled i32 | None) in one
+    native pass; None -> numpy fallback path."""
+    L = lib()
+    if L is None:
+        return None
+    t = np.ascontiguousarray(t, np.int64)
+    n = len(t)
+    b = np.empty(n, np.int32)
+    off = np.empty(n, np.int64) if want_off_ms else None
+    sc = np.empty(n, np.int32) if want_scaled else None
+    L.gm_time_split(
+        t, n, int(period_ms), int(scale), b,
+        off.ctypes.data if off is not None else None,
+        sc.ctypes.data if sc is not None else None,
+    )
+    return b, off, sc

@@ -18,7 +18,6 @@ Device uploads additionally carry normalized/fixed-point views and curve keys
 
 from __future__ import annotations
 
-import uuid
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
@@ -93,6 +92,8 @@ class ColumnBatch:
     def concat(batches: List["ColumnBatch"]) -> "ColumnBatch":
         if not batches:
             return ColumnBatch({}, 0)
+        if len(batches) == 1:  # bulk loads: no copy
+            return batches[0]
         keys = batches[0].columns.keys()
         return ColumnBatch(
             {k: np.concatenate([b.columns[k] for b in batches]) for k in keys},
@@ -103,6 +104,8 @@ class ColumnBatch:
 def _to_epoch_ms(vals) -> np.ndarray:
     a = np.asarray(vals)
     if a.dtype.kind == "M":  # datetime64
+        if a.dtype == np.dtype("datetime64[ms]"):
+            return a.view(np.int64)  # same representation, no copy
         return a.astype("datetime64[ms]").astype(np.int64)
     if a.dtype.kind in "iuf":
         return a.astype(np.int64)
@@ -209,10 +212,32 @@ def encode_batch(
 
     if n is None:
         raise ValueError("empty batch")
-    if fids is None:
-        fids = [uuid.uuid4().hex for _ in range(n)]
-    cols["__fid__"] = np.array(list(fids), dtype=object)
+    cols["__fid__"] = encode_fids(fids, n)
     return ColumnBatch(cols, n)
+
+
+def encode_fids(fids, n: int) -> np.ndarray:
+    """Feature ids as a fixed-width unicode numpy column.
+
+    Object arrays of 10^8+ python strings dominate both ingest time and
+    host memory at bulk-load scale; a 'U' column is one contiguous buffer.
+    Auto-generated ids are random 128-bit hex (Z3FeatureIdGenerator-style
+    UUIDs), produced in one urandom+hex pass instead of n uuid4() calls."""
+    if fids is None:
+        import os as _os
+
+        hexs = _os.urandom(16 * n).hex()
+        return np.frombuffer(hexs.encode("ascii"), dtype="S32").astype("U32")
+    a = np.asarray(fids)
+    if a.dtype.kind == "U":
+        pass
+    elif a.dtype.kind == "S":
+        a = a.astype("U")
+    else:  # object / numeric: stringify (vectorized in C)
+        a = a.astype("U")
+    if len(a) != n:
+        raise ValueError(f"{len(a)} fids for {n} rows")
+    return a
 
 
 def decode_batch(

@@ -14,9 +14,22 @@
 
 #include <cstdint>
 #include <cstring>
+#include <cmath>
 #include <algorithm>
 #include <deque>
 #include <vector>
+#if defined(_OPENMP)
+#include <omp.h>
+#include <parallel/algorithm>
+#endif
+
+// Parallelize a loop body over [0, n) when OpenMP is available and the
+// problem is large enough to amortize thread startup.
+#define GM_PAR_FOR(n) _Pragma("omp parallel for if ((n) > 1000000)")
+#if !defined(_OPENMP)
+#undef GM_PAR_FOR
+#define GM_PAR_FOR(n)
+#endif
 
 namespace {
 
@@ -70,6 +83,7 @@ extern "C" {
 
 void gm_interleave2(const uint64_t* x, const uint64_t* y, uint64_t* out,
                     int64_t n) {
+  GM_PAR_FOR(n)
   for (int64_t i = 0; i < n; ++i)
     out[i] = (split2(x[i]) << 1) | split2(y[i]);
 }
@@ -83,6 +97,7 @@ void gm_deinterleave2(const uint64_t* z, uint64_t* x, uint64_t* y, int64_t n) {
 
 void gm_interleave3(const uint64_t* x, const uint64_t* y, const uint64_t* t,
                     uint64_t* out, int64_t n) {
+  GM_PAR_FOR(n)
   for (int64_t i = 0; i < n; ++i)
     out[i] = (split3(x[i]) << 2) | (split3(y[i]) << 1) | split3(t[i]);
 }
@@ -260,6 +275,193 @@ int64_t gm_bin_windows(const int32_t* bins_col, const uint64_t* z_col,
   return m;
 }
 
-int32_t gm_abi_version() { return 1; }
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Fused Z-curve encode (normalize + interleave in one pass; bit-exact mirror
+// of zorder.py NormalizedDimension.normalize + interleave2/3)
+// ---------------------------------------------------------------------------
+
+namespace {
+
+inline uint64_t norm_dim(double v, double lo, double hi, int bits) {
+  const double scaled = (v - lo) / (hi - lo) * (double)(1ull << bits);
+  double f = std::floor(scaled);
+  const double maxi = (double)((1ull << bits) - 1);
+  if (!(f > 0.0)) f = 0.0;  // NaN and negatives clamp to 0 (np.clip parity)
+  if (f > maxi) f = maxi;
+  return (uint64_t)f;
+}
+
+const uint64_t kHashPrimes[8] = {
+    0x9E3779B97F4A7C15ull, 0xC2B2AE3D27D4EB4Full, 0x165667B19E3779F9ull,
+    0x27D4EB2F165667C5ull, 0x85EBCA77C2B2AE63ull, 0xFF51AFD7ED558CCDull,
+    0xC4CEB9FE1A85EC53ull, 0x2545F4914F6CDD1Dull};
+
+template <int64_t P>
+void time_split_fixed(const int64_t* t, int64_t n, int32_t scale, int32_t* bin,
+                      int64_t* off_ms, int32_t* off_scaled) {
+  // scale==1 branch keeps the inner loop free of a runtime-divisor division
+  if (off_scaled && scale == 1) {
+    GM_PAR_FOR(n)
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t b = t[i] / P;
+      if (t[i] % P < 0) --b;
+      const int64_t off = t[i] - b * P;
+      bin[i] = (int32_t)b;
+      if (off_ms) off_ms[i] = off;
+      off_scaled[i] = (int32_t)off;
+    }
+    return;
+  }
+  GM_PAR_FOR(n)
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t b = t[i] / P;
+    if (t[i] % P < 0) --b;  // floor division
+    const int64_t off = t[i] - b * P;
+    bin[i] = (int32_t)b;
+    if (off_ms) off_ms[i] = off;
+    if (off_scaled) off_scaled[i] = (int32_t)(off / scale);
+  }
+}
+
+}  // namespace
+
+extern "C" {
+
+void gm_z2_encode(const double* x, const double* y, int64_t n, uint64_t* out) {
+  GM_PAR_FOR(n)
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t xi = norm_dim(x[i], -180.0, 180.0, 31);
+    const uint64_t yi = norm_dim(y[i], -90.0, 90.0, 31);
+    out[i] = (split2(xi) << 1) | split2(yi);
+  }
+}
+
+void gm_z3_encode(const double* x, const double* y, const int64_t* off_ms,
+                  double off_max, int64_t n, uint64_t* out) {
+  GM_PAR_FOR(n)
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t xi = norm_dim(x[i], -180.0, 180.0, 21);
+    const uint64_t yi = norm_dim(y[i], -90.0, 90.0, 21);
+    const uint64_t ti = norm_dim((double)off_ms[i], 0.0, off_max, 21);
+    out[i] = (split3(xi) << 2) | (split3(yi) << 1) | split3(ti);
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Feature-id hash (bit-exact mirror of packsort.fid_hash64: NUL-padded
+// 8-byte little-endian chunks, XOR of chunk*prime, murmur-style avalanche)
+// ---------------------------------------------------------------------------
+
+void gm_fid_hash64(const uint8_t* data, int64_t n, int64_t itemsize,
+                   uint64_t* out) {
+  const int64_t k = (itemsize + 7) / 8;
+  GM_PAR_FOR(n)
+  for (int64_t i = 0; i < n; ++i) {
+    const uint8_t* row = data + i * itemsize;
+    uint64_t h = 0;
+    for (int64_t j = 0; j < k; ++j) {
+      uint64_t chunk = 0;
+      const int64_t off = j * 8;
+      const int64_t len = std::min<int64_t>(8, itemsize - off);
+      std::memcpy(&chunk, row + off, (size_t)len);  // little-endian hosts
+      h ^= chunk * kHashPrimes[j & 7];
+    }
+    h ^= h >> 33;
+    h *= 0xFF51AFD7ED558CCDull;
+    h ^= h >> 29;
+    out[i] = h;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Time split: epoch_ms -> (bin, offset_ms, offset_ms/scale) in one pass for
+// the fixed-width periods (binned_time.to_bin_and_offset / to_scaled).
+// Constant divisors per branch keep the integer division fast.
+// ---------------------------------------------------------------------------
+
+void gm_time_split(const int64_t* t, int64_t n, int64_t period_ms,
+                   int32_t scale, int32_t* bin, int64_t* off_ms,
+                   int32_t* off_scaled) {
+  const int64_t kDay = 86400000ll;
+  if (period_ms == kDay)
+    time_split_fixed<86400000ll>(t, n, scale, bin, off_ms, off_scaled);
+  else if (period_ms == 7 * kDay)
+    time_split_fixed<604800000ll>(t, n, scale, bin, off_ms, off_scaled);
+  else
+    GM_PAR_FOR(n)
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t b = t[i] / period_ms;
+      if (t[i] % period_ms < 0) --b;
+      const int64_t off = t[i] - b * period_ms;
+      bin[i] = (int32_t)b;
+      if (off_ms) off_ms[i] = off;
+      if (off_scaled) off_scaled[i] = (int32_t)(off / scale);
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Fused pack/unpack for the radix pack-sort (packsort.pack_sort): one pass
+// to assemble [prefix | key_q | tiebreak | idx] u64 rows, and one pass to
+// split the sorted array back into (perm, key_q, prefix). The sort itself
+// stays numpy's vectorized introsort.
+// ---------------------------------------------------------------------------
+
+void gm_pack_idx(const uint64_t* key, int64_t n, int32_t key_shift,
+                 int32_t idx_bits, int32_t tb_bits, const uint64_t* tiebreak,
+                 const int32_t* prefix, int32_t prefix_bits, int64_t pmin,
+                 uint64_t* out) {
+  GM_PAR_FOR(n)
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t v = (key[i] >> key_shift) << (idx_bits + tb_bits);
+    if (tiebreak) v |= (tiebreak[i] >> (64 - tb_bits)) << idx_bits;
+    if (prefix) v |= (uint64_t)((int64_t)prefix[i] - pmin) << (64 - prefix_bits);
+    out[i] = v | (uint64_t)i;
+  }
+}
+
+void gm_unpack_idx(const uint64_t* packed, int64_t n, int32_t kq_bits,
+                   int32_t idx_bits, int32_t tb_bits, int32_t prefix_bits,
+                   int64_t pmin, int32_t* perm32, int64_t* perm64,
+                   uint64_t* key_out, int32_t* prefix_out) {
+  const uint64_t idx_mask = ((uint64_t)1 << idx_bits) - 1;
+  const uint64_t key_mask =
+      kq_bits >= 64 ? ~0ull : (((uint64_t)1 << kq_bits) - 1);
+  GM_PAR_FOR(n)
+  for (int64_t i = 0; i < n; ++i) {
+    const uint64_t v = packed[i];
+    if (perm32)
+      perm32[i] = (int32_t)(v & idx_mask);
+    else
+      perm64[i] = (int64_t)(v & idx_mask);
+    key_out[i] = (v >> (idx_bits + tb_bits)) & key_mask;
+    if (prefix_out)
+      prefix_out[i] = (int32_t)((int64_t)(v >> (64 - prefix_bits)) + pmin);
+  }
+}
+
+// Sort a u64 array in place — parallel when OpenMP is enabled and worth it.
+// (Single-threaded callers should prefer numpy's AVX-vectorized introsort,
+// which beats scalar std::sort; see packsort.pack_sort's dispatch.)
+void gm_sort_u64(uint64_t* a, int64_t n) {
+#if defined(_OPENMP)
+  if (n > 2000000 && omp_get_max_threads() > 1) {
+    __gnu_parallel::sort(a, a + n);
+    return;
+  }
+#endif
+  std::sort(a, a + n);
+}
+
+int32_t gm_num_threads() {
+#if defined(_OPENMP)
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+int32_t gm_abi_version() { return 2; }
 
 }  // extern "C"
