@@ -77,6 +77,17 @@ class FeatureCollection:
     def columns(self):
         return self.batch.columns
 
+    @property
+    def fids(self):
+        """Feature ids as ``str`` (the raw ``columns['__fid__']`` is a
+        fixed-width bytes column at bulk scale)."""
+        from geomesa_tpu.schema.columns import fid_strs
+
+        col = self.batch.columns.get("__fid__")
+        if col is None:
+            return []
+        return fid_strs(col).tolist()
+
     def to_dict(self) -> Dict[str, Any]:
         if self.batch.n == 0:
             return {}

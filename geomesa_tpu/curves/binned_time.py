@@ -108,6 +108,22 @@ class BinnedTime:
             off = (dt - years).astype("timedelta64[ms]").astype(np.int64)
         return b.astype(np.int32), off.astype(np.int64)
 
+    def offset_from_bin(self, epoch_ms: np.ndarray, bins: np.ndarray) -> np.ndarray:
+        """offset_ms given ALREADY-computed bins — one multiply/subtract pass
+        instead of re-dividing (the ingest path computes bins once in
+        encode_batch and reuses them for every key space)."""
+        t = np.asarray(epoch_ms, dtype=np.int64)
+        if self.period in (TimePeriod.DAY, TimePeriod.WEEK):
+            from geomesa_tpu import native
+
+            P = DAY_MS if self.period == TimePeriod.DAY else WEEK_MS
+            out = native.off_from_bin(t, bins, P)
+            if out is not None:
+                return out
+            # widen during the multiply (skips a separate astype copy)
+            return t - np.multiply(bins, P, dtype=np.int64)
+        return t - self.bin_start_ms(bins)
+
     def bin_start_ms(self, b: np.ndarray) -> np.ndarray:
         """bin -> epoch ms of the bin's start. Vectorized."""
         b = np.asarray(b)

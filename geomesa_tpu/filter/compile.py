@@ -752,12 +752,24 @@ def compile_filter(
 
         if isinstance(node, ir.IdIn):
             need("__fid__")
-            ids = set(node.ids)
+            ids = [str(i) for i in node.ids]
 
             def fid_mask(cols, xp):
-                fids = cols["__fid__"]
-                # host-only column (object dtype)
-                return np.array([f in ids for f in fids], dtype=bool)
+                fids = np.asarray(cols["__fid__"])
+                # host-only column; match in the column's own layout ('S'
+                # bytes normally, 'U'/object fallback) — vectorized isin
+                if fids.dtype.kind == "S":
+                    # natural-width 'S' array: isin compares values, so a
+                    # query id longer than the column width just never hits
+                    q = np.asarray(
+                        [i.encode("utf-8", "surrogateescape") for i in ids]
+                    )
+                elif fids.dtype.kind == "U":
+                    q = np.asarray(ids)
+                else:
+                    idset = set(ids)
+                    return np.array([f in idset for f in fids], dtype=bool)
+                return np.isin(fids, q)
 
             return fid_mask
 

@@ -214,8 +214,18 @@ def fid_hash64(fids: np.ndarray) -> np.ndarray:
     if a.dtype.kind == "O":
         a = a.astype(str)
     if a.dtype.kind == "U":
-        w = a.dtype.itemsize  # UCS4 codepoints, little-endian
-    elif a.dtype.kind == "S":
+        # canonical hash layout is ALWAYS the UTF-8 byte ('S') form: the
+        # store keeps fid columns as 'S' when ASCII (columns.encode_fids),
+        # and a query-time hash of the same fid must land in the same
+        # bucket whatever array layout it arrived in — including mixed
+        # ASCII/non-ASCII batches, where content-dependent layouts would
+        # make the same ASCII fid hash two different ways
+        from geomesa_tpu.schema.columns import _u_to_s
+
+        a = _u_to_s(a)
+        if a.dtype.kind == "U":  # non-ASCII present: per-element UTF-8
+            a = np.char.encode(a, "utf-8")
+    if a.dtype.kind == "S":
         w = a.dtype.itemsize
     else:
         raise TypeError(f"fid hash needs a string column, got {a.dtype}")

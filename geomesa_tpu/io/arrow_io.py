@@ -83,6 +83,10 @@ def batch_to_arrow(
     fids = batch.columns.get(FID)
     if fids is None:
         fids = np.array([str(i) for i in range(batch.n)], dtype=object)
+    else:
+        from geomesa_tpu.schema.columns import fid_strs
+
+        fids = fid_strs(fids)
     arrays[0] = pa.array([str(f) for f in fids], pa.utf8())
     for name in names:
         a = ft.attr(name)

@@ -90,7 +90,9 @@ def pack_batch(ft, batch, dicts, track: Optional[str] = None,
         raise ValueError("BIN export requires geometry and date attributes")
     cols = batch.columns
     if track is None or track == "id":
-        tids = _hash_values(cols["__fid__"])
+        from geomesa_tpu.schema.columns import fid_strs
+
+        tids = _hash_values(fid_strs(cols["__fid__"]))
     else:
         a = ft.attr(track)
         col = cols[track]

@@ -71,6 +71,10 @@ def to_geojson(ft: FeatureType, batch: ColumnBatch,
         else:
             decoded[a.name] = col.tolist()
     fids = batch.columns.get("__fid__")
+    if fids is not None:
+        from geomesa_tpu.schema.columns import fid_strs
+
+        fids = fid_strs(fids)
     for i in range(batch.n):
         props = {k: v[i] for k, v in decoded.items()}
         features.append({

@@ -90,6 +90,7 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         _u64p, c64, c32, c32, c32, c32, c64,
         ctypes.c_void_p, ctypes.c_void_p, _u64p, ctypes.c_void_p,
     ]
+    lib.gm_off_from_bin.argtypes = [_i64p, _i32p, c64, c64, _i64p]
     lib.gm_sort_u64.argtypes = [_u64p, c64]
     lib.gm_num_threads.restype = c32
     return lib
@@ -114,11 +115,11 @@ def lib() -> "Optional[ctypes.CDLL]":
                 return None
         try:
             candidate = ctypes.CDLL(_SO_PATH)
-            if candidate.gm_abi_version() != 2:
+            if candidate.gm_abi_version() != 3:
                 # stale .so from an older source tree: rebuild once
                 if _build():
                     candidate = ctypes.CDLL(_SO_PATH)
-            if candidate.gm_abi_version() == 2:
+            if candidate.gm_abi_version() == 3:
                 _lib = _bind(candidate)
         except (OSError, AttributeError):
             _lib = None
@@ -326,6 +327,18 @@ def fid_hash64(a: np.ndarray) -> Optional[np.ndarray]:
     u8 = a.view(np.uint8)
     out = np.empty(len(a), np.uint64)
     L.gm_fid_hash64(u8, len(a), a.dtype.itemsize, out)
+    return out
+
+
+def off_from_bin(t: np.ndarray, bins: np.ndarray, period_ms: int):
+    """offset_ms = t - bin*period fused; None -> numpy fallback path."""
+    L = lib()
+    if L is None:
+        return None
+    t = np.ascontiguousarray(t, np.int64)
+    bins = np.ascontiguousarray(bins, np.int32)
+    out = np.empty(len(t), np.int64)
+    L.gm_off_from_bin(t, bins, int(period_ms), len(t), out)
     return out
 
 

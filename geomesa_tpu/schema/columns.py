@@ -10,7 +10,8 @@ are struct-of-arrays. Encoded column names:
 * point geometry ``g``       -> columns ``g__x``, ``g__y`` (float64)
 * non-point geometry ``g``   -> ``g__xmin/__ymin/__xmax/__ymax`` (float64 bbox)
                                 plus host-side object column ``g__wkt``
-* feature id                 -> host-side object column ``__fid__``
+* feature id                 -> host-side fixed-width bytes column ``__fid__``
+                                ('S'; 'U' fallback for non-ASCII ids)
 
 Device uploads additionally carry normalized/fixed-point views and curve keys
 (computed by the index layer, see geomesa_tpu/index/).
@@ -217,27 +218,57 @@ def encode_batch(
 
 
 def encode_fids(fids, n: int) -> np.ndarray:
-    """Feature ids as a fixed-width unicode numpy column.
+    """Feature ids as a fixed-width BYTES ('S') numpy column.
 
     Object arrays of 10^8+ python strings dominate both ingest time and
-    host memory at bulk-load scale; a 'U' column is one contiguous buffer.
+    host memory at bulk-load scale; 'S' is one contiguous buffer at 1
+    byte/char (vs 4 for 'U' — 128 bytes/row of fids at U32 was the #2 item
+    in the round-2 1B-point memory audit). Non-ASCII ids fall back to 'U'.
     Auto-generated ids are random 128-bit hex (Z3FeatureIdGenerator-style
     UUIDs), produced in one urandom+hex pass instead of n uuid4() calls."""
     if fids is None:
         import os as _os
 
         hexs = _os.urandom(16 * n).hex()
-        return np.frombuffer(hexs.encode("ascii"), dtype="S32").astype("U32")
+        return np.frombuffer(hexs.encode("ascii"), dtype="S32")
     a = np.asarray(fids)
-    if a.dtype.kind == "U":
-        pass
-    elif a.dtype.kind == "S":
-        a = a.astype("U")
-    else:  # object / numeric: stringify (vectorized in C)
-        a = a.astype("U")
     if len(a) != n:
         raise ValueError(f"{len(a)} fids for {n} rows")
-    return a
+    if a.dtype.kind == "S":
+        return a
+    if a.dtype.kind != "U":  # object / numeric: stringify (vectorized in C)
+        a = a.astype("U")
+    return _u_to_s(a)
+
+
+def _u_to_s(a: np.ndarray) -> np.ndarray:
+    """Fast 'U' -> 'S' for ASCII content: numpy's own U->S cast encodes
+    per element (~6s for 20M ids); viewing the UCS4 codepoints and
+    narrowing to uint8 is a pure SIMD pass."""
+    w = a.dtype.itemsize // 4
+    if w == 0:
+        return a.astype("S1")
+    cp = np.ascontiguousarray(a).view(np.uint32).reshape(len(a), w)
+    if not (cp < 128).all():
+        return a  # rare non-ASCII ids keep the unicode layout
+    return cp.astype(np.uint8).view(f"S{w}").reshape(len(a))
+
+
+def fid_strs(col: np.ndarray) -> np.ndarray:
+    """Fid column -> unicode ('U') view for exports/dedupe/user output.
+    Iterating / ``tolist()`` on the result yields ``str``, never bytes.
+    Mirror-image SIMD widening of :func:`_u_to_s` — numpy's own S->U cast
+    encodes per element, which dominates bulk export paths."""
+    a = np.asarray(col)
+    if a.dtype.kind != "S":
+        return a if a.dtype.kind == "U" else a.astype("U")
+    w = a.dtype.itemsize
+    if w == 0:
+        return a.astype("U1")
+    by = np.ascontiguousarray(a).view(np.uint8).reshape(len(a), w)
+    if not (by < 128).all():  # externally-supplied UTF-8 bytes: decode right
+        return np.array([s.decode("utf-8", "replace") for s in a.tolist()])
+    return by.astype(np.uint32).view(f"U{w}").reshape(len(a))
 
 
 def decode_batch(
@@ -246,7 +277,7 @@ def decode_batch(
     """Columns -> user-facing values (strings decoded, dates as datetime64).
 
     Attributes projected out of the batch (Query.properties) are skipped."""
-    out: Dict[str, Any] = {"__fid__": batch.columns["__fid__"].tolist()}
+    out: Dict[str, Any] = {"__fid__": fid_strs(batch.columns["__fid__"]).tolist()}
     for a in ft.attributes:
         if not a.is_geom and a.name not in batch.columns:
             continue

@@ -537,12 +537,40 @@ class Z3HistogramStat(Stat):
             z = self.sfc.index(xs, ys, off)
         if z.size == 0:
             return
-        bucket = (z >> np.uint64(self.shift)).astype(np.int64)
-        for bb in np.unique(b).tolist():
-            sel = b == bb
+        bucket = (z >> np.uint64(self.shift)).astype(np.int32)
+        # one composite bincount over (bin, bucket) — per-bin masked
+        # bincounts re-scan the whole batch once per distinct bin. Bin ids
+        # are dense small ints, so min/max beats a full np.unique sort.
+        bmin, bmax = int(b.min()), int(b.max())
+        if bmin == bmax:
+            if bmin not in self.bins:
+                self.bins[bmin] = np.zeros(self.length, dtype=np.int64)
+            self.bins[bmin] += np.bincount(bucket, minlength=self.length)
+            return
+        span = bmax - bmin + 1
+        # dense layout allocates span*length counters: bound the PRODUCT
+        # (a DSL-requested big length with a wide bin span would otherwise
+        # demand GBs where the sparse loop needs length*distinct_bins)
+        if span * self.length > (1 << 22):
+            for bb in np.unique(b).tolist():
+                sel = np.asarray(b) == bb
+                if bb not in self.bins:
+                    self.bins[bb] = np.zeros(self.length, dtype=np.int64)
+                self.bins[bb] += np.bincount(bucket[sel], minlength=self.length)
+            return
+        # int64 rel: span*length stays < 2^22 but the MULTIPLY inputs are
+        # per-row values — int64 keeps the composite index overflow-free
+        rel = (np.asarray(b, np.int64) - bmin) * np.int64(self.length) + bucket
+        counts = np.bincount(rel, minlength=span * self.length).reshape(
+            span, self.length
+        )
+        nonzero = counts.any(axis=1)
+        for i in np.nonzero(nonzero)[0].tolist():
+            bb = bmin + i
             if bb not in self.bins:
-                self.bins[bb] = np.zeros(self.length, dtype=np.int64)
-            self.bins[bb] += np.bincount(bucket[sel], minlength=self.length).astype(np.int64)
+                self.bins[bb] = counts[i].astype(np.int64)
+            else:
+                self.bins[bb] += counts[i]
 
     def merge(self, other: "Z3HistogramStat"):
         for k, v in other.bins.items():
@@ -620,8 +648,8 @@ class Z2HistogramStat(Stat):
             z = self.sfc.index(xs, ys)
         if z.size == 0:
             return
-        bucket = (z >> np.uint64(self.shift)).astype(np.int64)
-        self.counts += np.bincount(bucket, minlength=self.length).astype(np.int64)
+        bucket = (z >> np.uint64(self.shift)).astype(np.int32)
+        self.counts += np.bincount(bucket, minlength=self.length)
 
     def merge(self, other: "Z2HistogramStat"):
         self.counts += other.counts

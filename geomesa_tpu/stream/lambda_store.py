@@ -132,9 +132,14 @@ class LambdaDataset:
             return cold
         if cold.n == 0:
             return hot
-        hot_fids = set(hot.columns["__fid__"].tolist())
+        # normalize both tiers to str: the fid column layout ('S' vs 'U')
+        # is content-dependent, and a bytes set never matches str elements
+        from geomesa_tpu.schema.columns import fid_strs
+
+        hot_fids = set(fid_strs(hot.columns["__fid__"]).tolist())
         keep = np.array(
-            [f not in hot_fids for f in cold.columns["__fid__"]], dtype=bool
+            [f not in hot_fids for f in fid_strs(cold.columns["__fid__"])],
+            dtype=bool,
         )
         cold = cold.select(keep)
         # align to the shared column set (key columns may differ per tier)

@@ -441,6 +441,15 @@ void gm_unpack_idx(const uint64_t* packed, int64_t n, int32_t kq_bits,
   }
 }
 
+// offset_ms = t - bin*period in one fused pass (ingest reuses the bin
+// column encode_batch computed; a numpy multiply+subtract is two temps).
+void gm_off_from_bin(const int64_t* t, const int32_t* bin, int64_t period_ms,
+                     int64_t n, int64_t* out) {
+  GM_PAR_FOR(n)
+  for (int64_t i = 0; i < n; ++i)
+    out[i] = t[i] - (int64_t)bin[i] * period_ms;
+}
+
 // Sort a u64 array in place — parallel when OpenMP is enabled and worth it.
 // (Single-threaded callers should prefer numpy's AVX-vectorized introsort,
 // which beats scalar std::sort; see packsort.pack_sort's dispatch.)
@@ -462,6 +471,6 @@ int32_t gm_num_threads() {
 #endif
 }
 
-int32_t gm_abi_version() { return 2; }
+int32_t gm_abi_version() { return 3; }
 
 }  // extern "C"

@@ -166,12 +166,12 @@ def test_attribute_range_query(ds_and_data):
 def test_id_index(ds_and_data):
     ds, data = ds_and_data
     fc = ds.query("gdelt", Query(ecql="INCLUDE", max_features=3))
-    fids = fc.columns["__fid__"][:2].tolist()
+    fids = fc.fids[:2]
     q = "IN (" + ", ".join(f"'{f}'" for f in fids) + ")"
     exp = ds.explain("gdelt", q)
     assert "Chosen index: id" in exp
     fc2 = ds.query("gdelt", q)
-    assert sorted(fc2.columns["__fid__"].tolist()) == sorted(fids)
+    assert sorted(fc2.fids) == sorted(fids)
 
 
 def test_sampling_and_limit(ds_and_data):

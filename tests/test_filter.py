@@ -115,7 +115,9 @@ def test_include_exclude_idin(setup):
     ft, dicts, batch, data = setup
     assert run("INCLUDE", setup).all()
     assert not run("EXCLUDE", setup).any()
-    fid = batch["__fid__"][5]
+    from geomesa_tpu.schema.columns import fid_strs
+
+    fid = fid_strs(batch["__fid__"])[5]
     f = parse_ecql(f"IN ('{fid}')")
     assert isinstance(f, ir.IdIn)
     cf = compile_filter(f, ft, dicts)

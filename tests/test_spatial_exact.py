@@ -82,7 +82,7 @@ def _oracle_fids(geoms, op, lit):
 
 def _query_fids(ds, ecql):
     fc = ds.query("t", ecql)
-    return set(fc.columns["__fid__"]) if len(fc) else set()
+    return set(fc.fids) if len(fc) else set()
 
 
 @pytest.fixture(scope="module")

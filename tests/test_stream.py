@@ -127,7 +127,9 @@ def test_null_geometry_tolerated():
     assert ds.count("t") == 1
     assert ds.count("t", "speed > 0") == 1
     batch = ds.query("t")
-    assert batch.columns["__fid__"].tolist() == ["f1"]
+    from geomesa_tpu.schema.columns import fid_strs
+
+    assert fid_strs(batch.columns["__fid__"]).tolist() == ["f1"]
 
 
 def test_event_time_expiry():
