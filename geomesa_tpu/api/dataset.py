@@ -346,11 +346,29 @@ class GeoDataset:
              "max_features": q.max_features, "sampling": q.sampling},
             plan.__dict__.get("plan_time_ms", 0.0),
             (time.perf_counter() - t_scan0) * 1e3, hits,
+            scanned=plan.__dict__.get("scanned_rows", 0),
+            table_rows=plan.__dict__.get("table_rows", 0),
         )
 
-    def explain(self, name: str, query: "str | Query") -> str:
+    def explain(self, name: str, query: "str | Query",
+                analyze: bool = False) -> str:
+        """Planner explain tree. ``analyze=True`` additionally resolves the
+        scan windows and runs a count so the output reports selectivity —
+        candidate (scanned) rows vs matched rows — the over-scan signal."""
         exp = Explainer(enabled=True)
-        self._plan(name, query, exp)
+        st, _, plan = self._plan(name, query, exp)
+        if analyze:
+            ex = self._executor(st)
+            matched = ex.count(plan)
+            scanned = plan.__dict__.get("scanned_rows", 0)
+            total = plan.__dict__.get("table_rows", 0)
+            exp.push("Selectivity (analyze)")
+            exp.line(f"Table rows: {total}")
+            exp.line(f"Window candidates (scanned): {scanned}")
+            exp.line(f"Matched: {matched}")
+            if scanned:
+                exp.line(f"Match ratio: {matched / scanned:.4f}")
+            exp.pop()
         return str(exp)
 
     def _executor(self, st: FeatureStore) -> Executor:

@@ -32,6 +32,10 @@ class QueryEvent:
     plan_time_ms: float = 0.0
     scan_time_ms: float = 0.0
     hits: int = 0
+    #: coarse-window candidate rows (scanned) and table size — selectivity
+    #: of the index pushdown; hits/scanned ratios near 1 mean tight windows
+    scanned: int = 0
+    table_rows: int = 0
 
     def to_json(self) -> str:
         return json.dumps(asdict(self), default=str)
@@ -64,12 +68,13 @@ class AuditWriter:
 
     def record(self, type_name: str, filter_text: str, hints: Dict[str, Any],
                plan_time_ms: float, scan_time_ms: float, hits: int,
-               user: str = ""):
+               user: str = "", scanned: int = 0, table_rows: int = 0):
         self.write(
             QueryEvent(
                 store=self.store_name, type_name=type_name, user=user,
                 filter=filter_text, hints=hints, plan_time_ms=plan_time_ms,
-                scan_time_ms=scan_time_ms, hits=hits,
+                scan_time_ms=scan_time_ms, hits=hits, scanned=scanned,
+                table_rows=table_rows,
             )
         )
 

@@ -12,6 +12,12 @@ from __future__ import annotations
 import numpy as np
 
 
+# Below this many windows per shard, an unrolled broadcast-compare mask is
+# pure fused elementwise work; above it, the scatter+cumsum form wins (its
+# cost is O(L) regardless of K).
+_COMPARE_MASK_MAX_K = 16
+
+
 def window_mask(starts, ends, counts, L: int):
     """[S,K] local-row windows + [S] shard row counts -> [S,L] bool mask.
 
@@ -21,14 +27,25 @@ def window_mask(starts, ends, counts, L: int):
     import jax
     import jax.numpy as jnp
 
-    def one(s, e):
-        d = jnp.zeros(L + 1, jnp.int32)
-        d = d.at[s].add(1)
-        d = d.at[e].add(-1)
-        return jnp.cumsum(d)[:L] > 0
-
-    wm = jax.vmap(one)(starts, ends)
     iota = jnp.arange(L, dtype=jnp.int32)
+    K = starts.shape[1]
+    if K <= _COMPARE_MASK_MAX_K:
+        # K unrolled [S,L] compares fuse into the consuming kernel — no
+        # [S,L+1] scatter/cumsum materialization riding HBM
+        wm = None
+        for k in range(K):
+            m = (iota[None, :] >= starts[:, k, None]) & (
+                iota[None, :] < ends[:, k, None]
+            )
+            wm = m if wm is None else (wm | m)
+    else:
+        def one(s, e):
+            d = jnp.zeros(L + 1, jnp.int32)
+            d = d.at[s].add(1)
+            d = d.at[e].add(-1)
+            return jnp.cumsum(d)[:L] > 0
+
+        wm = jax.vmap(one)(starts, ends)
     return wm & (iota[None, :] < counts[:, None])
 
 

@@ -100,6 +100,13 @@ class Executor:
             and not plan.hints.sample_by
             and plan.compiled.refine is None
         )
+        # selectivity instrumentation: rows the coarse windows admit vs the
+        # table size. The audit event pairs this with `hits` so over-scan
+        # (candidates >> matches) is visible per query instead of silent.
+        plan.__dict__["scanned_rows"] = int(
+            np.maximum(ends - starts, 0).sum()
+        )
+        plan.__dict__["table_rows"] = int(table.n)
         return {
             "table": table, "starts": starts, "ends": ends, "counts": counts,
             "L": L, "needed": needed, "use_device": use_device,

@@ -293,3 +293,41 @@ def test_guards(ds_and_data):
             "gdelt",
             "dtg DURING 2020-01-05T00:00:00Z/2020-01-06T00:00:00Z",
         ) >= 0
+
+
+def test_window_mask_compare_vs_cumsum():
+    """The small-K broadcast-compare window mask must agree with the
+    scatter+cumsum form and with the numpy twin for every K."""
+    import jax.numpy as jnp
+
+    from geomesa_tpu.kernels import masks as km
+
+    rng = np.random.default_rng(5)
+    S, L = 4, 200
+    for K in (1, 2, km._COMPARE_MASK_MAX_K, km._COMPARE_MASK_MAX_K + 3):
+        starts = np.zeros((S, K), np.int32)
+        ends = np.zeros((S, K), np.int32)
+        for s in range(S):
+            # non-overlapping sorted windows, some padded (0,0)
+            edges = np.sort(rng.choice(L, size=2 * K, replace=False))
+            nwin = rng.integers(0, K + 1)
+            for k in range(nwin):
+                starts[s, k], ends[s, k] = edges[2 * k], edges[2 * k + 1]
+        counts = rng.integers(1, L + 1, S).astype(np.int32)
+        got = np.asarray(km.window_mask(
+            jnp.asarray(starts), jnp.asarray(ends), jnp.asarray(counts), L
+        ))
+        want = km.window_mask_np(starts, ends, counts, L)
+        np.testing.assert_array_equal(got, want, err_msg=f"K={K}")
+
+
+def test_selectivity_counters_in_audit_and_explain(ds_and_data):
+    ds, data = ds_and_data
+    n = ds.count("gdelt", BBOX_TIME)
+    ev = ds.audit.recent(1)[-1]
+    assert ev.table_rows == N
+    assert ev.scanned >= n > 0
+    assert ev.scanned <= N
+    out = ds.explain("gdelt", BBOX_TIME, analyze=True)
+    assert "Window candidates (scanned)" in out
+    assert f"Matched: {int(oracle_mask(data).sum())}" in out
