@@ -132,7 +132,14 @@ class GeoDataset:
             ft = FeatureType.from_spec(name_or_ft, spec)
         if ft.name in self._stores:
             raise ValueError(f"schema {ft.name!r} already exists")
-        self._stores[ft.name] = FeatureStore(ft, self.n_shards)
+        from geomesa_tpu.index.partitioned import (
+            PartitionedFeatureStore, is_partitioned_schema,
+        )
+
+        if is_partitioned_schema(ft):
+            self._stores[ft.name] = PartitionedFeatureStore(ft, self.n_shards)
+        else:
+            self._stores[ft.name] = FeatureStore(ft, self.n_shards)
         self.metadata[ft.name] = {"spec": ft.spec()}
         return ft
 
@@ -204,7 +211,14 @@ class GeoDataset:
         from geomesa_tpu.curves.binned_time import BinnedTime
         from geomesa_tpu.schema.columns import DictionaryEncoder
 
+        from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+
         st = self._store(name)
+        if isinstance(st, PartitionedFeatureStore):
+            raise NotImplementedError(
+                "update_schema on a time-partitioned store is not supported "
+                "yet; export + re-ingest under the new schema"
+            )
         st.flush()
         old = st.ft
         # insert new attributes before the ';user-data' section, if any
@@ -375,9 +389,15 @@ class GeoDataset:
         # one executor per store: executors cache NamedSharding objects, and
         # device_columns keys its upload cache by id(sharding) — a fresh
         # executor per query would re-upload every column on meshed datasets
+        from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+        from geomesa_tpu.planning.partitioned_exec import PartitionedExecutor
+
         ex = self._executors.get(st.ft.name)
         if ex is None or ex.store is not st:
-            ex = Executor(st, self.mesh, self.prefer_device)
+            if isinstance(st, PartitionedFeatureStore):
+                ex = PartitionedExecutor(st, self.mesh, self.prefer_device)
+            else:
+                ex = Executor(st, self.mesh, self.prefer_device)
             self._executors[st.ft.name] = ex
         return ex
 
@@ -538,22 +558,27 @@ class GeoDataset:
             query: "str | Query" = "INCLUDE") -> FeatureCollection:
         """K nearest neighbors (KNearestNeighborSearchProcess analog)."""
         st, q, plan = self._plan(name, query)
-        idx, dists = self._executor(st).knn(plan, x, y, k)
-        table = st.tables[plan.index_name]
-        L = table.shard_len
-        mask = np.zeros(table.n_shards * L, dtype=bool)
-        mask[idx] = True
-        batch = table.host_gather(mask)
-        # order by distance
+        ex = self._executor(st)
+        if hasattr(ex, "knn_features"):  # partitioned: per-partition top-k
+            batch = ex.knn_features(plan, x, y, k)
+        else:
+            idx, dists = ex.knn(plan, x, y, k)
+            table = st.tables[plan.index_name]
+            L = table.shard_len
+            mask = np.zeros(table.n_shards * L, dtype=bool)
+            mask[idx] = True
+            batch = table.host_gather(mask)
+        # order by distance, truncate to k (the partition merge may carry
+        # up to k candidates per partition)
         if batch.n:
             xs = batch.columns[st.ft.geom_field + "__x"]
             ys = batch.columns[st.ft.geom_field + "__y"]
             from geomesa_tpu.utils.geometry import haversine_m
 
             d = haversine_m(xs, ys, x, y)
-            order = np.argsort(d)
+            order = np.argsort(d)[:k]
             batch = ColumnBatch(
-                {k: v[order] for k, v in batch.columns.items()}, batch.n
+                {kk: v[order] for kk, v in batch.columns.items()}, len(order)
             )
         return FeatureCollection(st.ft, batch, st.dicts)
 
@@ -693,22 +718,31 @@ class GeoDataset:
 
     # -- persistence (shard-manifest checkpoint, SURVEY.md §5) -------------
     def save(self, path: str):
+        from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+
         os.makedirs(path, exist_ok=True)
         manifest = {"version": 1, "schemas": {}}
         for name, st in self._stores.items():
             st.flush()
-            manifest["schemas"][name] = {
+            entry = {
                 "spec": st.ft.spec(),
                 "n_shards": st.n_shards,
                 "dicts": {k: d.to_list() for k, d in st.dicts.items()},
                 "stats": {k: v.to_json() for k, v in st.stats.items()},
             }
-            if st._all is not None:
+            if isinstance(st, PartitionedFeatureStore):
+                # incremental: only dirty partitions rewrite their snapshot
+                parts = st.checkpoint_into(os.path.join(path, f"{name}_parts"))
+                entry["partitions"] = {
+                    str(b): os.path.relpath(d, path) for b, d in parts.items()
+                }
+            elif st._all is not None:
                 cols = {
                     k: (v.astype("U") if v.dtype.kind == "O" else v)
                     for k, v in st._all.columns.items()
                 }
                 np.savez_compressed(os.path.join(path, f"{name}.npz"), **cols)
+            manifest["schemas"][name] = entry
         with open(os.path.join(path, "manifest.json"), "w") as fh:
             json.dump(manifest, fh, indent=2)
 
@@ -726,6 +760,12 @@ class GeoDataset:
                 k: DictionaryEncoder(v) for k, v in meta["dicts"].items()
             }
             st.stats = {k: sk.Stat.from_json(v) for k, v in meta["stats"].items()}
+            if "partitions" in meta:
+                st.attach_snapshots({
+                    int(b): os.path.join(path, rel)
+                    for b, rel in meta["partitions"].items()
+                })
+                continue
             npz_path = os.path.join(path, f"{name}.npz")
             if os.path.exists(npz_path):
                 with np.load(npz_path, allow_pickle=False) as z:

@@ -175,3 +175,18 @@ AUDIT_PATH = SystemProperty("geomesa.audit.path", None)
 
 #: Enable query auditing (QueryEvent records; reference index/audit/).
 AUDIT_ENABLED = SystemProperty("geomesa.audit.enabled", "true")
+
+# ---------------------------------------------------------------------------
+# Time-partitioned / out-of-core store (TimePartition.scala:35 analog).
+# ---------------------------------------------------------------------------
+
+#: Spill directory for cold time partitions (unset = a per-store temp dir).
+SPILL_DIR = SystemProperty("geomesa.partition.spill.dir", None)
+
+#: Max time partitions kept resident in host RAM per partitioned store;
+#: the rest live on disk and stream through partition-at-a-time.
+MAX_RESIDENT_PARTITIONS = SystemProperty("geomesa.partition.max.resident", "4")
+
+#: Partitioned tables round their padded shard length up to a multiple of
+#: this, so near-equal partitions share one compiled scan kernel shape.
+SHARD_LEN_BUCKET = SystemProperty("geomesa.partition.shard.bucket", "65536")

@@ -20,6 +20,7 @@ attribute sketches) that drive the cost-based strategy decider.
 
 from __future__ import annotations
 
+import itertools
 import threading
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -78,6 +79,9 @@ class IndexTable:
         #: key-column quantization shifts when the radix pack-sort built
         #: this table (None = argsort path, raw keys stored)
         self.key_shifts: Optional[Dict[str, int]] = None
+        #: round the padded shard length up to a multiple of this, so tables
+        #: of near-equal size (time partitions) share compiled kernel shapes
+        self.shard_len_multiple = 1
 
     # -- build ------------------------------------------------------------
     def rebuild(self, columns: Dict[str, np.ndarray], dicts: Dict[str, DictionaryEncoder]):
@@ -235,7 +239,9 @@ class IndexTable:
         """Padded per-shard length (static shape for the device)."""
         if self.n == 0:
             return 0
-        return int(np.max(np.diff(self.shard_bounds)))
+        m = int(np.max(np.diff(self.shard_bounds)))
+        b = self.shard_len_multiple
+        return m if b <= 1 else -(-m // b) * b
 
     def shard_slice(self, s: int) -> slice:
         return slice(int(self.shard_bounds[s]), int(self.shard_bounds[s + 1]))
@@ -296,6 +302,10 @@ class IndexTable:
             starts, ends = plan.windows(shard_cols, n)
             per_shard.append((starts, ends))
         K = max(len(s) for s, _ in per_shard)
+        # pad the window count to a power of two: K is a kernel static shape,
+        # and pow2 bucketing keeps near-identical queries (or the same query
+        # across time partitions) on one compiled kernel
+        K = 1 << (K - 1).bit_length() if K > 1 else 1
         S = self.n_shards
         starts = np.zeros((S, K), np.int32)
         ends = np.zeros((S, K), np.int32)
@@ -330,7 +340,12 @@ class FeatureStore:
     The GeoMesaDataStore-per-type analog: schema, writer, tables, stats
     (reference GeoMesaDataStore.scala:49, MetadataBackedStats)."""
 
+    _uids = itertools.count()
+
     def __init__(self, ft: FeatureType, n_shards: Optional[int] = None):
+        #: process-unique id: cache keys must never collide across store
+        #: objects (id() can be recycled after GC — partition children churn)
+        self.uid = next(FeatureStore._uids)
         self.ft = ft
         self.n_shards = n_shards or ft.shards or config.DEFAULT_SHARDS.to_int()
         self.dicts: Dict[str, DictionaryEncoder] = {}

@@ -29,6 +29,8 @@ def window_mask(starts, ends, counts, L: int):
 
     iota = jnp.arange(L, dtype=jnp.int32)
     K = starts.shape[1]
+    if K == 0:
+        return jnp.zeros((starts.shape[0], L), bool)
     if K <= _COMPARE_MASK_MAX_K:
         # K unrolled [S,L] compares fuse into the consuming kernel — no
         # [S,L+1] scatter/cumsum materialization riding HBM

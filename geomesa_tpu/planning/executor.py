@@ -65,10 +65,17 @@ def check_deadline():
 
 
 class Executor:
-    def __init__(self, store: FeatureStore, mesh=None, prefer_device: bool = True):
+    def __init__(self, store: FeatureStore, mesh=None, prefer_device: bool = True,
+                 kernel_fns: Optional[Dict] = None, version_source=None):
         self.store = store
         self.mesh = mesh
         self.prefer_device = prefer_device
+        #: jitted-kernel cache shared ACROSS stores (time partitions of one
+        #: parent store execute the same plan: one trace/compile, many tables)
+        self.kernel_fns = kernel_fns
+        #: object whose ``.version`` keys kernel caches (the parent store for
+        #: partition children — any partition mutation bumps it)
+        self.version_source = version_source or store
 
     # -- helpers -----------------------------------------------------------
     def _table(self, plan: QueryPlan) -> IndexTable:
@@ -187,29 +194,32 @@ class Executor:
         compiled = plan.compiled
         sampling = plan.hints.sampling
 
-        # Cross-call kernel cache: plans carry a cache_token (ecql text +
-        # auth set) when their predicate is reproducible from text; combined
-        # with the store's mutation version this lets repeated queries reuse
-        # the jitted kernel across API calls. Plans without a token (raw IR
-        # filters) fall back to a per-plan cache.
+        # Two caches with different lifetimes:
+        # 1. the jitted kernel — reusable across API calls (same predicate
+        #    text + auths, via cache_token) AND across time-partition tables
+        #    of one store (same plan, same shapes). Keyed by the version of
+        #    `version_source` (the parent store for partition children) so a
+        #    predicate recompiled under grown dictionaries never reuses a
+        #    stale closure.
+        # 2. the device-resident window arrays — strictly per (store,
+        #    version): windows differ per partition and per mutation.
         token = plan.__dict__.get("cache_token")
-        if token is not None:
-            cache = self.store.__dict__.setdefault("_kernel_cache", {})
-            extra = (token, plan.index_name, sampling, self.store.version)
-        else:
-            cache = plan.__dict__.setdefault("_kernel_cache", {})
-            extra = ()
-        # L keys the cache too: a table rebuild changes shard_len and the
-        # kernel closes over it
-        # store.version keys BOTH cache flavors: cached device window arrays
-        # must never survive a mutation (token-ful keys also carry it in
-        # `extra`, harmlessly twice)
-        full_key = (
-            (cache_key, L, self.store.version) + extra
-            if cache_key is not None else None
-        )
-        entry = cache.get(full_key) if full_key is not None else None
-        if entry is None:
+        fn_cache = fn_key = None
+        if cache_key is not None:
+            K = setup["starts"].shape[1]
+            if token is not None:
+                fn_cache = (
+                    self.kernel_fns
+                    if self.kernel_fns is not None
+                    else self.version_source.__dict__.setdefault("_kernel_fns", {})
+                )
+                fn_key = (cache_key, L, K, sampling, token, plan.index_name,
+                          self.version_source.version)
+            else:  # raw-IR plan: cache on the plan (shared across partitions)
+                fn_cache = plan.__dict__.setdefault("_kernel_fns", {})
+                fn_key = (cache_key, L, K, sampling)
+        go = fn_cache.get(fn_key) if fn_cache is not None else None
+        if go is None:
 
             @jax.jit
             def go(cols, starts, ends, counts):
@@ -219,21 +229,36 @@ class Executor:
                     m = kmasks.sampling_mask(m, sampling, jnp)
                 return agg_fn(cols, m, jnp)
 
-            # pre-place the window arrays: they're derived from (plan, store
-            # version) like the kernel itself, and repeated same-plan runs
-            # (pagination, benchmarks) shouldn't re-upload per call — host
-            # link latency can dwarf the kernel
-            entry = (
-                go,
+            if fn_cache is not None:
+                if len(fn_cache) >= 64:  # bound compiled-kernel growth
+                    fn_cache.clear()
+                fn_cache[fn_key] = go
+        # pre-placed window arrays: repeated same-plan runs (pagination,
+        # benchmarks) shouldn't re-upload per call — host link latency can
+        # dwarf the kernel. Unlike the jitted fn, window DATA is plan- and
+        # store-specific: token-less fn_keys carry no plan identity, so
+        # their windows must live on the plan (keyed by store uid), never
+        # in a store-level cache another plan could hit.
+        win = None
+        if fn_key is not None:
+            if token is not None:
+                wcache = self.store.__dict__.setdefault("_win_cache", {})
+                wkey = (fn_key, self.store.uid, self.store.version)
+            else:
+                wcache = plan.__dict__.setdefault("_win_cache", {})
+                wkey = (fn_key, self.store.uid, self.store.version)
+            win = wcache.get(wkey)
+        if win is None:
+            win = (
                 jax.device_put(setup["starts"]),
                 jax.device_put(setup["ends"]),
                 jax.device_put(setup["counts"]),
             )
-            if full_key is not None:
-                if len(cache) >= 64:  # bound compiled-kernel growth
-                    cache.clear()
-                cache[full_key] = entry
-        go, d_starts, d_ends, d_counts = entry
+            if fn_key is not None:
+                if len(wcache) >= 64:
+                    wcache.clear()
+                wcache[wkey] = win
+        d_starts, d_ends, d_counts = win
         from geomesa_tpu.kernels import pallas_kernels as pk
 
         # trace-time flag: pallas dispatch must not fire under a sharded mesh

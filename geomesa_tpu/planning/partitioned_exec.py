@@ -1,0 +1,152 @@
+"""Partition-at-a-time query execution over a PartitionedFeatureStore.
+
+The runtime role of the reference's per-partition range scans + client merge
+(TablePartition tables scanned per partition, AbstractBatchScan.scala:32
+bounded-queue streaming; FeatureReducer merge in QueryPlanner.runQuery):
+prune partitions by the plan's time bounds, stream each pruned partition
+through RAM/HBM (loading spilled ones from disk, evicting over budget), run
+the ordinary :class:`Executor` against it, and merge the additive results.
+One plan → one traced kernel shared by every partition (kernel shapes are
+bucketed in IndexTable.shard_len / windows)."""
+
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.filter import ir
+from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+from geomesa_tpu.planning.executor import Executor, check_deadline
+from geomesa_tpu.planning.planner import QueryPlan
+from geomesa_tpu.schema.columns import ColumnBatch
+from geomesa_tpu.stats import sketches as sk
+
+
+class PartitionedExecutor:
+    def __init__(self, store: PartitionedFeatureStore, mesh=None,
+                 prefer_device: bool = True):
+        self.store = store
+        self.mesh = mesh
+        self.prefer_device = prefer_device
+        #: jitted kernels shared across every partition child
+        self._kernel_fns: Dict = {}
+        self._execs: Dict[int, Executor] = {}
+
+    # -- partition pruning (the TimePartition.partitions() analog) ---------
+    def prune(self, plan: QueryPlan) -> List[int]:
+        store = self.store
+        bins = store.partition_bins()
+        if plan.is_empty:
+            return []
+        kp = plan.key_plan
+        if (
+            kp.bins is not None
+            and store.partition_period == store.ft.time_period
+        ):
+            sel = {int(x) for x in np.asarray(kp.bins).ravel()}
+            return [b for b in bins if b in sel]
+        dtg = store.ft.dtg_field
+        iv = ir.extract_intervals(plan.filter, dtg) if dtg else None
+        if iv is not None and not iv.is_empty:
+            sel = set()
+            for lo, hi in iv.values:
+                if lo is None or hi is None:
+                    return bins
+                sel.update(
+                    int(x) for x in store.binned.bins_between(int(lo), int(hi))
+                )
+            return [b for b in bins if b in sel]
+        return bins
+
+    def _executor_for(self, b: int, child) -> Executor:
+        ex = self._execs.get(b)
+        if ex is None or ex.store is not child:
+            ex = Executor(
+                child, self.mesh, self.prefer_device,
+                kernel_fns=self._kernel_fns, version_source=self.store,
+            )
+            self._execs[b] = ex
+        return ex
+
+    def _each(self, plan: QueryPlan) -> Iterator[Tuple[int, Executor]]:
+        """Stream (bin, executor) over pruned partitions under the residency
+        budget; accumulates the selectivity counters across partitions."""
+        tot_scanned = tot_rows = 0
+        try:
+            for b in self.prune(plan):
+                check_deadline()
+                child = self.store.child(b)
+                if child is None or child.count == 0:
+                    continue
+                plan.__dict__.pop("scanned_rows", None)
+                plan.__dict__.pop("table_rows", None)
+                yield b, self._executor_for(b, child)
+                tot_scanned += plan.__dict__.pop("scanned_rows", 0)
+                tot_rows += plan.__dict__.pop("table_rows", 0)
+                self.store.evict()
+                resident = self.store.partitions
+                for bb in list(self._execs):
+                    if self._execs[bb].store is not resident.get(bb):
+                        del self._execs[bb]  # frees the child's device arrays
+        finally:
+            # an early consumer exit (features() hitting max_features)
+            # closes the generator AT the yield: the just-scanned
+            # partition's counters are still on the plan — fold them in
+            tot_scanned += plan.__dict__.get("scanned_rows", 0)
+            tot_rows += plan.__dict__.get("table_rows", 0)
+            plan.__dict__["scanned_rows"] = tot_scanned
+            plan.__dict__["table_rows"] = tot_rows
+
+    # -- public operations (Executor surface) ------------------------------
+    def count(self, plan: QueryPlan) -> int:
+        total = 0
+        for _, ex in self._each(plan):
+            total += ex.count(plan)
+        return total
+
+    def density(self, plan: QueryPlan, bbox, width: int, height: int,
+                weight: Optional[str] = None, as_numpy: bool = True):
+        out = None
+        for _, ex in self._each(plan):
+            g = ex.density(plan, bbox, width, height, weight, as_numpy=False)
+            # accumulate ON DEVICE: per-partition grid downloads would ride
+            # the host link once per partition per call
+            out = g if out is None else out + g
+        if out is None:
+            return np.zeros((height, width), np.float32)
+        return np.asarray(out) if as_numpy else out
+
+    def stats(self, plan: QueryPlan, stat: sk.Stat) -> sk.Stat:
+        for _, ex in self._each(plan):
+            ex.stats(plan, stat)
+        return stat
+
+    def features(self, plan: QueryPlan) -> ColumnBatch:
+        batches, got = [], 0
+        # early exit once the limit is reached — but only when no sort will
+        # reorder across partitions afterwards
+        limit = plan.hints.max_features if not plan.hints.sort_by else None
+        for _, ex in self._each(plan):
+            batch = ex.features(plan)
+            if batch.n:
+                batches.append(batch)
+                got += batch.n
+            if limit is not None and got >= limit:
+                break
+        return ColumnBatch.concat(batches) if batches else ColumnBatch({}, 0)
+
+    def knn_features(self, plan: QueryPlan, x: float, y: float,
+                     k: int) -> ColumnBatch:
+        """Per-partition top-k gathered and merged; the union of partition
+        top-ks contains the global top-k (caller orders and truncates)."""
+        parts = []
+        for _, ex in self._each(plan):
+            idx, _ = ex.knn(plan, x, y, k)
+            if len(idx) == 0:
+                continue
+            table = ex.store.tables[plan.index_name]
+            mask = np.zeros(table.n_shards * table.shard_len, bool)
+            mask[idx] = True
+            parts.append(table.host_gather(mask))
+        return ColumnBatch.concat(parts) if parts else ColumnBatch({}, 0)

@@ -365,6 +365,15 @@ def spatial_join(
     Runs as one device kernel over the scan (crossing matrix + segment-sum)
     when the store prefers the device path.
     """
+    from geomesa_tpu.planning.partitioned_exec import PartitionedExecutor
+
+    st0 = ds._store(points)
+    st0.flush()
+    if isinstance(ds._executor(st0), PartitionedExecutor):
+        raise NotImplementedError(
+            "spatial_join on a time-partitioned store is not supported yet; "
+            "query the window of interest into a plain store first"
+        )
     geoms = [geo.parse_wkt(p) if isinstance(p, str) else p for p in polygons]
     edges = geo.polygon_edge_buffers(
         geo.MultiPolygon(

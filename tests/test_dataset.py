@@ -331,3 +331,27 @@ def test_selectivity_counters_in_audit_and_explain(ds_and_data):
     out = ds.explain("gdelt", BBOX_TIME, analyze=True)
     assert "Window candidates (scanned)" in out
     assert f"Matched: {int(oracle_mask(data).sum())}" in out
+
+
+def test_tokenless_plans_do_not_share_window_arrays(ds_and_data):
+    """Two raw-IR plans with the same op but different bounds must not hit
+    each other's cached device window arrays (r4 code-review finding)."""
+    from geomesa_tpu.filter import ir, parse_ecql
+    from geomesa_tpu.planning.planner import QueryPlanner
+
+    ds, data = ds_and_data
+    st = ds._store("gdelt")
+    planner = QueryPlanner(st)
+    ex = ds._executor(st)
+    x, y = data["geom__x"], data["geom__y"]
+    f_a = parse_ecql("BBOX(geom, -100, 30, -80, 45)")
+    f_b = parse_ecql("BBOX(geom, -118, 26, -112, 34)")
+    plan_a = planner.plan(f_a)   # ir.Filter input -> no cache_token
+    plan_b = planner.plan(f_b)
+    assert plan_a.__dict__.get("cache_token") is None
+    got_a = ex.count(plan_a)
+    got_b = ex.count(plan_b)
+    want_a = int(((x >= -100) & (x <= -80) & (y >= 30) & (y <= 45)).sum())
+    want_b = int(((x >= -118) & (x <= -112) & (y >= 26) & (y <= 34)).sum())
+    assert got_a == want_a
+    assert got_b == want_b

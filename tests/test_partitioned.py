@@ -1,0 +1,218 @@
+"""Time-partitioned out-of-core store tests (TimePartition.scala:35 +
+ParquetFileSystemStorage streaming analog): routing, pruning, spill/stream
+correctness vs a plain in-RAM store, deletes, incremental checkpointing.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, Query
+from geomesa_tpu.filter.ecql import parse_iso_ms
+from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+
+SPEC = "name:String:index=true,weight:Double,dtg:Date,*geom:Point"
+PSPEC = SPEC + ";geomesa.partition='time'"
+N = 30_000
+
+BBOX_TIME = (
+    "BBOX(geom, -100, 30, -80, 45) AND "
+    "dtg DURING 2020-01-05T00:00:00Z/2020-01-15T00:00:00Z"
+)
+
+
+def _data(n=N, seed=11):
+    rng = np.random.default_rng(seed)
+    return {
+        "name": [f"actor{i % 20}" for i in range(n)],
+        "weight": rng.uniform(0, 10, n),
+        "dtg": rng.integers(
+            parse_iso_ms("2020-01-01"), parse_iso_ms("2020-03-01"), n
+        ).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+    }
+
+
+@pytest.fixture(scope="module")
+def pair(tmp_path_factory):
+    """(partitioned ds, plain ds) over identical data; partitioned store
+    runs with max_resident=1 so every multi-partition query streams."""
+    data = _data()
+    plain = GeoDataset(n_shards=8)
+    plain.create_schema("t", SPEC)
+    plain.insert("t", data, fids=np.arange(N).astype(str))
+    plain.flush()
+
+    part = GeoDataset(n_shards=8)
+    part.create_schema("t", PSPEC)
+    st = part._store("t")
+    assert isinstance(st, PartitionedFeatureStore)
+    st.max_resident = 1
+    st._spill_dir = str(tmp_path_factory.mktemp("spill"))
+    part.insert("t", data, fids=np.arange(N).astype(str))
+    part.flush()
+    return part, plain, data
+
+
+def test_partitions_created_and_spilled(pair):
+    part, _, data = pair
+    st = part._store("t")
+    bins = st.partition_bins()
+    # two months of data at weekly period -> ~9 partitions
+    assert len(bins) >= 8
+    assert len(st.partitions) <= st.max_resident
+    assert len(st.spilled) >= len(bins) - st.max_resident
+    for d in st.spilled.values():
+        assert os.path.isdir(d)
+    assert st.count == N
+
+
+def test_count_and_features_match_plain(pair):
+    part, plain, _ = pair
+    for q in ("INCLUDE", BBOX_TIME, "name = 'actor7'", "weight < 2.5"):
+        assert part.count("t", q) == plain.count("t", q), q
+    fa = part.query("t", BBOX_TIME)
+    fb = plain.query("t", BBOX_TIME)
+    assert len(fa) == len(fb)
+    assert sorted(fa.fids) == sorted(fb.fids)
+
+
+def test_density_matches_plain(pair):
+    part, plain, _ = pair
+    bbox = (-100, 30, -80, 45)
+    ga = part.density("t", BBOX_TIME, bbox=bbox, width=64, height=64)
+    gb = plain.density("t", BBOX_TIME, bbox=bbox, width=64, height=64)
+    np.testing.assert_allclose(ga, gb)
+
+
+def test_stats_match_plain(pair):
+    part, plain, _ = pair
+    for spec in ("MinMax(weight)", "Enumeration(name)",
+                 "Histogram(weight,10,0,10)"):
+        va = part.stats("t", spec, BBOX_TIME).value()
+        vb = plain.stats("t", spec, BBOX_TIME).value()
+        assert va == vb, spec
+
+
+def test_partition_pruning(pair):
+    part, _, _ = pair
+    st, _, plan = part._plan("t", BBOX_TIME)
+    pex = part._executor(st)
+    pruned = pex.prune(plan)
+    # a 10-day window at weekly partitioning touches at most 3 partitions
+    assert 1 <= len(pruned) <= 3
+    assert set(pruned) <= set(st.partition_bins())
+    ev_scanned_before = part.count("t", BBOX_TIME)
+    ev = part.audit.recent(1)[-1]
+    # selectivity counters aggregate only over pruned partitions
+    assert ev.table_rows < N
+    assert ev.scanned >= ev_scanned_before
+
+
+def test_knn_matches_plain(pair):
+    part, plain, _ = pair
+    a = part.knn("t", -90.0, 38.0, k=7)
+    b = plain.knn("t", -90.0, 38.0, k=7)
+    assert len(a) == 7 == len(b)
+    assert sorted(a.fids) == sorted(b.fids)
+
+
+def test_sort_limit_projection(pair):
+    part, plain, _ = pair
+    q = Query(ecql=BBOX_TIME, sort_by=[("weight", False)], max_features=25,
+              properties=["weight"])
+    fa, fb = part.query("t", q), plain.query("t", q)
+    assert len(fa) == len(fb) == 25
+    np.testing.assert_allclose(fa.columns["weight"], fb.columns["weight"])
+
+
+def test_delete_across_partitions(pair):
+    part, plain, data = pair
+    # fresh datasets so module fixture stays intact
+    p2 = GeoDataset(n_shards=4)
+    p2.create_schema("t", PSPEC)
+    p2._store("t").max_resident = 1
+    p2.insert("t", data, fids=np.arange(N).astype(str))
+    p2.flush()
+    removed = p2.delete_features("t", "weight < 5")
+    w = data["weight"]
+    assert removed == int((w < 5).sum())
+    assert p2.count("t") == N - removed
+    assert p2.count("t", "weight < 5") == 0
+
+
+def test_streamed_reload_is_exact(pair):
+    """Force every partition through a spill+reload cycle and re-verify."""
+    part, plain, _ = pair
+    st = part._store("t")
+    st.evict(keep=1)
+    assert part.count("t", BBOX_TIME) == plain.count("t", BBOX_TIME)
+
+
+def test_save_load_roundtrip(tmp_path, pair):
+    part, plain, _ = pair
+    p = str(tmp_path / "ckpt")
+    part.save(p)
+    ds2 = GeoDataset.load(p)
+    st2 = ds2._store("t")
+    assert isinstance(st2, PartitionedFeatureStore)
+    assert ds2.count("t") == N
+    assert ds2.count("t", BBOX_TIME) == plain.count("t", BBOX_TIME)
+    # merged stats survive without touching column data
+    assert ds2.bounds("t") is not None
+
+
+def test_incremental_checkpoint_touches_only_dirty(tmp_path):
+    """append → save → append-to-one-partition → save: the second save must
+    rewrite only the dirty partition's snapshot (GeoMesaMetadata /
+    TableBasedMetadata incremental-catalog analog)."""
+    data = _data(8_000, seed=3)
+    ds = GeoDataset(n_shards=4)
+    ds.create_schema("t", PSPEC)
+    ds.insert("t", data, fids=np.arange(8_000).astype(str))
+    ds.flush()
+    p = str(tmp_path / "ckpt")
+    ds.save(p)
+    st = ds._store("t")
+    snap1 = {
+        b: os.path.getmtime(os.path.join(d, "data.npz"))
+        for b, d in st.checkpoint_into(p + "/t_parts").items()
+    }
+    # touch exactly one partition: a single row inside one period
+    one = {
+        "name": ["x"], "weight": np.asarray([1.0]),
+        "dtg": np.asarray([parse_iso_ms("2020-01-08")]).astype("datetime64[ms]"),
+        "geom__x": np.asarray([-90.0]), "geom__y": np.asarray([40.0]),
+    }
+    ds.insert("t", one, fids=np.asarray(["z1"]))
+    ds.save(p)
+    touched = []
+    for b, d in st.checkpoint_into(p + "/t_parts").items():
+        m = os.path.getmtime(os.path.join(d, "data.npz"))
+        if m != snap1.get(b):
+            touched.append(b)
+    target_bin = st.binned.bin_of(parse_iso_ms("2020-01-08"))
+    assert touched == [target_bin]
+
+
+def test_device_and_host_paths_agree(pair):
+    part, plain, _ = pair
+    host = GeoDataset(n_shards=8, prefer_device=False)
+    host.create_schema("t", PSPEC)
+    host._store("t").max_resident = 2
+    d = _data(5_000, seed=9)
+    host.insert("t", d, fids=np.arange(5_000).astype(str))
+    dev = GeoDataset(n_shards=8, prefer_device=True)
+    dev.create_schema("t", PSPEC)
+    dev._store("t").max_resident = 2
+    dev.insert("t", d, fids=np.arange(5_000).astype(str))
+    for q in (BBOX_TIME, "INCLUDE", "name = 'actor3'"):
+        assert host.count("t", q) == dev.count("t", q), q
+
+
+def test_update_schema_partitioned_raises(pair):
+    part, _, _ = pair
+    with pytest.raises(NotImplementedError):
+        part.update_schema("t", "extra:Integer")
