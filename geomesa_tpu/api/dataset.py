@@ -446,6 +446,52 @@ class GeoDataset:
             )
         return FeatureCollection(st.ft, batch, st.dicts)
 
+    def query_batches(self, name: str, query: "str | Query" = "INCLUDE",
+                      batch_rows: Optional[int] = None):
+        """Stream query results as ColumnBatch chunks (the ArrowScan delta-
+        batch contract): a partitioned store yields partition-at-a-time so
+        peak memory is one partition's matches, never the whole result.
+        Sorted queries fall back to one materialized batch (a global sort
+        needs all rows). Projection applies per chunk; audit fires once at
+        stream end."""
+        q = Query(ecql=query) if isinstance(query, str) else query
+        if q.sort_by:  # a global sort needs all rows: one materialized batch
+            fc = self.query(name, q)
+
+            def _one():
+                if fc.batch.n:
+                    yield fc.batch
+
+            return _one()
+        # plan EAGERLY so unknown attributes / parse errors / guard vetoes
+        # raise here, not mid-stream inside the consumer's iteration
+        st, q, plan = self._plan(name, q)
+        keep_pref = None
+        if q.properties:
+            keep = set(q.properties) | {"__fid__"}
+            keep_pref = (keep, tuple(p + "__" for p in q.properties))
+
+        def _iter():
+            t0 = time.perf_counter()
+            hits = 0
+            with metrics.registry().timer("query.scan").time(), \
+                    query_deadline(self._timeout_s()):
+                for batch in self._executor(st).features_iter(plan, batch_rows):
+                    hits += batch.n
+                    if keep_pref is not None:
+                        keep, pref = keep_pref
+                        batch = ColumnBatch(
+                            {
+                                k: v for k, v in batch.columns.items()
+                                if k in keep or k.startswith(pref)
+                            },
+                            batch.n,
+                        )
+                    yield batch
+            self._audit(name, q, plan, t0, hits)
+
+        return _iter()
+
     def count(self, name: str, query: "str | Query" = "INCLUDE",
               exact: bool = True) -> int:
         st, q, plan = self._plan(name, query)
@@ -687,12 +733,9 @@ class GeoDataset:
         if fc.batch.n == 0:
             # schema of the empty table must match non-empty results: a
             # non-point geometry is utf8 WKT iff the store carries __wkt
-            wkt = [
-                a.name for a in st.ft.attributes
-                if a.is_geom and st._all is not None
-                and a.name + "__wkt" in st._all.columns
-            ]
-            return arrow_io.arrow_schema(st.ft, q.properties, wkt).empty_table()
+            return arrow_io.arrow_schema(
+                st.ft, q.properties, st.wkt_geoms()
+            ).empty_table()
         rb = arrow_io.batch_to_arrow(st.ft, fc.batch, st.dicts, q.properties)
         return pa.Table.from_batches([rb])
 

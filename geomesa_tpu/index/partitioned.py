@@ -70,6 +70,9 @@ class PartitionedFeatureStore(FeatureStore):
         self.part_counts: Dict[int, int] = {}
         #: resident children with changes not yet on disk
         self._dirty: set = set()
+        #: per-partition content sequence (bumped on every mutation) —
+        #: drives incremental checkpointing without path aliasing
+        self._part_seq: Dict[int, int] = {}
         self.max_resident = max(
             1,
             max_resident
@@ -244,6 +247,7 @@ class PartitionedFeatureStore(FeatureStore):
             child = self.child(b, create=True)
             child._buffer.append(sub)
             self._dirty.add(b)
+            self._part_seq[b] = self._part_seq.get(b, 0) + 1
             child.flush()
             self.part_counts[b] = child.count
             self.evict()
@@ -258,6 +262,7 @@ class PartitionedFeatureStore(FeatureStore):
             if r:
                 removed += r
                 self._dirty.add(b)
+                self._part_seq[b] = self._part_seq.get(b, 0) + 1
                 self.part_counts[b] = child.count
             self.evict()
         if removed:
@@ -309,31 +314,51 @@ class PartitionedFeatureStore(FeatureStore):
         return merged
 
     @stats.setter
-    def stats(self, value):  # super().__init__ assigns the empty base dict
+    def stats(self, value):
+        """Intentionally a cache-invalidating no-op: merged stats are ALWAYS
+        recomputed from partition sketches (resident + snapshot metas), so
+        assignments from FeatureStore.__init__ and GeoDataset.load are
+        absorbed rather than stored — there is no base-stats state."""
         self._merged_stats = None
-        self._base_stats = value
+
+    def wkt_geoms(self) -> List[str]:
+        for st in self.partitions.values():
+            return st.wkt_geoms()
+        for d in self.spilled.values():
+            try:
+                with np.load(os.path.join(d, "data.npz"), allow_pickle=False) as z:
+                    names = set(z.files)
+                return [
+                    a.name for a in self.ft.attributes
+                    if a.is_geom and "c/" + a.name + "__wkt" in names
+                ]
+            except OSError:
+                continue
+        return []
 
     # -- durable checkpoint (incremental; GeoMesaMetadata/TableBasedMetadata
     # analog at the partition granularity) --------------------------------
     def checkpoint_into(self, path: str) -> Dict[int, str]:
         """Write/refresh every partition's snapshot under ``path`` without
-        evicting residents. Only dirty partitions (or ones whose snapshot is
-        missing at ``path``) touch disk — append → save → load round-trips
-        rewrite only the changed partitions. Returns bin -> snapshot dir."""
+        evicting residents, and WITHOUT aliasing live store state into the
+        checkpoint (deleting a checkpoint must never corrupt the live
+        store). Incrementality comes from per-partition content sequence
+        numbers: a partition unchanged since the last checkpoint to the
+        same ``path`` is skipped. Returns bin -> snapshot dir."""
         os.makedirs(path, exist_ok=True)
         out: Dict[int, str] = {}
+        written = self.__dict__.setdefault("_ckpt_seqs", {}).setdefault(
+            os.path.abspath(path), {}
+        )
         snaps = getattr(self, "_snapshot_paths", {})
         for b, st in list(self.partitions.items()):
             st.flush()
             d = os.path.join(path, f"part_{b}")
+            cur = self._part_seq.get(b, 0)
+            if written.get(b) == cur and os.path.isdir(d):
+                out[b] = d
+                continue
             if (
-                b not in self._dirty
-                and snaps.get(b) == d
-                and os.path.isdir(d)
-            ):
-                pass  # snapshot at the target is current (and is the
-                #       partition's OWN latest snapshot, not a stale save)
-            elif (
                 b not in self._dirty
                 and os.path.isdir(snaps.get(b, ""))
                 and os.path.abspath(snaps[b]) != os.path.abspath(d)
@@ -343,19 +368,20 @@ class PartitionedFeatureStore(FeatureStore):
                 shutil.copytree(snaps[b], d)
             else:
                 self._write_snapshot(st, d)
-                self._dirty.discard(b)
-            snaps[b] = d
+            written[b] = cur
             out[b] = d
         for b, sd in list(self.spilled.items()):
             d = os.path.join(path, f"part_{b}")
+            cur = self._part_seq.get(b, 0)
+            if written.get(b) == cur and os.path.isdir(d):
+                out[b] = d
+                continue
             if os.path.abspath(sd) != os.path.abspath(d):
                 if os.path.isdir(d):
                     shutil.rmtree(d)
                 shutil.copytree(sd, d)
-                self.spilled[b] = d
+            written[b] = cur
             out[b] = d
-            snaps[b] = d
-        self._snapshot_paths = snaps
         return out
 
     def attach_snapshots(self, mapping: Dict[int, str]):

@@ -477,6 +477,15 @@ class FeatureStore:
             )
         self.version += 1
 
+    def wkt_geoms(self) -> List[str]:
+        """Non-point geometry attributes stored WITH exact WKT (drives the
+        Arrow field type for extent geometries)."""
+        cols = self._all.columns if self._all is not None else {}
+        return [
+            a.name for a in self.ft.attributes
+            if a.is_geom and a.name + "__wkt" in cols
+        ]
+
     def delete(self, mask_fn) -> int:
         """Remove rows matching ``mask_fn(columns) -> bool mask`` (host)."""
         self.flush()

@@ -425,6 +425,24 @@ class Executor:
             mask = self._host_mask(plan, setup)
         return setup["table"].host_gather(mask.reshape(-1))
 
+    def features_iter(self, plan: QueryPlan, batch_rows: Optional[int] = None):
+        """Matching rows as a stream of ColumnBatch chunks (ArrowScan's
+        batched-yield contract, AggregatingScan.scala:82-116). A single
+        table materializes its result once and re-slices it — the streaming
+        value on an unpartitioned store is wire chunking, not peak memory."""
+        batch_rows = batch_rows or int(
+            os.environ.get("GEOMESA_ARROW_BATCH_ROWS", 1_000_000)
+        )
+        out = self.features(plan)
+        n = out.n
+        if plan.hints.max_features is not None and not plan.hints.sort_by:
+            n = min(n, plan.hints.max_features)
+        for lo in range(0, n, batch_rows):
+            hi = min(lo + batch_rows, n)
+            yield ColumnBatch(
+                {k: v[lo:hi] for k, v in out.columns.items()}, hi - lo
+            )
+
     def density(self, plan: QueryPlan, bbox, width: int, height: int,
                 weight: Optional[str] = None, as_numpy: bool = True):
         """Density grid. ``as_numpy=False`` leaves the grid on device (no

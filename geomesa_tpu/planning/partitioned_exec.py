@@ -122,18 +122,33 @@ class PartitionedExecutor:
             ex.stats(plan, stat)
         return stat
 
-    def features(self, plan: QueryPlan) -> ColumnBatch:
-        batches, got = [], 0
-        # early exit once the limit is reached — but only when no sort will
-        # reorder across partitions afterwards
+    def features_iter(self, plan: QueryPlan, batch_rows: Optional[int] = None):
+        """Stream matching rows partition-at-a-time: peak memory is one
+        partition's gather, never the whole result (AbstractBatchScan /
+        ArrowScan streaming contract)."""
+        got = 0
         limit = plan.hints.max_features if not plan.hints.sort_by else None
         for _, ex in self._each(plan):
-            batch = ex.features(plan)
-            if batch.n:
-                batches.append(batch)
+            for batch in ex.features_iter(plan, batch_rows):
+                if not batch.n:
+                    continue
+                if limit is not None:
+                    if got >= limit:
+                        return
+                    if got + batch.n > limit:
+                        keep = limit - got
+                        yield ColumnBatch(
+                            {k: v[:keep] for k, v in batch.columns.items()},
+                            keep,
+                        )
+                        return
                 got += batch.n
+                yield batch
             if limit is not None and got >= limit:
-                break
+                return
+
+    def features(self, plan: QueryPlan) -> ColumnBatch:
+        batches = list(self.features_iter(plan))
         return ColumnBatch.concat(batches) if batches else ColumnBatch({}, 0)
 
     def knn_features(self, plan: QueryPlan, x: float, y: float,

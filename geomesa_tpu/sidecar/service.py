@@ -60,6 +60,23 @@ def _query_from(opts: Dict) -> Query:
     )
 
 
+def _spec_errors(fn):
+    """PROTOCOL.md §7: domain errors (unknown schema/attribute, guard
+    rejections, unsupported ops) cross the wire as FlightServerError with
+    the original message — never as raw Arrow-mapped Python exceptions."""
+    import functools
+
+    @functools.wraps(fn)
+    def wrapped(*args, **kw):
+        try:
+            return fn(*args, **kw)
+        except (KeyError, ValueError, NotImplementedError) as e:
+            msg = e.args[0] if e.args else str(e)
+            raise fl.FlightServerError(str(msg)) from e
+
+    return wrapped
+
+
 class GeoFlightServer(fl.FlightServerBase):
     def __init__(self, dataset: Optional[GeoDataset] = None,
                  location: str = "grpc+tcp://127.0.0.1:0", **kw):
@@ -68,14 +85,48 @@ class GeoFlightServer(fl.FlightServerBase):
         self._lock = threading.Lock()
 
     # -- reads -------------------------------------------------------------
+    @_spec_errors
     def do_get(self, context, ticket: fl.Ticket) -> fl.RecordBatchStream:
         opts = json.loads(ticket.ticket.decode())
         op = opts.get("op", "query")
         name = opts["schema"]
         ds = self.dataset
         if op == "query":
-            table = ds.to_arrow(name, _query_from(opts))
-            return fl.RecordBatchStream(table)
+            # streamed export (DeltaWriter.scala:53 / ArrowScan.scala:38-79
+            # contract): incremental record batches; dictionary deltas ride
+            # the IPC stream (emit_dictionary_deltas) so an append-only
+            # vocabulary never forces a replacement. A partitioned store
+            # streams partition-at-a-time — server peak memory is one
+            # partition's matches, not the result set.
+            from geomesa_tpu.io import arrow_io
+
+            q = _query_from(opts)
+            st = ds._store(name)
+            st.flush()
+            schema = arrow_io.arrow_schema(st.ft, q.properties, st.wkt_geoms())
+
+            # planning runs HERE (query_batches plans eagerly), so bad
+            # ECQL / guard vetoes surface as FlightServerError via the
+            # _spec_errors wrapper instead of escaping mid-stream
+            batches = ds.query_batches(name, q)
+
+            def gen():
+                # chunks ride as single-batch Tables: pyarrow's
+                # GeneratorStream only writes dictionary batches on its
+                # Table path (bare RecordBatches lose them and the client
+                # fails with "expected number of dictionaries")
+                any_ = False
+                for batch in batches:
+                    if batch.n:
+                        any_ = True
+                        rb = arrow_io.batch_to_arrow(
+                            st.ft, batch, st.dicts, q.properties
+                        )
+                        yield pa.Table.from_batches([rb])
+                if not any_:
+                    yield schema.empty_table()
+
+            return fl.GeneratorStream(schema, gen())
         if op == "density":
             q = _query_from(opts)
             grid = ds.density(
@@ -112,6 +163,7 @@ class GeoFlightServer(fl.FlightServerBase):
         raise fl.FlightServerError(f"unknown op {op!r}")
 
     # -- writes ------------------------------------------------------------
+    @_spec_errors
     def do_put(self, context, descriptor, reader, writer):
         opts = json.loads(descriptor.command.decode()) if descriptor.command else {}
         name = opts.get("schema")
@@ -119,15 +171,33 @@ class GeoFlightServer(fl.FlightServerBase):
             name = descriptor.path[0].decode()
         if not name:
             raise fl.FlightServerError("do_put needs a schema name")
-        table = reader.read_all()
+        # Stage the stream chunk-by-chunk WITHOUT the write lock (a slow
+        # uploader must not block other writers), then ingest + flush as
+        # one locked transaction: a mid-stream failure commits nothing.
+        staged = []
+        while True:
+            try:
+                chunk = reader.read_chunk()
+            except StopIteration:
+                break
+            if chunk.data is not None and chunk.data.num_rows:
+                staged.append(chunk.data)
+        n = 0
+        st = self.dataset._store(name)
         with self._lock:
-            n = self.dataset.ingest_arrow(name, table)
-            self.dataset.flush(name)
-        # respond with the ingested count as app metadata
+            mark = len(st._buffer)
+            try:
+                for rb in staged:
+                    n += self.dataset.ingest_arrow(name, rb)
+                self.dataset.flush(name)
+            except Exception:
+                del st._buffer[mark:]  # roll back this upload's batches
+                raise
         writer  # (no app-metadata channel needed; count via describe/count)
         return n
 
     # -- actions -----------------------------------------------------------
+    @_spec_errors
     def do_action(self, context, action: fl.Action) -> Iterator[fl.Result]:
         body = json.loads(action.body.to_pybytes().decode()) if action.body else {}
         ds = self.dataset

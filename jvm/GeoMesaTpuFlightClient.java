@@ -1,0 +1,237 @@
+/*
+ * GeoMesaTpuFlightClient — single-file JVM client for the geomesa-tpu
+ * sidecar, implementing docs/PROTOCOL.md v1 over Arrow Flight.
+ *
+ * This is the delegation layer a GeoTools DataStore builds on (the
+ * reference surface: GeoMesaDataStore.scala:49; SPI registration via
+ * META-INF/services/org.geotools.data.DataStoreFactorySpi). The method ->
+ * RPC mapping is PROTOCOL.md §8:
+ *
+ *   DataStore.createSchema(sft)        -> createSchema(name, specString)
+ *   DataStore.getTypeNames()           -> listSchemas()
+ *   DataStore.getSchema(name)          -> getSpec(name)  (geomesa:spec
+ *                                         metadata -> SimpleFeatureTypes.createType)
+ *   DataStore.removeSchema(name)       -> deleteSchema(name)
+ *   DataStore.getFeatureReader(q, tx)  -> query(name, ecql, props, max, ...)
+ *   FeatureSource.getCount(query)      -> count(name, ecql)
+ *   DensityProcess hints               -> density(name, ecql, bbox, w, h)
+ *   StatsProcess hints                 -> statsJson(name, statDsl, ecql)
+ *   store init version check           -> checkVersion()
+ *
+ * Dependencies (no GeoTools needed for this file):
+ *   org.apache.arrow:flight-core:15+  org.apache.arrow:arrow-memory-netty:15+
+ *
+ * Build+smoke-test (against `geomesa-tpu serve --catalog /tmp/cat`):
+ *   javac -cp "$ARROW_JARS" GeoMesaTpuFlightClient.java
+ *   java  -cp "$ARROW_JARS:." GeoMesaTpuFlightClient grpc+tcp://127.0.0.1:8815
+ */
+
+import java.nio.charset.StandardCharsets;
+import java.util.ArrayList;
+import java.util.Iterator;
+import java.util.List;
+
+import org.apache.arrow.flight.Action;
+import org.apache.arrow.flight.FlightClient;
+import org.apache.arrow.flight.FlightDescriptor;
+import org.apache.arrow.flight.FlightInfo;
+import org.apache.arrow.flight.FlightStream;
+import org.apache.arrow.flight.Location;
+import org.apache.arrow.flight.Result;
+import org.apache.arrow.flight.Ticket;
+import org.apache.arrow.memory.BufferAllocator;
+import org.apache.arrow.memory.RootAllocator;
+import org.apache.arrow.vector.VectorSchemaRoot;
+
+public final class GeoMesaTpuFlightClient implements AutoCloseable {
+
+    /** PROTOCOL.md v1 — refuse servers speaking a different major. */
+    public static final int PROTOCOL_VERSION = 1;
+
+    private final BufferAllocator allocator;
+    private final FlightClient client;
+
+    public GeoMesaTpuFlightClient(String location) {
+        this.allocator = new RootAllocator(Long.MAX_VALUE);
+        this.client = FlightClient.builder(
+                allocator, new Location(java.net.URI.create(location))).build();
+    }
+
+    // -- tiny JSON helpers (flat protocol objects only; no dependency) ----
+    private static String jstr(String s) {
+        StringBuilder b = new StringBuilder("\"");
+        for (int i = 0; i < s.length(); i++) {
+            char c = s.charAt(i);
+            if (c == '"' || c == '\\') b.append('\\');
+            if (c == '\n') { b.append("\\n"); continue; }
+            b.append(c);
+        }
+        return b.append('"').toString();
+    }
+
+    /** Extract a string field from a flat JSON object (protocol responses
+     *  are flat; a full JSON parser is overkill for the handshake path). */
+    static String jget(String json, String key) {
+        String needle = "\"" + key + "\"";
+        int i = json.indexOf(needle);
+        if (i < 0) return null;
+        int colon = json.indexOf(':', i + needle.length());
+        int j = colon + 1;
+        while (j < json.length() && Character.isWhitespace(json.charAt(j))) j++;
+        if (json.charAt(j) == '"') {
+            int end = json.indexOf('"', j + 1);
+            while (end > 0 && json.charAt(end - 1) == '\\') end = json.indexOf('"', end + 1);
+            return json.substring(j + 1, end);
+        }
+        int end = j;
+        while (end < json.length() && "-+.0123456789".indexOf(json.charAt(end)) >= 0) end++;
+        return json.substring(j, end);
+    }
+
+    private String action(String kind, String bodyJson) {
+        Iterator<Result> it = client.doAction(
+                new Action(kind, bodyJson.getBytes(StandardCharsets.UTF_8)));
+        StringBuilder out = new StringBuilder();
+        while (it.hasNext()) out.append(new String(it.next().getBody(), StandardCharsets.UTF_8));
+        return out.toString();
+    }
+
+    // -- PROTOCOL §1: version handshake -----------------------------------
+    public void checkVersion() {
+        String resp = action("version", "{}");
+        int server = Integer.parseInt(jget(resp, "protocol"));
+        if (server != PROTOCOL_VERSION) {
+            throw new IllegalStateException(
+                "sidecar protocol mismatch: server=" + server
+                + " client=" + PROTOCOL_VERSION + "; upgrade the older side");
+        }
+    }
+
+    // -- PROTOCOL §5: schema CRUD / management ----------------------------
+    public String createSchema(String name, String spec) {
+        return jget(action("create-schema",
+                "{\"name\": " + jstr(name) + ", \"spec\": " + jstr(spec) + "}"),
+                "created");
+    }
+
+    public void deleteSchema(String name) {
+        action("delete-schema", "{\"name\": " + jstr(name) + "}");
+    }
+
+    public List<String> listSchemas() {
+        String resp = action("list-schemas", "{}");
+        List<String> out = new ArrayList<>();
+        int i = resp.indexOf('[');
+        int end = resp.indexOf(']', i);
+        for (String part : resp.substring(i + 1, end).split(",")) {
+            String t = part.trim();
+            if (t.length() > 1) out.add(t.substring(1, t.length() - 1));
+        }
+        return out;
+    }
+
+    /** Spec string for GeoTools SimpleFeatureTypes.createType (PROTOCOL §2:
+     *  carried as the geomesa:spec metadata key on every Arrow schema). */
+    public String getSpec(String name) {
+        FlightInfo info = client.getInfo(FlightDescriptor.path(name));
+        byte[] spec = info.getSchema().getCustomMetadata() == null ? null
+                : info.getSchema().getCustomMetadata().get("geomesa:spec") == null ? null
+                : info.getSchema().getCustomMetadata().get("geomesa:spec")
+                      .getBytes(StandardCharsets.UTF_8);
+        return spec == null ? null : new String(spec, StandardCharsets.UTF_8);
+    }
+
+    public long count(String name, String ecql) {
+        String resp = action("count",
+                "{\"name\": " + jstr(name) + ", \"ecql\": " + jstr(ecql) + "}");
+        return Long.parseLong(jget(resp, "count"));
+    }
+
+    public String explain(String name, String ecql) {
+        return jget(action("explain",
+                "{\"name\": " + jstr(name) + ", \"ecql\": " + jstr(ecql) + "}"),
+                "explain");
+    }
+
+    // -- PROTOCOL §3: reads ------------------------------------------------
+    /** Feature scan: the FeatureReader delegate. Caller iterates the
+     *  FlightStream's VectorSchemaRoot batches (arrives incrementally with
+     *  dictionary deltas — DeltaWriter semantics) and wraps rows as
+     *  SimpleFeatures. */
+    public FlightStream query(String name, String ecql, List<String> properties,
+                              Long maxFeatures, Integer sampling) {
+        StringBuilder t = new StringBuilder("{\"op\": \"query\", \"schema\": ")
+                .append(jstr(name)).append(", \"ecql\": ").append(jstr(ecql));
+        if (properties != null && !properties.isEmpty()) {
+            t.append(", \"properties\": [");
+            for (int i = 0; i < properties.size(); i++) {
+                if (i > 0) t.append(", ");
+                t.append(jstr(properties.get(i)));
+            }
+            t.append(']');
+        }
+        if (maxFeatures != null) t.append(", \"max_features\": ").append(maxFeatures);
+        if (sampling != null) t.append(", \"sampling\": ").append(sampling);
+        t.append('}');
+        return client.getStream(new Ticket(t.toString().getBytes(StandardCharsets.UTF_8)));
+    }
+
+    /** Density heatmap (DensityProcess delegate): sparse row/col/weight. */
+    public FlightStream density(String name, String ecql, double[] bbox,
+                                int width, int height) {
+        String t = "{\"op\": \"density\", \"schema\": " + jstr(name)
+                + ", \"ecql\": " + jstr(ecql)
+                + ", \"bbox\": [" + bbox[0] + ", " + bbox[1] + ", " + bbox[2]
+                + ", " + bbox[3] + "], \"width\": " + width
+                + ", \"height\": " + height + "}";
+        return client.getStream(new Ticket(t.getBytes(StandardCharsets.UTF_8)));
+    }
+
+    /** Stats sketch (StatsProcess delegate): returns the sketch JSON. */
+    public String statsJson(String name, String statDsl, String ecql) {
+        String t = "{\"op\": \"stats\", \"schema\": " + jstr(name)
+                + ", \"ecql\": " + jstr(ecql) + ", \"stat\": " + jstr(statDsl) + "}";
+        try (FlightStream s = client.getStream(
+                new Ticket(t.getBytes(StandardCharsets.UTF_8)))) {
+            StringBuilder out = new StringBuilder();
+            while (s.next()) {
+                VectorSchemaRoot root = s.getRoot();
+                if (root.getRowCount() > 0) {
+                    out.append(root.getVector("value").getObject(0).toString());
+                }
+            }
+            return out.toString();
+        } catch (Exception e) {
+            throw new RuntimeException(e);
+        }
+    }
+
+    @Override
+    public void close() throws Exception {
+        client.close();
+        allocator.close();
+    }
+
+    // -- smoke test: the conformance lifecycle against a live sidecar -----
+    public static void main(String[] args) throws Exception {
+        String loc = args.length > 0 ? args[0] : "grpc+tcp://127.0.0.1:8815";
+        try (GeoMesaTpuFlightClient c = new GeoMesaTpuFlightClient(loc)) {
+            c.checkVersion();
+            System.out.println("handshake OK (protocol " + PROTOCOL_VERSION + ")");
+            String spec = "name:String:index=true,dtg:Date,*geom:Point";
+            c.createSchema("jvm_smoke", spec);
+            System.out.println("schemas: " + c.listSchemas());
+            System.out.println("spec round-trip: " + spec.equals(c.getSpec("jvm_smoke")));
+            System.out.println("count(INCLUDE) = " + c.count("jvm_smoke", "INCLUDE"));
+            System.out.println(c.explain("jvm_smoke",
+                    "BBOX(geom, -10, -10, 10, 10)"));
+            long rows = 0;
+            try (FlightStream s = c.query("jvm_smoke", "INCLUDE", null, null, null)) {
+                while (s.next()) rows += s.getRoot().getRowCount();
+            }
+            System.out.println("query rows = " + rows);
+            c.deleteSchema("jvm_smoke");
+            System.out.println("lifecycle OK");
+        }
+    }
+}
