@@ -354,10 +354,14 @@ class GeoDataset:
 
     def _audit(self, name: str, q: Query, plan, t_scan0: float, hits: int,
                op: str = "query"):
+        hints = {"op": op, "index": plan.index_name,
+                 "max_features": q.max_features, "sampling": q.sampling}
+        if "device_coarse_ms" in plan.__dict__:
+            hints["device_coarse_ms"] = round(
+                plan.__dict__["device_coarse_ms"], 3
+            )
         self.audit.record(
-            name, plan.ecql,
-            {"op": op, "index": plan.index_name,
-             "max_features": q.max_features, "sampling": q.sampling},
+            name, plan.ecql, hints,
             plan.__dict__.get("plan_time_ms", 0.0),
             (time.perf_counter() - t_scan0) * 1e3, hits,
             scanned=plan.__dict__.get("scanned_rows", 0),
@@ -382,6 +386,12 @@ class GeoDataset:
             exp.line(f"Matched: {matched}")
             if scanned:
                 exp.line(f"Match ratio: {matched / scanned:.4f}")
+            if "device_coarse_ms" in plan.__dict__:
+                exp.line(
+                    "Device coarse kernel: "
+                    f"{plan.__dict__['device_coarse_ms']:.3f} ms "
+                    "(host refined candidates only)"
+                )
             exp.pop()
         return str(exp)
 

@@ -107,6 +107,15 @@ class Executor:
             and not plan.hints.sample_by
             and plan.compiled.refine is None
         )
+        # refine-bearing plans (extent geometries, >2^24 int64 predicates)
+        # can still run their COARSE mask on device: the heavy dense scan
+        # stays a TPU kernel, the host only refines coarse-true candidates
+        # (AggregatingScan.scala:82-116 validate-then-aggregate, split
+        # across the device/host boundary)
+        coarse_device = (
+            self.prefer_device and not host_only
+            and plan.compiled.refine is not None
+        )
         # selectivity instrumentation: rows the coarse windows admit vs the
         # table size. The audit event pairs this with `hits` so over-scan
         # (candidates >> matches) is visible per query instead of silent.
@@ -117,22 +126,61 @@ class Executor:
         return {
             "table": table, "starts": starts, "ends": ends, "counts": counts,
             "L": L, "needed": needed, "use_device": use_device,
+            "coarse_device": coarse_device,
         }
 
-    def _host_mask(self, plan: QueryPlan, setup) -> np.ndarray:
-        """[S, L] mask on the host (numpy)."""
+    def _device_coarse_mask(self, plan: QueryPlan, setup) -> np.ndarray:
+        """Window mask ∧ coarse predicate as ONE device kernel, packed
+        8 rows/byte on device so the host download is n/8 bytes. Returns
+        the unpacked [S, L] numpy mask for host refinement."""
+        import time as _time
+
+        L = setup["L"]
+        Lp = -(-L // 8) * 8
+
+        def agg(cols, m, xp):
+            import jax.numpy as jnp
+
+            mp = jnp.pad(m, ((0, 0), (0, Lp - L))) if Lp != L else m
+            bits = mp.reshape(m.shape[0], Lp // 8, 8).astype(jnp.uint8)
+            w = (2 ** jnp.arange(8, dtype=jnp.uint8))[None, None, :]
+            return (bits * w).sum(axis=-1).astype(jnp.uint8)
+
+        t0 = _time.perf_counter()
+        packed = np.asarray(
+            self._device_mask_and_agg(plan, setup, agg,
+                                      cache_key=("coarse_mask",),
+                                      apply_sampling=False)
+        )
+        plan.__dict__["device_coarse_ms"] = (
+            plan.__dict__.get("device_coarse_ms", 0.0)
+            + (_time.perf_counter() - t0) * 1e3
+        )
+        bits = np.unpackbits(packed, axis=1, bitorder="little")
+        return bits[:, :L].astype(bool)
+
+    def _host_mask(self, plan: QueryPlan, setup,
+                   coarse: Optional[np.ndarray] = None) -> np.ndarray:
+        """[S, L] mask on the host (numpy). ``coarse`` short-circuits the
+        window+predicate passes with a device-computed coarse mask."""
         table = setup["table"]
-        wm = kmasks.window_mask_np(setup["starts"], setup["ends"], setup["counts"], setup["L"])
-        S, L = wm.shape
-        pm = np.zeros((S, L), dtype=bool)
-        needed = setup["needed"]
-        for s in range(table.n_shards):
-            check_deadline()
-            sl = table.shard_slice(s)
-            cols = table.shard_cols(needed, s)
-            pm[s, : sl.stop - sl.start] = np.asarray(plan.compiled(cols, np))
-        mask = wm & pm
+        if coarse is not None:
+            mask = coarse
+        else:
+            wm = kmasks.window_mask_np(
+                setup["starts"], setup["ends"], setup["counts"], setup["L"]
+            )
+            S, L = wm.shape
+            pm = np.zeros((S, L), dtype=bool)
+            needed = setup["needed"]
+            for s in range(table.n_shards):
+                check_deadline()
+                sl = table.shard_slice(s)
+                cols = table.shard_cols(needed, s)
+                pm[s, : sl.stop - sl.start] = np.asarray(plan.compiled(cols, np))
+            mask = wm & pm
         mask = self._apply_refine(plan, setup, mask)
+        S, L = mask.shape
         if plan.hints.sampling and plan.hints.sample_by:
             key = plan.hints.sample_by
             if not table.has_column(key):
@@ -178,7 +226,7 @@ class Executor:
         return mask
 
     def _device_mask_and_agg(self, plan: QueryPlan, setup, agg_fn, agg_cols=(),
-                             cache_key=None):
+                             cache_key=None, apply_sampling=True):
         """Run mask + aggregation in one jit. ``agg_fn(cols, mask, xp)``.
 
         ``cache_key`` caches the jitted kernel on the plan so re-running the
@@ -192,7 +240,9 @@ class Executor:
         )
         L = setup["L"]
         compiled = plan.compiled
-        sampling = plan.hints.sampling
+        # coarse-mask kernels must NOT sample: sampling runs once on the
+        # host, AFTER refinement (the 1-in-n counter sees exact matches)
+        sampling = plan.hints.sampling if apply_sampling else None
 
         # Two caches with different lifetimes:
         # 1. the jitted kernel — reusable across API calls (same predicate
@@ -376,7 +426,17 @@ class Executor:
                 logging.getLogger(__name__).warning(
                     "device scan failed, falling back to host: %r", e
                 )
-        mask = self._host_mask(plan, setup)
+        coarse = None
+        if setup.get("coarse_device"):
+            try:
+                coarse = self._device_coarse_mask(plan, setup)
+            except Exception as e:
+                if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
+                    raise
+                logging.getLogger(__name__).warning(
+                    "device coarse scan failed, computing mask on host: %r", e
+                )
+        mask = self._host_mask(plan, setup, coarse)
         table = setup["table"]
         cols = {}
         for c in set(list(setup["needed"]) + list(agg_cols)):
@@ -422,7 +482,18 @@ class Executor:
                     "device scan failed, falling back to host: %r", e
                 )
         if mask is None:
-            mask = self._host_mask(plan, setup)
+            coarse = None
+            if setup.get("coarse_device"):
+                try:
+                    coarse = self._device_coarse_mask(plan, setup)
+                except Exception as e:
+                    if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
+                        raise
+                    logging.getLogger(__name__).warning(
+                        "device coarse scan failed, computing mask on host: %r",
+                        e,
+                    )
+            mask = self._host_mask(plan, setup, coarse)
         return setup["table"].host_gather(mask.reshape(-1))
 
     def features_iter(self, plan: QueryPlan, batch_rows: Optional[int] = None):
