@@ -325,22 +325,27 @@ def _point_spatial_fn(node, xc: str, yc: str, exact: bool, neg: bool,
 #: LRU, not clear-on-overflow: unique-geometry sweeps evict steadily
 #: instead of wiping repeated candidates.
 from collections import OrderedDict  # noqa: E402
+from threading import Lock  # noqa: E402
 
 _GEOM_CACHE: "OrderedDict[str, geo.Geometry]" = OrderedDict()
 _GEOM_CACHE_MAX = 8192
+_GEOM_CACHE_LOCK = Lock()  # the Flight sidecar refines on gRPC pool threads
 
 
 def _parse_wkt_cached(w) -> geo.Geometry:
     if isinstance(w, geo.Geometry):
         return w
     s = str(w)
-    g = _GEOM_CACHE.get(s)
-    if g is None:
+    with _GEOM_CACHE_LOCK:
+        g = _GEOM_CACHE.get(s)
+        if g is not None:
+            _GEOM_CACHE.move_to_end(s)
+            return g
+    g = geo.parse_wkt(s)
+    with _GEOM_CACHE_LOCK:
         while len(_GEOM_CACHE) >= _GEOM_CACHE_MAX:
             _GEOM_CACHE.popitem(last=False)
-        g = _GEOM_CACHE[s] = geo.parse_wkt(s)
-    else:
-        _GEOM_CACHE.move_to_end(s)
+        _GEOM_CACHE[s] = g
     return g
 
 

@@ -147,8 +147,61 @@ def _bin_and_offset(binned: BinnedTime, ft: FeatureType, dtg: str, batch):
     return binned.to_bin_and_offset(batch[dtg])
 
 
-def _z_envelope(ranges: List[ZRange]) -> Tuple[int, int]:
-    return (ranges[0].lo, ranges[-1].hi) if ranges else (0, 0)
+#: per-shard budget for resolved scan windows (bins x z-ranges); beyond it
+#: ranges gap-union down (over-cover; the fine filter restores exactness)
+MAX_SHARD_WINDOWS = 256
+
+
+def _merge_cap(los: np.ndarray, his: np.ndarray, cap: int,
+               adjacent: int = 0) -> Tuple[np.ndarray, np.ndarray]:
+    """One vectorized pass shared by range- and window-capping: sort, merge
+    overlapping (or within ``adjacent``) intervals, then keep only the
+    ``cap-1`` LARGEST gaps as separators (equivalent to repeatedly unioning
+    the smallest gap, without the quadratic loop). Over-covers; the fine
+    filter restores exactness (Z3Filter.scala keeps every window; here the
+    kernel's window count is a static shape, so a budget applies)."""
+    if len(los) == 0:
+        return np.zeros(0, np.int64), np.zeros(0, np.int64)
+    order = np.argsort(los, kind="stable")
+    los = np.asarray(los, np.int64)[order]
+    his = np.asarray(his, np.int64)[order]
+    # merge overlapping/adjacent: a new interval starts where lo exceeds
+    # the running max of prior his (+adjacency)
+    run_hi = np.maximum.accumulate(his)
+    new = np.concatenate(([True], los[1:] > run_hi[:-1] + adjacent))
+    idx = np.flatnonzero(new)
+    mlo = los[idx]
+    mhi = run_hi[np.concatenate((idx[1:] - 1, [len(los) - 1]))]
+    if len(mlo) > cap:
+        gaps = mlo[1:] - mhi[:-1]
+        keep = np.sort(np.argpartition(gaps, -(cap - 1))[-(cap - 1):]) \
+            if cap > 1 else np.zeros(0, np.int64)
+        mlo = np.concatenate((mlo[:1], mlo[keep + 1]))
+        mhi = np.concatenate((mhi[keep], mhi[-1:]))
+    return mlo, mhi
+
+
+def _merge_zranges(ranges: List[Tuple[int, int]], cap: int) -> List[Tuple[int, int]]:
+    """Tuple-list façade over :func:`_merge_cap` (adjacency 1: integer key
+    ranges touching end-to-end fuse)."""
+    if not ranges:
+        return []
+    los = np.asarray([r[0] for r in ranges], np.int64)
+    his = np.asarray([r[1] for r in ranges], np.int64)
+    mlo, mhi = _merge_cap(los, his, cap, adjacent=1)
+    return list(zip(mlo.tolist(), mhi.tolist()))
+
+
+def _per_geom_ranges(cover_fn, bounds_list) -> List[ZRange]:
+    """Cover each query geometry's bounds separately and merge — disjoint
+    bboxes get disjoint covers instead of one envelope cover (reference
+    FilterHelper.extractGeometries feeds per-geometry ranges the same way)."""
+    all_r: List[Tuple[int, int]] = []
+    for b in bounds_list:
+        for r in cover_fn(b):
+            all_r.append((int(r.lo), int(r.hi)))
+    merged = _merge_zranges(all_r, config.SCAN_RANGES_TARGET.to_int() or 2000)
+    return [ZRange(lo, hi) for lo, hi in merged]
 
 
 def _shift_of(shard_cols: Dict, col: str) -> int:
@@ -218,40 +271,113 @@ class Z3KeySpace(KeySpace):
         bins = np.unique(
             np.concatenate([self.binned.bins_between(lo, hi) for lo, hi in iv])
         )
-        if geoms.is_empty:
-            bbox = (-180.0, -90.0, 180.0, 90.0)
-        else:
-            bs = np.asarray([g.bounds() for g in geoms.values])
-            bbox = (bs[:, 0].min(), bs[:, 1].min(), bs[:, 2].max(), bs[:, 3].max())
-        # Offset window: conservative union across bins (per-bin tight windows
-        # refined at resolve_windows time for the edge bins).
         max_off = float(self.binned.max_offset_ms)
-        ranges = self.sfc.ranges(
-            (bbox[0], bbox[2]), (bbox[1], bbox[3]), (0.0, max_off),
+        if geoms.is_empty:
+            xy = [((-180.0, -90.0, 180.0, 90.0))]
+        else:
+            xy = [g.bounds() for g in geoms.values]
+        # Per-geometry covers over the full offset span (middle bins);
+        # disjoint query boxes produce disjoint range sets (Z3Filter.scala
+        # checks every window per row — here every window becomes its own
+        # scan window at resolve time).
+        ranges = _per_geom_ranges(
+            lambda b: self.sfc.ranges(
+                (b[0], b[2]), (b[1], b[3]), (0.0, max_off)
+            ),
+            xy,
         )
+        # Edge-bin time tightening (Z3IndexKeySpace.getIndexValues:133-158:
+        # per-bin offset windows): the first/last bin of each interval gets
+        # its own cover restricted to the interval's offsets in that bin.
+        edge: Dict[int, List[Tuple[int, int]]] = {}
+        for lo, hi in iv:
+            blo, olo = self.binned.to_bin_and_offset(np.asarray([lo], np.int64))
+            bhi, ohi = self.binned.to_bin_and_offset(np.asarray([hi], np.int64))
+            blo, olo = int(blo[0]), float(olo[0])
+            bhi, ohi = int(bhi[0]), float(ohi[0])
+            for b, off_lo, off_hi in (
+                ((blo, olo, max_off if blo != bhi else ohi),)
+                + (((bhi, 0.0, ohi),) if bhi != blo else ())
+            ):
+                rs = [
+                    (int(r.lo), int(r.hi))
+                    for box in xy
+                    for r in self.sfc.ranges(
+                        (box[0], box[2]), (box[1], box[3]), (off_lo, off_hi)
+                    )
+                ]
+                edge.setdefault(b, []).extend(rs)
         cov = _coverage(ranges, 63) * min(1.0, len(bins) / max(len(bins), 1))
         plan = KeyPlan(self, ranges=ranges, bins=bins.astype(np.int32), coverage=cov)
-        plan._iv = iv  # retained for per-bin offset refinement
+        plan._iv = iv
+        plan._edge = {
+            b: _merge_zranges(rs, config.SCAN_RANGES_TARGET.to_int() or 2000)
+            for b, rs in edge.items()
+        }
         return plan
 
     def resolve_windows(self, plan, shard_cols, n):
         bins_col = shard_cols["__z3_bin"]
         z_col = shard_cols["__z3"]
-        zlo, zhi = _z_envelope(plan.ranges)
         sh = _shift_of(shard_cols, "__z3")
-        zlo, zhi = zlo >> sh, zhi >> sh
         bins = plan.bins
         if len(bins) > MAX_WINDOW_BINS:
             # collapse: one window spanning [first bin, last bin]
             s = np.searchsorted(bins_col, bins[0], side="left")
             e = np.searchsorted(bins_col, bins[-1], side="right")
             return np.asarray([s], np.int64), np.asarray([e], np.int64)
+        # Per-window pushdown (Z3Filter.scala:18-62 parity): every cover
+        # range resolves to its own scan window per bin — disjoint or
+        # L-shaped query geometries admit only their own candidates, not
+        # the [zmin, zmax] envelope. Edge bins use their time-tightened
+        # range sets from plan time. The shifted+merged range sets are
+        # shard-independent: computed once per (plan, shift) and cached.
+        edge = getattr(plan, "_edge", {})
+        per_bin_cap = max(1, MAX_SHARD_WINDOWS // max(len(bins), 1))
+        cache = plan.__dict__.setdefault("_shifted_ranges", {})
+        sets = cache.get(sh)
+        if sets is None:
+            base = _merge_zranges(
+                [(r.lo >> sh, r.hi >> sh) for r in plan.ranges], per_bin_cap
+            )
+            esets = {
+                b: _merge_zranges(
+                    [(lo >> sh, hi >> sh) for lo, hi in rs], per_bin_cap
+                )
+                for b, rs in edge.items()
+            }
+            sets = cache[sh] = (base, esets)
+        base, esets = sets
         from geomesa_tpu import native
 
-        starts, ends = native.bin_windows(bins_col, z_col, bins, zlo, zhi)
-        if not len(starts):
+        starts: List[int] = []
+        ends: List[int] = []
+        plain = np.asarray(
+            [b for b in bins.tolist() if b not in esets], np.int32
+        )
+        for lo, hi in base:
+            ws, we = native.bin_windows(bins_col, z_col, plain, lo, hi)
+            starts.extend(ws.tolist())
+            ends.extend(we.tolist())
+        for b, rs in esets.items():
+            s = int(np.searchsorted(bins_col, b, side="left"))
+            e = int(np.searchsorted(bins_col, b, side="right"))
+            if e <= s or not rs:
+                continue
+            seg = z_col[s:e]
+            los = np.asarray([r[0] for r in rs], seg.dtype)
+            his = np.asarray([r[1] for r in rs], seg.dtype)
+            ws = s + np.searchsorted(seg, los, side="left")
+            we = s + np.searchsorted(seg, his, side="right")
+            keep = we > ws
+            starts.extend(ws[keep].tolist())
+            ends.extend(we[keep].tolist())
+        if not starts:
             return np.zeros(1, np.int64), np.zeros(1, np.int64)
-        return starts, ends
+        return _cap_windows(
+            np.asarray(starts, np.int64), np.asarray(ends, np.int64),
+            MAX_SHARD_WINDOWS,
+        )
 
 
 class Z2KeySpace(KeySpace):
@@ -288,18 +414,33 @@ class Z2KeySpace(KeySpace):
             return KeyPlan(self, disjoint=True)
         if geoms.is_empty:
             return KeyPlan(self, full_scan=True)
-        bs = np.asarray([g.bounds() for g in geoms.values])
-        bbox = (bs[:, 0].min(), bs[:, 1].min(), bs[:, 2].max(), bs[:, 3].max())
-        ranges = self.sfc.ranges(*bbox)
+        ranges = _per_geom_ranges(
+            lambda b: self.sfc.ranges(*b),
+            [g.bounds() for g in geoms.values],
+        )
         return KeyPlan(self, ranges=ranges, coverage=_coverage(ranges, 62))
 
     def resolve_windows(self, plan, shard_cols, n):
+        # per-range windows (Z2Filter parity): disjoint query boxes scan
+        # only their own covers, not the [zmin, zmax] envelope
         z_col = shard_cols["__z2"]
-        zlo, zhi = _z_envelope(plan.ranges)
         sh = _shift_of(shard_cols, "__z2")
-        s = np.searchsorted(z_col, np.uint64(zlo >> sh), side="left")
-        e = np.searchsorted(z_col, np.uint64(zhi >> sh), side="right")
-        return np.asarray([s], np.int64), np.asarray([e], np.int64)
+        rs = _merge_zranges(
+            [(r.lo >> sh, r.hi >> sh) for r in plan.ranges], MAX_SHARD_WINDOWS
+        )
+        if not rs:
+            return np.zeros(1, np.int64), np.zeros(1, np.int64)
+        los = np.asarray([r[0] for r in rs], z_col.dtype)
+        his = np.asarray([r[1] for r in rs], z_col.dtype)
+        ws = np.searchsorted(z_col, los, side="left")
+        we = np.searchsorted(z_col, his, side="right")
+        keep = we > ws
+        if not keep.any():
+            return np.zeros(1, np.int64), np.zeros(1, np.int64)
+        return _cap_windows(
+            ws[keep].astype(np.int64), we[keep].astype(np.int64),
+            MAX_SHARD_WINDOWS,
+        )
 
 
 class XZ2KeySpace(KeySpace):
@@ -808,24 +949,10 @@ class AttributeKeySpace(KeySpace):
 
 
 def _cap_windows(starts: np.ndarray, ends: np.ndarray, cap: int):
-    """Merge overlapping windows; if more than ``cap`` remain, union gaps to
-    fit (over-covering; fine filter restores exactness)."""
-    order = np.argsort(starts)
-    starts, ends = starts[order], ends[order]
-    ms, me = [int(starts[0])], [int(ends[0])]
-    for s, e in zip(starts[1:].tolist(), ends[1:].tolist()):
-        if s <= me[-1]:
-            me[-1] = max(me[-1], e)
-        else:
-            ms.append(s)
-            me.append(e)
-    while len(ms) > cap:
-        # merge the pair with the smallest gap
-        gaps = [ms[i + 1] - me[i] for i in range(len(ms) - 1)]
-        i = int(np.argmin(gaps))
-        me[i] = me[i + 1]
-        del ms[i + 1], me[i + 1]
-    return np.asarray(ms, np.int64), np.asarray(me, np.int64)
+    """Merge overlapping row windows; if more than ``cap`` remain, union the
+    smallest gaps to fit (over-covering; fine filter restores exactness).
+    Row windows are half-open, so only true overlap merges (adjacency 0)."""
+    return _merge_cap(starts, ends, cap, adjacent=0)
 
 
 def keyspaces_for_schema(ft: FeatureType) -> List[KeySpace]:

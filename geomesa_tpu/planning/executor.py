@@ -159,6 +159,21 @@ class Executor:
         bits = np.unpackbits(packed, axis=1, bitorder="little")
         return bits[:, :L].astype(bool)
 
+    def _coarse_or_none(self, plan: QueryPlan, setup) -> Optional[np.ndarray]:
+        """Device coarse mask when the plan is eligible, else None (host
+        computes the full mask). Falls back loudly, honoring STRICT_DEVICE."""
+        if not setup.get("coarse_device"):
+            return None
+        try:
+            return self._device_coarse_mask(plan, setup)
+        except Exception as e:
+            if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
+                raise
+            logging.getLogger(__name__).warning(
+                "device coarse scan failed, computing mask on host: %r", e
+            )
+            return None
+
     def _host_mask(self, plan: QueryPlan, setup,
                    coarse: Optional[np.ndarray] = None) -> np.ndarray:
         """[S, L] mask on the host (numpy). ``coarse`` short-circuits the
@@ -426,17 +441,7 @@ class Executor:
                 logging.getLogger(__name__).warning(
                     "device scan failed, falling back to host: %r", e
                 )
-        coarse = None
-        if setup.get("coarse_device"):
-            try:
-                coarse = self._device_coarse_mask(plan, setup)
-            except Exception as e:
-                if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
-                    raise
-                logging.getLogger(__name__).warning(
-                    "device coarse scan failed, computing mask on host: %r", e
-                )
-        mask = self._host_mask(plan, setup, coarse)
+        mask = self._host_mask(plan, setup, self._coarse_or_none(plan, setup))
         table = setup["table"]
         cols = {}
         for c in set(list(setup["needed"]) + list(agg_cols)):
@@ -482,18 +487,9 @@ class Executor:
                     "device scan failed, falling back to host: %r", e
                 )
         if mask is None:
-            coarse = None
-            if setup.get("coarse_device"):
-                try:
-                    coarse = self._device_coarse_mask(plan, setup)
-                except Exception as e:
-                    if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
-                        raise
-                    logging.getLogger(__name__).warning(
-                        "device coarse scan failed, computing mask on host: %r",
-                        e,
-                    )
-            mask = self._host_mask(plan, setup, coarse)
+            mask = self._host_mask(
+                plan, setup, self._coarse_or_none(plan, setup)
+            )
         return setup["table"].host_gather(mask.reshape(-1))
 
     def features_iter(self, plan: QueryPlan, batch_rows: Optional[int] = None):
