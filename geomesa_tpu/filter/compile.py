@@ -113,14 +113,30 @@ def _pip_fn(g: geo.Geometry, xcol: str, ycol: str):
     def pip(cols, xp):
         x = cols[xcol]
         y = cols[ycol]
-        if xp is not np and pallas_ok and pk.use_pallas():
+        if xp is not np and pallas_ok:
             # TPU: edge table pinned in VMEM, point blocks streamed through
-            # the VPU — the [block, E] intermediate never touches HBM
-            out = None
-            for _, packed in tables:
-                inside = pk.pip_mask(x, y, packed)
-                out = inside if out is None else (out | inside)
-            return out
+            # the VPU — the [block, E] intermediate never touches HBM.
+            # Under a NamedSharding'd mesh the kernel runs per device via
+            # an inner shard_map over the local block.
+            mesh = pk.current_mesh()
+            run = None
+            if mesh is None and pk.use_pallas():
+                run = lambda packed: pk.pip_mask(  # noqa: E731
+                    x, y, packed, interpret=pk.interpret_mode()
+                )
+            elif (
+                mesh is not None and x.ndim == 2
+                and pk.use_pallas_sharded(mesh, x.shape[0])
+            ):
+                run = lambda packed: pk.pip_mask_sharded(  # noqa: E731
+                    x, y, packed, mesh, interpret=pk.interpret_mode()
+                )
+            if run is not None:
+                out = None
+                for _, packed in tables:
+                    inside = run(packed)
+                    out = inside if out is None else (out | inside)
+                return out
         # backend-generic broadcast path: trailing-axis broadcast handles
         # 1-D host shards and [S, L] device layouts alike
         out = None

@@ -32,32 +32,62 @@ _tls = threading.local()
 
 
 @contextlib.contextmanager
-def sharded_execution(on: bool):
+def sharded_execution(mesh_or_flag):
     """Mark that subsequent kernel traces run under a sharded mesh.
 
     pallas_call has no GSPMD partitioning rule, so under NamedSharding'd
-    inputs it would replicate (or fail -> permanent host fallback); the
-    executor flips this flag so dispatch sticks to the XLA broadcast path."""
+    inputs a bare call would replicate (or fail -> permanent host fallback).
+    When the executor passes its actual ``Mesh``, polygon fine-filters keep
+    the hand kernel by wrapping it in an inner ``shard_map`` (per-device
+    pallas over the local block); a bare truthy flag (mesh unknown) keeps
+    the old behavior of falling back to the XLA broadcast path."""
     prev = getattr(_tls, "sharded", False)
-    _tls.sharded = on
+    _tls.sharded = mesh_or_flag
     try:
         yield
     finally:
         _tls.sharded = prev
 
 
-def use_pallas() -> bool:
-    """Pallas dispatch gate: real TPU backend, unsharded, not env-disabled."""
+def current_mesh():
+    """The active mesh under :func:`sharded_execution`, if one was given."""
+    m = getattr(_tls, "sharded", False)
+    return m if m is not False and m is not True and m is not None else None
+
+
+def interpret_mode() -> bool:
+    """Force interpret-mode pallas on any backend (CPU-mesh tests)."""
+    return os.environ.get("GEOMESA_PALLAS_INTERPRET") == "1"
+
+
+def _backend_ok() -> bool:
     if os.environ.get("GEOMESA_PALLAS", "1") == "0":
         return False
-    if getattr(_tls, "sharded", False):
-        return False
+    if interpret_mode():
+        return True
     try:
         import jax
 
         return jax.default_backend() == "tpu"
     except Exception:
         return False
+
+
+def use_pallas() -> bool:
+    """Plain (unsharded) pallas dispatch gate."""
+    if getattr(_tls, "sharded", False):
+        return False
+    return _backend_ok()
+
+
+def use_pallas_sharded(mesh, lead_dim: int) -> bool:
+    """Sharded dispatch gate: backend ok, mesh has a 'shard' axis that
+    evenly divides the leading (shard) dimension — shard_map requires
+    exact divisibility, unlike GSPMD."""
+    if mesh is None or not _backend_ok():
+        return False
+    size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("shard")
+    return bool(size) and lead_dim % size == 0
 
 
 def polygon_edge_tables(poly):
@@ -162,6 +192,35 @@ def pip_mask(x, y, edges: np.ndarray, interpret: bool = False):
         yf = jnp.pad(yf, (0, pad))
     out = _pip_call(xf, yf, jnp.asarray(edges), interpret=interpret)
     return out[:n, 0].astype(bool).reshape(shape)
+
+
+def pip_mask_sharded(x, y, edges: np.ndarray, mesh, interpret: bool = False):
+    """:func:`pip_mask` under a NamedSharding'd [S, L] layout: an inner
+    ``shard_map`` over the mesh's 'shard' axis runs the pallas kernel
+    per-device on the LOCAL shard block (edge table replicated), so polygon
+    fine-filtering keeps the hand kernel at pod scale instead of dropping
+    to the [N, E] broadcast path. Axes other than 'shard' (e.g. the
+    binspace 'bin' axis) see replicated inputs and outputs."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    spec = P("shard", None)
+
+    def local(xl, yl, el):
+        return pip_mask(xl, yl, el, interpret=interpret)
+
+    try:
+        sm = jax.shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, P(None, None)),
+            out_specs=spec, check_vma=False,
+        )
+    except TypeError:  # older jax spells it check_rep
+        sm = jax.shard_map(
+            local, mesh=mesh, in_specs=(spec, spec, P(None, None)),
+            out_specs=spec, check_rep=False,
+        )
+    return sm(x, y, jnp.asarray(edges))
 
 
 def edges_fit(n_edges: int) -> bool:

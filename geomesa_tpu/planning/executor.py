@@ -326,9 +326,10 @@ class Executor:
         d_starts, d_ends, d_counts = win
         from geomesa_tpu.kernels import pallas_kernels as pk
 
-        # trace-time flag: pallas dispatch must not fire under a sharded mesh
-        # (pallas_call has no GSPMD partitioning rule)
-        with pk.sharded_execution(self.mesh is not None):
+        # trace-time context: under a sharded mesh, polygon pallas kernels
+        # re-dispatch through an inner shard_map over the mesh (bare
+        # pallas_call has no GSPMD partitioning rule)
+        with pk.sharded_execution(self.mesh):
             return go(dev_cols, d_starts, d_ends, d_counts)
 
     def _sharding(self):
