@@ -18,6 +18,12 @@ import time
 import numpy as np
 
 
+def _timed(fn) -> float:
+    t0 = time.time()
+    fn()
+    return time.time() - t0
+
+
 def main():
     n = int(os.environ.get("GEOMESA_BENCH_N", 20_000_000))
     iters = int(os.environ.get("GEOMESA_BENCH_ITERS", 10))
@@ -119,6 +125,15 @@ def main():
     t2 = min(chain(k2) for _ in range(iters))
     dev_s = max((t2 - t1) / (k2 - k1), 1e-9)
     grid = np.asarray(ex.density(plan, bbox, W, H, as_numpy=False))
+
+    # p50 END-TO-END density latency (BASELINE.md's second headline):
+    # the public API path — plan + window resolution + device scan + host
+    # grid transfer — cold-cache planning each call
+    e2e = sorted(
+        _timed(lambda: ds.density("gdelt", ecql, bbox=bbox, width=W, height=H))
+        for _ in range(5)
+    )
+    p50_e2e_ms = e2e[len(e2e) // 2] * 1e3
     matched = float(grid.sum())
 
     # CPU baseline: vectorized numpy over the same raw arrays (filter + 2D hist)
@@ -146,7 +161,8 @@ def main():
     speedup = cpu_s / dev_s
     sys.stderr.write(
         f"n={n} gen={gen_s:.1f}s ingest={ingest_s:.1f}s matched={matched:.0f} "
-        f"device={dev_s*1e3:.1f}ms cpu={cpu_s*1e3:.1f}ms speedup={speedup:.1f}x\n"
+        f"device={dev_s*1e3:.1f}ms cpu={cpu_s*1e3:.1f}ms speedup={speedup:.1f}x "
+        f"p50_e2e_density={p50_e2e_ms:.1f}ms\n"
     )
     print(json.dumps({
         "metric": "bbox_time_density_scan_throughput",

@@ -612,30 +612,124 @@ class GeoDataset:
 
     def knn(self, name: str, x: float, y: float, k: int = 10,
             query: "str | Query" = "INCLUDE") -> FeatureCollection:
-        """K nearest neighbors (KNearestNeighborSearchProcess analog)."""
-        st, q, plan = self._plan(name, query)
-        ex = self._executor(st)
-        if hasattr(ex, "knn_features"):  # partitioned: per-partition top-k
-            batch = ex.knn_features(plan, x, y, k)
-        else:
-            idx, dists = ex.knn(plan, x, y, k)
-            table = st.tables[plan.index_name]
-            L = table.shard_len
-            mask = np.zeros(table.n_shards * L, dtype=bool)
-            mask[idx] = True
-            batch = table.host_gather(mask)
-        # order by distance, truncate to k (the partition merge may carry
-        # up to k candidates per partition)
-        if batch.n:
-            xs = batch.columns[st.ft.geom_field + "__x"]
-            ys = batch.columns[st.ft.geom_field + "__y"]
-            from geomesa_tpu.utils.geometry import haversine_m
+        """K nearest neighbors via iterative expanding-radius search
+        (KNearestNeighborSearchProcess.scala parity): start from a radius
+        sized by the store's average point density, constrain the plan with
+        that bbox so the z-index windows prune the scan, and double until
+        the k-th candidate's exact distance fits inside the searched bbox's
+        inscribed circle — an INCLUDE kNN no longer scans the whole table."""
+        import math
 
-            d = haversine_m(xs, ys, x, y)
-            order = np.argsort(d)[:k]
-            batch = ColumnBatch(
-                {kk: v[order] for kk, v in batch.columns.items()}, len(order)
+        from geomesa_tpu.utils.geometry import EARTH_RADIUS_M, haversine_m
+
+        st = self._store(name)
+        st.flush()
+        q = Query(ecql=query) if isinstance(query, str) else query
+        ex = self._executor(st)
+        empty = FeatureCollection(st.ft, ColumnBatch({}, 0), st.dicts)
+        if st.count == 0 or k <= 0:
+            return empty
+        geom = st.ft.geom_field
+        base = parse_ecql(q.ecql)
+        bounds = self.bounds(name) or (-180.0, -90.0, 180.0, 90.0)
+        area = max((bounds[2] - bounds[0]) * (bounds[3] - bounds[1]), 1e-9)
+        full_span = max(bounds[2] - bounds[0], bounds[3] - bounds[1], 1e-6)
+        # initial radius: expect ~4k points of average density inside
+        r = max(
+            math.sqrt(4.0 * k * area / (math.pi * max(st.count, 1))), 1e-4
+        )
+        deg_m = math.pi / 180.0 * EARTH_RADIUS_M
+        planner = QueryPlanner(st)
+        auths = self._effective_auths(q)
+        from geomesa_tpu.filter.compile import compile_filter
+
+        base_compiled = compile_filter(base, st.ft, st.dicts)
+        batch, order = None, None
+        prev_n = -1
+        for attempt in range(16):
+            # the lon half-width uses the band-EDGE cosine (smallest in the
+            # band) so every point within r*deg_m meters falls inside the
+            # box; pole-adjacent or extreme-latitude searches skip the
+            # restriction (the inscribed-circle argument breaks there), and
+            # the last attempt is always unrestricted — the search can
+            # never silently return a truncated result
+            pole = (y + r >= 89.99) or (y - r <= -89.99)
+            cos_edge = math.cos(math.radians(min(abs(y) + r, 89.99)))
+            restricted = (
+                r < full_span and not pole and cos_edge >= 0.05
+                and attempt < 15
             )
+            if restricted:
+                half_lon = r / cos_edge
+                lat_lo, lat_hi = max(y - r, -90.0), min(y + r, 90.0)
+                lon_lo, lon_hi = x - half_lon, x + half_lon
+                if lon_hi - lon_lo >= 360.0:
+                    boxes = [(-180.0, lat_lo, 180.0, lat_hi)]
+                elif lon_lo < -180.0:  # antimeridian wrap (west)
+                    boxes = [(-180.0, lat_lo, lon_hi, lat_hi),
+                             (lon_lo + 360.0, lat_lo, 180.0, lat_hi)]
+                elif lon_hi > 180.0:  # antimeridian wrap (east)
+                    boxes = [(lon_lo, lat_lo, 180.0, lat_hi),
+                             (-180.0, lat_lo, lon_hi - 360.0, lat_hi)]
+                else:
+                    boxes = [(lon_lo, lat_lo, lon_hi, lat_hi)]
+                bb = tuple(ir.BBox(geom, *b) for b in boxes)
+                f = ir.And((base, bb[0] if len(bb) == 1 else ir.Or(bb)))
+            else:
+                boxes = None
+                f = base
+            plan = planner.plan(f, q.hints())
+            if restricted:
+                # the restriction prunes via the plan's WINDOWS and via
+                # traced box scalars inside the kNN aggregation — the
+                # compiled predicate stays location-free, so one jitted
+                # kernel serves every location and radius (a baked-in box
+                # with a location-blind cache token returned stale-box
+                # results — r4 review)
+                plan.compiled = base_compiled
+            plan.__dict__["cache_token"] = (
+                "knn", q.ecql, None if auths is None else tuple(auths),
+            )
+            plan.__dict__["window_token"] = (
+                plan.__dict__["cache_token"],
+                round(x, 9), round(y, 9), restricted and round(r, 9),
+            )
+            self._apply_visibility(st, plan, auths)
+            if hasattr(ex, "knn_features"):  # partitioned: per-partition top-k
+                batch = ex.knn_features(plan, x, y, k, boxes=boxes)
+            else:
+                idx, _ = ex.knn(plan, x, y, k, boxes=boxes)
+                table = st.tables[plan.index_name]
+                mask = np.zeros(table.n_shards * table.shard_len, dtype=bool)
+                mask[idx] = True
+                batch = table.host_gather(mask)
+            order = np.zeros(0, np.int64)
+            kth_m = math.inf
+            if batch.n:
+                d = haversine_m(
+                    batch.columns[geom + "__x"], batch.columns[geom + "__y"],
+                    x, y,
+                )
+                order = np.argsort(d)[:k]
+                kth_m = float(d[order[-1]])
+            if not restricted:
+                break
+            # exact iff the k-th neighbor lies inside the searched bbox's
+            # inscribed circle (domain-clamped edges hold no points beyond
+            # the lon/lat domain, so clamping never loses candidates)
+            if len(order) >= k and kth_m <= r * deg_m:
+                break
+            if batch.n == prev_n and batch.n < k:
+                # a doubling added no candidates and we're still short of
+                # k: the base filter is the limiting factor, not the box —
+                # jump straight to the unrestricted pass
+                r = full_span
+            else:
+                r *= 2.0
+            prev_n = batch.n
+        batch = ColumnBatch(
+            {kk: v[order] for kk, v in batch.columns.items()}, len(order)
+        )
         return FeatureCollection(st.ft, batch, st.dicts)
 
     def proximity(self, name: str, wkt_or_geom, distance_m: float,

@@ -10,19 +10,25 @@ import numpy as np
 from geomesa_tpu.utils.geometry import EARTH_RADIUS_M
 
 
-def knn_indices(x, y, mask, qx: float, qy: float, k: int, xp=None):
-    """Indices (into the flattened [S*L] layout) and distances (meters) of the
-    k nearest masked points to (qx, qy). Backend-generic."""
+def knn_indices(x, y, mask, qx, qy, k: int, xp=None):
+    """Indices (into the flattened [S*L] layout) and distances (meters) of
+    the k nearest masked points to (qx, qy). Backend-generic; ``qx``/``qy``
+    may be traced scalars — one compiled kernel serves every query point.
+
+    Device path: k iterations of argmin + mask-out. Measured on v5e this
+    is ~20x faster steady-state AND ~15x faster to compile than
+    ``lax.top_k`` at multi-million-row inputs (top_k: 20s compile,
+    1.4s/run at 5M; argmin iteration: 1.3s, 65ms)."""
     if xp is None:
         xp = np
     fx = x.reshape(-1)
     fy = y.reshape(-1)
     fm = mask.reshape(-1)
     rx1, ry1 = xp.radians(fx), xp.radians(fy)
-    rx2, ry2 = np.radians(qx), np.radians(qy)
+    rx2, ry2 = xp.radians(qx), xp.radians(qy)
     a = (
         xp.sin((ry2 - ry1) / 2) ** 2
-        + xp.cos(ry1) * np.cos(ry2) * xp.sin((rx2 - rx1) / 2) ** 2
+        + xp.cos(ry1) * xp.cos(ry2) * xp.sin((rx2 - rx1) / 2) ** 2
     )
     d = 2 * EARTH_RADIUS_M * xp.arcsin(xp.sqrt(xp.clip(a, 0, 1)))
     d = xp.where(fm, d, xp.inf)
@@ -30,6 +36,18 @@ def knn_indices(x, y, mask, qx: float, qy: float, k: int, xp=None):
         idx = np.argsort(d)[:k]
         return idx, d[idx]
     import jax.lax
+    import jax.numpy as jnp
 
-    neg, idx = jax.lax.top_k(-d, k)
-    return idx, -neg
+    if k > 32:
+        # the argmin iteration scales linearly in k (runtime AND unrolled
+        # HLO size); big-k requests are better served by the single-pass
+        # top_k despite its heavier compile
+        neg, idx = jax.lax.top_k(-d, k)
+        return idx, -neg
+    idxs, vals = [], []
+    for _ in range(k):
+        i = jnp.argmin(d)
+        idxs.append(i)
+        vals.append(d[i])
+        d = d.at[i].set(jnp.inf)
+    return jnp.stack(idxs), jnp.stack(vals)

@@ -210,16 +210,14 @@ def pip_mask_sharded(x, y, edges: np.ndarray, mesh, interpret: bool = False):
     def local(xl, yl, el):
         return pip_mask(xl, yl, el, interpret=interpret)
 
+    shard_map = getattr(jax, "shard_map", None)
+    if shard_map is None:  # pre-0.4.35 jax: experimental module
+        from jax.experimental.shard_map import shard_map
+    kw = dict(mesh=mesh, in_specs=(spec, spec, P(None, None)), out_specs=spec)
     try:
-        sm = jax.shard_map(
-            local, mesh=mesh, in_specs=(spec, spec, P(None, None)),
-            out_specs=spec, check_vma=False,
-        )
+        sm = shard_map(local, check_vma=False, **kw)
     except TypeError:  # older jax spells it check_rep
-        sm = jax.shard_map(
-            local, mesh=mesh, in_specs=(spec, spec, P(None, None)),
-            out_specs=spec, check_rep=False,
-        )
+        sm = shard_map(local, check_rep=False, **kw)
     return sm(x, y, jnp.asarray(edges))
 
 

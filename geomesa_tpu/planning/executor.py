@@ -241,8 +241,11 @@ class Executor:
         return mask
 
     def _device_mask_and_agg(self, plan: QueryPlan, setup, agg_fn, agg_cols=(),
-                             cache_key=None, apply_sampling=True):
-        """Run mask + aggregation in one jit. ``agg_fn(cols, mask, xp)``.
+                             cache_key=None, apply_sampling=True, extra=()):
+        """Run mask + aggregation in one jit. ``agg_fn(cols, mask, xp,
+        *extra)`` — ``extra`` values are TRACED jit arguments (scalar query
+        parameters like a kNN origin), so one compiled kernel serves every
+        value instead of baking them in as constants.
 
         ``cache_key`` caches the jitted kernel on the plan so re-running the
         same plan (benchmarks, pagination) skips retracing."""
@@ -287,12 +290,12 @@ class Executor:
         if go is None:
 
             @jax.jit
-            def go(cols, starts, ends, counts):
+            def go(cols, starts, ends, counts, extra):
                 m = kmasks.window_mask(starts, ends, counts, L)
                 m = m & compiled(cols, jnp)
                 if sampling:
                     m = kmasks.sampling_mask(m, sampling, jnp)
-                return agg_fn(cols, m, jnp)
+                return agg_fn(cols, m, jnp, *extra)
 
             if fn_cache is not None:
                 if len(fn_cache) >= 64:  # bound compiled-kernel growth
@@ -306,12 +309,15 @@ class Executor:
         # in a store-level cache another plan could hit.
         win = None
         if fn_key is not None:
+            # window_token lets plans that share a kernel but differ in
+            # their scan windows (knn radius expansion) key window arrays
+            # separately without forcing a retrace
+            wtoken = plan.__dict__.get("window_token", token)
             if token is not None:
                 wcache = self.store.__dict__.setdefault("_win_cache", {})
-                wkey = (fn_key, self.store.uid, self.store.version)
             else:
                 wcache = plan.__dict__.setdefault("_win_cache", {})
-                wkey = (fn_key, self.store.uid, self.store.version)
+            wkey = (fn_key, wtoken, self.store.uid, self.store.version)
             win = wcache.get(wkey)
         if win is None:
             win = (
@@ -330,7 +336,7 @@ class Executor:
         # re-dispatch through an inner shard_map over the mesh (bare
         # pallas_call has no GSPMD partitioning rule)
         with pk.sharded_execution(self.mesh):
-            return go(dev_cols, d_starts, d_ends, d_counts)
+            return go(dev_cols, d_starts, d_ends, d_counts, tuple(extra))
 
     def _sharding(self):
         if self.mesh is None:
@@ -408,7 +414,7 @@ class Executor:
         )
 
     def _run(self, plan: QueryPlan, agg_fn_dev, agg_fn_host, agg_cols=(),
-             cache_key=None, additive=False):
+             cache_key=None, additive=False, extra=()):
         check_deadline()
         setup = self._scan_setup(plan, agg_cols)
         if setup is None:
@@ -431,7 +437,7 @@ class Executor:
                     )
             try:
                 return self._device_mask_and_agg(
-                    plan, setup, agg_fn_dev, agg_cols, cache_key
+                    plan, setup, agg_fn_dev, agg_cols, cache_key, extra=extra
                 )
             except Exception as e:
                 if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
@@ -454,7 +460,7 @@ class Executor:
                     sl = table.shard_slice(s)
                     stacked[s, : sl.stop - sl.start] = full[sl]
                 cols[c] = stacked
-        return agg_fn_host(cols, mask, np)
+        return agg_fn_host(cols, mask, np, *extra)
 
     # -- public operations --------------------------------------------------
     def count(self, plan: QueryPlan) -> int:
@@ -573,14 +579,37 @@ class Executor:
             kstats.decode_enum_keys(stat, self.store.dicts)
         return stat
 
-    def knn(self, plan: QueryPlan, qx: float, qy: float, k: int):
+    def knn(self, plan: QueryPlan, qx: float, qy: float, k: int, boxes=None):
+        """k nearest to (qx, qy) among plan matches. ``boxes`` (optional):
+        up to two (x0, y0, x1, y1) restriction boxes applied INSIDE the
+        aggregation as traced scalars — the expanding-radius search passes
+        its search box here (and via the plan's windows) instead of baking
+        it into the compiled predicate, so one kernel serves every location
+        and radius."""
         geom = self.store.ft.geom_field
         xc, yc = geom + "__x", geom + "__y"
 
-        def agg(cols, m, xp):
-            return kknn.knn_indices(cols[xc], cols[yc], m, qx, qy, k, xp)
+        def agg(cols, m, xp, qx_, qy_, *bb):
+            if bb:
+                x, y = cols[xc], cols[yc]
+                inb = None
+                for i in range(0, len(bb), 4):
+                    x0, y0, x1, y1 = bb[i:i + 4]
+                    mi = (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+                    inb = mi if inb is None else (inb | mi)
+                m = m & inb
+            return kknn.knn_indices(cols[xc], cols[yc], m, qx_, qy_, k, xp)
 
-        out = self._run(plan, agg, agg, [xc, yc])
+        extra = [np.float32(qx), np.float32(qy)]
+        nb = 0
+        if boxes:
+            for b in boxes:
+                extra.extend(np.float32(v) for v in b)
+            nb = len(boxes)
+        out = self._run(
+            plan, agg, agg, [xc, yc], cache_key=("knn", int(k), nb),
+            extra=tuple(extra),
+        )
         if out is None:
             return np.zeros(0, np.int64), np.zeros(0)
         idx, d = np.asarray(out[0]), np.asarray(out[1])

@@ -152,12 +152,12 @@ class PartitionedExecutor:
         return ColumnBatch.concat(batches) if batches else ColumnBatch({}, 0)
 
     def knn_features(self, plan: QueryPlan, x: float, y: float,
-                     k: int) -> ColumnBatch:
+                     k: int, boxes=None) -> ColumnBatch:
         """Per-partition top-k gathered and merged; the union of partition
         top-ks contains the global top-k (caller orders and truncates)."""
         parts = []
         for _, ex in self._each(plan):
-            idx, _ = ex.knn(plan, x, y, k)
+            idx, _ = ex.knn(plan, x, y, k, boxes=boxes)
             if len(idx) == 0:
                 continue
             table = ex.store.tables[plan.index_name]

@@ -384,3 +384,111 @@ def test_disjoint_bbox_per_window_pushdown(ds_and_data):
     # sanity: the envelope would have admitted far more
     env = (x >= -118) & (x <= -72) & (y >= 26) & (y <= 49) & in_t
     assert ev.scanned < int(env.sum())
+
+
+def test_knn_expanding_radius_prunes_scan(ds_and_data):
+    """KNearestNeighborSearchProcess parity (r4): an INCLUDE kNN restricts
+    the scan with an expanding bbox — the executed plan's window
+    candidates stay far below the table size."""
+    from geomesa_tpu.planning.executor import Executor
+
+    ds, data = ds_and_data
+    seen = []
+    real = Executor.knn
+
+    def spy(self, plan, *a, **kw):
+        out = real(self, plan, *a, **kw)
+        seen.append(plan)
+        return out
+
+    Executor.knn = spy
+    try:
+        fc = ds.knn("gdelt", -95.0, 38.0, k=5)
+    finally:
+        Executor.knn = real
+    assert len(fc) == 5
+    # exactness vs brute force
+    from geomesa_tpu.utils.geometry import haversine_m
+
+    d = haversine_m(data["geom__x"], data["geom__y"], -95.0, 38.0)
+    want = np.sort(d)[:5]
+    got = np.sort(haversine_m(
+        fc.columns["geom__x"], fc.columns["geom__y"], -95.0, 38.0
+    ))
+    np.testing.assert_allclose(got, want, rtol=1e-9)
+    # the final executed plan scanned a small fraction of the table
+    assert seen, "knn never reached the executor"
+    assert seen[-1].__dict__.get("scanned_rows", N) < N // 4
+
+
+def test_knn_antimeridian_and_pole():
+    """r4 review: expanding-radius kNN must wrap the antimeridian and stay
+    exact at extreme latitudes (falls back to unrestricted there)."""
+    from geomesa_tpu.utils.geometry import haversine_m
+
+    rng = np.random.default_rng(44)
+    n = 2_000
+    ds = GeoDataset(n_shards=4)
+    ds.create_schema("w", "dtg:Date,*geom:Point")
+    # clusters on both sides of the dateline plus a polar cap
+    x = np.concatenate([
+        rng.uniform(179.0, 180.0, n // 2),
+        rng.uniform(-180.0, -179.0, n // 4),
+        rng.uniform(-180.0, 180.0, n // 4),
+    ])
+    y = np.concatenate([
+        rng.uniform(-5, 5, n // 2),
+        rng.uniform(-5, 5, n // 4),
+        rng.uniform(85.0, 90.0, n // 4),
+    ])
+    ds.insert("w", {
+        "dtg": np.full(n, parse_iso_ms("2022-06-01")).astype("datetime64[ms]"),
+        "geom__x": x, "geom__y": y,
+    }, fids=np.arange(n).astype(str))
+    ds.flush()
+    for qx, qy in ((-179.95, 0.0), (179.95, 1.0), (10.0, 89.5)):
+        fc = ds.knn("w", qx, qy, k=8)
+        d_all = np.sort(haversine_m(x, y, qx, qy))[:8]
+        got = np.sort(haversine_m(
+            fc.columns["geom__x"], fc.columns["geom__y"], qx, qy
+        ))
+        np.testing.assert_allclose(got, d_all, rtol=1e-9), (qx, qy)
+
+
+def test_knn_selective_filter_fewer_than_k():
+    """A base filter matching fewer than k rows must return ALL matches
+    (final unrestricted pass), not a truncated bbox subset."""
+    rng = np.random.default_rng(45)
+    n = 5_000
+    ds = GeoDataset(n_shards=4)
+    ds.create_schema("s", "name:String,dtg:Date,*geom:Point")
+    names = np.array(["rare" if i < 3 else f"c{i % 7}" for i in range(n)])
+    ds.insert("s", {
+        "name": names.tolist(),
+        "dtg": np.full(n, parse_iso_ms("2022-06-01")).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-170, 170, n),
+        "geom__y": rng.uniform(-80, 80, n),
+    }, fids=np.arange(n).astype(str))
+    ds.flush()
+    fc = ds.knn("s", 0.0, 0.0, k=10, query="name = 'rare'")
+    assert len(fc) == 3
+
+
+def test_knn_many_locations_no_stale_kernel(ds_and_data):
+    """r4 review (confirmed bug): sequential kNN calls at different
+    locations must never reuse a kernel with another location's search box
+    baked in — every call stays exact vs brute force."""
+    from geomesa_tpu.utils.geometry import haversine_m
+
+    ds, data = ds_and_data
+    x, y = data["geom__x"], data["geom__y"]
+    pts = [(-95.0, 38.0), (-110.0, 45.0), (-80.0, 30.0), (-95.0, 38.0),
+           (-118.0, 48.0), (-72.0, 26.0), (-100.0, 40.0), (-90.0, 35.0)]
+    for qx, qy in pts:
+        fc = ds.knn("gdelt", qx, qy, k=6)
+        want = np.sort(haversine_m(x, y, qx, qy))[:6]
+        got = np.sort(haversine_m(
+            fc.columns["geom__x"], fc.columns["geom__y"], qx, qy
+        ))
+        np.testing.assert_allclose(got, want, rtol=1e-9,
+                                   err_msg=f"stale kernel at {(qx, qy)}")
