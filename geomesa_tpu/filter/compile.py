@@ -48,6 +48,19 @@ class CompiledFilter:
     ecql: Optional[str] = None
     refine: Optional[Callable] = None
     refine_columns: Optional[List[str]] = None
+    #: device-evaluable mask of rows whose membership is UNCERTAIN at f32
+    #: precision (f64 column values colliding with an f32-rounded query
+    #: bound). None = the f32 evaluation is provably exact. The executor
+    #: counts band rows once per (plan, store version): zero (the usual
+    #: case) certifies the device result; nonzero reroutes to the
+    #: device-coarse + exact-f64-host-refine path. This is how "f64 never
+    #: reaches the device" coexists with reference-exact boundary
+    #: semantics.
+    band: Optional[Callable] = None
+    #: True when ``refine`` exists ONLY as the band fallback: with a clean
+    #: band certificate the device mask is already exact and refinement is
+    #: skipped entirely
+    refine_only_if_band: bool = False
 
     def __call__(self, cols, xp=np):
         return self.fn(cols, xp)
@@ -90,14 +103,26 @@ def _geom_cols(ft: FeatureType, prop: str) -> Dict[str, str]:
     }
 
 
-def _pip_fn(g: geo.Geometry, xcol: str, ycol: str):
+def _pip_fn(g: geo.Geometry, xcol: str, ycol: str, need_band=None,
+            neg: bool = False):
     """Point-in-(multi)polygon via even-odd crossing parity (holes included
-    naturally by the even-odd rule). Returns fn(cols, xp) -> mask."""
+    naturally by the even-odd rule). Returns fn(cols, xp) -> mask.
+
+    ``need_band(col, *bounds)``: f32-uncertainty registration for the
+    rectangle fast path (bbox boundary collisions), with NOT-polarity
+    rounding via ``neg``. General polygon edges remain f32-evaluated on
+    device (near-edge rows within ~1e-5 deg of an edge may classify
+    differently than exact f64 — the rectangle case, which CQL BBOX
+    compiles to, is band-exact)."""
     polys = g.polygons if isinstance(g, geo.MultiPolygon) else (g,)
     # Fast path: single axis-aligned rectangle -> bbox compare (the loose-bbox
     # trick; reference Z3IndexKeySpace.useFullFilter:235).
     if len(polys) == 1 and isinstance(polys[0], geo.Polygon) and polys[0].is_rectangle():
         xmin, ymin, xmax, ymax = polys[0].bounds()
+        if need_band is not None:
+            need_band(xcol, xmin, xmax)
+            need_band(ycol, ymin, ymax)
+            return _f32_box_fn(xcol, ycol, (xmin, ymin, xmax, ymax), neg)
 
         def rect(cols, xp):
             x, y = cols[xcol], cols[ycol]
@@ -219,6 +244,23 @@ _FALSE = lambda cols, xp: np.False_  # noqa: E731  broadcasts like a scalar
 _TRUE = lambda cols, xp: np.True_  # noqa: E731
 
 
+def _f32_box_fn(xc: str, yc: str, box, neg: bool):
+    """Backend-identical f32 box test (columns cast to f32 on the host too,
+    so the coarse mask means the same thing on both paths): inclusive
+    bounds when a superset is needed (even NOT-polarity), strict when a
+    subset is (odd)."""
+    x0, y0, x1, y1 = (float(np.float32(v)) for v in box)
+
+    def fn(cols, xp):
+        x = xp.asarray(cols[xc]).astype(xp.float32)
+        y = xp.asarray(cols[yc]).astype(xp.float32)
+        if neg:
+            return (x > x0) & (x < x1) & (y > y0) & (y < y1)
+        return (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+
+    return fn
+
+
 def _point_exact_fns(g: geo.Geometry, dim: int, xc: str, yc: str):
     """Exact host (f64) evaluators for a point column vs a literal, keyed by
     op — the refinement-side counterparts of the coarse kernels below."""
@@ -264,7 +306,7 @@ def _point_exact_fns(g: geo.Geometry, dim: int, xc: str, yc: str):
 
 
 def _point_spatial_fn(node, xc: str, yc: str, exact: bool, neg: bool,
-                      need_refine) -> Callable:
+                      need_refine, need_band=None) -> Callable:
     """Spatial predicate for a POINT column vs a geometry literal.
 
     A point's interior is the point itself, so every DE-9IM predicate
@@ -318,11 +360,14 @@ def _point_spatial_fn(node, xc: str, yc: str, exact: bool, neg: bool,
     # dim == 2: polygon / multipolygon literal
     if op in ("contains", "crosses", "overlaps", "equals"):
         return _FALSE
-    pip = _pip_fn(g, xc, yc)  # boundary-inclusive membership, device-exact
+    pip = _pip_fn(g, xc, yc, None if exact else need_band, neg)
     if op == "intersects":
         return pip
     if op == "disjoint":
-        return lambda cols, xp: ~pip(cols, xp)
+        # internal complement flips the rounding polarity: disjoint's
+        # superset is the complement of intersects' SUBSET
+        pip_n = _pip_fn(g, xc, yc, None if exact else need_band, not neg)
+        return lambda cols, xp: ~pip_n(cols, xp)
     # within/touches: boundary-sensitive -> coarse + refine
     ex = _point_exact_fns(g, dim, xc, yc)
     if exact:
@@ -471,6 +516,25 @@ def compile_filter(
         if c is not None and c not in refine_needed:
             refine_needed.append(c)
 
+    # f32-uncertainty bands: each entry masks rows whose f64 value rounds
+    # to the f32 image of a query bound — the only rows where the device's
+    # f32 compare can disagree with the exact f64 semantics
+    bands: List[Callable] = []
+
+    def band_eq(col: str, *bounds: float):
+        b32s = sorted({float(np.float32(b)) for b in bounds})
+
+        def bfn(cols, xp):
+            # f32-cast on BOTH backends: the band is defined by f32
+            # collision, and the host evaluates it on f64 master columns
+            c = xp.asarray(cols[col]).astype(xp.float32)
+            m = c == b32s[0]
+            for b in b32s[1:]:
+                m = m | (c == b)
+            return m
+
+        bands.append(bfn)
+
     def compile_node(node: ir.Filter, neg: bool = False, exact: bool = False) -> Callable:
         if isinstance(node, ir.Include):
             # scalar True broadcasts against the window/validity mask
@@ -507,12 +571,26 @@ def compile_filter(
             if "point" in gc:
                 need(gc["x"], gc["y"])
                 xc, yc = gc["x"], gc["y"]
+                if exact:
 
-                def bbox_pt(cols, xp):
-                    x, y = cols[xc], cols[yc]
-                    return (x >= xmin) & (x <= xmax) & (y >= ymin) & (y <= ymax)
+                    def bbox_exact(cols, xp):
+                        x, y = cols[xc], cols[yc]
+                        return (
+                            (x >= xmin) & (x <= xmax)
+                            & (y >= ymin) & (y <= ymax)
+                        )
 
-                return bbox_pt
+                    return bbox_exact
+                # f32 evaluation with polarity-correct rounding semantics:
+                # inclusive compares are a SUPERSET of the exact f64 box
+                # (monotone rounding), strict compares a SUBSET — so under
+                # even NOT-polarity emit inclusive, under odd emit strict.
+                # Rows colliding with an f32 bound (the band) are the only
+                # ones where the two differ; a clean band certificate makes
+                # either form bit-exact.
+                band_eq(xc, xmin, xmax)
+                band_eq(yc, ymin, ymax)
+                return _f32_box_fn(xc, yc, (xmin, ymin, xmax, ymax), neg)
             from geomesa_tpu import config
 
             if config.LOOSE_BBOX.to_bool():
@@ -544,7 +622,8 @@ def compile_filter(
             if "point" in gc:
                 need(gc["x"], gc["y"])
                 return _point_spatial_fn(
-                    node, gc["x"], gc["y"], exact, neg, need_refine
+                    node, gc["x"], gc["y"], exact, neg, need_refine,
+                    need_band=band_eq,
                 )
             # extent (line/polygon) column
             if exact:
@@ -715,6 +794,36 @@ def compile_filter(
                     return compile_node(ir.During(node.prop, v, ir.MAX_MS))
             val = float(val) if a.type in ("float32", "float64") else int(val)
             op = node.op
+            if a.type == "float64" and not exact:
+                # f64 column rides the device as f32: rows colliding with
+                # the f32 image of the bound are uncertain (the band), and
+                # the f32 compare must round with the right polarity —
+                # superset under even NOT-nesting, subset under odd (same
+                # monotone-rounding argument as the int64 case below)
+                band_eq(col, val)
+                v32 = float(np.float32(val))
+
+                def as32f(cols, xp):
+                    return xp.asarray(cols[col]).astype(xp.float32)
+
+                if op == "=":
+                    return (
+                        _FALSE if neg
+                        else (lambda cols, xp: as32f(cols, xp) == v32)
+                    )
+                if op == "<>":
+                    return (
+                        (lambda cols, xp: as32f(cols, xp) != v32)
+                        if neg else _TRUE
+                    )
+                if op in ("<", "<="):
+                    if neg:
+                        return lambda cols, xp: as32f(cols, xp) < v32
+                    return lambda cols, xp: as32f(cols, xp) <= v32
+                if op in (">", ">="):
+                    if neg:
+                        return lambda cols, xp: as32f(cols, xp) > v32
+                    return lambda cols, xp: as32f(cols, xp) >= v32
             if (
                 a.type == "int64" and not exact and abs(val) >= (1 << 24)
             ):
@@ -785,6 +894,21 @@ def compile_filter(
             vals = np.array(
                 [float(v) if a.type.startswith("float") else int(v) for v in node.values]
             )
+            if a.type == "float64" and not exact and len(vals):
+                band_eq(node.prop, *vals.tolist())
+                if neg:
+                    return _FALSE  # cannot CERTIFY membership at f32
+                vals32f = np.unique(vals.astype(np.float32))
+                propf = node.prop
+
+                def in32f(cols, xp):
+                    c = xp.asarray(cols[propf]).astype(xp.float32)
+                    m = c == float(vals32f[0])
+                    for v in vals32f[1:]:
+                        m = m | (c == float(v))
+                    return m
+
+                return in32f
             if (
                 a.type == "int64" and not exact
                 and np.abs(vals).max(initial=0) >= (1 << 24)
@@ -886,4 +1010,26 @@ def compile_filter(
         # exact host tree over candidate rows (same scalar columns + the
         # __wkt host columns); applied by the executor to coarse-true rows
         refine = compile_node(f, exact=True)
-    return CompiledFilter(fn, needed, refine=refine, refine_columns=refine_needed)
+    band = None
+    band_only = False
+    if bands and refine is None:
+        # refine-bearing plans are already host-exact on candidates; only
+        # the pure-device path needs the f32-uncertainty certificate. The
+        # exact tree doubles as the fallback refiner when the certificate
+        # fails (the f32 mask is a superset by monotone rounding, so
+        # coarse + exact-f64 refine is correct).
+        bfns = list(bands)
+
+        def band(cols, xp):  # noqa: F811
+            m = bfns[0](cols, xp)
+            for b in bfns[1:]:
+                m = m | b(cols, xp)
+            return m
+
+        refine = compile_node(f, exact=True)
+        band_only = True
+
+    return CompiledFilter(
+        fn, needed, refine=refine, refine_columns=refine_needed, band=band,
+        refine_only_if_band=band_only,
+    )

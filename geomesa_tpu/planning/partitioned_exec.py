@@ -117,6 +117,17 @@ class PartitionedExecutor:
             return np.zeros((height, width), np.float32)
         return np.asarray(out) if as_numpy else out
 
+    def density_curve(self, plan: QueryPlan, level: int, block_window,
+                      weight=None) -> np.ndarray:
+        out = None
+        for _, ex in self._each(plan):
+            g = ex.density_curve(plan, level, block_window, weight)
+            out = g if out is None else out + g
+        if out is None:
+            ix0, iy0, ix1, iy1 = block_window
+            out = np.zeros((iy1 - iy0 + 1, ix1 - ix0 + 1), np.float32)
+        return out
+
     def stats(self, plan: QueryPlan, stat: sk.Stat) -> sk.Stat:
         for _, ex in self._each(plan):
             ex.stats(plan, stat)

@@ -34,9 +34,15 @@ def _free_port() -> int:
 def server(tmp_path_factory):
     catalog = str(tmp_path_factory.mktemp("catalog"))
     port = _free_port()
+    # hermetic server: strip the axon TPU plugin's sitecustomize dir from
+    # PYTHONPATH (it force-overrides JAX_PLATFORMS at interpreter startup,
+    # which would make this suite compile over the device tunnel)
+    pp = [
+        p for p in os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        if p and "axon" not in p
+    ]
     env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=os.pathsep.join(
-        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
-        + os.environ.get("PYTHONPATH", "").split(os.pathsep)
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))] + pp
     ))
     proc = subprocess.Popen(
         [sys.executable, "-m", "geomesa_tpu.cli", "serve",

@@ -1,0 +1,122 @@
+"""Curve-aligned density (r4): exact per-block counts via CDF differences
+over the z2-sorted scan — the index-native heatmap for tile pyramids.
+Oracle: bin each point by the top bits of its normalized coordinate (the
+same fixed-point mapping the z2 keys are built from).
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, Query
+from geomesa_tpu.curves.zorder import Z2SFC
+from geomesa_tpu.filter.ecql import parse_iso_ms
+
+N = 50_000
+SPEC = "weight:Float,dtg:Date,*geom:Point"
+
+
+def _data(seed=21, n=N):
+    rng = np.random.default_rng(seed)
+    return {
+        "weight": rng.uniform(0, 2, n).astype(np.float32),
+        "dtg": rng.integers(
+            parse_iso_ms("2020-01-01"), parse_iso_ms("2020-03-01"), n
+        ).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-125, -66, n),
+        "geom__y": rng.uniform(24, 49, n),
+    }
+
+
+def _oracle(data, level, window, mask=None, weight=None):
+    sfc = Z2SFC()
+    ix = (sfc.lon.normalize(data["geom__x"]) >> np.uint64(31 - level)).astype(np.int64)
+    iy = (sfc.lat.normalize(data["geom__y"]) >> np.uint64(31 - level)).astype(np.int64)
+    ix0, iy0, ix1, iy1 = window
+    m = (ix >= ix0) & (ix <= ix1) & (iy >= iy0) & (iy <= iy1)
+    if mask is not None:
+        m &= mask
+    w = data[weight] if weight else np.ones(len(ix), np.float32)
+    grid = np.zeros((iy1 - iy0 + 1, ix1 - ix0 + 1), np.float64)
+    np.add.at(grid, (iy[m] - iy0, ix[m] - ix0), w[m])
+    return grid.astype(np.float32)
+
+
+@pytest.fixture(scope="module")
+def ds():
+    data = _data()
+    d = GeoDataset(n_shards=8)
+    d.create_schema("t", SPEC)
+    d.insert("t", data, fids=np.arange(N).astype(str))
+    d.flush()
+    return d, data
+
+
+def test_include_full_domain(ds):
+    d, data = ds
+    level = 6
+    grid, snapped = d.density_curve("t", "INCLUDE", level=level,
+                                    bbox=(-180, -90, 180, 90))
+    assert snapped == (-180.0, -90.0, 180.0, 90.0)
+    want = _oracle(data, level, (0, 0, 63, 63))
+    np.testing.assert_array_equal(grid, want)
+    assert grid.sum() == N
+
+
+def test_cropped_and_filtered(ds):
+    d, data = ds
+    level = 8
+    ecql = ("BBOX(geom, -100, 30, -80, 45) AND "
+            "dtg DURING 2020-01-05T00:00:00Z/2020-01-20T00:00:00Z")
+    grid, snapped = d.density_curve("t", ecql, level=level,
+                                    bbox=(-100, 30, -80, 45))
+    x, y = data["geom__x"], data["geom__y"]
+    t = data["dtg"].astype(np.int64)
+    m = ((x >= -100) & (x <= -80) & (y >= 30) & (y <= 45)
+         & (t >= parse_iso_ms("2020-01-05")) & (t <= parse_iso_ms("2020-01-20")))
+    nb = 1 << level
+    ix0 = int(np.floor((-100 + 180) / 360 * nb))
+    ix1 = int(np.ceil((-80 + 180) / 360 * nb)) - 1
+    iy0 = int(np.floor((30 + 90) / 180 * nb))
+    iy1 = int(np.ceil((45 + 90) / 180 * nb)) - 1
+    want = _oracle(data, level, (ix0, iy0, ix1, iy1), mask=m)
+    np.testing.assert_array_equal(grid, want)
+    # snapped bbox contains the request
+    assert snapped[0] <= -100 and snapped[1] <= 30
+    assert snapped[2] >= -80 and snapped[3] >= 45
+
+
+def test_weighted(ds):
+    d, data = ds
+    grid, _ = d.density_curve("t", "INCLUDE", level=5,
+                              bbox=(-180, -90, 180, 90), weight="weight")
+    want = _oracle(data, 5, (0, 0, 31, 31), weight="weight")
+    np.testing.assert_allclose(grid, want, rtol=1e-4)
+
+
+def test_host_and_device_agree(ds):
+    d, data = ds
+    host = GeoDataset(n_shards=8, prefer_device=False)
+    host.create_schema("t", SPEC)
+    host.insert("t", data, fids=np.arange(N).astype(str))
+    ga, _ = d.density_curve("t", "INCLUDE", level=7, bbox=(-130, 20, -60, 50))
+    gb, _ = host.density_curve("t", "INCLUDE", level=7, bbox=(-130, 20, -60, 50))
+    np.testing.assert_array_equal(ga, gb)
+
+
+def test_partitioned(ds):
+    d, data = ds
+    p = GeoDataset(n_shards=4)
+    p.create_schema("t", SPEC + ";geomesa.partition='time'")
+    p._store("t").max_resident = 1
+    p.insert("t", data, fids=np.arange(N).astype(str))
+    p.flush()
+    ga, _ = p.density_curve("t", "INCLUDE", level=6, bbox=(-180, -90, 180, 90))
+    want = _oracle(data, 6, (0, 0, 63, 63))
+    np.testing.assert_array_equal(ga, want)
+
+
+def test_matches_scatter_density_totals(ds):
+    d, data = ds
+    ecql = "BBOX(geom, -110, 28, -75, 47)"
+    grid, snapped = d.density_curve("t", ecql, level=9, bbox=(-110, 28, -75, 47))
+    assert float(grid.sum()) == float(d.count("t", ecql))
