@@ -581,10 +581,13 @@ class GeoDataset:
         n_blocks = 1 << level
         fx = lambda v: (v + 180.0) / 360.0 * n_blocks  # noqa: E731
         fy = lambda v: (v + 90.0) / 180.0 * n_blocks  # noqa: E731
+        # inclusive outward snap: floor on BOTH edges — a bbox edge exactly
+        # on a block boundary includes the block CONTAINING it, matching
+        # the inclusive x <= xmax semantics of the equivalent BBOX filter
         ix0 = int(np.clip(np.floor(fx(bbox[0])), 0, n_blocks - 1))
-        ix1 = int(np.clip(np.ceil(fx(bbox[2])) - 1, ix0, n_blocks - 1))
+        ix1 = int(np.clip(np.floor(fx(bbox[2])), ix0, n_blocks - 1))
         iy0 = int(np.clip(np.floor(fy(bbox[1])), 0, n_blocks - 1))
-        iy1 = int(np.clip(np.ceil(fy(bbox[3])) - 1, iy0, n_blocks - 1))
+        iy1 = int(np.clip(np.floor(fy(bbox[3])), iy0, n_blocks - 1))
         t0 = time.perf_counter()
         with metrics.registry().timer("query.density").time(), \
                 query_deadline(self._timeout_s()):

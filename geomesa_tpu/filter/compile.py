@@ -360,14 +360,14 @@ def _point_spatial_fn(node, xc: str, yc: str, exact: bool, neg: bool,
     # dim == 2: polygon / multipolygon literal
     if op in ("contains", "crosses", "overlaps", "equals"):
         return _FALSE
-    pip = _pip_fn(g, xc, yc, None if exact else need_band, neg)
     if op == "intersects":
-        return pip
+        return _pip_fn(g, xc, yc, None if exact else need_band, neg)
     if op == "disjoint":
         # internal complement flips the rounding polarity: disjoint's
         # superset is the complement of intersects' SUBSET
         pip_n = _pip_fn(g, xc, yc, None if exact else need_band, not neg)
         return lambda cols, xp: ~pip_n(cols, xp)
+    pip = _pip_fn(g, xc, yc, None if exact else need_band, neg)
     # within/touches: boundary-sensitive -> coarse + refine
     ex = _point_exact_fns(g, dim, xc, yc)
     if exact:

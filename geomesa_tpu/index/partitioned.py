@@ -39,6 +39,57 @@ def is_partitioned_schema(ft: FeatureType) -> bool:
     return v in ("time", "true")
 
 
+class _LazyCols(dict):
+    """Master-column mapping that loads snapshot members on first access —
+    the ColumnGroups analog (reference conf/ColumnGroups.scala:28: scans
+    touch only the column families they need). A reloaded cold partition
+    materializes exactly the columns its queries read; a count/density
+    touching 3 of 12 attributes never pays IO for the other 9."""
+
+    def __init__(self, npz_path: str, zkeys: Dict[str, str]):
+        super().__init__()
+        self._path = npz_path
+        self._zkeys = dict(zkeys)   # column name -> npz member
+        self._zf = None
+
+    def __missing__(self, k):
+        zk = self._zkeys.get(k)
+        if zk is None:
+            raise KeyError(k)
+        if self._zf is None:
+            self._zf = np.load(self._path, allow_pickle=False)
+        v = self._zf[zk]
+        self[k] = v
+        return v
+
+    def __contains__(self, k):
+        return super().__contains__(k) or k in self._zkeys
+
+    def get(self, k, default=None):
+        # dict.get bypasses __missing__; lazy members must still resolve
+        try:
+            return self[k]
+        except KeyError:
+            return default
+
+    def __iter__(self):
+        seen = dict.fromkeys(self._zkeys)
+        seen.update(dict.fromkeys(super().keys()))
+        return iter(seen)
+
+    def keys(self):
+        return list(iter(self))
+
+    def items(self):  # materializes: snapshot writes / merges need all
+        return [(k, self[k]) for k in self]
+
+    def values(self):
+        return [self[k] for k in self]
+
+    def __len__(self):
+        return len(set(self._zkeys) | set(super().keys()))
+
+
 class PartitionedFeatureStore(FeatureStore):
     """FeatureStore facade over per-time-period child stores.
 
@@ -187,21 +238,30 @@ class PartitionedFeatureStore(FeatureStore):
         with open(os.path.join(d, "meta.json")) as fh:
             meta = json.load(fh)
         st.stats = {k: sk.Stat.from_json(v) for k, v in meta["stats"].items()}
-        with np.load(os.path.join(d, "data.npz"), allow_pickle=False) as z:
-            cols = {k[2:]: z[k] for k in z.files if k.startswith("c/")}
-            st._key_cols = {k[2:]: z[k] for k in z.files if k.startswith("k/")}
+        path = os.path.join(d, "data.npz")
+        with np.load(path, allow_pickle=False) as z:
+            files = list(z.files)
+            # master/attribute columns load LAZILY on first access (the
+            # ColumnGroups analog); the sort permutations and key columns
+            # every scan touches load eagerly
+            zkeys = {k[2:]: k for k in files if k.startswith(("c/", "k/"))}
+            master = _LazyCols(path, zkeys)
+            cols = _LazyCols(path, {k[2:]: k for k in files if k.startswith("c/")})
+            st._key_cols = {k[2:]: z[k] for k in files if k.startswith("k/")}
+            # seed the eagerly-loaded key-cache arrays so master accesses
+            # share them instead of re-reading the npz member
+            master.update(st._key_cols)
             st._all = ColumnBatch(cols, int(meta["n"]))
-            master = {**cols, **st._key_cols}
             for name, t in st.tables.items():
                 pre = f"t/{name}/"
-                if pre + "order" not in z.files:
+                if pre + "order" not in files:
                     continue
                 t.order = z[pre + "order"]
                 t.key_columns = {
                     k[len(pre) + 4:]: z[k]
-                    for k in z.files if k.startswith(pre + "key/")
+                    for k in files if k.startswith(pre + "key/")
                 }
-                if pre + "vocab" in z.files:
+                if pre + "vocab" in files:
                     t._rank_vocab = z[pre + "vocab"].astype(object)
                 sh = meta["shifts"].get(name)
                 t.key_shifts = {k: int(v) for k, v in sh.items()} if sh else None

@@ -314,10 +314,15 @@ class IndexTable:
             ends[i, : len(e)] = e
         return starts, ends
 
-    def host_gather(self, global_mask: np.ndarray) -> ColumnBatch:
+    def host_gather(self, global_mask: np.ndarray,
+                    names: Optional[Sequence[str]] = None) -> ColumnBatch:
         """Select matching rows from the host master copy.
 
-        ``global_mask`` is over the padded [S, L] layout (flattened)."""
+        ``global_mask`` is over the padded [S, L] layout (flattened).
+        ``names``: optional projection — only the listed columns (plus
+        their derived ``<name>__*`` companions and the feature id) gather,
+        so projected queries on lazily-loaded cold partitions touch only
+        the column groups they need (ColumnGroups.scala:28 analog)."""
         L = self.shard_len
         idx = []
         for s in range(self.n_shards):
@@ -326,11 +331,19 @@ class IndexTable:
             idx.append(np.nonzero(local)[0] + sl.start)
         sel = np.concatenate(idx) if idx else np.zeros(0, np.int64)
         rows = self.order[sel]
-        out = {k: v[rows] for k, v in self._master.items()}
-        # include this index's extra key columns not present on the master
-        for k, v in self.key_columns.items():
-            if k not in out:
-                out[k] = v[sel]
+        cols = self.column_names() if names is None else [
+            k for k in self.column_names()
+            if k == "__fid__" or k in names
+            or any(k.startswith(n + "__") for n in names)
+        ]
+        out = {}
+        for k in cols:
+            if k in self._master:  # master wins: key copies may be quantized
+                out[k] = self._master[k][rows]
+            else:
+                kc = self.key_columns.get(k)
+                if kc is not None:
+                    out[k] = kc[sel]
         return ColumnBatch(out, len(sel))
 
 

@@ -201,19 +201,17 @@ class Executor:
         band = np.asarray(compiled.band(full, np)).reshape(-1)
         idx = np.nonzero(band)[0]
         if len(idx):
-            # inside the scan windows?
+            # inside the scan windows? (vectorized: [n_band, K] broadcast —
+            # equality predicates can band millions of rows)
             s_of = np.clip(
                 np.searchsorted(table.shard_bounds, idx, side="right") - 1,
                 0, table.n_shards - 1,
             )
-            local = idx - table.shard_bounds[s_of]
-            inw = np.zeros(len(idx), bool)
+            local = (idx - table.shard_bounds[s_of])[:, None]
             starts, ends = setup["starts"], setup["ends"]
-            for j in range(len(idx)):
-                s = int(s_of[j])
-                inw[j] = bool(
-                    ((starts[s] <= local[j]) & (local[j] < ends[s])).any()
-                )
+            inw = (
+                (starts[s_of] <= local) & (local < ends[s_of])
+            ).any(axis=1)
             idx = idx[inw]
         if len(idx):
             rows = {n: v[idx] for n, v in full.items()}
@@ -628,7 +626,14 @@ class Executor:
             mask = self._host_mask(
                 plan, setup, self._coarse_or_none(plan, setup)
             )
-        return setup["table"].host_gather(mask.reshape(-1))
+        names = None
+        if plan.hints.properties:
+            # projection pushdown into the gather (ColumnGroups analog):
+            # sort keys must survive for the caller's post-sort
+            names = list(plan.hints.properties) + [
+                a for a, _ in (plan.hints.sort_by or [])
+            ]
+        return setup["table"].host_gather(mask.reshape(-1), names)
 
     def features_iter(self, plan: QueryPlan, batch_rows: Optional[int] = None):
         """Matching rows as a stream of ColumnBatch chunks (ArrowScan's
@@ -753,7 +758,9 @@ class Executor:
                     xp.float32(0),
                 )
             c = xp.concatenate([xp.zeros(1, w.dtype), xp.cumsum(w)])
-            return (c[p1_] - c[p0_]).astype(xp.float32)
+            # counts stay int32 end-to-end: an f32 cast here would round
+            # blocks holding >2^24 rows
+            return c[p1_] - c[p0_]
 
         out = self._run(
             plan, agg, agg, agg_cols,
@@ -761,8 +768,11 @@ class Executor:
             extra=(p0, p1),
         )
         if out is None:
-            return np.zeros((ny, nx), np.float32)
-        flat = np.asarray(out)[:B]
+            return np.zeros((ny, nx), np.float64)
+        # float64 grid: cell counts are exact to 2^53 (an f32 grid would
+        # round cells beyond 2^24 rows); weighted cells carry the f32
+        # accumulation documented above
+        flat = np.asarray(out)[:B].astype(np.float64)
         # blocks were generated row-major over (j, i): reshape directly;
         # row 0 = ymin edge (RenderingGrid convention)
         return flat.reshape(ny, nx)

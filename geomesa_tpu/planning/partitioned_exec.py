@@ -125,7 +125,7 @@ class PartitionedExecutor:
             out = g if out is None else out + g
         if out is None:
             ix0, iy0, ix1, iy1 = block_window
-            out = np.zeros((iy1 - iy0 + 1, ix1 - ix0 + 1), np.float32)
+            out = np.zeros((iy1 - iy0 + 1, ix1 - ix0 + 1), np.float64)
         return out
 
     def stats(self, plan: QueryPlan, stat: sk.Stat) -> sk.Stat:

@@ -74,10 +74,11 @@ def test_cropped_and_filtered(ds):
     m = ((x >= -100) & (x <= -80) & (y >= 30) & (y <= 45)
          & (t >= parse_iso_ms("2020-01-05")) & (t <= parse_iso_ms("2020-01-20")))
     nb = 1 << level
+    # inclusive outward snap (floor on both edges), matching density_curve
     ix0 = int(np.floor((-100 + 180) / 360 * nb))
-    ix1 = int(np.ceil((-80 + 180) / 360 * nb)) - 1
+    ix1 = int(np.floor((-80 + 180) / 360 * nb))
     iy0 = int(np.floor((30 + 90) / 180 * nb))
-    iy1 = int(np.ceil((45 + 90) / 180 * nb)) - 1
+    iy1 = int(np.floor((45 + 90) / 180 * nb))
     want = _oracle(data, level, (ix0, iy0, ix1, iy1), mask=m)
     np.testing.assert_array_equal(grid, want)
     # snapped bbox contains the request
@@ -120,3 +121,25 @@ def test_matches_scatter_density_totals(ds):
     ecql = "BBOX(geom, -110, 28, -75, 47)"
     grid, snapped = d.density_curve("t", ecql, level=9, bbox=(-110, 28, -75, 47))
     assert float(grid.sum()) == float(d.count("t", ecql))
+
+
+def test_bbox_edge_on_block_boundary(ds):
+    """r4 review: a bbox edge exactly ON a block boundary must include the
+    block containing it (inclusive x <= xmax semantics)."""
+    d, _ = ds
+    n2 = 100
+    d2 = GeoDataset(n_shards=2)
+    d2.create_schema("e", SPEC)
+    # -78.75 is a level-9 block boundary (fx(-78.75) = 144.0 exactly)
+    xs = np.full(n2, -78.75)
+    ys = np.linspace(30, 40, n2)
+    d2.insert("e", {
+        "weight": np.ones(n2, np.float32),
+        "dtg": np.full(n2, parse_iso_ms("2020-01-05")).astype("datetime64[ms]"),
+        "geom__x": xs, "geom__y": ys,
+    }, fids=np.arange(n2).astype(str))
+    d2.flush()
+    q = "BBOX(geom, -100, 28, -78.75, 42)"
+    grid, snapped = d2.density_curve("e", q, level=9, bbox=(-100, 28, -78.75, 42))
+    assert grid.sum() == d2.count("e", q) == n2
+    assert snapped[2] >= -78.75

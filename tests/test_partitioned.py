@@ -216,3 +216,45 @@ def test_update_schema_partitioned_raises(pair):
     part, _, _ = pair
     with pytest.raises(NotImplementedError):
         part.update_schema("t", "extra:Integer")
+
+
+def test_lazy_columns_on_reload(tmp_path):
+    """ColumnGroups analog (r4): a reloaded cold partition materializes
+    only the columns its queries touch — a projected count never loads the
+    unrelated attribute columns from the snapshot."""
+    from geomesa_tpu.index.partitioned import _LazyCols
+
+    data = _data(6_000, seed=8)
+    ds = GeoDataset(n_shards=4, prefer_device=False)
+    ds.create_schema("t", PSPEC)
+    st = ds._store("t")
+    st.max_resident = 1
+    st._spill_dir = str(tmp_path / "spill")
+    ds.insert("t", data, fids=np.arange(6_000).astype(str))
+    ds.flush()
+    st.evict(keep=1)
+    # touch every partition with a count (loads lazily)
+    n = ds.count("t", BBOX_TIME)
+    assert n == GeoDatasetOracle(data)
+    loaded = []
+    for child in st.partitions.values():
+        m = child._all.columns
+        if isinstance(m, _LazyCols):
+            loaded.append(set(dict.keys(m)))
+    # the count touched geometry/time columns but never the 'name' string
+    # or 'weight' attribute columns
+    for keys in loaded:
+        assert "name" not in keys and "weight" not in keys, keys
+    # a full query then materializes what it needs and stays correct
+    fc = ds.query("t", BBOX_TIME)
+    assert len(fc) == n
+
+
+def GeoDatasetOracle(data):
+    x, y = data["geom__x"], data["geom__y"]
+    t = data["dtg"].astype(np.int64)
+    lo, hi = parse_iso_ms("2020-01-05"), parse_iso_ms("2020-01-15")
+    return int((
+        (x >= -100) & (x <= -80) & (y >= 30) & (y <= 45)
+        & (t >= lo) & (t <= hi)
+    ).sum())
