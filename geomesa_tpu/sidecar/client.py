@@ -134,6 +134,33 @@ class GeoFlightClient:
             grid[t["row"].to_numpy(), t["col"].to_numpy()] = t["weight"].to_numpy()
         return grid
 
+    def density_curve(self, name: str, ecql: str = "INCLUDE", level: int = 9,
+                      bbox=None, weight: Optional[str] = None,
+                      auths: Optional[Sequence[str]] = None):
+        """Morton-block-aligned density (tile pyramids): returns
+        ``(grid float64, snapped_bbox)`` — see PROTOCOL §3."""
+        import json as _json
+
+        opts = {"op": "density_curve", "schema": name, "ecql": ecql,
+                "level": level}
+        if bbox is not None:
+            opts["bbox"] = list(bbox)
+        if weight is not None:
+            opts["weight"] = weight
+        if auths is not None:
+            opts["auths"] = list(auths)
+        t = self._get(opts)
+        snapped = tuple(_json.loads(
+            t.schema.metadata[b"geomesa:snapped_bbox"].decode()
+        ))
+        n_blocks = 1 << level
+        nx = round((snapped[2] - snapped[0]) / 360.0 * n_blocks)
+        ny = round((snapped[3] - snapped[1]) / 180.0 * n_blocks)
+        grid = np.zeros((ny, nx), np.float64)
+        if t.num_rows:
+            grid[t["row"].to_numpy(), t["col"].to_numpy()] = t["weight"].to_numpy()
+        return grid, snapped
+
     def stats(self, name: str, stat_spec: str, ecql: str = "INCLUDE",
               auths: Optional[Sequence[str]] = None) -> sk.Stat:
         opts = {"op": "stats", "schema": name, "ecql": ecql, "stat": stat_spec}

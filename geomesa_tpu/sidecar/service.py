@@ -144,6 +144,26 @@ class GeoFlightServer(fl.FlightServerBase):
                 names=["row", "col", "weight"],
             )
             return fl.RecordBatchStream(pa.Table.from_batches([batch]))
+        if op == "density_curve":
+            q = _query_from(opts)
+            grid, snapped = ds.density_curve(
+                name, q, level=opts.get("level", 9),
+                bbox=opts.get("bbox"), weight=opts.get("weight"),
+            )
+            rows, cols = np.nonzero(grid)
+            batch = pa.record_batch(
+                [
+                    pa.array(rows.astype(np.int32)),
+                    pa.array(cols.astype(np.int32)),
+                    pa.array(grid[rows, cols].astype(np.float64)),
+                ],
+                names=["row", "col", "weight"],
+            )
+            return fl.RecordBatchStream(
+                pa.Table.from_batches([batch]).replace_schema_metadata(
+                    {b"geomesa:snapped_bbox": json.dumps(list(snapped)).encode()}
+                )
+            )
         if op == "stats":
             q = _query_from(opts)
             stat = ds.stats(name, opts["stat"], q)

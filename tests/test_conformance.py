@@ -214,3 +214,20 @@ def test_11_errors(client):
 def test_12_delete_schema(client):
     client.delete_schema("conf")
     assert "conf" not in client.list_schemas()
+
+
+def test_13_density_curve_over_wire(client):
+    """PROTOCOL §3 density_curve: sparse blocks + snapped bbox metadata."""
+    client.create_schema("tiles", SPEC)
+    t = _table(2_000, seed=9)
+    client.insert_arrow("tiles", t)
+    grid, snapped = client.density_curve(
+        "tiles", "BBOX(geom, -100, 30, -80, 45)", level=7,
+        bbox=(-100, 30, -80, 45),
+    )
+    geom = np.asarray(t["geom"].combine_chunks().flatten())
+    x, y = geom[0::2], geom[1::2]
+    want = int(((x >= -100) & (x <= -80) & (y >= 30) & (y <= 45)).sum())
+    assert int(grid.sum()) == want
+    assert snapped[0] <= -100 and snapped[2] >= -80
+    client.delete_schema("tiles")
