@@ -18,6 +18,14 @@ import pyarrow.flight as fl
 from geomesa_tpu.stats import sketches as sk
 
 
+def _dense_grid(t: pa.Table, shape, dtype) -> np.ndarray:
+    """Sparse (row, col, weight) wire encoding -> dense grid."""
+    grid = np.zeros(shape, dtype)
+    if t.num_rows:
+        grid[t["row"].to_numpy(), t["col"].to_numpy()] = t["weight"].to_numpy()
+    return grid
+
+
 class GeoFlightClient:
     def __init__(self, location: str, **kw):
         self._client = fl.FlightClient(location, **kw)
@@ -128,19 +136,13 @@ class GeoFlightClient:
             opts["weight"] = weight
         if auths is not None:
             opts["auths"] = list(auths)
-        t = self._get(opts)
-        grid = np.zeros((height, width), np.float32)
-        if t.num_rows:
-            grid[t["row"].to_numpy(), t["col"].to_numpy()] = t["weight"].to_numpy()
-        return grid
+        return _dense_grid(self._get(opts), (height, width), np.float32)
 
     def density_curve(self, name: str, ecql: str = "INCLUDE", level: int = 9,
                       bbox=None, weight: Optional[str] = None,
                       auths: Optional[Sequence[str]] = None):
         """Morton-block-aligned density (tile pyramids): returns
         ``(grid float64, snapped_bbox)`` — see PROTOCOL §3."""
-        import json as _json
-
         opts = {"op": "density_curve", "schema": name, "ecql": ecql,
                 "level": level}
         if bbox is not None:
@@ -150,16 +152,13 @@ class GeoFlightClient:
         if auths is not None:
             opts["auths"] = list(auths)
         t = self._get(opts)
-        snapped = tuple(_json.loads(
+        snapped = tuple(json.loads(
             t.schema.metadata[b"geomesa:snapped_bbox"].decode()
         ))
         n_blocks = 1 << level
         nx = round((snapped[2] - snapped[0]) / 360.0 * n_blocks)
         ny = round((snapped[3] - snapped[1]) / 180.0 * n_blocks)
-        grid = np.zeros((ny, nx), np.float64)
-        if t.num_rows:
-            grid[t["row"].to_numpy(), t["col"].to_numpy()] = t["weight"].to_numpy()
-        return grid, snapped
+        return _dense_grid(t, (ny, nx), np.float64), snapped
 
     def stats(self, name: str, stat_spec: str, ecql: str = "INCLUDE",
               auths: Optional[Sequence[str]] = None) -> sk.Stat:

@@ -8,6 +8,8 @@ GeoMesaCoprocessor.scala:44-61 serialized scan options):
      "auths": [...], "max_features": n, "sampling": n}
     {"op": "density", "schema": s, "ecql": e, "bbox": [xmin,ymin,xmax,ymax],
      "width": w, "height": h, "weight": attr}   -> sparse (row,col,weight)
+    {"op": "density_curve", "schema": s, "ecql": e, "level": l,
+     "bbox": [...], "weight": attr}  -> sparse blocks + snapped-bbox metadata
     {"op": "stats",   "schema": s, "ecql": e, "stat": "MinMax(a);..."}
     {"op": "bin",     "schema": s, "ecql": e, "track": attr, "label": attr}
 * ``do_put`` — ingest an Arrow stream into the descriptor's schema.
@@ -45,6 +47,20 @@ def _lib_version() -> str:
         return getattr(geomesa_tpu, "__version__", "0.1.0")
     except Exception:
         return "0.1.0"
+
+
+def _sparse_grid_batch(grid: np.ndarray, dtype) -> pa.RecordBatch:
+    """Dense grid -> the sparse (row, col, weight) wire encoding shared by
+    the density ops (reference DensityScan.scala:95-106 sparse encoding)."""
+    rows, cols = np.nonzero(grid)
+    return pa.record_batch(
+        [
+            pa.array(rows.astype(np.int32)),
+            pa.array(cols.astype(np.int32)),
+            pa.array(grid[rows, cols].astype(dtype)),
+        ],
+        names=["row", "col", "weight"],
+    )
 
 
 def _query_from(opts: Dict) -> Query:
@@ -134,15 +150,7 @@ class GeoFlightServer(fl.FlightServerBase):
                 width=opts.get("width", 256), height=opts.get("height", 256),
                 weight=opts.get("weight"),
             )
-            rows, cols = np.nonzero(grid)
-            batch = pa.record_batch(
-                [
-                    pa.array(rows.astype(np.int32)),
-                    pa.array(cols.astype(np.int32)),
-                    pa.array(grid[rows, cols].astype(np.float32)),
-                ],
-                names=["row", "col", "weight"],
-            )
+            batch = _sparse_grid_batch(grid, np.float32)
             return fl.RecordBatchStream(pa.Table.from_batches([batch]))
         if op == "density_curve":
             q = _query_from(opts)
@@ -150,15 +158,7 @@ class GeoFlightServer(fl.FlightServerBase):
                 name, q, level=opts.get("level", 9),
                 bbox=opts.get("bbox"), weight=opts.get("weight"),
             )
-            rows, cols = np.nonzero(grid)
-            batch = pa.record_batch(
-                [
-                    pa.array(rows.astype(np.int32)),
-                    pa.array(cols.astype(np.int32)),
-                    pa.array(grid[rows, cols].astype(np.float64)),
-                ],
-                names=["row", "col", "weight"],
-            )
+            batch = _sparse_grid_batch(grid, np.float64)
             return fl.RecordBatchStream(
                 pa.Table.from_batches([batch]).replace_schema_metadata(
                     {b"geomesa:snapped_bbox": json.dumps(list(snapped)).encode()}
