@@ -251,6 +251,8 @@ class GeoDataset:
                     cols[a.name + "__off"] = off
                 elif a.type == "bool":
                     cols[a.name] = np.zeros(n, bool)
+                elif a.type == "json":
+                    cols[a.name] = np.full(n, None, dtype=object)
                 elif a.type in ("float32", "float64"):
                     cols[a.name] = np.full(n, np.nan, np.dtype(a.type))
                 else:

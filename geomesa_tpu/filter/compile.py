@@ -393,6 +393,85 @@ _GEOM_CACHE_MAX = 8192
 _GEOM_CACHE_LOCK = Lock()  # the Flight sidecar refines on gRPC pool threads
 
 
+_JSON_CACHE: "OrderedDict[str, object]" = OrderedDict()
+_JSON_CACHE_MAX = 8192
+_JSON_CACHE_LOCK = Lock()
+
+
+def _parse_json_cached(s):
+    import json as _json
+
+    key = str(s)
+    with _JSON_CACHE_LOCK:
+        if key in _JSON_CACHE:
+            _JSON_CACHE.move_to_end(key)
+            return _JSON_CACHE[key]
+    try:
+        doc = _json.loads(key)
+    except ValueError:
+        doc = None
+    with _JSON_CACHE_LOCK:
+        while len(_JSON_CACHE) >= _JSON_CACHE_MAX:
+            _JSON_CACHE.popitem(last=False)
+        _JSON_CACHE[key] = doc
+    return doc
+
+
+def _json_path_pred(jp: "ir.JsonPath", test) -> Callable:
+    """Host evaluator for a jsonPath() predicate: parse each row's stored
+    document (cached) and test the extracted values (reference
+    geomesa-feature-kryo json/ JSONPath pushdown — there inside the kryo
+    lazy deserializer, here on the host object column)."""
+    from geomesa_tpu.convert.converter import _json_path_get
+
+    attr, path = jp.attr, jp.path
+
+    def fn(cols, xp=np):
+        col = cols[attr]
+        out = np.zeros(len(col), bool)
+        for i, s in enumerate(col):
+            if s is None:
+                continue
+            doc = _parse_json_cached(s)
+            if doc is None:
+                continue
+            vals = _json_path_get(doc, path)
+            out[i] = any(v is not None and test(v) for v in vals)
+        return out
+
+    return fn
+
+
+def _json_test(op: str, val) -> Callable:
+    """Value test with JSON-side type coercion: numeric compare when the
+    literal is numeric, else string compare."""
+    import operator
+
+    o = {
+        "=": operator.eq, "<>": operator.ne, "<": operator.lt,
+        "<=": operator.le, ">": operator.gt, ">=": operator.ge,
+    }[op]
+    numeric = isinstance(val, (int, float)) and not isinstance(val, bool)
+
+    def test(v):
+        try:
+            if numeric:
+                return bool(o(float(v), float(val)))
+            return bool(o(str(v), str(val)))
+        except (TypeError, ValueError):
+            return False
+
+    return test
+
+
+def _require_json_attr(ft: FeatureType, jp: "ir.JsonPath"):
+    a = ft.attr(jp.attr)
+    if a.type != "json":
+        raise ValueError(
+            f"jsonPath() requires a Json attribute; {jp.attr!r} is {a.type}"
+        )
+
+
 def _parse_wkt_cached(w) -> geo.Geometry:
     if isinstance(w, geo.Geometry):
         return w
@@ -459,13 +538,17 @@ def _exact_extent_dwithin_fn(prop: str, literal: geo.Geometry, dist_m: float):
     return fn
 
 
-def _like_codes(d: DictionaryEncoder, pattern: str, ci: bool) -> np.ndarray:
-    """Resolve a LIKE pattern against the dictionary vocab -> matching codes."""
+def _like_regex(pattern: str, ci: bool):
+    """LIKE pattern (%/_ wildcards) -> anchored compiled regex."""
     rx = "".join(
         ".*" if ch == "%" else "." if ch == "_" else re.escape(ch) for ch in pattern
     )
-    flags = re.IGNORECASE if ci else 0
-    cre = re.compile("^" + rx + "$", flags)
+    return re.compile("^" + rx + "$", re.IGNORECASE if ci else 0)
+
+
+def _like_codes(d: DictionaryEncoder, pattern: str, ci: bool) -> np.ndarray:
+    """Resolve a LIKE pattern against the dictionary vocab -> matching codes."""
+    cre = _like_regex(pattern, ci)
     return np.array(
         [i for i, v in enumerate(d.values) if cre.match(v)], dtype=np.int32
     )
@@ -740,6 +823,12 @@ def compile_filter(
             return dwithin_ext
 
         if isinstance(node, ir.Compare):
+            if isinstance(node.prop, ir.JsonPath):
+                _require_json_attr(ft, node.prop)
+                need(node.prop.attr)
+                return _json_path_pred(
+                    node.prop, _json_test(node.op, node.value)
+                )
             a = ft.attr(node.prop)
             col = node.prop
             need(col)
@@ -882,6 +971,13 @@ def compile_filter(
             return compile_node(inner, neg, exact)
 
         if isinstance(node, ir.In):
+            if isinstance(node.prop, ir.JsonPath):
+                _require_json_attr(ft, node.prop)
+                need(node.prop.attr)
+                tests = [_json_test("=", v) for v in node.values]
+                return _json_path_pred(
+                    node.prop, lambda v: any(t(v) for t in tests)
+                )
             a = ft.attr(node.prop)
             need(node.prop)
             if a.type == "string":
@@ -932,6 +1028,13 @@ def compile_filter(
             return _isin_fn(node.prop, vals)
 
         if isinstance(node, ir.Like):
+            if isinstance(node.prop, ir.JsonPath):
+                _require_json_attr(ft, node.prop)
+                need(node.prop.attr)
+                cre = _like_regex(node.pattern, node.case_insensitive)
+                return _json_path_pred(
+                    node.prop, lambda v: bool(cre.match(str(v)))
+                )
             a = ft.attr(node.prop)
             if a.type != "string":
                 raise ValueError(f"LIKE requires a string attribute, got {a.type}")
@@ -940,6 +1043,13 @@ def compile_filter(
             return _isin_fn(node.prop, _like_codes(d, node.pattern, node.case_insensitive))
 
         if isinstance(node, ir.IsNull):
+            if isinstance(node.prop, ir.JsonPath):
+                _require_json_attr(ft, node.prop)
+                need(node.prop.attr)
+                exists = _json_path_pred(node.prop, lambda v: True)
+                if node.negate:  # IS NOT NULL
+                    return exists
+                return lambda cols, xp: ~np.asarray(exists(cols, xp))
             a = ft.attr(node.prop)
             need(node.prop)
             col = node.prop
@@ -954,6 +1064,12 @@ def compile_filter(
             return fn
 
         if isinstance(node, ir.During):
+            if isinstance(node.prop, ir.JsonPath):
+                raise ValueError(
+                    "temporal predicates (DURING/BEFORE/AFTER/TEQUALS) are "
+                    "not supported on jsonPath() accessors; compare the "
+                    "extracted value numerically instead"
+                )
             # Temporal predicates run on the (bin, scaled-offset) int32 pair —
             # the device time representation. Lexicographic pair compare.
             from geomesa_tpu.curves.binned_time import BinnedTime

@@ -254,8 +254,15 @@ class _Parser:
                         break
                 self.expect("sym", ")")
                 return ir.IdIn(tuple(ids))
-        # property-led predicates
+        # property-led predicates; jsonPath('$.a.b', attr) is a property
+        # reference into a stored-JSON attribute
         prop = self.expect("id").text
+        if prop.lower() == "jsonpath" and self.accept("sym", "("):
+            path = str(self.literal())
+            self.expect("sym", ",")
+            attr = self.expect("id").text
+            self.expect("sym", ")")
+            prop = ir.JsonPath(attr, path)
         t = self.peek()
         if t and t.kind == "op":
             op = self.next().text

@@ -84,10 +84,21 @@ class DWithin(Filter):
 
 
 @dataclass(frozen=True)
+class JsonPath:
+    """Property reference into a stored-JSON attribute: the ECQL
+    ``jsonPath('$.a.b', attr)`` accessor (reference geomesa-feature-kryo
+    json/ JSONPath pushdown). Usable wherever a property name is — the
+    filter compiler emits a host-side document evaluator for it."""
+
+    attr: str
+    path: str
+
+
+@dataclass(frozen=True)
 class Compare(Filter):
     """=, <>, <, <=, >, >= on a scalar attribute."""
 
-    prop: str
+    prop: "str | JsonPath"
     op: str
     value: object  # float | int | str | np.int64 epoch-ms for dates
 
@@ -292,8 +303,11 @@ def props_referenced(f: Filter) -> List[str]:
         elif isinstance(node, Not):
             walk(node.child)
         elif hasattr(node, "prop"):
-            if node.prop not in out:
-                out.append(node.prop)
+            p = node.prop
+            if isinstance(p, JsonPath):
+                p = p.attr
+            if p not in out:
+                out.append(p)
 
     walk(f)
     return out

@@ -998,7 +998,7 @@ def keyspaces_for_schema(ft: FeatureType) -> List[KeySpace]:
             out.append(IdKeySpace())
         elif kind == "attr":
             for a in ft.attributes:
-                if a.indexed and not a.is_geom:
+                if a.indexed and not a.is_geom and a.type != "json":
                     out.append(AttributeKeySpace(a.name, geom, a.type))
     if not any(isinstance(k, IdKeySpace) for k in out):
         out.append(IdKeySpace())

@@ -389,7 +389,7 @@ class FeatureStore:
                 ft.geom_field, ft.dtg_field, ft.time_period, 1024
             )
         for a in ft.attributes:
-            if a.indexed and not a.is_geom:
+            if a.indexed and not a.is_geom and a.type != "json":
                 if a.type == "string":
                     out[f"enum-{a.name}"] = sk.EnumerationStat(a.name)
                 else:

@@ -49,6 +49,8 @@ def arrow_field(ft: FeatureType, name: str, wkt_geoms: Sequence[str] = ()) -> pa
         t = pa.timestamp("ms")
     elif a.type == "string":
         t = pa.dictionary(pa.int32(), pa.utf8())
+    elif a.type == "json":
+        t = pa.utf8()  # raw document text
     elif a.type == "bool":
         t = pa.bool_()
     else:
@@ -108,6 +110,12 @@ def batch_to_arrow(
         elif a.type == "date":
             arrays.append(pa.array(batch.columns[name], pa.timestamp("ms")))
             fields.append(pa.field(name, pa.timestamp("ms")))
+        elif a.type == "json":
+            arrays.append(pa.array(
+                [None if v is None else str(v) for v in batch.columns[name]],
+                pa.utf8(),
+            ))
+            fields.append(pa.field(name, pa.utf8()))
         elif a.type == "string":
             codes = batch.columns[name]
             vocab = dicts.get(name, DictionaryEncoder()).values
@@ -165,7 +173,7 @@ def table_to_data(ft: FeatureType, table: "pa.Table | pa.RecordBatch") -> Tuple[
                 data[name] = col.cast(pa.timestamp("ms")).to_numpy(zero_copy_only=False).astype("datetime64[ms]")
             else:
                 data[name] = np.asarray(col.to_numpy(zero_copy_only=False), np.int64)
-        elif a.type == "string":
+        elif a.type in ("string", "json"):
             col = cols[name]
             if pa.types.is_dictionary(col.type):
                 col = col.cast(pa.utf8())

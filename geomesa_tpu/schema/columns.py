@@ -19,6 +19,7 @@ Device uploads additionally carry normalized/fixed-point views and curve keys
 
 from __future__ import annotations
 
+import json
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, List, Optional, Sequence
 
@@ -202,6 +203,21 @@ def encode_batch(
             set_n(len(vals))
             d = dicts.setdefault(a.name, DictionaryEncoder())
             cols[a.name] = d.encode(vals)
+        elif a.type == "json":
+            # stored-JSON attribute (reference kryo-json): raw document
+            # text in a host-only object column; jsonPath() predicates
+            # parse on demand with a bounded cache
+            vals = data.get(a.name)
+            if vals is None:
+                raise KeyError(f"missing attribute {a.name!r}")
+            out = np.empty(len(vals), dtype=object)
+            for i, v in enumerate(vals):
+                out[i] = (
+                    None if v is None
+                    else v if isinstance(v, str) else json.dumps(v)
+                )
+            set_n(len(out))
+            cols[a.name] = out
         elif a.type == "bool":
             vals = np.asarray(data[a.name]).astype(bool)
             set_n(len(vals))

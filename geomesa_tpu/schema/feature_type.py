@@ -34,6 +34,7 @@ _TYPES = {
     "timestamp": "date",
     "uuid": "string",
     "bytes": "string",
+    "json": "json",
     "point": "point",
     "linestring": "linestring",
     "polygon": "polygon",
@@ -75,7 +76,8 @@ class AttributeSpec:
         names = {v: k for k, v in {
             "String": "string", "Integer": "int32", "Long": "int64",
             "Float": "float32", "Double": "float64", "Boolean": "bool",
-            "Date": "date", "Point": "point", "LineString": "linestring",
+            "Date": "date", "Json": "json",
+            "Point": "point", "LineString": "linestring",
             "Polygon": "polygon", "MultiPoint": "multipoint",
             "MultiLineString": "multilinestring", "MultiPolygon": "multipolygon",
             "Geometry": "geometry",

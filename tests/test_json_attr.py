@@ -1,0 +1,111 @@
+"""Stored-JSON attributes with jsonPath() pushdown (SURVEY §2.2 JSON-path
+support; reference geomesa-feature-kryo json/ — the subject of the
+reference's only JMH benchmark)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.filter.ecql import parse_iso_ms
+
+SPEC = "props:Json,dtg:Date,*geom:Point"
+
+
+@pytest.fixture(scope="module")
+def ds():
+    docs = [
+        '{"type": "car", "speed": 42, "tags": ["a", "b"]}',
+        '{"type": "truck", "speed": 80, "extra": {"axles": 3}}',
+        '{"type": "car", "speed": 12}',
+        '{"speed": 99}',
+        None,
+        'not valid json',
+    ]
+    n = len(docs)
+    d = GeoDataset(n_shards=2)
+    d.create_schema("j", SPEC)
+    d.insert("j", {
+        "props": docs,
+        "dtg": np.full(n, parse_iso_ms("2022-01-01")).astype("datetime64[ms]"),
+        "geom__x": np.linspace(-10, 10, n),
+        "geom__y": np.zeros(n),
+    }, fids=np.arange(n).astype(str))
+    d.flush()
+    return d
+
+
+def test_jsonpath_equality(ds):
+    assert ds.count("j", "jsonPath('$.type', props) = 'car'") == 2
+    assert ds.count("j", "jsonPath('$.type', props) = 'truck'") == 1
+
+
+def test_jsonpath_numeric_range(ds):
+    assert ds.count("j", "jsonPath('$.speed', props) > 40") == 3
+    assert ds.count("j", "jsonPath('$.speed', props) <= 42") == 2
+    assert ds.count("j", "jsonPath('$.speed', props) BETWEEN 40 AND 90") == 2
+
+
+def test_jsonpath_nested_and_null(ds):
+    assert ds.count("j", "jsonPath('$.extra.axles', props) = 3") == 1
+    assert ds.count("j", "jsonPath('$.type', props) IS NULL") == 3
+    assert ds.count("j", "jsonPath('$.type', props) IS NOT NULL") == 3
+
+
+def test_jsonpath_like_in_and_combination(ds):
+    assert ds.count("j", "jsonPath('$.type', props) LIKE 'c%'") == 2
+    assert ds.count("j", "jsonPath('$.type', props) IN ('car', 'truck')") == 3
+    assert ds.count(
+        "j", "jsonPath('$.type', props) = 'car' AND jsonPath('$.speed', props) > 20"
+    ) == 1
+    assert ds.count("j", "NOT (jsonPath('$.type', props) = 'car')") == 4
+
+
+def test_jsonpath_array_wildcard(ds):
+    assert ds.count("j", "jsonPath('$.tags[*]', props) = 'b'") == 1
+    assert ds.count("j", "jsonPath('$.tags[0]', props) = 'a'") == 1
+
+
+def test_json_roundtrip_query_and_arrow(ds):
+    fc = ds.query("j", "jsonPath('$.type', props) = 'truck'")
+    assert len(fc) == 1
+    assert '"axles": 3' in fc.columns["props"][0]
+    t = ds.to_arrow("j")
+    assert t.num_rows == 6
+    assert t["props"].null_count == 1
+
+
+def test_jsonpath_on_non_json_attr_raises(ds):
+    with pytest.raises(ValueError, match="requires a Json attribute"):
+        ds.count("j", "jsonPath('$.a', dtg) = 1")
+
+
+def test_indexed_json_attr_ingests(tmp_path):
+    """r4 review: index=true on a Json attribute must not break ingest
+    (no MinMax sketch over document text)."""
+    d = GeoDataset(n_shards=2)
+    d.create_schema("ji", "props:Json:index=true,dtg:Date,*geom:Point")
+    d.insert("ji", {
+        "props": ['{"a": 1}', None],
+        "dtg": np.full(2, parse_iso_ms("2022-01-01")).astype("datetime64[ms]"),
+        "geom__x": [0.0, 1.0], "geom__y": [0.0, 1.0],
+    }, fids=["a", "b"])
+    d.flush()
+    assert d.count("ji") == 2
+    assert d.count("ji", "jsonPath('$.a', props) = 1") == 1
+
+
+def test_update_schema_adds_json(ds):
+    d2 = GeoDataset(n_shards=2)
+    d2.create_schema("u", "dtg:Date,*geom:Point")
+    d2.insert("u", {
+        "dtg": np.full(2, parse_iso_ms("2022-01-01")).astype("datetime64[ms]"),
+        "geom__x": [0.0, 1.0], "geom__y": [0.0, 1.0],
+    }, fids=["a", "b"])
+    d2.flush()
+    d2.update_schema("u", "props:Json")
+    assert d2.count("u", "jsonPath('$.a', props) IS NULL") == 2
+
+
+def test_temporal_on_jsonpath_raises(ds):
+    with pytest.raises(ValueError, match="not supported on jsonPath"):
+        ds.count("j", "jsonPath('$.t', props) AFTER 2022-01-01T00:00:00Z")
