@@ -362,10 +362,14 @@ class GeoDataset:
         auths = self._effective_auths(q)
         self._apply_visibility(st, plan, auths)
         if isinstance(q.ecql, str):
-            # the predicate is reproducible from text + auths: allow the
-            # executor to reuse jitted kernels across API calls
+            # the predicate is reproducible from text + auths + the
+            # EFFECTIVE filter (interceptors may rewrite it for the same
+            # text — QueryInterceptor.scala:51): allow the executor to
+            # reuse jitted kernels and resolved windows across API calls
             plan.__dict__["cache_token"] = (
-                q.ecql, None if auths is None else tuple(auths)
+                q.ecql,
+                None if auths is None else tuple(auths),
+                hash(repr(plan.filter)),
             )
         plan.__dict__["plan_time_ms"] = (time.perf_counter() - t0) * 1e3
         return st, q, plan

@@ -151,6 +151,31 @@ def _bin_and_offset(binned: BinnedTime, ft: FeatureType, dtg: str, batch):
 #: ranges gap-union down (over-cover; the fine filter restores exactness)
 MAX_SHARD_WINDOWS = 256
 
+_window_cap_tls = __import__("threading").local()
+
+
+def shard_window_cap() -> int:
+    """Active per-shard window budget. The compacted scan path raises it
+    (``window_cap``) to resolve gap-union-free windows: scan cost there is
+    per admitted ROW, not per window, so fine windows are strictly
+    better — tighter chunk spatial boxes and fewer false-positive rows."""
+    return getattr(_window_cap_tls, "cap", None) or MAX_SHARD_WINDOWS
+
+
+class window_cap:
+    """Context manager scoping a raised shard-window budget."""
+
+    def __init__(self, cap: int):
+        self.cap = cap
+
+    def __enter__(self):
+        self.prev = getattr(_window_cap_tls, "cap", None)
+        _window_cap_tls.cap = self.cap
+        return self
+
+    def __exit__(self, *exc):
+        _window_cap_tls.cap = self.prev
+
 
 def _merge_cap(los: np.ndarray, his: np.ndarray, cap: int,
                adjacent: int = 0) -> Tuple[np.ndarray, np.ndarray]:
@@ -333,9 +358,10 @@ class Z3KeySpace(KeySpace):
         # range sets from plan time. The shifted+merged range sets are
         # shard-independent: computed once per (plan, shift) and cached.
         edge = getattr(plan, "_edge", {})
-        per_bin_cap = max(1, MAX_SHARD_WINDOWS // max(len(bins), 1))
+        cap = shard_window_cap()
+        per_bin_cap = max(1, cap // max(len(bins), 1))
         cache = plan.__dict__.setdefault("_shifted_ranges", {})
-        sets = cache.get(sh)
+        sets = cache.get((sh, cap))
         if sets is None:
             base = _merge_zranges(
                 [(r.lo >> sh, r.hi >> sh) for r in plan.ranges], per_bin_cap
@@ -346,7 +372,7 @@ class Z3KeySpace(KeySpace):
                 )
                 for b, rs in edge.items()
             }
-            sets = cache[sh] = (base, esets)
+            sets = cache[(sh, cap)] = (base, esets)
         base, esets = sets
         from geomesa_tpu import native
 
@@ -376,7 +402,7 @@ class Z3KeySpace(KeySpace):
             return np.zeros(1, np.int64), np.zeros(1, np.int64)
         return _cap_windows(
             np.asarray(starts, np.int64), np.asarray(ends, np.int64),
-            MAX_SHARD_WINDOWS,
+            shard_window_cap(),
         )
 
 
@@ -426,7 +452,7 @@ class Z2KeySpace(KeySpace):
         z_col = shard_cols["__z2"]
         sh = _shift_of(shard_cols, "__z2")
         rs = _merge_zranges(
-            [(r.lo >> sh, r.hi >> sh) for r in plan.ranges], MAX_SHARD_WINDOWS
+            [(r.lo >> sh, r.hi >> sh) for r in plan.ranges], shard_window_cap()
         )
         if not rs:
             return np.zeros(1, np.int64), np.zeros(1, np.int64)
@@ -439,7 +465,7 @@ class Z2KeySpace(KeySpace):
             return np.zeros(1, np.int64), np.zeros(1, np.int64)
         return _cap_windows(
             ws[keep].astype(np.int64), we[keep].astype(np.int64),
-            MAX_SHARD_WINDOWS,
+            shard_window_cap(),
         )
 
 

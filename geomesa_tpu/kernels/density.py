@@ -33,6 +33,25 @@ def density_grid(x, y, mask, bbox, width: int, height: int, weight=None, xp=None
     if xp is np:
         grid = np.zeros(height * width, np.float32)
         np.add.at(grid, flat_idx, w)
-    else:
-        grid = xp.zeros(height * width, xp.float32).at[flat_idx].add(w)
+        return grid.reshape(height, width)
+    # Split the scatter into independent pieces accumulating separate
+    # grids: measured on v5e, one 2M-update scatter costs ~6.1 ns/update
+    # while 8 independent 256k scatters + grid adds run at ~0.5 ns/update
+    # (the XLA scheduler overlaps the scatters' phases; a lax.scan over the
+    # same pieces stays serial at ~7 ns). Pieces must divide evenly —
+    # callers keep row counts a multiple of 8 (see executor chunk buckets).
+    import os
+
+    P = int(os.environ.get("GEOMESA_SCATTER_SPLIT", 8))
+    n = flat_idx.shape[0]
+    if P <= 1 or n % P or n < (1 << 14):
+        return (
+            xp.zeros(height * width, xp.float32).at[flat_idx].add(w)
+        ).reshape(height, width)
+    fi = flat_idx.reshape(P, -1)
+    fw = w.reshape(P, -1)
+    grid = None
+    for p in range(P):
+        s = xp.zeros(height * width, xp.float32).at[fi[p]].add(fw[p])
+        grid = s if grid is None else grid + s
     return grid.reshape(height, width)

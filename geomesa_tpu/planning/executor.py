@@ -35,6 +35,38 @@ class QueryTimeoutError(RuntimeError):
     ThreadManagement query killer, index/utils/ThreadManagement.scala:28-80)."""
 
 
+# -- window-compacted scan layout -------------------------------------------
+# Device scatter costs ~6.7 ns per TOUCHED row regardless of masking
+# (docs/SCALE.md cost model), so a density scan over the full padded table
+# pays for every row even when the z-windows admit a few percent. The
+# compacted path gathers ONLY the window rows — as chunked slabs, because
+# slice-sized gathers run at HBM bandwidth (~100 GiB/s measured) while
+# per-element gathers crawl at ~7.5 ns/element — and aggregates over the
+# [C, B] compact layout. Selective queries then scale with rows *scanned*,
+# not rows *stored* (the same property the reference gets from range scans:
+# AbstractBatchScan.scala:32 only ever reads the planned ranges).
+_COMPACT_MIN_TABLE = int(os.environ.get("GEOMESA_COMPACT_MIN_ROWS", 1 << 20))
+_COMPACT_FRACTION = float(os.environ.get("GEOMESA_COMPACT_FRACTION", 0.5))
+
+_SLAB_GATHER_FNS: Dict[int, Any] = {}
+
+
+def _slab_gather_fn(B: int):
+    """jit'd [C]-chunk slab gather (vmapped dynamic_slice of length B)."""
+    fn = _SLAB_GATHER_FNS.get(B)
+    if fn is None:
+        import jax
+
+        @jax.jit
+        def fn(flat, gstart):
+            return jax.vmap(
+                lambda s: jax.lax.dynamic_slice(flat, (s,), (B,))
+            )(gstart)
+
+        _SLAB_GATHER_FNS[B] = fn
+    return fn
+
+
 _deadline = threading.local()
 
 
@@ -86,7 +118,22 @@ class Executor:
         table = self._table(plan)
         if table.n == 0 or plan.is_empty:
             return None
-        starts, ends = table.windows(plan.key_plan)
+        # Resolved windows are pure in (key_plan, table contents): cache
+        # them so re-running the query — same plan object (pagination,
+        # benchmarks, kNN radius loop) or a fresh plan of the same text
+        # (cache_token) — skips the per-shard searchsorted sweep, which at
+        # 20M rows costs ~90 ms/query, dwarfing the device kernel it feeds.
+        rkey = ("win", self.store.uid, self.store.version, plan.index_name,
+                plan.__dict__.get("window_token"))
+        cache, rkey = self._resolve_cache(plan, rkey)
+        hit = cache.get(rkey)
+        if hit is not None:
+            starts, ends = hit
+        else:
+            starts, ends = table.windows(plan.key_plan)
+            if len(cache) >= 64:
+                cache.clear()
+            cache[rkey] = (starts, ends)
         counts = np.diff(table.shard_bounds).astype(np.int32)
         L = table.shard_len
         needed = list(dict.fromkeys(list(plan.compiled.columns) + list(extra_cols)))
@@ -131,6 +178,263 @@ class Executor:
             "L": L, "needed": needed, "use_device": use_device,
             "coarse_device": coarse_device,
         }
+
+    def _maybe_compact(self, plan: QueryPlan, setup, allowed: bool) -> None:
+        """Decide the window-compacted layout for this scan. Sets
+        ``setup['compact']`` to a chunk-descriptor dict (or None).
+
+        Chunks are B-row slabs (B = pow2 bucket of the typical window
+        length) covering every window, ordered by global position so the
+        deterministic sampling counter sees matches in the same order as
+        the padded path. ``lo`` handles the end-of-table dynamic_slice
+        clamp: valid rows of chunk c live at [lo, lo+valid) and map to
+        global rows cstart + lo + i."""
+        if "compact" in setup:
+            return
+        setup["compact"] = None
+        if (
+            not allowed
+            or not setup["use_device"]
+            or self.mesh is not None
+            or os.environ.get("GEOMESA_TPU_NO_COMPACT")
+        ):
+            return
+        table = setup["table"]
+        if table.n < _COMPACT_MIN_TABLE:
+            return
+        L = setup["L"]
+
+        def _choose(starts, ends):
+            """(B, rows, lens) minimizing padded rows for one window set."""
+            lens = np.maximum(ends - starts, 0).astype(np.int64)
+            if int(lens.sum()) == 0:
+                return None
+            flat = lens.reshape(-1)
+            rows_at = {
+                Bc: int((-(-flat // Bc)).sum()) * Bc
+                for Bc in (128, 256, 512, 1024, 2048, 4096)
+                if Bc <= L
+            }
+            if not rows_at:
+                return None
+            floor_rows = min(rows_at.values())
+            B = int(os.environ.get("GEOMESA_COMPACT_B", 0)) or max(
+                b for b, r in rows_at.items() if r <= 1.10 * floor_rows
+            )
+            return B, rows_at[B], lens
+
+        # steady-state cost is per PADDED row, so choose the chunk size
+        # minimizing padding (prefer the largest B within 10% — fewer,
+        # larger slabs gather faster on the one-time pass), over BOTH
+        # window resolutions: the fine (gap-union-free) set usually admits
+        # fewer rows AND gives spatially tight chunks (the MXU density
+        # pair lists depend on that), so it wins any near-tie.
+        cands = []
+        coarse = _choose(setup["starts"], setup["ends"])
+        if coarse is not None:
+            cands.append((coarse[1], 1, setup["starts"], setup["ends"]) + coarse[:1] + (coarse[2],))
+        fs, fe = self._fine_windows(plan, setup)
+        if fs is not None:
+            fine = _choose(fs, fe)
+            if fine is not None:
+                cands.append((int(fine[1] * 0.77), 0, fs, fe, fine[0], fine[2]))
+        if not cands:
+            return
+        cands.sort(key=lambda c: (c[0], c[1]))
+        _, _, starts, ends, B, lens = cands[0]
+        S, K = starts.shape
+        flat_lens = lens.reshape(-1)
+        nc = -(-flat_lens // B)
+        C = int(nc.sum())
+        if C * B >= table.n * _COMPACT_FRACTION:
+            return  # windows admit most of the table: compaction can't win
+        win = np.repeat(np.arange(S * K), nc)
+        j = np.arange(C) - np.repeat(np.cumsum(nc) - nc, nc)
+        s_of = win // K
+        gstart = (
+            s_of * L + starts.reshape(-1)[win] + j * B
+        ).astype(np.int64)
+        valid = np.minimum(flat_lens[win] - j * B, B).astype(np.int32)
+        order = np.argsort(gstart, kind="stable")
+        gstart, valid = gstart[order], valid[order]
+        cstart = np.minimum(gstart, S * L - B)
+        lo = (gstart - cstart).astype(np.int32)
+        # bucket the chunk count: multiples of 8 (the split-scatter factor)
+        # on a ~1.25 geometric ladder, so partitions of one store reuse few
+        # kernel shapes without pow2's 2x row padding (scatter pays per
+        # padded row, masked or not)
+        Cp = 8
+        while Cp < C:
+            Cp = -(-int(Cp * 1.25) // 8) * 8
+        if Cp != C:
+            pad = Cp - C
+            cstart = np.concatenate([cstart, np.zeros(pad, np.int64)])
+            lo = np.concatenate([lo, np.zeros(pad, np.int32)])
+            valid = np.concatenate([valid, np.zeros(pad, np.int32)])
+        setup["compact"] = {
+            "B": B,
+            "C": Cp,
+            "cstart": cstart.astype(np.int32),
+            "lo": lo,
+            "valid": valid,
+            "whash": hash((starts.tobytes(), ends.tobytes())),
+        }
+
+    def _resolve_cache(self, plan: QueryPlan, key):
+        """Window-resolution cache host: store-level keyed by the plan's
+        cache token when the plan is reproducible from query text (so a
+        fresh plan of the same query hits), else the plan itself."""
+        token = plan.__dict__.get("cache_token")
+        if token is not None:
+            return (
+                self.store.__dict__.setdefault("_win_resolve_cache", {}),
+                key + (token,),
+            )
+        return plan.__dict__.setdefault("_win_resolve_cache", {}), key
+
+    def _fine_windows(self, plan: QueryPlan, setup):
+        """Scan windows re-resolved from a RE-COVERED key plan under a much
+        larger range budget, with the per-shard window cap lifted to match.
+
+        The planner's default cover (~2000 ranges) leaves each range a
+        degrees-wide span of the curve — fine for the padded path, whose
+        cost is per stored row, but the compacted path costs per ADMITTED
+        row and the MXU density kernel wants spatially TIGHT chunks, so a
+        16-64x finer cover pays for itself immediately. Cover + resolve
+        run once per (plan, store version) and are cached on the plan.
+        (None, None) when disabled or the keyspace can't re-plan."""
+        cover = int(os.environ.get("GEOMESA_COMPACT_COVER", 32768))
+        from geomesa_tpu import config
+        from geomesa_tpu.index import keyspace as ksmod
+
+        if cover <= (config.SCAN_RANGES_TARGET.to_int() or 2000):
+            return None, None
+        rkey = ("fine", cover, self.store.uid, self.store.version,
+                plan.index_name, plan.__dict__.get("window_token"))
+        cache, rkey = self._resolve_cache(plan, rkey)
+        hit = cache.get(rkey)
+        if hit is not None:
+            return hit
+        out = (None, None)
+        try:
+            table = setup["table"]
+            with config.SCAN_RANGES_TARGET.scoped(cover), \
+                    ksmod.window_cap(cover):
+                fine_kp = table.keyspace.plan(self.store.ft, plan.filter)
+                if fine_kp is not None:
+                    out = table.windows(fine_kp)
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "fine window resolution failed; using the planner windows",
+                exc_info=True,
+            )
+        if len(cache) >= 64:
+            cache.clear()
+        cache[rkey] = out
+        return out
+
+    def _compact_cols(self, setup, names):
+        """Window rows of ``names`` as device [C, B] slabs, gathered from
+        the (cached) padded device columns and cached per (windows, store
+        version)."""
+        import jax
+
+        d = setup["compact"]
+        B, Cp = d["B"], d["C"]
+        cache = self.store.__dict__.setdefault("_compact_cache", {})
+        key0 = (d["whash"], self.store.uid, self.store.version, B, Cp)
+        out, missing = {}, []
+        for n in names:
+            hit = cache.get(key0 + (n,))
+            (out.__setitem__(n, hit) if hit is not None else missing.append(n))
+        if missing:
+            full = setup["table"].device_columns(tuple(missing), None)
+            g = jax.device_put(d["cstart"])
+            gather = _slab_gather_fn(B)
+            if len(cache) >= 64:
+                cache.clear()
+            for n in missing:
+                out[n] = cache[key0 + (n,)] = gather(full[n].reshape(-1), g)
+        return out
+
+    def _device_compact_agg(self, plan: QueryPlan, setup, agg_fn, agg_cols=(),
+                            cache_key=None, extra=()):
+        """Mask + aggregation in one jit over the compacted [C, B] layout.
+        Same caching contract as :meth:`_device_mask_and_agg`; band rows are
+        always excised (the compact path only serves the exact device
+        path), their correction is additive host-side."""
+        import jax
+        import jax.numpy as jnp
+
+        d = setup["compact"]
+        B, Cp = d["B"], d["C"]
+        compiled = plan.compiled
+        sampling = plan.hints.sampling
+        names = tuple(dict.fromkeys(list(setup["needed"]) + list(agg_cols)))
+        cols = self._compact_cols(setup, names)
+        token = plan.__dict__.get("cache_token")
+        fn_cache = fn_key = None
+        if cache_key is not None:
+            if token is not None:
+                fn_cache = (
+                    self.kernel_fns
+                    if self.kernel_fns is not None
+                    else self.version_source.__dict__.setdefault("_kernel_fns", {})
+                )
+                fn_key = ("compact", cache_key, B, Cp, sampling, token,
+                          plan.index_name, self.version_source.version)
+            else:
+                fn_cache = plan.__dict__.setdefault("_kernel_fns", {})
+                fn_key = ("compact", cache_key, B, Cp, sampling)
+        go = fn_cache.get(fn_key) if fn_cache is not None else None
+        if go is None:
+
+            @jax.jit
+            def go(cols, lo, valid, extra):
+                iota = jnp.arange(B, dtype=jnp.int32)[None, :]
+                m = (iota >= lo[:, None]) & (iota < (lo + valid)[:, None])
+                m = m & compiled(cols, jnp)
+                if compiled.band is not None:
+                    m = m & ~compiled.band(cols, jnp)
+                if sampling:
+                    m = kmasks.sampling_mask(m, sampling, jnp)
+                return agg_fn(cols, m, jnp, *extra)
+
+            if fn_cache is not None:
+                if len(fn_cache) >= 64:
+                    fn_cache.clear()
+                fn_cache[fn_key] = go
+        wcache = self.store.__dict__.setdefault("_win_cache", {})
+        wkey = ("compact_win", d["whash"], B, Cp, self.store.uid,
+                self.store.version)
+        win = wcache.get(wkey)
+        if win is None:
+            win = (jax.device_put(d["lo"]), jax.device_put(d["valid"]))
+            if len(wcache) >= 64:
+                wcache.clear()
+            wcache[wkey] = win
+        return go(cols, win[0], win[1], tuple(extra))
+
+    def _expand_compact_mask(self, setup, cmask) -> np.ndarray:
+        """[C, B] compact mask -> [S, L] padded mask (host, vectorized —
+        the chunk count can reach tens of thousands under the fine cover,
+        so a per-chunk Python loop would cost more than the scan)."""
+        d = setup["compact"]
+        table = setup["table"]
+        S, L = table.n_shards, setup["L"]
+        B = d["B"]
+        out = np.zeros(S * L, bool)
+        cm = np.asarray(cmask)
+        cstart = d["cstart"].astype(np.int64)
+        lo, valid = d["lo"].astype(np.int64), d["valid"].astype(np.int64)
+        n = int(valid.sum())
+        if n == 0:
+            return out.reshape(S, L)
+        # flat positions of every valid (chunk, row) cell, in chunk order
+        c_of = np.repeat(np.arange(len(valid)), valid)
+        r_of = np.arange(n) - np.repeat(np.cumsum(valid) - valid, valid)
+        out[cstart[c_of] + lo[c_of] + r_of] = cm[c_of, lo[c_of] + r_of]
+        return out.reshape(S, L)
 
     def _device_coarse_mask(self, plan: QueryPlan, setup) -> np.ndarray:
         """Window mask ∧ coarse predicate as ONE device kernel, packed
@@ -523,8 +827,43 @@ class Executor:
             jax.device_put(setup["counts"].astype(np.int32), cnt_sh),
         )
 
+    def _density_pairs(self, plan: QueryPlan, setup, bbox, width, height):
+        """(chunk, tile) pair arrays for the MXU density kernel, cached on
+        device per (windows, grid, store version). None when the index has
+        no morton key or the kernel is disabled."""
+        if os.environ.get("GEOMESA_DENSITY_MXU", "1") == "0":
+            return None
+        import jax
+
+        d = setup["compact"]
+        table = setup["table"]
+        from geomesa_tpu.kernels import density_mxu as _dm
+
+        cache = self.store.__dict__.setdefault("_pair_cache", {})
+        key = (d["whash"], tuple(bbox), width, height, d["B"], d["C"],
+               _dm.TILE_X, _dm.TILE_Y, self.store.uid, self.store.version)
+        hit = cache.get(key)
+        if hit is None:
+            from geomesa_tpu.kernels import density_mxu
+
+            pr = density_mxu.build_pairs(
+                d, table, table.keyspace, bbox, width, height,
+                box_cache=self.store.__dict__.setdefault(
+                    "_chunk_box_cache", {}
+                ),
+                version=self.store.version,
+            )
+            if pr is not None:
+                for k in ("chunk", "px0", "py0", "tile", "pvalid"):
+                    pr[k] = jax.device_put(pr[k])
+            if len(cache) >= 64:
+                cache.clear()
+            hit = cache[key] = pr if pr is not None else False
+        return hit or None
+
     def _run(self, plan: QueryPlan, agg_fn_dev, agg_fn_host, agg_cols=(),
-             cache_key=None, additive=False, extra=()):
+             cache_key=None, additive=False, extra=(), compactable=True,
+             compact_agg=None):
         check_deadline()
         setup = self._scan_setup(plan, agg_cols)
         if setup is None:
@@ -560,9 +899,24 @@ class Executor:
                         "binspace scan failed, trying GSPMD path: %r", e
                     )
             try:
-                out = self._device_mask_and_agg(
-                    plan, setup, agg_fn_dev, agg_cols, cache_key, extra=extra
-                )
+                self._maybe_compact(plan, setup, compactable)
+                if setup["compact"] is not None:
+                    agg_use, extra_use, ckey = agg_fn_dev, extra, cache_key
+                    if compact_agg is not None:
+                        alt = compact_agg(setup)
+                        if alt is not None:
+                            agg_use, alt_extra, suffix = alt
+                            extra_use = tuple(extra) + tuple(alt_extra)
+                            ckey = (cache_key or ()) + suffix
+                    out = self._device_compact_agg(
+                        plan, setup, agg_use, agg_cols, ckey,
+                        extra=extra_use,
+                    )
+                else:
+                    out = self._device_mask_and_agg(
+                        plan, setup, agg_fn_dev, agg_cols, cache_key,
+                        extra=extra,
+                    )
                 return out if corr is None else out + corr
             except Exception as e:
                 if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
@@ -610,11 +964,22 @@ class Executor:
             band_clean = info is None or len(info) == 0
         if setup["use_device"] and band_clean:
             try:
-                mask = np.asarray(
-                    self._device_mask_and_agg(
-                        plan, setup, lambda cols, m, xp: m, cache_key=("mask",)
+                self._maybe_compact(plan, setup, True)
+                if setup["compact"] is not None:
+                    mask = self._expand_compact_mask(
+                        setup,
+                        self._device_compact_agg(
+                            plan, setup, lambda cols, m, xp: m,
+                            cache_key=("mask",),
+                        ),
                     )
-                )
+                else:
+                    mask = np.asarray(
+                        self._device_mask_and_agg(
+                            plan, setup, lambda cols, m, xp: m,
+                            cache_key=("mask",),
+                        )
+                    )
             except Exception as e:
                 if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
                     raise
@@ -667,10 +1032,34 @@ class Executor:
                 cols[xc], cols[yc], m, bbox, width, height, w, xp
             )
 
+        def mxu_agg(setup):
+            # scatter-free MXU formulation over the compacted layout
+            # (kernels/density_mxu.py); falls back to the scatter agg when
+            # the index has no morton key column
+            pr = self._density_pairs(plan, setup, bbox, width, height)
+            if pr is None:
+                return None
+            from geomesa_tpu.kernels import density_mxu as kmxu
+
+            PB, ntx, nty = pr["PB"], pr["ntx"], pr["nty"]
+
+            def pagg(cols, m, xp, pc, p0, p1, pt, pv):
+                return kmxu.density_grid_pairs(
+                    cols[xc], cols[yc], m, bbox, width, height,
+                    cols.get(weight) if weight else None,
+                    pc, p0, p1, pt, pv, PB, ntx, nty, xp,
+                )
+
+            extra = (pr["chunk"], pr["px0"], pr["py0"], pr["tile"],
+                     pr["pvalid"])
+            return pagg, extra, ("mxu", pr["P"], PB, kmxu.TILE_X,
+                                 kmxu.TILE_Y)
+
         out = self._run(
             plan, agg, agg, agg_cols,
             cache_key=("density", tuple(bbox), width, height, weight),
             additive=True,
+            compact_agg=mxu_agg,
         )
         if out is None:
             return np.zeros((height, width), np.float32)
@@ -766,6 +1155,7 @@ class Executor:
             plan, agg, agg, agg_cols,
             cache_key=("density_curve", level, len(p0), weight),
             extra=(p0, p1),
+            compactable=False,  # CDF positions index the padded layout
         )
         if out is None:
             return np.zeros((ny, nx), np.float64)
@@ -854,6 +1244,7 @@ class Executor:
         out = self._run(
             plan, agg, agg, [xc, yc], cache_key=("knn", int(k), nb),
             extra=tuple(extra),
+            compactable=False,  # returned indices address the padded layout
         )
         if out is None:
             return np.zeros(0, np.int64), np.zeros(0)

@@ -407,7 +407,10 @@ def spatial_join(
     # cache the jitted kernel per polygon-set signature (re-join with the
     # same polygons skips retracing)
     sig = hash((edges["x1"].tobytes(), edges["poly_id"].tobytes()))
-    out = ex._run(plan, agg, agg, agg_cols, cache_key=("pip_join", sig))
+    out = ex._run(
+        plan, agg, agg, agg_cols, cache_key=("pip_join", sig),
+        compactable=False,  # the assignment is addressed in [S*L] layout
+    )
     if out is None:
         return np.zeros(0, np.int32), np.zeros(len(geoms), np.float32)
     assign_flat = np.asarray(out)
