@@ -355,11 +355,30 @@ class GeoDataset:
         st = self._store(name)
         st.flush()
         q = Query(ecql=query) if isinstance(query, str) else query
+        auths = self._effective_auths(q)
+        # Plan-object cache (IteratorCache.scala:30 analog: remote servers
+        # cache parsed filters by spec string): a plan is pure in (query,
+        # auths, schema/store version, interceptor registry), and reusing
+        # the OBJECT also reuses the window/kernel caches that live on it.
+        pkey = None
+        if explain is None and isinstance(q.ecql, str):
+            from geomesa_tpu.planning import interceptors
+
+            pkey = (name, repr(q), None if auths is None else tuple(auths),
+                    st.uid, st.version, interceptors.version())
+            cache = self.__dict__.setdefault("_plan_cache", {})
+            hit = cache.get(pkey)
+            if hit is not None:
+                # guards are config-dependent (e.g. BLOCK_FULL_TABLE_SCANS
+                # may have flipped since the plan was cached): re-check
+                # them on every hit — they are cheap; planning is not
+                QueryPlanner(st)._guard(hit.key_plan, hit.filter, Explainer())
+                interceptors.apply_guards(st.ft, hit)
+                return st, q, hit
         planner = QueryPlanner(st)
         t0 = time.perf_counter()
         with metrics.registry().timer("query.plan").time():
             plan = planner.plan(q.ecql, q.hints(), explain)
-        auths = self._effective_auths(q)
         self._apply_visibility(st, plan, auths)
         if isinstance(q.ecql, str):
             # the predicate is reproducible from text + auths + the
@@ -372,6 +391,10 @@ class GeoDataset:
                 hash(repr(plan.filter)),
             )
         plan.__dict__["plan_time_ms"] = (time.perf_counter() - t0) * 1e3
+        if pkey is not None:
+            if len(cache) >= 256:
+                cache.clear()
+            cache[pkey] = plan
         return st, q, plan
 
     def _audit(self, name: str, q: Query, plan, t_scan0: float, hits: int,
@@ -442,9 +465,29 @@ class GeoDataset:
     def query(self, name: str, query: "str | Query" = "INCLUDE") -> FeatureCollection:
         st, q, plan = self._plan(name, query)
         t0 = time.perf_counter()
+        ex = self._executor(st)
         with metrics.registry().timer("query.scan").time(), \
                 query_deadline(self._timeout_s()):
-            batch = self._executor(st).features(plan)
+            batch = None
+            # sort+limit pushdown: a single-key top-k ranks on device and
+            # gathers only k rows instead of the whole result set (the
+            # host re-sorts those k rows at f64 below, so the final order
+            # is exact for the selected set)
+            if (
+                q.sort_by and len(q.sort_by) == 1
+                and q.max_features is not None and q.max_features <= 4096
+                and hasattr(ex, "top_rows")
+            ):
+                attr, desc = q.sort_by[0]
+                idx = ex.top_rows(plan, attr, desc, q.max_features)
+                if idx is not None:
+                    table = st.tables[plan.index_name]
+                    names = None
+                    if plan.hints.properties:
+                        names = list(plan.hints.properties) + [attr]
+                    batch = table.host_gather_positions(idx, names)
+            if batch is None:
+                batch = ex.features(plan)
         self._audit(name, q, plan, t0, batch.n)
         # post-processing: sort -> limit -> projection (QueryPlanner.runQuery
         # order, reference QueryPlanner.scala:68-90)
@@ -453,6 +496,14 @@ class GeoDataset:
             order = np.arange(batch.n)
             for attr, desc in reversed(q.sort_by):
                 col = batch.columns[attr][order]
+                if attr in st.dicts:
+                    # dictionary codes are insertion-ordered: decode so
+                    # ORDER BY a string is lexicographic (nulls first)
+                    col = np.asarray(
+                        [v if v is not None else ""
+                         for v in st.dicts[attr].decode(col)],
+                        dtype=object,
+                    )
                 if desc:
                     o2 = (batch.n - 1) - np.argsort(col[::-1], kind="stable")[::-1]
                 else:
@@ -771,9 +822,7 @@ class GeoDataset:
             else:
                 idx, _ = ex.knn(plan, x, y, k, boxes=boxes)
                 table = st.tables[plan.index_name]
-                mask = np.zeros(table.n_shards * table.shard_len, dtype=bool)
-                mask[idx] = True
-                batch = table.host_gather(mask)
+                batch = table.host_gather_positions(np.sort(idx))
             order = np.zeros(0, np.int64)
             kth_m = math.inf
             if batch.n:

@@ -65,6 +65,9 @@ class ConverterConfig:
     format: Any = "CSV"
     options: Dict[str, Any] = field(default_factory=dict)
     feature_path: Optional[str] = None
+    #: enrichment-cache configs by name (EnrichmentCache.scala:19):
+    #: {type: simple, data: {...}} or {type: csv, path, id-field, columns}
+    caches: Dict[str, Dict[str, Any]] = field(default_factory=dict)
 
     @staticmethod
     def parse(source: "str | Dict") -> "ConverterConfig":
@@ -80,7 +83,44 @@ class ConverterConfig:
             format=cfg.get("format", "CSV"),
             options=dict(cfg.get("options", {})),
             feature_path=cfg.get("feature-path") or cfg.get("feature_path"),
+            caches=dict(cfg.get("caches", {})),
         )
+
+
+def load_enrichment_caches(
+    configs: Dict[str, Dict[str, Any]],
+) -> Dict[str, Dict[str, Dict[str, Any]]]:
+    """Materialize enrichment caches: name -> {key -> {field -> value}}.
+
+    ``simple`` holds inline data (SimpleEnrichmentCache); ``csv`` loads a
+    delimited file keyed by ``id-field`` (ResourceLoadingCache, but from a
+    filesystem path — there is no classpath here)."""
+    import csv as _csv
+
+    out: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for name, conf in (configs or {}).items():
+        ctype = conf.get("type", "simple")
+        if ctype == "simple":
+            out[name] = {
+                str(k): dict(v) for k, v in (conf.get("data") or {}).items()
+            }
+        elif ctype == "csv":
+            path = conf["path"]
+            id_field = conf.get("id-field") or conf.get("id_field")
+            columns = conf.get("columns")
+            table: Dict[str, Dict[str, Any]] = {}
+            with open(path, newline="") as fh:
+                reader = (
+                    _csv.DictReader(fh, fieldnames=list(columns))
+                    if columns
+                    else _csv.DictReader(fh)
+                )
+                for rec in reader:
+                    table[str(rec[id_field])] = dict(rec)
+            out[name] = table
+        else:
+            raise ValueError(f"unknown enrichment cache type {ctype!r}")
+    return out
 
 
 class _LineTee:
@@ -123,8 +163,11 @@ class BaseConverter:
                    ctx: EvaluationContext,
                    preset: Optional[Dict[str, np.ndarray]] = None):
         """raw columns -> (data dict, fids, kept-mask)."""
+        caches = self.__dict__.get("_caches")
+        if caches is None:
+            caches = self._caches = load_enrichment_caches(self.config.caches)
         ectx = ex.Context(raw=raw, fields=dict(preset or {}), n=n,
-                          line_offset=line_offset)
+                          line_offset=line_offset, caches=caches)
         keep = np.ones(n, dtype=bool)
         for name, expr in self._field_exprs:
             try:
@@ -163,6 +206,7 @@ class BaseConverter:
                 raw=[a[i: i + 1] for a in ectx.raw],
                 fields={k: v[i: i + 1] for k, v in ectx.fields.items()},
                 n=1, line_offset=ectx.line_offset + i,
+                caches=ectx.caches,
             )
             try:
                 vals[i] = expr.eval(row_ctx)[0]

@@ -42,6 +42,9 @@ class Context:
     n: int
     #: global line-number offset of this batch
     line_offset: int = 0
+    #: enrichment caches by name (EnrichmentCache.scala:19 analog):
+    #: name -> {key -> {field -> value}}
+    caches: "Dict[str, Dict[str, Dict[str, object]]]" = None
 
 
 class Expr:
@@ -385,6 +388,25 @@ def _string_to_bytes(ctx, a):
 @register("lineNumber")
 def _line_no(ctx):
     return np.arange(ctx.line_offset, ctx.line_offset + ctx.n, dtype=np.int64)
+
+
+@register("cacheLookup")
+def _cache_lookup(ctx, name, key, field):
+    """Enrichment-cache lookup (EnrichmentCacheFunctionFactory.scala:24:
+    cacheLookup(cache, entity-key, field)) — vectorized over the batch:
+    missing entities/fields yield None (the reference returns null)."""
+    caches = ctx.caches or {}
+    cname = name[0] if isinstance(name, np.ndarray) else name
+    cache = caches.get(str(cname))
+    if cache is None:
+        raise EvalError(f"no enrichment cache named {cname!r}")
+    keys = _as_obj(key)
+    fields = _as_obj(field)
+    out = np.empty(ctx.n, dtype=object)
+    for i in range(ctx.n):
+        row = cache.get(str(keys[i]))
+        out[i] = None if row is None else row.get(str(fields[i]))
+    return out
 
 
 # lazy control flow

@@ -330,6 +330,21 @@ class IndexTable:
             local = global_mask[s * L : s * L + (sl.stop - sl.start)]
             idx.append(np.nonzero(local)[0] + sl.start)
         sel = np.concatenate(idx) if idx else np.zeros(0, np.int64)
+        return self._gather_sorted(sel, names)
+
+    def host_gather_positions(self, positions: np.ndarray,
+                              names: Optional[Sequence[str]] = None) -> ColumnBatch:
+        """Like :meth:`host_gather` but from padded [S*L] flat POSITIONS
+        (device top-k / kNN results) — O(k), never touching a full-table
+        mask. Row order follows ``positions``."""
+        positions = np.asarray(positions, np.int64)
+        L = self.shard_len
+        s = positions // L
+        sel = self.shard_bounds[s] + (positions - s * L)
+        return self._gather_sorted(sel, names)
+
+    def _gather_sorted(self, sel: np.ndarray,
+                       names: Optional[Sequence[str]] = None) -> ColumnBatch:
         rows = self.order[sel]
         cols = self.column_names() if names is None else [
             k for k in self.column_names()

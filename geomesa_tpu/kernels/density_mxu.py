@@ -38,8 +38,10 @@ def pair_batch(B: int) -> int:
     return max(8, min(4096, _PAIR_ROWS // max(B, 1)))
 
 
-def _ladder8(n: int) -> int:
-    """Geometric (~1.25x) bucket ladder on multiples of 8."""
+def ladder8(n: int) -> int:
+    """Geometric (~1.25x) bucket ladder on multiples of 8 — the shared
+    shape-bucketing rule for compact chunk counts and MXU pair padding
+    (both feed compiled kernel shapes; one rule keeps them aligned)."""
     b = 8
     while b < n:
         b = -(-int(b * 1.25) // 8) * 8
@@ -180,7 +182,7 @@ def build_pairs(
     tx = tx0[chunk_of] + (j % np.maximum(nx[chunk_of], 1))
     ty = ty0[chunk_of] + (j // np.maximum(nx[chunk_of], 1))
     PB = pair_batch(B)
-    Pp = -(-_ladder8(P) // PB) * PB
+    Pp = -(-ladder8(P) // PB) * PB
     pad = Pp - P
 
     def _pad(a, fill=0):

@@ -88,6 +88,24 @@ def sampling_mask_by_key(mask: np.ndarray, n: int, key_codes: np.ndarray) -> np.
     return out.reshape(mask.shape)
 
 
+def sampling_mask_by_key_device(mask, n: int, codes, vocab_size: int, xp):
+    """Device twin of :func:`sampling_mask_by_key` for dictionary-coded
+    int32 key columns with a known (small) vocabulary: same deterministic
+    per-key 1-in-n counter. Sort-free by design — device sort compiles
+    pathologically on this TPU toolchain — instead one cumsum per code
+    value gives each row its rank within its key (vocab_size cumsums, each
+    bandwidth-bound; vocabularies here are query sample keys, typically
+    tens of values)."""
+    flat = mask.reshape(-1)
+    codes = codes.reshape(-1)
+    keep = xp.zeros(flat.shape[0], dtype=bool)
+    for v in range(-1, vocab_size):  # -1 = null key, its own group (host parity)
+        mv = flat & (codes == v)
+        rank = xp.cumsum(mv.astype(xp.int32)) - 1
+        keep = keep | (mv & ((rank % n) == 0))
+    return keep.reshape(mask.shape)
+
+
 def sampling_mask(mask, n: int, xp):
     """Keep ~1-in-n of the masked rows (SamplingIterator analog): deterministic
     modulo on the running match index so the sample is stable."""

@@ -137,21 +137,34 @@ class Executor:
         counts = np.diff(table.shard_bounds).astype(np.int32)
         L = table.shard_len
         needed = list(dict.fromkeys(list(plan.compiled.columns) + list(extra_cols)))
+        # sample_by is meaningless without a sampling rate.
+        if plan.hints.sample_by and not plan.hints.sampling:
+            raise ValueError("sample_by requires sampling (the 1-in-n rate)")
+        # per-key sampling runs on device when the key is a dictionary-
+        # coded string with a small vocabulary (the sort-free per-code
+        # cumsum kernel needs one pass per value); other dtypes fall back
+        # to the host counter (the reference runs it inside the iterator
+        # loop) — float keys would additionally merge distinct values at
+        # f32.
+        sb = plan.hints.sample_by
+        sb_device = bool(
+            sb and table.has_column(sb) and not table.is_host_only(sb)
+            and table.dtype_of(sb) == np.int32
+            and sb in self.store.dicts
+            and 0 < len(self.store.dicts[sb]) <= 256
+        )
+        if sb_device:
+            needed = list(dict.fromkeys(needed + [sb]))
         host_only = [
             c for c in needed
             if not table.has_column(c) or table.is_host_only(c)
         ]
-        # per-key sampling needs an exact running counter per key value —
-        # host path only (the reference runs it inside the iterator loop).
-        # sample_by is meaningless without a sampling rate.
-        if plan.hints.sample_by and not plan.hints.sampling:
-            raise ValueError("sample_by requires sampling (the 1-in-n rate)")
         # extent-geometry refinement (exact spatial predicates) runs on the
         # host __wkt columns, so the whole mask must be host-resident before
         # aggregation — route such plans through the host path
         use_device = (
             self.prefer_device and not host_only
-            and not plan.hints.sample_by
+            and (sb is None or sb_device)
             and (
                 plan.compiled.refine is None
                 or plan.compiled.refine_only_if_band
@@ -259,13 +272,12 @@ class Executor:
         gstart, valid = gstart[order], valid[order]
         cstart = np.minimum(gstart, S * L - B)
         lo = (gstart - cstart).astype(np.int32)
-        # bucket the chunk count: multiples of 8 (the split-scatter factor)
-        # on a ~1.25 geometric ladder, so partitions of one store reuse few
-        # kernel shapes without pow2's 2x row padding (scatter pays per
-        # padded row, masked or not)
-        Cp = 8
-        while Cp < C:
-            Cp = -(-int(Cp * 1.25) // 8) * 8
+        # bucket the chunk count (shared ladder with the MXU pair padding),
+        # so partitions of one store reuse few kernel shapes without pow2's
+        # 2x row padding (scatter pays per padded row, masked or not)
+        from geomesa_tpu.kernels.density_mxu import ladder8
+
+        Cp = ladder8(C)
         if Cp != C:
             pad = Cp - C
             cstart = np.concatenate([cstart, np.zeros(pad, np.int64)])
@@ -370,6 +382,11 @@ class Executor:
         B, Cp = d["B"], d["C"]
         compiled = plan.compiled
         sampling = plan.hints.sampling
+        sample_by = plan.hints.sample_by
+        sb_vocab = (
+            len(self.store.dicts[sample_by])
+            if sample_by and sample_by in self.store.dicts else 0
+        )
         names = tuple(dict.fromkeys(list(setup["needed"]) + list(agg_cols)))
         cols = self._compact_cols(setup, names)
         token = plan.__dict__.get("cache_token")
@@ -381,11 +398,11 @@ class Executor:
                     if self.kernel_fns is not None
                     else self.version_source.__dict__.setdefault("_kernel_fns", {})
                 )
-                fn_key = ("compact", cache_key, B, Cp, sampling, token,
-                          plan.index_name, self.version_source.version)
+                fn_key = ("compact", cache_key, B, Cp, sampling, sample_by,
+                          token, plan.index_name, self.version_source.version)
             else:
                 fn_cache = plan.__dict__.setdefault("_kernel_fns", {})
-                fn_key = ("compact", cache_key, B, Cp, sampling)
+                fn_key = ("compact", cache_key, B, Cp, sampling, sample_by)
         go = fn_cache.get(fn_key) if fn_cache is not None else None
         if go is None:
 
@@ -396,7 +413,11 @@ class Executor:
                 m = m & compiled(cols, jnp)
                 if compiled.band is not None:
                     m = m & ~compiled.band(cols, jnp)
-                if sampling:
+                if sampling and sample_by:
+                    m = kmasks.sampling_mask_by_key_device(
+                        m, sampling, cols[sample_by], sb_vocab, jnp
+                    )
+                elif sampling:
                     m = kmasks.sampling_mask(m, sampling, jnp)
                 return agg_fn(cols, m, jnp, *extra)
 
@@ -659,6 +680,11 @@ class Executor:
         # coarse-mask kernels must NOT sample: sampling runs once on the
         # host, AFTER refinement (the 1-in-n counter sees exact matches)
         sampling = plan.hints.sampling if apply_sampling else None
+        sample_by = plan.hints.sample_by if apply_sampling else None
+        sb_vocab = (
+            len(self.store.dicts[sample_by])
+            if sample_by and sample_by in self.store.dicts else 0
+        )
 
         # Two caches with different lifetimes:
         # 1. the jitted kernel — reusable across API calls (same predicate
@@ -679,11 +705,11 @@ class Executor:
                     if self.kernel_fns is not None
                     else self.version_source.__dict__.setdefault("_kernel_fns", {})
                 )
-                fn_key = (cache_key, L, K, sampling, token, plan.index_name,
-                          self.version_source.version)
+                fn_key = (cache_key, L, K, sampling, sample_by, token,
+                          plan.index_name, self.version_source.version)
             else:  # raw-IR plan: cache on the plan (shared across partitions)
                 fn_cache = plan.__dict__.setdefault("_kernel_fns", {})
-                fn_key = (cache_key, L, K, sampling)
+                fn_key = (cache_key, L, K, sampling, sample_by)
         go = fn_cache.get(fn_key) if fn_cache is not None else None
         if go is None:
 
@@ -697,7 +723,11 @@ class Executor:
                     # added back host-side from their f64 values. COARSE
                     # masks keep them (they are the refinement candidates).
                     m = m & ~compiled.band(cols, jnp)
-                if sampling:
+                if sampling and sample_by:
+                    m = kmasks.sampling_mask_by_key_device(
+                        m, sampling, cols[sample_by], sb_vocab, jnp
+                    )
+                elif sampling:
                     m = kmasks.sampling_mask(m, sampling, jnp)
                 return agg_fn(cols, m, jnp, *extra)
 
@@ -1205,6 +1235,56 @@ class Executor:
             stat.observe(batch.columns)
             kstats.decode_enum_keys(stat, self.store.dicts)
         return stat
+
+    def top_rows(self, plan: QueryPlan, attr: str, descending: bool,
+                 k: int):
+        """Flattened [S*L] positions of the top-k matched rows by one
+        attribute — the device half of a sorted+limited query (reference
+        SortingSimpleFeatureIterator, done as a masked top_k so the host
+        never gathers the full result set). Only offered for NATIVE
+        float32 columns, where device ranking is exact: an f64→f32 or
+        int32→f32 cast merges near-equal keys, and dictionary-coded
+        strings rank by insertion-order code, not value. Returns None when
+        the column can't rank exactly on device (caller sorts on host)."""
+        table = self._table(plan)
+        if (
+            not table.has_column(attr)
+            or table.is_host_only(attr)
+            or table.dtype_of(attr) != np.float32
+            or attr in self.store.dicts
+            or k > 32  # argmin iteration only: device sort compile hangs
+        ):
+            return None
+
+        def agg(cols, m, xp, *extra):
+            v = cols[attr].reshape(-1).astype(xp.float32)
+            d = xp.where(m.reshape(-1), -v if descending else v, xp.inf)
+            # argmin iteration (same tradeoff as kernels/knn.py): both
+            # lax.top_k and sort-based top-k compile pathologically on
+            # this TPU toolchain, so large k stays on the host
+            idxs, vals = [], []
+            for _ in range(k):
+                i = xp.argmin(d)
+                idxs.append(i)
+                vals.append(-d[i] if descending else d[i])
+                d = d.at[i].set(xp.inf)
+            return xp.stack(idxs), xp.stack(vals)
+
+        def agg_host(cols, m, xp, *extra):
+            v = cols[attr].reshape(-1).astype(np.float64)
+            v = np.where(m.reshape(-1), v if descending else -v, -np.inf)
+            idx = np.argsort(-v, kind="stable")[:k]
+            return idx, v[idx]
+
+        out = self._run(
+            plan, agg, agg_host, [attr],
+            cache_key=("top", attr, bool(descending), int(k)),
+            compactable=False,  # returned indices address the padded layout
+        )
+        if out is None:
+            return np.zeros(0, np.int64)
+        idx, vals = np.asarray(out[0]), np.asarray(out[1])
+        return idx[np.isfinite(vals)].astype(np.int64)
 
     def knn(self, plan: QueryPlan, qx: float, qy: float, k: int, boxes=None):
         """k nearest to (qx, qy) among plan matches. ``boxes`` (optional):

@@ -29,19 +29,32 @@ _loaded_userdata: Dict[str, List[Any]] = {}
 USER_DATA_KEY = "geomesa.query.interceptors"
 
 
+_version = 0
+
+
+def version() -> int:
+    """Bumped on every registry mutation — cache key for anything derived
+    from a planned query (plans are pure in (filter, hints, interceptors))."""
+    return _version
+
+
 def register(type_name: str, interceptor: Any):
     """Programmatic registration for one schema name."""
+    global _version
     with _lock:
         _registry.setdefault(type_name, []).append(interceptor)
+        _version += 1
 
 
 def clear(type_name: "str | None" = None):
+    global _version
     with _lock:
         if type_name is None:
             _registry.clear()
             _loaded_userdata.clear()
         else:
             _registry.pop(type_name, None)
+        _version += 1
 
 
 def _load_path(path: str) -> Any:

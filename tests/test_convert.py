@@ -233,3 +233,40 @@ def test_infer_schema():
     ctx = ds.ingest(ft.name, sample, cfg)
     assert ctx.success == 2
     assert ds.count(ft.name) == 2
+
+
+def test_enrichment_cache_lookup(tmp_path):
+    """cacheLookup(cache, key, field) with simple + csv caches
+    (EnrichmentCacheFunctionFactory.scala:24, EnrichmentCache.scala:19)."""
+    from geomesa_tpu.convert.converter import ConverterConfig, converter_for
+    from geomesa_tpu.schema.feature_type import FeatureType
+
+    csv_path = tmp_path / "lookup.csv"
+    csv_path.write_text("id,name,pop\nUS,United States,331\nFR,France,67\n")
+    conf = ConverterConfig.parse({
+        "type": "delimited-text",
+        "format": "CSV",
+        "id-field": "$cc",
+        "fields": [
+            {"name": "cc", "transform": "$1"},
+            {"name": "country", "transform": "cacheLookup('geo', $1, 'name')"},
+            {"name": "pop", "transform": "cacheLookup('geo', $1, 'pop')"},
+            {"name": "label", "transform": "cacheLookup('tags', $1, 'label')"},
+            {"name": "lon", "transform": "toDouble($2)"},
+            {"name": "lat", "transform": "toDouble($3)"},
+            {"name": "geom", "transform": "point($lon, $lat)"},
+        ],
+        "caches": {
+            "geo": {"type": "csv", "path": str(csv_path), "id-field": "id"},
+            "tags": {"type": "simple",
+                     "data": {"US": {"label": "us-tag"}}},
+        },
+    })
+    ft = FeatureType.from_spec(
+        "t", "cc:String,country:String,pop:String,label:String,*geom:Point"
+    )
+    conv = converter_for(ft, conf)
+    (data, fids), = conv.convert(["US,-100.0,40.0", "FR,2.0,48.0"])
+    assert list(data["country"]) == ["United States", "France"]
+    assert list(data["pop"]) == ["331", "67"]
+    assert list(data["label"]) == ["us-tag", None]

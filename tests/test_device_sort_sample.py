@@ -1,0 +1,143 @@
+"""Device pushdown of sort+limit (top-k) and per-key sampling
+(SortingSimpleFeatureIterator / SamplingIterator analogs — both
+previously host-only post-passes)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.api.dataset import Query
+from geomesa_tpu.filter.ecql import parse_iso_ms
+
+
+@pytest.fixture
+def ds_data():
+    rng = np.random.default_rng(5)
+    n = 40_000
+    lo = parse_iso_ms("2020-01-01")
+    hi = parse_iso_ms("2020-02-01")
+    data = {
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+        "dtg": rng.integers(lo, hi, n).astype("datetime64[ms]"),
+        "weight": rng.uniform(0, 1, n).astype(np.float32),
+        "kind": rng.choice(["a", "b", "c", "d"], n),
+        "code": rng.integers(0, 50, n).astype(np.int32),
+    }
+    ds = GeoDataset(n_shards=4)
+    ds.create_schema(
+        "t", "weight:Float,kind:String,code:Integer,dtg:Date,*geom:Point"
+    )
+    ds.insert("t", data, fids=np.arange(n).astype(str))
+    ds.flush("t")
+    return ds, data
+
+
+ECQL = "BBOX(geom, -100, 30, -80, 45)"
+
+
+def _mask(data):
+    x, y = data["geom__x"], data["geom__y"]
+    return (x >= -100) & (x <= -80) & (y >= 30) & (y <= 45)
+
+
+def test_topk_sorted_query_matches_host(ds_data):
+    ds, data = ds_data
+    m = _mask(data)
+    for desc, k in ((True, 7), (False, 7), (True, 100)):
+        out = ds.query("t", Query(ecql=ECQL, sort_by=[("weight", desc)],
+                                  max_features=k))
+        w = np.sort(data["weight"][m])
+        want = w[::-1][:k] if desc else w[:k]
+        np.testing.assert_allclose(
+            out.columns["weight"], want, rtol=0, atol=0
+        )
+
+
+def test_topk_projection(ds_data):
+    ds, data = ds_data
+    out = ds.query("t", Query(ecql=ECQL, sort_by=[("weight", True)],
+                              max_features=5, properties=["weight"]))
+    assert len(out) == 5
+    assert "weight" in out.columns
+
+
+def test_sample_by_device_matches_host(ds_data, monkeypatch):
+    ds, data = ds_data
+    # string key (dictionary codes ride the device as int32)
+    n_dev = ds.count("t", Query(ecql=ECQL, sampling=10, sample_by="kind"))
+    monkeypatch.setenv("GEOMESA_TPU_NO_COMPACT", "1")
+    n_dev2 = ds.count("t", Query(ecql=ECQL, sampling=10, sample_by="kind"))
+    monkeypatch.delenv("GEOMESA_TPU_NO_COMPACT")
+    assert n_dev == n_dev2
+    # host oracle: per-key 1-in-10 over matched rows
+    m = _mask(data)
+    want = 0
+    for kname in ("a", "b", "c", "d"):
+        cnt = int((m & (data["kind"] == kname)).sum())
+        want += -(-cnt // 10)
+    assert n_dev == want
+
+
+def test_sample_by_int_key(ds_data):
+    ds, data = ds_data
+    n_dev = ds.count("t", Query(ecql=ECQL, sampling=5, sample_by="code"))
+    m = _mask(data)
+    want = sum(
+        -(-int((m & (data["code"] == c)).sum()) // 5)
+        for c in np.unique(data["code"])
+    )
+    assert n_dev == want
+
+
+def test_sample_by_null_keys(ds_data):
+    """Null sample keys form their own group on both paths (host parity:
+    DictionaryEncoder codes None as -1)."""
+    ds, data = ds_data
+    n = 5_000
+    rng = np.random.default_rng(8)
+    kinds = rng.choice(["x", None, "y"], n)
+    d2 = {
+        "geom__x": rng.uniform(-99, -81, n),
+        "geom__y": rng.uniform(31, 44, n),
+        "dtg": np.full(n, parse_iso_ms("2020-01-10")).astype("datetime64[ms]"),
+        "weight": np.ones(n, np.float32),
+        "kind": kinds,
+        "code": np.zeros(n, np.int32),
+    }
+    ds2 = GeoDataset(n_shards=2)
+    ds2.create_schema(
+        "t", "weight:Float,kind:String,code:Integer,dtg:Date,*geom:Point"
+    )
+    ds2.insert("t", d2, fids=np.arange(n).astype(str))
+    ds2.flush("t")
+    got = ds2.count("t", Query(ecql="INCLUDE", sampling=7, sample_by="kind"))
+    want = sum(
+        -(-int((kinds == kname).sum()) // 7) for kname in ("x", "y")
+    ) + -(-int(sum(k is None for k in kinds)) // 7)
+    assert got == want
+
+
+def test_string_sort_stays_on_host(ds_data):
+    """ORDER BY a string column must rank lexicographically, not by
+    dictionary code (insertion order) — so the device top-k declines."""
+    ds, data = ds_data
+    m = _mask(data)
+    out = ds.query("t", Query(ecql=ECQL, sort_by=[("kind", False)],
+                              max_features=5))
+    st = ds._store("t")
+    got = st.dicts["kind"].decode(out.columns["kind"])
+    want = np.sort(data["kind"][m].astype(str))[:5]
+    assert got == list(want)
+
+
+def test_sample_by_float_falls_back_to_host(ds_data):
+    ds, data = ds_data
+    # float keys would merge distinct values at f32: host path, still exact
+    n = ds.count("t", Query(ecql=ECQL, sampling=3, sample_by="weight"))
+    m = _mask(data)
+    want = sum(
+        -(-int((m & (data["weight"] == w)).sum()) // 3)
+        for w in np.unique(data["weight"][m])
+    )
+    assert n == want
