@@ -214,11 +214,6 @@ class GeoDataset:
         from geomesa_tpu.index.partitioned import PartitionedFeatureStore
 
         st = self._store(name)
-        if isinstance(st, PartitionedFeatureStore):
-            raise NotImplementedError(
-                "update_schema on a time-partitioned store is not supported "
-                "yet; export + re-ingest under the new schema"
-            )
         st.flush()
         old = st.ft
         # insert new attributes before the ';user-data' section, if any
@@ -231,18 +226,12 @@ class GeoDataset:
         for a in added:
             if a.is_geom:
                 raise ValueError("cannot add geometry attributes to a schema")
-        new_store = FeatureStore(new_ft, self.n_shards)
-        # copy dictionaries (fresh encoders so the old store stays untouched)
-        new_store.dicts = {
-            k: DictionaryEncoder(list(d.values)) for k, d in st.dicts.items()
-        }
-        if st._all is not None and st._all.n:
-            n = st._all.n
-            cols = {k: v.copy() for k, v in st._all.columns.items()}
+
+        def null_fill(cols, n, dicts):
             for a in added:
                 if a.type == "string":
                     cols[a.name] = np.full(n, -1, np.int32)
-                    new_store.dicts.setdefault(a.name, DictionaryEncoder())
+                    dicts.setdefault(a.name, DictionaryEncoder())
                 elif a.type == "date":
                     cols[a.name] = np.zeros(n, np.int64)
                     bt = BinnedTime(new_ft.time_period)
@@ -257,10 +246,54 @@ class GeoDataset:
                     cols[a.name] = np.full(n, np.nan, np.dtype(a.type))
                 else:
                     cols[a.name] = np.zeros(n, np.dtype(a.type))
-            from geomesa_tpu.schema.columns import ColumnBatch
 
-            new_store._buffer = [ColumnBatch(cols, n)]
-            new_store.flush()
+        def upgrade_flat(src: FeatureStore, shard_bucket: int = 1) -> FeatureStore:
+            out = FeatureStore(new_ft, self.n_shards)
+            for t in out.tables.values():  # BEFORE flush: layout-time knob
+                t.shard_len_multiple = shard_bucket
+            # fresh encoders so the old store stays untouched
+            out.dicts = {
+                k: DictionaryEncoder(list(d.values))
+                for k, d in src.dicts.items()
+            }
+            if src._all is not None and src._all.n:
+                n = src._all.n
+                cols = {k: v.copy() for k, v in src._all.columns.items()}
+                null_fill(cols, n, out.dicts)
+                from geomesa_tpu.schema.columns import ColumnBatch
+
+                out._buffer = [ColumnBatch(cols, n)]
+                out.flush()
+            return out
+
+        if isinstance(st, PartitionedFeatureStore):
+            # re-index each partition under the new schema, one at a time
+            # (the residency budget bounds memory); spilled partitions
+            # round-trip through their snapshot
+            new_store = PartitionedFeatureStore(new_ft, self.n_shards)
+            # carry operational config: a shared spill dir would otherwise
+            # serve STALE old-schema snapshots (eviction skips clean bins)
+            new_store._spill_dir = st._spill_dir
+            new_store.max_resident = st.max_resident
+            new_store.dicts = {
+                k: DictionaryEncoder(list(d.values))
+                for k, d in st.dicts.items()
+            }
+            for a in added:
+                if a.type == "string":
+                    new_store.dicts.setdefault(a.name, DictionaryEncoder())
+            for b in st.partition_bins():
+                child = st.child(b)
+                if child is None or child._all is None or not child._all.n:
+                    continue
+                up = upgrade_flat(child, new_store._shard_bucket)
+                up.dicts = new_store.dicts
+                new_store.partitions[b] = up
+                new_store.part_counts[b] = up.count
+                new_store._dirty.add(b)  # force fresh snapshots on spill
+                new_store.evict()
+        else:
+            new_store = upgrade_flat(st)
         self._stores[name] = new_store
         self._executors.pop(name, None)
         self.metadata[name]["spec"] = new_ft.spec()

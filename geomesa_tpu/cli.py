@@ -51,6 +51,49 @@ def cmd_create_schema(args):
     print(ft.describe())
 
 
+def cmd_update_schema(args):
+    ds = _load(args.catalog)
+    ft = ds.update_schema(args.feature_name, args.add)
+    _save(ds, args.catalog)
+    print(f"updated schema {ft.name!r}")
+    print(ft.describe())
+
+
+def cmd_manage_partitions(args):
+    """List / age off time partitions of a partitioned store (reference
+    geomesa-tools manage-partitions; TimePartition.scala:35)."""
+    from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+
+    ds = _load(args.catalog)
+    st = ds._store(args.feature_name)
+    if not isinstance(st, PartitionedFeatureStore):
+        print(f"schema {args.feature_name!r} is not time-partitioned")
+        return
+    if args.action == "list":
+        for b in st.partition_bins():
+            lo = int(st.binned.bin_start_ms(np.asarray([b]))[0])
+            hi = int(st.binned.bin_start_ms(np.asarray([b + 1]))[0])
+            state = "resident" if b in st.partitions else "spilled"
+            rows = st.part_counts.get(b, 0)
+            print(f"bin {b}  [{_iso(lo)} .. {_iso(hi)})  {rows} rows  {state}")
+    elif args.action == "delete":
+        if not args.older_than:
+            raise SystemExit(
+                "manage-partitions delete requires --older-than <ISO date>"
+            )
+        n = ds.age_off(args.feature_name, args.older_than)
+        _save(ds, args.catalog)
+        print(f"removed {n} features older than {args.older_than}")
+
+
+def _iso(ms: int) -> str:
+    import datetime as _dt
+
+    return _dt.datetime.fromtimestamp(
+        ms / 1000.0, _dt.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+
 def cmd_delete_schema(args):
     ds = _load(args.catalog)
     ds.delete_schema(args.feature_name)
@@ -457,6 +500,20 @@ def build_parser() -> argparse.ArgumentParser:
     common(sp)
     sp.add_argument("-s", "--spec", required=True, help="schema spec string")
     sp.set_defaults(fn=cmd_create_schema)
+
+    sp = sub.add_parser("update-schema", help="add attributes to a schema")
+    common(sp)
+    sp.add_argument("--add", required=True,
+                    help="spec of attributes to append, e.g. 'tag:String'")
+    sp.set_defaults(fn=cmd_update_schema)
+
+    sp = sub.add_parser(
+        "manage-partitions", help="list or age off time partitions"
+    )
+    common(sp)
+    sp.add_argument("action", choices=["list", "delete"])
+    sp.add_argument("--older-than", help="ISO date for delete")
+    sp.set_defaults(fn=cmd_manage_partitions)
 
     sp = sub.add_parser("delete-schema", help="delete a schema and its data")
     common(sp)

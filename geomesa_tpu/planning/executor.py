@@ -1258,7 +1258,11 @@ class Executor:
 
         def agg(cols, m, xp, *extra):
             v = cols[attr].reshape(-1).astype(xp.float32)
-            d = xp.where(m.reshape(-1), -v if descending else v, xp.inf)
+            # NaN keys are excluded here (argmin would select them first);
+            # if that leaves fewer than k rows the caller falls back to the
+            # host sort, which orders NaNs last — exact parity either way
+            ok = m.reshape(-1) & ~xp.isnan(v)
+            d = xp.where(ok, -v if descending else v, xp.inf)
             # argmin iteration (same tradeoff as kernels/knn.py): both
             # lax.top_k and sort-based top-k compile pathologically on
             # this TPU toolchain, so large k stays on the host
@@ -1284,7 +1288,12 @@ class Executor:
         if out is None:
             return np.zeros(0, np.int64)
         idx, vals = np.asarray(out[0]), np.asarray(out[1])
-        return idx[np.isfinite(vals)].astype(np.int64)
+        idx = idx[np.isfinite(vals)].astype(np.int64)
+        if len(idx) < k:
+            # fewer finite matches than k: NaN-keyed or sparse matches may
+            # exist that the device path excluded — let the host decide
+            return None
+        return idx
 
     def knn(self, plan: QueryPlan, qx: float, qy: float, k: int, boxes=None):
         """k nearest to (qx, qy) among plan matches. ``boxes`` (optional):

@@ -122,6 +122,43 @@ class _Handler(BaseHTTPRequestHandler):
                         "nonzero": int(np.count_nonzero(grid)),
                         "grid": grid.tolist(),
                     })
+                if op == "tiles" and len(rest) == 4:
+                    # XYZ tile-pyramid heatmap: /tiles/<z>/<x>/<y> over the
+                    # curve-aligned density (DensityProcess under WMS; the
+                    # EPSG:4326 pyramid has 2 root tiles side by side, so
+                    # a z/x/y tile spans 180/2^z degrees and maps exactly
+                    # onto morton blocks at level z + sub)
+                    z, x, y = (int(v) for v in rest[1:4])
+                    sub = max(1, min(8, int(q.get("detail", "6"))))
+                    if z + 1 > 14:
+                        # morton levels cap at 15; deeper tiles would be
+                        # WIDER than a block and double-count neighbors
+                        return self._error(400, "max tile zoom is 13")
+                    if not (0 <= x < (1 << (z + 1)) and 0 <= y < (1 << z)):
+                        return self._error(400, "tile out of range")
+                    span = 180.0 / (1 << z)
+                    level = min(z + sub, 15)
+                    bbox = (
+                        -180.0 + x * span, -90.0 + y * span,
+                        -180.0 + (x + 1) * span, -90.0 + (y + 1) * span,
+                    )
+                    # exclusive upper edges: inset by half a morton block
+                    # so the inclusive snap never pulls in the neighbor
+                    # tile's first row/column
+                    hx = 180.0 / (1 << level)
+                    hy = 90.0 / (1 << level)
+                    grid, snapped = ds.density_curve(
+                        name, Query(ecql=cql, auths=auths),
+                        level=level,
+                        bbox=(bbox[0], bbox[1], bbox[2] - hx, bbox[3] - hy),
+                        weight=q.get("weight"),
+                    )
+                    return self._send({
+                        "z": z, "x": x, "y": y, "bbox": list(snapped),
+                        "width": grid.shape[1], "height": grid.shape[0],
+                        "nonzero": int(np.count_nonzero(grid)),
+                        "grid": grid.tolist(),
+                    })
                 if op == "features":
                     from geomesa_tpu.io import geojson
 

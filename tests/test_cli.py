@@ -117,3 +117,40 @@ def test_delete_schema(catalog, capsys):
 def test_version(capsys):
     assert cli.main(["version"]) == 0
     assert "geomesa-tpu" in capsys.readouterr().out
+
+
+def test_cli_update_schema_and_manage_partitions(tmp_path, capsys):
+    from geomesa_tpu.cli import main
+
+    cat = str(tmp_path / "cat")
+    main(["create-schema", "-c", cat, "-f", "ev",
+          "-s", "v:Integer,dtg:Date,*geom:Point;geomesa.partition='time'"])
+    # ingest a few rows across two weeks via the dataset API + save
+    import numpy as np
+
+    from geomesa_tpu import GeoDataset
+    from geomesa_tpu.filter.ecql import parse_iso_ms
+
+    ds = GeoDataset.load(cat)
+    n = 200
+    rng = np.random.default_rng(1)
+    lo = parse_iso_ms("2021-06-01")
+    ds.insert("ev", {
+        "geom__x": rng.uniform(-100, -90, n),
+        "geom__y": rng.uniform(30, 40, n),
+        "dtg": (lo + rng.integers(0, 14 * 86_400_000, n)).astype("datetime64[ms]"),
+        "v": rng.integers(0, 9, n).astype(np.int32),
+    }, fids=np.arange(n).astype(str))
+    ds.flush("ev")
+    ds.save(cat)
+    capsys.readouterr()
+    main(["manage-partitions", "-c", cat, "-f", "ev", "list"])
+    out = capsys.readouterr().out
+    assert "bin" in out and "rows" in out and ("resident" in out or "spilled" in out)
+    main(["update-schema", "-c", cat, "-f", "ev", "--add", "tag:String"])
+    out = capsys.readouterr().out
+    assert "updated schema" in out and "tag" in out
+    main(["manage-partitions", "-c", cat, "-f", "ev", "delete",
+          "--older-than", "2021-06-08"])
+    out = capsys.readouterr().out
+    assert "removed" in out

@@ -270,3 +270,40 @@ def test_enrichment_cache_lookup(tmp_path):
     assert list(data["country"]) == ["United States", "France"]
     assert list(data["pop"]) == ["331", "67"]
     assert list(data["label"]) == ["us-tag", None]
+
+
+def test_jdbc_converter(tmp_path):
+    """SQL-statement ingest via the embedded sqlite engine
+    (geomesa-convert-jdbc, JdbcConverter.scala:29)."""
+    import sqlite3
+
+    from geomesa_tpu.convert.converter import ConverterConfig, converter_for
+    from geomesa_tpu.schema.feature_type import FeatureType
+
+    db = tmp_path / "pts.db"
+    conn = sqlite3.connect(db)
+    conn.execute("CREATE TABLE pts (name TEXT, lon REAL, lat REAL)")
+    conn.executemany(
+        "INSERT INTO pts VALUES (?, ?, ?)",
+        [("a", -100.0, 40.0), ("b", -90.5, 35.25), ("c", -80.0, 30.0)],
+    )
+    conn.commit()
+    conn.close()
+    conf = ConverterConfig.parse({
+        "type": "jdbc",
+        "connection": f"sqlite:///{db}",
+        "id-field": "$name",
+        "fields": [
+            {"name": "name", "transform": "$1"},
+            {"name": "geom", "transform": "point(toDouble($2), toDouble($3))"},
+        ],
+    })
+    ft = FeatureType.from_spec("p", "name:String,*geom:Point")
+    conv = converter_for(ft, conf)
+    batches = list(conv.convert("SELECT name, lon, lat FROM pts ORDER BY name"))
+    assert len(batches) == 1
+    data, fids = batches[0]
+    assert list(data["name"]) == ["a", "b", "c"]
+    assert data["geom"][0] == (-100.0, 40.0)
+    assert data["geom"][1] == (-90.5, 35.25)
+    assert list(fids) == ["a", "b", "c"]

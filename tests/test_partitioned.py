@@ -212,10 +212,24 @@ def test_device_and_host_paths_agree(pair):
         assert host.count("t", q) == dev.count("t", q), q
 
 
-def test_update_schema_partitioned_raises(pair):
-    part, _, _ = pair
-    with pytest.raises(NotImplementedError):
-        part.update_schema("t", "extra:Integer")
+def test_update_schema_partitioned(tmp_path):
+    """Append-only schema update re-indexes every partition under the new
+    schema (GeoMesaDataStore.scala:288-336 transition validation analog);
+    old rows read the added column as null/zero, new rows carry values."""
+    data = _data(4_000, seed=13)
+    ds = GeoDataset(n_shards=4, prefer_device=False)
+    ds.create_schema("t", PSPEC)
+    st = ds._store("t")
+    st.max_resident = 1
+    st._spill_dir = str(tmp_path / "spill")
+    ds.insert("t", data, fids=np.arange(4_000).astype(str))
+    ds.flush()
+    before = ds.count("t", BBOX_TIME)
+    ds.update_schema("t", "extra:Integer,tag:String")
+    assert ds.count("t", BBOX_TIME) == before
+    assert ds.count("t", "extra = 0") == 4_000  # null fill for old rows
+    fc = ds.query("t", "INCLUDE")
+    assert "extra" in fc.columns and "tag" in fc.columns
 
 
 def test_lazy_columns_on_reload(tmp_path):

@@ -160,3 +160,19 @@ def test_leaflet_render():
     html = jupyter.render_density(ds, "t", bbox=(-10, -10, 10, 10),
                                   width=16, height_cells=16)
     assert "L.rectangle" in html and "fitBounds" in html
+
+
+def test_web_xyz_tiles(server):
+    """/tiles/z/x/y: curve-aligned tile-pyramid heatmap (the WMS
+    DensityProcess surface). Sibling tiles partition the data exactly."""
+    base, ds = server
+    total = 0
+    z = 2
+    for x in range(1 << (z + 1)):
+        for y in range(1 << z):
+            t, _ = _get(base, f"/api/schemas/t/tiles/{z}/{x}/{y}?detail=4")
+            total += sum(map(sum, t["grid"]))
+            # morton blocks span 360/2^l x 180/2^l degrees, so a square-
+            # degree tile is twice as tall in blocks as it is wide
+            assert (t["width"], t["height"]) == (8, 16)
+    assert total == ds.count("t", "INCLUDE")
