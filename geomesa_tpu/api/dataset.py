@@ -272,8 +272,13 @@ class GeoDataset:
             # round-trip through their snapshot
             new_store = PartitionedFeatureStore(new_ft, self.n_shards)
             # carry operational config: a shared spill dir would otherwise
-            # serve STALE old-schema snapshots (eviction skips clean bins)
+            # serve STALE old-schema snapshots (eviction skips clean bins).
+            # Ownership must move too — the old store's __del__ removes an
+            # owned temp spill dir, which would destroy the migrated
+            # store's snapshots.
             new_store._spill_dir = st._spill_dir
+            new_store._owns_spill_dir = getattr(st, "_owns_spill_dir", False)
+            st._owns_spill_dir = False
             new_store.max_resident = st.max_resident
             new_store.dicts = {
                 k: DictionaryEncoder(list(d.values))

@@ -190,3 +190,38 @@ MAX_RESIDENT_PARTITIONS = SystemProperty("geomesa.partition.max.resident", "4")
 #: Partitioned tables round their padded shard length up to a multiple of
 #: this, so near-equal partitions share one compiled scan kernel shape.
 SHARD_LEN_BUCKET = SystemProperty("geomesa.partition.shard.bucket", "65536")
+
+# ---------------------------------------------------------------------------
+# Compacted-scan + MXU density kernel tunables (r4; docs/SCALE.md cost
+# model). Env names follow the standard mapping, e.g.
+# geomesa.compact.min.rows -> GEOMESA_COMPACT_MIN_ROWS.
+# ---------------------------------------------------------------------------
+
+#: Enable the window-compacted scan layout (gather only window rows).
+COMPACT_ENABLED = SystemProperty("geomesa.compact.enabled", "true")
+
+#: Minimum table rows before compaction is considered.
+COMPACT_MIN_ROWS = SystemProperty("geomesa.compact.min.rows", str(1 << 20))
+
+#: Compaction engages only when padded chunk rows < this fraction of the
+#: table (windows admitting most rows can't win).
+COMPACT_FRACTION = SystemProperty("geomesa.compact.fraction", "0.5")
+
+#: Chunk slab length override (0 = adaptive: least padding, largest B
+#: within 10%).
+COMPACT_B = SystemProperty("geomesa.compact.b", "0")
+
+#: Range-cover budget for the compact path's fine (gap-union-free) window
+#: resolution; <= geomesa.scan.ranges.target disables the fine pass.
+COMPACT_COVER = SystemProperty("geomesa.compact.cover", "32768")
+
+#: Use the scatter-free MXU density kernel on z-indexed tables.
+DENSITY_MXU = SystemProperty("geomesa.density.mxu", "true")
+
+#: Split the padded-path density scatter into this many independent
+#: pieces (measured ~10x on v5e); <=1 disables.
+SCATTER_SPLIT = SystemProperty("geomesa.scatter.split", "8")
+
+#: MXU density grid tile shape (cells).
+MXU_TILE_X = SystemProperty("geomesa.mxu.tile.x", "64")
+MXU_TILE_Y = SystemProperty("geomesa.mxu.tile.y", "32")

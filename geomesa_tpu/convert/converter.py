@@ -33,6 +33,7 @@ from __future__ import annotations
 import csv
 import io
 import json
+import os
 from dataclasses import dataclass, field
 from typing import Any, Dict, Iterable, Iterator, List, Optional, Sequence, Tuple
 
@@ -595,6 +596,15 @@ class JdbcConverter(BaseConverter):
             if path.startswith(prefix):
                 path = path[len(prefix):] or ":memory:"
                 break
+        else:
+            if path != ":memory:" and ":" in path.split(os.sep)[0].split("/")[0]:
+                # a URL scheme we don't speak (jdbc:postgresql://...):
+                # fail clearly instead of treating it as a sqlite filename
+                raise ValueError(
+                    f"unsupported connection {conn_str!r}: only sqlite "
+                    "connections (sqlite:///path, jdbc:sqlite:path, or a "
+                    "bare file path) are supported"
+                )
         conn = sqlite3.connect(path)
         try:
             stmts = (

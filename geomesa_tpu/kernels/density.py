@@ -40,9 +40,9 @@ def density_grid(x, y, mask, bbox, width: int, height: int, weight=None, xp=None
     # (the XLA scheduler overlaps the scatters' phases; a lax.scan over the
     # same pieces stays serial at ~7 ns). Pieces must divide evenly —
     # callers keep row counts a multiple of 8 (see executor chunk buckets).
-    import os
+    from geomesa_tpu import config
 
-    P = int(os.environ.get("GEOMESA_SCATTER_SPLIT", 8))
+    P = config.SCATTER_SPLIT.to_int() or 0
     n = flat_idx.shape[0]
     if P <= 1 or n % P or n < (1 << 14):
         return (

@@ -23,13 +23,17 @@ from typing import Dict, Optional
 
 import numpy as np
 
-import os
+def tile_shape():
+    """Grid tile shape (TY, TX) in cells: the measured optimum on v5e for
+    fine-cover chunk boxes (~30-70 cells) — smaller tiles raise
+    pairs-per-chunk, larger tiles raise one-hot operand and tile-tensor
+    traffic. Tunable via geomesa.mxu.tile.y/x."""
+    from geomesa_tpu import config
 
-#: grid tile shape (cells): the measured optimum on v5e for fine-cover
-#: chunk boxes (~30-70 cells) — smaller tiles raise pairs-per-chunk,
-#: larger tiles raise one-hot operand and tile-tensor traffic
-TILE_Y = int(os.environ.get("GEOMESA_MXU_TILE_Y", 32))
-TILE_X = int(os.environ.get("GEOMESA_MXU_TILE_X", 64))
+    return (config.MXU_TILE_Y.to_int() or 32,
+            config.MXU_TILE_X.to_int() or 64)
+
+
 #: pair-batch row budget: PB pairs x B rows ~ 512Ki rows per matmul batch
 _PAIR_ROWS = 512 * 1024
 
@@ -167,10 +171,11 @@ def build_pairs(
     cy0 = np.clip(cy0, 0, height - 1)
     cy1 = np.clip(cy1, 0, height - 1)
 
-    ntx = -(-width // TILE_X)
-    nty = -(-height // TILE_Y)
-    tx0, tx1 = cx0 // TILE_X, cx1 // TILE_X
-    ty0, ty1 = cy0 // TILE_Y, cy1 // TILE_Y
+    TY, TX = tile_shape()
+    ntx = -(-width // TX)
+    nty = -(-height // TY)
+    tx0, tx1 = cx0 // TX, cx1 // TX
+    ty0, ty1 = cy0 // TY, cy1 // TY
     nx = np.where(act, tx1 - tx0 + 1, 0)
     ny = np.where(act, ty1 - ty0 + 1, 0)
     per = (nx * ny).astype(np.int64)
@@ -190,21 +195,23 @@ def build_pairs(
 
     return {
         "chunk": _pad(chunk_of.astype(np.int32)),
-        "px0": _pad((tx * TILE_X).astype(np.int32)),
-        "py0": _pad((ty * TILE_Y).astype(np.int32)),
+        "px0": _pad((tx * TX).astype(np.int32)),
+        "py0": _pad((ty * TY).astype(np.int32)),
         "tile": _pad((ty * ntx + tx).astype(np.int32)),
         "pvalid": _pad(np.ones(P, np.float32)),
         "P": Pp,
         "PB": PB,
         "ntx": ntx,
         "nty": nty,
+        "TY": TY,
+        "TX": TX,
         "n_pairs": P,
     }
 
 
 def density_grid_pairs(x, y, mask, bbox, width: int, height: int, weight,
                        pair_chunk, px0, py0, ptile, pvalid,
-                       PB: int, ntx: int, nty: int, xp):
+                       PB: int, ntx: int, nty: int, TY: int, TX: int, xp):
     """Device kernel: [C, B] compact columns + [P] pair arrays -> grid.
 
     Unweighted counts ride the MXU in bfloat16 one-hots (0/1 exact) with
@@ -227,8 +234,8 @@ def density_grid_pairs(x, y, mask, bbox, width: int, height: int, weight,
     dt = jnp.bfloat16 if weight is None else jnp.float32
     ntiles = ntx * nty
     P = pair_chunk.shape[0]
-    ix = jnp.arange(TILE_X, dtype=jnp.int32)[None, None, :]
-    iy = jnp.arange(TILE_Y, dtype=jnp.int32)[None, None, :]
+    ix = jnp.arange(TX, dtype=jnp.int32)[None, None, :]
+    iy = jnp.arange(TY, dtype=jnp.int32)[None, None, :]
     it = jnp.arange(ntiles, dtype=jnp.int32)[None, :]
 
     def body(i, acc):
@@ -248,7 +255,7 @@ def density_grid_pairs(x, y, mask, bbox, width: int, height: int, weight,
         )
 
     acc = jax.lax.fori_loop(
-        0, P // PB, body, jnp.zeros((ntiles, TILE_Y, TILE_X), jnp.float32)
+        0, P // PB, body, jnp.zeros((ntiles, TY, TX), jnp.float32)
     )
-    grid = acc.reshape(nty, ntx, TILE_Y, TILE_X).transpose(0, 2, 1, 3)
-    return grid.reshape(nty * TILE_Y, ntx * TILE_X)[:height, :width]
+    grid = acc.reshape(nty, ntx, TY, TX).transpose(0, 2, 1, 3)
+    return grid.reshape(nty * TY, ntx * TX)[:height, :width]

@@ -20,6 +20,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
+from geomesa_tpu import config
 from geomesa_tpu.index.store import FeatureStore, IndexTable
 from geomesa_tpu.kernels import density as kdensity
 from geomesa_tpu.kernels import knn as kknn
@@ -45,9 +46,6 @@ class QueryTimeoutError(RuntimeError):
 # [C, B] compact layout. Selective queries then scale with rows *scanned*,
 # not rows *stored* (the same property the reference gets from range scans:
 # AbstractBatchScan.scala:32 only ever reads the planned ranges).
-_COMPACT_MIN_TABLE = int(os.environ.get("GEOMESA_COMPACT_MIN_ROWS", 1 << 20))
-_COMPACT_FRACTION = float(os.environ.get("GEOMESA_COMPACT_FRACTION", 0.5))
-
 _SLAB_GATHER_FNS: Dict[int, Any] = {}
 
 
@@ -209,11 +207,11 @@ class Executor:
             not allowed
             or not setup["use_device"]
             or self.mesh is not None
-            or os.environ.get("GEOMESA_TPU_NO_COMPACT")
+            or not config.COMPACT_ENABLED.to_bool()
         ):
             return
         table = setup["table"]
-        if table.n < _COMPACT_MIN_TABLE:
+        if table.n < (config.COMPACT_MIN_ROWS.to_int() or 0):
             return
         L = setup["L"]
 
@@ -231,7 +229,7 @@ class Executor:
             if not rows_at:
                 return None
             floor_rows = min(rows_at.values())
-            B = int(os.environ.get("GEOMESA_COMPACT_B", 0)) or max(
+            B = (config.COMPACT_B.to_int() or 0) or max(
                 b for b, r in rows_at.items() if r <= 1.10 * floor_rows
             )
             return B, rows_at[B], lens
@@ -259,7 +257,8 @@ class Executor:
         flat_lens = lens.reshape(-1)
         nc = -(-flat_lens // B)
         C = int(nc.sum())
-        if C * B >= table.n * _COMPACT_FRACTION:
+        frac = config.COMPACT_FRACTION.to_float()
+        if C * B >= table.n * (0.5 if frac is None else frac):
             return  # windows admit most of the table: compaction can't win
         win = np.repeat(np.arange(S * K), nc)
         j = np.arange(C) - np.repeat(np.cumsum(nc) - nc, nc)
@@ -315,8 +314,7 @@ class Executor:
         16-64x finer cover pays for itself immediately. Cover + resolve
         run once per (plan, store version) and are cached on the plan.
         (None, None) when disabled or the keyspace can't re-plan."""
-        cover = int(os.environ.get("GEOMESA_COMPACT_COVER", 32768))
-        from geomesa_tpu import config
+        cover = config.COMPACT_COVER.to_int() or 0
         from geomesa_tpu.index import keyspace as ksmod
 
         if cover <= (config.SCAN_RANGES_TARGET.to_int() or 2000):
@@ -861,7 +859,7 @@ class Executor:
         """(chunk, tile) pair arrays for the MXU density kernel, cached on
         device per (windows, grid, store version). None when the index has
         no morton key or the kernel is disabled."""
-        if os.environ.get("GEOMESA_DENSITY_MXU", "1") == "0":
+        if not config.DENSITY_MXU.to_bool():
             return None
         import jax
 
@@ -871,7 +869,7 @@ class Executor:
 
         cache = self.store.__dict__.setdefault("_pair_cache", {})
         key = (d["whash"], tuple(bbox), width, height, d["B"], d["C"],
-               _dm.TILE_X, _dm.TILE_Y, self.store.uid, self.store.version)
+               _dm.tile_shape(), self.store.uid, self.store.version)
         hit = cache.get(key)
         if hit is None:
             from geomesa_tpu.kernels import density_mxu
@@ -1072,18 +1070,18 @@ class Executor:
             from geomesa_tpu.kernels import density_mxu as kmxu
 
             PB, ntx, nty = pr["PB"], pr["ntx"], pr["nty"]
+            TY, TX = pr["TY"], pr["TX"]
 
             def pagg(cols, m, xp, pc, p0, p1, pt, pv):
                 return kmxu.density_grid_pairs(
                     cols[xc], cols[yc], m, bbox, width, height,
                     cols.get(weight) if weight else None,
-                    pc, p0, p1, pt, pv, PB, ntx, nty, xp,
+                    pc, p0, p1, pt, pv, PB, ntx, nty, TY, TX, xp,
                 )
 
             extra = (pr["chunk"], pr["px0"], pr["py0"], pr["tile"],
                      pr["pvalid"])
-            return pagg, extra, ("mxu", pr["P"], PB, kmxu.TILE_X,
-                                 kmxu.TILE_Y)
+            return pagg, extra, ("mxu", pr["P"], PB, TX, TY)
 
         out = self._run(
             plan, agg, agg, agg_cols,

@@ -138,9 +138,11 @@ class _Handler(BaseHTTPRequestHandler):
                         return self._error(400, "tile out of range")
                     span = 180.0 / (1 << z)
                     level = min(z + sub, 15)
+                    # XYZ row order: y=0 is the NORTH edge (WMTS/slippy
+                    # convention), so flip to latitude
                     bbox = (
-                        -180.0 + x * span, -90.0 + y * span,
-                        -180.0 + (x + 1) * span, -90.0 + (y + 1) * span,
+                        -180.0 + x * span, 90.0 - (y + 1) * span,
+                        -180.0 + (x + 1) * span, 90.0 - y * span,
                     )
                     # exclusive upper edges: inset by half a morton block
                     # so the inclusive snap never pulls in the neighbor

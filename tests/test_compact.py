@@ -46,9 +46,14 @@ def _oracle_mask(data):
 
 
 @pytest.fixture
-def force_compact(monkeypatch):
-    monkeypatch.setattr(exmod, "_COMPACT_MIN_TABLE", 1)
-    monkeypatch.setattr(exmod, "_COMPACT_FRACTION", 2.0)
+def force_compact():
+    from geomesa_tpu import config
+
+    config.COMPACT_MIN_ROWS.set(1)
+    config.COMPACT_FRACTION.set(2.0)
+    yield
+    config.COMPACT_MIN_ROWS.set(None)
+    config.COMPACT_FRACTION.set(None)
 
 
 def _compact_was_used(ds, plan):
@@ -93,7 +98,7 @@ def test_compact_sampling_parity(ds_data, force_compact, monkeypatch):
     assert _compact_was_used(ds, plan)
     # same query, compaction off: the deterministic 1-in-n counter must
     # select the identical sample
-    monkeypatch.setenv("GEOMESA_TPU_NO_COMPACT", "1")
+    monkeypatch.setenv("GEOMESA_COMPACT_ENABLED", "false")
     n_full = ds.count("t", Query(ecql=ECQL, sampling=10))
     want = int(_oracle_mask(data).sum())
     assert n_compact == n_full == -(-want // 10)

@@ -307,3 +307,17 @@ def test_jdbc_converter(tmp_path):
     assert data["geom"][0] == (-100.0, 40.0)
     assert data["geom"][1] == (-90.5, 35.25)
     assert list(fids) == ["a", "b", "c"]
+
+
+def test_jdbc_rejects_foreign_schemes():
+    from geomesa_tpu.convert.converter import ConverterConfig, converter_for
+    from geomesa_tpu.schema.feature_type import FeatureType
+
+    conf = ConverterConfig.parse({
+        "type": "jdbc", "connection": "jdbc:postgresql://host/db",
+        "fields": [{"name": "geom", "transform": "point(0.0, 0.0)"}],
+    })
+    ft = FeatureType.from_spec("p", "*geom:Point")
+    conv = converter_for(ft, conf)
+    with pytest.raises(ValueError, match="only sqlite"):
+        list(conv.convert("SELECT 1"))

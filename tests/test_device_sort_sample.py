@@ -66,9 +66,9 @@ def test_sample_by_device_matches_host(ds_data, monkeypatch):
     ds, data = ds_data
     # string key (dictionary codes ride the device as int32)
     n_dev = ds.count("t", Query(ecql=ECQL, sampling=10, sample_by="kind"))
-    monkeypatch.setenv("GEOMESA_TPU_NO_COMPACT", "1")
+    monkeypatch.setenv("GEOMESA_COMPACT_ENABLED", "false")
     n_dev2 = ds.count("t", Query(ecql=ECQL, sampling=10, sample_by="kind"))
-    monkeypatch.delenv("GEOMESA_TPU_NO_COMPACT")
+    monkeypatch.delenv("GEOMESA_COMPACT_ENABLED")
     assert n_dev == n_dev2
     # host oracle: per-key 1-in-10 over matched rows
     m = _mask(data)

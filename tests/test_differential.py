@@ -1,0 +1,155 @@
+"""Randomized differential testing: every generated query must agree
+across (a) the device path (compaction + MXU kernels), (b) the host
+fallback path, and (c) a brute-force numpy oracle. This is the
+TestGeoMesaDataStore-style whole-stack exercise (SURVEY.md §4.2) with
+randomized inputs instead of fixtures — seeded, so failures reproduce."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.api.dataset import Query
+from geomesa_tpu.filter.ecql import parse_iso_ms
+
+N = 80_000
+T0 = parse_iso_ms("2020-01-01")
+T1 = parse_iso_ms("2020-03-01")
+
+
+def _make(seed, prefer_device):
+    rng = np.random.default_rng(seed)
+    data = {
+        "geom__x": rng.uniform(-120, -70, N),
+        "geom__y": rng.uniform(25, 50, N),
+        "dtg": rng.integers(T0, T1, N).astype("datetime64[ms]"),
+        "w": rng.uniform(0, 100, N),
+        "v": rng.integers(0, 1000, N).astype(np.int32),
+        "cat": rng.choice(["alpha", "beta", "gamma", "delta", None], N),
+    }
+    ds = GeoDataset(n_shards=4, prefer_device=prefer_device)
+    ds.create_schema(
+        "t", "w:Double,v:Integer,cat:String:index=true,dtg:Date,*geom:Point"
+    )
+    ds.insert("t", data, fids=np.arange(N).astype(str))
+    ds.flush("t")
+    return ds, data
+
+
+def _oracle(data, spec):
+    x, y = data["geom__x"], data["geom__y"]
+    t = data["dtg"].astype(np.int64)
+    m = np.ones(N, bool)
+    for kind, args in spec:
+        if kind == "bbox":
+            x0, y0, x1, y1 = args
+            m &= (x >= x0) & (x <= x1) & (y >= y0) & (y <= y1)
+        elif kind == "during":
+            lo, hi = args
+            m &= (t >= lo) & (t <= hi)
+        elif kind == "wlt":
+            m &= data["w"] < args
+        elif kind == "vge":
+            m &= data["v"] >= args
+        elif kind == "cat":
+            vals = np.asarray(
+                [c if c is not None else "" for c in data["cat"]], object
+            )
+            m &= vals == args
+    return m
+
+
+def _ecql(spec):
+    parts = []
+    for kind, args in spec:
+        if kind == "bbox":
+            x0, y0, x1, y1 = args
+            parts.append(f"BBOX(geom, {x0}, {y0}, {x1}, {y1})")
+        elif kind == "during":
+            lo, hi = args
+
+            def iso(ms):
+                import datetime as dt
+
+                return dt.datetime.fromtimestamp(
+                    ms / 1000, dt.timezone.utc
+                ).strftime("%Y-%m-%dT%H:%M:%SZ")
+
+            parts.append(f"dtg DURING {iso(lo)}/{iso(hi)}")
+        elif kind == "wlt":
+            parts.append(f"w < {args}")
+        elif kind == "vge":
+            parts.append(f"v >= {args}")
+        elif kind == "cat":
+            parts.append(f"cat = '{args}'")
+    return " AND ".join(parts) if parts else "INCLUDE"
+
+
+def _gen_queries(seed, n_queries):
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(n_queries):
+        spec = []
+        if rng.random() < 0.85:
+            cx = rng.uniform(-118, -72)
+            cy = rng.uniform(27, 48)
+            wx = rng.uniform(0.5, 25)
+            wy = rng.uniform(0.5, 12)
+            spec.append(("bbox", (round(cx - wx, 4), round(cy - wy, 4),
+                                  round(cx + wx, 4), round(cy + wy, 4))))
+        if rng.random() < 0.7:
+            lo = int(rng.integers(T0, T1 - 86_400_000))
+            hi = lo + int(rng.integers(3_600_000, 21 * 86_400_000))
+            # whole-second bounds: ECQL text carries seconds, so sub-second
+            # precision would diverge from the oracle
+            lo -= lo % 1000
+            hi -= hi % 1000
+            spec.append(("during", (lo, min(hi, T1))))
+        if rng.random() < 0.35:
+            spec.append(("wlt", round(float(rng.uniform(1, 99)), 3)))
+        if rng.random() < 0.25:
+            spec.append(("vge", int(rng.integers(0, 999))))
+        if rng.random() < 0.25:
+            spec.append(("cat", str(rng.choice(["alpha", "beta", "gamma"]))))
+        out.append(spec)
+    return out
+
+
+@pytest.mark.parametrize("seed", [101, 202])
+def test_differential_counts(seed):
+    from geomesa_tpu import config
+
+    dev, data = _make(seed, prefer_device=True)
+    host, _ = _make(seed, prefer_device=False)
+    config.COMPACT_MIN_ROWS.set(1)  # engage compaction at this table size
+    try:
+        for spec in _gen_queries(seed * 7, 25):
+            ecql = _ecql(spec)
+            want = int(_oracle(data, spec).sum())
+            got_dev = dev.count("t", ecql)
+            got_host = host.count("t", ecql)
+            assert got_dev == want, f"device path: {ecql!r}"
+            assert got_host == want, f"host path: {ecql!r}"
+    finally:
+        config.COMPACT_MIN_ROWS.set(None)
+
+
+def test_differential_density_and_stats():
+    from geomesa_tpu import config
+
+    dev, data = _make(7, prefer_device=True)
+    config.COMPACT_MIN_ROWS.set(1)
+    try:
+        for spec in _gen_queries(99, 8):
+            ecql = _ecql(spec)
+            m = _oracle(data, spec)
+            want = int(m.sum())
+            if not want:
+                continue
+            bbox = (-120.0, 25.0, -70.0, 50.0)
+            grid = dev.density("t", ecql, bbox=bbox, width=128, height=128)
+            assert abs(float(grid.sum()) - want) < 1e-3, ecql
+            s = dev.stats("t", "MinMax(w)", ecql)
+            assert np.isclose(s.lo, data["w"][m].min()), ecql
+            assert np.isclose(s.hi, data["w"][m].max()), ecql
+    finally:
+        config.COMPACT_MIN_ROWS.set(None)

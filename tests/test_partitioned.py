@@ -272,3 +272,25 @@ def GeoDatasetOracle(data):
         (x >= -100) & (x <= -80) & (y >= 30) & (y <= 45)
         & (t >= lo) & (t <= hi)
     ).sum())
+
+
+def test_update_schema_keeps_spill_ownership(tmp_path):
+    """After a partitioned update_schema, GC of the OLD store must not
+    remove the shared spill dir out from under the new one."""
+    import gc
+
+    data = _data(3_000, seed=21)
+    ds = GeoDataset(n_shards=2, prefer_device=False)
+    ds.create_schema("t", PSPEC)
+    st = ds._store("t")
+    st.max_resident = 1
+    ds.insert("t", data, fids=np.arange(3_000).astype(str))
+    ds.flush()
+    spill = st._spill_dir
+    assert getattr(st, "_owns_spill_dir", False) or spill is not None
+    before = ds.count("t", BBOX_TIME)
+    ds.update_schema("t", "extra:Integer")
+    del st
+    gc.collect()
+    # spilled snapshots must still be readable through the new store
+    assert ds.count("t", BBOX_TIME) == before
