@@ -24,9 +24,38 @@ def _timed(fn) -> float:
     return time.time() - t0
 
 
+def _probe_device(timeout_s: int = 240) -> None:
+    """Fail fast if the accelerator is unreachable. A dead/wedged device
+    claim makes ``jax.devices()`` block indefinitely in PJRT init (seen
+    with the tunneled TPU after a client was killed mid-compile), which
+    would hang this process forever; probing in a THROWAWAY subprocess
+    bounds the damage and leaves a clear diagnosis instead."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True,
+        )
+        if proc.returncode == 0:
+            return
+        sys.stderr.write(
+            f"device probe failed (rc={proc.returncode}):\n"
+            + proc.stderr.decode(errors="replace")[-2000:]
+        )
+    except subprocess.TimeoutExpired:
+        sys.stderr.write(
+            f"device probe hung for {timeout_s}s: accelerator unreachable "
+            "(likely a wedged device claim / dead tunnel). Refusing to "
+            "start a benchmark that would hang indefinitely.\n"
+        )
+    sys.exit(3)
+
+
 def main():
     n = int(os.environ.get("GEOMESA_BENCH_N", 20_000_000))
     iters = int(os.environ.get("GEOMESA_BENCH_ITERS", 10))
+    _probe_device()
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from geomesa_tpu import GeoDataset

@@ -272,13 +272,8 @@ class GeoDataset:
             # round-trip through their snapshot
             new_store = PartitionedFeatureStore(new_ft, self.n_shards)
             # carry operational config: a shared spill dir would otherwise
-            # serve STALE old-schema snapshots (eviction skips clean bins).
-            # Ownership must move too — the old store's __del__ removes an
-            # owned temp spill dir, which would destroy the migrated
-            # store's snapshots.
+            # serve STALE old-schema snapshots (eviction skips clean bins)
             new_store._spill_dir = st._spill_dir
-            new_store._owns_spill_dir = getattr(st, "_owns_spill_dir", False)
-            st._owns_spill_dir = False
             new_store.max_resident = st.max_resident
             new_store.dicts = {
                 k: DictionaryEncoder(list(d.values))
@@ -297,6 +292,11 @@ class GeoDataset:
                 new_store.part_counts[b] = up.count
                 new_store._dirty.add(b)  # force fresh snapshots on spill
                 new_store.evict()
+            # transfer spill-dir ownership only once migration SUCCEEDED:
+            # either store's finalizer removes an owned temp dir, so the
+            # owner must be whichever store survives this method
+            new_store._owns_spill_dir = getattr(st, "_owns_spill_dir", False)
+            st._owns_spill_dir = False
         else:
             new_store = upgrade_flat(st)
         self._stores[name] = new_store

@@ -597,9 +597,15 @@ class JdbcConverter(BaseConverter):
                 path = path[len(prefix):] or ":memory:"
                 break
         else:
-            if path != ":memory:" and ":" in path.split(os.sep)[0].split("/")[0]:
-                # a URL scheme we don't speak (jdbc:postgresql://...):
-                # fail clearly instead of treating it as a sqlite filename
+            import re as _re
+
+            # a URL scheme we don't speak (jdbc:postgresql://...): fail
+            # clearly instead of treating it as a sqlite filename. The
+            # scheme test requires >= 2 leading letters so Windows drive
+            # paths (C:\data.db) still count as bare file paths.
+            if path != ":memory:" and _re.match(
+                r"[A-Za-z][A-Za-z0-9+.-]+:", path
+            ):
                 raise ValueError(
                     f"unsupported connection {conn_str!r}: only sqlite "
                     "connections (sqlite:///path, jdbc:sqlite:path, or a "
