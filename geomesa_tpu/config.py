@@ -225,3 +225,7 @@ SCATTER_SPLIT = SystemProperty("geomesa.scatter.split", "8")
 #: MXU density grid tile shape (cells).
 MXU_TILE_X = SystemProperty("geomesa.mxu.tile.x", "64")
 MXU_TILE_Y = SystemProperty("geomesa.mxu.tile.y", "32")
+
+#: Bin-space (2-D mesh) streaming: lax.scan chunk count per device along
+#: the time-bin axis (1 = no streaming; >1 trades HBM for steps).
+BIN_STREAM_CHUNKS = SystemProperty("geomesa.bin.stream.chunks", "1")

@@ -807,7 +807,7 @@ class Executor:
             return None
         import jax
 
-        stream = int(os.environ.get("GEOMESA_BIN_STREAM_CHUNKS", "1"))
+        stream = config.BIN_STREAM_CHUNKS.to_int() or 1
         n_bin = mesh.shape["bin"]
         starts, ends = binspace.pad_windows(
             setup["starts"], setup["ends"], n_bin * stream
