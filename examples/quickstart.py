@@ -1,0 +1,108 @@
+"""End-to-end quickstart: converter ingest -> indexed store -> queries ->
+pushdown analytics -> export -> checkpoint.
+
+Run it (CPU backend works everywhere; on a TPU host just drop the env):
+
+    JAX_PLATFORMS=cpu python examples/quickstart.py
+
+Every step mirrors a reference GeoMesa workflow (the geomesa-tutorials
+GDELT walk-through): same converter config shape, same ECQL, same
+analytic surface — re-based on TPU-shaped kernels.
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+from geomesa_tpu import GeoDataset, Query
+
+# -- 1. schema + converter config (geomesa-convert HOCON shape) -----------
+
+SPEC = "event:String:index=true,score:Float,dtg:Date,*geom:Point"
+
+CONVERTER = {
+    "type": "delimited-text",
+    "format": "CSV",
+    "id-field": "$1",
+    "options": {"skip-lines": 1},
+    "fields": [
+        {"name": "event", "transform": "$2"},
+        {"name": "score", "transform": "toDouble($3)"},
+        {"name": "dtg", "transform": "date('yyyy-MM-dd', $4)"},
+        {"name": "geom", "transform": "point(toDouble($5), toDouble($6))"},
+    ],
+}
+
+
+def synthesize_csv(n: int = 200_000, seed: int = 7) -> str:
+    rng = np.random.default_rng(seed)
+    days = rng.integers(1, 28, n)
+    rows = ["id,event,score,date,lon,lat"]
+    events = np.asarray(["protest", "meeting", "aid", "statement"])
+    ev = events[rng.integers(0, 4, n)]
+    lon = rng.uniform(-125, -66, n)
+    lat = rng.uniform(24, 49, n)
+    sc = rng.uniform(0, 10, n)
+    for i in range(n):
+        rows.append(
+            f"e{i},{ev[i]},{sc[i]:.3f},2020-01-{days[i]:02d},"
+            f"{lon[i]:.5f},{lat[i]:.5f}"
+        )
+    return "\n".join(rows)
+
+
+def main():
+    ds = GeoDataset(n_shards=8)
+    ds.create_schema("gdelt", SPEC)
+
+    # -- 2. ingest ---------------------------------------------------------
+    ctx = ds.ingest("gdelt", synthesize_csv(), CONVERTER)
+    print(f"ingested: {ctx.success} ok, {ctx.failure} rejected")
+
+    # -- 3. ECQL queries ---------------------------------------------------
+    ecql = (
+        "BBOX(geom, -100, 30, -80, 45) AND "
+        "dtg DURING 2020-01-05T00:00:00Z/2020-01-15T00:00:00Z AND "
+        "event = 'protest'"
+    )
+    print("count:", ds.count("gdelt", ecql))
+    print(ds.explain("gdelt", ecql).splitlines()[0])
+
+    top = ds.query("gdelt", Query(
+        ecql=ecql, sort_by=[("score", True)], max_features=3,
+        properties=["score"],
+    ))
+    print("top scores:", np.round(np.asarray(top.columns["score"], float), 2))
+
+    # -- 4. pushdown analytics --------------------------------------------
+    grid = ds.density("gdelt", ecql, bbox=(-100, 30, -80, 45),
+                      width=256, height=256)
+    print("density grid:", grid.shape, "sum", int(grid.sum()))
+
+    tile, snapped = ds.density_curve("gdelt", ecql, level=8)
+    print("curve-aligned tile:", tile.shape, "bbox", [round(v, 2) for v in snapped])
+
+    stats = ds.stats("gdelt", "MinMax(score);Histogram(score,10,0,10)", ecql)
+    print("stats:", stats.to_json()[:80], "...")
+
+    knn = ds.knn("gdelt", x=-90.0, y=38.5, k=5)
+    print("knn fids:", knn.fids)
+
+    # -- 5. export + checkpoint -------------------------------------------
+    from geomesa_tpu.io import geojson
+
+    st = ds._store("gdelt")
+    fc = ds.query("gdelt", Query(ecql=ecql, max_features=2))
+    print("geojson head:", geojson.dumps(st.ft, fc.batch, st.dicts)[:90], "...")
+
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "catalog")
+        ds.save(path)
+        ds2 = GeoDataset.load(path)
+        assert ds2.count("gdelt", ecql) == ds.count("gdelt", ecql)
+        print("checkpoint round-trip OK")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,15 @@
+"""The examples must keep running — they are the tutorials."""
+
+import subprocess
+import sys
+
+
+def test_quickstart_runs():
+    proc = subprocess.run(
+        [sys.executable, "examples/quickstart.py"],
+        capture_output=True, text=True, timeout=600, cwd="/root/repo",
+        env={"JAX_PLATFORMS": "cpu", "PYTHONPATH": "/root/repo",
+             "PATH": "/usr/bin:/bin:/opt/venv/bin", "HOME": "/root"},
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "checkpoint round-trip OK" in proc.stdout
