@@ -12,6 +12,9 @@ serves the same surface as JSON:
     GET /api/schemas/<name>/stats?stat=...&cql=...   -> stat JSON
     GET /api/schemas/<name>/histogram?attribute=&bins=&cql=
     GET /api/schemas/<name>/density?bbox=&width=&height=&cql=
+    GET /api/schemas/<name>/tiles/<z>/<x>/<y>?detail=&cql=  -> XYZ heatmap
+        tile (slippy row order, EPSG:4326 2x1 root; exact per-cell counts
+        via the curve-aligned density — no scatter)
     GET /api/schemas/<name>/features?cql=&max=       -> GeoJSON
 
 Queries pass auths via the ``X-Geomesa-Auths`` header (visibility parity).
