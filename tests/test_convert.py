@@ -321,3 +321,44 @@ def test_jdbc_rejects_foreign_schemes():
     conv = converter_for(ft, conf)
     with pytest.raises(ValueError, match="only sqlite"):
         list(conv.convert("SELECT 1"))
+
+
+OSM_XML = """<?xml version='1.0' encoding='UTF-8'?>
+<osm version="0.6">
+  <node id="101" lat="48.8584" lon="2.2945" version="3" timestamp="2020-05-01T10:00:00Z">
+    <tag k="name" v="Tour Eiffel"/>
+    <tag k="tourism" v="attraction"/>
+  </node>
+  <node id="102" lat="40.6892" lon="-74.0445" version="5" timestamp="2020-06-02T11:30:00Z">
+    <tag k="name" v="Statue of Liberty"/>
+  </node>
+  <node id="103" lat="51.5007" lon="-0.1246" version="2" timestamp="2020-07-03T12:45:00Z"/>
+</osm>
+"""
+
+
+def test_osm_node_ingest_via_xml_converter():
+    """OSM node extracts are plain XML: the xml converter covers the
+    reference's geomesa-convert-osm node path (attributes via @, nested
+    tag values via a child path)."""
+    conf = {
+        "type": "xml",
+        "feature-path": "node",
+        "id-field": "$osm_id",
+        "fields": [
+            {"name": "osm_id", "path": "@id"},
+            {"name": "name", "path": "tag[@k='name']/@v"},
+            {"name": "dtg", "transform": "isoDate($ts)"},
+            {"name": "ts", "path": "@timestamp"},
+            {"name": "lon", "path": "@lon"},
+            {"name": "lat", "path": "@lat"},
+            {"name": "geom", "transform": "point(toDouble($lon), toDouble($lat))"},
+        ],
+    }
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("osm", "osm_id:String,name:String,dtg:Date,*geom:Point")
+    ctx = ds.ingest("osm", OSM_XML, conf)
+    assert ctx.success == 3, ctx.errors
+    assert ds.count("osm", "BBOX(geom, -80, 35, -70, 45)") == 1  # liberty
+    fc = ds.query("osm", "name = 'Tour Eiffel'")
+    assert len(fc) == 1 and fc.fids == ["101"]
