@@ -153,3 +153,71 @@ def test_differential_density_and_stats():
             assert np.isclose(s.hi, data["w"][m].max()), ecql
     finally:
         config.COMPACT_MIN_ROWS.set(None)
+
+
+def test_differential_partitioned_store():
+    """The same generated queries through a time-partitioned out-of-core
+    store (max_resident=1, so multi-partition queries stream)."""
+    from geomesa_tpu import config
+
+    import tempfile
+
+    seed = 31
+    rng = np.random.default_rng(seed)
+    data = {
+        "geom__x": rng.uniform(-120, -70, N),
+        "geom__y": rng.uniform(25, 50, N),
+        "dtg": rng.integers(T0, T1, N).astype("datetime64[ms]"),
+        "w": rng.uniform(0, 100, N),
+        "v": rng.integers(0, 1000, N).astype(np.int32),
+        "cat": rng.choice(["alpha", "beta", "gamma", "delta", None], N),
+    }
+    with tempfile.TemporaryDirectory() as spill:
+        ds = GeoDataset(n_shards=4)
+        ds.create_schema(
+            "t",
+            "w:Double,v:Integer,cat:String:index=true,dtg:Date,*geom:Point"
+            ";geomesa.partition='time'",
+        )
+        st = ds._store("t")
+        st.max_resident = 1
+        st._spill_dir = spill
+        ds.insert("t", data, fids=np.arange(N).astype(str))
+        ds.flush("t")
+        config.COMPACT_MIN_ROWS.set(1)
+        try:
+            for spec in _gen_queries(seed * 3, 12):
+                ecql = _ecql(spec)
+                want = int(_oracle(data, spec).sum())
+                assert ds.count("t", ecql) == want, ecql
+            # sorted + limited through the partition stream
+            spec = [("bbox", (-105.0, 30.0, -85.0, 45.0))]
+            m = _oracle(data, spec)
+            q = Query(ecql=_ecql(spec), sort_by=[("w", True)], max_features=9)
+            out = ds.query("t", q)
+            np.testing.assert_allclose(
+                out.columns["w"], np.sort(data["w"][m])[::-1][:9]
+            )
+            # per-key sampling: the 1-in-n counter runs PER PARTITION,
+            # matching the reference (SamplingIterator state lives in each
+            # scan region's iterator, not globally). NB: the null sentinel
+            # must not contain NUL — numpy object-array equality against a
+            # string with an embedded "\0" silently matches nothing.
+            got = ds.count("t", Query(ecql=_ecql(spec), sampling=8,
+                                      sample_by="cat"))
+            cats = np.asarray(
+                [c if c is not None else "<null>" for c in data["cat"]],
+                object,
+            )
+            bins = st.binned.to_bin_and_offset(
+                data["dtg"].astype("datetime64[ms]").astype(np.int64)
+            )[0]
+            want_s = sum(
+                -(-int((m & (cats == c) & (bins == b)).sum()) // 8)
+                for b in np.unique(bins)
+                for c in np.unique(cats)
+                if ((m & (cats == c) & (bins == b)).sum())
+            )
+            assert got == want_s
+        finally:
+            config.COMPACT_MIN_ROWS.set(None)
