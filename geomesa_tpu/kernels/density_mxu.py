@@ -105,11 +105,11 @@ def _chunk_boxes(compact: Dict, table, col: str, dims: int, shift: int,
     return out
 
 
-def build_pairs(
+def pair_candidates(
     compact: Dict, table, keyspace, bbox, width: int, height: int,
-    box_cache: Optional[Dict] = None, version=None,
+    TY: int, TX: int, box_cache: Optional[Dict] = None, version=None,
 ) -> Optional[Dict]:
-    """Host-side (chunk, tile) pair list for the compacted scan layout.
+    """Host-side (chunk, tile) candidate list for the compacted scan layout.
 
     Chunk spatial boxes come from the chunk's own sorted keys
     (:func:`_chunk_boxes`) — conservative supersets (quantized keys widen
@@ -117,7 +117,8 @@ def build_pairs(
     is covered by a one-cell pad), which is all correctness needs: rows
     outside a pair's tile simply match no one-hot column. Returns None
     when the index has no morton key column (attr/id/xz tables fall back
-    to the scatter path).
+    to the scatter path). Shared by the XLA-einsum pair kernel below and
+    the pallas grouped kernel (kernels/density_pallas.py).
     """
     kind = getattr(keyspace, "kind", None)
     if kind == "z3":
@@ -137,7 +138,6 @@ def build_pairs(
     lon, lat = sfc.lon, sfc.lat
     bits = lon.bits
 
-    B = compact["B"]
     valid = compact["valid"]
     act = valid > 0
     boxes = _chunk_boxes(compact, table, col, dims, shift, box_cache, version)
@@ -171,7 +171,6 @@ def build_pairs(
     cy0 = np.clip(cy0, 0, height - 1)
     cy1 = np.clip(cy1, 0, height - 1)
 
-    TY, TX = tile_shape()
     ntx = -(-width // TX)
     nty = -(-height // TY)
     tx0, tx1 = cx0 // TX, cx1 // TX
@@ -186,6 +185,27 @@ def build_pairs(
     j = np.arange(P) - np.repeat(np.cumsum(per) - per, per)
     tx = tx0[chunk_of] + (j % np.maximum(nx[chunk_of], 1))
     ty = ty0[chunk_of] + (j // np.maximum(nx[chunk_of], 1))
+    return {
+        "chunk_of": chunk_of, "tx": tx, "ty": ty,
+        "ntx": ntx, "nty": nty, "P": P,
+    }
+
+
+def build_pairs(
+    compact: Dict, table, keyspace, bbox, width: int, height: int,
+    box_cache: Optional[Dict] = None, version=None,
+) -> Optional[Dict]:
+    """(chunk, tile) pair arrays shaped for the XLA einsum kernel."""
+    TY, TX = tile_shape()
+    cand = pair_candidates(
+        compact, table, keyspace, bbox, width, height, TY, TX,
+        box_cache, version,
+    )
+    if cand is None:
+        return None
+    chunk_of, tx, ty = cand["chunk_of"], cand["tx"], cand["ty"]
+    ntx, nty, P = cand["ntx"], cand["nty"], cand["P"]
+    B = compact["B"]
     PB = pair_batch(B)
     Pp = -(-ladder8(P) // PB) * PB
     pad = Pp - P

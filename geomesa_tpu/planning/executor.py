@@ -855,26 +855,23 @@ class Executor:
             jax.device_put(setup["counts"].astype(np.int32), cnt_sh),
         )
 
-    def _density_pairs(self, plan: QueryPlan, setup, bbox, width, height):
-        """(chunk, tile) pair arrays for the MXU density kernel, cached on
-        device per (windows, grid, store version). None when the index has
-        no morton key or the kernel is disabled."""
-        if not config.DENSITY_MXU.to_bool():
-            return None
+    def _cached_density_schedule(self, setup, bbox, width, height,
+                                 cache_name, key_extras, build, device_keys):
+        """Shared cache host for the host-built density pair schedules
+        (pallas grouped / MXU einsum): build once per (windows, grid,
+        store version), device_put the array members, remember a False
+        sentinel for negative results."""
         import jax
 
         d = setup["compact"]
         table = setup["table"]
-        from geomesa_tpu.kernels import density_mxu as _dm
-
-        cache = self.store.__dict__.setdefault("_pair_cache", {})
-        key = (d["whash"], tuple(bbox), width, height, d["B"], d["C"],
-               _dm.tile_shape(), self.store.uid, self.store.version)
+        cache = self.store.__dict__.setdefault(cache_name, {})
+        key = (cache_name, d["whash"], tuple(bbox), width, height, d["B"],
+               d["C"]) + tuple(key_extras) + (
+                   self.store.uid, self.store.version)
         hit = cache.get(key)
         if hit is None:
-            from geomesa_tpu.kernels import density_mxu
-
-            pr = density_mxu.build_pairs(
+            pr = build(
                 d, table, table.keyspace, bbox, width, height,
                 box_cache=self.store.__dict__.setdefault(
                     "_chunk_box_cache", {}
@@ -882,12 +879,44 @@ class Executor:
                 version=self.store.version,
             )
             if pr is not None:
-                for k in ("chunk", "px0", "py0", "tile", "pvalid"):
+                for k in device_keys:
                     pr[k] = jax.device_put(pr[k])
             if len(cache) >= 64:
                 cache.clear()
             hit = cache[key] = pr if pr is not None else False
         return hit or None
+
+    def _density_grouped(self, plan: QueryPlan, setup, bbox, width, height):
+        """Pair schedule for the pallas grouped density kernel, cached on
+        device per (windows, grid, store version). None when pallas is
+        unavailable, the kernel is disabled, or the index has no morton
+        key (callers fall through to the einsum/scatter paths)."""
+        from geomesa_tpu.kernels import density_pallas as _dp
+        from geomesa_tpu.kernels import pallas_kernels as pk
+
+        if not config.DENSITY_PALLAS.to_bool() or not pk.use_pallas():
+            return None
+        return self._cached_density_schedule(
+            setup, bbox, width, height, "_grouped_cache",
+            (config.DENSITY_PALLAS_MAX_DUP.to_float(),),
+            _dp.build_grouped,
+            ("sc", "row", "tile", "ox", "oy", "seen"),
+        )
+
+    def _density_pairs(self, plan: QueryPlan, setup, bbox, width, height):
+        """(chunk, tile) pair arrays for the MXU density kernel, cached on
+        device per (windows, grid, store version). None when the index has
+        no morton key or the kernel is disabled."""
+        from geomesa_tpu.kernels import density_mxu as _dm
+
+        if not config.DENSITY_MXU.to_bool():
+            return None
+        return self._cached_density_schedule(
+            setup, bbox, width, height, "_pair_cache",
+            (_dm.tile_shape(),),
+            _dm.build_pairs,
+            ("chunk", "px0", "py0", "tile", "pvalid"),
+        )
 
     def _run(self, plan: QueryPlan, agg_fn_dev, agg_fn_host, agg_cols=(),
              cache_key=None, additive=False, extra=(), compactable=True,
@@ -1061,9 +1090,29 @@ class Executor:
             )
 
         def mxu_agg(setup):
-            # scatter-free MXU formulation over the compacted layout
-            # (kernels/density_mxu.py); falls back to the scatter agg when
-            # the index has no morton key column
+            # device kernel ladder over the compacted layout: pallas
+            # grouped one-hot matmul (kernels/density_pallas.py) when the
+            # backend has pallas, else the XLA einsum pair kernel
+            # (kernels/density_mxu.py), else the scatter agg (returns
+            # None when the index has no morton key column)
+            gr = self._density_grouped(plan, setup, bbox, width, height)
+            if gr is not None:
+                from geomesa_tpu.kernels import density_pallas as kdp
+
+                Bc, n_pairs = gr["B"], gr["n_pairs"]
+                gntx, gnty = gr["ntx"], gr["nty"]
+
+                def gagg(cols, m, xp, sc, row, tile, ox, oy, seen):
+                    return kdp.density_grid_grouped(
+                        cols[xc], cols[yc], m, bbox, width, height,
+                        cols.get(weight) if weight else None,
+                        sc, row, tile, ox, oy, seen,
+                        Bc, gntx, gnty, n_pairs,
+                    )
+
+                extra = (gr["sc"], gr["row"], gr["tile"], gr["ox"],
+                         gr["oy"], gr["seen"])
+                return gagg, extra, ("grouped", n_pairs, Bc, gntx, gnty)
             pr = self._density_pairs(plan, setup, bbox, width, height)
             if pr is None:
                 return None
