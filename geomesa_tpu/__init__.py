@@ -16,6 +16,7 @@ _LAZY = {
     "AttributeSpec": "geomesa_tpu.schema.feature_type",
     "GeoDataset": "geomesa_tpu.api.dataset",
     "Query": "geomesa_tpu.api.dataset",
+    "ArrowDataStore": "geomesa_tpu.io.arrow_store",
 }
 
 

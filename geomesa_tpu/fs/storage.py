@@ -321,7 +321,13 @@ class FileSystemStorage:
                 out.append(d)
         return out
 
-    def create(self, ft: FeatureType, scheme: Optional[PartitionScheme] = None):
+    def create(self, ft: FeatureType, scheme: Optional[PartitionScheme] = None,
+               fmt: str = "parquet"):
+        """``fmt``: "parquet" (default) or "arrow" (IPC files — the
+        reference ships both a Parquet and an Arrow file-system encoding;
+        ArrowDataStore.scala / ParquetFileSystemStorage.scala)."""
+        if fmt not in ("parquet", "arrow"):
+            raise ValueError(f"unknown storage format {fmt!r}")
         if os.path.exists(self._meta_path(ft.name)):
             raise ValueError(f"type {ft.name!r} already exists")
         scheme = scheme or (
@@ -331,9 +337,34 @@ class FileSystemStorage:
         self._save_meta(ft.name, {
             "spec": ft.spec(),
             "scheme": scheme.config(),
+            "format": fmt,
             "partitions": {},   # name -> [file names]
             "count": 0,
         })
+
+    # -- format-dispatched file IO ----------------------------------------
+    @staticmethod
+    def _write_file(table: pa.Table, path: str):
+        if path.endswith(".arrow"):
+            arrow_io.write_ipc(path, table.to_batches(), table.schema)
+        else:
+            pq.write_table(table, path)
+
+    @staticmethod
+    def _read_file(path: str, columns=None) -> pa.Table:
+        if path.endswith(".arrow"):
+            t = arrow_io.read_ipc(path)
+            if columns is not None:
+                keep = [c for c in columns if c in t.column_names]
+                t = t.select(keep)
+            return t
+        return pq.read_table(path, columns=columns)
+
+    @staticmethod
+    def _read_file_schema(path: str) -> pa.Schema:
+        if path.endswith(".arrow"):
+            return arrow_io.read_ipc(path).schema
+        return pq.read_schema(path)
 
     def schema(self, name: str) -> FeatureType:
         return FeatureType.from_spec(name, self._load_meta(name)["spec"])
@@ -357,13 +388,16 @@ class FileSystemStorage:
             dicts: Dict[str, DictionaryEncoder] = {}
             batch = encode_batch(ft, data, dicts, fids)
             pnames = scheme.names(ft, batch, dicts)
+            ext = ".arrow" if meta.get("format") == "arrow" else ".parquet"
             for p in np.unique(pnames):
                 sel = batch.select(pnames == p)
                 rb = arrow_io.batch_to_arrow(ft, sel, dicts)
                 pdir = os.path.join(self.root, name, "data", str(p))
                 os.makedirs(pdir, exist_ok=True)
-                fname = uuid.uuid4().hex[:16] + ".parquet"
-                pq.write_table(pa.Table.from_batches([rb]), os.path.join(pdir, fname))
+                fname = uuid.uuid4().hex[:16] + ext
+                self._write_file(
+                    pa.Table.from_batches([rb]), os.path.join(pdir, fname)
+                )
                 meta["partitions"].setdefault(str(p), []).append(fname)
             meta["count"] = meta.get("count", 0) + batch.n
             self._save_meta(name, meta)
@@ -387,7 +421,9 @@ class FileSystemStorage:
         for p in self.prune(name, ecql):
             pdir = os.path.join(self.root, name, "data", p)
             for fname in meta["partitions"][p]:
-                tables.append(pq.read_table(os.path.join(pdir, fname), columns=columns))
+                tables.append(
+                    self._read_file(os.path.join(pdir, fname), columns=columns)
+                )
         if not tables:
             # match the schema of existing files if any (WKT vs point geometry)
             schema = None
@@ -395,7 +431,7 @@ class FileSystemStorage:
                 files = meta["partitions"][p]
                 if files:
                     path = os.path.join(self.root, name, "data", p, files[0])
-                    schema = pq.read_schema(path)
+                    schema = self._read_file_schema(path)
                     break
             if schema is None:
                 ft = FeatureType.from_spec(name, meta["spec"])
@@ -412,7 +448,8 @@ class FileSystemStorage:
         meta = self._load_meta(name)
         pdir = os.path.join(self.root, name, "data", partition)
         tables = [
-            pq.read_table(os.path.join(pdir, f)) for f in meta["partitions"][partition]
+            self._read_file(os.path.join(pdir, f))
+            for f in meta["partitions"][partition]
         ]
         schema = pa.unify_schemas([t.schema for t in tables], promote_options="permissive")
         return pa.concat_tables([t.cast(schema) for t in tables]).unify_dictionaries()
@@ -430,15 +467,16 @@ class FileSystemStorage:
                 if len(files) <= 1:
                     continue
                 pdir = os.path.join(self.root, name, "data", p)
-                tables = [pq.read_table(os.path.join(pdir, f)) for f in files]
+                tables = [self._read_file(os.path.join(pdir, f)) for f in files]
                 schema = pa.unify_schemas(
                     [t.schema for t in tables], promote_options="permissive"
                 )
                 merged = pa.concat_tables(
                     [t.cast(schema) for t in tables]
                 ).unify_dictionaries()
-                fname = uuid.uuid4().hex[:16] + ".parquet"
-                pq.write_table(merged, os.path.join(pdir, fname))
+                ext = ".arrow" if meta.get("format") == "arrow" else ".parquet"
+                fname = uuid.uuid4().hex[:16] + ext
+                self._write_file(merged, os.path.join(pdir, fname))
                 for f in files:
                     os.remove(os.path.join(pdir, f))
                     removed += 1

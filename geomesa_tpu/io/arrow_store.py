@@ -1,0 +1,186 @@
+"""ArrowDataStore analog: query and append GeoMesa-schema Arrow IPC files.
+
+Reference parity: geomesa-arrow's ``ArrowDataStore``
+(geomesa-arrow/geomesa-arrow-gt/src/main/scala/org/locationtech/geomesa/
+arrow/data/ArrowDataStore.scala) exposes an Arrow IPC file — typically one
+produced by an Arrow export — as a queryable, appendable feature store.
+Here the file's batches are lazily hydrated into an in-process
+:class:`~geomesa_tpu.api.dataset.GeoDataset`, so every query rides the
+normal planner/executor stack (ECQL pushdown, density/stats kernels)
+instead of a bespoke row loop; appends re-dictionary-encode against the
+store and rewrite the file on :meth:`flush` (IPC files are immutable —
+the reference's writable mode likewise rewrites/streams whole files).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from geomesa_tpu.schema.feature_type import FeatureType
+
+
+class ArrowDataStore:
+    """One Arrow IPC file as a feature store.
+
+    >>> store = ArrowDataStore("/data/flights.arrow")
+    >>> store.query("BBOX(geom, -10, 40, 5, 55)").n
+    >>> store.append({...}, fids=[...]); store.flush()
+    """
+
+    def __init__(self, path: str, ft: Optional[FeatureType] = None,
+                 create: bool = False):
+        import pyarrow as pa  # noqa: F401  (hard dep of this module)
+
+        self.path = path
+        # reentrant: append() holds the lock across _dataset()
+        self._lock = threading.RLock()
+        self._ds = None
+        self._dirty = False
+        if not os.path.exists(path):
+            if not create or ft is None:
+                raise FileNotFoundError(
+                    f"{path!r} does not exist (pass create=True and a "
+                    "FeatureType to start a new store)"
+                )
+            self._ft = ft
+            # a created-but-never-appended store must still flush its
+            # (empty) file, or reopening it would raise FileNotFoundError
+            self._dirty = True
+        else:
+            self._ft = ft  # None = infer from the file on first use
+
+    # -- internals ---------------------------------------------------------
+    def _dataset(self):
+        """Lazily hydrate the file into a GeoDataset (under the lock —
+        an unlocked hydration racing an append could rebuild from the
+        stale file and drop the appended rows on the next flush)."""
+        with self._lock:
+            if self._ds is not None:
+                return self._ds
+            from geomesa_tpu.api.dataset import GeoDataset
+            from geomesa_tpu.io import arrow_io
+
+            ds = GeoDataset()
+            if os.path.exists(self.path):
+                table = arrow_io.read_ipc(self.path)
+                if self._ft is None:
+                    self._ft = _infer_feature_type(
+                        os.path.splitext(os.path.basename(self.path))[0],
+                        table,
+                    )
+                ds.create_schema(self._ft)
+                if table.num_rows:
+                    ds.ingest_arrow(self._ft.name, table)
+                    ds.flush(self._ft.name)
+            else:
+                ds.create_schema(self._ft)
+            self._ds = ds
+            return ds
+
+    @property
+    def feature_type(self) -> FeatureType:
+        self._dataset()
+        return self._ft
+
+    @property
+    def name(self) -> str:
+        return self.feature_type.name
+
+    # -- reads (full planner/executor stack) -------------------------------
+    def query(self, query="INCLUDE"):
+        """``query``: ECQL text or a :class:`~geomesa_tpu.api.dataset.Query`
+        (hints ride the Query object, as everywhere else)."""
+        return self._dataset().query(self.name, query)
+
+    def count(self, ecql: str = "INCLUDE") -> int:
+        return self._dataset().count(self.name, ecql)
+
+    def density(self, ecql: str = "INCLUDE", **kw):
+        return self._dataset().density(self.name, ecql, **kw)
+
+    def stats(self, stat: str, ecql: str = "INCLUDE"):
+        return self._dataset().stats(self.name, stat, ecql)
+
+    # -- writes ------------------------------------------------------------
+    def append(self, data: Dict[str, np.ndarray], fids=None) -> int:
+        """Buffer rows into the store (visible to queries immediately);
+        :meth:`flush` persists them to the file."""
+        with self._lock:
+            ds = self._dataset()
+            n = ds.insert(self.name, data, fids)
+            ds.flush(self.name)
+            self._dirty = True
+            return n
+
+    def flush(self):
+        """Rewrite the IPC file with the store's current contents."""
+        with self._lock:
+            if not self._dirty:
+                return
+            ds = self._dataset()
+            tmp = self.path + ".tmp"
+            ds.export_arrow(self.name, tmp)
+            os.replace(tmp, self.path)
+            self._dirty = False
+
+    def close(self):
+        self.flush()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def _infer_feature_type(name: str, table) -> FeatureType:
+    """Feature type of an Arrow table written by this framework: arrow_io
+    embeds the exact spec string as schema metadata (``geomesa:spec``).
+    Foreign Arrow files fall back to structural inference."""
+    import pyarrow as pa
+
+    md = table.schema.metadata or {}
+    spec = md.get(b"geomesa:spec")
+    if spec:
+        return FeatureType.from_spec(name, spec.decode())
+    from geomesa_tpu.io.arrow_io import FID
+
+    parts: List[str] = []
+    geom_done = False
+    for field in table.schema:
+        t = field.type
+        if field.name == FID:
+            continue
+        if pa.types.is_fixed_size_list(t) and t.list_size == 2 \
+                and not geom_done:
+            parts.append(f"*{field.name}:Point:srid=4326")
+            geom_done = True
+        elif pa.types.is_timestamp(t):
+            parts.append(f"{field.name}:Date")
+        elif pa.types.is_dictionary(t) or pa.types.is_string(t) or \
+                pa.types.is_large_string(t):
+            parts.append(f"{field.name}:String")
+        elif pa.types.is_integer(t):
+            parts.append(
+                f"{field.name}:Long" if t.bit_width == 64
+                else f"{field.name}:Integer"
+            )
+        elif pa.types.is_floating(t):
+            parts.append(
+                f"{field.name}:Double" if t.bit_width == 64
+                else f"{field.name}:Float"
+            )
+        elif pa.types.is_boolean(t):
+            parts.append(f"{field.name}:Boolean")
+        # unknown types are skipped
+    if not parts:
+        raise ValueError(
+            f"cannot infer a feature type from {name!r}: no recognized "
+            "columns and no geomesa:spec metadata"
+        )
+    return FeatureType.from_spec(name, ",".join(parts))

@@ -35,11 +35,15 @@ def density_grid(x, y, mask, bbox, width: int, height: int, weight=None, xp=None
         np.add.at(grid, flat_idx, w)
         return grid.reshape(height, width)
     # Split the scatter into independent pieces accumulating separate
-    # grids: measured on v5e, one 2M-update scatter costs ~6.1 ns/update
-    # while 8 independent 256k scatters + grid adds run at ~0.5 ns/update
-    # (the XLA scheduler overlaps the scatters' phases; a lax.scan over the
-    # same pieces stays serial at ~7 ns). Pieces must divide evenly —
-    # callers keep row counts a multiple of 8 (see executor chunk buckets).
+    # grids. Measured on v5e with pre-staged inputs, 8 independent pow2
+    # scatters + grid adds ran ~10x faster than one scatter; re-measured
+    # r4 FUSED behind a mask compute in one jit, the split shows no gain
+    # (~7 ns/update either way — XLA serializes the pieces after the
+    # shared producer). Kept because it never hurts and the pre-staged
+    # shape still benefits; the real fix is the pallas kernel
+    # (density_pallas.py), which replaces this path on z-indexed tables.
+    # Pieces must divide evenly — callers keep row counts a multiple of 8
+    # (see executor chunk buckets).
     from geomesa_tpu import config
 
     P = config.SCATTER_SPLIT.to_int() or 0

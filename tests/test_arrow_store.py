@@ -1,0 +1,134 @@
+"""ArrowDataStore analog (io/arrow_store.py) + Arrow-format FSDS tier.
+
+Reference parity: geomesa-arrow's ArrowDataStore queries/appends Arrow IPC
+files (geomesa-arrow-gt/.../arrow/data/ArrowDataStore.scala); the fs
+datastore ships multiple file encodings (ParquetFileSystemStorage.scala)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.filter.ecql import parse_iso_ms
+from geomesa_tpu.fs.storage import DateTimeScheme, FileSystemStorage
+from geomesa_tpu.io.arrow_store import ArrowDataStore
+from geomesa_tpu.schema.feature_type import FeatureType
+
+
+def _data(n=2000, seed=5):
+    rng = np.random.default_rng(seed)
+    lo, hi = parse_iso_ms("2020-01-01"), parse_iso_ms("2020-01-10")
+    return {
+        "name": rng.choice(["a", "b", "c"], n),
+        "val": rng.uniform(0, 100, n).astype(np.float32),
+        "dtg": rng.integers(lo, hi, n).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+    }
+
+
+SPEC = "name:String,val:Float,dtg:Date,*geom:Point"
+ECQL = "BBOX(geom, -100, 30, -80, 45) AND val < 50"
+
+
+def _oracle(data):
+    x, y = data["geom__x"], data["geom__y"]
+    return (
+        (x >= -100) & (x <= -80) & (y >= 30) & (y <= 45)
+        & (data["val"] < 50)
+    )
+
+
+def _export_ipc(tmp_path, data):
+    ds = GeoDataset()
+    ds.create_schema("pts", SPEC)
+    ds.insert("pts", data, fids=np.arange(len(data["val"])).astype(str))
+    ds.flush("pts")
+    path = str(tmp_path / "pts.arrow")
+    ds.export_arrow("pts", path)
+    return path
+
+
+def test_query_exported_file(tmp_path):
+    data = _data()
+    path = _export_ipc(tmp_path, data)
+    store = ArrowDataStore(path)
+    # feature type recovered from the embedded spec metadata
+    assert store.feature_type.spec().startswith("name:String")
+    assert store.count() == 2000
+    m = _oracle(data)
+    assert store.count(ECQL) == int(m.sum())
+    fc = store.query(ECQL)
+    assert len(fc) == int(m.sum())
+    # density through the full executor stack
+    g = store.density(ECQL, bbox=(-100, 30, -80, 45), width=64, height=64)
+    assert g.sum() == int(m.sum())
+
+
+def test_append_and_reopen(tmp_path):
+    data = _data(500)
+    path = _export_ipc(tmp_path, data)
+    with ArrowDataStore(path) as store:
+        more = _data(250, seed=9)
+        store.append(more, fids=[f"x{i}" for i in range(250)])
+        assert store.count() == 750  # visible before flush
+    # context manager flushed; a fresh store sees everything
+    again = ArrowDataStore(path)
+    assert again.count() == 750
+
+
+def test_create_new_store(tmp_path):
+    path = str(tmp_path / "fresh.arrow")
+    with pytest.raises(FileNotFoundError):
+        ArrowDataStore(path)
+    ft = FeatureType.from_spec("fresh", SPEC)
+    with ArrowDataStore(path, ft=ft, create=True) as store:
+        store.append(_data(100), fids=np.arange(100).astype(str))
+    assert ArrowDataStore(path).count() == 100
+
+
+def test_create_empty_store_reopens(tmp_path):
+    """A created-but-never-appended store still writes its (empty) file."""
+    path = str(tmp_path / "empty.arrow")
+    ft = FeatureType.from_spec("empty", SPEC)
+    with ArrowDataStore(path, ft=ft, create=True):
+        pass
+    again = ArrowDataStore(path)
+    assert again.count() == 0
+    assert again.feature_type.name == "empty"
+
+
+def test_fs_storage_arrow_format(tmp_path):
+    fs = FileSystemStorage(str(tmp_path))
+    ft = FeatureType.from_spec("t", SPEC)
+    fs.create(ft, DateTimeScheme("day"), fmt="arrow")
+    data = _data(1500)
+    fs.write("t", data, fids=np.arange(1500).astype(str))
+    # files carry the .arrow extension
+    import glob
+    files = glob.glob(str(tmp_path / "t" / "data" / "**" / "*.arrow"),
+                      recursive=True)
+    assert files, "no .arrow partition files written"
+    assert not glob.glob(str(tmp_path / "t" / "data" / "**" / "*.parquet"),
+                         recursive=True)
+    # pruned read round-trips
+    table = fs.read("t", "dtg DURING 2020-01-02T00:00:00Z/2020-01-04T00:00:00Z")
+    t = data["dtg"].astype(np.int64)
+    lo = parse_iso_ms("2020-01-02")
+    hi = parse_iso_ms("2020-01-04")
+    # partition pruning is day-granular: the pruned table is a superset
+    day_lo = parse_iso_ms("2020-01-02")
+    day_hi = parse_iso_ms("2020-01-05")
+    assert table.num_rows == int(((t >= day_lo) & (t < day_hi)).sum())
+    # compaction keeps the format
+    fs.write("t", _data(100, seed=11), fids=[f"y{i}" for i in range(100)])
+    fs.compact("t")
+    files = glob.glob(str(tmp_path / "t" / "data" / "**" / "*.arrow"),
+                      recursive=True)
+    assert files and not glob.glob(
+        str(tmp_path / "t" / "data" / "**" / "*.parquet"), recursive=True
+    )
+    assert fs.count("t") == 1600
+    # bulk load into a device store
+    ds = GeoDataset()
+    n = fs.load_into(ds, "t")
+    assert n == 1600 and ds.count("t") == 1600
