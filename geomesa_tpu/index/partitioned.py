@@ -294,15 +294,24 @@ class PartitionedFeatureStore(FeatureStore):
         bins, _ = self.binned.to_bin_and_offset(
             np.asarray(fresh.columns[dtg], np.int64)
         )
-        order = np.argsort(bins, kind="stable")
+        # i32 keys radix-sort ~5x faster than i64 (bin ids are epoch
+        # periods, far below 2^31); one global gather per column up front
+        # makes every partition's sub-batch a zero-copy slice
+        order = np.argsort(bins.astype(np.int32), kind="stable")
         sb = bins[order]
+        sorted_cols = {k: v[order] for k, v in fresh.columns.items()}
         cuts = np.flatnonzero(np.concatenate(([True], sb[1:] != sb[:-1])))
         bounds = np.concatenate((cuts, [len(sb)]))
         for i, c in enumerate(cuts):
             b = int(sb[c])
-            rows = order[c:bounds[i + 1]]
+            hi = bounds[i + 1]
+            # contiguous-slice COPIES (cheap memcpy, unlike the fancy
+            # gather this replaced) — a view would pin the whole sorted
+            # batch in every child's master columns, defeating the
+            # residency-budget eviction
             sub = ColumnBatch(
-                {k: v[rows] for k, v in fresh.columns.items()}, len(rows)
+                {k: v[c:hi].copy() for k, v in sorted_cols.items()},
+                int(hi - c),
             )
             child = self.child(b, create=True)
             child._buffer.append(sub)

@@ -92,6 +92,11 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     ]
     lib.gm_off_from_bin.argtypes = [_i64p, _i32p, c64, c64, _i64p]
     lib.gm_sort_u64.argtypes = [_u64p, c64]
+    _u32p = np.ctypeslib.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    lib.gm_u32_to_s.argtypes = [_u32p, _u8p, c64]
+    lib.gm_u32_to_s.restype = c32
+    lib.gm_s_to_u32.argtypes = [_u8p, _u32p, c64]
+    lib.gm_s_to_u32.restype = c32
     lib.gm_num_threads.restype = c32
     return lib
 
@@ -115,11 +120,11 @@ def lib() -> "Optional[ctypes.CDLL]":
                 return None
         try:
             candidate = ctypes.CDLL(_SO_PATH)
-            if candidate.gm_abi_version() != 3:
+            if candidate.gm_abi_version() != 4:
                 # stale .so from an older source tree: rebuild once
                 if _build():
                     candidate = ctypes.CDLL(_SO_PATH)
-            if candidate.gm_abi_version() == 3:
+            if candidate.gm_abi_version() == 4:
                 _lib = _bind(candidate)
         except (OSError, AttributeError):
             _lib = None
@@ -362,3 +367,29 @@ def time_split(
         sc.ctypes.data if sc is not None else None,
     )
     return b, off, sc
+
+
+def u32_to_s(cp: np.ndarray) -> "Optional[np.ndarray]":
+    """Fused UCS4->bytes narrowing with ASCII check. ``cp`` is the flat
+    uint32 code-point view of a 'U' array; returns the uint8 buffer, or
+    None when unavailable / non-ASCII (caller keeps the unicode layout)."""
+    L = lib()
+    if L is None:
+        return None
+    cp = np.ascontiguousarray(cp, np.uint32)
+    out = np.empty(cp.size, np.uint8)
+    if not L.gm_u32_to_s(cp.reshape(-1), out, cp.size):
+        return None
+    return out
+
+
+def s_to_u32(by: np.ndarray) -> "Optional[np.ndarray]":
+    """Fused bytes->UCS4 widening with ASCII check (export mirror)."""
+    L = lib()
+    if L is None:
+        return None
+    by = np.ascontiguousarray(by, np.uint8)
+    out = np.empty(by.size, np.uint32)
+    if not L.gm_s_to_u32(by.reshape(-1), out, by.size):
+        return None
+    return out

@@ -190,6 +190,61 @@ class Executor:
             "coarse_device": coarse_device,
         }
 
+    def _compact_candidates(self, plan: QueryPlan, setup):
+        """Window set + chunk size for a compacted scan: (starts, ends, B,
+        lens), or None when no window set admits chunking.
+
+        Steady-state cost is per PADDED row, so the chunk size minimizes
+        padding (preferring the largest B within 10% — fewer, larger slabs
+        gather faster on the one-time pass), over BOTH window resolutions:
+        the fine (gap-union-free) set usually admits fewer rows AND gives
+        spatially tight chunks (the density pair lists depend on that), so
+        it wins any near-tie (the 0.77 bias). Shared by the single-chip
+        and mesh compaction descriptors."""
+        L = setup["L"]
+        ladder = [b for b in (128, 256, 512, 1024, 2048, 4096) if b <= L]
+
+        def _choose(starts, ends):
+            """(B, rows, lens) minimizing padded rows for one window set."""
+            lens = np.maximum(ends - starts, 0).astype(np.int64)
+            if int(lens.sum()) == 0 or not ladder:
+                return None
+            flat = lens.reshape(-1)
+            rows_at = {
+                Bc: int((-(-flat // Bc)).sum()) * Bc for Bc in ladder
+            }
+            override = config.COMPACT_B.to_int() or 0
+            if override:
+                # clamp the knob into the legal ladder (values off the
+                # ladder or > L would break the slab clamp arithmetic)
+                B = min(ladder, key=lambda b: abs(b - override))
+            else:
+                floor_rows = min(rows_at.values())
+                B = max(
+                    b for b, r in rows_at.items() if r <= 1.10 * floor_rows
+                )
+            return B, rows_at[B], lens
+
+        cands = []
+        coarse = _choose(setup["starts"], setup["ends"])
+        if coarse is not None:
+            cands.append(
+                (coarse[1], 1, setup["starts"], setup["ends"], coarse[0],
+                 coarse[2])
+            )
+        fs, fe = self._fine_windows(plan, setup)
+        if fs is not None:
+            fine = _choose(fs, fe)
+            if fine is not None:
+                cands.append(
+                    (int(fine[1] * 0.77), 0, fs, fe, fine[0], fine[2])
+                )
+        if not cands:
+            return None
+        cands.sort(key=lambda c: (c[0], c[1]))
+        _, _, starts, ends, B, lens = cands[0]
+        return starts, ends, B, lens
+
     def _maybe_compact(self, plan: QueryPlan, setup, allowed: bool) -> None:
         """Decide the window-compacted layout for this scan. Sets
         ``setup['compact']`` to a chunk-descriptor dict (or None).
@@ -226,48 +281,13 @@ class Executor:
             setup["compact"] = chit or None
             return
         L = setup["L"]
-
-        def _choose(starts, ends):
-            """(B, rows, lens) minimizing padded rows for one window set."""
-            lens = np.maximum(ends - starts, 0).astype(np.int64)
-            if int(lens.sum()) == 0:
-                return None
-            flat = lens.reshape(-1)
-            rows_at = {
-                Bc: int((-(-flat // Bc)).sum()) * Bc
-                for Bc in (128, 256, 512, 1024, 2048, 4096)
-                if Bc <= L
-            }
-            if not rows_at:
-                return None
-            floor_rows = min(rows_at.values())
-            B = (config.COMPACT_B.to_int() or 0) or max(
-                b for b, r in rows_at.items() if r <= 1.10 * floor_rows
-            )
-            return B, rows_at[B], lens
-
-        # steady-state cost is per PADDED row, so choose the chunk size
-        # minimizing padding (prefer the largest B within 10% — fewer,
-        # larger slabs gather faster on the one-time pass), over BOTH
-        # window resolutions: the fine (gap-union-free) set usually admits
-        # fewer rows AND gives spatially tight chunks (the MXU density
-        # pair lists depend on that), so it wins any near-tie.
-        cands = []
-        coarse = _choose(setup["starts"], setup["ends"])
-        if coarse is not None:
-            cands.append((coarse[1], 1, setup["starts"], setup["ends"]) + coarse[:1] + (coarse[2],))
-        fs, fe = self._fine_windows(plan, setup)
-        if fs is not None:
-            fine = _choose(fs, fe)
-            if fine is not None:
-                cands.append((int(fine[1] * 0.77), 0, fs, fe, fine[0], fine[2]))
-        if not cands:
+        chosen = self._compact_candidates(plan, setup)
+        if chosen is None:
             if len(ccache) >= 64:
                 ccache.clear()
             ccache[ckey] = False
             return
-        cands.sort(key=lambda c: (c[0], c[1]))
-        _, _, starts, ends, B, lens = cands[0]
+        starts, ends, B, lens = chosen
         S, K = starts.shape
         flat_lens = lens.reshape(-1)
         nc = -(-flat_lens // B)
@@ -313,6 +333,176 @@ class Executor:
             ccache.clear()
         ccache[ckey] = desc
         setup["compact"] = desc
+
+    # -- mesh-sharded window compaction -----------------------------------
+    def _plain_shard_mesh(self):
+        """The mesh, when 'shard' is its only non-trivial axis (the
+        binspace 2-D layout has its own path)."""
+        m = self.mesh
+        if m is None or "shard" not in m.axis_names:
+            return None
+        other = int(np.prod([
+            m.shape[a] for a in m.axis_names if a != "shard"
+        ])) if len(m.axis_names) > 1 else 1
+        return m if other == 1 else None
+
+    def _mesh_compact_desc(self, plan: QueryPlan, setup, D: int):
+        """Per-device compact descriptors for a 'shard'-meshed scan:
+        [D, Cp] (cstart, lo, valid) arrays with a UNIFORM padded chunk
+        count Cp, chunk starts local to each device's [S/D, L] block —
+        every device slab-gathers only its own windows' rows, so a
+        multi-chip selective scan costs per row SCANNED per chip, exactly
+        like the single-chip compact path. False = compaction can't win
+        for these windows (cached)."""
+        ckey = ("compact_mesh", self.store.uid, self.store.version,
+                plan.index_name, plan.__dict__.get("window_token"), D,
+                config.COMPACT_B.to_int(), config.COMPACT_FRACTION.to_float(),
+                config.COMPACT_COVER.to_int())
+        cache, ckey = self._resolve_cache(plan, ckey)
+        hit = cache.get(ckey)
+        if hit is not None:
+            return hit or None
+        table = setup["table"]
+        L = setup["L"]
+        chosen = self._compact_candidates(plan, setup)
+        out = False
+        if chosen is not None:
+            starts, ends, B, lens = chosen
+            S, K = starts.shape
+            Sd = S // D
+            flat_lens = lens.reshape(-1)
+            nc = -(-flat_lens // B)
+            C = int(nc.sum())
+            c_dev = nc.reshape(D, Sd * K).sum(axis=1)
+            from geomesa_tpu.kernels.density_mxu import ladder8
+
+            Cp = ladder8(int(c_dev.max())) if C else 0
+            frac = config.COMPACT_FRACTION.to_float()
+            frac = 0.5 if frac is None else frac
+            if C and Cp * B * D < table.n * frac:
+                win = np.repeat(np.arange(S * K), nc)
+                j = np.arange(C) - np.repeat(np.cumsum(nc) - nc, nc)
+                s_of = win // K
+                d_of = s_of // Sd
+                gstart = (
+                    (s_of - d_of * Sd) * L + starts.reshape(-1)[win] + j * B
+                ).astype(np.int64)
+                valid = np.minimum(flat_lens[win] - j * B, B).astype(np.int32)
+                cstart = np.minimum(gstart, Sd * L - B)
+                lo = (gstart - cstart).astype(np.int32)
+                # pack into [D, Cp]: chunks of device d land at row d in
+                # their global (shard-major) order
+                slot = np.arange(C) - np.repeat(
+                    np.concatenate(([0], np.cumsum(c_dev)[:-1])), c_dev
+                )
+                a_cstart = np.zeros((D, Cp), np.int32)
+                a_lo = np.zeros((D, Cp), np.int32)
+                a_valid = np.zeros((D, Cp), np.int32)
+                a_cstart[d_of, slot] = cstart.astype(np.int32)
+                a_lo[d_of, slot] = lo
+                a_valid[d_of, slot] = valid
+                out = {
+                    "B": B, "Cp": Cp,
+                    "cstart": a_cstart, "lo": a_lo, "valid": a_valid,
+                    "whash": hash((starts.tobytes(), ends.tobytes())),
+                }
+        if len(cache) >= 64:
+            cache.clear()
+        cache[ckey] = out
+        return out or None
+
+    def _compact_mesh_run(self, plan: QueryPlan, setup, agg_fn, agg_cols,
+                          cache_key, extra):
+        """Additive aggregate over per-device compacted windows on the
+        plain-'shard' mesh (shard_map slab-gather + fused mask + psum).
+        None when the layout does not apply (caller falls through to the
+        padded GSPMD path)."""
+        mesh = self._plain_shard_mesh()
+        table = setup["table"]
+        if (
+            mesh is None
+            or not config.COMPACT_ENABLED.to_bool()
+            or plan.hints.sampling  # the 1-in-n counter is global
+            or table.n < (config.COMPACT_MIN_ROWS.to_int() or 0)
+            or table.n_shards % mesh.shape["shard"] != 0
+        ):
+            return None
+        D = mesh.shape["shard"]
+        d = self._mesh_compact_desc(plan, setup, D)
+        if d is None:
+            return None
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import PartitionSpec as P
+
+        shard_map = getattr(jax, "shard_map", None)
+        if shard_map is None:  # older jax: experimental module
+            from jax.experimental.shard_map import shard_map
+
+        B, Cp = d["B"], d["Cp"]
+        compiled = plan.compiled
+        names = tuple(dict.fromkeys(list(setup["needed"]) + list(agg_cols)))
+        dev_cols = table.device_columns(names, self._sharding())
+        token = plan.__dict__.get("cache_token")
+        if token is not None and cache_key is not None:
+            fn_cache = (
+                self.kernel_fns
+                if self.kernel_fns is not None
+                else self.version_source.__dict__.setdefault("_kernel_fns", {})
+            )
+            fn_key = ("compact_mesh", cache_key, B, Cp, D, token,
+                      plan.index_name, self.version_source.version)
+        else:
+            fn_cache = plan.__dict__.setdefault("_kernel_fns", {})
+            fn_key = ("compact_mesh", cache_key, B, Cp, D)
+        go = fn_cache.get(fn_key)
+        if go is None:
+            col_names = sorted(names)
+
+            def local(cols, cstart, lo, valid, extra):
+                gather = jax.vmap(
+                    lambda flat, s: jax.lax.dynamic_slice(flat, (s,), (B,)),
+                    in_axes=(None, 0),
+                )
+                ccols = {
+                    k: gather(cols[k].reshape(-1), cstart[0])
+                    for k in col_names
+                }
+                iota = jnp.arange(B, dtype=jnp.int32)[None, :]
+                m = (iota >= lo[0][:, None]) & (iota < (lo[0] + valid[0])[:, None])
+                m = m & compiled(ccols, jnp)
+                if compiled.band is not None:
+                    m = m & ~compiled.band(ccols, jnp)
+                return jax.lax.psum(agg_fn(ccols, m, jnp, *extra), "shard")
+
+            sm = shard_map(
+                local, mesh=mesh,
+                in_specs=(
+                    {k: P("shard", None) for k in col_names},
+                    P("shard", None), P("shard", None), P("shard", None),
+                    P(),
+                ),
+                out_specs=P(),
+            )
+            go = jax.jit(sm)
+            if len(fn_cache) >= 64:
+                fn_cache.clear()
+            fn_cache[fn_key] = go
+        wcache = self.store.__dict__.setdefault("_win_cache", {})
+        wkey = ("mesh_win", d["whash"], B, Cp, D, self.store.uid,
+                self.store.version)
+        win = wcache.get(wkey)
+        if win is None:
+            sh = self._sharding()
+            win = tuple(
+                jax.device_put(d[k], sh) for k in ("cstart", "lo", "valid")
+            )
+            if len(wcache) >= 64:
+                wcache.clear()
+            wcache[wkey] = win
+        return go(
+            {k: dev_cols[k] for k in sorted(names)}, *win, tuple(extra)
+        )
 
     def _resolve_cache(self, plan: QueryPlan, key):
         """Window-resolution cache host: store-level keyed by the plan's
@@ -979,6 +1169,12 @@ class Executor:
                         "binspace scan failed, trying GSPMD path: %r", e
                     )
             try:
+                if additive and compactable and self.mesh is not None:
+                    out = self._compact_mesh_run(
+                        plan, setup, agg_fn_dev, agg_cols, cache_key, extra
+                    )
+                    if out is not None:
+                        return out if corr is None else out + corr
                 self._maybe_compact(plan, setup, compactable)
                 if setup["compact"] is not None:
                     agg_use, extra_use, ckey = agg_fn_dev, extra, cache_key

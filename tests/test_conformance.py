@@ -77,6 +77,17 @@ def client(server):
         yield c
 
 
+@pytest.fixture()
+def seeded(client):
+    """Idempotently ensure the shared 'conf' schema exists with the
+    standard table, so every test also passes in isolation (-k / xdist),
+    not just in file order."""
+    if "conf" not in client.list_schemas():
+        client.create_schema("conf", SPEC)
+        client.insert_arrow("conf", _table())
+    return client
+
+
 N = 5_000
 
 
@@ -114,22 +125,26 @@ def test_01_version_handshake(client):
 
 
 def test_02_schema_lifecycle(client):
-    assert client.create_schema("conf", SPEC) == "conf"
-    assert "conf" in client.list_schemas()
-    desc = client.describe("conf")
+    assert client.create_schema("lc", SPEC) == "lc"
+    assert "lc" in client.list_schemas()
+    desc = client.describe("lc")
     assert "name" in desc and "geom" in desc
     with pytest.raises(fl.FlightError):
-        client.create_schema("conf", SPEC)  # duplicate
+        client.create_schema("lc", SPEC)  # duplicate
+    client.delete_schema("lc")
 
 
 def test_03_ingest_and_count(client):
+    if "conf" not in client.list_schemas():
+        client.create_schema("conf", SPEC)
     t = _table()
     client.insert_arrow("conf", t)
     assert client.count("conf") == N
     assert client.count("conf", CQL) == int(_oracle_mask(t).sum())
 
 
-def test_04_query_cql_projection_limit(client):
+def test_04_query_cql_projection_limit(seeded):
+    client = seeded
     t = _table()
     want = int(_oracle_mask(t).sum())
     got = client.query("conf", CQL)
@@ -144,7 +159,7 @@ def test_04_query_cql_projection_limit(client):
     assert 0 < samp.num_rows <= N // 10 + 1
 
 
-def test_05_streaming_batches(client, server):
+def test_05_streaming_batches(seeded, server):
     """PROTOCOL §3: query results arrive as incremental record batches."""
     os.environ["GEOMESA_ARROW_BATCH_ROWS"] = "100000"
     ticket = fl.Ticket(b'{"op": "query", "schema": "conf"}')
@@ -158,7 +173,8 @@ def test_05_streaming_batches(client, server):
     assert nbatches >= 1
 
 
-def test_06_density(client):
+def test_06_density(seeded):
+    client = seeded
     t = _table()
     grid = client.density("conf", CQL, bbox=(-100, 30, -80, 45),
                           width=64, height=64)
@@ -166,7 +182,8 @@ def test_06_density(client):
     assert int(grid.sum()) == int(_oracle_mask(t).sum())
 
 
-def test_07_stats(client):
+def test_07_stats(seeded):
+    client = seeded
     t = _table()
     mm = client.stats("conf", "MinMax(speed)", CQL)
     speeds = np.asarray(t["speed"].to_pylist())[_oracle_mask(t)]
@@ -177,14 +194,16 @@ def test_07_stats(client):
     assert set(enum.value().keys()) == {"n1"}
 
 
-def test_08_bin_export(client):
+def test_08_bin_export(seeded):
+    client = seeded
     t = _table()
     blob = client.export_bin("conf", CQL, track="name")
     want = int(_oracle_mask(t).sum())
     assert len(blob) == want * 16
 
 
-def test_09_explain_and_audit(client):
+def test_09_explain_and_audit(seeded):
+    client = seeded
     plan = client.explain("conf", CQL)
     assert "Chosen index" in plan
     client.count("conf", CQL)
@@ -196,13 +215,15 @@ def test_09_explain_and_audit(client):
     assert last["scanned"] >= last["hits"] > 0
 
 
-def test_10_discovery(client):
+def test_10_discovery(seeded):
+    client = seeded
     infos = list(client._client.list_flights())
     names = [i.descriptor.path[0].decode() for i in infos]
     assert "conf" in names
 
 
-def test_11_errors(client):
+def test_11_errors(seeded):
+    client = seeded
     with pytest.raises(fl.FlightError, match="conf2|no schema"):
         client.count("conf2")
     with pytest.raises(fl.FlightError, match="nosuch"):
@@ -212,8 +233,12 @@ def test_11_errors(client):
 
 
 def test_12_delete_schema(client):
-    client.delete_schema("conf")
-    assert "conf" not in client.list_schemas()
+    # delete semantics on a data-bearing schema of its own
+    client.create_schema("tmpdel", SPEC)
+    client.insert_arrow("tmpdel", _table(500, seed=3))
+    assert client.count("tmpdel") == 500
+    client.delete_schema("tmpdel")
+    assert "tmpdel" not in client.list_schemas()
 
 
 def test_13_density_curve_over_wire(client):
