@@ -49,6 +49,11 @@ class Query:
     index: Optional[str] = None
     #: visibility authorizations for this query (None = dataset default)
     auths: Optional[List[str]] = None
+    #: EPSG code to reproject result geometries into (storage is 4326;
+    #: the reference reprojects as the final post-processing step,
+    #: QueryPlanner.scala:68-90). Built-in: 3857; others pluggable via
+    #: utils.reproject.register.
+    srid: Optional[int] = None
 
     def hints(self) -> QueryHints:
         return QueryHints(
@@ -65,10 +70,12 @@ class FeatureCollection:
     """Query result: host columns + decode helpers."""
 
     def __init__(self, ft: FeatureType, batch: ColumnBatch,
-                 dicts: Dict[str, DictionaryEncoder]):
+                 dicts: Dict[str, DictionaryEncoder], srid: int = 4326):
         self.ft = ft
         self.batch = batch
         self.dicts = dicts
+        #: CRS of the geometry columns (4326 unless the query reprojected)
+        self.srid = srid
 
     def __len__(self):
         return self.batch.n
@@ -565,7 +572,38 @@ class GeoDataset:
                 },
                 batch.n,
             )
-        return FeatureCollection(st.ft, batch, st.dicts)
+        if q.srid is not None and q.srid != 4326 and batch.n:
+            batch = self._reproject_batch(st.ft, batch, q.srid)
+        return FeatureCollection(st.ft, batch, st.dicts, srid=q.srid or 4326)
+
+    @staticmethod
+    def _reproject_batch(ft: FeatureType, batch: ColumnBatch,
+                         srid: int) -> ColumnBatch:
+        """Transform every geometry column to ``srid`` (last step of the
+        post-processing chain, matching QueryPlanner.scala:68-90; raises
+        for unregistered CRS pairs). Point x/y columns transform in one
+        vectorized pass; WKT extent columns per geometry."""
+        from geomesa_tpu.utils import reproject as rp
+
+        fn = rp.transformer(4326, srid)
+        cols = dict(batch.columns)
+        for a in ft.attributes:
+            if not a.is_geom:
+                continue
+            xc, yc = a.name + "__x", a.name + "__y"
+            if xc in cols:
+                x, y = fn(
+                    np.asarray(cols[xc], np.float64),
+                    np.asarray(cols[yc], np.float64),
+                )
+                cols[xc], cols[yc] = x, y
+            wc = a.name + "__wkt"
+            if wc in cols:
+                cols[wc] = np.array(
+                    [rp.reproject_wkt(str(w), fn) for w in cols[wc]],
+                    dtype=object,
+                )
+        return ColumnBatch(cols, batch.n)
 
     def query_batches(self, name: str, query: "str | Query" = "INCLUDE",
                       batch_rows: Optional[int] = None):

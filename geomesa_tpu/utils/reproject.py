@@ -1,0 +1,111 @@
+"""Result reprojection (the CRS half of GeoTools Query semantics).
+
+Reference parity: the reference reprojects query results as the LAST
+post-processing step (QueryPlanner.runQuery's reduce -> sort -> limit ->
+reproject chain, geomesa-index-api/.../planning/QueryPlanner.scala:68-90),
+delegating the math to GeoTools' referencing module. Storage stays
+EPSG:4326 (like the reference's indices, which normalize to lon/lat for
+the space-filling curves); a query may ask for results in another CRS.
+
+This module ships closed-form transforms for the CRS pair that covers
+web mapping (EPSG:4326 <-> EPSG:3857 spherical mercator) behind a small
+registry, so additional projections plug in without touching the query
+path. Transforms are vectorized numpy (and jit-able: pure ufunc math)."""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Tuple
+
+import numpy as np
+
+#: spherical-mercator earth radius (EPSG:3857 definition)
+R = 6378137.0
+
+#: 3857's valid latitude band; beyond it the projection diverges
+MAX_LAT = 85.051128779806604
+
+
+def to_mercator(x, y, xp=np):
+    """EPSG:4326 lon/lat degrees -> EPSG:3857 meters."""
+    mx = x * (math.pi / 180.0) * R
+    yc = xp.clip(y, -MAX_LAT, MAX_LAT)
+    my = xp.log(xp.tan((90.0 + yc) * (math.pi / 360.0))) * R
+    return mx, my
+
+
+def from_mercator(mx, my, xp=np):
+    """EPSG:3857 meters -> EPSG:4326 lon/lat degrees."""
+    x = mx / R * (180.0 / math.pi)
+    y = (2.0 * xp.arctan(xp.exp(my / R)) - math.pi / 2.0) * (180.0 / math.pi)
+    return x, y
+
+
+_TRANSFORMS: Dict[Tuple[int, int], Callable] = {
+    (4326, 3857): to_mercator,
+    (3857, 4326): from_mercator,
+}
+
+
+def register(src: int, dst: int, fn: Callable) -> None:
+    """Plug in a transform ``fn(x, y, xp) -> (x', y')``."""
+    _TRANSFORMS[(src, dst)] = fn
+
+
+def transformer(src: int, dst: int) -> Callable:
+    """The (x, y, xp) -> (x', y') transform, or raise for unknown pairs."""
+    if src == dst:
+        return lambda x, y, xp=np: (x, y)
+    fn = _TRANSFORMS.get((src, dst))
+    if fn is None:
+        known = sorted({c for pair in _TRANSFORMS for c in pair})
+        raise ValueError(
+            f"no transform EPSG:{src} -> EPSG:{dst} (built-in codes: "
+            f"{known}; register one via utils.reproject.register)"
+        )
+    return fn
+
+
+def reproject_wkt(wkt: str, fn: Callable) -> str:
+    """Transform every vertex of a WKT geometry (slow path for extent
+    geometry columns; point columns transform vectorized)."""
+    from geomesa_tpu.utils.geometry import parse_wkt
+
+    g = parse_wkt(wkt)
+    return _rebuild(g, fn).wkt()
+
+
+def _rebuild(g, fn):
+    from geomesa_tpu.utils import geometry as geo
+
+    def pt(x, y):
+        nx, ny = fn(np.asarray([x]), np.asarray([y]))
+        return float(nx[0]), float(ny[0])
+
+    def ring(r):
+        a = np.asarray(r, np.float64)
+        xs, ys = fn(a[:, 0], a[:, 1])
+        return tuple((float(x), float(y)) for x, y in zip(xs, ys))
+
+    if isinstance(g, geo.Point):
+        return geo.Point(*pt(g.x, g.y))
+    if isinstance(g, geo.MultiPoint):
+        return geo.MultiPoint(
+            tuple(geo.Point(*pt(p.x, p.y)) for p in g.points)
+        )
+    if isinstance(g, geo.LineString):
+        return geo.LineString(ring(g.coords))
+    if isinstance(g, geo.MultiLineString):
+        return geo.MultiLineString(
+            tuple(geo.LineString(ring(ls.coords)) for ls in g.lines)
+        )
+    if isinstance(g, geo.Polygon):
+        return geo.Polygon(
+            ring(g.shell), tuple(ring(h) for h in g.holes)
+        )
+    if isinstance(g, geo.MultiPolygon):
+        return geo.MultiPolygon(tuple(
+            geo.Polygon(ring(p.shell), tuple(ring(h) for h in p.holes))
+            for p in g.polygons
+        ))
+    raise ValueError(f"cannot reproject geometry type {type(g).__name__}")

@@ -1,0 +1,68 @@
+"""Result reprojection (Query.srid — the reproject step of
+QueryPlanner.runQuery's post-processing chain, QueryPlanner.scala:68-90)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, Query
+from geomesa_tpu.utils import reproject as rp
+
+
+def test_mercator_round_trip():
+    rng = np.random.default_rng(2)
+    x = rng.uniform(-179, 179, 1000)
+    y = rng.uniform(-84, 84, 1000)
+    mx, my = rp.to_mercator(x, y)
+    x2, y2 = rp.from_mercator(mx, my)
+    assert np.allclose(x, x2, atol=1e-9)
+    assert np.allclose(y, y2, atol=1e-9)
+    # known anchor: (0, 0) -> (0, 0); 180 deg -> earth half-circumference
+    assert rp.to_mercator(np.array([0.0]), np.array([0.0]))[0][0] == 0
+    mx180 = rp.to_mercator(np.array([180.0]), np.array([0.0]))[0][0]
+    assert mx180 == pytest.approx(np.pi * rp.R)
+
+
+def test_unknown_crs_raises():
+    with pytest.raises(ValueError, match="32633"):
+        rp.transformer(4326, 32633)
+    # identity pair always works
+    fn = rp.transformer(4326, 4326)
+    assert fn(1.0, 2.0)[0] == 1.0
+
+
+def test_query_srid_points():
+    rng = np.random.default_rng(3)
+    n = 5_000
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "v:Float,*geom:Point")
+    x = rng.uniform(-120, -70, n)
+    y = rng.uniform(25, 50, n)
+    ds.insert("t", {"geom__x": x, "geom__y": y,
+                    "v": rng.uniform(0, 1, n).astype(np.float32)},
+              fids=np.arange(n).astype(str))
+    ds.flush("t")
+    fc = ds.query("t", Query("BBOX(geom, -100, 30, -80, 45)", srid=3857))
+    assert fc.srid == 3857
+    m = (x >= -100) & (x <= -80) & (y >= 30) & (y <= 45)
+    assert len(fc) == int(m.sum())
+    # every point transformed; mercator CONUS x is around -1e7 meters
+    gx = fc.batch.columns["geom__x"]
+    assert (gx < -8e6).all() and (gx > -1.2e7).all()
+    # round-trip matches the stored f32 coordinates
+    bx, by = rp.from_mercator(gx, fc.batch.columns["geom__y"])
+    assert np.allclose(np.sort(bx), np.sort(x[m].astype(np.float32)),
+                       atol=1e-6)
+
+
+def test_query_srid_wkt_geometries():
+    ds = GeoDataset(n_shards=1)
+    ds.create_schema("p", "*geom:Polygon")
+    wkt = "POLYGON ((0 0, 10 0, 10 10, 0 10, 0 0))"
+    ds.insert("p", {"geom": [wkt]}, fids=["a"])
+    ds.flush("p")
+    fc = ds.query("p", Query("INCLUDE", srid=3857))
+    out = str(fc.batch.columns["geom__wkt"][0])
+    assert out.startswith("POLYGON")
+    # the (10, 10) vertex in mercator
+    mx, my = rp.to_mercator(np.array([10.0]), np.array([10.0]))
+    assert f"{mx[0]:.0f}" in out.replace(".0 ", " ") or "1113194" in out
