@@ -51,7 +51,10 @@ class Query:
     auths: Optional[List[str]] = None
     #: EPSG code to reproject result geometries into (storage is 4326;
     #: the reference reprojects as the final post-processing step,
-    #: QueryPlanner.scala:68-90). Built-in: 3857; others pluggable via
+    #: QueryPlanner.scala:68-90). Built-in closed forms: 3857 (latitudes
+    #: beyond +/-85.051 clamp to the projection edge with a
+    #: RuntimeWarning), 3395, UTM 326xx/327xx, 5070, 3035; any EPSG via
+    #: pyproj when installed; others pluggable via
     #: utils.reproject.register.
     srid: Optional[int] = None
 
@@ -582,7 +585,8 @@ class GeoDataset:
         """Transform every geometry column to ``srid`` (last step of the
         post-processing chain, matching QueryPlanner.scala:68-90; raises
         for unregistered CRS pairs). Point x/y columns transform in one
-        vectorized pass; WKT extent columns per geometry."""
+        vectorized pass; WKT extent columns batch every vertex of every
+        geometry into one transform call (nulls pass through)."""
         from geomesa_tpu.utils import reproject as rp
 
         fn = rp.transformer(4326, srid)
@@ -599,10 +603,7 @@ class GeoDataset:
                 cols[xc], cols[yc] = x, y
             wc = a.name + "__wkt"
             if wc in cols:
-                cols[wc] = np.array(
-                    [rp.reproject_wkt(str(w), fn) for w in cols[wc]],
-                    dtype=object,
-                )
+                cols[wc] = rp.reproject_wkt_array(cols[wc], fn)
         return ColumnBatch(cols, batch.n)
 
     def query_batches(self, name: str, query: "str | Query" = "INCLUDE",
@@ -611,8 +612,9 @@ class GeoDataset:
         batch contract): a partitioned store yields partition-at-a-time so
         peak memory is one partition's matches, never the whole result.
         Sorted queries fall back to one materialized batch (a global sort
-        needs all rows). Projection applies per chunk; audit fires once at
-        stream end."""
+        needs all rows). Projection and CRS reprojection (Query.srid)
+        apply per chunk — the stream carries the same CRS query() returns
+        — and audit fires once at stream end."""
         q = Query(ecql=query) if isinstance(query, str) else query
         if q.sort_by:  # a global sort needs all rows: one materialized batch
             fc = self.query(name, q)
@@ -623,8 +625,13 @@ class GeoDataset:
 
             return _one()
         # plan EAGERLY so unknown attributes / parse errors / guard vetoes
-        # raise here, not mid-stream inside the consumer's iteration
+        # (and unregistered CRS pairs) raise here, not mid-stream inside
+        # the consumer's iteration
         st, q, plan = self._plan(name, q)
+        if q.srid is not None and q.srid != 4326:
+            from geomesa_tpu.utils import reproject as rp
+
+            rp.transformer(4326, q.srid)  # raise now if unknown
         keep_pref = None
         if q.properties:
             keep = set(q.properties) | {"__fid__"}
@@ -646,6 +653,8 @@ class GeoDataset:
                             },
                             batch.n,
                         )
+                    if q.srid is not None and q.srid != 4326 and batch.n:
+                        batch = self._reproject_batch(st.ft, batch, q.srid)
                     yield batch
             self._audit(name, q, plan, t0, hits)
 

@@ -352,11 +352,18 @@ class FileSystemStorage:
 
     @staticmethod
     def _read_file(path: str, columns=None) -> pa.Table:
+        # both formats raise on a requested-but-missing column, so
+        # schema-evolution behavior cannot silently diverge by format
         if path.endswith(".arrow"):
             t = arrow_io.read_ipc(path)
             if columns is not None:
-                keep = [c for c in columns if c in t.column_names]
-                t = t.select(keep)
+                missing = [c for c in columns if c not in t.column_names]
+                if missing:
+                    raise KeyError(
+                        f"columns {missing} not present in {path} "
+                        f"(has: {t.column_names})"
+                    )
+                t = t.select(list(columns))
             return t
         return pq.read_table(path, columns=columns)
 

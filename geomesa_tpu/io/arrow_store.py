@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import os
 import threading
+import warnings
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -151,6 +152,7 @@ def _infer_feature_type(name: str, table) -> FeatureType:
     from geomesa_tpu.io.arrow_io import FID
 
     parts: List[str] = []
+    skipped: List[str] = []
     geom_done = False
     for field in table.schema:
         t = field.type
@@ -177,7 +179,15 @@ def _infer_feature_type(name: str, table) -> FeatureType:
             )
         elif pa.types.is_boolean(t):
             parts.append(f"{field.name}:Boolean")
-        # unknown types are skipped
+        else:
+            skipped.append(f"{field.name}:{t}")
+    if skipped:
+        warnings.warn(
+            f"inferring a feature type for {name!r} (no geomesa:spec "
+            f"metadata): skipped columns with unsupported Arrow types "
+            f"{skipped}; their values will be absent from query results",
+            stacklevel=2,
+        )
     if not parts:
         raise ValueError(
             f"cannot infer a feature type from {name!r}: no recognized "

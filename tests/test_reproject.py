@@ -22,12 +22,199 @@ def test_mercator_round_trip():
     assert mx180 == pytest.approx(np.pi * rp.R)
 
 
-def test_unknown_crs_raises():
-    with pytest.raises(ValueError, match="32633"):
-        rp.transformer(4326, 32633)
+def test_unknown_crs_raises(monkeypatch):
+    # 27700 (OSGB, Airy ellipsoid) has no built-in closed form; disable
+    # the pyproj escape hatch so the test holds even where it's installed
+    monkeypatch.setattr(rp, "_pyproj_transform", lambda s, d: None)
+    with pytest.raises(ValueError, match="27700"):
+        rp.transformer(4326, 27700)
     # identity pair always works
     fn = rp.transformer(4326, 4326)
     assert fn(1.0, 2.0)[0] == 1.0
+
+
+def test_utm_anchors_and_round_trip():
+    """EPSG:32631 (UTM 31N): exact anchors from the projection definition
+    plus an external meridian-arc cross-check."""
+    fwd = rp.transformer(4326, 32631)
+    inv = rp.transformer(32631, 4326)
+    # central meridian (3E) at the equator IS (500000, 0) by definition
+    e, n = fwd(np.array([3.0]), np.array([0.0]))
+    assert e[0] == pytest.approx(500000.0, abs=1e-6)
+    assert n[0] == pytest.approx(0.0, abs=1e-6)
+    # UTM south false northing: 10,000,000 at the equator
+    es, ns = rp.transformer(4326, 32731)(np.array([3.0]), np.array([0.0]))
+    assert ns[0] == pytest.approx(10_000_000.0, abs=1e-6)
+    # external check: one degree of meridian arc at 40.5N is 111044.3 m
+    # (WGS84 meridian-degree series), scaled by k0=0.9996 on the CM
+    _, n40 = fwd(np.array([3.0]), np.array([40.0]))
+    _, n41 = fwd(np.array([3.0]), np.array([41.0]))
+    assert (n41[0] - n40[0]) == pytest.approx(0.9996 * 111044.3, abs=30)
+    # round trip over the whole zone band
+    rng = np.random.default_rng(4)
+    lon = rng.uniform(0, 6, 2000)
+    lat = rng.uniform(-80, 84, 2000)
+    x, y = fwd(lon, lat)
+    lon2, lat2 = inv(x, y)
+    assert np.allclose(lon, lon2, atol=1e-9)
+    assert np.allclose(lat, lat2, atol=1e-9)
+
+
+def test_laea_3035_anchor_and_round_trip():
+    """EPSG:3035: the projection center (10E, 52N) maps to the false
+    origin (4321000, 3210000) exactly."""
+    fwd = rp.transformer(4326, 3035)
+    inv = rp.transformer(3035, 4326)
+    x, y = fwd(np.array([10.0]), np.array([52.0]))
+    assert x[0] == pytest.approx(4321000.0, abs=1e-6)
+    assert y[0] == pytest.approx(3210000.0, abs=1e-6)
+    rng = np.random.default_rng(5)
+    lon = rng.uniform(-10, 35, 2000)
+    lat = rng.uniform(35, 70, 2000)
+    lon2, lat2 = inv(*fwd(lon, lat))
+    assert np.allclose(lon, lon2, atol=1e-9)
+    assert np.allclose(lat, lat2, atol=1e-9)
+
+
+def test_albers_5070_anchor_and_round_trip():
+    """EPSG:5070 (CONUS Albers): the projection origin (-96, 23) maps to
+    (0, 0) exactly; the projection is equal-area (checked numerically on
+    a small quad against the authalic sphere)."""
+    fwd = rp.transformer(4326, 5070)
+    inv = rp.transformer(5070, 4326)
+    x, y = fwd(np.array([-96.0]), np.array([23.0]))
+    assert x[0] == pytest.approx(0.0, abs=1e-6)
+    assert y[0] == pytest.approx(0.0, abs=1e-6)
+    rng = np.random.default_rng(6)
+    lon = rng.uniform(-125, -66, 2000)
+    lat = rng.uniform(24, 49, 2000)
+    lon2, lat2 = inv(*fwd(lon, lat))
+    assert np.allclose(lon, lon2, atol=1e-9)
+    assert np.allclose(lat, lat2, atol=1e-9)
+    # equal-area property: a 0.1-degree quad at 40N projects to an area
+    # equal to its ellipsoidal area (within series truncation)
+    d = 0.1
+    qlon = np.array([-100.0, -100.0 + d, -100.0 + d, -100.0])
+    qlat = np.array([40.0, 40.0, 40.0 + d, 40.0 + d])
+    qx, qy = fwd(qlon, qlat)
+    area = 0.5 * abs(
+        np.dot(qx, np.roll(qy, -1)) - np.dot(qy, np.roll(qx, -1))
+    )
+    # ellipsoidal quad area ~ (pi/180 * d)^2 * cos(40) * M(40) * N(40)
+    # with M,N the meridional/normal radii: 6361816 m and 6387345 m
+    expect = (np.pi / 180 * d) ** 2 * np.cos(np.radians(40.05)) \
+        * 6361816.0 * 6387345.0
+    assert area == pytest.approx(expect, rel=1e-3)
+
+
+def test_world_mercator_3395_vs_3857():
+    """EPSG:3395 (ellipsoidal) shares x with 3857 but its y at 45N is
+    ~0.5% smaller (the classic spherical-vs-ellipsoidal web map offset)."""
+    fwd = rp.transformer(4326, 3395)
+    x95, y95 = fwd(np.array([12.0]), np.array([45.0]))
+    x57, y57 = rp.to_mercator(np.array([12.0]), np.array([45.0]))
+    assert x95[0] == pytest.approx(x57[0], abs=1e-6)
+    ratio = y95[0] / y57[0]
+    assert 0.99 < ratio < 0.998
+    inv = rp.transformer(3395, 4326)
+    lon2, lat2 = inv(x95, y95)
+    assert lon2[0] == pytest.approx(12.0, abs=1e-9)
+    assert lat2[0] == pytest.approx(45.0, abs=1e-9)
+
+
+def test_composed_projected_to_projected():
+    """src->dst with neither side 4326 composes through geographic."""
+    fn = rp.transformer(3857, 32631)
+    mx, my = rp.to_mercator(np.array([3.0]), np.array([0.0]))
+    e, n = fn(mx, my)
+    assert e[0] == pytest.approx(500000.0, abs=1e-6)
+    assert n[0] == pytest.approx(0.0, abs=1e-6)
+
+
+def test_mercator_clamp_warns():
+    with pytest.warns(RuntimeWarning, match="clamped"):
+        rp.to_mercator(np.array([0.0]), np.array([89.0]))
+
+
+def test_reproject_wkt_array_nulls_and_batching():
+    fn = rp.transformer(4326, 3857)
+    wkts = np.array(
+        ["POINT (10 10)", None, "", "LINESTRING (0 0, 10 10)"],
+        dtype=object,
+    )
+    out = rp.reproject_wkt_array(wkts, fn)
+    assert out[1] is None and out[2] == ""
+    mx, my = rp.to_mercator(np.array([10.0]), np.array([10.0]))
+    assert f"{mx[0]:.6f}".rstrip("0") in out[0] or "POINT" in out[0]
+    px, py = out[0].replace("POINT (", "").rstrip(")").split()
+    assert float(px) == pytest.approx(mx[0])
+    assert float(py) == pytest.approx(my[0])
+    assert out[3].startswith("LINESTRING")
+
+
+def test_query_batches_applies_srid():
+    """ADVICE r4 (medium): the streaming path must carry the same CRS as
+    query() — previously it silently streamed raw 4326."""
+    rng = np.random.default_rng(7)
+    n = 3000
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("s", "v:Float,*geom:Point")
+    x, y = rng.uniform(-120, -70, n), rng.uniform(25, 50, n)
+    ds.insert("s", {"geom__x": x, "geom__y": y,
+                    "v": rng.uniform(0, 1, n).astype(np.float32)},
+              fids=np.arange(n).astype(str))
+    ds.flush("s")
+    q = Query("BBOX(geom, -100, 30, -80, 45)", srid=3857)
+    got = np.concatenate([
+        b.columns["geom__x"] for b in ds.query_batches("s", q)
+    ])
+    ref = ds.query("s", q).batch.columns["geom__x"]
+    assert np.allclose(np.sort(got), np.sort(ref))
+    assert (got < -8e6).all()  # mercator meters, not degrees
+
+
+def test_query_batches_unknown_srid_raises_eagerly(monkeypatch):
+    monkeypatch.setattr(rp, "_pyproj_transform", lambda s, d: None)
+    ds = GeoDataset(n_shards=1)
+    ds.create_schema("e", "*geom:Point")
+    ds.insert("e", {"geom__x": np.array([0.0]), "geom__y": np.array([0.0])},
+              fids=["a"])
+    ds.flush("e")
+    # raises at call time, not mid-stream
+    with pytest.raises(ValueError, match="27700"):
+        ds.query_batches("e", Query("INCLUDE", srid=27700))
+
+
+def test_transforms_are_jittable():
+    """The (x, y, xp) contract: every built-in projection traces under
+    jax.jit when handed xp=jnp (the module header's jit-ability claim)."""
+    import jax
+    import jax.numpy as jnp
+
+    lon = np.array([3.0, 5.0])
+    lat = np.array([40.0, 45.0])
+    for code in (3857, 3395, 32631, 5070, 3035):
+        fwd = rp.transformer(4326, code)
+        inv = rp.transformer(code, 4326)
+
+        def rt(lo, la, _f=fwd, _i=inv):
+            return _i(*_f(lo, la, xp=jnp), xp=jnp)
+
+        lo2, la2 = jax.jit(rt)(lon, lat)
+        # f32 under jit without x64: ~1e-4 degrees is the dtype floor
+        assert np.allclose(np.asarray(lo2), lon, atol=1e-3)
+        assert np.allclose(np.asarray(la2), lat, atol=1e-3)
+
+
+def test_query_srid_utm():
+    """Query.srid works for any built-in code, not just 3857."""
+    ds = GeoDataset(n_shards=1)
+    ds.create_schema("u", "*geom:Point")
+    ds.insert("u", {"geom__x": np.array([3.0]), "geom__y": np.array([0.0])},
+              fids=["a"])
+    ds.flush("u")
+    fc = ds.query("u", Query("INCLUDE", srid=32631))
+    assert fc.batch.columns["geom__x"][0] == pytest.approx(500000.0, abs=0.1)
 
 
 def test_query_srid_points():
