@@ -444,9 +444,17 @@ class FileSystemStorage:
                 ft = FeatureType.from_spec(name, meta["spec"])
                 schema = arrow_io.arrow_schema(ft)
             if columns is not None:
-                schema = pa.schema(
-                    [schema.field(c) for c in columns if schema.get_field_index(c) >= 0]
-                )
+                missing = [c for c in columns
+                           if schema.get_field_index(c) < 0]
+                if missing:
+                    # same strict contract as _read_file: a requested
+                    # column the table cannot supply is an error, even
+                    # when pruning selected zero files
+                    raise KeyError(
+                        f"columns {missing} not present in {name} "
+                        f"(has: {schema.names})"
+                    )
+                schema = pa.schema([schema.field(c) for c in columns])
             return schema.empty_table()
         schema = pa.unify_schemas([t.schema for t in tables], promote_options="permissive")
         return pa.concat_tables([t.cast(schema) for t in tables]).unify_dictionaries()

@@ -89,3 +89,108 @@ def to_geojson(ft: FeatureType, batch: ColumnBatch,
 def dumps(ft: FeatureType, batch: ColumnBatch,
           dicts: Dict[str, DictionaryEncoder]) -> str:
     return json.dumps(to_geojson(ft, batch, dicts))
+
+
+def _json_to_shape(g: Dict) -> geo.Geometry:
+    t = g["type"]
+    c = g["coordinates"]
+    if t == "Point":
+        return geo.Point(float(c[0]), float(c[1]))
+    if t == "LineString":
+        return geo.LineString(tuple((float(x), float(y)) for x, y in c))
+    if t == "Polygon":
+        rings = [tuple((float(x), float(y)) for x, y in r) for r in c]
+        return geo.Polygon(rings[0], tuple(rings[1:]))
+    if t == "MultiPoint":
+        return geo.MultiPoint(tuple(
+            geo.Point(float(x), float(y)) for x, y in c))
+    if t == "MultiLineString":
+        return geo.MultiLineString(tuple(
+            geo.LineString(tuple((float(x), float(y)) for x, y in ls))
+            for ls in c))
+    if t == "MultiPolygon":
+        polys = []
+        for pc in c:
+            rings = [tuple((float(x), float(y)) for x, y in r) for r in pc]
+            polys.append(geo.Polygon(rings[0], tuple(rings[1:])))
+        return geo.MultiPolygon(tuple(polys))
+    raise ValueError(f"cannot decode GeoJSON geometry type {t!r}")
+
+
+def from_geojson(ft: FeatureType, doc: "str | Dict"):
+    """GeoJSON FeatureCollection (or single Feature) -> (columns, fids)
+    shaped for ``GeoDataset.insert`` under ``ft``'s schema — the parse
+    direction of :func:`to_geojson`, used by the REST ingest endpoint and
+    the JVM DataStore's writer path.
+
+    Missing properties fill with the columnar null representation
+    (string -> None is not representable, so "" ; numeric -> NaN/0;
+    date -> epoch 0), matching ``update_schema``'s null fill."""
+    if isinstance(doc, str):
+        doc = json.loads(doc)
+    try:
+        return _from_geojson(ft, doc)
+    except (KeyError, IndexError, TypeError) as e:
+        # structural problems in the client's body are input errors
+        # (-> HTTP 400 at the REST layer), never KeyError (-> 404)
+        raise ValueError(f"malformed GeoJSON: {type(e).__name__}: {e}")
+
+
+def _from_geojson(ft: FeatureType, doc: Dict):
+    feats = (doc["features"] if doc.get("type") == "FeatureCollection"
+             else [doc])
+    n = len(feats)
+    data: Dict[str, np.ndarray] = {}
+    fids: List[str] = []
+    for i, f in enumerate(feats):
+        fid = f.get("id")
+        if fid is None:
+            fid = (f.get("properties") or {}).get("id", f"gj-{i}")
+        fids.append(str(fid))
+    for a in ft.attributes:
+        if a.is_geom:
+            geoms = [f.get("geometry") for f in feats]
+            if any(g is None for g in geoms):
+                raise ValueError(
+                    f"feature missing geometry for attribute {a.name!r}"
+                )
+            if a.type == "point":
+                bad = {g["type"] for g in geoms if g.get("type") != "Point"}
+                if bad:
+                    raise ValueError(
+                        f"attribute {a.name!r} is Point-typed but the "
+                        f"body carries {sorted(bad)} geometries"
+                    )
+                data[a.name + "__x"] = np.array(
+                    [float(g["coordinates"][0]) for g in geoms], np.float64)
+                data[a.name + "__y"] = np.array(
+                    [float(g["coordinates"][1]) for g in geoms], np.float64)
+            else:
+                data[a.name] = np.array(
+                    [_json_to_shape(g).wkt() for g in geoms], dtype=object)
+            continue
+        vals = [(f.get("properties") or {}).get(a.name) for f in feats]
+        if a.type == "string" or a.type == "json":
+            data[a.name] = np.array(
+                [("" if v is None else
+                  (v if isinstance(v, str) else json.dumps(v)))
+                 for v in vals], dtype=object)
+        elif a.type == "date":
+            data[a.name] = np.array(
+                ["1970-01-01T00:00:00" if v is None
+                 else str(v).rstrip("Z") for v in vals],
+                dtype="datetime64[ms]")
+        elif a.type in ("float32", "float64"):
+            data[a.name] = np.array(
+                [np.nan if v is None else float(v) for v in vals],
+                np.float32 if a.type == "float32" else np.float64)
+        elif a.type in ("int32", "int64"):
+            data[a.name] = np.array(
+                [0 if v is None else int(v) for v in vals],
+                np.int32 if a.type == "int32" else np.int64)
+        elif a.type == "bool":
+            data[a.name] = np.array(
+                [bool(v) for v in vals], np.bool_)
+        else:  # pragma: no cover - the registry above is exhaustive
+            raise ValueError(f"unsupported attribute type {a.type!r}")
+    return data, np.array(fids, dtype=object) if n else np.array([], object)

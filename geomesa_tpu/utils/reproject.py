@@ -377,16 +377,15 @@ def supported_codes() -> Tuple[int, ...]:
 def transformer(src: int, dst: int) -> Callable:
     """The (x, y, xp) -> (x', y') transform, or raise for unknown pairs.
 
-    Resolution order: registered pairs, pyproj (if installed), built-in
-    closed forms (composed through 4326 when neither side is 4326)."""
+    Resolution order: registered pairs, built-in closed forms (composed
+    through 4326 when neither side is 4326), then pyproj (if installed)
+    for codes with no closed form. Built-ins win over pyproj so the
+    vectorized, jit-able (x, y, xp) contract holds regardless of what is
+    installed."""
     if src == dst:
         return lambda x, y, xp=np: (x, y)
     fn = _TRANSFORMS.get((src, dst))
     if fn is not None:
-        return fn
-    fn = _pyproj_transform(src, dst)
-    if fn is not None:
-        _TRANSFORMS[(src, dst)] = fn
         return fn
     to_geo = None if src == 4326 else _builtin_projection(src)
     from_geo = None if dst == 4326 else _builtin_projection(dst)
@@ -401,6 +400,10 @@ def transformer(src: int, dst: int) -> Callable:
 
         _TRANSFORMS[(src, dst)] = composed
         return composed
+    fn = _pyproj_transform(src, dst)
+    if fn is not None:
+        _TRANSFORMS[(src, dst)] = fn
+        return fn
     known = sorted({c for pair in _TRANSFORMS for c in pair}
                    | set(_BUILTIN_CODES))
     raise ValueError(

@@ -17,6 +17,14 @@ serves the same surface as JSON:
         via the curve-aligned density — no scatter)
     GET /api/schemas/<name>/features?cql=&max=       -> GeoJSON
 
+Write surface (the JVM DataStore's zero-dependency transport; the
+reference's DataStore mutates through the same catalog the servlets read):
+
+    POST   /api/schemas                  {"name","spec"} -> create schema
+    DELETE /api/schemas/<name>                           -> delete schema
+    POST   /api/schemas/<name>/features  GeoJSON FC      -> ingest+flush
+    DELETE /api/schemas/<name>/features?cql=...          -> delete by filter
+
 Queries pass auths via the ``X-Geomesa-Auths`` header (visibility parity).
 """
 
@@ -176,6 +184,75 @@ class _Handler(BaseHTTPRequestHandler):
                     return self._send(
                         text.encode(), content_type="application/geo+json"
                     )
+            return self._error(404, f"unknown path {parsed.path!r}")
+        except KeyError as e:
+            return self._error(404, str(e))
+        except ValueError as e:
+            return self._error(400, str(e))
+        except Exception as e:  # pragma: no cover - defensive
+            return self._error(500, f"{type(e).__name__}: {e}")
+
+    def _read_body(self):
+        length = int(self.headers.get("Content-Length", "0"))
+        return self.rfile.read(length).decode() if length else ""
+
+    def do_POST(self):  # noqa: N802
+        ds = self.dataset
+        parsed = urllib.parse.urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        try:
+            if parts == ["api", "schemas"]:
+                body = json.loads(self._read_body() or "{}")
+                name, spec = body.get("name"), body.get("spec")
+                if not name or not spec:
+                    return self._error(400, 'body must be {"name", "spec"}')
+                if name in ds.list_schemas():
+                    return self._error(409, f"schema {name!r} exists")
+                ft = ds.create_schema(name, spec)
+                return self._send({"name": name, "spec": ft.spec()}, 201)
+            if len(parts) == 4 and parts[:2] == ["api", "schemas"] \
+                    and parts[3] == "features":
+                name = urllib.parse.unquote(parts[2])
+                from geomesa_tpu.io import geojson
+
+                ft = ds.get_schema(name)
+                data, fids = geojson.from_geojson(ft, self._read_body())
+                n = ds.insert(name, data, fids=fids)
+                ds.flush(name)
+                return self._send(
+                    {"inserted": int(n), "fids": list(map(str, fids))}, 201
+                )
+            return self._error(404, f"unknown path {parsed.path!r}")
+        except KeyError as e:
+            return self._error(404, str(e))
+        except ValueError as e:
+            return self._error(400, str(e))
+        except Exception as e:  # pragma: no cover - defensive
+            return self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_DELETE(self):  # noqa: N802
+        ds = self.dataset
+        parsed = urllib.parse.urlparse(self.path)
+        q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+        parts = [p for p in parsed.path.split("/") if p]
+        auths_hdr = self.headers.get("X-Geomesa-Auths")
+        auths = auths_hdr.split(",") if auths_hdr is not None else None
+        try:
+            if len(parts) == 3 and parts[:2] == ["api", "schemas"]:
+                name = urllib.parse.unquote(parts[2])
+                if name not in ds.list_schemas():
+                    return self._error(404, f"no schema {name!r}")
+                ds.delete_schema(name)
+                return self._send({"deleted": name})
+            if len(parts) == 4 and parts[:2] == ["api", "schemas"] \
+                    and parts[3] == "features":
+                name = urllib.parse.unquote(parts[2])
+                cql = q.get("cql")
+                if not cql:
+                    return self._error(400, "missing ?cql= (use the schema "
+                                            "DELETE to drop everything)")
+                n = ds.delete_features(name, cql, auths=auths)
+                return self._send({"deleted": int(n)})
             return self._error(404, f"unknown path {parsed.path!r}")
         except KeyError as e:
             return self._error(404, str(e))

@@ -1,0 +1,20 @@
+#!/bin/sh
+# Compile the GeoTools DataStore module + smoke runner against the
+# vendored interface mock (no third-party jars needed; JDK 11+).
+#
+# To compile against real GeoTools instead, drop the geotools-mock
+# sourcepath and put gt-api/gt-cql/gt-referencing jars on -cp.
+#
+#   ./build.sh            # compile into out/
+#   geomesa-tpu web --port 8080 &
+#   java -cp out Smoke http://127.0.0.1:8080
+set -e
+cd "$(dirname "$0")"
+rm -rf out
+mkdir -p out
+javac -d out \
+    $(find geotools-mock -name '*.java') \
+    $(find src/main/java -name '*.java') \
+    smoke/Smoke.java
+cp -r src/main/resources/META-INF out/
+echo "compiled to out/; run: java -cp out Smoke <rest-url>"

@@ -1,0 +1,17 @@
+package org.geotools.api.data;
+
+import java.io.IOException;
+import java.util.List;
+import org.geotools.api.feature.type.Name;
+
+/** Mock subset of {@code org.geotools.api.data.DataAccess}. */
+public interface DataAccess<T, F> {
+    ServiceInfo getInfo();
+    void createSchema(T featureType) throws IOException;
+    void updateSchema(Name typeName, T featureType) throws IOException;
+    void removeSchema(Name typeName) throws IOException;
+    List<Name> getNames() throws IOException;
+    T getSchema(Name name) throws IOException;
+    FeatureSource<T, F> getFeatureSource(Name typeName) throws IOException;
+    void dispose();
+}

@@ -1,0 +1,44 @@
+package org.geotools.api.data;
+
+import java.io.IOException;
+import java.util.Map;
+
+/** Mock subset of {@code org.geotools.api.data.DataAccessFactory}. */
+public interface DataAccessFactory {
+    String getDisplayName();
+    String getDescription();
+    Param[] getParametersInfo();
+    boolean canProcess(Map<String, ?> params);
+    boolean isAvailable();
+
+    /** Connection parameter descriptor (subset of the real Param). */
+    class Param {
+        public final String key;
+        public final Class<?> type;
+        public final String description;
+        public final boolean required;
+        public final Object sample;
+
+        public Param(String key, Class<?> type, String description,
+                     boolean required) {
+            this(key, type, description, required, null);
+        }
+
+        public Param(String key, Class<?> type, String description,
+                     boolean required, Object sample) {
+            this.key = key;
+            this.type = type;
+            this.description = description;
+            this.required = required;
+            this.sample = sample;
+        }
+
+        public Object lookUp(Map<String, ?> params) throws IOException {
+            Object v = params.get(key);
+            if (v == null && required) {
+                throw new IOException("missing required parameter " + key);
+            }
+            return v;
+        }
+    }
+}

@@ -1,0 +1,28 @@
+package org.geotools.api.data;
+
+import java.io.IOException;
+import org.geotools.api.feature.simple.SimpleFeature;
+import org.geotools.api.feature.simple.SimpleFeatureType;
+import org.geotools.api.filter.Filter;
+
+/** Mock subset of {@code org.geotools.api.data.DataStore} — the method
+ * set the reference's GeoMesaDataStore implements
+ * (geomesa-index-api/.../geotools/GeoMesaDataStore.scala:49). */
+public interface DataStore extends DataAccess<SimpleFeatureType, SimpleFeature> {
+    void updateSchema(String typeName, SimpleFeatureType featureType)
+            throws IOException;
+    void removeSchema(String typeName) throws IOException;
+    String[] getTypeNames() throws IOException;
+    SimpleFeatureType getSchema(String typeName) throws IOException;
+    SimpleFeatureSource getFeatureSource(String typeName) throws IOException;
+    FeatureReader<SimpleFeatureType, SimpleFeature> getFeatureReader(
+            Query query, Transaction transaction) throws IOException;
+    FeatureWriter<SimpleFeatureType, SimpleFeature> getFeatureWriter(
+            String typeName, Filter filter, Transaction transaction)
+            throws IOException;
+    FeatureWriter<SimpleFeatureType, SimpleFeature> getFeatureWriter(
+            String typeName, Transaction transaction) throws IOException;
+    FeatureWriter<SimpleFeatureType, SimpleFeature> getFeatureWriterAppend(
+            String typeName, Transaction transaction) throws IOException;
+    LockingManager getLockingManager();
+}

@@ -1,0 +1,14 @@
+package org.geotools.api.data;
+
+import java.io.IOException;
+import java.util.Map;
+
+/** Mock subset of {@code org.geotools.api.data.DataStoreFactorySpi} —
+ * the SPI the reference registers via META-INF/services
+ * (geomesa-accumulo-datastore/src/main/resources/META-INF/services/
+ * org.geotools.data.DataStoreFactorySpi; the package moved to
+ * org.geotools.api.data in GeoTools 30). */
+public interface DataStoreFactorySpi extends DataAccessFactory {
+    DataStore createDataStore(Map<String, ?> params) throws IOException;
+    DataStore createNewDataStore(Map<String, ?> params) throws IOException;
+}

@@ -1,0 +1,25 @@
+package org.geotools.api.data;
+
+import java.io.IOException;
+import java.util.Iterator;
+import java.util.Map;
+import java.util.ServiceLoader;
+
+/** Mock of {@code org.geotools.api.data.DataStoreFinder}: resolves
+ * factories from META-INF/services exactly as the real finder does. */
+public final class DataStoreFinder {
+    private DataStoreFinder() {}
+
+    public static DataStore getDataStore(Map<String, ?> params)
+            throws IOException {
+        Iterator<DataStoreFactorySpi> it =
+                ServiceLoader.load(DataStoreFactorySpi.class).iterator();
+        while (it.hasNext()) {
+            DataStoreFactorySpi f = it.next();
+            if (f.isAvailable() && f.canProcess(params)) {
+                return f.createDataStore(params);
+            }
+        }
+        return null;
+    }
+}

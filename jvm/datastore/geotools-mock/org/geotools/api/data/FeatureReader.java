@@ -1,0 +1,13 @@
+package org.geotools.api.data;
+
+import java.io.Closeable;
+import java.io.IOException;
+import java.util.NoSuchElementException;
+
+/** Mock subset of {@code org.geotools.api.data.FeatureReader}. */
+public interface FeatureReader<T, F> extends Closeable {
+    T getFeatureType();
+    F next() throws IOException, NoSuchElementException;
+    boolean hasNext() throws IOException;
+    @Override void close() throws IOException;
+}

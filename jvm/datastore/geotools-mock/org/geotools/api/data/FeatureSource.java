@@ -1,0 +1,13 @@
+package org.geotools.api.data;
+
+import java.io.IOException;
+import org.geotools.geometry.jts.ReferencedEnvelope;
+
+/** Mock subset of {@code org.geotools.api.data.FeatureSource}. */
+public interface FeatureSource<T, F> {
+    T getSchema();
+    DataStore getDataStore();
+    ReferencedEnvelope getBounds() throws IOException;
+    ReferencedEnvelope getBounds(Query query) throws IOException;
+    int getCount(Query query) throws IOException;
+}

@@ -1,0 +1,14 @@
+package org.geotools.api.data;
+
+import java.io.Closeable;
+import java.io.IOException;
+
+/** Mock subset of {@code org.geotools.api.data.FeatureWriter}. */
+public interface FeatureWriter<T, F> extends Closeable {
+    T getFeatureType();
+    F next() throws IOException;
+    void remove() throws IOException;
+    void write() throws IOException;
+    boolean hasNext() throws IOException;
+    @Override void close() throws IOException;
+}

@@ -1,0 +1,5 @@
+package org.geotools.api.data;
+
+/** Mock marker for {@code org.geotools.api.data.LockingManager}. */
+public interface LockingManager {
+}

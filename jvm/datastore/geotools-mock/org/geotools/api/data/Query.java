@@ -1,0 +1,30 @@
+package org.geotools.api.data;
+
+import org.geotools.api.filter.Filter;
+
+/** Mock subset of {@code org.geotools.api.data.Query}: type name,
+ * filter, max features, property projection. */
+public class Query {
+    public static final int DEFAULT_MAX = Integer.MAX_VALUE;
+
+    private String typeName;
+    private Filter filter = Filter.INCLUDE;
+    private int maxFeatures = DEFAULT_MAX;
+    private String[] propertyNames;
+
+    public Query() {}
+    public Query(String typeName) { this.typeName = typeName; }
+    public Query(String typeName, Filter filter) {
+        this.typeName = typeName;
+        this.filter = filter;
+    }
+
+    public String getTypeName() { return typeName; }
+    public void setTypeName(String typeName) { this.typeName = typeName; }
+    public Filter getFilter() { return filter; }
+    public void setFilter(Filter filter) { this.filter = filter; }
+    public int getMaxFeatures() { return maxFeatures; }
+    public void setMaxFeatures(int maxFeatures) { this.maxFeatures = maxFeatures; }
+    public String[] getPropertyNames() { return propertyNames; }
+    public void setPropertyNames(String[] propertyNames) { this.propertyNames = propertyNames; }
+}

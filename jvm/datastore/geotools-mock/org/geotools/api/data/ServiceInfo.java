@@ -1,0 +1,7 @@
+package org.geotools.api.data;
+
+/** Mock subset of {@code org.geotools.api.data.ServiceInfo}. */
+public interface ServiceInfo {
+    String getTitle();
+    String getDescription();
+}

@@ -1,0 +1,12 @@
+package org.geotools.api.data;
+
+import java.io.IOException;
+import org.geotools.api.feature.simple.SimpleFeature;
+import org.geotools.api.feature.simple.SimpleFeatureType;
+
+/** Mock subset of {@code org.geotools.api.data.SimpleFeatureSource}. */
+public interface SimpleFeatureSource
+        extends FeatureSource<SimpleFeatureType, SimpleFeature> {
+    FeatureReader<SimpleFeatureType, SimpleFeature> getFeatures(Query query)
+            throws IOException;
+}

@@ -1,0 +1,8 @@
+package org.geotools.api.data;
+
+/** Mock subset of {@code org.geotools.api.data.Transaction}. */
+public interface Transaction {
+    Transaction AUTO_COMMIT = new Transaction() {
+        @Override public String toString() { return "AUTO_COMMIT"; }
+    };
+}

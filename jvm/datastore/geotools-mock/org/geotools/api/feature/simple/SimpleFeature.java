@@ -1,0 +1,11 @@
+package org.geotools.api.feature.simple;
+
+/** Mock subset of {@code org.geotools.api.feature.simple.SimpleFeature}. */
+public interface SimpleFeature {
+    String getID();
+    SimpleFeatureType getFeatureType();
+    Object getAttribute(String name);
+    Object getAttribute(int index);
+    void setAttribute(String name, Object value);
+    Object getDefaultGeometry();
+}

@@ -1,0 +1,14 @@
+package org.geotools.api.feature.simple;
+
+import java.util.List;
+import org.geotools.api.feature.type.Name;
+
+/** Mock subset of {@code org.geotools.api.feature.simple.SimpleFeatureType}. */
+public interface SimpleFeatureType {
+    String getTypeName();
+    Name getName();
+    int getAttributeCount();
+    List<String> getAttributeNames();
+    Class<?> getType(String name);
+    String getGeometryAttribute();
+}

@@ -1,0 +1,10 @@
+package org.geotools.api.feature.type;
+
+/** Mock of GeoTools' {@code org.geotools.api.feature.type.Name} — the
+ * subset the geomesa-tpu DataStore uses. Replace this source tree with
+ * the real gt-api jar to compile against GeoTools proper. */
+public interface Name {
+    String getLocalPart();
+    String getNamespaceURI();
+    String getURI();
+}

@@ -1,0 +1,8 @@
+package org.geotools.api.filter;
+
+/** Mock subset of {@code org.geotools.api.filter.Filter}. */
+public interface Filter {
+    Filter INCLUDE = new Filter() {
+        @Override public String toString() { return "INCLUDE"; }
+    };
+}

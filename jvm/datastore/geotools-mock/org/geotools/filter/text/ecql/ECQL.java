@@ -1,0 +1,21 @@
+package org.geotools.filter.text.ecql;
+
+import org.geotools.api.filter.Filter;
+
+/** Mock of gt-cql's {@code ECQL}: filters carry their ECQL text
+ * verbatim (the real class parses/serializes the filter model). */
+public final class ECQL {
+    private ECQL() {}
+
+    private static final class TextFilter implements Filter {
+        private final String ecql;
+        TextFilter(String ecql) { this.ecql = ecql; }
+        @Override public String toString() { return ecql; }
+    }
+
+    public static Filter toFilter(String ecql) { return new TextFilter(ecql); }
+
+    public static String toCQL(Filter filter) {
+        return filter == null ? "INCLUDE" : filter.toString();
+    }
+}

@@ -1,0 +1,22 @@
+package org.geotools.geometry.jts;
+
+/** Mock subset of {@code org.geotools.geometry.jts.ReferencedEnvelope}
+ * (CRS is fixed to EPSG:4326 in this transport). */
+public class ReferencedEnvelope {
+    private final double minX, minY, maxX, maxY;
+
+    public ReferencedEnvelope(double minX, double maxX,
+                              double minY, double maxY) {
+        this.minX = minX; this.maxX = maxX;
+        this.minY = minY; this.maxY = maxY;
+    }
+
+    public double getMinX() { return minX; }
+    public double getMaxX() { return maxX; }
+    public double getMinY() { return minY; }
+    public double getMaxY() { return maxY; }
+
+    @Override public String toString() {
+        return "[" + minX + ", " + minY + ", " + maxX + ", " + maxY + "]";
+    }
+}

@@ -1,0 +1,107 @@
+import java.util.HashMap;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import org.geotools.api.data.DataStore;
+import org.geotools.api.data.DataStoreFinder;
+import org.geotools.api.data.FeatureReader;
+import org.geotools.api.data.FeatureWriter;
+import org.geotools.api.data.Query;
+import org.geotools.api.data.SimpleFeatureSource;
+import org.geotools.api.data.Transaction;
+import org.geotools.api.feature.simple.SimpleFeature;
+import org.geotools.api.feature.simple.SimpleFeatureType;
+import org.geotools.filter.text.ecql.ECQL;
+import org.geotools.geometry.jts.ReferencedEnvelope;
+import org.locationtech.geomesa.tpu.geotools.GeoMesaTpuDataStoreFactory;
+import org.locationtech.geomesa.tpu.geotools.SimpleFeatureTypes;
+
+/**
+ * End-to-end smoke for the GeoTools DataStore module:
+ * DataStoreFinder resolves the factory from META-INF/services, then the
+ * full lifecycle round-trips through a live geomesa-tpu REST server:
+ * createSchema -> writer append -> count/bounds via stats -> filtered
+ * read -> removeSchema -> dispose.
+ *
+ * <pre>
+ *   geomesa-tpu web --port 8080 &amp;
+ *   java -cp out Smoke http://127.0.0.1:8080
+ * </pre>
+ */
+public final class Smoke {
+    private Smoke() {}
+
+    private static void check(boolean ok, String what) {
+        if (!ok) throw new AssertionError("FAILED: " + what);
+        System.out.println("ok: " + what);
+    }
+
+    public static void main(String[] args) throws Exception {
+        String url = args.length > 0 ? args[0] : "http://127.0.0.1:8080";
+        Map<String, Object> params = new HashMap<>();
+        params.put(GeoMesaTpuDataStoreFactory.REST_URL_PARAM.key, url);
+
+        DataStore store = DataStoreFinder.getDataStore(params);
+        check(store != null,
+                "DataStoreFinder resolved the factory via META-INF/services");
+
+        String typeName = "smoke_" + (System.nanoTime() % 1000000);
+        SimpleFeatureType sft = SimpleFeatureTypes.createType(typeName,
+                "name:String,age:Integer,dtg:Date,*geom:Point:srid=4326");
+        store.createSchema(sft);
+        SimpleFeatureType fetched = store.getSchema(typeName);
+        check(fetched.getAttributeNames().contains("age")
+                        && "geom".equals(fetched.getGeometryAttribute()),
+                "schema round-trip through the server catalog");
+
+        try (FeatureWriter<SimpleFeatureType, SimpleFeature> writer =
+                     store.getFeatureWriterAppend(
+                             typeName, Transaction.AUTO_COMMIT)) {
+            for (int i = 0; i < 10; i++) {
+                SimpleFeature f = writer.next();
+                f.setAttribute("name", i % 2 == 0 ? "even" : "odd");
+                f.setAttribute("age", i);
+                f.setAttribute("dtg", "2020-01-05T00:00:00");
+                Map<String, Object> geom = new LinkedHashMap<>();
+                geom.put("type", "Point");
+                geom.put("coordinates", List.of((double) i, 1.0));
+                f.setAttribute("geom", geom);
+                writer.write();
+            }
+        }
+
+        SimpleFeatureSource source = store.getFeatureSource(typeName);
+        check(source.getCount(new Query(typeName)) == 10,
+                "count via server stats == 10");
+        ReferencedEnvelope bounds = source.getBounds();
+        check(bounds != null && bounds.getMinX() == 0.0
+                        && bounds.getMaxX() == 9.0,
+                "bounds via server stats == [0, 9] x [1, 1]");
+
+        Query q = new Query(typeName,
+                ECQL.toFilter("age > 4 AND BBOX(geom, -1, 0, 20, 2)"));
+        int hits = 0;
+        boolean sawGeometry = false;
+        try (FeatureReader<SimpleFeatureType, SimpleFeature> reader =
+                     store.getFeatureReader(q, Transaction.AUTO_COMMIT)) {
+            while (reader.hasNext()) {
+                SimpleFeature f = reader.next();
+                hits++;
+                sawGeometry |= f.getDefaultGeometry() != null;
+                check(((Number) f.getAttribute("age")).intValue() > 4,
+                        "filter pushdown honored for " + f.getID());
+            }
+        }
+        check(hits == 5 && sawGeometry,
+                "filtered read returned 5 features with geometries");
+
+        store.removeSchema(typeName);
+        boolean gone = true;
+        for (String n : store.getTypeNames()) {
+            gone &= !n.equals(typeName);
+        }
+        check(gone, "removeSchema dropped the type");
+        store.dispose();
+        System.out.println("SMOKE PASSED against " + url);
+    }
+}

@@ -1,0 +1,239 @@
+package org.locationtech.geomesa.tpu.geotools;
+
+import java.io.IOException;
+import java.util.ArrayList;
+import java.util.List;
+import java.util.Map;
+import java.util.concurrent.ConcurrentHashMap;
+import org.geotools.api.data.DataStore;
+import org.geotools.api.data.FeatureReader;
+import org.geotools.api.data.FeatureSource;
+import org.geotools.api.data.FeatureWriter;
+import org.geotools.api.data.LockingManager;
+import org.geotools.api.data.Query;
+import org.geotools.api.data.ServiceInfo;
+import org.geotools.api.data.SimpleFeatureSource;
+import org.geotools.api.data.Transaction;
+import org.geotools.api.feature.simple.SimpleFeature;
+import org.geotools.api.feature.simple.SimpleFeatureType;
+import org.geotools.api.feature.type.Name;
+import org.geotools.api.filter.Filter;
+import org.geotools.filter.text.ecql.ECQL;
+
+/**
+ * GeoTools {@code DataStore} over a geomesa-tpu server — the analog of
+ * the reference's GeoMesaDataStore
+ * (geomesa-index-api/src/main/scala/org/locationtech/geomesa/index/
+ * geotools/GeoMesaDataStore.scala:49): schema CRUD against the remote
+ * catalog, query planning/execution delegated to the TPU-side planner,
+ * results streamed back as features.
+ *
+ * <p>Transport: the zero-dependency REST client ({@link TpuRestClient});
+ * the Arrow Flight client (jvm/GeoMesaTpuFlightClient.java) implements
+ * the same method-to-RPC delegation map (docs/PROTOCOL.md §8) for
+ * columnar streaming when Arrow jars are on the classpath.</p>
+ */
+public class GeoMesaTpuDataStore implements DataStore {
+
+    private final TpuRestClient client;
+    private final Map<String, TpuSimpleFeatureType> schemaCache =
+            new ConcurrentHashMap<>();
+    private volatile boolean disposed;
+
+    GeoMesaTpuDataStore(String restUrl) {
+        this.client = new TpuRestClient(restUrl);
+    }
+
+    private void checkOpen() throws IOException {
+        if (disposed) {
+            throw new IOException("DataStore has been disposed");
+        }
+    }
+
+    // -- schema CRUD ------------------------------------------------------
+
+    @Override public void createSchema(SimpleFeatureType featureType)
+            throws IOException {
+        checkOpen();
+        String spec = featureType instanceof TpuSimpleFeatureType
+                ? ((TpuSimpleFeatureType) featureType).getSpec()
+                : specOf(featureType);
+        client.createSchema(featureType.getTypeName(), spec);
+        schemaCache.remove(featureType.getTypeName());
+    }
+
+    /** Build a spec string from any SimpleFeatureType implementation. */
+    private static String specOf(SimpleFeatureType ft) {
+        StringBuilder spec = new StringBuilder();
+        for (String name : ft.getAttributeNames()) {
+            if (spec.length() > 0) spec.append(',');
+            Class<?> b = ft.getType(name);
+            String t;
+            if (name.equals(ft.getGeometryAttribute())) {
+                spec.append('*');
+                t = "Point";
+            } else if (b == Integer.class) {
+                t = "Integer";
+            } else if (b == Long.class) {
+                t = "Long";
+            } else if (b == Float.class) {
+                t = "Float";
+            } else if (b == Double.class) {
+                t = "Double";
+            } else if (b == Boolean.class) {
+                t = "Boolean";
+            } else if (b == java.util.Date.class) {
+                t = "Date";
+            } else {
+                t = "String";
+            }
+            spec.append(name).append(':').append(t);
+        }
+        return spec.toString();
+    }
+
+    @Override public SimpleFeatureType getSchema(String typeName)
+            throws IOException {
+        checkOpen();
+        TpuSimpleFeatureType cached = schemaCache.get(typeName);
+        if (cached != null) return cached;
+        Map<String, Object> d = client.describeSchema(typeName);
+        TpuSimpleFeatureType ft = new TpuSimpleFeatureType(
+                typeName, String.valueOf(d.get("spec")));
+        schemaCache.put(typeName, ft);
+        return ft;
+    }
+
+    @Override public SimpleFeatureType getSchema(Name name)
+            throws IOException {
+        return getSchema(name.getLocalPart());
+    }
+
+    @Override public void updateSchema(String typeName,
+                                       SimpleFeatureType featureType)
+            throws IOException {
+        checkOpen();
+        // the server's update path is append-only attribute addition
+        // (GeoMesaDataStore.scala:288-336 validates transitions the same
+        // way); surfaced via the CLI/py API — not this transport yet
+        throw new UnsupportedOperationException(
+                "updateSchema over REST is not supported yet; use the "
+                + "geomesa-tpu CLI (update-schema)");
+    }
+
+    @Override public void updateSchema(Name typeName,
+                                       SimpleFeatureType featureType)
+            throws IOException {
+        updateSchema(typeName.getLocalPart(), featureType);
+    }
+
+    @Override public void removeSchema(String typeName) throws IOException {
+        checkOpen();
+        client.deleteSchema(typeName);
+        schemaCache.remove(typeName);
+    }
+
+    @Override public void removeSchema(Name typeName) throws IOException {
+        removeSchema(typeName.getLocalPart());
+    }
+
+    @Override public String[] getTypeNames() throws IOException {
+        checkOpen();
+        List<Object> names = client.listSchemas();
+        String[] out = new String[names.size()];
+        for (int i = 0; i < out.length; i++) {
+            out[i] = String.valueOf(names.get(i));
+        }
+        return out;
+    }
+
+    @Override public List<Name> getNames() throws IOException {
+        List<Name> names = new ArrayList<>();
+        for (String n : getTypeNames()) {
+            names.add(new TpuSimpleFeatureType.TpuName(n));
+        }
+        return names;
+    }
+
+    // -- query / write ----------------------------------------------------
+
+    @Override public SimpleFeatureSource getFeatureSource(String typeName)
+            throws IOException {
+        return new GeoMesaTpuFeatureSource(
+                this, client, (TpuSimpleFeatureType) getSchema(typeName));
+    }
+
+    @Override
+    public FeatureSource<SimpleFeatureType, SimpleFeature> getFeatureSource(
+            Name typeName) throws IOException {
+        return getFeatureSource(typeName.getLocalPart());
+    }
+
+    @Override
+    public FeatureReader<SimpleFeatureType, SimpleFeature> getFeatureReader(
+            Query query, Transaction transaction) throws IOException {
+        checkOpen();
+        TpuSimpleFeatureType ft =
+                (TpuSimpleFeatureType) getSchema(query.getTypeName());
+        String cql = ECQL.toCQL(query.getFilter());
+        return new GeoMesaTpuFeatureReader(ft, client.features(
+                ft.getTypeName(), cql, query.getMaxFeatures()));
+    }
+
+    @Override
+    public FeatureWriter<SimpleFeatureType, SimpleFeature> getFeatureWriter(
+            String typeName, Filter filter, Transaction transaction)
+            throws IOException {
+        // modify-in-place writers need per-feature update RPCs; the
+        // supported mutation surface is append + delete-by-filter
+        throw new UnsupportedOperationException(
+                "modify writers are not supported; use "
+                + "getFeatureWriterAppend + deleteFeatures(cql)");
+    }
+
+    @Override
+    public FeatureWriter<SimpleFeatureType, SimpleFeature> getFeatureWriter(
+            String typeName, Transaction transaction) throws IOException {
+        return getFeatureWriter(typeName, Filter.INCLUDE, transaction);
+    }
+
+    @Override
+    public FeatureWriter<SimpleFeatureType, SimpleFeature>
+            getFeatureWriterAppend(String typeName, Transaction transaction)
+            throws IOException {
+        checkOpen();
+        return new GeoMesaTpuFeatureWriter(
+                client, (TpuSimpleFeatureType) getSchema(typeName));
+    }
+
+    /** Delete features matching an ECQL filter (the reference's
+     * removeFeatures fast path on GeoMesaFeatureStore). */
+    public long deleteFeatures(String typeName, String ecql)
+            throws IOException {
+        checkOpen();
+        return client.deleteFeatures(typeName, ecql);
+    }
+
+    // -- infrastructure ---------------------------------------------------
+
+    @Override public ServiceInfo getInfo() {
+        return new ServiceInfo() {
+            @Override public String getTitle() {
+                return "geomesa-tpu @ " + client.baseUrl();
+            }
+            @Override public String getDescription() {
+                return "TPU-native GeoMesa-equivalent feature store "
+                        + "(REST transport)";
+            }
+        };
+    }
+
+    @Override public LockingManager getLockingManager() {
+        return null; // like the reference: no cross-client locking
+    }
+
+    @Override public void dispose() {
+        disposed = true;
+        schemaCache.clear();
+    }
+}

@@ -1,0 +1,74 @@
+package org.locationtech.geomesa.tpu.geotools;
+
+import java.io.IOException;
+import java.util.Map;
+import org.geotools.api.data.DataStore;
+import org.geotools.api.data.DataStoreFactorySpi;
+
+/**
+ * GeoTools {@code DataStoreFactorySpi} for geomesa-tpu — the SPI entry
+ * point GeoServer/WFS/WMS discover via
+ * {@code META-INF/services/org.geotools.api.data.DataStoreFactorySpi}
+ * (reference registration: geomesa-accumulo-datastore/src/main/
+ * resources/META-INF/services/org.geotools.data.DataStoreFactorySpi;
+ * factory shape: geomesa-accumulo-datastore/.../AccumuloDataStoreFactory
+ * .scala).
+ *
+ * <p>Connection parameters:</p>
+ * <ul>
+ *   <li>{@code geomesa.tpu.rest.url} (required) — base URL of a
+ *       geomesa-tpu REST server ({@code geomesa-tpu web} or
+ *       {@code geomesa_tpu.web.serve}), e.g.
+ *       {@code http://tpu-host:8080}</li>
+ *   <li>{@code geomesa.tpu.auths} (optional) — comma-separated
+ *       visibility authorizations for queries</li>
+ * </ul>
+ */
+public class GeoMesaTpuDataStoreFactory implements DataStoreFactorySpi {
+
+    /** Base URL of the geomesa-tpu REST server. */
+    public static final Param REST_URL_PARAM = new Param(
+            "geomesa.tpu.rest.url", String.class,
+            "Base URL of the geomesa-tpu REST server", true,
+            "http://localhost:8080");
+
+    /** Comma-separated visibility authorizations. */
+    public static final Param AUTHS_PARAM = new Param(
+            "geomesa.tpu.auths", String.class,
+            "Comma-separated visibility authorizations", false);
+
+    @Override public String getDisplayName() {
+        return "GeoMesa TPU";
+    }
+
+    @Override public String getDescription() {
+        return "TPU-native GeoMesa-equivalent feature store "
+                + "(JAX/XLA planner and kernels behind a REST/Flight "
+                + "sidecar)";
+    }
+
+    @Override public Param[] getParametersInfo() {
+        return new Param[] { REST_URL_PARAM, AUTHS_PARAM };
+    }
+
+    @Override public boolean canProcess(Map<String, ?> params) {
+        return params != null && params.get(REST_URL_PARAM.key) != null;
+    }
+
+    @Override public boolean isAvailable() {
+        return true; // JDK-only transport: no optional dependencies
+    }
+
+    @Override public DataStore createDataStore(Map<String, ?> params)
+            throws IOException {
+        Object url = REST_URL_PARAM.lookUp(params);
+        return new GeoMesaTpuDataStore(String.valueOf(url));
+    }
+
+    @Override public DataStore createNewDataStore(Map<String, ?> params)
+            throws IOException {
+        // like the reference's factories: the catalog is created lazily
+        // on first createSchema, so "new" and "existing" converge
+        return createDataStore(params);
+    }
+}

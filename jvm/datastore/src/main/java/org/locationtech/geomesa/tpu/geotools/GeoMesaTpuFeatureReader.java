@@ -1,0 +1,51 @@
+package org.locationtech.geomesa.tpu.geotools;
+
+import java.io.IOException;
+import java.util.Iterator;
+import java.util.List;
+import java.util.Map;
+import java.util.NoSuchElementException;
+import org.geotools.api.data.FeatureReader;
+import org.geotools.api.feature.simple.SimpleFeature;
+import org.geotools.api.feature.simple.SimpleFeatureType;
+
+/**
+ * FeatureReader over the REST transport's GeoJSON FeatureCollection —
+ * the analog of the reference's reader over the QueryPlan's scan
+ * (geomesa-index-api/.../planning/QueryPlanner.scala runQuery results).
+ */
+final class GeoMesaTpuFeatureReader
+        implements FeatureReader<SimpleFeatureType, SimpleFeature> {
+
+    private final TpuSimpleFeatureType type;
+    private final Iterator<Object> features;
+
+    @SuppressWarnings("unchecked")
+    GeoMesaTpuFeatureReader(TpuSimpleFeatureType type,
+                            Map<String, Object> featureCollection) {
+        this.type = type;
+        Object f = featureCollection.get("features");
+        this.features = ((List<Object>) f).iterator();
+    }
+
+    @Override public SimpleFeatureType getFeatureType() { return type; }
+
+    @Override public boolean hasNext() { return features.hasNext(); }
+
+    @Override
+    @SuppressWarnings("unchecked")
+    public SimpleFeature next() throws NoSuchElementException {
+        Map<String, Object> f = (Map<String, Object>) features.next();
+        Map<String, Object> props = (Map<String, Object>) f.get("properties");
+        return new TpuSimpleFeature(
+                type,
+                String.valueOf(f.get("id")),
+                f.get("geometry"),
+                props == null ? Map.of() : props);
+    }
+
+    @Override public void close() throws IOException {
+        // the collection is fully materialized by the transport;
+        // nothing to release
+    }
+}

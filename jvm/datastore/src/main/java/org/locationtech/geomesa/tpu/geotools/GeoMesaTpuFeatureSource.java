@@ -1,0 +1,70 @@
+package org.locationtech.geomesa.tpu.geotools;
+
+import java.io.IOException;
+import java.util.List;
+import org.geotools.api.data.DataStore;
+import org.geotools.api.data.FeatureReader;
+import org.geotools.api.data.Query;
+import org.geotools.api.data.SimpleFeatureSource;
+import org.geotools.api.feature.simple.SimpleFeature;
+import org.geotools.api.feature.simple.SimpleFeatureType;
+import org.geotools.filter.text.ecql.ECQL;
+import org.geotools.geometry.jts.ReferencedEnvelope;
+
+/**
+ * SimpleFeatureSource whose bounds/count come from the server's stats
+ * subsystem (write-time sketches; the analog of the reference's
+ * GeoMesaFeatureSource delegating to stats,
+ * geomesa-index-api/.../geotools/GeoMesaFeatureSource.scala) rather
+ * than a scan.
+ */
+final class GeoMesaTpuFeatureSource implements SimpleFeatureSource {
+
+    private final GeoMesaTpuDataStore store;
+    private final TpuRestClient client;
+    private final TpuSimpleFeatureType type;
+
+    GeoMesaTpuFeatureSource(GeoMesaTpuDataStore store, TpuRestClient client,
+                            TpuSimpleFeatureType type) {
+        this.store = store;
+        this.client = client;
+        this.type = type;
+    }
+
+    @Override public SimpleFeatureType getSchema() { return type; }
+
+    @Override public DataStore getDataStore() { return store; }
+
+    @Override public ReferencedEnvelope getBounds() throws IOException {
+        List<Object> b = client.bounds(type.getTypeName());
+        if (b == null || b.size() != 4) {
+            return null; // empty store: no bounds yet
+        }
+        return new ReferencedEnvelope(
+                ((Number) b.get(0)).doubleValue(),
+                ((Number) b.get(2)).doubleValue(),
+                ((Number) b.get(1)).doubleValue(),
+                ((Number) b.get(3)).doubleValue());
+    }
+
+    @Override public ReferencedEnvelope getBounds(Query query)
+            throws IOException {
+        // full-extent bounds for filtered queries would need a scan;
+        // like the reference, fall back to the schema-wide envelope
+        return getBounds();
+    }
+
+    @Override public int getCount(Query query) throws IOException {
+        String cql = ECQL.toCQL(query == null ? null : query.getFilter());
+        return (int) client.count(type.getTypeName(), cql);
+    }
+
+    @Override
+    public FeatureReader<SimpleFeatureType, SimpleFeature> getFeatures(
+            Query query) throws IOException {
+        String cql = ECQL.toCQL(query == null ? null : query.getFilter());
+        int max = query == null ? Integer.MAX_VALUE : query.getMaxFeatures();
+        return new GeoMesaTpuFeatureReader(
+                type, client.features(type.getTypeName(), cql, max));
+    }
+}

@@ -1,0 +1,83 @@
+package org.locationtech.geomesa.tpu.geotools;
+
+import java.io.IOException;
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import org.geotools.api.data.FeatureWriter;
+import org.geotools.api.feature.simple.SimpleFeature;
+import org.geotools.api.feature.simple.SimpleFeatureType;
+
+/**
+ * Append-mode FeatureWriter: features accumulate locally and flush as
+ * one GeoJSON FeatureCollection POST on {@link #close()} (matching the
+ * reference's batched writer flush,
+ * geomesa-index-api/.../api/IndexAdapter WriteAdapter semantics — one
+ * mutation batch per flush, not one RPC per feature).
+ */
+final class GeoMesaTpuFeatureWriter
+        implements FeatureWriter<SimpleFeatureType, SimpleFeature> {
+
+    private final TpuRestClient client;
+    private final TpuSimpleFeatureType type;
+    private final List<Object> pending = new ArrayList<>();
+    private TpuSimpleFeature current;
+    private long counter;
+
+    GeoMesaTpuFeatureWriter(TpuRestClient client, TpuSimpleFeatureType type) {
+        this.client = client;
+        this.type = type;
+    }
+
+    @Override public SimpleFeatureType getFeatureType() { return type; }
+
+    @Override public boolean hasNext() { return false; } // append-only
+
+    @Override public SimpleFeature next() {
+        current = new TpuSimpleFeature(
+                type, type.getTypeName() + "-" + (counter++),
+                null, new LinkedHashMap<>());
+        return current;
+    }
+
+    @Override public void remove() throws IOException {
+        throw new IOException(
+                "append-only writer: use deleteFeatures(cql) to remove");
+    }
+
+    @Override public void write() throws IOException {
+        if (current == null) {
+            throw new IOException("call next() before write()");
+        }
+        Map<String, Object> f = new LinkedHashMap<>();
+        f.put("type", "Feature");
+        f.put("id", current.getID());
+        Object geom = current.getAttribute(type.getGeometryAttribute());
+        if (geom == null) {
+            throw new IOException("feature " + current.getID()
+                    + " has no geometry (attribute "
+                    + type.getGeometryAttribute() + ")");
+        }
+        f.put("geometry", geom);
+        Map<String, Object> props = new LinkedHashMap<>();
+        for (String name : type.getAttributeNames()) {
+            if (!name.equals(type.getGeometryAttribute())) {
+                props.put(name, current.getAttribute(name));
+            }
+        }
+        f.put("properties", props);
+        pending.add(f);
+        current = null;
+    }
+
+    @Override public void close() throws IOException {
+        if (!pending.isEmpty()) {
+            Map<String, Object> fc = new LinkedHashMap<>();
+            fc.put("type", "FeatureCollection");
+            fc.put("features", pending);
+            client.insertFeatures(type.getTypeName(), fc);
+            pending.clear();
+        }
+    }
+}

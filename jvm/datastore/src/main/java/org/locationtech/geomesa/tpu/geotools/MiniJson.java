@@ -1,0 +1,214 @@
+package org.locationtech.geomesa.tpu.geotools;
+
+import java.util.ArrayList;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * Minimal JSON parser/writer (JDK-only, no third-party dependency) for
+ * the REST transport. Parses into Map/List/String/Double/Boolean/null;
+ * writes the same shapes back. Sufficient for the geomesa-tpu REST
+ * surface (geomesa_tpu/web.py); not a general-purpose JSON library.
+ */
+final class MiniJson {
+    private final String s;
+    private int i;
+
+    private MiniJson(String s) { this.s = s; }
+
+    static Object parse(String text) {
+        MiniJson p = new MiniJson(text);
+        Object v = p.value();
+        p.ws();
+        if (p.i != p.s.length()) {
+            throw new IllegalArgumentException(
+                    "trailing JSON at offset " + p.i);
+        }
+        return v;
+    }
+
+    @SuppressWarnings("unchecked")
+    static Map<String, Object> parseObject(String text) {
+        return (Map<String, Object>) parse(text);
+    }
+
+    private void ws() {
+        while (i < s.length() && Character.isWhitespace(s.charAt(i))) i++;
+    }
+
+    private char peek() {
+        if (i >= s.length()) throw new IllegalArgumentException("eof");
+        return s.charAt(i);
+    }
+
+    private Object value() {
+        ws();
+        char c = peek();
+        switch (c) {
+            case '{': return object();
+            case '[': return array();
+            case '"': return string();
+            case 't': expect("true"); return Boolean.TRUE;
+            case 'f': expect("false"); return Boolean.FALSE;
+            case 'n': expect("null"); return null;
+            default: return number();
+        }
+    }
+
+    private void expect(String lit) {
+        if (!s.startsWith(lit, i)) {
+            throw new IllegalArgumentException(
+                    "bad literal at offset " + i);
+        }
+        i += lit.length();
+    }
+
+    private Map<String, Object> object() {
+        Map<String, Object> m = new LinkedHashMap<>();
+        i++; // {
+        ws();
+        if (peek() == '}') { i++; return m; }
+        while (true) {
+            ws();
+            String k = string();
+            ws();
+            if (peek() != ':') throw new IllegalArgumentException(
+                    "expected : at offset " + i);
+            i++;
+            m.put(k, value());
+            ws();
+            char c = peek();
+            i++;
+            if (c == '}') return m;
+            if (c != ',') throw new IllegalArgumentException(
+                    "expected , or } at offset " + (i - 1));
+        }
+    }
+
+    private List<Object> array() {
+        List<Object> l = new ArrayList<>();
+        i++; // [
+        ws();
+        if (peek() == ']') { i++; return l; }
+        while (true) {
+            l.add(value());
+            ws();
+            char c = peek();
+            i++;
+            if (c == ']') return l;
+            if (c != ',') throw new IllegalArgumentException(
+                    "expected , or ] at offset " + (i - 1));
+        }
+    }
+
+    private String string() {
+        if (peek() != '"') throw new IllegalArgumentException(
+                "expected string at offset " + i);
+        i++;
+        StringBuilder b = new StringBuilder();
+        while (true) {
+            char c = s.charAt(i++);
+            if (c == '"') return b.toString();
+            if (c == '\\') {
+                char e = s.charAt(i++);
+                switch (e) {
+                    case '"': b.append('"'); break;
+                    case '\\': b.append('\\'); break;
+                    case '/': b.append('/'); break;
+                    case 'b': b.append('\b'); break;
+                    case 'f': b.append('\f'); break;
+                    case 'n': b.append('\n'); break;
+                    case 'r': b.append('\r'); break;
+                    case 't': b.append('\t'); break;
+                    case 'u':
+                        b.append((char) Integer.parseInt(
+                                s.substring(i, i + 4), 16));
+                        i += 4;
+                        break;
+                    default: throw new IllegalArgumentException(
+                            "bad escape \\" + e);
+                }
+            } else {
+                b.append(c);
+            }
+        }
+    }
+
+    private Double number() {
+        int start = i;
+        while (i < s.length() && "+-0123456789.eE".indexOf(s.charAt(i)) >= 0) {
+            i++;
+        }
+        return Double.parseDouble(s.substring(start, i));
+    }
+
+    // -- writer -----------------------------------------------------------
+
+    static String write(Object v) {
+        StringBuilder b = new StringBuilder();
+        writeTo(b, v);
+        return b.toString();
+    }
+
+    private static void writeTo(StringBuilder b, Object v) {
+        if (v == null) {
+            b.append("null");
+        } else if (v instanceof String) {
+            writeString(b, (String) v);
+        } else if (v instanceof Map) {
+            b.append('{');
+            boolean first = true;
+            for (Map.Entry<?, ?> e : ((Map<?, ?>) v).entrySet()) {
+                if (!first) b.append(',');
+                first = false;
+                writeString(b, String.valueOf(e.getKey()));
+                b.append(':');
+                writeTo(b, e.getValue());
+            }
+            b.append('}');
+        } else if (v instanceof Iterable) {
+            b.append('[');
+            boolean first = true;
+            for (Object o : (Iterable<?>) v) {
+                if (!first) b.append(',');
+                first = false;
+                writeTo(b, o);
+            }
+            b.append(']');
+        } else if (v instanceof Double || v instanceof Float) {
+            double d = ((Number) v).doubleValue();
+            if (d == Math.floor(d) && !Double.isInfinite(d)
+                    && Math.abs(d) < 1e15) {
+                b.append((long) d);
+            } else {
+                b.append(d);
+            }
+        } else if (v instanceof Number || v instanceof Boolean) {
+            b.append(v);
+        } else {
+            writeString(b, String.valueOf(v));
+        }
+    }
+
+    private static void writeString(StringBuilder b, String v) {
+        b.append('"');
+        for (int j = 0; j < v.length(); j++) {
+            char c = v.charAt(j);
+            switch (c) {
+                case '"': b.append("\\\""); break;
+                case '\\': b.append("\\\\"); break;
+                case '\n': b.append("\\n"); break;
+                case '\r': b.append("\\r"); break;
+                case '\t': b.append("\\t"); break;
+                default:
+                    if (c < 0x20) {
+                        b.append(String.format("\\u%04x", (int) c));
+                    } else {
+                        b.append(c);
+                    }
+            }
+        }
+        b.append('"');
+    }
+}

@@ -1,0 +1,32 @@
+package org.locationtech.geomesa.tpu.geotools;
+
+import org.geotools.api.feature.simple.SimpleFeatureType;
+
+/**
+ * Spec-string SimpleFeatureType builder — the analog of the reference's
+ * {@code SimpleFeatureTypes.createType}
+ * (geomesa-utils/.../geotools/SimpleFeatureTypes.scala), kept
+ * format-compatible so GeoMesa specs carry over verbatim:
+ *
+ * <pre>
+ *   SimpleFeatureTypes.createType("gdelt",
+ *       "name:String,dtg:Date,*geom:Point:srid=4326");
+ * </pre>
+ */
+public final class SimpleFeatureTypes {
+    private SimpleFeatureTypes() {}
+
+    public static SimpleFeatureType createType(String typeName, String spec) {
+        return new TpuSimpleFeatureType(typeName, spec);
+    }
+
+    /** The spec string for a type created by {@link #createType} (or
+     * fetched from a geomesa-tpu server). */
+    public static String encodeType(SimpleFeatureType type) {
+        if (type instanceof TpuSimpleFeatureType) {
+            return ((TpuSimpleFeatureType) type).getSpec();
+        }
+        throw new IllegalArgumentException(
+                "not a geomesa-tpu feature type: " + type);
+    }
+}

@@ -1,0 +1,156 @@
+package org.locationtech.geomesa.tpu.geotools;
+
+import java.io.IOException;
+import java.net.URI;
+import java.net.URLEncoder;
+import java.net.http.HttpClient;
+import java.net.http.HttpRequest;
+import java.net.http.HttpResponse;
+import java.nio.charset.StandardCharsets;
+import java.time.Duration;
+import java.util.List;
+import java.util.Map;
+
+/**
+ * JDK-only transport for the geomesa-tpu REST surface
+ * (geomesa_tpu/web.py). Endpoint contract (CI-verified by
+ * tests/test_jvm_datastore_contract.py against the live server):
+ *
+ * <pre>
+ *   GET    /api/version
+ *   GET    /api/schemas
+ *   GET    /api/schemas/{name}
+ *   POST   /api/schemas                       {"name","spec"}
+ *   DELETE /api/schemas/{name}
+ *   GET    /api/schemas/{name}/count?cql=
+ *   GET    /api/schemas/{name}/bounds
+ *   GET    /api/schemas/{name}/features?cql=&max=
+ *   POST   /api/schemas/{name}/features       GeoJSON FeatureCollection
+ *   DELETE /api/schemas/{name}/features?cql=
+ * </pre>
+ *
+ * The Arrow Flight sidecar (docs/PROTOCOL.md, jvm/GeoMesaTpuFlightClient
+ * .java) is the high-throughput alternative; this client trades Arrow
+ * columnar streams for zero third-party dependencies, which is what lets
+ * the DataStore module compile and smoke-test against nothing but a JDK.
+ */
+final class TpuRestClient {
+    private final String base;
+    private final HttpClient http;
+
+    TpuRestClient(String baseUrl) {
+        this.base = baseUrl.endsWith("/")
+                ? baseUrl.substring(0, baseUrl.length() - 1) : baseUrl;
+        this.http = HttpClient.newBuilder()
+                .connectTimeout(Duration.ofSeconds(10))
+                .build();
+    }
+
+    String baseUrl() { return base; }
+
+    private static String enc(String v) {
+        return URLEncoder.encode(v, StandardCharsets.UTF_8);
+    }
+
+    private String send(String method, String path, String body)
+            throws IOException {
+        HttpRequest.Builder rb = HttpRequest.newBuilder()
+                .uri(URI.create(base + path))
+                .timeout(Duration.ofSeconds(120));
+        if (body == null) {
+            rb.method(method, HttpRequest.BodyPublishers.noBody());
+        } else {
+            rb.header("Content-Type", "application/json")
+              .method(method, HttpRequest.BodyPublishers.ofString(body));
+        }
+        HttpResponse<String> resp;
+        try {
+            resp = http.send(rb.build(), HttpResponse.BodyHandlers.ofString());
+        } catch (InterruptedException e) {
+            Thread.currentThread().interrupt();
+            throw new IOException("interrupted talking to " + base, e);
+        }
+        if (resp.statusCode() >= 400) {
+            String msg = resp.body();
+            try {
+                Object err = MiniJson.parseObject(msg).get("error");
+                if (err != null) msg = String.valueOf(err);
+            } catch (RuntimeException ignored) {
+                // not JSON; keep raw body
+            }
+            throw new IOException(
+                    method + " " + path + " -> HTTP " + resp.statusCode()
+                    + ": " + msg);
+        }
+        return resp.body();
+    }
+
+    String version() throws IOException {
+        return (String) MiniJson.parseObject(
+                send("GET", "/api/version", null)).get("version");
+    }
+
+    @SuppressWarnings("unchecked")
+    List<Object> listSchemas() throws IOException {
+        return (List<Object>) MiniJson.parse(
+                send("GET", "/api/schemas", null));
+    }
+
+    /** {"name","spec","count","indices"} or IOException(404). */
+    Map<String, Object> describeSchema(String name) throws IOException {
+        return MiniJson.parseObject(
+                send("GET", "/api/schemas/" + enc(name), null));
+    }
+
+    void createSchema(String name, String spec) throws IOException {
+        send("POST", "/api/schemas", MiniJson.write(
+                Map.of("name", name, "spec", spec)));
+    }
+
+    void deleteSchema(String name) throws IOException {
+        send("DELETE", "/api/schemas/" + enc(name), null);
+    }
+
+    long count(String name, String cql) throws IOException {
+        String path = "/api/schemas/" + enc(name) + "/count?cql=" + enc(cql);
+        Object n = MiniJson.parseObject(send("GET", path, null)).get("count");
+        return ((Number) n).longValue();
+    }
+
+    /** [xmin, ymin, xmax, ymax], or null for an empty store. */
+    @SuppressWarnings("unchecked")
+    List<Object> bounds(String name) throws IOException {
+        Object v = MiniJson.parse(
+                send("GET", "/api/schemas/" + enc(name) + "/bounds", null));
+        return (List<Object>) v;
+    }
+
+    /** GeoJSON FeatureCollection for the query. */
+    Map<String, Object> features(String name, String cql, int max)
+            throws IOException {
+        StringBuilder path = new StringBuilder(
+                "/api/schemas/" + enc(name) + "/features?cql=" + enc(cql));
+        if (max > 0 && max != Integer.MAX_VALUE) {
+            path.append("&max=").append(max);
+        }
+        return MiniJson.parseObject(send("GET", path.toString(), null));
+    }
+
+    /** Ingest a GeoJSON FeatureCollection; returns the inserted count. */
+    long insertFeatures(String name, Map<String, Object> featureCollection)
+            throws IOException {
+        String body = MiniJson.write(featureCollection);
+        Object n = MiniJson.parseObject(send(
+                "POST", "/api/schemas/" + enc(name) + "/features", body)
+        ).get("inserted");
+        return ((Number) n).longValue();
+    }
+
+    long deleteFeatures(String name, String cql) throws IOException {
+        Object n = MiniJson.parseObject(send(
+                "DELETE",
+                "/api/schemas/" + enc(name) + "/features?cql=" + enc(cql),
+                null)).get("deleted");
+        return ((Number) n).longValue();
+    }
+}

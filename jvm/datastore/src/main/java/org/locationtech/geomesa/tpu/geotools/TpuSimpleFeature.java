@@ -1,0 +1,77 @@
+package org.locationtech.geomesa.tpu.geotools;
+
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import org.geotools.api.feature.simple.SimpleFeature;
+import org.geotools.api.feature.simple.SimpleFeatureType;
+
+/**
+ * SimpleFeature over one GeoJSON feature from the REST transport.
+ * Geometry attributes surface as the parsed GeoJSON geometry map
+ * ({@code {"type": "Point", "coordinates": [...]}}); scalar attributes
+ * as String/Number/Boolean per the schema binding.
+ */
+final class TpuSimpleFeature implements SimpleFeature {
+    private final TpuSimpleFeatureType type;
+    private final String id;
+    private final Map<String, Object> values = new LinkedHashMap<>();
+    private final Object geometry;
+
+    TpuSimpleFeature(TpuSimpleFeatureType type, String id,
+                     Object geometry, Map<String, Object> properties) {
+        this.type = type;
+        this.id = id;
+        this.geometry = geometry;
+        for (String name : type.getAttributeNames()) {
+            if (name.equals(type.getGeometryAttribute())) {
+                values.put(name, geometry);
+            } else {
+                values.put(name, coerce(type.getType(name),
+                        properties.get(name)));
+            }
+        }
+    }
+
+    private static Object coerce(Class<?> binding, Object v) {
+        if (v == null || binding == null) return v;
+        if (binding == Integer.class && v instanceof Number) {
+            return ((Number) v).intValue();
+        }
+        if (binding == Long.class && v instanceof Number) {
+            return ((Number) v).longValue();
+        }
+        if (binding == Float.class && v instanceof Number) {
+            return ((Number) v).floatValue();
+        }
+        if (binding == Double.class && v instanceof Number) {
+            return ((Number) v).doubleValue();
+        }
+        return v;
+    }
+
+    @Override public String getID() { return id; }
+
+    @Override public SimpleFeatureType getFeatureType() { return type; }
+
+    @Override public Object getAttribute(String name) {
+        return values.get(name);
+    }
+
+    @Override public Object getAttribute(int index) {
+        List<String> names = type.getAttributeNames();
+        return values.get(names.get(index));
+    }
+
+    @Override public void setAttribute(String name, Object value) {
+        values.put(name, value);
+    }
+
+    @Override public Object getDefaultGeometry() { return geometry; }
+
+    Map<String, Object> attributeMap() { return values; }
+
+    @Override public String toString() {
+        return "SimpleFeature(" + id + ", " + values + ")";
+    }
+}

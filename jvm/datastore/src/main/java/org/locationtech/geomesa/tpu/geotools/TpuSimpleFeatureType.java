@@ -1,0 +1,101 @@
+package org.locationtech.geomesa.tpu.geotools;
+
+import java.util.ArrayList;
+import java.util.Collections;
+import java.util.Date;
+import java.util.LinkedHashMap;
+import java.util.List;
+import java.util.Map;
+import org.geotools.api.feature.simple.SimpleFeatureType;
+import org.geotools.api.feature.type.Name;
+
+/**
+ * SimpleFeatureType over a GeoMesa spec string
+ * ({@code name:Type[:opt=val],*geom:Point;userdata}) — the same format
+ * the reference's SimpleFeatureTypes.createType accepts
+ * (geomesa-utils/.../geotools/SimpleFeatureTypes.scala), so specs and
+ * tutorials carry over verbatim.
+ */
+final class TpuSimpleFeatureType implements SimpleFeatureType {
+
+    static final class TpuName implements Name {
+        private final String local;
+        TpuName(String local) { this.local = local; }
+        @Override public String getLocalPart() { return local; }
+        @Override public String getNamespaceURI() { return null; }
+        @Override public String getURI() { return local; }
+        @Override public String toString() { return local; }
+    }
+
+    private final String typeName;
+    private final String spec;
+    private final Map<String, Class<?>> attrs = new LinkedHashMap<>();
+    private String geomAttribute;
+
+    TpuSimpleFeatureType(String typeName, String spec) {
+        this.typeName = typeName;
+        this.spec = spec;
+        String attrPart = spec.split(";", 2)[0];
+        for (String field : attrPart.split(",")) {
+            if (field.isBlank()) continue;
+            String f = field.trim();
+            boolean isDefaultGeom = f.startsWith("*");
+            if (isDefaultGeom) f = f.substring(1);
+            String[] bits = f.split(":");
+            String name = bits[0];
+            String type = bits.length > 1 ? bits[1] : "String";
+            Class<?> binding = binding(type);
+            attrs.put(name, binding);
+            if (isDefaultGeom || (geomAttribute == null
+                    && isGeometryType(type))) {
+                geomAttribute = name;
+            }
+        }
+    }
+
+    private static boolean isGeometryType(String t) {
+        switch (t.toLowerCase()) {
+            case "point": case "linestring": case "polygon":
+            case "multipoint": case "multilinestring": case "multipolygon":
+            case "geometry": case "geometrycollection":
+                return true;
+            default:
+                return false;
+        }
+    }
+
+    private static Class<?> binding(String t) {
+        switch (t.toLowerCase()) {
+            case "integer": case "int": return Integer.class;
+            case "long": return Long.class;
+            case "float": return Float.class;
+            case "double": return Double.class;
+            case "boolean": return Boolean.class;
+            case "date": case "timestamp": return Date.class;
+            default:
+                // strings, uuids, json, and geometries (carried as
+                // GeoJSON-derived maps / WKT strings in this transport)
+                return isGeometryType(t) ? Object.class : String.class;
+        }
+    }
+
+    String getSpec() { return spec; }
+
+    @Override public String getTypeName() { return typeName; }
+
+    @Override public Name getName() { return new TpuName(typeName); }
+
+    @Override public int getAttributeCount() { return attrs.size(); }
+
+    @Override public List<String> getAttributeNames() {
+        return Collections.unmodifiableList(new ArrayList<>(attrs.keySet()));
+    }
+
+    @Override public Class<?> getType(String name) { return attrs.get(name); }
+
+    @Override public String getGeometryAttribute() { return geomAttribute; }
+
+    @Override public String toString() {
+        return "SimpleFeatureType(" + typeName + ", " + spec + ")";
+    }
+}
