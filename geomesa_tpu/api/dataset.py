@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import json
 import os
+import shutil
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -214,15 +215,14 @@ class GeoDataset:
         Store.scala:288-336 validates transitions the same way).
 
         Existing columns — including visibility labels and derived geometry/
-        time columns — are carried over verbatim. Added columns are filled
-        with this layout's null representation: string -> null code (-1),
-        float -> NaN, int/long -> 0, bool -> False, date -> epoch 0 (the
-        fixed-width columnar model has no validity bitmap for those)."""
-        from geomesa_tpu.curves.binned_time import BinnedTime
-        from geomesa_tpu.schema.columns import DictionaryEncoder
-
-        from geomesa_tpu.index.partitioned import PartitionedFeatureStore
-
+        time columns — are carried over verbatim IN PLACE: no index key
+        changes, so sort permutations are untouched and no row is
+        re-flushed (r4 rebuilt the whole store here — O(dataset) per
+        added column). Added columns fill with this layout's null
+        representation: string -> null code (-1), float -> NaN, int/long
+        -> 0, bool -> False, date -> epoch 0 (the fixed-width columnar
+        model has no validity bitmap for those). Spilled partitions
+        upgrade lazily on their next load."""
         st = self._store(name)
         st.flush()
         old = st.ft
@@ -236,83 +236,44 @@ class GeoDataset:
         for a in added:
             if a.is_geom:
                 raise ValueError("cannot add geometry attributes to a schema")
-
-        def null_fill(cols, n, dicts):
-            for a in added:
-                if a.type == "string":
-                    cols[a.name] = np.full(n, -1, np.int32)
-                    dicts.setdefault(a.name, DictionaryEncoder())
-                elif a.type == "date":
-                    cols[a.name] = np.zeros(n, np.int64)
-                    bt = BinnedTime(new_ft.time_period)
-                    b, off = bt.to_scaled(cols[a.name])
-                    cols[a.name + "__bin"] = b
-                    cols[a.name + "__off"] = off
-                elif a.type == "bool":
-                    cols[a.name] = np.zeros(n, bool)
-                elif a.type == "json":
-                    cols[a.name] = np.full(n, None, dtype=object)
-                elif a.type in ("float32", "float64"):
-                    cols[a.name] = np.full(n, np.nan, np.dtype(a.type))
-                else:
-                    cols[a.name] = np.zeros(n, np.dtype(a.type))
-
-        def upgrade_flat(src: FeatureStore, shard_bucket: int = 1) -> FeatureStore:
-            out = FeatureStore(new_ft, self.n_shards)
-            for t in out.tables.values():  # BEFORE flush: layout-time knob
-                t.shard_len_multiple = shard_bucket
-            # fresh encoders so the old store stays untouched
-            out.dicts = {
-                k: DictionaryEncoder(list(d.values))
-                for k, d in src.dicts.items()
-            }
-            if src._all is not None and src._all.n:
-                n = src._all.n
-                cols = {k: v.copy() for k, v in src._all.columns.items()}
-                null_fill(cols, n, out.dicts)
-                from geomesa_tpu.schema.columns import ColumnBatch
-
-                out._buffer = [ColumnBatch(cols, n)]
-                out.flush()
-            return out
-
-        if isinstance(st, PartitionedFeatureStore):
-            # re-index each partition under the new schema, one at a time
-            # (the residency budget bounds memory); spilled partitions
-            # round-trip through their snapshot
-            new_store = PartitionedFeatureStore(new_ft, self.n_shards)
-            # carry operational config: a shared spill dir would otherwise
-            # serve STALE old-schema snapshots (eviction skips clean bins)
-            new_store._spill_dir = st._spill_dir
-            new_store.max_resident = st.max_resident
-            new_store.dicts = {
-                k: DictionaryEncoder(list(d.values))
-                for k, d in st.dicts.items()
-            }
-            for a in added:
-                if a.type == "string":
-                    new_store.dicts.setdefault(a.name, DictionaryEncoder())
-            for b in st.partition_bins():
-                child = st.child(b)
-                if child is None or child._all is None or not child._all.n:
-                    continue
-                up = upgrade_flat(child, new_store._shard_bucket)
-                up.dicts = new_store.dicts
-                new_store.partitions[b] = up
-                new_store.part_counts[b] = up.count
-                new_store._dirty.add(b)  # force fresh snapshots on spill
-                new_store.evict()
-            # transfer spill-dir ownership only once migration SUCCEEDED:
-            # either store's finalizer removes an owned temp dir, so the
-            # owner must be whichever store survives this method
-            new_store._owns_spill_dir = getattr(st, "_owns_spill_dir", False)
-            st._owns_spill_dir = False
-        else:
-            new_store = upgrade_flat(st)
-        self._stores[name] = new_store
+        st.add_columns(new_ft, added)
         self._executors.pop(name, None)
+        self._plan_cache_clear(name)
         self.metadata[name]["spec"] = new_ft.spec()
         return new_ft
+
+    def add_attribute_index(self, name: str, attr: str) -> None:
+        """Enable an attribute index on an existing schema without
+        recreating it: builds ONLY the new sort permutation (per
+        partition, under the residency budget, for partitioned stores;
+        spilled partitions build theirs on next load). The reference
+        validates exactly this transition in updateSchema
+        (GeoMesaDataStore.scala:288-336)."""
+        st = self._store(name)
+        a = st.ft.attr(attr)
+        st.add_attribute_index(attr)
+        a.options["index"] = "true"  # so spec()/save()/load round-trips
+        # an explicit geomesa.indices list overrides the option-derived
+        # defaults in keyspaces_for_schema — it must name the attr kind
+        # or rebuilt/loaded child stores would silently drop the index
+        explicit = st.ft.user_data.get("geomesa.indices")
+        if explicit is not None:
+            kinds = [k.strip().lower() for k in explicit.split(",")
+                     if k.strip()]
+            if "attr" not in kinds:
+                st.ft.user_data["geomesa.indices"] = explicit + ",attr"
+        self._executors.pop(name, None)
+        self._plan_cache_clear(name)
+        self.metadata[name]["spec"] = st.ft.spec()
+
+    def remove_attribute_index(self, name: str, attr: str) -> None:
+        """Drop an attribute index (permutation + sketch); data untouched."""
+        st = self._store(name)
+        st.remove_attribute_index(attr)
+        st.ft.attr(attr).options.pop("index", None)
+        self._executors.pop(name, None)
+        self._plan_cache_clear(name)
+        self.metadata[name]["spec"] = st.ft.spec()
 
     def age_off(self, name: str, older_than) -> int:
         """Drop features older than a cutoff (AgeOffFilter/DtgAgeOffFilter
@@ -444,6 +405,15 @@ class GeoDataset:
                 cache.clear()
             cache[pkey] = plan
         return st, q, plan
+
+    def _plan_cache_clear(self, name: str) -> None:
+        """Drop cached plans for one schema (lifecycle changes bump the
+        store version too, so stale entries could never HIT — this just
+        releases them eagerly)."""
+        cache = self.__dict__.get("_plan_cache")
+        if cache:
+            for k in [k for k in cache if k[0] == name]:
+                del cache[k]
 
     def _audit(self, name: str, q: Query, plan, t_scan0: float, hits: int,
                op: str = "query"):
@@ -1069,11 +1039,54 @@ class GeoDataset:
         return self.insert(name, data, fids)
 
     # -- persistence (shard-manifest checkpoint, SURVEY.md §5) -------------
+    def _save_flat_chunks(self, path: str, name: str, st,
+                          prev_entry: Optional[dict]) -> dict:
+        """Incremental flat-store checkpoint (TableBasedMetadata
+        incrementality analog): the master batch is append-only between
+        non-append mutations (tracked by ``mutation_epoch``), so a
+        re-save after appends writes ONE new chunk covering the fresh
+        rows and leaves every existing chunk file untouched. Deletes /
+        column adds change the epoch and force a full rewrite."""
+        n = st._all.n if st._all is not None else 0
+        cdir = os.path.join(path, f"{name}_chunks")
+        prev = prev_entry.get("chunks") if prev_entry else None
+        incremental = (
+            prev is not None
+            and prev_entry.get("epoch") == st.mutation_epoch
+            and prev_entry.get("rows", -1) <= n
+            and all(os.path.exists(os.path.join(path, f)) for f in prev)
+        )
+        if not incremental:
+            if os.path.isdir(cdir):
+                shutil.rmtree(cdir)
+            legacy = os.path.join(path, f"{name}.npz")  # v1 layout
+            if os.path.exists(legacy):
+                os.remove(legacy)
+            chunks, lo = [], 0
+        else:
+            chunks, lo = list(prev), int(prev_entry["rows"])
+        os.makedirs(cdir, exist_ok=True)
+        if n > lo:
+            fname = f"{name}_chunks/chunk-{len(chunks):05d}-{lo}-{n}.npz"
+            cols = {
+                k: (v[lo:n].astype("U") if v.dtype.kind == "O"
+                    else v[lo:n])
+                for k, v in st._all.columns.items()
+            }
+            np.savez_compressed(os.path.join(path, fname), **cols)
+            chunks.append(fname)
+        return {"chunks": chunks, "rows": n, "epoch": st.mutation_epoch}
+
     def save(self, path: str):
         from geomesa_tpu.index.partitioned import PartitionedFeatureStore
 
         os.makedirs(path, exist_ok=True)
-        manifest = {"version": 1, "schemas": {}}
+        prev_manifest = {}
+        mpath = os.path.join(path, "manifest.json")
+        if os.path.exists(mpath):
+            with open(mpath) as fh:
+                prev_manifest = json.load(fh).get("schemas", {})
+        manifest = {"version": 2, "schemas": {}}
         for name, st in self._stores.items():
             st.flush()
             entry = {
@@ -1088,14 +1101,11 @@ class GeoDataset:
                 entry["partitions"] = {
                     str(b): os.path.relpath(d, path) for b, d in parts.items()
                 }
-            elif st._all is not None:
-                cols = {
-                    k: (v.astype("U") if v.dtype.kind == "O" else v)
-                    for k, v in st._all.columns.items()
-                }
-                np.savez_compressed(os.path.join(path, f"{name}.npz"), **cols)
+            else:
+                entry.update(self._save_flat_chunks(
+                    path, name, st, prev_manifest.get(name)))
             manifest["schemas"][name] = entry
-        with open(os.path.join(path, "manifest.json"), "w") as fh:
+        with open(mpath, "w") as fh:
             json.dump(manifest, fh, indent=2)
 
     @staticmethod
@@ -1118,22 +1128,37 @@ class GeoDataset:
                     for b, rel in meta["partitions"].items()
                 })
                 continue
-            npz_path = os.path.join(path, f"{name}.npz")
-            if os.path.exists(npz_path):
-                with np.load(npz_path, allow_pickle=False) as z:
+            # v2 chunked layout, with the v1 single-npz fallback
+            chunk_files = meta.get("chunks")
+            if chunk_files is None:
+                npz_path = os.path.join(path, f"{name}.npz")
+                chunk_files = ([os.path.relpath(npz_path, path)]
+                               if os.path.exists(npz_path) else [])
+            parts = []
+            for rel in chunk_files:
+                with np.load(os.path.join(path, rel),
+                             allow_pickle=False) as z:
                     cols = {}
                     for k in z.files:
                         v = z[k]
-                        cols[k] = v.astype(object) if v.dtype.kind == "U" else v
-                n = len(next(iter(cols.values()))) if cols else 0
-                st._all = ColumnBatch(cols, n)
-                key_cols = dict(cols)
+                        cols[k] = (v.astype(object) if v.dtype.kind == "U"
+                                   else v)
+                    if cols:
+                        parts.append(ColumnBatch(
+                            cols, len(next(iter(cols.values())))))
+            if parts:
+                st._all = (parts[0] if len(parts) == 1
+                           else ColumnBatch.concat(parts))
+                if "epoch" in meta:
+                    st.mutation_epoch = meta["epoch"]
+                key_cols = dict(st._all.columns)
                 for ks in st.keyspaces:
                     key_cols.update(ks.index_keys(ft, st._all))
                     st.tables[ks.name].rebuild(key_cols, st.dicts)
                 # seed the key cache so the next flush appends incrementally
                 st._key_cols = {
-                    k: v for k, v in key_cols.items() if k not in cols
+                    k: v for k, v in key_cols.items()
+                    if k not in st._all.columns
                 }
         ds.n_shards = None
         return ds

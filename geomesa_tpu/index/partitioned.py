@@ -28,6 +28,7 @@ import numpy as np
 
 from geomesa_tpu import config
 from geomesa_tpu.curves.binned_time import BinnedTime
+from geomesa_tpu.index.keyspace import AttributeKeySpace
 from geomesa_tpu.index.store import FeatureStore
 from geomesa_tpu.schema.columns import ColumnBatch
 from geomesa_tpu.schema.feature_type import FeatureType
@@ -270,6 +271,7 @@ class PartitionedFeatureStore(FeatureStore):
                 t.shard_bounds = np.linspace(
                     0, t.n, t.n_shards + 1
                 ).astype(np.int64)
+        self._upgrade_loaded(st, master)
         self.partitions[b] = st
         self.part_counts[b] = st.count
         # remember the snapshot dir: if the partition stays clean, a later
@@ -321,6 +323,76 @@ class PartitionedFeatureStore(FeatureStore):
             self.part_counts[b] = child.count
             self.evict()
         self.version += 1
+
+    def _upgrade_loaded(self, st: FeatureStore, master) -> None:
+        """Patch a freshly-loaded child whose snapshot predates a schema
+        or index change: null-fill missing attribute columns and build
+        missing index permutations — touching ONLY this partition, in
+        memory (the snapshot on disk is not rewritten; it upgrades for
+        real the next time this partition is dirtied)."""
+        from geomesa_tpu.schema.columns import null_columns
+
+        n = st._all.n if st._all is not None else 0
+        missing = [a for a in self.ft.attributes
+                   if not a.is_geom and a.name not in master]
+        if missing and n:
+            cols = null_columns(self.ft, missing, n, self.dicts)
+            master.update(cols)
+            st._all.columns.update(cols)
+        st.ft = self.ft
+        for t in st.tables.values():
+            t.ft = self.ft
+            if t.n == 0 and n:
+                st.build_missing_table(t)
+        # write-time sketches for indexed attrs the snapshot predates
+        for a in self.ft.attributes:
+            if a.indexed and not a.is_geom and a.type != "json":
+                st.ensure_attr_sketch(a.name)
+
+    # -- schema / index lifecycle -----------------------------------------
+    def add_columns(self, new_ft, added) -> None:
+        """In-place column append, partition-aware: resident children
+        upgrade immediately; spilled snapshots upgrade lazily on load
+        (``_load`` null-fills missing schema columns), so no partition is
+        rewritten — the O(dataset) re-flush r4 did here is gone."""
+        from geomesa_tpu.schema.columns import null_columns
+
+        self.flush()
+        self.ft = new_ft
+        null_columns(new_ft, added, 0, self.dicts)  # register encoders
+        for child in self.partitions.values():
+            child.add_columns(new_ft, added)
+        self.version += 1
+        self._merged_stats = None
+
+    def add_attribute_index(self, attr: str) -> None:
+        """Enable an attribute index: resident children build only the new
+        permutation; spilled partitions build theirs on next load (under
+        the residency budget). Snapshots are NOT dirtied — the new index
+        arrays rebuild per load until the partition is next written."""
+        a = self.ft.attr(attr)
+        if a.is_geom or a.type == "json":
+            raise ValueError(f"cannot attribute-index {attr!r} ({a.type})")
+        ks = AttributeKeySpace(attr, self.ft.geom_field, a.type)
+        if any(k.name == ks.name for k in self.keyspaces):
+            return
+        self.flush()
+        self.keyspaces.append(ks)
+        for child in self.partitions.values():
+            child.add_attribute_index(attr)
+        self.version += 1
+        self._merged_stats = None
+
+    def remove_attribute_index(self, attr: str) -> None:
+        name = f"attr:{attr}"
+        if not any(k.name == name for k in self.keyspaces):
+            raise KeyError(f"no attribute index on {attr!r}")
+        self.keyspaces = [k for k in self.keyspaces if k.name != name]
+        for child in self.partitions.values():
+            if name in child.tables:
+                child.remove_attribute_index(attr)
+        self.version += 1
+        self._merged_stats = None
 
     def delete(self, mask_fn) -> int:
         self.flush()

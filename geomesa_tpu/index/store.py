@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import itertools
 import threading
+import uuid
 from dataclasses import dataclass
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -389,6 +390,12 @@ class FeatureStore:
         self.stats = self._init_stats()
         #: bumped on every data mutation; keys cross-query kernel caches
         self.version = 0
+        #: changes whenever PERSISTED rows are rewritten (delete, column
+        #: adds) rather than appended; incremental checkpoints compare it
+        #: to decide between append-a-chunk and full rewrite. EVERY
+        #: mutation path that rewrites existing rows must call
+        #: :meth:`_bump_epoch`.
+        self.mutation_epoch = uuid.uuid4().hex
 
     def _init_stats(self) -> Dict[str, sk.Stat]:
         ft = self.ft
@@ -505,6 +512,113 @@ class FeatureStore:
             )
         self.version += 1
 
+    # -- schema / index lifecycle -----------------------------------------
+    def add_columns(self, new_ft: FeatureType, added) -> None:
+        """Append null-filled columns for ``added`` attributes IN PLACE —
+        no index key changes, so every table keeps its sort permutation
+        and only learns the new master columns (the O(1)-per-index path
+        GeoMesaDataStore.scala:288-336's append-only updateSchema implies;
+        r4 rebuilt + re-flushed the whole store here)."""
+        from geomesa_tpu.schema.columns import null_columns
+
+        self.flush()
+        self.ft = new_ft
+        n = self._all.n if self._all is not None else 0
+        cols = null_columns(new_ft, added, n, self.dicts)
+        self._bump_epoch()
+        if n:
+            self._all.columns.update(cols)
+        for t in self.tables.values():
+            t.ft = new_ft
+            if n:
+                t._master.update(cols)
+                t._device_cache.clear()
+        self.version += 1
+
+    def _bump_epoch(self) -> None:
+        """Mark persisted rows as rewritten: the next incremental
+        checkpoint must do a full rewrite, not append a chunk."""
+        self.mutation_epoch = uuid.uuid4().hex
+
+    def _attr_stat_key(self, attr: str) -> str:
+        a = self.ft.attr(attr)
+        return f"enum-{attr}" if a.type == "string" else f"minmax-{attr}"
+
+    def build_missing_table(self, t: IndexTable) -> None:
+        """Build an empty table's permutation from the master rows —
+        used both when an index is enabled on a live store and when a
+        partition snapshot predating the index is loaded. Only the
+        keyspace's own input columns are touched, so lazily-loaded
+        snapshots (_LazyCols) materialize one column, not the store."""
+        if self._all is None or not self._all.n:
+            return
+        ks = t.keyspace
+        fresh = ks.index_keys(self.ft, self._all)
+        self._key_cols.update(fresh)
+        needed = dict(fresh)
+        if isinstance(ks, AttributeKeySpace):
+            needed[ks.attr] = self._all.columns[ks.attr]
+        t.rebuild(needed, self.dicts)
+        # master lookup mapping for on-demand attribute gathers:
+        # share an existing table's (possibly lazy) master
+        other = next((ot for oname, ot in self.tables.items()
+                      if oname != ks.name and ot.n), None)
+        if other is not None:
+            base = other._master
+            for k, v in t._master.items():
+                if k not in base:
+                    base[k] = v
+            t._master = base
+        else:
+            merged = {**self._all.columns, **self._key_cols}
+            for k, v in t._master.items():
+                merged.setdefault(k, v)
+            t._master = merged
+
+    def ensure_attr_sketch(self, attr: str) -> None:
+        """Retroactively build the write-time sketch the cost model needs
+        for an attribute index, if absent."""
+        skey = self._attr_stat_key(attr)
+        if skey in self.stats:
+            return
+        a = self.ft.attr(attr)
+        stat = (sk.EnumerationStat(attr) if a.type == "string"
+                else sk.MinMax(attr))
+        if self._all is not None and self._all.n:
+            stat.observe(self._all.columns)
+        self.stats[skey] = stat
+
+    def add_attribute_index(self, attr: str) -> None:
+        """Enable an attribute index on a live schema: build ONLY the new
+        sort permutation over the existing master columns (the reference
+        validates such transitions in updateSchema,
+        GeoMesaDataStore.scala:288-336; r4 required a full re-create)."""
+        a = self.ft.attr(attr)
+        if a.is_geom or a.type == "json":
+            raise ValueError(f"cannot attribute-index {attr!r} ({a.type})")
+        ks = AttributeKeySpace(attr, self.ft.geom_field, a.type)
+        if ks.name in self.tables:
+            return  # already indexed
+        self.flush()
+        self.keyspaces.append(ks)
+        t = IndexTable(ks, self.ft, self.n_shards)
+        self.tables[ks.name] = t
+        self.build_missing_table(t)
+        self.ensure_attr_sketch(attr)
+        self.version += 1
+
+    def remove_attribute_index(self, attr: str) -> None:
+        """Drop an attribute index (permutation + key columns + sketch);
+        master data is untouched."""
+        name = f"attr:{attr}"
+        if name not in self.tables:
+            raise KeyError(f"no attribute index on {attr!r}")
+        del self.tables[name]
+        self.keyspaces = [k for k in self.keyspaces if k.name != name]
+        self._key_cols.pop(f"__attr_{attr}", None)
+        self.stats.pop(self._attr_stat_key(attr), None)
+        self.version += 1
+
     def wkt_geoms(self) -> List[str]:
         """Non-point geometry attributes stored WITH exact WKT (drives the
         Arrow field type for extent geometries)."""
@@ -526,6 +640,7 @@ class FeatureStore:
         keep_mask = ~mask
         keep = self._all.select(keep_mask)
         self._all = keep
+        self._bump_epoch()
         self.stats["count"] = sk.CountStat(keep.n)
         key_cols: Dict[str, np.ndarray] = dict(keep.columns)
         # filter the cached key columns with the same mask (per-row values)

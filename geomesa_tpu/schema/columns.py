@@ -322,3 +322,34 @@ def decode_batch(
         else:
             out[a.name] = batch.columns[a.name]
     return out
+
+
+def null_columns(ft, attrs, n: int, dicts) -> dict:
+    """Columns for ``attrs`` holding ``n`` nulls in this layout's null
+    representation (string -> code -1, float -> NaN, int/long -> 0,
+    bool -> False, date -> epoch 0 + derived bins; no validity bitmap in
+    the fixed-width columnar model). Shared by ``update_schema``'s
+    in-place column append and the partition snapshot's lazy schema
+    upgrade (GeoMesaDataStore.scala:288-336 parity)."""
+    from geomesa_tpu.curves.binned_time import BinnedTime
+
+    cols: dict = {}
+    for a in attrs:
+        if a.type == "string":
+            cols[a.name] = np.full(n, -1, np.int32)
+            dicts.setdefault(a.name, DictionaryEncoder())
+        elif a.type == "date":
+            cols[a.name] = np.zeros(n, np.int64)
+            bt = BinnedTime(ft.time_period)
+            b, off = bt.to_scaled(cols[a.name])
+            cols[a.name + "__bin"] = b
+            cols[a.name + "__off"] = off
+        elif a.type == "bool":
+            cols[a.name] = np.zeros(n, bool)
+        elif a.type == "json":
+            cols[a.name] = np.full(n, None, dtype=object)
+        elif a.type in ("float32", "float64"):
+            cols[a.name] = np.full(n, np.nan, np.dtype(a.type))
+        else:
+            cols[a.name] = np.zeros(n, np.dtype(a.type))
+    return cols

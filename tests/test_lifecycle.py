@@ -1,0 +1,221 @@
+"""Incremental schema & index lifecycle + incremental flat checkpoints.
+
+Reference parity: GeoMesaDataStore.scala:288-336 (updateSchema transition
+validation), TableBasedMetadata incrementality. Round-5 asks: add/remove
+an attribute index without recreating the store; update_schema without
+re-flushing rows; flat-store save() writing only new data.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.filter.ecql import parse_iso_ms
+
+SPEC = "name:String,weight:Double,dtg:Date,*geom:Point"
+PSPEC = SPEC + ";geomesa.partition='time'"
+
+
+def _data(n, seed=11):
+    rng = np.random.default_rng(seed)
+    return {
+        "name": [f"actor{i % 20}" for i in range(n)],
+        "weight": rng.uniform(0, 10, n),
+        "dtg": rng.integers(
+            parse_iso_ms("2020-01-01"), parse_iso_ms("2020-03-01"), n
+        ).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+    }
+
+
+def test_update_schema_is_in_place():
+    """Adding columns must not rebuild stores or re-sort indices: same
+    store object, same permutation arrays, version bumped."""
+    ds = GeoDataset(n_shards=2, prefer_device=False)
+    ds.create_schema("t", SPEC)
+    ds.insert("t", _data(2_000), fids=np.arange(2_000).astype(str))
+    ds.flush()
+    st = ds._store("t")
+    v0 = st.version
+    orders = {k: id(t.order) for k, t in st.tables.items()}
+    ds.update_schema("t", "extra:Integer,score:Float")
+    assert ds._store("t") is st              # no store rebuild
+    for k, t in st.tables.items():
+        assert id(t.order) == orders[k]      # no re-sort
+    assert st.version > v0
+    assert ds.count("t", "extra = 0") == 2_000
+    assert np.isnan(
+        ds.query("t", "INCLUDE").batch.columns["score"]).all()
+
+
+def test_add_remove_attribute_index_flat():
+    ds = GeoDataset(n_shards=2, prefer_device=False)
+    ds.create_schema("t", SPEC)
+    data = _data(5_000, seed=3)
+    ds.insert("t", data, fids=np.arange(5_000).astype(str))
+    ds.flush()
+    oracle = int((data["weight"] > 7.5).sum())
+    st = ds._store("t")
+    assert "attr:weight" not in st.tables
+    ds.add_attribute_index("t", "weight")
+    assert "attr:weight" in st.tables
+    # the planner now has the index AND its cost sketch
+    ex = ds.explain("t", "weight > 7.5")
+    assert "attr:weight" in ex
+    assert ds.count("t", "weight > 7.5") == oracle
+    # string attr index too (rank vocab path)
+    ds.add_attribute_index("t", "name")
+    assert ds.count("t", "name = 'actor7'") == 250
+    # spec round-trips the index option
+    assert "index=true" in ds.get_schema("t").spec()
+    ds.remove_attribute_index("t", "weight")
+    assert "attr:weight" not in st.tables
+    assert ds.count("t", "weight > 7.5") == oracle  # falls back, correct
+    with pytest.raises(KeyError):
+        ds.remove_attribute_index("t", "weight")
+
+
+def test_add_index_after_more_appends():
+    """Index added mid-life stays correct across subsequent flushes."""
+    ds = GeoDataset(n_shards=2, prefer_device=False)
+    ds.create_schema("t", SPEC)
+    d1 = _data(2_000, seed=5)
+    ds.insert("t", d1, fids=np.arange(2_000).astype(str))
+    ds.flush()
+    ds.add_attribute_index("t", "weight")
+    d2 = _data(2_000, seed=6)
+    ds.insert("t", d2, fids=(np.arange(2_000) + 2_000).astype(str))
+    ds.flush()
+    oracle = int((d1["weight"] > 5).sum() + (d2["weight"] > 5).sum())
+    assert ds.count("t", "weight > 5") == oracle
+
+
+def test_add_index_partitioned_touches_only_index_arrays(tmp_path):
+    """Enabling an index on a 10-partition store must not rewrite any
+    partition snapshot (mtime-asserted), and spilled partitions build
+    their permutation lazily on load."""
+    data = _data(10_000, seed=7)
+    ds = GeoDataset(n_shards=2, prefer_device=False)
+    ds.create_schema("t", PSPEC)
+    st = ds._store("t")
+    st.max_resident = 2
+    st._spill_dir = str(tmp_path / "spill")
+    ds.insert("t", data, fids=np.arange(10_000).astype(str))
+    ds.flush()
+    p = str(tmp_path / "ckpt")
+    ds.save(p)
+    snap = {}
+    for root, _, files in os.walk(p):
+        for f in files:
+            fp = os.path.join(root, f)
+            snap[fp] = os.path.getmtime(fp)
+    ds.add_attribute_index("t", "weight")
+    ds.save(p)
+    touched = []
+    for fp, m in snap.items():
+        if os.path.getmtime(fp) != m and not fp.endswith("manifest.json"):
+            touched.append(fp)
+    assert touched == [], f"data files rewritten: {touched}"
+    oracle = int((data["weight"] > 7.5).sum())
+    assert ds.count("t", "weight > 7.5") == oracle
+    # full round trip through the checkpoint keeps the index
+    ds2 = GeoDataset.load(p, prefer_device=False)
+    assert "attr:weight" in [k.name for k in ds2._store("t").keyspaces]
+    assert ds2.count("t", "weight > 7.5") == oracle
+
+
+def test_update_schema_partitioned_lazy_upgrade(tmp_path):
+    """update_schema must not rewrite partition snapshots; spilled
+    partitions null-fill the new columns when next loaded."""
+    data = _data(6_000, seed=9)
+    ds = GeoDataset(n_shards=2, prefer_device=False)
+    ds.create_schema("t", PSPEC)
+    st = ds._store("t")
+    st.max_resident = 1
+    st._spill_dir = str(tmp_path / "spill")
+    ds.insert("t", data, fids=np.arange(6_000).astype(str))
+    ds.flush()
+    st.evict(keep=1)
+    snaps = {
+        d: os.path.getmtime(os.path.join(d, "data.npz"))
+        for d in (os.path.join(st._spill_dir, f) for f in
+                  os.listdir(st._spill_dir))
+        if os.path.isdir(d)
+    }
+    assert len(snaps) >= 2
+    ds.update_schema("t", "extra:Integer,tag:String")
+    for d, m in snaps.items():
+        assert os.path.getmtime(os.path.join(d, "data.npz")) == m
+    assert ds.count("t", "extra = 0") == 6_000  # loads + null-fills lazily
+
+
+def test_flat_incremental_checkpoint(tmp_path):
+    """save -> append -> save writes only a new chunk; delete forces a
+    full rewrite (mutation epoch change); loads stay correct."""
+    ds = GeoDataset(n_shards=2, prefer_device=False)
+    ds.create_schema("t", SPEC)
+    ds.insert("t", _data(3_000, seed=1), fids=np.arange(3_000).astype(str))
+    ds.flush()
+    p = str(tmp_path / "ckpt")
+    ds.save(p)
+    cdir = os.path.join(p, "t_chunks")
+    first = sorted(os.listdir(cdir))
+    assert len(first) == 1
+    m0 = os.path.getmtime(os.path.join(cdir, first[0]))
+    # append-only growth: second save leaves chunk 0 untouched
+    ds.insert("t", _data(1_000, seed=2),
+              fids=(np.arange(1_000) + 3_000).astype(str))
+    ds.flush()
+    ds.save(p)
+    now = sorted(os.listdir(cdir))
+    assert len(now) == 2
+    assert os.path.getmtime(os.path.join(cdir, first[0])) == m0
+    ds2 = GeoDataset.load(p, prefer_device=False)
+    assert ds2.count("t") == 4_000
+    assert ds2.count("t", "weight > 5") == ds.count("t", "weight > 5")
+    # idempotent save with no changes writes nothing new
+    ds.save(p)
+    assert sorted(os.listdir(cdir)) == now
+    # a delete rewrites (epoch changed) and drops stale chunks
+    ds.delete_features("t", "weight > 5")
+    ds.save(p)
+    after = sorted(os.listdir(cdir))
+    assert len(after) == 1 and after[0] not in now
+    ds3 = GeoDataset.load(p, prefer_device=False)
+    assert ds3.count("t") == ds.count("t")
+    # loaded store saves incrementally too (epoch round-trips)
+    ds3.insert("t", _data(500, seed=4),
+               fids=(np.arange(500) + 10_000).astype(str))
+    ds3.flush()
+    ds3.save(p)
+    names = sorted(os.listdir(cdir))
+    assert len(names) == 2
+
+
+def test_add_index_with_explicit_indices_list(tmp_path):
+    """Review r5: an explicit geomesa.indices list must learn the attr
+    kind, or loaded/rebuilt child stores silently drop the new index
+    (reproduced as KeyError on spilled-partition queries)."""
+    data = _data(6_000, seed=15)
+    spec = SPEC + ";geomesa.partition='time',geomesa.indices='z3,id'"
+    ds = GeoDataset(n_shards=2, prefer_device=False)
+    ds.create_schema("t", spec)
+    st = ds._store("t")
+    st.max_resident = 1
+    st._spill_dir = str(tmp_path / "spill")
+    ds.insert("t", data, fids=np.arange(6_000).astype(str))
+    ds.flush()
+    st.evict(keep=1)
+    ds.add_attribute_index("t", "weight")
+    assert "attr" in ds.get_schema("t").user_data["geomesa.indices"]
+    oracle = int((data["weight"] > 7.5).sum())
+    assert ds.count("t", "weight > 7.5") == oracle  # loads spilled parts
+    # flat variant: save/load keeps the index
+    p = str(tmp_path / "ckpt")
+    ds.save(p)
+    ds2 = GeoDataset.load(p, prefer_device=False)
+    assert "attr:weight" in [k.name for k in ds2._store("t").keyspaces]
+    assert ds2.count("t", "weight > 7.5") == oracle
