@@ -571,6 +571,311 @@ def _isin_fn(col: str, codes: np.ndarray):
     return fn
 
 
+# -- expression comparisons (ExprCompare) --------------------------------
+
+def _expr_mark_needs(node: "ir.ExprCompare", ft: FeatureType,
+                     need, need_refine) -> bool:
+    """Register column needs for an expression comparison; returns True
+    when the expression can only be evaluated on the host (functions,
+    strings, or geometry-valued properties)."""
+    host_only = ir.expr_has_fn(node.left) or ir.expr_has_fn(node.right)
+    for p in node.props():
+        a = ft.attr(p)  # raises KeyError naming unknown attributes
+        if a.is_geom:
+            host_only = True
+            if a.is_point:
+                need(p + "__x", p + "__y")
+            else:
+                need_refine(p + "__wkt")
+        elif a.type == "json":
+            raise ValueError(
+                f"json attribute {p!r} cannot appear in an expression; "
+                "query it via jsonPath('$...', attr) instead"
+            )
+        elif a.type == "string":
+            host_only = True
+            need(p)
+        else:
+            need(p)
+    return host_only
+
+
+def _expr_resolve_fn(name: str):
+    from geomesa_tpu import geofn
+
+    fn = getattr(geofn, name, None)
+    if fn is None and not name.startswith("st_"):
+        fn = getattr(geofn, "st_" + name, None)
+    if fn is None or not callable(fn):
+        raise ValueError(
+            f"unknown filter function {name!r} (available: geofn st_*)"
+        )
+    return fn
+
+
+def _expr_eval_exact(e: "ir.Expr", ft: FeatureType,
+                     dicts: Dict[str, DictionaryEncoder], cols, n: int):
+    """Exact host evaluation -> f64 ndarray, object ndarray (strings /
+    geometries), or a scalar for literal subtrees."""
+    if isinstance(e, ir.Lit):
+        return e.value
+    if isinstance(e, ir.Prop):
+        a = ft.attr(e.name)
+        if a.is_geom:
+            if a.is_point:
+                x = np.asarray(cols[e.name + "__x"], np.float64)
+                y = np.asarray(cols[e.name + "__y"], np.float64)
+                out = np.empty(len(x), dtype=object)
+                for i in range(len(x)):
+                    out[i] = geo.Point(float(x[i]), float(y[i]))
+                return out
+            wkt = cols[e.name + "__wkt"]
+            out = np.empty(len(wkt), dtype=object)
+            for i, w in enumerate(wkt):
+                out[i] = None if w is None else geo.parse_wkt(str(w))
+            return out
+        if a.type == "string":
+            d = dicts.setdefault(e.name, DictionaryEncoder())
+            codes = np.asarray(cols[e.name])
+            vocab = np.array(list(d.values) + [None], dtype=object)
+            return vocab[np.where(codes >= 0, codes, len(d.values))]
+        col = np.asarray(cols[e.name])
+        if col.dtype.kind in "iu":
+            # int64 stays exact (a float64 cast corrupts > 2^53 — the
+            # legacy Compare path reads the i64 master column exactly)
+            return col.astype(np.int64, copy=False)
+        return np.asarray(col, np.float64)
+    if isinstance(e, ir.Arith):
+        left = _expr_eval_exact(e.left, ft, dicts, cols, n)
+        right = _expr_eval_exact(e.right, ft, dicts, cols, n)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            if e.op == "+":
+                return left + right
+            if e.op == "-":
+                return left - right
+            if e.op == "*":
+                return left * right
+            return left / right
+    if isinstance(e, ir.FnCall):
+        fn = _expr_resolve_fn(e.name)
+        # point-geometry property args keep their raw (x, y) column form
+        # so vectorized geofn paths can run in one call instead of a
+        # Python Point object per row
+        xy_forms: Dict[int, tuple] = {}
+        args: list = []
+        for i, a in enumerate(e.args):
+            if isinstance(a, ir.Prop) and ft.has(a.name) \
+                    and ft.attr(a.name).is_point:
+                xy_forms[i] = (
+                    np.asarray(cols[a.name + "__x"], np.float64),
+                    np.asarray(cols[a.name + "__y"], np.float64),
+                )
+                args.append(None)  # object array built lazily below
+            else:
+                args.append(_expr_eval_exact(a, ft, dicts, cols, n))
+        if not xy_forms and not any(
+                isinstance(a, np.ndarray) for a in args):
+            # pure-literal subtree (e.g. st_geomFromWKT('...')): one call,
+            # result may be a geometry, number, or string
+            return fn(*args)
+        # distance functions are symmetric, and geofn vectorizes their
+        # SECOND argument as an (xs, ys) tuple: one haversine call for
+        # the whole window instead of a per-row loop
+        if e.name in ("st_distance", "st_distanceSphere",
+                      "st_distanceSpheroid") \
+                and len(e.args) == 2 and len(xy_forms) == 1:
+            i = next(iter(xy_forms))
+            other = args[1 - i]
+            if not isinstance(other, np.ndarray):
+                try:
+                    out = fn(other, xy_forms[i])
+                    out = np.asarray(out, np.float64)
+                    if out.shape == (n,):
+                        return out
+                except Exception:
+                    pass
+        if xy_forms:
+            # some geofn functions take (xs, ys) tuples directly
+            try:
+                out = fn(*[xy_forms.get(i, v) for i, v in enumerate(args)])
+                if isinstance(out, np.ndarray) and out.shape[:1] == (n,):
+                    return (out if out.dtype.kind == "O"
+                            else np.asarray(out, np.float64))
+            except Exception:
+                pass
+            for i, (x, y) in xy_forms.items():
+                pts = np.empty(n, dtype=object)
+                for j in range(n):
+                    pts[j] = geo.Point(float(x[j]), float(y[j]))
+                args[i] = pts
+        try:
+            out = fn(*args)
+            if isinstance(out, np.ndarray) and out.shape[:1] == (n,):
+                return (out if out.dtype.kind == "O"
+                        else np.asarray(out, np.float64))
+        except Exception:
+            pass
+        # scalar function: map row-wise over the array arguments
+        vals = np.empty(n, dtype=object)
+        for i in range(n):
+            row = [a[i] if isinstance(a, np.ndarray) else a for a in args]
+            if any(r is None for r in row):
+                continue
+            try:
+                vals[i] = fn(*row)
+            except Exception:
+                pass  # per-row failure -> null -> row excluded
+        try:
+            return np.array(
+                [np.nan if v is None else float(v) for v in vals],
+                np.float64)
+        except (TypeError, ValueError):
+            return vals  # geometry/string-valued results stay objects
+    raise ValueError(f"cannot evaluate expression node {e!r}")
+
+
+def _expr_exact_fn(node: "ir.ExprCompare", ft: FeatureType,
+                   dicts: Dict[str, DictionaryEncoder]):
+    op = node.op
+
+    def fn(cols, xp=np):
+        probe = None
+        for p in node.props():
+            a = ft.attr(p)
+            key = p + "__x" if a.is_point else (
+                p + "__wkt" if a.is_geom else p)
+            if key in cols:
+                probe = cols[key]
+                break
+        if probe is None:
+            raise ValueError(
+                f"expression references no resolvable column: {node!r}")
+        n = len(probe)
+        left = _expr_eval_exact(node.left, ft, dicts, cols, n)
+        right = _expr_eval_exact(node.right, ft, dicts, cols, n)
+        lobj = isinstance(left, np.ndarray) and left.dtype.kind == "O"
+        robj = isinstance(right, np.ndarray) and right.dtype.kind == "O"
+        if lobj or robj or isinstance(left, str) or isinstance(right, str):
+            if op not in ("=", "<>"):
+                raise ValueError(
+                    f"ordering comparison {op!r} is not defined for "
+                    "string/geometry expressions"
+                )
+            la = left if isinstance(left, np.ndarray) else np.full(
+                n, left, dtype=object)
+            ra = right if isinstance(right, np.ndarray) else np.full(
+                n, right, dtype=object)
+            valid = np.array([a is not None and b is not None
+                              for a, b in zip(la, ra)])
+            eqm = np.array([a == b for a, b in zip(la, ra)], dtype=bool)
+            return (eqm if op == "=" else ~eqm) & valid
+        lint = (np.asarray(left).dtype.kind in "iub"
+                if isinstance(left, np.ndarray)
+                else isinstance(left, (int, np.integer)))
+        rint = (np.asarray(right).dtype.kind in "iub"
+                if isinstance(right, np.ndarray)
+                else isinstance(right, (int, np.integer)))
+        if lint and rint:
+            # pure-integer comparison stays in int64 (exact beyond 2^53)
+            left = np.asarray(left, np.int64)
+            right = np.asarray(right, np.int64)
+            valid = np.asarray(True)
+        else:
+            left = np.asarray(left, np.float64)
+            right = np.asarray(right, np.float64)
+            valid = ~(np.isnan(left) | np.isnan(right))
+        if op == "=":
+            m = left == right
+        elif op == "<>":
+            m = left != right
+        elif op == "<":
+            m = left < right
+        elif op == "<=":
+            m = left <= right
+        elif op == ">":
+            m = left > right
+        else:
+            m = left >= right
+        return m & valid
+
+    return fn
+
+
+#: relative f32 ulp with a 4x safety factor absorbing the error
+#: arithmetic's own rounding
+_EXPR_EPS = 4.0 * 2.0 ** -23
+
+
+def _expr_eval_coarse(e: "ir.Expr", cols, xp):
+    """f32 interval evaluation -> (value, absolute error bound)."""
+    if isinstance(e, ir.Lit):
+        v = float(e.value)
+        return v, abs(v) * _EXPR_EPS
+    if isinstance(e, ir.Prop):
+        v = xp.asarray(cols[e.name]) * 1.0  # promote int/bool to float
+        return v, xp.abs(v) * _EXPR_EPS
+    if isinstance(e, ir.Arith):
+        lv, le = _expr_eval_coarse(e.left, cols, xp)
+        rv, re_ = _expr_eval_coarse(e.right, cols, xp)
+        if e.op == "+":
+            v = lv + rv
+            return v, le + re_ + xp.abs(v) * _EXPR_EPS
+        if e.op == "-":
+            v = lv - rv
+            return v, le + re_ + xp.abs(v) * _EXPR_EPS
+        if e.op == "*":
+            v = lv * rv
+            return v, (xp.abs(lv) * re_ + xp.abs(rv) * le + le * re_
+                       + xp.abs(v) * _EXPR_EPS)
+        # division: denominator interval must exclude zero, else the
+        # bound is infinite (row stays a candidate)
+        v = lv / rv
+        den = xp.maximum(xp.abs(rv) - re_, 0.0)
+        err = xp.where(
+            den > 0,
+            (le + xp.abs(v) * re_) / xp.maximum(den, 1e-30)
+            + xp.abs(v) * _EXPR_EPS,
+            xp.asarray(xp.inf),
+        )
+        return v, err
+    raise ValueError(f"cannot device-evaluate expression node {e!r}")
+
+
+def _expr_coarse_fn(node: "ir.ExprCompare", neg: bool):
+    """Device prefilter: superset of exact matches under even NOT-polarity
+    (include every possibly-true row), subset under odd (only certainly-
+    true rows). NaN rows compare False either way — matching the exact
+    tree's validity mask."""
+    op = node.op
+
+    def fn(cols, xp):
+        lv, le = _expr_eval_coarse(node.left, cols, xp)
+        rv, re_ = _expr_eval_coarse(node.right, cols, xp)
+        slack = le + re_
+        if not neg:  # possibly true
+            if op == "=":
+                return xp.abs(lv - rv) <= slack
+            if op == "<>":
+                return ~((xp.abs(lv - rv) == 0) & (slack == 0))
+            if op in ("<", "<="):
+                return lv - slack <= rv
+            return lv + slack >= rv
+        # certainly true (mask will be inverted by the NOT above)
+        if op == "=":
+            return (xp.abs(lv - rv) == 0) & (slack == 0)
+        if op == "<>":
+            return xp.abs(lv - rv) > slack
+        if op == "<":
+            return lv + slack < rv
+        if op == "<=":
+            return lv + slack <= rv
+        if op == ">":
+            return lv - slack > rv
+        return lv - slack >= rv
+
+    return fn
+
+
 def compile_filter(
     f: ir.Filter,
     ft: FeatureType,
@@ -1117,6 +1422,24 @@ def compile_filter(
                 return np.isin(fids, q)
 
             return fid_mask
+
+        if isinstance(node, ir.ExprCompare):
+            # property-vs-property / arithmetic / st_* function comparisons
+            # (FastFilterFactory.scala:395 parity). Exact semantics live on
+            # the host refine pass; function-free numeric expressions also
+            # get an ERROR-BOUNDED f32 device prefilter (interval
+            # arithmetic: every emitted coarse mask is a provable superset
+            # of the exact matches under even NOT-polarity, a subset under
+            # odd — same contract as the f32 box compares above).
+            host_only = _expr_mark_needs(node, ft, need, need_refine)
+            if exact:
+                return _expr_exact_fn(node, ft, dicts)
+            need_refine(None)
+            if host_only:
+                # device cannot evaluate (functions / strings / extent
+                # geometries): pass every candidate to the host refine
+                return _FALSE if neg else (lambda cols, xp: xp.asarray(True))
+            return _expr_coarse_fn(node, neg)
 
         raise ValueError(f"cannot compile filter node: {node!r}")
 

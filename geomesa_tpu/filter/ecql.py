@@ -14,7 +14,17 @@ geomesa-filter/.../factory/FastFilterFactory.scala):
     dtg DURING t1/t2 | dtg BEFORE t | dtg AFTER t | dtg TEQUALS t
     IN ('id1', 'id2')              -- feature-id filter
     AND / OR / NOT, parentheses
+    expr CMP expr                  -- property-vs-property / arithmetic /
+                                   -- function comparisons
+                                   -- (FastFilterFactory.scala:395 parity):
+        speed > heading
+        weight * 2 < limit
+        (a + b) * 2 >= c - 1
+        st_area(geom) > 0.5
+        st_distanceSphere(geom, st_geomFromWKT('POINT (0 0)')) < 1e5
+    jsonPath('$.a.b', attr) CMP literal
 
+Functions resolve against :mod:`geomesa_tpu.geofn`'s st_* library.
 Dates are ISO-8601 (bare or quoted); bare date tokens are recognized lexically.
 """
 
@@ -37,7 +47,7 @@ _TOKEN_RE = re.compile(
             r"(?P<num>[-+]?\d+\.?\d*(?:[eE][-+]?\d+)?)",
             r"(?P<str>'(?:[^']|'')*')",
             r"(?P<op><=|>=|<>|!=|=|<|>)",
-            r"(?P<sym>[(),/])",
+            r"(?P<sym>[(),/*+\-])",
             r"(?P<id>[A-Za-z_][A-Za-z0-9_.:]*)",
             r"(?P<ws>\s+)",
         ]
@@ -144,10 +154,17 @@ class _Parser:
             return ir.Not(self.factor())
         t = self.peek()
         if t and t.kind == "sym" and t.text == "(":
-            self.next()
-            e = self.expr()
-            self.expect("sym", ")")
-            return e
+            # '(' opens either a boolean group or an arithmetic group
+            # ('(a + b) * 2 >= c'): try boolean, backtrack to the
+            # expression-led predicate parse on failure
+            mark = self.pos
+            try:
+                self.next()
+                e = self.expr()
+                self.expect("sym", ")")
+                return e
+            except ValueError:
+                self.pos = mark
         return self.predicate()
 
     # -- literals ---------------------------------------------------------
@@ -186,6 +203,108 @@ class _Parser:
                 parts.append(nt.text)
             return geo.parse_wkt(tag + " " + " ".join(parts))
         raise ValueError(f"ECQL: expected WKT geometry, got {t!r}")
+
+    # -- scalar expressions (FastFilterFactory.scala:395 parity) ----------
+    @staticmethod
+    def _mk_arith(op: str, left, right):
+        """Build an Arith node; jsonPath() refs cannot ride arithmetic,
+        and literal-only subtrees fold to a literal (so 'speed < 1 + 1'
+        and unary minus keep the legacy Compare IR + its pushdown)."""
+        for side in (left, right):
+            if isinstance(side, ir.JsonPath):
+                raise ValueError(
+                    "jsonPath() cannot appear inside arithmetic "
+                    "expressions; compare it directly against a literal"
+                )
+        if isinstance(left, ir.Lit) and isinstance(right, ir.Lit) \
+                and isinstance(left.value, (int, float, np.integer)) \
+                and isinstance(right.value, (int, float, np.integer)):
+            lv, rv = left.value, right.value
+            if op == "+":
+                return ir.Lit(lv + rv)
+            if op == "-":
+                return ir.Lit(lv - rv)
+            if op == "*":
+                return ir.Lit(lv * rv)
+            if rv != 0:
+                v = lv / rv
+                return ir.Lit(int(v) if isinstance(lv, (int, np.integer))
+                              and isinstance(rv, (int, np.integer))
+                              and v == int(v) else v)
+        return ir.Arith(op, left, right)
+
+    # additive := multiplicative (('+'|'-') multiplicative)*
+    def expr_operand(self):
+        left = self.expr_mul()
+        while True:
+            t = self.peek()
+            if t and t.kind == "sym" and t.text in "+-":
+                self.next()
+                left = self._mk_arith(t.text, left, self.expr_mul())
+            elif t and t.kind == "num" and t.text[0] in "+-":
+                # 'a -5' lexes the sign into the number: it is really a
+                # binary minus (a + (-5))
+                self.next()
+                v = float(t.text)
+                v = int(v) if v.is_integer() and "." not in t.text else v
+                left = self._mk_arith("+", left, ir.Lit(v))
+            else:
+                return left
+
+    def expr_mul(self):
+        left = self.expr_unary()
+        while True:
+            t = self.peek()
+            if t and t.kind == "sym" and t.text in "*/":
+                self.next()
+                left = self._mk_arith(t.text, left, self.expr_unary())
+            else:
+                return left
+
+    def expr_unary(self):
+        t = self.peek()
+        if t is None:
+            raise ValueError("ECQL: expected expression operand")
+        if t.kind == "sym" and t.text == "(":
+            self.next()
+            e = self.expr_operand()
+            self.expect("sym", ")")
+            return e
+        if t.kind == "sym" and t.text == "-":
+            self.next()
+            return self._mk_arith("-", ir.Lit(0), self.expr_unary())
+        if t.kind in ("num", "str", "date"):
+            return ir.Lit(self.literal())
+        if t.kind == "id":
+            name = self.next().text
+            if name.lower() in ("true", "false"):
+                return ir.Lit(name.lower() == "true")
+            nt = self.peek()
+            if nt and nt.kind == "sym" and nt.text == "(":
+                if name.lower() == "jsonpath":
+                    self.next()
+                    path = str(self.literal())
+                    self.expect("sym", ",")
+                    attr = self.expect("id").text
+                    self.expect("sym", ")")
+                    return ir.JsonPath(attr, path)
+                self.next()
+                args = []
+                if not self.accept("sym", ")"):
+                    while True:
+                        a = self.expr_operand()
+                        if isinstance(a, ir.JsonPath):
+                            raise ValueError(
+                                "jsonPath() cannot be a function argument;"
+                                " compare it directly against a literal"
+                            )
+                        args.append(a)
+                        if not self.accept("sym", ","):
+                            break
+                    self.expect("sym", ")")
+                return ir.FnCall(name, tuple(args))
+            return ir.Prop(name)
+        raise ValueError(f"ECQL: expected expression operand, got {t!r}")
 
     # -- predicates -------------------------------------------------------
     def predicate(self) -> ir.Filter:
@@ -254,21 +373,49 @@ class _Parser:
                         break
                 self.expect("sym", ")")
                 return ir.IdIn(tuple(ids))
-        # property-led predicates; jsonPath('$.a.b', attr) is a property
-        # reference into a stored-JSON attribute
-        prop = self.expect("id").text
-        if prop.lower() == "jsonpath" and self.accept("sym", "("):
-            path = str(self.literal())
-            self.expect("sym", ",")
-            attr = self.expect("id").text
-            self.expect("sym", ")")
-            prop = ir.JsonPath(attr, path)
+        # property-led predicates: the LHS is a full scalar expression
+        # (property, jsonPath(), arithmetic, st_* function call); plain
+        # property-vs-literal forms keep the legacy Compare IR (and all
+        # its device pushdown), anything richer becomes ExprCompare
+        lhs = self.expr_operand()
+        if isinstance(lhs, ir.JsonPath):
+            prop = lhs
+        elif isinstance(lhs, ir.Prop):
+            prop = lhs.name
+        else:
+            prop = None  # expression: comparison operators only
         t = self.peek()
         if t and t.kind == "op":
             op = self.next().text
             if op == "!=":
                 op = "<>"
-            return ir.Compare(prop, op, self.literal())
+            rhs = self.expr_operand()
+            if prop is not None and isinstance(rhs, ir.Lit):
+                return ir.Compare(prop, op, rhs.value)
+            if isinstance(lhs, ir.Lit) and isinstance(rhs, ir.Prop):
+                flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<="}
+                return ir.Compare(rhs.name, flip.get(op, op), lhs.value)
+            if isinstance(lhs, ir.JsonPath) or isinstance(rhs, ir.JsonPath):
+                raise ValueError(
+                    "jsonPath() comparisons support literal operands only"
+                )
+            if isinstance(lhs, ir.Lit) and isinstance(rhs, ir.Lit):
+                # constant comparison folds at parse time ('1 + 1 = 2')
+                table = {
+                    "=": lhs.value == rhs.value,
+                    "<>": lhs.value != rhs.value,
+                    "<": lhs.value < rhs.value,
+                    "<=": lhs.value <= rhs.value,
+                    ">": lhs.value > rhs.value,
+                    ">=": lhs.value >= rhs.value,
+                }
+                return ir.Include() if table[op] else ir.Exclude()
+            return ir.ExprCompare(op, lhs, rhs)
+        if prop is None:
+            raise ValueError(
+                f"ECQL: expression must be followed by a comparison "
+                f"operator in {self.text!r}"
+            )
         if t and t.kind == "kw":
             kw = self.next().text
             if kw == "BETWEEN":

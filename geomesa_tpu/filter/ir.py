@@ -103,6 +103,79 @@ class Compare(Filter):
     value: object  # float | int | str | np.int64 epoch-ms for dates
 
 
+# -- expression trees (FastFilterFactory.scala:395 parity: arbitrary
+# GeoTools expressions — property-vs-property, arithmetic, functions) ----
+
+@dataclass(frozen=True)
+class Expr:
+    """Scalar expression node (the GeoTools Expression analog)."""
+
+
+@dataclass(frozen=True)
+class Prop(Expr):
+    name: str
+
+
+@dataclass(frozen=True)
+class Lit(Expr):
+    value: object
+
+
+@dataclass(frozen=True)
+class Arith(Expr):
+    """Binary arithmetic: + - * /"""
+
+    op: str
+    left: Expr
+    right: Expr
+
+
+@dataclass(frozen=True)
+class FnCall(Expr):
+    """Filter-function call, e.g. ``st_area(geom)`` (the GeoTools
+    FilterFunction surface; resolved against geofn's st_* library)."""
+
+    name: str
+    args: Tuple[Expr, ...]
+
+
+def expr_props(e: Expr) -> List[str]:
+    """Attribute names referenced by an expression tree."""
+    if isinstance(e, Prop):
+        return [e.name]
+    if isinstance(e, Arith):
+        return expr_props(e.left) + expr_props(e.right)
+    if isinstance(e, FnCall):
+        out: List[str] = []
+        for a in e.args:
+            out.extend(expr_props(a))
+        return out
+    return []
+
+
+def expr_has_fn(e: Expr) -> bool:
+    if isinstance(e, FnCall):
+        return True
+    if isinstance(e, Arith):
+        return expr_has_fn(e.left) or expr_has_fn(e.right)
+    return False
+
+
+@dataclass(frozen=True)
+class ExprCompare(Filter):
+    """Comparison where either side is a non-trivial expression:
+    ``speed > heading``, ``weight * 2 < limit``, ``st_area(geom) > 0.5``.
+    Compiles to an exact host mask (+ an error-bounded f32 device
+    prefilter when function-free)."""
+
+    op: str  # = <> < <= > >=
+    left: Expr
+    right: Expr
+
+    def props(self) -> List[str]:
+        return expr_props(self.left) + expr_props(self.right)
+
+
 @dataclass(frozen=True)
 class Between(Filter):
     prop: str
@@ -302,6 +375,10 @@ def props_referenced(f: Filter) -> List[str]:
                 walk(c)
         elif isinstance(node, Not):
             walk(node.child)
+        elif isinstance(node, ExprCompare):
+            for p in node.props():
+                if p not in out:
+                    out.append(p)
         elif hasattr(node, "prop"):
             p = node.prop
             if isinstance(p, JsonPath):

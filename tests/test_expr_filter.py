@@ -1,0 +1,199 @@
+"""Expression filter grammar: property-vs-property, arithmetic, st_*
+function calls (FastFilterFactory.scala:395 parity — arbitrary GeoTools
+expressions; r4's grammar was a fixed predicate set)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.filter.ecql import parse_ecql
+from geomesa_tpu.filter import ir
+
+
+def _ds(n=20_000, seed=0):
+    rng = np.random.default_rng(seed)
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema(
+        "t", "speed:Double,heading:Double,weight:Float,limit:Double,"
+             "a:String,b:String,dtg:Date,*geom:Point")
+    data = {
+        "speed": rng.uniform(0, 100, n),
+        "heading": rng.uniform(0, 100, n),
+        "weight": rng.uniform(0, 10, n).astype(np.float32),
+        "limit": rng.uniform(0, 20, n),
+        "a": rng.choice(["x", "y", "z"], n),
+        "b": rng.choice(["x", "y"], n),
+        "dtg": rng.integers(1577836800000, 1580515200000, n
+                            ).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+    }
+    ds.insert("t", data, fids=np.arange(n).astype(str))
+    ds.flush()
+    return ds, data
+
+
+def test_parse_shapes():
+    f = parse_ecql("speed > heading")
+    assert isinstance(f, ir.ExprCompare)
+    f = parse_ecql("weight * 2 < limit")
+    assert isinstance(f.left, ir.Arith) and f.left.op == "*"
+    f = parse_ecql("(a + b) * 2 >= c - 1")
+    assert isinstance(f.left, ir.Arith) and f.left.op == "*"
+    f = parse_ecql("st_area(geom) > 0.5")
+    assert isinstance(f.left, ir.FnCall) and f.left.name == "st_area"
+    # legacy forms keep the legacy IR (device pushdown intact)
+    assert isinstance(parse_ecql("speed > 5"), ir.Compare)
+    assert isinstance(parse_ecql("5 < speed"), ir.Compare)
+    # boolean vs arithmetic parens disambiguate by backtracking
+    f = parse_ecql("(speed > 5) AND (heading < speed)")
+    assert isinstance(f, ir.And)
+
+
+def test_prop_vs_prop_and_arithmetic():
+    ds, d = _ds()
+    assert ds.count("t", "speed > heading") == int(
+        (d["speed"] > d["heading"]).sum())
+    assert ds.count("t", "weight * 2 < limit") == int(
+        (d["weight"].astype(np.float64) * 2 < d["limit"]).sum())
+    assert ds.count("t", "NOT (speed > heading)") == int(
+        (~(d["speed"] > d["heading"])).sum())
+    assert ds.count("t", "speed / 2 > heading - 10") == int(
+        (d["speed"] / 2 > d["heading"] - 10).sum())
+    assert ds.count("t", "speed - heading >= 0") == int(
+        (d["speed"] - d["heading"] >= 0).sum())
+
+
+def test_combined_with_indexed_predicates():
+    """The expression rides as a refinement on the indexed window scan."""
+    ds, d = _ds()
+    q = "BBOX(geom, -100, 30, -80, 45) AND speed / 2 > heading - 10"
+    m = ((d["geom__x"] >= -100) & (d["geom__x"] <= -80)
+         & (d["geom__y"] >= 30) & (d["geom__y"] <= 45))
+    assert ds.count("t", q) == int(
+        (m & (d["speed"] / 2 > d["heading"] - 10)).sum())
+
+
+def test_f32_adversarial_boundaries():
+    """Values whose f32 images collide must still compare with exact f64
+    semantics (the interval-arithmetic coarse mask may not drop them)."""
+    ds = GeoDataset(n_shards=1)
+    ds.create_schema("e", "p:Double,q:Double,*geom:Point")
+    base = 1.0
+    eps64 = np.finfo(np.float64).eps
+    p = np.array([base, base, base + eps64, base - eps64, 2.0])
+    q = np.array([base, base + eps64, base, base, 2.0 + 1e-12])
+    ds.insert("e", {"p": p, "q": q,
+                    "geom__x": np.zeros(5), "geom__y": np.zeros(5)},
+              fids=np.arange(5).astype(str))
+    ds.flush()
+    assert ds.count("e", "p = q") == int((p == q).sum())
+    assert ds.count("e", "p < q") == int((p < q).sum())
+    assert ds.count("e", "p <> q") == int((p != q).sum())
+    assert ds.count("e", "NOT (p < q)") == int((~(p < q)).sum())
+
+
+def test_division_by_zero_rows_excluded():
+    ds = GeoDataset(n_shards=1)
+    ds.create_schema("z", "num:Double,den:Double,*geom:Point")
+    num = np.array([1.0, 2.0, 3.0, 4.0])
+    den = np.array([1.0, 0.0, 2.0, 0.0])
+    ds.insert("z", {"num": num, "den": den,
+                    "geom__x": np.zeros(4), "geom__y": np.zeros(4)},
+              fids=np.arange(4).astype(str))
+    ds.flush()
+    # 1/1=1 > 0.9 yes; 2/0=inf > 0.9 yes (inf is a value, not null);
+    # 3/2=1.5 yes; 4/0=inf yes
+    assert ds.count("z", "num / den > 0.9") == 4
+    assert ds.count("z", "num / den < 2") == 2  # rows 0 and 2
+
+
+def test_string_prop_vs_prop():
+    ds, d = _ds()
+    oracle = int((np.asarray(d["a"]) == np.asarray(d["b"])).sum())
+    assert ds.count("t", "a = b") == oracle
+    assert ds.count("t", "a <> b") == len(d["a"]) - oracle
+    with pytest.raises(ValueError, match="ordering"):
+        ds.count("t", "a < b")
+
+
+def test_function_calls():
+    ds, d = _ds()
+    from geomesa_tpu.utils.geometry import haversine_m
+
+    got = ds.count(
+        "t", "st_distanceSphere(geom, st_geomFromWKT('POINT (-95 38)'))"
+             " < 500000")
+    dist = haversine_m(d["geom__x"], d["geom__y"], -95.0, 38.0)
+    assert got == int((dist < 500000).sum())
+    # function on both sides of arithmetic
+    got = ds.count(
+        "t", "st_distanceSphere(geom, st_geomFromWKT('POINT (-95 38)'))"
+             " / 1000 < 500")
+    assert got == int((dist / 1000 < 500).sum())
+
+
+def test_st_area_on_extent_column():
+    ds = GeoDataset(n_shards=1)
+    ds.create_schema("p", "v:Double,*geom:Polygon")
+    wkts = ["POLYGON ((0 0, 1 0, 1 1, 0 1, 0 0))",          # area 1
+            "POLYGON ((0 0, 3 0, 3 3, 0 3, 0 0))",          # area 9
+            "POLYGON ((0 0, 0.5 0, 0.5 0.5, 0 0.5, 0 0))"]  # area 0.25
+    ds.insert("p", {"geom": np.array(wkts, object),
+                    "v": np.arange(3.0)}, fids=["a", "b", "c"])
+    ds.flush()
+    assert ds.count("p", "st_area(geom) > 0.5") == 2
+    assert ds.count("p", "st_area(geom) > 0.5 AND v < 1") == 1
+
+
+def test_expr_errors():
+    ds, _ = _ds(n=100, seed=9)
+    with pytest.raises(ValueError, match="st_nosuch"):
+        ds.count("t", "st_nosuch(geom) > 1")
+    with pytest.raises(KeyError, match="nope"):
+        ds.count("t", "nope > speed")
+    with pytest.raises(ValueError):
+        parse_ecql("speed + heading")  # expression without comparison
+
+
+def test_constant_folding_keeps_legacy_ir():
+    """Review r5: literal-only subtrees fold so pushdown survives."""
+    f = parse_ecql("speed < - 2")
+    assert isinstance(f, ir.Compare) and f.value == -2
+    f = parse_ecql("speed < 1 + 1")
+    assert isinstance(f, ir.Compare) and f.value == 2
+    assert isinstance(parse_ecql("1 + 1 = 2"), ir.Include)
+    assert isinstance(parse_ecql("1 + 1 = 3"), ir.Exclude)
+
+
+def test_jsonpath_guards():
+    with pytest.raises(ValueError, match="jsonPath"):
+        parse_ecql("jsonPath('$.a', js) + 1 > 2")
+    with pytest.raises(ValueError, match="jsonPath"):
+        parse_ecql("st_area(jsonPath('$.a', js)) > 2")
+
+
+def test_json_attr_rejected_in_expressions():
+    ds = GeoDataset(n_shards=1)
+    ds.create_schema("j", "js:Json,speed:Double,*geom:Point")
+    ds.insert("j", {"js": np.array(['{"a": 1}'], object),
+                    "speed": np.array([1.0]),
+                    "geom__x": np.zeros(1), "geom__y": np.zeros(1)},
+              fids=["a"])
+    ds.flush()
+    with pytest.raises(ValueError, match="jsonPath"):
+        ds.count("j", "js > speed")
+
+
+def test_int64_exact_beyond_2_53():
+    """Review r5: Long columns compare exactly past the f64 mantissa."""
+    ds = GeoDataset(n_shards=1)
+    ds.create_schema("i", "p:Long,q:Long,*geom:Point")
+    p = np.array([2**53, 2**53, 7], np.int64)
+    q = np.array([2**53 + 1, 2**53, 7], np.int64)
+    ds.insert("i", {"p": p, "q": q,
+                    "geom__x": np.zeros(3), "geom__y": np.zeros(3)},
+              fids=np.arange(3).astype(str))
+    ds.flush()
+    assert ds.count("i", "p = q") == 2
+    assert ds.count("i", "p <> q") == 1
