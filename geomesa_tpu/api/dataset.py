@@ -383,6 +383,10 @@ class GeoDataset:
                 # them on every hit — they are cheap; planning is not
                 QueryPlanner(st)._guard(hit.key_plan, hit.filter, Explainer())
                 interceptors.apply_guards(st.ft, hit)
+                # exec_path describes ONE execution: stale notes from the
+                # cached plan's previous run (device_error, sort, ...)
+                # must not leak into this call's audit/explain
+                hit.__dict__.pop("exec_path", None)
                 return st, q, hit
         planner = QueryPlanner(st)
         t0 = time.perf_counter()
@@ -419,6 +423,11 @@ class GeoDataset:
                op: str = "query"):
         hints = {"op": op, "index": plan.index_name,
                  "max_features": q.max_features, "sampling": q.sampling}
+        path = plan.__dict__.get("exec_path")
+        if path:
+            hints["exec_path"] = {
+                k: v for k, v in path.items() if v is not None
+            }
         if "device_coarse_ms" in plan.__dict__:
             hints["device_coarse_ms"] = round(
                 plan.__dict__["device_coarse_ms"], 3
@@ -455,6 +464,22 @@ class GeoDataset:
                     f"{plan.__dict__['device_coarse_ms']:.3f} ms "
                     "(host refined candidates only)"
                 )
+            path = plan.__dict__.get("exec_path")
+            if path:
+                exp.push("Execution path")
+                for k, v in path.items():
+                    if v is not None:
+                        exp.line(f"{k}: {v}")
+                # achieved scan bandwidth vs the docs/SCALE.md roofline
+                # (the cost model's per-row HBM bound), when a device
+                # coarse timing exists to measure against
+                ms = plan.__dict__.get("device_coarse_ms")
+                if ms and scanned:
+                    n_cols = len(plan.compiled.columns) or 1
+                    gbs = scanned * n_cols * 4 / (ms * 1e-3) / 1e9
+                    exp.line(f"achieved scan bandwidth: {gbs:.1f} GB/s "
+                             f"({scanned} rows x {n_cols} f32 cols)")
+                exp.pop()
             exp.pop()
         return str(exp)
 
@@ -487,23 +512,32 @@ class GeoDataset:
         with metrics.registry().timer("query.scan").time(), \
                 query_deadline(self._timeout_s()):
             batch = None
-            # sort+limit pushdown: a single-key top-k ranks on device and
-            # gathers only k rows instead of the whole result set (the
-            # host re-sorts those k rows at f64 below, so the final order
-            # is exact for the selected set)
+            # sort+limit pushdown: the device selects the top-k candidate
+            # rows by the PRIMARY sort key (superset with boundary ties —
+            # threshold select for large k / non-f32 dtypes), and the host
+            # gathers + exact-sorts only those candidates instead of the
+            # whole result set. Multi-key sorts are exact because every
+            # primary-key boundary tie is among the candidates.
+            topk_max = config.TOPK_MAX.to_int()
+            topk_max = 100000 if topk_max is None else topk_max  # 0 disables
             if (
-                q.sort_by and len(q.sort_by) == 1
-                and q.max_features is not None and q.max_features <= 4096
+                q.sort_by
+                and q.max_features is not None
+                and 0 < q.max_features <= topk_max
                 and hasattr(ex, "top_rows")
             ):
                 attr, desc = q.sort_by[0]
-                idx = ex.top_rows(plan, attr, desc, q.max_features)
+                idx = ex.top_rows(plan, attr, desc, q.max_features,
+                                  include_ties=len(q.sort_by) > 1)
                 if idx is not None:
                     table = st.tables[plan.index_name]
                     names = None
                     if plan.hints.properties:
-                        names = list(plan.hints.properties) + [attr]
+                        names = list(plan.hints.properties) + [
+                            a for a, _ in q.sort_by]
                     batch = table.host_gather_positions(idx, names)
+                    plan.__dict__.setdefault("exec_path", {})[
+                        "sort"] = f"device-topk(k={q.max_features})"
             if batch is None:
                 batch = ex.features(plan)
         self._audit(name, q, plan, t0, batch.n)

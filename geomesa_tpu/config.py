@@ -238,3 +238,17 @@ MXU_TILE_Y = SystemProperty("geomesa.mxu.tile.y", "32")
 #: Bin-space (2-D mesh) streaming: lax.scan chunk count per device along
 #: the time-bin axis (1 = no streaming; >1 trades HBM for steps).
 BIN_STREAM_CHUNKS = SystemProperty("geomesa.bin.stream.chunks", "1")
+
+#: Bucket count for hash-bucketed per-key sampling (int keys and
+#: dictionary vocabularies beyond the exact per-code kernel's gate).
+#: Power of two; 0 routes such keys to the host's exact per-key counter.
+SAMPLE_HASH_BUCKETS = SystemProperty("geomesa.sample.hash-buckets", "64")
+
+#: Sorted-query top-k pushdown: max Query.max_features eligible for the
+#: device threshold-select (binary-searched count reductions, no device
+#: sort); larger limits gather the full result and sort on host.
+TOPK_MAX = SystemProperty("geomesa.topk.max", "100000")
+
+#: Extra gather slots for boundary ties in the device top-k selection;
+#: selections whose tie group overflows k + slack fall back to the host.
+TOPK_TIE_SLACK = SystemProperty("geomesa.topk.tie-slack", "4096")

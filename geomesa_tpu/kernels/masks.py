@@ -113,3 +113,33 @@ def sampling_mask(mask, n: int, xp):
     seq = xp.cumsum(flat.astype(xp.int32)) - 1
     keep = (seq % n) == 0
     return (flat & keep).reshape(mask.shape)
+
+
+def bucket_of(keys, n_buckets: int, xp):
+    """Deterministic hash bucket for int keys (both backends produce the
+    same buckets): a 32-bit splitmix-style mixer, masked to n_buckets
+    (power of two). Null codes (-1) map to their own stable bucket."""
+    h = xp.asarray(keys).astype(xp.uint32)
+    h = (h ^ (h >> 16)) * xp.uint32(0x7FEB352D)
+    h = (h ^ (h >> 15)) * xp.uint32(0x846CA68B)
+    h = h ^ (h >> 16)
+    return (h & xp.uint32(n_buckets - 1)).astype(xp.int32)
+
+
+def sampling_mask_by_key_hash(mask, n: int, keys, n_buckets: int, xp):
+    """Per-key sampling for UNBOUNDED key spaces (int attributes,
+    dictionary vocabularies > the per-code kernel's gate): keys hash into
+    ``n_buckets`` groups, each group keeps a deterministic 1-in-n of its
+    matches in row order. Keys sharing a bucket share a counter — an
+    approximation of the reference SamplingIterator's exact per-key
+    counter, traded for a device kernel that is ``n_buckets`` cumsum
+    passes instead of one pass per distinct key. Identical results on
+    both backends (the host twin runs the same code with xp=numpy)."""
+    flat = mask.reshape(-1)
+    b = bucket_of(xp.asarray(keys).reshape(-1), n_buckets, xp)
+    keep = xp.zeros(flat.shape[0], dtype=bool)
+    for v in range(n_buckets):
+        mv = flat & (b == v)
+        rank = xp.cumsum(mv.astype(xp.int32)) - 1
+        keep = keep | (mv & ((rank % n) == 0))
+    return keep.reshape(mask.shape)

@@ -138,19 +138,47 @@ class Executor:
         # sample_by is meaningless without a sampling rate.
         if plan.hints.sample_by and not plan.hints.sampling:
             raise ValueError("sample_by requires sampling (the 1-in-n rate)")
-        # per-key sampling runs on device when the key is a dictionary-
-        # coded string with a small vocabulary (the sort-free per-code
-        # cumsum kernel needs one pass per value); other dtypes fall back
-        # to the host counter (the reference runs it inside the iterator
-        # loop) — float keys would additionally merge distinct values at
-        # f32.
+        # per-key sampling device modes (sort-free by design — device sort
+        # compiles pathologically on this TPU toolchain):
+        #   "exact": dictionary-coded key with a small vocabulary — one
+        #     cumsum pass per code, exact per-key counters;
+        #   "hash":  any other device-resident int32 key (large vocab,
+        #     Integer attrs) — keys hash into SAMPLE_HASH_BUCKETS groups
+        #     sharing counters (documented approximation; the host twin
+        #     hashes identically so results are backend-independent).
+        # float/int64/object keys stay on the host's exact counter (float
+        # keys would merge distinct values at f32).
         sb = plan.hints.sample_by
-        sb_device = bool(
-            sb and table.has_column(sb) and not table.is_host_only(sb)
-            and table.dtype_of(sb) == np.int32
-            and sb in self.store.dicts
-            and 0 < len(self.store.dicts[sb]) <= 256
-        )
+        sb_mode, sb_off, sb_span_vocab = None, 0, 0
+        if sb and table.has_column(sb) and not table.is_host_only(sb) \
+                and table.dtype_of(sb) == np.int32:
+            if sb in self.store.dicts:
+                if 0 < len(self.store.dicts[sb]) <= 256:
+                    sb_mode = "exact"
+                elif (config.SAMPLE_HASH_BUCKETS.to_int() or 0) > 0:
+                    sb_mode = "hash"
+            else:
+                # raw int keys: a small VALUE SPAN runs the exact
+                # per-code kernel on offset values (preserving the
+                # reference's exact per-key counters); wide key spaces
+                # hash-bucket. min/max cached per store version.
+                span_cache = self.store.__dict__.setdefault("_sb_span", {})
+                skey = (sb, plan.index_name, self.version_source.version)
+                rng = span_cache.get(skey)
+                if rng is None:
+                    col = table.col_sorted(sb)
+                    rng = ((int(col.min()), int(col.max()))
+                           if len(col) else (0, -1))
+                    if len(span_cache) >= 64:
+                        span_cache.clear()
+                    span_cache[skey] = rng
+                lo_v, hi_v = rng
+                if 0 <= hi_v - lo_v < 256:
+                    sb_mode, sb_off = "exact-span", lo_v
+                    sb_span_vocab = hi_v - lo_v + 1
+                elif (config.SAMPLE_HASH_BUCKETS.to_int() or 0) > 0:
+                    sb_mode = "hash"
+        sb_device = sb_mode is not None
         if sb_device:
             needed = list(dict.fromkeys(needed + [sb]))
         host_only = [
@@ -187,7 +215,8 @@ class Executor:
         return {
             "table": table, "starts": starts, "ends": ends, "counts": counts,
             "L": L, "needed": needed, "use_device": use_device,
-            "coarse_device": coarse_device,
+            "coarse_device": coarse_device, "sb_mode": sb_mode,
+            "sb_off": sb_off, "sb_span_vocab": sb_span_vocab,
         }
 
     def _compact_candidates(self, plan: QueryPlan, setup):
@@ -594,10 +623,16 @@ class Executor:
         compiled = plan.compiled
         sampling = plan.hints.sampling
         sample_by = plan.hints.sample_by
-        sb_vocab = (
-            len(self.store.dicts[sample_by])
-            if sample_by and sample_by in self.store.dicts else 0
-        )
+        sb_mode = setup["sb_mode"]
+        sb_off = setup["sb_off"]
+        if sb_mode == "exact-span":
+            sb_vocab = setup["sb_span_vocab"]
+        else:
+            sb_vocab = (
+                len(self.store.dicts[sample_by])
+                if sample_by and sample_by in self.store.dicts else 0
+            )
+        sb_buckets = config.SAMPLE_HASH_BUCKETS.to_int() or 64
         names = tuple(dict.fromkeys(list(setup["needed"]) + list(agg_cols)))
         cols = self._compact_cols(setup, names)
         token = plan.__dict__.get("cache_token")
@@ -610,10 +645,12 @@ class Executor:
                     else self.version_source.__dict__.setdefault("_kernel_fns", {})
                 )
                 fn_key = ("compact", cache_key, B, Cp, sampling, sample_by,
-                          token, plan.index_name, self.version_source.version)
+                          sb_mode, sb_off, sb_buckets, token, plan.index_name,
+                          self.version_source.version)
             else:
                 fn_cache = plan.__dict__.setdefault("_kernel_fns", {})
-                fn_key = ("compact", cache_key, B, Cp, sampling, sample_by)
+                fn_key = ("compact", cache_key, B, Cp, sampling, sample_by,
+                          sb_mode, sb_off, sb_buckets)
         go = fn_cache.get(fn_key) if fn_cache is not None else None
         if go is None:
 
@@ -624,9 +661,14 @@ class Executor:
                 m = m & compiled(cols, jnp)
                 if compiled.band is not None:
                     m = m & ~compiled.band(cols, jnp)
-                if sampling and sample_by:
+                if sampling and sample_by and sb_mode == "hash":
+                    m = kmasks.sampling_mask_by_key_hash(
+                        m, sampling, cols[sample_by], sb_buckets, jnp
+                    )
+                elif sampling and sample_by:
                     m = kmasks.sampling_mask_by_key_device(
-                        m, sampling, cols[sample_by], sb_vocab, jnp
+                        m, sampling, cols[sample_by] - sb_off, sb_vocab,
+                        jnp
                     )
                 elif sampling:
                     m = kmasks.sampling_mask(m, sampling, jnp)
@@ -830,16 +872,29 @@ class Executor:
             if not table.has_column(key):
                 raise KeyError(f"sample-by attribute {key!r} not found")
             col = table.col_sorted(key)
-            # exact distinct-value codes for ANY dtype (float truncation or
-            # object hashing would merge distinct keys)
-            _, codes = np.unique(col, return_inverse=True)
-            stacked = np.zeros((S, L), dtype=np.int64)
-            for s in range(table.n_shards):
-                sl = table.shard_slice(s)
-                stacked[s, : sl.stop - sl.start] = codes[sl]
-            mask = kmasks.sampling_mask_by_key(
-                mask, plan.hints.sampling, stacked
-            )
+            if setup.get("sb_mode") == "hash":
+                # backend parity: keys the DEVICE would hash-bucket are
+                # hash-bucketed here too (same mixer, xp=numpy), so a
+                # host fallback never changes which rows are sampled
+                stacked = np.zeros((S, L), dtype=np.int32)
+                for s in range(table.n_shards):
+                    sl = table.shard_slice(s)
+                    stacked[s, : sl.stop - sl.start] = col[sl]
+                mask = kmasks.sampling_mask_by_key_hash(
+                    mask, plan.hints.sampling, stacked,
+                    config.SAMPLE_HASH_BUCKETS.to_int() or 64, np,
+                )
+            else:
+                # exact distinct-value codes for ANY dtype (float
+                # truncation or object hashing would merge distinct keys)
+                _, codes = np.unique(col, return_inverse=True)
+                stacked = np.zeros((S, L), dtype=np.int64)
+                for s in range(table.n_shards):
+                    sl = table.shard_slice(s)
+                    stacked[s, : sl.stop - sl.start] = codes[sl]
+                mask = kmasks.sampling_mask_by_key(
+                    mask, plan.hints.sampling, stacked
+                )
         elif plan.hints.sampling:
             mask = kmasks.sampling_mask(mask, plan.hints.sampling, np)
         return mask
@@ -892,10 +947,16 @@ class Executor:
         # host, AFTER refinement (the 1-in-n counter sees exact matches)
         sampling = plan.hints.sampling if apply_sampling else None
         sample_by = plan.hints.sample_by if apply_sampling else None
-        sb_vocab = (
-            len(self.store.dicts[sample_by])
-            if sample_by and sample_by in self.store.dicts else 0
-        )
+        sb_mode = setup["sb_mode"] if apply_sampling else None
+        sb_off = setup["sb_off"]
+        if sb_mode == "exact-span":
+            sb_vocab = setup["sb_span_vocab"]
+        else:
+            sb_vocab = (
+                len(self.store.dicts[sample_by])
+                if sample_by and sample_by in self.store.dicts else 0
+            )
+        sb_buckets = config.SAMPLE_HASH_BUCKETS.to_int() or 64
 
         # Two caches with different lifetimes:
         # 1. the jitted kernel — reusable across API calls (same predicate
@@ -916,11 +977,13 @@ class Executor:
                     if self.kernel_fns is not None
                     else self.version_source.__dict__.setdefault("_kernel_fns", {})
                 )
-                fn_key = (cache_key, L, K, sampling, sample_by, token,
-                          plan.index_name, self.version_source.version)
+                fn_key = (cache_key, L, K, sampling, sample_by, sb_mode,
+                          token, plan.index_name,
+                          self.version_source.version)
             else:  # raw-IR plan: cache on the plan (shared across partitions)
                 fn_cache = plan.__dict__.setdefault("_kernel_fns", {})
-                fn_key = (cache_key, L, K, sampling, sample_by)
+                fn_key = (cache_key, L, K, sampling, sample_by, sb_mode,
+                          sb_off, sb_buckets)
         go = fn_cache.get(fn_key) if fn_cache is not None else None
         if go is None:
 
@@ -934,9 +997,14 @@ class Executor:
                     # added back host-side from their f64 values. COARSE
                     # masks keep them (they are the refinement candidates).
                     m = m & ~compiled.band(cols, jnp)
-                if sampling and sample_by:
+                if sampling and sample_by and sb_mode == "hash":
+                    m = kmasks.sampling_mask_by_key_hash(
+                        m, sampling, cols[sample_by], sb_buckets, jnp
+                    )
+                elif sampling and sample_by:
                     m = kmasks.sampling_mask_by_key_device(
-                        m, sampling, cols[sample_by], sb_vocab, jnp
+                        m, sampling, cols[sample_by] - sb_off, sb_vocab,
+                        jnp
                     )
                 elif sampling:
                     m = kmasks.sampling_mask(m, sampling, jnp)
@@ -1131,6 +1199,15 @@ class Executor:
             ("chunk", "px0", "py0", "tile", "pvalid"),
         )
 
+    @staticmethod
+    def _note(plan: QueryPlan, **kw) -> None:
+        """Record which execution path served (part of) this query in
+        ``plan.exec_path`` — surfaced by explain(analyze=True) and the
+        audit log so silent fallbacks (device -> host, pallas -> XLA,
+        mesh -> single-chip) are visible per query instead of only as a
+        perf cliff."""
+        plan.__dict__.setdefault("exec_path", {}).update(kw)
+
     def _run(self, plan: QueryPlan, agg_fn_dev, agg_fn_host, agg_cols=(),
              cache_key=None, additive=False, extra=(), compactable=True,
              compact_agg=None):
@@ -1138,6 +1215,13 @@ class Executor:
         setup = self._scan_setup(plan, agg_cols)
         if setup is None:
             return None
+        self._note(
+            plan,
+            sampling=setup["sb_mode"] if plan.hints.sample_by else None,
+            mesh=(None if self.mesh is None
+                  else dict(zip(self.mesh.axis_names,
+                                self.mesh.devices.shape))),
+        )
         corr = None
         band_rows = 0
         if setup["use_device"] and plan.compiled.band is not None:
@@ -1159,6 +1243,8 @@ class Executor:
                         plan, setup, agg_fn_dev, agg_cols, cache_key
                     )
                     if out is not None:
+                        self._note(plan, scan="device-binspace",
+                                   band_rows=band_rows)
                         return out if corr is None else out + corr
                 except Exception as e:
                     if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
@@ -1174,6 +1260,8 @@ class Executor:
                         plan, setup, agg_fn_dev, agg_cols, cache_key, extra
                     )
                     if out is not None:
+                        self._note(plan, scan="device-compact-mesh",
+                                   band_rows=band_rows)
                         return out if corr is None else out + corr
                 self._maybe_compact(plan, setup, compactable)
                 if setup["compact"] is not None:
@@ -1188,11 +1276,15 @@ class Executor:
                         plan, setup, agg_use, agg_cols, ckey,
                         extra=extra_use,
                     )
+                    self._note(plan, scan="device-compact",
+                               B=setup["compact"]["B"], band_rows=band_rows)
                 else:
                     out = self._device_mask_and_agg(
                         plan, setup, agg_fn_dev, agg_cols, cache_key,
                         extra=extra,
                     )
+                    self._note(plan, scan="device-padded",
+                               band_rows=band_rows)
                 return out if corr is None else out + corr
             except Exception as e:
                 if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
@@ -1203,7 +1295,14 @@ class Executor:
                 logging.getLogger(__name__).warning(
                     "device scan failed, falling back to host: %r", e
                 )
-        mask = self._host_mask(plan, setup, self._coarse_or_none(plan, setup))
+                self._note(plan, device_error=repr(e)[:200])
+        coarse = self._coarse_or_none(plan, setup)
+        self._note(
+            plan,
+            scan=("host+device-coarse" if coarse is not None else "host"),
+            band_rows=band_rows,
+        )
+        mask = self._host_mask(plan, setup, coarse)
         table = setup["table"]
         cols = {}
         for c in set(list(setup["needed"]) + list(agg_cols)):
@@ -1316,6 +1415,7 @@ class Executor:
             # None when the index has no morton key column)
             gr = self._density_grouped(plan, setup, bbox, width, height)
             if gr is not None:
+                self._note(plan, density_kernel="pallas-grouped-mxu")
                 from geomesa_tpu.kernels import density_pallas as kdp
 
                 Bc, n_pairs = gr["B"], gr["n_pairs"]
@@ -1334,7 +1434,9 @@ class Executor:
                 return gagg, extra, ("grouped", n_pairs, Bc, gntx, gnty)
             pr = self._density_pairs(plan, setup, bbox, width, height)
             if pr is None:
+                self._note(plan, density_kernel="scatter")
                 return None
+            self._note(plan, density_kernel="mxu-einsum")
             from geomesa_tpu.kernels import density_mxu as kmxu
 
             PB, ntx, nty = pr["PB"], pr["ntx"], pr["nty"]
@@ -1503,24 +1605,40 @@ class Executor:
         return stat
 
     def top_rows(self, plan: QueryPlan, attr: str, descending: bool,
-                 k: int):
-        """Flattened [S*L] positions of the top-k matched rows by one
-        attribute — the device half of a sorted+limited query (reference
-        SortingSimpleFeatureIterator, done as a masked top_k so the host
-        never gathers the full result set). Only offered for NATIVE
-        float32 columns, where device ranking is exact: an f64→f32 or
-        int32→f32 cast merges near-equal keys, and dictionary-coded
-        strings rank by insertion-order code, not value. Returns None when
-        the column can't rank exactly on device (caller sorts on host)."""
+                 k: int, include_ties: bool = False):
+        """Flattened [S*L] positions of a SUPERSET of the top-k matched
+        rows by one attribute (every boundary tie included) — the device
+        half of a sorted+limited query (reference
+        SortingSimpleFeatureIterator, done without a device sort, which
+        compiles pathologically on this TPU toolchain). The caller sorts
+        the gathered candidates exactly on host, so: for single-key
+        sorts the final order is exact; for MULTI-key sorts this is
+        called with the primary key, and tie inclusion guarantees every
+        lexicographic top-k row is among the candidates.
+
+        Two device strategies:
+        - k <= 32, native f32 column: exact argmin iteration (r4 path);
+        - otherwise: THRESHOLD SELECT — binary-search the k-th key value
+          with masked count reductions (48 bandwidth-bound passes, one
+          dispatch), then compact the <=threshold row positions into a
+          k + tie-slack buffer with a sized nonzero. f64/int32 columns
+          ride at f32: monotone rounding makes the selection a provable
+          superset; the host's exact sort of the candidates restores f64
+          order. Returns None when the column can't rank on device or
+          the tie group overflows the buffer (caller sorts on host)."""
         table = self._table(plan)
         if (
             not table.has_column(attr)
             or table.is_host_only(attr)
-            or table.dtype_of(attr) != np.float32
-            or attr in self.store.dicts
-            or k > 32  # argmin iteration only: device sort compile hangs
+            or attr in self.store.dicts  # codes rank by insertion order
+            or table.dtype_of(attr) == np.bool_
         ):
             return None
+        if include_ties or table.dtype_of(attr) != np.float32 or k > 32:
+            # multi-key sorts REQUIRE tie inclusion: the argmin path
+            # returns exactly k rows and would drop a boundary tie that
+            # wins on a secondary key
+            return self._top_rows_threshold(plan, attr, descending, k)
 
         def agg(cols, m, xp, *extra):
             v = cols[attr].reshape(-1).astype(xp.float32)
@@ -1560,6 +1678,75 @@ class Executor:
             # exist that the device path excluded — let the host decide
             return None
         return idx
+
+    def _top_rows_threshold(self, plan: QueryPlan, attr: str,
+                            descending: bool, k: int):
+        """Threshold-select top-k candidates (see :meth:`top_rows`)."""
+        slack = config.TOPK_TIE_SLACK.to_int()
+        slack = 4096 if slack is None else slack
+        B = int(k + slack)
+        desc = bool(descending)
+
+        def agg(cols, m, xp, *extra):
+            from jax import lax
+
+            v = cols[attr].reshape(-1).astype(xp.float32)
+            key = -v if desc else v
+            ok = m.reshape(-1) & ~xp.isnan(v)
+            kv = xp.where(ok, key, xp.inf)
+            n_ok = ok.sum()
+            lo = xp.min(kv)
+            hi = xp.max(xp.where(ok, key, -xp.inf))
+
+            # smallest t with count(key <= t) >= k: 48 halvings reach f32
+            # resolution from any normal range
+            def body(_, lohi):
+                lo, hi = lohi
+                mid = (lo + hi) * 0.5
+                c = xp.sum(kv <= mid)
+                ge = c >= k
+                return xp.where(ge, lo, mid), xp.where(ge, mid, hi)
+
+            lo, hi = lax.fori_loop(0, 48, body, (lo, hi))
+            t = xp.where(n_ok <= k, xp.inf, hi)  # few matches: take all
+            sel = ok & (kv <= t)
+            cnt = sel.sum()
+            idx = xp.nonzero(sel, size=B, fill_value=sel.shape[0])[0]
+            return idx, cnt
+
+        def agg_host(cols, m, xp, *extra):
+            # host twin with the same superset-with-ties contract
+            v = cols[attr].reshape(-1).astype(np.float64)
+            ok = m.reshape(-1) & ~np.isnan(v)
+            key = np.where(ok, -v if desc else v, np.inf)
+            n_ok = int(ok.sum())
+            out = np.full(B, len(key), np.int64)
+            if n_ok == 0:
+                return out, 0
+            kk = min(k, n_ok)
+            t = np.partition(key, kk - 1)[kk - 1]
+            sel = np.nonzero(key <= t)[0]
+            out[: min(len(sel), B)] = sel[:B]
+            return out, len(sel)
+
+        out = self._run(
+            plan, agg, agg_host, [attr],
+            cache_key=("topt", attr, desc, int(k), B),
+            compactable=False,  # returned indices address the padded layout
+        )
+        if out is None:
+            return np.zeros(0, np.int64)
+        idx, cnt = np.asarray(out[0]), int(out[1])
+        if cnt > B:
+            return None  # tie group overflowed the buffer: host sorts
+        if cnt < k:
+            # fewer non-NaN matches than k: NaN-keyed matches (which sort
+            # LAST, but still belong in an under-filled result) were
+            # excluded here — let the host decide
+            return None
+        table = self._table(plan)
+        total = int(table.n_shards * table.shard_len)
+        return idx[idx < total].astype(np.int64)
 
     def knn(self, plan: QueryPlan, qx: float, qy: float, k: int, boxes=None):
         """k nearest to (qx, qy) among plan matches. ``boxes`` (optional):

@@ -141,3 +141,121 @@ def test_sample_by_float_falls_back_to_host(ds_data):
         for w in np.unique(data["weight"][m])
     )
     assert n == want
+
+
+def test_multikey_sort_device_pushdown(ds_data):
+    """r5: multi-key sorts push the primary-key top-k selection to the
+    device (threshold select + tie gather); order matches the host's
+    full stable multi-key sort exactly, and the audit records the path."""
+    ds, data = ds_data
+    q = Query(ecql=ECQL, sort_by=[("weight", False), ("code", True)],
+              max_features=500)
+    fc = ds.query("t", q)
+    # host oracle: full filter + lexicographic sort
+    m = _mask(data)
+    idx = np.nonzero(m)[0]
+    order = np.lexsort((-data["code"][idx], data["weight"][idx]))
+    want_vals = data["weight"][idx][order][:500]
+    got = fc.batch.columns["weight"]
+    assert len(got) == min(500, len(idx))
+    assert np.allclose(np.asarray(got, np.float64), want_vals)
+    ev = ds.audit.recent(1)[0]
+    assert "device-topk" in str(ev.hints.get("exec_path", {}))
+
+
+def test_large_k_threshold_select(ds_data):
+    """k far beyond the old 32-row argmin gate ranks on device."""
+    ds, data = ds_data
+    q = Query(ecql=ECQL, sort_by=[("weight", True)], max_features=3000)
+    fc = ds.query("t", q)
+    m = _mask(data)
+    want = np.sort(data["weight"][m].astype(np.float64))[::-1][:3000]
+    assert np.allclose(
+        np.asarray(fc.batch.columns["weight"], np.float64), want)
+
+
+def test_sample_by_large_vocab_hash(ds_data):
+    """r5: a 10k-vocab sample key runs the hash-bucketed device kernel
+    (deterministic, ~1/n overall) and explain names the path."""
+    rng = np.random.default_rng(3)
+    n = 30_000
+    ds2 = GeoDataset(n_shards=2)
+    ds2.create_schema("big", "key:String,val:Double,*geom:Point")
+    data = {
+        "key": np.array([f"k{rng.integers(0, 10_000)}" for _ in range(n)],
+                        dtype=object),
+        "val": rng.uniform(0, 1, n),
+        "geom__x": rng.uniform(-10, 10, n),
+        "geom__y": rng.uniform(-10, 10, n),
+    }
+    ds2.insert("big", data, fids=np.arange(n).astype(str))
+    ds2.flush()
+    q = Query(ecql="INCLUDE", sampling=5, sample_by="key")
+    got = ds2.count("big", q)
+    # per-bucket ceil(matches/5) summed over 64 buckets: between n/5 and
+    # n/5 + 64, and deterministic
+    assert n / 5 <= got <= n / 5 + 64
+    assert got == ds2.count("big", q)
+    ex = ds2.explain("big", q, analyze=True)
+    assert "sampling: hash" in ex and "Execution path" in ex
+
+
+def test_sample_hash_host_parity(ds_data):
+    """The host twin hash-buckets identically: prefer_device=False gives
+    the same sampled count."""
+    rng = np.random.default_rng(4)
+    n = 8_000
+    common = {
+        "key": np.array([f"k{rng.integers(0, 5_000)}" for _ in range(n)],
+                        dtype=object),
+        "val": rng.uniform(0, 1, n),
+        "geom__x": rng.uniform(-10, 10, n),
+        "geom__y": rng.uniform(-10, 10, n),
+    }
+    counts = []
+    for dev in (True, False):
+        d = GeoDataset(n_shards=2, prefer_device=dev)
+        d.create_schema("p", "key:String,val:Double,*geom:Point")
+        d.insert("p", common, fids=np.arange(n).astype(str))
+        d.flush()
+        counts.append(d.count("p", Query(
+            ecql="INCLUDE", sampling=7, sample_by="key")))
+    assert counts[0] == counts[1]
+
+
+def test_multikey_ties_at_boundary_small_k(ds_data):
+    """Review r5: small-k multi-key sorts must include boundary ties
+    (the argmin path would drop a tie that wins on the secondary key)."""
+    ds, _ = ds_data
+    rng = np.random.default_rng(9)
+    n = 2000
+    d2 = GeoDataset(n_shards=2)
+    d2.create_schema("tie", "w:Float,c:Integer,*geom:Point")
+    # heavy ties on the primary key
+    w = rng.choice(np.array([1.0, 2.0, 3.0], np.float32), n)
+    c = rng.integers(0, 1000, n).astype(np.int32)
+    d2.insert("tie", {"w": w, "c": c,
+                      "geom__x": rng.uniform(-10, 10, n),
+                      "geom__y": rng.uniform(-10, 10, n)},
+              fids=np.arange(n).astype(str))
+    d2.flush()
+    q = Query("INCLUDE", sort_by=[("w", False), ("c", False)],
+              max_features=10)
+    fc = d2.query("tie", q)
+    order = np.lexsort((c, w))
+    want_c = c[order][:10]
+    assert np.array_equal(np.asarray(fc.batch.columns["c"]), want_c)
+
+
+def test_underfilled_topk_falls_back(ds_data):
+    """cnt < k (few matches) routes to the host full path, not a batch
+    polluted with padding/masked rows."""
+    ds, data = ds_data
+    q = Query(ecql="weight > 0.999", sort_by=[("weight", True)],
+              max_features=3000)
+    fc = ds.query("t", q)
+    m = data["weight"] > 0.999
+    assert len(fc) == int(m.sum()) < 3000
+    want = np.sort(data["weight"][m].astype(np.float64))[::-1]
+    assert np.allclose(np.asarray(fc.batch.columns["weight"], np.float64),
+                       want)
