@@ -519,7 +519,8 @@ class GeoDataset:
             # whole result set. Multi-key sorts are exact because every
             # primary-key boundary tie is among the candidates.
             topk_max = config.TOPK_MAX.to_int()
-            topk_max = 100000 if topk_max is None else topk_max  # 0 disables
+            if topk_max is None:
+                topk_max = int(config.TOPK_MAX.default)  # 0 disables
             if (
                 q.sort_by
                 and q.max_features is not None

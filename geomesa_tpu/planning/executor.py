@@ -155,7 +155,10 @@ class Executor:
             if sb in self.store.dicts:
                 if 0 < len(self.store.dicts[sb]) <= 256:
                     sb_mode = "exact"
-                elif (config.SAMPLE_HASH_BUCKETS.to_int() or 0) > 0:
+                elif self.prefer_device \
+                        and (config.SAMPLE_HASH_BUCKETS.to_int() or 0) > 0:
+                    # the approximation only buys anything when a device
+                    # scan runs; host-only stores keep the exact counter
                     sb_mode = "hash"
             else:
                 # raw int keys: a small VALUE SPAN runs the exact
@@ -176,7 +179,8 @@ class Executor:
                 if 0 <= hi_v - lo_v < 256:
                     sb_mode, sb_off = "exact-span", lo_v
                     sb_span_vocab = hi_v - lo_v + 1
-                elif (config.SAMPLE_HASH_BUCKETS.to_int() or 0) > 0:
+                elif self.prefer_device \
+                        and (config.SAMPLE_HASH_BUCKETS.to_int() or 0) > 0:
                     sb_mode = "hash"
         sb_device = sb_mode is not None
         if sb_device:
@@ -632,7 +636,7 @@ class Executor:
                 len(self.store.dicts[sample_by])
                 if sample_by and sample_by in self.store.dicts else 0
             )
-        sb_buckets = config.SAMPLE_HASH_BUCKETS.to_int() or 64
+        sb_buckets = config.SAMPLE_HASH_BUCKETS.to_int() or int(config.SAMPLE_HASH_BUCKETS.default)
         names = tuple(dict.fromkeys(list(setup["needed"]) + list(agg_cols)))
         cols = self._compact_cols(setup, names)
         token = plan.__dict__.get("cache_token")
@@ -882,7 +886,7 @@ class Executor:
                     stacked[s, : sl.stop - sl.start] = col[sl]
                 mask = kmasks.sampling_mask_by_key_hash(
                     mask, plan.hints.sampling, stacked,
-                    config.SAMPLE_HASH_BUCKETS.to_int() or 64, np,
+                    config.SAMPLE_HASH_BUCKETS.to_int() or int(config.SAMPLE_HASH_BUCKETS.default), np,
                 )
             else:
                 # exact distinct-value codes for ANY dtype (float
@@ -956,7 +960,7 @@ class Executor:
                 len(self.store.dicts[sample_by])
                 if sample_by and sample_by in self.store.dicts else 0
             )
-        sb_buckets = config.SAMPLE_HASH_BUCKETS.to_int() or 64
+        sb_buckets = config.SAMPLE_HASH_BUCKETS.to_int() or int(config.SAMPLE_HASH_BUCKETS.default)
 
         # Two caches with different lifetimes:
         # 1. the jitted kernel — reusable across API calls (same predicate
@@ -978,7 +982,7 @@ class Executor:
                     else self.version_source.__dict__.setdefault("_kernel_fns", {})
                 )
                 fn_key = (cache_key, L, K, sampling, sample_by, sb_mode,
-                          token, plan.index_name,
+                          sb_off, sb_buckets, token, plan.index_name,
                           self.version_source.version)
             else:  # raw-IR plan: cache on the plan (shared across partitions)
                 fn_cache = plan.__dict__.setdefault("_kernel_fns", {})
@@ -1683,7 +1687,8 @@ class Executor:
                             descending: bool, k: int):
         """Threshold-select top-k candidates (see :meth:`top_rows`)."""
         slack = config.TOPK_TIE_SLACK.to_int()
-        slack = 4096 if slack is None else slack
+        if slack is None:
+            slack = int(config.TOPK_TIE_SLACK.default)
         B = int(k + slack)
         desc = bool(descending)
 
