@@ -59,6 +59,23 @@ def cmd_update_schema(args):
     print(ft.describe())
 
 
+def cmd_add_index(args):
+    """Enable an attribute index on a live schema without recreating it
+    (reference updateSchema index transitions,
+    GeoMesaDataStore.scala:288-336)."""
+    ds = _load(args.catalog)
+    ds.add_attribute_index(args.feature_name, args.attribute)
+    _save(ds, args.catalog)
+    print(f"added attr:{args.attribute} to {args.feature_name!r}")
+
+
+def cmd_remove_index(args):
+    ds = _load(args.catalog)
+    ds.remove_attribute_index(args.feature_name, args.attribute)
+    _save(ds, args.catalog)
+    print(f"removed attr:{args.attribute} from {args.feature_name!r}")
+
+
 def cmd_manage_partitions(args):
     """List / age off time partitions of a partitioned store (reference
     geomesa-tools manage-partitions; TimePartition.scala:35)."""
@@ -506,6 +523,18 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--add", required=True,
                     help="spec of attributes to append, e.g. 'tag:String'")
     sp.set_defaults(fn=cmd_update_schema)
+
+    sp = sub.add_parser("add-attribute-index",
+                        help="enable an attribute index on a live schema")
+    common(sp)
+    sp.add_argument("--attribute", required=True)
+    sp.set_defaults(fn=cmd_add_index)
+
+    sp = sub.add_parser("remove-attribute-index",
+                        help="drop an attribute index (data untouched)")
+    common(sp)
+    sp.add_argument("--attribute", required=True)
+    sp.set_defaults(fn=cmd_remove_index)
 
     sp = sub.add_parser(
         "manage-partitions", help="list or age off time partitions"

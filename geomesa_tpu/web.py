@@ -21,9 +21,12 @@ Write surface (the JVM DataStore's zero-dependency transport; the
 reference's DataStore mutates through the same catalog the servlets read):
 
     POST   /api/schemas                  {"name","spec"} -> create schema
+    PATCH  /api/schemas/<name>           {"add_spec"}    -> append attributes
     DELETE /api/schemas/<name>                           -> delete schema
     POST   /api/schemas/<name>/features  GeoJSON FC      -> ingest+flush
     DELETE /api/schemas/<name>/features?cql=...          -> delete by filter
+    POST   /api/schemas/<name>/indices   {"attribute"}   -> add attr index
+    DELETE /api/schemas/<name>/indices/<attr>            -> drop attr index
 
 Queries pass auths via the ``X-Geomesa-Auths`` header (visibility parity).
 """
@@ -222,6 +225,38 @@ class _Handler(BaseHTTPRequestHandler):
                 return self._send(
                     {"inserted": int(n), "fids": list(map(str, fids))}, 201
                 )
+            if len(parts) == 4 and parts[:2] == ["api", "schemas"] \
+                    and parts[3] == "indices":
+                name = urllib.parse.unquote(parts[2])
+                ds.get_schema(name)  # unknown schema -> 404, before 400s
+                body = json.loads(self._read_body() or "{}")
+                attr = body.get("attribute")
+                if not attr:
+                    return self._error(400, 'body must be {"attribute"}')
+                ds.add_attribute_index(name, attr)
+                return self._send({"index": f"attr:{attr}"}, 201)
+            return self._error(404, f"unknown path {parsed.path!r}")
+        except KeyError as e:
+            return self._error(404, str(e))
+        except ValueError as e:
+            return self._error(400, str(e))
+        except Exception as e:  # pragma: no cover - defensive
+            return self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_PATCH(self):  # noqa: N802
+        ds = self.dataset
+        parsed = urllib.parse.urlparse(self.path)
+        parts = [p for p in parsed.path.split("/") if p]
+        try:
+            if len(parts) == 3 and parts[:2] == ["api", "schemas"]:
+                name = urllib.parse.unquote(parts[2])
+                ds.get_schema(name)  # unknown schema -> 404, before 400s
+                body = json.loads(self._read_body() or "{}")
+                add = body.get("add_spec")
+                if not add:
+                    return self._error(400, 'body must be {"add_spec"}')
+                ft = ds.update_schema(name, add)
+                return self._send({"name": name, "spec": ft.spec()})
             return self._error(404, f"unknown path {parsed.path!r}")
         except KeyError as e:
             return self._error(404, str(e))
@@ -253,6 +288,12 @@ class _Handler(BaseHTTPRequestHandler):
                                             "DELETE to drop everything)")
                 n = ds.delete_features(name, cql, auths=auths)
                 return self._send({"deleted": int(n)})
+            if len(parts) == 5 and parts[:2] == ["api", "schemas"] \
+                    and parts[3] == "indices":
+                name = urllib.parse.unquote(parts[2])
+                attr = urllib.parse.unquote(parts[4])
+                ds.remove_attribute_index(name, attr)
+                return self._send({"removed": f"attr:{attr}"})
             return self._error(404, f"unknown path {parsed.path!r}")
         except KeyError as e:
             return self._error(404, str(e))

@@ -41,7 +41,11 @@ public class GeoMesaTpuDataStore implements DataStore {
     private volatile boolean disposed;
 
     GeoMesaTpuDataStore(String restUrl) {
-        this.client = new TpuRestClient(restUrl);
+        this(restUrl, null);
+    }
+
+    GeoMesaTpuDataStore(String restUrl, String auths) {
+        this.client = new TpuRestClient(restUrl, auths);
     }
 
     private void checkOpen() throws IOException {
@@ -62,32 +66,28 @@ public class GeoMesaTpuDataStore implements DataStore {
         schemaCache.remove(featureType.getTypeName());
     }
 
+    /** Java class -> spec type name (shared by create/update paths). */
+    private static String specType(Class<?> b) {
+        if (b == Integer.class) return "Integer";
+        if (b == Long.class) return "Long";
+        if (b == Float.class) return "Float";
+        if (b == Double.class) return "Double";
+        if (b == Boolean.class) return "Boolean";
+        if (b == java.util.Date.class) return "Date";
+        return "String";
+    }
+
     /** Build a spec string from any SimpleFeatureType implementation. */
     private static String specOf(SimpleFeatureType ft) {
         StringBuilder spec = new StringBuilder();
         for (String name : ft.getAttributeNames()) {
             if (spec.length() > 0) spec.append(',');
-            Class<?> b = ft.getType(name);
-            String t;
             if (name.equals(ft.getGeometryAttribute())) {
-                spec.append('*');
-                t = "Point";
-            } else if (b == Integer.class) {
-                t = "Integer";
-            } else if (b == Long.class) {
-                t = "Long";
-            } else if (b == Float.class) {
-                t = "Float";
-            } else if (b == Double.class) {
-                t = "Double";
-            } else if (b == Boolean.class) {
-                t = "Boolean";
-            } else if (b == java.util.Date.class) {
-                t = "Date";
+                spec.append('*').append(name).append(":Point");
             } else {
-                t = "String";
+                spec.append(name).append(':')
+                    .append(specType(ft.getType(name)));
             }
-            spec.append(name).append(':').append(t);
         }
         return spec.toString();
     }
@@ -113,12 +113,48 @@ public class GeoMesaTpuDataStore implements DataStore {
                                        SimpleFeatureType featureType)
             throws IOException {
         checkOpen();
-        // the server's update path is append-only attribute addition
-        // (GeoMesaDataStore.scala:288-336 validates transitions the same
-        // way); surfaced via the CLI/py API — not this transport yet
-        throw new UnsupportedOperationException(
-                "updateSchema over REST is not supported yet; use the "
-                + "geomesa-tpu CLI (update-schema)");
+        // append-only attribute addition — the ONLY transition the
+        // reference's updateSchema permits (GeoMesaDataStore.scala:
+        // 288-336 validates and rejects everything else). Removed or
+        // retyped attributes are rejected loudly rather than silently
+        // ignored; server-side the append is in place (no row re-flush).
+        SimpleFeatureType current = getSchema(typeName);
+        for (String name : current.getAttributeNames()) {
+            if (!featureType.getAttributeNames().contains(name)) {
+                throw new UnsupportedOperationException(
+                        "updateSchema is append-only: cannot remove "
+                        + "attribute " + name);
+            }
+            if (name.equals(current.getGeometryAttribute())) {
+                continue; // geometry bindings are opaque in this client
+            }
+            if (!specType(current.getType(name)).equals(
+                    specType(featureType.getType(name)))) {
+                throw new UnsupportedOperationException(
+                        "updateSchema is append-only: cannot change the "
+                        + "type of attribute " + name);
+            }
+        }
+        StringBuilder add = new StringBuilder();
+        for (String name : featureType.getAttributeNames()) {
+            if (current.getAttributeNames().contains(name)) {
+                continue;
+            }
+            Class<?> b = featureType.getType(name);
+            if (name.equals(featureType.getGeometryAttribute())
+                    || b == Object.class) {
+                // Object.class is this client's binding for every
+                // geometry type — adding geometries is not supported
+                throw new UnsupportedOperationException(
+                        "cannot add geometry attributes to a schema");
+            }
+            if (add.length() > 0) add.append(',');
+            add.append(name).append(':').append(specType(b));
+        }
+        if (add.length() > 0) {
+            client.updateSchema(typeName, add.toString());
+        }
+        schemaCache.remove(typeName);
     }
 
     @Override public void updateSchema(Name typeName,
@@ -212,6 +248,23 @@ public class GeoMesaTpuDataStore implements DataStore {
             throws IOException {
         checkOpen();
         return client.deleteFeatures(typeName, ecql);
+    }
+
+    /** Enable an attribute index on a live schema (no store recreate;
+     * the server builds only the new permutation). */
+    public void addAttributeIndex(String typeName, String attribute)
+            throws IOException {
+        checkOpen();
+        client.addAttributeIndex(typeName, attribute);
+        schemaCache.remove(typeName);
+    }
+
+    /** Drop an attribute index; data is untouched. */
+    public void removeAttributeIndex(String typeName, String attribute)
+            throws IOException {
+        checkOpen();
+        client.removeAttributeIndex(typeName, attribute);
+        schemaCache.remove(typeName);
     }
 
     // -- infrastructure ---------------------------------------------------

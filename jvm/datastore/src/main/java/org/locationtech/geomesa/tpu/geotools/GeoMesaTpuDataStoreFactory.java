@@ -62,7 +62,10 @@ public class GeoMesaTpuDataStoreFactory implements DataStoreFactorySpi {
     @Override public DataStore createDataStore(Map<String, ?> params)
             throws IOException {
         Object url = REST_URL_PARAM.lookUp(params);
-        return new GeoMesaTpuDataStore(String.valueOf(url));
+        Object auths = AUTHS_PARAM.lookUp(params);
+        return new GeoMesaTpuDataStore(
+                String.valueOf(url),
+                auths == null ? null : String.valueOf(auths));
     }
 
     @Override public DataStore createNewDataStore(Map<String, ?> params)

@@ -21,7 +21,10 @@ import java.util.Map;
  *   GET    /api/schemas
  *   GET    /api/schemas/{name}
  *   POST   /api/schemas                       {"name","spec"}
+ *   PATCH  /api/schemas/{name}                {"add_spec"}
  *   DELETE /api/schemas/{name}
+ *   POST   /api/schemas/{name}/indices        {"attribute"}
+ *   DELETE /api/schemas/{name}/indices/{attr}
  *   GET    /api/schemas/{name}/count?cql=
  *   GET    /api/schemas/{name}/bounds
  *   GET    /api/schemas/{name}/features?cql=&max=
@@ -36,11 +39,17 @@ import java.util.Map;
  */
 final class TpuRestClient {
     private final String base;
+    private final String auths;
     private final HttpClient http;
 
     TpuRestClient(String baseUrl) {
+        this(baseUrl, null);
+    }
+
+    TpuRestClient(String baseUrl, String auths) {
         this.base = baseUrl.endsWith("/")
                 ? baseUrl.substring(0, baseUrl.length() - 1) : baseUrl;
+        this.auths = auths;
         this.http = HttpClient.newBuilder()
                 .connectTimeout(Duration.ofSeconds(10))
                 .build();
@@ -57,6 +66,11 @@ final class TpuRestClient {
         HttpRequest.Builder rb = HttpRequest.newBuilder()
                 .uri(URI.create(base + path))
                 .timeout(Duration.ofSeconds(120));
+        if (auths != null && !auths.isEmpty()) {
+            // visibility authorizations ride every request (the server
+            // enforces them on reads AND delete-by-filter)
+            rb.header("X-Geomesa-Auths", auths);
+        }
         if (body == null) {
             rb.method(method, HttpRequest.BodyPublishers.noBody());
         } else {
@@ -109,6 +123,25 @@ final class TpuRestClient {
 
     void deleteSchema(String name) throws IOException {
         send("DELETE", "/api/schemas/" + enc(name), null);
+    }
+
+    /** Append-only schema update: returns the new spec string. */
+    String updateSchema(String name, String addSpec) throws IOException {
+        return (String) MiniJson.parseObject(send(
+                "PATCH", "/api/schemas/" + enc(name),
+                MiniJson.write(Map.of("add_spec", addSpec)))).get("spec");
+    }
+
+    void addAttributeIndex(String name, String attribute)
+            throws IOException {
+        send("POST", "/api/schemas/" + enc(name) + "/indices",
+                MiniJson.write(Map.of("attribute", attribute)));
+    }
+
+    void removeAttributeIndex(String name, String attribute)
+            throws IOException {
+        send("DELETE", "/api/schemas/" + enc(name) + "/indices/"
+                + enc(attribute), null);
     }
 
     long count(String name, String cql) throws IOException {

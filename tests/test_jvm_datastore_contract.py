@@ -103,10 +103,11 @@ def test_rest_client_endpoints_exist_in_router():
         assert f'"/{op}' in client_src or f"/{op}?" in client_src
         assert f'"{op}"' in web_src, f"web.py does not route {op!r}"
     # the write surface exists in the router
-    assert "def do_POST" in web_src and "def do_DELETE" in web_src
+    assert "def do_POST" in web_src and "def do_DELETE" in web_src \
+        and "def do_PATCH" in web_src
     # methods the client sends are exactly the ones the router handles
-    methods = set(re.findall(r'send\("(\w+)"', client_src))
-    assert methods == {"GET", "POST", "DELETE"}
+    methods = set(re.findall(r'send\(\s*"(\w+)"', client_src))
+    assert methods == {"GET", "POST", "DELETE", "PATCH"}
 
 
 def test_spi_registration_and_shape():
@@ -149,3 +150,37 @@ def test_javac_compiles_module_when_available():
     res = subprocess.run([javac, "-d", str(out)] + srcs,
                          capture_output=True, text=True, timeout=300)
     assert res.returncode == 0, res.stderr
+
+
+def test_schema_update_and_index_over_rest(rest_base):
+    """The Java DataStore's updateSchema + index lifecycle transport."""
+    base = rest_base
+    _req(base, "/api/schemas", "POST", json.dumps(
+        {"name": "u", "spec": "v:Integer,*geom:Point"}))
+    fc = {"type": "FeatureCollection", "features": [
+        {"type": "Feature", "id": f"f{i}",
+         "geometry": {"type": "Point", "coordinates": [float(i), 0.0]},
+         "properties": {"v": i}} for i in range(6)]}
+    _req(base, "/api/schemas/u/features", "POST", json.dumps(fc))
+    # PATCH appends attributes in place
+    body, code = _req(base, "/api/schemas/u", "PATCH",
+                      json.dumps({"add_spec": "tag:String,score:Double"}))
+    assert code == 200 and "score:Double" in body["spec"]
+    # index lifecycle
+    body, code = _req(base, "/api/schemas/u/indices", "POST",
+                      json.dumps({"attribute": "v"}))
+    assert code == 201 and body["index"] == "attr:v"
+    desc, _ = _req(base, "/api/schemas/u")
+    assert "attr:v" in desc["indices"]
+    body, code = _req(base, "/api/schemas/u/indices/v", "DELETE")
+    assert code == 200
+    desc, _ = _req(base, "/api/schemas/u")
+    assert "attr:v" not in desc["indices"]
+    # errors: unknown schema 404, bad body 400
+    _, code = _req(base, "/api/schemas/nope/indices", "POST",
+                   json.dumps({"attribute": "v"}))
+    assert code == 404
+    _, code = _req(base, "/api/schemas/u/indices", "POST", "{}")
+    assert code == 400
+    _, code = _req(base, "/api/schemas/u/indices/nosuch", "DELETE")
+    assert code == 404

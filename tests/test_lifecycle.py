@@ -219,3 +219,22 @@ def test_add_index_with_explicit_indices_list(tmp_path):
     ds2 = GeoDataset.load(p, prefer_device=False)
     assert "attr:weight" in [k.name for k in ds2._store("t").keyspaces]
     assert ds2.count("t", "weight > 7.5") == oracle
+
+
+def test_cli_index_lifecycle(tmp_path, capsys):
+    from geomesa_tpu import cli
+
+    cat = str(tmp_path / "cat")
+    ds = GeoDataset(n_shards=2, prefer_device=False)
+    ds.create_schema("t", SPEC)
+    ds.insert("t", _data(1000), fids=np.arange(1000).astype(str))
+    ds.flush()
+    ds.save(cat)
+    cli.main(["add-attribute-index", "--catalog", cat,
+              "--feature-name", "t", "--attribute", "weight"])
+    ds2 = GeoDataset.load(cat, prefer_device=False)
+    assert "attr:weight" in [k.name for k in ds2._store("t").keyspaces]
+    cli.main(["remove-attribute-index", "--catalog", cat,
+              "--feature-name", "t", "--attribute", "weight"])
+    ds3 = GeoDataset.load(cat, prefer_device=False)
+    assert "attr:weight" not in [k.name for k in ds3._store("t").keyspaces]
