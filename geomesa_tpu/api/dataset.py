@@ -525,18 +525,25 @@ class GeoDataset:
                 q.sort_by
                 and q.max_features is not None
                 and 0 < q.max_features <= topk_max
-                and hasattr(ex, "top_rows")
             ):
                 attr, desc = q.sort_by[0]
-                idx = ex.top_rows(plan, attr, desc, q.max_features,
-                                  include_ties=len(q.sort_by) > 1)
-                if idx is not None:
-                    table = st.tables[plan.index_name]
-                    names = None
-                    if plan.hints.properties:
-                        names = list(plan.hints.properties) + [
-                            a for a, _ in q.sort_by]
-                    batch = table.host_gather_positions(idx, names)
+                ties = len(q.sort_by) > 1
+                names = None
+                if plan.hints.properties:
+                    names = list(plan.hints.properties) + [
+                        a for a, _ in q.sort_by]
+                if hasattr(ex, "top_rows"):
+                    idx = ex.top_rows(plan, attr, desc, q.max_features,
+                                      include_ties=ties)
+                    if idx is not None:
+                        table = st.tables[plan.index_name]
+                        batch = table.host_gather_positions(idx, names)
+                elif hasattr(ex, "top_batch"):
+                    # partitioned store: per-partition candidate top-ks,
+                    # exact-sorted + truncated below
+                    batch = ex.top_batch(plan, attr, desc, q.max_features,
+                                         names, include_ties=ties)
+                if batch is not None:
                     plan.__dict__.setdefault("exec_path", {})[
                         "sort"] = f"device-topk(k={q.max_features})"
             if batch is None:

@@ -162,6 +162,43 @@ class PartitionedExecutor:
         batches = list(self.features_iter(plan))
         return ColumnBatch.concat(batches) if batches else ColumnBatch({}, 0)
 
+    def top_batch(self, plan: QueryPlan, attr: str, descending: bool,
+                  k: int, names=None,
+                  include_ties: bool = False) -> Optional[ColumnBatch]:
+        """Candidate rows for a sorted+limited query over the partitioned
+        store: each pruned partition contributes ITS OWN device-selected
+        top-k candidates (threshold select, boundary ties included when
+        asked), so the union provably contains the global top-k — the
+        caller's exact host sort + truncate finishes the job. Partitions
+        whose device selection declines (tie overflow, NaN-keyed
+        underfill) contribute their full match set instead, which is
+        still a superset. The reference sorts client-side after merging
+        per-partition scans (QueryPlanner.runQuery); here each partition
+        ships at most k + tie-slack rows to the host."""
+        parts: List[ColumnBatch] = []
+        pushed = 0
+        for b, ex in self._each(plan):
+            idx = ex.top_rows(plan, attr, descending, k,
+                              include_ties=include_ties)
+            if idx is None:
+                batch = ex.features(plan)
+            elif len(idx) == 0:
+                pushed += 1  # device ran and found nothing: still pushdown
+                continue
+            else:
+                pushed += 1
+                table = ex.store.tables[plan.index_name]
+                batch = table.host_gather_positions(idx, names)
+            if batch.n:
+                parts.append(batch)
+        if pushed == 0:
+            # no partition device-selected anything: report None so the
+            # caller runs (and its audit records) the plain gather path
+            return None
+        if not parts:
+            return ColumnBatch({}, 0)
+        return ColumnBatch.concat(parts)
+
     def knn_features(self, plan: QueryPlan, x: float, y: float,
                      k: int, boxes=None) -> ColumnBatch:
         """Per-partition top-k gathered and merged; the union of partition

@@ -269,3 +269,73 @@ def test_underfilled_topk_falls_back(ds_data):
     want = np.sort(data["weight"][m].astype(np.float64))[::-1]
     assert np.allclose(np.asarray(fc.batch.columns["weight"], np.float64),
                        want)
+
+
+def test_partitioned_sorted_query_pushdown(tmp_path):
+    """r5: sorted+limited queries on a PARTITIONED store push per-
+    partition top-k candidate selection down instead of gathering every
+    match; results match a flat store exactly."""
+    from geomesa_tpu.filter.ecql import parse_iso_ms as iso
+
+    rng = np.random.default_rng(11)
+    n = 30_000
+    data = {
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+        "dtg": rng.integers(iso("2020-01-01"), iso("2020-03-01"), n
+                            ).astype("datetime64[ms]"),
+        "weight": rng.uniform(0, 1, n),
+        "code": rng.integers(0, 50, n).astype(np.int32),
+    }
+    spec = "weight:Double,code:Integer,dtg:Date,*geom:Point"
+    flat = GeoDataset(n_shards=2)
+    flat.create_schema("t", spec)
+    flat.insert("t", data, fids=np.arange(n).astype(str))
+    flat.flush()
+    part = GeoDataset(n_shards=2)
+    part.create_schema("t", spec + ";geomesa.partition='time'")
+    st = part._store("t")
+    st.max_resident = 2
+    st._spill_dir = str(tmp_path / "spill")
+    part.insert("t", data, fids=np.arange(n).astype(str))
+    part.flush()
+    q = Query("BBOX(geom, -110, 28, -80, 48)",
+              sort_by=[("weight", True), ("code", False)],
+              max_features=800)
+    a = flat.query("t", q).batch
+    b = part.query("t", q).batch
+    assert a.n == b.n == 800
+    assert np.allclose(np.asarray(a.columns["weight"], np.float64),
+                       np.asarray(b.columns["weight"], np.float64))
+    assert np.array_equal(a.columns["code"], b.columns["code"])
+    ev = part.audit.recent(1)[0]
+    assert "device-topk" in str(ev.hints.get("exec_path", {}))
+
+
+def test_partitioned_string_sort_not_stamped_as_pushdown(tmp_path):
+    """Review r5: when every partition declines device selection (string
+    sort key), the audit must NOT claim device-topk."""
+    from geomesa_tpu.filter.ecql import parse_iso_ms as iso
+
+    rng = np.random.default_rng(13)
+    n = 4000
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema(
+        "t", "kind:String,dtg:Date,*geom:Point;geomesa.partition='time'")
+    st = ds._store("t")
+    st._spill_dir = str(tmp_path / "spill")
+    data = {
+        "kind": rng.choice(["a", "b", "c"], n),
+        "dtg": rng.integers(iso("2020-01-01"), iso("2020-03-01"), n
+                            ).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-10, 10, n),
+        "geom__y": rng.uniform(-10, 10, n),
+    }
+    ds.insert("t", data, fids=np.arange(n).astype(str))
+    ds.flush()
+    fc = ds.query("t", Query("INCLUDE", sort_by=[("kind", False)],
+                             max_features=5))
+    got = st.dicts["kind"].decode(fc.batch.columns["kind"])
+    assert got == sorted(data["kind"].astype(str))[:5]
+    ev = ds.audit.recent(1)[0]
+    assert "device-topk" not in str(ev.hints.get("exec_path", {}))
