@@ -146,13 +146,15 @@ def _pip_fn(g: geo.Geometry, xcol: str, ycol: str, need_band=None,
             mesh = pk.current_mesh()
             run = None
             if mesh is None and pk.use_pallas():
+                pk.record_dispatch("pip", "pallas")
                 run = lambda packed: pk.pip_mask(  # noqa: E731
                     x, y, packed, interpret=pk.interpret_mode()
                 )
             elif (
                 mesh is not None and x.ndim == 2
-                and pk.use_pallas_sharded(mesh, x.shape[0])
+                and pk.use_pallas_sharded(mesh, x.shape[0], kernel="pip")
             ):
+                pk.record_dispatch("pip", "pallas-sharded")
                 run = lambda packed: pk.pip_mask_sharded(  # noqa: E731
                     x, y, packed, mesh, interpret=pk.interpret_mode()
                 )

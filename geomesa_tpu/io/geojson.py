@@ -38,6 +38,11 @@ def _shape_to_json(g: geo.Geometry) -> Dict:
         }
     if isinstance(g, geo.MultiPoint):
         return {"type": "MultiPoint", "coordinates": [[p.x, p.y] for p in g.points]}
+    if isinstance(g, geo.MultiLineString):
+        return {
+            "type": "MultiLineString",
+            "coordinates": [[list(p) for p in ls.coords] for ls in g.lines],
+        }
     if isinstance(g, geo.MultiPolygon):
         return {
             "type": "MultiPolygon",

@@ -80,14 +80,44 @@ def use_pallas() -> bool:
     return _backend_ok()
 
 
-def use_pallas_sharded(mesh, lead_dim: int) -> bool:
+def use_pallas_sharded(mesh, lead_dim: int, kernel: str = None) -> bool:
     """Sharded dispatch gate: backend ok, mesh has a 'shard' axis that
     evenly divides the leading (shard) dimension — shard_map requires
-    exact divisibility, unlike GSPMD."""
+    exact divisibility, unlike GSPMD. Pass ``kernel`` to record an
+    uneven-mesh refusal as that kernel's dispatch (bare capability
+    probes record nothing)."""
     if mesh is None or not _backend_ok():
         return False
     size = dict(zip(mesh.axis_names, mesh.devices.shape)).get("shard")
-    return bool(size) and lead_dim % size == 0
+    if not size:
+        return False
+    if lead_dim % size != 0:
+        if kernel is not None:
+            # the fallback to the XLA broadcast path used to be silent —
+            # the dispatch record makes it visible in explain/audit
+            record_dispatch(kernel,
+                            f"xla-fallback(uneven mesh: {lead_dim} rows"
+                            f" % {size} shards != 0)")
+        return False
+    return True
+
+
+def record_dispatch(kernel: str, choice: str) -> None:
+    """Note a kernel-dispatch decision. Decisions happen at TRACE time,
+    so a record exists only for the execution that compiled the kernel;
+    cached-kernel reuse produces none (exec_path's ``kernel:*`` entries
+    are compile-time attribution). The executor drains these into
+    ``plan.exec_path`` once per run."""
+    if getattr(_tls, "dispatch", None) is None:
+        _tls.dispatch = {}
+    _tls.dispatch[kernel] = choice
+
+
+def take_dispatch() -> dict:
+    """Drain the per-thread dispatch records."""
+    out = getattr(_tls, "dispatch", None) or {}
+    _tls.dispatch = {}
+    return out
 
 
 def polygon_edge_tables(poly):

@@ -1226,6 +1226,22 @@ class Executor:
                   else dict(zip(self.mesh.axis_names,
                                 self.mesh.devices.shape))),
         )
+        from geomesa_tpu.kernels import pallas_kernels as _pk
+
+        _pk.take_dispatch()  # drop records a prior query's trace left
+        try:
+            return self._run_inner(
+                plan, setup, agg_fn_dev, agg_fn_host, agg_cols, cache_key,
+                additive, extra, compactable, compact_agg,
+            )
+        finally:
+            disp = _pk.take_dispatch()
+            if disp:
+                self._note(plan, **{f"kernel:{k}": v
+                                    for k, v in disp.items()})
+
+    def _run_inner(self, plan, setup, agg_fn_dev, agg_fn_host, agg_cols,
+                   cache_key, additive, extra, compactable, compact_agg):
         corr = None
         band_rows = 0
         if setup["use_device"] and plan.compiled.band is not None:

@@ -339,3 +339,25 @@ def test_partitioned_string_sort_not_stamped_as_pushdown(tmp_path):
     assert got == sorted(data["kind"].astype(str))[:5]
     ev = ds.audit.recent(1)[0]
     assert "device-topk" not in str(ev.hints.get("exec_path", {}))
+
+
+def test_pallas_uneven_mesh_fallback_is_recorded(monkeypatch):
+    """r5: the use_pallas_sharded uneven-mesh XLA fallback (previously
+    silent, pallas_kernels.py gate) leaves a dispatch record."""
+    import jax
+    from jax.sharding import Mesh
+
+    from geomesa_tpu.kernels import pallas_kernels as pk
+
+    monkeypatch.setattr(pk, "_backend_ok", lambda: True)
+    mesh = Mesh(np.array(jax.devices()[:8]), axis_names=("shard",))
+    pk.take_dispatch()  # drain
+    assert pk.use_pallas_sharded(mesh, 16, kernel="pip")  # even: no record
+    assert pk.take_dispatch() == {}
+    # bare capability probes stay side-effect-free
+    assert not pk.use_pallas_sharded(mesh, 7)
+    assert pk.take_dispatch() == {}
+    # named refusal is recorded
+    assert not pk.use_pallas_sharded(mesh, 7, kernel="pip")
+    d = pk.take_dispatch()
+    assert "xla-fallback" in d["pip"] and "7 rows" in d["pip"]

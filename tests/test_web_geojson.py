@@ -316,3 +316,20 @@ def test_rest_write_error_mapping_and_auths():
             assert json.loads(r.read().decode())["deleted"] == 4
     finally:
         srv.shutdown()
+
+
+def test_geojson_multilinestring_round_trip():
+    """to_geojson/from_geojson are symmetric for MultiLineString."""
+    from geomesa_tpu.io import geojson as gj
+
+    ds = GeoDataset(n_shards=1)
+    ft = ds.create_schema("mls", "*geom:MultiLineString")
+    wkt = "MULTILINESTRING ((0 0, 1 1), (2 2, 3 3, 4 4))"
+    ds.insert("mls", {"geom": [wkt]}, fids=["a"])
+    ds.flush("mls")
+    st = ds._store("mls")
+    doc = gj.to_geojson(ft, st._all, st.dicts)
+    g = doc["features"][0]["geometry"]
+    assert g["type"] == "MultiLineString" and len(g["coordinates"]) == 2
+    data, fids = gj.from_geojson(ft, doc)
+    assert data["geom"][0].startswith("MULTILINESTRING")
