@@ -13,7 +13,9 @@ rc, "probe_error": <stderr tail>; the process then exits 3 — parseable JSON
 AND a failure exit code, never a bare non-zero exit with no JSON.
 
 Env knobs: GEOMESA_BENCH_N (points, default 20M), GEOMESA_BENCH_ITERS,
-GEOMESA_BENCH_PROBE_{ATTEMPTS,TIMEOUT,BACKOFF}, GEOMESA_BENCH_RESET_CMD.
+GEOMESA_BENCH_PROBE_{ATTEMPTS,TIMEOUT,BACKOFF}, GEOMESA_BENCH_RESET_CMD,
+GEOMESA_BENCH_WALL_TIMEOUT (whole-run watchdog seconds, default 1800,
+0 disables — raise it for runs expected to exceed 30 minutes).
 """
 
 import json
@@ -93,9 +95,42 @@ def _probe_device() -> "dict | None":
     return failure
 
 
+def _arm_watchdog() -> None:
+    """The probe catches a PRE-wedged device; this catches one that
+    wedges MID-run (enqueue acks but execution never completes — the
+    bench would hang past the probe and the round would again end with
+    no JSON). After GEOMESA_BENCH_WALL_TIMEOUT seconds the watchdog
+    prints the failure line and hard-exits."""
+    import threading
+
+    wall_s = int(os.environ.get("GEOMESA_BENCH_WALL_TIMEOUT", 1800))
+    if wall_s <= 0:
+        return
+
+    def fire():
+        sys.stderr.write(
+            f"bench exceeded the {wall_s}s wall-clock watchdog "
+            "(device wedged mid-run?)\n"
+        )
+        print(json.dumps({
+            "metric": "bbox_time_density_scan_throughput",
+            "value": 0,
+            "unit": "features/sec",
+            "vs_baseline": 0,
+            "device_unreachable": True,
+            "probe_error": f"wall-clock watchdog fired after {wall_s}s",
+        }), flush=True)
+        os._exit(3)
+
+    t = threading.Timer(wall_s, fire)
+    t.daemon = True
+    t.start()
+
+
 def main():
     n = int(os.environ.get("GEOMESA_BENCH_N", 20_000_000))
     iters = int(os.environ.get("GEOMESA_BENCH_ITERS", 10))
+    _arm_watchdog()
     probe_failure = _probe_device()
     if probe_failure is not None:
         # Still ONE parseable JSON line: the driver records the round's

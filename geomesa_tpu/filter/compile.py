@@ -164,6 +164,15 @@ def _pip_fn(g: geo.Geometry, xcol: str, ycol: str, need_band=None,
                     inside = run(packed)
                     out = inside if out is None else (out | inside)
                 return out
+            # record WHY the hand kernel was skipped (the uneven-mesh
+            # case records inside use_pallas_sharded)
+            if mesh is None:
+                pk.record_dispatch("pip", "xla-fallback(no pallas backend)")
+            elif x.ndim != 2:
+                pk.record_dispatch("pip", "xla-fallback(1-D layout)")
+        elif xp is not np:
+            pk.record_dispatch(
+                "pip", "xla-fallback(edge table exceeds the VMEM cap)")
         # backend-generic broadcast path: trailing-axis broadcast handles
         # 1-D host shards and [S, L] device layouts alike
         out = None

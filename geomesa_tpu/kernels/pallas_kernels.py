@@ -110,14 +110,20 @@ def record_dispatch(kernel: str, choice: str) -> None:
     ``plan.exec_path`` once per run."""
     if getattr(_tls, "dispatch", None) is None:
         _tls.dispatch = {}
-    _tls.dispatch[kernel] = choice
+    # a query may trace several predicates of the same kernel kind with
+    # different outcomes (e.g. one pallas, one fallback): keep them all
+    seen = _tls.dispatch.setdefault(kernel, [])
+    if choice not in seen:
+        seen.append(choice)
 
 
 def take_dispatch() -> dict:
-    """Drain the per-thread dispatch records."""
+    """Drain the per-thread dispatch records (kernel -> choice, with
+    multiple distinct outcomes joined)."""
     out = getattr(_tls, "dispatch", None) or {}
     _tls.dispatch = {}
-    return out
+    return {k: v[0] if len(v) == 1 else " + ".join(v)
+            for k, v in out.items()}
 
 
 def polygon_edge_tables(poly):
