@@ -103,6 +103,33 @@ def main():
         assert ds2.count("gdelt", ecql) == ds.count("gdelt", ecql)
         print("checkpoint round-trip OK")
 
+    # -- 6. round-5 surfaces ----------------------------------------------
+    # expression comparisons: property-vs-property, arithmetic, st_* calls
+    n_expr = ds.count("gdelt", ecql + " AND score * 2 > 10")
+    n_fn = ds.count(
+        "gdelt",
+        "st_distanceSphere(geom, st_geomFromWKT('POINT (-90 38)')) < 300000")
+    print(f"expression filters: score*2>10 -> {n_expr}, "
+          f"within 300km of (-90,38) -> {n_fn}")
+
+    # device top-k sort pushdown (threshold select): multi-key sorts stay
+    # exact — the device gathers primary-key candidates with boundary
+    # ties, the host finishes the lexicographic order
+    top = ds.query("gdelt", Query(
+        ecql=ecql, sort_by=[("event", False), ("score", True)],
+        max_features=3))
+    print("top-3 by (event asc, score desc):",
+          list(zip(top.to_dict()["event"],
+                   [round(float(v), 2) for v in top.columns["score"]])))
+
+    # live index lifecycle: enable an attribute index without recreating
+    ds.add_attribute_index("gdelt", "score")
+    print(ds.describe("gdelt").splitlines()[-1].strip())
+
+    # CRS: results in web mercator (closed-form; UTM/5070/3035 also built in)
+    merc = ds.query("gdelt", Query(ecql=ecql, max_features=1, srid=3857))
+    print("EPSG:3857 x:", round(float(merc.columns["geom__x"][0]), 1))
+
 
 if __name__ == "__main__":
     main()
