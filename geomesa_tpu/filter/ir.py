@@ -267,6 +267,11 @@ def extract_geometries(f: Filter, geom_prop: str) -> FilterValues:
                 g = walk(c)
                 if g is None:
                     continue
+                if not g:
+                    # a provably-empty arm (EXCLUDE, folded constants)
+                    # empties the whole conjunction — and must not reach
+                    # _union_bounds, which needs >= 1 geometry
+                    return []
                 if geoms is None:
                     geoms, bounds = g, _union_bounds(g)
                 else:
