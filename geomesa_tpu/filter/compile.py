@@ -1147,6 +1147,17 @@ def compile_filter(
                 )
             a = ft.attr(node.prop)
             col = node.prop
+            if (
+                a.type in ("int32", "int64")
+                and isinstance(node.value, (float, np.floating))
+                and not float(node.value).is_integer()
+                and node.op in ("=", "<>")
+            ):
+                # constant result: no int equals a non-integral literal —
+                # resolved BEFORE need(col) so the scan never ships a
+                # column the predicate cannot read
+                const = node.op == "<>"
+                return lambda cols, xp, c=const: xp.asarray(c)
             need(col)
             if a.type == "string":
                 d = dicts.setdefault(node.prop, DictionaryEncoder())
@@ -1197,7 +1208,28 @@ def compile_filter(
                     return compile_node(ir.During(node.prop, v + 1, ir.MAX_MS))
                 if node.op == ">=":
                     return compile_node(ir.During(node.prop, v, ir.MAX_MS))
-            val = float(val) if a.type in ("float32", "float64") else int(val)
+            if a.type in ("float32", "float64"):
+                val = float(val)
+            elif isinstance(val, (float, np.floating)) \
+                    and not float(val).is_integer():
+                # non-integral literal vs an INT column: int(val) truncates
+                # toward zero and corrupts =, <>, >= and negative bounds
+                # (fuzz-found r5). Resolve with exact integer semantics.
+                import math
+
+                fv = float(val)
+                if node.op == "=":
+                    return lambda cols, xp: xp.asarray(False)
+                if node.op == "<>":
+                    return lambda cols, xp: xp.asarray(True)
+                if node.op in ("<", "<="):
+                    val, op = math.floor(fv), "<="
+                    node = ir.Compare(node.prop, op, val)
+                else:  # > or >=
+                    val, op = math.ceil(fv), ">="
+                    node = ir.Compare(node.prop, op, val)
+            else:
+                val = int(val)
             op = node.op
             if a.type == "float64" and not exact:
                 # f64 column rides the device as f32: rows colliding with
@@ -1303,9 +1335,18 @@ def compile_filter(
                 )
                 codes = codes[codes >= 0]
                 return _isin_fn(node.prop, codes)
-            vals = np.array(
-                [float(v) if a.type.startswith("float") else int(v) for v in node.values]
-            )
+            if a.type.startswith("float"):
+                vals = np.array([float(v) for v in node.values])
+            else:
+                # int columns: a non-integral literal can never match —
+                # drop it instead of truncating it onto a wrong integer
+                vals = np.array([
+                    int(v) for v in node.values
+                    if not (isinstance(v, (float, np.floating))
+                            and not float(v).is_integer())
+                    and -(2 ** 63) <= int(v) < 2 ** 63  # outside the
+                    # column dtype can never match: drop, don't overflow
+                ], dtype=np.int64)
             if a.type == "float64" and not exact and len(vals):
                 band_eq(node.prop, *vals.tolist())
                 if neg:
