@@ -1213,15 +1213,12 @@ def compile_filter(
             elif isinstance(val, (float, np.floating)) \
                     and not float(val).is_integer():
                 # non-integral literal vs an INT column: int(val) truncates
-                # toward zero and corrupts =, <>, >= and negative bounds
-                # (fuzz-found r5). Resolve with exact integer semantics.
+                # toward zero and corrupts ordering bounds (fuzz-found r5;
+                # = and <> resolved to constants before need(col) above).
+                # Resolve with exact integer semantics.
                 import math
 
                 fv = float(val)
-                if node.op == "=":
-                    return lambda cols, xp: xp.asarray(False)
-                if node.op == "<>":
-                    return lambda cols, xp: xp.asarray(True)
                 if node.op in ("<", "<="):
                     val, op = math.floor(fv), "<="
                     node = ir.Compare(node.prop, op, val)

@@ -1,0 +1,95 @@
+"""Randomized spatial differential tests: random polygons (convex and
+star-concave, some with holes, some multi) queried as INTERSECTS /
+DISJOINT / DWITHIN over a point table. The device-preferring store, the
+host-only store, count(), query(), and density() must all agree — this
+cross-checks the window pushdown, the PIP kernels, coarse+refine, and
+the aggregation paths against each other."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+
+N = 6_000
+
+
+def _ring(rng, cx, cy, r_lo, r_hi, k):
+    ang = np.sort(rng.uniform(0, 2 * np.pi, k))
+    rad = rng.uniform(r_lo, r_hi, k)
+    xs = cx + rad * np.cos(ang)
+    ys = cy + rad * np.sin(ang)
+    pts = ", ".join(f"{x:.4f} {y:.4f}" for x, y in zip(xs, ys))
+    first = f"{xs[0]:.4f} {ys[0]:.4f}"
+    return f"({pts}, {first})"
+
+
+def _rand_poly_wkt(rng):
+    cx, cy = rng.uniform(-6, 6, 2)
+    kind = rng.integers(0, 3)
+    if kind == 0:  # convex-ish / star polygon
+        return f"POLYGON ({_ring(rng, cx, cy, 1.0, 5.0, int(rng.integers(3, 9)))})"
+    if kind == 1:  # with a hole
+        outer = _ring(rng, cx, cy, 3.0, 5.0, int(rng.integers(4, 8)))
+        hole = _ring(rng, cx, cy, 0.5, 1.5, int(rng.integers(3, 6)))
+        return f"POLYGON ({outer}, {hole})"
+    a = f"({_ring(rng, cx, cy, 0.5, 3.0, int(rng.integers(3, 7)))})"
+    b = f"({_ring(rng, cx + 6, cy, 0.5, 3.0, int(rng.integers(3, 7)))})"
+    return f"MULTIPOLYGON ({a}, {b})"
+
+
+@pytest.fixture(scope="module")
+def spatial_pair():
+    rng = np.random.default_rng(77)
+    data = {
+        "geom__x": rng.uniform(-12, 12, N),
+        "geom__y": rng.uniform(-12, 12, N),
+    }
+    stores = []
+    for dev in (True, False):
+        ds = GeoDataset(n_shards=2, prefer_device=dev)
+        ds.create_schema("s", "*geom:Point")
+        ds.insert("s", data, fids=np.arange(N).astype(str))
+        ds.flush()
+        stores.append(ds)
+    return stores, data
+
+
+def test_random_polygons_device_host_agree(spatial_pair):
+    (dev, host), data = spatial_pair
+    rng = np.random.default_rng(17)
+    nonzero = 0
+    for case in range(40):
+        wkt = _rand_poly_wkt(rng)
+        rel = ["INTERSECTS", "DISJOINT"][rng.integers(0, 2)]
+        q = f"{rel}(geom, {wkt})"
+        a = dev.count("s", q)
+        b = host.count("s", q)
+        assert a == b, f"case {case}: {q!r} device={a} host={b}"
+        rows = len(dev.query("s", q))
+        assert rows == a, f"case {case}: query rows {rows} != count {a}"
+        if rel == "INTERSECTS" and a:
+            nonzero += 1
+            g = dev.density("s", q, bbox=(-12, -12, 12, 12),
+                            width=16, height=16)
+            assert abs(float(np.asarray(g).sum()) - a) < 1e-3, q
+        # complements partition the table exactly
+        comp = ("DISJOINT" if rel == "INTERSECTS" else "INTERSECTS")
+        assert dev.count("s", f"{comp}(geom, {wkt})") == N - a, q
+    assert nonzero >= 10  # the fuzz hit real geometry
+
+
+def test_random_dwithin_device_host_agree(spatial_pair):
+    (dev, host), data = spatial_pair
+    rng = np.random.default_rng(23)
+    from geomesa_tpu.utils.geometry import haversine_m
+
+    for case in range(20):
+        cx, cy = rng.uniform(-8, 8, 2)
+        dist = float(rng.uniform(50_000, 500_000))
+        q = f"DWITHIN(geom, POINT ({cx:.4f} {cy:.4f}), {dist:.0f}, meters)"
+        a = dev.count("s", q)
+        b = host.count("s", q)
+        assert a == b, f"case {case}: {q!r} device={a} host={b}"
+        d = haversine_m(data["geom__x"], data["geom__y"], cx, cy)
+        want = int((d <= dist).sum())
+        assert a == want, f"case {case}: {q!r} -> {a}, haversine oracle {want}"
