@@ -1,0 +1,97 @@
+"""Randomized differential test: a time-PARTITIONED store (with spilled
+partitions) must answer every random predicate tree exactly like a flat
+store over the same rows. This hammers partition pruning (time-bound
+extraction feeding bin selection) composed with window pushdown, lazy
+snapshot reload, and per-partition merge."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.filter.ecql import parse_iso_ms
+
+N = 20_000
+T0 = parse_iso_ms("2020-01-01")
+T1 = parse_iso_ms("2020-04-01")  # ~13 weekly partitions
+SPEC = "v:Double,k:Integer,dtg:Date,*geom:Point"
+
+
+@pytest.fixture(scope="module")
+def pair(tmp_path_factory):
+    rng = np.random.default_rng(55)
+    data = {
+        "v": rng.uniform(0, 10, N),
+        "k": rng.integers(0, 20, N).astype(np.int32),
+        "dtg": rng.integers(T0, T1, N).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-20, 20, N),
+        "geom__y": rng.uniform(-20, 20, N),
+    }
+    flat = GeoDataset(n_shards=2)
+    flat.create_schema("t", SPEC)
+    flat.insert("t", data, fids=np.arange(N).astype(str))
+    flat.flush()
+    part = GeoDataset(n_shards=2)
+    part.create_schema("t", SPEC + ";geomesa.partition='time'")
+    st = part._store("t")
+    st.max_resident = 2  # constant spill/reload churn
+    st._spill_dir = str(tmp_path_factory.mktemp("spill"))
+    part.insert("t", data, fids=np.arange(N).astype(str))
+    part.flush()
+    st.evict(keep=1)
+    return flat, part
+
+
+def _rand_time(rng):
+    a, b = sorted(rng.integers(T0 - 10**9, T1 + 10**9, 2))
+    ai, bi = np.datetime64(int(a), "ms"), np.datetime64(int(b), "ms")
+    form = rng.integers(0, 4)
+    if form == 0:
+        return f"dtg DURING {ai}Z/{bi}Z"
+    if form == 1:
+        return f"dtg BEFORE {ai}Z"
+    if form == 2:
+        return f"dtg AFTER {bi}Z"
+    return f"dtg TEQUALS {ai}Z"
+
+
+def _rand_pred(rng, depth):
+    if depth == 0 or rng.random() < 0.4:
+        kind = rng.integers(0, 3)
+        if kind == 0:
+            return _rand_time(rng)
+        if kind == 1:
+            op = ["<", ">", "<=", ">="][rng.integers(0, 4)]
+            return f"v {op} {rng.uniform(0, 10):.2f}"
+        x0, y0 = rng.uniform(-20, 10, 2)
+        return f"BBOX(geom, {x0:.2f}, {y0:.2f}, {x0+10:.2f}, {y0+10:.2f})"
+    k = rng.integers(0, 3)
+    lt = _rand_pred(rng, depth - 1)
+    if k == 2:
+        return f"NOT ({lt})"
+    rt = _rand_pred(rng, depth - 1)
+    return f"({lt}) {'AND' if k == 0 else 'OR'} ({rt})"
+
+
+def test_partitioned_matches_flat_on_random_trees(pair):
+    flat, part = pair
+    rng = np.random.default_rng(67)
+    nonzero = 0
+    for case in range(80):
+        q = _rand_pred(rng, 2)
+        a, b = flat.count("t", q), part.count("t", q)
+        assert a == b, f"case {case}: {q!r} flat={a} partitioned={b}"
+        nonzero += a > 0
+    assert nonzero >= 40
+
+
+def test_partitioned_matches_flat_sampling_and_stats(pair):
+    flat, part = pair
+    rng = np.random.default_rng(71)
+    for case in range(15):
+        q = _rand_pred(rng, 1)
+        sf = flat.stats("t", "MinMax(v);Count()", q).to_json()
+        sp = part.stats("t", "MinMax(v);Count()", q).to_json()
+        assert sf == sp, f"case {case}: {q!r}\n{sf}\n{sp}"
+        g1 = flat.density("t", q, bbox=(-20, -20, 20, 20), width=8, height=8)
+        g2 = part.density("t", q, bbox=(-20, -20, 20, 20), width=8, height=8)
+        assert np.allclose(np.asarray(g1), np.asarray(g2)), f"{case}: {q!r}"
