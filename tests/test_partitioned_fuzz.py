@@ -84,7 +84,7 @@ def test_partitioned_matches_flat_on_random_trees(pair):
     assert nonzero >= 40
 
 
-def test_partitioned_matches_flat_sampling_and_stats(pair):
+def test_partitioned_matches_flat_stats_and_density(pair):
     flat, part = pair
     rng = np.random.default_rng(71)
     for case in range(15):
