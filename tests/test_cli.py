@@ -6,7 +6,7 @@ import os
 import numpy as np
 import pytest
 
-from geomesa_tpu import cli
+from geomesa_tpu import GeoDataset, cli
 
 CSV = """id,name,age,date,lon,lat
 a1,alice,30,2020-01-05,-100.0,40.0
@@ -154,3 +154,72 @@ def test_cli_update_schema_and_manage_partitions(tmp_path, capsys):
           "--older-than", "2021-06-08"])
     out = capsys.readouterr().out
     assert "removed" in out
+
+
+def test_cli_env_convert_playback_compact(tmp_path, capsys):
+    """The previously-untested CLI commands: env, convert (dry run),
+    playback --fast, fs compact."""
+    # env: prints every registered tunable with a source column
+    cli.main(["env"])
+    out = capsys.readouterr().out
+    assert "geomesa.scan.ranges.target" in out
+    assert "geomesa.sample.hash-buckets" in out
+
+    # convert: dry-run a delimited config against a csv, nothing ingested
+    cfg = tmp_path / "conv.conf"
+    cfg.write_text(
+        'type = "delimited-text"\n'
+        'format = "CSV"\n'
+        'id-field = "$fid"\n'
+        'fields = [\n'
+        '  { name = "fid", transform = "$1" }\n'
+        '  { name = "name", transform = "$2" }\n'
+        '  { name = "lon", transform = "toDouble($3)" }\n'
+        '  { name = "lat", transform = "toDouble($4)" }\n'
+        '  { name = "geom", transform = "point($lon, $lat)" }\n'
+        ']\n'
+    )
+    csv = tmp_path / "in.csv"
+    csv.write_text("a1,alpha,1.5,2.5\na2,beta,3.0,4.0\n")
+    cli.main(["convert", "-f", "conv", "-s", "name:String,*geom:Point",
+              "-C", str(cfg), "-i", str(csv)])
+    cap = capsys.readouterr()
+    assert "alpha" in cap.out and "beta" in cap.out
+    assert "converted: 2 ok, 0 failed" in cap.err
+
+    # playback --fast over a saved catalog
+    cat = str(tmp_path / "cat")
+    ds = GeoDataset(n_shards=1, prefer_device=False)
+    ds.create_schema("pb", "v:Integer,dtg:Date,*geom:Point")
+    ds.insert("pb", {
+        "v": np.arange(5, dtype=np.int32),
+        "dtg": (np.arange(5) * 1000 + 1577836800000).astype("datetime64[ms]"),
+        "geom__x": np.arange(5.0), "geom__y": np.zeros(5),
+    }, fids=np.arange(5).astype(str))
+    ds.flush()
+    ds.save(cat)
+    cli.main(["playback", "--catalog", cat, "--feature-name", "pb", "--fast"])
+    out = capsys.readouterr().out
+    assert "played back 5 features" in out
+
+    # compact over a filesystem store
+    from geomesa_tpu.fs import FileSystemStorage
+    from geomesa_tpu.fs.storage import DateTimeScheme
+
+    root = str(tmp_path / "fs")
+    fs = FileSystemStorage(root)
+    from geomesa_tpu.schema.feature_type import FeatureType
+
+    ft = FeatureType.from_spec("c", "v:Integer,dtg:Date,*geom:Point")
+    fs.create(ft, DateTimeScheme("day"))
+    for i in range(3):  # several files in one partition
+        fs.write(
+            "c",
+            {"v": np.array([i], np.int32),
+             "dtg": np.array(["2020-01-05"], "datetime64[ms]"),
+             "geom__x": np.array([1.0]), "geom__y": np.array([2.0])},
+            fids=np.array([f"f{i}"]),
+        )
+    cli.main(["compact", "--catalog", root, "--feature-name", "c"])
+    out = capsys.readouterr().out
+    assert "compacted" in out

@@ -79,14 +79,13 @@ def test_random_stats_match_oracle(sfuzz):
         else:
             got = json.loads(ds.stats(
                 "t", "GroupBy(k,Count())", ecql).to_json())
-            by = {}
-            for _, sub in got["groups"]:
-                s = json.loads(sub)
-                # group label rides in the sub count? groups are
-                # [code, substat-json]; resolve codes via the dict
-            # oracle: total across groups == window count
-            total = sum(json.loads(sub)["count"] for _, sub in got["groups"])
-            assert total == int(m.sum()), (case, ecql)
+            # per-group exactness: group keys are dictionary codes
+            vocab = ds._store("t").dicts["k"].values
+            by = {vocab[int(code)]: json.loads(sub)["count"]
+                  for code, sub in got["groups"]}
+            keys, cnts = np.unique(d["k"][m], return_counts=True)
+            want = {str(kk): int(c) for kk, c in zip(keys, cnts)}
+            assert by == want, (case, ecql)
 
 
 def test_stats_partial_merge_associativity(sfuzz):
