@@ -1,0 +1,157 @@
+"""Golden wire-format tests (SURVEY.md §4's TPU translation item 4):
+frozen byte images of every serialization surface, so format drift is an
+explicit, reviewed change — never a silent break of external consumers
+(the JVM client, BIN viewers, Avro readers, stat JSON parsers).
+
+Regenerate with `python tests/test_golden_formats.py regen` after an
+INTENTIONAL format change, and say so in the commit message.
+"""
+
+import functools
+import io as _io
+import json
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+GOLDEN = Path(__file__).parent / "golden"
+
+
+def _fixture_batch():
+    from geomesa_tpu import GeoDataset
+
+    ds = GeoDataset(n_shards=1, prefer_device=False)
+    ds.create_schema(
+        "g", "name:String,v:Integer,w:Double,dtg:Date,*geom:Point")
+    ds.insert("g", {
+        "name": np.array(["alpha", "beta", "alpha"], dtype=object),
+        "v": np.array([1, -2, 3], np.int32),
+        "w": np.array([1.5, 2.25, -3.75]),
+        "dtg": np.array(["2020-01-05T00:00:01", "2020-01-06T12:30:00",
+                         "2020-01-07T23:59:59"], dtype="datetime64[ms]"),
+        "geom__x": np.array([10.0, -20.5, 30.25]),
+        "geom__y": np.array([1.0, 2.5, -3.25]),
+    }, fids=np.array(["f1", "f2", "f3"], dtype=object))
+    ds.flush()
+    st = ds._store("g")
+    return ds, st
+
+
+@functools.lru_cache(maxsize=1)
+def _artifacts():
+    """name -> bytes for every frozen surface."""
+    from geomesa_tpu.io import bin_format, twkb
+    from geomesa_tpu.io.avro_io import write_avro
+    from geomesa_tpu.schema.feature_type import FeatureType
+    from geomesa_tpu.stream.confluent import ConfluentSerializer, SchemaRegistry
+    from geomesa_tpu.stream.messages import GeoMessage
+    from geomesa_tpu.utils.geometry import parse_wkt
+
+    out = {}
+
+    # BIN track format: 16-byte and 24-byte records
+    tracks = np.array([7, 7, 9], np.int32)
+    dtg = np.array([1578182401000, 1578313800000, 1578441599000], np.int64)
+    lat = np.array([1.0, 2.5, -3.25], np.float32)
+    lon = np.array([10.0, -20.5, 30.25], np.float32)
+    out["bin16.bin"] = bin_format.pack(tracks, dtg, lat, lon)
+    out["bin24.bin"] = bin_format.pack(
+        tracks, dtg, lat, lon, labels=np.array([11, 22, 33], np.int64))
+
+    # TWKB geometries at default precision
+    out["twkb_point.bin"] = twkb.encode(parse_wkt("POINT (10.5 -3.25)"))
+    out["twkb_line.bin"] = twkb.encode(
+        parse_wkt("LINESTRING (0 0, 1.5 2.5, -3 4)"))
+    out["twkb_poly.bin"] = twkb.encode(
+        parse_wkt("POLYGON ((0 0, 4 0, 4 4, 0 4, 0 0))"))
+
+    # GeoMessage wire format (change / delete / clear)
+    out["geomessage_change.bin"] = GeoMessage.change(
+        "fid-1", {"a": 1, "b": "x"}, 1578182400123).serialize()
+    out["geomessage_delete.bin"] = GeoMessage.delete(
+        "fid-2", 1578182400456).serialize()
+    out["geomessage_clear.bin"] = GeoMessage.clear(1578182400789).serialize()
+
+    # Confluent frame: registry-assigned id 1 + avro record
+    ft = FeatureType.from_spec("c", "name:String,v:Integer,*geom:Point")
+    reg = SchemaRegistry()
+    ser = ConfluentSerializer(reg, "c-value", ft)
+    out["confluent_frame.bin"] = ser.serialize(
+        "k1", {"name": "alpha", "v": 7, "geom": "POINT (1 2)"})
+
+    # Avro container file with a FIXED sync marker
+    ds, st = _fixture_batch()
+    buf = _io.BytesIO()
+    write_avro(buf, st.ft, st._all, st.dicts, sync=b"\x00" * 16)
+    out["avro_container.bin"] = buf.getvalue()
+
+    # stat JSON (cost-model persistence format)
+    stats = {
+        "minmax": ds.stats("g", "MinMax(w)", "INCLUDE").to_json(),
+        "histogram": ds.stats("g", "Histogram(w,4,-4,4)", "INCLUDE").to_json(),
+        "enum": ds.stats("g", "Enumeration(name)", "INCLUDE").to_json(),
+        "count": ds.stats("g", "Count()", "INCLUDE").to_json(),
+    }
+    out["stats.json"] = json.dumps(stats, indent=1, sort_keys=True).encode()
+
+    # schema spec round-trip string (the catalog's persisted form)
+    out["spec.txt"] = st.ft.spec().encode()
+    return out
+
+
+GOLDEN_NAMES = (
+    "bin16.bin", "bin24.bin", "twkb_point.bin", "twkb_line.bin",
+    "twkb_poly.bin", "geomessage_change.bin", "geomessage_delete.bin",
+    "geomessage_clear.bin", "confluent_frame.bin", "avro_container.bin",
+    "stats.json", "spec.txt",
+)
+
+
+def test_golden_set_is_complete():
+    """The artifact map, the parametrize list, and the files on disk
+    must agree — a new surface without a checked golden (or a stale file)
+    is exactly the silent drift this suite exists to prevent."""
+    assert set(_artifacts()) == set(GOLDEN_NAMES)
+    on_disk = {p.name for p in GOLDEN.iterdir()}
+    assert on_disk == set(GOLDEN_NAMES)
+
+
+@pytest.mark.parametrize("name", GOLDEN_NAMES)
+def test_golden(name):
+    arts = _artifacts()
+    want = (GOLDEN / name).read_bytes()
+    got = arts[name]
+    assert got == want, (
+        f"wire format {name} drifted ({len(got)} vs {len(want)} bytes). "
+        "If intentional, regenerate: python tests/test_golden_formats.py regen"
+    )
+
+
+def test_goldens_decode():
+    """The frozen bytes must also DECODE correctly (goldens aren't just
+    stable — they are valid)."""
+    from geomesa_tpu.io import twkb
+    from geomesa_tpu.io.avro_io import read_avro
+    from geomesa_tpu.stream.messages import GeoMessage
+
+    g = twkb.decode((GOLDEN / "twkb_point.bin").read_bytes())
+    assert g.wkt().startswith("POINT")
+    m = GeoMessage.deserialize((GOLDEN / "geomessage_change.bin").read_bytes())
+    assert m.fid == "fid-1" and m.payload == {"a": 1, "b": "x"}
+    schema, rows = read_avro(_io.BytesIO(
+        (GOLDEN / "avro_container.bin").read_bytes()))
+    assert len(rows) == 3
+    assert rows[0] == {
+        "__fid__": "f1", "name": "alpha", "v": 1, "w": 1.5,
+        "dtg": 1578182401000, "geom": "POINT (10.0 1.0)",
+    }
+
+
+if __name__ == "__main__":
+    if len(sys.argv) > 1 and sys.argv[1] == "regen":
+        GOLDEN.mkdir(exist_ok=True)
+        for name, data in _artifacts().items():
+            (GOLDEN / name).write_bytes(data)
+            print(f"wrote tests/golden/{name} ({len(data)} bytes)")
