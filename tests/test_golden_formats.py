@@ -150,6 +150,8 @@ def test_goldens_decode():
 
 
 if __name__ == "__main__":
+    # direct invocation puts tests/ (not the repo root) on sys.path[0]
+    sys.path.insert(0, str(Path(__file__).parent.parent))
     if len(sys.argv) > 1 and sys.argv[1] == "regen":
         GOLDEN.mkdir(exist_ok=True)
         for name, data in _artifacts().items():

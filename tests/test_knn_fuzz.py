@@ -1,0 +1,90 @@
+"""Randomized kNN differential test: the expanding-radius, index-pruned
+kNN (geomesa-process KNearestNeighborSearchProcess analog) must return
+exactly the brute-force k nearest by great-circle distance for random
+query points, k values, and filters — including edge cases (k larger
+than matches, a query hard against the antimeridian, filters leaving
+fewer than k matches)."""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+from geomesa_tpu.utils.geometry import haversine_m
+
+N = 8_000
+
+
+@pytest.fixture(scope="module")
+def kfuzz():
+    rng = np.random.default_rng(404)
+    data = {
+        "v": rng.uniform(0, 10, N),
+        "geom__x": rng.uniform(-179.5, 179.5, N),
+        "geom__y": rng.uniform(-60, 60, N),
+    }
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "v:Double,*geom:Point")
+    ds.insert("t", data, fids=np.arange(N).astype(str))
+    ds.flush()
+    return ds, data
+
+
+def test_random_knn_matches_brute_force(kfuzz):
+    ds, d = kfuzz
+    rng = np.random.default_rng(55)
+    for case in range(25):
+        qx = float(rng.uniform(-179, 179))
+        qy = float(rng.uniform(-70, 70))
+        k = int(rng.choice([1, 3, 10, 50]))
+        got = ds.knn("t", x=qx, y=qy, k=k)
+        dist = haversine_m(d["geom__x"], d["geom__y"], qx, qy)
+        want = set(np.argsort(dist, kind="stable")[:k].astype(str))
+        got_ids = set(got.fids)
+        assert len(got_ids) == k, (case, qx, qy, k)
+        # distance-set equality (ties at the k-th distance may pick
+        # either member; compare by distance values, not ids)
+        got_idx = np.array(sorted(int(f) for f in got_ids))
+        want_idx = np.array(sorted(int(f) for f in want))
+        assert np.allclose(
+            np.sort(dist[got_idx]), np.sort(dist[want_idx]), rtol=1e-9
+        ), (case, qx, qy, k)
+
+
+def test_knn_with_filter(kfuzz):
+    ds, d = kfuzz
+    rng = np.random.default_rng(66)
+    for case in range(10):
+        qx, qy = float(rng.uniform(-90, 90)), float(rng.uniform(-50, 50))
+        thr = round(float(rng.uniform(3, 7)), 2)
+        k = 20
+        got = ds.knn("t", x=qx, y=qy, k=k, query=f"v > {thr}")
+        m = d["v"] > thr
+        dist = np.where(m, haversine_m(d["geom__x"], d["geom__y"], qx, qy),
+                        np.inf)
+        want_idx = np.argsort(dist, kind="stable")[:k]
+        got_idx = np.array(sorted(int(f) for f in got.fids))
+        assert np.allclose(
+            np.sort(dist[got_idx]), np.sort(dist[want_idx]), rtol=1e-9
+        ), (case, qx, qy, thr)
+
+
+def test_knn_k_exceeds_matches(kfuzz):
+    ds, d = kfuzz
+    got = ds.knn("t", x=0.0, y=0.0, k=50, query="v > 9.99")
+    want_ids = set(np.nonzero(d["v"] > 9.99)[0].astype(str))
+    assert len(want_ids) <= 50
+    assert set(got.fids) == want_ids  # exactly the matching features
+
+
+def test_knn_at_antimeridian(kfuzz):
+    """A query at lon 179.4 must still return the true k nearest even
+    when closer points sit across the antimeridian (expanding bbox
+    wrap)."""
+    ds, d = kfuzz
+    qx, qy = 179.4, 10.0
+    k = 10
+    got = ds.knn("t", x=qx, y=qy, k=k)
+    dist = haversine_m(d["geom__x"], d["geom__y"], qx, qy)
+    want = np.sort(np.sort(dist, kind="stable")[:k])
+    got_idx = np.array(sorted(int(f) for f in got.fids))
+    assert np.allclose(np.sort(dist[got_idx]), want, rtol=1e-9)
