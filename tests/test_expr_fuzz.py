@@ -6,6 +6,7 @@ prefilter (the interval-arithmetic superset + exact host refine must
 compose to exact f64 semantics for EVERY tree, not just the
 hand-written cases)."""
 
+pytestmark = __import__("pytest").mark.fuzz
 import numpy as np
 import pytest
 

@@ -5,6 +5,7 @@ query points, k values, and filters — including edge cases (k larger
 than matches, a query hard against the antimeridian, filters leaving
 fewer than k matches)."""
 
+pytestmark = __import__("pytest").mark.fuzz
 import numpy as np
 import pytest
 

@@ -4,6 +4,7 @@ store over the same rows. This hammers partition pruning (time-bound
 extraction feeding bin selection) composed with window pushdown, lazy
 snapshot reload, and per-partition merge."""
 
+pytestmark = __import__("pytest").mark.fuzz
 import numpy as np
 import pytest
 

@@ -5,6 +5,7 @@ oracle; plus random sorted+limited queries against a lexsort oracle.
 The device kernels, window pushdown, f32 band machinery, refine pass,
 and top-k selection must compose to exact semantics for every tree."""
 
+pytestmark = __import__("pytest").mark.fuzz
 import numpy as np
 import pytest
 

@@ -5,6 +5,7 @@ host-only store, count(), query(), and density() must all agree — this
 cross-checks the window pushdown, the PIP kernels, coarse+refine, and
 the aggregation paths against each other."""
 
+pytestmark = __import__("pytest").mark.fuzz
 import numpy as np
 import pytest
 

@@ -4,6 +4,7 @@ specs over random predicate windows must match numpy oracles exactly
 same sketches feed the cost model, so silent drift here skews planning
 everywhere."""
 
+pytestmark = __import__("pytest").mark.fuzz
 import json
 
 import numpy as np
