@@ -178,7 +178,8 @@ class BaseConverter:
             except Exception as e:
                 # batch-level failure: fall back to row-at-a-time so one bad
                 # row doesn't poison the batch
-                vals, row_ok = self._row_fallback(expr, ectx, ctx, name, e)
+                vals, row_ok = self._row_fallback(
+                    expr, ectx, ctx, name, e, keep)
                 ectx.fields[name] = vals
                 keep &= row_ok
         fids = None
@@ -198,13 +199,22 @@ class BaseConverter:
                 data[a.name] = ectx.fields[a.name]
         return data, fids, keep
 
-    def _row_fallback(self, expr, ectx, ctx, name, batch_err):
+    def _row_fallback(self, expr, ectx, ctx, name, batch_err,
+                      still_ok=None):
         if self.error_mode == "raise-errors":
             raise ValueError(f"field {name!r}: {batch_err}") from batch_err
         n = ectx.n
         vals = np.empty(n, dtype=object)
         ok = np.ones(n, dtype=bool)
         for i in range(n):
+            if still_ok is not None and not still_ok[i]:
+                # an earlier field already failed this row: it is dead —
+                # don't evaluate further fields and, critically, don't
+                # record a SECOND failure for the same record (fuzz-found
+                # r5: a row bad in two fields counted as two failures;
+                # the reference's ErrorMode counts per record)
+                ok[i] = False
+                continue
             row_ctx = ex.Context(
                 raw=[a[i: i + 1] for a in ectx.raw],
                 fields={k: v[i: i + 1] for k, v in ectx.fields.items()},

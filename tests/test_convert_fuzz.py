@@ -1,0 +1,122 @@
+"""Randomized converter differential test: generated CSV/JSON inputs
+with injected malformations (bad numbers, bad dates, short rows,
+quoting) must convert with EXACTLY the oracle's good/bad row split, and
+every successfully-converted value must round-trip bit-exactly into the
+dataset. Ingest is where silent corruption enters a store — the fuzz
+pins the error-isolation contract (one bad row never skews its
+neighbors)."""
+
+pytestmark = __import__("pytest").mark.fuzz
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset
+
+SPEC = "name:String,age:Integer,w:Double,dtg:Date,*geom:Point"
+
+CSV_CONFIG = {
+    "type": "delimited-text",
+    "format": "CSV",
+    "id-field": "$1",
+    "options": {"skip-lines": 1, "error-mode": "skip-bad-records"},
+    "fields": [
+        {"name": "name", "transform": "trim($2)"},
+        {"name": "age", "transform": "toInt($3)"},
+        {"name": "w", "transform": "toDouble($4)"},
+        {"name": "dtg", "transform": "date('yyyy-MM-dd', $5)"},
+        {"name": "geom", "transform": "point(toDouble($6), toDouble($7))"},
+    ],
+}
+
+JSON_CONFIG = {
+    "type": "json",
+    "feature-path": "$.rows[*]",
+    "id-field": "$fid",
+    "options": {"error-mode": "skip-bad-records"},
+    "fields": [
+        {"name": "fid", "path": "$.id"},
+        {"name": "name", "path": "$.name"},
+        {"name": "age_raw", "path": "$.age"},
+        {"name": "age", "transform": "toInt($age_raw)"},
+        {"name": "w_raw", "path": "$.w"},
+        {"name": "w", "transform": "toDouble($w_raw)"},
+        {"name": "d_raw", "path": "$.d"},
+        {"name": "dtg", "transform": "date('yyyy-MM-dd', $d_raw)"},
+        {"name": "x", "path": "$.x"},
+        {"name": "y", "path": "$.y"},
+        {"name": "geom", "transform": "point($x, $y)"},
+    ],
+}
+
+
+def _rand_rows(rng, n):
+    """(csv_lines, json_rows, good_flags, values). A row is 'bad' when a
+    typed field cannot parse."""
+    lines, jrows, good, vals = [], [], [], []
+    for i in range(n):
+        name = ["ann", "bo b", "c,d", "efg"][rng.integers(0, 4)]
+        age = int(rng.integers(0, 99))
+        w = round(float(rng.uniform(-5, 5)), 3)
+        day = int(rng.integers(1, 28))
+        x = round(float(rng.uniform(-170, 170)), 3)
+        y = round(float(rng.uniform(-80, 80)), 3)
+        corrupt = rng.integers(0, 9)  # 0-4 = clean
+        age_s, w_s, d_s = str(age), repr(w), f"2020-01-{day:02d}"
+        is_good = True
+        if corrupt == 5:
+            age_s, is_good = "NaNish", False
+        elif corrupt == 6:
+            d_s, is_good = "01/2020/99", False
+        elif corrupt == 7:
+            w_s, is_good = "", False
+        elif corrupt == 8:
+            # MULTIPLE bad fields in one row must count as ONE failed
+            # record, not one per field (fuzz-found converter bug, r5)
+            age_s, w_s, d_s, is_good = "bad", "also-bad", "nope", False
+        q = f'"{name}"' if "," in name else name
+        lines.append(f"r{i},{q},{age_s},{w_s},{d_s},{x},{y}")
+        jrows.append({"id": f"r{i}", "name": name, "age": age_s,
+                      "w": w_s if w_s else None, "d": d_s, "x": x, "y": y})
+        good.append(is_good)
+        vals.append((f"r{i}", name, age, w, f"2020-01-{day:02d}", x, y))
+    return lines, jrows, good, vals
+
+
+@pytest.mark.parametrize("fmt", ["csv", "json"])
+def test_random_malformed_inputs(fmt):
+    rng = np.random.default_rng(808)
+    for case in range(8):
+        n = int(rng.integers(20, 60))
+        lines, jrows, good, vals = _rand_rows(rng, n)
+        ds = GeoDataset(n_shards=1, prefer_device=False)
+        ds.create_schema("t", SPEC)
+        if fmt == "csv":
+            src = "id,name,age,w,date,lon,lat\n" + "\n".join(lines) + "\n"
+            ctx = ds.ingest("t", src, CSV_CONFIG)
+        else:
+            src = json.dumps({"rows": jrows})
+            ctx = ds.ingest("t", src, JSON_CONFIG)
+        want_good = sum(good)
+        assert ctx.success == want_good, (fmt, case, ctx.errors[:3])
+        assert ctx.failure == n - want_good, (fmt, case)
+        assert ds.count("t") == want_good
+        if want_good == 0:
+            continue
+        # every good row round-trips exactly; bad neighbors don't skew it
+        fc = ds.query("t", "INCLUDE")
+        d = fc.to_dict()
+        got = {fid: (nm, a, ww, dd, gg) for fid, nm, a, ww, dd, gg in zip(
+            fc.fids, d["name"], d["age"], d["w"], d["dtg"], d["geom"])}
+        for (fid, nm, a, ww, ds_, x, y), g in zip(vals, good):
+            if not g:
+                assert fid not in got, (fmt, case, fid)
+                continue
+            gnm, ga, gw, gd, gg = got[fid]
+            assert gnm == nm.strip() and ga == a, (fmt, case, fid)
+            assert gw == ww, (fmt, case, fid)  # f64 exact, not approx
+            assert str(np.datetime64(gd, "D")) == ds_, (fmt, case, fid)
+            assert gg[0] == pytest.approx(x, abs=5e-7)  # f32 coord store
+            assert gg[1] == pytest.approx(y, abs=5e-7), (fmt, case, fid)
