@@ -383,10 +383,12 @@ class GeoDataset:
                 # them on every hit — they are cheap; planning is not
                 QueryPlanner(st)._guard(hit.key_plan, hit.filter, Explainer())
                 interceptors.apply_guards(st.ft, hit)
-                # exec_path describes ONE execution: stale notes from the
-                # cached plan's previous run (device_error, sort, ...)
-                # must not leak into this call's audit/explain
+                # exec_path/degraded describe ONE execution: stale notes
+                # from the cached plan's previous run (device_error, sort,
+                # skipped partitions, ...) must not leak into this call's
+                # audit/explain
                 hit.__dict__.pop("exec_path", None)
+                hit.__dict__.pop("degraded", None)
                 return st, q, hit
         planner = QueryPlanner(st)
         t0 = time.perf_counter()
@@ -432,6 +434,16 @@ class GeoDataset:
             hints["device_coarse_ms"] = round(
                 plan.__dict__["device_coarse_ms"], 3
             )
+        # degraded executions carry their skipped-partition account into the
+        # audit event (docs/RESILIENCE.md): the aggregate is exact over the
+        # surviving partitions, and THIS is the record of what was dropped.
+        # pop: the plan object is cached/reused across calls.
+        degraded = plan.__dict__.pop("degraded", None)
+        if degraded:
+            hints["degraded"] = [
+                {"part": d.part, "error": d.error, "phase": d.phase}
+                for d in degraded
+            ]
         self.audit.record(
             name, plan.ecql, hints,
             plan.__dict__.get("plan_time_ms", 0.0),

@@ -81,3 +81,70 @@ class AuditWriter:
     def recent(self, n: int = 100) -> List[QueryEvent]:
         with self._lock:
             return list(self.events)[-n:]
+
+
+# ---------------------------------------------------------------------------
+# Degradation trail (resilience layer; docs/RESILIENCE.md). Every skipped
+# partition / quarantined message / corrupt file records a DegradationEvent
+# here — the operational answer to "what did my degraded aggregate drop?".
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class DegradationEvent:
+    """One unit of work dropped by the resilience layer."""
+
+    source: str        # fault-point site, e.g. "fs.read_partition"
+    part: str          # partition name / file path / message id
+    error: str         # repr of the failure
+    phase: str = ""
+    date: float = 0.0  # epoch seconds
+
+    def to_json(self) -> str:
+        return json.dumps(asdict(self), default=str)
+
+
+class DegradationLog:
+    """In-memory ring of DegradationEvents (JSONL-appended alongside the
+    query audit when ``geomesa.audit.path`` is set)."""
+
+    def __init__(self, max_events: int = 10_000):
+        self.events: deque = deque(maxlen=max_events)
+        self._lock = threading.Lock()
+
+    def write(self, event: DegradationEvent):
+        if not config.AUDIT_ENABLED.to_bool():
+            return  # same gate AuditWriter honors: disabled means disabled
+        if not event.date:
+            event.date = time.time()
+        with self._lock:
+            self.events.append(event)
+            path = config.AUDIT_PATH.get()
+            if path:
+                with open(path, "a") as fh:
+                    fh.write(event.to_json() + "\n")
+
+    def recent(self, n: int = 100) -> List[DegradationEvent]:
+        with self._lock:
+            return list(self.events)[-n:]
+
+    def clear(self):
+        with self._lock:
+            self.events.clear()
+
+
+#: process-wide degradation trail
+degradations = DegradationLog()
+
+
+def record_degradation(rec) -> None:
+    """Record a resilience-layer skip (``rec`` is a ``resilience.Skipped``
+    or anything with source/part/error/phase attributes)."""
+    degradations.write(
+        DegradationEvent(
+            source=getattr(rec, "source", ""),
+            part=getattr(rec, "part", ""),
+            error=getattr(rec, "error", ""),
+            phase=getattr(rec, "phase", ""),
+        )
+    )

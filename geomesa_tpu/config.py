@@ -249,6 +249,45 @@ SAMPLE_HASH_BUCKETS = SystemProperty("geomesa.sample.hash-buckets", "64")
 #: sort); larger limits gather the full result and sort on host.
 TOPK_MAX = SystemProperty("geomesa.topk.max", "100000")
 
+# ---------------------------------------------------------------------------
+# Resilience layer (resilience.py; docs/RESILIENCE.md). Retry defaults track
+# the reference's tablet-server client retry posture; the breaker fences a
+# dead sidecar so calls fail fast instead of paying the timeout each time.
+# ---------------------------------------------------------------------------
+
+#: Per-call timeout for sidecar Flight RPCs (FlightCallOptions.timeout);
+#: a live query deadline tightens it further. None = no per-call timeout.
+SIDECAR_TIMEOUT = SystemProperty("geomesa.sidecar.timeout", "30 s")
+
+#: Total tries per retryable remote call (1 disables retry).
+RETRY_ATTEMPTS = SystemProperty("geomesa.retry.attempts", "3")
+
+#: Backoff base delay (ms); retry i waits base * 2^(i-1), capped below.
+RETRY_BASE_MS = SystemProperty("geomesa.retry.base.ms", "50")
+
+#: Backoff delay cap (ms).
+RETRY_MAX_MS = SystemProperty("geomesa.retry.max.ms", "5000")
+
+#: Jitter fraction [0, 1): each delay is scaled by 1 - jitter * U(0, 1)
+#: from the policy's seeded RNG (deterministic under a fixed seed).
+RETRY_JITTER = SystemProperty("geomesa.retry.jitter", "0.2")
+
+#: Consecutive failures that open a circuit breaker.
+BREAKER_THRESHOLD = SystemProperty("geomesa.breaker.threshold", "5")
+
+#: Open -> half-open reset window (ms).
+BREAKER_RESET_MS = SystemProperty("geomesa.breaker.reset.ms", "30000")
+
+#: Allow degraded (partial) aggregates: a failing partition is skipped and
+#: recorded instead of failing the whole scan. Off = strict (raise); the
+#: ``resilience.allow_partial()`` scope enables it per-operation.
+SCAN_PARTIAL = SystemProperty("geomesa.scan.partial", "false")
+
+#: Master switch for the deterministic fault-injection registry
+#: (resilience.inject_faults refuses to install without it). Fault points
+#: are a single no-op check when no injector is installed.
+FAULT_INJECTION = SystemProperty("geomesa.fault.injection", "false")
+
 #: Extra gather slots for boundary ties in the device top-k selection;
 #: selections whose tie group overflows k + slack fall back to the host.
 TOPK_TIE_SLACK = SystemProperty("geomesa.topk.tie-slack", "4096")

@@ -26,6 +26,7 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
+from geomesa_tpu import resilience
 from geomesa_tpu.curves.zorder import NormalizedDimension, deinterleave2, interleave2
 from geomesa_tpu.filter import ir, parse_ecql
 from geomesa_tpu.io import arrow_io
@@ -295,6 +296,10 @@ class FileSystemStorage:
         self.root = root
         os.makedirs(root, exist_ok=True)
         self._lock = threading.Lock()
+        #: corrupt-partition quarantine: file path -> first failure (repr).
+        #: Quarantined files are skipped without re-parsing on later reads;
+        #: strict (non-partial) reads still raise for them.
+        self._quarantine: Dict[str, str] = {}
 
     # -- metadata ----------------------------------------------------------
     def _meta_path(self, name: str) -> str:
@@ -308,11 +313,34 @@ class FileSystemStorage:
             raise KeyError(f"no filesystem type {name!r} under {self.root}")
 
     def _save_meta(self, name: str, meta: Dict):
+        # crash-safe persistence: serialize to a same-directory temp file,
+        # fsync it, then atomically replace — a crash at ANY point leaves
+        # either the old complete metadata or the new complete metadata,
+        # never torn JSON that would poison every later open. The directory
+        # fsync makes the rename itself durable.
         path = self._meta_path(name)
-        tmp = path + ".tmp"
-        with open(tmp, "w") as fh:
-            json.dump(meta, fh, indent=2)
-        os.replace(tmp, path)
+        tmp = path + f".{uuid.uuid4().hex[:8]}.tmp"
+        resilience.fault_point("fs.write_meta", name=name, path=path)
+        try:
+            with open(tmp, "w") as fh:
+                json.dump(meta, fh, indent=2)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, path)
+        except BaseException:
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            raise
+        try:
+            dirfd = os.open(os.path.dirname(path), os.O_RDONLY)
+            try:
+                os.fsync(dirfd)
+            finally:
+                os.close(dirfd)
+        except OSError:
+            pass  # some filesystems refuse directory fsync; replace still atomic
 
     def list_types(self) -> List[str]:
         out = []
@@ -354,6 +382,7 @@ class FileSystemStorage:
     def _read_file(path: str, columns=None) -> pa.Table:
         # both formats raise on a requested-but-missing column, so
         # schema-evolution behavior cannot silently diverge by format
+        resilience.fault_point("fs.read_partition", path=path)
         if path.endswith(".arrow"):
             t = arrow_io.read_ipc(path)
             if columns is not None:
@@ -365,6 +394,18 @@ class FileSystemStorage:
                     )
                 t = t.select(list(columns))
             return t
+        if columns is not None:
+            # surface a requested-but-missing column as the same KeyError
+            # the arrow branch raises (parquet would raise ArrowInvalid,
+            # which the degraded-read path would mistake for corruption
+            # and quarantine a healthy file)
+            schema = pq.read_schema(path)
+            missing = [c for c in columns if schema.get_field_index(c) < 0]
+            if missing:
+                raise KeyError(
+                    f"columns {missing} not present in {path} "
+                    f"(has: {schema.names})"
+                )
         return pq.read_table(path, columns=columns)
 
     @staticmethod
@@ -419,26 +460,69 @@ class FileSystemStorage:
         f = parse_ecql(ecql) if isinstance(ecql, str) else ecql
         return [p for p in sorted(meta["partitions"]) if scheme.keep(ft, p, f)]
 
+    def _read_or_quarantine(self, part: str, path: str,
+                            columns=None) -> Optional[pa.Table]:
+        """One partition file, under the degradation contract
+        (docs/RESILIENCE.md): a corrupt/unreadable file is quarantined and
+        — when the operation allows partial results — recorded + skipped
+        (returns None); strict reads raise. A missing REQUESTED column is
+        a schema-evolution error, never a corruption skip."""
+        prior = self._quarantine.get(path)
+        if prior is not None:
+            err = RuntimeError(f"quarantined: {prior}")
+            if resilience.partial_allowed():
+                resilience.record_skip("fs.read_partition", path, err, phase=part)
+                return None
+            raise err
+        try:
+            return self._read_file(path, columns=columns)
+        except KeyError:
+            raise  # requested-but-missing column: the strict §schema contract
+        except Exception as e:
+            self._quarantine[path] = repr(e)
+            if resilience.partial_allowed():
+                resilience.record_skip("fs.read_partition", path, e, phase=part)
+                return None
+            raise
+
+    def quarantined(self) -> Dict[str, str]:
+        """Quarantined file paths -> first failure (advisory copy)."""
+        return dict(self._quarantine)
+
     def read(self, name: str, ecql: "str | ir.Filter" = "INCLUDE",
              columns: Optional[Sequence[str]] = None) -> pa.Table:
         """Read all (pruned) partitions as one Arrow table. Row-level
-        filtering is left to the caller's compiled predicate."""
+        filtering is left to the caller's compiled predicate. Under
+        ``resilience.allow_partial()`` (or ``geomesa.scan.partial``) corrupt
+        partition files are quarantined + skipped and the surviving rows
+        returned; strict mode raises on the first corrupt file."""
         meta = self._load_meta(name)
         tables = []
         for p in self.prune(name, ecql):
             pdir = os.path.join(self.root, name, "data", p)
             for fname in meta["partitions"][p]:
-                tables.append(
-                    self._read_file(os.path.join(pdir, fname), columns=columns)
+                t = self._read_or_quarantine(
+                    p, os.path.join(pdir, fname), columns=columns
                 )
+                if t is not None:
+                    tables.append(t)
         if not tables:
             # match the schema of existing files if any (WKT vs point geometry)
             schema = None
             for p in sorted(meta["partitions"]):
-                files = meta["partitions"][p]
-                if files:
-                    path = os.path.join(self.root, name, "data", p, files[0])
-                    schema = self._read_file_schema(path)
+                for fname in meta["partitions"][p]:
+                    path = os.path.join(self.root, name, "data", p, fname)
+                    if path in self._quarantine:
+                        continue
+                    try:
+                        schema = self._read_file_schema(path)
+                    except Exception:
+                        # unreadable schema source: the spec-derived schema
+                        # below still serves (degraded reads must not die
+                        # probing a corrupt file for its schema)
+                        continue
+                    break
+                if schema is not None:
                     break
             if schema is None:
                 ft = FeatureType.from_spec(name, meta["spec"])
@@ -462,12 +546,34 @@ class FileSystemStorage:
     def read_partition(self, name: str, partition: str) -> pa.Table:
         meta = self._load_meta(name)
         pdir = os.path.join(self.root, name, "data", partition)
-        tables = [
-            self._read_file(os.path.join(pdir, f))
-            for f in meta["partitions"][partition]
-        ]
+        tables = []
+        for f in meta["partitions"][partition]:
+            t = self._read_or_quarantine(partition, os.path.join(pdir, f))
+            if t is not None:
+                tables.append(t)
+        if not tables:
+            ft = FeatureType.from_spec(name, meta["spec"])
+            return arrow_io.arrow_schema(ft).empty_table()
         schema = pa.unify_schemas([t.schema for t in tables], promote_options="permissive")
         return pa.concat_tables([t.cast(schema) for t in tables]).unify_dictionaries()
+
+    def read_partial(self, name: str, ecql: "str | ir.Filter" = "INCLUDE",
+                     columns: Optional[Sequence[str]] = None,
+                     ) -> "resilience.PartialResult[pa.Table]":
+        """Typed degraded read: the surviving rows plus a structured account
+        of every skipped partition file (the GeoBlocks-style contract —
+        exact over what survived, explicit about what didn't)."""
+        meta = self._load_meta(name)
+        pruned = self.prune(name, ecql)
+        total = sum(len(meta["partitions"][p]) for p in pruned)
+        with resilience.allow_partial() as partial:
+            table = self.read(name, ecql, columns)
+        return resilience.PartialResult(
+            value=table,
+            skipped=list(partial.skipped),
+            total_parts=total,  # unit of work here = one partition file
+            ok_parts=total - len({s.part for s in partial.skipped}),
+        )
 
     # -- maintenance -------------------------------------------------------
     def compact(self, name: str, partition: Optional[str] = None) -> int:

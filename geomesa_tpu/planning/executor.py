@@ -14,8 +14,6 @@ from __future__ import annotations
 import contextlib
 import logging
 import os
-import threading
-import time
 from typing import Any, Dict, Optional
 
 import numpy as np
@@ -31,9 +29,13 @@ from geomesa_tpu.schema.columns import ColumnBatch
 from geomesa_tpu.stats import sketches as sk
 
 
-class QueryTimeoutError(RuntimeError):
-    """Raised when a scan exceeds ``geomesa.query.timeout`` (the reference's
-    ThreadManagement query killer, index/utils/ThreadManagement.scala:28-80)."""
+# QueryTimeoutError is defined in the resilience layer (resilience.py) and
+# re-exported here: the deadline primitive moved there so remote edges can
+# propagate the remaining budget, while existing callers keep importing the
+# error (and query_deadline) from this module.
+from geomesa_tpu.resilience import (  # noqa: E402  (re-export)
+    QueryTimeoutError, check_deadline, deadline_scope,
+)
 
 
 # -- window-compacted scan layout -------------------------------------------
@@ -65,33 +67,17 @@ def _slab_gather_fn(B: int):
     return fn
 
 
-_deadline = threading.local()
-
-
 @contextlib.contextmanager
 def query_deadline(timeout_s: "Optional[float]"):
-    """Scope a wall-clock deadline over a query's scan phases. Checked
-    between per-shard host passes and around device dispatches — kernels
-    themselves are not interruptible, so enforcement is at phase granularity
-    (the same guarantee the reference's killer thread gives a blocking scan)."""
-    if timeout_s is None:
+    """Scope a wall-clock deadline over a query's scan phases (built on
+    ``resilience.deadline_scope``). Checked between per-shard host passes,
+    around device dispatches, and per partition — kernels themselves are not
+    interruptible, so enforcement is at phase granularity (the same guarantee
+    the reference's killer thread gives a blocking scan). Remote edges
+    (sidecar client) read ``resilience.current_deadline()`` to tighten their
+    per-call timeouts to the remaining budget."""
+    with deadline_scope(timeout_s):
         yield
-        return
-    prev = getattr(_deadline, "t", None)
-    _deadline.t = time.monotonic() + timeout_s
-    try:
-        yield
-    finally:
-        _deadline.t = prev
-
-
-def check_deadline():
-    t = getattr(_deadline, "t", None)
-    if t is not None and time.monotonic() > t:
-        raise QueryTimeoutError(
-            "query exceeded geomesa.query.timeout; narrow the filter or "
-            "raise the timeout"
-        )
 
 
 class Executor:

@@ -15,12 +15,16 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
+from geomesa_tpu import resilience
 from geomesa_tpu.filter import ir
 from geomesa_tpu.index.partitioned import PartitionedFeatureStore
 from geomesa_tpu.planning.executor import Executor, check_deadline
 from geomesa_tpu.planning.planner import QueryPlan
+from geomesa_tpu.resilience import QueryTimeoutError
 from geomesa_tpu.schema.columns import ColumnBatch
 from geomesa_tpu.stats import sketches as sk
+
+_SKIPPED = object()  # sentinel: partition degraded away (fn may return None)
 
 
 class PartitionedExecutor:
@@ -98,18 +102,48 @@ class PartitionedExecutor:
             plan.__dict__["scanned_rows"] = tot_scanned
             plan.__dict__["table_rows"] = tot_rows
 
+    def _scan_part(self, plan: QueryPlan, b: int, op: str, fn):
+        """One partition's scan under the degradation contract
+        (docs/RESILIENCE.md): strict mode re-raises; under
+        ``resilience.allow_partial()`` / ``geomesa.scan.partial`` a failing
+        partition is recorded (collector + audit trail + the plan, for the
+        query audit event) and skipped — returns the ``_SKIPPED`` sentinel.
+        Deadline expiry always propagates: a timed-out scan must never
+        masquerade as a degraded-but-complete one."""
+        try:
+            resilience.fault_point("exec.partition.scan", bin=b, op=op)
+            return fn()
+        except QueryTimeoutError:
+            raise
+        except Exception as e:
+            if not resilience.partial_allowed():
+                raise
+            rec = resilience.record_skip(
+                "exec.partition.scan", f"bin:{b}", e, phase=op
+            )
+            plan.__dict__.setdefault("degraded", []).append(rec)
+            return _SKIPPED
+
     # -- public operations (Executor surface) ------------------------------
     def count(self, plan: QueryPlan) -> int:
         total = 0
-        for _, ex in self._each(plan):
-            total += ex.count(plan)
+        for b, ex in self._each(plan):
+            n = self._scan_part(plan, b, "count", lambda: ex.count(plan))
+            if n is not _SKIPPED:
+                total += n
         return total
 
     def density(self, plan: QueryPlan, bbox, width: int, height: int,
                 weight: Optional[str] = None, as_numpy: bool = True):
         out = None
-        for _, ex in self._each(plan):
-            g = ex.density(plan, bbox, width, height, weight, as_numpy=False)
+        for b, ex in self._each(plan):
+            g = self._scan_part(
+                plan, b, "density",
+                lambda: ex.density(plan, bbox, width, height, weight,
+                                   as_numpy=False),
+            )
+            if g is _SKIPPED:
+                continue
             # accumulate ON DEVICE: per-partition grid downloads would ride
             # the host link once per partition per call
             out = g if out is None else out + g
@@ -120,8 +154,13 @@ class PartitionedExecutor:
     def density_curve(self, plan: QueryPlan, level: int, block_window,
                       weight=None) -> np.ndarray:
         out = None
-        for _, ex in self._each(plan):
-            g = ex.density_curve(plan, level, block_window, weight)
+        for b, ex in self._each(plan):
+            g = self._scan_part(
+                plan, b, "density_curve",
+                lambda: ex.density_curve(plan, level, block_window, weight),
+            )
+            if g is _SKIPPED:
+                continue
             out = g if out is None else out + g
         if out is None:
             ix0, iy0, ix1, iy1 = block_window
@@ -129,8 +168,8 @@ class PartitionedExecutor:
         return out
 
     def stats(self, plan: QueryPlan, stat: sk.Stat) -> sk.Stat:
-        for _, ex in self._each(plan):
-            ex.stats(plan, stat)
+        for b, ex in self._each(plan):
+            self._scan_part(plan, b, "stats", lambda: ex.stats(plan, stat))
         return stat
 
     def features_iter(self, plan: QueryPlan, batch_rows: Optional[int] = None):
@@ -139,8 +178,24 @@ class PartitionedExecutor:
         ArrowScan streaming contract)."""
         got = 0
         limit = plan.hints.max_features if not plan.hints.sort_by else None
-        for _, ex in self._each(plan):
-            for batch in ex.features_iter(plan, batch_rows):
+        for b, ex in self._each(plan):
+            if resilience.partial_allowed():
+                # degraded mode: materialize the partition before any yield,
+                # so a failing partition drops WHOLE — never half-streamed
+                batches = self._scan_part(
+                    plan, b, "features",
+                    lambda: list(ex.features_iter(plan, batch_rows)),
+                )
+                if batches is _SKIPPED:
+                    continue
+            else:
+                # strict mode streams chunk-at-a-time (the ArrowScan
+                # contract): max_features can return mid-partition without
+                # gathering the rest
+                resilience.fault_point("exec.partition.scan", bin=b,
+                                       op="features")
+                batches = ex.features_iter(plan, batch_rows)
+            for batch in batches:
                 if not batch.n:
                     continue
                 if limit is not None:
@@ -178,18 +233,23 @@ class PartitionedExecutor:
         parts: List[ColumnBatch] = []
         pushed = 0
         for b, ex in self._each(plan):
-            idx = ex.top_rows(plan, attr, descending, k,
-                              include_ties=include_ties)
-            if idx is None:
-                batch = ex.features(plan)
-            elif len(idx) == 0:
-                pushed += 1  # device ran and found nothing: still pushdown
-                continue
-            else:
-                pushed += 1
+            def one_part(ex=ex):
+                idx = ex.top_rows(plan, attr, descending, k,
+                                  include_ties=include_ties)
+                if idx is None:
+                    return None, ex.features(plan)
+                if len(idx) == 0:
+                    return True, None  # device ran and found nothing
                 table = ex.store.tables[plan.index_name]
-                batch = table.host_gather_positions(idx, names)
-            if batch.n:
+                return True, table.host_gather_positions(idx, names)
+
+            got = self._scan_part(plan, b, "top", one_part)
+            if got is _SKIPPED:
+                continue
+            dev, batch = got
+            if dev:
+                pushed += 1
+            if batch is not None and batch.n:
                 parts.append(batch)
         if pushed == 0:
             # no partition device-selected anything: report None so the
@@ -204,12 +264,17 @@ class PartitionedExecutor:
         """Per-partition top-k gathered and merged; the union of partition
         top-ks contains the global top-k (caller orders and truncates)."""
         parts = []
-        for _, ex in self._each(plan):
-            idx, _ = ex.knn(plan, x, y, k, boxes=boxes)
-            if len(idx) == 0:
-                continue
-            table = ex.store.tables[plan.index_name]
-            mask = np.zeros(table.n_shards * table.shard_len, bool)
-            mask[idx] = True
-            parts.append(table.host_gather(mask))
+        for b, ex in self._each(plan):
+            def one_part(ex=ex):
+                idx, _ = ex.knn(plan, x, y, k, boxes=boxes)
+                if len(idx) == 0:
+                    return None
+                table = ex.store.tables[plan.index_name]
+                mask = np.zeros(table.n_shards * table.shard_len, bool)
+                mask[idx] = True
+                return table.host_gather(mask)
+
+            batch = self._scan_part(plan, b, "knn", one_part)
+            if batch is not _SKIPPED and batch is not None:
+                parts.append(batch)
         return ColumnBatch.concat(parts) if parts else ColumnBatch({}, 0)

@@ -1,0 +1,569 @@
+"""Resilience layer: retry/deadline/circuit-breaker policies, deterministic
+fault injection, and typed partial-result degradation.
+
+The production posture of the reference stack is spread across several
+mechanisms this module unifies for the TPU port:
+
+* tablet-server retry semantics (Accumulo/HBase client retries under the
+  datastore) -> :class:`RetryPolicy` — exponential backoff + full jitter from
+  a seeded RNG, so a retry schedule is reproducible in tests;
+* the ThreadManagement query killer (index/utils/ThreadManagement.scala:28-80)
+  -> :class:`Deadline`, the primitive under ``planning.executor.query_deadline``
+  (which remains the public scan-scope API);
+* client-side connection fencing -> :class:`CircuitBreaker`, so a dead
+  sidecar fails fast instead of paying the full timeout per call;
+* GeoBlocks-style partial aggregation over pruned regions (PAPERS.md) ->
+  :class:`PartialResult` / :class:`Skipped` — a scan over N partitions where
+  K fail can return the aggregate over N−K plus a structured account of what
+  was skipped and why, instead of raising or hanging.
+
+Fault injection
+---------------
+Every I/O edge calls :func:`fault_point` with a dotted site name
+(``sidecar.do_get``, ``fs.read_partition``, ``stream.poll.decode``,
+``exec.partition.scan``). When no injector is installed the call is a single
+module-global ``None`` check — fault points sit at partition/RPC/message
+granularity, never inside per-row loops, so the disabled cost is unmeasurable
+on the hot scan path. Installing an injector requires the
+``geomesa.fault.injection`` property to be enabled, and rules are seeded, so
+a chaos scenario replays identically run to run::
+
+    with config.FAULT_INJECTION.scoped("true"):
+        with inject_faults(seed=7) as inj:
+            inj.fail("sidecar.do_get", errors.Unavailable("sidecar restart"), times=2)
+            client.count("t")   # fails twice, retries, succeeds
+
+Degradation contract (docs/RESILIENCE.md)
+-----------------------------------------
+Partition-loop call sites consult :func:`partial_allowed`. Strict mode (the
+default) re-raises — behavior is unchanged from before this module existed.
+Under ``with allow_partial() as partial:`` (or the ``geomesa.scan.partial``
+property) a failing partition is recorded via :func:`record_skip` and the
+scan continues; the aggregate over the surviving partitions is returned and
+``partial.skipped`` lists what was dropped. Degraded aggregates are exact
+over the partitions that survived — never an estimate.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, Generic, List, Optional, Tuple, TypeVar
+
+from geomesa_tpu import config
+
+T = TypeVar("T")
+
+
+# ---------------------------------------------------------------------------
+# Errors
+# ---------------------------------------------------------------------------
+
+
+class QueryTimeoutError(RuntimeError):
+    """Raised when a scan exceeds its :class:`Deadline` (``geomesa.query.
+    timeout`` — the reference's ThreadManagement query killer). Re-exported
+    by ``planning.executor`` for compatibility."""
+
+
+class CircuitOpenError(RuntimeError):
+    """Raised by :meth:`CircuitBreaker.allow` while the breaker is open:
+    the callee has failed repeatedly and calls are being fenced off until
+    the reset window elapses."""
+
+    def __init__(self, name: str, retry_after_s: float):
+        super().__init__(
+            f"circuit {name!r} is open (retry after {retry_after_s:.1f}s)"
+        )
+        self.breaker_name = name
+        self.retry_after_s = retry_after_s
+
+
+class InjectedFault(RuntimeError):
+    """Default error type raised by a fault-injection rule."""
+
+
+# ---------------------------------------------------------------------------
+# RetryPolicy
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class RetryPolicy:
+    """Exponential backoff with full jitter from a seeded RNG.
+
+    ``attempts`` is the TOTAL number of tries (1 = no retry). Delay before
+    retry ``i`` (1-based) is ``min(base_ms * 2**(i-1), max_ms)`` scaled by
+    ``1 - jitter * rng.random()`` — deterministic for a given seed."""
+
+    attempts: int = 3
+    base_ms: float = 50.0
+    max_ms: float = 5_000.0
+    jitter: float = 0.2
+    seed: Optional[int] = None
+    sleep: Callable[[float], None] = time.sleep
+
+    def __post_init__(self):
+        self._rng = random.Random(self.seed)
+
+    @staticmethod
+    def from_config(seed: Optional[int] = None) -> "RetryPolicy":
+        def cfg(v, default):
+            # explicit 0 is a real setting (no delay / no retry): only an
+            # UNSET property falls back to the default
+            return default if v is None else v
+
+        return RetryPolicy(
+            attempts=cfg(config.RETRY_ATTEMPTS.to_int(), 3),
+            base_ms=cfg(config.RETRY_BASE_MS.to_float(), 50.0),
+            max_ms=cfg(config.RETRY_MAX_MS.to_float(), 5_000.0),
+            jitter=cfg(config.RETRY_JITTER.to_float(), 0.0),
+            seed=seed,
+        )
+
+    def delays_ms(self) -> List[float]:
+        """The backoff schedule for this policy's remaining retries
+        (consumes RNG state — one call per executed schedule)."""
+        out = []
+        for i in range(max(self.attempts - 1, 0)):
+            d = min(self.base_ms * (2.0 ** i), self.max_ms)
+            if self.jitter:
+                d *= 1.0 - self.jitter * self._rng.random()
+            out.append(d)
+        return out
+
+    def call(self, fn: Callable[[], T],
+             retryable: Callable[[BaseException], bool] = lambda e: True,
+             deadline: "Optional[Deadline]" = None,
+             on_retry: Optional[Callable[[int, BaseException], None]] = None) -> T:
+        """Run ``fn`` with retries. ``retryable(exc)`` gates each retry;
+        a live ``deadline`` stops retrying (and trims sleeps) when the
+        budget would be exceeded."""
+        last: Optional[BaseException] = None
+        attempts = max(self.attempts, 1)  # 0/negative still means one try
+        for attempt in range(1, attempts + 1):
+            try:
+                return fn()
+            except Exception as e:  # KeyboardInterrupt/SystemExit propagate
+                last = e
+                if attempt >= attempts or not retryable(e):
+                    raise
+                d = min(self.base_ms * (2.0 ** (attempt - 1)), self.max_ms)
+                if self.jitter:
+                    d *= 1.0 - self.jitter * self._rng.random()
+                if deadline is not None:
+                    rem = deadline.remaining_s()
+                    if rem is not None:
+                        if rem <= 0:
+                            raise
+                        d = min(d, rem * 1000.0)
+                if on_retry is not None:
+                    on_retry(attempt, e)
+                if d > 0:
+                    self.sleep(d / 1000.0)
+        raise last  # pragma: no cover — loop always returns or raises
+
+
+# ---------------------------------------------------------------------------
+# Deadline
+# ---------------------------------------------------------------------------
+
+_deadline_local = threading.local()
+
+
+@dataclass(frozen=True)
+class Deadline:
+    """A wall-clock budget. ``expires_at`` is ``time.monotonic()``-based;
+    ``None`` means unlimited (checks are no-ops)."""
+
+    expires_at: Optional[float]
+
+    @staticmethod
+    def after(timeout_s: Optional[float]) -> "Deadline":
+        return Deadline(
+            None if timeout_s is None else time.monotonic() + timeout_s
+        )
+
+    def remaining_s(self) -> Optional[float]:
+        if self.expires_at is None:
+            return None
+        return self.expires_at - time.monotonic()
+
+    @property
+    def expired(self) -> bool:
+        return self.expires_at is not None and time.monotonic() > self.expires_at
+
+    def check(self, what: str = "query") -> None:
+        if self.expired:
+            raise QueryTimeoutError(
+                f"{what} exceeded geomesa.query.timeout; narrow the filter "
+                "or raise the timeout"
+            )
+
+
+UNLIMITED = Deadline(None)
+
+
+def current_deadline() -> Deadline:
+    """The innermost active deadline scope on this thread (UNLIMITED when
+    none). Remote/IO edges use it to propagate the query budget into
+    per-call timeouts."""
+    d = getattr(_deadline_local, "stack", None)
+    return d[-1] if d else UNLIMITED
+
+
+class _DeadlineScope:
+    def __init__(self, deadline: Deadline):
+        self.deadline = deadline
+
+    def __enter__(self) -> Deadline:
+        stack = getattr(_deadline_local, "stack", None)
+        if stack is None:
+            stack = _deadline_local.stack = []
+        stack.append(self.deadline)
+        self._stack = stack  # enter/exit may run on different threads
+        return self.deadline
+
+    def __exit__(self, *exc):
+        # generators (streamed exports) can resume on a different thread
+        # than the one that opened the scope: pop from the ENTERED stack,
+        # and remove this scope's own deadline even if others interleaved
+        try:
+            self._stack.remove(self.deadline)
+        except ValueError:
+            pass
+        return False
+
+
+def deadline_scope(timeout_s: Optional[float]) -> _DeadlineScope:
+    """Scope a deadline over this thread (nests; inner scopes may be
+    tighter or looser — ``check_deadline`` honors the innermost)."""
+    return _DeadlineScope(Deadline.after(timeout_s))
+
+
+def check_deadline(what: str = "query") -> None:
+    """Raise :class:`QueryTimeoutError` if the innermost deadline passed.
+    Called between per-shard host passes, around device dispatches, and per
+    partition — kernels are not interruptible, so enforcement is at phase
+    granularity (the guarantee the reference's killer thread gives a
+    blocking scan)."""
+    current_deadline().check(what)
+
+
+# ---------------------------------------------------------------------------
+# CircuitBreaker
+# ---------------------------------------------------------------------------
+
+
+class CircuitBreaker:
+    """Count-based breaker: ``threshold`` consecutive failures open the
+    circuit; after ``reset_ms`` one trial call is admitted (half-open) —
+    success closes, failure re-opens. ``clock`` is injectable so tests
+    advance time deterministically."""
+
+    CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
+
+    def __init__(self, name: str, threshold: Optional[int] = None,
+                 reset_ms: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic):
+        self.name = name
+        self.threshold = threshold if threshold is not None else (
+            config.BREAKER_THRESHOLD.to_int() or 5
+        )
+        self.reset_ms = reset_ms if reset_ms is not None else (
+            config.BREAKER_RESET_MS.to_float() or 30_000.0
+        )
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._failures = 0
+        self._state = self.CLOSED
+        self._opened_at = 0.0
+
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._effective_state()
+
+    def _effective_state(self) -> str:
+        if self._state == self.OPEN and (
+            (self.clock() - self._opened_at) * 1000.0 >= self.reset_ms
+        ):
+            return self.HALF_OPEN
+        return self._state
+
+    def allow(self) -> None:
+        """Raise :class:`CircuitOpenError` unless a call may proceed.
+        In half-open, admits the caller as the trial request."""
+        with self._lock:
+            st = self._effective_state()
+            if st == self.OPEN:
+                rem = self.reset_ms / 1000.0 - (self.clock() - self._opened_at)
+                raise CircuitOpenError(self.name, max(rem, 0.0))
+            if st == self.HALF_OPEN:
+                self._state = self.HALF_OPEN
+
+    def record_success(self) -> None:
+        with self._lock:
+            self._failures = 0
+            self._state = self.CLOSED
+
+    def record_failure(self) -> None:
+        with self._lock:
+            self._failures += 1
+            if self._state == self.HALF_OPEN or self._failures >= self.threshold:
+                self._state = self.OPEN
+                self._opened_at = self.clock()
+
+
+_breakers: Dict[str, CircuitBreaker] = {}
+_breakers_lock = threading.Lock()
+
+
+def breaker(name: str, **kw) -> CircuitBreaker:
+    """Process-wide named breaker registry (one breaker per sidecar
+    location, shared by every client to it)."""
+    with _breakers_lock:
+        b = _breakers.get(name)
+        if b is None:
+            b = _breakers[name] = CircuitBreaker(name, **kw)
+        return b
+
+
+def reset_breakers() -> None:
+    """Drop all registered breakers (test isolation)."""
+    with _breakers_lock:
+        _breakers.clear()
+
+
+# ---------------------------------------------------------------------------
+# Deterministic fault injection
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class _FaultRule:
+    pattern: str
+    error: Any                      # exception instance, type, or factory
+    times: Optional[int] = None     # None = every matching hit
+    p: float = 1.0                  # probability per hit (seeded RNG)
+    delay_s: float = 0.0            # sleep before raising/continuing
+    hits: int = 0                   # matched (after p/times gating)
+
+
+class FaultInjector:
+    """Seeded registry of fault rules matched against fault-point names
+    (``fnmatch`` patterns: ``sidecar.*``, ``fs.read_partition``, ...)."""
+
+    def __init__(self, seed: int = 0):
+        self.seed = seed
+        self._rng = random.Random(seed)
+        self._rules: List[_FaultRule] = []
+        self._lock = threading.Lock()
+        self.fired: List[Tuple[str, str]] = []  # (site, error repr)
+
+    def fail(self, pattern: str, error: Any = None, times: Optional[int] = 1,
+             p: float = 1.0, delay_s: float = 0.0) -> "_FaultRule":
+        """Arm a rule. ``error`` may be an exception instance/type or a
+        zero-arg factory; default :class:`InjectedFault`. ``times=None``
+        fires on every match."""
+        rule = _FaultRule(pattern, error, times, p, delay_s)
+        with self._lock:
+            self._rules.append(rule)
+        return rule
+
+    def _materialize(self, rule: _FaultRule, site: str) -> BaseException:
+        err = rule.error
+        if err is None:
+            return InjectedFault(f"injected fault at {site}")
+        if isinstance(err, BaseException):
+            return err
+        out = err()  # type or factory
+        return out if isinstance(out, BaseException) else InjectedFault(str(out))
+
+    def fire(self, site: str, ctx: Dict[str, Any]) -> None:
+        with self._lock:
+            for rule in self._rules:
+                if not fnmatch.fnmatch(site, rule.pattern):
+                    continue
+                if rule.times is not None and rule.hits >= rule.times:
+                    continue
+                if rule.p < 1.0 and self._rng.random() >= rule.p:
+                    continue
+                rule.hits += 1
+                err = self._materialize(rule, site)
+                self.fired.append((site, repr(err)))
+                delay = rule.delay_s
+                break
+            else:
+                return
+        if delay:
+            time.sleep(delay)
+        raise err
+
+
+_injector: Optional[FaultInjector] = None
+
+
+def fault_point(site: str, **ctx: Any) -> None:
+    """An instrumented I/O edge. No-op (one global load + compare) unless
+    an injector is installed via :func:`inject_faults`. Sites live at
+    partition/RPC/message granularity — never inside per-row loops — so
+    the disabled overhead is unmeasurable on the hot scan path."""
+    inj = _injector
+    if inj is None:
+        return
+    inj.fire(site, ctx)
+
+
+class _InjectScope:
+    def __init__(self, injector: FaultInjector):
+        self.injector = injector
+
+    def __enter__(self) -> FaultInjector:
+        global _injector
+        if not config.FAULT_INJECTION.to_bool():
+            raise RuntimeError(
+                "fault injection requires geomesa.fault.injection=true "
+                "(scoped or via GEOMESA_FAULT_INJECTION)"
+            )
+        if _injector is not None:
+            raise RuntimeError("a fault injector is already installed")
+        _injector = self.injector
+        return self.injector
+
+    def __exit__(self, *exc):
+        global _injector
+        _injector = None
+        return False
+
+
+def inject_faults(seed: int = 0) -> _InjectScope:
+    """Install a process-global seeded :class:`FaultInjector` for the
+    scope (off by default; gated by ``geomesa.fault.injection``). The
+    injector is global — faults fire on server/consumer threads too."""
+    return _InjectScope(FaultInjector(seed))
+
+
+# ---------------------------------------------------------------------------
+# Typed partial-result degradation
+# ---------------------------------------------------------------------------
+
+
+@dataclass(frozen=True)
+class Skipped:
+    """One unit of work dropped from a degraded scan."""
+
+    source: str        # e.g. "fs.read_partition", "exec.partition.scan"
+    part: str          # partition name / bin / file path
+    error: str         # repr of the failure
+    phase: str = ""    # optional sub-phase ("decode", "scan", ...)
+
+
+@dataclass
+class PartialResult(Generic[T]):
+    """An aggregate over the surviving subset of a partitioned scan.
+
+    ``value`` is exact over ``ok_parts`` partitions; ``skipped`` lists the
+    dropped ones with why. ``degraded`` is False when nothing was skipped
+    (then ``value`` is the complete answer)."""
+
+    value: T
+    skipped: List[Skipped] = field(default_factory=list)
+    total_parts: int = 0
+    ok_parts: int = 0
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.skipped)
+
+    def unwrap(self) -> T:
+        """``value``, raising if anything was skipped (strict consumers)."""
+        if self.skipped:
+            s = self.skipped[0]
+            raise RuntimeError(
+                f"degraded result: {len(self.skipped)} partition(s) skipped "
+                f"(first: {s.part}: {s.error})"
+            )
+        return self.value
+
+
+class DegradationCollector:
+    """Accumulates :class:`Skipped` records for one logical operation.
+    Installed thread-locally by :func:`allow_partial`."""
+
+    def __init__(self):
+        self.skipped: List[Skipped] = []
+        self._lock = threading.Lock()
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.skipped)
+
+    def add(self, rec: Skipped) -> None:
+        with self._lock:
+            self.skipped.append(rec)
+
+
+_partial_local = threading.local()
+
+
+def _collectors() -> List[DegradationCollector]:
+    st = getattr(_partial_local, "stack", None)
+    if st is None:
+        st = _partial_local.stack = []
+    return st
+
+
+class _PartialScope:
+    def __enter__(self) -> DegradationCollector:
+        c = DegradationCollector()
+        _collectors().append(c)
+        return c
+
+    def __exit__(self, *exc):
+        _collectors().pop()
+        return False
+
+
+def allow_partial() -> _PartialScope:
+    """``with allow_partial() as partial:`` — partition failures inside the
+    scope degrade (skip + record) instead of raising; ``partial.skipped``
+    holds the account. Nests; records land in the innermost collector."""
+    return _PartialScope()
+
+
+def partial_allowed() -> bool:
+    """May the current operation degrade? True inside an
+    :func:`allow_partial` scope or when ``geomesa.scan.partial`` is set."""
+    if _collectors():
+        return True
+    return bool(config.SCAN_PARTIAL.to_bool())
+
+
+def record_skip(source: str, part: str, error: BaseException,
+                phase: str = "") -> Skipped:
+    """Record one skipped partition: into the active collector (if any)
+    and the process audit trail (``audit.degradations``). Callers decide
+    whether to continue (see :func:`partial_allowed`)."""
+    rec = Skipped(source=source, part=str(part), error=repr(error), phase=phase)
+    st = _collectors()
+    if st:
+        st[-1].add(rec)
+    from geomesa_tpu import audit
+
+    audit.record_degradation(rec)
+    return rec
+
+
+__all__ = [
+    "QueryTimeoutError", "CircuitOpenError", "InjectedFault",
+    "RetryPolicy", "Deadline", "UNLIMITED", "current_deadline",
+    "deadline_scope", "check_deadline",
+    "CircuitBreaker", "breaker", "reset_breakers",
+    "FaultInjector", "fault_point", "inject_faults",
+    "Skipped", "PartialResult", "DegradationCollector", "allow_partial",
+    "partial_allowed", "record_skip",
+]

@@ -9,13 +9,49 @@ sidecar process, with Arrow as the interchange.
 from __future__ import annotations
 
 import json
+import re
+import threading
 from typing import Dict, List, Optional, Sequence
 
 import numpy as np
 import pyarrow as pa
 import pyarrow.flight as fl
 
+from geomesa_tpu import config, resilience
+from geomesa_tpu.resilience import QueryTimeoutError
 from geomesa_tpu.stats import sketches as sk
+
+#: structured error-code prefix on Flight error messages (PROTOCOL.md §7.1):
+#: "[GM-ARG] unknown schema 'x'" — lets clients classify retryable vs fatal
+#: without string-matching free-form text.
+_CODE_RE = re.compile(r"\[(GM-[A-Z]+)\]")
+
+#: codes a client may retry (transient server states); everything else is
+#: fatal — the same request would fail the same way.
+RETRYABLE_CODES = {"GM-INTERNAL", "GM-UNAVAILABLE"}
+
+
+def error_code(exc: BaseException) -> Optional[str]:
+    """The ``GM-*`` code carried by a Flight error, or None."""
+    m = _CODE_RE.search(str(exc))
+    return m.group(1) if m else None
+
+
+def is_retryable(exc: BaseException) -> bool:
+    """Transport-level classification for the retry policy: coded errors
+    retry iff their code is in RETRYABLE_CODES; uncoded transport failures
+    (connection refused/reset, deadline on the channel) are retryable;
+    coded-fatal and client-side errors are not."""
+    code = error_code(exc)
+    if code is not None:
+        return code in RETRYABLE_CODES
+    if isinstance(exc, (fl.FlightUnavailableError, fl.FlightInternalError)):
+        return True
+    if isinstance(exc, fl.FlightTimedOutError):
+        # per-call timeout: the server may just be slow — one retry is
+        # worth it, and a live query deadline bounds the total spend
+        return True
+    return False
 
 
 def _dense_grid(t: pa.Table, shape, dtype) -> np.ndarray:
@@ -27,8 +63,20 @@ def _dense_grid(t: pa.Table, shape, dtype) -> np.ndarray:
 
 
 class GeoFlightClient:
-    def __init__(self, location: str, **kw):
+    """Flight client with the full client-side resilience stack: per-call
+    timeouts (``geomesa.sidecar.timeout``, tightened to any live query
+    deadline), seeded exponential-backoff retries of retryable failures
+    with channel reconnect between attempts, and a per-location circuit
+    breaker shared across client instances (a dead sidecar fails fast
+    instead of paying the timeout on every call)."""
+
+    def __init__(self, location: str, retry_seed: Optional[int] = None, **kw):
+        self.location = location
+        self._kw = kw
         self._client = fl.FlightClient(location, **kw)
+        self._lock = threading.Lock()
+        self._retry = resilience.RetryPolicy.from_config(seed=retry_seed)
+        self._breaker = resilience.breaker(f"sidecar:{location}")
 
     def close(self):
         self._client.close()
@@ -40,11 +88,103 @@ class GeoFlightClient:
         self.close()
         return False
 
+    # -- resilience plumbing -----------------------------------------------
+    @staticmethod
+    def _effective_timeout_s() -> Optional[float]:
+        """Per-call timeout: the configured sidecar timeout, tightened to
+        the remaining query deadline when one is active (deadline
+        propagation — a 2 s query budget never waits 30 s on the wire)."""
+        ms = config.SIDECAR_TIMEOUT.to_duration_ms()
+        t = ms / 1000.0 if ms is not None else None
+        rem = resilience.current_deadline().remaining_s()
+        if rem is not None:
+            rem = max(rem, 0.001)  # expired: fail via a tiny wire timeout
+            t = rem if t is None else min(t, rem)
+        return t
+
+    def _call_options(self) -> Optional[fl.FlightCallOptions]:
+        t = self._effective_timeout_s()
+        return fl.FlightCallOptions(timeout=t) if t is not None else None
+
+    def _reconnect(self):
+        """Swap in a fresh channel (the old one may be a stale connection
+        to a restarted server). The old channel is abandoned, NOT closed:
+        another thread may have an in-flight RPC on it, and closing would
+        abort a healthy call (and charge the shared breaker for it) — GC
+        reclaims the dropped channel."""
+        with self._lock:
+            self._client = fl.FlightClient(self.location, **self._kw)
+
+    def _invoke(self, fault_site: str, fn, retry: bool = True):
+        """Breaker + retry + reconnect envelope shared by every RPC.
+        ``retry=False`` (writes) still gets the breaker and timeout but
+        never re-sends: a do_put whose ack was lost may have committed."""
+        if resilience.current_deadline().expired:
+            # the query budget is gone before dialing: raise the typed
+            # timeout directly — a client-side deadline says nothing about
+            # the sidecar's health, so the breaker must not be charged
+            raise QueryTimeoutError(
+                "query deadline expired before sidecar call"
+            )
+        self._breaker.allow()
+
+        def attempt():
+            resilience.fault_point(fault_site)
+            return fn()
+
+        def run():
+            if not retry:
+                return attempt()
+            return self._retry.call(
+                attempt,
+                retryable=is_retryable,
+                deadline=resilience.current_deadline(),
+                on_retry=lambda i, e: self._reconnect(),
+            )
+
+        try:
+            out = run()
+        except Exception as e:
+            code = error_code(e)
+            if code in ("GM-ARG", "GM-TIMEOUT"):
+                # a coded domain error/timeout IS a server response: the
+                # callee is healthy — only transport failures and
+                # GM-INTERNAL count toward opening the circuit (bad user
+                # queries must never fence the sidecar off for everyone)
+                self._breaker.record_success()
+            else:
+                self._breaker.record_failure()
+            if code == "GM-TIMEOUT":
+                raise QueryTimeoutError(str(e)) from e
+            raise
+        self._breaker.record_success()
+        return out
+
+    #: actions that mutate server state: like do_put, never retried — a
+    #: lost ack may mean the mutation committed, and a blind re-send would
+    #: surface a bogus "already exists"/"unknown schema" for a call that
+    #: actually succeeded
+    _MUTATING_ACTIONS = frozenset({"create-schema", "delete-schema"})
+
     # -- actions -----------------------------------------------------------
     def _action(self, kind: str, body: Optional[Dict] = None) -> Dict:
         action = fl.Action(kind, json.dumps(body or {}).encode())
-        results = list(self._client.do_action(action))
-        return json.loads(results[0].body.to_pybytes().decode()) if results else {}
+
+        def go():
+            opts = self._call_options()
+            results = (
+                list(self._client.do_action(action, opts))
+                if opts is not None else list(self._client.do_action(action))
+            )
+            return (
+                json.loads(results[0].body.to_pybytes().decode())
+                if results else {}
+            )
+
+        return self._invoke(
+            "sidecar.do_action", go,
+            retry=kind not in self._MUTATING_ACTIONS,
+        )
 
     def version(self) -> Dict:
         """Server library + protocol version."""
@@ -104,7 +244,16 @@ class GeoFlightClient:
     # -- reads -------------------------------------------------------------
     def _get(self, opts: Dict) -> pa.Table:
         ticket = fl.Ticket(json.dumps(opts).encode())
-        return self._client.do_get(ticket).read_all()
+
+        def go():
+            copts = self._call_options()
+            reader = (
+                self._client.do_get(ticket, copts)
+                if copts is not None else self._client.do_get(ticket)
+            )
+            return reader.read_all()
+
+        return self._invoke("sidecar.do_get", go)
 
     def query(self, name: str, ecql: str = "INCLUDE", properties=None,
               max_features=None, sampling=None, sample_by=None,
@@ -186,6 +335,18 @@ class GeoFlightClient:
         descriptor = fl.FlightDescriptor.for_command(
             json.dumps({"schema": name}).encode()
         )
-        writer, _ = self._client.do_put(descriptor, table.schema)
-        writer.write_table(table)
-        writer.close()
+
+        def go():
+            copts = self._call_options()
+            writer, _ = (
+                self._client.do_put(descriptor, table.schema, copts)
+                if copts is not None
+                else self._client.do_put(descriptor, table.schema)
+            )
+            writer.write_table(table)
+            writer.close()
+
+        # retry=False: an upload whose ack was lost may have committed —
+        # re-sending would double-insert (the server ingest is transactional
+        # per stream, but not idempotent across streams)
+        self._invoke("sidecar.do_put", go, retry=False)

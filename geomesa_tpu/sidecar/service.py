@@ -63,6 +63,22 @@ def _sparse_grid_batch(grid: np.ndarray, dtype) -> pa.RecordBatch:
     )
 
 
+def _wire_schema(schema: pa.Schema) -> pa.Schema:
+    """The on-wire variant of a §2 schema: dictionary<int32, utf8> fields
+    ride as plain utf8 (PROTOCOL §3 v1.1 note — see do_get). Returns the
+    input object unchanged when nothing is dictionary-encoded."""
+    if not any(pa.types.is_dictionary(f.type) for f in schema):
+        return schema
+    return pa.schema(
+        [
+            pa.field(f.name, f.type.value_type, f.nullable)
+            if pa.types.is_dictionary(f.type) else f
+            for f in schema
+        ],
+        metadata=schema.metadata,
+    )
+
+
 def _query_from(opts: Dict) -> Query:
     return Query(
         ecql=opts.get("ecql", "INCLUDE"),
@@ -77,20 +93,104 @@ def _query_from(opts: Dict) -> Query:
 
 
 def _spec_errors(fn):
-    """PROTOCOL.md §7: domain errors (unknown schema/attribute, guard
-    rejections, unsupported ops) cross the wire as FlightServerError with
-    the original message — never as raw Arrow-mapped Python exceptions."""
+    """PROTOCOL.md §7: every server-raised error crosses the wire as a
+    Flight error whose message leads with a structured ``[GM-*]`` code, so
+    clients classify retryable vs fatal without parsing free-form text:
+
+    * ``GM-ARG`` (fatal) — domain errors: unknown schema/attribute, bad
+      ECQL, guard rejections, unsupported ops;
+    * ``GM-TIMEOUT`` (fatal) — the server-side query deadline fired; the
+      client maps it back to ``QueryTimeoutError``;
+    * ``GM-INTERNAL`` (retryable) — unexpected server failure.
+
+    Already-coded Flight errors pass through untouched."""
     import functools
+
+    from geomesa_tpu.resilience import QueryTimeoutError
 
     @functools.wraps(fn)
     def wrapped(*args, **kw):
         try:
             return fn(*args, **kw)
+        except QueryTimeoutError as e:
+            raise fl.FlightTimedOutError(f"[GM-TIMEOUT] {e}") from e
         except (KeyError, ValueError, NotImplementedError) as e:
             msg = e.args[0] if e.args else str(e)
-            raise fl.FlightServerError(str(msg)) from e
+            raise fl.FlightServerError(f"[GM-ARG] {msg}") from e
+        except fl.FlightError:
+            raise  # already coded (or deliberately uncoded) by the handler
+        except Exception as e:
+            raise fl.FlightServerError(f"[GM-INTERNAL] {e!r}") from e
 
     return wrapped
+
+
+class _QueryThread:
+    """Single dedicated worker that runs every dataset operation.
+
+    gRPC owns the transport threads Flight handlers run on; compiling jax
+    kernels there wedges nondeterministically (MLIR context creation can
+    deadlock on a foreign C++ thread — observed as an unkillable server
+    stuck in ``make_ir_context`` under the conformance suite). Routing all
+    planning/compute through one ordinary Python thread keeps jax on the
+    kind of thread it is tested on, and matches the device model anyway:
+    the sidecar owns ONE accelerator, and device work is serial."""
+
+    def __init__(self):
+        import queue
+
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._stopped = False
+        self._t = threading.Thread(
+            target=self._loop, name="geomesa-query", daemon=True
+        )
+        self._t.start()
+
+    def _loop(self):
+        while True:
+            fut, fn = self._q.get()
+            if fn is None:
+                # drain stragglers that raced the stop: their callers must
+                # not block forever on a future nothing will complete
+                while True:
+                    try:
+                        fut2, fn2 = self._q.get_nowait()
+                    except Exception:
+                        return
+                    if fn2 is not None:
+                        fut2.set_exception(
+                            RuntimeError("sidecar query thread stopped")
+                        )
+            try:
+                fut.set_result(fn())
+            except BaseException as e:  # noqa: B036 — relayed to caller
+                fut.set_exception(e)
+
+    def run(self, fn):
+        """Run ``fn()`` on the query thread; re-raises its exception."""
+        from concurrent.futures import Future
+
+        if self._stopped:
+            raise RuntimeError("sidecar query thread stopped")
+        fut: Future = Future()
+        self._q.put((fut, fn))
+        return fut.result()
+
+    def iterate(self, it):
+        """Drive iterator ``it`` with every ``next`` on the query thread
+        (streamed exports compute their chunks there too)."""
+        done = object()
+        while True:
+            item = self.run(lambda: next(it, done))
+            if item is done:
+                return
+            yield item
+
+    def stop(self):
+        from concurrent.futures import Future
+
+        self._stopped = True
+        self._q.put((Future(), None))
 
 
 class GeoFlightServer(fl.FlightServerBase):
@@ -99,10 +199,22 @@ class GeoFlightServer(fl.FlightServerBase):
         super().__init__(location, **kw)
         self.dataset = dataset if dataset is not None else GeoDataset()
         self._lock = threading.Lock()
+        self._qt = _QueryThread()
+
+    def shutdown(self, *a, **kw):
+        # stop the worker AFTER Flight drains active RPCs — those RPCs hop
+        # onto the query thread, and stopping it first would strand them
+        # on futures nothing completes (shutdown would then never return)
+        out = super().shutdown(*a, **kw)
+        self._qt.stop()
+        return out
 
     # -- reads -------------------------------------------------------------
     @_spec_errors
     def do_get(self, context, ticket: fl.Ticket) -> fl.RecordBatchStream:
+        return self._qt.run(lambda: self._do_get(ticket))
+
+    def _do_get(self, ticket: fl.Ticket) -> fl.RecordBatchStream:
         opts = json.loads(ticket.ticket.decode())
         op = opts.get("op", "query")
         name = opts["schema"]
@@ -120,6 +232,12 @@ class GeoFlightServer(fl.FlightServerBase):
             st = ds._store(name)
             st.flush()
             schema = arrow_io.arrow_schema(st.ft, q.properties, st.wkt_geoms())
+            # String columns stream PLAIN utf8, decoded per chunk (PROTOCOL
+            # §3 v1.1 note): pyarrow's GeneratorStream no longer writes
+            # dictionary batches (nor Table chunks) correctly — clients hit
+            # "expected number of dictionaries" — and a dictionary reader
+            # accepts plain utf8 transparently via the stream schema.
+            wire = _wire_schema(schema)
 
             # planning runs HERE (query_batches plans eagerly), so bad
             # ECQL / guard vetoes surface as FlightServerError via the
@@ -127,22 +245,33 @@ class GeoFlightServer(fl.FlightServerBase):
             batches = ds.query_batches(name, q)
 
             def gen():
-                # chunks ride as single-batch Tables: pyarrow's
-                # GeneratorStream only writes dictionary batches on its
-                # Table path (bare RecordBatches lose them and the client
-                # fails with "expected number of dictionaries")
-                any_ = False
-                for batch in batches:
-                    if batch.n:
-                        any_ = True
-                        rb = arrow_io.batch_to_arrow(
-                            st.ft, batch, st.dicts, q.properties
-                        )
-                        yield pa.Table.from_batches([rb])
-                if not any_:
-                    yield schema.empty_table()
+                # mid-stream failures surface during gRPC iteration, OUTSIDE
+                # the _spec_errors decorator (do_get already returned): apply
+                # the same [GM-*] coding here so a streamed deadline expiry
+                # is typed (not an uncoded internal error the client would
+                # re-scan for nothing)
+                from geomesa_tpu.resilience import QueryTimeoutError
 
-            return fl.GeneratorStream(schema, gen())
+                try:
+                    for batch in batches:
+                        if batch.n:
+                            rb = arrow_io.batch_to_arrow(
+                                st.ft, batch, st.dicts, q.properties
+                            )
+                            t = pa.Table.from_batches([rb])
+                            if wire is not schema:
+                                t = t.cast(wire)
+                            yield from t.to_batches()
+                except QueryTimeoutError as e:
+                    raise fl.FlightTimedOutError(f"[GM-TIMEOUT] {e}") from e
+                except fl.FlightError:
+                    raise
+                except Exception as e:
+                    raise fl.FlightServerError(f"[GM-INTERNAL] {e!r}") from e
+
+            # chunks are computed on the query thread too: gRPC pulls the
+            # stream from its own threads, but every next() hops back
+            return fl.GeneratorStream(wire, self._qt.iterate(gen()))
         if op == "density":
             q = _query_from(opts)
             grid = ds.density(
@@ -180,7 +309,7 @@ class GeoFlightServer(fl.FlightServerBase):
             )
             batch = pa.record_batch([pa.array([blob], pa.binary())], names=["bin"])
             return fl.RecordBatchStream(pa.Table.from_batches([batch]))
-        raise fl.FlightServerError(f"unknown op {op!r}")
+        raise fl.FlightServerError(f"[GM-ARG] unknown op {op!r}")
 
     # -- writes ------------------------------------------------------------
     @_spec_errors
@@ -190,7 +319,7 @@ class GeoFlightServer(fl.FlightServerBase):
         if not name and descriptor.path:
             name = descriptor.path[0].decode()
         if not name:
-            raise fl.FlightServerError("do_put needs a schema name")
+            raise fl.FlightServerError("[GM-ARG] do_put needs a schema name")
         # Stage the stream chunk-by-chunk WITHOUT the write lock (a slow
         # uploader must not block other writers), then ingest + flush as
         # one locked transaction: a mid-stream failure commits nothing.
@@ -202,23 +331,30 @@ class GeoFlightServer(fl.FlightServerBase):
                 break
             if chunk.data is not None and chunk.data.num_rows:
                 staged.append(chunk.data)
-        n = 0
-        st = self.dataset._store(name)
-        with self._lock:
-            mark = len(st._buffer)
-            try:
-                for rb in staged:
-                    n += self.dataset.ingest_arrow(name, rb)
-                self.dataset.flush(name)
-            except Exception:
-                del st._buffer[mark:]  # roll back this upload's batches
-                raise
+        def ingest():
+            n = 0
+            st = self.dataset._store(name)
+            with self._lock:
+                mark = len(st._buffer)
+                try:
+                    for rb in staged:
+                        n += self.dataset.ingest_arrow(name, rb)
+                    self.dataset.flush(name)
+                except Exception:
+                    del st._buffer[mark:]  # roll back this upload's batches
+                    raise
+            return n
+
+        n = self._qt.run(ingest)
         writer  # (no app-metadata channel needed; count via describe/count)
         return n
 
     # -- actions -----------------------------------------------------------
     @_spec_errors
     def do_action(self, context, action: fl.Action) -> Iterator[fl.Result]:
+        return self._qt.run(lambda: self._do_action(action))
+
+    def _do_action(self, action: fl.Action) -> Iterator[fl.Result]:
         body = json.loads(action.body.to_pybytes().decode()) if action.body else {}
         ds = self.dataset
         kind = action.type
@@ -258,7 +394,7 @@ class GeoFlightServer(fl.FlightServerBase):
             return ok({
                 "version": _lib_version(), "protocol": PROTOCOL_VERSION,
             })
-        raise fl.FlightServerError(f"unknown action {kind!r}")
+        raise fl.FlightServerError(f"[GM-ARG] unknown action {kind!r}")
 
     def list_actions(self, context):
         return [
@@ -282,7 +418,7 @@ class GeoFlightServer(fl.FlightServerBase):
             descriptor = fl.FlightDescriptor.for_path(name.encode())
             ticket = fl.Ticket(json.dumps({"op": "query", "schema": name}).encode())
             yield fl.FlightInfo(
-                arrow_io.arrow_schema(ft), descriptor,
+                _wire_schema(arrow_io.arrow_schema(ft)), descriptor,
                 [fl.FlightEndpoint(ticket, [])], -1, -1,
             )
 
@@ -293,7 +429,7 @@ class GeoFlightServer(fl.FlightServerBase):
         ft = self.dataset.get_schema(name)
         ticket = fl.Ticket(json.dumps({"op": "query", "schema": name}).encode())
         return fl.FlightInfo(
-            arrow_io.arrow_schema(ft), descriptor,
+            _wire_schema(arrow_io.arrow_schema(ft)), descriptor,
             [fl.FlightEndpoint(ticket, [])], -1, -1,
         )
 

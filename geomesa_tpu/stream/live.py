@@ -66,6 +66,30 @@ class LiveFeatureCache:
         return len(self._state)
 
     # -- mutation ----------------------------------------------------------
+    def validate(self, attrs: Dict[str, Any]) -> None:
+        """Reject a payload the columnar encode could not absorb (poison
+        protection: an unappliable feature must fail HERE, at the message,
+        not later in ``batch()`` where it would poison every query of the
+        window). Point geometries must be None or an (x, y) pair of
+        numbers; extent geometries must be None or a WKT string."""
+        for a in self.ft.attributes:
+            if not a.is_geom:
+                continue
+            v = attrs.get(a.name)
+            if v is None:
+                continue
+            if a.is_point:
+                try:
+                    float(v[0]), float(v[1])
+                except (TypeError, ValueError, IndexError, KeyError) as e:
+                    raise ValueError(
+                        f"bad point payload for {a.name!r}: {v!r}"
+                    ) from e
+            elif not isinstance(v, str):
+                raise ValueError(
+                    f"bad geometry payload for {a.name!r}: {type(v).__name__}"
+                )
+
     def put(self, fid: str, attrs: Dict[str, Any], ts_ms: int):
         with self._lock:
             cur = self._state.get(fid)
@@ -229,6 +253,10 @@ class StreamingDataset:
         self._caches: Dict[str, LiveFeatureCache] = {}
         self._offsets: Dict[str, List[int]] = {}
         self._listeners: Dict[str, List[Callable[[GeoMessage], None]]] = {}
+        #: poison-message quarantine counters per schema (docs/RESILIENCE.md):
+        #: a message that fails to decode or apply is counted + recorded and
+        #: skipped — it can never kill the consumer loop
+        self.quarantined: Dict[str, int] = {}
 
     # -- schema CRUD -------------------------------------------------------
     def create_schema(self, name_or_ft, spec: Optional[str] = None) -> FeatureType:
@@ -293,27 +321,62 @@ class StreamingDataset:
         self._topics[name].send(GeoMessage.clear(int(time.time() * 1000)))
 
     # -- consumer (micro-batch) --------------------------------------------
+    def _quarantine(self, name: str, part, error: BaseException,
+                    phase: str) -> None:
+        """Poison-message quarantine (docs/RESILIENCE.md): count, record
+        through the audit degradation trail, and move on — a bad message
+        must never kill the consumer."""
+        from geomesa_tpu import resilience
+
+        self.quarantined[name] = self.quarantined.get(name, 0) + 1
+        resilience.record_skip(
+            "stream.poll.decode", f"{name}/{part}", error, phase=phase
+        )
+
     def poll(self, name: Optional[str] = None, max_messages: int = 100_000) -> int:
-        """Consume pending messages into the live cache(s). Returns #consumed."""
+        """Consume pending messages into the live cache(s). Returns #consumed
+        (quarantined poison messages are skipped, counted in
+        :attr:`quarantined`, and NOT included in the returned count)."""
         names = [name] if name else list(self._schemas)
         total = 0
         for nm in names:
             msgs, self._offsets[nm] = self._topics[nm].poll(
-                self._offsets[nm], max_messages
+                self._offsets[nm], max_messages,
+                on_error=lambda p, off, raw, e, nm=nm: self._quarantine(
+                    nm, f"{p}@{off}", e, "decode"
+                ),
             )
             cache = self._caches[nm]
             listeners = self._listeners[nm]
             for m in msgs:
-                if m.kind == CHANGE:
-                    cache.put(m.fid, m.payload or {}, m.ts_ms)
-                elif m.kind == DELETE:
-                    cache.remove(m.fid)
-                elif m.kind == CLEAR:
-                    cache.clear()
+                try:
+                    if m.kind == CHANGE:
+                        cache.validate(m.payload or {})
+                        cache.put(m.fid, m.payload or {}, m.ts_ms)
+                    elif m.kind == DELETE:
+                        cache.remove(m.fid)
+                    elif m.kind == CLEAR:
+                        cache.clear()
+                except Exception as e:
+                    # decoded but unappliable (bad payload types): same
+                    # quarantine path as an undecodable message
+                    self._quarantine(nm, m.fid or m.kind, e, "apply")
+                    continue
                 for fn in listeners:
-                    fn(m)
+                    try:
+                        fn(m)
+                    except Exception:
+                        # a throwing listener is an observer bug, not a data
+                        # fault: log it, keep the message (it applied) and
+                        # the consumer alive
+                        import logging
+
+                        logging.getLogger(__name__).warning(
+                            "feature listener failed on %s/%s",
+                            nm, m.fid or m.kind, exc_info=True,
+                        )
+                total += 1
             cache.expire()
-            total += len(msgs)
         return total
 
     # -- local query runner (KafkaQueryRunner analog) ----------------------

@@ -90,8 +90,16 @@ class Topic:
         with self._lock:
             self._logs[p].append(data)
 
-    def poll(self, offsets: List[int], max_messages: int = 10_000) -> Tuple[List[GeoMessage], List[int]]:
-        """Read from per-partition ``offsets``; returns (messages, new offsets)."""
+    def poll(self, offsets: List[int], max_messages: int = 10_000,
+             on_error=None) -> Tuple[List[GeoMessage], List[int]]:
+        """Read from per-partition ``offsets``; returns (messages, new offsets).
+
+        ``on_error(partition, offset, raw_bytes, exc)`` — when given, an
+        undecodable (poison) message is reported and SKIPPED, and the offset
+        still advances past it; without it, decode errors raise (a consumer
+        that doesn't opt into quarantine must not silently lose data)."""
+        from geomesa_tpu import resilience
+
         out: List[GeoMessage] = []
         new = list(offsets)
         with self._lock:
@@ -99,7 +107,16 @@ class Topic:
                 log = self._logs[p]
                 end = min(len(log), offsets[p] + max_messages)
                 for i in range(offsets[p], end):
-                    out.append(GeoMessage.deserialize(log[i]))
+                    try:
+                        resilience.fault_point(
+                            "stream.poll.decode", topic=self.name,
+                            partition=p, offset=i,
+                        )
+                        out.append(GeoMessage.deserialize(log[i]))
+                    except Exception as e:
+                        if on_error is None:
+                            raise
+                        on_error(p, i, log[i], e)
                 new[p] = end
         out.sort(key=lambda m: m.ts_ms)
         return out, new

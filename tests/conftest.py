@@ -15,10 +15,20 @@ import os
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+if "--xla_force_host_platform_device_count" not in os.environ.get("XLA_FLAGS", ""):
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax: the XLA_FLAGS fallback above provides the 8 virtual devices
+    pass
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

@@ -1,0 +1,619 @@
+"""Resilience layer: policies, deterministic fault injection, and typed
+partial-result degradation (docs/RESILIENCE.md).
+
+The chaos scenarios here are the acceptance contract of the layer — every
+seeded fault ends in a successful retry or a TYPED outcome (``Degraded``
+account / ``QueryTimeoutError``), never a hang, a dead consumer, or a
+silently wrong aggregate: degraded totals must equal the sum over the
+partitions that survived.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, audit, config, resilience
+from geomesa_tpu.filter.ecql import parse_iso_ms
+from geomesa_tpu.resilience import (
+    CircuitBreaker, CircuitOpenError, Deadline, InjectedFault, QueryTimeoutError,
+    RetryPolicy, allow_partial, check_deadline, deadline_scope, fault_point,
+    inject_faults,
+)
+
+SPEC = "name:String:index=true,weight:Double,dtg:Date,*geom:Point"
+PSPEC = SPEC + ";geomesa.partition='time'"
+
+
+def _data(n=3000, seed=7):
+    rng = np.random.default_rng(seed)
+    return {
+        "name": [f"actor{i % 5}" for i in range(n)],
+        "weight": rng.uniform(0, 10, n),
+        "dtg": rng.integers(
+            parse_iso_ms("2020-01-01"), parse_iso_ms("2020-02-15"), n
+        ).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+    }
+
+
+# ---------------------------------------------------------------------------
+# policy units
+# ---------------------------------------------------------------------------
+
+
+def test_retry_policy_deterministic_backoff():
+    mk = lambda: RetryPolicy(  # noqa: E731
+        attempts=5, base_ms=10, max_ms=60, jitter=0.5, seed=123
+    )
+    a, b = mk().delays_ms(), mk().delays_ms()
+    assert a == b  # seeded jitter replays identically
+    assert len(a) == 4
+    # exponential shape under the cap: un-jittered would be 10, 20, 40, 60
+    for d, hi in zip(a, (10, 20, 40, 60)):
+        assert hi * 0.5 <= d <= hi
+
+
+def test_retry_policy_retries_then_succeeds():
+    calls = []
+
+    def flaky():
+        calls.append(1)
+        if len(calls) < 3:
+            raise OSError("transient")
+        return "ok"
+
+    p = RetryPolicy(attempts=3, base_ms=1, jitter=0.0, sleep=lambda s: None)
+    assert p.call(flaky) == "ok"
+    assert len(calls) == 3
+
+
+def test_retry_policy_respects_classification_and_attempts():
+    p = RetryPolicy(attempts=3, base_ms=1, jitter=0.0, sleep=lambda s: None)
+    calls = []
+
+    def fatal():
+        calls.append(1)
+        raise ValueError("bad request")
+
+    with pytest.raises(ValueError):
+        p.call(fatal, retryable=lambda e: isinstance(e, OSError))
+    assert len(calls) == 1  # fatal: no retry
+
+    calls.clear()
+
+    def always():
+        calls.append(1)
+        raise OSError("down")
+
+    with pytest.raises(OSError):
+        RetryPolicy(attempts=3, base_ms=1, jitter=0.0,
+                    sleep=lambda s: None).call(always)
+    assert len(calls) == 3  # attempts exhausted
+
+
+def test_retry_policy_stops_at_deadline():
+    calls = []
+
+    def always():
+        calls.append(1)
+        raise OSError("down")
+
+    with deadline_scope(0.0) as d:
+        with pytest.raises(OSError):
+            RetryPolicy(attempts=10, base_ms=1, jitter=0.0,
+                        sleep=lambda s: None).call(always, deadline=d)
+    assert len(calls) == 1  # no budget left: first failure is final
+
+
+def test_deadline_scope_and_nesting():
+    with deadline_scope(None):
+        check_deadline()  # unlimited: no-op
+        with deadline_scope(0.0):
+            with pytest.raises(QueryTimeoutError):
+                check_deadline()
+        check_deadline()  # inner scope popped
+    assert resilience.current_deadline() is resilience.UNLIMITED
+    assert Deadline.after(None).remaining_s() is None
+    assert Deadline.after(100.0).remaining_s() > 99.0
+
+
+def test_circuit_breaker_states():
+    clock = [0.0]
+    b = CircuitBreaker("t", threshold=3, reset_ms=1000, clock=lambda: clock[0])
+    for _ in range(2):
+        b.record_failure()
+    b.allow()  # still closed below threshold
+    b.record_failure()
+    with pytest.raises(CircuitOpenError) as ei:
+        b.allow()
+    assert ei.value.retry_after_s <= 1.0
+    clock[0] = 1.5
+    assert b.state == CircuitBreaker.HALF_OPEN
+    b.allow()  # trial call admitted
+    b.record_failure()  # trial failed: re-open
+    with pytest.raises(CircuitOpenError):
+        b.allow()
+    clock[0] = 3.0
+    b.allow()
+    b.record_success()
+    assert b.state == CircuitBreaker.CLOSED
+
+
+# ---------------------------------------------------------------------------
+# fault injection plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_fault_point_is_noop_when_uninstalled():
+    assert resilience._injector is None  # off by default
+    fault_point("anything.at.all", extra=1)  # must not raise
+
+
+def test_inject_faults_requires_config_flag():
+    with pytest.raises(RuntimeError, match="geomesa.fault.injection"):
+        with inject_faults():
+            pass
+
+
+def test_injector_deterministic_and_bounded():
+    with config.FAULT_INJECTION.scoped("true"):
+        with inject_faults(seed=3) as inj:
+            rule = inj.fail("edge.*", times=2)
+            for _ in range(2):
+                with pytest.raises(InjectedFault):
+                    fault_point("edge.read")
+            fault_point("edge.read")  # rule exhausted
+            fault_point("other.site")  # never matched
+            assert rule.hits == 2
+            assert [s for s, _ in inj.fired] == ["edge.read", "edge.read"]
+    fault_point("edge.read")  # uninstalled again
+
+
+def test_injector_probabilistic_rules_replay_identically():
+    def run(seed):
+        fired = []
+        with config.FAULT_INJECTION.scoped("true"):
+            with inject_faults(seed=seed) as inj:
+                inj.fail("p.*", times=None, p=0.5)
+                for i in range(20):
+                    try:
+                        fault_point("p.x")
+                        fired.append(0)
+                    except InjectedFault:
+                        fired.append(1)
+        return fired
+
+    assert run(9) == run(9)  # seeded: same coin flips
+    assert 0 < sum(run(9)) < 20  # actually probabilistic
+
+
+# ---------------------------------------------------------------------------
+# chaos scenario 1: flaky Flight call -> retry succeeds; fatal -> no retry
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def flight():
+    from geomesa_tpu.sidecar import GeoFlightClient, GeoFlightServer
+
+    resilience.reset_breakers()
+    srv = GeoFlightServer(GeoDataset(n_shards=2, prefer_device=False))
+    ds = srv.dataset
+    ds.create_schema("t", SPEC)
+    ds.insert("t", _data(500), fids=[f"f{i}" for i in range(500)])
+    ds.flush("t")
+    with GeoFlightClient(f"grpc+tcp://127.0.0.1:{srv.port}",
+                         retry_seed=1) as client:
+        yield srv, client
+    srv.shutdown()
+    resilience.reset_breakers()
+
+
+def test_flaky_flight_call_retries_to_success(flight):
+    import pyarrow.flight as fl
+
+    _, client = flight
+    with config.FAULT_INJECTION.scoped("true"), \
+            config.RETRY_BASE_MS.scoped("1"):
+        with inject_faults(seed=5) as inj:
+            rule = inj.fail(
+                "sidecar.do_action",
+                lambda: fl.FlightUnavailableError("sidecar restarting"),
+                times=2,
+            )
+            assert client.count("t") == 500  # 2 failures, then success
+            assert rule.hits == 2
+    assert client._breaker.state == CircuitBreaker.CLOSED
+
+
+def test_fatal_flight_error_does_not_retry(flight):
+    import pyarrow.flight as fl
+
+    from geomesa_tpu.sidecar.client import error_code, is_retryable
+
+    _, client = flight
+    with pytest.raises(fl.FlightServerError) as ei:
+        client.query("t", "NOT REAL ECQL ((")
+    assert error_code(ei.value) == "GM-ARG"
+    assert not is_retryable(ei.value)
+    # uncoded transport failures stay retryable
+    assert is_retryable(fl.FlightUnavailableError("conn refused"))
+
+
+def test_server_timeout_maps_to_typed_error(flight, monkeypatch):
+    _, client = flight
+    monkeypatch.setenv("GEOMESA_QUERY_TIMEOUT", "0ms")
+    with pytest.raises(QueryTimeoutError):
+        client.count("t")
+    monkeypatch.delenv("GEOMESA_QUERY_TIMEOUT")
+    assert client.count("t") == 500  # recovers once the budget is sane
+
+
+def test_breaker_fences_repeated_failures(flight):
+    import pyarrow.flight as fl
+
+    _, client = flight
+    with config.FAULT_INJECTION.scoped("true"), \
+            config.RETRY_ATTEMPTS.scoped("1"), \
+            config.BREAKER_THRESHOLD.scoped("2"):
+        resilience.reset_breakers()
+        from geomesa_tpu.sidecar import GeoFlightClient
+
+        with GeoFlightClient(client.location, retry_seed=2) as c2:
+            with inject_faults(seed=5) as inj:
+                inj.fail("sidecar.do_action",
+                         lambda: fl.FlightUnavailableError("down"),
+                         times=None)
+                for _ in range(2):
+                    with pytest.raises(fl.FlightUnavailableError):
+                        c2.count("t")
+                # threshold hit: calls now fail fast without touching the wire
+                with pytest.raises(CircuitOpenError):
+                    c2.count("t")
+    resilience.reset_breakers()
+
+
+def test_client_timeout_tightens_to_deadline(flight):
+    _, client = flight
+    with config.SIDECAR_TIMEOUT.scoped("30 s"):
+        assert client._effective_timeout_s() == pytest.approx(30.0)
+        with deadline_scope(2.0):
+            assert client._effective_timeout_s() <= 2.0
+    # a default is always configured: no call can hang forever
+    assert config.SIDECAR_TIMEOUT.default is not None
+
+
+# ---------------------------------------------------------------------------
+# chaos scenario 2: corrupt partition file -> quarantine + typed degradation
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def fs_store(tmp_path):
+    from geomesa_tpu.fs.storage import DateTimeScheme, FileSystemStorage
+    from geomesa_tpu.schema.feature_type import FeatureType
+
+    fs = FileSystemStorage(str(tmp_path))
+    ft = FeatureType.from_spec("t", SPEC)
+    fs.create(ft, DateTimeScheme("month"))
+    fs.write("t", _data(2000))
+    assert len(fs.partitions("t")) == 2  # jan + feb
+    return fs
+
+
+def _corrupt_one_file(fs, name="t"):
+    files = sorted(glob.glob(os.path.join(fs.root, name, "data", "**", "*.parquet"),
+                             recursive=True))
+    assert files
+    with open(files[0], "wb") as fh:
+        fh.write(b"\x00garbage not parquet\xff" * 32)
+    return files[0]
+
+
+def test_corrupt_partition_strict_read_raises(fs_store):
+    _corrupt_one_file(fs_store)
+    with pytest.raises(Exception):
+        fs_store.read("t")
+
+
+def test_corrupt_partition_degrades_with_exact_surviving_total(fs_store):
+    full = fs_store.read("t")
+    per_part = {p: fs_store.read_partition("t", p).num_rows
+                for p in fs_store.partitions("t")}
+    assert sum(per_part.values()) == full.num_rows == 2000
+
+    bad = _corrupt_one_file(fs_store)
+    audit.degradations.clear()
+    pr = fs_store.read_partial("t")
+    assert pr.degraded
+    assert [s.part for s in pr.skipped] == [bad]
+    # the degraded aggregate equals the sum over SURVIVING partition files —
+    # never an estimate, never silently the old total
+    bad_part = pr.skipped[0].phase
+    survivors = sum(n for p, n in per_part.items() if p != bad_part)
+    assert pr.value.num_rows == survivors
+    assert 0 < pr.value.num_rows < 2000
+    assert pr.ok_parts == pr.total_parts - 1
+    # quarantined: later reads skip without re-parsing; strict still raises
+    assert bad in fs_store.quarantined()
+    with pytest.raises(Exception):
+        fs_store.read("t")
+    # recorded through the audit degradation trail
+    assert any(e.part == bad for e in audit.degradations.recent())
+
+
+def test_corrupt_partition_config_flag_degrades_plain_read(fs_store):
+    _corrupt_one_file(fs_store)
+    with config.SCAN_PARTIAL.scoped("true"):
+        t = fs_store.read("t")
+    assert 0 < t.num_rows < 2000
+
+
+def test_missing_column_is_schema_error_not_corruption(fs_store):
+    # a requested-but-missing column must raise (schema-evolution contract)
+    # WITHOUT quarantining the healthy file, even under partial mode
+    with config.SCAN_PARTIAL.scoped("true"):
+        with pytest.raises(KeyError):
+            fs_store.read("t", columns=["name", "not_a_column"])
+    assert not fs_store.quarantined()
+    assert fs_store.read("t").num_rows == 2000  # file still healthy
+
+
+def test_every_file_corrupt_degrades_to_empty_not_error(fs_store):
+    for f in glob.glob(os.path.join(fs_store.root, "t", "data", "**",
+                                    "*.parquet"), recursive=True):
+        with open(f, "wb") as fh:
+            fh.write(b"\xde\xad")
+    pr = fs_store.read_partial("t")
+    assert pr.degraded and pr.ok_parts == 0
+    assert pr.value.num_rows == 0  # typed empty survivor set, not a crash
+
+
+def test_metadata_save_is_atomic(fs_store, monkeypatch):
+    import geomesa_tpu.fs.storage as stmod
+
+    count0 = fs_store.count("t")
+
+    def torn(obj, fh, **kw):  # crash mid-serialization
+        fh.write('{"spec": "tor')
+        raise RuntimeError("crash mid-write")
+
+    monkeypatch.setattr(stmod.json, "dump", torn)
+    with pytest.raises(RuntimeError, match="crash mid-write"):
+        fs_store.write("t", _data(50))
+    monkeypatch.undo()
+    # the torn temp never replaced the real metadata, and no debris remains
+    assert fs_store.count("t") == count0
+    assert fs_store.read("t").num_rows == 2000
+    assert not glob.glob(os.path.join(fs_store.root, "t", "*.tmp"))
+
+
+def test_metadata_save_fault_point(fs_store):
+    with config.FAULT_INJECTION.scoped("true"):
+        with inject_faults(seed=0) as inj:
+            inj.fail("fs.write_meta", times=1)
+            with pytest.raises(InjectedFault):
+                fs_store.write("t", _data(50))
+    assert fs_store.count("t") == 2000  # old metadata intact
+    assert fs_store.read("t").num_rows == 2000
+
+
+# ---------------------------------------------------------------------------
+# chaos scenario 3: poison stream message -> quarantine, consumer survives
+# ---------------------------------------------------------------------------
+
+
+def test_poison_stream_message_quarantined():
+    from geomesa_tpu.stream.live import StreamingDataset
+
+    ds = StreamingDataset()
+    ds.create_schema("t", "name:String,*geom:Point")
+    ds.write("t", {"name": ["a", "b"], "geom": [(0.0, 0.0), (1.0, 1.0)]},
+             fids=["f0", "f1"])
+    # a poison blob lands on the topic between two valid batches
+    topic = ds._topics["t"]
+    topic._logs[0].append(b"\x01\x02 not a geomessage")
+    ds.write("t", {"name": ["c"], "geom": [(2.0, 2.0)]}, fids=["f2"])
+
+    audit.degradations.clear()
+    n = ds.poll("t")
+    assert n == 3                      # every VALID message applied
+    assert ds.quarantined["t"] == 1    # the poison one counted + skipped
+    assert len(ds.cache("t")) == 3     # consumer alive, state correct
+    assert ds.count("t") == 3
+    assert any(e.source == "stream.poll.decode"
+               for e in audit.degradations.recent())
+    # the offset advanced PAST the poison message: no repeat quarantine
+    assert ds.poll("t") == 0
+    assert ds.quarantined["t"] == 1
+
+
+def test_unappliable_message_quarantined_not_fatal():
+    from geomesa_tpu.stream.live import StreamingDataset
+    from geomesa_tpu.stream.messages import GeoMessage
+
+    ds = StreamingDataset()
+    ds.create_schema("t", "name:String,*geom:Point")
+    # decodes fine but the geometry payload is garbage for the cache
+    ds._topics["t"].send(GeoMessage.change("bad", {"geom": "not-a-point"}, 1))
+    ds.write("t", {"name": ["a"], "geom": [(0.0, 0.0)]}, fids=["f0"])
+    assert ds.poll("t") == 1
+    assert ds.quarantined["t"] == 1
+    assert ds.count("t") == 1
+
+
+def test_poison_via_fault_injection_seeded():
+    from geomesa_tpu.stream.live import StreamingDataset
+
+    ds = StreamingDataset()
+    ds.create_schema("t", "name:String,*geom:Point")
+    ds.write("t", {"name": list("abcd"),
+                   "geom": [(float(i), 0.0) for i in range(4)]},
+             fids=[f"f{i}" for i in range(4)])
+    with config.FAULT_INJECTION.scoped("true"):
+        with inject_faults(seed=11) as inj:
+            inj.fail("stream.poll.decode", times=1)
+            assert ds.poll("t") == 3  # one injected poison, three applied
+    assert ds.quarantined["t"] == 1
+    assert ds.count("t") == 3
+
+
+def test_throwing_listener_does_not_kill_consumer():
+    from geomesa_tpu.stream.live import StreamingDataset
+
+    ds = StreamingDataset()
+    ds.create_schema("t", "name:String,*geom:Point")
+    seen = []
+    ds.add_listener("t", lambda m: seen.append(m.fid))
+    ds.add_listener("t", lambda m: 1 / 0)
+    ds.write("t", {"name": ["a", "b"], "geom": [(0.0, 0.0), (1.0, 1.0)]},
+             fids=["f0", "f1"])
+    assert ds.poll("t") == 2
+    assert len(ds.cache("t")) == 2
+    assert sorted(seen) == ["f0", "f1"]
+
+
+# ---------------------------------------------------------------------------
+# chaos scenario 4: partition scan faults + deadlines on partitioned scans
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def pds(tmp_path_factory):
+    from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+
+    ds = GeoDataset(n_shards=4, prefer_device=False)
+    ds.create_schema("t", PSPEC)
+    st = ds._store("t")
+    assert isinstance(st, PartitionedFeatureStore)
+    st.max_resident = 2
+    st._spill_dir = str(tmp_path_factory.mktemp("spill"))
+    n = 6000
+    ds.insert("t", _data(n), fids=np.arange(n).astype(str))
+    ds.flush("t")
+    return ds, n
+
+
+def test_partition_scan_fault_strict_raises(pds):
+    ds, _ = pds
+    with config.FAULT_INJECTION.scoped("true"):
+        with inject_faults(seed=2) as inj:
+            inj.fail("exec.partition.scan", times=1)
+            with pytest.raises(InjectedFault):
+                ds.count("t")
+    assert ds.count("t") == pds[1]  # store healthy afterwards
+
+
+def test_partition_scan_fault_degrades_to_exact_survivor_totals(pds):
+    ds, n = pds
+    st = ds._store("t")
+    per_bin = {b: st.child(b).count for b in st.partition_bins()}
+    assert sum(per_bin.values()) == n
+
+    with config.FAULT_INJECTION.scoped("true"):
+        with inject_faults(seed=2) as inj:
+            inj.fail("exec.partition.scan", times=1)
+            with allow_partial() as partial:
+                degraded = ds.count("t")
+    assert partial.degraded and len(partial.skipped) == 1
+    failed_bin = int(partial.skipped[0].part.split(":")[1])
+    # the degraded aggregate equals the EXACT sum over surviving partitions
+    assert degraded == n - per_bin[failed_bin]
+    # the query audit event carries the skipped-partition account
+    ev = ds.audit.recent(1)[0]
+    assert ev.hints.get("degraded") and \
+        ev.hints["degraded"][0]["part"] == f"bin:{failed_bin}"
+
+
+def test_partition_density_degrades_additively(pds):
+    ds, n = pds
+    st = ds._store("t")
+    per_bin = {b: st.child(b).count for b in st.partition_bins()}
+    world = (-180.0, -90.0, 180.0, 90.0)
+    full = ds.density("t", bbox=world, width=64, height=64)
+    assert full.sum() == pytest.approx(n)
+
+    with config.FAULT_INJECTION.scoped("true"):
+        with inject_faults(seed=4) as inj:
+            inj.fail("exec.partition.scan", times=1)
+            with allow_partial() as partial:
+                grid = ds.density("t", bbox=world, width=64, height=64)
+    failed_bin = int(partial.skipped[0].part.split(":")[1])
+    # degraded density = full density minus exactly the failed partition
+    assert grid.sum() == pytest.approx(n - per_bin[failed_bin])
+
+
+def test_partition_query_features_degrade(pds):
+    ds, n = pds
+    with config.FAULT_INJECTION.scoped("true"):
+        with inject_faults(seed=6) as inj:
+            inj.fail("exec.partition.scan", times=1)
+            with allow_partial() as partial:
+                fc = ds.query("t")
+    assert partial.degraded
+    assert 0 < len(fc) < n
+
+
+def test_query_deadline_partitioned_scan_paths(pds, monkeypatch):
+    ds, _ = pds
+    monkeypatch.setenv("GEOMESA_QUERY_TIMEOUT", "0ms")
+    with pytest.raises(QueryTimeoutError):
+        ds.count("t")
+    with pytest.raises(QueryTimeoutError):
+        ds.query("t", "BBOX(geom, -100, 30, -80, 45)")
+    with pytest.raises(QueryTimeoutError):
+        ds.density("t", bbox=(-180, -90, 180, 90), width=32, height=32)
+    with pytest.raises(QueryTimeoutError):
+        ds.stats("t", "MinMax(weight)")
+    # a deadline is NEVER degradable: partial mode must still raise (a
+    # timed-out scan masquerading as degraded-but-complete would be a
+    # silently wrong answer)
+    with allow_partial():
+        with pytest.raises(QueryTimeoutError):
+            ds.count("t")
+    monkeypatch.delenv("GEOMESA_QUERY_TIMEOUT")
+    assert ds.count("t") == pds[1]
+
+
+def test_query_deadline_multishard_single_store(monkeypatch):
+    """Satellite coverage: the deadline fires on the plain multi-shard
+    (non-partitioned) host path too, between per-shard passes."""
+    ds = GeoDataset(n_shards=8, prefer_device=False)
+    ds.create_schema("t", SPEC)
+    ds.insert("t", _data(4000), fids=np.arange(4000).astype(str))
+    ds.flush("t")
+    monkeypatch.setenv("GEOMESA_QUERY_TIMEOUT", "0ms")
+    with pytest.raises(QueryTimeoutError):
+        ds.count("t")
+    with pytest.raises(QueryTimeoutError):
+        ds.query("t", "name = 'actor1'")
+    monkeypatch.delenv("GEOMESA_QUERY_TIMEOUT")
+    assert ds.count("t") == 4000
+
+
+# ---------------------------------------------------------------------------
+# disabled-path guarantees
+# ---------------------------------------------------------------------------
+
+
+def test_resilience_defaults_off():
+    assert config.FAULT_INJECTION.to_bool() is False
+    assert config.SCAN_PARTIAL.to_bool() is False
+    assert resilience._injector is None
+    assert not resilience.partial_allowed()
+
+
+def test_degraded_unwrap_is_strict():
+    pr = resilience.PartialResult(value=41, skipped=[], total_parts=1, ok_parts=1)
+    assert not pr.degraded and pr.unwrap() == 41
+    pr = resilience.PartialResult(
+        value=41,
+        skipped=[resilience.Skipped("s", "p", "boom")],
+        total_parts=2, ok_parts=1,
+    )
+    assert pr.degraded
+    with pytest.raises(RuntimeError, match="degraded"):
+        pr.unwrap()
