@@ -279,6 +279,42 @@ def main():
         f"device {matched} vs cpu {float(m.sum())}"
     )
 
+    # Aggregate-cache effectiveness (docs/CACHE.md): cold vs warm latency
+    # with the cache enabled — an exact repeat (whole-result hit) and an
+    # overlapping pan (partial-cover reuse: only the newly exposed strip
+    # scans). GEOMESA_BENCH_CACHE=0 skips the section.
+    cache_keys = {}
+    if os.environ.get("GEOMESA_BENCH_CACHE", "1") != "0":
+        from geomesa_tpu import config as _cfg
+
+        during = "dtg DURING 2020-01-05T00:00:00Z/2020-01-15T00:00:00Z"
+
+        def pan_ecql(dx):
+            return (
+                f"BBOX(geom, {-100 + dx}, 30, {-80 + dx}, 45) AND {during}"
+            )
+
+        with _cfg.CACHE_ENABLED.scoped("true"):
+            dens_cold = _timed(lambda: ds.density(
+                "gdelt", ecql, bbox=bbox, width=W, height=H))
+            dens_warm = min(_timed(lambda: ds.density(
+                "gdelt", ecql, bbox=bbox, width=W, height=H))
+                for _ in range(3))
+            cnt_cold = _timed(lambda: ds.count("gdelt", pan_ecql(0.0)))
+            # pan east by 2 deg: ~90% overlap with the cold query's cells
+            cnt_pan = _timed(lambda: ds.count("gdelt", pan_ecql(2.0)))
+        cache_keys = {
+            "cache_density_cold_ms": round(dens_cold * 1e3, 2),
+            "cache_density_warm_ms": round(dens_warm * 1e3, 2),
+            "cache_count_cold_ms": round(cnt_cold * 1e3, 2),
+            "cache_count_pan_ms": round(cnt_pan * 1e3, 2),
+        }
+        sys.stderr.write(
+            f"cache: density cold={dens_cold*1e3:.1f}ms "
+            f"warm={dens_warm*1e3:.1f}ms | count cold={cnt_cold*1e3:.1f}ms "
+            f"pan={cnt_pan*1e3:.1f}ms\n"
+        )
+
     feats_per_sec = n / dev_s
     speedup = cpu_s / dev_s
     scanned = int(plan.__dict__.get("scanned_rows", 0))
@@ -302,6 +338,7 @@ def main():
         "rows_scanned": scanned,
         "rows_matched": int(matched),
         "ingest_s": round(ingest_s, 1),
+        **cache_keys,
     }))
 
 

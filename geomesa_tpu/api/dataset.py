@@ -24,6 +24,7 @@ import numpy as np
 
 from geomesa_tpu import config, metrics, security
 from geomesa_tpu.audit import AuditWriter
+from geomesa_tpu.cache import AggregateCache
 from geomesa_tpu.filter import ir, parse_ecql
 from geomesa_tpu.filter.compile import CompiledFilter
 from geomesa_tpu.index.store import FeatureStore
@@ -131,6 +132,10 @@ class GeoDataset:
         #: unrestricted; per-query ``Query.auths`` overrides)
         self.auths = list(auths) if auths is not None else None
         self.audit = AuditWriter()
+        #: aggregate result cache (docs/CACHE.md) — shared by every query of
+        #: this dataset, including all Flight queries when a sidecar serves
+        #: it. Inert unless geomesa.cache.enabled=true.
+        self.cache = AggregateCache()
         self._stores: Dict[str, FeatureStore] = {}
         self._executors: Dict[str, Executor] = {}
         self.metadata: Dict[str, Dict[str, str]] = {}
@@ -161,7 +166,10 @@ class GeoDataset:
         return sorted(self._stores)
 
     def delete_schema(self, name: str):
-        self._store(name)  # raise if missing
+        st = self._store(name)  # raise if missing
+        # drop the schema's cached aggregates: its uid is never accessed
+        # again, so neither epoch sync nor the per-uid LRU could reclaim them
+        self.cache.store.invalidate(st.uid)
         del self._stores[name]
         del self.metadata[name]
 
@@ -459,6 +467,22 @@ class GeoDataset:
         candidate (scanned) rows vs matched rows — the over-scan signal."""
         exp = Explainer(enabled=True)
         st, _, plan = self._plan(name, query, exp)
+        # cache participation (docs/CACHE.md): would this query be served
+        # from / populate the aggregate cache, and in what shape?
+        from geomesa_tpu.cache import decompose
+
+        exp.push("Aggregate cache")
+        exp.kv("enabled", bool(config.CACHE_ENABLED.to_bool()))
+        d = decompose(plan.filter, st.ft)
+        if d is not None:
+            exp.kv("partial-cover", f"level {d.level}, "
+                   f"{len(d.cells)} interior cells, "
+                   f"{len(d.strips)} boundary strips")
+            exp.kv("residual filter", d.residual_key)
+        else:
+            exp.line("partial-cover: not decomposable "
+                     "(whole-result caching only)")
+        exp.pop()
         if analyze:
             ex = self._executor(st)
             matched = ex.count(plan)
@@ -691,7 +715,7 @@ class GeoDataset:
             return int(plan.est_count)
         t0 = time.perf_counter()
         with query_deadline(self._timeout_s()):
-            n = self._executor(st).count(plan)
+            n = self.cache.count(self, st, q, plan)
         self._audit(name, q, plan, t0, n, op="count")
         return n
 
@@ -717,7 +741,9 @@ class GeoDataset:
         t0 = time.perf_counter()
         with metrics.registry().timer("query.density").time(), \
                 query_deadline(self._timeout_s()):
-            grid = self._executor(st).density(plan, bbox, width, height, weight)
+            grid = self.cache.density(
+                self, st, q, plan, bbox, width, height, weight
+            )
         self._audit(name, q, plan, t0, int(np.count_nonzero(grid)), op="density")
         return grid
 
@@ -757,8 +783,8 @@ class GeoDataset:
         t0 = time.perf_counter()
         with metrics.registry().timer("query.density").time(), \
                 query_deadline(self._timeout_s()):
-            grid = self._executor(st).density_curve(
-                plan, level, (ix0, iy0, ix1, iy1), weight
+            grid = self.cache.density_curve(
+                self, st, q, plan, level, (ix0, iy0, ix1, iy1), weight
             )
         self._audit(name, q, plan, t0, int(np.count_nonzero(grid)),
                     op="density_curve")
@@ -774,11 +800,11 @@ class GeoDataset:
               query: "str | Query" = "INCLUDE") -> sk.Stat:
         """Exact stats over matching features (StatsProcess/StatsScan analog)."""
         st, q, plan = self._plan(name, query)
-        stat = parse_stat(stat_spec)
+        parse_stat(stat_spec)  # validate the spec before any timing/scan
         t0 = time.perf_counter()
         with metrics.registry().timer("query.stats").time(), \
                 query_deadline(self._timeout_s()):
-            out = self._executor(st).stats(plan, stat)
+            out = self.cache.stats(self, st, q, plan, stat_spec)
         self._audit(name, q, plan, t0, 0, op="stats")
         return out
 
@@ -1201,8 +1227,14 @@ class GeoDataset:
                         parts.append(ColumnBatch(
                             cols, len(next(iter(cols.values())))))
             if parts:
+                from geomesa_tpu.schema.columns import schema_null_fills
+
+                # schema-derived fills: mixed-vintage chunks (e.g. saved
+                # before a column existed) null-fill per the layout's
+                # convention, not a dtype guess
                 st._all = (parts[0] if len(parts) == 1
-                           else ColumnBatch.concat(parts))
+                           else ColumnBatch.concat(
+                               parts, fills=schema_null_fills(ft)))
                 if "epoch" in meta:
                     st.mutation_epoch = meta["epoch"]
                 key_cols = dict(st._all.columns)

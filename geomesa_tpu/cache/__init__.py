@@ -1,0 +1,17 @@
+"""Spatial aggregate cache (docs/CACHE.md).
+
+SFC-cell result caching with epoch invalidation and partial-cover reuse:
+repeated and overlapping pushdown aggregates (density grids, stats sketches,
+counts) are served from memoized per-cell partials, so repeat latency is
+independent of dataset size. Off by default; enable with
+``geomesa.cache.enabled=true`` (GEOMESA_CACHE_ENABLED=true).
+"""
+
+from geomesa_tpu.cache.cells import Decomposition, decompose, split_bbox_conjunct
+from geomesa_tpu.cache.service import EXACT_MERGE_KINDS, AggregateCache
+from geomesa_tpu.cache.store import CacheStore
+
+__all__ = [
+    "AggregateCache", "CacheStore", "Decomposition", "decompose",
+    "split_bbox_conjunct", "EXACT_MERGE_KINDS",
+]

@@ -1,0 +1,216 @@
+"""Partial-cover decomposition: query bbox -> SFC cells + boundary strips.
+
+The cacheable unit is a **grid cell** of the global 2^level x 2^level lon/lat
+partition — the same cell family the z2 curve's prefix blocks quantize to
+(``curves/zorder.interleave2(ix, iy)`` is each cell's curve prefix, used as
+its identity), so cell keys are absolute: a panned query re-derives the same
+cell ids for the overlap and pays only for the newly exposed strip
+(GeoBlocks' query/cache decomposition over aggregate cells; PAPERS.md).
+
+Exactness contract (what makes cached + fresh partials merge bit-identically
+with a cold scan):
+
+* cells are **half-open** ``[x0, x1) x [y0, y1)`` — realized as closed BBox
+  predicates with the open edges pulled one f64 ulp inward — so the cells of
+  a level partition the plane and no row is double-counted or dropped;
+* the cell edges ``i * (360 / 2^level) - 180`` are exact in f64 (the cell
+  span is 45 * 2^(3-level), a dyadic multiple), so every query derives
+  byte-identical cell boxes;
+* interior cells satisfy ``[x0, x1) x [y0, y1) ⊆ Q`` *by direct f64
+  comparison against the query box*, so a cell query (residual ∧ cell box)
+  returns exactly the query's rows inside that cell;
+* the boundary Q \\ interior is covered by at most four disjoint strips
+  (left/right full-height, bottom/top between them).
+
+Decomposition applies when the schema's geometry is a POINT and the filter
+constrains it with exactly one BBox conjunct at the top level (the pan/zoom
+shape); anything richer — extent (line/polygon) geometry columns, whose
+features intersect multiple cells and would be counted once per cell,
+polygon query literals, spatial predicates under OR/NOT, multiple boxes —
+falls back to whole-result caching, which is always safe.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu import config
+from geomesa_tpu.filter import ir
+
+Box = Tuple[float, float, float, float]
+
+
+def _has_spatial(node: ir.Filter, geom: str) -> bool:
+    """Does this subtree constrain (or even mention) the geometry?"""
+    if isinstance(node, (ir.BBox, ir.Spatial, ir.DWithin)):
+        return node.prop == geom
+    if isinstance(node, (ir.And, ir.Or)):
+        return any(_has_spatial(c, geom) for c in node.children)
+    if isinstance(node, ir.Not):
+        return _has_spatial(node.child, geom)
+    if isinstance(node, ir.ExprCompare):
+        return geom in node.props()
+    prop = getattr(node, "prop", None)
+    return prop == geom
+
+
+def _prev(v: float) -> float:
+    return float(np.nextafter(v, -np.inf))
+
+
+@dataclass
+class Decomposition:
+    """One query's partial-cover plan."""
+
+    level: int
+    #: the filter minus the spatial conjunct (what cell queries AND with)
+    residual: ir.Filter
+    #: canonical text of the residual — part of every cell key
+    residual_key: str
+    #: interior cell ids, absolute (ix, iy) at ``level``
+    cells: List[Tuple[int, int]]
+    #: (ix, iy) -> closed BBox realizing the half-open cell
+    cell_boxes: Dict[Tuple[int, int], Box]
+    #: boundary strips (closed boxes, disjoint, covering Q minus interior)
+    strips: List[Box]
+
+    def cell_filter(self, cell: Tuple[int, int], geom: str) -> ir.Filter:
+        b = self.cell_boxes[cell]
+        return _and(self.residual, ir.BBox(geom, *b))
+
+    def strip_filter(self, geom: str) -> Optional[ir.Filter]:
+        if not self.strips:
+            return None
+        boxes = tuple(ir.BBox(geom, *s) for s in self.strips)
+        spatial = boxes[0] if len(boxes) == 1 else ir.Or(boxes)
+        return _and(self.residual, spatial)
+
+    def cell_prefix(self, cell: Tuple[int, int]) -> int:
+        """The cell's z2 curve prefix (its identity on the curve)."""
+        from geomesa_tpu.curves.zorder import interleave2
+
+        ix, iy = cell
+        return int(interleave2(
+            np.asarray([ix], np.uint64), np.asarray([iy], np.uint64)
+        )[0])
+
+
+def _and(residual: ir.Filter, spatial: ir.Filter) -> ir.Filter:
+    if isinstance(residual, ir.Include):
+        return spatial
+    return ir.And((residual, spatial))
+
+
+def split_bbox_conjunct(
+    f: ir.Filter, geom: Optional[str]
+) -> Optional[Tuple[ir.BBox, ir.Filter]]:
+    """(bbox, residual) when the filter is `BBOX ∧ rest` with exactly one
+    spatial constraint, all at top level; None otherwise."""
+    if geom is None:
+        return None
+    conjuncts = list(f.children) if isinstance(f, ir.And) else [f]
+    boxes = [c for c in conjuncts if isinstance(c, ir.BBox) and c.prop == geom]
+    if len(boxes) != 1:
+        return None
+    rest = [c for c in conjuncts if c is not boxes[0]]
+    if any(_has_spatial(c, geom) for c in rest):
+        return None  # a second spatial constraint: not the pan/zoom shape
+    if not rest:
+        residual: ir.Filter = ir.Include()
+    elif len(rest) == 1:
+        residual = rest[0]
+    else:
+        residual = ir.And(tuple(rest))
+    return boxes[0], residual
+
+
+def _pick_level(dx: float, dy: float) -> Optional[int]:
+    per_axis = config.CACHE_CELLS_PER_AXIS.to_int() or 8
+    max_level = config.CACHE_MAX_LEVEL.to_int() or 12
+    if dx <= 0 or dy <= 0:
+        return None
+    # finest level where the bbox spans at most per_axis cells on each axis
+    lx = int(np.floor(np.log2(per_axis * 360.0 / dx)))
+    ly = int(np.floor(np.log2(per_axis * 180.0 / dy)))
+    level = min(lx, ly, max_level)
+    return level if level >= 1 else None
+
+
+def decompose(f: ir.Filter, ft) -> Optional[Decomposition]:
+    """Partial-cover plan for a filter against schema ``ft``, or None when
+    not decomposable. Only POINT geometries decompose: an extent feature
+    (line/polygon) intersects every cell it straddles — the cells would
+    each count it, breaking the disjoint-partition argument."""
+    geom = None if ft is None else ft.geom_field
+    if geom is None or not ft.attr(geom).is_point:
+        return None
+    split = split_bbox_conjunct(f, geom)
+    if split is None:
+        return None
+    box, residual = split
+    xmin, ymin, xmax, ymax = box.xmin, box.ymin, box.xmax, box.ymax
+    if not (
+        np.isfinite([xmin, ymin, xmax, ymax]).all()
+        and -180.0 <= xmin <= xmax <= 180.0
+        and -90.0 <= ymin <= ymax <= 90.0
+    ):
+        return None
+    level = _pick_level(xmax - xmin, ymax - ymin)
+    if level is None:
+        return None
+    n = 1 << level
+    sx = 360.0 / n  # 45 * 2^(3-level): exact in f64
+    sy = 180.0 / n
+
+    def xedge(i: int) -> float:
+        return i * sx - 180.0
+
+    def yedge(i: int) -> float:
+        return i * sy - 90.0
+
+    # interior cells: [edge(i), edge(i+1)) ⊆ [min, max] by f64 comparison
+    ix_lo = max(0, int(np.floor((xmin + 180.0) / sx)))
+    ix_hi = min(n - 1, int(np.ceil((xmax + 180.0) / sx)))
+    iy_lo = max(0, int(np.floor((ymin + 90.0) / sy)))
+    iy_hi = min(n - 1, int(np.ceil((ymax + 90.0) / sy)))
+    xs = [i for i in range(ix_lo, ix_hi + 1)
+          if xedge(i) >= xmin and xedge(i + 1) <= xmax]
+    ys = [i for i in range(iy_lo, iy_hi + 1)
+          if yedge(i) >= ymin and yedge(i + 1) <= ymax]
+    if not xs or not ys:
+        return None
+    max_cells = config.CACHE_MAX_CELLS.to_int() or 256
+    if len(xs) * len(ys) > max_cells:
+        return None
+    # the interior index ranges are contiguous by construction
+    X0, X1 = xedge(xs[0]), xedge(xs[-1] + 1)
+    Y0, Y1 = yedge(ys[0]), yedge(ys[-1] + 1)
+
+    cells: List[Tuple[int, int]] = []
+    cell_boxes: Dict[Tuple[int, int], Box] = {}
+    for iy in ys:
+        for ix in xs:
+            cells.append((ix, iy))
+            cell_boxes[(ix, iy)] = (
+                xedge(ix), yedge(iy), _prev(xedge(ix + 1)), _prev(yedge(iy + 1))
+            )
+
+    # Q \ interior as disjoint closed strips. The right strip is always
+    # present: rows at exactly x == X1 (the interior's open edge) live there
+    # even when X1 == xmax.
+    strips: List[Box] = []
+    if xmin < X0:
+        strips.append((xmin, ymin, _prev(X0), ymax))          # left
+    strips.append((X1, ymin, xmax, ymax))                     # right
+    if ymin < Y0:
+        strips.append((X0, ymin, _prev(X1), _prev(Y0)))       # bottom
+    strips.append((X0, Y1, _prev(X1), ymax))                  # top
+    strips = [s for s in strips if s[0] <= s[2] and s[1] <= s[3]]
+
+    return Decomposition(
+        level=level, residual=residual, residual_key=repr(residual),
+        cells=cells, cell_boxes=cell_boxes, strips=strips,
+    )

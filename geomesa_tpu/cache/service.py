@@ -1,0 +1,305 @@
+"""Aggregate-cache orchestration: memoize pushdown aggregates per SFC cell.
+
+One :class:`AggregateCache` hangs off a GeoDataset (so the sidecar's Flight
+queries share it — one process, one cache) and fronts the four aggregate
+entry points (count / density / density_curve / stats):
+
+1. **whole-result fast path** — an exact repeat of a query (same canonical
+   filter, same op parameters, same auths, same dataset epoch) returns the
+   stored aggregate without touching the executor;
+2. **partial-cover reuse** — a decomposable query (cells.py) looks up each
+   interior SFC cell, executes ONLY the missing cells and the boundary
+   strips through the ordinary planner/executor, merges cached + fresh
+   partials (grids add, counts add, sketches merge), and stores the fresh
+   cells for the next overlapping query.
+
+Invalidation is epoch-based (store.py): the FeatureStore ``version`` is the
+epoch, so every mutation path (flush / delete / schema or index change)
+drops the dataset's covers wholesale.
+
+Bit-identical contract (docs/CACHE.md): decomposition is only attempted for
+aggregates whose partial merge is exact —
+
+* counts: integer addition over disjoint cells;
+* unweighted density: f32 grids hold integer counts (exact to 2^24), so
+  per-cell grid addition reproduces the cold scatter bit-for-bit; weighted
+  grids (f32 rounding is order-dependent) use the whole-result path only;
+* stats: only sketch kinds whose ``merge`` is exact integer/extremum algebra
+  (count, minmax, enumeration, topk, histogram, frequency);
+* density_curve: whole-result only (block membership is decided by the SFC
+  quantization of row coordinates, which a coordinate-space cell predicate
+  cannot reproduce exactly at block edges).
+
+Degraded aggregates (resilience partial-results: ``plan.degraded``) are
+**never** cached — a skipped partition must not become a permanent lie.
+Sampling hints bypass the cache entirely (the 1-in-n counter is scan-order
+dependent and not decomposable).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu import config, metrics
+from geomesa_tpu.cache import cells as cellmod
+from geomesa_tpu.cache.store import CacheStore
+from geomesa_tpu.stats import sketches as sk
+
+#: sketch kinds whose merge is exact (integer / extremum algebra) — the only
+#: ones partial-cover decomposition may split
+EXACT_MERGE_KINDS = {
+    "count", "minmax", "enumeration", "topk", "histogram", "frequency",
+}
+
+
+class _Op:
+    """Per-aggregate behavior bundle for the generic serve loop."""
+
+    def __init__(self, fingerprint: Tuple, run: Callable, zero: Callable,
+                 merge: Callable, pack: Callable, unpack: Callable,
+                 decomposable: bool, cell_nbytes: int = 0):
+        self.fingerprint = fingerprint
+        self.run = run          # plan -> raw value (through the executor)
+        self.zero = zero        # () -> empty value
+        self.merge = merge      # (acc, piece) -> acc
+        self.pack = pack        # value -> storable (immutable-ish)
+        self.unpack = unpack    # storable -> fresh value safe to hand out
+        self.decomposable = decomposable
+        #: estimated stored size of ONE cell entry (0 = negligible) — gates
+        #: decomposition against the LRU budget
+        self.cell_nbytes = cell_nbytes
+
+
+class AggregateCache:
+    """Query-result cache for one GeoDataset (shared across its queries)."""
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        self.store = CacheStore(budget_bytes)
+
+    # -- gates -------------------------------------------------------------
+    @staticmethod
+    def enabled() -> bool:
+        return bool(config.CACHE_ENABLED.to_bool())
+
+    @staticmethod
+    def _bypass(q) -> bool:
+        # sampling's 1-in-n counter depends on scan order: not cacheable
+        return q.sampling is not None or q.sample_by is not None
+
+    # -- plumbing ----------------------------------------------------------
+    @staticmethod
+    def _note(plan, **kw) -> None:
+        plan.__dict__.setdefault("exec_path", {}).update(kw)
+
+    @staticmethod
+    def _auth_key(ds, q) -> Optional[Tuple[str, ...]]:
+        auths = ds._effective_auths(q)
+        return None if auths is None else tuple(auths)
+
+    @staticmethod
+    def _sub_plan(ds, st, q, f):
+        """Plan + visibility-wrap a residual/cell filter through the
+        ordinary pipeline (interceptor guards included)."""
+        from geomesa_tpu.planning.planner import QueryHints, QueryPlanner
+
+        plan2 = QueryPlanner(st).plan(f, QueryHints(query_index=q.index))
+        ds._apply_visibility(st, plan2, ds._effective_auths(q))
+        return plan2
+
+    def _run_sub(self, ds, st, q, f, op, plan, scan_acc: List[int]):
+        """Execute one cell/strip query; returns (value, cacheable)."""
+        plan2 = self._sub_plan(ds, st, q, f)
+        value = op.run(plan2)
+        scan_acc[0] += plan2.__dict__.get("scanned_rows", 0)
+        scan_acc[1] = max(scan_acc[1], plan2.__dict__.get("table_rows", 0))
+        degraded = plan2.__dict__.pop("degraded", None)
+        if degraded:
+            # carry the skipped-partition account into the outer query's
+            # audit event; the piece itself must not be cached
+            plan.__dict__.setdefault("degraded", []).extend(degraded)
+            return value, False
+        return value, True
+
+    # -- the generic serve loop --------------------------------------------
+    def _serve(self, ds, st, q, plan, op: "_Op"):
+        if not self.enabled() or self._bypass(q):
+            return op.run(plan)
+        uid, epoch = st.uid, st.version
+        akey = self._auth_key(ds, q)
+        wkey = ("whole",) + op.fingerprint + (repr(plan.filter), akey)
+        hit = self.store.get(uid, epoch, wkey)
+        if hit is not None:
+            metrics.inc(metrics.CACHE_HIT)
+            self._note(plan, cache="hit")
+            plan.__dict__["scanned_rows"] = 0
+            plan.__dict__.setdefault("table_rows", 0)
+            return op.unpack(hit)
+
+        geom = st.ft.geom_field
+        decomp = (
+            cellmod.decompose(plan.filter, st.ft)
+            if op.decomposable and not plan.is_empty else None
+        )
+        if (
+            decomp is not None
+            and op.cell_nbytes
+            and op.cell_nbytes * (len(decomp.cells) + 1)
+                > self.store.budget() // 2
+        ):
+            # the cell partials alone would blow half the LRU budget (e.g.
+            # a large density raster stored once PER cell), evicting
+            # everything including this query's own earlier cells — the
+            # whole-result entry is the only one worth keeping
+            decomp = None
+        if decomp is None:
+            value = op.run(plan)
+            if not plan.__dict__.get("degraded"):
+                self.store.put(uid, epoch, wkey, op.pack(value))
+            metrics.inc(metrics.CACHE_MISS)
+            self._note(plan, cache="miss")
+            return value
+
+        # partial-cover path: cached interior cells + executed residual
+        acc = op.zero()
+        hits = 0
+        scan_acc = [0, 0]  # [scanned_rows, table_rows] over executed pieces
+        all_cacheable = True
+        for cell in decomp.cells:
+            ckey = ("cell",) + op.fingerprint + (
+                decomp.residual_key, akey, decomp.level,
+                decomp.cell_prefix(cell),
+            )
+            got = self.store.get(uid, epoch, ckey)
+            if got is not None:
+                hits += 1
+                acc = op.merge(acc, op.unpack(got))
+                continue
+            value, cacheable = self._run_sub(
+                ds, st, q, decomp.cell_filter(cell, geom), op, plan, scan_acc
+            )
+            if cacheable:
+                self.store.put(uid, epoch, ckey, op.pack(value))
+            else:
+                all_cacheable = False
+            acc = op.merge(acc, value)
+        strip_f = decomp.strip_filter(geom)
+        if strip_f is not None:
+            value, cacheable = self._run_sub(
+                ds, st, q, strip_f, op, plan, scan_acc
+            )
+            if not cacheable:
+                all_cacheable = False
+            acc = op.merge(acc, value)
+        if all_cacheable:
+            self.store.put(uid, epoch, wkey, op.pack(acc))
+        plan.__dict__["scanned_rows"] = scan_acc[0]
+        plan.__dict__["table_rows"] = scan_acc[1]
+        if hits:
+            metrics.inc(metrics.CACHE_PARTIAL)
+        else:
+            metrics.inc(metrics.CACHE_MISS)
+        self._note(
+            plan,
+            cache=("partial" if hits else "miss"),
+            cache_cells=f"{hits}/{len(decomp.cells)}",
+            cache_level=decomp.level,
+        )
+        return acc
+
+    # -- ops ----------------------------------------------------------------
+    def count(self, ds, st, q, plan) -> int:
+        ex = ds._executor(st)
+        op = _Op(
+            fingerprint=("count",),
+            run=lambda p: int(ex.count(p)),
+            zero=lambda: 0,
+            merge=lambda a, b: a + int(b),
+            pack=int,
+            unpack=int,
+            decomposable=True,
+        )
+        return int(self._serve(ds, st, q, plan, op))
+
+    def density(self, ds, st, q, plan, bbox, width: int, height: int,
+                weight: Optional[str]) -> np.ndarray:
+        ex = ds._executor(st)
+        render = tuple(float(v) for v in bbox)
+
+        def run(p):
+            return np.asarray(ex.density(p, bbox, width, height, weight))
+
+        def raster_decoupled() -> bool:
+            # cell entries embed the render raster in their fingerprint, so
+            # they are only ever reusable while the raster stays FIXED. In
+            # the pan/zoom map shape the filter bbox IS the raster — a pan
+            # moves both, every cell key changes, and decomposing would
+            # burn cold latency and LRU budget for cells nothing can reuse
+            # (the whole-result entry already serves exact repeats).
+            # Decompose only when the raster is fixed relative to the
+            # filter (dashboard / WMS-overview shape).
+            split = cellmod.split_bbox_conjunct(plan.filter, st.ft.geom_field)
+            if split is None:
+                return True  # decompose() re-checks and rejects these
+            b = split[0]
+            return (b.xmin, b.ymin, b.xmax, b.ymax) != render
+
+        op = _Op(
+            fingerprint=("density", render, int(width), int(height), weight),
+            run=run,
+            zero=lambda: np.zeros((height, width), np.float32),
+            merge=lambda a, b: a + np.asarray(b, np.float32),
+            pack=lambda v: np.asarray(v, np.float32).copy(),
+            unpack=lambda v: v.copy(),
+            # unweighted grids are integer-valued f32: cell addition is
+            # exact; weighted grids would re-order f32 rounding
+            decomposable=weight is None and raster_decoupled(),
+            # every cell entry holds a FULL render raster
+            cell_nbytes=int(width) * int(height) * 4,
+        )
+        return self._serve(ds, st, q, plan, op)
+
+    def density_curve(self, ds, st, q, plan, level: int, block_window,
+                      weight: Optional[str]) -> np.ndarray:
+        ex = ds._executor(st)
+        op = _Op(
+            fingerprint=("density_curve", int(level),
+                         tuple(int(v) for v in block_window), weight),
+            run=lambda p: np.asarray(
+                ex.density_curve(p, level, block_window, weight)
+            ),
+            zero=lambda: None,
+            merge=lambda a, b: b if a is None else a + b,
+            pack=lambda v: v.copy(),
+            unpack=lambda v: v.copy(),
+            decomposable=False,  # block membership is SFC-quantized
+        )
+        return self._serve(ds, st, q, plan, op)
+
+    def stats(self, ds, st, q, plan, stat_spec: str) -> sk.Stat:
+        from geomesa_tpu.kernels.stats_scan import _leaf_stats
+        from geomesa_tpu.stats import parse_stat
+
+        ex = ds._executor(st)
+        probe = parse_stat(stat_spec)
+        exact_merge = all(
+            leaf.kind in EXACT_MERGE_KINDS for leaf in _leaf_stats(probe)
+        )
+
+        def merge(acc: sk.Stat, piece: sk.Stat) -> sk.Stat:
+            acc.merge(piece)
+            return acc
+
+        op = _Op(
+            fingerprint=("stats", stat_spec),
+            run=lambda p: ex.stats(p, parse_stat(stat_spec)),
+            zero=lambda: parse_stat(stat_spec),
+            merge=merge,
+            # serialized snapshots: the caller's (mutable) Stat object can
+            # never alias a cache entry
+            pack=lambda v: v.to_json(),
+            unpack=sk.Stat.from_json,
+            decomposable=exact_merge,
+        )
+        return self._serve(ds, st, q, plan, op)

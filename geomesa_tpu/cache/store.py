@@ -1,0 +1,163 @@
+"""Size-aware LRU store for cached aggregate results.
+
+One object holds every feature store's entries, partitioned by the store's
+process-unique ``uid`` so budgets and invalidation are per schema (the LRU
+budget applies to EACH uid — a dataset with N schemas can hold N budgets).
+Entries are keyed under a dataset **epoch** (the FeatureStore ``version``,
+bumped by every mutation path — flush, delete, schema/index changes): an
+access with a newer epoch drops *all* of that dataset's covers at once, the
+invalidation contract GeoBlocks-style caches need (PAPERS.md) — a cached
+cell must never survive a write it cannot see.
+
+Thread-safe; metrics ride the process registry (metrics.py: cache.*).
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from collections import OrderedDict
+from typing import Any, Dict, Optional, Tuple
+
+from geomesa_tpu import config, metrics
+
+#: every live CacheStore, so the process-wide cache.bytes/cache.entries
+#: gauges sum across datasets instead of tracking only the newest store
+_STORES: "weakref.WeakSet[CacheStore]" = weakref.WeakSet()
+
+
+def _gauge_total(attr: str) -> float:
+    return float(sum(getattr(s, attr) for s in list(_STORES)))
+
+
+def value_nbytes(value: Any) -> int:
+    """Approximate resident size of a cached value."""
+    import numpy as np
+
+    if isinstance(value, np.ndarray):
+        return int(value.nbytes)
+    if isinstance(value, (bytes, str)):
+        return len(value)
+    if isinstance(value, tuple):
+        return sum(value_nbytes(v) for v in value)
+    return 32  # ints / floats / small scalars
+
+
+class CacheStore:
+    """Per-dataset, epoch-keyed, size-aware LRU."""
+
+    def __init__(self, budget_bytes: Optional[int] = None):
+        #: uid -> OrderedDict[key, (value, nbytes)] in LRU order
+        self._data: Dict[int, "OrderedDict[Tuple, Tuple[Any, int]]"] = {}
+        self._bytes: Dict[int, int] = {}
+        self._epoch: Dict[int, int] = {}
+        self._budget = budget_bytes
+        self._lock = threading.Lock()
+        _STORES.add(self)
+        # (re-)registering is idempotent: the gauge fn sums over _STORES,
+        # never over one captured store
+        reg = metrics.registry()
+        reg.gauge(metrics.CACHE_BYTES, lambda: _gauge_total("total_bytes"))
+        reg.gauge(metrics.CACHE_ENTRIES,
+                  lambda: _gauge_total("total_entries"))
+
+    # -- budgets -----------------------------------------------------------
+    def budget(self) -> int:
+        if self._budget is not None:
+            return self._budget
+        b = config.CACHE_BUDGET_BYTES.to_int()
+        return b if b is not None else int(config.CACHE_BUDGET_BYTES.default)
+
+    @property
+    def total_bytes(self) -> int:
+        with self._lock:
+            return sum(self._bytes.values())
+
+    @property
+    def total_entries(self) -> int:
+        with self._lock:
+            return sum(len(d) for d in self._data.values())
+
+    # -- epoch invalidation ------------------------------------------------
+    def _sync_epoch(self, uid: int, epoch: int) -> None:
+        """Drop every cover of ``uid`` when its epoch advanced (caller holds
+        the lock). A regressed epoch (store object reuse after load) also
+        invalidates — staleness is *any* mismatch, not just monotone growth."""
+        cur = self._epoch.get(uid)
+        if cur is None:
+            self._epoch[uid] = epoch
+            return
+        if cur != epoch:
+            dropped = len(self._data.get(uid, ()))
+            self._data.pop(uid, None)
+            self._bytes.pop(uid, None)
+            self._epoch[uid] = epoch
+            if dropped:
+                metrics.inc(metrics.CACHE_INVALIDATE, dropped)
+
+    # -- access ------------------------------------------------------------
+    def get(self, uid: int, epoch: int, key: Tuple) -> Optional[Any]:
+        with self._lock:
+            self._sync_epoch(uid, epoch)
+            d = self._data.get(uid)
+            if d is None:
+                return None
+            hit = d.get(key)
+            if hit is None:
+                return None
+            d.move_to_end(key)
+            return hit[0]
+
+    def put(self, uid: int, epoch: int, key: Tuple, value: Any) -> bool:
+        nbytes = value_nbytes(value)
+        budget = self.budget()
+        if nbytes > budget:
+            return False  # a single over-budget entry would evict everything
+        with self._lock:
+            self._sync_epoch(uid, epoch)
+            d = self._data.setdefault(uid, OrderedDict())
+            old = d.pop(key, None)
+            if old is not None:
+                self._bytes[uid] = self._bytes.get(uid, 0) - old[1]
+            d[key] = (value, nbytes)
+            self._bytes[uid] = self._bytes.get(uid, 0) + nbytes
+            metrics.inc(metrics.CACHE_PUT)
+            while self._bytes.get(uid, 0) > budget and d:
+                _, (_, sz) = d.popitem(last=False)
+                self._bytes[uid] -= sz
+                metrics.inc(metrics.CACHE_EVICT)
+            return True
+
+    def invalidate(self, uid: Optional[int] = None) -> None:
+        """Explicit drop — all datasets, or one."""
+        with self._lock:
+            if uid is None:
+                dropped = sum(len(d) for d in self._data.values())
+                self._data.clear()
+                self._bytes.clear()
+                self._epoch.clear()
+            else:
+                dropped = len(self._data.get(uid, ()))
+                self._data.pop(uid, None)
+                self._bytes.pop(uid, None)
+                self._epoch.pop(uid, None)
+            if dropped:
+                metrics.inc(metrics.CACHE_INVALIDATE, dropped)
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Operator-facing stats (sidecar ``cache-stats`` action)."""
+        reg = metrics.registry().report()
+        with self._lock:
+            per_ds = {
+                str(uid): {"entries": len(d), "bytes": self._bytes.get(uid, 0),
+                           "epoch": self._epoch.get(uid)}
+                for uid, d in self._data.items()
+            }
+        return {
+            "enabled": bool(config.CACHE_ENABLED.to_bool()),
+            "budget_bytes": self.budget(),
+            "datasets": per_ds,
+            "counters": {
+                k: v for k, v in reg.items() if k.startswith("cache.")
+            },
+        }

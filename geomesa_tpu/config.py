@@ -250,6 +250,32 @@ SAMPLE_HASH_BUCKETS = SystemProperty("geomesa.sample.hash-buckets", "64")
 TOPK_MAX = SystemProperty("geomesa.topk.max", "100000")
 
 # ---------------------------------------------------------------------------
+# Spatial aggregate cache (cache/; docs/CACHE.md). Memoizes aggregate results
+# (density grids, stats sketches, counts) per SFC cell so repeated and
+# overlapping queries pay only for the newly exposed residual region.
+# ---------------------------------------------------------------------------
+
+#: Master switch for the aggregate result cache (default off).
+CACHE_ENABLED = SystemProperty("geomesa.cache.enabled", "false")
+
+#: Memory budget for cached aggregates (bytes), applied PER FEATURE STORE
+#: (one budget per schema — a dataset with N schemas can hold up to N x
+#: this); size-aware LRU eviction keeps each store under it.
+CACHE_BUDGET_BYTES = SystemProperty("geomesa.cache.budget.bytes", str(64 << 20))
+
+#: Partial-cover decomposition targets at most this many grid cells per
+#: axis over the query bbox (cell level adapts to the bbox span).
+CACHE_CELLS_PER_AXIS = SystemProperty("geomesa.cache.cells-per-axis", "8")
+
+#: Finest SFC cell level the decomposition may choose (cells are the
+#: 2^level x 2^level lon/lat grid aligned with the z2 curve blocks).
+CACHE_MAX_LEVEL = SystemProperty("geomesa.cache.max.level", "12")
+
+#: Hard cap on interior cells per decomposed query; beyond it the query
+#: falls back to whole-result caching only.
+CACHE_MAX_CELLS = SystemProperty("geomesa.cache.max.cells", "256")
+
+# ---------------------------------------------------------------------------
 # Resilience layer (resilience.py; docs/RESILIENCE.md). Retry defaults track
 # the reference's tablet-server client retry posture; the breaker fences a
 # dead sidecar so calls fail fast instead of paying the timeout each time.

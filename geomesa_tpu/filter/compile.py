@@ -666,6 +666,18 @@ def _expr_eval_exact(e: "ir.Expr", ft: FeatureType,
                 return left - right
             if e.op == "*":
                 return left * right
+            # scalar/scalar division follows PYTHON semantics, so a zero
+            # literal divisor raised an uncaught ZeroDivisionError at query
+            # time; coerce literal operands to np.float64 (x/0 -> inf/nan,
+            # matching the array path under errstate)
+            if not isinstance(left, np.ndarray) \
+                    and not isinstance(right, np.ndarray):
+                try:
+                    left, right = np.float64(left), np.float64(right)
+                except (TypeError, ValueError) as exc:
+                    raise ValueError(
+                        f"non-numeric operands in division: {e!r}"
+                    ) from exc
             return left / right
     if isinstance(e, ir.FnCall):
         fn = _expr_resolve_fn(e.name)
@@ -743,6 +755,41 @@ def _expr_eval_exact(e: "ir.Expr", ft: FeatureType,
         except (TypeError, ValueError):
             return vals  # geometry/string-valued results stay objects
     raise ValueError(f"cannot evaluate expression node {e!r}")
+
+
+def _expr_const_fold(node: "ir.ExprCompare", ft: FeatureType,
+                     dicts: Dict[str, DictionaryEncoder]) -> bool:
+    """Truth value of a property-free comparison (both sides are literal
+    subtrees — literals, arithmetic over literals, function calls on
+    literals). Evaluated once at compile time."""
+    left = _expr_eval_exact(node.left, ft, dicts, {}, 1)
+    right = _expr_eval_exact(node.right, ft, dicts, {}, 1)
+
+    def scalar(v):
+        if isinstance(v, np.ndarray):
+            return v.reshape(-1)[0] if v.size else None
+        return v
+
+    left, right = scalar(left), scalar(right)
+    op = node.op
+    try:
+        if op == "=":
+            return bool(left == right)
+        if op == "<>":
+            return bool(left != right)
+        if left is None or right is None:
+            return False
+        if op == "<":
+            return bool(left < right)
+        if op == "<=":
+            return bool(left <= right)
+        if op == ">":
+            return bool(left > right)
+        return bool(left >= right)
+    except TypeError as e:
+        raise ValueError(
+            f"incomparable constant operands in {node!r}"
+        ) from e
 
 
 def _expr_exact_fn(node: "ir.ExprCompare", ft: FeatureType,
@@ -839,8 +886,14 @@ def _expr_eval_coarse(e: "ir.Expr", cols, xp):
             return v, (xp.abs(lv) * re_ + xp.abs(rv) * le + le * re_
                        + xp.abs(v) * _EXPR_EPS)
         # division: denominator interval must exclude zero, else the
-        # bound is infinite (row stays a candidate)
-        v = lv / rv
+        # bound is infinite (row stays a candidate). Literal/literal
+        # operands are Python floats whose division RAISES on zero —
+        # coerce to np.float64 so x/0 follows IEEE (inf/nan) like the
+        # column path
+        if not hasattr(lv, "shape") and not hasattr(rv, "shape"):
+            lv, rv = np.float64(lv), np.float64(rv)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            v = lv / rv
         den = xp.maximum(xp.abs(rv) - re_, 0.0)
         err = xp.where(
             den > 0,
@@ -1473,6 +1526,15 @@ def compile_filter(
             return fid_mask
 
         if isinstance(node, ir.ExprCompare):
+            # property-free comparisons (both sides fold to constants, e.g.
+            # st_area(st_geomFromWKT('...')) > 0.5) reference no column at
+            # all — the generic path would fail with "no resolvable
+            # column"; fold them to a constant Include/Exclude instead
+            if not node.props():
+                const = _expr_const_fold(node, ft, dicts)
+                return compile_node(
+                    ir.Include() if const else ir.Exclude(), neg, exact
+                )
             # property-vs-property / arithmetic / st_* function comparisons
             # (FastFilterFactory.scala:395 parity). Exact semantics live on
             # the host refine pass; function-free numeric expressions also

@@ -400,16 +400,30 @@ class _Parser:
                     "jsonPath() comparisons support literal operands only"
                 )
             if isinstance(lhs, ir.Lit) and isinstance(rhs, ir.Lit):
-                # constant comparison folds at parse time ('1 + 1 = 2')
-                table = {
-                    "=": lhs.value == rhs.value,
-                    "<>": lhs.value != rhs.value,
-                    "<": lhs.value < rhs.value,
-                    "<=": lhs.value <= rhs.value,
-                    ">": lhs.value > rhs.value,
-                    ">=": lhs.value >= rhs.value,
-                }
-                return ir.Include() if table[op] else ir.Exclude()
+                # constant comparison folds at parse time ('1 + 1 = 2').
+                # Dispatch on the op — eagerly building a table of all six
+                # evaluated '1 < "a"' even for '1 = "a"', leaking TypeError
+                # past parser backtracking
+                a, b = lhs.value, rhs.value
+                try:
+                    if op == "=":
+                        res = a == b
+                    elif op == "<>":
+                        res = a != b
+                    elif op == "<":
+                        res = a < b
+                    elif op == "<=":
+                        res = a <= b
+                    elif op == ">":
+                        res = a > b
+                    else:
+                        res = a >= b
+                except TypeError as e:
+                    raise ValueError(
+                        f"incomparable literal types in {self.text!r}: "
+                        f"{a!r} {op} {b!r}"
+                    ) from e
+                return ir.Include() if res else ir.Exclude()
             return ir.ExprCompare(op, lhs, rhs)
         if prop is None:
             raise ValueError(

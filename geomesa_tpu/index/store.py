@@ -481,6 +481,11 @@ class FeatureStore:
 
             if VIS_COLUMN in fresh.columns and VIS_COLUMN not in self._all.columns:
                 self._all.columns[VIS_COLUMN] = np.zeros(self._all.n, np.int32)
+                # the back-fill REWRITES persisted rows (they gain a column):
+                # an incremental checkpoint appending only the fresh chunk
+                # would leave old chunks without __vis__, silently dropping
+                # visibility labels on reload — force a full rewrite
+                self._bump_epoch()
         if self._all is None:
             merged = fresh
             key_cols: Dict[str, np.ndarray] = {**fresh.columns, **fresh_keys}

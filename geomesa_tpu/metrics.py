@@ -147,3 +147,30 @@ _REGISTRY = MetricRegistry()
 
 def registry() -> MetricRegistry:
     return _REGISTRY
+
+
+def inc(name: str, n: int = 1) -> None:
+    """Shorthand: bump a counter in the process registry (used by the
+    aggregate cache and the stream quarantine path, which count from hot
+    loops and shouldn't re-spell the registry plumbing)."""
+    _REGISTRY.counter(name).inc(n)
+
+
+# Aggregate-cache metric names (cache/store.py, cache/service.py). Kept here
+# so operators grepping the exposition format find the contract in one place:
+#   cache.hit          whole-result hits (no scan at all)
+#   cache.partial      partial-cover hits (only the residual cells scanned)
+#   cache.miss         queries that found nothing reusable
+#   cache.put          entries admitted
+#   cache.evict        entries evicted by the size-aware LRU
+#   cache.invalidate   entries dropped by a dataset epoch bump
+#   cache.bytes        resident cached bytes (gauge)
+#   cache.entries      resident entry count (gauge)
+CACHE_HIT = "cache.hit"
+CACHE_PARTIAL = "cache.partial"
+CACHE_MISS = "cache.miss"
+CACHE_PUT = "cache.put"
+CACHE_EVICT = "cache.evict"
+CACHE_INVALIDATE = "cache.invalidate"
+CACHE_BYTES = "cache.bytes"
+CACHE_ENTRIES = "cache.entries"

@@ -30,5 +30,10 @@ class Explainer:
         self._depth = max(0, self._depth - 1)
         return self
 
+    def kv(self, key: str, value) -> "Explainer":
+        """One `key: value` line — the idiom sections like the cache
+        participation block are built from."""
+        return self.line(f"{key}: {value}")
+
     def __str__(self) -> str:
         return "\n".join(self._lines)

@@ -91,16 +91,66 @@ class ColumnBatch:
         )
 
     @staticmethod
-    def concat(batches: List["ColumnBatch"]) -> "ColumnBatch":
+    def concat(batches: List["ColumnBatch"],
+               fills: Optional[Dict[str, Any]] = None) -> "ColumnBatch":
+        """Concatenate batches, UNIONING their column sets: a column missing
+        from some batch null-fills that batch's rows. Intersecting to the
+        first batch's columns silently dropped late-added columns such as
+        ``__vis__`` on reload.
+
+        ``fills`` maps column name -> fill value; derive it from the schema
+        with :func:`schema_null_fills` when one is at hand (a dtype alone
+        cannot tell a dictionary-coded string, whose null is -1, from a
+        plain int, whose null convention is 0). Without a hint: float ->
+        NaN, object/str -> None, bool -> False, int32 -> -1 (coded-string
+        assumption — code 0 would alias the first REAL dictionary value),
+        int64 -> 0, and ``__vis__`` -> 0 = the empty visibility, so
+        pre-visibility chunks reload as visible-to-all."""
         if not batches:
             return ColumnBatch({}, 0)
         if len(batches) == 1:  # bulk loads: no copy
             return batches[0]
-        keys = batches[0].columns.keys()
-        return ColumnBatch(
-            {k: np.concatenate([b.columns[k] for b in batches]) for k in keys},
-            sum(b.n for b in batches),
-        )
+        keys = dict.fromkeys(k for b in batches for k in b.columns)
+
+        def _fill(name: str, n: int, dtype) -> np.ndarray:
+            if fills is not None and name in fills:
+                return np.full(n, fills[name], dtype)
+            if dtype.kind == "f":
+                return np.full(n, np.nan, dtype)
+            if dtype.kind in "OUS":
+                return np.full(n, None, object)
+            if dtype == np.int32 and name != "__vis__":
+                return np.full(n, -1, dtype)
+            return np.zeros(n, dtype)
+
+        out = {}
+        for k in keys:
+            dtype = next(
+                b.columns[k].dtype for b in batches if k in b.columns
+            )
+            out[k] = np.concatenate([
+                b.columns[k] if k in b.columns else _fill(k, b.n, dtype)
+                for b in batches
+            ])
+        return ColumnBatch(out, sum(b.n for b in batches))
+
+
+def schema_null_fills(ft: FeatureType) -> Dict[str, Any]:
+    """Per-column null-fill values for :meth:`ColumnBatch.concat`, matching
+    ``null_columns``' convention: string code -1, int/long/date 0, bool
+    False (floats and derived geometry columns fall through to concat's NaN
+    default); ``__vis__`` fills the empty-visibility code 0."""
+    fills: Dict[str, Any] = {"__vis__": 0}
+    for a in ft.attributes:
+        if a.is_geom:
+            continue
+        if a.type == "string":
+            fills[a.name] = -1
+        elif a.type in ("int32", "int64", "date"):
+            fills[a.name] = 0
+        elif a.type == "bool":
+            fills[a.name] = False
+    return fills
 
 
 def _to_epoch_ms(vals) -> np.ndarray:

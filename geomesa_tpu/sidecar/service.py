@@ -387,6 +387,11 @@ class GeoFlightServer(fl.FlightServerBase):
             from geomesa_tpu import metrics
 
             return ok({"metrics": metrics.registry().report()})
+        if kind == "cache-stats":
+            # the aggregate cache is dataset-scoped, so every Flight query
+            # of this sidecar shares it; this is the operator's view of
+            # residency + hit rates (docs/CACHE.md)
+            return ok({"cache": ds.cache.store.snapshot()})
         if kind == "version":
             # the distributed-version handshake (GeoMesaDataStore.scala:
             # 498-503, 615-667: client checks the server-side iterator
@@ -407,6 +412,7 @@ class GeoFlightServer(fl.FlightServerBase):
             ("count", "feature count: {name, ecql, exact}"),
             ("audit", "recent query events: {n}"),
             ("metrics", "metrics registry snapshot"),
+            ("cache-stats", "aggregate cache residency + hit counters"),
         ]
 
     # -- discovery ---------------------------------------------------------

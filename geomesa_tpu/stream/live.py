@@ -61,6 +61,10 @@ class LiveFeatureCache:
         self._lock = threading.Lock()
         self._batch: Optional[ColumnBatch] = None  # columnar view cache
         self._grid: Optional[Dict[int, List[str]]] = None
+        #: mutation epoch: bumped by every applied change/delete/clear/expiry
+        #: — the invalidation key for anything caching aggregates over the
+        #: live window (same contract as FeatureStore.version; docs/CACHE.md)
+        self.epoch = 0
 
     def __len__(self):
         return len(self._state)
@@ -125,6 +129,7 @@ class LiveFeatureCache:
     def _invalidate(self):
         self._batch = None
         self._grid = None
+        self.epoch += 1
 
     # -- columnar view ------------------------------------------------------
     def batch(self) -> ColumnBatch:
@@ -325,10 +330,15 @@ class StreamingDataset:
                     phase: str) -> None:
         """Poison-message quarantine (docs/RESILIENCE.md): count, record
         through the audit degradation trail, and move on — a bad message
-        must never kill the consumer."""
-        from geomesa_tpu import resilience
+        must never kill the consumer. Counters ride the process metrics
+        registry (ROADMAP open item) so operators see quarantine volume in
+        the same exposition as the cache/query counters:
+        ``stream.poll.quarantined`` total plus a per-schema breakdown."""
+        from geomesa_tpu import metrics, resilience
 
         self.quarantined[name] = self.quarantined.get(name, 0) + 1
+        metrics.inc("stream.poll.quarantined")
+        metrics.inc(f"stream.poll.quarantined.{name}")
         resilience.record_skip(
             "stream.poll.decode", f"{name}/{part}", error, phase=phase
         )

@@ -1,0 +1,140 @@
+"""Regression tests for the ADVICE r5 fixes riding the cache PR.
+
+1. ``flush()``'s ``__vis__`` back-fill bumps the mutation epoch (an
+   incremental checkpoint after the back-fill must fully rewrite, or old
+   chunks reload without the column) and ``ColumnBatch.concat`` unions
+   column sets with null-fill instead of silently intersecting.
+2. literal/literal division by zero follows IEEE instead of raising an
+   uncaught ZeroDivisionError at query time.
+3. property-free comparisons fold to a constant Include/Exclude.
+4. mixed-type literal comparisons dispatch on the op and raise a clean
+   ValueError for genuinely incomparable orderings.
+5. stream poison-message quarantine counters ride the metrics registry.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import metrics
+from geomesa_tpu.api.dataset import GeoDataset, Query
+from geomesa_tpu.filter import parse_ecql
+from geomesa_tpu.filter.ir import Exclude, Include
+from geomesa_tpu.schema.columns import ColumnBatch
+from geomesa_tpu.security import VIS_COLUMN
+
+
+@pytest.fixture()
+def ds():
+    d = GeoDataset(n_shards=2)
+    d.create_schema("t", "weight:Float,*geom:Point")
+    d.insert("t", {
+        "geom__x": [1.0, 2.0], "geom__y": [1.0, 2.0], "weight": [0.5, 2.0],
+    })
+    d.flush("t")
+    return d
+
+
+# -- 1: __vis__ back-fill epoch + concat union ------------------------------
+
+def test_vis_backfill_forces_full_rewrite(tmp_path):
+    path = str(tmp_path / "store")
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "name:String,*geom:Point")
+    ds.insert("t", {"name": ["a", "b"], "geom__x": [1.0, 2.0],
+                    "geom__y": [1.0, 2.0]})
+    ds.flush("t")
+    st = ds._store("t")
+    # simulate a dataset persisted before visibility support
+    st._all.columns.pop(VIS_COLUMN, None)
+    st.dicts.pop(VIS_COLUMN, None)
+    ds.save(path)
+
+    ds2 = GeoDataset.load(path)
+    ds2.insert("t", {"name": ["c"], "geom__x": [3.0], "geom__y": [3.0]},
+               visibilities=["admin"])
+    ds2.flush("t")  # back-fills __vis__ on old rows -> must bump the epoch
+    ds2.save(path)  # would otherwise append one chunk WITHOUT rewriting
+
+    ds3 = GeoDataset.load(path)
+    st3 = ds3._store("t")
+    st3.flush()
+    assert VIS_COLUMN in st3._all.columns
+    assert st3._all.columns[VIS_COLUMN].tolist() == [0, 0, 1]
+    assert len(ds3.query("t", Query(auths=[]))) == 2        # admin row hidden
+    assert len(ds3.query("t", Query(auths=["admin"]))) == 3
+
+
+def test_concat_unions_columns_with_null_fill():
+    a = ColumnBatch({
+        "x": np.array([1.0, 2.0]),
+        "s": np.array(["a", "b"], object),
+        "flag": np.array([True, False]),
+    }, 2)
+    b = ColumnBatch({
+        "x": np.array([3.0]),
+        "v": np.array([7], np.int32),       # dict-code-shaped: null is -1
+        VIS_COLUMN: np.array([2], np.int32),  # visibility: null is "" = 0
+        "big": np.array([9], np.int64),
+    }, 1)
+    c = ColumnBatch.concat([a, b])
+    assert c.n == 3
+    assert set(c.columns) == {"x", "s", "flag", "v", VIS_COLUMN, "big"}
+    assert c.columns["x"].tolist() == [1.0, 2.0, 3.0]
+    assert c.columns["s"].tolist() == ["a", "b", None]
+    assert c.columns["v"].tolist() == [-1, -1, 7]
+    assert c.columns[VIS_COLUMN].tolist() == [0, 0, 2]
+    assert c.columns["big"].tolist() == [0, 0, 9]
+    assert c.columns["flag"].tolist() == [True, False, False]
+
+
+# -- 2: literal division by zero -------------------------------------------
+
+def test_literal_division_by_zero_is_ieee(ds):
+    assert ds.count("t", "weight > 1 / 0") == 0     # weight > inf
+    assert ds.count("t", "weight > -1 / 0") == 2    # weight > -inf
+    assert ds.count("t", "weight * 2 > 0 / 0") == 0  # NaN compares False
+
+
+# -- 3: property-free comparisons ------------------------------------------
+
+POLY = "st_geomFromWKT('POLYGON((0 0,1 0,1 1,0 1,0 0))')"
+
+
+def test_property_free_compare_folds_to_constant(ds):
+    assert ds.count("t", f"st_area({POLY}) > 0.5") == 2   # area 1 -> Include
+    assert ds.count("t", f"st_area({POLY}) > 2.5") == 0   # -> Exclude
+    assert ds.count("t", f"weight > 1 AND st_area({POLY}) > 0.5") == 1
+
+
+# -- 4: mixed-type literal comparisons -------------------------------------
+
+def test_mixed_literal_comparison():
+    assert isinstance(parse_ecql("1 = 'a'"), Exclude)   # equality: just False
+    assert isinstance(parse_ecql("1 <> 'a'"), Include)
+    assert isinstance(parse_ecql("'a' = 'a'"), Include)
+    with pytest.raises(ValueError, match="incomparable literal types"):
+        parse_ecql("1 < 'a'")
+    with pytest.raises(ValueError, match="incomparable literal types"):
+        parse_ecql("'a' >= 2")
+
+
+# -- 5: quarantine counters in the metrics registry -------------------------
+
+def test_stream_quarantine_counters_in_registry():
+    from geomesa_tpu.stream.live import StreamingDataset
+    from geomesa_tpu.stream.messages import GeoMessage
+
+    sd = StreamingDataset()
+    sd.create_schema("live", "name:String,*geom:Point")
+    total_before = metrics.registry().counter("stream.poll.quarantined").value
+    sd.write("live", {"name": ["ok"], "geom": [(1.0, 2.0)]}, ["f1"])
+    # poison: a point payload the columnar encode cannot absorb
+    sd._topics["live"].send(
+        GeoMessage.change("bad", {"name": "x", "geom": "not-a-point"}, 1)
+    )
+    applied = sd.poll("live")
+    assert applied == 1
+    assert sd.quarantined["live"] == 1
+    reg = metrics.registry()
+    assert reg.counter("stream.poll.quarantined").value == total_before + 1
+    assert reg.counter("stream.poll.quarantined.live").value >= 1

@@ -1,0 +1,408 @@
+"""Spatial aggregate cache (geomesa_tpu/cache/; docs/CACHE.md).
+
+Tier-1 correctness contract: with ``geomesa.cache.enabled``, repeated and
+overlapping density/stats/count queries return BIT-IDENTICAL results to a
+cold (cache-disabled) run — including after interleaved inserts/deletes
+(epoch invalidation) and under partial-cover reuse — and a warm overlapping
+query executes only the residual cells (asserted via the partial-hit
+counter and the executor's scan accounting in the audit event).
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import config, metrics
+from geomesa_tpu.api.dataset import GeoDataset, Query
+from geomesa_tpu.cache import AggregateCache, decompose
+from geomesa_tpu.filter import parse_ecql
+
+
+def _counter(name: str) -> int:
+    return metrics.registry().counter(name).value
+
+
+def _enabled():
+    return config.CACHE_ENABLED.scoped("true")
+
+
+@pytest.fixture()
+def ds(rng):
+    """Seeded points including rows EXACTLY on level-5 cell edges (span
+    360/32 = 11.25 deg), so the half-open cell partition is exercised."""
+    ds = GeoDataset(n_shards=4)
+    ds.create_schema(
+        "pts", "type:String:index=true,weight:Float,dtg:Date,*geom:Point"
+    )
+    edges = np.arange(-180.0, 180.1, 11.25)
+    span = edges[(edges > -30) & (edges < 30)]
+    bx, by = np.meshgrid(span, span)
+    r = np.random.default_rng(7)
+    n = 4000
+    x = np.concatenate([bx.ravel(), r.uniform(-35, 35, n)])
+    y = np.concatenate([by.ravel(), r.uniform(-35, 35, n)])
+    m = len(x)
+    lo = np.datetime64("2020-01-01", "ms").astype(np.int64)
+    ds.insert("pts", {
+        "geom__x": x, "geom__y": y,
+        "weight": r.uniform(0, 2, m),
+        "dtg": (lo + r.integers(0, 10**9, m)).astype("datetime64[ms]"),
+        "type": r.choice(["bus", "car", "train"], m),
+    }, fids=np.arange(m).astype(str))
+    ds.flush("pts")
+    return ds
+
+
+Q1 = "BBOX(geom, -22.5, -22.5, 22.5, 22.5) AND type = 'bus'"
+#: pan east: heavy cell overlap with Q1, plus a newly exposed cell column
+Q2 = "BBOX(geom, -18.0, -22.5, 34.9, 22.5) AND type = 'bus'"
+
+
+# -- count: identity, partial reuse, scan accounting -----------------------
+
+def test_count_repeat_and_overlap_identical(ds):
+    cold1 = ds.count("pts", Q1)
+    cold2 = ds.count("pts", Q2)
+    with _enabled():
+        assert ds.count("pts", Q1) == cold1     # cold populate
+        assert ds.count("pts", Q1) == cold1     # whole-result hit
+        assert ds.count("pts", Q2) == cold2     # partial-cover reuse
+
+
+def test_warm_overlap_scans_only_residual(ds):
+    with _enabled():
+        ds.count("pts", Q1)
+        ev_cold = ds.audit.recent(1)[0]
+        assert ev_cold.hints["exec_path"]["cache"] == "miss"
+        cold_scanned = ev_cold.scanned
+        assert cold_scanned > 0
+
+        partial_before = _counter("cache.partial")
+        ds.count("pts", Q2)
+        ev_warm = ds.audit.recent(1)[0]
+        # the partial-hit counter fired and the exec path names the shape
+        assert _counter("cache.partial") == partial_before + 1
+        path = ev_warm.hints["exec_path"]
+        assert path["cache"] == "partial"
+        hits, total = map(int, path["cache_cells"].split("/"))
+        assert 0 < hits < total
+        # executor scan accounting: the warm overlapping query scanned
+        # strictly fewer candidate rows than its own cold run would have
+        with config.CACHE_ENABLED.scoped("false"):
+            ds.count("pts", Q2)
+        assert ev_warm.scanned < ds.audit.recent(1)[0].scanned
+
+
+def test_exact_repeat_scans_nothing(ds):
+    with _enabled():
+        ds.count("pts", Q1)
+        hit_before = _counter("cache.hit")
+        ds.count("pts", Q1)
+        ev = ds.audit.recent(1)[0]
+        assert _counter("cache.hit") == hit_before + 1
+        assert ev.hints["exec_path"]["cache"] == "hit"
+        assert ev.scanned == 0
+
+
+def test_epoch_invalidation_insert_delete(ds):
+    with _enabled():
+        base = ds.count("pts", Q1)
+        ds.insert("pts", {
+            "geom__x": [0.0, 11.25], "geom__y": [0.0, 11.25],
+            "weight": [1.0, 1.0],
+            "dtg": np.array(["2020-01-02", "2020-01-03"], "datetime64[ms]"),
+            "type": ["bus", "bus"],
+        }, fids=["fresh1", "fresh2"])
+        ds.flush("pts")
+        assert ds.count("pts", Q1) == base + 2
+        ds.delete_features("pts", "IN ('fresh1')")
+        assert ds.count("pts", Q1) == base + 1
+    # and the final state matches a cache-disabled recount
+    assert ds.count("pts", Q1) == base + 1
+
+
+# -- density ---------------------------------------------------------------
+
+def test_density_unweighted_bit_identical(ds):
+    bbox = (-22.5, -22.5, 22.5, 22.5)
+    cold = ds.density("pts", Q1, bbox=bbox, width=96, height=64)
+    with _enabled():
+        g1 = ds.density("pts", Q1, bbox=bbox, width=96, height=64)
+        g2 = ds.density("pts", Q1, bbox=bbox, width=96, height=64)  # hit
+        g3 = ds.density("pts", Q2, bbox=bbox, width=96, height=64)  # partial
+    assert np.array_equal(cold, g1)
+    assert np.array_equal(cold, g2)
+    assert np.array_equal(
+        ds.density("pts", Q2, bbox=bbox, width=96, height=64), g3
+    )
+
+
+def test_density_partial_reuse_under_fixed_raster(ds):
+    """A raster decoupled from the filter bbox (dashboard/WMS-overview
+    shape) decomposes; overlapping filters then reuse cells."""
+    bbox = (-30.0, -30.0, 30.0, 30.0)  # fixed render raster
+    f1 = "BBOX(geom, -22.5, -22.5, 22.5, 22.5)"
+    f2 = "BBOX(geom, -18.0, -22.5, 34.9, 22.5)"
+    cold2 = ds.density("pts", f2, bbox=bbox, width=64, height=64)
+    with _enabled():
+        ds.density("pts", f1, bbox=bbox, width=64, height=64)
+        assert "cache_cells" in ds.audit.recent(1)[0].hints["exec_path"]
+        partial_before = _counter("cache.partial")
+        warm2 = ds.density("pts", f2, bbox=bbox, width=64, height=64)
+        assert _counter("cache.partial") == partial_before + 1
+    assert np.array_equal(cold2, warm2)
+
+
+def test_density_coupled_raster_whole_result_only(ds):
+    """Filter bbox == render raster (pan/zoom map shape): a pan would move
+    every cell key, so decomposition is skipped for density here."""
+    bbox = (-22.5, -22.5, 22.5, 22.5)
+    with _enabled():
+        ds.density("pts", "BBOX(geom, -22.5, -22.5, 22.5, 22.5)",
+                   bbox=bbox, width=32, height=32)
+        assert "cache_cells" not in ds.audit.recent(1)[0].hints["exec_path"]
+
+
+def test_density_cells_gated_by_budget(ds):
+    """Per-cell density entries hold full rasters; when the cells alone
+    would blow half the budget, decomposition is skipped so one query
+    cannot evict the whole cache."""
+    ds.cache = AggregateCache(budget_bytes=100_000)
+    bbox = (-30.0, -30.0, 30.0, 30.0)  # decoupled raster (would decompose)
+    with _enabled():
+        ds.density("pts", "BBOX(geom, -22.5, -22.5, 22.5, 22.5)",
+                   bbox=bbox, width=64, height=64)  # 16 KiB/cell x ~30 cells
+        assert "cache_cells" not in ds.audit.recent(1)[0].hints["exec_path"]
+    assert ds.cache.store.total_bytes <= 100_000
+
+
+def test_density_weighted_whole_result_only(ds):
+    bbox = (-22.5, -22.5, 22.5, 22.5)
+    cold = ds.density("pts", Q1, bbox=bbox, width=64, height=64,
+                      weight="weight")
+    with _enabled():
+        g1 = ds.density("pts", Q1, bbox=bbox, width=64, height=64,
+                        weight="weight")
+        ev = ds.audit.recent(1)[0]
+        # weighted grids must not decompose (f32 rounding is order-dependent)
+        assert "cache_cells" not in ev.hints["exec_path"]
+        g2 = ds.density("pts", Q1, bbox=bbox, width=64, height=64,
+                        weight="weight")
+    assert np.array_equal(cold, g1)
+    assert np.array_equal(cold, g2)
+
+
+def test_cached_grid_immune_to_caller_mutation(ds):
+    bbox = (-22.5, -22.5, 22.5, 22.5)
+    with _enabled():
+        ds.density("pts", Q1, bbox=bbox, width=32, height=32)
+        g_hit = ds.density("pts", Q1, bbox=bbox, width=32, height=32)
+        g_hit[:] = -1.0  # hit results are fresh copies: scribbling is safe
+        g_again = ds.density("pts", Q1, bbox=bbox, width=32, height=32)
+    assert g_again.min() >= 0.0
+
+
+def test_density_curve_whole_result_cache(ds):
+    cold, snapped = ds.density_curve("pts", Q1, level=6,
+                                     bbox=(-22.5, -22.5, 22.5, 22.5))
+    with _enabled():
+        g1, s1 = ds.density_curve("pts", Q1, level=6,
+                                  bbox=(-22.5, -22.5, 22.5, 22.5))
+        hit_before = _counter("cache.hit")
+        g2, s2 = ds.density_curve("pts", Q1, level=6,
+                                  bbox=(-22.5, -22.5, 22.5, 22.5))
+        assert _counter("cache.hit") == hit_before + 1
+    assert s1 == snapped and s2 == snapped
+    assert np.array_equal(cold, g1) and np.array_equal(cold, g2)
+
+
+# -- stats -----------------------------------------------------------------
+
+def test_stats_exact_merge_kinds_identical(ds):
+    spec = "Count();MinMax(weight);Enumeration(type)"
+    cold = ds.stats("pts", spec, Q1).value()
+    with _enabled():
+        assert ds.stats("pts", spec, Q1).value() == cold   # populate
+        assert ds.stats("pts", spec, Q1).value() == cold   # whole hit
+        warm_overlap = ds.stats("pts", spec, Q2).value()   # partial reuse
+    assert warm_overlap == ds.stats("pts", spec, Q2).value()
+
+
+def test_stats_inexact_merge_kind_whole_result_only(ds):
+    spec = "DescriptiveStats(weight)"  # moment merge reorders f64 sums
+    cold = ds.stats("pts", spec, Q1).value()
+    with _enabled():
+        v1 = ds.stats("pts", spec, Q1).value()
+        ev = ds.audit.recent(1)[0]
+        assert "cache_cells" not in ev.hints["exec_path"]
+        v2 = ds.stats("pts", spec, Q1).value()
+    assert v1 == cold and v2 == cold
+
+
+def test_cached_stat_immune_to_caller_mutation(ds):
+    spec = "Count()"
+    with _enabled():
+        ds.stats("pts", spec, Q1)
+        hot = ds.stats("pts", spec, Q1)
+        expected = hot.value()
+        hot.count = -999  # entries are serialized snapshots: no aliasing
+        assert ds.stats("pts", spec, Q1).value() == expected
+
+
+# -- visibility / auth keying ---------------------------------------------
+
+def test_auths_partition_the_cache(rng):
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("sec", "name:String,*geom:Point")
+    ds.insert("sec", {"name": ["open"], "geom__x": [1.0], "geom__y": [1.0]})
+    ds.insert("sec", {"name": ["secret"], "geom__x": [2.0], "geom__y": [2.0]},
+              visibilities=["admin"])
+    ds.flush("sec")
+    q = "BBOX(geom, 0, 0, 10, 10)"
+    with _enabled():
+        assert ds.count("sec", Query(ecql=q, auths=["admin"])) == 2
+        assert ds.count("sec", Query(ecql=q, auths=[])) == 1
+        # repeat both from cache: entries must not bleed across auth sets
+        assert ds.count("sec", Query(ecql=q, auths=["admin"])) == 2
+        assert ds.count("sec", Query(ecql=q, auths=[])) == 1
+
+
+# -- bypasses / admission ---------------------------------------------------
+
+def test_sampling_bypasses_cache(ds):
+    with _enabled():
+        before = (_counter("cache.hit") + _counter("cache.miss")
+                  + _counter("cache.partial"))
+        ds.count("pts", Query(ecql=Q1, sampling=4))
+        after = (_counter("cache.hit") + _counter("cache.miss")
+                 + _counter("cache.partial"))
+    assert after == before
+
+
+def test_eviction_under_budget(ds):
+    ds.cache = AggregateCache(budget_bytes=500)
+    before = _counter("cache.evict")
+    with _enabled():
+        results = {}
+        for dx in range(8):
+            q = f"BBOX(geom, {-22.5 + dx}, -22.5, {22.5 + dx}, 22.5)"
+            results[q] = ds.count("pts", q)
+        # under heavy eviction every answer must still be exact
+        for q, v in results.items():
+            assert ds.count("pts", q) == v
+    assert _counter("cache.evict") > before
+    assert ds.cache.store.total_bytes <= 500
+
+
+def test_delete_schema_drops_cached_entries(ds):
+    with _enabled():
+        ds.count("pts", Q1)
+    assert ds.cache.store.total_entries > 0
+    ds.delete_schema("pts")
+    assert ds.cache.store.total_entries == 0
+    assert ds.cache.store.total_bytes == 0
+
+
+def test_disabled_cache_stores_nothing(ds):
+    puts = _counter("cache.put")
+    ds.count("pts", Q1)
+    ds.density("pts", Q1, bbox=(-22.5, -22.5, 22.5, 22.5), width=16, height=16)
+    assert _counter("cache.put") == puts
+    assert ds.cache.store.total_entries == 0
+
+
+# -- decomposition unit behavior -------------------------------------------
+
+def _pt_ft():
+    from geomesa_tpu.schema.feature_type import FeatureType
+
+    return FeatureType.from_spec("t", "type:String,*geom:Point")
+
+
+def test_decompose_shapes():
+    f = parse_ecql(Q1)
+    d = decompose(f, _pt_ft())
+    assert d is not None
+    assert d.cells and len(d.strips) <= 4
+    assert d.residual_key == repr(parse_ecql("type = 'bus'"))
+    # cell boxes are half-open realizations: max edge strictly below the
+    # next cell's min edge
+    (ix, iy) = d.cells[0]
+    b = d.cell_boxes[(ix, iy)]
+    assert b[2] < b[0] + 360.0 / (1 << d.level) + 1e-12
+    # absolute identity: the same cell derived from the panned query
+    d2 = decompose(parse_ecql(Q2), _pt_ft())
+    shared = set(d.cells) & set(d2.cells)
+    assert shared
+    for c in shared:
+        assert d.cell_boxes[c] == d2.cell_boxes[c]
+        assert d.cell_prefix(c) == d2.cell_prefix(c)
+
+
+def test_decompose_rejects_non_pan_shapes():
+    ft = _pt_ft()
+    # two boxes, polygon intersection, spatial under OR: all non-decomposable
+    assert decompose(parse_ecql(
+        "BBOX(geom, 0, 0, 10, 10) AND BBOX(geom, 5, 5, 15, 15)"), ft) is None
+    assert decompose(parse_ecql(
+        "INTERSECTS(geom, POLYGON((0 0, 10 0, 10 10, 0 10, 0 0)))"), ft) is None
+    assert decompose(parse_ecql(
+        "BBOX(geom, 0, 0, 10, 10) OR type = 'bus'"), ft) is None
+    assert decompose(parse_ecql("INCLUDE"), ft) is None
+    # extent geometry schemas never decompose: a polygon feature straddling
+    # a cell edge would be counted once PER intersecting cell
+    from geomesa_tpu.schema.feature_type import FeatureType
+
+    poly_ft = FeatureType.from_spec("p", "type:String,*geom:Polygon")
+    assert decompose(parse_ecql("BBOX(geom, 0, 0, 10, 10)"), poly_ft) is None
+
+
+def test_extent_geometry_whole_result_only():
+    """Reviewer repro: a polygon straddling cell edges must count ONCE with
+    the cache enabled (extent schemas skip decomposition)."""
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("poly", "type:String,*geom:Polygon")
+    ds.insert("poly", {
+        "type": ["a"],
+        "geom": ["POLYGON((-1 -1, 1 -1, 1 1, -1 1, -1 -1))"],
+    })
+    ds.flush("poly")
+    q = "BBOX(geom, -22.5, -22.5, 22.5, 22.5)"
+    cold = ds.count("poly", q)
+    assert cold == 1
+    with _enabled():
+        assert ds.count("poly", q) == 1
+        assert "cache_cells" not in ds.audit.recent(1)[0].hints["exec_path"]
+        assert ds.count("poly", q) == 1  # whole-result hit
+
+
+def test_explain_reports_cache_participation(ds):
+    out = ds.explain("pts", Q1)
+    assert "Aggregate cache" in out
+    assert "partial-cover: level" in out
+    out2 = ds.explain("pts", "type = 'bus'")
+    assert "not decomposable" in out2
+
+
+# -- partitioned stores -----------------------------------------------------
+
+def test_partitioned_store_cache(rng):
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema(
+        "part", "weight:Float,dtg:Date,*geom:Point;geomesa.partition='time'"
+    )
+    r = np.random.default_rng(3)
+    n = 2000
+    lo = np.datetime64("2020-01-01", "ms").astype(np.int64)
+    ds.insert("part", {
+        "geom__x": r.uniform(-20, 20, n), "geom__y": r.uniform(-20, 20, n),
+        "weight": r.uniform(0, 1, n),
+        "dtg": (lo + r.integers(0, 40 * 86_400_000, n)).astype("datetime64[ms]"),
+    }, fids=np.arange(n).astype(str))
+    ds.flush("part")
+    q = ("BBOX(geom, -10, -10, 12.5, 12.5) AND "
+         "dtg DURING 2020-01-01T00:00:00Z/2020-02-01T00:00:00Z")
+    cold = ds.count("part", q)
+    with _enabled():
+        assert ds.count("part", q) == cold
+        assert ds.count("part", q) == cold
+        assert ds.audit.recent(1)[0].hints["exec_path"]["cache"] == "hit"
