@@ -7,15 +7,22 @@ the measured baseline, per BASELINE.md).
 
 Prints ONE JSON line. Success: {"metric", "value", "unit", "vs_baseline"}
 plus driver-checkable extras (p50_e2e_density_ms, device_ms, cpu_ms, n_rows,
-rows_scanned, rows_matched, ingest_s). Failure: the same metric keys zeroed
-plus "device_unreachable": true and, when the probe failed with a non-zero
-rc, "probe_error": <stderr tail>; the process then exits 3 — parseable JSON
-AND a failure exit code, never a bare non-zero exit with no JSON.
+rows_scanned, rows_matched, ingest_s, warm_requery_ms,
+recompiles_per_100_queries). When the accelerator probe fails, the bench
+FALLS BACK to JAX_PLATFORMS=cpu and reports REAL CPU numbers annotated with
+"device_unreachable": true (plus "probe_error" when the probe died with a
+non-zero rc) — never a zeroed metric that poisons the trajectory (the
+BENCH_r05 failure mode). Only a crash mid-run exits non-zero.
 
-Env knobs: GEOMESA_BENCH_N (points, default 20M), GEOMESA_BENCH_ITERS,
-GEOMESA_BENCH_PROBE_{ATTEMPTS,TIMEOUT,BACKOFF}, GEOMESA_BENCH_RESET_CMD,
-GEOMESA_BENCH_WALL_TIMEOUT (whole-run watchdog seconds, default 1800,
-0 disables — raise it for runs expected to exceed 30 minutes).
+``--smoke``: CI mode — tiny dataset (200k rows), forced CPU backend, no
+device probe; same JSON keys plus "smoke": true, so warm-path regressions
+(recompiles_per_100_queries > 0) are caught without TPU access.
+
+Env knobs: GEOMESA_BENCH_N (points, default 20M; 200k under --smoke),
+GEOMESA_BENCH_ITERS, GEOMESA_BENCH_PROBE_{ATTEMPTS,TIMEOUT,BACKOFF},
+GEOMESA_BENCH_RESET_CMD, GEOMESA_BENCH_WALL_TIMEOUT (whole-run watchdog
+seconds, default 1800, 0 disables — raise it for runs expected to exceed
+30 minutes).
 """
 
 import json
@@ -40,10 +47,11 @@ def _probe_device() -> "dict | None":
     bounds the damage.
 
     Round-4 lesson: one wedged claim must not zero a round's evidence.
-    So: up to GEOMESA_BENCH_PROBE_ATTEMPTS (default 3) probes with
-    exponential backoff, an optional operator reset hook
-    (GEOMESA_BENCH_RESET_CMD, run between attempts), and the caller
-    emits a parseable failure JSON instead of a bare non-zero exit.
+    Round-5 lesson: even a PARSEABLE zeroed metric poisons the
+    trajectory — so the caller now falls back to a real CPU run after
+    the FIRST failed probe (GEOMESA_BENCH_PROBE_ATTEMPTS default 1;
+    raise it to re-probe with the optional GEOMESA_BENCH_RESET_CMD
+    operator reset hook between attempts).
 
     Returns None if the device answered; otherwise a dict of failure keys
     to merge into the emitted JSON line: always "device_unreachable": true,
@@ -54,7 +62,7 @@ def _probe_device() -> "dict | None":
     """
     import subprocess
 
-    attempts = int(os.environ.get("GEOMESA_BENCH_PROBE_ATTEMPTS", 3))
+    attempts = int(os.environ.get("GEOMESA_BENCH_PROBE_ATTEMPTS", 1))
     timeout_s = int(os.environ.get("GEOMESA_BENCH_PROBE_TIMEOUT", 240))
     backoff_s = int(os.environ.get("GEOMESA_BENCH_PROBE_BACKOFF", 15))
     reset_cmd = os.environ.get("GEOMESA_BENCH_RESET_CMD")
@@ -127,26 +135,46 @@ def _arm_watchdog() -> None:
     t.start()
 
 
+def _force_cpu() -> None:
+    """Route this process onto the CPU backend (the axon TPU plugin's
+    sitecustomize overrides JAX_PLATFORMS at startup, so the jax.config
+    update is required too)."""
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+
+
 def main():
-    n = int(os.environ.get("GEOMESA_BENCH_N", 20_000_000))
-    iters = int(os.environ.get("GEOMESA_BENCH_ITERS", 10))
+    smoke = "--smoke" in sys.argv[1:]
+    n = int(os.environ.get("GEOMESA_BENCH_N", 200_000 if smoke else 20_000_000))
+    iters = int(os.environ.get("GEOMESA_BENCH_ITERS", 2 if smoke else 10))
     _arm_watchdog()
-    probe_failure = _probe_device()
-    if probe_failure is not None:
-        # Still ONE parseable JSON line: the driver records the round's
-        # evidence (device unreachable / probe error) instead of a bare
-        # rc=3/parsed:null that erases the whole round (the r4 failure
-        # mode). The exit code stays non-zero so exit-code-gating consumers
-        # also see the infra failure — never a measured 0 feat/s.
-        print(json.dumps({
-            "metric": "bbox_time_density_scan_throughput",
-            "value": 0,
-            "unit": "features/sec",
-            "vs_baseline": 0,
-            **probe_failure,
-        }))
-        sys.stdout.flush()
-        sys.exit(3)
+    annotations = {}
+    cpu_backend = smoke
+    if smoke:
+        # CI mode: tiny dataset, no probe, forced CPU — the warm-path keys
+        # below still regress-test the executor without TPU access
+        annotations["smoke"] = True
+        _force_cpu()
+    else:
+        probe_failure = _probe_device()
+        if probe_failure is not None:
+            # Accelerator unreachable: fall back to the CPU backend and
+            # measure REAL numbers instead of emitting value: 0 with rc=3
+            # (the BENCH_r05 failure mode — a zeroed metric poisons the
+            # round's trajectory). "device_unreachable": true rides along
+            # as an annotation so the driver knows these are CPU numbers.
+            sys.stderr.write(
+                "accelerator unreachable: falling back to JAX_PLATFORMS=cpu "
+                "(annotated, not zeroed)\n"
+            )
+            annotations.update(probe_failure)
+            cpu_backend = True
+            _force_cpu()
 
     sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
     from geomesa_tpu import GeoDataset
@@ -167,9 +195,12 @@ def main():
     # extends the time axis the way real feeds do — the partition-pruning
     # story then matches production shape: a 10-day query window over a
     # long-running feed)
+    # never shrink below one month: the fixed Jan-05/15 query window must
+    # keep matching rows at small n (the --smoke dataset), or the bench
+    # measures empty scans
     span_ms = int(
         (parse_iso_ms("2020-02-01") - parse_iso_ms("2020-01-01"))
-        * (n / 20_000_000)
+        * max(n / 20_000_000, 1.0)
     )
     lo_ms = parse_iso_ms("2020-01-01")
     data = {
@@ -240,7 +271,9 @@ def main():
 
     chain(2)  # warmup: compile + column/window upload
     k1 = 2
-    k2 = k1 + int(os.environ.get("GEOMESA_BENCH_BATCH", 32))
+    k2 = k1 + int(
+        os.environ.get("GEOMESA_BENCH_BATCH", 4 if cpu_backend else 32)
+    )
     t1 = min(chain(k1) for _ in range(iters))
     t2 = min(chain(k2) for _ in range(iters))
     dev_s = max((t2 - t1) / (k2 - k1), 1e-9)
@@ -279,6 +312,43 @@ def main():
         f"device {matched} vs cpu {float(m.sum())}"
     )
 
+    during = "dtg DURING 2020-01-05T00:00:00Z/2020-01-15T00:00:00Z"
+
+    def pan_ecql(dx):
+        return (
+            f"BBOX(geom, {-100 + dx}, 30, {-80 + dx}, 45) AND {during}"
+        )
+
+    # Warm-path executor effectiveness (docs/PERF.md): steady state must be
+    # compile-free. warm_requery_ms = p50 of the SAME public-API query
+    # re-issued (plan cache + kernel registry + window caches warm);
+    # recompiles_per_100_queries = fresh jit traces per 100 queries cycling
+    # distinct-but-similar filters AFTER one warmup cycle — zero when
+    # shape bucketing + version-stable kernel keys hold.
+    from geomesa_tpu import metrics as _metrics
+
+    warm = sorted(
+        _timed(lambda: ds.density("gdelt", ecql, bbox=bbox, width=W, height=H))
+        for _ in range(5)
+    )
+    warm_requery_ms = warm[len(warm) // 2] * 1e3
+    variants = [pan_ecql(dx) for dx in (0.0, 0.5, 1.0, 1.5)]
+    for v in variants:  # warmup: at most one trace per distinct filter
+        ds.count("gdelt", v)
+    _rec = _metrics.registry().counter(_metrics.KERNEL_RECOMPILES)
+    rec0 = _rec.value
+    n_q = int(os.environ.get("GEOMESA_BENCH_WARM_QUERIES", 100))
+    t0 = time.time()
+    for i in range(n_q):
+        ds.count("gdelt", variants[i % len(variants)])
+    warm_count_s = time.time() - t0
+    recompiles_per_100 = (_rec.value - rec0) * 100.0 / max(n_q, 1)
+    sys.stderr.write(
+        f"warm path: requery p50={warm_requery_ms:.1f}ms "
+        f"recompiles/100q={recompiles_per_100:.1f} "
+        f"({n_q} warm counts in {warm_count_s:.2f}s)\n"
+    )
+
     # Aggregate-cache effectiveness (docs/CACHE.md): cold vs warm latency
     # with the cache enabled — an exact repeat (whole-result hit) and an
     # overlapping pan (partial-cover reuse: only the newly exposed strip
@@ -286,13 +356,6 @@ def main():
     cache_keys = {}
     if os.environ.get("GEOMESA_BENCH_CACHE", "1") != "0":
         from geomesa_tpu import config as _cfg
-
-        during = "dtg DURING 2020-01-05T00:00:00Z/2020-01-15T00:00:00Z"
-
-        def pan_ecql(dx):
-            return (
-                f"BBOX(geom, {-100 + dx}, 30, {-80 + dx}, 45) AND {during}"
-            )
 
         with _cfg.CACHE_ENABLED.scoped("true"):
             dens_cold = _timed(lambda: ds.density(
@@ -338,7 +401,10 @@ def main():
         "rows_scanned": scanned,
         "rows_matched": int(matched),
         "ingest_s": round(ingest_s, 1),
+        "warm_requery_ms": round(warm_requery_ms, 2),
+        "recompiles_per_100_queries": round(recompiles_per_100, 1),
         **cache_keys,
+        **annotations,
     }))
 
 

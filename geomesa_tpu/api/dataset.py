@@ -483,6 +483,30 @@ class GeoDataset:
             exp.line("partial-cover: not decomposable "
                      "(whole-result caching only)")
         exp.pop()
+        # warm-path posture (docs/PERF.md): shape bucketing + the shared
+        # version-stable kernel registry + the partition prefetch pipeline
+        exp.push("Warm path")
+        floor = config.COMPACT_BUCKET_FLOOR.to_int()
+        exp.kv(
+            "shape bucketing",
+            f"on (K floor {8 if floor is None else floor})"
+            if config.COMPACT_BUCKETING.to_bool() else "off",
+        )
+        ex0 = self._executor(st)
+        reg = (ex0.kernel_registry()
+               if hasattr(ex0, "kernel_registry") else None)
+        if reg is not None:
+            tr = reg.traces()
+            exp.kv(
+                "kernel registry",
+                f"{len(reg)} compiled kernels, "
+                f"{sum(tr.values())} traces to date",
+            )
+        exp.kv("prefetch pipeline",
+               bool(config.PIPELINE_PREFETCH.to_bool()))
+        exp.kv("persistent compile cache",
+               config.COMPILE_CACHE_DIR.get() or "off")
+        exp.pop()
         if analyze:
             ex = self._executor(st)
             matched = ex.count(plan)

@@ -101,11 +101,24 @@ class AggregateCache:
     @staticmethod
     def _sub_plan(ds, st, q, f):
         """Plan + visibility-wrap a residual/cell filter through the
-        ordinary pipeline (interceptor guards included)."""
+        ordinary pipeline (interceptor guards included).
+
+        Cell filters are canonical per (cell, residual), so the sub-plan
+        gets a stable ``cache_token``: its jitted kernels land in the
+        store's shared LRU kernel registry and are REUSED whenever any
+        later query decomposes over the same cell — even after a mutation
+        drops the cached results themselves (kernel keys are
+        version-stable; docs/PERF.md). Cold decomposed queries therefore
+        share compiled kernels instead of tracing one per cell per query."""
         from geomesa_tpu.planning.planner import QueryHints, QueryPlanner
 
         plan2 = QueryPlanner(st).plan(f, QueryHints(query_index=q.index))
-        ds._apply_visibility(st, plan2, ds._effective_auths(q))
+        auths = ds._effective_auths(q)
+        ds._apply_visibility(st, plan2, auths)
+        plan2.__dict__["cache_token"] = (
+            "cache_cell", repr(plan2.filter),
+            None if auths is None else tuple(auths),
+        )
         return plan2
 
     def _run_sub(self, ds, st, q, f, op, plan, scan_acc: List[int]):

@@ -124,6 +124,22 @@ def registry() -> Dict[str, SystemProperty]:
     return dict(_REGISTRY)
 
 
+def snapshot_overrides() -> Dict[str, str]:
+    """Copy of the CURRENT thread's override map. Overrides are
+    thread-local, so a worker thread spawned mid-scope sees only
+    env/defaults; pass this snapshot to :func:`adopt_overrides` on the
+    worker so both threads resolve every property identically (the
+    partition prefetcher does this — a bucketing knob diverging between
+    the staging and consuming threads would silently mismatch shapes)."""
+    return dict(_overrides())
+
+
+def adopt_overrides(snapshot: Dict[str, str]) -> None:
+    """Install a :func:`snapshot_overrides` copy as this thread's
+    override map (replaces any existing thread-local overrides)."""
+    _local.overrides = dict(snapshot)
+
+
 # ---------------------------------------------------------------------------
 # Query/scan tunables (names kept from the reference so operator docs carry
 # over; see geomesa-index-api/.../conf/QueryProperties.scala).
@@ -214,6 +230,38 @@ COMPACT_B = SystemProperty("geomesa.compact.b", "0")
 #: Range-cover budget for the compact path's fine (gap-union-free) window
 #: resolution; <= geomesa.scan.ranges.target disables the fine pass.
 COMPACT_COVER = SystemProperty("geomesa.compact.cover", "32768")
+
+#: Bucket compiled-kernel shapes (padded window count K to a power of two
+#: above the floor below; compact chunk counts already follow the
+#: geometric ladder in kernels/density_mxu.ladder8) so distinct-but-similar
+#: queries trace once per bucket instead of once per shape. Masked tails
+#: keep results exact.
+COMPACT_BUCKETING = SystemProperty("geomesa.compact.bucketing", "true")
+
+#: Floor for the bucketed window count K: every query's K pads up to at
+#: least this, so any plan with <= floor windows per shard shares one
+#: kernel shape. Padded windows are empty (start == end == 0).
+COMPACT_BUCKET_FLOOR = SystemProperty("geomesa.compact.bucket.floor", "8")
+
+#: Plain (non-partitioned) stores round their padded shard length L up to
+#: a multiple of this under bucketing, so a small insert never changes the
+#: padded scan kernel's static shape (partitioned children use the larger
+#: geomesa.partition.shard.bucket, set explicitly per table).
+COMPACT_SHARD_BUCKET = SystemProperty("geomesa.compact.shard.bucket", "8192")
+
+#: Capacity of the shared compiled-kernel LRU registry (entries). Evicts
+#: least-recently-used kernels one at a time — never clear-on-overflow.
+KERNEL_CACHE_SIZE = SystemProperty("geomesa.kernel.cache.size", "256")
+
+#: Directory for JAX's persistent compilation cache; when set, compiled
+#: XLA executables survive process restarts (warm starts skip compiles).
+COMPILE_CACHE_DIR = SystemProperty("geomesa.compile.cache.dir", None)
+
+#: Double-buffered partition pipeline: overlap the NEXT partition's host
+#: slab-gather/column assembly with the CURRENT partition's device
+#: execution (one prefetch thread, one in-flight partition; compile and
+#: dispatch stay on the query thread).
+PIPELINE_PREFETCH = SystemProperty("geomesa.pipeline.prefetch", "true")
 
 #: Use the scatter-free MXU density kernel on z-indexed tables.
 DENSITY_MXU = SystemProperty("geomesa.density.mxu", "true")

@@ -466,7 +466,16 @@ class FileSystemStorage:
         (docs/RESILIENCE.md): a corrupt/unreadable file is quarantined and
         — when the operation allows partial results — recorded + skipped
         (returns None); strict reads raise. A missing REQUESTED column is
-        a schema-evolution error, never a corruption skip."""
+        a schema-evolution error, never a corruption skip.
+
+        Transient I/O failures (``OSError``: fd pressure, an NFS blip) are
+        retried in place via :class:`resilience.RetryPolicy` (the standard
+        ``geomesa.retry.*`` knobs) and — even when retries are exhausted —
+        are NEVER quarantined: the next read re-attempts the file, so one
+        blip cannot lose the partition until process restart (ROADMAP open
+        item). Only non-OSError parse failures (real corruption) enter the
+        quarantine, and :meth:`clear_quarantine` re-admits those after an
+        operator repairs the file."""
         prior = self._quarantine.get(path)
         if prior is not None:
             err = RuntimeError(f"quarantined: {prior}")
@@ -475,11 +484,26 @@ class FileSystemStorage:
                 return None
             raise err
         try:
-            return self._read_file(path, columns=columns)
+            policy = resilience.RetryPolicy.from_config()
+            return policy.call(
+                lambda: self._read_file(path, columns=columns),
+                # a missing file will not heal by retrying; other OSErrors
+                # (EMFILE, ESTALE, EIO on network mounts) often do
+                retryable=lambda e: isinstance(e, OSError)
+                and not isinstance(e, FileNotFoundError),
+                deadline=resilience.current_deadline(),
+            )
         except KeyError:
             raise  # requested-but-missing column: the strict §schema contract
+        except OSError as e:
+            # transient path — recorded/raised but NOT quarantined
+            if resilience.partial_allowed():
+                resilience.record_skip("fs.read_partition", path, e, phase=part)
+                return None
+            raise
         except Exception as e:
-            self._quarantine[path] = repr(e)
+            with self._lock:
+                self._quarantine[path] = repr(e)
             if resilience.partial_allowed():
                 resilience.record_skip("fs.read_partition", path, e, phase=part)
                 return None
@@ -488,6 +512,22 @@ class FileSystemStorage:
     def quarantined(self) -> Dict[str, str]:
         """Quarantined file paths -> first failure (advisory copy)."""
         return dict(self._quarantine)
+
+    def clear_quarantine(self, path: Optional[str] = None) -> List[str]:
+        """Re-admit quarantined file(s) for reading: the operator re-read
+        path after a corrupt file is repaired/restored (``path=None``
+        clears everything). Returns the paths cleared. The next read
+        re-parses them — and re-quarantines on repeat failure."""
+        with self._lock:
+            if path is not None:
+                cleared = (
+                    [path] if self._quarantine.pop(path, None) is not None
+                    else []
+                )
+            else:
+                cleared = list(self._quarantine)
+                self._quarantine.clear()
+        return cleared
 
     def read(self, name: str, ecql: "str | ir.Filter" = "INCLUDE",
              columns: Optional[Sequence[str]] = None) -> pa.Table:

@@ -22,6 +22,7 @@ import json
 import os
 import shutil
 import tempfile
+import threading
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -133,6 +134,12 @@ class PartitionedFeatureStore(FeatureStore):
         )
         self._spill_dir = spill_dir or config.SPILL_DIR.get()
         self._owns_spill_dir = False
+        #: guards the partition map (partitions/spilled/_dirty/_snapshot
+        #: paths): the query pipeline's prefetch thread loads partition
+        #: i+1 while the query thread may evict after partition i
+        #: (planning/partitioned_exec.py). RLock: child() -> _load() ->
+        #: evict() nests.
+        self._part_lock = threading.RLock()
         self._shard_bucket = config.SHARD_LEN_BUCKET.to_int() or 1
         self._merged_stats = None
         self._merged_stats_version = -1
@@ -146,7 +153,8 @@ class PartitionedFeatureStore(FeatureStore):
         return self._spill_dir
 
     def partition_bins(self) -> List[int]:
-        return sorted(set(self.partitions) | set(self.spilled))
+        with self._part_lock:
+            return sorted(set(self.partitions) | set(self.spilled))
 
     def _new_child(self) -> FeatureStore:
         child = FeatureStore(self.ft, self.n_shards)
@@ -161,26 +169,28 @@ class PartitionedFeatureStore(FeatureStore):
 
     def child(self, b: int, create: bool = False) -> Optional[FeatureStore]:
         """Resident child for bin ``b``, loading from disk if spilled."""
-        st = self.partitions.get(b)
-        if st is not None:
-            self._touch(b)
+        with self._part_lock:
+            st = self.partitions.get(b)
+            if st is not None:
+                self._touch(b)
+                return st
+            if b in self.spilled:
+                return self._load(b)
+            if not create:
+                return None
+            st = self._new_child()
+            self.partitions[b] = st
+            self._dirty.add(b)
             return st
-        if b in self.spilled:
-            return self._load(b)
-        if not create:
-            return None
-        st = self._new_child()
-        self.partitions[b] = st
-        self._dirty.add(b)
-        return st
 
     def evict(self, keep: Optional[int] = None):
         """Spill least-recently-used residents down to ``keep`` (default the
         store's ``max_resident``)."""
         keep = self.max_resident if keep is None else keep
-        while len(self.partitions) > max(keep, 1):
-            b = next(iter(self.partitions))  # LRU head
-            self._spill(b)
+        with self._part_lock:
+            while len(self.partitions) > max(keep, 1):
+                b = next(iter(self.partitions))  # LRU head
+                self._spill(b)
 
     # -- spill format ------------------------------------------------------
     def _part_dir(self, b: int) -> str:

@@ -1,0 +1,173 @@
+"""Version-stable compiled-kernel registry + shape bucketing (warm path).
+
+GeoMesa's tablet-server iterators are compile-free; the TPU port instead
+pays an XLA trace+compile for every *new* jitted scan kernel. This module
+is the executor's warm-path substrate (docs/PERF.md):
+
+* :class:`KernelRegistry` — a bounded, thread-safe LRU of jitted kernels,
+  shared across time partitions of one store AND across aggregate-cache
+  cell queries (one registry per parent store / partitioned executor).
+  Entries evict one at a time, least-recently-used first — never the
+  clear-on-overflow wipe the per-site dicts used to do, which threw away
+  63 hot kernels to admit the 65th.
+* **version-stable keys** — kernel cache keys carry NO store version: the
+  compiled function is structure-only (shapes + predicate closure), so a
+  store mutation must not recompile anything. What CAN invalidate a
+  compiled closure is dictionary growth (string predicates bake resolved
+  codes at compile time): :func:`dict_fingerprint` captures exactly that.
+  Window *data* stays version-keyed in the executor's separate win caches.
+* **shape bucketing** — :func:`bucket_count` pads the per-shard window
+  count K to a power of two above a floor, so distinct-but-similar
+  queries land on one compiled shape (padded windows are empty and the
+  ``valid``/``counts`` masks keep results exact).
+* **persistent compile cache** — :func:`enable_persistent_cache` wires
+  ``jax_compilation_cache_dir`` behind ``geomesa.compile.cache.dir`` so
+  restarts start warm.
+
+Metrics (process registry): ``kernel.recompiles`` (fresh traces),
+``kernel.bucket_hit`` (registry hits), ``kernel.evict``.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Hashable, Optional, Tuple
+
+from geomesa_tpu import config, metrics
+
+#: metric names (declared in metrics.py with the exposition contract)
+KERNEL_RECOMPILES = metrics.KERNEL_RECOMPILES
+KERNEL_HIT = metrics.KERNEL_BUCKET_HIT
+KERNEL_EVICT = metrics.KERNEL_EVICT
+
+
+class KernelRegistry:
+    """Bounded LRU of compiled kernels, keyed by version-stable tuples.
+
+    The mapping protocol mirrors the plain dicts it replaces (``get`` /
+    ``put``) plus per-site trace accounting: ``key[0]`` (or, for tagged
+    keys, ``key[0][0]``) names the jit site, and :meth:`traces` reports
+    how many fresh compiles each site has paid — the recompile-regression
+    tests assert directly on it.
+    """
+
+    def __init__(self, capacity: Optional[int] = None):
+        self._entries: "OrderedDict[Hashable, Any]" = OrderedDict()
+        self._capacity = capacity
+        self._lock = threading.Lock()
+        #: site label -> fresh-trace count (puts, not hits)
+        self._traces: Dict[Any, int] = {}
+
+    def _cap(self) -> int:
+        if self._capacity is not None:
+            return self._capacity
+        return config.KERNEL_CACHE_SIZE.to_int() or 256
+
+    @staticmethod
+    def _site(key: Hashable) -> Any:
+        site = key[0] if isinstance(key, tuple) and key else key
+        if isinstance(site, tuple) and site:
+            site = site[0]
+        return site
+
+    def get(self, key: Hashable, default=None):
+        if key is None:
+            return default
+        with self._lock:
+            fn = self._entries.get(key)
+            if fn is None:
+                return default
+            self._entries.move_to_end(key)
+        metrics.inc(KERNEL_HIT)
+        return fn
+
+    def put(self, key: Hashable, fn) -> None:
+        """Admit one freshly-traced kernel, evicting LRU entries over
+        capacity (one at a time — the clear-on-overflow this replaces
+        wiped every hot kernel to admit one)."""
+        with self._lock:
+            self._entries[key] = fn
+            self._entries.move_to_end(key)
+            site = self._site(key)
+            self._traces[site] = self._traces.get(site, 0) + 1
+            evicted = 0
+            cap = max(self._cap(), 1)
+            while len(self._entries) > cap:
+                self._entries.popitem(last=False)
+                evicted += 1
+        metrics.inc(KERNEL_RECOMPILES)
+        if evicted:
+            metrics.inc(KERNEL_EVICT, evicted)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def traces(self, site=None):
+        """Fresh-compile count per jit site (or one site's count)."""
+        with self._lock:
+            if site is not None:
+                return self._traces.get(site, 0)
+            return dict(self._traces)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+
+
+def dict_fingerprint(dicts: Dict[str, Any]) -> Tuple:
+    """Compiled-predicate validity fingerprint: string predicates resolve
+    dictionary codes at compile time, and dictionaries are append-only, so
+    per-encoder vocabulary *length* captures every growth that could change
+    a compiled closure. Mutations that don't grow a vocabulary (inserts of
+    known strings, numeric updates, deletes) leave it unchanged — the
+    warm-path guarantee that a store mutation never forces a recompile."""
+    return tuple(sorted((k, len(d.values)) for k, d in dicts.items()))
+
+
+def bucket_count(n: int) -> int:
+    """Pad a per-shard window count to its shape bucket: the next power of
+    two, floored at ``geomesa.compact.bucket.floor``. Identity when
+    ``geomesa.compact.bucketing`` is off (old behavior: exact pow2)."""
+    if n <= 1:
+        n = 1
+    else:
+        n = 1 << (n - 1).bit_length()
+    if not config.COMPACT_BUCKETING.to_bool():
+        return n
+    floor = config.COMPACT_BUCKET_FLOOR.to_int()
+    floor = 8 if floor is None else max(floor, 1)
+    return max(n, floor)
+
+
+_persistent_cache_done = [False]
+_persistent_cache_lock = threading.Lock()
+
+
+def enable_persistent_cache() -> Optional[str]:
+    """Point JAX's persistent compilation cache at
+    ``geomesa.compile.cache.dir`` (idempotent; no-op when unset). With it
+    set, process restarts reuse compiled XLA executables from disk — the
+    cold-start twin of the in-process registry above. Returns the dir in
+    effect (None = disabled)."""
+    d = config.COMPILE_CACHE_DIR.get()
+    if not d:
+        return None
+    with _persistent_cache_lock:
+        if _persistent_cache_done[0]:
+            return d
+        import jax
+
+        try:
+            jax.config.update("jax_compilation_cache_dir", d)
+            # persist everything: scan kernels compile fast but re-trace
+            # often; the default min-compile-time gate would skip them
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        except AttributeError:
+            # older jax without these knobs: directory option alone still
+            # enables the cache where supported
+            pass
+        _persistent_cache_done[0] = True
+    return d

@@ -167,6 +167,19 @@ def inc(name: str, n: int = 1) -> None:
 #   cache.bytes        resident cached bytes (gauge)
 #   cache.entries      resident entry count (gauge)
 CACHE_HIT = "cache.hit"
+# Warm-path executor metrics (kernels/registry.py, planning/executor.py,
+# planning/partitioned_exec.py; docs/PERF.md):
+#   kernel.recompiles   fresh jit traces admitted to the kernel registry
+#                       (each one paid an XLA trace+compile)
+#   kernel.bucket_hit   kernel registry hits — a query served by an
+#                       already-compiled kernel (shape bucket + key match)
+#   kernel.evict        LRU evictions from the kernel registry
+#   pipeline.prefetch   partitions whose host load/column assembly was
+#                       overlapped with the previous partition's execution
+KERNEL_RECOMPILES = "kernel.recompiles"
+KERNEL_BUCKET_HIT = "kernel.bucket_hit"
+KERNEL_EVICT = "kernel.evict"
+PIPELINE_PREFETCH = "pipeline.prefetch"
 CACHE_PARTIAL = "cache.partial"
 CACHE_MISS = "cache.miss"
 CACHE_PUT = "cache.put"

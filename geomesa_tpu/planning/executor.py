@@ -24,6 +24,9 @@ from geomesa_tpu.kernels import density as kdensity
 from geomesa_tpu.kernels import knn as kknn
 from geomesa_tpu.kernels import masks as kmasks
 from geomesa_tpu.kernels import stats_scan as kstats
+from geomesa_tpu.kernels.registry import (
+    KernelRegistry, dict_fingerprint, enable_persistent_cache,
+)
 from geomesa_tpu.planning.planner import QueryPlan
 from geomesa_tpu.schema.columns import ColumnBatch
 from geomesa_tpu.stats import sketches as sk
@@ -86,16 +89,47 @@ class Executor:
         self.store = store
         self.mesh = mesh
         self.prefer_device = prefer_device
-        #: jitted-kernel cache shared ACROSS stores (time partitions of one
+        #: jitted-kernel LRU shared ACROSS stores (time partitions of one
         #: parent store execute the same plan: one trace/compile, many tables)
         self.kernel_fns = kernel_fns
-        #: object whose ``.version`` keys kernel caches (the parent store for
-        #: partition children — any partition mutation bumps it)
+        #: object hosting the shared kernel registry and version-keyed host
+        #: caches (the parent store for partition children). Kernel KEYS are
+        #: version-stable (a mutation never recompiles — docs/PERF.md);
+        #: window/verdict DATA caches stay keyed by ``.version``.
         self.version_source = version_source or store
+        enable_persistent_cache()  # geomesa.compile.cache.dir (idempotent)
 
     # -- helpers -----------------------------------------------------------
     def _table(self, plan: QueryPlan) -> IndexTable:
         return self.store.tables[plan.index_name]
+
+    def kernel_registry(self) -> KernelRegistry:
+        """The shared compiled-kernel LRU: one per parent store, shared by
+        every partition child and every aggregate-cache cell query (the
+        ROADMAP per-cell kernel-token item)."""
+        if self.kernel_fns is not None:
+            return self.kernel_fns
+        reg = self.version_source.__dict__.get("_kernel_registry")
+        if reg is None:
+            reg = KernelRegistry()
+            self.version_source.__dict__["_kernel_registry"] = reg
+        return reg
+
+    @staticmethod
+    def _plan_registry(plan: QueryPlan) -> KernelRegistry:
+        """Token-less (raw-IR) plans cache kernels on the plan itself —
+        still LRU-managed so pagination/benchmark loops never hit the old
+        clear-on-overflow wipe."""
+        reg = plan.__dict__.get("_kernel_fns")
+        if reg is None:
+            reg = plan.__dict__["_kernel_fns"] = KernelRegistry()
+        return reg
+
+    def _dict_fp(self):
+        """Dictionary-growth fingerprint: the ONLY store change that can
+        invalidate a compiled predicate closure (string codes are resolved
+        at compile time). Replaces the store version in kernel keys."""
+        return dict_fingerprint(self.store.dicts)
 
     def _scan_setup(self, plan: QueryPlan, extra_cols=()):
         """Resolve windows + choose device/host path. Returns a dict bundle."""
@@ -108,7 +142,9 @@ class Executor:
         # (cache_token) — skips the per-shard searchsorted sweep, which at
         # 20M rows costs ~90 ms/query, dwarfing the device kernel it feeds.
         rkey = ("win", self.store.uid, self.store.version, plan.index_name,
-                plan.__dict__.get("window_token"))
+                plan.__dict__.get("window_token"),
+                config.COMPACT_BUCKETING.to_bool(),
+                config.COMPACT_BUCKET_FLOOR.to_int())
         cache, rkey = self._resolve_cache(plan, rkey)
         hit = cache.get(rkey)
         if hit is not None:
@@ -202,6 +238,9 @@ class Executor:
             np.maximum(ends - starts, 0).sum()
         )
         plan.__dict__["table_rows"] = int(table.n)
+        # the partition prefetcher stages exactly this column set for the
+        # NEXT partition while this one executes (partitioned_exec.py)
+        plan.__dict__["needed_cols"] = tuple(needed)
         return {
             "table": table, "starts": starts, "ends": ends, "counts": counts,
             "L": L, "needed": needed, "use_device": use_device,
@@ -464,15 +503,11 @@ class Executor:
         dev_cols = table.device_columns(names, self._sharding())
         token = plan.__dict__.get("cache_token")
         if token is not None and cache_key is not None:
-            fn_cache = (
-                self.kernel_fns
-                if self.kernel_fns is not None
-                else self.version_source.__dict__.setdefault("_kernel_fns", {})
-            )
+            fn_cache = self.kernel_registry()
             fn_key = ("compact_mesh", cache_key, B, Cp, D, token,
-                      plan.index_name, self.version_source.version)
+                      plan.index_name, self._dict_fp())
         else:
-            fn_cache = plan.__dict__.setdefault("_kernel_fns", {})
+            fn_cache = self._plan_registry(plan)
             fn_key = ("compact_mesh", cache_key, B, Cp, D)
         go = fn_cache.get(fn_key)
         if go is None:
@@ -504,9 +539,7 @@ class Executor:
                 out_specs=P(),
             )
             go = jax.jit(sm)
-            if len(fn_cache) >= 64:
-                fn_cache.clear()
-            fn_cache[fn_key] = go
+            fn_cache.put(fn_key, go)
         wcache = self.store.__dict__.setdefault("_win_cache", {})
         wkey = ("mesh_win", d["whash"], B, Cp, D, self.store.uid,
                 self.store.version)
@@ -552,7 +585,9 @@ class Executor:
         if cover <= (config.SCAN_RANGES_TARGET.to_int() or 2000):
             return None, None
         rkey = ("fine", cover, self.store.uid, self.store.version,
-                plan.index_name, plan.__dict__.get("window_token"))
+                plan.index_name, plan.__dict__.get("window_token"),
+                config.COMPACT_BUCKETING.to_bool(),
+                config.COMPACT_BUCKET_FLOOR.to_int())
         cache, rkey = self._resolve_cache(plan, rkey)
         hit = cache.get(rkey)
         if hit is not None:
@@ -629,18 +664,16 @@ class Executor:
         fn_cache = fn_key = None
         if cache_key is not None:
             if token is not None:
-                fn_cache = (
-                    self.kernel_fns
-                    if self.kernel_fns is not None
-                    else self.version_source.__dict__.setdefault("_kernel_fns", {})
-                )
+                fn_cache = self.kernel_registry()
+                # sb_vocab is baked static below: it belongs in the key now
+                # that the store version no longer stands in for it
                 fn_key = ("compact", cache_key, B, Cp, sampling, sample_by,
-                          sb_mode, sb_off, sb_buckets, token, plan.index_name,
-                          self.version_source.version)
+                          sb_mode, sb_off, sb_vocab, sb_buckets, token,
+                          plan.index_name, self._dict_fp())
             else:
-                fn_cache = plan.__dict__.setdefault("_kernel_fns", {})
+                fn_cache = self._plan_registry(plan)
                 fn_key = ("compact", cache_key, B, Cp, sampling, sample_by,
-                          sb_mode, sb_off, sb_buckets)
+                          sb_mode, sb_off, sb_vocab, sb_buckets)
         go = fn_cache.get(fn_key) if fn_cache is not None else None
         if go is None:
 
@@ -665,9 +698,10 @@ class Executor:
                 return agg_fn(cols, m, jnp, *extra)
 
             if fn_cache is not None:
-                if len(fn_cache) >= 64:
-                    fn_cache.clear()
-                fn_cache[fn_key] = go
+                fn_cache.put(fn_key, go)
+                self._note(plan, kernel="trace")
+        elif fn_cache is not None:
+            self._note(plan, kernel="hit")
         wcache = self.store.__dict__.setdefault("_win_cache", {})
         wkey = ("compact_win", d["whash"], B, Cp, self.store.uid,
                 self.store.version)
@@ -950,11 +984,13 @@ class Executor:
 
         # Two caches with different lifetimes:
         # 1. the jitted kernel — reusable across API calls (same predicate
-        #    text + auths, via cache_token) AND across time-partition tables
-        #    of one store (same plan, same shapes). Keyed by the version of
-        #    `version_source` (the parent store for partition children) so a
-        #    predicate recompiled under grown dictionaries never reuses a
-        #    stale closure.
+        #    text + auths, via cache_token), across time-partition tables
+        #    of one store (same plan, same bucketed shapes), and across
+        #    aggregate-cache cell queries. Keys are VERSION-STABLE: the
+        #    compiled closure depends only on structure (shapes, predicate,
+        #    sampling mode) plus the dictionary fingerprint (string codes
+        #    are baked at compile time), so a store mutation never forces a
+        #    recompile.
         # 2. the device-resident window arrays — strictly per (store,
         #    version): windows differ per partition and per mutation.
         token = plan.__dict__.get("cache_token")
@@ -962,18 +998,15 @@ class Executor:
         if cache_key is not None:
             K = setup["starts"].shape[1]
             if token is not None:
-                fn_cache = (
-                    self.kernel_fns
-                    if self.kernel_fns is not None
-                    else self.version_source.__dict__.setdefault("_kernel_fns", {})
-                )
+                fn_cache = self.kernel_registry()
                 fn_key = (cache_key, L, K, sampling, sample_by, sb_mode,
-                          sb_off, sb_buckets, token, plan.index_name,
-                          self.version_source.version)
+                          sb_off, sb_vocab, sb_buckets, token,
+                          plan.index_name, self._dict_fp())
             else:  # raw-IR plan: cache on the plan (shared across partitions)
-                fn_cache = plan.__dict__.setdefault("_kernel_fns", {})
+                fn_cache = self._plan_registry(plan)
                 fn_key = (cache_key, L, K, sampling, sample_by, sb_mode,
-                          sb_off, sb_buckets)
+                          sb_off, sb_vocab, sb_buckets)
+            self._note(plan, shape_bucket=(L, K))
         go = fn_cache.get(fn_key) if fn_cache is not None else None
         if go is None:
 
@@ -1001,9 +1034,10 @@ class Executor:
                 return agg_fn(cols, m, jnp, *extra)
 
             if fn_cache is not None:
-                if len(fn_cache) >= 64:  # bound compiled-kernel growth
-                    fn_cache.clear()
-                fn_cache[fn_key] = go
+                fn_cache.put(fn_key, go)
+                self._note(plan, kernel="trace")
+        elif fn_cache is not None:
+            self._note(plan, kernel="hit")
         # pre-placed window arrays: repeated same-plan runs (pagination,
         # benchmarks) shouldn't re-upload per call — host link latency can
         # dwarf the kernel. Unlike the jitted fn, window DATA is plan- and
@@ -1095,11 +1129,11 @@ class Executor:
         L = setup["L"]
         token = plan.__dict__.get("cache_token")
         if token is not None and cache_key is not None:
-            cache = self.store.__dict__.setdefault("_kernel_cache", {})
+            cache = self.kernel_registry()
             key = ("binspace", cache_key, L, starts.shape[1], stream, token,
-                   plan.index_name, self.store.version)
+                   plan.index_name, self._dict_fp())
         else:  # token-less plan: cache on the plan (pagination, benchmarks)
-            cache = plan.__dict__.setdefault("_kernel_cache", {})
+            cache = self._plan_registry(plan)
             key = ("binspace", cache_key, L, starts.shape[1], stream)
         fn = cache.get(key)
         if fn is None:
@@ -1116,9 +1150,7 @@ class Executor:
             fn = binspace.build_bin_parallel(
                 mesh, sorted(dev_cols), L, predicate, agg_fn, stream
             )
-            if len(cache) >= 64:
-                cache.clear()
-            cache[key] = fn
+            cache.put(key, fn)
         return fn(
             {k: dev_cols[k] for k in sorted(dev_cols)},
             jax.device_put(starts.astype(np.int32), win_sh),

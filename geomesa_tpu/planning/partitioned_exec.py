@@ -11,13 +11,16 @@ bucketed in IndexTable.shard_len / windows)."""
 
 from __future__ import annotations
 
+import queue
+import threading
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from geomesa_tpu import resilience
+from geomesa_tpu import config, metrics, resilience
 from geomesa_tpu.filter import ir
 from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+from geomesa_tpu.kernels.registry import KernelRegistry
 from geomesa_tpu.planning.executor import Executor, check_deadline
 from geomesa_tpu.planning.planner import QueryPlan
 from geomesa_tpu.resilience import QueryTimeoutError
@@ -33,9 +36,13 @@ class PartitionedExecutor:
         self.store = store
         self.mesh = mesh
         self.prefer_device = prefer_device
-        #: jitted kernels shared across every partition child
-        self._kernel_fns: Dict = {}
+        #: jitted-kernel LRU shared across every partition child AND every
+        #: aggregate-cache cell query (version-stable keys — docs/PERF.md)
+        self._kernel_fns = KernelRegistry()
         self._execs: Dict[int, Executor] = {}
+
+    def kernel_registry(self) -> KernelRegistry:
+        return self._kernel_fns
 
     # -- partition pruning (the TimePartition.partitions() analog) ---------
     def prune(self, plan: QueryPlan) -> List[int]:
@@ -73,14 +80,108 @@ class PartitionedExecutor:
             self._execs[b] = ex
         return ex
 
+    # -- double-buffered partition pipeline --------------------------------
+    def _stage(self, child, plan: QueryPlan) -> None:
+        """Prefetch-thread half of the double buffer: pull the partition's
+        columns off disk (lazy snapshot members) and assemble the stacked
+        [S, L] HOST arrays the device upload will consume. Pure host work —
+        no jax calls, so all compile/dispatch stays on the query thread
+        (the PR 1 one-query-thread jit discipline)."""
+        names = plan.__dict__.get("needed_cols")
+        if child is None or not names:
+            return
+        t = child.tables.get(plan.index_name)
+        if t is not None and t.n:
+            t.stage_host(names)
+            metrics.inc(metrics.PIPELINE_PREFETCH)
+
+    def _children(self, plan: QueryPlan):
+        """(bin, child) over pruned partitions. With
+        ``geomesa.pipeline.prefetch`` (default on), partition i+1's host
+        load/column assembly overlaps partition i's device execution on a
+        single prefetch thread, bounded to ONE in-flight partition (the
+        consumer grants each load). Load errors re-raise on the query
+        thread at the same point they would have sequentially; order and
+        merge semantics are unchanged, so results stay bit-identical."""
+        bins = self.prune(plan)
+        if len(bins) < 2 or not config.PIPELINE_PREFETCH.to_bool():
+            for b in bins:
+                yield b, self.store.child(b)
+            return
+        out: "queue.Queue" = queue.Queue()
+        stop = threading.Event()
+        slot = threading.Semaphore(0)  # one permit per granted load
+        # config overrides are thread-local: the worker must resolve every
+        # property (bucketed shard length above all) exactly as the query
+        # thread does, or staged (name, L) keys would silently mismatch
+        ov = config.snapshot_overrides()
+
+        def worker():
+            config.adopt_overrides(ov)
+            try:
+                for b in bins:
+                    while not slot.acquire(timeout=0.1):
+                        if stop.is_set():
+                            return
+                    if stop.is_set():
+                        return
+                    try:
+                        child = self.store.child(b)
+                        self._stage(child, plan)
+                    except BaseException as e:
+                        out.put((b, None, e))
+                    else:
+                        out.put((b, child, None))
+            finally:
+                out.put(None)
+
+        t = threading.Thread(
+            target=worker, name="geomesa-part-prefetch", daemon=True
+        )
+        t.start()
+        slot.release()  # the first load starts immediately
+        try:
+            while True:
+                item = out.get()
+                if item is None:
+                    return
+                # grant the NEXT load now: it overlaps this partition's
+                # execution — exactly one partition ever in flight
+                slot.release()
+                b, child, err = item
+                if err is not None:
+                    raise err
+                yield b, child
+        finally:
+            stop.set()
+            # JOIN, not fire-and-forget: an early consumer exit
+            # (max_features, deadline) must not leave the worker mutating
+            # the partition map under a follow-up query's unlocked readers
+            # (partition_bins, flush loops). The wait is bounded by the
+            # one in-flight load (worker observes `stop` right after it).
+            t.join()
+            # free staged host arrays of prefetched-but-never-executed
+            # partitions (their loop-body cleanup never ran)
+            while True:
+                try:
+                    item = out.get_nowait()
+                except queue.Empty:
+                    break
+                if item is None:
+                    continue
+                _, child, _ = item
+                if child is not None:
+                    tb = child.tables.get(plan.index_name)
+                    if tb is not None:
+                        tb._host_stage.clear()
+
     def _each(self, plan: QueryPlan) -> Iterator[Tuple[int, Executor]]:
         """Stream (bin, executor) over pruned partitions under the residency
         budget; accumulates the selectivity counters across partitions."""
         tot_scanned = tot_rows = 0
         try:
-            for b in self.prune(plan):
+            for b, child in self._children(plan):
                 check_deadline()
-                child = self.store.child(b)
                 if child is None or child.count == 0:
                     continue
                 plan.__dict__.pop("scanned_rows", None)
@@ -88,6 +189,12 @@ class PartitionedExecutor:
                 yield b, self._executor_for(b, child)
                 tot_scanned += plan.__dict__.pop("scanned_rows", 0)
                 tot_rows += plan.__dict__.pop("table_rows", 0)
+                # free staged host arrays the scan didn't consume (host
+                # path, projection change): staging is per-partition-pass,
+                # never a resident duplicate of the device columns
+                t = child.tables.get(plan.index_name)
+                if t is not None:
+                    t._host_stage.clear()
                 self.store.evict()
                 resident = self.store.partitions
                 for bb in list(self._execs):

@@ -260,9 +260,12 @@ def check_deadline(what: str = "query") -> None:
 
 class CircuitBreaker:
     """Count-based breaker: ``threshold`` consecutive failures open the
-    circuit; after ``reset_ms`` one trial call is admitted (half-open) —
-    success closes, failure re-opens. ``clock`` is injectable so tests
-    advance time deterministically."""
+    circuit; after ``reset_ms`` ONE trial call is admitted (half-open) —
+    success closes, failure re-opens. While that single trial is in
+    flight, every other caller is fenced with :class:`CircuitOpenError`:
+    a half-open breaker must probe the callee with one request, not a
+    thundering herd of them. ``clock`` is injectable so tests advance
+    time deterministically."""
 
     CLOSED, OPEN, HALF_OPEN = "closed", "open", "half-open"
 
@@ -281,6 +284,9 @@ class CircuitBreaker:
         self._failures = 0
         self._state = self.CLOSED
         self._opened_at = 0.0
+        self._trial_in_flight = False
+        self._trial_started = 0.0
+        self._trial_thread: Optional[int] = None
 
     @property
     def state(self) -> str:
@@ -296,26 +302,61 @@ class CircuitBreaker:
 
     def allow(self) -> None:
         """Raise :class:`CircuitOpenError` unless a call may proceed.
-        In half-open, admits the caller as the trial request."""
+        In half-open, admits ONE caller as the trial request; concurrent
+        callers are fenced until the trial resolves (or, if the trial
+        never reports back — caller died mid-call — until a full reset
+        window has elapsed since it started, when a new trial is
+        admitted so the breaker cannot wedge half-open forever)."""
         with self._lock:
             st = self._effective_state()
             if st == self.OPEN:
                 rem = self.reset_ms / 1000.0 - (self.clock() - self._opened_at)
                 raise CircuitOpenError(self.name, max(rem, 0.0))
             if st == self.HALF_OPEN:
+                if self._trial_in_flight:
+                    stale = (
+                        (self.clock() - self._trial_started) * 1000.0
+                        >= self.reset_ms
+                    )
+                    if not stale:
+                        rem = (
+                            self.reset_ms / 1000.0
+                            - (self.clock() - self._trial_started)
+                        )
+                        raise CircuitOpenError(self.name, max(rem, 0.0))
                 self._state = self.HALF_OPEN
+                self._trial_in_flight = True
+                self._trial_started = self.clock()
+                self._trial_thread = threading.get_ident()
 
     def record_success(self) -> None:
         with self._lock:
+            if (
+                self._state == self.HALF_OPEN
+                and self._trial_in_flight
+                and self._trial_thread is not None
+                and threading.get_ident() != self._trial_thread
+            ):
+                # a SUPERSEDED trial (slow caller outlived its staleness
+                # window; a fresher trial is probing now) reporting back
+                # late: its success must not close the circuit over the
+                # live trial's head — the live trial's own report decides
+                return
             self._failures = 0
             self._state = self.CLOSED
+            self._trial_in_flight = False
+            self._trial_thread = None
 
     def record_failure(self) -> None:
+        # failures count from ANY caller, including a superseded trial —
+        # a failure signal from the callee is always valid evidence
         with self._lock:
             self._failures += 1
             if self._state == self.HALF_OPEN or self._failures >= self.threshold:
                 self._state = self.OPEN
                 self._opened_at = self.clock()
+            self._trial_in_flight = False
+            self._trial_thread = None
 
 
 _breakers: Dict[str, CircuitBreaker] = {}

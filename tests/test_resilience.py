@@ -142,6 +142,81 @@ def test_circuit_breaker_states():
     assert b.state == CircuitBreaker.CLOSED
 
 
+def test_circuit_breaker_half_open_admits_one_trial():
+    # ROADMAP open item: half-open must probe with ONE in-flight trial,
+    # not admit every concurrent caller
+    clock = [0.0]
+    b = CircuitBreaker("t1", threshold=1, reset_ms=1000, clock=lambda: clock[0])
+    b.record_failure()
+    clock[0] = 1.5
+    assert b.state == CircuitBreaker.HALF_OPEN
+    b.allow()  # the trial
+    # a second caller while the trial is in flight is fenced
+    with pytest.raises(CircuitOpenError):
+        b.allow()
+    # trial succeeds -> closed -> everyone admitted again
+    b.record_success()
+    assert b.state == CircuitBreaker.CLOSED
+    b.allow()
+    b.allow()
+
+
+def test_circuit_breaker_half_open_trial_failure_reopens_and_refences():
+    clock = [0.0]
+    b = CircuitBreaker("t2", threshold=1, reset_ms=1000, clock=lambda: clock[0])
+    b.record_failure()
+    clock[0] = 1.5
+    b.allow()  # trial admitted
+    b.record_failure()  # trial failed: re-open
+    with pytest.raises(CircuitOpenError):
+        b.allow()
+    # next window: a NEW single trial is admitted
+    clock[0] = 3.0
+    b.allow()
+    with pytest.raises(CircuitOpenError):
+        b.allow()
+    b.record_success()
+
+
+def test_circuit_breaker_superseded_trial_success_does_not_close():
+    # a slow trial outlives its staleness window; a fresher trial is
+    # admitted. The stale trial's LATE success (different thread) must
+    # not close the circuit over the live trial's head; the live trial's
+    # own report decides.
+    import threading as _threading
+
+    clock = [0.0]
+    b = CircuitBreaker("t4", threshold=1, reset_ms=1000, clock=lambda: clock[0])
+    b.record_failure()
+    clock[0] = 1.5
+    t = _threading.Thread(target=b.allow)  # trial 1, on its own thread
+    t.start(); t.join()
+    clock[0] = 2.6
+    b.allow()  # trial 2 supersedes (this thread)
+    t = _threading.Thread(target=b.record_success)  # trial 1's late report
+    t.start(); t.join()
+    assert b.state == CircuitBreaker.HALF_OPEN  # NOT closed
+    b.record_success()  # the live trial decides
+    assert b.state == CircuitBreaker.CLOSED
+
+
+def test_circuit_breaker_stuck_trial_does_not_wedge_half_open():
+    # a trial whose caller died without recording an outcome must not
+    # fence the breaker forever: after a full reset window a new trial
+    # is admitted
+    clock = [0.0]
+    b = CircuitBreaker("t3", threshold=1, reset_ms=1000, clock=lambda: clock[0])
+    b.record_failure()
+    clock[0] = 1.5
+    b.allow()  # trial never reports back
+    with pytest.raises(CircuitOpenError):
+        b.allow()
+    clock[0] = 2.6  # >= reset_ms past the stuck trial's start
+    b.allow()
+    b.record_success()
+    assert b.state == CircuitBreaker.CLOSED
+
+
 # ---------------------------------------------------------------------------
 # fault injection plumbing
 # ---------------------------------------------------------------------------
@@ -370,6 +445,54 @@ def test_every_file_corrupt_degrades_to_empty_not_error(fs_store):
     pr = fs_store.read_partial("t")
     assert pr.degraded and pr.ok_parts == 0
     assert pr.value.num_rows == 0  # typed empty survivor set, not a crash
+
+
+def test_transient_oserror_retries_in_place(fs_store):
+    # an NFS blip (OSError) heals within one read via RetryPolicy: two
+    # injected failures, the third attempt succeeds — nothing quarantined
+    with config.FAULT_INJECTION.scoped("true"), \
+            config.RETRY_BASE_MS.scoped("0"):
+        with inject_faults(seed=0) as inj:
+            inj.fail("fs.read_partition", OSError("stale NFS handle"),
+                     times=2)
+            assert fs_store.read("t").num_rows == 2000
+    assert not fs_store.quarantined()
+
+
+def test_transient_oserror_never_quarantines_partition(fs_store):
+    # retries exhausted: the read fails (strict) or degrades (partial),
+    # but the file is NOT quarantined — the next read re-attempts it, so
+    # one blip cannot lose the partition until restart (ROADMAP item)
+    with config.FAULT_INJECTION.scoped("true"), \
+            config.RETRY_BASE_MS.scoped("0"), \
+            config.RETRY_ATTEMPTS.scoped("1"):
+        with inject_faults(seed=0) as inj:
+            inj.fail("fs.read_partition", OSError("EIO"), times=None)
+            with pytest.raises(OSError):
+                fs_store.read("t")
+            with config.SCAN_PARTIAL.scoped("true"):
+                assert fs_store.read("t").num_rows < 2000
+    assert not fs_store.quarantined()
+    # the blip passed (injector gone): full data is back, no restart needed
+    assert fs_store.read("t").num_rows == 2000
+
+
+def test_clear_quarantine_readmits_repaired_file(fs_store):
+    files = sorted(glob.glob(os.path.join(
+        fs_store.root, "t", "data", "**", "*.parquet"), recursive=True))
+    good = open(files[0], "rb").read()
+    bad = _corrupt_one_file(fs_store)
+    with config.SCAN_PARTIAL.scoped("true"):
+        assert fs_store.read("t").num_rows < 2000
+    assert bad in fs_store.quarantined()
+    # operator repairs the file, then re-admits it
+    with open(bad, "wb") as fh:
+        fh.write(good)
+    assert fs_store.clear_quarantine(bad) == [bad]
+    assert not fs_store.quarantined()
+    assert fs_store.read("t").num_rows == 2000
+    # clearing an unknown path is a no-op
+    assert fs_store.clear_quarantine("/nope") == []
 
 
 def test_metadata_save_is_atomic(fs_store, monkeypatch):
