@@ -332,6 +332,30 @@ def main():
         for _ in range(5)
     )
     warm_requery_ms = warm[len(warm) // 2] * 1e3
+
+    # Tracing overhead (docs/OBSERVABILITY.md): the SAME warm requery with
+    # span tracing enabled vs the untraced p50 above. The disabled span API
+    # must be a no-op (the ci.yml smoke gate holds trace_overhead_pct of
+    # the ENABLED path under 5% — the disabled path rides inside
+    # warm_requery_ms itself, so any disabled-path regression shows there).
+    from geomesa_tpu import config as _tcfg
+
+    with _tcfg.TRACE_ENABLED.scoped("true"):
+        ds.density("gdelt", ecql, bbox=bbox, width=W, height=H)  # warm trace
+        traced = sorted(
+            _timed(lambda: ds.density("gdelt", ecql, bbox=bbox,
+                                      width=W, height=H))
+            for _ in range(5)
+        )
+    traced_ms = traced[len(traced) // 2] * 1e3
+    trace_overhead_pct = (
+        (traced_ms - warm_requery_ms) / warm_requery_ms * 100.0
+        if warm_requery_ms > 0 else 0.0
+    )
+    sys.stderr.write(
+        f"tracing: warm traced p50={traced_ms:.1f}ms vs untraced "
+        f"{warm_requery_ms:.1f}ms ({trace_overhead_pct:+.1f}%)\n"
+    )
     variants = [pan_ecql(dx) for dx in (0.0, 0.5, 1.0, 1.5)]
     for v in variants:  # warmup: at most one trace per distinct filter
         ds.count("gdelt", v)
@@ -378,6 +402,30 @@ def main():
             f"pan={cnt_pan*1e3:.1f}ms\n"
         )
 
+    # Observability snapshot (docs/OBSERVABILITY.md): the perf trajectory
+    # carries the registry's warm-path/cache/pipeline counters and the
+    # query-stage latency distribution, so a regression in ANY of them is
+    # visible in the BENCH_*.json history without re-running anything.
+    _report = _metrics.registry().report()
+
+    def _metric(name, default=0):
+        v = _report.get(name, default)
+        return round(v, 4) if isinstance(v, float) else v
+
+    _scan_hist = _metrics.registry().timer("query.density").hist
+    metrics_snapshot = {
+        "kernel_recompiles": _metric("kernel.recompiles"),
+        "kernel_bucket_hit": _metric("kernel.bucket_hit"),
+        "kernel_evict": _metric("kernel.evict"),
+        "kernel_recompile_alerts": _metric("kernel.recompile.alerts"),
+        "pipeline_prefetch": _metric("pipeline.prefetch"),
+        "cache_hit": _metric("cache.hit"),
+        "cache_partial": _metric("cache.partial"),
+        "cache_miss": _metric("cache.miss"),
+        "density_p50_ms": round(_scan_hist.quantile(0.5) * 1e3, 3),
+        "density_p99_ms": round(_scan_hist.quantile(0.99) * 1e3, 3),
+    }
+
     feats_per_sec = n / dev_s
     speedup = cpu_s / dev_s
     scanned = int(plan.__dict__.get("scanned_rows", 0))
@@ -403,6 +451,8 @@ def main():
         "ingest_s": round(ingest_s, 1),
         "warm_requery_ms": round(warm_requery_ms, 2),
         "recompiles_per_100_queries": round(recompiles_per_100, 1),
+        "trace_overhead_pct": round(trace_overhead_pct, 2),
+        "metrics": metrics_snapshot,
         **cache_keys,
         **annotations,
     }))

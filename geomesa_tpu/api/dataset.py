@@ -13,6 +13,7 @@ pushdown scans.
 
 from __future__ import annotations
 
+import functools
 import json
 import os
 import shutil
@@ -22,7 +23,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from geomesa_tpu import config, metrics, security
+from geomesa_tpu import config, metrics, security, tracing
 from geomesa_tpu.audit import AuditWriter
 from geomesa_tpu.cache import AggregateCache
 from geomesa_tpu.filter import ir, parse_ecql
@@ -117,6 +118,23 @@ class FeatureCollection:
             d[geom + "_x"], d[geom + "_y"] = list(xs), list(ys)
             del d[geom]
         return pd.DataFrame(d)
+
+
+def _traced(op: str):
+    """Open one ROOT span per public query operation (docs/OBSERVABILITY.md).
+    No-op singleton when ``geomesa.trace.enabled`` is off; when on, every
+    stage span below (plan, cache cells, partitions, device_put, kernel,
+    sync) nests under this root and the trace_id lands in the audit event."""
+
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(self, name, *args, **kw):
+            with tracing.start(op, schema=name):
+                return fn(self, name, *args, **kw)
+
+        return wrapper
+
+    return deco
 
 
 class GeoDataset:
@@ -369,6 +387,16 @@ class GeoDataset:
         plan.compiled = self._vis_wrap(st, plan.compiled, auths)
 
     def _plan(self, name: str, query: "str | Query", explain=None):
+        from geomesa_tpu.kernels import registry as kreg
+
+        # per-query recompile window: a jit site tracing more than
+        # geomesa.kernel.alert.threshold times before the next query trips
+        # the kernel.recompile.alert gauge (docs/OBSERVABILITY.md)
+        kreg.begin_query_window()
+        with tracing.span("plan"):
+            return self._plan_inner(name, query, explain)
+
+    def _plan_inner(self, name: str, query: "str | Query", explain=None):
         st = self._store(name)
         st.flush()
         q = Query(ecql=query) if isinstance(query, str) else query
@@ -433,6 +461,12 @@ class GeoDataset:
                op: str = "query"):
         hints = {"op": op, "index": plan.index_name,
                  "max_features": q.max_features, "sampling": q.sampling}
+        # the span tree and the audit event meet on this id: operators go
+        # from a slow QueryEvent straight to its trace (and, for sidecar
+        # queries, from the server audit back to the client's root span)
+        tid = tracing.current_trace_id()
+        if tid is not None:
+            hints["trace_id"] = tid
         path = plan.__dict__.get("exec_path")
         if path:
             hints["exec_path"] = {
@@ -460,6 +494,7 @@ class GeoDataset:
             table_rows=plan.__dict__.get("table_rows", 0),
         )
 
+    @_traced("explain")
     def explain(self, name: str, query: "str | Query",
                 analyze: bool = False) -> str:
         """Planner explain tree. ``analyze=True`` additionally resolves the
@@ -502,10 +537,41 @@ class GeoDataset:
                 f"{len(reg)} compiled kernels, "
                 f"{sum(tr.values())} traces to date",
             )
+            if tr:
+                per_site = ", ".join(
+                    f"{site}={n}" for site, n in sorted(
+                        tr.items(), key=lambda kv: -kv[1]
+                    )[:8]
+                )
+                exp.kv("traces by site", per_site)
+        # per-site recompile alert posture (docs/OBSERVABILITY.md): the
+        # same signal /metrics exposes as kernel.recompile.alert
+        from geomesa_tpu.kernels import registry as kreg
+
+        thr = kreg.alert_threshold()
+        qw = kreg.query_recompiles()
+        over = {s: n for s, n in qw.items() if n > thr}
+        exp.kv(
+            "recompile alert",
+            (f"TRIPPED ({', '.join(f'{s}={n}' for s, n in sorted(over.items()))})"
+             if over else f"clear (threshold {thr}/query)"),
+        )
         exp.kv("prefetch pipeline",
                bool(config.PIPELINE_PREFETCH.to_bool()))
         exp.kv("persistent compile cache",
                config.COMPILE_CACHE_DIR.get() or "off")
+        exp.pop()
+        # observability posture. The trace_id is THIS explain call's own
+        # trace (explain writes no audit event); a query's audit-greppable
+        # id lives in its QueryEvent hints — this line documents the id
+        # format and proves tracing is live end-to-end
+        exp.push("Observability")
+        exp.kv("tracing", "on" if tracing.enabled() else "off")
+        tid = tracing.current_trace_id()
+        if tid is not None:
+            exp.kv("trace_id (this explain call)", tid)
+        slow = config.TRACE_SLOW_MS.get()
+        exp.kv("slow-query threshold", f"{slow} ms" if slow else "off")
         exp.pop()
         if analyze:
             ex = self._executor(st)
@@ -565,6 +631,7 @@ class GeoDataset:
         ms = config.QUERY_TIMEOUT.to_duration_ms()
         return ms / 1000.0 if ms is not None else None
 
+    @_traced("query")
     def query(self, name: str, query: "str | Query" = "INCLUDE") -> FeatureCollection:
         st, q, plan = self._plan(name, query)
         t0 = time.perf_counter()
@@ -698,12 +765,31 @@ class GeoDataset:
             return _one()
         # plan EAGERLY so unknown attributes / parse errors / guard vetoes
         # (and unregistered CRS pairs) raise here, not mid-stream inside
-        # the consumer's iteration
-        st, q, plan = self._plan(name, q)
-        if q.srid is not None and q.srid != 4326:
-            from geomesa_tpu.utils import reproject as rp
+        # the consumer's iteration. The root span is managed manually
+        # (adopt + finish, never __enter__/__exit__): it must cover the
+        # consumer-driven iteration, which outlives this call frame.
+        root = tracing.start("query_batches", schema=name)
+        traced = root is not tracing.NOOP
+        prev = tracing.snapshot()
+        if traced:
+            root.t0 = time.perf_counter()
+            tracing.adopt(root)
+        try:
+            st, q, plan = self._plan(name, q)
+            if q.srid is not None and q.srid != 4326:
+                from geomesa_tpu.utils import reproject as rp
 
-            rp.transformer(4326, q.srid)  # raise now if unknown
+                rp.transformer(4326, q.srid)  # raise now if unknown
+        except BaseException:
+            # the generator (whose finally owns the happy-path finish)
+            # never runs when planning raises: close the root here so a
+            # failed query still lands in the histogram/slow log
+            if traced:
+                root.finish()
+            raise
+        finally:
+            if traced:
+                tracing.adopt(prev)  # restore any enclosing span, not None
         keep_pref = None
         if q.properties:
             keep = set(q.properties) | {"__fid__"}
@@ -712,26 +798,35 @@ class GeoDataset:
         def _iter():
             t0 = time.perf_counter()
             hits = 0
-            with metrics.registry().timer("query.scan").time(), \
-                    query_deadline(self._timeout_s()):
-                for batch in self._executor(st).features_iter(plan, batch_rows):
-                    hits += batch.n
-                    if keep_pref is not None:
-                        keep, pref = keep_pref
-                        batch = ColumnBatch(
-                            {
-                                k: v for k, v in batch.columns.items()
-                                if k in keep or k.startswith(pref)
-                            },
-                            batch.n,
-                        )
-                    if q.srid is not None and q.srid != 4326 and batch.n:
-                        batch = self._reproject_batch(st.ft, batch, q.srid)
-                    yield batch
-            self._audit(name, q, plan, t0, hits)
+            iter_prev = tracing.snapshot()  # the CONSUMER thread's context
+            if traced:
+                tracing.adopt(root)
+            try:
+                with metrics.registry().timer("query.scan").time(), \
+                        query_deadline(self._timeout_s()):
+                    for batch in self._executor(st).features_iter(plan, batch_rows):
+                        hits += batch.n
+                        if keep_pref is not None:
+                            keep, pref = keep_pref
+                            batch = ColumnBatch(
+                                {
+                                    k: v for k, v in batch.columns.items()
+                                    if k in keep or k.startswith(pref)
+                                },
+                                batch.n,
+                            )
+                        if q.srid is not None and q.srid != 4326 and batch.n:
+                            batch = self._reproject_batch(st.ft, batch, q.srid)
+                        yield batch
+                self._audit(name, q, plan, t0, hits)
+            finally:
+                if traced:
+                    root.finish()
+                    tracing.adopt(iter_prev)
 
         return _iter()
 
+    @_traced("count")
     def count(self, name: str, query: "str | Query" = "INCLUDE",
               exact: bool = True) -> int:
         st, q, plan = self._plan(name, query)
@@ -752,6 +847,7 @@ class GeoDataset:
         return (mm.lo[0], mm.lo[1], mm.hi[0], mm.hi[1])
 
     # -- analytics (geomesa-process parity) --------------------------------
+    @_traced("density")
     def density(self, name: str, query: "str | Query" = "INCLUDE",
                 bbox=None, width: int = 256, height: int = 256,
                 weight: Optional[str] = None) -> np.ndarray:
@@ -771,6 +867,7 @@ class GeoDataset:
         self._audit(name, q, plan, t0, int(np.count_nonzero(grid)), op="density")
         return grid
 
+    @_traced("density_curve")
     def density_curve(self, name: str, query: "str | Query" = "INCLUDE",
                       level: int = 9, bbox=None,
                       weight: Optional[str] = None):
@@ -820,6 +917,7 @@ class GeoDataset:
         )
         return grid, snapped
 
+    @_traced("stats")
     def stats(self, name: str, stat_spec: str,
               query: "str | Query" = "INCLUDE") -> sk.Stat:
         """Exact stats over matching features (StatsProcess/StatsScan analog)."""
@@ -891,6 +989,7 @@ class GeoDataset:
         z = st.stats.get("z3-histogram")
         return z if isinstance(z, sk.Z3HistogramStat) and not z.is_empty else None
 
+    @_traced("knn")
     def knn(self, name: str, x: float, y: float, k: int = 10,
             query: "str | Query" = "INCLUDE") -> FeatureCollection:
         """K nearest neighbors via iterative expanding-radius search

@@ -19,6 +19,72 @@ from typing import Any, Dict, List, Optional
 from geomesa_tpu import config
 
 
+class _JsonlAppender:
+    """One held append handle for the audit JSONL file (satellite fix: the
+    old code reopened the file under the registry lock on EVERY event).
+    The handle reopens only when ``geomesa.audit.path`` changes; every
+    record kind — query events, degradations, slow traces — flushes
+    through this single writer, so file ordering matches event ordering."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._path: "str | None" = None
+        self._fh = None
+
+    def write(self, line: str) -> None:
+        import os
+
+        with self._lock:
+            path = config.AUDIT_PATH.get()
+            reopen = path != self._path
+            if not reopen and self._fh is not None:
+                # rotation check: logrotate renames/removes the file while
+                # the path string stays the same — one stat per event (far
+                # cheaper than the open+close this appender replaced)
+                # detects it and reopens, so records land in the NEW file
+                try:
+                    st = os.stat(path)
+                    fst = os.fstat(self._fh.fileno())
+                    reopen = (st.st_ino, st.st_dev) != (fst.st_ino, fst.st_dev)
+                except OSError:
+                    reopen = True  # target missing: recreate it
+            if reopen:
+                if self._fh is not None:
+                    try:
+                        self._fh.close()
+                    except OSError:
+                        pass
+                self._fh = open(path, "a") if path else None
+                self._path = path
+            if self._fh is not None:
+                self._fh.write(line + "\n")
+                self._fh.flush()
+
+    def reset(self) -> None:
+        """Close the held handle (tests; a removed-but-same-path file)."""
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.close()
+                except OSError:
+                    pass
+            self._fh = None
+            self._path = None
+
+
+#: process-wide JSONL appender shared by every audit record kind
+_appender = _JsonlAppender()
+
+
+def append_record(obj: Dict[str, Any]) -> None:
+    """Append one structured record (e.g. a slow-trace tree from
+    tracing.py) through the shared audit appender. Honors the same
+    enabled/path gates as query events."""
+    if not config.AUDIT_ENABLED.to_bool():
+        return
+    _appender.write(json.dumps(obj, default=str))
+
+
 @dataclass
 class QueryEvent:
     """One audited query (QueryEvent.scala:14 field parity)."""
@@ -60,11 +126,11 @@ class AuditWriter:
         if not event.date:
             event.date = time.time()
         with self._lock:
+            # file append INSIDE the registry lock (via the held appender
+            # handle): ring order and file order stay identical even under
+            # concurrent writers
             self.events.append(event)
-            path = config.AUDIT_PATH.get()
-            if path:
-                with open(path, "a") as fh:
-                    fh.write(event.to_json() + "\n")
+            _appender.write(event.to_json())
 
     def record(self, type_name: str, filter_text: str, hints: Dict[str, Any],
                plan_time_ms: float, scan_time_ms: float, hits: int,
@@ -119,10 +185,7 @@ class DegradationLog:
             event.date = time.time()
         with self._lock:
             self.events.append(event)
-            path = config.AUDIT_PATH.get()
-            if path:
-                with open(path, "a") as fh:
-                    fh.write(event.to_json() + "\n")
+            _appender.write(event.to_json())
 
     def recent(self, n: int = 100) -> List[DegradationEvent]:
         with self._lock:

@@ -42,7 +42,7 @@ from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
-from geomesa_tpu import config, metrics
+from geomesa_tpu import config, metrics, tracing
 from geomesa_tpu.cache import cells as cellmod
 from geomesa_tpu.cache.store import CacheStore
 from geomesa_tpu.stats import sketches as sk
@@ -142,7 +142,8 @@ class AggregateCache:
         uid, epoch = st.uid, st.version
         akey = self._auth_key(ds, q)
         wkey = ("whole",) + op.fingerprint + (repr(plan.filter), akey)
-        hit = self.store.get(uid, epoch, wkey)
+        with tracing.span("cache.lookup", key="whole"):
+            hit = self.store.get(uid, epoch, wkey)
         if hit is not None:
             metrics.inc(metrics.CACHE_HIT)
             self._note(plan, cache="hit")
@@ -179,34 +180,42 @@ class AggregateCache:
         hits = 0
         scan_acc = [0, 0]  # [scanned_rows, table_rows] over executed pieces
         all_cacheable = True
-        for cell in decomp.cells:
-            ckey = ("cell",) + op.fingerprint + (
-                decomp.residual_key, akey, decomp.level,
-                decomp.cell_prefix(cell),
-            )
-            got = self.store.get(uid, epoch, ckey)
-            if got is not None:
-                hits += 1
-                acc = op.merge(acc, op.unpack(got))
-                continue
-            value, cacheable = self._run_sub(
-                ds, st, q, decomp.cell_filter(cell, geom), op, plan, scan_acc
-            )
-            if cacheable:
-                self.store.put(uid, epoch, ckey, op.pack(value))
-            else:
-                all_cacheable = False
-            acc = op.merge(acc, value)
+        with tracing.span("cache.cells", total=len(decomp.cells),
+                          level=decomp.level) as cells_span:
+            for cell in decomp.cells:
+                ckey = ("cell",) + op.fingerprint + (
+                    decomp.residual_key, akey, decomp.level,
+                    decomp.cell_prefix(cell),
+                )
+                with tracing.span("cache.lookup", key="cell"):
+                    got = self.store.get(uid, epoch, ckey)
+                if got is not None:
+                    hits += 1
+                    acc = op.merge(acc, op.unpack(got))
+                    continue
+                with tracing.span("cache.cell.scan"):
+                    value, cacheable = self._run_sub(
+                        ds, st, q, decomp.cell_filter(cell, geom), op, plan,
+                        scan_acc,
+                    )
+                if cacheable:
+                    self.store.put(uid, epoch, ckey, op.pack(value))
+                else:
+                    all_cacheable = False
+                acc = op.merge(acc, value)
+            cells_span.set(hits=hits)
         strip_f = decomp.strip_filter(geom)
         if strip_f is not None:
-            value, cacheable = self._run_sub(
-                ds, st, q, strip_f, op, plan, scan_acc
-            )
+            with tracing.span("cache.residual"):
+                value, cacheable = self._run_sub(
+                    ds, st, q, strip_f, op, plan, scan_acc
+                )
             if not cacheable:
                 all_cacheable = False
             acc = op.merge(acc, value)
-        if all_cacheable:
-            self.store.put(uid, epoch, wkey, op.pack(acc))
+        with tracing.span("cache.merge"):
+            if all_cacheable:
+                self.store.put(uid, epoch, wkey, op.pack(acc))
         plan.__dict__["scanned_rows"] = scan_acc[0]
         plan.__dict__["table_rows"] = scan_acc[1]
         if hits:

@@ -54,12 +54,15 @@ class CacheStore:
         self._budget = budget_bytes
         self._lock = threading.Lock()
         _STORES.add(self)
-        # (re-)registering is idempotent: the gauge fn sums over _STORES,
-        # never over one captured store
+        # (re-)registering is idempotent in effect: the gauge fn sums over
+        # _STORES, never over one captured store — but each init builds a
+        # fresh lambda, so the swap must be EXPLICIT (replace=True; the
+        # registry refuses silent callable replacement)
         reg = metrics.registry()
-        reg.gauge(metrics.CACHE_BYTES, lambda: _gauge_total("total_bytes"))
+        reg.gauge(metrics.CACHE_BYTES,
+                  lambda: _gauge_total("total_bytes"), replace=True)
         reg.gauge(metrics.CACHE_ENTRIES,
-                  lambda: _gauge_total("total_entries"))
+                  lambda: _gauge_total("total_entries"), replace=True)
 
     # -- budgets -----------------------------------------------------------
     def budget(self) -> int:

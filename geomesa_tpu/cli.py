@@ -380,6 +380,77 @@ def cmd_serve(args):
             _save(ds, args.catalog)
 
 
+def cmd_metrics(args):
+    """Print the metrics exposition (tools analog of the Dropwizard
+    reporters; docs/OBSERVABILITY.md). Three sources:
+
+    * ``--url http://host:port/metrics`` — scrape a running obs/web
+      endpoint (prometheus text passthrough);
+    * ``--host/--port`` — fetch a running sidecar's registry snapshot via
+      the Flight ``metrics`` action (JSON);
+    * neither — this process's own registry (prometheus text; mostly
+      relevant when invoked after in-process work, e.g. under test).
+    """
+    if args.url:
+        import urllib.request
+
+        with urllib.request.urlopen(args.url, timeout=10) as resp:
+            sys.stdout.write(resp.read().decode())
+        return
+    if args.sidecar_host:
+        from geomesa_tpu.sidecar import GeoFlightClient
+
+        port = args.sidecar_port or 8815
+        with GeoFlightClient(f"grpc+tcp://{args.sidecar_host}:{port}") as c:
+            print(json.dumps(c.metrics(), indent=2, sort_keys=True, default=str))
+        return
+    from geomesa_tpu import metrics
+
+    sys.stdout.write(metrics.registry().prometheus())
+
+
+def cmd_trace(args):
+    """Run one query with tracing enabled and print its span tree — the
+    operator's "where did this query's 40 ms go?" loop without touching
+    config (docs/OBSERVABILITY.md)."""
+    from geomesa_tpu import config, tracing
+    from geomesa_tpu.api.dataset import Query
+
+    ds = _load(args.catalog)
+    q = Query(ecql=args.cql)
+    with config.TRACE_ENABLED.scoped("true"):
+        if args.op == "count":
+            out = ds.count(args.feature_name, q)
+        elif args.op == "density":
+            out = f"grid nonzero={int((ds.density(args.feature_name, q) > 0).sum())}"
+        elif args.op == "query":
+            out = len(ds.query(args.feature_name, q))
+        else:
+            raise SystemExit(f"unknown --op {args.op!r}")
+    tr = tracing.last_trace()
+    if tr is None:
+        raise SystemExit("no trace captured (query produced no root span)")
+    tree = tr.root.to_dict()
+    if args.json:
+        print(json.dumps({"trace_id": tr.trace_id, "result": str(out),
+                          "tree": tree}, indent=2, default=str))
+    else:
+        print(f"trace_id: {tr.trace_id}")
+        print(f"result: {out}")
+        print(tracing.render(tree))
+
+
+def cmd_obs(args):
+    """Run the standalone observability endpoint (/metrics, /healthz,
+    /debug/queries) over a catalog."""
+    from geomesa_tpu import obs
+
+    ds = _load(args.catalog)
+    print(f"geomesa-tpu obs listening on http://{args.host}:{args.port}"
+          "/metrics /healthz /debug/queries")
+    obs.serve(ds, args.host, args.port)
+
+
 def cmd_version(args):
     print(f"geomesa-tpu {__version__}")
 
@@ -623,6 +694,27 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=8081)
     sp.set_defaults(fn=cmd_web)
+
+    sp = sub.add_parser("metrics", help="print the metrics exposition")
+    sp.add_argument("--url", help="scrape a running /metrics endpoint")
+    sp.add_argument("--host", dest="sidecar_host",
+                    help="fetch a sidecar's registry via Flight")
+    sp.add_argument("--port", dest="sidecar_port", type=int)
+    sp.set_defaults(fn=cmd_metrics)
+
+    sp = sub.add_parser("trace",
+                        help="run one query with tracing on; print the span tree")
+    common(sp, cql=True)
+    sp.add_argument("--op", default="count", choices=["count", "density", "query"])
+    sp.add_argument("--json", action="store_true", help="emit JSON")
+    sp.set_defaults(fn=cmd_trace)
+
+    sp = sub.add_parser("obs", help="run the observability endpoint "
+                                    "(/metrics /healthz /debug/queries)")
+    common(sp, feature=False)
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=9090)
+    sp.set_defaults(fn=cmd_obs)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

@@ -365,3 +365,32 @@ FAULT_INJECTION = SystemProperty("geomesa.fault.injection", "false")
 #: Extra gather slots for boundary ties in the device top-k selection;
 #: selections whose tie group overflows k + slack fall back to the host.
 TOPK_TIE_SLACK = SystemProperty("geomesa.topk.tie-slack", "4096")
+
+# ---------------------------------------------------------------------------
+# Observability (tracing.py, obs.py; docs/OBSERVABILITY.md). Tracing is
+# off-by-default-cheap: with geomesa.trace.enabled false the span API is a
+# no-op (a context-var read returning a shared singleton), asserted by the
+# bench smoke trace_overhead_pct gate.
+# ---------------------------------------------------------------------------
+
+#: Master switch for query span-tree tracing (default off).
+TRACE_ENABLED = SystemProperty("geomesa.trace.enabled", "false")
+
+#: Slow-query threshold: a completed root span slower than this writes its
+#: full span tree as a JSONL record through the audit appender (and into
+#: the in-memory slow-trace ring served by /debug/queries). Unset = never.
+TRACE_SLOW_MS = SystemProperty("geomesa.trace.slow.ms", None)
+
+#: Per-query span budget: spans beyond this are dropped (counted on the
+#: root as ``dropped``) so a decomposed 256-cell query cannot balloon its
+#: trace unboundedly.
+TRACE_MAX_SPANS = SystemProperty("geomesa.trace.max.spans", "512")
+
+#: Mirror spans into jax.profiler.TraceAnnotation scopes so they appear in
+#: TensorBoard/Perfetto device profiles alongside XLA ops (default off).
+TRACE_JAX_PROFILER = SystemProperty("geomesa.trace.jax.profiler", "false")
+
+#: Per-site recompile alert: a jit site that pays more than this many
+#: fresh traces within ONE query trips the ``kernel.recompile.alert``
+#: gauge (warm-path regression signal; docs/PERF.md).
+KERNEL_ALERT_THRESHOLD = SystemProperty("geomesa.kernel.alert.threshold", "3")

@@ -31,15 +31,113 @@ Metrics (process registry): ``kernel.recompiles`` (fresh traces),
 from __future__ import annotations
 
 import threading
+import time as _time
 from collections import OrderedDict
 from typing import Any, Dict, Hashable, Optional, Tuple
 
-from geomesa_tpu import config, metrics
+from geomesa_tpu import config, metrics, tracing
 
 #: metric names (declared in metrics.py with the exposition contract)
 KERNEL_RECOMPILES = metrics.KERNEL_RECOMPILES
 KERNEL_HIT = metrics.KERNEL_BUCKET_HIT
 KERNEL_EVICT = metrics.KERNEL_EVICT
+
+
+# ---------------------------------------------------------------------------
+# Per-query recompile window + alert (the ROADMAP "surface per-site
+# recompile counts as alerts in the metrics exposition" item). Every fresh
+# trace bumps a per-site counter (kernel.recompiles.<site>) and a
+# thread-local per-QUERY window; a site paying more than
+# geomesa.kernel.alert.threshold traces within one query trips the
+# kernel.recompile.alert gauge — the warm-path-broken signal (a healthy
+# steady state compiles at most once per site per novel shape bucket).
+#
+# The gauge LATCHES for _ALERT_TTL_S after the last trip instead of being
+# zeroed by the next query: windows are thread-local but the gauge is
+# process-global, so clear-on-next-query would let concurrent (or merely
+# subsequent) queries race a trip away before any scraper could see it.
+# ---------------------------------------------------------------------------
+
+_query_window = threading.local()
+
+#: how long a trip stays visible on the gauge (covers realistic scrape
+#: intervals; the kernel.recompile.alerts counter is the durable record)
+_ALERT_TTL_S = 300.0
+_alert_lock = threading.Lock()
+_alert_state = {"at": 0.0, "over": 0}
+
+
+def _alert_value() -> float:
+    """Callable backing of the kernel.recompile.alert gauge: the number of
+    sites over threshold in the most recent tripped window, until the
+    latch TTL expires."""
+    with _alert_lock:
+        if _time.monotonic() - _alert_state["at"] <= _ALERT_TTL_S:
+            return float(_alert_state["over"])
+    return 0.0
+
+
+def _ensure_alert_gauge() -> None:
+    # same module-level fn every time: registration is idempotent and
+    # survives a registry.clear() (re-registered on the next query)
+    metrics.registry().gauge(metrics.KERNEL_RECOMPILE_ALERT, _alert_value)
+
+
+def reset_alert() -> None:
+    """Clear the alert latch (tests)."""
+    with _alert_lock:
+        _alert_state["at"] = 0.0
+        _alert_state["over"] = 0
+
+
+def _site_slug(site) -> str:
+    """Metric-name-safe jit-site label."""
+    s = str(site)
+    return "".join(ch if (ch.isalnum() or ch in "._-") else "_" for ch in s)
+
+
+def begin_query_window() -> None:
+    """Reset this thread's per-query recompile window (called at the top
+    of every query plan). The alert gauge is NOT cleared here — it latches
+    for _ALERT_TTL_S so a trip survives until a scraper can observe it."""
+    _query_window.counts = {}
+    _ensure_alert_gauge()
+
+
+def query_recompiles() -> Dict[str, int]:
+    """site -> fresh traces paid by the CURRENT query window (explain's
+    Warm path section reports this next to the lifetime totals)."""
+    return dict(getattr(_query_window, "counts", {}))
+
+
+def alert_threshold() -> int:
+    """Effective geomesa.kernel.alert.threshold (single source of the
+    default — explain and the trip logic must agree)."""
+    t = config.KERNEL_ALERT_THRESHOLD.to_int()
+    return 3 if t is None else t
+
+
+def _note_recompile(site) -> None:
+    slug = _site_slug(site)
+    metrics.inc(KERNEL_RECOMPILES)
+    metrics.inc(f"{KERNEL_RECOMPILES}.{slug}")
+    # visible INSIDE the query that paid for it (span-tree event)
+    tracing.event("kernel.recompile", site=slug)
+    counts = getattr(_query_window, "counts", None)
+    if counts is None:
+        return
+    counts[slug] = counts.get(slug, 0) + 1
+    threshold = alert_threshold()
+    if counts[slug] > threshold:
+        over = sum(1 for v in counts.values() if v > threshold)
+        with _alert_lock:
+            _alert_state["at"] = _time.monotonic()
+            _alert_state["over"] = over
+        _ensure_alert_gauge()
+        if counts[slug] == threshold + 1:  # first trip for this site
+            metrics.inc(metrics.KERNEL_RECOMPILE_ALERTS)
+            tracing.event("kernel.recompile.alert", site=slug,
+                          recompiles=counts[slug])
 
 
 class KernelRegistry:
@@ -96,7 +194,7 @@ class KernelRegistry:
             while len(self._entries) > cap:
                 self._entries.popitem(last=False)
                 evicted += 1
-        metrics.inc(KERNEL_RECOMPILES)
+        _note_recompile(site)
         if evicted:
             metrics.inc(KERNEL_EVICT, evicted)
 

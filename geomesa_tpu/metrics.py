@@ -9,9 +9,10 @@ execution, and the streaming layer.
 
 from __future__ import annotations
 
+import bisect
 import threading
 import time
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 
 class Counter:
@@ -27,29 +28,108 @@ class Counter:
 
 
 class Gauge:
-    """A sampled value; either set explicitly or backed by a callable."""
+    """A sampled value; either set explicitly or backed by a callable.
+
+    ``set()``/``value`` are lock-protected, and a callable backing is only
+    installed through :meth:`set_fn` — replacing an existing (different)
+    callable must be explicit (``replace=True``), never the silent
+    last-registration-wins the old ``MetricRegistry.gauge`` did."""
 
     def __init__(self, fn: Optional[Callable[[], float]] = None):
+        self._lock = threading.Lock()
         self.fn = fn
         self._value = 0.0
 
     def set(self, v: float):
-        self._value = float(v)
+        with self._lock:
+            self._value = float(v)
+
+    def set_fn(self, fn: Callable[[], float], replace: bool = False) -> None:
+        """Install (or explicitly replace) the callable backing."""
+        with self._lock:
+            if self.fn is not None and self.fn is not fn and not replace:
+                raise ValueError(
+                    "gauge is already callable-backed; pass replace=True to "
+                    "swap the backing function"
+                )
+            self.fn = fn
 
     @property
     def value(self) -> float:
-        return float(self.fn()) if self.fn is not None else self._value
+        with self._lock:
+            fn = self.fn
+            if fn is None:
+                return self._value
+        return float(fn())  # sample outside the lock: fn may be slow
+
+
+#: Fixed histogram bucket upper bounds (seconds). Spans sub-millisecond
+#: kernel dispatches through multi-second partitioned scans; the prometheus
+#: rendering emits cumulative ``_bucket{le=...}`` lines so p50/p90/p99 are
+#: derivable with the standard histogram_quantile arithmetic.
+DEFAULT_BUCKETS_S: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+
+class Histogram:
+    """Fixed-bucket latency histogram (seconds)."""
+
+    __slots__ = ("buckets", "counts", "count", "sum_s", "_lock")
+
+    def __init__(self, buckets: Optional[Tuple[float, ...]] = None):
+        self.buckets = tuple(buckets or DEFAULT_BUCKETS_S)
+        self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf overflow
+        self.count = 0
+        self.sum_s = 0.0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds: float):
+        i = bisect.bisect_left(self.buckets, seconds)
+        with self._lock:
+            self.counts[i] += 1
+            self.count += 1
+            self.sum_s += seconds
+
+    def quantile(self, q: float) -> float:
+        """Approximate quantile: the upper bound of the bucket holding the
+        q-th observation (the same answer prometheus derives from the text
+        exposition; +Inf resolves to the largest finite bound)."""
+        with self._lock:
+            total = self.count
+            counts = list(self.counts)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        cum = 0
+        for i, c in enumerate(counts):
+            cum += c
+            if cum >= rank:
+                return self.buckets[min(i, len(self.buckets) - 1)]
+        return self.buckets[-1]
+
+    def snapshot(self) -> Dict[str, object]:
+        with self._lock:
+            counts = list(self.counts)
+            total, s = self.count, self.sum_s
+        return {"count": total, "sum_s": s, "counts": counts,
+                "buckets": list(self.buckets)}
 
 
 class Timer:
-    """Count + total/max duration. Use as a context manager."""
+    """Count + total/max duration + latency distribution. Use as a context
+    manager; every existing ``timer(...)`` hot site feeds the embedded
+    :class:`Histogram` with no call-site changes, so /metrics carries
+    p50/p90/p99 for all of them."""
 
-    __slots__ = ("count", "total_s", "max_s", "_lock")
+    __slots__ = ("count", "total_s", "max_s", "hist", "_lock")
 
     def __init__(self):
         self.count = 0
         self.total_s = 0.0
         self.max_s = 0.0
+        self.hist = Histogram()
         self._lock = threading.Lock()
 
     def update(self, seconds: float):
@@ -57,6 +137,7 @@ class Timer:
             self.count += 1
             self.total_s += seconds
             self.max_s = max(self.max_s, seconds)
+        self.hist.observe(seconds)
 
     def time(self):
         return _TimerContext(self)
@@ -98,14 +179,21 @@ class MetricRegistry:
     def counter(self, name: str) -> Counter:
         return self._get(name, Counter)
 
-    def gauge(self, name: str, fn: Optional[Callable[[], float]] = None) -> Gauge:
-        g = self._get(name, Gauge, fn)
+    def gauge(self, name: str, fn: Optional[Callable[[], float]] = None,
+              replace: bool = False) -> Gauge:
+        """A named gauge. ``fn`` installs a callable backing; replacing an
+        EXISTING different backing requires ``replace=True`` (satellite fix:
+        the old path silently swapped ``fn`` under concurrent readers)."""
+        g = self._get(name, Gauge)
         if fn is not None:
-            g.fn = fn
+            g.set_fn(fn, replace=replace)
         return g
 
     def timer(self, name: str) -> Timer:
         return self._get(name, Timer)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
 
     def report(self) -> Dict[str, object]:
         out: Dict[str, object] = {}
@@ -120,21 +208,53 @@ class MetricRegistry:
                 out[name] = {
                     "count": m.count, "total_s": m.total_s,
                     "mean_s": m.mean_s, "max_s": m.max_s,
+                    "p50_s": m.hist.quantile(0.5),
+                    "p99_s": m.hist.quantile(0.99),
+                }
+            elif isinstance(m, Histogram):
+                snap = m.snapshot()
+                out[name] = {
+                    "count": snap["count"], "sum_s": snap["sum_s"],
+                    "p50_s": m.quantile(0.5), "p90_s": m.quantile(0.9),
+                    "p99_s": m.quantile(0.99),
                 }
         return out
 
+    @staticmethod
+    def _prom_hist_lines(metric: str, h: Histogram) -> List[str]:
+        """Cumulative prometheus histogram lines for one Histogram."""
+        snap = h.snapshot()
+        lines: List[str] = []
+        cum = 0
+        for le, c in zip(snap["buckets"], snap["counts"]):
+            cum += c
+            lines.append(f'{metric}_bucket{{le="{le}"}} {cum}')
+        cum += snap["counts"][-1]
+        lines.append(f'{metric}_bucket{{le="+Inf"}} {cum}')
+        lines.append(f"{metric}_sum {snap['sum_s']:.6f}")
+        lines.append(f"{metric}_count {snap['count']}")
+        return lines
+
     def prometheus(self) -> str:
-        """Prometheus text exposition of all metrics."""
+        """Prometheus text exposition of all metrics. Timers render their
+        legacy count/total/max lines PLUS ``_seconds`` histogram buckets;
+        standalone histograms render the standard bucket/sum/count triple
+        (p50/p90/p99 derivable with histogram_quantile)."""
         lines: List[str] = []
         p = self.prefix
-        for name, v in self.report().items():
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in items:
             metric = f"{p}_{name}".replace(".", "_").replace("-", "_")
-            if isinstance(v, dict):  # timer
-                lines.append(f"{metric}_count {v['count']}")
-                lines.append(f"{metric}_seconds_total {v['total_s']:.6f}")
-                lines.append(f"{metric}_seconds_max {v['max_s']:.6f}")
-            else:
-                lines.append(f"{metric} {v}")
+            if isinstance(m, Timer):
+                lines.append(f"{metric}_count {m.count}")
+                lines.append(f"{metric}_seconds_total {m.total_s:.6f}")
+                lines.append(f"{metric}_seconds_max {m.max_s:.6f}")
+                lines.extend(self._prom_hist_lines(metric + "_seconds", m.hist))
+            elif isinstance(m, Histogram):
+                lines.extend(self._prom_hist_lines(metric + "_seconds", m))
+            elif isinstance(m, (Counter, Gauge)):
+                lines.append(f"{metric} {m.value}")
         return "\n".join(lines) + "\n"
 
     def clear(self):
@@ -154,6 +274,12 @@ def inc(name: str, n: int = 1) -> None:
     aggregate cache and the stream quarantine path, which count from hot
     loops and shouldn't re-spell the registry plumbing)."""
     _REGISTRY.counter(name).inc(n)
+
+
+def observe(name: str, seconds: float) -> None:
+    """Shorthand: record one latency observation into a process-registry
+    histogram (span completions in tracing.py use this path)."""
+    _REGISTRY.histogram(name).observe(seconds)
 
 
 # Aggregate-cache metric names (cache/store.py, cache/service.py). Kept here
@@ -180,6 +306,16 @@ KERNEL_RECOMPILES = "kernel.recompiles"
 KERNEL_BUCKET_HIT = "kernel.bucket_hit"
 KERNEL_EVICT = "kernel.evict"
 PIPELINE_PREFETCH = "pipeline.prefetch"
+# Observability metrics (tracing.py, kernels/registry.py, obs.py;
+# docs/OBSERVABILITY.md):
+#   kernel.recompiles.<site>   per-jit-site fresh traces (suffix = site)
+#   kernel.recompile.alert     gauge: sites over geomesa.kernel.alert.
+#                              threshold within the LAST query window
+#   kernel.recompile.alerts    total alert trips (counter)
+#   trace.<stage>              per-stage latency histograms (span tree)
+#   trace.slow                 queries that exceeded geomesa.trace.slow.ms
+KERNEL_RECOMPILE_ALERT = "kernel.recompile.alert"
+KERNEL_RECOMPILE_ALERTS = "kernel.recompile.alerts"
 CACHE_PARTIAL = "cache.partial"
 CACHE_MISS = "cache.miss"
 CACHE_PUT = "cache.put"

@@ -1,0 +1,197 @@
+"""Live observability surface (docs/OBSERVABILITY.md).
+
+A stdlib ``ThreadingHTTPServer`` exposing the process's operational state —
+the Dropwizard-reporter role of the reference's geomesa-metrics module
+(SURVEY.md §2.8), plus the ``_queries`` audit table as a debug endpoint:
+
+    GET /metrics        prometheus text exposition (counters, gauges,
+                        timers WITH latency histogram buckets, the
+                        trace.<stage> span histograms, per-site
+                        kernel.recompiles.* and the recompile alert gauge)
+    GET /healthz        JSON health: circuit-breaker states
+                        (resilience.py), quarantine counters (stream
+                        poison messages, corrupt partitions), accelerator
+                        reachability — 200 when healthy, 503 when any
+                        breaker is open
+    GET /debug/queries  JSON: recent query audit events, the degradation
+                        trail, and slow-query span trees
+                        (?n= bounds each list, default 50)
+
+``web.py`` mounts the same three routes on the REST server, so a process
+already serving the API needs no second port; :func:`serve` runs a
+standalone endpoint (e.g. next to the Flight sidecar, which has no HTTP
+listener of its own).
+
+Payload builders are plain functions so both servers — and tests — share
+one implementation.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+from geomesa_tpu import metrics, resilience, tracing
+
+
+def metrics_text() -> str:
+    """The /metrics payload: prometheus text exposition."""
+    return metrics.registry().prometheus()
+
+
+# -- device reachability -----------------------------------------------------
+# jax.devices() can BLOCK indefinitely on a wedged device claim (the bench
+# probes it in a throwaway subprocess for the same reason), so the health
+# probe runs it on a daemon thread with a short join and caches the answer.
+
+_device_lock = threading.Lock()
+_device_state: Dict[str, Any] = {"status": "unknown", "checked_at": 0.0}
+_DEVICE_TTL_S = 60.0
+
+
+def _probe_devices(timeout_s: float = 2.0) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+
+    def probe():
+        try:
+            import jax
+
+            out["devices"] = [str(d) for d in jax.devices()]
+            out["status"] = "ok"
+        except Exception as e:  # unreachable backend / import failure
+            out["status"] = "unreachable"
+            out["error"] = repr(e)[:200]
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if t.is_alive():
+        return {"status": "unreachable",
+                "error": f"device probe hung > {timeout_s}s (wedged claim?)"}
+    return out
+
+
+def device_health() -> Dict[str, Any]:
+    """Cached accelerator reachability (TTL so /healthz polling never
+    hammers — or re-hangs on — the PJRT client)."""
+    with _device_lock:
+        if time.monotonic() - _device_state.get("checked_at", 0.0) < _DEVICE_TTL_S \
+                and _device_state.get("status") != "unknown":
+            return {k: v for k, v in _device_state.items() if k != "checked_at"}
+    probed = _probe_devices()
+    with _device_lock:
+        _device_state.clear()
+        _device_state.update(probed)
+        _device_state["checked_at"] = time.monotonic()
+    return probed
+
+
+def health() -> Dict[str, Any]:
+    """The /healthz payload. ``status`` is ``ok`` unless a circuit breaker
+    is open (``degraded``); quarantine counters and device reachability
+    ride along for the operator's first glance."""
+    breakers = resilience.breaker_states()
+    report = metrics.registry().report()
+    quarantine = {
+        name: v for name, v in report.items()
+        if "quarantin" in name and isinstance(v, (int, float)) and v
+    }
+    open_breakers = [n for n, s in breakers.items() if s == "open"]
+    return {
+        "status": "degraded" if open_breakers else "ok",
+        "breakers": breakers,
+        "open_breakers": open_breakers,
+        "quarantine": quarantine,
+        "device": device_health(),
+        "tracing": tracing.enabled(),
+    }
+
+
+def debug_queries(dataset=None, n: int = 50) -> Dict[str, Any]:
+    """The /debug/queries payload: recent audits + degradations + slow
+    traces. ``dataset`` optional — the degradation trail and slow traces
+    are process-wide; audit events need the dataset's writer."""
+    from geomesa_tpu import audit as audit_mod
+
+    events = []
+    if dataset is not None:
+        events = [json.loads(e.to_json()) for e in dataset.audit.recent(n)]
+    degraded = [
+        json.loads(e.to_json()) for e in audit_mod.degradations.recent(n)
+    ]
+    return {
+        "queries": events,
+        "degradations": degraded,
+        "slow_traces": tracing.slow_traces(n),
+    }
+
+
+def handle(path: str, dataset=None):
+    """Route one GET path to (status, content_type, body-bytes), or None
+    when the path is not an observability route (web.py falls through to
+    its own API routing)."""
+    parsed = urllib.parse.urlparse(path)
+    q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
+    route = parsed.path.rstrip("/") or "/"
+    if route == "/metrics":
+        return 200, "text/plain; version=0.0.4", metrics_text().encode()
+    if route == "/healthz":
+        h = health()
+        code = 200 if h["status"] == "ok" else 503
+        return code, "application/json", json.dumps(h).encode()
+    if route == "/debug/queries":
+        try:
+            n = max(1, min(int(q.get("n", "50")), 1000))
+        except ValueError:
+            return (400, "application/json",
+                    json.dumps({"error": "?n= must be an integer"}).encode())
+        body = json.dumps(debug_queries(dataset, n), default=str).encode()
+        return 200, "application/json", body
+    return None
+
+
+class _ObsHandler(BaseHTTPRequestHandler):
+    dataset = None  # injected by serve()
+
+    def log_message(self, fmt, *args):  # noqa: D102 — quiet stderr
+        pass
+
+    def do_GET(self):  # noqa: N802
+        try:
+            out = handle(self.path, self.dataset)
+        except Exception as e:  # pragma: no cover - defensive
+            out = (500, "application/json",
+                   json.dumps({"error": f"{type(e).__name__}: {e}"}).encode())
+        if out is None:
+            out = (404, "application/json",
+                   json.dumps({"error": f"unknown path {self.path!r}"}).encode())
+        code, ctype, body = out
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+def serve(dataset=None, host: str = "127.0.0.1", port: int = 9090,
+          background: bool = False) -> ThreadingHTTPServer:
+    """Serve /metrics + /healthz + /debug/queries. ``background=True``
+    runs in a daemon thread and returns the server (tests / embedding
+    next to a Flight sidecar)."""
+    handler = type("ObsHandler", (_ObsHandler,), {"dataset": dataset})
+    server = ThreadingHTTPServer((host, port), handler)
+    if background:
+        t = threading.Thread(target=server.serve_forever, daemon=True)
+        t.start()
+        return server
+    try:
+        server.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.server_close()
+    return server

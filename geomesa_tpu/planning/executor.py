@@ -18,7 +18,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from geomesa_tpu import config
+from geomesa_tpu import config, tracing
 from geomesa_tpu.index.store import FeatureStore, IndexTable
 from geomesa_tpu.kernels import density as kdensity
 from geomesa_tpu.kernels import knn as kknn
@@ -625,13 +625,16 @@ class Executor:
             hit = cache.get(key0 + (n,))
             (out.__setitem__(n, hit) if hit is not None else missing.append(n))
         if missing:
-            full = setup["table"].device_columns(tuple(missing), None)
-            g = jax.device_put(d["cstart"])
-            gather = _slab_gather_fn(B)
-            if len(cache) >= 64:
-                cache.clear()
-            for n in missing:
-                out[n] = cache[key0 + (n,)] = gather(full[n].reshape(-1), g)
+            with tracing.span("scan.device_put", compact=True):
+                full = setup["table"].device_columns(tuple(missing), None)
+                g = jax.device_put(d["cstart"])
+                gather = _slab_gather_fn(B)
+                if len(cache) >= 64:
+                    cache.clear()
+                for n in missing:
+                    out[n] = cache[key0 + (n,)] = gather(
+                        full[n].reshape(-1), g
+                    )
         return out
 
     def _device_compact_agg(self, plan: QueryPlan, setup, agg_fn, agg_cols=(),
@@ -711,7 +714,9 @@ class Executor:
             if len(wcache) >= 64:
                 wcache.clear()
             wcache[wkey] = win
-        return go(cols, win[0], win[1], tuple(extra))
+        with tracing.span("scan.kernel", compact=True,
+                          site=str(cache_key[0]) if cache_key else None):
+            return go(cols, win[0], win[1], tuple(extra))
 
     def _expand_compact_mask(self, setup, cmask) -> np.ndarray:
         """[C, B] compact mask -> [S, L] padded mask (host, vectorized —
@@ -962,9 +967,10 @@ class Executor:
         import jax.numpy as jnp
 
         table = setup["table"]
-        dev_cols = table.device_columns(
-            tuple(setup["needed"]) + tuple(agg_cols), self._sharding()
-        )
+        with tracing.span("scan.device_put"):
+            dev_cols = table.device_columns(
+                tuple(setup["needed"]) + tuple(agg_cols), self._sharding()
+            )
         L = setup["L"]
         compiled = plan.compiled
         # coarse-mask kernels must NOT sample: sampling runs once on the
@@ -1072,7 +1078,9 @@ class Executor:
         # trace-time context: under a sharded mesh, polygon pallas kernels
         # re-dispatch through an inner shard_map over the mesh (bare
         # pallas_call has no GSPMD partitioning rule)
-        with pk.sharded_execution(self.mesh):
+        with pk.sharded_execution(self.mesh), \
+                tracing.span("scan.kernel",
+                             site=str(cache_key[0]) if cache_key else None):
             return go(dev_cols, d_starts, d_ends, d_counts, tuple(extra))
 
     def _sharding(self):
@@ -1340,19 +1348,20 @@ class Executor:
             scan=("host+device-coarse" if coarse is not None else "host"),
             band_rows=band_rows,
         )
-        mask = self._host_mask(plan, setup, coarse)
-        table = setup["table"]
-        cols = {}
-        for c in set(list(setup["needed"]) + list(agg_cols)):
-            if table.has_column(c):
-                L = setup["L"]
-                full = table.col_sorted(c)
-                stacked = np.zeros((table.n_shards, L), dtype=full.dtype)
-                for s in range(table.n_shards):
-                    sl = table.shard_slice(s)
-                    stacked[s, : sl.stop - sl.start] = full[sl]
-                cols[c] = stacked
-        return agg_fn_host(cols, mask, np, *extra)
+        with tracing.span("scan.host"):
+            mask = self._host_mask(plan, setup, coarse)
+            table = setup["table"]
+            cols = {}
+            for c in set(list(setup["needed"]) + list(agg_cols)):
+                if table.has_column(c):
+                    L = setup["L"]
+                    full = table.col_sorted(c)
+                    stacked = np.zeros((table.n_shards, L), dtype=full.dtype)
+                    for s in range(table.n_shards):
+                        sl = table.shard_slice(s)
+                        stacked[s, : sl.stop - sl.start] = full[sl]
+                    cols[c] = stacked
+            return agg_fn_host(cols, mask, np, *extra)
 
     # -- public operations --------------------------------------------------
     def count(self, plan: QueryPlan) -> int:
@@ -1363,7 +1372,10 @@ class Executor:
             cache_key=("count",),
             additive=True,
         )
-        return 0 if out is None else int(out)
+        if out is None:
+            return 0
+        with tracing.span("scan.sync"):
+            return int(out)
 
     def features(self, plan: QueryPlan) -> ColumnBatch:
         """Matching rows as a host ColumnBatch (sort/limit applied by caller)."""
@@ -1379,20 +1391,19 @@ class Executor:
             try:
                 self._maybe_compact(plan, setup, True)
                 if setup["compact"] is not None:
-                    mask = self._expand_compact_mask(
-                        setup,
-                        self._device_compact_agg(
-                            plan, setup, lambda cols, m, xp: m,
-                            cache_key=("mask",),
-                        ),
+                    cmask = self._device_compact_agg(
+                        plan, setup, lambda cols, m, xp: m,
+                        cache_key=("mask",),
                     )
+                    with tracing.span("scan.sync"):
+                        mask = self._expand_compact_mask(setup, cmask)
                 else:
-                    mask = np.asarray(
-                        self._device_mask_and_agg(
-                            plan, setup, lambda cols, m, xp: m,
-                            cache_key=("mask",),
-                        )
+                    dmask = self._device_mask_and_agg(
+                        plan, setup, lambda cols, m, xp: m,
+                        cache_key=("mask",),
                     )
+                    with tracing.span("scan.sync"):
+                        mask = np.asarray(dmask)
             except Exception as e:
                 if os.environ.get("GEOMESA_TPU_STRICT_DEVICE"):
                     raise
@@ -1499,7 +1510,10 @@ class Executor:
         )
         if out is None:
             return np.zeros((height, width), np.float32)
-        return np.asarray(out) if as_numpy else out
+        if not as_numpy:
+            return out
+        with tracing.span("scan.sync"):
+            return np.asarray(out)
 
     # -- curve-aligned density (the index-native heatmap) ------------------
     def _curve_positions(self, plan: QueryPlan, level: int, block_window):

@@ -17,7 +17,7 @@ from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
-from geomesa_tpu import config, metrics, resilience
+from geomesa_tpu import config, metrics, resilience, tracing
 from geomesa_tpu.filter import ir
 from geomesa_tpu.index.partitioned import PartitionedFeatureStore
 from geomesa_tpu.kernels.registry import KernelRegistry
@@ -115,9 +115,15 @@ class PartitionedExecutor:
         # property (bucketed shard length above all) exactly as the query
         # thread does, or staged (name, L) keys would silently mismatch
         ov = config.snapshot_overrides()
+        # the span context crosses the same boundary the same way: staging
+        # spans the worker opens nest under the query's current span, so a
+        # trace shows partition i+1's host load overlapping partition i's
+        # device execution (docs/OBSERVABILITY.md)
+        tspan = tracing.snapshot()
 
         def worker():
             config.adopt_overrides(ov)
+            tracing.adopt(tspan)
             try:
                 for b in bins:
                     while not slot.acquire(timeout=0.1):
@@ -127,7 +133,8 @@ class PartitionedExecutor:
                         return
                     try:
                         child = self.store.child(b)
-                        self._stage(child, plan)
+                        with tracing.span("scan.stage", part=int(b)):
+                            self._stage(child, plan)
                     except BaseException as e:
                         out.put((b, None, e))
                     else:
@@ -219,7 +226,8 @@ class PartitionedExecutor:
         masquerade as a degraded-but-complete one."""
         try:
             resilience.fault_point("exec.partition.scan", bin=b, op=op)
-            return fn()
+            with tracing.span("scan.partition", part=int(b), op=op):
+                return fn()
         except QueryTimeoutError:
             raise
         except Exception as e:

@@ -379,6 +379,14 @@ def reset_breakers() -> None:
         _breakers.clear()
 
 
+def breaker_states() -> Dict[str, str]:
+    """name -> effective state for every registered breaker (the /healthz
+    surface in obs.py; docs/OBSERVABILITY.md)."""
+    with _breakers_lock:
+        items = list(_breakers.items())
+    return {name: b.state for name, b in items}
+
+
 # ---------------------------------------------------------------------------
 # Deterministic fault injection
 # ---------------------------------------------------------------------------

@@ -17,9 +17,15 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.flight as fl
 
-from geomesa_tpu import config, resilience
+from geomesa_tpu import config, resilience, tracing
 from geomesa_tpu.resilience import QueryTimeoutError
 from geomesa_tpu.stats import sketches as sk
+
+#: Flight header carrying the client's trace id (lower-case: gRPC metadata
+#: keys are case-normalized). The server middleware reads it and opens its
+#: server-side root span with the SAME id, so client and server spans (and
+#: both audit events) join on one trace (docs/OBSERVABILITY.md).
+TRACE_HEADER = "x-geomesa-trace-id"
 
 #: structured error-code prefix on Flight error messages (PROTOCOL.md §7.1):
 #: "[GM-ARG] unknown schema 'x'" — lets clients classify retryable vs fatal
@@ -103,8 +109,14 @@ class GeoFlightClient:
         return t
 
     def _call_options(self) -> Optional[fl.FlightCallOptions]:
+        kw = {}
         t = self._effective_timeout_s()
-        return fl.FlightCallOptions(timeout=t) if t is not None else None
+        if t is not None:
+            kw["timeout"] = t
+        tid = tracing.current_trace_id()
+        if tid is not None:
+            kw["headers"] = [(TRACE_HEADER.encode(), tid.encode())]
+        return fl.FlightCallOptions(**kw) if kw else None
 
     def _reconnect(self):
         """Swap in a fresh channel (the old one may be a stale connection
@@ -142,8 +154,15 @@ class GeoFlightClient:
                 on_retry=lambda i, e: self._reconnect(),
             )
 
+        # span the RPC: a child when a query trace is already open, else a
+        # fresh root (a bare client call is its own trace) — either way the
+        # trace id is on the context when _call_options builds the headers
+        cm = tracing.span("sidecar.call", site=fault_site)
+        if cm is tracing.NOOP:
+            cm = tracing.start("sidecar.call", site=fault_site)
         try:
-            out = run()
+            with cm:
+                out = run()
         except Exception as e:
             code = error_code(e)
             if code in ("GM-ARG", "GM-TIMEOUT"):

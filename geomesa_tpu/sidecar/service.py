@@ -25,6 +25,7 @@ sparse DensityScan encoding, DensityScan.scala:95-106).
 from __future__ import annotations
 
 import json
+import re
 import threading
 from typing import Dict, Iterator, Optional
 
@@ -32,12 +33,52 @@ import numpy as np
 import pyarrow as pa
 import pyarrow.flight as fl
 
+from geomesa_tpu import tracing
 from geomesa_tpu.api.dataset import GeoDataset, Query
 
 
 #: RPC protocol version; clients refuse pushdown when the major differs
 #: (the reference's server-side iterator-version compatibility contract)
 PROTOCOL_VERSION = 1
+
+#: header carrying the client's trace id (sidecar/client.py TRACE_HEADER)
+_TRACE_HEADER = "x-geomesa-trace-id"
+
+
+class _TraceMiddleware(fl.ServerMiddleware):
+    """Per-call carrier of the client's trace id (read from the Flight
+    headers by the factory; the handlers fetch it via context)."""
+
+    def __init__(self, trace_id: Optional[str]):
+        self.trace_id = trace_id
+
+
+_TRACE_ID_RE = re.compile(r"^[0-9A-Za-z_-]{1,64}$")
+
+
+class _TraceMiddlewareFactory(fl.ServerMiddlewareFactory):
+    def start_call(self, info, headers):
+        vals = headers.get(_TRACE_HEADER) or headers.get(
+            _TRACE_HEADER.encode()
+        )
+        if not vals:
+            return None
+        v = vals[0]
+        tid = v.decode(errors="replace") if isinstance(v, bytes) else str(v)
+        # the id flows verbatim into audit hints and slow-trace JSONL:
+        # refuse anything that isn't a short token (log-injection /
+        # oversized-header hygiene; our own ids are 16 hex chars)
+        if not _TRACE_ID_RE.match(tid):
+            return None
+        return _TraceMiddleware(tid)
+
+
+def _context_trace_id(context) -> Optional[str]:
+    try:
+        mw = context.get_middleware("geomesa-trace")
+    except Exception:
+        return None
+    return mw.trace_id if mw is not None else None
 
 
 def _lib_version() -> str:
@@ -196,10 +237,27 @@ class _QueryThread:
 class GeoFlightServer(fl.FlightServerBase):
     def __init__(self, dataset: Optional[GeoDataset] = None,
                  location: str = "grpc+tcp://127.0.0.1:0", **kw):
-        super().__init__(location, **kw)
+        mw = dict(kw.pop("middleware", None) or {})
+        mw.setdefault("geomesa-trace", _TraceMiddlewareFactory())
+        super().__init__(location, middleware=mw, **kw)
         self.dataset = dataset if dataset is not None else GeoDataset()
         self._lock = threading.Lock()
         self._qt = _QueryThread()
+
+    def _run_traced(self, context, name: str, fn):
+        """Run ``fn`` on the query thread under a server-side root span
+        that ADOPTS the client's trace id from the Flight header (so the
+        server audit event and any server-side spans share the client's
+        trace). ``force``: an incoming header is honored even when this
+        process's own tracing knob is off — the client already opted in."""
+        tid = _context_trace_id(context)
+
+        def go():
+            with tracing.start(name, trace_id=tid, force=tid is not None,
+                               remote=tid is not None):
+                return fn()
+
+        return self._qt.run(go)
 
     def shutdown(self, *a, **kw):
         # stop the worker AFTER Flight drains active RPCs — those RPCs hop
@@ -212,7 +270,9 @@ class GeoFlightServer(fl.FlightServerBase):
     # -- reads -------------------------------------------------------------
     @_spec_errors
     def do_get(self, context, ticket: fl.Ticket) -> fl.RecordBatchStream:
-        return self._qt.run(lambda: self._do_get(ticket))
+        return self._run_traced(
+            context, "sidecar.do_get", lambda: self._do_get(ticket)
+        )
 
     def _do_get(self, ticket: fl.Ticket) -> fl.RecordBatchStream:
         opts = json.loads(ticket.ticket.decode())
@@ -345,14 +405,16 @@ class GeoFlightServer(fl.FlightServerBase):
                     raise
             return n
 
-        n = self._qt.run(ingest)
+        n = self._run_traced(context, "sidecar.do_put", ingest)
         writer  # (no app-metadata channel needed; count via describe/count)
         return n
 
     # -- actions -----------------------------------------------------------
     @_spec_errors
     def do_action(self, context, action: fl.Action) -> Iterator[fl.Result]:
-        return self._qt.run(lambda: self._do_action(action))
+        return self._run_traced(
+            context, "sidecar.do_action", lambda: self._do_action(action)
+        )
 
     def _do_action(self, action: fl.Action) -> Iterator[fl.Result]:
         body = json.loads(action.body.to_pybytes().decode()) if action.body else {}

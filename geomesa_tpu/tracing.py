@@ -1,0 +1,350 @@
+"""Span-tree query tracing (docs/OBSERVABILITY.md).
+
+Answers "where did this query's 40 ms go?": each query opens a root span
+(``start``), every stage on the way down — plan, cache cell lookups, per
+partition staging, ``device_put``, kernel dispatch, device sync, Flight
+hops — opens a child (``span``), and the finished tree is:
+
+* stamped into the query's audit event / explain output by its
+  ``trace_id``;
+* routed into the fixed-bucket latency histograms (``trace.<stage>`` in
+  the metrics registry) so /metrics carries p50/p90/p99 per stage;
+* written as one JSONL record through the audit appender when the query
+  exceeds ``geomesa.trace.slow.ms`` (the slow-query log), and kept in an
+  in-memory ring served by ``/debug/queries``.
+
+Cheap when off: the current span lives in a :mod:`contextvars` ContextVar,
+and with no active trace ``span()`` is a single ContextVar read returning a
+shared no-op singleton — no allocation, no lock, no clock read (asserted by
+``tests/test_tracing.py`` and the bench smoke ``trace_overhead_pct`` gate).
+
+Cross-thread: the partition prefetch worker adopts the query thread's span
+context exactly the way it adopts config overrides (:func:`snapshot` /
+:func:`adopt`); the sidecar propagates ``trace_id`` as a Flight header so
+server-side spans (and the server audit) share the client's trace id.
+Span mutation is lock-protected on the owning :class:`Trace` — the
+prefetch worker appends staging spans concurrently with the query thread.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import json
+import threading
+import time
+import uuid
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+from geomesa_tpu import config, metrics
+
+#: the innermost open span of the calling context (None = not tracing)
+_current: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
+    "geomesa_trace_span", default=None
+)
+
+
+class _NoopSpan:
+    """Shared do-nothing span: the entire tracing surface when disabled.
+    A singleton so the disabled hot path allocates nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+
+NOOP = _NoopSpan()
+
+
+class Trace:
+    """One query's span tree: id, root, and the bounded span budget."""
+
+    __slots__ = ("trace_id", "root", "max_spans", "n_spans", "dropped",
+                 "profiler", "lock", "finished", "slow_logged")
+
+    def __init__(self, trace_id: Optional[str] = None):
+        self.trace_id = trace_id or uuid.uuid4().hex[:16]
+        self.root: Optional[Span] = None
+        cap = config.TRACE_MAX_SPANS.to_int()
+        self.max_spans = 512 if cap is None else max(cap, 1)
+        self.n_spans = 0
+        self.dropped = 0
+        self.profiler = bool(config.TRACE_JAX_PROFILER.to_bool())
+        self.lock = threading.Lock()
+        self.finished = False
+        self.slow_logged = False
+
+    def admit(self) -> bool:
+        """Reserve one span slot (False = budget exhausted, span dropped)."""
+        with self.lock:
+            if self.n_spans >= self.max_spans:
+                self.dropped += 1
+                return False
+            self.n_spans += 1
+            return True
+
+
+class Span:
+    """One timed stage. Context manager; durations are monotonic-clock.
+
+    Children attach under the span that was current when they were
+    opened, so trees assemble correctly even when stages run on an
+    adopted worker thread (the trace lock orders the appends)."""
+
+    __slots__ = ("name", "trace", "parent", "attrs", "children",
+                 "t0", "duration_ms", "_token", "_annotation")
+
+    def __init__(self, name: str, trace: Trace, parent: "Optional[Span]",
+                 attrs: Optional[Dict[str, Any]] = None):
+        self.name = name
+        self.trace = trace
+        self.parent = parent
+        self.attrs = attrs or {}
+        self.children: List[Span] = []
+        self.t0 = 0.0
+        self.duration_ms = 0.0
+        self._token = None
+        self._annotation = None
+
+    def set(self, **attrs) -> "Span":
+        """Attach attributes to an open (or closed) span."""
+        with self.trace.lock:
+            self.attrs.update(attrs)
+        return self
+
+    def __enter__(self) -> "Span":
+        self._token = _current.set(self)
+        if self.trace.profiler:
+            self._annotation = _jax_annotation(self.name)
+            if self._annotation is not None:
+                self._annotation.__enter__()
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        self.finish()
+        return False
+
+    def finish(self) -> None:
+        """Close the span without touching the context var — for spans
+        whose lifetime outlives the opening frame (the streamed
+        ``query_batches`` root closes at stream end, possibly from the
+        consumer's iteration). ``__exit__`` routes through here."""
+        end = time.perf_counter()
+        self.duration_ms = (end - self.t0) * 1e3
+        if self._annotation is not None:
+            self._annotation.__exit__(None, None, None)
+            self._annotation = None
+        # per-stage latency histogram: p50/p90/p99 derivable from /metrics
+        metrics.observe("trace." + self.name, self.duration_ms / 1e3)
+        if self.parent is None:
+            _finish_trace(self.trace)
+        elif self.trace.finished:
+            # a span that OUTLIVED its root (a streamed query's scan spans
+            # finish at stream end, after the sidecar's do_get root
+            # returned the stream object): stretch the root to cover it
+            # and re-evaluate the slow-query threshold, so a slow streamed
+            # query is still logged (once — _finish_trace is idempotent
+            # per trace)
+            root = self.trace.root
+            if root is not None:
+                root.duration_ms = max(
+                    root.duration_ms, (end - root.t0) * 1e3
+                )
+                _finish_trace(self.trace)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """The span subtree as plain JSON-able data (slow-query records,
+        the CLI ``trace`` command, /debug/queries)."""
+        with self.trace.lock:
+            children = list(self.children)
+            attrs = dict(self.attrs)
+        out: Dict[str, Any] = {
+            "name": self.name,
+            "ms": round(self.duration_ms, 3),
+        }
+        if attrs:
+            out["attrs"] = {k: _jsonable(v) for k, v in attrs.items()}
+        if children:
+            out["children"] = [c.to_dict() for c in children]
+        return out
+
+
+def _jsonable(v):
+    if isinstance(v, (str, int, float, bool)) or v is None:
+        return v
+    return str(v)
+
+
+def _jax_annotation(name: str):
+    try:
+        import jax
+
+        return jax.profiler.TraceAnnotation("geomesa:" + name)
+    except Exception:
+        return None
+
+
+# ---------------------------------------------------------------------------
+# public API
+# ---------------------------------------------------------------------------
+
+
+def enabled() -> bool:
+    return bool(config.TRACE_ENABLED.to_bool())
+
+
+def start(name: str, trace_id: Optional[str] = None, force: bool = False,
+          **attrs):
+    """Open a ROOT span (one per query). No-op singleton unless tracing is
+    enabled — or ``force`` is set (the sidecar server honors an incoming
+    Flight trace header even when its own tracing knob is off, so the
+    server audit carries the client's trace id). Called with a trace
+    already active on the context (a dataset op inside the sidecar's
+    server root, a nested public API call), it JOINS that trace as a
+    child instead of shadowing it with a second root."""
+    if _current.get() is not None:
+        return span(name, **attrs)
+    if not (enabled() or (force and trace_id)):
+        return NOOP
+    trace = Trace(trace_id)
+    root = Span(name, trace, None, attrs or None)
+    trace.root = root
+    trace.n_spans = 1
+    return root
+
+
+def span(name: str, **attrs):
+    """Open a child span under the calling context's current span. With no
+    active trace this is a single ContextVar read returning the shared
+    no-op singleton — the disabled fast path."""
+    cur = _current.get()
+    if cur is None:
+        return NOOP
+    trace = cur.trace
+    if not trace.admit():
+        return NOOP
+    child = Span(name, trace, cur, attrs or None)
+    with trace.lock:
+        cur.children.append(child)
+    return child
+
+
+def event(name: str, **attrs) -> None:
+    """A zero-duration marker attached to the current span (e.g. a kernel
+    recompile inside the query that paid for it). No-op without a trace."""
+    cur = _current.get()
+    if cur is None:
+        return
+    trace = cur.trace
+    if not trace.admit():
+        return
+    child = Span(name, trace, cur, attrs or None)
+    with trace.lock:
+        cur.children.append(child)
+
+
+def current_span():
+    """The innermost open span, or None."""
+    return _current.get()
+
+
+def current_trace_id() -> Optional[str]:
+    cur = _current.get()
+    return None if cur is None else cur.trace.trace_id
+
+
+def snapshot():
+    """The calling thread's current span, for cross-thread adoption
+    (the partition prefetch worker pairs this with :func:`adopt` exactly
+    like ``config.snapshot_overrides``/``adopt_overrides``)."""
+    return _current.get()
+
+
+def adopt(span_) -> None:
+    """Install a :func:`snapshot` span as this thread's current span, so
+    worker-side ``span()`` calls nest under the query's tree."""
+    _current.set(span_)
+
+
+# ---------------------------------------------------------------------------
+# slow-query log + recent-trace ring
+# ---------------------------------------------------------------------------
+
+_slow_lock = threading.Lock()
+_slow: "deque" = deque(maxlen=256)
+_last: List[Optional[Trace]] = [None]
+
+
+def last_trace() -> Optional[Trace]:
+    """The most recently completed trace (CLI ``trace`` subcommand,
+    tests) — None when tracing never ran."""
+    return _last[0]
+
+
+def _finish_trace(trace: Trace) -> None:
+    """Root closed: threshold-check against geomesa.trace.slow.ms and, when
+    slow, record the full tree (ring + the audit JSONL appender, so file
+    ordering matches the query events around it)."""
+    root = trace.root
+    if root is None:
+        return
+    trace.finished = True
+    _last[0] = trace
+    try:
+        thresh = config.TRACE_SLOW_MS.to_float()
+    except (TypeError, ValueError):
+        thresh = None
+    if thresh is None or root.duration_ms < thresh or trace.slow_logged:
+        return
+    trace.slow_logged = True
+    rec = {
+        "kind": "slow_trace",
+        "trace_id": trace.trace_id,
+        "total_ms": round(root.duration_ms, 3),
+        "threshold_ms": thresh,
+        "dropped_spans": trace.dropped,
+        "date": time.time(),
+        "tree": root.to_dict(),
+    }
+    with _slow_lock:
+        _slow.append(rec)
+    from geomesa_tpu import audit
+
+    audit.append_record(rec)
+    metrics.inc("trace.slow")
+
+
+def slow_traces(n: int = 50) -> List[Dict[str, Any]]:
+    """Most recent slow-query span trees (newest last)."""
+    with _slow_lock:
+        return list(_slow)[-n:]
+
+
+def clear_slow_traces() -> None:
+    with _slow_lock:
+        _slow.clear()
+
+
+def render(tree: Dict[str, Any], indent: int = 0) -> str:
+    """Human-readable span tree (CLI ``trace`` subcommand)."""
+    pad = "  " * indent
+    attrs = tree.get("attrs")
+    suffix = (
+        " [" + ", ".join(f"{k}={v}" for k, v in attrs.items()) + "]"
+        if attrs else ""
+    )
+    lines = [f"{pad}{tree['name']}: {tree.get('ms', 0.0):.3f} ms{suffix}"]
+    for c in tree.get("children", ()):
+        lines.append(render(c, indent + 1))
+    return "\n".join(lines)

@@ -17,6 +17,13 @@ serves the same surface as JSON:
         via the curve-aligned density — no scatter)
     GET /api/schemas/<name>/features?cql=&max=       -> GeoJSON
 
+Observability surface (obs.py; docs/OBSERVABILITY.md — the same routes the
+standalone obs server exposes, mounted here so one port serves both):
+
+    GET /metrics        -> prometheus text (histograms included)
+    GET /healthz        -> breaker/quarantine/device health JSON
+    GET /debug/queries  -> recent audits + degradations + slow traces
+
 Write surface (the JVM DataStore's zero-dependency transport; the
 reference's DataStore mutates through the same catalog the servlets read):
 
@@ -74,8 +81,13 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802
         from geomesa_tpu.api.dataset import Query
+        from geomesa_tpu import obs
 
         ds = self.dataset
+        out = obs.handle(self.path, ds)
+        if out is not None:  # /metrics, /healthz, /debug/queries
+            code, ctype, body = out
+            return self._send(body, code, content_type=ctype)
         parsed = urllib.parse.urlparse(self.path)
         q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
         parts = [p for p in parsed.path.split("/") if p]

@@ -329,3 +329,45 @@ def test_cache_cell_kernels_survive_epoch_bump():
         assert _recompiles() == r0, (
             "cold re-decomposition after a mutation must reuse cell kernels"
         )
+
+
+# ---------------------------------------------------------------------------
+# per-site recompile alert (docs/OBSERVABILITY.md; ROADMAP item closed)
+# ---------------------------------------------------------------------------
+
+
+def test_per_site_recompile_counters_and_alert_trip():
+    from geomesa_tpu.kernels import registry as kreg
+
+    kreg.reset_alert()
+    ds = _mk_ds(n=8_000)
+    q = _bbox_q(-100, 30, -80, 45)
+    site_counter = metrics.registry().counter(
+        f"{metrics.KERNEL_RECOMPILES}.count"
+    )
+    c0 = site_counter.value
+    # threshold 0: the FIRST fresh trace at any site inside one query
+    # window trips the alert gauge
+    with config.KERNEL_ALERT_THRESHOLD.scoped("0"):
+        assert ds.count("t", q) > 0
+    assert site_counter.value > c0, "per-site recompile counter must move"
+    gauge = metrics.registry().gauge(metrics.KERNEL_RECOMPILE_ALERT)
+    assert gauge.value >= 1, "alert gauge must trip past the threshold"
+    assert metrics.registry().counter(
+        metrics.KERNEL_RECOMPILE_ALERTS
+    ).value >= 1
+    assert kreg.query_recompiles().get("count", 0) >= 1
+    # surfaced in the exposition format (the /metrics contract)
+    text = metrics.registry().prometheus()
+    assert "geomesa_kernel_recompiles_count " in text
+    assert "geomesa_kernel_recompile_alert " in text
+    # a healthy (compile-free) warm repeat does NOT clear the latch: the
+    # gauge stays visible for the scrape TTL so a trip can't be raced
+    # away by the next query's window reset
+    with config.KERNEL_ALERT_THRESHOLD.scoped("0"):
+        r0 = _recompiles()
+        assert ds.count("t", q) > 0
+    assert _recompiles() == r0, "warm repeat must be compile-free"
+    assert gauge.value >= 1, "alert latch must survive the next query"
+    kreg.reset_alert()
+    assert gauge.value == 0
