@@ -373,6 +373,74 @@ def main():
         f"({n_q} warm counts in {warm_count_s:.2f}s)\n"
     )
 
+    # Concurrent serving (docs/SERVING.md): N=8 identical-shape count
+    # queries, serial vs fused through the scheduler. The fused batch must
+    # ACTUALLY fuse — at most 2 device dispatches for the whole batch (the
+    # ci.yml smoke gate) — and return bit-identical counts. queue-wait and
+    # fusion-batch distributions ride along from the metrics registry.
+    serving_keys = {}
+    if os.environ.get("GEOMESA_BENCH_SERVING", "1") != "0":
+        import threading as _threading
+
+        from geomesa_tpu.serving import fuse as _fuse
+
+        N_FUSE = 8
+        serial_counts = []
+        ds.count("gdelt", ecql)  # warm (plan + kernel + windows)
+        t0 = time.time()
+        for _ in range(N_FUSE):
+            serial_counts.append(ds.count("gdelt", ecql))
+        serving_serial_s = time.time() - t0
+        sched = ds.serving.start()
+        _disp = _metrics.registry().counter(_metrics.EXEC_DEVICE_DISPATCH)
+        gate = _threading.Event()
+        stall = sched.submit(lambda: gate.wait(30), user="warm", op="stall")
+        opts = {"ecql": ecql}
+        futs = [
+            sched.submit(
+                lambda: ds.count("gdelt", ecql),
+                user=f"client{i % 4}", op="count",
+                fuse=_fuse.make_spec(ds, "count", "gdelt", opts),
+            )
+            for i in range(N_FUSE)
+        ]
+        d0 = _disp.value
+        t0 = time.time()
+        gate.set()
+        fused_counts = [f.result(120) for f in futs]
+        serving_fused_s = time.time() - t0
+        stall.result(30)
+        fused_dispatches = _disp.value - d0
+        sched.stop()
+        assert fused_counts == serial_counts, (
+            f"fused {fused_counts[:2]} != serial {serial_counts[:2]}"
+        )
+        wait_hist = _metrics.registry().histogram(
+            _metrics.SERVING_QUEUE_WAIT
+        )
+        batch_hist = _metrics.registry().histogram(
+            _metrics.SERVING_FUSION_BATCH,
+            buckets=_metrics.FUSION_BATCH_BUCKETS, unit=None,
+        )
+        serving_keys = {
+            "concurrent_qps": round(
+                N_FUSE / max(serving_fused_s, 1e-9), 1
+            ),
+            "serving_fused_speedup": round(
+                serving_serial_s / max(serving_fused_s, 1e-9), 2
+            ),
+            "fused_batch_p50": batch_hist.quantile(0.5),
+            "fused_dispatches": int(fused_dispatches),
+            "queue_wait_p99_ms": round(wait_hist.quantile(0.99) * 1e3, 3),
+        }
+        sys.stderr.write(
+            f"serving: {N_FUSE} identical counts serial="
+            f"{serving_serial_s * 1e3:.1f}ms fused="
+            f"{serving_fused_s * 1e3:.1f}ms "
+            f"dispatches={fused_dispatches} "
+            f"batch_p50={serving_keys['fused_batch_p50']}\n"
+        )
+
     # Aggregate-cache effectiveness (docs/CACHE.md): cold vs warm latency
     # with the cache enabled — an exact repeat (whole-result hit) and an
     # overlapping pan (partial-cover reuse: only the newly exposed strip
@@ -422,6 +490,9 @@ def main():
         "cache_hit": _metric("cache.hit"),
         "cache_partial": _metric("cache.partial"),
         "cache_miss": _metric("cache.miss"),
+        "serving_fused": _metric("serving.fused"),
+        "serving_shed": _metric("serving.shed.deadline"),
+        "device_dispatches": _metric("exec.device.dispatch"),
         "density_p50_ms": round(_scan_hist.quantile(0.5) * 1e3, 3),
         "density_p99_ms": round(_scan_hist.quantile(0.99) * 1e3, 3),
     }
@@ -453,6 +524,7 @@ def main():
         "recompiles_per_100_queries": round(recompiles_per_100, 1),
         "trace_overhead_pct": round(trace_overhead_pct, 2),
         "metrics": metrics_snapshot,
+        **serving_keys,
         **cache_keys,
         **annotations,
     }))

@@ -17,6 +17,7 @@ _LAZY = {
     "GeoDataset": "geomesa_tpu.api.dataset",
     "Query": "geomesa_tpu.api.dataset",
     "ArrowDataStore": "geomesa_tpu.io.arrow_store",
+    "QueryScheduler": "geomesa_tpu.serving",
 }
 
 

@@ -121,15 +121,20 @@ class FeatureCollection:
 
 
 def _traced(op: str):
-    """Open one ROOT span per public query operation (docs/OBSERVABILITY.md).
-    No-op singleton when ``geomesa.trace.enabled`` is off; when on, every
-    stage span below (plan, cache cells, partitions, device_put, kernel,
-    sync) nests under this root and the trace_id lands in the audit event."""
+    """Open one ROOT span per public query operation (docs/OBSERVABILITY.md)
+    and pass it through serving admission (docs/SERVING.md): the local-path
+    analog of the sidecar's admission queue — an op whose deadline budget is
+    already expired (or provably unmeetable against recent service times)
+    is SHED with a typed error before any planning or device work, and the
+    op's wall time lands in the per-user serving ledger that backs both
+    fair-share and the /debug/queries rollups. Admission is reentrant
+    (nested public ops account once) and a no-op inside a scheduler-
+    dispatched ticket (the ticket already accounts)."""
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(self, name, *args, **kw):
-            with tracing.start(op, schema=name):
+            with tracing.start(op, schema=name), self.serving.admit(op):
                 return fn(self, name, *args, **kw)
 
         return wrapper
@@ -154,6 +159,13 @@ class GeoDataset:
         #: this dataset, including all Flight queries when a sidecar serves
         #: it. Inert unless geomesa.cache.enabled=true.
         self.cache = AggregateCache()
+        #: serving scheduler (docs/SERVING.md): local ops pass through its
+        #: inline admission (deadline shed + per-user ledger); a Flight
+        #: sidecar serving this dataset starts its dispatch thread, so
+        #: Flight and local ops share ONE fair-share domain and ledger.
+        from geomesa_tpu.serving import QueryScheduler
+
+        self.serving = QueryScheduler()
         self._stores: Dict[str, FeatureStore] = {}
         self._executors: Dict[str, Executor] = {}
         self.metadata: Dict[str, Dict[str, str]] = {}
@@ -457,6 +469,32 @@ class GeoDataset:
             for k in [k for k in cache if k[0] == name]:
                 del cache[k]
 
+    @staticmethod
+    def _plan_audit_extras(plan) -> Dict[str, Any]:
+        """Execution-path hints shared by every audit writer (the normal
+        :meth:`_audit` and the fused batch's per-member events): exec_path,
+        device timings, and the degraded-partition account. Pops
+        ``degraded`` — the plan object is cached/reused across calls, and
+        each execution's skip list must be reported exactly once
+        (docs/RESILIENCE.md)."""
+        extras: Dict[str, Any] = {}
+        path = plan.__dict__.get("exec_path")
+        if path:
+            extras["exec_path"] = {
+                k: v for k, v in path.items() if v is not None
+            }
+        if "device_coarse_ms" in plan.__dict__:
+            extras["device_coarse_ms"] = round(
+                plan.__dict__["device_coarse_ms"], 3
+            )
+        degraded = plan.__dict__.pop("degraded", None)
+        if degraded:
+            extras["degraded"] = [
+                {"part": d.part, "error": d.error, "phase": d.phase}
+                for d in degraded
+            ]
+        return extras
+
     def _audit(self, name: str, q: Query, plan, t_scan0: float, hits: int,
                op: str = "query"):
         hints = {"op": op, "index": plan.index_name,
@@ -467,29 +505,15 @@ class GeoDataset:
         tid = tracing.current_trace_id()
         if tid is not None:
             hints["trace_id"] = tid
-        path = plan.__dict__.get("exec_path")
-        if path:
-            hints["exec_path"] = {
-                k: v for k, v in path.items() if v is not None
-            }
-        if "device_coarse_ms" in plan.__dict__:
-            hints["device_coarse_ms"] = round(
-                plan.__dict__["device_coarse_ms"], 3
-            )
-        # degraded executions carry their skipped-partition account into the
-        # audit event (docs/RESILIENCE.md): the aggregate is exact over the
-        # surviving partitions, and THIS is the record of what was dropped.
-        # pop: the plan object is cached/reused across calls.
-        degraded = plan.__dict__.pop("degraded", None)
-        if degraded:
-            hints["degraded"] = [
-                {"part": d.part, "error": d.error, "phase": d.phase}
-                for d in degraded
-            ]
+        hints.update(self._plan_audit_extras(plan))
         self.audit.record(
             name, plan.ecql, hints,
             plan.__dict__.get("plan_time_ms", 0.0),
             (time.perf_counter() - t_scan0) * 1e3, hits,
+            # serving identity (docs/SERVING.md): the admitted user —
+            # Flight header or geomesa.user — lands on the QueryEvent, so
+            # the audit log and the fair-share ledger attribute alike
+            user=self.serving.current_user() or "",
             scanned=plan.__dict__.get("scanned_rows", 0),
             table_rows=plan.__dict__.get("table_rows", 0),
         )
@@ -775,7 +799,11 @@ class GeoDataset:
             root.t0 = time.perf_counter()
             tracing.adopt(root)
         try:
-            st, q, plan = self._plan(name, q)
+            # serving admission (docs/SERVING.md): shed-before-work + the
+            # per-user ledger; the admitted span covers the eager planning
+            # (the stream body is driven by the consumer's iteration)
+            with self.serving.admit("query_batches"):
+                st, q, plan = self._plan(name, q)
             if q.srid is not None and q.srid != 4326:
                 from geomesa_tpu.utils import reproject as rp
 
@@ -891,31 +919,124 @@ class GeoDataset:
         st, q, plan = self._plan(name, q)
         if bbox is None:
             bbox = self.bounds(name) or (-180.0, -90.0, 180.0, 90.0)
-        n_blocks = 1 << level
-        fx = lambda v: (v + 180.0) / 360.0 * n_blocks  # noqa: E731
-        fy = lambda v: (v + 90.0) / 180.0 * n_blocks  # noqa: E731
-        # inclusive outward snap: floor on BOTH edges — a bbox edge exactly
-        # on a block boundary includes the block CONTAINING it, matching
-        # the inclusive x <= xmax semantics of the equivalent BBOX filter
-        ix0 = int(np.clip(np.floor(fx(bbox[0])), 0, n_blocks - 1))
-        ix1 = int(np.clip(np.floor(fx(bbox[2])), ix0, n_blocks - 1))
-        iy0 = int(np.clip(np.floor(fy(bbox[1])), 0, n_blocks - 1))
-        iy1 = int(np.clip(np.floor(fy(bbox[3])), iy0, n_blocks - 1))
+        window, snapped = self._snap_blocks(bbox, level)
         t0 = time.perf_counter()
         with metrics.registry().timer("query.density").time(), \
                 query_deadline(self._timeout_s()):
             grid = self.cache.density_curve(
-                self, st, q, plan, level, (ix0, iy0, ix1, iy1), weight
+                self, st, q, plan, level, window, weight
             )
         self._audit(name, q, plan, t0, int(np.count_nonzero(grid)),
                     op="density_curve")
+        return grid, snapped
+
+    @staticmethod
+    def _snap_blocks(bbox, level: int):
+        """Snap a bbox outward to the level-``level`` morton block grid:
+        ``((ix0, iy0, ix1, iy1), snapped_bbox)``. Inclusive outward snap:
+        floor on BOTH edges — a bbox edge exactly on a block boundary
+        includes the block CONTAINING it, matching the inclusive
+        x <= xmax semantics of the equivalent BBOX filter."""
+        n_blocks = 1 << level
+        fx = lambda v: (v + 180.0) / 360.0 * n_blocks  # noqa: E731
+        fy = lambda v: (v + 90.0) / 180.0 * n_blocks  # noqa: E731
+        ix0 = int(np.clip(np.floor(fx(bbox[0])), 0, n_blocks - 1))
+        ix1 = int(np.clip(np.floor(fx(bbox[2])), ix0, n_blocks - 1))
+        iy0 = int(np.clip(np.floor(fy(bbox[1])), 0, n_blocks - 1))
+        iy1 = int(np.clip(np.floor(fy(bbox[3])), iy0, n_blocks - 1))
         snapped = (
             ix0 * 360.0 / n_blocks - 180.0,
             iy0 * 180.0 / n_blocks - 90.0,
             (ix1 + 1) * 360.0 / n_blocks - 180.0,
             (iy1 + 1) * 180.0 / n_blocks - 90.0,
         )
-        return grid, snapped
+        return (ix0, iy0, ix1, iy1), snapped
+
+    def density_curve_batch(self, name: str, query: "str | Query" = "INCLUDE",
+                            level: int = 9, bboxes=(), weight: Optional[str] = None,
+                            members: Optional[List[Dict[str, Any]]] = None):
+        """N curve-aligned density crops of ONE layer + filter in a single
+        device pass (docs/SERVING.md): the cross-query fusion entry the
+        serving scheduler uses when concurrent clients ask for different
+        tiles of the same heatmap. Plans once, stacks the per-crop CDF
+        gather positions over the query axis, and de-interleaves
+        bit-identically versus calling :meth:`density_curve` per bbox.
+
+        Returns ``[(grid, snapped_bbox), ...]`` in ``bboxes`` order (a
+        ``None`` bbox uses the store bounds). ``members`` (optional, same
+        length): per-member metadata dicts — ``trace_id``/``user`` land in
+        that member's audit event so fused queries stay individually
+        attributable. Bypasses the aggregate cache (each member is a
+        fresh crop; repeats are served by fusion itself)."""
+        if not 0 < level <= 15:
+            raise ValueError("level must be in 1..15 (grid = 4^level blocks)")
+        q = Query(ecql=query) if isinstance(query, str) else query
+        import dataclasses
+
+        q = dataclasses.replace(q, index="z2")
+        bboxes = list(bboxes)
+        if members is not None and len(members) != len(bboxes):
+            raise ValueError("members must align with bboxes")
+        with tracing.start("density_curve_batch", schema=name,
+                           batch=len(bboxes)), \
+                self.serving.admit("density_curve"):
+            st, q, plan = self._plan(name, q)
+            default_bbox = None
+            windows, snaps = [], []
+            for bb in bboxes:
+                if bb is None:
+                    if default_bbox is None:
+                        default_bbox = (
+                            self.bounds(name)
+                            or (-180.0, -90.0, 180.0, 90.0)
+                        )
+                    bb = default_bbox
+                w, s = self._snap_blocks(bb, level)
+                windows.append(w)
+                snaps.append(s)
+            t0 = time.perf_counter()
+            with metrics.registry().timer("query.density").time(), \
+                    query_deadline(self._timeout_s()):
+                ex = self._executor(st)
+                if hasattr(ex, "density_curve_batch"):
+                    grids = ex.density_curve_batch(plan, level, windows,
+                                                   weight)
+                else:  # executor without the fused entry: per-crop serial
+                    grids = [
+                        ex.density_curve(plan, level, w, weight)
+                        for w in windows
+                    ]
+            scan_ms = (time.perf_counter() - t0) * 1e3
+            # one audit event PER MEMBER: fused queries stay individually
+            # attributable (ISSUE acceptance; docs/SERVING.md). The shared
+            # scan cost and execution-path extras are recorded on the
+            # first member; the rest carry 0 so summing scan_time_ms over
+            # events never double-counts.
+            extras = self._plan_audit_extras(plan)
+            for i, g in enumerate(grids):
+                hints: Dict[str, Any] = {
+                    "op": "density_curve", "index": plan.index_name,
+                    "fused": True, "fused_batch": len(grids),
+                    "fused_member": i, "level": level,
+                }
+                m = members[i] if members is not None else {}
+                tid = m.get("trace_id") or tracing.current_trace_id()
+                if tid is not None:
+                    hints["trace_id"] = tid
+                if m.get("user"):
+                    hints["user"] = m["user"]
+                if i == 0:
+                    hints.update(extras)
+                self.audit.record(
+                    name, plan.ecql, hints,
+                    plan.__dict__.get("plan_time_ms", 0.0) if i == 0 else 0.0,
+                    scan_ms if i == 0 else 0.0,
+                    int(np.count_nonzero(g)),
+                    user=m.get("user") or (self.serving.current_user() or ""),
+                    scanned=plan.__dict__.get("scanned_rows", 0) if i == 0 else 0,
+                    table_rows=plan.__dict__.get("table_rows", 0),
+                )
+            return list(zip(grids, snaps))
 
     @_traced("stats")
     def stats(self, name: str, stat_spec: str,

@@ -394,3 +394,39 @@ TRACE_JAX_PROFILER = SystemProperty("geomesa.trace.jax.profiler", "false")
 #: fresh traces within ONE query trips the ``kernel.recompile.alert``
 #: gauge (warm-path regression signal; docs/PERF.md).
 KERNEL_ALERT_THRESHOLD = SystemProperty("geomesa.kernel.alert.threshold", "3")
+
+# ---------------------------------------------------------------------------
+# Serving scheduler (serving/scheduler.py; docs/SERVING.md). The sidecar's
+# single dispatch thread sits behind a bounded admission queue with
+# deadline-aware ordering, per-user fair share, and cross-query fusion of
+# compatible aggregates into one device pass.
+# ---------------------------------------------------------------------------
+
+#: Bounded admission queue depth: requests beyond it are rejected at
+#: submission with a typed [GM-OVERLOADED] error (load shedding before any
+#: planning or device work).
+SERVING_QUEUE_DEPTH = SystemProperty("geomesa.serving.queue.depth", "256")
+
+#: Cross-query fusion: compatible queued aggregates (same schema, predicate
+#: text, auths, and op shape — hence the same version-stable kernel token)
+#: coalesce into one micro-batch sharing a single device pass. Only
+#: already-queued work fuses; fusion never delays dispatch to grow a batch.
+SERVING_FUSION = SystemProperty("geomesa.serving.fusion", "true")
+
+#: Max members per fused micro-batch.
+SERVING_FUSION_MAX = SystemProperty("geomesa.serving.fusion.max", "16")
+
+#: Per-user fair share: the dispatcher serves the pending user with the
+#: least attained service time instead of global FIFO, so one user's burst
+#: cannot starve another's interactive queries. Off = strict FIFO.
+SERVING_FAIR_SHARE = SystemProperty("geomesa.serving.fair-share", "true")
+
+#: Admission-time estimate shedding: reject a request whose deadline budget
+#: is smaller than the estimated queue wait (EWMA service time x pending
+#: depth) with a typed [GM-SHED] error — before any device work.
+SERVING_SHED_ESTIMATE = SystemProperty("geomesa.serving.shed.estimate", "true")
+
+#: Identity attached to queries for fair-share accounting and the
+#: /debug/queries per-user rollups (the sidecar client forwards it as the
+#: x-geomesa-user Flight header; unset = "anonymous").
+USER = SystemProperty("geomesa.user", None)

@@ -19,6 +19,7 @@ import json
 import os
 import threading
 import uuid
+import weakref
 from dataclasses import dataclass
 from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
@@ -283,6 +284,24 @@ def scheme_from_config(cfg: Dict) -> PartitionScheme:
     raise ValueError(f"unknown partition scheme {kind!r}")
 
 
+#: live FileSystemStorage instances (weak — GC'd stores drop out), so
+#: /healthz can expose every instance's quarantine MAP (which files, which
+#: errors), not just the aggregate counters (docs/OBSERVABILITY.md)
+_instances: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def quarantine_snapshot() -> Dict[str, Dict[str, str]]:
+    """root -> {file path -> first failure} for every live storage
+    instance with a non-empty quarantine (the /healthz ``fs_quarantine``
+    payload; obs.py reads this lazily so pyarrow stays optional)."""
+    out: Dict[str, Dict[str, str]] = {}
+    for st in list(_instances):
+        qm = st.quarantined()
+        if qm:
+            out.setdefault(st.root, {}).update(qm)
+    return out
+
+
 class FileSystemStorage:
     """A directory of partitioned Parquet files + JSON metadata per type.
 
@@ -300,6 +319,7 @@ class FileSystemStorage:
         #: Quarantined files are skipped without re-parsing on later reads;
         #: strict (non-partial) reads still raise for them.
         self._quarantine: Dict[str, str] = {}
+        _instances.add(self)  # /healthz exposes each live instance's map
 
     # -- metadata ----------------------------------------------------------
     def _meta_path(self, name: str) -> str:

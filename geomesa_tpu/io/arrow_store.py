@@ -55,6 +55,27 @@ class ArrowDataStore:
             self._ft = ft  # None = infer from the file on first use
 
     # -- internals ---------------------------------------------------------
+    def _read_ipc(self):
+        """The store's IPC file, under the resilience contract
+        (docs/RESILIENCE.md): the read is a named fault point
+        (``io.arrow.read_ipc``) and transient ``OSError``s (fd pressure,
+        an NFS blip) retry in place via the standard ``geomesa.retry.*``
+        RetryPolicy — a missing file or real corruption raises
+        immediately (retrying cannot heal either)."""
+        from geomesa_tpu import resilience
+        from geomesa_tpu.io import arrow_io
+
+        def attempt():
+            resilience.fault_point("io.arrow.read_ipc", path=self.path)
+            return arrow_io.read_ipc(self.path)
+
+        return resilience.RetryPolicy.from_config().call(
+            attempt,
+            retryable=lambda e: isinstance(e, OSError)
+            and not isinstance(e, FileNotFoundError),
+            deadline=resilience.current_deadline(),
+        )
+
     def _dataset(self):
         """Lazily hydrate the file into a GeoDataset (under the lock —
         an unlocked hydration racing an append could rebuild from the
@@ -63,11 +84,10 @@ class ArrowDataStore:
             if self._ds is not None:
                 return self._ds
             from geomesa_tpu.api.dataset import GeoDataset
-            from geomesa_tpu.io import arrow_io
 
             ds = GeoDataset()
             if os.path.exists(self.path):
-                table = arrow_io.read_ipc(self.path)
+                table = self._read_ipc()
                 if self._ft is None:
                     self._ft = _infer_feature_type(
                         os.path.splitext(os.path.basename(self.path))[0],
@@ -118,12 +138,19 @@ class ArrowDataStore:
             return n
 
     def flush(self):
-        """Rewrite the IPC file with the store's current contents."""
+        """Rewrite the IPC file with the store's current contents. The
+        write is a named fault point (``io.arrow.write_ipc``); it is NOT
+        retried — the tmp-then-replace sequence is not idempotent against
+        a half-acknowledged rename, and a failed flush leaves the old
+        complete file in place (re-flush at will: ``_dirty`` stays set)."""
+        from geomesa_tpu import resilience
+
         with self._lock:
             if not self._dirty:
                 return
             ds = self._dataset()
             tmp = self.path + ".tmp"
+            resilience.fault_point("io.arrow.write_ipc", path=self.path)
             ds.export_arrow(self.name, tmp)
             os.replace(tmp, self.path)
             self._dirty = False

@@ -224,6 +224,18 @@ def dict_fingerprint(dicts: Dict[str, Any]) -> Tuple:
     return tuple(sorted((k, len(d.values)) for k, d in dicts.items()))
 
 
+def bucket_batch(n: int) -> int:
+    """Pad a fused micro-batch's member count to the next power of two, so
+    one batched-parameter kernel (its registry key carries the padded
+    member axis next to the usual version-stable token — see
+    ``Executor.density_curve_batch``) serves every batch size in the
+    bucket instead of tracing per size (docs/SERVING.md). Padded members
+    carry zero-length parameter spans and are dropped at de-interleave."""
+    if n <= 1:
+        return 1
+    return 1 << (n - 1).bit_length()
+
+
 def bucket_count(n: int) -> int:
     """Pad a per-shard window count to its shape bucket: the next power of
     two, floored at ``geomesa.compact.bucket.floor``. Identity when

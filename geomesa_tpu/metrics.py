@@ -74,11 +74,16 @@ DEFAULT_BUCKETS_S: Tuple[float, ...] = (
 
 
 class Histogram:
-    """Fixed-bucket latency histogram (seconds)."""
+    """Fixed-bucket histogram. Defaults to the latency buckets (seconds);
+    pass custom ``buckets`` plus ``unit=None`` for dimensionless
+    distributions (e.g. fusion batch sizes) — the prometheus rendering then
+    drops the ``_seconds`` suffix."""
 
-    __slots__ = ("buckets", "counts", "count", "sum_s", "_lock")
+    __slots__ = ("buckets", "counts", "count", "sum_s", "unit", "_lock")
 
-    def __init__(self, buckets: Optional[Tuple[float, ...]] = None):
+    def __init__(self, buckets: Optional[Tuple[float, ...]] = None,
+                 unit: Optional[str] = "s"):
+        self.unit = unit
         self.buckets = tuple(buckets or DEFAULT_BUCKETS_S)
         self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf overflow
         self.count = 0
@@ -192,8 +197,11 @@ class MetricRegistry:
     def timer(self, name: str) -> Timer:
         return self._get(name, Timer)
 
-    def histogram(self, name: str) -> Histogram:
-        return self._get(name, Histogram)
+    def histogram(self, name: str, buckets: Optional[Tuple[float, ...]] = None,
+                  unit: Optional[str] = "s") -> Histogram:
+        """A named histogram. ``buckets``/``unit`` apply only on first
+        registration (a histogram's shape is fixed for its lifetime)."""
+        return self._get(name, Histogram, buckets, unit)
 
     def report(self) -> Dict[str, object]:
         out: Dict[str, object] = {}
@@ -252,7 +260,8 @@ class MetricRegistry:
                 lines.append(f"{metric}_seconds_max {m.max_s:.6f}")
                 lines.extend(self._prom_hist_lines(metric + "_seconds", m.hist))
             elif isinstance(m, Histogram):
-                lines.extend(self._prom_hist_lines(metric + "_seconds", m))
+                suffix = "_seconds" if m.unit == "s" else ""
+                lines.extend(self._prom_hist_lines(metric + suffix, m))
             elif isinstance(m, (Counter, Gauge)):
                 lines.append(f"{metric} {m.value}")
         return "\n".join(lines) + "\n"
@@ -316,6 +325,37 @@ PIPELINE_PREFETCH = "pipeline.prefetch"
 #   trace.slow                 queries that exceeded geomesa.trace.slow.ms
 KERNEL_RECOMPILE_ALERT = "kernel.recompile.alert"
 KERNEL_RECOMPILE_ALERTS = "kernel.recompile.alerts"
+# Serving-scheduler metrics (serving/scheduler.py, planning/executor.py;
+# docs/SERVING.md):
+#   serving.queue.depth     gauge: tickets currently queued (all users)
+#   serving.queue.wait      histogram: admission -> dispatch latency
+#   serving.admitted        tickets admitted to the queue
+#   serving.completed       tickets whose execution finished (any outcome)
+#   serving.shed.deadline   tickets shed with [GM-SHED] (budget unmeetable)
+#   serving.shed.queue_full tickets rejected with [GM-OVERLOADED]
+#   serving.fused           tickets served via a fused batch (every member,
+#                           primary included — matches the ledger rollups)
+#   serving.fusion.batch    histogram (dimensionless): fused batch sizes
+#   exec.device.dispatch    device kernel dispatches issued by the executor
+#                           (the fusion-actually-fused bench gate counts it)
+SERVING_QUEUE_DEPTH = "serving.queue.depth"
+SERVING_QUEUE_WAIT = "serving.queue.wait"
+SERVING_ADMITTED = "serving.admitted"
+SERVING_COMPLETED = "serving.completed"
+SERVING_SHED_DEADLINE = "serving.shed.deadline"
+SERVING_SHED_QUEUE_FULL = "serving.shed.queue_full"
+SERVING_FUSED = "serving.fused"
+SERVING_FUSION_BATCH = "serving.fusion.batch"
+EXEC_DEVICE_DISPATCH = "exec.device.dispatch"
+#: fused batch-size histogram buckets (members per micro-batch)
+FUSION_BATCH_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0)
+# Stream-consumer lag (stream/live.py, stream/confluent.py;
+# docs/OBSERVABILITY.md):
+#   stream.lag          gauge: ms between the last applied message's event
+#                       time and its apply time (poll -> apply lag)
+#   stream.apply        histogram: per-poll apply-phase latency
+STREAM_LAG = "stream.lag"
+STREAM_APPLY = "stream.apply"
 CACHE_PARTIAL = "cache.partial"
 CACHE_MISS = "cache.miss"
 CACHE_PUT = "cache.put"

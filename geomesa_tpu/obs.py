@@ -90,10 +90,27 @@ def device_health() -> Dict[str, Any]:
     return probed
 
 
+def _fs_quarantine() -> Dict[str, Dict[str, str]]:
+    """Per-instance FileSystemStorage quarantine maps (root -> file ->
+    first failure), beyond the aggregate counters: the operator sees WHICH
+    files are quarantined, not just how many. Imported lazily — the fs
+    module needs pyarrow, and /healthz must work without it."""
+    import sys
+
+    mod = sys.modules.get("geomesa_tpu.fs.storage")
+    if mod is None:
+        return {}
+    try:
+        return mod.quarantine_snapshot()
+    except Exception:  # pragma: no cover — defensive
+        return {}
+
+
 def health() -> Dict[str, Any]:
     """The /healthz payload. ``status`` is ``ok`` unless a circuit breaker
-    is open (``degraded``); quarantine counters and device reachability
-    ride along for the operator's first glance."""
+    is open (``degraded``); quarantine counters (plus the per-instance
+    fs-storage quarantine maps) and device reachability ride along for the
+    operator's first glance."""
     breakers = resilience.breaker_states()
     report = metrics.registry().report()
     quarantine = {
@@ -106,6 +123,7 @@ def health() -> Dict[str, Any]:
         "breakers": breakers,
         "open_breakers": open_breakers,
         "quarantine": quarantine,
+        "fs_quarantine": _fs_quarantine(),
         "device": device_health(),
         "tracing": tracing.enabled(),
     }
@@ -113,13 +131,22 @@ def health() -> Dict[str, Any]:
 
 def debug_queries(dataset=None, n: int = 50) -> Dict[str, Any]:
     """The /debug/queries payload: recent audits + degradations + slow
-    traces. ``dataset`` optional — the degradation trail and slow traces
-    are process-wide; audit events need the dataset's writer."""
+    traces + per-user serving rollups. ``dataset`` optional — the
+    degradation trail and slow traces are process-wide; audit events and
+    the user rollup need the dataset (the rollup reads the serving
+    scheduler's ledger, the SAME accounting fair-share runs on —
+    docs/SERVING.md)."""
     from geomesa_tpu import audit as audit_mod
 
     events = []
+    users: Dict[str, Any] = {}
+    serving: Dict[str, Any] = {}
     if dataset is not None:
         events = [json.loads(e.to_json()) for e in dataset.audit.recent(n)]
+        sched = getattr(dataset, "serving", None)
+        if sched is not None:
+            users = sched.user_rollups()
+            serving = sched.snapshot()
     degraded = [
         json.loads(e.to_json()) for e in audit_mod.degradations.recent(n)
     ]
@@ -127,6 +154,8 @@ def debug_queries(dataset=None, n: int = 50) -> Dict[str, Any]:
         "queries": events,
         "degradations": degraded,
         "slow_traces": tracing.slow_traces(n),
+        "users": users,
+        "serving": serving,
     }
 
 

@@ -18,7 +18,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from geomesa_tpu import config, tracing
+from geomesa_tpu import config, metrics, tracing
 from geomesa_tpu.index.store import FeatureStore, IndexTable
 from geomesa_tpu.kernels import density as kdensity
 from geomesa_tpu.kernels import knn as kknn
@@ -552,6 +552,7 @@ class Executor:
             if len(wcache) >= 64:
                 wcache.clear()
             wcache[wkey] = win
+        metrics.inc(metrics.EXEC_DEVICE_DISPATCH)
         return go(
             {k: dev_cols[k] for k in sorted(names)}, *win, tuple(extra)
         )
@@ -716,6 +717,7 @@ class Executor:
             wcache[wkey] = win
         with tracing.span("scan.kernel", compact=True,
                           site=str(cache_key[0]) if cache_key else None):
+            metrics.inc(metrics.EXEC_DEVICE_DISPATCH)
             return go(cols, win[0], win[1], tuple(extra))
 
     def _expand_compact_mask(self, setup, cmask) -> np.ndarray:
@@ -1081,6 +1083,9 @@ class Executor:
         with pk.sharded_execution(self.mesh), \
                 tracing.span("scan.kernel",
                              site=str(cache_key[0]) if cache_key else None):
+            # one observable unit of device work (the serving bench's
+            # fusion-actually-fused gate counts these; docs/SERVING.md)
+            metrics.inc(metrics.EXEC_DEVICE_DISPATCH)
             return go(dev_cols, d_starts, d_ends, d_counts, tuple(extra))
 
     def _sharding(self):
@@ -1159,6 +1164,7 @@ class Executor:
                 mesh, sorted(dev_cols), L, predicate, agg_fn, stream
             )
             cache.put(key, fn)
+        metrics.inc(metrics.EXEC_DEVICE_DISPATCH)
         return fn(
             {k: dev_cols[k] for k in sorted(dev_cols)},
             jax.device_put(starts.astype(np.int32), win_sh),
@@ -1616,6 +1622,74 @@ class Executor:
         # blocks were generated row-major over (j, i): reshape directly;
         # row 0 = ymin edge (RenderingGrid convention)
         return flat.reshape(ny, nx)
+
+    def density_curve_batch(self, plan: QueryPlan, level: int,
+                            block_windows, weight: Optional[str] = None):
+        """N curve-aligned density crops of ONE (plan, level) in a single
+        device pass — the cross-query fusion entry point (docs/SERVING.md):
+        concurrent tile clients share the mask + cumsum (the expensive
+        O(rows) work) and each member costs only its own CDF gathers,
+        stacked over the query axis as ``[M, P]`` position operands.
+
+        Per-member results are bit-identical to :meth:`density_curve` run
+        serially: the shared cumsum is the same array either way, and
+        ``c[p1] - c[p0]`` gathers are exact. The kernel registry key pads
+        the member axis to a power of two (``registry.bucket_batch``) next
+        to the usual version-stable token, so batch sizes in one bucket
+        share a compiled kernel. Returns one ``[ny, nx]`` float64 grid per
+        window, in order."""
+        from geomesa_tpu.kernels.registry import bucket_batch
+
+        infos = [
+            self._curve_positions(plan, level, bw) for bw in block_windows
+        ]
+        if not infos:
+            return []
+        # stack the per-member CDF positions: members pad to a common P
+        # (each is already pow2-padded, so P = max is a pow2) and the
+        # member axis pads to its batch bucket. Padded cells gather
+        # c[0] - c[0] = 0 and are sliced away below.
+        P = max(len(i[0]) for i in infos)
+        M = len(infos)
+        Mp = bucket_batch(M)
+        p0s = np.zeros((Mp, P), np.int32)
+        p1s = np.zeros((Mp, P), np.int32)
+        for i, (p0, p1, _B, _nx, _ny) in enumerate(infos):
+            p0s[i, : len(p0)] = p0
+            p1s[i, : len(p1)] = p1
+        agg_cols = [weight] if weight else []
+
+        def agg(cols, m, xp, p0_, p1_):
+            if weight is None:
+                w = m.reshape(-1).astype(xp.int32)
+            else:
+                w = xp.where(
+                    m.reshape(-1),
+                    cols[weight].reshape(-1).astype(xp.float32),
+                    xp.float32(0),
+                )
+            # ONE cumsum serves every member; the [M, P] gather pair is
+            # the only per-member work (same int32 exactness contract as
+            # density_curve)
+            c = xp.concatenate([xp.zeros(1, w.dtype), xp.cumsum(w)])
+            return c[p1_] - c[p0_]
+
+        out = self._run(
+            plan, agg, agg, agg_cols,
+            cache_key=("density_curve_batch", level, P, Mp, weight),
+            extra=(p0s, p1s),
+            compactable=False,  # CDF positions index the padded layout
+        )
+        results = []
+        arr = None if out is None else np.asarray(out)
+        for i, (_p0, _p1, B, nx, ny) in enumerate(infos):
+            if arr is None:
+                results.append(np.zeros((ny, nx), np.float64))
+            else:
+                results.append(
+                    arr[i, :B].astype(np.float64).reshape(ny, nx)
+                )
+        return results
 
     def stats(self, plan: QueryPlan, stat: sk.Stat) -> sk.Stat:
         table = self._table(plan)

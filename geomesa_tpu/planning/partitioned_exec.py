@@ -282,6 +282,32 @@ class PartitionedExecutor:
             out = np.zeros((iy1 - iy0 + 1, ix1 - ix0 + 1), np.float64)
         return out
 
+    def density_curve_batch(self, plan: QueryPlan, level: int,
+                            block_windows, weight=None):
+        """Fused tile batch over the partitioned store: each pruned
+        partition executes ONE stacked device pass for every member crop
+        (Executor.density_curve_batch), and per-member grids accumulate
+        across partitions — M concurrent tile queries cost one scan of the
+        pruned partitions, not M (docs/SERVING.md)."""
+        outs = None
+        for b, ex in self._each(plan):
+            g = self._scan_part(
+                plan, b, "density_curve",
+                lambda: ex.density_curve_batch(
+                    plan, level, block_windows, weight
+                ),
+            )
+            if g is _SKIPPED:
+                continue
+            outs = g if outs is None else [a + p for a, p in zip(outs, g)]
+        if outs is None:
+            outs = []
+            for ix0, iy0, ix1, iy1 in block_windows:
+                outs.append(
+                    np.zeros((iy1 - iy0 + 1, ix1 - ix0 + 1), np.float64)
+                )
+        return outs
+
     def stats(self, plan: QueryPlan, stat: sk.Stat) -> sk.Stat:
         for b, ex in self._each(plan):
             self._scan_part(plan, b, "stats", lambda: ex.stats(plan, stat))

@@ -69,6 +69,34 @@ class QueryTimeoutError(RuntimeError):
     by ``planning.executor`` for compatibility."""
 
 
+class DeadlineShedError(QueryTimeoutError):
+    """Raised by the serving scheduler when a query is SHED — dropped at
+    admission or dispatch because its deadline budget cannot be met (already
+    expired while queued, or smaller than the estimated queue wait) —
+    BEFORE any planning or device work ran. Crosses the sidecar wire as a
+    ``[GM-SHED]`` coded Flight error (PROTOCOL §7.1). Subclasses
+    :class:`QueryTimeoutError` so existing deadline-aware callers classify
+    it as a timeout; ``retry_after_s`` is advisory (0 = don't retry: a
+    deadline-bound request will not make it on a busy queue either)."""
+
+    def __init__(self, msg: str, retry_after_s: float = 0.0):
+        super().__init__(msg)
+        self.retry_after_s = retry_after_s
+
+
+class AdmissionRejectedError(RuntimeError):
+    """Raised by the serving scheduler when the bounded admission queue is
+    full: backpressure, not failure — the server is healthy but saturated.
+    Crosses the wire as ``[GM-OVERLOADED]`` (retryable with backoff)."""
+
+    def __init__(self, depth: int):
+        super().__init__(
+            f"admission queue full ({depth} queued); retry with backoff or "
+            "raise geomesa.serving.queue.depth"
+        )
+        self.depth = depth
+
+
 class CircuitOpenError(RuntimeError):
     """Raised by :meth:`CircuitBreaker.allow` while the breaker is open:
     the callee has failed repeatedly and calls are being fenced off until
@@ -608,7 +636,8 @@ def record_skip(source: str, part: str, error: BaseException,
 
 
 __all__ = [
-    "QueryTimeoutError", "CircuitOpenError", "InjectedFault",
+    "QueryTimeoutError", "DeadlineShedError", "AdmissionRejectedError",
+    "CircuitOpenError", "InjectedFault",
     "RetryPolicy", "Deadline", "UNLIMITED", "current_deadline",
     "deadline_scope", "check_deadline",
     "CircuitBreaker", "breaker", "reset_breakers",

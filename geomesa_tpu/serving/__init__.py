@@ -1,0 +1,7 @@
+"""Serving layer: multi-query scheduling in front of the single dispatch
+thread (docs/SERVING.md) — bounded admission, deadline-aware ordering,
+per-user fair share, load shedding, and cross-query kernel fusion."""
+
+from geomesa_tpu.serving.scheduler import FuseSpec, QueryScheduler, Ticket
+
+__all__ = ["QueryScheduler", "FuseSpec", "Ticket"]

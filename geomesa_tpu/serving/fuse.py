@@ -1,0 +1,244 @@
+"""Cross-query fusion: eligibility keys + micro-batch executors
+(docs/SERVING.md).
+
+Two queries may fuse when they would compile to the SAME kernel — same
+schema, predicate text, auths, and op shape, which is exactly what the
+executor's version-stable kernel tokens key on (docs/PERF.md) — so a fused
+group shares the column ``device_put`` and the compiled kernel and differs
+only in query *data*. Concretely:
+
+* ``count`` / ``density`` / ``stats`` — members are *repeats* of one
+  question (the dominant serving pattern per "Manycore processing of
+  repeated range queries", PAPERS.md): the group executes the full path
+  ONCE and every member shares the result bit-identically;
+* ``density_curve`` — members share layer + filter + level but ask for
+  DIFFERENT tile crops (N map clients panning one heatmap layer): the
+  group executes one device pass with the per-member CDF gather positions
+  stacked over the query axis
+  (:meth:`~geomesa_tpu.planning.executor.Executor.density_curve_batch`)
+  — the GeoBlocks shared-work shape (PAPERS.md).
+
+Every fused member keeps its own trace span and audit event (hints carry
+``fused: true`` and the batch size); results de-interleave bit-identically
+versus serial execution because the per-member math is either literally the
+same execution (repeat fusion) or exact per-member gathers off one shared
+cumsum (tile fusion).
+
+Queries carrying hints that change execution shape per member (sampling,
+max_features, sort, properties, explicit index) never fuse.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from geomesa_tpu import tracing
+from geomesa_tpu.serving.scheduler import FusedMemberError, FuseSpec, Ticket
+
+#: opts keys that make a query ineligible for fusion (they change the
+#: execution shape or result per member in ways a shared pass can't serve)
+_UNFUSABLE_HINTS = (
+    "sampling", "sample_by", "max_features", "properties", "sort_by",
+    "index",
+)
+
+#: the ONLY opts keys a fusable request may carry with a truthy value:
+#: routing/identity keys plus the per-op parameters fuse_key folds into
+#: the compatibility key. Eligibility is an ALLOW-list — a future
+#: result-affecting request key that fuse.py doesn't know about makes the
+#: query ineligible (fail safe) instead of silently fusing two queries
+#: that differ in it and handing one client another client's answer.
+_FUSABLE_KEYS = frozenset(
+    ("op", "name", "schema", "ecql", "auths", "exact",
+     "bbox", "width", "height", "weight", "level", "stat")
+    + _UNFUSABLE_HINTS
+)
+
+
+def _auths_key(opts: Dict[str, Any]):
+    a = opts.get("auths")
+    return None if a is None else tuple(a)
+
+
+def fuse_key(op: str, schema: str, opts: Dict[str, Any]) -> Optional[tuple]:
+    """The fusion-compatibility key for one request, or None when the
+    request is ineligible. Equal keys => the members share a compiled
+    kernel (the same inputs determine the executor's version-stable
+    token) and may coalesce into one device pass."""
+    if any(opts.get(k) for k in _UNFUSABLE_HINTS):
+        return None
+    if any(v is not None and v is not False and k not in _FUSABLE_KEYS
+           for k, v in opts.items()):
+        return None
+    ecql = opts.get("ecql", "INCLUDE")
+    auths = _auths_key(opts)
+    if op == "count":
+        return ("count", schema, ecql, auths, bool(opts.get("exact", True)))
+    if op == "density":
+        bbox = opts.get("bbox")
+        return ("density", schema, ecql, auths,
+                tuple(bbox) if bbox is not None else None,
+                int(opts.get("width", 256)), int(opts.get("height", 256)),
+                opts.get("weight"))
+    if op == "density_curve":
+        # bbox deliberately NOT in the key: different crops stack into one
+        # pass (the tile-fusion path)
+        return ("density_curve", schema, ecql, auths,
+                int(opts.get("level", 9)), opts.get("weight"))
+    if op == "stats":
+        return ("stats", schema, ecql, auths, opts.get("stat"))
+    return None
+
+
+def make_spec(ds, op: str, schema: str,
+              opts: Dict[str, Any]) -> Optional[FuseSpec]:
+    """A :class:`FuseSpec` whose batch executor returns RAW results (ints,
+    grids, stats). The sidecar wraps these into wire frames; local callers
+    (bench, tests) consume them directly."""
+    key = fuse_key(op, schema, opts)
+    if key is None:
+        return None
+    return FuseSpec(
+        key=("local", op, schema) + key,
+        payload=dict(opts),
+        batch=lambda tickets: run_batch(ds, op, schema, tickets),
+    )
+
+
+def _query_from(opts: Dict[str, Any]):
+    from geomesa_tpu.api.dataset import Query
+
+    return Query(ecql=opts.get("ecql", "INCLUDE"), auths=opts.get("auths"))
+
+
+def _member_span(t: Ticket, op: str, batch_n: int) -> None:
+    """A fused non-primary member's OWN root span, joined to the member's
+    client trace id when one rode the Flight header — fused queries stay
+    individually traceable. Must be called with NO trace active on the
+    thread (so the span opens a fresh root under the member's id, not a
+    child of the primary's tree)."""
+    with tracing.start(f"fused.{op}.member", trace_id=t.trace_id,
+                       force=t.trace_id is not None) as sp:
+        sp.set(fused=True, fused_batch=batch_n,
+               queue_wait_ms=round(t.wait_s * 1e3, 3))
+
+
+def _member_record(ds, schema: str, t: Ticket, op: str, ecql: str,
+                   hits: int, batch_n: int, primary_tid: Optional[str],
+                   extra_hints: Optional[Dict[str, Any]] = None) -> None:
+    """Per-member bookkeeping for a fused non-primary member: its own root
+    span plus its OWN audit event — fused queries stay individually
+    attributable."""
+    _member_span(t, op, batch_n)
+    hints: Dict[str, Any] = {
+        "op": op, "fused": True, "fused_batch": batch_n, "user": t.user,
+    }
+    if t.trace_id is not None:
+        hints["trace_id"] = t.trace_id
+    if primary_tid is not None and primary_tid != t.trace_id:
+        hints["fused_primary"] = primary_tid
+    if extra_hints:
+        hints.update(extra_hints)
+    ds.audit.record(schema, ecql, hints, 0.0, 0.0, hits, user=t.user)
+
+
+def run_batch(ds, op: str, schema: str, tickets: List[Ticket]) -> List[Any]:
+    """Execute one fused group, returning one raw result per ticket (in
+    order). The primary member runs the full audited public path under its
+    own trace; non-primary members record their spans/audits via
+    :func:`_member_record`."""
+    primary = tickets[0]
+    opts = primary.fuse.payload
+    ecql = opts.get("ecql", "INCLUDE")
+    n_batch = len(tickets)
+
+    if op == "density_curve":
+        return _density_curve_batch(ds, schema, tickets)
+
+    # repeat fusion: one execution, shared result (bit-identical by
+    # construction — it IS the serial execution, run once)
+    with tracing.start(f"fused.{op}", trace_id=primary.trace_id,
+                       force=primary.trace_id is not None,
+                       fused_batch=n_batch):
+        q = _query_from(opts)
+        if op == "count":
+            result = ds.count(schema, q, exact=bool(opts.get("exact", True)))
+            hits = int(result)
+        elif op == "density":
+            import numpy as np
+
+            result = ds.density(
+                schema, q, bbox=opts.get("bbox"),
+                width=int(opts.get("width", 256)),
+                height=int(opts.get("height", 256)),
+                weight=opts.get("weight"),
+            )
+            hits = int(np.count_nonzero(result))
+        elif op == "stats":
+            result = ds.stats(schema, opts["stat"], q)
+            hits = 0
+        else:
+            raise ValueError(f"unfusable op {op!r}")
+    # each member gets its OWN result object: a caller mutating its grid
+    # in place (normalization etc.) must never corrupt another member's —
+    # fusion can change latency, never results. Per-member bookkeeping
+    # failures (audit path unwritable, say) stay PER-member: the batch
+    # already executed, so raising here would trigger the serial fallback
+    # and duplicate the device pass + the primary's audit event.
+    out: List[Any] = [result]
+    for t in tickets[1:]:
+        try:
+            _member_record(ds, schema, t, op, ecql, hits, n_batch,
+                           primary.trace_id)
+            out.append(_own_copy(result))
+        except Exception as e:
+            out.append(FusedMemberError(e))
+    return out
+
+
+def _own_copy(result):
+    """An independently-mutable copy of a fused result (ints pass
+    through; grids copy; stats deep-copy)."""
+    import numpy as np
+
+    if isinstance(result, np.ndarray):
+        return result.copy()
+    if isinstance(result, (int, float, str, bytes, bool)) or result is None:
+        return result
+    import copy
+
+    try:
+        return copy.deepcopy(result)
+    except Exception:  # pragma: no cover — exotic result: share read-only
+        return result
+
+
+def _density_curve_batch(ds, schema: str, tickets: List[Ticket]) -> List[Any]:
+    """Tile fusion: one device pass over stacked per-member crops."""
+    primary = tickets[0]
+    opts = primary.fuse.payload
+    level = int(opts.get("level", 9))
+    weight = opts.get("weight")
+    members = [
+        {"bbox": t.fuse.payload.get("bbox"), "trace_id": t.trace_id,
+         "user": t.user}
+        for t in tickets
+    ]
+    with tracing.start("fused.density_curve", trace_id=primary.trace_id,
+                       force=primary.trace_id is not None,
+                       fused_batch=len(tickets)):
+        # per-member audit events are written by density_curve_batch (it
+        # holds the plan + per-member hit counts); only the member spans
+        # are opened here, after the primary trace closes
+        out = ds.density_curve_batch(
+            schema, _query_from(opts), level=level,
+            bboxes=[m["bbox"] for m in members], weight=weight,
+            members=members,
+        )
+    # span failures stay per-member (see run_batch): the batch already ran
+    for i, t in enumerate(tickets[1:], start=1):
+        try:
+            _member_span(t, "density_curve", len(tickets))
+        except Exception as e:
+            out[i] = FusedMemberError(e)
+    return out

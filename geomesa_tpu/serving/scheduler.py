@@ -1,0 +1,788 @@
+"""Multi-query serving scheduler (docs/SERVING.md).
+
+GeoMesa's tablet/region servers serve many concurrent client scans against
+one index, amortizing I/O across sessions (SURVEY §2.9). The TPU port
+funnels every dataset operation through ONE dedicated query thread (the
+jit-deadlock discipline from sidecar/service.py) — so concurrency is not a
+thread-pool problem but a *scheduling* one, and the single-thread constraint
+becomes a batching opportunity: while one query executes, everything else
+queues, and whatever is queued can be reordered, shed, or fused.
+
+This module is that scheduler:
+
+* **bounded admission queue** — requests beyond ``geomesa.serving.queue.
+  depth`` are rejected at submission with a typed
+  :class:`~geomesa_tpu.resilience.AdmissionRejectedError`
+  (``[GM-OVERLOADED]`` on the wire) before any planning or device work;
+* **deadline-aware ordering + shedding** — each ticket carries a deadline
+  budget; a ticket whose budget expires while queued (or whose budget is
+  smaller than the estimated queue wait at admission) is SHED with a typed
+  :class:`~geomesa_tpu.resilience.DeadlineShedError` (``[GM-SHED]``),
+  never dispatched. Within a user, earliest-deadline-first;
+* **per-user fair share** — the dispatcher serves the pending user with the
+  least *attained service time* (accumulated execution seconds) instead of
+  global FIFO, so one user's burst of heavy scans cannot starve another
+  user's interactive queries ("Manycore processing of repeated range
+  queries", PAPERS.md, motivates exactly this serving shape);
+* **cross-query fusion** — tickets carrying a :class:`FuseSpec` with equal
+  fusion keys (same dataset, predicate text, auths, op shape — hence the
+  same version-stable kernel token, docs/PERF.md) coalesce into one
+  micro-batch executed by the spec's ``batch`` callable as a single device
+  pass (serving/fuse.py builds those). Only already-queued work fuses —
+  fusion never delays dispatch to grow a batch — and a failing batch falls
+  back to per-member serial execution, so fusion can change latency but
+  never results;
+* **one ledger** — per-user accounting (submitted/completed/shed/service/
+  wait) backs BOTH the fair-share policy and the ``/debug/queries``
+  per-user rollups (obs.py), so the operator's view and the scheduler's
+  decisions cannot drift apart.
+
+Two modes share the implementation:
+
+* **inline** (the default; every :class:`~geomesa_tpu.api.dataset.
+  GeoDataset` owns one): no thread — :meth:`admit` wraps each public op on
+  the caller's thread, performing admission-time shed checks and ledger
+  accounting;
+* **dispatch-thread** (:meth:`start`; the Flight sidecar): tickets queue
+  and a single worker thread — the jit-safe query thread — drains them
+  under the policy above. Streamed exports enqueue *continuation* tickets
+  (one per chunk) that bypass admission bounds and run ahead of new
+  queries: an accepted stream must stay live under load.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import threading
+import time
+import weakref
+from collections import deque
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from geomesa_tpu import config, metrics
+from geomesa_tpu.resilience import (
+    AdmissionRejectedError, Deadline, DeadlineShedError, current_deadline,
+    deadline_scope,
+)
+
+log = logging.getLogger(__name__)
+
+
+@dataclass
+class FuseSpec:
+    """Fusion eligibility + group executor for one ticket.
+
+    ``key`` — the compatibility key: tickets with equal keys may coalesce.
+    serving/fuse.py derives it from (op, schema, predicate text, auths,
+    op-shape params), i.e. the inputs that determine the version-stable
+    kernel token — members of a group share compiled code and differ only
+    in query DATA. ``payload`` — the member's per-query parameters (e.g.
+    a tile bbox). ``batch`` — called with the whole group's tickets,
+    returns one result per ticket in order; None = this op can mark
+    compatibility but has no batch executor (members run serially)."""
+
+    key: tuple
+    payload: Any = None
+    batch: Optional[Callable[[List["Ticket"]], List[Any]]] = None
+
+
+class FusedMemberError:
+    """A per-member failure inside an otherwise-successful fused batch:
+    a batch executor returns this IN PLACE of that member's result and the
+    scheduler delivers the wrapped exception to that member alone. This
+    exists so post-execution failures (e.g. wire-frame serialization for
+    one member) never trigger the whole-batch serial fallback — the batch
+    already ran, and re-running would duplicate device work and audit
+    events."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+@dataclass
+class Ticket:
+    """One admitted request."""
+
+    seq: int
+    user: str
+    op: str
+    fn: Callable[[], Any]
+    future: Future
+    deadline: Deadline
+    submitted_at: float
+    fuse: Optional[FuseSpec] = None
+    trace_id: Optional[str] = None
+    continuation: bool = False
+    wait_s: float = 0.0
+    #: the submitter's thread-local config overrides — adopted on the
+    #: dispatch thread so a scoped knob resolves identically in queue and
+    #: inline modes (the partition prefetcher crosses threads the same way)
+    overrides: Dict[str, str] = field(default_factory=dict)
+
+    def _order_key(self):
+        # deadline-aware ordering within a user: earliest deadline first,
+        # FIFO among equal/absent deadlines
+        exp = self.deadline.expires_at
+        return (exp if exp is not None else float("inf"), self.seq)
+
+
+class _UserLedger:
+    """Per-user accounting (one entry per user). Backs the fair-share
+    policy AND the /debug/queries rollup — a single source of truth."""
+
+    __slots__ = ("submitted", "completed", "shed", "rejected", "errors",
+                 "fused", "service_s", "wait_s", "last_ts")
+
+    def __init__(self):
+        self.submitted = 0
+        self.completed = 0
+        self.shed = 0
+        self.rejected = 0
+        self.errors = 0
+        self.fused = 0
+        self.service_s = 0.0
+        self.wait_s = 0.0
+        self.last_ts = 0.0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "submitted": self.submitted,
+            "completed": self.completed,
+            "shed": self.shed,
+            "rejected": self.rejected,
+            "errors": self.errors,
+            "fused": self.fused,
+            "service_ms": round(self.service_s * 1e3, 3),
+            "queue_wait_ms": round(self.wait_s * 1e3, 3),
+            "mean_service_ms": round(
+                self.service_s / self.completed * 1e3, 3
+            ) if self.completed else 0.0,
+            "last_ts": self.last_ts,
+        }
+
+
+def _default_user() -> str:
+    return config.USER.get() or "anonymous"
+
+
+#: weakref to the most recently STARTED scheduler — the one actually
+#: dispatching for this process. The serving.queue.depth gauge reads it
+#: through this indirection so (a) scratch inline schedulers (every
+#: GeoDataset owns one) can never hijack the metric away from the live
+#: sidecar scheduler, and (b) the gauge never strong-pins a scheduler.
+_live_sched: Optional["weakref.ref[QueryScheduler]"] = None
+
+
+def _depth_gauge_value() -> float:
+    s = _live_sched() if _live_sched is not None else None
+    return float(s._pending) if s is not None else 0.0
+
+
+class QueryScheduler:
+    """See the module docstring. Thread-safe; one per dataset (the sidecar
+    reuses its dataset's scheduler so Flight and local ops share a ledger
+    and one fair-share domain)."""
+
+    def __init__(self, name: str = "geomesa-serving"):
+        self.name = name
+        self._cv = threading.Condition()
+        self._queues: Dict[str, List[Ticket]] = {}
+        self._continuations: "deque[Ticket]" = deque()
+        self._pending = 0
+        self._ledger: Dict[str, _UserLedger] = {}
+        self._seq = 0
+        self._thread: Optional[threading.Thread] = None
+        self._stopped = False
+        #: EWMA of recent execution times (seconds): the admission-time
+        #: queue-wait estimate
+        self._ewma_all: Optional[float] = None
+        #: users whose tickets the dispatch thread is executing right now
+        #: (guarded by _cv) — shielded from ledger eviction, which would
+        #: otherwise reset their fair-share debt mid-query
+        self._active_users: set = set()
+        #: users inside an inline admit() right now, refcounted (multiple
+        #: caller threads may admit concurrently) — same eviction shield
+        self._inline_users: Dict[str, int] = {}
+        self._tls = threading.local()
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def depth(self) -> int:
+        return self._pending
+
+    def user_rollups(self) -> Dict[str, Dict[str, Any]]:
+        """Per-user serving rollup (the /debug/queries ``users`` payload)."""
+        with self._cv:
+            return {u: led.to_dict() for u, led in self._ledger.items()}
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._cv:
+            return {
+                "depth": self._pending,
+                "users": len(self._ledger),
+                "running": self._thread is not None and not self._stopped,
+                "ewma_service_ms": round((self._ewma_all or 0.0) * 1e3, 3),
+            }
+
+    def current_wait_ms(self) -> float:
+        """Queue wait of the ticket executing on THIS thread (0 outside a
+        dispatch) — the sidecar stamps it onto the root span."""
+        return getattr(self._tls, "wait_ms", 0.0)
+
+    def current_user(self) -> Optional[str]:
+        """The user whose admitted op is running on THIS thread (ticket
+        dispatch or inline admit) — audit events attribute to it."""
+        return getattr(self._tls, "user", None)
+
+    # -- ledger helpers (call under self._cv) ------------------------------
+    def _led(self, user: str) -> _UserLedger:
+        led = self._ledger.get(user)
+        if led is None:
+            if len(self._ledger) >= 4096:
+                # bound the per-user map: evict the longest-idle entries
+                # (a fuzzing client must not grow server memory forever) —
+                # but never a user with queued work: dropping their ledger
+                # would reset their fair-share debt mid-burst
+                busy = {t.user for t in self._continuations}
+                busy |= self._active_users
+                busy |= self._inline_users.keys()
+                idle = [
+                    u for u in self._ledger
+                    if not self._queues.get(u) and u not in busy
+                ]
+                for u in sorted(
+                    idle, key=lambda u: self._ledger[u].last_ts
+                )[:256]:
+                    del self._ledger[u]
+            led = self._ledger[user] = _UserLedger()
+            led.last_ts = time.time()  # creation counts as activity
+        return led
+
+    def _note_service(self, user: str, op: str, seconds: float,
+                      ewma: bool = True) -> None:
+        with self._cv:
+            led = self._led(user)
+            led.completed += 1
+            led.service_s += seconds
+            led.last_ts = time.time()
+            if ewma:
+                self._ewma_update_locked(seconds)
+        metrics.inc(metrics.SERVING_COMPLETED)
+
+    def _ewma_update_locked(self, seconds: float) -> None:
+        """One admission-estimate sample (call under self._cv).
+        Continuation chunks and failures never feed it — thousands of ~ms
+        samples would drag the wait estimate to zero exactly when the
+        server is busiest — and a fused batch feeds ONE sample for the
+        whole batch, not a per-member share (16 share samples would
+        collapse the estimate to elapsed/16 after a single batch)."""
+        a = 0.2  # EWMA horizon ~ last 5 queries
+        self._ewma_all = (
+            seconds if self._ewma_all is None
+            else (1 - a) * self._ewma_all + a * seconds
+        )
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, fn: Callable[[], Any], user: Optional[str] = None,
+               op: str = "op", fuse: Optional[FuseSpec] = None,
+               budget_s: Optional[float] = None,
+               trace_id: Optional[str] = None,
+               continuation: bool = False) -> Future:
+        """Admit one request to the dispatch queue (requires :meth:`start`).
+        Raises :class:`AdmissionRejectedError` when the bounded queue is
+        full and :class:`DeadlineShedError` when the budget provably cannot
+        be met — both BEFORE any planning or device work. ``budget_s``
+        None inherits the submitter's ambient resilience deadline."""
+        user = user or _default_user()
+        if budget_s is not None:
+            deadline = Deadline.after(budget_s)
+        else:
+            deadline = current_deadline()
+        fut: Future = Future()
+        with self._cv:
+            if self._stopped or self._thread is None:
+                raise RuntimeError("serving scheduler is not running")
+            led = self._led(user)
+            # submitted counts EVERY attempt — shed and rejected included —
+            # so shed/submitted means the same thing on the queue path as
+            # on the inline admit() path
+            led.submitted += 1
+            led.last_ts = time.time()
+            if not continuation:
+                cap = config.SERVING_QUEUE_DEPTH.to_int()
+                cap = 256 if cap is None else cap
+                if self._pending >= cap:
+                    led.rejected += 1
+                    metrics.inc(metrics.SERVING_SHED_QUEUE_FULL)
+                    raise AdmissionRejectedError(self._pending)
+                shed_msg = self._admission_shed_locked(deadline)
+                if shed_msg is not None:
+                    led.shed += 1
+                    metrics.inc(metrics.SERVING_SHED_DEADLINE)
+                    raise DeadlineShedError(shed_msg)
+            self._seq += 1
+            t = Ticket(
+                seq=self._seq, user=user, op=op, fn=fn, future=fut,
+                deadline=deadline, submitted_at=time.perf_counter(),
+                fuse=fuse if config.SERVING_FUSION.to_bool() else None,
+                trace_id=trace_id, continuation=continuation,
+                overrides=config.snapshot_overrides(),
+            )
+            if continuation:
+                self._continuations.append(t)
+            else:
+                self._queues.setdefault(user, []).append(t)
+            self._pending += 1
+            metrics.inc(metrics.SERVING_ADMITTED)
+            self._cv.notify()
+        return fut
+
+    def _admission_shed_locked(self, deadline: Deadline) -> Optional[str]:
+        """Reject-before-work check: a deadline that is already expired, or
+        smaller than the estimated queue wait, cannot be met."""
+        rem = deadline.remaining_s()
+        if rem is None:
+            return None
+        if rem <= 0:
+            return (
+                "query shed at admission: deadline already expired before "
+                "any work was scheduled"
+            )
+        if not config.SERVING_SHED_ESTIMATE.to_bool():
+            return None
+        # count queued QUERIES only — continuation (stream-chunk) tickets
+        # are excluded from the EWMA, so they must not multiply it either
+        n_queries = sum(len(q) for q in self._queues.values())
+        if self._ewma_all is not None and n_queries > 0:
+            est = self._ewma_all * (n_queries + 1)
+            if est > rem:
+                return (
+                    f"query shed at admission: estimated queue wait "
+                    f"{est * 1e3:.0f} ms exceeds the {rem * 1e3:.0f} ms "
+                    "deadline budget"
+                )
+        return None
+
+    def run(self, fn: Callable[[], Any], user: Optional[str] = None,
+            op: str = "op", fuse: Optional[FuseSpec] = None,
+            budget_s: Optional[float] = None,
+            trace_id: Optional[str] = None,
+            continuation: bool = False):
+        """Submit and wait (the ``_QueryThread.run`` shape). Without a
+        dispatch thread, executes inline under admission accounting."""
+        if self._thread is None:
+            if continuation:
+                # a continuation belongs to a stream the dispatch thread
+                # was driving: running it inline on the caller's (gRPC)
+                # thread would break the jit discipline — fail like the
+                # stopped query thread always did
+                raise RuntimeError("serving scheduler stopped")
+            # an explicit budget must bind inline too (admit() reads the
+            # ambient deadline) — the two modes share one shed contract
+            ctx = (deadline_scope(budget_s) if budget_s is not None
+                   else contextlib.nullcontext())
+            with ctx, self.admit(op, user=user):
+                return fn()
+        fut = self.submit(
+            fn, user=user, op=op, fuse=fuse, budget_s=budget_s,
+            trace_id=trace_id, continuation=continuation,
+        )
+        return fut.result()
+
+    def iterate(self, it, user: Optional[str] = None, op: str = "stream"):
+        """Drive iterator ``it`` with every ``next`` on the dispatch thread
+        (streamed exports compute their chunks there). Every chunk rides a
+        continuation ticket — head-of-line, never bounded or shed: the
+        stream's opening request already passed admission, and an accepted
+        stream must stay live under queue pressure."""
+        done = object()
+        while True:
+            item = self.run(
+                lambda: next(it, done), user=user, op=op,
+                continuation=True,
+            )
+            if item is done:
+                return
+            yield item
+
+    @contextlib.contextmanager
+    def admit(self, op: str, user: Optional[str] = None):
+        """Local-path admission: wrap one public dataset op. Sheds (typed)
+        when the caller's ambient deadline is expired or provably
+        unmeetable, and accounts the op into the shared ledger. Reentrant
+        (nested public ops account once) and a no-op inside a dispatched
+        ticket (the ticket already accounts)."""
+        depth = getattr(self._tls, "admit_depth", 0)
+        if depth or getattr(self._tls, "in_dispatch", False):
+            self._tls.admit_depth = depth + 1
+            try:
+                yield
+            finally:
+                self._tls.admit_depth = depth
+            return
+        user = user or _default_user()
+        d = current_deadline()
+        rem = d.remaining_s()
+        shed = None
+        if rem is not None and rem <= 0:
+            # inline admission sheds ONLY on an already-expired deadline.
+            # An EWMA-estimate check here would livelock: a shed op never
+            # executes, so the estimate (inflated by one cold compile)
+            # could never decay back under the budget. With no queue in
+            # front of an inline op, the in-scan deadline enforcement is
+            # the right backstop; estimate shedding stays a QUEUE-path
+            # policy (where the wait is real and other traffic keeps the
+            # EWMA honest).
+            shed = (
+                "query shed at admission: deadline already expired before "
+                "any work"
+            )
+        with self._cv:
+            led = self._led(user)
+            led.submitted += 1
+            led.last_ts = time.time()
+            if shed is not None:
+                led.shed += 1
+            else:
+                self._inline_users[user] = \
+                    self._inline_users.get(user, 0) + 1
+        if shed is not None:
+            metrics.inc(metrics.SERVING_SHED_DEADLINE)
+            raise DeadlineShedError(shed)
+        self._tls.admit_depth = 1
+        self._tls.user = user
+        t0 = time.perf_counter()
+        ok = True
+        try:
+            yield
+        except BaseException:
+            ok = False
+            with self._cv:
+                self._led(user).errors += 1
+            raise
+        finally:
+            self._tls.admit_depth = 0
+            self._tls.user = None
+            with self._cv:
+                n = self._inline_users.get(user, 0) - 1
+                if n > 0:
+                    self._inline_users[user] = n
+                else:
+                    self._inline_users.pop(user, None)
+            # failures stay out of the EWMA here too (the _execute_one
+            # rule): fast-failing local ops must not deflate the queue
+            # path's admission estimate on a shared scheduler
+            self._note_service(user, op, time.perf_counter() - t0, ewma=ok)
+
+    # -- dispatch ----------------------------------------------------------
+    def start(self) -> "QueryScheduler":
+        """Spawn the single dispatch thread (idempotent). The started
+        scheduler becomes the one the process serving.queue.depth gauge
+        reads — inline (scratch) schedulers never touch the metric."""
+        global _live_sched
+        with self._cv:
+            self._stopped = False
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._loop, name=self.name, daemon=True
+                )
+                self._thread.start()
+            # else: a previous stop()'s join timed out and the old thread
+            # is still draining its in-flight query — clearing _stopped
+            # re-adopts it as THE dispatcher instead of spawning a second
+            # one (two dispatch threads would break the jit discipline)
+        _live_sched = weakref.ref(self)
+        metrics.registry().gauge(
+            metrics.SERVING_QUEUE_DEPTH, _depth_gauge_value, replace=True
+        )
+        return self
+
+    def stop(self) -> None:
+        """Stop dispatching; queued tickets fail (their callers must not
+        block forever on futures nothing will complete)."""
+        with self._cv:
+            self._stopped = True
+            stranded = list(self._continuations)
+            self._continuations.clear()
+            for q in self._queues.values():
+                stranded.extend(q)
+            self._queues.clear()
+            self._pending = 0
+            self._cv.notify_all()
+            t = self._thread
+        for tk in stranded:
+            tk.future.set_exception(
+                RuntimeError("serving scheduler stopped")
+            )
+        if t is not None and t is not threading.current_thread():
+            t.join(timeout=5.0)
+        # _loop clears self._thread itself (under the lock) as it exits;
+        # a timed-out join must leave the reference in place so a later
+        # start() re-adopts the still-draining thread rather than racing
+        # a second dispatcher against it
+
+    def _loop(self):
+        try:
+            while True:
+                # assembled in place so a mid-assembly failure (e.g. a
+                # malformed config knob read during the fusion sweep)
+                # leaves already-dequeued tickets reachable for the
+                # except arm below — their callers must never hang on
+                # futures nothing will complete
+                group: List[Ticket] = []
+                try:
+                    with self._cv:
+                        while not self._stopped and self._pending == 0:
+                            self._cv.wait()
+                        if self._stopped:
+                            # the exit handshake happens under the lock so
+                            # start() can never observe a live-looking
+                            # thread that is about to return (it would
+                            # fail to spawn a new one)
+                            if self._thread is threading.current_thread():
+                                self._thread = None
+                            return
+                        self._next_group_locked(group)
+                        self._active_users = {t.user for t in group}
+                    if group:
+                        self._execute_group(group)
+                except Exception as e:
+                    # the dispatcher is the ONLY thread draining the
+                    # queue: it must survive anything a single dispatch
+                    # can throw (per-ticket errors land on futures in
+                    # _execute_one; this arm is for policy/assembly
+                    # failures outside that path)
+                    log.exception("serving dispatch iteration failed")
+                    for t in group:
+                        if not t.future.done():
+                            t.future.set_exception(e)
+                finally:
+                    with self._cv:
+                        self._active_users = set()
+        finally:
+            # backstop for a genuinely dying thread (BaseException, e.g.
+            # SystemExit): strand-and-fail everything still queued, and
+            # drop the thread reference so submit() raises "not running"
+            # instead of silently enqueueing forever
+            self._dispatcher_exit()
+
+    def _dispatcher_exit(self) -> None:
+        with self._cv:
+            if self._thread is threading.current_thread():
+                self._thread = None
+            stranded = list(self._continuations)
+            self._continuations.clear()
+            for q in self._queues.values():
+                stranded.extend(q)
+            self._queues.clear()
+            self._pending = 0
+        for tk in stranded:
+            if not tk.future.done():
+                tk.future.set_exception(
+                    RuntimeError("serving dispatch thread exited")
+                )
+
+    def _pick_user_locked(self) -> Optional[str]:
+        users = [u for u, q in self._queues.items() if q]
+        if not users:
+            return None
+        if not config.SERVING_FAIR_SHARE.to_bool():
+            # strict FIFO across users
+            return min(users, key=lambda u: min(t.seq for t in self._queues[u]))
+        # least attained service first; FIFO head seq breaks ties so two
+        # fresh users interleave in arrival order
+        return min(
+            users,
+            key=lambda u: (
+                self._led(u).service_s,
+                min(t.seq for t in self._queues[u]),
+            ),
+        )
+
+    def _next_group_locked(self, group: List[Ticket]) -> List[Ticket]:
+        """Fills ``group`` IN PLACE (and returns it): every ticket is
+        appended the moment it leaves a queue, so the dispatch loop can
+        fail dequeued tickets' futures if assembly itself throws."""
+        if self._continuations:
+            t = self._continuations.popleft()
+            self._pending -= 1
+            group.append(t)
+            return group
+        user = self._pick_user_locked()
+        if user is None:
+            return group
+        q = self._queues[user]
+        head = min(q, key=Ticket._order_key)
+        q.remove(head)
+        self._pending -= 1
+        group.append(head)
+        # cap <= 1 disables the sweep entirely (a negative slice bound
+        # would otherwise fuse almost everything)
+        cap = config.SERVING_FUSION_MAX.to_int()
+        cap = 16 if cap is None else cap
+        if head.fuse is not None and cap > 1:
+            # sweep EVERY user's queue for fusion-compatible members, in
+            # submission order: fusion amortizes device work across users,
+            # and members removed here are served NOW — ahead of their
+            # fair-share turn, which only helps them
+            cands: List[Ticket] = []
+            for uq in self._queues.values():
+                cands.extend(
+                    t for t in uq
+                    if t.fuse is not None and t.fuse.key == head.fuse.key
+                    # the batch executes under the PRIMARY's config
+                    # overrides: a member scoped differently could resolve
+                    # shape/cache knobs differently and must run alone
+                    and t.overrides == head.overrides
+                )
+            cands.sort(key=lambda t: t.seq)
+            for t in cands[: cap - 1]:
+                self._queues[t.user].remove(t)
+                self._pending -= 1
+                group.append(t)  # appended as dequeued — see docstring
+        # drop emptied per-user queues: the dict must track users with
+        # PENDING work only, or a fuzzing client with unique user headers
+        # would grow it (and every dispatch's pick/sweep walk) forever
+        for u in {t.user for t in group}:
+            if not self._queues.get(u):
+                self._queues.pop(u, None)
+        return group
+
+    def _shed_ticket(self, t: Ticket) -> None:
+        with self._cv:
+            self._led(t.user).shed += 1
+        metrics.inc(metrics.SERVING_SHED_DEADLINE)
+        t.future.set_exception(DeadlineShedError(
+            f"query shed before dispatch: deadline expired after "
+            f"{t.wait_s * 1e3:.0f} ms queued (no device work was done)"
+        ))
+
+    def _execute_group(self, group: List[Ticket]) -> None:
+        now = time.perf_counter()
+        wait_hist = metrics.registry().histogram(metrics.SERVING_QUEUE_WAIT)
+        live: List[Ticket] = []
+        for t in group:
+            t.wait_s = now - t.submitted_at
+            if not t.continuation:
+                # continuation chunks skip the wait histogram + ledger for
+                # the same reason they skip the EWMA: thousands of ~0-wait
+                # chunk tickets would collapse the queue-wait p99 exactly
+                # when a stream is holding real queries back
+                wait_hist.observe(t.wait_s)
+                with self._cv:
+                    self._led(t.user).wait_s += t.wait_s
+            # shed-before-work: a deadline that lapsed while queued is a
+            # guaranteed wire timeout — don't burn device time on it.
+            # Continuations are exempt (never bounded or shed mid-stream):
+            # an accepted stream stays live even past an inherited ambient
+            # deadline — in-scan enforcement is its backstop
+            if t.deadline.expired and not t.continuation:
+                self._shed_ticket(t)
+            else:
+                live.append(t)
+        if not live:
+            return
+        if len(live) > 1 and live[0].fuse is not None \
+                and live[0].fuse.batch is not None:
+            if self._execute_fused(live):
+                return
+        for t in live:
+            self._execute_one(t)
+
+    def _execute_fused(self, group: List[Ticket]) -> bool:
+        """One device pass for the whole group. False = fall back to
+        serial execution (fusion may change latency, never results)."""
+        head = group[0]
+        t0 = time.perf_counter()
+        self._tls.in_dispatch = True
+        self._tls.wait_ms = head.wait_s * 1e3
+        self._tls.user = head.user
+        prev_ov = config.snapshot_overrides()
+        config.adopt_overrides(head.overrides)
+        try:
+            results = head.fuse.batch(group)
+        except BaseException as e:
+            if not isinstance(e, Exception):
+                # KeyboardInterrupt/SystemExit during the batch: relay to
+                # every member (the _execute_one invariant) rather than
+                # letting it kill the dispatch thread — queued callers
+                # would block forever on futures nothing completes — or
+                # re-running the batch serially under the same signal
+                for t in group:
+                    with self._cv:
+                        self._led(t.user).errors += 1
+                    t.future.set_exception(e)
+                return True
+            log.warning(
+                "fused batch of %d %s queries failed (%r); degrading to "
+                "per-query execution", len(group), head.op, e,
+            )
+            return False
+        finally:
+            config.adopt_overrides(prev_ov)
+            self._tls.in_dispatch = False
+            self._tls.wait_ms = 0.0
+            self._tls.user = None
+        if results is None or len(results) != len(group):
+            log.warning(
+                "fused batch executor returned %s results for %d members; "
+                "degrading to per-query execution",
+                "no" if results is None else len(results), len(group),
+            )
+            return False
+        elapsed = time.perf_counter() - t0
+        metrics.registry().histogram(
+            metrics.SERVING_FUSION_BATCH,
+            buckets=metrics.FUSION_BATCH_BUCKETS, unit=None,
+        ).observe(float(len(group)))
+        # every member counts (primary included) — the same definition the
+        # per-user ledger 'fused' field uses, so /metrics and the
+        # /debug/queries rollups always agree
+        metrics.inc(metrics.SERVING_FUSED, len(group))
+        share = elapsed / len(group)
+        for t, r in zip(group, results):
+            with self._cv:
+                self._led(t.user).fused += 1
+            self._note_service(t.user, t.op, share, ewma=False)
+            if isinstance(r, FusedMemberError):
+                t.future.set_exception(r.error)
+            else:
+                t.future.set_result(r)
+        with self._cv:
+            # one estimate sample for the whole batch (see
+            # _ewma_update_locked): ledgers got their share above
+            self._ewma_update_locked(elapsed)
+        return True
+
+    def _execute_one(self, t: Ticket) -> None:
+        t0 = time.perf_counter()
+        self._tls.in_dispatch = True
+        self._tls.wait_ms = t.wait_s * 1e3
+        self._tls.user = t.user
+        prev_ov = config.snapshot_overrides()
+        config.adopt_overrides(t.overrides)
+        try:
+            out = t.fn()
+        except BaseException as e:  # noqa: B036 — relayed to the caller
+            with self._cv:
+                self._led(t.user).errors += 1
+            # failures stay out of the EWMA: a burst of ~ms fast-fail
+            # queries would deflate the admission wait estimate exactly
+            # when the queue is contended
+            self._note_service(t.user, t.op, time.perf_counter() - t0,
+                               ewma=False)
+            t.future.set_exception(e)
+            return
+        finally:
+            config.adopt_overrides(prev_ov)
+            self._tls.in_dispatch = False
+            self._tls.wait_ms = 0.0
+            self._tls.user = None
+        self._note_service(t.user, t.op, time.perf_counter() - t0,
+                           ewma=not t.continuation)
+        t.future.set_result(out)

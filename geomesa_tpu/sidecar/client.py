@@ -27,14 +27,28 @@ from geomesa_tpu.stats import sketches as sk
 #: both audit events) join on one trace (docs/OBSERVABILITY.md).
 TRACE_HEADER = "x-geomesa-trace-id"
 
+#: Serving headers (docs/SERVING.md): the caller's fair-share identity
+#: (``geomesa.user``) and the remaining deadline budget in ms — the
+#: server's admission queue sheds typed-and-early when the budget can't
+#: be met, instead of burning device time on a guaranteed wire timeout.
+USER_HEADER = "x-geomesa-user"
+DEADLINE_HEADER = "x-geomesa-deadline-ms"
+
 #: structured error-code prefix on Flight error messages (PROTOCOL.md §7.1):
 #: "[GM-ARG] unknown schema 'x'" — lets clients classify retryable vs fatal
 #: without string-matching free-form text.
 _CODE_RE = re.compile(r"\[(GM-[A-Z]+)\]")
 
 #: codes a client may retry (transient server states); everything else is
-#: fatal — the same request would fail the same way.
-RETRYABLE_CODES = {"GM-INTERNAL", "GM-UNAVAILABLE"}
+#: fatal — the same request would fail the same way. GM-OVERLOADED is
+#: admission-queue backpressure: the server is healthy but saturated, and
+#: the retry policy's backoff is exactly the right response.
+RETRYABLE_CODES = {"GM-INTERNAL", "GM-UNAVAILABLE", "GM-OVERLOADED"}
+
+#: codes that ARE a server response (the callee is healthy): they close
+#: the breaker rather than charging it — a user's bad/late/shed query
+#: must never fence the sidecar off for everyone.
+_RESPONSE_CODES = ("GM-ARG", "GM-TIMEOUT", "GM-SHED", "GM-OVERLOADED")
 
 
 def error_code(exc: BaseException) -> Optional[str]:
@@ -110,12 +124,23 @@ class GeoFlightClient:
 
     def _call_options(self) -> Optional[fl.FlightCallOptions]:
         kw = {}
+        headers = []
         t = self._effective_timeout_s()
         if t is not None:
             kw["timeout"] = t
+            # deadline propagation into the server's admission queue: the
+            # server sheds typed-and-early when this budget can't be met
+            headers.append(
+                (DEADLINE_HEADER.encode(), str(int(t * 1000)).encode())
+            )
         tid = tracing.current_trace_id()
         if tid is not None:
-            kw["headers"] = [(TRACE_HEADER.encode(), tid.encode())]
+            headers.append((TRACE_HEADER.encode(), tid.encode()))
+        user = config.USER.get()
+        if user:
+            headers.append((USER_HEADER.encode(), user.encode()))
+        if headers:
+            kw["headers"] = headers
         return fl.FlightCallOptions(**kw) if kw else None
 
     def _reconnect(self):
@@ -151,7 +176,14 @@ class GeoFlightClient:
                 attempt,
                 retryable=is_retryable,
                 deadline=resilience.current_deadline(),
-                on_retry=lambda i, e: self._reconnect(),
+                # reconnect only on UNCODED transport failures — a coded
+                # response (GM-OVERLOADED backpressure especially) came
+                # from a healthy channel, and redialing per attempt would
+                # flood a saturated server with handshakes at peak load
+                on_retry=lambda i, e: (
+                    None if error_code(e) in _RESPONSE_CODES
+                    else self._reconnect()
+                ),
             )
 
         # span the RPC: a child when a query trace is already open, else a
@@ -165,14 +197,19 @@ class GeoFlightClient:
                 out = run()
         except Exception as e:
             code = error_code(e)
-            if code in ("GM-ARG", "GM-TIMEOUT"):
-                # a coded domain error/timeout IS a server response: the
-                # callee is healthy — only transport failures and
-                # GM-INTERNAL count toward opening the circuit (bad user
-                # queries must never fence the sidecar off for everyone)
+            if code in _RESPONSE_CODES:
+                # a coded domain error / timeout / shed / backpressure IS
+                # a server response: the callee is healthy — only
+                # transport failures and GM-INTERNAL count toward opening
+                # the circuit (bad user queries must never fence the
+                # sidecar off for everyone)
                 self._breaker.record_success()
             else:
                 self._breaker.record_failure()
+            if code == "GM-SHED":
+                from geomesa_tpu.resilience import DeadlineShedError
+
+                raise DeadlineShedError(str(e)) from e
             if code == "GM-TIMEOUT":
                 raise QueryTimeoutError(str(e)) from e
             raise
@@ -259,6 +296,11 @@ class GeoFlightClient:
 
     def metrics(self) -> Dict:
         return self._action("metrics")["metrics"]
+
+    def serving_stats(self) -> Dict:
+        """Server-side admission queue snapshot + per-user serving rollups
+        (docs/SERVING.md)."""
+        return self._action("serving-stats")
 
     # -- reads -------------------------------------------------------------
     def _get(self, opts: Dict) -> pa.Table:

@@ -41,44 +41,72 @@ from geomesa_tpu.api.dataset import GeoDataset, Query
 #: (the reference's server-side iterator-version compatibility contract)
 PROTOCOL_VERSION = 1
 
-#: header carrying the client's trace id (sidecar/client.py TRACE_HEADER)
+#: headers carried per call (sidecar/client.py sends all three): the
+#: client's trace id, its fair-share identity, and its remaining deadline
+#: budget in ms (serving admission sheds when the budget can't be met —
+#: docs/SERVING.md)
 _TRACE_HEADER = "x-geomesa-trace-id"
+_USER_HEADER = "x-geomesa-user"
+_DEADLINE_HEADER = "x-geomesa-deadline-ms"
 
 
-class _TraceMiddleware(fl.ServerMiddleware):
-    """Per-call carrier of the client's trace id (read from the Flight
-    headers by the factory; the handlers fetch it via context)."""
+class _CallHeaders(fl.ServerMiddleware):
+    """Per-call carrier of the client's serving headers (read from the
+    Flight headers by the factory; the handlers fetch it via context)."""
 
-    def __init__(self, trace_id: Optional[str]):
+    def __init__(self, trace_id: Optional[str], user: Optional[str],
+                 budget_s: Optional[float]):
         self.trace_id = trace_id
+        self.user = user
+        self.budget_s = budget_s
 
 
 _TRACE_ID_RE = re.compile(r"^[0-9A-Za-z_-]{1,64}$")
+#: user identities are looser than trace ids — emails and dotted/scoped
+#: names ("alice@example.com", "svc.ingest:prod") must survive, or fair
+#: share silently collapses those users into one "anonymous" bucket; still
+#: a single printable token (no whitespace/control chars) with a hard cap,
+#: since it flows into audit hints and JSONL
+_USER_RE = re.compile(r"^[0-9A-Za-z@._+:/=-]{1,128}$")
+
+
+def _header(headers, name: str) -> Optional[str]:
+    vals = headers.get(name) or headers.get(name.encode())
+    if not vals:
+        return None
+    v = vals[0]
+    return v.decode(errors="replace") if isinstance(v, bytes) else str(v)
 
 
 class _TraceMiddlewareFactory(fl.ServerMiddlewareFactory):
     def start_call(self, info, headers):
-        vals = headers.get(_TRACE_HEADER) or headers.get(
-            _TRACE_HEADER.encode()
-        )
-        if not vals:
-            return None
-        v = vals[0]
-        tid = v.decode(errors="replace") if isinstance(v, bytes) else str(v)
-        # the id flows verbatim into audit hints and slow-trace JSONL:
+        # the ids flow verbatim into audit hints and slow-trace JSONL:
         # refuse anything that isn't a short token (log-injection /
         # oversized-header hygiene; our own ids are 16 hex chars)
-        if not _TRACE_ID_RE.match(tid):
+        tid = _header(headers, _TRACE_HEADER)
+        if tid is not None and not _TRACE_ID_RE.match(tid):
+            tid = None
+        user = _header(headers, _USER_HEADER)
+        if user is not None and not _USER_RE.match(user):
+            user = None
+        budget_s = None
+        raw = _header(headers, _DEADLINE_HEADER)
+        if raw is not None:
+            try:
+                budget_s = max(float(raw) / 1000.0, 0.0)
+            except ValueError:
+                pass
+        if tid is None and user is None and budget_s is None:
             return None
-        return _TraceMiddleware(tid)
+        return _CallHeaders(tid, user, budget_s)
 
 
-def _context_trace_id(context) -> Optional[str]:
+def _call_headers(context) -> _CallHeaders:
     try:
         mw = context.get_middleware("geomesa-trace")
     except Exception:
-        return None
-    return mw.trace_id if mw is not None else None
+        mw = None
+    return mw if mw is not None else _CallHeaders(None, None, None)
 
 
 def _lib_version() -> str:
@@ -144,15 +172,32 @@ def _spec_errors(fn):
       client maps it back to ``QueryTimeoutError``;
     * ``GM-INTERNAL`` (retryable) — unexpected server failure.
 
+    Serving-scheduler rejections (docs/SERVING.md) carry their own codes:
+
+    * ``GM-SHED`` (fatal to this attempt) — the query was shed at
+      admission/dispatch because its deadline budget could not be met; no
+      device work ran;
+    * ``GM-OVERLOADED`` (retryable with backoff) — the bounded admission
+      queue is full: backpressure from a healthy but saturated server.
+
     Already-coded Flight errors pass through untouched."""
     import functools
 
-    from geomesa_tpu.resilience import QueryTimeoutError
+    from geomesa_tpu.resilience import (
+        AdmissionRejectedError, DeadlineShedError, QueryTimeoutError,
+    )
 
     @functools.wraps(fn)
     def wrapped(*args, **kw):
         try:
             return fn(*args, **kw)
+        except DeadlineShedError as e:
+            # before QueryTimeoutError: shed is its subclass, and the
+            # client distinguishes "server never started" from "ran out
+            # of budget mid-scan"
+            raise fl.FlightTimedOutError(f"[GM-SHED] {e}") from e
+        except AdmissionRejectedError as e:
+            raise fl.FlightUnavailableError(f"[GM-OVERLOADED] {e}") from e
         except QueryTimeoutError as e:
             raise fl.FlightTimedOutError(f"[GM-TIMEOUT] {e}") from e
         except (KeyError, ValueError, NotImplementedError) as e:
@@ -166,75 +211,17 @@ def _spec_errors(fn):
     return wrapped
 
 
-class _QueryThread:
-    """Single dedicated worker that runs every dataset operation.
-
-    gRPC owns the transport threads Flight handlers run on; compiling jax
-    kernels there wedges nondeterministically (MLIR context creation can
-    deadlock on a foreign C++ thread — observed as an unkillable server
-    stuck in ``make_ir_context`` under the conformance suite). Routing all
-    planning/compute through one ordinary Python thread keeps jax on the
-    kind of thread it is tested on, and matches the device model anyway:
-    the sidecar owns ONE accelerator, and device work is serial."""
-
-    def __init__(self):
-        import queue
-
-        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
-        self._stopped = False
-        self._t = threading.Thread(
-            target=self._loop, name="geomesa-query", daemon=True
-        )
-        self._t.start()
-
-    def _loop(self):
-        while True:
-            fut, fn = self._q.get()
-            if fn is None:
-                # drain stragglers that raced the stop: their callers must
-                # not block forever on a future nothing will complete
-                while True:
-                    try:
-                        fut2, fn2 = self._q.get_nowait()
-                    except Exception:
-                        return
-                    if fn2 is not None:
-                        fut2.set_exception(
-                            RuntimeError("sidecar query thread stopped")
-                        )
-            try:
-                fut.set_result(fn())
-            except BaseException as e:  # noqa: B036 — relayed to caller
-                fut.set_exception(e)
-
-    def run(self, fn):
-        """Run ``fn()`` on the query thread; re-raises its exception."""
-        from concurrent.futures import Future
-
-        if self._stopped:
-            raise RuntimeError("sidecar query thread stopped")
-        fut: Future = Future()
-        self._q.put((fut, fn))
-        return fut.result()
-
-    def iterate(self, it):
-        """Drive iterator ``it`` with every ``next`` on the query thread
-        (streamed exports compute their chunks there too)."""
-        done = object()
-        while True:
-            item = self.run(lambda: next(it, done))
-            if item is done:
-                return
-            yield item
-
-    def stop(self):
-        from concurrent.futures import Future
-
-        self._stopped = True
-        self._q.put((Future(), None))
-
-
 class GeoFlightServer(fl.FlightServerBase):
+    """Flight server over a GeoDataset. Every dataset operation runs on
+    ONE dispatch thread behind the serving scheduler (docs/SERVING.md) —
+    the jit-deadlock discipline (gRPC owns the transport threads Flight
+    handlers run on; compiling jax kernels there wedges
+    nondeterministically in MLIR context creation, so all planning/compute
+    routes through one ordinary Python thread) now doubles as the serving
+    bottleneck the scheduler manages: a bounded admission queue with
+    deadline-aware ordering, per-user fair share, typed load shedding, and
+    cross-query fusion of compatible aggregates into one device pass."""
+
     def __init__(self, dataset: Optional[GeoDataset] = None,
                  location: str = "grpc+tcp://127.0.0.1:0", **kw):
         mw = dict(kw.pop("middleware", None) or {})
@@ -242,40 +229,135 @@ class GeoFlightServer(fl.FlightServerBase):
         super().__init__(location, middleware=mw, **kw)
         self.dataset = dataset if dataset is not None else GeoDataset()
         self._lock = threading.Lock()
-        self._qt = _QueryThread()
+        # the DATASET's scheduler, promoted to dispatch-thread mode: local
+        # ops and Flight ops share one ledger and one fair-share domain
+        self._sched = self.dataset.serving.start()
 
-    def _run_traced(self, context, name: str, fn):
-        """Run ``fn`` on the query thread under a server-side root span
-        that ADOPTS the client's trace id from the Flight header (so the
-        server audit event and any server-side spans share the client's
-        trace). ``force``: an incoming header is honored even when this
-        process's own tracing knob is off — the client already opted in."""
-        tid = _context_trace_id(context)
+    def _serve(self, context, name: str, fn, op: Optional[str] = None,
+               fuse=None, continuation: bool = False):
+        """Admit ``fn`` to the dispatch queue and wait. Execution runs
+        under a server-side root span that ADOPTS the client's trace id
+        from the Flight header (so the server audit event and any
+        server-side spans share the client's trace). ``force``: an
+        incoming header is honored even when this process's own tracing
+        knob is off — the client already opted in. The client's
+        ``x-geomesa-user`` header keys fair share; its
+        ``x-geomesa-deadline-ms`` budget drives admission shedding."""
+        h = _call_headers(context)
+        tid = h.trace_id
 
         def go():
             with tracing.start(name, trace_id=tid, force=tid is not None,
-                               remote=tid is not None):
+                               remote=tid is not None) as root:
+                if root is not tracing.NOOP:
+                    w = self._sched.current_wait_ms()
+                    if w:
+                        root.set(queue_wait_ms=round(w, 3))
                 return fn()
 
-        return self._qt.run(go)
+        # submit (never inline): after shutdown the scheduler raises here,
+        # exactly like the stopped query thread did — a straggler RPC must
+        # not compile jax on its gRPC transport thread
+        return self._sched.submit(
+            go, user=h.user, op=op or name, fuse=fuse,
+            budget_s=h.budget_s, trace_id=tid, continuation=continuation,
+        ).result()
+
+    def _fuse_spec(self, op: str, opts: Dict):
+        """Fusion eligibility for one wire request: compatible queued
+        requests coalesce into one device pass; results wrap back into
+        the op's wire frame per member (serving/fuse.py)."""
+        from geomesa_tpu.serving import FuseSpec
+        from geomesa_tpu.serving import fuse as fusemod
+
+        name = opts.get("schema")
+        if not name:
+            return None
+        key = fusemod.fuse_key(op, name, opts)
+        if key is None:
+            return None
+
+        def batch(tickets):
+            from geomesa_tpu.serving.scheduler import FusedMemberError
+
+            # run_batch failures fall back to per-member serial execution
+            # (nothing committed yet); WRAP failures after the batch ran
+            # must not — the device pass and audit events already
+            # happened, so a bad member gets its own error instead
+            raws = fusemod.run_batch(self.dataset, op, name, tickets)
+            out = []
+            for t, r in zip(tickets, raws):
+                if isinstance(r, FusedMemberError):
+                    # run_batch already failed this member's bookkeeping:
+                    # pass its REAL error through — wrapping the sentinel
+                    # would bury it under a framing TypeError
+                    out.append(r)
+                    continue
+                try:
+                    out.append(self._wrap_fused(op, t.fuse.payload, r))
+                except Exception as e:
+                    out.append(FusedMemberError(e))
+            return out
+
+        # "wire" prefix: wire tickets return Flight frames — they must
+        # never coalesce with raw local tickets of the same query
+        return FuseSpec(key=("wire", op, name) + key, payload=dict(opts),
+                        batch=batch)
+
+    def _wrap_fused(self, op: str, opts: Dict, raw):
+        """One member's raw fused result -> the op's wire frame (identical
+        to what the serial handler would have returned)."""
+        if op == "count":
+            return iter([fl.Result(
+                json.dumps({"count": int(raw)}).encode()
+            )])
+        if op == "density":
+            batch = _sparse_grid_batch(raw, np.float32)
+            return fl.RecordBatchStream(pa.Table.from_batches([batch]))
+        if op == "density_curve":
+            grid, snapped = raw
+            batch = _sparse_grid_batch(grid, np.float64)
+            return fl.RecordBatchStream(
+                pa.Table.from_batches([batch]).replace_schema_metadata(
+                    {b"geomesa:snapped_bbox":
+                     json.dumps(list(snapped)).encode()}
+                )
+            )
+        if op == "stats":
+            batch = pa.record_batch(
+                [pa.array([opts["stat"]]), pa.array([raw.to_json()])],
+                names=["stat", "value"],
+            )
+            return fl.RecordBatchStream(pa.Table.from_batches([batch]))
+        raise ValueError(f"unfusable op {op!r}")
 
     def shutdown(self, *a, **kw):
-        # stop the worker AFTER Flight drains active RPCs — those RPCs hop
-        # onto the query thread, and stopping it first would strand them
-        # on futures nothing completes (shutdown would then never return)
+        # stop the scheduler AFTER Flight drains active RPCs — those RPCs
+        # hop onto the dispatch thread, and stopping it first would strand
+        # them on futures nothing completes (shutdown would never return).
+        # The dataset's scheduler drops back to inline mode: local ops on
+        # the dataset keep working after the server is gone.
         out = super().shutdown(*a, **kw)
-        self._qt.stop()
+        self._sched.stop()
         return out
 
     # -- reads -------------------------------------------------------------
     @_spec_errors
     def do_get(self, context, ticket: fl.Ticket) -> fl.RecordBatchStream:
-        return self._run_traced(
-            context, "sidecar.do_get", lambda: self._do_get(ticket)
+        # parse on the transport thread (cheap, no jax): the op's fusion
+        # key must exist BEFORE the ticket queues, or nothing could
+        # coalesce with it
+        opts = json.loads(ticket.ticket.decode())
+        op = opts.get("op", "query")
+        fuse = None
+        if op in ("density", "density_curve", "stats"):
+            fuse = self._fuse_spec(op, opts)
+        return self._serve(
+            context, "sidecar.do_get", lambda: self._do_get(opts),
+            op=f"get:{op}", fuse=fuse,
         )
 
-    def _do_get(self, ticket: fl.Ticket) -> fl.RecordBatchStream:
-        opts = json.loads(ticket.ticket.decode())
+    def _do_get(self, opts: Dict) -> fl.RecordBatchStream:
         op = opts.get("op", "query")
         name = opts["schema"]
         ds = self.dataset
@@ -329,9 +411,19 @@ class GeoFlightServer(fl.FlightServerBase):
                 except Exception as e:
                     raise fl.FlightServerError(f"[GM-INTERNAL] {e!r}") from e
 
-            # chunks are computed on the query thread too: gRPC pulls the
-            # stream from its own threads, but every next() hops back
-            return fl.GeneratorStream(wire, self._qt.iterate(gen()))
+            # chunks are computed on the dispatch thread too: gRPC pulls
+            # the stream from its own threads, but every next() hops back
+            # (as continuation tickets — never bounded or shed mid-stream).
+            # Chunks charge the STREAM OWNER's ledger (current_user() here
+            # is the opening ticket's user), so a heavy exporter cannot
+            # hide its load under "anonymous" and beat fair share.
+            owner = self._sched.current_user()
+            return fl.GeneratorStream(
+                wire, self._sched.iterate(gen(), user=owner,
+                                          op="get:query:stream")
+            )
+        # serial framing delegates to _wrap_fused so the serial and fused
+        # wire frames are the SAME code — they can never drift apart
         if op == "density":
             q = _query_from(opts)
             grid = ds.density(
@@ -339,28 +431,18 @@ class GeoFlightServer(fl.FlightServerBase):
                 width=opts.get("width", 256), height=opts.get("height", 256),
                 weight=opts.get("weight"),
             )
-            batch = _sparse_grid_batch(grid, np.float32)
-            return fl.RecordBatchStream(pa.Table.from_batches([batch]))
+            return self._wrap_fused("density", opts, grid)
         if op == "density_curve":
             q = _query_from(opts)
             grid, snapped = ds.density_curve(
                 name, q, level=opts.get("level", 9),
                 bbox=opts.get("bbox"), weight=opts.get("weight"),
             )
-            batch = _sparse_grid_batch(grid, np.float64)
-            return fl.RecordBatchStream(
-                pa.Table.from_batches([batch]).replace_schema_metadata(
-                    {b"geomesa:snapped_bbox": json.dumps(list(snapped)).encode()}
-                )
-            )
+            return self._wrap_fused("density_curve", opts, (grid, snapped))
         if op == "stats":
             q = _query_from(opts)
             stat = ds.stats(name, opts["stat"], q)
-            batch = pa.record_batch(
-                [pa.array([opts["stat"]]), pa.array([stat.to_json()])],
-                names=["stat", "value"],
-            )
-            return fl.RecordBatchStream(pa.Table.from_batches([batch]))
+            return self._wrap_fused("stats", opts, stat)
         if op == "bin":
             q = _query_from(opts)
             blob = ds.export_bin(
@@ -405,19 +487,38 @@ class GeoFlightServer(fl.FlightServerBase):
                     raise
             return n
 
-        n = self._run_traced(context, "sidecar.do_put", ingest)
+        n = self._serve(context, "sidecar.do_put", ingest, op="put")
         writer  # (no app-metadata channel needed; count via describe/count)
         return n
 
     # -- actions -----------------------------------------------------------
     @_spec_errors
     def do_action(self, context, action: fl.Action) -> Iterator[fl.Result]:
-        return self._run_traced(
-            context, "sidecar.do_action", lambda: self._do_action(action)
+        kind = action.type
+        fuse = None
+        # parse once on the transport thread (do_get's shape); bad JSON
+        # leaves body None so _do_action re-parses and raises the typed
+        # error on the dispatch thread, exactly as before
+        try:
+            body = json.loads(action.body.to_pybytes().decode()) \
+                if action.body else {}
+        except ValueError:
+            body = None
+        if kind == "count" and body and body.get("name"):
+            fuse = self._fuse_spec(
+                "count", {**body, "schema": body["name"]}
+            )
+        return self._serve(
+            context, "sidecar.do_action",
+            lambda: self._do_action(action, body),
+            op=f"action:{kind}", fuse=fuse,
         )
 
-    def _do_action(self, action: fl.Action) -> Iterator[fl.Result]:
-        body = json.loads(action.body.to_pybytes().decode()) if action.body else {}
+    def _do_action(self, action: fl.Action,
+                   body: Optional[Dict] = None) -> Iterator[fl.Result]:
+        if body is None:
+            body = json.loads(action.body.to_pybytes().decode()) \
+                if action.body else {}
         ds = self.dataset
         kind = action.type
 
@@ -441,7 +542,7 @@ class GeoFlightServer(fl.FlightServerBase):
         if kind == "count":
             n = ds.count(body["name"], _query_from(body),
                          exact=body.get("exact", True))
-            return ok({"count": int(n)})
+            return self._wrap_fused("count", body, n)
         if kind == "audit":
             evs = ds.audit.recent(body.get("n", 100))
             return ok({"events": [json.loads(e.to_json()) for e in evs]})
@@ -454,6 +555,13 @@ class GeoFlightServer(fl.FlightServerBase):
             # of this sidecar shares it; this is the operator's view of
             # residency + hit rates (docs/CACHE.md)
             return ok({"cache": ds.cache.store.snapshot()})
+        if kind == "serving-stats":
+            # queue depth + per-user ledger (docs/SERVING.md; the same
+            # rollup /debug/queries exposes)
+            return ok({
+                "serving": self._sched.snapshot(),
+                "users": self._sched.user_rollups(),
+            })
         if kind == "version":
             # the distributed-version handshake (GeoMesaDataStore.scala:
             # 498-503, 615-667: client checks the server-side iterator
@@ -475,6 +583,7 @@ class GeoFlightServer(fl.FlightServerBase):
             ("audit", "recent query events: {n}"),
             ("metrics", "metrics registry snapshot"),
             ("cache-stats", "aggregate cache residency + hit counters"),
+            ("serving-stats", "admission queue depth + per-user rollups"),
         ]
 
     # -- discovery ---------------------------------------------------------

@@ -161,16 +161,46 @@ def attach_confluent(sds, name: str, registry: SchemaRegistry):
     (serializer, ingest) where ``ingest(data: bytes | None, fid=None,
     ts_ms=None)`` routes one Kafka-style record into the live cache —
     framed Avro value = upsert, ``None`` value + fid = tombstone delete
-    (ConfluentKafkaDataStore's consumer loop semantics)."""
+    (ConfluentKafkaDataStore's consumer loop semantics).
+
+    Observability (docs/OBSERVABILITY.md): each record applies under a
+    ``stream.apply`` span + timer, and the ``stream.lag`` gauge tracks
+    poll→apply latency (apply wall-clock minus the record's event time) —
+    the same lag signal ``StreamingDataset.poll`` exposes, here measured
+    at the broker-facing decode/apply edge."""
     import time as _time
+
+    from geomesa_tpu import metrics, tracing
 
     ft = sds.get_schema(name)
     ser = ConfluentSerializer(registry, name, ft)
     de = ConfluentDeserializer(registry, ft)
+    # metric objects are invariant for the attachment's lifetime — resolve
+    # them once here, not per record under the registry lock on the
+    # broker-facing hot path
+    apply_timer = metrics.registry().timer(metrics.STREAM_APPLY)
+    lag_gauge = metrics.registry().gauge(metrics.STREAM_LAG)
+    lag_gauge_schema = metrics.registry().gauge(f"{metrics.STREAM_LAG}.{name}")
 
     def ingest(data: Optional[bytes], fid: Optional[str] = None,
                ts_ms: Optional[int] = None) -> str:
+        with tracing.span("stream.apply", schema=name, edge="confluent") \
+                as sp, apply_timer.time():
+            out = _ingest(data, fid, ts_ms, sp)
+        return out
+
+    def _ingest(data: Optional[bytes], fid: Optional[str],
+                ts_ms: Optional[int], sp) -> str:
         now = int(_time.time() * 1000) if ts_ms is None else int(ts_ms)
+        if ts_ms is not None:
+            # lag is only meaningful against a real record timestamp — a
+            # producer that sets none would pin the gauge at 0 and mask
+            # genuine consumer lag (same guard as StreamingDataset.poll's
+            # applied_ts check)
+            lag_ms = max(int(_time.time() * 1000) - int(ts_ms), 0)
+            sp.set(lag_ms=lag_ms)
+            lag_gauge.set(lag_ms)
+            lag_gauge_schema.set(lag_ms)
         if data is None:
             if not fid:
                 raise ValueError("a tombstone needs a feature id")

@@ -346,7 +346,15 @@ class StreamingDataset:
     def poll(self, name: Optional[str] = None, max_messages: int = 100_000) -> int:
         """Consume pending messages into the live cache(s). Returns #consumed
         (quarantined poison messages are skipped, counted in
-        :attr:`quarantined`, and NOT included in the returned count)."""
+        :attr:`quarantined`, and NOT included in the returned count).
+
+        Observability (docs/OBSERVABILITY.md): each schema's apply phase
+        runs under a ``stream.apply`` span + timer, and the ``stream.lag``
+        gauge (plus a per-schema breakdown) tracks poll→apply latency —
+        apply wall-clock minus the last applied message's event time, the
+        consumer-lag signal /metrics exposes."""
+        from geomesa_tpu import metrics, tracing
+
         names = [name] if name else list(self._schemas)
         total = 0
         for nm in names:
@@ -358,34 +366,53 @@ class StreamingDataset:
             )
             cache = self._caches[nm]
             listeners = self._listeners[nm]
-            for m in msgs:
-                try:
-                    if m.kind == CHANGE:
-                        cache.validate(m.payload or {})
-                        cache.put(m.fid, m.payload or {}, m.ts_ms)
-                    elif m.kind == DELETE:
-                        cache.remove(m.fid)
-                    elif m.kind == CLEAR:
-                        cache.clear()
-                except Exception as e:
-                    # decoded but unappliable (bad payload types): same
-                    # quarantine path as an undecodable message
-                    self._quarantine(nm, m.fid or m.kind, e, "apply")
-                    continue
-                for fn in listeners:
+            if not msgs:
+                # empty polls skip the span AND the timer: a tight idle
+                # poll loop would otherwise flood stream.apply with ~0 s
+                # samples and collapse its histogram quantiles exactly
+                # when an operator investigates apply latency
+                cache.expire()
+                continue
+            applied_ts: Optional[int] = None
+            with tracing.span("stream.apply", schema=nm,
+                              messages=len(msgs)) as sp, \
+                    metrics.registry().timer(metrics.STREAM_APPLY).time():
+                for m in msgs:
                     try:
-                        fn(m)
-                    except Exception:
-                        # a throwing listener is an observer bug, not a data
-                        # fault: log it, keep the message (it applied) and
-                        # the consumer alive
-                        import logging
+                        if m.kind == CHANGE:
+                            cache.validate(m.payload or {})
+                            cache.put(m.fid, m.payload or {}, m.ts_ms)
+                        elif m.kind == DELETE:
+                            cache.remove(m.fid)
+                        elif m.kind == CLEAR:
+                            cache.clear()
+                    except Exception as e:
+                        # decoded but unappliable (bad payload types): same
+                        # quarantine path as an undecodable message
+                        self._quarantine(nm, m.fid or m.kind, e, "apply")
+                        continue
+                    applied_ts = m.ts_ms
+                    for fn in listeners:
+                        try:
+                            fn(m)
+                        except Exception:
+                            # a throwing listener is an observer bug, not a
+                            # data fault: log it, keep the message (it
+                            # applied) and the consumer alive
+                            import logging
 
-                        logging.getLogger(__name__).warning(
-                            "feature listener failed on %s/%s",
-                            nm, m.fid or m.kind, exc_info=True,
-                        )
-                total += 1
+                            logging.getLogger(__name__).warning(
+                                "feature listener failed on %s/%s",
+                                nm, m.fid or m.kind, exc_info=True,
+                            )
+                    total += 1
+                if applied_ts is not None:
+                    lag_ms = max(int(time.time() * 1000) - applied_ts, 0)
+                    sp.set(lag_ms=lag_ms)
+                    metrics.registry().gauge(metrics.STREAM_LAG).set(lag_ms)
+                    metrics.registry().gauge(
+                        f"{metrics.STREAM_LAG}.{nm}"
+                    ).set(lag_ms)
             cache.expire()
         return total
 

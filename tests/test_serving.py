@@ -1,0 +1,577 @@
+"""Serving scheduler tests (docs/SERVING.md): bounded admission,
+deadline-aware shedding, per-user fair share, cross-query fusion
+(correctness proof: bit-identical to serial, ≤ 2 device dispatches for a
+fused batch of 8), the wire surface ([GM-SHED]/[GM-OVERLOADED], headers),
+and the observability satellites (per-user rollups, stream lag, fs
+quarantine in /healthz, arrow-store fault points)."""
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, config, metrics, resilience, tracing
+from geomesa_tpu.api.dataset import Query
+from geomesa_tpu.resilience import (
+    AdmissionRejectedError, DeadlineShedError, deadline_scope,
+)
+from geomesa_tpu.serving import FuseSpec, QueryScheduler, fuse
+
+ECQL = "BBOX(geom, -5, -5, 5, 5)"
+
+
+@pytest.fixture(scope="module")
+def ds():
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "a:Integer,dtg:Date,*geom:Point")
+    rng = np.random.default_rng(7)
+    n = 4000
+    ds.insert("t", {
+        "geom__x": rng.uniform(-10, 10, n),
+        "geom__y": rng.uniform(-10, 10, n),
+        "dtg": rng.integers(0, 10**10, n).astype("datetime64[ms]"),
+        "a": rng.integers(0, 5, n).astype(np.int32),
+    }, fids=np.arange(n).astype(str))
+    ds.flush("t")
+    ds.count("t", ECQL)  # warm: plan + kernel + windows
+    return ds
+
+
+@pytest.fixture()
+def sched(ds):
+    s = ds.serving.start()
+    yield s
+    s.stop()
+
+
+def _stall(sched, timeout=10.0):
+    """Block the dispatch thread so subsequent submissions queue. Waits
+    until the stall ticket is actually EXECUTING (not merely queued), so
+    callers can rely on the queue being empty and the dispatcher busy."""
+    gate = threading.Event()
+    started = threading.Event()
+
+    def fn():
+        started.set()
+        return gate.wait(timeout)
+
+    fut = sched.submit(fn, user="stall", op="stall")
+    assert started.wait(10), "stall ticket never dispatched"
+    return gate, fut
+
+
+# ---------------------------------------------------------------------------
+# admission queue
+# ---------------------------------------------------------------------------
+
+
+def test_queue_full_rejects_typed(sched, ds):
+    gate, fut = _stall(sched)
+    try:
+        with config.SERVING_QUEUE_DEPTH.scoped(2):
+            sched.submit(lambda: 1, user="u", op="x")
+            sched.submit(lambda: 2, user="u", op="x")
+            with pytest.raises(AdmissionRejectedError):
+                sched.submit(lambda: 3, user="u", op="x")
+    finally:
+        gate.set()
+        fut.result(10)
+    assert ds.serving.user_rollups()["u"]["rejected"] == 1
+
+
+def test_expired_budget_sheds_before_any_device_work(sched):
+    disp = metrics.registry().counter(metrics.EXEC_DEVICE_DISPATCH)
+    d0 = disp.value
+    with pytest.raises(DeadlineShedError):
+        sched.submit(lambda: (_ for _ in ()).throw(AssertionError("ran")),
+                     user="u", op="count", budget_s=0.0)
+    assert disp.value == d0  # typed rejection, zero device work
+
+
+def test_budget_lapsing_in_queue_sheds_at_dispatch(sched):
+    gate, fut = _stall(sched)
+    # estimate shedding off: force the dispatch-time check specifically
+    with config.SERVING_SHED_ESTIMATE.scoped("false"):
+        f = sched.submit(lambda: "ran", user="u", op="x", budget_s=0.02)
+    time.sleep(0.08)  # budget lapses while queued
+    gate.set()
+    fut.result(10)
+    with pytest.raises(DeadlineShedError) as ei:
+        f.result(10)
+    assert "no device work" in str(ei.value)
+
+
+def test_estimated_wait_sheds_at_admission(sched):
+    sched._ewma_all = 5.0  # recent queries "took 5 s"
+    try:
+        gate, fut = _stall(sched)
+        sched.submit(lambda: 1, user="filler", op="x")  # queued depth > 0
+        with pytest.raises(DeadlineShedError) as ei:
+            sched.submit(lambda: 1, user="u", op="x", budget_s=0.5)
+        assert "estimated queue wait" in str(ei.value)
+        gate.set()
+        fut.result(10)
+    finally:
+        sched._ewma_all = None
+
+
+def test_continuations_bypass_queue_bound(sched):
+    gate, fut = _stall(sched)
+    try:
+        with config.SERVING_QUEUE_DEPTH.scoped(1):
+            sched.submit(lambda: 1, user="u", op="x")
+            # a stream continuation must not be bounced by a full queue
+            f = sched.submit(lambda: "chunk", user="u", op="stream",
+                             continuation=True)
+    finally:
+        gate.set()
+        fut.result(10)
+    assert f.result(10) == "chunk"
+
+
+def test_local_admission_sheds_expired_deadline(ds):
+    disp = metrics.registry().counter(metrics.EXEC_DEVICE_DISPATCH)
+    d0 = disp.value
+    with deadline_scope(0.0):
+        with pytest.raises(DeadlineShedError):
+            ds.count("t", ECQL)
+    assert disp.value == d0
+    # DeadlineShedError still classifies as a timeout for existing callers
+    assert issubclass(DeadlineShedError, resilience.QueryTimeoutError)
+
+
+# ---------------------------------------------------------------------------
+# fair share
+# ---------------------------------------------------------------------------
+
+
+def test_fair_share_prevents_burst_starvation(sched):
+    done = []
+    lock = threading.Lock()
+
+    def work(tag, dur=0.02):
+        def fn():
+            time.sleep(dur)
+            with lock:
+                done.append(tag)
+            return tag
+        return fn
+
+    gate, fut = _stall(sched)
+    futs = [sched.submit(work(f"A{i}"), user="burst", op="w")
+            for i in range(6)]
+    futs += [sched.submit(work(f"B{i}"), user="interactive", op="w")
+             for i in range(2)]
+    gate.set()
+    fut.result(10)
+    for f in futs:
+        f.result(30)
+    # under FIFO, B0/B1 would run after all six A ops; fair share must
+    # interleave them well before the burst drains
+    assert done.index("B1") < done.index("A5"), done
+    assert done.index("B0") <= 3, done
+
+
+# ---------------------------------------------------------------------------
+# cross-query fusion
+# ---------------------------------------------------------------------------
+
+
+def test_fused_count_batch_bit_identical_and_two_dispatches(sched, ds):
+    serial = ds.count("t", ECQL)
+    opts = {"ecql": ECQL}
+    gate, fut = _stall(sched)
+    futs = [
+        sched.submit(lambda: ds.count("t", ECQL), user=f"u{i % 3}",
+                     op="count", fuse=fuse.make_spec(ds, "count", "t", opts),
+                     trace_id=f"member{i:011d}")
+        for i in range(8)
+    ]
+    disp = metrics.registry().counter(metrics.EXEC_DEVICE_DISPATCH)
+    fused_before = metrics.registry().counter(metrics.SERVING_FUSED).value
+    d0 = disp.value
+    gate.set()
+    fut.result(10)
+    results = [f.result(30) for f in futs]
+    # correctness proof: bit-identical to serial execution, ≤ 2 dispatches
+    assert results == [serial] * 8
+    assert disp.value - d0 <= 2, disp.value - d0
+    assert metrics.registry().counter(metrics.SERVING_FUSED).value \
+        - fused_before >= 7
+    # each fused member keeps its own audit event, carrying its trace id
+    evs = [json.loads(e.to_json()) for e in ds.audit.recent(50)]
+    fused_evs = [e for e in evs if e["hints"].get("fused")]
+    tids = {e["hints"].get("trace_id") for e in fused_evs}
+    assert {f"member{i:011d}" for i in range(1, 8)} <= tids
+
+
+def test_mixed_batch_degrades_to_per_query(sched, ds):
+    other = "BBOX(geom, 0, 0, 9, 9)"
+    ds.count("t", other)  # warm the second kernel
+    n1, n2 = ds.count("t", ECQL), ds.count("t", other)
+    gate, fut = _stall(sched)
+    futs = []
+    for i in range(6):
+        ecql = ECQL if i % 2 == 0 else other
+        opts = {"ecql": ecql}
+        futs.append(sched.submit(
+            lambda e=ecql: ds.count("t", e), user="u", op="count",
+            fuse=fuse.make_spec(ds, "count", "t", opts),
+        ))
+    gate.set()
+    fut.result(10)
+    results = [f.result(30) for f in futs]
+    # incompatible kernel tokens -> separate groups, correct per-query
+    assert results == [n1, n2, n1, n2, n1, n2]
+
+
+def test_fused_density_curve_tiles_bit_identical(sched, ds):
+    bboxes = [(-5, -5, 0, 0), (0, 0, 5, 5), (-5, 0, 0, 5), (-2, -2, 2, 2)]
+    serial = [ds.density_curve("t", ECQL, level=6, bbox=b) for b in bboxes]
+    disp = metrics.registry().counter(metrics.EXEC_DEVICE_DISPATCH)
+    gate, fut = _stall(sched)
+    futs = []
+    for b in bboxes:
+        opts = {"ecql": ECQL, "level": 6, "bbox": list(b)}
+        futs.append(sched.submit(
+            lambda bb=b: ds.density_curve("t", ECQL, level=6, bbox=bb),
+            user="tiles", op="density_curve",
+            fuse=fuse.make_spec(ds, "density_curve", "t", opts),
+        ))
+    d0 = disp.value
+    gate.set()
+    fut.result(10)
+    out = [f.result(30) for f in futs]
+    assert disp.value - d0 <= 2, disp.value - d0
+    for (g, s), (gs, ss) in zip(out, serial):
+        assert np.array_equal(g, gs)
+        assert s == ss
+
+
+def test_fusion_respects_master_switch(sched, ds):
+    opts = {"ecql": ECQL}
+    with config.SERVING_FUSION.scoped("false"):
+        gate, fut = _stall(sched)
+        futs = [
+            sched.submit(lambda: ds.count("t", ECQL), user="u", op="count",
+                         fuse=fuse.make_spec(ds, "count", "t", opts))
+            for _ in range(3)
+        ]
+        fused0 = metrics.registry().counter(metrics.SERVING_FUSED).value
+        gate.set()
+        fut.result(10)
+        [f.result(30) for f in futs]
+    assert metrics.registry().counter(metrics.SERVING_FUSED).value == fused0
+
+
+def test_failing_batch_falls_back_to_serial(sched):
+    calls = []
+
+    def boom(tickets):
+        raise RuntimeError("batch exploded")
+
+    spec = FuseSpec(key=("k",), batch=boom)
+    gate, fut = _stall(sched)
+    futs = [
+        sched.submit(lambda i=i: calls.append(i) or i, user="u", op="x",
+                     fuse=FuseSpec(key=("k",), batch=boom))
+        for i in range(3)
+    ]
+    del spec
+    gate.set()
+    fut.result(10)
+    assert [f.result(10) for f in futs] == [0, 1, 2]
+    assert calls == [0, 1, 2]  # per-member serial fallback ran them all
+
+
+def test_unfusable_hints_get_no_key():
+    assert fuse.fuse_key("count", "t", {"ecql": ECQL, "sampling": 10}) is None
+    assert fuse.fuse_key("count", "t", {"ecql": ECQL, "max_features": 5}) is None
+    k1 = fuse.fuse_key("density_curve", "t",
+                       {"ecql": ECQL, "level": 6, "bbox": [0, 0, 1, 1]})
+    k2 = fuse.fuse_key("density_curve", "t",
+                       {"ecql": ECQL, "level": 6, "bbox": [2, 2, 3, 3]})
+    assert k1 == k2  # tile crops stack: bbox is data, not key
+    assert fuse.fuse_key("count", "t", {"ecql": "INCLUDE"}) != \
+        fuse.fuse_key("count", "t", {"ecql": ECQL})
+
+
+def test_density_curve_batch_public_api(ds):
+    bboxes = [(-5, -5, 0, 0), (0, 0, 5, 5)]
+    serial = [ds.density_curve("t", ECQL, level=6, bbox=b) for b in bboxes]
+    out = ds.density_curve_batch(
+        "t", ECQL, level=6, bboxes=bboxes,
+        members=[{"trace_id": "aaaa", "user": "u1"},
+                 {"trace_id": "bbbb", "user": "u2"}],
+    )
+    for (g, s), (gs, ss) in zip(out, serial):
+        assert np.array_equal(g, gs)
+        assert s == ss
+    evs = [json.loads(e.to_json()) for e in ds.audit.recent(4)]
+    members = [e for e in evs if e["hints"].get("fused_batch") == 2]
+    assert len(members) == 2
+    assert {e["hints"]["trace_id"] for e in members} == {"aaaa", "bbbb"}
+
+
+# ---------------------------------------------------------------------------
+# metrics + rollups
+# ---------------------------------------------------------------------------
+
+
+def test_serving_metrics_visible_in_prometheus(ds):
+    # self-sufficient: run one fused pair so every serving metric exists
+    # even when this test runs alone
+    s = ds.serving.start()
+    try:
+        gate, fut = _stall(s)
+        spec = lambda: FuseSpec(key=("prom",), batch=lambda ts: [1] * len(ts))  # noqa: E731
+        f1 = s.submit(lambda: 1, user="m", op="x", fuse=spec())
+        f2 = s.submit(lambda: 1, user="m", op="x", fuse=spec())
+        gate.set()
+        fut.result(10)
+        assert f1.result(10) == 1 and f2.result(10) == 1
+    finally:
+        s.stop()
+    text = metrics.registry().prometheus()
+    assert "geomesa_serving_queue_depth" in text
+    assert "geomesa_serving_admitted" in text
+    # queue-wait renders as a seconds histogram; the fusion batch-size
+    # histogram is dimensionless (no _seconds suffix)
+    assert "geomesa_serving_queue_wait_seconds_bucket" in text
+    assert "geomesa_serving_fusion_batch_bucket" in text
+    assert "geomesa_serving_fusion_batch_seconds" not in text
+
+
+def test_debug_queries_carries_user_rollups(ds):
+    from geomesa_tpu import obs
+
+    ds.count("t", ECQL)
+    out = obs.debug_queries(ds, 10)
+    assert "anonymous" in out["users"]
+    roll = out["users"]["anonymous"]
+    assert roll["completed"] > 0 and roll["service_ms"] > 0
+    assert "depth" in out["serving"]
+    # the rollup and fair share share ONE ledger
+    assert out["users"] == ds.serving.user_rollups()
+
+
+# ---------------------------------------------------------------------------
+# wire surface (sidecar)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture()
+def flight(ds):
+    import pyarrow.flight  # noqa: F401
+
+    from geomesa_tpu.sidecar.client import GeoFlightClient
+    from geomesa_tpu.sidecar.service import GeoFlightServer
+
+    server = GeoFlightServer(ds, "grpc+tcp://127.0.0.1:0")
+    client = GeoFlightClient(f"grpc+tcp://127.0.0.1:{server.port}")
+    yield server, client
+    client.close()
+    server.shutdown()
+    resilience.reset_breakers()
+
+
+def test_sidecar_user_header_feeds_shared_ledger(flight, ds):
+    server, client = flight
+    with config.USER.scoped("alice"):
+        n = client.count("t", ECQL)
+    assert n == ds.count("t", ECQL)
+    roll = server._sched.user_rollups()
+    assert roll["alice"]["completed"] >= 1
+    stats = client.serving_stats()
+    assert "alice" in stats["users"]
+    assert stats["serving"]["running"] is True
+
+
+def test_sidecar_sheds_with_gm_shed(flight):
+    server, client = flight
+    sched = server._sched
+    # recent queries "took 30 s" and the queue is non-empty: a 10 s budget
+    # provably cannot be met -> typed [GM-SHED] before any device work
+    sched._ewma_all = 30.0
+    gate, fut = _stall(sched)
+    sched.submit(lambda: 1, user="filler", op="x")  # pending depth > 0
+    try:
+        with config.SIDECAR_TIMEOUT.scoped("10 s"):
+            with pytest.raises(DeadlineShedError) as ei:
+                client.count("t", ECQL)
+        assert "GM-SHED" in str(ei.value)
+    finally:
+        sched._ewma_all = None
+        gate.set()
+        fut.result(10)
+
+
+def test_sidecar_queue_full_is_gm_overloaded(flight):
+    import pyarrow.flight as fl
+
+    from geomesa_tpu.sidecar.client import error_code, is_retryable
+
+    server, client = flight
+    sched = server._sched
+    os.environ["GEOMESA_SERVING_QUEUE_DEPTH"] = "1"
+    gate, fut = _stall(sched)
+    try:
+        sched.submit(lambda: 1, user="u", op="x")  # fills the queue
+        with config.RETRY_ATTEMPTS.scoped(1):
+            with pytest.raises(fl.FlightUnavailableError) as ei:
+                client.count("t", ECQL)
+        assert error_code(ei.value) == "GM-OVERLOADED"
+        assert is_retryable(ei.value)  # backpressure: retry with backoff
+    finally:
+        del os.environ["GEOMESA_SERVING_QUEUE_DEPTH"]
+        gate.set()
+        fut.result(10)
+
+
+def test_sidecar_fuses_identical_wire_counts(flight, ds):
+    from geomesa_tpu.sidecar.client import GeoFlightClient
+
+    server, client = flight
+    serial = ds.count("t", ECQL)
+    sched = server._sched
+    gate, fut = _stall(sched)
+    out = []
+    lock = threading.Lock()
+
+    def call():
+        with GeoFlightClient(client.location) as c:
+            n = c.count("t", ECQL)
+        with lock:
+            out.append(n)
+
+    threads = [threading.Thread(target=call) for _ in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(0.5)  # let all four RPCs reach the queue
+    disp = metrics.registry().counter(metrics.EXEC_DEVICE_DISPATCH)
+    d0 = disp.value
+    gate.set()
+    fut.result(10)
+    for t in threads:
+        t.join(30)
+    assert out == [serial] * 4
+    assert disp.value - d0 <= 2
+
+
+def test_streams_survive_queue_pressure(flight):
+    server, client = flight
+    os.environ["GEOMESA_SERVING_QUEUE_DEPTH"] = "1"
+    try:
+        t = client.query("t", ECQL)  # streamed op=query export
+        assert t.num_rows > 0
+    finally:
+        del os.environ["GEOMESA_SERVING_QUEUE_DEPTH"]
+
+
+# ---------------------------------------------------------------------------
+# satellites: stream lag, fs quarantine in /healthz, arrow-store faults
+# ---------------------------------------------------------------------------
+
+
+def test_stream_lag_gauge_and_span():
+    from geomesa_tpu.stream.live import StreamingDataset
+
+    sds = StreamingDataset()
+    sds.create_schema("s", "a:Integer,dtg:Date,*geom:Point")
+    past = int(time.time() * 1000) - 5_000
+    sds.write("s", {"a": [1], "dtg": [past], "geom": [(1.0, 2.0)]},
+              ["f1"], ts_ms=[past])
+    with config.TRACE_ENABLED.scoped("true"):
+        with tracing.start("poll-test"):
+            sds.poll("s")
+        tree = tracing.last_trace().root.to_dict()
+    names = [c["name"] for c in tree.get("children", ())]
+    assert "stream.apply" in names
+    lag = metrics.registry().gauge(metrics.STREAM_LAG).value
+    assert lag >= 5_000  # event time 5 s in the past -> lag >= 5 s
+    assert metrics.registry().gauge("stream.lag.s").value >= 5_000
+
+
+def test_confluent_apply_lag():
+    from geomesa_tpu.stream.confluent import SchemaRegistry, attach_confluent
+    from geomesa_tpu.stream.live import StreamingDataset
+
+    sds = StreamingDataset()
+    sds.create_schema("c", "a:Integer,dtg:Date,*geom:Point")
+    reg = SchemaRegistry()
+    ser, ingest = attach_confluent(sds, "c", reg)
+    past = int(time.time() * 1000) - 3_000
+    blob = ser.serialize("f1", {"a": 1, "dtg": past, "geom": "POINT(1 2)"})
+    ingest(blob, ts_ms=past)
+    assert metrics.registry().gauge("stream.lag.c").value >= 3_000
+    t = metrics.registry().timer(metrics.STREAM_APPLY)
+    assert t.count >= 1
+
+
+def test_healthz_exposes_fs_quarantine_map(tmp_path):
+    import glob
+
+    from geomesa_tpu import obs
+    from geomesa_tpu.fs.storage import DateTimeScheme, FileSystemStorage
+    from geomesa_tpu.schema.feature_type import FeatureType
+
+    fs = FileSystemStorage(str(tmp_path))
+    ft = FeatureType.from_spec("q", "a:Integer,dtg:Date,*geom:Point")
+    fs.create(ft, DateTimeScheme("month"))
+    fs.write("q", {
+        "a": np.array([1, 2], np.int32),
+        "dtg": np.array([0, 40 * 86_400_000], "datetime64[ms]"),
+        "geom__x": np.array([1.0, 2.0]),
+        "geom__y": np.array([1.0, 2.0]),
+    })
+    files = sorted(glob.glob(
+        os.path.join(fs.root, "q", "data", "**", "*.parquet"),
+        recursive=True,
+    ))
+    with open(files[0], "wb") as fh:
+        fh.write(b"\x00not parquet\xff" * 16)
+    with resilience.allow_partial():
+        fs.read("q")
+    assert files[0] in fs.quarantined()
+    h = obs.health()
+    assert files[0] in h["fs_quarantine"].get(fs.root, {})
+    # clearing re-admits and the map empties
+    fs.clear_quarantine()
+    assert obs.health()["fs_quarantine"].get(fs.root) is None
+
+
+def test_arrow_store_read_fault_point_retries(tmp_path):
+    from geomesa_tpu.io.arrow_store import ArrowDataStore
+    from geomesa_tpu.resilience import inject_faults
+    from geomesa_tpu.schema.feature_type import FeatureType
+
+    path = str(tmp_path / "s.arrow")
+    ft = FeatureType.from_spec("s", "a:Integer,*geom:Point")
+    store = ArrowDataStore(path, ft, create=True)
+    store.append({"a": np.array([1, 2], np.int32),
+                  "geom__x": np.array([1.0, 2.0]),
+                  "geom__y": np.array([3.0, 4.0])}, fids=["a", "b"])
+    store.close()
+    with config.FAULT_INJECTION.scoped("true"), \
+            config.RETRY_BASE_MS.scoped(1):
+        with inject_faults(seed=3) as inj:
+            # two transient blips, healed by the RetryPolicy in place
+            inj.fail("io.arrow.read_ipc", lambda: OSError("nfs blip"),
+                     times=2)
+            reopened = ArrowDataStore(path)
+            assert reopened.count() == 2
+            assert [s for s, _ in inj.fired].count("io.arrow.read_ipc") == 2
+    # write edge is a fault point too (not retried: rename isn't idempotent)
+    with config.FAULT_INJECTION.scoped("true"):
+        with inject_faults(seed=4) as inj:
+            inj.fail("io.arrow.write_ipc", lambda: OSError("disk"), times=1)
+            reopened.append({"a": np.array([3], np.int32),
+                             "geom__x": np.array([5.0]),
+                             "geom__y": np.array([6.0])}, fids=["c"])
+            with pytest.raises(OSError):
+                reopened.flush()
+        reopened.flush()  # old file intact, re-flush succeeds
+    assert ArrowDataStore(path).count() == 3
