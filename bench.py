@@ -14,9 +14,16 @@ FALLS BACK to JAX_PLATFORMS=cpu and reports REAL CPU numbers annotated with
 non-zero rc) — never a zeroed metric that poisons the trajectory (the
 BENCH_r05 failure mode). Only a crash mid-run exits non-zero.
 
-``--smoke``: CI mode — tiny dataset (200k rows), forced CPU backend, no
-device probe; same JSON keys plus "smoke": true, so warm-path regressions
-(recompiles_per_100_queries > 0) are caught without TPU access.
+``--smoke``: CI mode — tiny dataset (200k rows), forced CPU backend with a
+virtual 8-device mesh (GEOMESA_BENCH_DEVICES), no device probe; same JSON
+keys plus "smoke": true, so warm-path regressions
+(recompiles_per_100_queries > 0), sharded-scan bit-identity, and
+pool-parallelism regressions are caught without TPU access. Multi-device
+keys: sharded_scan_speedup, sharded_device_dispatches, pool_qps_scaleup,
+pool_slot_dispatches — plus "parallel_headroom_limited": true when the
+host's cores cannot express the fan-out (2-core boxes: the speedups are
+honest-but-flat; the CI >1.5x gates condition on headroom, the
+bit-identity/parallelism gates hold everywhere).
 
 Env knobs: GEOMESA_BENCH_N (points, default 20M; 200k under --smoke),
 GEOMESA_BENCH_ITERS, GEOMESA_BENCH_PROBE_{ATTEMPTS,TIMEOUT,BACKOFF},
@@ -135,17 +142,29 @@ def _arm_watchdog() -> None:
     t.start()
 
 
-def _force_cpu() -> None:
+def _force_cpu(n_devices: int = 0) -> None:
     """Route this process onto the CPU backend (the axon TPU plugin's
     sitecustomize overrides JAX_PLATFORMS at startup, so the jax.config
-    update is required too)."""
+    update is required too). ``n_devices`` > 1 provisions a virtual
+    CPU device mesh (GEOMESA_BENCH_DEVICES; the 8-device CI smoke) so the
+    sharded-scan/serving-pool keys exercise the real fan-out paths."""
     os.environ["JAX_PLATFORMS"] = "cpu"
+    if n_devices > 1:
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
     import jax
 
     try:
         jax.config.update("jax_platforms", "cpu")
     except Exception:
         pass
+    if n_devices > 1:
+        try:
+            jax.config.update("jax_num_cpu_devices", n_devices)
+        except AttributeError:
+            pass  # older jax: the XLA flag above provides the devices
 
 
 def main():
@@ -156,10 +175,11 @@ def main():
     annotations = {}
     cpu_backend = smoke
     if smoke:
-        # CI mode: tiny dataset, no probe, forced CPU — the warm-path keys
-        # below still regress-test the executor without TPU access
+        # CI mode: tiny dataset, no probe, forced CPU with a virtual
+        # 8-device mesh — the warm-path AND multi-device keys below
+        # regress-test the executor without TPU access
         annotations["smoke"] = True
-        _force_cpu()
+        _force_cpu(int(os.environ.get("GEOMESA_BENCH_DEVICES", 8)))
     else:
         probe_failure = _probe_device()
         if probe_failure is not None:
@@ -441,6 +461,142 @@ def main():
             f"batch_p50={serving_keys['fused_batch_p50']}\n"
         )
 
+    # Multi-device scale-out (docs/SCALE.md sharded scan + docs/SERVING.md
+    # executor pool): with >= 2 local devices, (a) a time-partitioned
+    # spill dataset scans serial-vs-sharded — results must match BIT-
+    # identically (hard assert) and the speedup rides along with the
+    # per-device dispatch counts; (b) serving QPS is measured at pool
+    # width 1 vs min(devices, 4). On hosts whose physical cores cannot
+    # express 8-way parallelism (the 2-core dev box), the speedup keys
+    # are honest-but-flat: "parallel_headroom_limited": true annotates
+    # them (the device_unreachable precedent — annotate, never fake), and
+    # the CI gate conditions the >1.5x thresholds on headroom while the
+    # bit-identity and pool-actually-parallel gates hold everywhere.
+    sharded_keys = {}
+    if os.environ.get("GEOMESA_BENCH_SHARDED", "1") != "0":
+        from geomesa_tpu import config as _scfg
+        from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+
+        n_dev = len(jax.devices())
+        cores = os.cpu_count() or 1
+        sharded_keys["n_devices"] = n_dev
+        sharded_keys["parallel_headroom"] = cores
+        if cores < 2 * min(n_dev, 4):
+            sharded_keys["parallel_headroom_limited"] = True
+    if sharded_keys.get("n_devices", 0) >= 2:
+        import tempfile as _tempfile
+
+        n_part = min(n, 1_000_000)
+        pds = GeoDataset(n_shards=8)
+        pds.create_schema("gdelt_p", "weight:Float,dtg:Date,*geom:Point"
+                                     ";geomesa.partition='time'")
+        pst = pds._store("gdelt_p")
+        assert isinstance(pst, PartitionedFeatureStore)
+        pst.max_resident = 1
+        pst._spill_dir = _tempfile.mkdtemp(prefix="gm_bench_spill_")
+        pds.insert("gdelt_p", {k: v[:n_part] for k, v in data.items()},
+                   fids=np.arange(n_part).astype(str))
+        pds.flush("gdelt_p")
+
+        def _scan_once():
+            c = pds.count("gdelt_p", ecql)
+            g = pds.density("gdelt_p", ecql, bbox=bbox, width=128,
+                            height=128)
+            return c, g
+
+        # warm both paths fully (kernels, windows, per-device uploads),
+        # then best-of-3 each
+        c_sh, g_sh = _scan_once()
+        t_sharded = min(_timed(_scan_once) for _ in range(3))
+        with _scfg.MESH_DEVICES.scoped("off"):
+            c_se, g_se = _scan_once()
+            t_serial = min(_timed(_scan_once) for _ in range(3))
+        assert c_sh == c_se and np.array_equal(g_sh, g_se), (
+            f"sharded scan NOT bit-identical: count {c_sh} vs {c_se}"
+        )
+        dev_disp = {
+            k.rsplit(".", 1)[1]: int(v)
+            for k, v in _metrics.registry().report().items()
+            if k.startswith(_metrics.SCAN_SHARDED_DEVICE + ".")
+        }
+        sharded_keys.update({
+            "sharded_bit_identical": True,
+            "sharded_partitions": len(pst.partition_bins()),
+            "sharded_scan_speedup": round(
+                t_serial / max(t_sharded, 1e-9), 2
+            ),
+            "sharded_device_dispatches": dev_disp,
+        })
+        sys.stderr.write(
+            f"sharded scan: {len(pst.partition_bins())} partitions x "
+            f"{sharded_keys['n_devices']} devices serial="
+            f"{t_serial*1e3:.1f}ms sharded={t_sharded*1e3:.1f}ms "
+            f"speedup={sharded_keys['sharded_scan_speedup']}x "
+            f"dispatches={dev_disp}\n"
+        )
+
+        # serving pool QPS: distinct-bbox counts (fusion can't collapse
+        # them) at width 1 vs min(devices, 4); each width warms until
+        # every slot has dispatched (per-device executable first-touch)
+        pool_w = min(sharded_keys["n_devices"], 4)
+        pboxes = [
+            f"BBOX(geom, -100, 30, {x}, 45) AND {during}"
+            for x in (-95.0, -90.0, -85.0, -80.0)
+        ]
+
+        def _pool_qps(width):
+            with _scfg.SERVING_EXECUTORS.scoped(str(width)), \
+                    _scfg.SERVING_FUSION.scoped("false"):
+                s = ds.serving.start()
+                try:
+                    for _ in range(12):  # warm every slot
+                        fs = [
+                            s.submit((lambda q: lambda: ds.count(
+                                "gdelt", q))(q), user="bench", op="count")
+                            for q in pboxes * 2
+                        ]
+                        [f.result(240) for f in fs]
+                        sd = s.snapshot()["slot_dispatches"]
+                        if len(sd) == width and min(sd.values()) >= 8:
+                            break
+                    # per-slot counts persist across start()/stop() on the
+                    # dataset's scheduler: report the MEASUREMENT WINDOW's
+                    # delta, not warm-up + earlier widths' residue
+                    sd0 = dict(s.snapshot()["slot_dispatches"])
+                    t0 = time.time()
+                    fs = [
+                        s.submit((lambda q: lambda: ds.count(
+                            "gdelt", q))(q), user="bench", op="count")
+                        for q in pboxes * 12
+                    ]
+                    [f.result(240) for f in fs]
+                    dt = time.time() - t0
+                    sd1 = s.snapshot()["slot_dispatches"]
+                    delta = {
+                        k: v - sd0.get(k, 0)
+                        for k, v in sd1.items() if v - sd0.get(k, 0) > 0
+                    }
+                    return len(pboxes) * 12 / max(dt, 1e-9), delta
+                finally:
+                    s.stop()
+
+        qps_1, _ = _pool_qps(1)
+        qps_n, slot_disp = _pool_qps(pool_w)
+        sharded_keys.update({
+            "pool_executors": pool_w,
+            "pool_qps_1": round(qps_1, 1),
+            "pool_qps_n": round(qps_n, 1),
+            "pool_qps_scaleup": round(qps_n / max(qps_1, 1e-9), 2),
+            "pool_slot_dispatches": {
+                str(k): int(v) for k, v in sorted(slot_disp.items())
+            },
+        })
+        sys.stderr.write(
+            f"serving pool: width 1={qps_1:.1f} qps, width {pool_w}="
+            f"{qps_n:.1f} qps (scaleup "
+            f"{sharded_keys['pool_qps_scaleup']}x, per-slot {slot_disp})\n"
+        )
+
     # Aggregate-cache effectiveness (docs/CACHE.md): cold vs warm latency
     # with the cache enabled — an exact repeat (whole-result hit) and an
     # overlapping pan (partial-cover reuse: only the newly exposed strip
@@ -525,6 +681,7 @@ def main():
         "trace_overhead_pct": round(trace_overhead_pct, 2),
         "metrics": metrics_snapshot,
         **serving_keys,
+        **sharded_keys,
         **cache_keys,
         **annotations,
     }))

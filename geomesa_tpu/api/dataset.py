@@ -275,7 +275,7 @@ class GeoDataset:
             if a.is_geom:
                 raise ValueError("cannot add geometry attributes to a schema")
         st.add_columns(new_ft, added)
-        self._executors.pop(name, None)
+        self._drop_executors(name)
         self._plan_cache_clear(name)
         self.metadata[name]["spec"] = new_ft.spec()
         return new_ft
@@ -300,7 +300,7 @@ class GeoDataset:
                      if k.strip()]
             if "attr" not in kinds:
                 st.ft.user_data["geomesa.indices"] = explicit + ",attr"
-        self._executors.pop(name, None)
+        self._drop_executors(name)
         self._plan_cache_clear(name)
         self.metadata[name]["spec"] = st.ft.spec()
 
@@ -309,7 +309,7 @@ class GeoDataset:
         st = self._store(name)
         st.remove_attribute_index(attr)
         st.ft.attr(attr).options.pop("index", None)
-        self._executors.pop(name, None)
+        self._drop_executors(name)
         self._plan_cache_clear(name)
         self.metadata[name]["spec"] = st.ft.spec()
 
@@ -634,20 +634,40 @@ class GeoDataset:
         return str(exp)
 
     def _executor(self, st: FeatureStore) -> Executor:
-        # one executor per store: executors cache NamedSharding objects, and
-        # device_columns keys its upload cache by id(sharding) — a fresh
-        # executor per query would re-upload every column on meshed datasets
+        # one executor per store (per serving-pool slot): executors cache
+        # sharding objects, and device_columns keys its upload cache by
+        # id(sharding) — a fresh executor per query would re-upload every
+        # column on meshed datasets. On a pool dispatch thread (slot > 0)
+        # the executor is keyed (schema, slot) and PINNED to that slot's
+        # device, so N dispatch threads drive N devices without ever
+        # sharing one (docs/SERVING.md); slot 0 / inline callers keep the
+        # original un-keyed, un-pinned executor byte-for-byte.
         from geomesa_tpu.index.partitioned import PartitionedFeatureStore
         from geomesa_tpu.planning.partitioned_exec import PartitionedExecutor
 
-        ex = self._executors.get(st.ft.name)
+        slot = self.serving.current_slot()
+        key = st.ft.name if not slot else (st.ft.name, slot)
+        ex = self._executors.get(key)
         if ex is None or ex.store is not st:
+            device = None
+            if slot and self.mesh is None and self.prefer_device:
+                from geomesa_tpu.parallel.devices import slot_device
+
+                device = slot_device(slot)
             if isinstance(st, PartitionedFeatureStore):
-                ex = PartitionedExecutor(st, self.mesh, self.prefer_device)
+                ex = PartitionedExecutor(st, self.mesh, self.prefer_device,
+                                         device=device)
             else:
-                ex = Executor(st, self.mesh, self.prefer_device)
-            self._executors[st.ft.name] = ex
+                ex = Executor(st, self.mesh, self.prefer_device,
+                              device=device)
+            self._executors[key] = ex
         return ex
+
+    def _drop_executors(self, name: str) -> None:
+        """Drop every slot's executor for one schema (lifecycle changes)."""
+        for k in [k for k in self._executors
+                  if k == name or (isinstance(k, tuple) and k[0] == name)]:
+            del self._executors[k]
 
     # -- reads -------------------------------------------------------------
     @staticmethod

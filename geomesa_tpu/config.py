@@ -287,6 +287,27 @@ MXU_TILE_Y = SystemProperty("geomesa.mxu.tile.y", "32")
 #: the time-bin axis (1 = no streaming; >1 trades HBM for steps).
 BIN_STREAM_CHUNKS = SystemProperty("geomesa.bin.stream.chunks", "1")
 
+#: Devices for the sharded partitioned scan (docs/SCALE.md): pruned
+#: partitions fan out round-robin over this many local devices, with
+#: per-device partial aggregates merged in a fixed deterministic order.
+#: Unset/"all" = every local device; an integer caps the count;
+#: 0/1/"off" disables (single-device streaming, the pre-sharding path).
+#: Ignored when an explicit GSPMD mesh is configured on the dataset (the
+#: mesh shards WITHIN a partition instead) and while a serving pool with
+#: more than one executor is running (the pool owns the devices — one
+#: dispatch thread per device).
+MESH_DEVICES = SystemProperty("geomesa.mesh.devices", None)
+
+#: Extend the partition prefetch pipeline's overlap to the device upload
+#: on the SHARDED scan: the prefetch thread device_puts partition i+1's
+#: staged host arrays onto its assigned device while device i executes.
+#: Safe under the one-jit-thread-per-device discipline because device_put
+#: is a pure transfer — it never traces or compiles (the PR 1 wedge was
+#: jit compilation on foreign threads) — and results are bit-identical
+#: with the overlap off (the upload populates the same device cache, same
+#: sharding singleton, the query thread would have populated itself).
+PIPELINE_DEVICE_PUT = SystemProperty("geomesa.pipeline.device-put", "true")
+
 #: Bucket count for hash-bucketed per-key sampling (int keys and
 #: dictionary vocabularies beyond the exact per-code kernel's gate).
 #: Power of two; 0 routes such keys to the host's exact per-key counter.
@@ -426,7 +447,44 @@ SERVING_FAIR_SHARE = SystemProperty("geomesa.serving.fair-share", "true")
 #: depth) with a typed [GM-SHED] error — before any device work.
 SERVING_SHED_ESTIMATE = SystemProperty("geomesa.serving.shed.estimate", "true")
 
+#: Dispatch-thread pool width for the serving scheduler: N executors,
+#: one dispatch thread per executor slot (slot i pins jax device
+#: i % device_count), each keeping the one-jit-thread-per-device
+#: discipline. Admission, deadline shedding, fair share, and fusion stay
+#: GLOBAL; a fusion group binds to one executor so batch results stay
+#: bit-identical. "all" = one per local device; default 1 = the single
+#: dispatch thread (pre-pool behavior, byte-for-byte).
+SERVING_EXECUTORS = SystemProperty("geomesa.serving.executors", "1")
+
 #: Identity attached to queries for fair-share accounting and the
 #: /debug/queries per-user rollups (the sidecar client forwards it as the
 #: x-geomesa-user Flight header; unset = "anonymous").
 USER = SystemProperty("geomesa.user", None)
+
+#: Per-user fair-share weight prefix: ``geomesa.serving.user.weight.<user>``
+#: scales a user's attained-service debt (the dispatcher picks the user
+#: minimizing service_s / weight), so weight 4 earns ~4x the service of
+#: weight 1 under contention. Resolved on the SUBMITTING thread at each
+#: submit/admit and captured into the user's ledger (thread-local
+#: override first, then env — non-alphanumeric identity chars map to
+#: ``_`` in the env name), default 1.0; values <= 0 are treated as 1.0.
+#: Surfaced in the /debug/queries per-user rollups.
+USER_WEIGHT_PREFIX = "geomesa.serving.user.weight."
+
+
+def user_weight(user: str) -> float:
+    """Effective fair-share weight for ``user`` (see USER_WEIGHT_PREFIX)."""
+    name = USER_WEIGHT_PREFIX + user
+    v = _overrides().get(name)
+    if v is None:
+        env = "".join(
+            ch if ch.isalnum() else "_" for ch in name
+        ).upper()
+        v = os.environ.get(env)
+    if v is None:
+        return 1.0
+    try:
+        w = float(v)
+    except ValueError:
+        return 1.0
+    return w if w > 0 else 1.0

@@ -315,6 +315,16 @@ KERNEL_RECOMPILES = "kernel.recompiles"
 KERNEL_BUCKET_HIT = "kernel.bucket_hit"
 KERNEL_EVICT = "kernel.evict"
 PIPELINE_PREFETCH = "pipeline.prefetch"
+# Sharded partitioned scan (planning/partitioned_exec.py; docs/SCALE.md):
+#   scan.sharded.queries     queries served by the multi-device fan-out
+#   scan.sharded.device.<id> per-device partition dispatches (the bench's
+#                            per-device dispatch counts read these)
+#   pipeline.deviceput       partitions whose device upload was overlapped
+#                            on the prefetch thread (geomesa.pipeline.
+#                            device-put; docs/PERF.md)
+SCAN_SHARDED = "scan.sharded.queries"
+SCAN_SHARDED_DEVICE = "scan.sharded.device"
+PIPELINE_DEVICE_PUT = "pipeline.deviceput"
 # Observability metrics (tracing.py, kernels/registry.py, obs.py;
 # docs/OBSERVABILITY.md):
 #   kernel.recompiles.<site>   per-jit-site fresh traces (suffix = site)
@@ -344,8 +354,12 @@ SERVING_ADMITTED = "serving.admitted"
 SERVING_COMPLETED = "serving.completed"
 SERVING_SHED_DEADLINE = "serving.shed.deadline"
 SERVING_SHED_QUEUE_FULL = "serving.shed.queue_full"
+#   serving.executor.dispatch.<slot>  groups executed per pool slot (the
+#                           pool-actually-parallel bench/CI gate reads
+#                           these; docs/SERVING.md)
 SERVING_FUSED = "serving.fused"
 SERVING_FUSION_BATCH = "serving.fusion.batch"
+SERVING_EXECUTOR_DISPATCH = "serving.executor.dispatch"
 EXEC_DEVICE_DISPATCH = "exec.device.dispatch"
 #: fused batch-size histogram buckets (members per micro-batch)
 FUSION_BATCH_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0)

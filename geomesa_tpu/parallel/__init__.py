@@ -1,1 +1,5 @@
 from geomesa_tpu.parallel.mesh import shard_mesh, device_count  # noqa: F401
+from geomesa_tpu.parallel.devices import (  # noqa: F401
+    TreeReducer, device_sharding, merge_partials, scan_devices,
+    slot_device, tree_merge,
+)

@@ -1,0 +1,191 @@
+"""Local device fan-out: the sharded partitioned scan + serving pool
+substrate (docs/SCALE.md, docs/SERVING.md).
+
+The reference scales range scans by fanning partitions out across tablet
+servers and merging server-side partial aggregates (SURVEY.md §2.9). The
+TPU-native analog here is *partition-level* device parallelism, distinct
+from the GSPMD mesh (`parallel/mesh.py`, which shards one partition's
+arrays ACROSS devices): each pruned time partition is pinned whole to one
+local device, per-device partial aggregates dispatch asynchronously from
+the single query thread (jax dispatch is async, so device d executes
+partition i while the thread dispatches partition i+1 to device d+1), and
+the partials merge in a fixed, documented order — see :func:`tree_merge`.
+
+Two consumers share these helpers and must not overlap:
+
+* the **sharded partitioned scan** (`planning/partitioned_exec.py`) —
+  intra-query parallelism, devices resolved by :func:`scan_devices`;
+* the **serving pool** (`serving/scheduler.py`) — inter-query
+  parallelism, one dispatch thread per executor slot, slot i pinned to
+  :func:`slot_device`. While a pool wider than one executor is running it
+  owns the devices (one jit thread per device), so :func:`scan_devices`
+  stands down — the scheduler flips :func:`set_pool_width` on
+  start()/stop().
+"""
+
+from __future__ import annotations
+
+import threading
+import weakref
+from typing import Dict, List, Optional
+
+from geomesa_tpu import config
+
+#: LIVE serving pools, owner (scheduler) -> executor width. Weak keys:
+#: a scheduler that is garbage-collected without stop() must not pin the
+#: sharded scan down forever. Per-owner (not one process global) because
+#: every GeoDataset owns a scheduler: dataset B starting/stopping its
+#: width-1 scheduler must not release devices that dataset A's width-4
+#: pool still owns.
+_pools: "weakref.WeakKeyDictionary" = weakref.WeakKeyDictionary()
+_lock = threading.Lock()
+
+
+def register_pool(owner, n: int) -> None:
+    """Record ``owner``'s live executor-pool width (scheduler start()).
+    A pool wider than 1 claims exclusive per-device dispatch threads, so
+    the sharded partitioned scan stands down while it runs."""
+    with _lock:
+        _pools[owner] = max(1, int(n))
+
+
+def unregister_pool(owner) -> None:
+    """Forget ``owner``'s pool (scheduler stop())."""
+    with _lock:
+        _pools.pop(owner, None)
+
+
+def pool_width() -> int:
+    """Width of the WIDEST live pool (1 = no pool owns the devices)."""
+    with _lock:
+        return max(_pools.values(), default=1)
+
+
+def scan_devices() -> Optional[List]:
+    """Devices the sharded partitioned scan may fan out over, resolved
+    from ``geomesa.mesh.devices`` (unset/"all" = every local device, an
+    integer caps the count, 0/1/"off" disables). None = the sharded scan
+    does not engage (single device, knob off, or a >1-executor serving
+    pool owns the devices)."""
+    if pool_width() > 1:
+        return None
+    raw = (config.MESH_DEVICES.get() or "all").strip().lower()
+    if raw in ("0", "1", "off", "false", "no", "none"):
+        return None
+    import jax
+
+    devs = list(jax.devices())
+    if raw not in ("all", "true", "on", "yes", ""):
+        try:
+            devs = devs[: max(int(raw), 0)]
+        except ValueError:
+            return None
+    if len(devs) < 2:
+        return None
+    return devs
+
+
+def slot_device(slot: int):
+    """The device pinned to serving-pool executor slot ``slot``
+    (slot i -> device i % device_count; slot 0 keeps the default
+    placement and is handled by the caller)."""
+    import jax
+
+    devs = jax.devices()
+    return devs[slot % len(devs)]
+
+
+#: SingleDeviceSharding singletons per device id. Singletons matter:
+#: IndexTable.device_columns keys its upload cache by id(sharding), so the
+#: prefetch thread's device_put overlap and the query thread's executor
+#: must present the SAME object to hit one cache entry.
+_shardings: Dict[int, object] = {}
+
+
+def device_sharding(device):
+    """The process-wide SingleDeviceSharding for ``device`` (cached)."""
+    sh = _shardings.get(device.id)
+    if sh is None:
+        from jax.sharding import SingleDeviceSharding
+
+        with _lock:
+            sh = _shardings.get(device.id)
+            if sh is None:
+                sh = _shardings[device.id] = SingleDeviceSharding(device)
+    return sh
+
+
+def tree_merge(parts, combine):
+    """Fixed balanced pairwise reduction of ``parts`` (None = empty).
+
+    THE documented merge order of the partitioned scan, serial and
+    sharded alike: with partials ``[p0, p1, p2, p3, p4]`` in pruned-bin
+    order, round 1 combines adjacent pairs left-to-right —
+    ``(p0+p1), (p2+p3), p4`` — and rounds repeat until one remains:
+    ``((p0+p1)+(p2+p3)) + p4``. The order depends ONLY on the input
+    order (pruned-bin order), never on device assignment or completion
+    timing, so the sharded scan is bit-identical to the single-device
+    path by construction — the contract the aggregate cache and the
+    fusion layer rely on (docs/CACHE.md, docs/SERVING.md)."""
+    items = [p for p in parts if p is not None]
+    if not items:
+        return None
+    while len(items) > 1:
+        nxt = []
+        for j in range(0, len(items) - 1, 2):
+            nxt.append(combine(items[j], items[j + 1]))
+        if len(items) % 2:
+            nxt.append(items[-1])
+        items = nxt
+    return items[0]
+
+
+class TreeReducer:
+    """Streaming form of :func:`tree_merge`: push partials in pruned-bin
+    order, :meth:`result` returns the SAME association (asserted against
+    tree_merge for every size in tests) — so callers can merge
+    incrementally, holding O(log n) partials instead of all n, without
+    changing a single result bit. The classic binary-counter reduction:
+    a pushed value combines with the stack top while both sit at the
+    same level, and the leftover stack folds lowest-level-first at the
+    end (exactly tree_merge's final odd-tail rounds)."""
+
+    def __init__(self, combine):
+        self.combine = combine
+        self._stack: List = []  # (level, value), levels strictly decreasing
+
+    def push(self, v) -> None:
+        if v is None:
+            return
+        lvl = 0
+        while self._stack and self._stack[-1][0] == lvl:
+            _, u = self._stack.pop()
+            v = self.combine(u, v)
+            lvl += 1
+        self._stack.append((lvl, v))
+
+    def result(self):
+        if not self._stack:
+            return None
+        vals = [v for _, v in self._stack]
+        v = vals[-1]
+        for u in reversed(vals[:-1]):
+            v = self.combine(u, v)
+        return v
+
+
+def merge_partials(parts, device=None):
+    """Additive merge of per-partition device/host partials via
+    :func:`tree_merge`. With ``device`` set (the sharded scan), every
+    partial is first transferred onto it — ``jax.devices()[0]``, the same
+    device the serial path computes on — so the adds run on ONE device in
+    the documented order and stay bit-identical to the serial merge."""
+    items = [p for p in parts if p is not None]
+    if not items:
+        return None
+    if device is not None:
+        import jax
+
+        sh = device_sharding(device)
+        items = [jax.device_put(p, sh) for p in items]
+    return tree_merge(items, lambda a, b: a + b)

@@ -85,10 +85,20 @@ def query_deadline(timeout_s: "Optional[float]"):
 
 class Executor:
     def __init__(self, store: FeatureStore, mesh=None, prefer_device: bool = True,
-                 kernel_fns: Optional[Dict] = None, version_source=None):
+                 kernel_fns: Optional[Dict] = None, version_source=None,
+                 device=None):
         self.store = store
         self.mesh = mesh
         self.prefer_device = prefer_device
+        #: optional jax device PIN (mutually exclusive with ``mesh``): every
+        #: column/window/schedule placement commits to this one device, so
+        #: the sharded partitioned scan can run partition i on device d and
+        #: the serving pool can give each dispatch thread its own device
+        #: (one jit thread per device — docs/SCALE.md, docs/SERVING.md).
+        #: Kernel registry keys stay device-free: one traced callable
+        #: serves every device (jax specializes the executable per device
+        #: internally without re-tracing), so pinning never recompiles.
+        self.device = device
         #: jitted-kernel LRU shared ACROSS stores (time partitions of one
         #: parent store execute the same plan: one trace/compile, many tables)
         self.kernel_fns = kernel_fns
@@ -614,21 +624,22 @@ class Executor:
     def _compact_cols(self, setup, names):
         """Window rows of ``names`` as device [C, B] slabs, gathered from
         the (cached) padded device columns and cached per (windows, store
-        version)."""
-        import jax
-
+        version, device pin)."""
         d = setup["compact"]
         B, Cp = d["B"], d["C"]
         cache = self.store.__dict__.setdefault("_compact_cache", {})
-        key0 = (d["whash"], self.store.uid, self.store.version, B, Cp)
+        key0 = (d["whash"], self.store.uid, self.store.version, B, Cp,
+                self._devkey())
         out, missing = {}, []
         for n in names:
             hit = cache.get(key0 + (n,))
             (out.__setitem__(n, hit) if hit is not None else missing.append(n))
         if missing:
             with tracing.span("scan.device_put", compact=True):
-                full = setup["table"].device_columns(tuple(missing), None)
-                g = jax.device_put(d["cstart"])
+                full = setup["table"].device_columns(
+                    tuple(missing), self._sharding()
+                )
+                g = self._put(d["cstart"])
                 gather = _slab_gather_fn(B)
                 if len(cache) >= 64:
                     cache.clear()
@@ -708,10 +719,10 @@ class Executor:
             self._note(plan, kernel="hit")
         wcache = self.store.__dict__.setdefault("_win_cache", {})
         wkey = ("compact_win", d["whash"], B, Cp, self.store.uid,
-                self.store.version)
+                self.store.version, self._devkey())
         win = wcache.get(wkey)
         if win is None:
-            win = (jax.device_put(d["lo"]), jax.device_put(d["valid"]))
+            win = (self._put(d["lo"]), self._put(d["valid"]))
             if len(wcache) >= 64:
                 wcache.clear()
             wcache[wkey] = win
@@ -1062,13 +1073,14 @@ class Executor:
                 wcache = self.store.__dict__.setdefault("_win_cache", {})
             else:
                 wcache = plan.__dict__.setdefault("_win_cache", {})
-            wkey = (fn_key, wtoken, self.store.uid, self.store.version)
+            wkey = (fn_key, wtoken, self.store.uid, self.store.version,
+                    self._devkey())
             win = wcache.get(wkey)
         if win is None:
             win = (
-                jax.device_put(setup["starts"]),
-                jax.device_put(setup["ends"]),
-                jax.device_put(setup["counts"]),
+                self._put(setup["starts"]),
+                self._put(setup["ends"]),
+                self._put(setup["counts"]),
             )
             if fn_key is not None:
                 if len(wcache) >= 64:
@@ -1090,7 +1102,14 @@ class Executor:
 
     def _sharding(self):
         if self.mesh is None:
-            return None
+            if self.device is None:
+                return None
+            # process-wide singleton per device: the prefetch thread's
+            # device_put overlap must present the SAME sharding object
+            # (device_columns keys its cache by id(sharding))
+            from geomesa_tpu.parallel.devices import device_sharding
+
+            return device_sharding(self.device)
         # cached: device_columns keys its upload cache by id(sharding), so a
         # fresh NamedSharding per call would re-upload every column per query
         sh = self.__dict__.get("_sharding_cache")
@@ -1100,6 +1119,24 @@ class Executor:
             sh = NamedSharding(self.mesh, PartitionSpec("shard", None))
             self.__dict__["_sharding_cache"] = sh
         return sh
+
+    def _put(self, x):
+        """``jax.device_put`` honoring the executor's device pin (window
+        arrays, compact descriptors, density schedules — operands that are
+        NOT mesh-sharded; mesh placements keep their own shardings)."""
+        import jax
+
+        if self.mesh is None and self.device is not None:
+            return jax.device_put(x, self._sharding())
+        return jax.device_put(x)
+
+    def _devkey(self):
+        """Cache-key component for device-RESIDENT data (window arrays,
+        compact slabs, schedules): a pinned executor must never hit
+        another device's arrays — mixing committed devices in one jit is
+        an error. Compiled-KERNEL keys deliberately omit it (one trace
+        serves every device)."""
+        return None if self.device is None else self.device.id
 
     # -- bin-space (sequence) parallelism ---------------------------------
     def _binspace_mesh(self):
@@ -1176,16 +1213,14 @@ class Executor:
                                  cache_name, key_extras, build, device_keys):
         """Shared cache host for the host-built density pair schedules
         (pallas grouped / MXU einsum): build once per (windows, grid,
-        store version), device_put the array members, remember a False
-        sentinel for negative results."""
-        import jax
-
+        store version, device pin), device_put the array members, remember
+        a False sentinel for negative results."""
         d = setup["compact"]
         table = setup["table"]
         cache = self.store.__dict__.setdefault(cache_name, {})
         key = (cache_name, d["whash"], tuple(bbox), width, height, d["B"],
                d["C"]) + tuple(key_extras) + (
-                   self.store.uid, self.store.version)
+                   self.store.uid, self.store.version, self._devkey())
         hit = cache.get(key)
         if hit is None:
             pr = build(
@@ -1197,7 +1232,7 @@ class Executor:
             )
             if pr is not None:
                 for k in device_keys:
-                    pr[k] = jax.device_put(pr[k])
+                    pr[k] = self._put(pr[k])
             if len(cache) >= 64:
                 cache.clear()
             hit = cache[key] = pr if pr is not None else False
@@ -1370,14 +1405,20 @@ class Executor:
             return agg_fn_host(cols, mask, np, *extra)
 
     # -- public operations --------------------------------------------------
-    def count(self, plan: QueryPlan) -> int:
-        out = self._run(
+    def count_partial(self, plan: QueryPlan):
+        """:meth:`count` WITHOUT the device sync: the additive partial
+        (device scalar or host value; None = empty scan) the sharded
+        partitioned scan merges after every device has been dispatched."""
+        return self._run(
             plan,
             lambda cols, m, xp: m.sum(),
             lambda cols, m, xp: m.sum(),
             cache_key=("count",),
             additive=True,
         )
+
+    def count(self, plan: QueryPlan) -> int:
+        out = self.count_partial(plan)
         if out is None:
             return 0
         with tracing.span("scan.sync"):
@@ -1581,15 +1622,13 @@ class Executor:
         cache[key] = out
         return out
 
-    def density_curve(self, plan: QueryPlan, level: int, block_window,
-                      weight: Optional[str] = None) -> np.ndarray:
-        """Exact density over a morton-block-aligned grid (XYZ/EPSG:4326
-        tile pyramids align by construction): masked counts via one cumsum
-        over the z2-sorted scan + two gathers per block. At 20M rows this
-        is ~25x faster than the scatter path, because TPU scatter costs
-        ~6.7 ns/row while cumsum runs at bandwidth (docs/SCALE.md).
-        Unweighted counts accumulate in int32 (exact to 2^31 rows);
-        weighted densities accumulate in f32."""
+    def density_curve_raw(self, plan: QueryPlan, level: int, block_window,
+                          weight: Optional[str] = None):
+        """:meth:`density_curve` WITHOUT the final host transfer:
+        ``(partial_or_None, B, nx, ny)``. The sharded partitioned scan
+        dispatches one of these per partition (each async, on its own
+        device) and decodes via :meth:`decode_curve` only after every
+        device is busy."""
         p0, p1, B, nx, ny = self._curve_positions(plan, level, block_window)
         agg_cols = [weight] if weight else []
 
@@ -1613,18 +1652,40 @@ class Executor:
             extra=(p0, p1),
             compactable=False,  # CDF positions index the padded layout
         )
+        return out, B, nx, ny
+
+    @staticmethod
+    def decode_curve(raw) -> np.ndarray:
+        """One :meth:`density_curve_raw` partial as the host f64 grid
+        (zeros for an empty partial) — the per-partition decode the
+        partitioned merge runs in pruned-bin order, identically on the
+        serial and sharded paths."""
+        out, B, nx, ny = raw
         if out is None:
             return np.zeros((ny, nx), np.float64)
         # float64 grid: cell counts are exact to 2^53 (an f32 grid would
         # round cells beyond 2^24 rows); weighted cells carry the f32
-        # accumulation documented above
+        # accumulation documented in density_curve_raw
         flat = np.asarray(out)[:B].astype(np.float64)
         # blocks were generated row-major over (j, i): reshape directly;
         # row 0 = ymin edge (RenderingGrid convention)
         return flat.reshape(ny, nx)
 
-    def density_curve_batch(self, plan: QueryPlan, level: int,
-                            block_windows, weight: Optional[str] = None):
+    def density_curve(self, plan: QueryPlan, level: int, block_window,
+                      weight: Optional[str] = None) -> np.ndarray:
+        """Exact density over a morton-block-aligned grid (XYZ/EPSG:4326
+        tile pyramids align by construction): masked counts via one cumsum
+        over the z2-sorted scan + two gathers per block. At 20M rows this
+        is ~25x faster than the scatter path, because TPU scatter costs
+        ~6.7 ns/row while cumsum runs at bandwidth (docs/SCALE.md).
+        Unweighted counts accumulate in int32 (exact to 2^31 rows);
+        weighted densities accumulate in f32."""
+        return self.decode_curve(
+            self.density_curve_raw(plan, level, block_window, weight)
+        )
+
+    def density_curve_batch_raw(self, plan: QueryPlan, level: int,
+                                block_windows, weight: Optional[str] = None):
         """N curve-aligned density crops of ONE (plan, level) in a single
         device pass — the cross-query fusion entry point (docs/SERVING.md):
         concurrent tile clients share the mask + cumsum (the expensive
@@ -1636,15 +1697,16 @@ class Executor:
         ``c[p1] - c[p0]`` gathers are exact. The kernel registry key pads
         the member axis to a power of two (``registry.bucket_batch``) next
         to the usual version-stable token, so batch sizes in one bucket
-        share a compiled kernel. Returns one ``[ny, nx]`` float64 grid per
-        window, in order."""
+        share a compiled kernel. Returns the UNSYNCED ``(partial, infos)``
+        pair (the sharded partitioned scan merges these across devices);
+        :meth:`density_curve_batch` is the synchronous public form."""
         from geomesa_tpu.kernels.registry import bucket_batch
 
         infos = [
             self._curve_positions(plan, level, bw) for bw in block_windows
         ]
         if not infos:
-            return []
+            return None, []
         # stack the per-member CDF positions: members pad to a common P
         # (each is already pow2-padded, so P = max is a pow2) and the
         # member axis pads to its batch bucket. Padded cells gather
@@ -1680,6 +1742,13 @@ class Executor:
             extra=(p0s, p1s),
             compactable=False,  # CDF positions index the padded layout
         )
+        return out, infos
+
+    @staticmethod
+    def decode_curve_batch(raw):
+        """One :meth:`density_curve_batch_raw` partial as per-member host
+        f64 grids (the per-partition decode of the sharded merge)."""
+        out, infos = raw
         results = []
         arr = None if out is None else np.asarray(out)
         for i, (_p0, _p1, B, nx, ny) in enumerate(infos):
@@ -1691,7 +1760,17 @@ class Executor:
                 )
         return results
 
-    def stats(self, plan: QueryPlan, stat: sk.Stat) -> sk.Stat:
+    def density_curve_batch(self, plan: QueryPlan, level: int,
+                            block_windows, weight: Optional[str] = None):
+        """See :meth:`density_curve_batch_raw` — this is the synchronous
+        public form, one ``[ny, nx]`` float64 grid per window, in order."""
+        return self.decode_curve_batch(
+            self.density_curve_batch_raw(plan, level, block_windows, weight)
+        )
+
+    def _stats_bundle(self, plan: QueryPlan, stat: sk.Stat):
+        """(agg_cols, vocab_sizes) when every leaf of ``stat`` can update
+        on device over this table, else None (the gather path serves)."""
         table = self._table(plan)
         host_only = {
             c for c in table.column_names() if table.is_host_only(c)
@@ -1714,13 +1793,29 @@ class Executor:
             for leaf in kstats._leaf_stats(stat)
             if leaf.kind in ("enumeration", "topk")
         )
-        if kstats.device_supported(stat, host_only) and enum_ok:
-            partials = self._run(
-                plan,
-                lambda cols, m, xp: kstats.device_update(stat, cols, m, xp, vocab_sizes),
-                lambda cols, m, xp: kstats.device_update(stat, cols, m, xp, vocab_sizes),
-                agg_cols,
-            )
+        if not (kstats.device_supported(stat, host_only) and enum_ok):
+            return None
+        return agg_cols, vocab_sizes
+
+    def stats_partials(self, plan: QueryPlan, stat: sk.Stat):
+        """``(supported, partials)`` — the async device partial-update
+        pytree for ``stat`` (the sharded partitioned scan absorbs these in
+        pruned-bin order AFTER every device has been dispatched). Does NOT
+        mutate ``stat``. ``supported=False`` means the stat tree needs the
+        host gather path; ``partials`` may be None on an empty scan."""
+        bundle = self._stats_bundle(plan, stat)
+        if bundle is None:
+            return False, None
+        agg_cols, vocab_sizes = bundle
+
+        def agg(cols, m, xp):
+            return kstats.device_update(stat, cols, m, xp, vocab_sizes)
+
+        return True, self._run(plan, agg, agg, agg_cols)
+
+    def stats(self, plan: QueryPlan, stat: sk.Stat) -> sk.Stat:
+        supported, partials = self.stats_partials(plan, stat)
+        if supported:
             if partials is not None:
                 kstats.absorb_partials(stat, partials, self.store.dicts)
             return stat

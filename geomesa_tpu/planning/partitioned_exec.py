@@ -7,7 +7,21 @@ prune partitions by the plan's time bounds, stream each pruned partition
 through RAM/HBM (loading spilled ones from disk, evicting over budget), run
 the ordinary :class:`Executor` against it, and merge the additive results.
 One plan → one traced kernel shared by every partition (kernel shapes are
-bucketed in IndexTable.shard_len / windows)."""
+bucketed in IndexTable.shard_len / windows).
+
+**Sharded scan** (docs/SCALE.md): with more than one local device and
+``geomesa.mesh.devices`` not disabled, additive aggregates (count /
+density / density_curve / stats) fan the pruned partitions out
+ROUND-ROBIN over the devices — partition i (in pruned-bin order) pins to
+device i % D, its scan dispatches asynchronously (jax dispatch returns
+before execution, so device d runs partition i while the one query thread
+dispatches partition i+1 to the next device — the jit discipline is
+untouched), and the per-device partials merge in the fixed order
+:func:`geomesa_tpu.parallel.devices.tree_merge` documents. The merge
+order depends only on the pruned-bin order, never on device assignment or
+completion timing, and the serial path uses the SAME tree merge — so the
+sharded scan is bit-identical to the single-device path by construction.
+Non-additive ops (features/top/knn) keep the serial partition stream."""
 
 from __future__ import annotations
 
@@ -21,6 +35,8 @@ from geomesa_tpu import config, metrics, resilience, tracing
 from geomesa_tpu.filter import ir
 from geomesa_tpu.index.partitioned import PartitionedFeatureStore
 from geomesa_tpu.kernels.registry import KernelRegistry
+from geomesa_tpu.kernels import stats_scan as kstats
+from geomesa_tpu.parallel import devices as pdev
 from geomesa_tpu.planning.executor import Executor, check_deadline
 from geomesa_tpu.planning.planner import QueryPlan
 from geomesa_tpu.resilience import QueryTimeoutError
@@ -28,17 +44,31 @@ from geomesa_tpu.schema.columns import ColumnBatch
 from geomesa_tpu.stats import sketches as sk
 
 _SKIPPED = object()  # sentinel: partition degraded away (fn may return None)
+_UNSET = object()
 
 
 class PartitionedExecutor:
     def __init__(self, store: PartitionedFeatureStore, mesh=None,
-                 prefer_device: bool = True):
+                 prefer_device: bool = True, device=None):
         self.store = store
         self.mesh = mesh
         self.prefer_device = prefer_device
+        #: serving-pool device pin: a slot executor streams every partition
+        #: through ITS device (the pool owns one device per dispatch
+        #: thread), which also disables the sharded fan-out below — two
+        #: threads must never dispatch to one device (docs/SERVING.md)
+        self.device = device
         #: jitted-kernel LRU shared across every partition child AND every
-        #: aggregate-cache cell query (version-stable keys — docs/PERF.md)
-        self._kernel_fns = KernelRegistry()
+        #: aggregate-cache cell query (version-stable keys — docs/PERF.md).
+        #: Also shared across the sharded scan's per-device executors AND
+        #: every serving-pool slot's PartitionedExecutor over this store:
+        #: hosted on the STORE (the same ``_kernel_registry`` slot plain
+        #: Executors use via version_source), because keys are device-free
+        #: — D devices or N pool slots cost ONE trace per kernel shape.
+        reg = store.__dict__.get("_kernel_registry")
+        if reg is None:
+            reg = store.__dict__["_kernel_registry"] = KernelRegistry()
+        self._kernel_fns = reg
         self._execs: Dict[int, Executor] = {}
 
     def kernel_registry(self) -> KernelRegistry:
@@ -70,15 +100,32 @@ class PartitionedExecutor:
             return [b for b in bins if b in sel]
         return bins
 
-    def _executor_for(self, b: int, child) -> Executor:
+    def _executor_for(self, b: int, child, device=_UNSET) -> Executor:
+        if device is _UNSET:
+            device = self.device
         ex = self._execs.get(b)
-        if ex is None or ex.store is not child:
+        if ex is None or ex.store is not child \
+                or getattr(ex, "device", None) is not device:
             ex = Executor(
                 child, self.mesh, self.prefer_device,
                 kernel_fns=self._kernel_fns, version_source=self.store,
+                device=device,
             )
             self._execs[b] = ex
         return ex
+
+    # -- multi-device sharded scan (docs/SCALE.md) -------------------------
+    def _scan_devices(self):
+        """Devices for the sharded fan-out, or None when it cannot engage:
+        an explicit GSPMD mesh shards WITHIN partitions instead; a pinned
+        (serving-pool slot) executor owns exactly one device; the host
+        path has nothing to fan out; and ``geomesa.mesh.devices`` can turn
+        it off (parallel/devices.py also stands down while a >1-executor
+        pool runs)."""
+        if self.mesh is not None or self.device is not None \
+                or not self.prefer_device:
+            return None
+        return pdev.scan_devices()
 
     # -- double-buffered partition pipeline --------------------------------
     def _stage(self, child, plan: QueryPlan) -> None:
@@ -96,76 +143,117 @@ class PartitionedExecutor:
             metrics.inc(metrics.PIPELINE_PREFETCH)
 
     def _children(self, plan: QueryPlan):
-        """(bin, child) over pruned partitions. With
-        ``geomesa.pipeline.prefetch`` (default on), partition i+1's host
-        load/column assembly overlaps partition i's device execution on a
-        single prefetch thread, bounded to ONE in-flight partition (the
-        consumer grants each load). Load errors re-raise on the query
-        thread at the same point they would have sequentially; order and
-        merge semantics are unchanged, so results stay bit-identical."""
-        bins = self.prune(plan)
+        """(bin, child) over pruned partitions through the serial
+        (one-staging-slot) prefetch pipeline — see :meth:`_pipeline`."""
+        for _i, b, child in self._pipeline(plan, self.prune(plan)):
+            yield b, child
+
+    def _stage_device(self, child, plan: QueryPlan, dev) -> None:
+        """device_put half of the sharded prefetch overlap (docs/PERF.md):
+        upload the staged host arrays for the partition's assigned device
+        FROM THE PREFETCH THREAD, overlapping the previous partition's
+        execution on another device. Safe under the one-jit-thread-per-
+        device discipline: device_put is a pure transfer — it never traces
+        or compiles (the PR 1 wedge was jit compilation on foreign
+        threads) — and it populates the same device cache, through the
+        same per-device sharding singleton, the query thread would have
+        populated itself, so results are bit-identical with the overlap
+        off (gated by ``geomesa.pipeline.device-put``)."""
+        names = plan.__dict__.get("needed_cols")
+        if not names or child is None:
+            return
+        t = child.tables.get(plan.index_name)
+        if t is None or not t.n:
+            return
+        t.device_columns(tuple(names), pdev.device_sharding(dev))
+        metrics.inc(metrics.PIPELINE_DEVICE_PUT)
+
+    def _pipeline(self, plan: QueryPlan, bins: List[int], devs=None):
+        """(i, bin, child) over pruned partitions — THE prefetch
+        pipeline, serial and sharded in one body. With
+        ``geomesa.pipeline.prefetch`` (default on), a single worker
+        thread stages partition host columns ahead of the consumer,
+        granted ONE STAGING SLOT PER DEVICE (serial ``devs=None`` = one
+        slot = the classic double buffer: partition i+1's load overlaps
+        partition i's execution). With ``devs`` and
+        ``geomesa.pipeline.device-put``, the worker also uploads each
+        staged partition to its assigned device (a pure transfer — never
+        traces or compiles — through the shared per-device sharding
+        singleton; docs/PERF.md §3), so every device has its next
+        partition's columns resident the moment its current scan drains.
+
+        Consumption order is pruned-bin order in both modes; a load
+        error re-raises on the query thread at the same point it would
+        have sequentially; config overrides and the span context cross
+        the thread boundary via snapshot/adopt (staged (name, L) keys
+        and trace nesting must match the query thread exactly)."""
         if len(bins) < 2 or not config.PIPELINE_PREFETCH.to_bool():
-            for b in bins:
-                yield b, self.store.child(b)
+            for i, b in enumerate(bins):
+                yield i, b, self.store.child(b)
             return
         out: "queue.Queue" = queue.Queue()
         stop = threading.Event()
         slot = threading.Semaphore(0)  # one permit per granted load
-        # config overrides are thread-local: the worker must resolve every
-        # property (bucketed shard length above all) exactly as the query
-        # thread does, or staged (name, L) keys would silently mismatch
+        overlap = devs is not None \
+            and bool(config.PIPELINE_DEVICE_PUT.to_bool())
         ov = config.snapshot_overrides()
-        # the span context crosses the same boundary the same way: staging
-        # spans the worker opens nest under the query's current span, so a
-        # trace shows partition i+1's host load overlapping partition i's
-        # device execution (docs/OBSERVABILITY.md)
         tspan = tracing.snapshot()
 
         def worker():
             config.adopt_overrides(ov)
             tracing.adopt(tspan)
             try:
-                for b in bins:
+                for i, b in enumerate(bins):
                     while not slot.acquire(timeout=0.1):
                         if stop.is_set():
                             return
                     if stop.is_set():
                         return
+                    attrs = {"part": int(b)}
+                    dev = None
+                    if devs is not None:
+                        dev = devs[i % len(devs)]
+                        attrs["device"] = int(dev.id)
                     try:
                         child = self.store.child(b)
-                        with tracing.span("scan.stage", part=int(b)):
+                        with tracing.span("scan.stage", **attrs):
                             self._stage(child, plan)
+                            if overlap and child is not None:
+                                self._stage_device(child, plan, dev)
                     except BaseException as e:
-                        out.put((b, None, e))
+                        out.put((i, b, None, e))
                     else:
-                        out.put((b, child, None))
+                        out.put((i, b, child, None))
             finally:
                 out.put(None)
 
         t = threading.Thread(
-            target=worker, name="geomesa-part-prefetch", daemon=True
+            target=worker, daemon=True,
+            name="geomesa-part-prefetch" if devs is None
+            else "geomesa-shard-prefetch",
         )
         t.start()
-        slot.release()  # the first load starts immediately
+        for _ in range(1 if devs is None else len(devs)):
+            slot.release()  # the first load(s) start immediately
         try:
             while True:
                 item = out.get()
                 if item is None:
                     return
                 # grant the NEXT load now: it overlaps this partition's
-                # execution — exactly one partition ever in flight
+                # execution — at most one in-flight partition per slot
                 slot.release()
-                b, child, err = item
+                i, b, child, err = item
                 if err is not None:
                     raise err
-                yield b, child
+                yield i, b, child
         finally:
             stop.set()
             # JOIN, not fire-and-forget: an early consumer exit
             # (max_features, deadline) must not leave the worker mutating
             # the partition map under a follow-up query's unlocked readers
             # (partition_bins, flush loops). The wait is bounded by the
-            # one in-flight load (worker observes `stop` right after it).
+            # in-flight loads (worker observes `stop` right after each).
             t.join()
             # free staged host arrays of prefetched-but-never-executed
             # partitions (their loop-body cleanup never ran)
@@ -176,11 +264,102 @@ class PartitionedExecutor:
                     break
                 if item is None:
                     continue
-                _, child, _ = item
+                _, _, child, _ = item
                 if child is not None:
                     tb = child.tables.get(plan.index_name)
                     if tb is not None:
                         tb._host_stage.clear()
+
+    def _sharded_scan(self, plan: QueryPlan, op: str, dispatch, finish,
+                      devs, bins: List[int]) -> None:
+        """Round-robin fan-out of one additive op over ``devs``:
+        ``dispatch(ex)`` runs per pruned partition against an executor
+        pinned to the partition's device (it must return WITHOUT forcing
+        a device sync). Each partial is handed to ``finish(bin, partial,
+        merge_device)`` in pruned-bin order — the only order the merge
+        ever sees — but DEFERRED until D further partitions have been
+        dispatched (or the scan ends), so every device keeps executing
+        while older partials sync/merge and at most D partials plus the
+        reducer spine are ever outstanding (never all P). finish runs
+        under the same degradation guard as the scan, attributing a
+        sync-time device failure to its partition."""
+        metrics.inc(metrics.SCAN_SHARDED)
+        from collections import deque
+
+        pending: "deque" = deque()  # (bin, partial) awaiting finish
+        mdev = devs[0]  # the device the serial path computes on
+
+        def _finish_oldest():
+            fb, fr = pending.popleft()
+            self._scan_part(plan, fb, op, lambda: finish(fb, fr, mdev),
+                            probe=False, spanned=False)
+
+        tot_scanned = tot_rows = 0
+        try:
+            for i, b, child in self._pipeline(plan, bins, devs):
+                check_deadline()
+                if child is None or child.count == 0:
+                    continue
+                dev = devs[i % len(devs)]
+                ex = self._executor_for(b, child, device=dev)
+                plan.__dict__.pop("scanned_rows", None)
+                plan.__dict__.pop("table_rows", None)
+                r = self._scan_part(plan, b, op, lambda: dispatch(ex),
+                                    device=dev)
+                tot_scanned += plan.__dict__.pop("scanned_rows", 0)
+                tot_rows += plan.__dict__.pop("table_rows", 0)
+                metrics.inc(f"{metrics.SCAN_SHARDED_DEVICE}.{dev.id}")
+                if r is not _SKIPPED and r is not None:
+                    pending.append((b, r))
+                # dispatched work holds its own buffer references: staged
+                # host arrays and evicted children free safely here even
+                # while the device is still executing
+                t = child.tables.get(plan.index_name)
+                if t is not None:
+                    t._host_stage.clear()
+                self.store.evict()
+                resident = self.store.partitions
+                for bb in list(self._execs):
+                    if self._execs[bb].store is not resident.get(bb):
+                        del self._execs[bb]
+                while len(pending) > len(devs):
+                    _finish_oldest()
+            while pending:
+                _finish_oldest()
+        finally:
+            plan.__dict__["scanned_rows"] = tot_scanned
+            plan.__dict__["table_rows"] = tot_rows
+        self._note_sharded(plan, len(bins), len(devs))
+
+    def _note_sharded(self, plan: QueryPlan, n_parts: int, n_devs: int):
+        plan.__dict__.setdefault("exec_path", {}).update(
+            sharded=f"{n_parts} partitions over {n_devs} devices"
+        )
+
+    def _additive_scan(self, plan: QueryPlan, op: str, dispatch,
+                       finish) -> None:
+        """Drive one additive op over the pruned partitions, delivering
+        each partition's partial to ``finish(bin, partial, merge_device)``
+        in pruned-bin order. The sharded fan-out serves when it engages
+        (merge_device = the first local device — where the serial path
+        computes — so the merge is bit-identical); otherwise the serial
+        partition stream runs finish immediately after each partition
+        (merge_device None), exactly the pre-sharding cadence. Both
+        paths guard finish with the _scan_part degradation contract, so
+        a device failure surfacing at sync time skips that partition
+        with exact survivor totals instead of failing the query under
+        ``allow_partial()``."""
+        devs = self._scan_devices()
+        if devs is not None:
+            bins = self.prune(plan)
+            if len(bins) >= 2:
+                self._sharded_scan(plan, op, dispatch, finish, devs, bins)
+                return
+        for b, ex in self._each(plan):
+            r = self._scan_part(plan, b, op, lambda: dispatch(ex))
+            if r is not _SKIPPED and r is not None:
+                self._scan_part(plan, b, op, lambda: finish(b, r, None),
+                                probe=False, spanned=False)
 
     def _each(self, plan: QueryPlan) -> Iterator[Tuple[int, Executor]]:
         """Stream (bin, executor) over pruned partitions under the residency
@@ -216,17 +395,32 @@ class PartitionedExecutor:
             plan.__dict__["scanned_rows"] = tot_scanned
             plan.__dict__["table_rows"] = tot_rows
 
-    def _scan_part(self, plan: QueryPlan, b: int, op: str, fn):
+    def _scan_part(self, plan: QueryPlan, b: int, op: str, fn, device=None,
+                   probe: bool = True, spanned: bool = True):
         """One partition's scan under the degradation contract
         (docs/RESILIENCE.md): strict mode re-raises; under
         ``resilience.allow_partial()`` / ``geomesa.scan.partial`` a failing
         partition is recorded (collector + audit trail + the plan, for the
         query audit event) and skipped — returns the ``_SKIPPED`` sentinel.
         Deadline expiry always propagates: a timed-out scan must never
-        masquerade as a degraded-but-complete one."""
+        masquerade as a degraded-but-complete one. ``device``: the sharded
+        scan's assigned device — stamped on the span (per-device
+        attribution, docs/OBSERVABILITY.md); on that path the span covers
+        dispatch only (execution is async by design). ``probe=False`` /
+        ``spanned=False``: the finish (sync/merge) half of a partition —
+        same degradation handling, but no second fault-injection probe
+        (one probe per partition keeps seeded chaos tests deterministic)
+        and no second scan.partition span (sync time attributes to the
+        op's parent span, as the pre-sharding merges did)."""
         try:
-            resilience.fault_point("exec.partition.scan", bin=b, op=op)
-            with tracing.span("scan.partition", part=int(b), op=op):
+            if probe:
+                resilience.fault_point("exec.partition.scan", bin=b, op=op)
+            if not spanned:
+                return fn()
+            attrs = {"part": int(b), "op": op}
+            if device is not None:
+                attrs["device"] = int(device.id)
+            with tracing.span("scan.partition", **attrs):
                 return fn()
         except QueryTimeoutError:
             raise
@@ -240,43 +434,65 @@ class PartitionedExecutor:
             return _SKIPPED
 
     # -- public operations (Executor surface) ------------------------------
+    # Additive aggregates collect per-partition partials (async-dispatched
+    # round-robin over the local devices when the sharded scan engages)
+    # and merge in pruned-bin order via the fixed tree reduction
+    # parallel/devices.tree_merge documents — serial and sharded paths
+    # share the merge code, so they are bit-identical by construction.
     def count(self, plan: QueryPlan) -> int:
-        total = 0
-        for b, ex in self._each(plan):
-            n = self._scan_part(plan, b, "count", lambda: ex.count(plan))
-            if n is not _SKIPPED:
-                total += n
-        return total
+        # counts merge as exact host integers (a device tree-add would
+        # accumulate in int32 and overflow past 2^31 total rows); on the
+        # sharded path each int() waits on a partial whose device was
+        # dispatched D partitions ago, so the devices stay concurrent
+        totals: List[int] = []
+        self._additive_scan(
+            plan, "count", lambda ex: ex.count_partial(plan),
+            lambda b, p, mdev: totals.append(int(p)),
+        )
+        return sum(totals)
 
     def density(self, plan: QueryPlan, bbox, width: int, height: int,
                 weight: Optional[str] = None, as_numpy: bool = True):
-        out = None
-        for b, ex in self._each(plan):
-            g = self._scan_part(
-                plan, b, "density",
-                lambda: ex.density(plan, bbox, width, height, weight,
-                                   as_numpy=False),
-            )
-            if g is _SKIPPED:
-                continue
-            # accumulate ON DEVICE: per-partition grid downloads would ride
-            # the host link once per partition per call
-            out = g if out is None else out + g
+        import jax
+
+        # merge ON DEVICE (per-partition grid downloads would ride the
+        # host link once per partition per call) through the streaming
+        # tree reduction — bit-identical to tree_merge over all partials,
+        # holding O(log P) grids instead of P; sharded partials first
+        # transfer to the merge device (jax.devices()[0], where the
+        # serial path computes)
+        red = pdev.TreeReducer(lambda a, b: a + b)
+
+        def finish(b, p, mdev):
+            if mdev is not None:
+                p = jax.device_put(p, pdev.device_sharding(mdev))
+            red.push(p)
+
+        self._additive_scan(
+            plan, "density",
+            lambda ex: ex.density(plan, bbox, width, height, weight,
+                                  as_numpy=False),
+            finish,
+        )
+        out = red.result()
         if out is None:
             return np.zeros((height, width), np.float32)
         return np.asarray(out) if as_numpy else out
 
     def density_curve(self, plan: QueryPlan, level: int, block_window,
                       weight=None) -> np.ndarray:
-        out = None
-        for b, ex in self._each(plan):
-            g = self._scan_part(
-                plan, b, "density_curve",
-                lambda: ex.density_curve(plan, level, block_window, weight),
-            )
-            if g is _SKIPPED:
-                continue
-            out = g if out is None else out + g
+        # decode syncs each partition's partial (deferred D partitions on
+        # the sharded path) and the f64 host grids reduce in pruned-bin
+        # tree order (integer counts are exact to 2^53; identical bits on
+        # both paths)
+        red = pdev.TreeReducer(lambda a, b: a + b)
+        self._additive_scan(
+            plan, "density_curve",
+            lambda ex: ex.density_curve_raw(plan, level, block_window,
+                                            weight),
+            lambda b, p, mdev: red.push(Executor.decode_curve(p)),
+        )
+        out = red.result()
         if out is None:
             ix0, iy0, ix1, iy1 = block_window
             out = np.zeros((iy1 - iy0 + 1, ix1 - ix0 + 1), np.float64)
@@ -286,29 +502,57 @@ class PartitionedExecutor:
                             block_windows, weight=None):
         """Fused tile batch over the partitioned store: each pruned
         partition executes ONE stacked device pass for every member crop
-        (Executor.density_curve_batch), and per-member grids accumulate
+        (Executor.density_curve_batch), and per-member grids tree-merge
         across partitions — M concurrent tile queries cost one scan of the
         pruned partitions, not M (docs/SERVING.md)."""
-        outs = None
-        for b, ex in self._each(plan):
-            g = self._scan_part(
-                plan, b, "density_curve",
-                lambda: ex.density_curve_batch(
-                    plan, level, block_windows, weight
-                ),
-            )
-            if g is _SKIPPED:
-                continue
-            outs = g if outs is None else [a + p for a, p in zip(outs, g)]
-        if outs is None:
-            outs = []
-            for ix0, iy0, ix1, iy1 in block_windows:
-                outs.append(
-                    np.zeros((iy1 - iy0 + 1, ix1 - ix0 + 1), np.float64)
-                )
+        # one streaming reduction over the per-partition member LISTS:
+        # elementwise combine keeps every member's association identical
+        # to a per-member tree_merge over the same partials
+        red = pdev.TreeReducer(
+            lambda A, B: [a + b for a, b in zip(A, B)]
+        )
+        self._additive_scan(
+            plan, "density_curve",
+            lambda ex: ex.density_curve_batch_raw(
+                plan, level, block_windows, weight
+            ),
+            lambda b, p, mdev: red.push(Executor.decode_curve_batch(p)),
+        )
+        merged = red.result()
+        outs = []
+        for i, (ix0, iy0, ix1, iy1) in enumerate(block_windows):
+            g = merged[i] if merged is not None else None
+            if g is None:
+                g = np.zeros((iy1 - iy0 + 1, ix1 - ix0 + 1), np.float64)
+            outs.append(g)
         return outs
 
+    def _stats_device_ok(self, plan: QueryPlan, stat: sk.Stat) -> bool:
+        """Can every leaf of ``stat`` update on device? Decided once from
+        the first non-empty pruned partition (children share the schema
+        and dictionaries, so the answer is partition-invariant)."""
+        for b in self.prune(plan):
+            child = self.store.child(b)
+            if child is None or child.count == 0:
+                continue
+            ex = self._executor_for(b, child)
+            return ex._stats_bundle(plan, stat) is not None
+        return False
+
     def stats(self, plan: QueryPlan, stat: sk.Stat) -> sk.Stat:
+        if self._scan_devices() is not None \
+                and self._stats_device_ok(plan, stat):
+            # absorb in pruned-bin order — the exact sequence of
+            # absorb_partials calls the serial loop performs (deferred D
+            # partitions behind dispatch on the fan-out)
+            self._additive_scan(
+                plan, "stats",
+                lambda ex: ex.stats_partials(plan, stat)[1],
+                lambda b, p, mdev: kstats.absorb_partials(
+                    stat, p, self.store.dicts
+                ),
+            )
+            return stat
         for b, ex in self._each(plan):
             self._scan_part(plan, b, "stats", lambda: ex.stats(plan, stat))
         return stat

@@ -26,6 +26,14 @@ cumsum (tile fusion).
 
 Queries carrying hints that change execution shape per member (sampling,
 max_features, sort, properties, explicit index) never fuse.
+
+With an executor POOL (``geomesa.serving.executors`` > 1 —
+docs/SERVING.md §10), fusion stays GLOBAL but a group is assembled and
+executed entirely by ONE slot's dispatch thread: every member of a batch
+runs through the same slot-keyed executor on the same device, so the
+shared pass — and therefore every member's result — is bit-identical to
+what the single dispatch thread would have produced. Groups never split
+across slots; slots parallelize ACROSS groups.
 """
 
 from __future__ import annotations

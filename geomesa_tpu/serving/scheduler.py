@@ -44,10 +44,19 @@ Two modes share the implementation:
   the caller's thread, performing admission-time shed checks and ledger
   accounting;
 * **dispatch-thread** (:meth:`start`; the Flight sidecar): tickets queue
-  and a single worker thread — the jit-safe query thread — drains them
-  under the policy above. Streamed exports enqueue *continuation* tickets
-  (one per chunk) that bypass admission bounds and run ahead of new
-  queries: an accepted stream must stay live under load.
+  and a POOL of worker threads — ``geomesa.serving.executors`` wide,
+  default 1, one executor slot per thread, slot i pinned to jax device
+  i % device_count through the dataset's slot-keyed executors — drains
+  them under the policy above. Each slot keeps the PR-1
+  one-jit-thread-per-device discipline (slot 0 keeps the default
+  placement, so the width-1 pool IS the original single dispatch
+  thread); admission, shedding, fair share, and fusion stay GLOBAL, and
+  a fusion group is assembled and executed entirely by ONE slot's
+  thread, so batch results stay bit-identical to serial execution.
+  Streamed exports enqueue *continuation* tickets (one per chunk) that
+  bypass admission bounds and run ahead of new queries — pinned to the
+  slot that opened the stream (its executor's device arrays belong to
+  that slot's thread): an accepted stream must stay live under load.
 """
 
 from __future__ import annotations
@@ -119,6 +128,10 @@ class Ticket:
     trace_id: Optional[str] = None
     continuation: bool = False
     wait_s: float = 0.0
+    #: executor-slot affinity (continuations only): a stream's chunks must
+    #: all run on the slot that opened it — its executor's device arrays
+    #: belong to that slot's dispatch thread (one jit thread per device)
+    slot: Optional[int] = None
     #: the submitter's thread-local config overrides — adopted on the
     #: dispatch thread so a scoped knob resolves identically in queue and
     #: inline modes (the partition prefetcher crosses threads the same way)
@@ -136,7 +149,7 @@ class _UserLedger:
     policy AND the /debug/queries rollup — a single source of truth."""
 
     __slots__ = ("submitted", "completed", "shed", "rejected", "errors",
-                 "fused", "service_s", "wait_s", "last_ts")
+                 "fused", "service_s", "wait_s", "last_ts", "weight")
 
     def __init__(self):
         self.submitted = 0
@@ -148,6 +161,11 @@ class _UserLedger:
         self.service_s = 0.0
         self.wait_s = 0.0
         self.last_ts = 0.0
+        #: fair-share weight (geomesa.serving.user.weight.<user>) captured
+        #: on the SUBMITTING thread at each submit/admit — the dispatcher
+        #: picks under its own ambient config, so resolving there would
+        #: make caller-scoped overrides silently dead
+        self.weight = 1.0
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -163,6 +181,7 @@ class _UserLedger:
                 self.service_s / self.completed * 1e3, 3
             ) if self.completed else 0.0,
             "last_ts": self.last_ts,
+            "weight": self.weight,
         }
 
 
@@ -196,19 +215,48 @@ class QueryScheduler:
         self._pending = 0
         self._ledger: Dict[str, _UserLedger] = {}
         self._seq = 0
-        self._thread: Optional[threading.Thread] = None
+        #: dispatch-thread pool, slot -> thread (docs/SERVING.md): slot 0
+        #: keeps the default device placement (the single-thread scheduler,
+        #: byte-for-byte); slots 1..N-1 pin device slot % device_count via
+        #: the dataset's slot-keyed executors. Admission, shedding, fair
+        #: share, and fusion stay GLOBAL — the pool parallelizes dispatch,
+        #: never policy.
+        self._threads: Dict[int, threading.Thread] = {}
         self._stopped = False
         #: EWMA of recent execution times (seconds): the admission-time
         #: queue-wait estimate
         self._ewma_all: Optional[float] = None
-        #: users whose tickets the dispatch thread is executing right now
+        #: users whose tickets each dispatch slot is executing right now
         #: (guarded by _cv) — shielded from ledger eviction, which would
         #: otherwise reset their fair-share debt mid-query
-        self._active_users: set = set()
+        self._active_users: Dict[int, set] = {}
         #: users inside an inline admit() right now, refcounted (multiple
         #: caller threads may admit concurrently) — same eviction shield
         self._inline_users: Dict[str, int] = {}
+        #: groups executed per slot (the pool-actually-parallel gate)
+        self._slot_dispatch: Dict[int, int] = {}
         self._tls = threading.local()
+
+    @staticmethod
+    def _pool_size() -> int:
+        """Effective geomesa.serving.executors ("all" = one per device).
+        Integers clamp to the local device count: slot i pins device
+        i % D, so a width beyond D would put two dispatch threads on one
+        device — the exact violation of the one-jit-thread-per-device
+        rule the pool exists to preserve."""
+        raw = (config.SERVING_EXECUTORS.get() or "1").strip().lower()
+        try:
+            import jax
+
+            n_dev = max(1, len(jax.devices()))
+        except Exception:
+            n_dev = 1
+        if raw in ("all", "devices"):
+            return n_dev
+        try:
+            return max(1, min(int(raw), n_dev))
+        except ValueError:
+            return 1
 
     # -- introspection -----------------------------------------------------
     @property
@@ -216,7 +264,11 @@ class QueryScheduler:
         return self._pending
 
     def user_rollups(self) -> Dict[str, Dict[str, Any]]:
-        """Per-user serving rollup (the /debug/queries ``users`` payload)."""
+        """Per-user serving rollup (the /debug/queries ``users`` payload).
+        Carries the user's effective fair-share ``weight`` (geomesa.
+        serving.user.weight.<user>, as last captured at submission — the
+        value the weighted policy actually divided by) next to the
+        attained-service numbers."""
         with self._cv:
             return {u: led.to_dict() for u, led in self._ledger.items()}
 
@@ -225,9 +277,17 @@ class QueryScheduler:
             return {
                 "depth": self._pending,
                 "users": len(self._ledger),
-                "running": self._thread is not None and not self._stopped,
+                "running": bool(self._threads) and not self._stopped,
+                "executors": len(self._threads),
+                "slot_dispatches": dict(self._slot_dispatch),
                 "ewma_service_ms": round((self._ewma_all or 0.0) * 1e3, 3),
             }
+
+    def current_slot(self) -> Optional[int]:
+        """Executor slot of the calling dispatch thread (None off the
+        pool) — GeoDataset routes slot-keyed executors (and their device
+        pins) through this."""
+        return getattr(self._tls, "slot", None)
 
     def current_wait_ms(self) -> float:
         """Queue wait of the ticket executing on THIS thread (0 outside a
@@ -249,7 +309,8 @@ class QueryScheduler:
                 # but never a user with queued work: dropping their ledger
                 # would reset their fair-share debt mid-burst
                 busy = {t.user for t in self._continuations}
-                busy |= self._active_users
+                for users in self._active_users.values():
+                    busy |= users
                 busy |= self._inline_users.keys()
                 idle = [
                     u for u in self._ledger
@@ -292,12 +353,14 @@ class QueryScheduler:
                op: str = "op", fuse: Optional[FuseSpec] = None,
                budget_s: Optional[float] = None,
                trace_id: Optional[str] = None,
-               continuation: bool = False) -> Future:
+               continuation: bool = False,
+               slot: Optional[int] = None) -> Future:
         """Admit one request to the dispatch queue (requires :meth:`start`).
         Raises :class:`AdmissionRejectedError` when the bounded queue is
         full and :class:`DeadlineShedError` when the budget provably cannot
         be met — both BEFORE any planning or device work. ``budget_s``
-        None inherits the submitter's ambient resilience deadline."""
+        None inherits the submitter's ambient resilience deadline.
+        ``slot`` pins a continuation to one executor slot (streams)."""
         user = user or _default_user()
         if budget_s is not None:
             deadline = Deadline.after(budget_s)
@@ -305,14 +368,23 @@ class QueryScheduler:
             deadline = current_deadline()
         fut: Future = Future()
         with self._cv:
-            if self._stopped or self._thread is None:
+            if self._stopped or not self._threads:
                 raise RuntimeError("serving scheduler is not running")
+            if continuation and slot is not None \
+                    and slot not in self._threads:
+                # the stream's slot thread died (dispatcher-exit backstop):
+                # no surviving slot may drive its device, so fail fast
+                # instead of enqueueing a ticket nothing will ever pick up
+                raise RuntimeError(
+                    f"serving executor slot {slot} is not running"
+                )
             led = self._led(user)
             # submitted counts EVERY attempt — shed and rejected included —
             # so shed/submitted means the same thing on the queue path as
             # on the inline admit() path
             led.submitted += 1
             led.last_ts = time.time()
+            led.weight = config.user_weight(user)
             if not continuation:
                 cap = config.SERVING_QUEUE_DEPTH.to_int()
                 cap = 256 if cap is None else cap
@@ -332,6 +404,7 @@ class QueryScheduler:
                 fuse=fuse if config.SERVING_FUSION.to_bool() else None,
                 trace_id=trace_id, continuation=continuation,
                 overrides=config.snapshot_overrides(),
+                slot=slot if continuation else None,
             )
             if continuation:
                 self._continuations.append(t)
@@ -339,7 +412,9 @@ class QueryScheduler:
                 self._queues.setdefault(user, []).append(t)
             self._pending += 1
             metrics.inc(metrics.SERVING_ADMITTED)
-            self._cv.notify()
+            # notify_all: with a pool, a slot-pinned continuation must wake
+            # ITS slot's thread, whichever of the waiters that is
+            self._cv.notify_all()
         return fut
 
     def _admission_shed_locked(self, deadline: Deadline) -> Optional[str]:
@@ -372,10 +447,11 @@ class QueryScheduler:
             op: str = "op", fuse: Optional[FuseSpec] = None,
             budget_s: Optional[float] = None,
             trace_id: Optional[str] = None,
-            continuation: bool = False):
+            continuation: bool = False,
+            slot: Optional[int] = None):
         """Submit and wait (the ``_QueryThread.run`` shape). Without a
         dispatch thread, executes inline under admission accounting."""
-        if self._thread is None:
+        if not self._threads:
             if continuation:
                 # a continuation belongs to a stream the dispatch thread
                 # was driving: running it inline on the caller's (gRPC)
@@ -390,7 +466,7 @@ class QueryScheduler:
                 return fn()
         fut = self.submit(
             fn, user=user, op=op, fuse=fuse, budget_s=budget_s,
-            trace_id=trace_id, continuation=continuation,
+            trace_id=trace_id, continuation=continuation, slot=slot,
         )
         return fut.result()
 
@@ -399,12 +475,21 @@ class QueryScheduler:
         (streamed exports compute their chunks there). Every chunk rides a
         continuation ticket — head-of-line, never bounded or shed: the
         stream's opening request already passed admission, and an accepted
-        stream must stay live under queue pressure."""
+        stream must stay live under queue pressure.
+
+        With an executor POOL, every chunk pins to ONE slot — the slot
+        whose dispatch thread opened the stream (iterate() is called from
+        the opening ticket's execution) — because the stream's scan state
+        holds that slot's device arrays and only that slot's thread may
+        drive its device (slot 0 when opened off the pool)."""
+        pin = self.current_slot()
+        if pin is None and len(self._threads) > 1:
+            pin = 0
         done = object()
         while True:
             item = self.run(
                 lambda: next(it, done), user=user, op=op,
-                continuation=True,
+                continuation=True, slot=pin,
             )
             if item is done:
                 return
@@ -446,6 +531,7 @@ class QueryScheduler:
             led = self._led(user)
             led.submitted += 1
             led.last_ts = time.time()
+            led.weight = config.user_weight(user)
             if shed is not None:
                 led.shed += 1
             else:
@@ -481,21 +567,44 @@ class QueryScheduler:
 
     # -- dispatch ----------------------------------------------------------
     def start(self) -> "QueryScheduler":
-        """Spawn the single dispatch thread (idempotent). The started
-        scheduler becomes the one the process serving.queue.depth gauge
-        reads — inline (scratch) schedulers never touch the metric."""
+        """Spawn the dispatch-thread pool (idempotent): one thread per
+        executor slot, ``geomesa.serving.executors`` wide (default 1 — the
+        single dispatch thread, byte-for-byte the pre-pool scheduler).
+        The started scheduler becomes the one the process
+        serving.queue.depth gauge reads — inline (scratch) schedulers
+        never touch the metric. While the pool is wider than one executor
+        it owns the devices (one jit thread per device), so the sharded
+        partitioned scan stands down (parallel/devices.register_pool)."""
         global _live_sched
+        n = self._pool_size()
+        from geomesa_tpu.parallel import devices as pdev
+
+        # claim the devices BEFORE any slot thread can dispatch: a sharded
+        # scan racing the pool spin-up must already see the pool's width
+        pdev.register_pool(self, n)
         with self._cv:
             self._stopped = False
-            if self._thread is None or not self._thread.is_alive():
-                self._thread = threading.Thread(
-                    target=self._loop, name=self.name, daemon=True
-                )
-                self._thread.start()
-            # else: a previous stop()'s join timed out and the old thread
-            # is still draining its in-flight query — clearing _stopped
-            # re-adopts it as THE dispatcher instead of spawning a second
-            # one (two dispatch threads would break the jit discipline)
+            for slot in range(n):
+                t = self._threads.get(slot)
+                if t is None or not t.is_alive():
+                    t = threading.Thread(
+                        target=self._loop, args=(slot,), daemon=True,
+                        name=self.name if slot == 0
+                        else f"{self.name}-{slot}",
+                    )
+                    self._threads[slot] = t
+                    t.start()
+                # else: a previous stop()'s join timed out and the old
+                # thread is still draining its in-flight query — clearing
+                # _stopped re-adopts it as this slot's dispatcher instead
+                # of spawning a second one (two dispatch threads on one
+                # slot would break the one-jit-thread-per-device rule)
+            width = len(self._threads)
+        # re-register with the FINAL width: covers re-adopted straggler
+        # slots from a timed-out stop, and a last-generation straggler
+        # whose exit handshake raced this start() and unregistered the
+        # claim made above
+        pdev.register_pool(self, width)
         _live_sched = weakref.ref(self)
         metrics.registry().gauge(
             metrics.SERVING_QUEUE_DEPTH, _depth_gauge_value, replace=True
@@ -514,19 +623,41 @@ class QueryScheduler:
             self._queues.clear()
             self._pending = 0
             self._cv.notify_all()
-            t = self._thread
+            threads = list(self._threads.values())
         for tk in stranded:
             tk.future.set_exception(
                 RuntimeError("serving scheduler stopped")
             )
-        if t is not None and t is not threading.current_thread():
-            t.join(timeout=5.0)
-        # _loop clears self._thread itself (under the lock) as it exits;
-        # a timed-out join must leave the reference in place so a later
-        # start() re-adopts the still-draining thread rather than racing
-        # a second dispatcher against it
+        for t in threads:
+            if t is not threading.current_thread():
+                t.join(timeout=5.0)
+        # _loop clears its slot's self._threads entry itself (under the
+        # lock) as it exits; a timed-out join must leave the reference in
+        # place so a later start() re-adopts the still-draining thread
+        # rather than racing a second dispatcher against it
+        from geomesa_tpu.parallel import devices as pdev
 
-    def _loop(self):
+        if any(t.is_alive() and t is not threading.current_thread()
+               for t in threads):
+            # a join timed out: a slot thread is still draining its
+            # in-flight query ON ITS DEVICE, so the pool must keep the
+            # devices claimed — releasing now would let a sharded scan
+            # fan out onto a device this straggler is still dispatching
+            # to. A later start()/stop() cycle (or the straggler's own
+            # exit handshake via a fresh stop()) releases them.
+            return
+        pdev.unregister_pool(self)
+
+    def _has_work_locked(self, slot: int) -> bool:
+        """Is there anything THIS slot may dispatch? (call under _cv)
+        Queries are slot-free; continuations only wake their pinned slot."""
+        if any(self._queues.values()):
+            return True
+        return any(t.slot is None or t.slot == slot
+                   for t in self._continuations)
+
+    def _loop(self, slot: int = 0):
+        self._tls.slot = slot
         try:
             while True:
                 # assembled in place so a mid-assembly failure (e.g. a
@@ -537,23 +668,40 @@ class QueryScheduler:
                 group: List[Ticket] = []
                 try:
                     with self._cv:
-                        while not self._stopped and self._pending == 0:
+                        while not self._stopped \
+                                and not self._has_work_locked(slot):
                             self._cv.wait()
                         if self._stopped:
                             # the exit handshake happens under the lock so
                             # start() can never observe a live-looking
                             # thread that is about to return (it would
                             # fail to spawn a new one)
-                            if self._thread is threading.current_thread():
-                                self._thread = None
+                            if self._threads.get(slot) is \
+                                    threading.current_thread():
+                                del self._threads[slot]
+                            if not self._threads:
+                                # the LAST slot out releases the device
+                                # claim — covers the straggler whose
+                                # stop()-time join timed out (stop left
+                                # the pool registered for exactly this
+                                # moment)
+                                from geomesa_tpu.parallel import \
+                                    devices as pdev
+
+                                pdev.unregister_pool(self)
                             return
-                        self._next_group_locked(group)
-                        self._active_users = {t.user for t in group}
+                        self._next_group_locked(group, slot)
+                        self._active_users[slot] = {t.user for t in group}
                     if group:
+                        with self._cv:
+                            self._slot_dispatch[slot] = \
+                                self._slot_dispatch.get(slot, 0) + 1
+                        metrics.inc(
+                            f"{metrics.SERVING_EXECUTOR_DISPATCH}.{slot}"
+                        )
                         self._execute_group(group)
                 except Exception as e:
-                    # the dispatcher is the ONLY thread draining the
-                    # queue: it must survive anything a single dispatch
+                    # a dispatcher must survive anything a single dispatch
                     # can throw (per-ticket errors land on futures in
                     # _execute_one; this arm is for policy/assembly
                     # failures outside that path)
@@ -563,29 +711,48 @@ class QueryScheduler:
                             t.future.set_exception(e)
                 finally:
                     with self._cv:
-                        self._active_users = set()
+                        self._active_users.pop(slot, None)
         finally:
             # backstop for a genuinely dying thread (BaseException, e.g.
-            # SystemExit): strand-and-fail everything still queued, and
-            # drop the thread reference so submit() raises "not running"
-            # instead of silently enqueueing forever
-            self._dispatcher_exit()
+            # SystemExit): fail what only this slot could have served —
+            # and, when it was the LAST slot, everything still queued —
+            # so callers never hang on futures nothing will complete
+            self._dispatcher_exit(slot)
 
-    def _dispatcher_exit(self) -> None:
+    def _dispatcher_exit(self, slot: int = 0) -> None:
+        last = False
         with self._cv:
-            if self._thread is threading.current_thread():
-                self._thread = None
-            stranded = list(self._continuations)
-            self._continuations.clear()
-            for q in self._queues.values():
-                stranded.extend(q)
-            self._queues.clear()
-            self._pending = 0
+            if self._threads.get(slot) is threading.current_thread():
+                del self._threads[slot]
+            last = not self._threads
+            if self._threads:
+                # surviving slots keep draining queries; only this slot's
+                # pinned continuations are stranded
+                stranded = [t for t in self._continuations
+                            if t.slot == slot]
+                for t in stranded:
+                    self._continuations.remove(t)
+                self._pending -= len(stranded)
+            else:
+                stranded = list(self._continuations)
+                self._continuations.clear()
+                for q in self._queues.values():
+                    stranded.extend(q)
+                self._queues.clear()
+                self._pending = 0
         for tk in stranded:
             if not tk.future.done():
                 tk.future.set_exception(
                     RuntimeError("serving dispatch thread exited")
                 )
+        if last:
+            # a fully-dead pool must release the devices (submit() already
+            # raises "not running"); a concurrent start() re-registers its
+            # own claim as its final step, so this cannot strand a new
+            # generation unclaimed
+            from geomesa_tpu.parallel import devices as pdev
+
+            pdev.unregister_pool(self)
 
     def _pick_user_locked(self) -> Optional[str]:
         users = [u for u, q in self._queues.items() if q]
@@ -594,25 +761,33 @@ class QueryScheduler:
         if not config.SERVING_FAIR_SHARE.to_bool():
             # strict FIFO across users
             return min(users, key=lambda u: min(t.seq for t in self._queues[u]))
-        # least attained service first; FIFO head seq breaks ties so two
-        # fresh users interleave in arrival order
+        # least attained WEIGHTED service first (service_s / weight, so a
+        # weight-4 user earns ~4x the service of a weight-1 user under
+        # contention — geomesa.serving.user.weight.<user>, captured into
+        # the ledger on the submitting thread so scoped overrides apply);
+        # FIFO head seq breaks ties so two fresh users interleave in
+        # arrival order
         return min(
             users,
             key=lambda u: (
-                self._led(u).service_s,
+                self._led(u).service_s / (self._led(u).weight or 1.0),
                 min(t.seq for t in self._queues[u]),
             ),
         )
 
-    def _next_group_locked(self, group: List[Ticket]) -> List[Ticket]:
+    def _next_group_locked(self, group: List[Ticket],
+                           slot: int = 0) -> List[Ticket]:
         """Fills ``group`` IN PLACE (and returns it): every ticket is
         appended the moment it leaves a queue, so the dispatch loop can
-        fail dequeued tickets' futures if assembly itself throws."""
-        if self._continuations:
-            t = self._continuations.popleft()
-            self._pending -= 1
-            group.append(t)
-            return group
+        fail dequeued tickets' futures if assembly itself throws.
+        Continuations dispatch only on their pinned slot (stream
+        affinity); queries go to whichever slot asks first."""
+        for t in self._continuations:
+            if t.slot is None or t.slot == slot:
+                self._continuations.remove(t)
+                self._pending -= 1
+                group.append(t)
+                return group
         user = self._pick_user_locked()
         if user is None:
             return group

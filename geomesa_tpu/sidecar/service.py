@@ -213,14 +213,17 @@ def _spec_errors(fn):
 
 class GeoFlightServer(fl.FlightServerBase):
     """Flight server over a GeoDataset. Every dataset operation runs on
-    ONE dispatch thread behind the serving scheduler (docs/SERVING.md) —
-    the jit-deadlock discipline (gRPC owns the transport threads Flight
-    handlers run on; compiling jax kernels there wedges
-    nondeterministically in MLIR context creation, so all planning/compute
-    routes through one ordinary Python thread) now doubles as the serving
-    bottleneck the scheduler manages: a bounded admission queue with
-    deadline-aware ordering, per-user fair share, typed load shedding, and
-    cross-query fusion of compatible aggregates into one device pass."""
+    the serving scheduler's dispatch-thread POOL (docs/SERVING.md;
+    ``geomesa.serving.executors``, default 1) — the jit-deadlock
+    discipline (gRPC owns the transport threads Flight handlers run on;
+    compiling jax kernels there wedges nondeterministically in MLIR
+    context creation, so all planning/compute routes through ordinary
+    Python dispatch threads, one per executor slot, one slot per device)
+    doubles as the serving bottleneck the scheduler manages: a bounded
+    admission queue with deadline-aware ordering, per-user (weighted)
+    fair share, typed load shedding, and cross-query fusion of compatible
+    aggregates into one device pass — admission/fairness/fusion global,
+    execution fanned across slots."""
 
     def __init__(self, dataset: Optional[GeoDataset] = None,
                  location: str = "grpc+tcp://127.0.0.1:0", **kw):
@@ -253,6 +256,9 @@ class GeoFlightServer(fl.FlightServerBase):
                     w = self._sched.current_wait_ms()
                     if w:
                         root.set(queue_wait_ms=round(w, 3))
+                    slot = self._sched.current_slot()
+                    if slot:  # pool mode: which executor/device served
+                        root.set(executor_slot=int(slot))
                 return fn()
 
         # submit (never inline): after shutdown the scheduler raises here,

@@ -147,6 +147,17 @@ class Span:
             self._annotation = None
         # per-stage latency histogram: p50/p90/p99 derivable from /metrics
         metrics.observe("trace." + self.name, self.duration_ms / 1e3)
+        # per-DEVICE attribution (docs/SCALE.md sharded scan): stages that
+        # carry a ``device`` attr — partition staging/scans assigned to a
+        # device by the sharded fan-out — additionally feed a
+        # device-suffixed histogram, so /metrics shows whether one device
+        # of the mesh is the straggler. Cardinality is bounded by the
+        # local device count.
+        dev = self.attrs.get("device") if self.attrs else None
+        if dev is not None and isinstance(dev, int):
+            metrics.observe(
+                f"trace.{self.name}.device.{dev}", self.duration_ms / 1e3
+            )
         if self.parent is None:
             _finish_trace(self.trace)
         elif self.trace.finished:

@@ -1,0 +1,435 @@
+"""Multi-device sharded partitioned scan + serving pool tests
+(docs/SCALE.md, docs/SERVING.md). Runs on CPU: conftest forces
+``--xla_force_host_platform_device_count=8``, so the fan-out paths
+exercise 8 virtual devices in tier-1 — the in-process analog of the
+reference's multi-tablet-server scans (SURVEY.md §2.9).
+
+Covered invariants:
+
+* sharded partitioned scan == single-device oracle BIT-identically for
+  count / density / density_curve / stats (the merge is the fixed tree
+  reduction of parallel/devices.tree_merge, in pruned-bin order, so the
+  result is independent of device count and assignment);
+* deterministic merge when partition-count % device-count != 0;
+* degradation (a partition quarantined mid-sharded-scan) keeps exact
+  survivor totals, identical to the serial path's degradation;
+* the device_put prefetch overlap changes nothing: bit-identical grids
+  and zero recompiles with the overlap on vs off;
+* the serving pool actually parallelizes (every slot dispatches), keeps
+  fusion bit-identical on one slot, honors per-user weights, and stands
+  the sharded scan down while it owns the devices.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, config, metrics, resilience
+from geomesa_tpu.filter.ecql import parse_iso_ms
+from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+from geomesa_tpu.parallel import devices as pdev
+from geomesa_tpu.resilience import InjectedFault, allow_partial, inject_faults
+
+SPEC = "name:String:index=true,weight:Double,dtg:Date,*geom:Point"
+PSPEC = SPEC + ";geomesa.partition='time'"
+N = 12_000
+ECQL = "BBOX(geom, -110, 28, -75, 48)"
+BBOX = (-120.0, 25.0, -70.0, 50.0)
+STATS = "MinMax(weight);Count();Enumeration(name)"
+
+
+def _data(n=N, seed=11):
+    rng = np.random.default_rng(seed)
+    return {
+        "name": [f"actor{i % 20}" for i in range(n)],
+        "weight": rng.uniform(0, 10, n),
+        "dtg": rng.integers(
+            parse_iso_ms("2020-01-01"), parse_iso_ms("2020-03-01"), n
+        ).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+    }
+
+
+@pytest.fixture(scope="module")
+def pds(tmp_path_factory):
+    """Partitioned dataset: ~9 weekly partitions, max_resident=1 so every
+    multi-partition query streams through the (sharded) pipeline."""
+    ds = GeoDataset(n_shards=4)
+    ds.create_schema("t", PSPEC)
+    st = ds._store("t")
+    assert isinstance(st, PartitionedFeatureStore)
+    st.max_resident = 1
+    st._spill_dir = str(tmp_path_factory.mktemp("spill"))
+    ds.insert("t", _data(), fids=np.arange(N).astype(str))
+    ds.flush()
+    return ds
+
+
+def _ctr(name: str) -> float:
+    return metrics.registry().counter(name).value
+
+
+def _recompiles() -> float:
+    return _ctr(metrics.KERNEL_RECOMPILES)
+
+
+# ---------------------------------------------------------------------------
+# sharded scan engages + bit-identity vs the single-device oracle
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_scan_engages_on_the_virtual_mesh(pds):
+    import jax
+
+    assert len(jax.devices()) == 8  # conftest's forced virtual mesh
+    devs = pdev.scan_devices()
+    assert devs is not None and len(devs) == 8
+    before = _ctr(metrics.SCAN_SHARDED)
+    pds.count("t", ECQL)
+    assert _ctr(metrics.SCAN_SHARDED) == before + 1
+    # partitions really dispatched round-robin across > 1 device
+    used = [
+        d.id for d in devs
+        if _ctr(f"{metrics.SCAN_SHARDED_DEVICE}.{d.id}") > 0
+    ]
+    assert len(used) > 1
+    # and the audit/explain trail names the fan-out
+    _, _, plan = pds._plan("t", ECQL)
+    pds.count("t", ECQL)
+
+
+def test_sharded_count_density_curve_stats_bit_identical(pds):
+    c = pds.count("t", ECQL)
+    d = pds.density("t", ECQL, bbox=BBOX, width=96, height=96)
+    dc, snap = pds.density_curve("t", ECQL, level=6)
+    s = pds.stats("t", STATS, ECQL)
+    with config.MESH_DEVICES.scoped("off"):
+        assert pds.count("t", ECQL) == c
+        assert np.array_equal(
+            pds.density("t", ECQL, bbox=BBOX, width=96, height=96), d
+        )
+        dc2, snap2 = pds.density_curve("t", ECQL, level=6)
+        assert snap2 == snap and np.array_equal(dc2, dc)
+        assert pds.stats("t", STATS, ECQL).to_json() == s.to_json()
+
+
+def test_merge_deterministic_when_partitions_not_divisible(pds):
+    """Pruned-partition count (~9) % device count != 0 for 2, 4, and 8
+    devices: the tree merge depends only on pruned-bin order, so every
+    fan-out width must produce the same bits as the serial scan."""
+    bins = pds._store("t").partition_bins()
+    with config.MESH_DEVICES.scoped("off"):
+        want_c = pds.count("t")
+        want_d = pds.density("t", bbox=BBOX, width=64, height=64)
+    for width in ("2", "3", "8"):
+        if width != "2":
+            assert len(bins) % int(width) != 0  # the awkward remainders
+        with config.MESH_DEVICES.scoped(width):
+            assert pds.count("t") == want_c, width
+            got = pds.density("t", bbox=BBOX, width=64, height=64)
+            assert np.array_equal(got, want_d), width
+
+
+def test_weighted_density_bit_identical(pds):
+    d = pds.density("t", ECQL, bbox=BBOX, width=64, height=64,
+                    weight="weight")
+    with config.MESH_DEVICES.scoped("off"):
+        d2 = pds.density("t", ECQL, bbox=BBOX, width=64, height=64,
+                         weight="weight")
+    assert np.array_equal(d, d2)
+
+
+def test_tree_reducer_matches_tree_merge_association():
+    """The streaming reducer the partitioned merges use must reproduce
+    tree_merge's association EXACTLY for every input size — that identity
+    is what lets the scan merge incrementally (O(log n) resident
+    partials) without changing a single result bit."""
+    comb = "({}+{})".format
+    for n in range(0, 40):
+        parts = [str(i) for i in range(n)]
+        red = pdev.TreeReducer(comb)
+        for p in parts:
+            red.push(p)
+        assert red.result() == pdev.tree_merge(parts, comb), n
+    # None partials are dropped, matching tree_merge's filter
+    red = pdev.TreeReducer(comb)
+    for p in ["0", None, "1", "2", None]:
+        red.push(p)
+    assert red.result() == pdev.tree_merge(["0", "1", "2"], comb)
+
+
+# ---------------------------------------------------------------------------
+# degradation under the sharded fan-out
+# ---------------------------------------------------------------------------
+
+
+def test_sharded_degradation_keeps_exact_survivor_totals(pds):
+    st = pds._store("t")
+    per_bin = {b: st.child(b).count for b in st.partition_bins()}
+    with config.FAULT_INJECTION.scoped("true"):
+        with inject_faults(seed=2) as inj:
+            inj.fail("exec.partition.scan", times=1)
+            with allow_partial() as partial:
+                degraded = pds.count("t")
+    assert partial.degraded and len(partial.skipped) == 1
+    failed_bin = int(partial.skipped[0].part.split(":")[1])
+    assert degraded == N - per_bin[failed_bin]
+    # strict mode still raises through the fan-out
+    with config.FAULT_INJECTION.scoped("true"):
+        with inject_faults(seed=2) as inj:
+            inj.fail("exec.partition.scan", times=1)
+            with pytest.raises(InjectedFault):
+                pds.count("t")
+    assert pds.count("t") == N  # healthy afterwards
+
+
+def test_sharded_and_serial_degrade_identically(pds):
+    """Same seeded fault, sharded vs single-device: the same partition is
+    skipped and the partial grids match bit-for-bit."""
+    def degraded_grid():
+        with config.FAULT_INJECTION.scoped("true"):
+            with inject_faults(seed=4) as inj:
+                inj.fail("exec.partition.scan", times=1)
+                with allow_partial() as partial:
+                    g = pds.density("t", bbox=BBOX, width=64, height=64)
+        return g, partial.skipped[0].part
+
+    g_shard, part_shard = degraded_grid()
+    with config.MESH_DEVICES.scoped("off"):
+        g_ser, part_ser = degraded_grid()
+    assert part_shard == part_ser
+    assert np.array_equal(g_shard, g_ser)
+
+
+# ---------------------------------------------------------------------------
+# device_put prefetch overlap (docs/PERF.md)
+# ---------------------------------------------------------------------------
+
+
+def test_device_put_overlap_bit_identical_and_no_recompiles(pds):
+    pds.density("t", ECQL, bbox=BBOX, width=64, height=64)  # warm
+    before_over = _ctr(metrics.PIPELINE_DEVICE_PUT)
+    base = _recompiles()
+    with_overlap = pds.density("t", ECQL, bbox=BBOX, width=64, height=64)
+    assert _ctr(metrics.PIPELINE_DEVICE_PUT) > before_over
+    with config.PIPELINE_DEVICE_PUT.scoped("false"):
+        without = pds.density("t", ECQL, bbox=BBOX, width=64, height=64)
+    assert np.array_equal(with_overlap, without)
+    # the overlapped upload hits the same per-device caches the query
+    # thread would populate: a warm re-query never traces, either way
+    assert _recompiles() == base
+
+
+# ---------------------------------------------------------------------------
+# serving pool (docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def ds():
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "a:Integer,dtg:Date,*geom:Point")
+    rng = np.random.default_rng(7)
+    n = 4000
+    ds.insert("t", {
+        "geom__x": rng.uniform(-10, 10, n),
+        "geom__y": rng.uniform(-10, 10, n),
+        "dtg": rng.integers(0, 10**10, n).astype("datetime64[ms]"),
+        "a": rng.integers(0, 5, n).astype(np.int32),
+    }, fids=np.arange(n).astype(str))
+    ds.flush("t")
+    ds.count("t", "BBOX(geom, -5, -5, 5, 5)")  # warm plan + kernels
+    return ds
+
+
+def test_pool_actually_parallel_every_slot_dispatches(ds):
+    """A 4-wide pool must run 4 tickets CONCURRENTLY: each ticket blocks
+    on a barrier that only releases when all 4 execute at once, which is
+    impossible unless 4 distinct dispatch threads picked one each."""
+    width = 4
+    barrier = threading.Barrier(width, timeout=15)
+    with config.SERVING_EXECUTORS.scoped(str(width)):
+        s = ds.serving.start()
+        try:
+            assert pdev.pool_width() == width  # scan stands down
+            assert pdev.scan_devices() is None
+            futs = [
+                s.submit(lambda: barrier.wait(15), user=f"u{i}", op="op")
+                for i in range(width)
+            ]
+            for f in futs:
+                f.result(timeout=30)
+            snap = s.snapshot()
+            assert snap["executors"] == width
+            slots = {
+                k: v for k, v in snap["slot_dispatches"].items() if v > 0
+            }
+            assert len(slots) == width
+            # per-slot dispatch counters surfaced for the bench/CI gate
+            for slot in slots:
+                assert _ctr(
+                    f"{metrics.SERVING_EXECUTOR_DISPATCH}.{slot}"
+                ) > 0
+        finally:
+            s.stop()
+    assert pdev.pool_width() == 1  # devices released to the sharded scan
+
+
+def test_pool_queries_match_serial_results(ds):
+    boxes = [
+        f"BBOX(geom, -5, -5, {x:.2f}, 5)" for x in np.linspace(0.5, 5, 12)
+    ]
+    want = [ds.count("t", q) for q in boxes]
+    with config.SERVING_EXECUTORS.scoped("4"):
+        s = ds.serving.start()
+        try:
+            futs = [
+                s.submit((lambda q: lambda: ds.count("t", q))(q),
+                         user=f"u{i % 3}", op="count")
+                for i, q in enumerate(boxes)
+            ]
+            assert [f.result(timeout=60) for f in futs] == want
+        finally:
+            s.stop()
+
+
+def test_pool_fusion_binds_to_one_slot_and_stays_bit_identical(ds):
+    """Fusion stays GLOBAL on the pool: identical counts queued while the
+    pool is stalled coalesce into one batch, executed entirely by ONE
+    slot's thread — results bit-identical to serial, ≤ 2 device-dispatch
+    groups for the batch (one straggler allowance, as on the single
+    dispatch thread)."""
+    q = "BBOX(geom, -5, -5, 4.5, 5)"
+    want = ds.count("t", q)
+    width = 2
+    gate = threading.Event()
+    started = [threading.Event() for _ in range(width)]
+
+    def stall(i):
+        def fn():
+            started[i].set()
+            gate.wait(15)
+        return fn
+
+    with config.SERVING_EXECUTORS.scoped(str(width)):
+        s = ds.serving.start()
+        try:
+            stalls = [
+                s.submit(stall(i), user=f"stall{i}", op="op")
+                for i in range(width)
+            ]
+            for ev in started:
+                assert ev.wait(15)  # both slots busy -> queries must queue
+            from geomesa_tpu.serving import fuse
+
+            fused_before = _ctr(metrics.SERVING_FUSED)
+            futs = [
+                s.submit((lambda: ds.count("t", q)), user="same",
+                         op="count",
+                         fuse=fuse.make_spec(ds, "count", "t", {"ecql": q}))
+                for _ in range(6)
+            ]
+            gate.set()
+            got = [f.result(timeout=60) for f in futs]
+            for f in stalls:
+                f.result(timeout=30)
+            assert got == [want] * 6
+            assert _ctr(metrics.SERVING_FUSED) >= fused_before + 4
+        finally:
+            s.stop()
+
+
+def test_weighted_fair_share_prefers_heavy_user(ds, monkeypatch):
+    """geomesa.serving.user.weight.<user>: under contention a weight-4
+    user earns ~4x the dispatches of a weight-1 user — the least-
+    attained-WEIGHTED-service order is heavy,heavy,heavy,heavy,light
+    after the opening tie."""
+    monkeypatch.setenv("GEOMESA_SERVING_USER_WEIGHT_HEAVY", "4")
+    assert config.user_weight("heavy") == 4.0
+    assert config.user_weight("light") == 1.0
+    order = []
+    gate = threading.Event()
+    started = threading.Event()
+
+    def work(tag):
+        def fn():
+            order.append(tag)
+            time.sleep(0.004)  # comparable per-ticket service cost
+        return fn
+
+    with config.SERVING_EXECUTORS.scoped("1"):
+        s = ds.serving.start()
+        try:
+            stall = s.submit(
+                lambda: (started.set(), gate.wait(15)), user="stall",
+                op="op",
+            )
+            assert started.wait(15)
+            futs = []
+            for i in range(6):  # interleaved arrivals
+                futs.append(s.submit(work("light"), user="light", op="op"))
+                futs.append(s.submit(work("heavy"), user="heavy", op="op"))
+            gate.set()
+            for f in futs:
+                f.result(timeout=60)
+            stall.result(timeout=30)
+        finally:
+            s.stop()
+    # the first 6 dispatches: heavy dominates ~4:1 after the opening tie
+    assert order.count("heavy") == order.count("light") == 6
+    assert order[:6].count("heavy") >= 4, order
+    # rollups surface the effective weight next to the service ledger
+    roll = ds.serving.user_rollups()
+    assert roll["heavy"]["weight"] == 4.0
+    assert roll["light"]["weight"] == 1.0
+
+
+def test_weight_captured_at_submission_scoped_override(ds):
+    """A caller-scoped weight override must reach the dispatcher: the
+    weight is captured into the ledger ON THE SUBMITTING THREAD (the
+    dispatch thread's ambient config never sees scoped overrides)."""
+    with config.SERVING_EXECUTORS.scoped("1"):
+        s = ds.serving.start()
+        try:
+            prop = config.SystemProperty(
+                "geomesa.serving.user.weight.scopedu", None
+            )
+            with prop.scoped("2.5"):
+                s.submit(lambda: 1, user="scopedu", op="op").result(30)
+            assert ds.serving.user_rollups()["scopedu"]["weight"] == 2.5
+        finally:
+            s.stop()
+
+
+def test_user_weight_parsing_defaults():
+    assert config.user_weight("nobody") == 1.0
+    with config.SystemProperty(
+        "geomesa.serving.user.weight.bad", None
+    ).scoped("not-a-number"):
+        assert config.user_weight("bad") == 1.0
+    with config.SystemProperty(
+        "geomesa.serving.user.weight.neg", None
+    ).scoped("-2"):
+        assert config.user_weight("neg") == 1.0
+
+
+def test_sharded_scan_resumes_after_pool_stop(pds):
+    """Pool ownership of the devices is scoped to start()..stop(): the
+    sharded scan stands down while a >1 pool runs and re-engages after."""
+    with config.SERVING_EXECUTORS.scoped("2"):
+        s = pds.serving.start()
+        try:
+            assert pdev.scan_devices() is None
+            before = _ctr(metrics.SCAN_SHARDED)
+            # queries still run (serial partition stream) while the pool
+            # owns the devices — and return the same results
+            assert pds.count("t", ECQL) > 0
+            assert _ctr(metrics.SCAN_SHARDED) == before
+        finally:
+            s.stop()
+    before = _ctr(metrics.SCAN_SHARDED)
+    pds.count("t", ECQL)
+    assert _ctr(metrics.SCAN_SHARDED) == before + 1
