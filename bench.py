@@ -376,6 +376,40 @@ def main():
         f"tracing: warm traced p50={traced_ms:.1f}ms vs untraced "
         f"{warm_requery_ms:.1f}ms ({trace_overhead_pct:+.1f}%)\n"
     )
+
+    # Export overhead (docs/OBSERVABILITY.md): the same warm requery with
+    # tracing AND the file-sink exporter active, vs the untraced p50 —
+    # mirrors trace_overhead_pct, gated < 5% by ci.yml. The export file is
+    # left behind (GEOMESA_BENCH_EXPORT_PATH) so CI validates the OTLP
+    # span-batch shape of what actually got written.
+    export_path = os.environ.get(
+        "GEOMESA_BENCH_EXPORT_PATH", "/tmp/_trace_export.jsonl"
+    )
+    try:
+        os.remove(export_path)
+    except OSError:
+        pass
+    from geomesa_tpu import tracing_export as _texp
+
+    with _tcfg.TRACE_ENABLED.scoped("true"), \
+            _tcfg.TRACE_EXPORT_PATH.scoped(export_path):
+        ds.density("gdelt", ecql, bbox=bbox, width=W, height=H)  # warm
+        exporting = sorted(
+            _timed(lambda: ds.density("gdelt", ecql, bbox=bbox,
+                                      width=W, height=H))
+            for _ in range(5)
+        )
+        _texp.flush()
+    exporting_ms = exporting[len(exporting) // 2] * 1e3
+    export_overhead_pct = (
+        (exporting_ms - warm_requery_ms) / warm_requery_ms * 100.0
+        if warm_requery_ms > 0 else 0.0
+    )
+    sys.stderr.write(
+        f"export: warm exporting p50={exporting_ms:.1f}ms vs untraced "
+        f"{warm_requery_ms:.1f}ms ({export_overhead_pct:+.1f}%) "
+        f"-> {export_path}\n"
+    )
     variants = [pan_ecql(dx) for dx in (0.0, 0.5, 1.0, 1.5)]
     for v in variants:  # warmup: at most one trace per distinct filter
         ds.count("gdelt", v)
@@ -637,6 +671,20 @@ def main():
         return round(v, 4) if isinstance(v, float) else v
 
     _scan_hist = _metrics.registry().timer("query.density").hist
+    from geomesa_tpu import utilization as _util
+
+    _usnap = _util.snapshot()
+    # per-device attributed busy seconds (the device.busy.<id> gauges'
+    # totals). NOTE: like every key in this file since BENCH_r04/r05,
+    # these are CPU(-mesh) numbers when device_unreachable is set — the
+    # accelerator utilization baseline is still an open gap.
+    _dev_busy = {
+        k: v["busy_s"] for k, v in _usnap["devices"].items()
+    }
+    _cost_rollup = {}
+    for _led in ds.serving.user_rollups().values():
+        for _k, _v in _led.get("cost", {}).items():
+            _cost_rollup[_k] = round(_cost_rollup.get(_k, 0.0) + _v, 4)
     metrics_snapshot = {
         "kernel_recompiles": _metric("kernel.recompiles"),
         "kernel_bucket_hit": _metric("kernel.bucket_hit"),
@@ -651,6 +699,18 @@ def main():
         "device_dispatches": _metric("exec.device.dispatch"),
         "density_p50_ms": round(_scan_hist.quantile(0.5) * 1e3, 3),
         "density_p99_ms": round(_scan_hist.quantile(0.99) * 1e3, 3),
+        "trace_export_exported": _metric("trace.export.exported"),
+        "trace_export_dropped": _metric("trace.export.dropped"),
+        # busiest device's trailing-window fraction (0 when the window
+        # has rolled past the measurement — totals are in device_busy)
+        "device_busy_fraction": max(
+            [v["busy_fraction"] for v in _usnap["devices"].values()],
+            default=0.0,
+        ),
+        # per-user cost attribution summed over the serving ledger:
+        # device_ms.<id>, partitions_scanned/pruned, bytes_staged,
+        # cache_hits, recompiles (docs/OBSERVABILITY.md)
+        "cost_ledger": _cost_rollup,
     }
 
     feats_per_sec = n / dev_s
@@ -679,6 +739,9 @@ def main():
         "warm_requery_ms": round(warm_requery_ms, 2),
         "recompiles_per_100_queries": round(recompiles_per_100, 1),
         "trace_overhead_pct": round(trace_overhead_pct, 2),
+        "export_overhead_pct": round(export_overhead_pct, 2),
+        "export_path": export_path,
+        "device_busy": _dev_busy,
         "metrics": metrics_snapshot,
         **serving_keys,
         **sharded_keys,

@@ -631,6 +631,21 @@ class GeoDataset:
                              f"({scanned} rows x {n_cols} f32 cols)")
                 exp.pop()
             exp.pop()
+        # per-query cost attribution (docs/OBSERVABILITY.md): THIS explain
+        # call's trace cost ledger — device ms per device, partition
+        # pruning, bytes staged, cache hits — populated by analyze's count
+        # (a plan-only explain shows planning-side cost only). The same
+        # ledger rolls per-user into /debug/queries and rides exported
+        # traces as geomesa.cost.* attributes.
+        exp.push("Cost")
+        cost = tracing.current_cost()
+        if cost:
+            for k, v in sorted(cost.items()):
+                exp.kv(k, round(v, 3))
+        else:
+            exp.line("(none recorded — enable geomesa.trace.enabled and "
+                     "analyze=True for device/partition attribution)")
+        exp.pop()
         return str(exp)
 
     def _executor(self, st: FeatureStore) -> Executor:

@@ -146,6 +146,7 @@ class AggregateCache:
             hit = self.store.get(uid, epoch, wkey)
         if hit is not None:
             metrics.inc(metrics.CACHE_HIT)
+            tracing.add_cost("cache_hits", 1.0)
             self._note(plan, cache="hit")
             plan.__dict__["scanned_rows"] = 0
             plan.__dict__.setdefault("table_rows", 0)
@@ -191,6 +192,7 @@ class AggregateCache:
                     got = self.store.get(uid, epoch, ckey)
                 if got is not None:
                     hits += 1
+                    tracing.add_cost("cache_hits", 1.0)
                     acc = op.merge(acc, op.unpack(got))
                     continue
                 with tracing.span("cache.cell.scan"):

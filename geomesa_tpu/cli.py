@@ -442,13 +442,34 @@ def cmd_trace(args):
 
 def cmd_obs(args):
     """Run the standalone observability endpoint (/metrics, /healthz,
-    /debug/queries) over a catalog."""
+    /debug/queries, /debug/devices) over a catalog."""
     from geomesa_tpu import obs
 
     ds = _load(args.catalog)
     print(f"geomesa-tpu obs listening on http://{args.host}:{args.port}"
-          "/metrics /healthz /debug/queries")
+          "/metrics /healthz /debug/queries /debug/devices")
     obs.serve(ds, args.host, args.port)
+
+
+def cmd_devices(args):
+    """Print the /debug/devices payload — per-device busy fractions, pool
+    slot occupancy, the queue-wait vs device-time breakdown, and the SLO
+    burn summary (docs/OBSERVABILITY.md). ``--url`` scrapes a running
+    obs/web endpoint; without it, this process's own counters (mostly
+    relevant under test)."""
+    if args.url:
+        import urllib.request
+
+        url = args.url.rstrip("/")
+        if not url.endswith("/debug/devices"):
+            url += "/debug/devices"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            sys.stdout.write(resp.read().decode() + "\n")
+        return
+    from geomesa_tpu import obs
+
+    print(json.dumps(obs.debug_devices(), indent=2, sort_keys=True,
+                     default=str))
 
 
 def cmd_version(args):
@@ -715,6 +736,11 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--host", default="127.0.0.1")
     sp.add_argument("--port", type=int, default=9090)
     sp.set_defaults(fn=cmd_obs)
+
+    sp = sub.add_parser("devices", help="per-device utilization, slot "
+                        "occupancy, and SLO burn (JSON)")
+    sp.add_argument("--url", help="base URL of a running obs/web endpoint")
+    sp.set_defaults(fn=cmd_devices)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

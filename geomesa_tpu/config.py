@@ -417,6 +417,95 @@ TRACE_JAX_PROFILER = SystemProperty("geomesa.trace.jax.profiler", "false")
 KERNEL_ALERT_THRESHOLD = SystemProperty("geomesa.kernel.alert.threshold", "3")
 
 # ---------------------------------------------------------------------------
+# Trace export + tail-based sampling (tracing_export.py;
+# docs/OBSERVABILITY.md). Export engages when either sink below is
+# configured; the sampling decision is made at trace COMPLETION (tail-based):
+# slow/errored/degraded/shed/recompile-carrying traces are always kept,
+# healthy traces sample at the seeded-deterministic rate.
+# ---------------------------------------------------------------------------
+
+#: HTTP OTLP sink: POST finished span batches (OTLP/JSON shape) here.
+#: Retried via resilience.RetryPolicy and fenced by the ``trace.otlp``
+#: circuit breaker. Unset = no HTTP sink.
+TRACE_OTLP_ENDPOINT = SystemProperty("geomesa.trace.otlp.endpoint", None)
+
+#: File sink: append one OTLP-shaped JSON span batch per line (JSONL) —
+#: the air-gapped/CI sink. Unset = no file sink.
+TRACE_EXPORT_PATH = SystemProperty("geomesa.trace.export.path", None)
+
+#: Tail-sampling keep rate for HEALTHY traces in [0, 1]. Decided
+#: deterministically from (seed, trace_id), so a given trace id is kept or
+#: dropped identically run to run. Always-keep classes (slow, errored,
+#: degraded, shed, recompile-carrying) ignore the rate.
+TRACE_SAMPLE_RATE = SystemProperty("geomesa.trace.sample.rate", "1.0")
+
+#: Seed for the deterministic sampling hash above.
+TRACE_SAMPLE_SEED = SystemProperty("geomesa.trace.sample.seed", "0")
+
+#: Bounded export queue depth between trace completion and the background
+#: flusher. Overflow DROPS the trace (counted in ``trace.export.dropped``)
+#: — the query/dispatch threads never block on export.
+TRACE_EXPORT_QUEUE = SystemProperty("geomesa.trace.export.queue", "1024")
+
+#: Max traces converted + written per flusher pass (one OTLP batch).
+TRACE_EXPORT_BATCH = SystemProperty("geomesa.trace.export.batch", "64")
+
+# ---------------------------------------------------------------------------
+# Per-device utilization accounting (utilization.py; docs/OBSERVABILITY.md).
+# ---------------------------------------------------------------------------
+
+#: Trailing window (seconds) over which the ``device.busy.<id>`` and
+#: ``serving.slot.occupancy.<slot>`` gauges compute their busy fraction.
+DEVICE_BUSY_WINDOW = SystemProperty("geomesa.device.busy.window", "60")
+
+# ---------------------------------------------------------------------------
+# SLO burn-rate monitor (slo.py; docs/OBSERVABILITY.md). Targets are
+# per-op p99 latencies named ``geomesa.slo.<op>.p99.ms`` (thread-local
+# override or env, e.g. GEOMESA_SLO_COUNT_P99_MS=50), evaluated over the
+# existing ``trace.<op>`` histograms with fast/slow dual-window burn rates.
+# ---------------------------------------------------------------------------
+
+#: Fast burn window (seconds): /healthz degrades when this window burns
+#: past geomesa.slo.burn.threshold.
+SLO_WINDOW_FAST_S = SystemProperty("geomesa.slo.window.fast.s", "300")
+
+#: Slow burn window (seconds): the page-worthy confirmation window.
+SLO_WINDOW_SLOW_S = SystemProperty("geomesa.slo.window.slow.s", "3600")
+
+#: Fast-window burn rate past which /healthz reports degraded (the classic
+#: 14.4x = "a 99% monthly budget gone in ~2 days at this rate" threshold).
+SLO_BURN_THRESHOLD = SystemProperty("geomesa.slo.burn.threshold", "14.4")
+
+#: Per-op SLO target prefix/suffix: ``geomesa.slo.<op>.p99.ms`` (op is a
+#: root-span name: count, density, density_curve, ... — underscores, no
+#: dots). Resolved via :func:`slo_targets`.
+SLO_PREFIX = "geomesa.slo."
+SLO_SUFFIX = ".p99.ms"
+
+
+def slo_targets() -> Dict[str, float]:
+    """Effective per-op p99 targets in ms: ``{op: target_ms}``. Thread-local
+    overrides first (``geomesa.slo.<op>.p99.ms``), then env
+    (``GEOMESA_SLO_<OP>_P99_MS``); an unparseable value is ignored."""
+    out: Dict[str, float] = {}
+    env_pre, env_suf = "GEOMESA_SLO_", "_P99_MS"
+    for k, v in os.environ.items():
+        if k.startswith(env_pre) and k.endswith(env_suf) \
+                and len(k) > len(env_pre) + len(env_suf):
+            try:
+                out[k[len(env_pre):-len(env_suf)].lower()] = float(v)
+            except ValueError:
+                pass
+    for k, v in _overrides().items():
+        if k.startswith(SLO_PREFIX) and k.endswith(SLO_SUFFIX) \
+                and len(k) > len(SLO_PREFIX) + len(SLO_SUFFIX):
+            try:
+                out[k[len(SLO_PREFIX):-len(SLO_SUFFIX)]] = float(v)
+            except ValueError:
+                pass
+    return out
+
+# ---------------------------------------------------------------------------
 # Serving scheduler (serving/scheduler.py; docs/SERVING.md). The sidecar's
 # single dispatch thread sits behind a bounded admission queue with
 # deadline-aware ordering, per-user fair share, and cross-query fusion of

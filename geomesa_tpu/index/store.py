@@ -279,7 +279,7 @@ class IndexTable:
             stacked[s, : sl.stop - sl.start] = dv[sl]
         return stacked
 
-    def stage_host(self, names: Sequence[str]) -> None:
+    def stage_host(self, names: Sequence[str]) -> int:
         """Assemble (and cache) the stacked host arrays for ``names`` —
         the expensive host half of :meth:`device_columns`, jax-free so the
         partition pipeline's prefetch thread can overlap it with another
@@ -287,17 +287,22 @@ class IndexTable:
         staged array (paying only the device_put) and frees it. Columns
         already device-resident are skipped: in the warm steady state
         (device cache hit) staging would be pure waste, and the pipeline's
-        consumer additionally clears leftovers after each partition."""
+        consumer additionally clears leftovers after each partition.
+        Returns the bytes newly staged by THIS call (the per-query cost
+        ledger's ``bytes_staged`` contribution — 0 in the warm state)."""
         L = self.shard_len
         resident = set()
         for cached in list(self._device_cache.values()):
             resident.update(cached)
+        staged_bytes = 0
         for name in sorted(set(names)):
             if name in resident or (name, L) in self._host_stage:
                 continue
             stacked = self._stack_host(name, L)
             if stacked is not None:
                 self._host_stage[(name, L)] = stacked
+                staged_bytes += int(stacked.nbytes)
+        return staged_bytes
 
     def device_columns(self, names: Sequence[str], sharding=None):
         """Stacked padded [n_shards, shard_len] jnp arrays for ``names``

@@ -77,9 +77,17 @@ class Histogram:
     """Fixed-bucket histogram. Defaults to the latency buckets (seconds);
     pass custom ``buckets`` plus ``unit=None`` for dimensionless
     distributions (e.g. fusion batch sizes) — the prometheus rendering then
-    drops the ``_seconds`` suffix."""
+    drops the ``_seconds`` suffix.
 
-    __slots__ = ("buckets", "counts", "count", "sum_s", "unit", "_lock")
+    **Exemplars**: ``observe(seconds, trace_id=...)`` additionally records
+    the trace id against the bucket the observation landed in (last-writer
+    wins per bucket), rendered in OpenMetrics exemplar syntax — so a p99
+    outlier in /metrics links directly to its exported/slow-logged trace.
+    The exemplar map is lazily allocated: histograms never fed a trace_id
+    pay nothing."""
+
+    __slots__ = ("buckets", "counts", "count", "sum_s", "unit", "exemplars",
+                 "_lock")
 
     def __init__(self, buckets: Optional[Tuple[float, ...]] = None,
                  unit: Optional[str] = "s"):
@@ -88,14 +96,20 @@ class Histogram:
         self.counts = [0] * (len(self.buckets) + 1)  # last = +Inf overflow
         self.count = 0
         self.sum_s = 0.0
+        #: bucket index -> (trace_id, value, unix_ts); None until first use
+        self.exemplars: Optional[Dict[int, Tuple[str, float, float]]] = None
         self._lock = threading.Lock()
 
-    def observe(self, seconds: float):
+    def observe(self, seconds: float, trace_id: Optional[str] = None):
         i = bisect.bisect_left(self.buckets, seconds)
         with self._lock:
             self.counts[i] += 1
             self.count += 1
             self.sum_s += seconds
+            if trace_id is not None:
+                if self.exemplars is None:
+                    self.exemplars = {}
+                self.exemplars[i] = (trace_id, seconds, time.time())
 
     def quantile(self, q: float) -> float:
         """Approximate quantile: the upper bound of the bucket holding the
@@ -118,8 +132,9 @@ class Histogram:
         with self._lock:
             counts = list(self.counts)
             total, s = self.count, self.sum_s
+            ex = dict(self.exemplars) if self.exemplars else {}
         return {"count": total, "sum_s": s, "counts": counts,
-                "buckets": list(self.buckets)}
+                "buckets": list(self.buckets), "exemplars": ex}
 
 
 class Timer:
@@ -229,25 +244,49 @@ class MetricRegistry:
         return out
 
     @staticmethod
-    def _prom_hist_lines(metric: str, h: Histogram) -> List[str]:
-        """Cumulative prometheus histogram lines for one Histogram."""
+    def _prom_hist_lines(metric: str, h: Histogram,
+                         exemplars: bool = False) -> List[str]:
+        """Cumulative prometheus histogram lines for one Histogram. With
+        ``exemplars`` (OpenMetrics exposition ONLY — the `#` suffix is a
+        parse error under the classic text format, so callers must
+        negotiate the content type first), buckets holding an exemplar
+        render it in OpenMetrics exemplar syntax
+        (`... # {trace_id="…"} value timestamp`), linking the bucket to a
+        concrete trace (docs/OBSERVABILITY.md)."""
         snap = h.snapshot()
+        ex = (snap.get("exemplars") or {}) if exemplars else {}
+
+        def _ex(i: int) -> str:
+            e = ex.get(i)
+            if e is None:
+                return ""
+            tid, val, ts = e
+            return f' # {{trace_id="{tid}"}} {val:.6f} {ts:.3f}'
+
         lines: List[str] = []
         cum = 0
-        for le, c in zip(snap["buckets"], snap["counts"]):
+        for i, (le, c) in enumerate(zip(snap["buckets"], snap["counts"])):
             cum += c
-            lines.append(f'{metric}_bucket{{le="{le}"}} {cum}')
+            lines.append(f'{metric}_bucket{{le="{le}"}} {cum}{_ex(i)}')
         cum += snap["counts"][-1]
-        lines.append(f'{metric}_bucket{{le="+Inf"}} {cum}')
+        lines.append(
+            f'{metric}_bucket{{le="+Inf"}} {cum}'
+            f'{_ex(len(snap["buckets"]))}'
+        )
         lines.append(f"{metric}_sum {snap['sum_s']:.6f}")
         lines.append(f"{metric}_count {snap['count']}")
         return lines
 
-    def prometheus(self) -> str:
+    def prometheus(self, exemplars: bool = False) -> str:
         """Prometheus text exposition of all metrics. Timers render their
         legacy count/total/max lines PLUS ``_seconds`` histogram buckets;
         standalone histograms render the standard bucket/sum/count triple
-        (p50/p90/p99 derivable with histogram_quantile)."""
+        (p50/p90/p99 derivable with histogram_quantile). ``exemplars``
+        adds per-bucket exemplar suffixes — legal ONLY in the OpenMetrics
+        exposition (obs.py negotiates it via the Accept header and
+        appends the required ``# EOF``); the classic ``version=0.0.4``
+        text format must stay exemplar-free or standard scrapers fail the
+        whole scrape."""
         lines: List[str] = []
         p = self.prefix
         with self._lock:
@@ -258,10 +297,12 @@ class MetricRegistry:
                 lines.append(f"{metric}_count {m.count}")
                 lines.append(f"{metric}_seconds_total {m.total_s:.6f}")
                 lines.append(f"{metric}_seconds_max {m.max_s:.6f}")
-                lines.extend(self._prom_hist_lines(metric + "_seconds", m.hist))
+                lines.extend(self._prom_hist_lines(metric + "_seconds",
+                                                   m.hist, exemplars))
             elif isinstance(m, Histogram):
                 suffix = "_seconds" if m.unit == "s" else ""
-                lines.extend(self._prom_hist_lines(metric + suffix, m))
+                lines.extend(self._prom_hist_lines(metric + suffix, m,
+                                                   exemplars))
             elif isinstance(m, (Counter, Gauge)):
                 lines.append(f"{metric} {m.value}")
         return "\n".join(lines) + "\n"
@@ -285,10 +326,12 @@ def inc(name: str, n: int = 1) -> None:
     _REGISTRY.counter(name).inc(n)
 
 
-def observe(name: str, seconds: float) -> None:
+def observe(name: str, seconds: float,
+            trace_id: Optional[str] = None) -> None:
     """Shorthand: record one latency observation into a process-registry
-    histogram (span completions in tracing.py use this path)."""
-    _REGISTRY.histogram(name).observe(seconds)
+    histogram (span completions in tracing.py use this path). An optional
+    ``trace_id`` rides along as the bucket's exemplar."""
+    _REGISTRY.histogram(name).observe(seconds, trace_id)
 
 
 # Aggregate-cache metric names (cache/store.py, cache/service.py). Kept here
@@ -335,6 +378,28 @@ PIPELINE_DEVICE_PUT = "pipeline.deviceput"
 #   trace.slow                 queries that exceeded geomesa.trace.slow.ms
 KERNEL_RECOMPILE_ALERT = "kernel.recompile.alert"
 KERNEL_RECOMPILE_ALERTS = "kernel.recompile.alerts"
+# Trace export + tail sampling (tracing_export.py; docs/OBSERVABILITY.md):
+#   trace.export.exported   traces handed to a sink (after sampling)
+#   trace.export.sampled    healthy traces dropped by the sample rate
+#   trace.export.dropped    traces dropped on export-queue overflow (the
+#                           non-blocking contract: full queue = drop+count,
+#                           never a blocked query/dispatch thread)
+#   trace.export.failed     sink write failures after retries/breaker
+#   trace.export.batches    OTLP batches successfully written
+TRACE_EXPORT_EXPORTED = "trace.export.exported"
+TRACE_EXPORT_SAMPLED = "trace.export.sampled"
+TRACE_EXPORT_DROPPED = "trace.export.dropped"
+TRACE_EXPORT_FAILED = "trace.export.failed"
+TRACE_EXPORT_BATCHES = "trace.export.batches"
+# Per-device utilization + SLO burn (utilization.py, slo.py):
+#   device.busy.<id>             gauge: busy fraction of device <id> over
+#                                the trailing geomesa.device.busy.window
+#   serving.slot.occupancy.<s>   gauge: busy fraction of pool slot <s>
+#   slo.burn.<op>                gauge: fast-window burn rate for the
+#                                geomesa.slo.<op>.p99.ms target
+DEVICE_BUSY_PREFIX = "device.busy"
+SLOT_OCCUPANCY_PREFIX = "serving.slot.occupancy"
+SLO_BURN_PREFIX = "slo.burn"
 # Serving-scheduler metrics (serving/scheduler.py, planning/executor.py;
 # docs/SERVING.md):
 #   serving.queue.depth     gauge: tickets currently queued (all users)

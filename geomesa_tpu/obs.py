@@ -15,9 +15,14 @@ the Dropwizard-reporter role of the reference's geomesa-metrics module
                         breaker is open
     GET /debug/queries  JSON: recent query audit events, the degradation
                         trail, and slow-query span trees
-                        (?n= bounds each list, default 50)
+                        (?n= bounds each list, default 50; ?user= and
+                        ?op= filter events/rollups/slow traces)
+    GET /debug/devices  JSON: per-device busy fractions + totals, serving
+                        slot occupancy, the queue-wait vs device-time
+                        breakdown, and the SLO burn summary
+                        (utilization.py, slo.py)
 
-``web.py`` mounts the same three routes on the REST server, so a process
+``web.py`` mounts the same routes on the REST server, so a process
 already serving the API needs no second port; :func:`serve` runs a
 standalone endpoint (e.g. next to the Flight sidecar, which has no HTTP
 listener of its own).
@@ -38,9 +43,19 @@ from typing import Any, Dict, Optional
 from geomesa_tpu import metrics, resilience, tracing
 
 
-def metrics_text() -> str:
-    """The /metrics payload: prometheus text exposition."""
-    return metrics.registry().prometheus()
+#: OpenMetrics content type served when the scraper negotiates it
+OPENMETRICS_CTYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def metrics_text(openmetrics: bool = False) -> str:
+    """The /metrics payload. Classic prometheus text by default;
+    ``openmetrics`` renders the OpenMetrics exposition instead —
+    exemplars on histogram buckets plus the required ``# EOF`` trailer.
+    Exemplars are ONLY legal there: a classic-format scrape with a ``#``
+    suffix would fail entirely, so the format is chosen by Accept-header
+    negotiation in :func:`handle`."""
+    text = metrics.registry().prometheus(exemplars=openmetrics)
+    return text + "# EOF\n" if openmetrics else text
 
 
 # -- device reachability -----------------------------------------------------
@@ -108,9 +123,12 @@ def _fs_quarantine() -> Dict[str, Dict[str, str]]:
 
 def health() -> Dict[str, Any]:
     """The /healthz payload. ``status`` is ``ok`` unless a circuit breaker
-    is open (``degraded``); quarantine counters (plus the per-instance
-    fs-storage quarantine maps) and device reachability ride along for the
-    operator's first glance."""
+    is open or an SLO's fast window burns past geomesa.slo.burn.threshold
+    (``degraded``); quarantine counters (plus the per-instance fs-storage
+    quarantine maps) and device reachability ride along for the operator's
+    first glance."""
+    from geomesa_tpu import slo
+
     breakers = resilience.breaker_states()
     report = metrics.registry().report()
     quarantine = {
@@ -118,8 +136,10 @@ def health() -> Dict[str, Any]:
         if "quarantin" in name and isinstance(v, (int, float)) and v
     }
     open_breakers = [n for n, s in breakers.items() if s == "open"]
-    return {
-        "status": "degraded" if open_breakers else "ok",
+    slo_status = slo.monitor().status()
+    slo_hot = {op: s for op, s in slo_status.items() if s["hot"]}
+    out = {
+        "status": "degraded" if (open_breakers or slo_hot) else "ok",
         "breakers": breakers,
         "open_breakers": open_breakers,
         "quarantine": quarantine,
@@ -127,46 +147,98 @@ def health() -> Dict[str, Any]:
         "device": device_health(),
         "tracing": tracing.enabled(),
     }
+    if slo_status:
+        out["slo"] = slo_status
+        if slo_hot:
+            out["slo_burning"] = sorted(slo_hot)
+    return out
 
 
-def debug_queries(dataset=None, n: int = 50) -> Dict[str, Any]:
+def debug_queries(dataset=None, n: int = 50, user: Optional[str] = None,
+                  op: Optional[str] = None) -> Dict[str, Any]:
     """The /debug/queries payload: recent audits + degradations + slow
     traces + per-user serving rollups. ``dataset`` optional — the
     degradation trail and slow traces are process-wide; audit events and
     the user rollup need the dataset (the rollup reads the serving
     scheduler's ledger, the SAME accounting fair-share runs on —
-    docs/SERVING.md)."""
+    docs/SERVING.md). ``user``/``op`` filter events, rollups, and slow
+    traces (filters apply BEFORE the ``n`` cap, so "the last 5 of user
+    X's density queries" means what it says)."""
     from geomesa_tpu import audit as audit_mod
 
     events = []
     users: Dict[str, Any] = {}
     serving: Dict[str, Any] = {}
+    user_tids = None
     if dataset is not None:
-        events = [json.loads(e.to_json()) for e in dataset.audit.recent(n)]
+        # pull a deeper window when filtering, so the filter selects from
+        # history rather than from an already-capped tail
+        raw = dataset.audit.recent(n if user is None and op is None
+                                   else 10_000)
+        events = [json.loads(e.to_json()) for e in raw]
+        if user is not None:
+            events = [e for e in events if e.get("user") == user]
+            # slow traces carry no user — join through the trace_id the
+            # audit event and the trace share, so a filtered view never
+            # leaks another tenant's slow query trees
+            user_tids = {
+                e.get("hints", {}).get("trace_id") for e in events
+            } - {None}
+        if op is not None:
+            events = [e for e in events
+                      if e.get("hints", {}).get("op") == op]
+        events = events[-n:]
         sched = getattr(dataset, "serving", None)
         if sched is not None:
             users = sched.user_rollups()
+            if user is not None:
+                users = {u: r for u, r in users.items() if u == user}
             serving = sched.snapshot()
     degraded = [
         json.loads(e.to_json()) for e in audit_mod.degradations.recent(n)
     ]
+    slow = tracing.slow_traces(
+        10_000 if (op is not None or user is not None) else n
+    )
+    if op is not None:
+        # a slow trace's op is its root span's name
+        slow = [s for s in slow if s.get("tree", {}).get("name") == op]
+    if user is not None:
+        slow = [s for s in slow if s.get("trace_id") in (user_tids or ())]
     return {
         "queries": events,
         "degradations": degraded,
-        "slow_traces": tracing.slow_traces(n),
+        "slow_traces": slow[-n:],
         "users": users,
         "serving": serving,
     }
 
 
-def handle(path: str, dataset=None):
+def debug_devices() -> Dict[str, Any]:
+    """The /debug/devices payload: per-device utilization, pool slot
+    occupancy, the queue-wait vs device-time breakdown, and the SLO burn
+    summary (docs/OBSERVABILITY.md)."""
+    from geomesa_tpu import slo, utilization
+
+    out = utilization.snapshot()
+    out["slo"] = slo.monitor().status()
+    return out
+
+
+def handle(path: str, dataset=None, accept: Optional[str] = None):
     """Route one GET path to (status, content_type, body-bytes), or None
     when the path is not an observability route (web.py falls through to
-    its own API routing)."""
+    its own API routing). ``accept`` is the request's Accept header:
+    a scraper negotiating ``application/openmetrics-text`` gets the
+    OpenMetrics exposition (with exemplars) from /metrics; everyone else
+    gets the classic exemplar-free text format."""
     parsed = urllib.parse.urlparse(path)
     q = {k: v[0] for k, v in urllib.parse.parse_qs(parsed.query).items()}
     route = parsed.path.rstrip("/") or "/"
     if route == "/metrics":
+        if accept and "application/openmetrics-text" in accept:
+            return (200, OPENMETRICS_CTYPE,
+                    metrics_text(openmetrics=True).encode())
         return 200, "text/plain; version=0.0.4", metrics_text().encode()
     if route == "/healthz":
         h = health()
@@ -174,12 +246,18 @@ def handle(path: str, dataset=None):
         return code, "application/json", json.dumps(h).encode()
     if route == "/debug/queries":
         try:
-            n = max(1, min(int(q.get("n", "50")), 1000))
+            n = max(1, min(int(q.get("n", "50")), 10_000))
         except ValueError:
             return (400, "application/json",
                     json.dumps({"error": "?n= must be an integer"}).encode())
-        body = json.dumps(debug_queries(dataset, n), default=str).encode()
+        body = json.dumps(
+            debug_queries(dataset, n, user=q.get("user"), op=q.get("op")),
+            default=str,
+        ).encode()
         return 200, "application/json", body
+    if route == "/debug/devices":
+        return (200, "application/json",
+                json.dumps(debug_devices(), default=str).encode())
     return None
 
 
@@ -191,7 +269,8 @@ class _ObsHandler(BaseHTTPRequestHandler):
 
     def do_GET(self):  # noqa: N802
         try:
-            out = handle(self.path, self.dataset)
+            out = handle(self.path, self.dataset,
+                         accept=self.headers.get("Accept"))
         except Exception as e:  # pragma: no cover - defensive
             out = (500, "application/json",
                    json.dumps({"error": f"{type(e).__name__}: {e}"}).encode())

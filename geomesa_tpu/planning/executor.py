@@ -18,7 +18,7 @@ from typing import Any, Dict, Optional
 
 import numpy as np
 
-from geomesa_tpu import config, metrics, tracing
+from geomesa_tpu import config, metrics, tracing, utilization
 from geomesa_tpu.index.store import FeatureStore, IndexTable
 from geomesa_tpu.kernels import density as kdensity
 from geomesa_tpu.kernels import knn as kknn
@@ -563,9 +563,10 @@ class Executor:
                 wcache.clear()
             wcache[wkey] = win
         metrics.inc(metrics.EXEC_DEVICE_DISPATCH)
-        return go(
-            {k: dev_cols[k] for k in sorted(names)}, *win, tuple(extra)
-        )
+        with utilization.device_busy(self._devkey() or 0):
+            return go(
+                {k: dev_cols[k] for k in sorted(names)}, *win, tuple(extra)
+            )
 
     def _resolve_cache(self, plan: QueryPlan, key):
         """Window-resolution cache host: store-level keyed by the plan's
@@ -727,7 +728,8 @@ class Executor:
                 wcache.clear()
             wcache[wkey] = win
         with tracing.span("scan.kernel", compact=True,
-                          site=str(cache_key[0]) if cache_key else None):
+                          site=str(cache_key[0]) if cache_key else None), \
+                utilization.device_busy(self._devkey() or 0):
             metrics.inc(metrics.EXEC_DEVICE_DISPATCH)
             return go(cols, win[0], win[1], tuple(extra))
 
@@ -1094,9 +1096,13 @@ class Executor:
         # pallas_call has no GSPMD partitioning rule)
         with pk.sharded_execution(self.mesh), \
                 tracing.span("scan.kernel",
-                             site=str(cache_key[0]) if cache_key else None):
+                             site=str(cache_key[0]) if cache_key else None), \
+                utilization.device_busy(self._devkey() or 0):
             # one observable unit of device work (the serving bench's
-            # fusion-actually-fused gate counts these; docs/SERVING.md)
+            # fusion-actually-fused gate counts these; docs/SERVING.md).
+            # The busy interval covers dispatch (async backends may still
+            # be executing past it) and feeds the device.busy.<id> gauge
+            # plus the per-query device_ms cost attribution.
             metrics.inc(metrics.EXEC_DEVICE_DISPATCH)
             return go(dev_cols, d_starts, d_ends, d_counts, tuple(extra))
 
@@ -1202,12 +1208,13 @@ class Executor:
             )
             cache.put(key, fn)
         metrics.inc(metrics.EXEC_DEVICE_DISPATCH)
-        return fn(
-            {k: dev_cols[k] for k in sorted(dev_cols)},
-            jax.device_put(starts.astype(np.int32), win_sh),
-            jax.device_put(ends.astype(np.int32), win_sh),
-            jax.device_put(setup["counts"].astype(np.int32), cnt_sh),
-        )
+        with utilization.device_busy(self._devkey() or 0):
+            return fn(
+                {k: dev_cols[k] for k in sorted(dev_cols)},
+                jax.device_put(starts.astype(np.int32), win_sh),
+                jax.device_put(ends.astype(np.int32), win_sh),
+                jax.device_put(setup["counts"].astype(np.int32), cnt_sh),
+            )
 
     def _cached_density_schedule(self, setup, bbox, width, height,
                                  cache_name, key_extras, build, device_keys):

@@ -139,7 +139,12 @@ class PartitionedExecutor:
             return
         t = child.tables.get(plan.index_name)
         if t is not None and t.n:
-            t.stage_host(names)
+            staged = t.stage_host(names)
+            if staged:
+                # per-query cost ledger: host bytes assembled for upload.
+                # The prefetch worker adopted the query's span context, so
+                # this lands on the right trace (docs/OBSERVABILITY.md)
+                tracing.add_cost("bytes_staged", float(staged))
             metrics.inc(metrics.PIPELINE_PREFETCH)
 
     def _children(self, plan: QueryPlan):
@@ -187,6 +192,12 @@ class PartitionedExecutor:
         have sequentially; config overrides and the span context cross
         the thread boundary via snapshot/adopt (staged (name, L) keys
         and trace nesting must match the query thread exactly)."""
+        # cost ledger: partition pruning effectiveness for this scan
+        # (pruned = bins the plan's time bounds excluded outright)
+        total_bins = len(self.store.partition_bins())
+        tracing.add_cost("partitions_scanned", float(len(bins)))
+        tracing.add_cost("partitions_pruned",
+                         float(max(total_bins - len(bins), 0)))
         if len(bins) < 2 or not config.PIPELINE_PREFETCH.to_bool():
             for i, b in enumerate(bins):
                 yield i, b, self.store.child(b)

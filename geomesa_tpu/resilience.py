@@ -629,9 +629,11 @@ def record_skip(source: str, part: str, error: BaseException,
     st = _collectors()
     if st:
         st[-1].add(rec)
-    from geomesa_tpu import audit
+    from geomesa_tpu import audit, tracing
 
     audit.record_degradation(rec)
+    # a degraded query is an always-keep class for trace tail sampling
+    tracing.mark_degraded()
     return rec
 
 

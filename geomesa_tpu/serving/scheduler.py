@@ -71,7 +71,7 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from geomesa_tpu import config, metrics
+from geomesa_tpu import config, metrics, tracing, utilization
 from geomesa_tpu.resilience import (
     AdmissionRejectedError, Deadline, DeadlineShedError, current_deadline,
     deadline_scope,
@@ -149,7 +149,8 @@ class _UserLedger:
     policy AND the /debug/queries rollup — a single source of truth."""
 
     __slots__ = ("submitted", "completed", "shed", "rejected", "errors",
-                 "fused", "service_s", "wait_s", "last_ts", "weight")
+                 "fused", "service_s", "wait_s", "last_ts", "weight",
+                 "cost")
 
     def __init__(self):
         self.submitted = 0
@@ -166,6 +167,18 @@ class _UserLedger:
         #: picks under its own ambient config, so resolving there would
         #: make caller-scoped overrides silently dead
         self.weight = 1.0
+        #: accumulated per-query cost ledger (docs/OBSERVABILITY.md):
+        #: device_ms.<id>, partitions_scanned/pruned, bytes_staged,
+        #: cache_hits, recompiles — summed from each completed op's trace
+        #: cost, so "what did this user's queries cost in device time?"
+        #: reads straight off the /debug/queries rollup
+        self.cost: Dict[str, float] = {}
+
+    def add_cost(self, cost: Optional[Dict[str, float]]) -> None:
+        if not cost:
+            return
+        for k, v in cost.items():
+            self.cost[k] = self.cost.get(k, 0.0) + v
 
     def to_dict(self) -> Dict[str, Any]:
         return {
@@ -182,6 +195,7 @@ class _UserLedger:
             ) if self.completed else 0.0,
             "last_ts": self.last_ts,
             "weight": self.weight,
+            "cost": {k: round(v, 4) for k, v in sorted(self.cost.items())},
         }
 
 
@@ -325,15 +339,28 @@ class QueryScheduler:
         return led
 
     def _note_service(self, user: str, op: str, seconds: float,
-                      ewma: bool = True) -> None:
+                      ewma: bool = True,
+                      cost: Optional[Dict[str, float]] = None) -> None:
         with self._cv:
             led = self._led(user)
             led.completed += 1
             led.service_s += seconds
             led.last_ts = time.time()
+            led.add_cost(cost)
             if ewma:
                 self._ewma_update_locked(seconds)
         metrics.inc(metrics.SERVING_COMPLETED)
+
+    @staticmethod
+    def _take_cost() -> Optional[Dict[str, float]]:
+        """The just-finished op's trace cost, read from THIS thread's
+        completed-trace slot (the op's root trace closed inside the
+        dispatched fn). None when the op didn't trace."""
+        tr = tracing.pop_thread_trace()
+        if tr is None:
+            return None
+        with tr.lock:
+            return dict(tr.cost) or None
 
     def _ewma_update_locked(self, seconds: float) -> None:
         """One admission-estimate sample (call under self._cv).
@@ -562,8 +589,11 @@ class QueryScheduler:
                     self._inline_users.pop(user, None)
             # failures stay out of the EWMA here too (the _execute_one
             # rule): fast-failing local ops must not deflate the queue
-            # path's admission estimate on a shared scheduler
-            self._note_service(user, op, time.perf_counter() - t0, ewma=ok)
+            # path's admission estimate on a shared scheduler. The op's
+            # root trace is still OPEN here (admit nests inside it), so
+            # its cost ledger reads via the active-trace accessor.
+            self._note_service(user, op, time.perf_counter() - t0, ewma=ok,
+                               cost=tracing.current_cost() or None)
 
     # -- dispatch ----------------------------------------------------------
     def start(self) -> "QueryScheduler":
@@ -699,7 +729,11 @@ class QueryScheduler:
                         metrics.inc(
                             f"{metrics.SERVING_EXECUTOR_DISPATCH}.{slot}"
                         )
-                        self._execute_group(group)
+                        # slot occupancy (docs/OBSERVABILITY.md): the
+                        # serving.slot.occupancy.<slot> gauge reads these
+                        # busy intervals
+                        with utilization.slot_busy(slot):
+                            self._execute_group(group)
                 except Exception as e:
                     # a dispatcher must survive anything a single dispatch
                     # can throw (per-ticket errors land on futures in
@@ -849,6 +883,7 @@ class QueryScheduler:
                 # chunk tickets would collapse the queue-wait p99 exactly
                 # when a stream is holding real queries back
                 wait_hist.observe(t.wait_s)
+                utilization.record_wait(t.wait_s)
                 with self._cv:
                     self._led(t.user).wait_s += t.wait_s
             # shed-before-work: a deadline that lapsed while queued is a
@@ -879,6 +914,7 @@ class QueryScheduler:
         self._tls.user = head.user
         prev_ov = config.snapshot_overrides()
         config.adopt_overrides(head.overrides)
+        tracing.pop_thread_trace()  # clear a previous ticket's residue
         try:
             results = head.fuse.batch(group)
         except BaseException as e:
@@ -920,10 +956,19 @@ class QueryScheduler:
         # /debug/queries rollups always agree
         metrics.inc(metrics.SERVING_FUSED, len(group))
         share = elapsed / len(group)
+        # the batch ran under ONE trace (the primary's): its cost ledger
+        # splits evenly across members, matching the service-time share —
+        # a fused member costs 1/N of the device pass it rode
+        batch_cost = self._take_cost()
+        cost_share = (
+            {k: v / len(group) for k, v in batch_cost.items()}
+            if batch_cost else None
+        )
         for t, r in zip(group, results):
             with self._cv:
                 self._led(t.user).fused += 1
-            self._note_service(t.user, t.op, share, ewma=False)
+            self._note_service(t.user, t.op, share, ewma=False,
+                               cost=cost_share)
             if isinstance(r, FusedMemberError):
                 t.future.set_exception(r.error)
             else:
@@ -941,6 +986,7 @@ class QueryScheduler:
         self._tls.user = t.user
         prev_ov = config.snapshot_overrides()
         config.adopt_overrides(t.overrides)
+        tracing.pop_thread_trace()  # clear a previous ticket's residue
         try:
             out = t.fn()
         except BaseException as e:  # noqa: B036 — relayed to the caller
@@ -950,7 +996,7 @@ class QueryScheduler:
             # queries would deflate the admission wait estimate exactly
             # when the queue is contended
             self._note_service(t.user, t.op, time.perf_counter() - t0,
-                               ewma=False)
+                               ewma=False, cost=self._take_cost())
             t.future.set_exception(e)
             return
         finally:
@@ -959,5 +1005,6 @@ class QueryScheduler:
             self._tls.wait_ms = 0.0
             self._tls.user = None
         self._note_service(t.user, t.op, time.perf_counter() - t0,
-                           ewma=not t.continuation)
+                           ewma=not t.continuation,
+                           cost=self._take_cost())
         t.future.set_result(out)

@@ -1,0 +1,183 @@
+"""SLO burn-rate monitor over the trace stage histograms
+(docs/OBSERVABILITY.md).
+
+An operator declares per-op p99 latency targets — ``geomesa.slo.<op>.p99.ms``
+(thread-local override or ``GEOMESA_SLO_<OP>_P99_MS``), where ``<op>`` is a
+root-span name the tracing layer already histograms (``count``,
+``density``, ``density_curve``, ``query``, ...). This module turns those
+targets plus the existing ``trace.<op>`` histograms into the standard
+multi-window burn-rate signal:
+
+* **bad fraction** over a window = observations above the target bucket /
+  total observations in that window (windowed by differencing timestamped
+  histogram snapshots — the histograms themselves are cumulative);
+* **burn rate** = bad fraction / error budget, where a p99 target implies
+  a 1% budget — burn 1.0 means "exactly on budget", 14.4 means "a month's
+  budget gone in ~2 days";
+* **dual windows**: the fast window (``geomesa.slo.window.fast.s``, 5 min)
+  pages — /healthz reports ``degraded`` while it burns past
+  ``geomesa.slo.burn.threshold`` — and the slow window
+  (``geomesa.slo.window.slow.s``, 1 h) confirms a sustained burn vs a
+  blip. Both ride the ``slo.burn.<op>`` gauges and /debug/devices.
+
+Observations land in the histograms at the *bucket* granularity the
+exposition already commits to, so "above target" snaps the target to the
+smallest bucket bound >= target — the same answer a PromQL burn query
+over the exported buckets would compute.
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+from geomesa_tpu import config, metrics
+
+#: error budget implied by a p99 target: 1% of requests may exceed it
+P99_BUDGET = 0.01
+
+#: injectable clock (tests drive window arithmetic deterministically)
+_clock = time.monotonic
+
+
+def _over_count(hist: metrics.Histogram, target_ms: float) -> "tuple":
+    """(total, over-target) observation counts from one histogram, with
+    the target snapped UP to a bucket bound (bucket granularity is all the
+    fixed-bucket histogram can answer; observations in the target's own
+    bucket count as within-SLO, matching the cumulative le= semantics)."""
+    snap = hist.snapshot()
+    total = snap["count"]
+    target_s = target_ms / 1e3
+    buckets = snap["buckets"]
+    i = bisect.bisect_left(buckets, target_s)
+    good = sum(snap["counts"][: i + 1])  # le= the snapped bound (+Inf ok)
+    return total, max(total - good, 0)
+
+
+class SloMonitor:
+    """Timestamped snapshot ring per op; burn rates by differencing the
+    newest snapshot against the oldest one inside each window."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: op -> deque[(t, total, over)]
+        self._snaps: Dict[str, "deque"] = {}
+        self._last_eval = 0.0
+
+    # -- sampling ----------------------------------------------------------
+    def evaluate(self, force: bool = False) -> None:
+        """Take one snapshot per targeted op (rate-limited to 1/s unless
+        forced — gauges and /healthz may poll much faster)."""
+        now = _clock()
+        targets = config.slo_targets()
+        with self._lock:
+            # a target with no snapshot yet (just declared) bypasses the
+            # rate limit: its first poll must see a burn, not a blank
+            fresh = any(op not in self._snaps for op in targets)
+            if not force and not fresh and now - self._last_eval < 1.0:
+                return
+            self._last_eval = now
+        reg = metrics.registry()
+        slow_s = config.SLO_WINDOW_SLOW_S.to_float() or 3600.0
+        for op, target_ms in targets.items():
+            hist = reg.histogram(f"trace.{op}")
+            total, over = _over_count(hist, target_ms)
+            with self._lock:
+                dq = self._snaps.setdefault(op, deque())
+                dq.append((now, total, over))
+                # retain one snapshot beyond the slow window so the oldest
+                # in-window diff always has a baseline
+                while len(dq) > 2 and dq[1][0] < now - slow_s:
+                    dq.popleft()
+            self._ensure_gauge(op)
+
+    _gauged: set = set()
+
+    def _ensure_gauge(self, op: str) -> None:
+        name = f"{metrics.SLO_BURN_PREFIX}.{op}"
+        if name in self._gauged:
+            return
+        with self._lock:
+            if name in self._gauged:
+                return
+            fast_s = config.SLO_WINDOW_FAST_S.to_float() or 300.0
+            metrics.registry().gauge(
+                name, lambda op=op, w=fast_s: self.burn(op, w),
+                replace=True,
+            )
+            self._gauged.add(name)
+
+    # -- burn arithmetic ---------------------------------------------------
+    def burn(self, op: str, window_s: float) -> float:
+        """Burn rate for ``op`` over the trailing ``window_s``: bad
+        fraction of the window's observations over the 1% p99 budget.
+        0.0 with no observations (an idle service burns nothing)."""
+        now = _clock()
+        with self._lock:
+            dq = self._snaps.get(op)
+            if not dq:
+                return 0.0
+            newest = dq[-1]
+            base = None
+            for t, total, over in dq:
+                if t >= now - window_s:
+                    break
+                base = (t, total, over)
+            if base is None:
+                # whole history inside the window: diff from zero
+                base = (0.0, 0, 0)
+        d_total = newest[1] - base[1]
+        d_over = newest[2] - base[2]
+        if d_total <= 0:
+            return 0.0
+        return (d_over / d_total) / P99_BUDGET
+
+    def status(self) -> Dict[str, Any]:
+        """Per-op burn summary for /healthz and /debug/devices:
+        ``{op: {target_ms, fast_burn, slow_burn, hot}}``. ``hot`` = the
+        fast window burns past geomesa.slo.burn.threshold (the /healthz
+        degradation trigger)."""
+        self.evaluate()
+        fast_s = config.SLO_WINDOW_FAST_S.to_float() or 300.0
+        slow_s = config.SLO_WINDOW_SLOW_S.to_float() or 3600.0
+        thresh = config.SLO_BURN_THRESHOLD.to_float() or 14.4
+        out: Dict[str, Any] = {}
+        for op, target_ms in config.slo_targets().items():
+            fast = self.burn(op, fast_s)
+            slow = self.burn(op, slow_s)
+            out[op] = {
+                "target_ms": target_ms,
+                "fast_burn": round(fast, 3),
+                "slow_burn": round(slow, 3),
+                "hot": fast > thresh,
+            }
+        return out
+
+    def hot_ops(self) -> Dict[str, Any]:
+        return {op: s for op, s in self.status().items() if s["hot"]}
+
+
+_monitor: Optional[SloMonitor] = None
+_lock = threading.Lock()
+
+
+def monitor() -> SloMonitor:
+    global _monitor
+    m = _monitor
+    if m is None:
+        with _lock:
+            m = _monitor
+            if m is None:
+                m = _monitor = SloMonitor()
+    return m
+
+
+def reset() -> None:
+    """Drop monitor state (test isolation)."""
+    global _monitor
+    with _lock:
+        _monitor = None
+    SloMonitor._gauged = set()

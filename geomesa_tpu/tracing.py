@@ -64,10 +64,19 @@ NOOP = _NoopSpan()
 
 
 class Trace:
-    """One query's span tree: id, root, and the bounded span budget."""
+    """One query's span tree: id, root, and the bounded span budget.
+
+    Carries the tail-sampling classification flags (``error``/``shed``/
+    ``degraded``/``recompiles`` — set as the query runs, read at
+    completion by tracing_export.py) and the per-query cost ledger
+    (``cost``: device ms per device, partitions, bytes staged, cache hits
+    — accumulated via :func:`add_cost`, rolled into the serving ledger and
+    explain's Cost section; docs/OBSERVABILITY.md)."""
 
     __slots__ = ("trace_id", "root", "max_spans", "n_spans", "dropped",
-                 "profiler", "lock", "finished", "slow_logged")
+                 "profiler", "lock", "finished", "slow_logged",
+                 "error", "shed", "degraded", "recompiles", "cost",
+                 "exported", "sample_counted")
 
     def __init__(self, trace_id: Optional[str] = None):
         self.trace_id = trace_id or uuid.uuid4().hex[:16]
@@ -80,6 +89,13 @@ class Trace:
         self.lock = threading.Lock()
         self.finished = False
         self.slow_logged = False
+        self.error: Optional[str] = None   # exception type name, if raised
+        self.shed = False                  # typed deadline shed
+        self.degraded = False              # partitions skipped (resilience)
+        self.recompiles = 0                # kernel.recompile events seen
+        self.cost: Dict[str, float] = {}   # per-query cost ledger
+        self.exported = False              # handed to the exporter once
+        self.sample_counted = False        # sampled-out counted once
 
     def admit(self) -> bool:
         """Reserve one span slot (False = budget exhausted, span dropped)."""
@@ -129,6 +145,21 @@ class Span:
         return self
 
     def __exit__(self, *exc):
+        if exc and exc[0] is not None and self.parent is None:
+            # tail-sampling classification: an OP that raised is an
+            # always-keep trace; a typed deadline shed is its own class.
+            # Root-only: an exception a child span propagates may be
+            # caught and recovered above (a skipped partition under
+            # allow_partial succeeds degraded) — only one that escapes
+            # the ROOT means the query actually failed.
+            self.trace.error = exc[0].__name__
+            try:
+                from geomesa_tpu.resilience import DeadlineShedError
+
+                if issubclass(exc[0], DeadlineShedError):
+                    self.trace.shed = True
+            except Exception:  # pragma: no cover — defensive
+                pass
         if self._token is not None:
             _current.reset(self._token)
             self._token = None
@@ -145,8 +176,11 @@ class Span:
         if self._annotation is not None:
             self._annotation.__exit__(None, None, None)
             self._annotation = None
-        # per-stage latency histogram: p50/p90/p99 derivable from /metrics
-        metrics.observe("trace." + self.name, self.duration_ms / 1e3)
+        # per-stage latency histogram: p50/p90/p99 derivable from /metrics.
+        # The trace id rides along as the bucket's exemplar, so an outlier
+        # bucket in the exposition links straight to its exported trace.
+        metrics.observe("trace." + self.name, self.duration_ms / 1e3,
+                        trace_id=self.trace.trace_id)
         # per-DEVICE attribution (docs/SCALE.md sharded scan): stages that
         # carry a ``device`` attr — partition staging/scans assigned to a
         # device by the sharded fan-out — additionally feed a
@@ -156,7 +190,8 @@ class Span:
         dev = self.attrs.get("device") if self.attrs else None
         if dev is not None and isinstance(dev, int):
             metrics.observe(
-                f"trace.{self.name}.device.{dev}", self.duration_ms / 1e3
+                f"trace.{self.name}.device.{dev}", self.duration_ms / 1e3,
+                trace_id=self.trace.trace_id,
             )
         if self.parent is None:
             _finish_trace(self.trace)
@@ -258,6 +293,12 @@ def event(name: str, **attrs) -> None:
     if cur is None:
         return
     trace = cur.trace
+    if name == "kernel.recompile":
+        # tail-sampling classification: a recompile-carrying trace is an
+        # always-keep class (the warm-path-broke evidence must survive
+        # sampling). Flagged here so export never has to walk the tree.
+        with trace.lock:
+            trace.recompiles += 1
     if not trace.admit():
         return
     child = Span(name, trace, cur, attrs or None)
@@ -289,6 +330,65 @@ def adopt(span_) -> None:
 
 
 # ---------------------------------------------------------------------------
+# per-query cost ledger + classification hooks (docs/OBSERVABILITY.md)
+# ---------------------------------------------------------------------------
+
+
+def add_cost(key: str, value: float) -> None:
+    """Accumulate one cost contribution (``device_ms.<id>``,
+    ``partitions_scanned``, ``bytes_staged``, ``cache_hits``, ...) into the
+    calling context's trace. No-op without an active trace — the cost
+    ledger is trace-scoped, so it shares tracing's off-by-default-cheap
+    contract. Contributors cross threads the way spans do (the prefetch
+    worker's adopted context routes its staging bytes here too)."""
+    cur = _current.get()
+    if cur is None:
+        return
+    tr = cur.trace
+    with tr.lock:
+        tr.cost[key] = tr.cost.get(key, 0.0) + value
+
+
+def current_cost() -> Dict[str, float]:
+    """Copy of the active trace's cost ledger (empty without a trace).
+    Folds the live recompile count in, so mid-trace readers (inline
+    serving admission, explain) see the same keys a finished trace
+    carries."""
+    cur = _current.get()
+    if cur is None:
+        return {}
+    tr = cur.trace
+    with tr.lock:
+        out = dict(tr.cost)
+    if tr.recompiles:
+        out.setdefault("recompiles", float(tr.recompiles))
+    return out
+
+
+def mark_degraded() -> None:
+    """Flag the active trace degraded (a partition was skipped under the
+    resilience contract) — an always-keep class for tail sampling. Called
+    by ``resilience.record_skip``."""
+    cur = _current.get()
+    if cur is not None:
+        cur.trace.degraded = True
+
+
+#: per-thread most recently completed trace — the serving scheduler reads
+#: (and clears) it around a dispatched ticket to attribute the ticket's
+#: cost ledger to its user without racing other slots on the process-global
+#: ``last_trace`` slot
+_tls = threading.local()
+
+
+def pop_thread_trace() -> Optional[Trace]:
+    """Return-and-clear THIS thread's most recently completed trace."""
+    tr = getattr(_tls, "last", None)
+    _tls.last = None
+    return tr
+
+
+# ---------------------------------------------------------------------------
 # slow-query log + recent-trace ring
 # ---------------------------------------------------------------------------
 
@@ -306,17 +406,27 @@ def last_trace() -> Optional[Trace]:
 def _finish_trace(trace: Trace) -> None:
     """Root closed: threshold-check against geomesa.trace.slow.ms and, when
     slow, record the full tree (ring + the audit JSONL appender, so file
-    ordering matches the query events around it)."""
+    ordering matches the query events around it); then hand the trace to
+    the exporter (tracing_export.py) when an export sink is configured —
+    the tail-sampling decision happens there, at completion."""
     root = trace.root
     if root is None:
         return
     trace.finished = True
     _last[0] = trace
+    _tls.last = trace
+    if trace.recompiles:
+        # fold the recompile count into the cost ledger, so the serving
+        # rollup and exported cost attributes carry it without a second
+        # accounting path
+        with trace.lock:
+            trace.cost["recompiles"] = float(trace.recompiles)
     try:
         thresh = config.TRACE_SLOW_MS.to_float()
     except (TypeError, ValueError):
         thresh = None
     if thresh is None or root.duration_ms < thresh or trace.slow_logged:
+        _offer_export(trace)
         return
     trace.slow_logged = True
     rec = {
@@ -334,6 +444,23 @@ def _finish_trace(trace: Trace) -> None:
 
     audit.append_record(rec)
     metrics.inc("trace.slow")
+    _offer_export(trace)
+
+
+def _offer_export(trace: Trace) -> None:
+    """Hand a completed trace to the exporter when a sink is configured.
+    Re-entrant safe: a late-finishing child re-runs _finish_trace, and a
+    trace sampled OUT on its first completion may be re-offered if it
+    became slow (an always-keep class) in the meantime — the exporter's
+    ``exported`` flag guarantees at-most-once enqueue."""
+    if trace.exported:
+        return
+    if not (config.TRACE_OTLP_ENDPOINT.get()
+            or config.TRACE_EXPORT_PATH.get()):
+        return
+    from geomesa_tpu import tracing_export
+
+    tracing_export.offer(trace)
 
 
 def slow_traces(n: int = 50) -> List[Dict[str, Any]]:

@@ -1,0 +1,194 @@
+"""Per-device utilization + executor-slot occupancy accounting
+(docs/OBSERVABILITY.md).
+
+"How busy is device 2?" is the question the many-core evaluations in
+PAPERS.md show scaled geospatial scans lose their headroom on — occupancy,
+not kernel speed. This module records busy-time intervals at the existing
+dispatch sites (the executor's device kernel dispatches, the sharded
+scan's per-device partition scans, the serving pool's per-slot ticket
+execution) and rolls them into:
+
+* ``device.busy.<id>`` gauges — busy fraction of each device over the
+  trailing ``geomesa.device.busy.window`` seconds;
+* ``serving.slot.occupancy.<slot>`` gauges — same, per pool slot;
+* the ``/debug/devices`` payload (obs.py): per-device/per-slot busy
+  seconds, fractions, and interval counts, plus the queue-wait vs
+  device-time breakdown (total seconds queries spent WAITING vs total
+  seconds devices spent WORKING — the saturation-vs-starvation signal).
+
+Recording is a perf_counter pair + one lock per interval at dispatch
+granularity (never per row), and :func:`device_busy` also feeds the
+per-query cost ledger (``tracing.add_cost("device_ms.<id>", …)``) so the
+same measurement backs fleet gauges AND per-user cost attribution — one
+source of truth, like the serving ledger.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from collections import deque
+from typing import Any, Dict
+
+from geomesa_tpu import config, metrics, tracing
+
+#: injectable clock (tests advance time deterministically)
+_clock = time.monotonic
+
+
+class _Usage:
+    """Busy intervals for one key: cumulative totals plus a trailing-window
+    deque of (end_time, duration) the busy-fraction gauge reads."""
+
+    __slots__ = ("busy_s", "count", "recent", "lock")
+
+    def __init__(self):
+        self.busy_s = 0.0
+        self.count = 0
+        self.recent: "deque" = deque()
+        self.lock = threading.Lock()
+
+    def add(self, seconds: float, now: float) -> None:
+        with self.lock:
+            self.busy_s += seconds
+            self.count += 1
+            self.recent.append((now, seconds))
+            self._trim(now)
+
+    def _trim(self, now: float) -> None:
+        win = _window_s()
+        while self.recent and self.recent[0][0] < now - win:
+            self.recent.popleft()
+
+    def fraction(self) -> float:
+        """Busy fraction over the trailing window: sum of interval
+        durations clipped to the window, over the window length. Clamped
+        to 1.0 (overlapping intervals from concurrent dispatch can sum
+        past the wall clock)."""
+        now = _clock()
+        win = _window_s()
+        with self.lock:
+            self._trim(now)
+            total = 0.0
+            for end, dur in self.recent:
+                start = end - dur
+                total += end - max(start, now - win)
+        return min(total / win, 1.0) if win > 0 else 0.0
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self.lock:
+            busy, n = self.busy_s, self.count
+        return {
+            "busy_s": round(busy, 6),
+            "busy_fraction": round(self.fraction(), 4),
+            "intervals": n,
+        }
+
+
+def _window_s() -> float:
+    try:
+        w = config.DEVICE_BUSY_WINDOW.to_float()
+    except (TypeError, ValueError):
+        w = None
+    return 60.0 if w is None or w <= 0 else w
+
+
+_lock = threading.Lock()
+_devices: Dict[int, _Usage] = {}
+_slots: Dict[int, _Usage] = {}
+_gauged = set()
+#: queue-wait half of the breakdown (seconds queries spent queued, fed by
+#: the serving scheduler at dispatch time)
+_wait = _Usage()
+
+
+def _usage(table: Dict[int, _Usage], key: int, gauge_name: str) -> _Usage:
+    u = table.get(key)
+    if u is None:
+        with _lock:
+            u = table.get(key)
+            if u is None:
+                u = table[key] = _Usage()
+    if gauge_name not in _gauged:
+        with _lock:
+            if gauge_name not in _gauged:
+                # one bound method per key backs the gauge; replace=True
+                # because reset() (tests, metrics.clear survivors) leaves
+                # a stale backing the fresh _Usage must take over from
+                metrics.registry().gauge(gauge_name, u.fraction,
+                                         replace=True)
+                _gauged.add(gauge_name)
+    return u
+
+
+def record_device(device_id: int, seconds: float) -> None:
+    """One device busy interval (a kernel dispatch / sharded partition
+    scan). Also attributes the time to the active trace's cost ledger."""
+    did = int(device_id)
+    _usage(_devices, did,
+           f"{metrics.DEVICE_BUSY_PREFIX}.{did}").add(seconds, _clock())
+    tracing.add_cost(f"device_ms.{did}", seconds * 1e3)
+
+
+def record_slot(slot: int, seconds: float) -> None:
+    """One serving-pool slot busy interval (a dispatched ticket group)."""
+    s = int(slot)
+    _usage(_slots, s,
+           f"{metrics.SLOT_OCCUPANCY_PREFIX}.{s}").add(seconds, _clock())
+
+
+def record_wait(seconds: float) -> None:
+    """One query's queue wait (the other half of the wait-vs-work
+    breakdown in /debug/devices)."""
+    _wait.add(seconds, _clock())
+
+
+@contextlib.contextmanager
+def device_busy(device_id: int):
+    """Time one device dispatch as a busy interval."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_device(device_id, time.perf_counter() - t0)
+
+
+@contextlib.contextmanager
+def slot_busy(slot: int):
+    """Time one pool-slot dispatch as a busy interval."""
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        record_slot(slot, time.perf_counter() - t0)
+
+
+def snapshot() -> Dict[str, Any]:
+    """The /debug/devices payload: per-device and per-slot usage plus the
+    queue-wait vs device-time breakdown."""
+    with _lock:
+        devs = dict(_devices)
+        slots = dict(_slots)
+    device_busy_s = sum(u.busy_s for u in devs.values())
+    return {
+        "window_s": _window_s(),
+        "devices": {str(k): u.snapshot() for k, u in sorted(devs.items())},
+        "slots": {str(k): u.snapshot() for k, u in sorted(slots.items())},
+        "breakdown": {
+            "queue_wait_s": round(_wait.busy_s, 6),
+            "device_time_s": round(device_busy_s, 6),
+            "waits": _wait.count,
+        },
+    }
+
+
+def reset() -> None:
+    """Drop all usage state (test isolation). Gauges registered against
+    previous _Usage objects are re-pointed on next use via replace."""
+    global _wait
+    with _lock:
+        _devices.clear()
+        _slots.clear()
+        _gauged.clear()
+        _wait = _Usage()

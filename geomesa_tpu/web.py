@@ -21,8 +21,10 @@ Observability surface (obs.py; docs/OBSERVABILITY.md — the same routes the
 standalone obs server exposes, mounted here so one port serves both):
 
     GET /metrics        -> prometheus text (histograms included)
-    GET /healthz        -> breaker/quarantine/device health JSON
+    GET /healthz        -> breaker/quarantine/device/SLO health JSON
     GET /debug/queries  -> recent audits + degradations + slow traces
+                           (?n=/?user=/?op= filters)
+    GET /debug/devices  -> device utilization + slot occupancy + SLO burn
 
 Write surface (the JVM DataStore's zero-dependency transport; the
 reference's DataStore mutates through the same catalog the servlets read):
@@ -84,8 +86,9 @@ class _Handler(BaseHTTPRequestHandler):
         from geomesa_tpu import obs
 
         ds = self.dataset
-        out = obs.handle(self.path, ds)
-        if out is not None:  # /metrics, /healthz, /debug/queries
+        out = obs.handle(self.path, ds,
+                         accept=self.headers.get("Accept"))
+        if out is not None:  # /metrics, /healthz, /debug/*
             code, ctype, body = out
             return self._send(body, code, content_type=ctype)
         parsed = urllib.parse.urlparse(self.path)

@@ -1,0 +1,370 @@
+"""Trace export + tail-based sampling tests (docs/OBSERVABILITY.md,
+tracing_export.py).
+
+The contract under test:
+
+* finished traces stream to the configured sinks as OTLP-shaped JSON span
+  batches (traceId/spanId/parent links, unix-nano times, attributes);
+* the sampling decision happens at COMPLETION: slow, errored, degraded,
+  shed, and recompile-carrying traces are ALWAYS kept; healthy traces keep
+  at the seeded-deterministic geomesa.trace.sample.rate;
+* the exporter NEVER blocks the query path: a wedged/failing sink plus a
+  full bounded queue drops traces and counts them in trace.export.dropped
+  while queries proceed at full speed;
+* sink failures ride the resilience layer: retried per RetryPolicy,
+  fenced by a named circuit breaker, driven deterministically through the
+  geomesa.fault.injection registry.
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import (
+    GeoDataset, config, metrics, resilience, tracing, tracing_export,
+)
+from geomesa_tpu.filter.ecql import parse_iso_ms
+
+BBOX = "BBOX(geom, -100, 30, -80, 45)"
+
+
+def _mk_ds(n=4000, partitioned=False, seed=5):
+    spec = "name:String,weight:Float,dtg:Date,*geom:Point"
+    if partitioned:
+        spec += ";geomesa.partition='time'"
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", spec)
+    rng = np.random.default_rng(seed)
+    lo, hi = parse_iso_ms("2020-01-01"), parse_iso_ms("2020-03-01")
+    ds.insert("t", {
+        "name": rng.choice(["a", "b"], n),
+        "weight": rng.uniform(0, 1, n).astype(np.float32),
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+        "dtg": rng.integers(lo, hi, n).astype("datetime64[ms]"),
+    }, fids=np.arange(n).astype(str))
+    ds.flush("t")
+    return ds
+
+
+@pytest.fixture(autouse=True)
+def _isolated_exporter():
+    tracing_export.reset()
+    resilience.reset_breakers()
+    yield
+    tracing_export.reset()
+    resilience.reset_breakers()
+
+
+def _ctr(name):
+    return metrics.registry().counter(name).value
+
+
+def _batches(path):
+    return [json.loads(ln) for ln in open(path).read().splitlines()]
+
+
+def _spans(batch):
+    return batch["resourceSpans"][0]["scopeSpans"][0]["spans"]
+
+
+def _mk_trace(name="count", trace_id=None, children=("plan",)):
+    """A synthetic finished trace (no dataset machinery)."""
+    with config.TRACE_ENABLED.scoped("true"):
+        root = tracing.start(name, trace_id=trace_id, schema="t")
+        with root:
+            for c in children:
+                with tracing.span(c):
+                    pass
+        return root.trace
+
+
+# ---------------------------------------------------------------------------
+# OTLP shape + file sink
+# ---------------------------------------------------------------------------
+
+
+def test_query_exports_otlp_batch_to_file_sink(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    ds = _mk_ds()
+    with config.TRACE_ENABLED.scoped("true"), \
+            config.TRACE_EXPORT_PATH.scoped(str(path)):
+        n = ds.count("t", BBOX)
+        tracing_export.flush()
+    assert n > 0
+    batches = _batches(path)
+    assert batches
+    spans = _spans(batches[0])
+    assert spans[0]["name"] == "count"
+    root_id = spans[0]["spanId"]
+    tid = spans[0]["traceId"]
+    assert len(tid) == 32
+    # every span carries the OTLP essentials and shares the trace id
+    for s in spans:
+        assert len(s["spanId"]) == 16
+        assert s["traceId"] == tid
+        assert int(s["endTimeUnixNano"]) >= int(s["startTimeUnixNano"])
+    # children link to the root
+    kids = [s for s in spans if s.get("parentSpanId") == root_id]
+    assert kids, spans
+    names = {s["name"] for s in spans}
+    assert "plan" in names
+
+
+def test_root_span_carries_cost_and_keep_attrs(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    ds = _mk_ds()
+    with config.TRACE_ENABLED.scoped("true"), \
+            config.TRACE_EXPORT_PATH.scoped(str(path)):
+        ds.count("t", BBOX)
+        tracing_export.flush()
+    root = _spans(_batches(path)[0])[0]
+    attrs = {a["key"]: a["value"] for a in root["attributes"]}
+    assert "geomesa.keep" in attrs
+    # the device kernel dispatch attributed its time to the cost ledger
+    assert any(k.startswith("geomesa.cost.device_ms") for k in attrs), attrs
+
+
+# ---------------------------------------------------------------------------
+# tail sampling: always-keep classes + seeded determinism
+# ---------------------------------------------------------------------------
+
+
+def test_always_keep_classes_ignore_sample_rate(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    with config.TRACE_EXPORT_PATH.scoped(str(path)), \
+            config.TRACE_SAMPLE_RATE.scoped("0.0"):
+        # healthy -> sampled out at rate 0
+        healthy = _mk_trace("count")
+        assert not healthy.exported
+        # slow
+        with config.TRACE_SLOW_MS.scoped("0"):
+            slow = _mk_trace("count")
+        assert slow.exported
+        # errored
+        err = _mk_trace("count")
+        err.error = "ValueError"
+        err.exported = False
+        assert tracing_export.offer(err)
+        # degraded
+        deg = _mk_trace("count")
+        deg.degraded = True
+        deg.exported = False
+        assert tracing_export.offer(deg)
+        # shed
+        shed = _mk_trace("count")
+        shed.shed = True
+        shed.exported = False
+        assert tracing_export.offer(shed)
+        # recompile-carrying
+        rec = _mk_trace("count")
+        rec.recompiles = 2
+        rec.exported = False
+        assert tracing_export.offer(rec)
+        tracing_export.flush()
+    reasons = []
+    for b in _batches(path):
+        for s in _spans(b):
+            for a in s.get("attributes", []):
+                if a["key"] == "geomesa.keep":
+                    reasons.append(a["value"]["stringValue"])
+    assert set(reasons) >= {"slow", "error", "degraded", "shed",
+                            "recompile"}
+
+
+def test_shed_and_error_flags_set_by_span_exit():
+    from geomesa_tpu.resilience import DeadlineShedError
+
+    with config.TRACE_ENABLED.scoped("true"):
+        root = tracing.start("count", schema="t")
+        with pytest.raises(DeadlineShedError):
+            with root:
+                raise DeadlineShedError("budget gone")
+        assert root.trace.shed
+        assert root.trace.error == "DeadlineShedError"
+
+        root2 = tracing.start("count", schema="t")
+        with pytest.raises(ValueError):
+            with root2:
+                raise ValueError("boom")
+        assert root2.trace.error == "ValueError"
+        assert not root2.trace.shed
+
+
+def test_degraded_partition_marks_trace(tmp_path):
+    ds = _mk_ds(20_000, partitioned=True)
+    path = tmp_path / "spans.jsonl"
+    with config.TRACE_ENABLED.scoped("true"), \
+            config.TRACE_EXPORT_PATH.scoped(str(path)), \
+            config.TRACE_SAMPLE_RATE.scoped("0.0"), \
+            config.FAULT_INJECTION.scoped("true"), \
+            resilience.allow_partial():
+        with resilience.inject_faults(seed=7) as inj:
+            inj.fail("exec.partition.scan", times=1)
+            n = ds.count("t", BBOX)
+    assert n > 0
+    tr = tracing.last_trace()
+    assert tr.degraded
+    # degraded is an always-keep class even at rate 0
+    assert tr.exported
+
+
+def test_seeded_sampling_is_deterministic():
+    ids = [f"{i:016x}" for i in range(200)]
+    with config.TRACE_SAMPLE_RATE.scoped("0.3"), \
+            config.TRACE_SAMPLE_SEED.scoped("42"):
+        kept_a = {i for i in ids if tracing_export.sampled_in(i)}
+        kept_b = {i for i in ids if tracing_export.sampled_in(i)}
+    assert kept_a == kept_b  # same seed -> identical keep set
+    assert 0 < len(kept_a) < len(ids)  # rate actually bites
+    with config.TRACE_SAMPLE_RATE.scoped("0.3"), \
+            config.TRACE_SAMPLE_SEED.scoped("43"):
+        kept_c = {i for i in ids if tracing_export.sampled_in(i)}
+    assert kept_c != kept_a  # a different seed picks a different set
+    with config.TRACE_SAMPLE_RATE.scoped("1.0"):
+        assert all(tracing_export.sampled_in(i) for i in ids)
+    with config.TRACE_SAMPLE_RATE.scoped("0.0"):
+        assert not any(tracing_export.sampled_in(i) for i in ids)
+
+
+def test_sampled_out_traces_counted(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    before = _ctr(metrics.TRACE_EXPORT_SAMPLED)
+    with config.TRACE_EXPORT_PATH.scoped(str(path)), \
+            config.TRACE_SAMPLE_RATE.scoped("0.0"):
+        for _ in range(5):
+            _mk_trace("count")
+    assert _ctr(metrics.TRACE_EXPORT_SAMPLED) - before == 5
+    assert not path.exists()
+
+
+# ---------------------------------------------------------------------------
+# non-blocking contract: wedged sink -> drops counted, queries unharmed
+# ---------------------------------------------------------------------------
+
+
+def test_flusher_drains_bursts_larger_than_one_batch(tmp_path):
+    """A burst beyond geomesa.trace.export.batch (64) must fully drain on
+    the background flusher without waiting for the next offer."""
+    path = tmp_path / "spans.jsonl"
+    with config.TRACE_EXPORT_PATH.scoped(str(path)):
+        for i in range(70):
+            _mk_trace("count", trace_id=f"{i:016x}")
+        ex = tracing_export.exporter()
+        for _ in range(400):
+            if not ex._buf:
+                break
+            time.sleep(0.01)
+        assert not ex._buf, f"{len(ex._buf)} traces stranded in the buffer"
+        # give the in-flight write (dequeued, mid-sink) a moment to land
+        ex.flush()
+    batches = _batches(path)
+    assert len(batches) >= 2  # 70 traces > one 64-trace batch
+    roots = [s for b in batches for s in _spans(b)
+             if "parentSpanId" not in s]
+    assert len(roots) == 70
+
+
+def _sync_exporter():
+    """Install a flusher-less exporter: flush() is the only drain, so the
+    sink path runs on the CALLING thread where scoped config (retry
+    attempts, breaker threshold) is visible — deterministic chaos tests."""
+    tracing_export.reset()
+    tracing_export._exporter = tracing_export.TraceExporter(autoflush=False)
+    return tracing_export._exporter
+
+
+def test_wedged_sink_drops_overflow_and_never_blocks(tmp_path):
+    path = tmp_path / "spans.jsonl"
+    drop0 = _ctr(metrics.TRACE_EXPORT_DROPPED)
+    with config.TRACE_EXPORT_PATH.scoped(str(path)), \
+            config.TRACE_EXPORT_QUEUE.scoped("2"), \
+            config.FAULT_INJECTION.scoped("true"):
+        with resilience.inject_faults(seed=3) as inj:
+            # every sink write stalls then fails: wedge the REAL
+            # background flusher on one trace first...
+            inj.fail(tracing_export.SINK_FAULT_POINT, times=None,
+                     delay_s=0.2)
+            _mk_trace("count")
+            for _ in range(200):
+                if inj.fired:
+                    break
+                time.sleep(0.005)
+            assert inj.fired, "flusher never reached the wedged sink"
+            # ...then hammer offers while it is stuck inside the write:
+            # the 2-deep queue fills, the rest drop instantly
+            t0 = time.perf_counter()
+            for _ in range(12):
+                _mk_trace("count")
+            offered_s = time.perf_counter() - t0
+            # the query/offer path never waits on the sink: 12 traces
+            # offered in far less time than ONE wedged sink write
+            assert offered_s < 0.2, f"offer path blocked ({offered_s:.3f}s)"
+            dropped = _ctr(metrics.TRACE_EXPORT_DROPPED) - drop0
+            assert dropped >= 8, f"expected overflow drops, got {dropped}"
+        tracing_export.reset()  # discard the wedged queue
+
+
+def test_sink_failures_retry_then_succeed(tmp_path):
+    _sync_exporter()
+    path = tmp_path / "spans.jsonl"
+    fail0 = _ctr(metrics.TRACE_EXPORT_FAILED)
+    with config.TRACE_EXPORT_PATH.scoped(str(path)), \
+            config.RETRY_BASE_MS.scoped("1"), \
+            config.FAULT_INJECTION.scoped("true"):
+        with resilience.inject_faults(seed=3) as inj:
+            # two injected failures < the default 3 attempts: the batch
+            # must land after retries with nothing counted failed
+            inj.fail(tracing_export.SINK_FAULT_POINT, times=2)
+            _mk_trace("count")
+            tracing_export.flush()
+            assert len(inj.fired) == 2
+    assert _ctr(metrics.TRACE_EXPORT_FAILED) == fail0
+    assert _batches(path), "batch lost despite retry budget"
+
+
+def test_sink_breaker_opens_after_repeated_failures(tmp_path):
+    _sync_exporter()
+    path = tmp_path / "spans.jsonl"
+    fail0 = _ctr(metrics.TRACE_EXPORT_FAILED)
+    with config.TRACE_EXPORT_PATH.scoped(str(path)), \
+            config.RETRY_ATTEMPTS.scoped("1"), \
+            config.RETRY_BASE_MS.scoped("1"), \
+            config.BREAKER_THRESHOLD.scoped("2"), \
+            config.FAULT_INJECTION.scoped("true"):
+        with resilience.inject_faults(seed=3) as inj:
+            inj.fail(tracing_export.SINK_FAULT_POINT, times=None)
+            for _ in range(4):
+                _mk_trace("count")
+                tracing_export.flush()
+    assert resilience.breaker("trace.export.file").state == "open"
+    failed = _ctr(metrics.TRACE_EXPORT_FAILED) - fail0
+    assert failed == 4
+    # once open, the sink is fenced: the injector's hit count stops
+    # growing (failures 3 and 4 never reached the fault point)
+    assert len(inj.fired) == 2, inj.fired
+
+
+def test_late_slow_trace_still_exported(tmp_path):
+    """A streamed trace sampled OUT at first completion becomes slow when
+    a late child stretches the root — it must then export (always-keep)."""
+    path = tmp_path / "spans.jsonl"
+    with config.TRACE_ENABLED.scoped("true"), \
+            config.TRACE_EXPORT_PATH.scoped(str(path)), \
+            config.TRACE_SAMPLE_RATE.scoped("0.0"), \
+            config.TRACE_SLOW_MS.scoped("5"):
+        root = tracing.start("sidecar.do_get")
+        with root:
+            child = tracing.span("query_batches")
+            child.t0 = time.perf_counter()
+        assert not root.trace.exported  # fast + rate 0 -> sampled out
+        time.sleep(0.02)
+        child.finish()  # stretches the root past the slow threshold
+        assert root.trace.exported
+        tracing_export.flush()
+    reasons = [a["value"]["stringValue"]
+               for b in _batches(path) for s in _spans(b)
+               for a in s.get("attributes", []) if a["key"] == "geomesa.keep"]
+    assert "slow" in reasons
