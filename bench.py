@@ -167,7 +167,136 @@ def _force_cpu(n_devices: int = 0) -> None:
             pass  # older jax: the XLA flag above provides the devices
 
 
+def run_chaos():
+    """``--chaos``: the CI chaos harness (docs/RESILIENCE.md §6) — a
+    seeded fault scenario on the forced 8-virtual-device CPU mesh over a
+    small partitioned dataset, gating the device-fault-tolerance
+    invariants: (1) a failed device's partitions reassign and the
+    recovered result is BIT-IDENTICAL to the healthy oracle; (2)
+    exhausted retries degrade typed with exact survivor totals; (3) a
+    killed pool dispatcher slot respawns within one scheduling round;
+    (4) nothing hangs (the watchdog would kill us). One JSON line, like
+    --smoke."""
+    _arm_watchdog()
+    _force_cpu(int(os.environ.get("GEOMESA_BENCH_DEVICES", 8)))
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    import jax
+
+    from geomesa_tpu import GeoDataset, config, metrics, resilience
+    from geomesa_tpu.filter.ecql import parse_iso_ms
+    from geomesa_tpu.parallel import health as phealth
+    from geomesa_tpu.resilience import InjectedFault, allow_partial, \
+        inject_faults
+
+    seed = int(os.environ.get("GEOMESA_BENCH_CHAOS_SEED", 42))
+    n = int(os.environ.get("GEOMESA_BENCH_N", 60_000))
+    rng = np.random.default_rng(seed)
+    lo = parse_iso_ms("2020-01-01")
+    hi = parse_iso_ms("2020-03-01")
+    ds = GeoDataset(n_shards=4)
+    ds.create_schema(
+        "chaos", "weight:Float,dtg:Date,*geom:Point;geomesa.partition='time'"
+    )
+    ds._store("chaos").max_resident = 1
+    t0 = time.time()
+    ds.insert("chaos", {
+        "geom__x": rng.uniform(-125, -66, n),
+        "geom__y": rng.uniform(24, 49, n),
+        "dtg": rng.integers(lo, hi, n).astype("datetime64[ms]"),
+        "weight": rng.uniform(0, 1, n).astype(np.float32),
+    })
+    ds.flush()
+    ingest_s = time.time() - t0
+    ecql = "BBOX(geom, -110, 28, -75, 48)"
+    bbox = (-125.0, 24.0, -66.0, 50.0)
+
+    def _ctr(name):
+        return metrics.registry().counter(name).value
+
+    # healthy oracle (single-device serial path — the bit-identity ref)
+    with config.MESH_DEVICES.scoped("off"):
+        c_ref = ds.count("chaos", ecql)
+        d_ref = ds.density("chaos", ecql, bbox=bbox, width=64, height=64)
+    hung = 0
+    t0 = time.time()
+    # (1) one of 8 devices fails every dispatch: reassign + bit-identity
+    reassigned0 = _ctr(metrics.SCAN_REASSIGNED)
+    with config.FAULT_INJECTION.scoped("true"), \
+            config.RETRY_BASE_MS.scoped("0"), inject_faults(seed=seed) as inj:
+        inj.fail("scan.device.dispatch", InjectedFault("dead lane"),
+                 times=None, where=lambda c: c.get("device") == 3)
+        c_chaos = ds.count("chaos", ecql)
+        d_chaos = ds.density("chaos", ecql, bbox=bbox, width=64, height=64)
+        lane_fired = len(inj.fired)
+    bit_identical = (c_chaos == c_ref) and bool(np.array_equal(d_chaos, d_ref))
+    assert bit_identical, (
+        f"chaos recovery NOT bit-identical: count {c_chaos} vs {c_ref}"
+    )
+    reassigned = _ctr(metrics.SCAN_REASSIGNED) - reassigned0
+    # (2) a partition failing on EVERY device: exact survivor totals
+    st = ds._store("chaos")
+    bins = sorted(st.part_counts)
+    dead = bins[len(bins) // 2]
+    total = ds.count("chaos", "INCLUDE")
+    with config.FAULT_INJECTION.scoped("true"), \
+            config.RETRY_BASE_MS.scoped("0"), inject_faults(seed=seed) as inj:
+        inj.fail("scan.device.dispatch", InjectedFault("bad partition"),
+                 times=None, where=lambda c: c.get("bin") == dead)
+        with allow_partial() as partial:
+            survivors = ds.count("chaos", "INCLUDE")
+    survivor_exact = survivors == total - st.part_counts[dead] \
+        and len(partial.skipped) == 1
+    assert survivor_exact, (survivors, total, st.part_counts[dead])
+    phealth.reset()
+    resilience.reset_breakers()
+    # (3) kill one pool dispatcher slot; the supervisor respawns it
+    died0 = _ctr(metrics.SERVING_SLOT_DIED)
+    resp0 = _ctr(metrics.SERVING_SLOT_RESPAWN)
+    with config.SERVING_EXECUTORS.scoped("2"), \
+            config.FAULT_INJECTION.scoped("true"), \
+            inject_faults(seed=seed) as inj:
+        inj.fail("serving.slot.loop", lambda: SystemExit("chaos kill"),
+                 times=1, where=lambda c: c.get("slot") == 1)
+        s = ds.serving.start()
+        try:
+            for _ in range(500):
+                if _ctr(metrics.SERVING_SLOT_DIED) > died0:
+                    break
+                time.sleep(0.01)
+            slot_died = _ctr(metrics.SERVING_SLOT_DIED) - died0
+            s.submit(lambda: ds.count("chaos", ecql),
+                     user="chaos", op="count").result(timeout=60)
+            pool_width = s.snapshot()["executors"]
+            respawns = _ctr(metrics.SERVING_SLOT_RESPAWN) - resp0
+        finally:
+            s.stop()
+    chaos_s = time.time() - t0
+    assert slot_died >= 1 and respawns >= 1 and pool_width == 2, (
+        slot_died, respawns, pool_width
+    )
+    print(json.dumps({
+        "metric": "chaos_suite",
+        "chaos": True,
+        "seed": seed,
+        "n_rows": n,
+        "n_devices": len(jax.devices()),
+        "ingest_s": round(ingest_s, 2),
+        "chaos_s": round(chaos_s, 2),
+        "hung_queries": hung,
+        "bit_identical_after_reassign": bit_identical,
+        "reassigned_partitions": int(reassigned),
+        "lane_faults_fired": int(lane_fired),
+        "survivor_totals_exact": survivor_exact,
+        "degraded_partitions": len(partial.skipped),
+        "slot_died": int(slot_died),
+        "slot_respawns": int(respawns),
+        "pool_width_after_respawn": int(pool_width),
+    }))
+
+
 def main():
+    if "--chaos" in sys.argv[1:]:
+        return run_chaos()
     smoke = "--smoke" in sys.argv[1:]
     n = int(os.environ.get("GEOMESA_BENCH_N", 200_000 if smoke else 20_000_000))
     iters = int(os.environ.get("GEOMESA_BENCH_ITERS", 2 if smoke else 10))

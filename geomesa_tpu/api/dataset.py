@@ -663,6 +663,18 @@ class GeoDataset:
         slot = self.serving.current_slot()
         key = st.ft.name if not slot else (st.ft.name, slot)
         ex = self._executors.get(key)
+        if ex is not None and slot and self.mesh is None \
+                and self.prefer_device:
+            # device-health re-pin (docs/RESILIENCE.md §6): the slot ->
+            # device mapping moves when a device is cordoned or its
+            # breaker opens (parallel/devices.slot_device skips fenced
+            # lanes), so a cached slot executor pinned to the OLD device
+            # is rebuilt on its next dispatch — the supervisor's
+            # "respawn on a healthy device" lands here
+            from geomesa_tpu.parallel.devices import slot_device
+
+            if getattr(ex, "device", None) is not slot_device(slot):
+                ex = None
         if ex is None or ex.store is not st:
             device = None
             if slot and self.mesh is None and self.prefer_device:

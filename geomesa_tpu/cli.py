@@ -452,11 +452,42 @@ def cmd_obs(args):
 
 
 def cmd_devices(args):
-    """Print the /debug/devices payload — per-device busy fractions, pool
-    slot occupancy, the queue-wait vs device-time breakdown, and the SLO
-    burn summary (docs/OBSERVABILITY.md). ``--url`` scrapes a running
-    obs/web endpoint; without it, this process's own counters (mostly
-    relevant under test)."""
+    """``devices`` prints the /debug/devices payload — per-device busy
+    fractions + HEALTH (ok/cordoned/broken, reassignment counts, last
+    failure), pool slot occupancy, the queue-wait vs device-time
+    breakdown, and the SLO burn summary (docs/OBSERVABILITY.md,
+    docs/RESILIENCE.md §6). ``devices cordon <id>`` / ``devices uncordon
+    <id>`` remove/re-admit a device from scheduling without a restart —
+    against a running sidecar with ``--host/--port`` (the
+    ``cordon-device`` action), or this process's registry otherwise.
+    ``--url`` scrapes a running obs/web endpoint's payload."""
+    if args.action:
+        if args.device is None:
+            print("devices cordon/uncordon needs a device id",
+                  file=sys.stderr)
+            return 2
+        did = int(args.device)
+        if args.sidecar_host:
+            from geomesa_tpu.sidecar import GeoFlightClient
+
+            port = args.sidecar_port or 8815
+            with GeoFlightClient(
+                f"grpc+tcp://{args.sidecar_host}:{port}"
+            ) as c:
+                out = c.cordon_device(did, reason=args.reason) \
+                    if args.action == "cordon" else c.uncordon_device(did)
+            print(json.dumps(out, indent=2, sort_keys=True, default=str))
+            return
+        from geomesa_tpu.parallel import health as phealth
+
+        reg = phealth.registry()
+        if args.action == "cordon":
+            reg.cordon(did, reason=args.reason or "operator")
+        else:
+            reg.uncordon(did)
+        print(json.dumps({"devices": reg.snapshot()}, indent=2,
+                         sort_keys=True, default=str))
+        return
     if args.url:
         import urllib.request
 
@@ -737,9 +768,19 @@ def build_parser() -> argparse.ArgumentParser:
     sp.add_argument("--port", type=int, default=9090)
     sp.set_defaults(fn=cmd_obs)
 
-    sp = sub.add_parser("devices", help="per-device utilization, slot "
-                        "occupancy, and SLO burn (JSON)")
+    sp = sub.add_parser("devices", help="per-device utilization + health, "
+                        "slot occupancy, and SLO burn (JSON); "
+                        "cordon/uncordon removes/re-admits a device")
+    sp.add_argument("action", nargs="?", choices=["cordon", "uncordon"],
+                    help="mutate device health instead of printing it")
+    sp.add_argument("device", nargs="?", type=int,
+                    help="device id for cordon/uncordon")
+    sp.add_argument("--reason", help="cordon reason (recorded in "
+                    "/debug/devices)")
     sp.add_argument("--url", help="base URL of a running obs/web endpoint")
+    sp.add_argument("--host", dest="sidecar_host",
+                    help="apply cordon/uncordon on a running sidecar")
+    sp.add_argument("--port", dest="sidecar_port", type=int)
     sp.set_defaults(fn=cmd_devices)
 
     sp = sub.add_parser("version", help="print version")
@@ -774,8 +815,10 @@ def build_parser() -> argparse.ArgumentParser:
 def main(argv: Optional[List[str]] = None) -> int:
     args = build_parser().parse_args(argv)
     try:
-        args.fn(args)
-        return 0
+        # a command may return its own non-zero exit code (e.g. a usage
+        # error in `devices cordon`); None keeps the success default
+        rc = args.fn(args)
+        return int(rc) if rc else 0
     except (KeyError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 1

@@ -298,6 +298,43 @@ BIN_STREAM_CHUNKS = SystemProperty("geomesa.bin.stream.chunks", "1")
 #: dispatch thread per device).
 MESH_DEVICES = SystemProperty("geomesa.mesh.devices", None)
 
+#: Devices cordoned out of scheduling, comma-separated ids (e.g. "3" or
+#: "2,5"): a cordoned device is excluded from the sharded scan's fan-out
+#: and from serving-pool slot pinning WITHOUT a restart — the config-knob
+#: face of parallel/health.py's explicit cordon()/uncordon() API (the CLI
+#: ``devices cordon`` and the sidecar ``cordon-device`` action mutate the
+#: in-process registry instead). Unset = nothing cordoned.
+MESH_CORDON = SystemProperty("geomesa.mesh.cordon", None)
+
+#: Consecutive dispatch failures that BREAK a device (open its
+#: ``device:<id>`` circuit breaker, removing it from scheduling until the
+#: reset window's half-open trial succeeds). Fed by sharded-scan dispatch
+#: failures and latency-outlier streaks (parallel/health.py).
+DEVICE_BREAKER_THRESHOLD = SystemProperty(
+    "geomesa.device.breaker.threshold", "3"
+)
+
+#: Broken-device reset window (ms): after it, ONE trial dispatch is
+#: admitted — success restores the device to scheduling, failure re-opens.
+DEVICE_BREAKER_RESET_MS = SystemProperty(
+    "geomesa.device.breaker.reset.ms", "30000"
+)
+
+#: Latency-outlier factor: a per-device partition sync slower than
+#: factor x the trailing mesh-wide median (AND over the floor below)
+#: counts one outlier; geomesa.device.breaker.threshold consecutive
+#: outliers trip the device's breaker. "0" disables outlier detection.
+DEVICE_LATENCY_OUTLIER = SystemProperty(
+    "geomesa.device.latency.outlier", "20"
+)
+
+#: Absolute floor (ms) below which a sync is never an outlier — keeps
+#: microsecond-scale jitter on tiny partitions from breaking a healthy
+#: device (outliers are a straggler-lane signal, not a noise detector).
+DEVICE_LATENCY_FLOOR_MS = SystemProperty(
+    "geomesa.device.latency.floor.ms", "250"
+)
+
 #: Extend the partition prefetch pipeline's overlap to the device upload
 #: on the SHARDED scan: the prefetch thread device_puts partition i+1's
 #: staged host arrays onto its assigned device while device i executes.

@@ -27,7 +27,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from geomesa_tpu import config
+from geomesa_tpu import config, metrics, resilience
 from geomesa_tpu.curves.binned_time import BinnedTime
 from geomesa_tpu.index.keyspace import AttributeKeySpace
 from geomesa_tpu.index.store import FeatureStore
@@ -140,6 +140,12 @@ class PartitionedFeatureStore(FeatureStore):
         #: (planning/partitioned_exec.py). RLock: child() -> _load() ->
         #: evict() nests.
         self._part_lock = threading.RLock()
+        #: corrupt-snapshot quarantine (docs/RESILIENCE.md): bin -> first
+        #: failure repr. A quarantined bin fails fast on load (the query
+        #: layer's degradation contract skips it per-query) until
+        #: :meth:`clear_spill_quarantine` re-admits it. Transient OSErrors
+        #: are retried in place and NEVER quarantined.
+        self._spill_quarantine: Dict[int, str] = {}
         self._shard_bucket = config.SHARD_LEN_BUCKET.to_int() or 1
         self._merged_stats = None
         self._merged_stats_version = -1
@@ -199,16 +205,32 @@ class PartitionedFeatureStore(FeatureStore):
     def _spill(self, b: int):
         """Write partition ``b``'s columnar snapshot to disk and drop it
         from RAM. Partitions that are clean since their last load/spill skip
-        the write (their snapshot dir is still valid)."""
-        st = self.partitions.pop(b)
+        the write (their snapshot dir is still valid).
+
+        Fault posture (docs/RESILIENCE.md, ``index.spill.store``): the
+        write is retried in place on transient ``OSError`` (seeded
+        RetryPolicy, ``geomesa.retry.*``); the partition leaves RAM only
+        AFTER its snapshot is durable, so a store failure (retries
+        exhausted) raises with the partition still resident — a spill can
+        back off, it can never lose data."""
+        st = self.partitions[b]
         st.flush()
         snaps = getattr(self, "_snapshot_paths", {})
         d = snaps.get(b, self._part_dir(b))
         if b in self._dirty or not os.path.isdir(d):
             d = self._part_dir(b)
-            self._write_snapshot(st, d)
+            policy = resilience.RetryPolicy.from_config(seed=int(b))
+
+            def attempt():
+                resilience.fault_point("index.spill.store", bin=int(b),
+                                       path=d)
+                self._write_snapshot(st, d)
+
+            policy.call(attempt,
+                        retryable=resilience.transient_os_error)
             snaps[b] = d
             self._snapshot_paths = snaps
+        self.partitions.pop(b)  # only now: the snapshot is durable
         self._dirty.discard(b)
         self.spilled[b] = d
         self.part_counts[b] = st.count
@@ -244,7 +266,69 @@ class PartitionedFeatureStore(FeatureStore):
         os.replace(tmp, d)
 
     def _load(self, b: int) -> FeatureStore:
-        d = self.spilled.pop(b)
+        """Reload a spilled partition (``index.spill.load`` fault edge;
+        docs/RESILIENCE.md): transient ``OSError`` retries in place via a
+        seeded RetryPolicy and is never quarantined (the next query
+        re-attempts); any other parse failure marks the snapshot CORRUPT —
+        the bin quarantines (fail-fast on later loads, counted in
+        ``index.spill.quarantined``) until :meth:`clear_spill_quarantine`
+        re-admits it after repair. The ``spilled`` entry is removed only
+        on success, so a failed load can always be retried."""
+        q = self._spill_quarantine.get(b)
+        if q is not None:
+            raise ValueError(
+                f"partition {b} snapshot quarantined: {q} "
+                "(clear_spill_quarantine() re-admits after repair)"
+            )
+        d = self.spilled[b]
+        policy = resilience.RetryPolicy.from_config(seed=int(b))
+
+        def attempt():
+            resilience.fault_point("index.spill.load", bin=int(b), path=d)
+            return self._load_snapshot(b, d)
+
+        try:
+            st = policy.call(attempt,
+                             retryable=resilience.transient_os_error)
+        except OSError:
+            raise  # transient: never quarantined, the next read retries
+        except Exception as e:
+            self._spill_quarantine[b] = repr(e)[:300]
+            metrics.inc("index.spill.quarantined")
+            raise ValueError(
+                f"corrupt partition snapshot for bin {b}: {e!r}"
+            ) from e
+        self.spilled.pop(b, None)
+        self.partitions[b] = st
+        self.part_counts[b] = st.count
+        # remember the snapshot dir: if the partition stays clean, a later
+        # eviction re-uses it without rewriting (incremental checkpointing)
+        self._snapshot_paths = getattr(self, "_snapshot_paths", {})
+        self._snapshot_paths[b] = d
+        self.evict()
+        return st
+
+    def spill_quarantine(self) -> Dict[int, str]:
+        """Copy of the corrupt-snapshot quarantine map (bin -> first
+        failure)."""
+        with self._part_lock:
+            return dict(self._spill_quarantine)
+
+    def clear_spill_quarantine(self, b: Optional[int] = None) -> List[int]:
+        """Re-admit quarantined partition snapshot(s) for loading (the
+        operator repaired or restored the files). Returns the bins
+        cleared; repeat failures re-quarantine."""
+        with self._part_lock:
+            if b is not None:
+                return ([b] if self._spill_quarantine.pop(b, None)
+                        is not None else [])
+            cleared = list(self._spill_quarantine)
+            self._spill_quarantine.clear()
+            return cleared
+
+    def _load_snapshot(self, b: int, d: str) -> FeatureStore:
+        """Parse one snapshot dir into a fresh child store — pure read,
+        no partition-map mutation (:meth:`_load` commits on success)."""
         st = self._new_child()
         with open(os.path.join(d, "meta.json")) as fh:
             meta = json.load(fh)
@@ -282,13 +366,6 @@ class PartitionedFeatureStore(FeatureStore):
                     0, t.n, t.n_shards + 1
                 ).astype(np.int64)
         self._upgrade_loaded(st, master)
-        self.partitions[b] = st
-        self.part_counts[b] = st.count
-        # remember the snapshot dir: if the partition stays clean, a later
-        # eviction re-uses it without rewriting (incremental checkpointing)
-        self._snapshot_paths = getattr(self, "_snapshot_paths", {})
-        self._snapshot_paths[b] = d
-        self.evict()
         return st
 
     # -- write path --------------------------------------------------------
@@ -314,24 +391,47 @@ class PartitionedFeatureStore(FeatureStore):
         sorted_cols = {k: v[order] for k, v in fresh.columns.items()}
         cuts = np.flatnonzero(np.concatenate(([True], sb[1:] != sb[:-1])))
         bounds = np.concatenate((cuts, [len(sb)]))
-        for i, c in enumerate(cuts):
-            b = int(sb[c])
-            hi = bounds[i + 1]
-            # contiguous-slice COPIES (cheap memcpy, unlike the fancy
-            # gather this replaced) — a view would pin the whole sorted
-            # batch in every child's master columns, defeating the
-            # residency-budget eviction
-            sub = ColumnBatch(
-                {k: v[c:hi].copy() for k, v in sorted_cols.items()},
-                int(hi - c),
-            )
-            child = self.child(b, create=True)
-            child._buffer.append(sub)
-            self._dirty.add(b)
-            self._part_seq[b] = self._part_seq.get(b, 0) + 1
-            child.flush()
-            self.part_counts[b] = child.count
-            self.evict()
+        done = 0
+        try:
+            for i, c in enumerate(cuts):
+                b = int(sb[c])
+                hi = bounds[i + 1]
+                # contiguous-slice COPIES (cheap memcpy, unlike the fancy
+                # gather this replaced) — a view would pin the whole sorted
+                # batch in every child's master columns, defeating the
+                # residency-budget eviction
+                sub = ColumnBatch(
+                    {k: v[c:hi].copy() for k, v in sorted_cols.items()},
+                    int(hi - c),
+                )
+                child = self.child(b, create=True)
+                child._buffer.append(sub)
+                # routed: the sub-batch now lives in the child's buffer —
+                # even if its flush below fails, the child's NEXT flush
+                # commits it, so it must not be re-buffered on error
+                done = i + 1
+                self._dirty.add(b)
+                self._part_seq[b] = self._part_seq.get(b, 0) + 1
+                child.flush()
+                self.part_counts[b] = child.count
+                self.evict()
+        except BaseException:
+            # spill backpressure must never LOSE rows (docs/RESILIENCE.md,
+            # index.spill.store): a failed eviction mid-route re-buffers
+            # the not-yet-routed remainder of this batch, so the very next
+            # flush retries it — before this, everything past the failing
+            # partition silently vanished from the ingest buffer
+            rest = int(cuts[done]) if done < len(cuts) else len(sb)
+            if rest < len(sb):
+                with self._lock:
+                    self._buffer.append(ColumnBatch(
+                        {k: v[rest:].copy()
+                         for k, v in sorted_cols.items()},
+                        int(len(sb) - rest),
+                    ))
+            if done:
+                self.version += 1  # some partitions did take rows
+            raise
         self.version += 1
 
     def _upgrade_loaded(self, st: FeatureStore, master) -> None:

@@ -131,9 +131,17 @@ def from_geojson(ft: FeatureType, doc: "str | Dict"):
     Missing properties fill with the columnar null representation
     (string -> None is not representable, so "" ; numeric -> NaN/0;
     date -> epoch 0), matching ``update_schema``'s null fill."""
+    from geomesa_tpu import resilience
+
     if isinstance(doc, str):
         doc = json.loads(doc)
     try:
+        # ingest-parser fault edge (docs/RESILIENCE.md, ``io.geojson.
+        # parse``): corruption in the body is contained to a typed
+        # ValueError — the REST layer answers 400, a converter pipeline
+        # quarantines the record; there is nothing to retry in a
+        # malformed document
+        resilience.fault_point("io.geojson.parse", schema=ft.name)
         return _from_geojson(ft, doc)
     except (KeyError, IndexError, TypeError) as e:
         # structural problems in the client's body are input errors

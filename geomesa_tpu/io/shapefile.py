@@ -187,11 +187,37 @@ def _write_dbf(path: str, attrs, d: Dict[str, Any], n: int):
 
 
 def read_shapefile(path: str) -> List[Tuple[int, List[np.ndarray]]]:
-    """Minimal .shp reader (round-trip tests): [(shape_type, parts)]."""
+    """Minimal .shp reader (round-trip tests + CLI import):
+    [(shape_type, parts)].
+
+    Fault posture (docs/RESILIENCE.md, ``io.shapefile.read``): the file
+    read retries in place on transient ``OSError`` (fd pressure, NFS
+    blips — seeded RetryPolicy, ``geomesa.retry.*``); a file whose
+    geometry records fail to parse is CORRUPTION and raises a typed
+    ``ValueError`` naming the path — there is nothing to retry in broken
+    bytes, the operator repairs or drops the file."""
+    from geomesa_tpu import resilience
+
     base = path[:-4] if path.lower().endswith(".shp") else path
+
+    def _read() -> bytes:
+        resilience.fault_point("io.shapefile.read", path=base + ".shp")
+        with open(base + ".shp", "rb") as f:
+            return f.read()
+
+    data = resilience.RetryPolicy.from_config(seed=0).call(
+        _read, retryable=resilience.transient_os_error
+    )
+    try:
+        return _parse_shp(data)
+    except (struct.error, ValueError, IndexError) as e:
+        raise ValueError(
+            f"corrupt shapefile {base + '.shp'!r}: {type(e).__name__}: {e}"
+        ) from e
+
+
+def _parse_shp(data: bytes) -> List[Tuple[int, List[np.ndarray]]]:
     out = []
-    with open(base + ".shp", "rb") as f:
-        data = f.read()
     pos = 100
     while pos < len(data):
         (_, words) = struct.unpack(">2i", data[pos:pos + 8])

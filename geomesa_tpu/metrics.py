@@ -368,6 +368,19 @@ PIPELINE_PREFETCH = "pipeline.prefetch"
 SCAN_SHARDED = "scan.sharded.queries"
 SCAN_SHARDED_DEVICE = "scan.sharded.device"
 PIPELINE_DEVICE_PUT = "pipeline.deviceput"
+# Device fault tolerance (parallel/health.py, planning/partitioned_exec.py,
+# serving/scheduler.py; docs/RESILIENCE.md §6):
+#   device.health.<id>        gauge: 1 = ok, 0 = cordoned, -1 = broken
+#                             (breaker open / half-open awaiting trial)
+#   scan.reassigned           partitions requeued onto a surviving device
+#                             after a per-device dispatch failure
+#   serving.slot.died         pool dispatcher deaths (per-slot suffix too)
+#   serving.slot.respawn      slots respawned by the pool supervisor
+#                             (per-slot suffix too)
+DEVICE_HEALTH_PREFIX = "device.health"
+SCAN_REASSIGNED = "scan.reassigned"
+SERVING_SLOT_DIED = "serving.slot.died"
+SERVING_SLOT_RESPAWN = "serving.slot.respawn"
 # Observability metrics (tracing.py, kernels/registry.py, obs.py;
 # docs/OBSERVABILITY.md):
 #   kernel.recompiles.<site>   per-jit-site fresh traces (suffix = site)

@@ -17,10 +17,13 @@ the Dropwizard-reporter role of the reference's geomesa-metrics module
                         trail, and slow-query span trees
                         (?n= bounds each list, default 50; ?user= and
                         ?op= filter events/rollups/slow traces)
-    GET /debug/devices  JSON: per-device busy fractions + totals, serving
-                        slot occupancy, the queue-wait vs device-time
-                        breakdown, and the SLO burn summary
-                        (utilization.py, slo.py)
+    GET /debug/devices  JSON: per-device busy fractions + totals, the
+                        per-device HEALTH map (ok/cordoned/broken,
+                        reassignment counts, last failure —
+                        parallel/health.py, docs/RESILIENCE.md §6),
+                        serving slot occupancy + the pool supervision
+                        digest, the queue-wait vs device-time breakdown,
+                        and the SLO burn summary (utilization.py, slo.py)
 
 ``web.py`` mounts the same routes on the REST server, so a process
 already serving the API needs no second port; :func:`serve` runs a
@@ -123,11 +126,16 @@ def _fs_quarantine() -> Dict[str, Dict[str, str]]:
 
 def health() -> Dict[str, Any]:
     """The /healthz payload. ``status`` is ``ok`` unless a circuit breaker
-    is open or an SLO's fast window burns past geomesa.slo.burn.threshold
-    (``degraded``); quarantine counters (plus the per-instance fs-storage
-    quarantine maps) and device reachability ride along for the operator's
-    first glance."""
+    is open, an SLO's fast window burns past geomesa.slo.burn.threshold,
+    or a mesh device is cordoned/broken (``degraded``). Device-level
+    degradation is SOFT while capacity remains — one cordoned device of
+    eight means "look at me", not "stop sending traffic" — so the HTTP
+    code stays 200 (``soft: true``); an open non-device breaker, a
+    burning SLO, or a mesh with NO usable device is hard (503). Quarantine
+    counters (plus the per-instance fs-storage quarantine maps) and
+    device reachability ride along for the operator's first glance."""
     from geomesa_tpu import slo
+    from geomesa_tpu.parallel import health as phealth
 
     breakers = resilience.breaker_states()
     report = metrics.registry().report()
@@ -136,15 +144,27 @@ def health() -> Dict[str, Any]:
         if "quarantin" in name and isinstance(v, (int, float)) and v
     }
     open_breakers = [n for n, s in breakers.items() if s == "open"]
+    # device:* breakers degrade softly (capacity permitting) — the mesh
+    # summary below carries them; everything else fencing open is hard
+    hard_breakers = [n for n in open_breakers
+                     if not n.startswith("device:")]
     slo_status = slo.monitor().status()
     slo_hot = {op: s for op, s in slo_status.items() if s["hot"]}
+    dev = device_health()
+    total_devices = len(dev.get("devices") or ())
+    mesh = phealth.registry().summary(total_devices)
+    mesh_degraded = bool(mesh["cordoned"] or mesh["broken"])
+    no_capacity = total_devices > 0 and mesh["usable"] <= 0
+    hard = bool(hard_breakers or slo_hot or no_capacity)
     out = {
-        "status": "degraded" if (open_breakers or slo_hot) else "ok",
+        "status": "degraded" if (hard or mesh_degraded) else "ok",
+        "soft": bool(mesh_degraded and not hard),
         "breakers": breakers,
         "open_breakers": open_breakers,
         "quarantine": quarantine,
         "fs_quarantine": _fs_quarantine(),
-        "device": device_health(),
+        "device": dev,
+        "mesh": mesh,
         "tracing": tracing.enabled(),
     }
     if slo_status:
@@ -214,14 +234,23 @@ def debug_queries(dataset=None, n: int = 50, user: Optional[str] = None,
     }
 
 
-def debug_devices() -> Dict[str, Any]:
+def debug_devices(dataset=None) -> Dict[str, Any]:
     """The /debug/devices payload: per-device utilization, pool slot
-    occupancy, the queue-wait vs device-time breakdown, and the SLO burn
-    summary (docs/OBSERVABILITY.md)."""
+    occupancy, the queue-wait vs device-time breakdown, the SLO burn
+    summary (docs/OBSERVABILITY.md), and — docs/RESILIENCE.md §6 — the
+    per-device HEALTH map (ok/cordoned/broken, breaker state, failure +
+    reassignment counts, last failure) plus the serving pool's
+    supervision digest (width, respawns) when a dataset is mounted."""
     from geomesa_tpu import slo, utilization
+    from geomesa_tpu.parallel import health as phealth
 
     out = utilization.snapshot()
     out["slo"] = slo.monitor().status()
+    out["health"] = phealth.registry().snapshot()
+    if dataset is not None:
+        sched = getattr(dataset, "serving", None)
+        if sched is not None:
+            out["pool"] = sched.snapshot()
     return out
 
 
@@ -242,7 +271,10 @@ def handle(path: str, dataset=None, accept: Optional[str] = None):
         return 200, "text/plain; version=0.0.4", metrics_text().encode()
     if route == "/healthz":
         h = health()
-        code = 200 if h["status"] == "ok" else 503
+        # soft (device-cordon with capacity standing) degrades the STATUS
+        # but keeps 200: load balancers must not eject a node that is
+        # merely running narrower (docs/RESILIENCE.md §6)
+        code = 200 if h["status"] == "ok" or h.get("soft") else 503
         return code, "application/json", json.dumps(h).encode()
     if route == "/debug/queries":
         try:
@@ -257,7 +289,7 @@ def handle(path: str, dataset=None, accept: Optional[str] = None):
         return 200, "application/json", body
     if route == "/debug/devices":
         return (200, "application/json",
-                json.dumps(debug_devices(), default=str).encode())
+                json.dumps(debug_devices(dataset), default=str).encode())
     return None
 
 

@@ -1,5 +1,6 @@
 from geomesa_tpu.parallel.mesh import shard_mesh, device_count  # noqa: F401
 from geomesa_tpu.parallel.devices import (  # noqa: F401
-    TreeReducer, device_sharding, merge_partials, scan_devices,
-    slot_device, tree_merge,
+    TreeReducer, device_sharding, healthy_device_count, merge_partials,
+    scan_devices, slot_device, tree_merge,
 )
+from geomesa_tpu.parallel import health  # noqa: F401

@@ -64,9 +64,13 @@ def pool_width() -> int:
 def scan_devices() -> Optional[List]:
     """Devices the sharded partitioned scan may fan out over, resolved
     from ``geomesa.mesh.devices`` (unset/"all" = every local device, an
-    integer caps the count, 0/1/"off" disables). None = the sharded scan
-    does not engage (single device, knob off, or a >1-executor serving
-    pool owns the devices)."""
+    integer caps the count, 0/1/"off" disables) and filtered through the
+    per-device health registry (cordoned/broken devices never receive
+    partitions — docs/RESILIENCE.md §6). None = the sharded scan does not
+    engage (single usable device, knob off, or a >1-executor serving pool
+    owns the devices); the serial path then runs on the default placement
+    regardless of health — cordoning every device caps capacity, it never
+    zeroes it."""
     if pool_width() > 1:
         return None
     raw = (config.MESH_DEVICES.get() or "all").strip().lower()
@@ -80,18 +84,49 @@ def scan_devices() -> Optional[List]:
             devs = devs[: max(int(raw), 0)]
         except ValueError:
             return None
+    from geomesa_tpu.parallel import health as phealth
+
+    hreg = phealth.registry()
+    devs = [d for d in devs if hreg.usable(d.id)]
     if len(devs) < 2:
         return None
     return devs
 
 
+def healthy_device_count() -> int:
+    """Local devices the health registry allows scheduling on (>= 1 so a
+    fully cordoned mesh still leaves the default serial placement — the
+    capacity floor, never a zero)."""
+    try:
+        import jax
+
+        devs = list(jax.devices())
+    except Exception:
+        return 1
+    from geomesa_tpu.parallel import health as phealth
+
+    hreg = phealth.registry()
+    return max(1, sum(1 for d in devs if hreg.usable(d.id)))
+
+
 def slot_device(slot: int):
     """The device pinned to serving-pool executor slot ``slot``
-    (slot i -> device i % device_count; slot 0 keeps the default
-    placement and is handled by the caller)."""
+    (slot i -> device i % healthy_device_count; slot 0 keeps the default
+    placement and is handled by the caller). Health-aware: cordoned and
+    broken devices drop out of the rotation, so a respawned (or re-pinned)
+    slot lands on a healthy device — GeoDataset's slot-keyed executors
+    re-pin when this mapping moves (docs/RESILIENCE.md §6). With the pool
+    width re-clamped to the healthy count by the supervisor, distinct
+    slots keep distinct devices (the one-jit-thread-per-device rule).
+    Falls back to the full device list when health fences everything."""
     import jax
 
-    devs = jax.devices()
+    devs = list(jax.devices())
+    from geomesa_tpu.parallel import health as phealth
+
+    hreg = phealth.registry()
+    healthy = [d for d in devs if hreg.usable(d.id)]
+    devs = healthy or devs
     return devs[slot % len(devs)]
 
 

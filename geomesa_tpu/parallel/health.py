@@ -1,0 +1,277 @@
+"""Per-device health: circuit breakers, cordon/drain, and the fault
+bookkeeping behind mid-scan reassignment (docs/RESILIENCE.md §6).
+
+Production heterogeneous meshes routinely see one lane run slow or fail
+outright ("Large-Scale Geospatial Processing on Multi-Core and Many-Core
+Processors", PAPERS.md); before this module a sick device took the whole
+sharded scan — or its serving-pool slot — down with it. Now every local
+device carries:
+
+* a **circuit breaker** (``resilience.breaker("device:<id>")``) fed by
+  sharded-scan dispatch failures and latency-outlier streaks:
+  ``geomesa.device.breaker.threshold`` consecutive failures open it
+  (state *broken*), the normal half-open trial after
+  ``geomesa.device.breaker.reset.ms`` restores it;
+* a **latency-outlier detector**: a per-partition device sync slower than
+  ``geomesa.device.latency.outlier`` x the trailing mesh-wide median
+  (and over ``geomesa.device.latency.floor.ms``) counts one outlier;
+  a threshold-long consecutive streak trips the breaker — the
+  slow-but-not-failing straggler lane is fenced like a failing one;
+* an explicit **cordon** state — operator action via the CLI
+  (``geomesa-tpu devices cordon``), the sidecar ``cordon-device``
+  action, :func:`cordon` in process, or the ``geomesa.mesh.cordon``
+  config knob — that removes the device from scheduling without a
+  restart and without touching its breaker.
+
+Consumers: ``parallel/devices.py`` filters :func:`usable` devices out of
+the sharded fan-out and serving-pool slot pinning; the partitioned
+executor records failures/successes/latencies per dispatch and requeues a
+failed device's partitions onto survivors (``scan.reassigned``); obs.py
+surfaces :func:`snapshot` at ``/debug/devices`` and degrades (not 503)
+``/healthz`` while cordoned/broken devices leave capacity standing.
+
+Everything is process-local state at partition/dispatch granularity —
+never consulted inside per-row loops.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Set
+
+from geomesa_tpu import config, metrics, resilience
+
+#: health states surfaced to operators (gauge values in parens)
+OK, CORDONED, BROKEN = "ok", "cordoned", "broken"
+_GAUGE_VALUE = {OK: 1.0, CORDONED: 0.0, BROKEN: -1.0}
+
+
+def _cordon_config_ids() -> Set[int]:
+    """Device ids cordoned via the ``geomesa.mesh.cordon`` knob."""
+    raw = (config.MESH_CORDON.get() or "").strip()
+    if not raw:
+        return set()
+    out: Set[int] = set()
+    for tok in raw.split(","):
+        tok = tok.strip()
+        if tok:
+            try:
+                out.add(int(tok))
+            except ValueError:
+                pass  # a malformed token never un-cordons the valid ones
+    return out
+
+
+class DeviceHealthRegistry:
+    """Process-wide per-device health state. Thread-safe; device ids are
+    the local jax device ids (bounded cardinality — one entry, one
+    ``device.health.<id>`` gauge per local device)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        #: explicit cordons: id -> reason (the config knob is consulted
+        #: separately so scoped/env cordons never leak into this map)
+        self._cordoned: Dict[int, str] = {}
+        self._last_failure: Dict[int, str] = {}
+        #: partitions requeued off this device (docs/RESILIENCE.md §6)
+        self._reassigned: Dict[int, int] = {}
+        self._failures: Dict[int, int] = {}
+        #: trailing mesh-wide sync-latency samples (the outlier baseline)
+        self._lat_recent: "deque" = deque(maxlen=256)
+        self._outlier_streak: Dict[int, int] = {}
+        self._gauged: Set[int] = set()
+
+    # -- breaker plumbing --------------------------------------------------
+    def _breaker(self, did: int) -> resilience.CircuitBreaker:
+        """The device's circuit breaker, through the process-wide named
+        registry (so it shows up in resilience.breaker_states() and the
+        /healthz breaker map like every other breaker — obs.py treats
+        ``device:*`` breakers as soft-degrading, not 503)."""
+        return resilience.breaker(
+            f"device:{did}",
+            threshold=config.DEVICE_BREAKER_THRESHOLD.to_int() or 3,
+            reset_ms=config.DEVICE_BREAKER_RESET_MS.to_float() or 30_000.0,
+        )
+
+    def _ensure_gauge(self, did: int) -> None:
+        if did in self._gauged:
+            return
+        with self._lock:
+            if did in self._gauged:
+                return
+            self._gauged.add(did)
+        metrics.registry().gauge(
+            f"{metrics.DEVICE_HEALTH_PREFIX}.{did}",
+            lambda d=did: _GAUGE_VALUE[self.state(d)],
+            replace=True,
+        )
+
+    # -- state -------------------------------------------------------------
+    def cordon_reason(self, did: int) -> Optional[str]:
+        with self._lock:
+            reason = self._cordoned.get(did)
+        if reason is not None:
+            return reason
+        if did in _cordon_config_ids():
+            return "geomesa.mesh.cordon"
+        return None
+
+    def state(self, did: int) -> str:
+        """``ok`` | ``cordoned`` (operator/config) | ``broken`` (breaker
+        open or half-open awaiting its trial — the trial dispatch itself
+        is admitted through :meth:`usable`)."""
+        if self.cordon_reason(did) is not None:
+            return CORDONED
+        if self._breaker(did).state != resilience.CircuitBreaker.CLOSED:
+            return BROKEN
+        return OK
+
+    def usable(self, did: int) -> bool:
+        """May the scheduler place work on this device? Cordoned: no.
+        Open breaker: no. Half-open: yes — the next dispatch IS the trial
+        (its success/failure report closes or re-opens the circuit); a
+        pure state read here, never ``allow()``, so an observability poll
+        can never consume the trial slot without dispatching."""
+        self._ensure_gauge(did)
+        if self.cordon_reason(did) is not None:
+            return False
+        return self._breaker(did).state != resilience.CircuitBreaker.OPEN
+
+    # -- operator surface --------------------------------------------------
+    def cordon(self, did: int, reason: str = "operator") -> None:
+        """Remove a device from scheduling (sticky until uncordon)."""
+        self._ensure_gauge(did)
+        with self._lock:
+            self._cordoned[int(did)] = str(reason)
+
+    def uncordon(self, did: int) -> bool:
+        """Re-admit an explicitly cordoned device. Returns False when the
+        device was not cordoned here (a ``geomesa.mesh.cordon`` config
+        cordon is cleared by unsetting the knob, not through this API)."""
+        with self._lock:
+            return self._cordoned.pop(int(did), None) is not None
+
+    def cordoned_ids(self) -> Set[int]:
+        with self._lock:
+            out = set(self._cordoned)
+        return out | _cordon_config_ids()
+
+    # -- fault bookkeeping (partition/dispatch granularity) ----------------
+    def record_failure(self, did: int, error: BaseException) -> None:
+        """One failed dispatch on ``did`` — feeds its breaker."""
+        self._ensure_gauge(did)
+        self._breaker(did).record_failure()
+        with self._lock:
+            self._failures[did] = self._failures.get(did, 0) + 1
+            self._last_failure[did] = repr(error)[:300]
+
+    def record_success(self, did: int) -> None:
+        """One successful dispatch — closes a half-open trial, resets the
+        consecutive-failure count."""
+        self._breaker(did).record_success()
+
+    def record_latency(self, did: int, seconds: float) -> None:
+        """One partition-sync latency sample. Consecutive outliers (vs
+        the trailing mesh median, over the floor) trip the device's
+        breaker: the straggler lane the many-core evaluations in PAPERS.md
+        blame for lost headroom gets fenced like a failing one."""
+        try:
+            factor = config.DEVICE_LATENCY_OUTLIER.to_float() or 0.0
+        except (TypeError, ValueError):
+            factor = 0.0
+        if factor <= 0:
+            return
+        floor_s = (config.DEVICE_LATENCY_FLOOR_MS.to_float() or 250.0) / 1e3
+        with self._lock:
+            samples = sorted(self._lat_recent)
+            self._lat_recent.append(seconds)
+            median = samples[len(samples) // 2] if len(samples) >= 8 else None
+            if median is not None \
+                    and seconds >= max(floor_s, factor * median):
+                streak = self._outlier_streak.get(did, 0) + 1
+                self._outlier_streak[did] = streak
+                threshold = config.DEVICE_BREAKER_THRESHOLD.to_int() or 3
+                if streak < threshold:
+                    return
+                self._outlier_streak[did] = 0
+                self._last_failure[did] = (
+                    f"latency outlier: {seconds * 1e3:.1f} ms >= "
+                    f"{factor:g} x mesh median {median * 1e3:.1f} ms "
+                    f"({streak} consecutive)"
+                )
+            else:
+                self._outlier_streak[did] = 0
+                return
+        # trip outside the registry lock (breaker has its own)
+        self._breaker(did).trip()
+
+    def note_reassigned(self, did: int) -> None:
+        """One partition requeued OFF this device onto a survivor."""
+        with self._lock:
+            self._reassigned[did] = self._reassigned.get(did, 0) + 1
+
+    # -- operator payloads -------------------------------------------------
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-device health payload (/debug/devices, the CLI ``devices``
+        command): state, breaker state, cordon reason, failure counts,
+        reassignments, and the last failure's repr."""
+        with self._lock:
+            ids = (set(self._gauged) | set(self._cordoned)
+                   | set(self._last_failure) | set(self._reassigned))
+            cordons = dict(self._cordoned)
+            failures = dict(self._failures)
+            reassigned = dict(self._reassigned)
+            last = dict(self._last_failure)
+        ids |= _cordon_config_ids()
+        out: Dict[str, Dict[str, Any]] = {}
+        for did in sorted(ids):
+            entry: Dict[str, Any] = {
+                "state": self.state(did),
+                "breaker": self._breaker(did).state,
+                "failures": failures.get(did, 0),
+                "reassigned": reassigned.get(did, 0),
+            }
+            reason = cordons.get(did) or (
+                "geomesa.mesh.cordon" if did in _cordon_config_ids()
+                else None
+            )
+            if reason is not None:
+                entry["cordon_reason"] = reason
+            if did in last:
+                entry["last_failure"] = last[did]
+            out[str(did)] = entry
+        return out
+
+    def summary(self, total_devices: int) -> Dict[str, Any]:
+        """The /healthz device-capacity digest: cordoned/broken id lists
+        plus how many of ``total_devices`` remain schedulable."""
+        cordoned: List[int] = []
+        broken: List[int] = []
+        for did in range(max(int(total_devices), 0)):
+            st = self.state(did)
+            if st == CORDONED:
+                cordoned.append(did)
+            elif st == BROKEN:
+                broken.append(did)
+        usable = max(int(total_devices), 0) - len(cordoned) - len(broken)
+        return {
+            "total": int(total_devices),
+            "usable": usable,
+            "cordoned": cordoned,
+            "broken": broken,
+        }
+
+
+_registry = DeviceHealthRegistry()
+
+
+def registry() -> DeviceHealthRegistry:
+    return _registry
+
+
+def reset() -> None:
+    """Fresh registry (test isolation). Does NOT clear the underlying
+    ``device:*`` breakers — pair with ``resilience.reset_breakers()``."""
+    global _registry
+    _registry = DeviceHealthRegistry()

@@ -27,11 +27,13 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Dict, Iterator, List, Optional, Tuple
 
 import numpy as np
 
 from geomesa_tpu import config, metrics, resilience, tracing
+from geomesa_tpu.parallel import health as phealth
 from geomesa_tpu.filter import ir
 from geomesa_tpu.index.partitioned import PartitionedFeatureStore
 from geomesa_tpu.kernels.registry import KernelRegistry
@@ -200,7 +202,12 @@ class PartitionedExecutor:
                          float(max(total_bins - len(bins), 0)))
         if len(bins) < 2 or not config.PIPELINE_PREFETCH.to_bool():
             for i, b in enumerate(bins):
-                yield i, b, self.store.child(b)
+                try:
+                    child = self.store.child(b)
+                except BaseException as e:
+                    self._contain_load(plan, b, e)
+                    continue
+                yield i, b, child
             return
         out: "queue.Queue" = queue.Queue()
         stop = threading.Event()
@@ -225,16 +232,30 @@ class PartitionedExecutor:
                     if devs is not None:
                         dev = devs[i % len(devs)]
                         attrs["device"] = int(dev.id)
+                    child = err = None
                     try:
                         child = self.store.child(b)
-                        with tracing.span("scan.stage", **attrs):
-                            self._stage(child, plan)
-                            if overlap and child is not None:
-                                self._stage_device(child, plan, dev)
                     except BaseException as e:
-                        out.put((i, b, None, e))
-                    else:
-                        out.put((i, b, child, None))
+                        err = e  # a LOAD failure: _contain_load decides
+                    if err is None and child is not None:
+                        # staging (host assembly + device upload) is a
+                        # best-effort OVERLAP, never the dispatch: a
+                        # staging failure must not fail — or mislabel as
+                        # a spill-load skip — a partition the dispatch
+                        # can still serve by assembling on demand, and a
+                        # fenced lane must stop receiving uploads
+                        try:
+                            with tracing.span("scan.stage", **attrs):
+                                self._stage(child, plan)
+                                if overlap:
+                                    if phealth.registry().usable(dev.id):
+                                        self._stage_device(child, plan,
+                                                           dev)
+                        except Exception:
+                            pass  # dispatch re-stages on demand
+                        except BaseException as e:
+                            err = e  # interpreter teardown etc.: surface
+                    out.put((i, b, child if err is None else None, err))
             finally:
                 out.put(None)
 
@@ -256,7 +277,8 @@ class PartitionedExecutor:
                 slot.release()
                 i, b, child, err = item
                 if err is not None:
-                    raise err
+                    self._contain_load(plan, b, err)
+                    continue
                 yield i, b, child
         finally:
             stop.set()
@@ -281,6 +303,111 @@ class PartitionedExecutor:
                     if tb is not None:
                         tb._host_stage.clear()
 
+    def _dispatch_reassign(self, plan: QueryPlan, b: int, child, i: int,
+                           op: str, dispatch, live: List, state: Dict):
+        """One partition's dispatch under the device fault-tolerance
+        contract (docs/RESILIENCE.md §6). The partition pins to
+        ``live[i % len(live)]`` — pruned-bin round-robin over the devices
+        still SURVIVING this scan (cordoned/broken lanes are skipped; a
+        lane that fails here is dropped, so its pending partitions requeue
+        onto the survivors). Each attempt passes the
+        ``scan.device.dispatch`` fault point; a failed attempt feeds the
+        device's breaker (``parallel/health.py``) and retries on the next
+        survivor under a seeded RetryPolicy (``geomesa.retry.*``, seed =
+        the partition bin — a chaos run replays identically). Exhausted
+        retries, or no survivors, re-raise into ``_scan_part``'s
+        degradation contract: exact survivor totals under
+        ``allow_partial()``, typed failure otherwise, never a wedge.
+
+        Bit-identity holds by construction: whichever device computes a
+        partial, it enters the tree reduction in pruned-bin order — the
+        only order :func:`~geomesa_tpu.parallel.devices.tree_merge` ever
+        sees — so a recovered run is bit-identical to a healthy one
+        (asserted by tests/test_chaos.py)."""
+        hreg = phealth.registry()
+        policy = resilience.RetryPolicy.from_config(seed=int(b))
+        attempts = max(policy.attempts, 1)
+        delays = policy.delays_ms()
+        last: Optional[BaseException] = None
+        removed_here: List = []  # lanes this PARTITION's attempts removed
+        for attempt in range(attempts):
+            # rotate past lanes health has fenced since the scan started
+            while live and not hreg.usable(live[i % len(live)].id):
+                live.pop(i % len(live))
+            if not live:
+                break
+            dev = live[i % len(live)]
+            try:
+                resilience.fault_point(
+                    "scan.device.dispatch", bin=int(b),
+                    device=int(dev.id), op=op, attempt=attempt,
+                )
+                ex = self._executor_for(b, child, device=dev)
+                r = dispatch(ex)
+            except QueryTimeoutError:
+                raise
+            except Exception as e:
+                last = e
+                hreg.record_failure(dev.id, e)
+                try:
+                    live.remove(dev)
+                    removed_here.append(dev)
+                except ValueError:
+                    pass
+                if attempt + 1 >= attempts or not live:
+                    break
+                # requeue onto the next survivor (round-robin continues
+                # over the shrunken rotation)
+                hreg.note_reassigned(dev.id)
+                metrics.inc(metrics.SCAN_REASSIGNED)
+                tracing.event("scan.reassigned", part=int(b),
+                              device=int(dev.id), error=type(e).__name__)
+                d = delays[attempt] if attempt < len(delays) else 0.0
+                if d > 0:
+                    policy.sleep(d / 1000.0)
+                check_deadline()
+                continue
+            # success on a survivor: lanes removed above STAY removed —
+            # the same partition worked elsewhere, so the evidence is
+            # lane-scoped. The device's own breaker success is recorded
+            # at SYNC time (_finish_oldest), where execution errors
+            # actually surface — an enqueue is not evidence of health.
+            state["device"] = dev
+            return r
+        # the partition failed on EVERY lane it tried: the evidence is
+        # PARTITION-scoped (bad data / oversized staging), not lane-
+        # scoped — restore the lanes it removed so one poison partition
+        # cannot fence the whole mesh off for the rest of the scan
+        # (their breakers keep the charge; genuinely dead lanes still
+        # accumulate consecutive failures across partitions)
+        for dev in removed_here:
+            if hreg.usable(dev.id) and dev not in live:
+                live.append(dev)
+        if last is not None:
+            raise last
+        raise RuntimeError(
+            "no surviving devices for the sharded scan (all cordoned or "
+            "broken mid-scan)"
+        )
+
+    def _contain_load(self, plan: QueryPlan, b: int, err: BaseException):
+        """Degradation contract for a partition LOAD failure (a corrupt
+        or unreadable spill snapshot — ``index/partitioned.py``'s
+        ``index.spill.load`` edge): under ``allow_partial()`` the
+        partition is skipped with a recorded degradation (exact survivor
+        totals, same as a scan failure); strict mode — and any deadline
+        expiry or non-Exception — re-raises at the point the sequential
+        load would have. Before this, a spill-load failure took the whole
+        query down even in degraded mode (ROADMAP resilience item)."""
+        if isinstance(err, QueryTimeoutError) \
+                or not isinstance(err, Exception) \
+                or not resilience.partial_allowed():
+            raise err
+        rec = resilience.record_skip(
+            "index.spill.load", f"bin:{b}", err, phase="load"
+        )
+        plan.__dict__.setdefault("degraded", []).append(rec)
+
     def _sharded_scan(self, plan: QueryPlan, op: str, dispatch, finish,
                       devs, bins: List[int]) -> None:
         """Round-robin fan-out of one additive op over ``devs``:
@@ -293,17 +420,47 @@ class PartitionedExecutor:
         while older partials sync/merge and at most D partials plus the
         reducer spine are ever outstanding (never all P). finish runs
         under the same degradation guard as the scan, attributing a
-        sync-time device failure to its partition."""
+        sync-time device failure to its partition; its sync wall time
+        feeds the device's latency-outlier detector (a straggler lane is
+        fenced like a failing one — parallel/health.py). Dispatch
+        failures requeue the partition onto surviving devices
+        (:meth:`_dispatch_reassign`)."""
         metrics.inc(metrics.SCAN_SHARDED)
         from collections import deque
 
-        pending: "deque" = deque()  # (bin, partial) awaiting finish
+        pending: "deque" = deque()  # (bin, partial, device) awaiting finish
         mdev = devs[0]  # the device the serial path computes on
+        hreg = phealth.registry()
+        #: devices still surviving THIS scan (failed lanes drop out and
+        #: their pending partitions requeue round-robin onto the rest)
+        live: List = list(devs)
 
         def _finish_oldest():
-            fb, fr = pending.popleft()
-            self._scan_part(plan, fb, op, lambda: finish(fb, fr, mdev),
+            fb, fr, fdev = pending.popleft()
+            t0 = time.perf_counter()
+
+            def _fin():
+                # jax dispatch is async: execution errors surface HERE,
+                # at the blocking sync — so health verdicts are recorded
+                # at sync time, not enqueue time (an enqueue that
+                # "succeeded" on a dead device is not evidence of
+                # health, and must not reset its breaker)
+                try:
+                    out = finish(fb, fr, mdev)
+                except QueryTimeoutError:
+                    raise
+                except Exception as e:
+                    if fdev is not None:
+                        hreg.record_failure(fdev.id, e)
+                    raise
+                if fdev is not None:
+                    hreg.record_success(fdev.id)
+                return out
+
+            self._scan_part(plan, fb, op, _fin,
                             probe=False, spanned=False)
+            if fdev is not None:
+                hreg.record_latency(fdev.id, time.perf_counter() - t0)
 
         tot_scanned = tot_rows = 0
         try:
@@ -311,17 +468,23 @@ class PartitionedExecutor:
                 check_deadline()
                 if child is None or child.count == 0:
                     continue
-                dev = devs[i % len(devs)]
-                ex = self._executor_for(b, child, device=dev)
                 plan.__dict__.pop("scanned_rows", None)
                 plan.__dict__.pop("table_rows", None)
-                r = self._scan_part(plan, b, op, lambda: dispatch(ex),
-                                    device=dev)
+                state: Dict = {}
+                r = self._scan_part(
+                    plan, b, op,
+                    lambda b=b, i=i, child=child, state=state:
+                        self._dispatch_reassign(plan, b, child, i, op,
+                                                dispatch, live, state),
+                    device=live[i % len(live)] if live else None,
+                )
                 tot_scanned += plan.__dict__.pop("scanned_rows", 0)
                 tot_rows += plan.__dict__.pop("table_rows", 0)
-                metrics.inc(f"{metrics.SCAN_SHARDED_DEVICE}.{dev.id}")
+                dev = state.get("device")
+                if dev is not None:
+                    metrics.inc(f"{metrics.SCAN_SHARDED_DEVICE}.{dev.id}")
                 if r is not _SKIPPED and r is not None:
-                    pending.append((b, r))
+                    pending.append((b, r, dev))
                 # dispatched work holds its own buffer references: staged
                 # host arrays and evicted children free safely here even
                 # while the device is still executing

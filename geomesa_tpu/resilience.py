@@ -97,6 +97,17 @@ class AdmissionRejectedError(RuntimeError):
         self.depth = depth
 
 
+class DeviceDrainError(RuntimeError):
+    """Raised when serving work is rejected or stranded because its
+    executor slot / device was DRAINED: the slot's dispatcher died, or the
+    pinned device was cordoned (operator action or an open device breaker)
+    and the supervisor re-clamped the pool (docs/RESILIENCE.md §6,
+    docs/SERVING.md). Crosses the sidecar wire as ``[GM-DRAINING]``
+    (retryable: a respawned slot — or a re-opened stream — will serve the
+    request; the device work that was in flight is NOT known to have
+    committed, so streams must re-open, not resume)."""
+
+
 class CircuitOpenError(RuntimeError):
     """Raised by :meth:`CircuitBreaker.allow` while the breaker is open:
     the callee has failed repeatedly and calls are being fenced off until
@@ -386,6 +397,19 @@ class CircuitBreaker:
             self._trial_in_flight = False
             self._trial_thread = None
 
+    def trip(self) -> None:
+        """Force the circuit OPEN regardless of the failure count — the
+        device-health latency-outlier path (parallel/health.py): evidence
+        other than a thrown exception (a consecutive-outlier streak) has
+        judged the callee sick. Recovery follows the normal half-open
+        trial after ``reset_ms``."""
+        with self._lock:
+            self._failures = max(self._failures, self.threshold)
+            self._state = self.OPEN
+            self._opened_at = self.clock()
+            self._trial_in_flight = False
+            self._trial_thread = None
+
 
 _breakers: Dict[str, CircuitBreaker] = {}
 _breakers_lock = threading.Lock()
@@ -428,6 +452,10 @@ class _FaultRule:
     p: float = 1.0                  # probability per hit (seeded RNG)
     delay_s: float = 0.0            # sleep before raising/continuing
     hits: int = 0                   # matched (after p/times gating)
+    #: optional context predicate: the rule matches only when
+    #: ``where(ctx)`` is truthy (ctx = the fault point's keyword args —
+    #: e.g. target device 3 only: ``where=lambda c: c.get("device") == 3``)
+    where: Optional[Callable[[Dict[str, Any]], bool]] = None
 
 
 class FaultInjector:
@@ -442,11 +470,15 @@ class FaultInjector:
         self.fired: List[Tuple[str, str]] = []  # (site, error repr)
 
     def fail(self, pattern: str, error: Any = None, times: Optional[int] = 1,
-             p: float = 1.0, delay_s: float = 0.0) -> "_FaultRule":
+             p: float = 1.0, delay_s: float = 0.0,
+             where: Optional[Callable[[Dict[str, Any]], bool]] = None,
+             ) -> "_FaultRule":
         """Arm a rule. ``error`` may be an exception instance/type or a
         zero-arg factory; default :class:`InjectedFault`. ``times=None``
-        fires on every match."""
-        rule = _FaultRule(pattern, error, times, p, delay_s)
+        fires on every match. ``where`` narrows the rule to fault-point
+        hits whose context satisfies the predicate (e.g. one device of
+        the mesh: ``where=lambda c: c.get("device") == 3``)."""
+        rule = _FaultRule(pattern, error, times, p, delay_s, where=where)
         with self._lock:
             self._rules.append(rule)
         return rule
@@ -467,6 +499,8 @@ class FaultInjector:
                     continue
                 if rule.times is not None and rule.hits >= rule.times:
                     continue
+                if rule.where is not None and not rule.where(ctx):
+                    continue
                 if rule.p < 1.0 and self._rng.random() >= rule.p:
                     continue
                 rule.hits += 1
@@ -479,6 +513,18 @@ class FaultInjector:
         if delay:
             time.sleep(delay)
         raise err
+
+
+def transient_os_error(e: BaseException) -> bool:
+    """Retryable-``OSError`` classification for file edges (spill
+    load/store, shapefile import): fd pressure and NFS blips retry;
+    DETERMINISTIC path errors — missing file, wrong node type, denied
+    permission — fail fast, because retrying them only stalls through
+    the backoff schedule to the identical error."""
+    return isinstance(e, OSError) and not isinstance(
+        e, (FileNotFoundError, IsADirectoryError, NotADirectoryError,
+            PermissionError),
+    )
 
 
 _injector: Optional[FaultInjector] = None
@@ -639,7 +685,7 @@ def record_skip(source: str, part: str, error: BaseException,
 
 __all__ = [
     "QueryTimeoutError", "DeadlineShedError", "AdmissionRejectedError",
-    "CircuitOpenError", "InjectedFault",
+    "CircuitOpenError", "DeviceDrainError", "InjectedFault",
     "RetryPolicy", "Deadline", "UNLIMITED", "current_deadline",
     "deadline_scope", "check_deadline",
     "CircuitBreaker", "breaker", "reset_breakers",

@@ -71,10 +71,11 @@ from concurrent.futures import Future
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
-from geomesa_tpu import config, metrics, tracing, utilization
+from geomesa_tpu import config, metrics, resilience as resilience_mod, \
+    tracing, utilization
 from geomesa_tpu.resilience import (
-    AdmissionRejectedError, Deadline, DeadlineShedError, current_deadline,
-    deadline_scope,
+    AdmissionRejectedError, Deadline, DeadlineShedError, DeviceDrainError,
+    current_deadline, deadline_scope,
 )
 
 log = logging.getLogger(__name__)
@@ -249,20 +250,36 @@ class QueryScheduler:
         self._inline_users: Dict[str, int] = {}
         #: groups executed per slot (the pool-actually-parallel gate)
         self._slot_dispatch: Dict[int, int] = {}
+        #: slot supervision (docs/RESILIENCE.md §6): the width start()
+        #: was asked for (0 = never started / stopped — supervision off),
+        #: slots flagged to DRAIN (exit typed at their next wake-up), and
+        #: the lifetime respawn count (snapshot()/debug surface)
+        self._width0 = 0
+        self._draining: set = set()
+        self._respawns = 0
+        #: per-slot spawn GENERATION (bumped every time a slot's thread
+        #: is (re)spawned): a stream captures its slot's generation at
+        #: open, and a continuation from an older generation fails typed
+        #: [GM-DRAINING] — a slot that died and respawned must never
+        #: silently RESUME a stream whose in-flight work it cannot vouch
+        #: for (docs/RESILIENCE.md §6: streams re-open, not resume)
+        self._slot_gen: Dict[int, int] = {}
+        self._last_supervise = 0.0
         self._tls = threading.local()
 
     @staticmethod
     def _pool_size() -> int:
         """Effective geomesa.serving.executors ("all" = one per device).
-        Integers clamp to the local device count: slot i pins device
-        i % D, so a width beyond D would put two dispatch threads on one
-        device — the exact violation of the one-jit-thread-per-device
-        rule the pool exists to preserve."""
+        Integers clamp to the local HEALTHY device count (cordoned/broken
+        devices hold no slot — docs/RESILIENCE.md §6): slot i pins device
+        i % D, so a width beyond the usable count would put two dispatch
+        threads on one device — the exact violation of the
+        one-jit-thread-per-device rule the pool exists to preserve."""
         raw = (config.SERVING_EXECUTORS.get() or "1").strip().lower()
         try:
-            import jax
+            from geomesa_tpu.parallel.devices import healthy_device_count
 
-            n_dev = max(1, len(jax.devices()))
+            n_dev = healthy_device_count()
         except Exception:
             n_dev = 1
         if raw in ("all", "devices"):
@@ -293,6 +310,9 @@ class QueryScheduler:
                 "users": len(self._ledger),
                 "running": bool(self._threads) and not self._stopped,
                 "executors": len(self._threads),
+                "configured_width": self._width0,
+                "respawns": self._respawns,
+                "draining": sorted(self._draining),
                 "slot_dispatches": dict(self._slot_dispatch),
                 "ewma_service_ms": round((self._ewma_all or 0.0) * 1e3, 3),
             }
@@ -381,14 +401,24 @@ class QueryScheduler:
                budget_s: Optional[float] = None,
                trace_id: Optional[str] = None,
                continuation: bool = False,
-               slot: Optional[int] = None) -> Future:
+               slot: Optional[int] = None,
+               slot_gen: Optional[int] = None) -> Future:
         """Admit one request to the dispatch queue (requires :meth:`start`).
         Raises :class:`AdmissionRejectedError` when the bounded queue is
         full and :class:`DeadlineShedError` when the budget provably cannot
         be met — both BEFORE any planning or device work. ``budget_s``
         None inherits the submitter's ambient resilience deadline.
-        ``slot`` pins a continuation to one executor slot (streams)."""
+        ``slot`` pins a continuation to one executor slot (streams);
+        ``slot_gen`` is the slot GENERATION the stream opened under — a
+        mismatch (the slot died/drained and was respawned since) fails
+        typed [GM-DRAINING], because the respawned dispatcher cannot
+        vouch for the dead one's in-flight device work."""
         user = user or _default_user()
+        # supervision rides the submit path (docs/RESILIENCE.md §6): a
+        # dead slot respawns — and a cordoned-out width re-clamps —
+        # within one scheduling round, no supervisor thread needed
+        # (throttled: the nothing-is-wrong case skips the health sweep)
+        self.supervise(throttle=True)
         if budget_s is not None:
             deadline = Deadline.after(budget_s)
         else:
@@ -397,13 +427,22 @@ class QueryScheduler:
         with self._cv:
             if self._stopped or not self._threads:
                 raise RuntimeError("serving scheduler is not running")
-            if continuation and slot is not None \
-                    and slot not in self._threads:
-                # the stream's slot thread died (dispatcher-exit backstop):
-                # no surviving slot may drive its device, so fail fast
-                # instead of enqueueing a ticket nothing will ever pick up
-                raise RuntimeError(
-                    f"serving executor slot {slot} is not running"
+            if continuation and slot is not None and (
+                slot not in self._threads
+                or (slot_gen is not None
+                    and self._slot_gen.get(slot) != slot_gen)
+            ):
+                # the stream's slot thread died or drained (gone, or
+                # respawned into a NEWER generation than the stream
+                # opened under): its device arrays belong to the dead
+                # dispatcher, so no surviving slot may drive the stream
+                # — fail fast, typed, instead of enqueueing a ticket
+                # nothing may safely pick up ([GM-DRAINING] on the wire;
+                # the supervisor respawns the SLOT, but the stream must
+                # re-open, not resume)
+                raise DeviceDrainError(
+                    f"serving executor slot {slot} died or was respawned "
+                    "since this stream opened; re-open the stream"
                 )
             led = self._led(user)
             # submitted counts EVERY attempt — shed and rejected included —
@@ -475,7 +514,8 @@ class QueryScheduler:
             budget_s: Optional[float] = None,
             trace_id: Optional[str] = None,
             continuation: bool = False,
-            slot: Optional[int] = None):
+            slot: Optional[int] = None,
+            slot_gen: Optional[int] = None):
         """Submit and wait (the ``_QueryThread.run`` shape). Without a
         dispatch thread, executes inline under admission accounting."""
         if not self._threads:
@@ -494,6 +534,7 @@ class QueryScheduler:
         fut = self.submit(
             fn, user=user, op=op, fuse=fuse, budget_s=budget_s,
             trace_id=trace_id, continuation=continuation, slot=slot,
+            slot_gen=slot_gen,
         )
         return fut.result()
 
@@ -512,11 +553,19 @@ class QueryScheduler:
         pin = self.current_slot()
         if pin is None and len(self._threads) > 1:
             pin = 0
+        # capture the slot's spawn GENERATION at stream open: chunks
+        # submitted after the slot dies/drains and respawns must fail
+        # typed [GM-DRAINING] rather than silently resume on a fresh
+        # dispatcher (docs/RESILIENCE.md §6)
+        gen = None
+        if pin is not None:
+            with self._cv:
+                gen = self._slot_gen.get(pin)
         done = object()
         while True:
             item = self.run(
                 lambda: next(it, done), user=user, op=op,
-                continuation=True, slot=pin,
+                continuation=True, slot=pin, slot_gen=gen,
             )
             if item is done:
                 return
@@ -614,6 +663,8 @@ class QueryScheduler:
         pdev.register_pool(self, n)
         with self._cv:
             self._stopped = False
+            self._width0 = n
+            self._draining.clear()
             for slot in range(n):
                 t = self._threads.get(slot)
                 if t is None or not t.is_alive():
@@ -623,6 +674,7 @@ class QueryScheduler:
                         else f"{self.name}-{slot}",
                     )
                     self._threads[slot] = t
+                    self._slot_gen[slot] = self._slot_gen.get(slot, 0) + 1
                     t.start()
                 # else: a previous stop()'s join timed out and the old
                 # thread is still draining its in-flight query — clearing
@@ -646,6 +698,8 @@ class QueryScheduler:
         block forever on futures nothing will complete)."""
         with self._cv:
             self._stopped = True
+            self._width0 = 0  # an intentional stop is not a death: the
+            self._draining.clear()  # supervisor must not respawn slots
             stranded = list(self._continuations)
             self._continuations.clear()
             for q in self._queues.values():
@@ -678,6 +732,90 @@ class QueryScheduler:
             return
         pdev.unregister_pool(self)
 
+    def _target_width(self) -> int:
+        """The width the pool SHOULD be running at: the configured width,
+        re-clamped to the healthy device count (a cordoned/broken device
+        must not keep a dispatch thread — two slots on one surviving
+        device would break the one-jit-thread-per-device rule), floored
+        at 1 so the pool never supervises itself out of existence."""
+        try:
+            from geomesa_tpu.parallel.devices import healthy_device_count
+
+            healthy = healthy_device_count()
+        except Exception:  # pragma: no cover — defensive
+            healthy = self._width0
+        return max(1, min(self._width0, healthy))
+
+    def supervise(self, throttle: bool = False) -> Dict[str, Any]:
+        """One supervision round (docs/RESILIENCE.md §6): respawn dead
+        dispatcher slots (a slot whose thread died via BaseException —
+        its pinned continuations were already failed typed by the exit
+        backstop), and re-clamp the pool width to the healthy device
+        count — slots beyond it are flagged to DRAIN (they exit typed at
+        their next wake-up, failing their pinned continuations with
+        :class:`DeviceDrainError`). Runs on every :meth:`submit` and
+        dispatch wake-up, so a killed dispatcher is back within one
+        scheduling round with the admission queue, fair-share ledgers,
+        and fusion state untouched (they live on the scheduler, not the
+        thread). Idempotent; ``throttle`` (the hot-path callers) skips
+        the full health sweep when the thread set looks whole and a
+        round ran recently — a DEAD slot (count below width) is always
+        repaired immediately, only cordon re-clamps ride the throttle
+        window."""
+        out: Dict[str, Any] = {"respawned": [], "draining": [], "width": 0}
+        if self._width0 <= 0:
+            return out
+        if throttle:
+            # compare against the LAST round's computed target (not the
+            # configured width): a cordon-shrunken pool at its clamped
+            # width is "whole" and must not pay the sweep per submit
+            now = time.monotonic()
+            whole = getattr(self, "_width_target", self._width0)
+            if len(self._threads) >= whole and not self._draining \
+                    and now - self._last_supervise < 0.25:
+                return out
+            self._last_supervise = now
+        with self._cv:
+            if self._stopped or self._width0 <= 0:
+                return out
+            # target is computed AND applied under the lock: a round
+            # that computed a stale pre-cordon target outside it could
+            # otherwise respawn the very slot a newer round just drained
+            # (the classic check-then-act race; _target_width only reads
+            # cached jax device handles + breaker states — leaf locks)
+            target = self._width_target = self._target_width()
+            # drain slots beyond the re-clamped width (never slot 0)
+            for slot in list(self._threads):
+                if slot >= target and slot not in self._draining:
+                    self._draining.add(slot)
+                    out["draining"].append(slot)
+            # respawn dead slots within it
+            for slot in range(target):
+                t = self._threads.get(slot)
+                if (t is None or not t.is_alive()) \
+                        and slot not in self._draining:
+                    nt = threading.Thread(
+                        target=self._loop, args=(slot,), daemon=True,
+                        name=self.name if slot == 0
+                        else f"{self.name}-{slot}",
+                    )
+                    self._threads[slot] = nt
+                    self._slot_gen[slot] = self._slot_gen.get(slot, 0) + 1
+                    nt.start()
+                    out["respawned"].append(slot)
+            self._respawns += len(out["respawned"])
+            out["width"] = len(self._threads)
+            if out["draining"]:
+                self._cv.notify_all()  # wake the drained slots to exit
+        for slot in out["respawned"]:
+            metrics.inc(metrics.SERVING_SLOT_RESPAWN)
+            metrics.inc(f"{metrics.SERVING_SLOT_RESPAWN}.{slot}")
+        if out["respawned"] or out["draining"]:
+            from geomesa_tpu.parallel import devices as pdev
+
+            pdev.register_pool(self, max(out["width"], 1))
+        return out
+
     def _has_work_locked(self, slot: int) -> bool:
         """Is there anything THIS slot may dispatch? (call under _cv)
         Queries are slot-free; continuations only wake their pinned slot."""
@@ -696,12 +834,31 @@ class QueryScheduler:
                 # except arm below — their callers must never hang on
                 # futures nothing will complete
                 group: List[Ticket] = []
+                drained: Optional[List[Ticket]] = None
                 try:
+                    resilience_mod.fault_point("serving.slot.loop",
+                                               slot=slot)
+                    # a surviving slot's wake-up doubles as a supervision
+                    # round: a sibling slot's death is repaired even when
+                    # no new submission arrives to trigger it
+                    self.supervise(throttle=True)
                     with self._cv:
                         while not self._stopped \
+                                and slot not in self._draining \
                                 and not self._has_work_locked(slot):
                             self._cv.wait()
-                        if self._stopped:
+                            # the WAITING dispatcher's chaos-kill point:
+                            # an idle slot that loses the race for a
+                            # ticket re-waits without reaching the
+                            # iteration-top fault point, so a seeded
+                            # kill must also be able to fire on the
+                            # wake itself (tests/test_chaos.py)
+                            resilience_mod.fault_point(
+                                "serving.slot.loop", slot=slot, wake=True
+                            )
+                        if slot in self._draining and not self._stopped:
+                            drained = self._drain_exit_locked(slot)
+                        elif self._stopped:
                             # the exit handshake happens under the lock so
                             # start() can never observe a live-looking
                             # thread that is about to return (it would
@@ -720,8 +877,22 @@ class QueryScheduler:
 
                                 pdev.unregister_pool(self)
                             return
-                        self._next_group_locked(group, slot)
-                        self._active_users[slot] = {t.user for t in group}
+                        if drained is None:
+                            self._next_group_locked(group, slot)
+                            self._active_users[slot] = \
+                                {t.user for t in group}
+                    if drained is not None:
+                        # typed drain exit (outside the lock): the pool
+                        # width was re-clamped — fail this slot's pinned
+                        # continuations with [GM-DRAINING], re-register
+                        # the SHRUNKEN device claim, and leave
+                        self._fail_drained(slot, drained)
+                        with self._cv:
+                            width = len(self._threads)
+                        from geomesa_tpu.parallel import devices as pdev
+
+                        pdev.register_pool(self, max(width, 1))
+                        return
                     if group:
                         with self._cv:
                             self._slot_dispatch[slot] = \
@@ -753,11 +924,46 @@ class QueryScheduler:
             # so callers never hang on futures nothing will complete
             self._dispatcher_exit(slot)
 
+    def _drain_exit_locked(self, slot: int) -> List[Ticket]:
+        """Remove THIS slot from the pool under a width re-clamp (call
+        under ``_cv``): unregisters the thread and collects its pinned
+        continuations for the caller to fail typed outside the lock."""
+        self._draining.discard(slot)
+        if self._threads.get(slot) is threading.current_thread():
+            del self._threads[slot]
+        stranded = [t for t in self._continuations if t.slot == slot]
+        for t in stranded:
+            self._continuations.remove(t)
+        self._pending -= len(stranded)
+        return stranded
+
+    def _fail_drained(self, slot: int, stranded: List[Ticket]) -> None:
+        """Fail a drained slot's pinned continuations with the typed
+        ``[GM-DRAINING]`` contract (docs/RESILIENCE.md §6) and flag their
+        traces for tail-sampling keep."""
+        metrics.inc(f"{metrics.SERVING_SLOT_DIED}.drained")
+        for tk in stranded:
+            tracing.mark_slot_died(tk.trace_id, slot, reason="drained")
+            if not tk.future.done():
+                tk.future.set_exception(DeviceDrainError(
+                    f"serving executor slot {slot} drained (pool width "
+                    "re-clamped after a device cordon); re-open the stream"
+                ))
+
     def _dispatcher_exit(self, slot: int = 0) -> None:
         last = False
+        died = False
         with self._cv:
+            # a slot that died while FLAGGED to drain must not leave the
+            # stale flag behind: it would block this slot's respawn
+            # forever once the width grows back (uncordon)
+            self._draining.discard(slot)
             if self._threads.get(slot) is threading.current_thread():
+                # still registered at exit = nothing de-registered this
+                # thread on purpose (stop()/drain handshakes delete the
+                # entry first): a genuine dispatcher DEATH
                 del self._threads[slot]
+                died = not self._stopped
             last = not self._threads
             if self._threads:
                 # surviving slots keep draining queries; only this slot's
@@ -774,11 +980,34 @@ class QueryScheduler:
                     stranded.extend(q)
                 self._queues.clear()
                 self._pending = 0
+        if died:
+            # a dispatcher death is never silent (docs/RESILIENCE.md §6):
+            # it counts in /metrics, and every stranded stream's trace is
+            # flagged slot_died — an always-keep class for PR 7's tail
+            # sampling, with a serving.slot.died event under the root
+            # span — so the post-mortem trace always exports. An
+            # intentional stop()/drain is NOT a death and stays quiet.
+            metrics.inc(metrics.SERVING_SLOT_DIED)
+            metrics.inc(f"{metrics.SERVING_SLOT_DIED}.{slot}")
         for tk in stranded:
+            tracing.mark_slot_died(tk.trace_id, slot, reason="died")
             if not tk.future.done():
-                tk.future.set_exception(
-                    RuntimeError("serving dispatch thread exited")
-                )
+                tk.future.set_exception(DeviceDrainError(
+                    f"serving executor slot {slot} dispatcher exited; "
+                    "re-open the stream"
+                    if tk.continuation else
+                    "serving dispatch thread exited"
+                ))
+        if died:
+            # prompt repair: the dying dispatcher's last act is a
+            # supervision round, so an IDLE pool heals immediately
+            # instead of waiting for the next submission to trigger it
+            # (stop() zeroes _width0 first, so an intentional shutdown
+            # never resurrects itself here)
+            try:
+                self.supervise()
+            except Exception:  # pragma: no cover — defensive
+                log.exception("post-death supervision failed")
         if last:
             # a fully-dead pool must release the devices (submit() already
             # raises "not running"); a concurrent start() re-registers its

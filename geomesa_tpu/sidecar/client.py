@@ -43,12 +43,17 @@ _CODE_RE = re.compile(r"\[(GM-[A-Z]+)\]")
 #: fatal — the same request would fail the same way. GM-OVERLOADED is
 #: admission-queue backpressure: the server is healthy but saturated, and
 #: the retry policy's backoff is exactly the right response.
-RETRYABLE_CODES = {"GM-INTERNAL", "GM-UNAVAILABLE", "GM-OVERLOADED"}
+#: GM-DRAINING is a drained/respawned serving slot (docs/RESILIENCE.md
+#: §6): retryable for unary requests — a respawned slot serves the
+#: retry — while streams re-open at the caller's layer.
+RETRYABLE_CODES = {"GM-INTERNAL", "GM-UNAVAILABLE", "GM-OVERLOADED",
+                   "GM-DRAINING"}
 
 #: codes that ARE a server response (the callee is healthy): they close
 #: the breaker rather than charging it — a user's bad/late/shed query
 #: must never fence the sidecar off for everyone.
-_RESPONSE_CODES = ("GM-ARG", "GM-TIMEOUT", "GM-SHED", "GM-OVERLOADED")
+_RESPONSE_CODES = ("GM-ARG", "GM-TIMEOUT", "GM-SHED", "GM-OVERLOADED",
+                   "GM-DRAINING")
 
 
 def error_code(exc: BaseException) -> Optional[str]:
@@ -212,6 +217,10 @@ class GeoFlightClient:
                 raise DeadlineShedError(str(e)) from e
             if code == "GM-TIMEOUT":
                 raise QueryTimeoutError(str(e)) from e
+            if code == "GM-DRAINING":
+                from geomesa_tpu.resilience import DeviceDrainError
+
+                raise DeviceDrainError(str(e)) from e
             raise
         self._breaker.record_success()
         return out
@@ -296,6 +305,24 @@ class GeoFlightClient:
 
     def metrics(self) -> Dict:
         return self._action("metrics")["metrics"]
+
+    def device_health(self) -> Dict:
+        """Per-device health map (ok/cordoned/broken, reassignment
+        counts, last failure — docs/RESILIENCE.md §6)."""
+        return self._action("device-health")["devices"]
+
+    def cordon_device(self, device: int,
+                      reason: Optional[str] = None) -> Dict:
+        """Remove a device from the server's scheduling (sharded-scan
+        fan-out + pool slot pinning) without a restart."""
+        body: Dict = {"device": int(device)}
+        if reason:
+            body["reason"] = str(reason)
+        return self._action("cordon-device", body)
+
+    def uncordon_device(self, device: int) -> Dict:
+        """Re-admit an explicitly cordoned device."""
+        return self._action("uncordon-device", {"device": int(device)})
 
     def serving_stats(self) -> Dict:
         """Server-side admission queue snapshot + per-user serving rollups

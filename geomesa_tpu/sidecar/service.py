@@ -184,7 +184,8 @@ def _spec_errors(fn):
     import functools
 
     from geomesa_tpu.resilience import (
-        AdmissionRejectedError, DeadlineShedError, QueryTimeoutError,
+        AdmissionRejectedError, DeadlineShedError, DeviceDrainError,
+        QueryTimeoutError,
     )
 
     @functools.wraps(fn)
@@ -198,6 +199,11 @@ def _spec_errors(fn):
             raise fl.FlightTimedOutError(f"[GM-SHED] {e}") from e
         except AdmissionRejectedError as e:
             raise fl.FlightUnavailableError(f"[GM-OVERLOADED] {e}") from e
+        except DeviceDrainError as e:
+            # PROTOCOL §7.1 v1.3: the serving slot (or its device) was
+            # drained/died under this request — retryable: a respawned
+            # slot serves the retry; streams must RE-OPEN, not resume
+            raise fl.FlightUnavailableError(f"[GM-DRAINING] {e}") from e
         except QueryTimeoutError as e:
             raise fl.FlightTimedOutError(f"[GM-TIMEOUT] {e}") from e
         except (KeyError, ValueError, NotImplementedError) as e:
@@ -568,6 +574,31 @@ class GeoFlightServer(fl.FlightServerBase):
                 "serving": self._sched.snapshot(),
                 "users": self._sched.user_rollups(),
             })
+        if kind == "device-health":
+            from geomesa_tpu.parallel import health as phealth
+
+            return ok({"devices": phealth.registry().snapshot()})
+        if kind == "cordon-device":
+            # operator drain without a restart (docs/RESILIENCE.md §6):
+            # the device leaves the sharded fan-out and pool pinning; the
+            # next supervision round re-clamps the pool width
+            from geomesa_tpu.parallel import health as phealth
+
+            did = int(body["device"])
+            phealth.registry().cordon(
+                did, reason=str(body.get("reason") or "sidecar")
+            )
+            self._sched.supervise()
+            return ok({"cordoned": did,
+                       "devices": phealth.registry().snapshot()})
+        if kind == "uncordon-device":
+            from geomesa_tpu.parallel import health as phealth
+
+            did = int(body["device"])
+            cleared = phealth.registry().uncordon(did)
+            self._sched.supervise()
+            return ok({"uncordoned": did, "was_cordoned": bool(cleared),
+                       "devices": phealth.registry().snapshot()})
         if kind == "version":
             # the distributed-version handshake (GeoMesaDataStore.scala:
             # 498-503, 615-667: client checks the server-side iterator
@@ -590,6 +621,10 @@ class GeoFlightServer(fl.FlightServerBase):
             ("metrics", "metrics registry snapshot"),
             ("cache-stats", "aggregate cache residency + hit counters"),
             ("serving-stats", "admission queue depth + per-user rollups"),
+            ("device-health", "per-device health map (ok/cordoned/broken)"),
+            ("cordon-device", "drain a device from scheduling: "
+                              "{device, reason}"),
+            ("uncordon-device", "re-admit a cordoned device: {device}"),
         ]
 
     # -- discovery ---------------------------------------------------------

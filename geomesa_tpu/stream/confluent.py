@@ -167,10 +167,21 @@ def attach_confluent(sds, name: str, registry: SchemaRegistry):
     ``stream.apply`` span + timer, and the ``stream.lag`` gauge tracks
     poll→apply latency (apply wall-clock minus the record's event time) —
     the same lag signal ``StreamingDataset.poll`` exposes, here measured
-    at the broker-facing decode/apply edge."""
+    at the broker-facing decode/apply edge.
+
+    Resilience (docs/RESILIENCE.md, ``stream.confluent.ingest`` fault
+    point): a poison record — unframeable bytes, an unresolvable schema
+    id, a malformed geometry, a keyless tombstone — must never kill the
+    consumer loop: it QUARANTINES (counted in
+    ``stream.confluent.quarantined`` + the per-schema breakdown, recorded
+    through the audit degradation trail) and ``ingest`` returns ``""``;
+    the consumer's offset advances past it. Corruption quarantines —
+    there is nothing to retry in a broken payload; transient broker
+    errors live on the broker client's side of this edge and are its
+    retry domain."""
     import time as _time
 
-    from geomesa_tpu import metrics, tracing
+    from geomesa_tpu import metrics, resilience, tracing
 
     ft = sds.get_schema(name)
     ser = ConfluentSerializer(registry, name, ft)
@@ -186,7 +197,22 @@ def attach_confluent(sds, name: str, registry: SchemaRegistry):
                ts_ms: Optional[int] = None) -> str:
         with tracing.span("stream.apply", schema=name, edge="confluent") \
                 as sp, apply_timer.time():
-            out = _ingest(data, fid, ts_ms, sp)
+            try:
+                resilience.fault_point("stream.confluent.ingest",
+                                       schema=name, fid=fid)
+                out = _ingest(data, fid, ts_ms, sp)
+            except resilience.QueryTimeoutError:
+                raise
+            except Exception as e:
+                # poison-record quarantine (never kill the consumer)
+                metrics.inc("stream.confluent.quarantined")
+                metrics.inc(f"stream.confluent.quarantined.{name}")
+                resilience.record_skip(
+                    "stream.confluent.ingest", f"{name}/{fid or '?'}", e,
+                    phase="decode",
+                )
+                sp.set(quarantined=True, error=type(e).__name__)
+                return ""
         return out
 
     def _ingest(data: Optional[bytes], fid: Optional[str],

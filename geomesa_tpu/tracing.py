@@ -33,10 +33,18 @@ import json
 import threading
 import time
 import uuid
+import weakref
 from collections import deque
 from typing import Any, Dict, List, Optional
 
 from geomesa_tpu import config, metrics
+
+#: live traces by id (weak values — a trace lives exactly as long as its
+#: holders do): the serving supervisor looks a stranded ticket's trace up
+#: here to flag it slot_died and append the root-span event
+_open: "weakref.WeakValueDictionary[str, Trace]" = (
+    weakref.WeakValueDictionary()
+)
 
 #: the innermost open span of the calling context (None = not tracing)
 _current: "contextvars.ContextVar[Optional[Span]]" = contextvars.ContextVar(
@@ -76,7 +84,7 @@ class Trace:
     __slots__ = ("trace_id", "root", "max_spans", "n_spans", "dropped",
                  "profiler", "lock", "finished", "slow_logged",
                  "error", "shed", "degraded", "recompiles", "cost",
-                 "exported", "sample_counted")
+                 "exported", "sample_counted", "slot_died", "__weakref__")
 
     def __init__(self, trace_id: Optional[str] = None):
         self.trace_id = trace_id or uuid.uuid4().hex[:16]
@@ -96,6 +104,11 @@ class Trace:
         self.cost: Dict[str, float] = {}   # per-query cost ledger
         self.exported = False              # handed to the exporter once
         self.sample_counted = False        # sampled-out counted once
+        self.slot_died = False             # serving slot died under it
+        # open-trace registry (weak): lets the serving supervisor mark a
+        # stranded stream's trace by id when its slot dies — see
+        # mark_slot_died (docs/RESILIENCE.md §6)
+        _open[self.trace_id] = self
 
     def admit(self) -> bool:
         """Reserve one span slot (False = budget exhausted, span dropped)."""
@@ -372,6 +385,33 @@ def mark_degraded() -> None:
     cur = _current.get()
     if cur is not None:
         cur.trace.degraded = True
+
+
+def mark_slot_died(trace_id: Optional[str], slot: int,
+                   reason: str = "died") -> bool:
+    """Flag the trace behind ``trace_id`` as stranded by a dying/drained
+    serving slot (docs/RESILIENCE.md §6): sets the ``slot_died``
+    always-keep class for tail sampling (tracing_export.classify) and
+    appends a ``serving.slot.died`` zero-duration event under the ROOT
+    span, so the exported/slow-logged tree records which slot took the
+    stream down. Called by the serving scheduler for each pinned
+    continuation it strands — by id, because the dying dispatcher is not
+    in the stream's span context. Returns False when no live trace holds
+    that id (tracing off / trace already collected)."""
+    if not trace_id:
+        return False
+    tr = _open.get(trace_id)
+    if tr is None:
+        return False
+    with tr.lock:
+        tr.slot_died = True
+    root = tr.root
+    if root is not None and tr.admit():
+        ev = Span("serving.slot.died", tr, root,
+                  {"slot": int(slot), "reason": reason})
+        with tr.lock:
+            root.children.append(ev)
+    return True
 
 
 #: per-thread most recently completed trace — the serving scheduler reads

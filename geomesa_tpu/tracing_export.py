@@ -59,6 +59,10 @@ def classify(trace) -> Optional[str]:
     """The always-keep class of a completed trace, or None (healthy —
     subject to the sample rate). Flags are set while the query runs
     (tracing.py), so this is a handful of attribute reads."""
+    if getattr(trace, "slot_died", False):
+        # a serving slot died/drained under this trace's stream — the
+        # device-fault post-mortem evidence (docs/RESILIENCE.md §6)
+        return "slot_died"
     if trace.shed:
         return "shed"
     if trace.error is not None:

@@ -1,0 +1,664 @@
+"""Cross-layer chaos suite (docs/RESILIENCE.md §6): device-level fault
+tolerance on the 8-virtual-device mesh.
+
+Drives the seeded fault-injection registry across the device-dispatch,
+spill, stream, and serving edges and gates the core invariants:
+
+* a failed device's partitions REASSIGN to survivors and the recovered
+  result is BIT-IDENTICAL to the healthy run (the tree merge orders by
+  pruned bin, never by device) — at mesh widths 2/4/8;
+* exhausted retries degrade typed with EXACT survivor totals, never a
+  hang;
+* per-device breakers open after the configured consecutive failures and
+  recover through the half-open trial; cordon/drain removes a device
+  from scheduling without a restart (API, config knob, CLI);
+* a killed pool dispatcher slot respawns within one scheduling round
+  with the fair-share ledgers intact; a drained slot fails its pinned
+  continuations typed (``[GM-DRAINING]``) and flags their traces for
+  tail-sampling keep;
+* the whole scenario replays identically under its seed (two runs, same
+  outcomes).
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, config, metrics, resilience, tracing
+from geomesa_tpu.filter.ecql import parse_iso_ms
+from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+from geomesa_tpu.parallel import devices as pdev
+from geomesa_tpu.parallel import health as phealth
+from geomesa_tpu.resilience import (
+    DeviceDrainError, InjectedFault, allow_partial, inject_faults,
+)
+
+SPEC = "name:String:index=true,weight:Double,dtg:Date,*geom:Point"
+PSPEC = SPEC + ";geomesa.partition='time'"
+N = 9_000
+ECQL = "BBOX(geom, -110, 28, -75, 48)"
+BBOX = (-120.0, 25.0, -70.0, 50.0)
+
+
+def _data(n=N, seed=23):
+    rng = np.random.default_rng(seed)
+    return {
+        "name": [f"actor{i % 16}" for i in range(n)],
+        "weight": rng.uniform(0, 10, n),
+        "dtg": rng.integers(
+            parse_iso_ms("2021-01-01"), parse_iso_ms("2021-03-01"), n
+        ).astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+    }
+
+
+@pytest.fixture(scope="module")
+def pds(tmp_path_factory):
+    ds = GeoDataset(n_shards=4)
+    ds.create_schema("t", PSPEC)
+    st = ds._store("t")
+    assert isinstance(st, PartitionedFeatureStore)
+    st.max_resident = 1
+    st._spill_dir = str(tmp_path_factory.mktemp("chaos_spill"))
+    ds.insert("t", _data(), fids=np.arange(N).astype(str))
+    ds.flush()
+    return ds
+
+
+@pytest.fixture(autouse=True)
+def _clean_health():
+    """Every chaos test starts and ends with a pristine device-health
+    registry and breaker set (faults must not leak between tests)."""
+    phealth.reset()
+    resilience.reset_breakers()
+    yield
+    phealth.reset()
+    resilience.reset_breakers()
+
+
+def _ctr(name: str) -> float:
+    return metrics.registry().counter(name).value
+
+
+def _fast_retries():
+    return config.RETRY_BASE_MS.scoped("0")
+
+
+# ---------------------------------------------------------------------------
+# device health: states, breakers, cordon
+# ---------------------------------------------------------------------------
+
+
+def test_health_states_cordon_and_gauge():
+    reg = phealth.registry()
+    assert reg.state(0) == "ok" and reg.usable(0)
+    reg.cordon(0, reason="maintenance")
+    assert reg.state(0) == "cordoned" and not reg.usable(0)
+    snap = reg.snapshot()["0"]
+    assert snap["state"] == "cordoned"
+    assert snap["cordon_reason"] == "maintenance"
+    g = metrics.registry().gauge(f"{metrics.DEVICE_HEALTH_PREFIX}.0")
+    assert g.value == 0.0
+    assert reg.uncordon(0) is True
+    assert reg.state(0) == "ok" and g.value == 1.0
+
+
+def test_mesh_cordon_config_knob_excludes_devices():
+    reg = phealth.registry()
+    with config.MESH_CORDON.scoped("2, 5"):
+        assert reg.state(2) == "cordoned" and reg.state(5) == "cordoned"
+        assert reg.cordon_reason(2) == "geomesa.mesh.cordon"
+        devs = pdev.scan_devices()
+        assert devs is not None
+        assert {d.id for d in devs} == {0, 1, 3, 4, 6, 7}
+    assert reg.state(2) == "ok"
+
+
+def test_breaker_opens_after_consecutive_failures_and_recovers():
+    with config.DEVICE_BREAKER_THRESHOLD.scoped("2"), \
+            config.DEVICE_BREAKER_RESET_MS.scoped("30"):
+        reg = phealth.registry()
+        err = RuntimeError("lane down")
+        reg.record_failure(3, err)
+        assert reg.state(3) == "ok"  # one failure < threshold
+        reg.record_failure(3, err)
+        assert reg.state(3) == "broken" and not reg.usable(3)
+        assert reg.snapshot()["3"]["last_failure"].startswith("RuntimeError")
+        # the broken device drops out of the fan-out
+        devs = pdev.scan_devices()
+        assert devs is not None and 3 not in {d.id for d in devs}
+        # after the reset window the half-open trial is schedulable again
+        time.sleep(0.05)
+        assert reg.usable(3)  # trial admitted
+        reg.record_success(3)
+        assert reg.state(3) == "ok"
+
+
+def test_latency_outlier_streak_trips_the_breaker():
+    with config.DEVICE_BREAKER_THRESHOLD.scoped("2"), \
+            config.DEVICE_LATENCY_OUTLIER.scoped("10"), \
+            config.DEVICE_LATENCY_FLOOR_MS.scoped("50"):
+        reg = phealth.registry()
+        for _ in range(16):  # healthy mesh baseline ~1 ms
+            reg.record_latency(0, 0.001)
+            reg.record_latency(1, 0.001)
+        reg.record_latency(6, 0.2)  # 200x the median, over the floor
+        assert reg.state(6) == "ok"  # streak of 1 < threshold 2
+        reg.record_latency(6, 0.2)
+        assert reg.state(6) == "broken"
+        assert "latency outlier" in reg.snapshot()["6"]["last_failure"]
+
+
+# ---------------------------------------------------------------------------
+# mid-scan reassignment: bit-identity + exact survivor totals
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("width", [2, 4, 8])
+def test_single_device_failure_recovers_bit_identical(pds, width):
+    """1 of W devices fails every dispatch mid-scan: its partitions
+    requeue onto the survivors and the result is bit-identical to the
+    healthy run — count and density, at mesh widths 2/4/8."""
+    with config.MESH_DEVICES.scoped(str(width)):
+        c0 = pds.count("t", ECQL)
+        d0 = pds.density("t", ECQL, bbox=BBOX, width=64, height=64)
+        bad = width - 1  # the last device of the scan rotation
+        before = _ctr(metrics.SCAN_REASSIGNED)
+        with config.FAULT_INJECTION.scoped("true"), _fast_retries(), \
+                inject_faults(seed=7) as inj:
+            inj.fail("scan.device.dispatch", InjectedFault("lane down"),
+                     times=None, where=lambda c: c.get("device") == bad)
+            c1 = pds.count("t", ECQL)
+            d1 = pds.density("t", ECQL, bbox=BBOX, width=64, height=64)
+            assert inj.fired  # the failing lane was actually exercised
+        assert c1 == c0
+        assert np.array_equal(d1, d0)
+        assert _ctr(metrics.SCAN_REASSIGNED) > before
+        assert phealth.registry().snapshot()[str(bad)]["reassigned"] > 0
+
+
+def test_exhausted_retries_degrade_with_exact_survivor_totals(pds):
+    """A partition that fails on EVERY device exhausts its retries and
+    degrades typed: the count is exact over the surviving partitions
+    (total - the dead partition's rows), never an estimate, never a
+    hang."""
+    st = pds._store("t")
+    bins = sorted(st.part_counts)
+    dead = bins[len(bins) // 2]
+    total = pds.count("t", "INCLUDE")
+    with config.FAULT_INJECTION.scoped("true"), _fast_retries(), \
+            inject_faults(seed=11) as inj:
+        inj.fail("scan.device.dispatch", InjectedFault("bad partition"),
+                 times=None, where=lambda c: c.get("bin") == dead)
+        with allow_partial() as partial:
+            got = pds.count("t", "INCLUDE")
+    assert partial.degraded
+    assert {s.part for s in partial.skipped} == {f"bin:{dead}"}
+    assert got == total - st.part_counts[dead]  # exact survivor totals
+    # strict mode: the same failure is a typed error, not a wedge
+    with config.FAULT_INJECTION.scoped("true"), _fast_retries(), \
+            inject_faults(seed=11) as inj:
+        inj.fail("scan.device.dispatch", InjectedFault("bad partition"),
+                 times=None, where=lambda c: c.get("bin") == dead)
+        with pytest.raises(InjectedFault):
+            pds.count("t", "INCLUDE")
+
+
+def test_cordoned_device_receives_no_partitions(pds):
+    reg = phealth.registry()
+    reg.cordon(2, reason="drain test")
+    before = _ctr(f"{metrics.SCAN_SHARDED_DEVICE}.2")
+    c_ref = None
+    with config.MESH_DEVICES.scoped("off"):
+        c_ref = pds.count("t", ECQL)
+    assert pds.count("t", ECQL) == c_ref  # bit-identical around the hole
+    assert _ctr(f"{metrics.SCAN_SHARDED_DEVICE}.2") == before
+    reg.uncordon(2)
+
+
+def test_mid_scan_cordon_is_honored_between_partitions(pds):
+    """A device cordoned WHILE a scan runs stops receiving partitions at
+    its next turn (the rotation checks health per dispatch)."""
+    reg = phealth.registry()
+    seen = []
+    orig = phealth.DeviceHealthRegistry.usable
+
+    def spy(self, did):
+        out = orig(self, did)
+        seen.append((did, out))
+        if len(seen) == 3:  # cordon early, mid-scan
+            reg.cordon(1, reason="mid-scan")
+        return out
+
+    try:
+        phealth.DeviceHealthRegistry.usable = spy
+        with config.MESH_DEVICES.scoped("off"):
+            ref = pds.count("t", "INCLUDE")
+        assert pds.count("t", "INCLUDE") == ref
+    finally:
+        phealth.DeviceHealthRegistry.usable = orig
+        reg.uncordon(1)
+
+
+# ---------------------------------------------------------------------------
+# spill edges: transient retry, corrupt quarantine, store never loses data
+# ---------------------------------------------------------------------------
+
+
+def test_spill_load_transient_oserror_retries_in_place(pds):
+    ref = pds.count("t", ECQL)
+    with config.FAULT_INJECTION.scoped("true"), _fast_retries(), \
+            inject_faults(seed=3) as inj:
+        inj.fail("index.spill.load", OSError("nfs blip"), times=2)
+        assert pds.count("t", ECQL) == ref  # retried, not degraded
+        assert len(inj.fired) == 2
+    assert pds._store("t").spill_quarantine() == {}
+
+
+def test_spill_load_corruption_quarantines_and_clears(pds):
+    st = pds._store("t")
+    total = pds.count("t", "INCLUDE")
+    with config.FAULT_INJECTION.scoped("true"), _fast_retries(), \
+            inject_faults(seed=4) as inj:
+        rule = inj.fail("index.spill.load", ValueError("bad npz"),
+                        times=1)
+        with allow_partial() as partial:
+            got = pds.count("t", "INCLUDE")
+        assert rule.hits == 1
+    assert partial.degraded and len(partial.skipped) == 1
+    (skip,) = partial.skipped
+    assert skip.source == "index.spill.load"
+    dead = int(skip.part.split(":")[1])
+    assert got == total - st.part_counts[dead]
+    # quarantined: the next load fails fast without re-parsing …
+    q = st.spill_quarantine()
+    assert list(q) == [dead]
+    with allow_partial():
+        assert pds.count("t", "INCLUDE") == got
+    # … until the operator re-admits it
+    assert st.clear_spill_quarantine() == [dead]
+    assert pds.count("t", "INCLUDE") == total
+
+
+def test_spill_store_failure_never_loses_the_partition(pds):
+    st = pds._store("t")
+    ref = pds.count("t", "INCLUDE")
+    with config.FAULT_INJECTION.scoped("true"), \
+            config.RETRY_ATTEMPTS.scoped("1"), \
+            inject_faults(seed=5) as inj:
+        inj.fail("index.spill.store", OSError("disk full"), times=None)
+        # force fresh rows into a partition, then evict under the fault
+        extra = _data(64, seed=99)
+        pds.insert("t", extra, fids=[f"x{i}" for i in range(64)])
+        try:
+            pds.flush("t")
+        except OSError:
+            pass  # the spill backed off …
+    # … but the partition stayed resident: nothing was lost
+    assert pds.count("t", "INCLUDE") == ref + 64
+
+
+# ---------------------------------------------------------------------------
+# stream edge: poison records quarantine, never kill the consumer
+# ---------------------------------------------------------------------------
+
+
+def test_confluent_poison_record_quarantines():
+    from geomesa_tpu.stream.confluent import SchemaRegistry, attach_confluent
+    from geomesa_tpu.stream.live import StreamingDataset
+
+    sds = StreamingDataset()
+    sds.create_schema("c", SPEC)
+    reg = SchemaRegistry()
+    ser, ingest = attach_confluent(sds, "c", reg)
+    before = _ctr("stream.confluent.quarantined")
+    assert ingest(b"\x01not-a-frame") == ""        # bad magic
+    assert ingest(None) == ""                      # keyless tombstone
+    assert _ctr("stream.confluent.quarantined") == before + 2
+    # the consumer loop survives: a good record still applies
+    ingest(ser.serialize("f1", {
+        "name": "ok", "weight": 1.0, "dtg": 1578182400000,
+        "geom": "POINT (1 2)",
+    }))
+    sds.poll("c")
+    assert len(sds.cache("c")) == 1
+
+
+def test_confluent_injected_fault_quarantines():
+    from geomesa_tpu.stream.confluent import SchemaRegistry, attach_confluent
+    from geomesa_tpu.stream.live import StreamingDataset
+
+    sds = StreamingDataset()
+    sds.create_schema("c", SPEC)
+    reg = SchemaRegistry()
+    ser, ingest = attach_confluent(sds, "c", reg)
+    good = ser.serialize("f1", {
+        "name": "ok", "weight": 1.0, "dtg": 1578182400000,
+        "geom": "POINT (1 2)",
+    })
+    with config.FAULT_INJECTION.scoped("true"), inject_faults(seed=6) as inj:
+        inj.fail("stream.confluent.ingest", ValueError("decoder blew up"),
+                 times=1)
+        assert ingest(good) == ""   # quarantined, not raised
+        assert ingest(good) == "f1"  # next record applies normally
+
+
+# ---------------------------------------------------------------------------
+# serving pool: slot death -> respawn; drain -> typed strand
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_slot_death_respawns_within_one_round_ledgers_survive():
+    ds = GeoDataset()
+    ds.create_schema("s", SPEC)
+    ds.insert("s", _data(128, seed=1), fids=np.arange(128).astype(str))
+    ds.flush()
+    died0 = _ctr(metrics.SERVING_SLOT_DIED)
+    resp0 = _ctr(metrics.SERVING_SLOT_RESPAWN)
+    with config.SERVING_EXECUTORS.scoped("2"), \
+            config.FAULT_INJECTION.scoped("true"), \
+            inject_faults(seed=8) as inj:
+        inj.fail("serving.slot.loop", SystemExit("chaos kill"), times=1,
+                 where=lambda c: c.get("slot") == 1)
+        s = ds.serving.start()
+        try:
+            # slot 1 dies on its first loop iteration (the armed kill) —
+            # wait on the death METRIC, not the width: a sibling slot's
+            # wake-up may have respawned it already, which only proves
+            # the supervisor is faster than this poll
+            for _ in range(500):
+                if _ctr(metrics.SERVING_SLOT_DIED) >= died0 + 1:
+                    break
+                time.sleep(0.01)
+            assert _ctr(metrics.SERVING_SLOT_DIED) == died0 + 1
+            # ledger state from before the death …
+            s.submit(lambda: ds.count("s", "INCLUDE"),
+                     user="alice", op="count").result(timeout=30)
+            pre = s.user_rollups()["alice"]
+            # … survives the respawn, which happens within the round the
+            # next submission triggers
+            s.submit(lambda: ds.count("s", "INCLUDE"),
+                     user="alice", op="count").result(timeout=30)
+            snap = s.snapshot()
+            assert snap["executors"] == 2
+            assert snap["respawns"] >= 1
+            assert _ctr(metrics.SERVING_SLOT_RESPAWN) >= resp0 + 1
+            post = s.user_rollups()["alice"]
+            assert post["completed"] == pre["completed"] + 1
+            assert post["service_ms"] >= pre["service_ms"]
+            # queued/inflight work keeps flowing on the healed pool
+            futs = [s.submit(lambda: ds.count("s", "INCLUDE"),
+                             user=f"u{i}", op="count") for i in range(4)]
+            for f in futs:
+                f.result(timeout=30)
+            # the respawn is visible in /debug/devices (pool digest)
+            from geomesa_tpu import obs
+
+            dd = obs.debug_devices(ds)
+            assert dd["pool"]["respawns"] >= 1
+            assert dd["pool"]["executors"] == 2
+        finally:
+            s.stop()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_slot_death_strands_pinned_continuation_typed():
+    """A queued continuation pinned to a dying slot fails with the typed
+    [GM-DRAINING] contract and its trace joins the always-keep classes
+    with a serving.slot.died root-span event."""
+    ds = GeoDataset()
+    root = tracing.start("stream", trace_id="chaostrace000001",
+                         force=True)
+    trace = root.trace
+    width = 2
+    started = threading.Barrier(width + 1, timeout=15)
+    release = threading.Event()
+
+    def blocker():
+        started.wait(15)
+        release.wait(15)
+
+    with config.SERVING_EXECUTORS.scoped(str(width)), \
+            config.FAULT_INJECTION.scoped("true"), \
+            inject_faults(seed=9) as inj:
+        s = ds.serving.start()
+        try:
+            # occupy BOTH slots so the pinned continuation stays queued
+            blockers = [s.submit(blocker, user="b", op="block")
+                        for _ in range(width)]
+            started.wait(15)  # both slots are EXECUTING their blocker
+            cont = s.submit(lambda: "never runs", user="stream",
+                            op="chunk", continuation=True, slot=1,
+                            trace_id="chaostrace000001")
+            # kill slot 1 at its NEXT loop iteration (after its blocker)
+            inj.fail("serving.slot.loop", SystemExit("chaos kill"),
+                     times=1, where=lambda c: c.get("slot") == 1)
+            release.set()
+            for f in blockers:
+                f.result(timeout=30)
+            with pytest.raises(DeviceDrainError, match="re-open"):
+                cont.result(timeout=30)
+            assert trace.slot_died is True
+            from geomesa_tpu import tracing_export
+
+            assert tracing_export.classify(trace) == "slot_died"
+            names = [c.name for c in trace.root.children]
+            assert "serving.slot.died" in names
+        finally:
+            s.stop()
+    root.finish()
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_respawned_slot_rejects_stale_generation_continuations():
+    """A stream that opened under slot generation G must NOT silently
+    resume on the respawned (G+1) dispatcher — the dead dispatcher's
+    in-flight device work cannot be vouched for. A stale-generation
+    continuation fails typed [GM-DRAINING] even though the slot LOOKS
+    alive again."""
+    ds = GeoDataset()
+    with config.SERVING_EXECUTORS.scoped("2"), \
+            config.FAULT_INJECTION.scoped("true"), \
+            inject_faults(seed=10) as inj:
+        s = ds.serving.start()
+        try:
+            with s._cv:
+                gen0 = s._slot_gen[1]
+            inj.fail("serving.slot.loop", SystemExit("chaos kill"),
+                     times=1, where=lambda c: c.get("slot") == 1)
+            s.submit(lambda: None, user="w", op="wake").result(timeout=30)
+            # wait for the respawn (a new generation for slot 1)
+            for _ in range(500):
+                with s._cv:
+                    alive = 1 in s._threads and s._slot_gen[1] > gen0
+                if alive:
+                    break
+                time.sleep(0.01)
+            with s._cv:
+                assert s._slot_gen[1] > gen0
+            # the slot is back — but THIS stream's chunks must re-open
+            with pytest.raises(DeviceDrainError, match="re-open"):
+                s.submit(lambda: "chunk", user="stream", op="chunk",
+                         continuation=True, slot=1, slot_gen=gen0)
+            # a freshly-opened stream (current generation) is served
+            with s._cv:
+                gen1 = s._slot_gen[1]
+            assert s.submit(lambda: "chunk", user="stream", op="chunk",
+                            continuation=True, slot=1,
+                            slot_gen=gen1).result(timeout=30) == "chunk"
+        finally:
+            s.stop()
+
+
+def test_cordon_drains_excess_slots_and_rejects_their_streams():
+    """Cordoning devices below the pool width re-clamps it: the excess
+    slot drains (typed), new pinned continuations for it are rejected
+    [GM-DRAINING], and slot 0 keeps serving."""
+    ds = GeoDataset()
+    reg = phealth.registry()
+    with config.SERVING_EXECUTORS.scoped("2"):
+        s = ds.serving.start()
+        try:
+            assert s.snapshot()["executors"] == 2
+            for did in range(1, 8):
+                reg.cordon(did, reason="shrink")
+            out = s.supervise()
+            assert 1 in out["draining"]
+            for _ in range(200):
+                if s.snapshot()["executors"] == 1:
+                    break
+                time.sleep(0.01)
+            assert s.snapshot()["executors"] == 1
+            with pytest.raises(DeviceDrainError):
+                s.submit(lambda: None, user="x", op="chunk",
+                         continuation=True, slot=1)
+            # the surviving slot still serves queries
+            assert s.submit(lambda: 42, user="x",
+                            op="q").result(timeout=30) == 42
+            assert pdev.pool_width() == 1
+        finally:
+            s.stop()
+            for did in range(1, 8):
+                reg.uncordon(did)
+
+
+def test_sidecar_wire_code_for_drained_slot():
+    """DeviceDrainError crosses the Flight wire as [GM-DRAINING]
+    (PROTOCOL §7.1, retryable)."""
+    fl = pytest.importorskip("pyarrow.flight")
+    from geomesa_tpu.sidecar.service import _spec_errors
+
+    @_spec_errors
+    def boom():
+        raise DeviceDrainError("slot 1 drained; re-open the stream")
+
+    with pytest.raises(fl.FlightUnavailableError, match=r"\[GM-DRAINING\]"):
+        boom()
+
+
+# ---------------------------------------------------------------------------
+# the concurrent seeded scenario: deterministic, never hangs, breakers real
+# ---------------------------------------------------------------------------
+
+
+def _chaos_round(pds, seed: int):
+    """One seeded chaos pass over the query + spill edges; returns the
+    outcome list (results + degradation counts) for determinism
+    comparison. Prefetch is disabled so every fault point fires on the
+    query thread in program order — the property that makes the seeded
+    run replayable."""
+    outcomes = []
+    with config.FAULT_INJECTION.scoped("true"), _fast_retries(), \
+            config.PIPELINE_PREFETCH.scoped("false"), \
+            inject_faults(seed=seed) as inj:
+        inj.fail("scan.device.dispatch", InjectedFault("flaky lane"),
+                 p=0.3, times=None)
+        inj.fail("index.spill.load", OSError("nfs blip"), p=0.15,
+                 times=None)
+        for ecql in (ECQL, "INCLUDE", "BBOX(geom, -100, 30, -80, 45)"):
+            with allow_partial() as partial:
+                c = pds.count("t", ecql)
+                d = pds.density("t", ecql, bbox=BBOX, width=32, height=32)
+            outcomes.append(
+                (c, float(d.sum()), len(partial.skipped),
+                 sorted({s.part for s in partial.skipped}))
+            )
+        fired = list(inj.fired)
+    return outcomes, fired
+
+
+def test_chaos_scenario_is_seeded_deterministic_and_never_hangs(pds):
+    t0 = time.monotonic()
+    out1, fired1 = _chaos_round(pds, seed=42)
+    phealth.reset()
+    resilience.reset_breakers()
+    pds._store("t").clear_spill_quarantine()
+    out2, fired2 = _chaos_round(pds, seed=42)
+    elapsed = time.monotonic() - t0
+    assert out1 == out2            # identical outcomes under the seed
+    assert fired1 == fired2        # identical fault schedule
+    assert elapsed < 120           # and nothing wedged
+    # a healthy follow-up run is untouched by the chaos residue
+    phealth.reset()
+    resilience.reset_breakers()
+    pds._store("t").clear_spill_quarantine()
+    with config.MESH_DEVICES.scoped("off"):
+        ref = pds.count("t", ECQL)
+    assert pds.count("t", ECQL) == ref
+
+
+def test_chaos_breakers_open_and_healthz_reflects_reality(pds):
+    """Persistent failure of one device opens its breaker mid-scan;
+    /healthz degrades SOFTLY (200, capacity remains) and /debug/devices
+    names the broken lane; recovery closes it again."""
+    from geomesa_tpu import obs
+
+    with config.DEVICE_BREAKER_THRESHOLD.scoped("2"), \
+            config.DEVICE_BREAKER_RESET_MS.scoped("50"), \
+            config.FAULT_INJECTION.scoped("true"), _fast_retries(), \
+            inject_faults(seed=13) as inj:
+        inj.fail("scan.device.dispatch", InjectedFault("dead lane"),
+                 times=None, where=lambda c: c.get("device") == 4)
+        with config.MESH_DEVICES.scoped("off"):
+            ref = pds.count("t", "INCLUDE")
+        assert pds.count("t", "INCLUDE") == ref   # reassigned, recovered
+        # the second scan's first dispatch to device 4 is failure #2:
+        # the breaker opens mid-scan and the lane drops out — still
+        # bit-identical around the hole
+        assert pds.count("t", "INCLUDE") == ref
+        reg = phealth.registry()
+        assert reg.state(4) == "broken"
+        h = obs.health()
+        assert h["status"] == "degraded" and h["soft"] is True
+        assert 4 in h["mesh"]["broken"]
+        assert h["mesh"]["usable"] == h["mesh"]["total"] - 1
+        code, _, _ = obs.handle("/healthz")
+        assert code == 200  # degraded-not-503: capacity remains
+        dd = obs.debug_devices()
+        assert dd["health"]["4"]["state"] == "broken"
+    # recovery: reset window elapses, the next scan's trial succeeds
+    time.sleep(0.08)
+    assert pds.count("t", "INCLUDE") == ref
+    assert phealth.registry().state(4) == "ok"
+    assert obs.health()["status"] == "ok"
+
+
+def test_healthz_hard_503_when_no_capacity_remains():
+    from geomesa_tpu import obs
+
+    reg = phealth.registry()
+    obs.device_health()  # prime the device probe cache
+    total = len(obs.device_health().get("devices") or ())
+    assert total == 8
+    for did in range(total):
+        reg.cordon(did, reason="full drain")
+    try:
+        h = obs.health()
+        assert h["status"] == "degraded" and h["soft"] is False
+        code, _, _ = obs.handle("/healthz")
+        assert code == 503
+    finally:
+        for did in range(total):
+            reg.uncordon(did)
+
+
+def test_cli_devices_cordon_uncordon(capsys):
+    from geomesa_tpu import cli
+
+    cli.main(["devices", "cordon", "6", "--reason", "maint"])
+    out = capsys.readouterr().out
+    assert '"cordoned"' in out and "maint" in out
+    assert phealth.registry().state(6) == "cordoned"
+    cli.main(["devices", "uncordon", "6"])
+    capsys.readouterr()
+    assert phealth.registry().state(6) == "ok"
+    cli.main(["devices"])
+    out = capsys.readouterr().out
+    assert '"health"' in out
