@@ -789,6 +789,95 @@ def main():
             f"pan={cnt_pan*1e3:.1f}ms\n"
         )
 
+        # Hierarchical pre-aggregation (docs/CACHE.md): fine-level quadrant
+        # queries warm the level-(k+1) cells, then a domain-spanning
+        # zoom-out decomposes over level-k cells. FLAT arm (hierarchy off):
+        # every coarse cell misses and scans. HIER arm: coarse cells are
+        # pre-merged from the fine cells (bottom-up rollup / on-miss
+        # assembly) — the zoom-out must execute ZERO device dispatches and
+        # match the uncached full scan bit-for-bit (the smoke-CI gate).
+        zoom = f"BBOX(geom, -180, -90, 180, 90) AND {during}"
+        quads = [
+            f"BBOX(geom, -180, -90, 0, 0) AND {during}",
+            f"BBOX(geom, 0, -90, 180, 0) AND {during}",
+            f"BBOX(geom, -180, 0, 0, 90) AND {during}",
+            f"BBOX(geom, 0, 0, 180, 90) AND {during}",
+        ]
+        zoom_exact = ds.count("gdelt", zoom)  # cache-disabled oracle
+        _disp = _metrics.registry().counter(_metrics.EXEC_DEVICE_DISPATCH)
+        import contextlib as _ctx
+
+        # smoke: coarser decomposition (8 coarse / 32 fine cells instead
+        # of 64/256) keeps the two warm-up passes inside the CI budget;
+        # the gates (zero residual, served fraction, bit-identity) are
+        # granularity-independent. The full bench keeps the default.
+        _zoom_axis = (_cfg.CACHE_CELLS_PER_AXIS.scoped("4") if smoke
+                      else _ctx.nullcontext())
+        with _cfg.CACHE_ENABLED.scoped("true"), _zoom_axis:
+            with _cfg.CACHE_HIERARCHY.scoped("false"):
+                ds.cache.store.invalidate()
+                for qq in quads:
+                    ds.count("gdelt", qq)
+                zoom_flat = _timed(lambda: ds.count("gdelt", zoom))
+            ds.cache.store.invalidate()
+            for qq in quads:
+                ds.count("gdelt", qq)
+            d0 = _disp.value
+            zoom_n = [None]
+            zoom_warm = _timed(lambda: zoom_n.__setitem__(
+                0, ds.count("gdelt", zoom)))
+            zoom_dispatches = _disp.value - d0
+            zev = ds.audit.recent(1)[0]
+            zhits, ztotal = map(
+                int, zev.hints["exec_path"]["cache_cells"].split("/"))
+        assert zoom_n[0] == zoom_exact, (
+            f"hierarchy zoom-out NOT bit-identical: {zoom_n[0]} vs "
+            f"{zoom_exact}"
+        )
+        cache_keys.update({
+            "cache_zoomout_flat_ms": round(zoom_flat * 1e3, 2),
+            "cache_zoomout_warm_ms": round(zoom_warm * 1e3, 2),
+            "cache_zoomout_speedup": round(
+                zoom_flat / max(zoom_warm, 1e-9), 2
+            ),
+            "zoomout_zero_residual": zoom_dispatches == 0,
+            "hierarchy_served_fraction": round(zhits / max(ztotal, 1), 4),
+        })
+        sys.stderr.write(
+            f"hierarchy: zoom-out flat={zoom_flat*1e3:.1f}ms "
+            f"warm={zoom_warm*1e3:.1f}ms "
+            f"({cache_keys['cache_zoomout_speedup']}x, "
+            f"dispatches={zoom_dispatches}, cells={zhits}/{ztotal})\n"
+        )
+
+        # Polygon-region aggregates (docs/CACHE.md): cold = decomposed
+        # interior cells + exact boundary scan, warm = whole-result hit.
+        # Bit-identity vs the cache-disabled scan is hard-asserted.
+        poly = ("POLYGON((-120 26, -84 25, -70 42, -100 48, -122 46, "
+                "-120 26))")
+        poly_q = f"INTERSECTS(geom, {poly}) AND {during}"
+        poly_exact = ds.count("gdelt", poly_q)
+        with _cfg.CACHE_ENABLED.scoped("true"):
+            pn = [None]
+            poly_cold = _timed(lambda: pn.__setitem__(
+                0, ds.count("gdelt", poly_q)))
+            pw = [None]
+            poly_warm = _timed(lambda: pw.__setitem__(
+                0, ds.count("gdelt", poly_q)))
+        assert pn[0] == poly_exact and pw[0] == poly_exact, (
+            f"polygon aggregate NOT bit-identical: cold {pn[0]} warm "
+            f"{pw[0]} vs exact {poly_exact}"
+        )
+        cache_keys.update({
+            "cache_polygon_cold_ms": round(poly_cold * 1e3, 2),
+            "cache_polygon_warm_ms": round(poly_warm * 1e3, 2),
+            "polygon_bit_identical": True,
+        })
+        sys.stderr.write(
+            f"polygon: cold={poly_cold*1e3:.1f}ms "
+            f"warm={poly_warm*1e3:.1f}ms (exact n={poly_exact})\n"
+        )
+
     # Observability snapshot (docs/OBSERVABILITY.md): the perf trajectory
     # carries the registry's warm-path/cache/pipeline counters and the
     # query-stage latency distribution, so a regression in ANY of them is
@@ -823,6 +912,10 @@ def main():
         "cache_hit": _metric("cache.hit"),
         "cache_partial": _metric("cache.partial"),
         "cache_miss": _metric("cache.miss"),
+        "cache_hierarchy_hit": _metric("cache.hierarchy.hit"),
+        "cache_hierarchy_promote": _metric("cache.hierarchy.promote"),
+        "cache_hierarchy_residual": _metric("cache.hierarchy.residual"),
+        "cache_polygon": _metric("cache.polygon"),
         "serving_fused": _metric("serving.fused"),
         "serving_shed": _metric("serving.shed.deadline"),
         "device_dispatches": _metric("exec.device.dispatch"),

@@ -520,12 +520,16 @@ class GeoDataset:
 
     @_traced("explain")
     def explain(self, name: str, query: "str | Query",
-                analyze: bool = False) -> str:
+                analyze: bool = False, region=None) -> str:
         """Planner explain tree. ``analyze=True`` additionally resolves the
         scan windows and runs a count so the output reports selectivity —
-        candidate (scanned) rows vs matched rows — the over-scan signal."""
+        candidate (scanned) rows vs matched rows — the over-scan signal.
+        ``region``: optional polygon, folded in exactly as the aggregate
+        entry points do (see :meth:`density`)."""
         exp = Explainer(enabled=True)
-        st, _, plan = self._plan(name, query, exp)
+        st, q0, plan = self._plan(
+            name, self._with_region(name, query, region), exp
+        )
         # cache participation (docs/CACHE.md): would this query be served
         # from / populate the aggregate cache, and in what shape?
         from geomesa_tpu.cache import decompose
@@ -539,8 +543,42 @@ class GeoDataset:
                    f"{len(d.strips)} boundary strips")
             exp.kv("residual filter", d.residual_key)
         else:
-            exp.line("partial-cover: not decomposable "
-                     "(whole-result caching only)")
+            from geomesa_tpu.cache import decompose_region
+
+            dr = decompose_region(plan.filter, st.ft)
+            if dr is not None:
+                exp.kv("polygon cover", f"level {dr.level}, "
+                       f"{len(dr.cells)} interior cells, "
+                       f"{len(dr.boundary)} boundary cells")
+                exp.kv("residual filter", dr.residual_key)
+            else:
+                exp.line("partial-cover: not decomposable "
+                         "(whole-result caching only)")
+        exp.pop()
+        # hierarchical pre-aggregation posture (docs/CACHE.md): would this
+        # query's cells be served from the quadtree, and from which levels?
+        from geomesa_tpu.cache import hierarchy as _hier
+
+        exp.push("Hierarchy")
+        exp.kv("enabled", _hier.enabled())
+        exp.kv("depth", _hier.depth())
+        probe = (self.cache.probe_cover(self, st, q0, plan)
+                 if _hier.enabled() else None)
+        if probe is not None:
+            served = sum(probe["levels"].values())
+            exp.kv(
+                "cells resident/assemblable",
+                f"{served}/{probe['cells']}"
+                + (f" ({probe['boundary']} boundary cells scan exactly)"
+                   if probe["kind"] == "polygon" else ""),
+            )
+            if probe["levels"]:
+                exp.kv("levels hit", ", ".join(
+                    f"L{lvl}={n}" for lvl, n in sorted(probe["levels"].items())
+                ))
+            exp.kv("residual fraction", probe["residual_fraction"])
+        else:
+            exp.line("no cell cover for this query (whole-result only)")
         exp.pop()
         # warm-path posture (docs/PERF.md): shape bucketing + the shared
         # version-stable kernel registry + the partition prefetch pipeline
@@ -901,10 +939,45 @@ class GeoDataset:
 
         return _iter()
 
+    def _with_region(self, name: str, query: "str | Query", region):
+        """Fold a polygon ``region`` into the query as one INTERSECTS
+        conjunct on the schema's geometry — the canonical aggregate-over-
+        polygon shape (docs/CACHE.md): the cache decomposes it into
+        interior cells (hierarchy-served) plus an exact boundary scan.
+        ``region``: WKT text or a geometry object. Composed as ECQL TEXT
+        when the query is textual, so the plan cache, the version-stable
+        kernel tokens, and the serving fusion keys (docs/SERVING.md) all
+        see the polygon — two different regions can never fuse or share a
+        whole-result entry."""
+        if region is None:
+            return query
+        from geomesa_tpu.utils import geometry as geo
+
+        geom = self._store(name).ft.geom_field
+        if geom is None:
+            raise ValueError(f"schema {name!r} has no geometry field")
+        wkt = region if isinstance(region, str) else region.wkt()
+        geo.parse_wkt(wkt)  # validate before it reaches the planner
+        conjunct = f"INTERSECTS({geom}, {wkt})"
+        q = query if isinstance(query, Query) else Query(ecql=query)
+        if not isinstance(q.ecql, str):
+            combined: "str | ir.Filter" = ir.And(
+                (q.ecql, parse_ecql(conjunct))
+            )
+        elif q.ecql.strip().upper() == "INCLUDE":
+            combined = conjunct
+        else:
+            combined = f"({q.ecql}) AND {conjunct}"
+        import dataclasses
+
+        q = dataclasses.replace(q, ecql=combined)
+        return q if isinstance(query, Query) or not isinstance(combined, str) \
+            else combined
+
     @_traced("count")
     def count(self, name: str, query: "str | Query" = "INCLUDE",
-              exact: bool = True) -> int:
-        st, q, plan = self._plan(name, query)
+              exact: bool = True, region=None) -> int:
+        st, q, plan = self._plan(name, self._with_region(name, query, region))
         if not exact:
             return int(plan.est_count)
         t0 = time.perf_counter()
@@ -925,9 +998,13 @@ class GeoDataset:
     @_traced("density")
     def density(self, name: str, query: "str | Query" = "INCLUDE",
                 bbox=None, width: int = 256, height: int = 256,
-                weight: Optional[str] = None) -> np.ndarray:
-        """Heatmap grid (DensityProcess / DensityScan analog)."""
-        st, q, plan = self._plan(name, query)
+                weight: Optional[str] = None, region=None) -> np.ndarray:
+        """Heatmap grid (DensityProcess / DensityScan analog). ``region``:
+        optional polygon (WKT or geometry) clipping the aggregate — folded
+        in as an INTERSECTS conjunct; with the cache enabled the interior
+        decomposes over hierarchy cells and only the polygon boundary
+        scans (docs/CACHE.md)."""
+        st, q, plan = self._plan(name, self._with_region(name, query, region))
         if bbox is None:
             bbox = self.bounds(name) or (-180, -90, 180, 90)
             bbox = (bbox[0], bbox[1], bbox[2], bbox[3])
@@ -1087,9 +1164,11 @@ class GeoDataset:
 
     @_traced("stats")
     def stats(self, name: str, stat_spec: str,
-              query: "str | Query" = "INCLUDE") -> sk.Stat:
-        """Exact stats over matching features (StatsProcess/StatsScan analog)."""
-        st, q, plan = self._plan(name, query)
+              query: "str | Query" = "INCLUDE", region=None) -> sk.Stat:
+        """Exact stats over matching features (StatsProcess/StatsScan
+        analog). ``region``: optional polygon (WKT or geometry) — see
+        :meth:`density`."""
+        st, q, plan = self._plan(name, self._with_region(name, query, region))
         parse_stat(stat_spec)  # validate the spec before any timing/scan
         t0 = time.perf_counter()
         with metrics.registry().timer("query.stats").time(), \

@@ -1,17 +1,26 @@
 """Spatial aggregate cache (docs/CACHE.md).
 
-SFC-cell result caching with epoch invalidation and partial-cover reuse:
-repeated and overlapping pushdown aggregates (density grids, stats sketches,
-counts) are served from memoized per-cell partials, so repeat latency is
-independent of dataset size. Off by default; enable with
-``geomesa.cache.enabled=true`` (GEOMESA_CACHE_ENABLED=true).
+SFC-cell result caching with epoch invalidation, partial-cover reuse, a
+hierarchical pre-aggregation quadtree (coarse cells assemble from cached
+children — zoom-out costs O(visible cells), not O(data)), and polygon-
+region decomposition (interior cells cache-served, boundary cells scanned
+exactly): repeated and overlapping pushdown aggregates (density grids,
+stats sketches, counts, curve-block grids) are served from memoized
+per-cell partials, so repeat latency is independent of dataset size. Off
+by default; enable with ``geomesa.cache.enabled=true``
+(GEOMESA_CACHE_ENABLED=true).
 """
 
-from geomesa_tpu.cache.cells import Decomposition, decompose, split_bbox_conjunct
+from geomesa_tpu.cache import hierarchy
+from geomesa_tpu.cache.cells import (
+    Decomposition, RegionDecomposition, decompose, decompose_region,
+    split_bbox_conjunct, split_region_conjunct,
+)
 from geomesa_tpu.cache.service import EXACT_MERGE_KINDS, AggregateCache
 from geomesa_tpu.cache.store import CacheStore
 
 __all__ = [
-    "AggregateCache", "CacheStore", "Decomposition", "decompose",
-    "split_bbox_conjunct", "EXACT_MERGE_KINDS",
+    "AggregateCache", "CacheStore", "Decomposition", "RegionDecomposition",
+    "decompose", "decompose_region", "split_bbox_conjunct",
+    "split_region_conjunct", "hierarchy", "EXACT_MERGE_KINDS",
 ]

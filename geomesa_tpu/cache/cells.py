@@ -26,8 +26,27 @@ Decomposition applies when the schema's geometry is a POINT and the filter
 constrains it with exactly one BBox conjunct at the top level (the pan/zoom
 shape); anything richer — extent (line/polygon) geometry columns, whose
 features intersect multiple cells and would be counted once per cell,
-polygon query literals, spatial predicates under OR/NOT, multiple boxes —
-falls back to whole-result caching, which is always safe.
+spatial predicates under OR/NOT, multiple boxes — falls back to
+whole-result caching, which is always safe.
+
+Polygon-region queries (one INTERSECTS/WITHIN polygon-literal conjunct on a
+point column) get their own decomposition (:func:`decompose_region`,
+GeoBlocks' polygon split; PAPERS.md): the covering cells classify against
+the polygon (``kernels/join.classify_cells``, the join kernel's crossing
+test) into **interior** cells — wholly inside with margin to spare, served
+from the same cell entries bbox queries populate, because a cell fully
+inside the polygon makes the polygon conjunct a tautology over it —
+**boundary** cells, scanned exactly under the original polygon predicate
+(the same kernel an undecomposed query runs, so near-edge rows decide
+identically), and **outside** cells, contributing nothing.
+
+Domain-edge closure: the half-open ``[x0, x1)`` partition leaves the
+``x = 180`` meridian and ``y = 90`` pole lines uncovered, so the LAST cell
+column/row closes at the domain edge (its box realization ends at exactly
+180 / 90 instead of one ulp below). The cells of a level then partition the
+full [-180, 180] x [-90, 90] domain — a domain-spanning zoom-out
+decomposes with NO residual strips, which is what lets a warm zoom-out
+answer with zero device dispatches (docs/CACHE.md).
 """
 
 from __future__ import annotations
@@ -61,9 +80,34 @@ def _prev(v: float) -> float:
     return float(np.nextafter(v, -np.inf))
 
 
+def cell_box(level: int, ix: int, iy: int) -> Box:
+    """The closed-BBox realization of the absolute half-open cell
+    ``(ix, iy)`` at ``level``: open edges pulled one f64 ulp inward,
+    except the domain-edge column/row, which closes at exactly 180 / 90
+    (see the module docstring's domain-edge closure)."""
+    n = 1 << level
+    sx, sy = 360.0 / n, 180.0 / n
+    xmax = 180.0 if ix == n - 1 else _prev((ix + 1) * sx - 180.0)
+    ymax = 90.0 if iy == n - 1 else _prev((iy + 1) * sy - 90.0)
+    return (ix * sx - 180.0, iy * sy - 90.0, xmax, ymax)
+
+
+def cell_prefix(level: int, cell: Tuple[int, int]) -> int:
+    """A cell's z2 curve prefix (its absolute identity on the curve) —
+    also the identity the hierarchy keys child/parent lookups on."""
+    from geomesa_tpu.curves.zorder import interleave2
+
+    ix, iy = cell
+    return int(interleave2(
+        np.asarray([ix], np.uint64), np.asarray([iy], np.uint64)
+    )[0])
+
+
 @dataclass
-class Decomposition:
-    """One query's partial-cover plan."""
+class _CellCover:
+    """Shared shape of a partial-cover plan: the interior cells (served
+    from / stored into the cache, and assemblable by the hierarchy) plus a
+    residual filter every cell query ANDs with."""
 
     level: int
     #: the filter minus the spatial conjunct (what cell queries AND with)
@@ -74,12 +118,23 @@ class Decomposition:
     cells: List[Tuple[int, int]]
     #: (ix, iy) -> closed BBox realizing the half-open cell
     cell_boxes: Dict[Tuple[int, int], Box]
-    #: boundary strips (closed boxes, disjoint, covering Q minus interior)
-    strips: List[Box]
 
     def cell_filter(self, cell: Tuple[int, int], geom: str) -> ir.Filter:
         b = self.cell_boxes[cell]
         return _and(self.residual, ir.BBox(geom, *b))
+
+    def cell_prefix(self, cell: Tuple[int, int]) -> int:
+        """The cell's z2 curve prefix (its identity on the curve)."""
+        return cell_prefix(self.level, cell)
+
+
+@dataclass
+class Decomposition(_CellCover):
+    """One bbox query's partial-cover plan."""
+
+    #: boundary strips (closed boxes, disjoint, covering Q minus interior)
+    strips: List[Box]
+    kind: str = "bbox"
 
     def strip_filter(self, geom: str) -> Optional[ir.Filter]:
         if not self.strips:
@@ -88,14 +143,39 @@ class Decomposition:
         spatial = boxes[0] if len(boxes) == 1 else ir.Or(boxes)
         return _and(self.residual, spatial)
 
-    def cell_prefix(self, cell: Tuple[int, int]) -> int:
-        """The cell's z2 curve prefix (its identity on the curve)."""
-        from geomesa_tpu.curves.zorder import interleave2
+    #: uniform residual-scan surface shared with RegionDecomposition
+    residual_scan_filter = strip_filter
 
-        ix, iy = cell
-        return int(interleave2(
-            np.asarray([ix], np.uint64), np.asarray([iy], np.uint64)
-        )[0])
+    def residual_count(self) -> int:
+        return len(self.strips)
+
+
+@dataclass
+class RegionDecomposition(_CellCover):
+    """One polygon query's partial-cover plan: interior cells + boundary
+    cells scanned exactly under the original polygon predicate."""
+
+    #: the polygon spatial conjunct, verbatim (op + literal)
+    spatial: ir.Filter = None  # type: ignore[assignment]
+    #: boundary cell ids at ``level``
+    boundary: List[Tuple[int, int]] = None  # type: ignore[assignment]
+    #: disjoint closed boxes covering exactly the boundary cells (adjacent
+    #: cells merged into row runs, so the residual scan's OR stays small)
+    boundary_boxes: List[Box] = None  # type: ignore[assignment]
+    kind: str = "polygon"
+
+    def residual_scan_filter(self, geom: str) -> Optional[ir.Filter]:
+        """residual ∧ polygon ∧ (boundary-cell cover) — the polygon
+        predicate evaluates through the same kernel an undecomposed query
+        compiles to, so boundary rows decide identically (bit-identity)."""
+        if not self.boundary_boxes:
+            return None
+        boxes = tuple(ir.BBox(geom, *b) for b in self.boundary_boxes)
+        cover = boxes[0] if len(boxes) == 1 else ir.Or(boxes)
+        return _and(_and(self.residual, self.spatial), cover)
+
+    def residual_count(self) -> int:
+        return len(self.boundary)
 
 
 def _and(residual: ir.Filter, spatial: ir.Filter) -> ir.Filter:
@@ -194,23 +274,157 @@ def decompose(f: ir.Filter, ft) -> Optional[Decomposition]:
     for iy in ys:
         for ix in xs:
             cells.append((ix, iy))
-            cell_boxes[(ix, iy)] = (
-                xedge(ix), yedge(iy), _prev(xedge(ix + 1)), _prev(yedge(iy + 1))
-            )
+            cell_boxes[(ix, iy)] = cell_box(level, ix, iy)
 
-    # Q \ interior as disjoint closed strips. The right strip is always
-    # present: rows at exactly x == X1 (the interior's open edge) live there
-    # even when X1 == xmax.
+    # Q \ interior as disjoint closed strips. The right strip is normally
+    # present — rows at exactly x == X1 (the interior's open edge) live
+    # there even when X1 == xmax — EXCEPT when the interior reaches the
+    # domain-edge column, whose cells close at x == 180 (likewise the top
+    # strip at y == 90), so a domain-spanning bbox has no strips at all.
+    right_closed = xs[-1] == n - 1  # interior owns x == 180 (== xmax)
+    top_closed = ys[-1] == n - 1    # interior owns y == 90 (== ymax)
+    ix_hi_edge = 180.0 if right_closed else _prev(X1)
     strips: List[Box] = []
     if xmin < X0:
         strips.append((xmin, ymin, _prev(X0), ymax))          # left
-    strips.append((X1, ymin, xmax, ymax))                     # right
+    if not right_closed:
+        strips.append((X1, ymin, xmax, ymax))                 # right
     if ymin < Y0:
-        strips.append((X0, ymin, _prev(X1), _prev(Y0)))       # bottom
-    strips.append((X0, Y1, _prev(X1), ymax))                  # top
+        strips.append((X0, ymin, ix_hi_edge, _prev(Y0)))      # bottom
+    if not top_closed:
+        strips.append((X0, Y1, ix_hi_edge, ymax))             # top
     strips = [s for s in strips if s[0] <= s[2] and s[1] <= s[3]]
 
     return Decomposition(
         level=level, residual=residual, residual_key=repr(residual),
         cells=cells, cell_boxes=cell_boxes, strips=strips,
+    )
+
+
+#: cell-vs-polygon classification margin (degrees): a cell is INTERIOR or
+#: OUTSIDE only when the verdict holds with this much room, so the scan
+#: kernel's f32 near-edge uncertainty (~1e-4 deg worst case at
+#: filter/compile._pip_fn) plus f32 coordinate rounding (~1e-5 deg) can
+#: never flip a row the classification already committed. Near-edge rows
+#: land in BOUNDARY cells and decide through the same kernel as an
+#: undecomposed query — the bit-identity contract (docs/CACHE.md).
+CLASSIFY_MARGIN = 1e-3
+
+#: polygon ops decomposable for POINT columns: the predicate is constant
+#: over any cell that clears the margin (for a point, INTERSECTS == WITHIN
+#: off the boundary — and boundary-adjacent cells always scan exactly)
+_REGION_OPS = ("intersects", "within")
+
+
+def split_region_conjunct(
+    f: ir.Filter, geom: Optional[str]
+) -> Optional[Tuple[ir.Spatial, ir.Filter]]:
+    """(polygon conjunct, residual) when the filter is ``SPATIAL ∧ rest``
+    with exactly one spatial constraint — an INTERSECTS/WITHIN of a
+    (multi)polygon literal — at top level; None otherwise."""
+    from geomesa_tpu.utils import geometry as geo
+
+    if geom is None:
+        return None
+    conjuncts = list(f.children) if isinstance(f, ir.And) else [f]
+    polys = [
+        c for c in conjuncts
+        if isinstance(c, ir.Spatial) and c.prop == geom
+        and c.op in _REGION_OPS
+        and isinstance(c.geom, (geo.Polygon, geo.MultiPolygon))
+    ]
+    if len(polys) != 1:
+        return None
+    rest = [c for c in conjuncts if c is not polys[0]]
+    if any(_has_spatial(c, geom) for c in rest):
+        return None  # a second spatial constraint: not the region shape
+    if not rest:
+        residual: ir.Filter = ir.Include()
+    elif len(rest) == 1:
+        residual = rest[0]
+    else:
+        residual = ir.And(tuple(rest))
+    return polys[0], residual
+
+
+def _merge_runs(
+    level: int, boundary: List[Tuple[int, int]]
+) -> List[Box]:
+    """Disjoint closed boxes covering exactly the boundary cells: per-row
+    consecutive runs merge into one rectangle, so the residual scan's OR
+    stays small."""
+    by_row: Dict[int, List[int]] = {}
+    for ix, iy in boundary:
+        by_row.setdefault(iy, []).append(ix)
+    out: List[Box] = []
+    for iy in sorted(by_row):
+        xs = sorted(by_row[iy])
+        lo = prev = xs[0]
+        for ix in xs[1:] + [None]:  # type: ignore[list-item]
+            if ix is not None and ix == prev + 1:
+                prev = ix
+                continue
+            b0 = cell_box(level, lo, iy)
+            b1 = cell_box(level, prev, iy)
+            out.append((b0[0], b0[1], b1[2], b1[3]))
+            if ix is not None:
+                lo = prev = ix
+    return out
+
+
+def decompose_region(f: ir.Filter, ft) -> Optional[RegionDecomposition]:
+    """Polygon partial-cover plan: interior cells (cache-served — they
+    share cell keys with bbox decompositions of the same residual) plus
+    boundary cells (exact residual scan under the polygon predicate), or
+    None when not decomposable. POINT geometries only, like
+    :func:`decompose` (an extent feature straddles cells)."""
+    if not config.CACHE_POLYGON.to_bool():
+        return None
+    geom = None if ft is None else ft.geom_field
+    if geom is None or not ft.attr(geom).is_point:
+        return None
+    split = split_region_conjunct(f, geom)
+    if split is None:
+        return None
+    spatial, residual = split
+    xmin, ymin, xmax, ymax = spatial.geom.bounds()
+    if not (
+        np.isfinite([xmin, ymin, xmax, ymax]).all()
+        and -180.0 <= xmin <= xmax <= 180.0
+        and -90.0 <= ymin <= ymax <= 90.0
+    ):
+        return None
+    level = _pick_level(xmax - xmin, ymax - ymin)
+    if level is None:
+        return None
+    n = 1 << level
+    sx, sy = 360.0 / n, 180.0 / n
+    ix_lo = max(0, int(np.floor((xmin + 180.0) / sx)))
+    ix_hi = min(n - 1, int(np.floor((xmax + 180.0) / sx)))
+    iy_lo = max(0, int(np.floor((ymin + 90.0) / sy)))
+    iy_hi = min(n - 1, int(np.floor((ymax + 90.0) / sy)))
+    max_cells = config.CACHE_MAX_CELLS.to_int() or 256
+    if (ix_hi - ix_lo + 1) * (iy_hi - iy_lo + 1) > max_cells:
+        return None
+
+    from geomesa_tpu.kernels import join as jk
+
+    candidates = [
+        (ix, iy)
+        for iy in range(iy_lo, iy_hi + 1)
+        for ix in range(ix_lo, ix_hi + 1)
+    ]
+    boxes = np.asarray(
+        [cell_box(level, ix, iy) for ix, iy in candidates], np.float64
+    )
+    codes = jk.classify_cells(boxes, spatial.geom, CLASSIFY_MARGIN)
+    cells = [c for c, k in zip(candidates, codes) if k == jk.CELL_INTERIOR]
+    boundary = [c for c, k in zip(candidates, codes) if k == jk.CELL_BOUNDARY]
+    if not cells:
+        return None  # nothing reusable: whole-result caching is cheaper
+    cell_boxes = {c: cell_box(level, *c) for c in cells}
+    return RegionDecomposition(
+        level=level, residual=residual, residual_key=repr(residual),
+        cells=cells, cell_boxes=cell_boxes, spatial=spatial,
+        boundary=boundary, boundary_boxes=_merge_runs(level, boundary),
     )

@@ -11,7 +11,18 @@ entry points (count / density / density_curve / stats):
    interior SFC cell, executes ONLY the missing cells and the boundary
    strips through the ordinary planner/executor, merges cached + fresh
    partials (grids add, counts add, sketches merge), and stores the fresh
-   cells for the next overlapping query.
+   cells for the next overlapping query;
+3. **hierarchical pre-aggregation** (hierarchy.py; GeoBlocks, PAPERS.md) —
+   a missing cell assembles from its four finer children before falling
+   back to a scan, and completed sibling quads roll up bottom-up, so a
+   zoom-out over a warm region costs O(visible cells), not O(data);
+4. **polygon regions** (cells.decompose_region) — a query whose spatial
+   conjunct is INTERSECTS/WITHIN of a polygon literal splits into interior
+   cells (cache/hierarchy-served; they share cell keys with bbox queries
+   over the same residual) plus boundary cells scanned exactly under the
+   polygon predicate through the ordinary planner/executor — which is the
+   partitioned/sharded executor on partitioned stores, so residual
+   boundary scans fan out over the device mesh like any other scan.
 
 Invalidation is epoch-based (store.py): the FeatureStore ``version`` is the
 epoch, so every mutation path (flush / delete / schema or index change)
@@ -44,6 +55,7 @@ import numpy as np
 
 from geomesa_tpu import config, metrics, tracing
 from geomesa_tpu.cache import cells as cellmod
+from geomesa_tpu.cache import hierarchy
 from geomesa_tpu.cache.store import CacheStore
 from geomesa_tpu.stats import sketches as sk
 
@@ -153,10 +165,15 @@ class AggregateCache:
             return op.unpack(hit)
 
         geom = st.ft.geom_field
-        decomp = (
-            cellmod.decompose(plan.filter, st.ft)
-            if op.decomposable and not plan.is_empty else None
-        )
+        decomp = None
+        if op.decomposable and not plan.is_empty:
+            decomp = cellmod.decompose(plan.filter, st.ft)
+            if decomp is None:
+                # polygon-region shape (GeoBlocks decomposition): interior
+                # cells share keys with bbox queries over the same residual
+                decomp = cellmod.decompose_region(plan.filter, st.ft)
+                if decomp is not None:
+                    metrics.inc(metrics.CACHE_POLYGON)
         if (
             decomp is not None
             and op.cell_nbytes
@@ -176,20 +193,53 @@ class AggregateCache:
             self._note(plan, cache="miss")
             return value
 
-        # partial-cover path: cached interior cells + executed residual
+        # partial-cover path: cached interior cells + executed residual.
+        # Cell keys are level-qualified, so the hierarchy can address any
+        # level of the quadtree with the same builder.
+        def cell_key(level: int, cell) -> Tuple:
+            return ("cell",) + op.fingerprint + (
+                decomp.residual_key, akey, level,
+                cellmod.cell_prefix(level, cell),
+            )
+
+        def hier_get(level: int, cell):
+            return self.store.get(uid, epoch, cell_key(level, cell))
+
+        def hier_put(level: int, cell, packed):
+            return self.store.put(uid, epoch, cell_key(level, cell), packed)
+
+        def merge4(vals):
+            acc4 = op.zero()
+            for v in vals:
+                acc4 = op.merge(acc4, op.unpack(v))
+            return op.pack(acc4)
+
+        use_hier = hierarchy.enabled()
+        hstats: dict = {}
         acc = op.zero()
         hits = 0
+        hier_hits = 0
         scan_acc = [0, 0]  # [scanned_rows, table_rows] over executed pieces
         all_cacheable = True
         with tracing.span("cache.cells", total=len(decomp.cells),
-                          level=decomp.level) as cells_span:
+                          level=decomp.level, kind=decomp.kind) as cells_span:
             for cell in decomp.cells:
-                ckey = ("cell",) + op.fingerprint + (
-                    decomp.residual_key, akey, decomp.level,
-                    decomp.cell_prefix(cell),
-                )
+                ckey = cell_key(decomp.level, cell)
                 with tracing.span("cache.lookup", key="cell"):
                     got = self.store.get(uid, epoch, ckey)
+                if got is None and use_hier:
+                    # zoom-out path: pre-merge the cell from cached finer
+                    # children before paying a scan (docs/CACHE.md)
+                    with tracing.span("cache.hierarchy", level=decomp.level):
+                        got = hierarchy.assemble(
+                            hier_get, hier_put, merge4,
+                            decomp.level, cell, stats=hstats,
+                        )
+                    if got is not None:
+                        hier_hits += 1
+                        metrics.inc(metrics.CACHE_HIER_HIT)
+                    else:
+                        metrics.inc(metrics.CACHE_HIER_RESIDUAL)
                 if got is not None:
                     hits += 1
                     tracing.add_cost("cache_hits", 1.0)
@@ -202,13 +252,18 @@ class AggregateCache:
                     )
                 if cacheable:
                     self.store.put(uid, epoch, ckey, op.pack(value))
+                    if use_hier:
+                        # bottom-up population: a completed sibling quad
+                        # pre-merges its parent for the next zoom-out
+                        hierarchy.rollup(hier_get, hier_put, merge4,
+                                         decomp.level, cell)
                 else:
                     all_cacheable = False
                 acc = op.merge(acc, value)
-            cells_span.set(hits=hits)
-        strip_f = decomp.strip_filter(geom)
+            cells_span.set(hits=hits, assembled=hier_hits)
+        strip_f = decomp.residual_scan_filter(geom)
         if strip_f is not None:
-            with tracing.span("cache.residual"):
+            with tracing.span("cache.residual", kind=decomp.kind):
                 value, cacheable = self._run_sub(
                     ds, st, q, strip_f, op, plan, scan_acc
                 )
@@ -230,7 +285,84 @@ class AggregateCache:
             cache_cells=f"{hits}/{len(decomp.cells)}",
             cache_level=decomp.level,
         )
+        if decomp.kind == "polygon":
+            covered = len(decomp.cells) + len(decomp.boundary)
+            self._note(
+                plan, cache_region="polygon",
+                cache_boundary_cells=len(decomp.boundary),
+                cache_residual_fraction=round(
+                    len(decomp.boundary) / max(covered, 1), 3
+                ),
+            )
+        if hier_hits:
+            self._note(
+                plan,
+                hierarchy=f"{hier_hits}/{len(decomp.cells)} cells assembled"
+                          f" (children to level {hstats.get('deepest', 0)})",
+            )
         return acc
+
+    # -- explain support -----------------------------------------------------
+    def probe_cover(self, ds, st, q, plan) -> Optional[dict]:
+        """Dry-run decomposition + residency probe for explain's
+        ``Hierarchy`` section (docs/CACHE.md): which cells the query would
+        cover, how many are resident at the query's own level, how many
+        the hierarchy could assemble from finer children (probed with the
+        ``count`` fingerprint, without promoting anything), and the
+        residual fraction a polygon query would scan exactly."""
+        if plan.is_empty:
+            return None
+        decomp = cellmod.decompose(plan.filter, st.ft)
+        if decomp is None:
+            decomp = cellmod.decompose_region(plan.filter, st.ft)
+        if decomp is None:
+            return None
+        uid, epoch = st.uid, st.version
+        akey = self._auth_key(ds, q)
+        fp = ("count",)
+
+        def key(level, cell):
+            return ("cell",) + fp + (
+                decomp.residual_key, akey, level,
+                cellmod.cell_prefix(level, cell),
+            )
+
+        levels: dict = {}
+        missing = 0
+        dep = hierarchy.depth() if hierarchy.enabled() else 0
+        for cell in decomp.cells:
+            if self.store.get(uid, epoch, key(decomp.level, cell)) is not None:
+                levels[decomp.level] = levels.get(decomp.level, 0) + 1
+                continue
+            hstats: dict = {}
+            got = hierarchy.assemble(
+                lambda lvl, c: self.store.get(uid, epoch, key(lvl, c)),
+                lambda lvl, c, v: None,  # probe: never promote
+                lambda vals: 0,          # count probe: values irrelevant
+                decomp.level, cell, max_depth=dep, stats=hstats,
+                count_promotes=False,
+            ) if dep else None
+            if got is not None:
+                lvl = hstats.get("deepest", decomp.level + 1)
+                levels[lvl] = levels.get(lvl, 0) + 1
+            else:
+                missing += 1
+        boundary = decomp.residual_count()
+        covered = len(decomp.cells) + (
+            boundary if decomp.kind == "polygon" else 0
+        )
+        return {
+            "kind": decomp.kind,
+            "level": decomp.level,
+            "cells": len(decomp.cells),
+            "boundary": boundary,
+            "levels": levels,
+            "missing": missing,
+            "residual_fraction": round(
+                (missing + (boundary if decomp.kind == "polygon" else 0))
+                / max(covered, 1), 3
+            ),
+        }
 
     # -- ops ----------------------------------------------------------------
     def count(self, ds, st, q, plan) -> int:
@@ -297,9 +429,178 @@ class AggregateCache:
             merge=lambda a, b: b if a is None else a + b,
             pack=lambda v: v.copy(),
             unpack=lambda v: v.copy(),
-            decomposable=False,  # block membership is SFC-quantized
+            # coordinate-space cells can't reproduce SFC block membership,
+            # but BLOCK-SPACE chunks can: the partial-cover path for this
+            # op is _serve_curve below, not the generic cell loop
+            decomposable=False,
         )
+        if (
+            self.enabled() and not self._bypass(q) and weight is None
+            and not plan.is_empty
+        ):
+            # unweighted only: a block's count is window-independent (CDF
+            # differences over the same z2-sorted scan), so chunk grids
+            # concatenate exactly and downsample-add exactly (f64 integer
+            # counts); weighted cross-level sums would re-round f32 — the
+            # whole-result fallback keeps those bit-identical (CACHE.md)
+            return self._serve_curve(
+                ds, st, q, plan, int(level), block_window, op, ex
+            )
         return self._serve(ds, st, q, plan, op)
+
+    def _serve_curve(self, ds, st, q, plan, level: int, block_window,
+                     op: "_Op", ex) -> np.ndarray:
+        """Block-space partial-cover for density_curve (docs/CACHE.md):
+        the window splits into aligned power-of-two chunks; cached chunk
+        grids assemble by slicing, only missing sub-windows execute (one
+        fused ``density_curve_batch`` dispatch when the executor has it),
+        and the hierarchy serves a zoom-out by downsample-adding the
+        chunk's level-(k+1) projection. Tile pyramids over one filter
+        share chunks across tiles AND across zoom levels."""
+        uid, epoch = st.uid, st.version
+        akey = self._auth_key(ds, q)
+        wkey = ("whole",) + op.fingerprint + (repr(plan.filter), akey)
+        with tracing.span("cache.lookup", key="whole"):
+            hit = self.store.get(uid, epoch, wkey)
+        if hit is not None:
+            metrics.inc(metrics.CACHE_HIT)
+            tracing.add_cost("cache_hits", 1.0)
+            self._note(plan, cache="hit")
+            plan.__dict__["scanned_rows"] = 0
+            plan.__dict__.setdefault("table_rows", 0)
+            return op.unpack(hit)
+
+        ix0, iy0, ix1, iy1 = (int(v) for v in block_window)
+        nx, ny = ix1 - ix0 + 1, iy1 - iy0 + 1
+        per_axis = config.CACHE_CELLS_PER_AXIS.to_int() or 8
+        c = 1
+        while max(nx, ny) > per_axis * c:
+            c *= 2
+        cx0, cx1, cy0, cy1 = ix0 // c, ix1 // c, iy0 // c, iy1 // c
+        n_chunks = (cx1 - cx0 + 1) * (cy1 - cy0 + 1)
+        if c * c * 8 * (n_chunks + 1) > self.store.budget() // 2:
+            # chunk grids alone would blow half the LRU budget: the
+            # whole-result entry is the only one worth keeping
+            return self._serve(ds, st, q, plan, op)
+
+        base = ("curve",) + (repr(plan.filter), akey)
+
+        def chunk_get(lvl: int, side: int, kx: int, ky: int):
+            return self.store.get(uid, epoch, base + (lvl, side, kx, ky))
+
+        def chunk_put(lvl: int, side: int, kx: int, ky: int, g):
+            return self.store.put(
+                uid, epoch, base + (lvl, side, kx, ky),
+                np.ascontiguousarray(g),
+            )
+
+        use_hier = hierarchy.enabled()
+        hstats: dict = {}
+        out = np.zeros((ny, nx), np.float64)
+        hits = hier_hits = 0
+        misses = []  # (sub_window, out-slice, full-chunk coords or None)
+        with tracing.span("cache.cells", total=n_chunks, level=level,
+                          kind="curve", chunk=c) as cells_span:
+            for ky in range(cy0, cy1 + 1):
+                for kx in range(cx0, cx1 + 1):
+                    bx0, by0 = kx * c, ky * c
+                    bx1, by1 = bx0 + c - 1, by0 + c - 1
+                    sx0, sy0 = max(bx0, ix0), max(by0, iy0)
+                    sx1, sy1 = min(bx1, ix1), min(by1, iy1)
+                    full = (sx0, sy0, sx1, sy1) == (bx0, by0, bx1, by1)
+                    with tracing.span("cache.lookup", key="chunk"):
+                        g = chunk_get(level, c, kx, ky)
+                    if g is None and use_hier:
+                        with tracing.span("cache.hierarchy", level=level):
+                            g = hierarchy.assemble_curve(
+                                chunk_get, chunk_put, level, c, kx, ky,
+                                stats=hstats,
+                            )
+                        if g is not None:
+                            hier_hits += 1
+                            metrics.inc(metrics.CACHE_HIER_HIT)
+                        else:
+                            metrics.inc(metrics.CACHE_HIER_RESIDUAL)
+                    dst = np.s_[sy0 - iy0: sy1 - iy0 + 1,
+                                sx0 - ix0: sx1 - ix0 + 1]
+                    if g is not None:
+                        hits += 1
+                        tracing.add_cost("cache_hits", 1.0)
+                        out[dst] = g[sy0 - by0: sy1 - by0 + 1,
+                                     sx0 - bx0: sx1 - bx0 + 1]
+                    else:
+                        misses.append((
+                            (sx0, sy0, sx1, sy1), dst,
+                            (kx, ky) if full else None,
+                        ))
+            cells_span.set(hits=hits, assembled=hier_hits)
+
+        all_cacheable = True
+        if misses:
+            windows = [m[0] for m in misses]
+            deg0 = len(plan.__dict__.get("degraded") or ())
+            scan_acc = [0, 0]  # executed [scanned_rows, table_rows]
+
+            def _fold_scan():
+                # each execution overwrites the plan counters: fold them
+                # into the accumulator so the audit reports ALL executed
+                # work, matching the generic cell path's accounting
+                scan_acc[0] += plan.__dict__.pop("scanned_rows", 0)
+                scan_acc[1] = max(scan_acc[1],
+                                  plan.__dict__.pop("table_rows", 0))
+
+            with tracing.span("cache.cell.scan", n=len(windows)):
+                if len(windows) > 1 and hasattr(ex, "density_curve_batch"):
+                    grids = ex.density_curve_batch(plan, level, windows,
+                                                   None)
+                    _fold_scan()
+                else:
+                    grids = []
+                    for w in windows:
+                        grids.append(np.asarray(
+                            ex.density_curve(plan, level, w, None)))
+                        _fold_scan()
+            plan.__dict__["scanned_rows"] = scan_acc[0]
+            plan.__dict__["table_rows"] = scan_acc[1]
+            if len(plan.__dict__.get("degraded") or ()) > deg0:
+                # a partition was skipped somewhere in the fresh scans:
+                # none of them may become a permanently-cached lie
+                all_cacheable = False
+            for (win, dst, full_at), g in zip(misses, grids):
+                g = np.asarray(g, np.float64)
+                out[dst] = g
+                if full_at is not None and all_cacheable:
+                    kx, ky = full_at
+                    chunk_put(level, c, kx, ky, g)
+                    if use_hier:
+                        hierarchy.rollup_curve(
+                            chunk_get, chunk_put, level, c, kx, ky, g
+                        )
+        else:
+            # fully chunk-warm: nothing executed, the audit must say so
+            plan.__dict__["scanned_rows"] = 0
+            plan.__dict__.setdefault("table_rows", 0)
+        with tracing.span("cache.merge"):
+            if all_cacheable:
+                self.store.put(uid, epoch, wkey, op.pack(out))
+        if hits:
+            metrics.inc(metrics.CACHE_PARTIAL)
+        else:
+            metrics.inc(metrics.CACHE_MISS)
+        self._note(
+            plan,
+            cache=("partial" if hits else "miss"),
+            cache_cells=f"{hits}/{n_chunks}",
+            cache_level=level,
+            cache_chunk=c,
+        )
+        if hier_hits:
+            self._note(
+                plan,
+                hierarchy=f"{hier_hits}/{n_chunks} chunks assembled"
+                          f" (children to level {hstats.get('deepest', 0)})",
+            )
+        return out
 
     def stats(self, ds, st, q, plan, stat_spec: str) -> sk.Stat:
         from geomesa_tpu.kernels.stats_scan import _leaf_stats

@@ -381,6 +381,26 @@ CACHE_MAX_LEVEL = SystemProperty("geomesa.cache.max.level", "12")
 #: falls back to whole-result caching only.
 CACHE_MAX_CELLS = SystemProperty("geomesa.cache.max.cells", "256")
 
+#: Hierarchical pre-aggregation (cache/hierarchy.py; docs/CACHE.md): a
+#: level-k cell assembles from its four level-(k+1) children (counts add,
+#: unweighted grids downsample-add, exact sketches merge — all in the
+#: fixed SW/SE/NW/NE child order, so assembly is bit-identical to a fresh
+#: scan), and completed sibling quads roll up bottom-up on put. Makes a
+#: zoom-out over a warm region cost O(visible cells), never O(data).
+CACHE_HIERARCHY = SystemProperty("geomesa.cache.hierarchy", "true")
+
+#: How many levels DOWN an on-miss assembly may recurse looking for
+#: cached children (1 = direct children only).
+CACHE_HIERARCHY_DEPTH = SystemProperty("geomesa.cache.hierarchy.depth", "2")
+
+#: Polygon-region decomposition (cache/cells.py; docs/CACHE.md): a query
+#: whose one spatial conjunct is INTERSECTS/WITHIN of a polygon literal
+#: splits into interior cells (served from the cache/hierarchy — they
+#: share cell keys with bbox queries) plus boundary cells scanned exactly
+#: under the polygon predicate. Off = polygon queries are whole-result
+#: cached only.
+CACHE_POLYGON = SystemProperty("geomesa.cache.polygon", "true")
+
 # ---------------------------------------------------------------------------
 # Resilience layer (resilience.py; docs/RESILIENCE.md). Retry defaults track
 # the reference's tablet-server client retry posture; the breaker fences a

@@ -62,6 +62,86 @@ def pip_assign(px, py, mask, edges, xp):
     return xp.where(mask.reshape(-1), assign, -1)
 
 
+#: classify_cells codes — a cell wholly outside the polygon, wholly inside
+#: it (with margin to spare), or touching its boundary
+CELL_OUTSIDE, CELL_INTERIOR, CELL_BOUNDARY = 0, 1, 2
+
+
+def _poly_edges(g) -> "list[np.ndarray]":
+    """Per-polygon [E, 4] f64 ring segments (shell + holes) of a
+    (multi)polygon literal — the edge tables the crossing test runs on."""
+    from geomesa_tpu.utils import geometry as geo
+
+    polys = g.polygons if isinstance(g, geo.MultiPolygon) else (g,)
+    out = []
+    for p in polys:
+        segs = []
+        for r in p.rings():
+            segs.append(np.concatenate([r[:-1], r[1:]], axis=1))
+        out.append(np.concatenate(segs, axis=0).astype(np.float64))
+    return out
+
+
+def classify_cells(boxes: np.ndarray, g, margin: float) -> np.ndarray:
+    """Classify axis-aligned cells against a (multi)polygon literal:
+    int8 [C] of CELL_OUTSIDE / CELL_INTERIOR / CELL_BOUNDARY for ``boxes``
+    [C, 4] = (xmin, ymin, xmax, ymax), f64.
+
+    Every box is inflated by ``margin`` before testing, so INTERIOR and
+    OUTSIDE verdicts hold for every point the scan kernel could place in
+    the cell even under its f32 edge arithmetic (the ~1e-4-deg near-edge
+    uncertainty documented at filter/compile._pip_fn) — near-edge rows
+    always land in BOUNDARY cells, which the caller scans through the
+    *same* polygon kernel as an undecomposed query, so the decomposed
+    total is bit-identical by construction (docs/CACHE.md).
+
+    The segment-vs-box test is an exact SAT (box axes + the segment's
+    normal); insidedness of edge-free cells reuses :func:`crossing_matrix`
+    on the cell centers, per polygon part, matching the scan kernel's
+    per-polygon even-odd OR semantics for multipolygons."""
+    boxes = np.asarray(boxes, np.float64)
+    C = len(boxes)
+    x0 = boxes[:, 0] - margin
+    y0 = boxes[:, 1] - margin
+    x1 = boxes[:, 2] + margin
+    y1 = boxes[:, 3] + margin
+    codes = np.zeros(C, np.int8)
+    inside = np.zeros(C, bool)
+    on_boundary = np.zeros(C, bool)
+    cx = (x0 + x1) * 0.5
+    cy = (y0 + y1) * 0.5
+    for E in _poly_edges(g):
+        ex1, ey1, ex2, ey2 = E[:, 0], E[:, 1], E[:, 2], E[:, 3]
+        # SAT axis 1+2 (the box normals): segment bbox vs inflated box
+        overlap = (
+            (np.minimum(ex1, ex2)[None, :] <= x1[:, None])
+            & (np.maximum(ex1, ex2)[None, :] >= x0[:, None])
+            & (np.minimum(ey1, ey2)[None, :] <= y1[:, None])
+            & (np.maximum(ey1, ey2)[None, :] >= y0[:, None])
+        )
+        # SAT axis 3 (the segment normal): all four box corners strictly
+        # on one side of the segment's line => separated
+        dx = (ex2 - ex1)[None, :]
+        dy = (ey2 - ey1)[None, :]
+        cross = [
+            dx * (by[:, None] - ey1[None, :]) - dy * (bx[:, None] - ex1[None, :])
+            for bx, by in ((x0, y0), (x1, y0), (x0, y1), (x1, y1))
+        ]
+        straddle = ~(
+            np.all([c > 0 for c in cross], axis=0)
+            | np.all([c < 0 for c in cross], axis=0)
+        )
+        on_boundary |= (overlap & straddle).any(axis=1)
+        # even-odd insidedness of the cell center for THIS polygon part;
+        # only meaningful for edge-free cells (the caller's margin makes
+        # the whole cell share the center's verdict)
+        crossings = crossing_matrix(cx, cy, ex1, ey1, ex2, ey2, np)
+        inside |= (crossings.sum(axis=1) % 2) == 1
+    codes[inside] = CELL_INTERIOR
+    codes[on_boundary] = CELL_BOUNDARY
+    return codes
+
+
 def pip_counts(px, py, mask, edges, weights, xp):
     """Per-polygon masked point (or weight) totals: float32 [P]."""
     P = int(edges["n_polys"])

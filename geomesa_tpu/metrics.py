@@ -344,7 +344,19 @@ def observe(name: str, seconds: float,
 #   cache.invalidate   entries dropped by a dataset epoch bump
 #   cache.bytes        resident cached bytes (gauge)
 #   cache.entries      resident entry count (gauge)
+#   cache.hierarchy.hit      interior cells served by assembling cached
+#                            child cells instead of scanning (zoom-out path)
+#   cache.hierarchy.promote  coarse entries written by assembly / bottom-up
+#                            sibling roll-up
+#   cache.hierarchy.residual cells that fell through to a residual scan
+#                            after an assembly attempt found no children
+#   cache.polygon            queries decomposed into interior + boundary
+#                            cells by the polygon-region path
 CACHE_HIT = "cache.hit"
+CACHE_HIER_HIT = "cache.hierarchy.hit"
+CACHE_HIER_PROMOTE = "cache.hierarchy.promote"
+CACHE_HIER_RESIDUAL = "cache.hierarchy.residual"
+CACHE_POLYGON = "cache.polygon"
 # Warm-path executor metrics (kernels/registry.py, planning/executor.py,
 # planning/partitioned_exec.py; docs/PERF.md):
 #   kernel.recompiles   fresh jit traces admitted to the kernel registry
@@ -410,9 +422,14 @@ TRACE_EXPORT_BATCHES = "trace.export.batches"
 #   serving.slot.occupancy.<s>   gauge: busy fraction of pool slot <s>
 #   slo.burn.<op>                gauge: fast-window burn rate for the
 #                                geomesa.slo.<op>.p99.ms target
+#   slo.breaker.<name>           gauge: circuit-breaker state on the SLO
+#                                alert surface (1 open, 0.5 half-open,
+#                                0 closed) — breaker-open transitions page
+#                                through the same scrape the burn gauges do
 DEVICE_BUSY_PREFIX = "device.busy"
 SLOT_OCCUPANCY_PREFIX = "serving.slot.occupancy"
 SLO_BURN_PREFIX = "slo.burn"
+SLO_BREAKER_PREFIX = "slo.breaker"
 # Serving-scheduler metrics (serving/scheduler.py, planning/executor.py;
 # docs/SERVING.md):
 #   serving.queue.depth     gauge: tickets currently queued (all users)

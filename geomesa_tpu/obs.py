@@ -137,7 +137,10 @@ def health() -> Dict[str, Any]:
     from geomesa_tpu import slo
     from geomesa_tpu.parallel import health as phealth
 
-    breakers = resilience.breaker_states()
+    # breaker-open transitions ride the SLO alert surface too: this call
+    # keeps the slo.breaker.<name> gauges registered for every breaker the
+    # process has ever named (docs/OBSERVABILITY.md, RESILIENCE follow-up)
+    breakers = slo.sync_breaker_gauges()
     report = metrics.registry().report()
     quarantine = {
         name: v for name, v in report.items()
@@ -156,9 +159,10 @@ def health() -> Dict[str, Any]:
     mesh_degraded = bool(mesh["cordoned"] or mesh["broken"])
     no_capacity = total_devices > 0 and mesh["usable"] <= 0
     hard = bool(hard_breakers or slo_hot or no_capacity)
+    degraded = hard or mesh_degraded or bool(open_breakers)
     out = {
-        "status": "degraded" if (hard or mesh_degraded) else "ok",
-        "soft": bool(mesh_degraded and not hard),
+        "status": "degraded" if degraded else "ok",
+        "soft": bool(degraded and not hard),
         "breakers": breakers,
         "open_breakers": open_breakers,
         "quarantine": quarantine,
@@ -167,6 +171,14 @@ def health() -> Dict[str, Any]:
         "mesh": mesh,
         "tracing": tracing.enabled(),
     }
+    if open_breakers:
+        # soft-degrade note: any open breaker marks the payload even when
+        # the HTTP code stays 200 (device breakers with capacity left) —
+        # the same transition the slo.breaker.<name> gauges page on
+        out["breaker_note"] = (
+            "breaker open: " + ", ".join(sorted(open_breakers))
+            + " — see slo.breaker.* gauges"
+        )
     if slo_status:
         out["slo"] = slo_status
         if slo_hot:

@@ -14,9 +14,15 @@ device carries:
   ``geomesa.device.breaker.reset.ms`` restores it;
 * a **latency-outlier detector**: a per-partition device sync slower than
   ``geomesa.device.latency.outlier`` x the trailing mesh-wide median
-  (and over ``geomesa.device.latency.floor.ms``) counts one outlier;
-  a threshold-long consecutive streak trips the breaker — the
-  slow-but-not-failing straggler lane is fenced like a failing one;
+  *for its kernel shape* (and over ``geomesa.device.latency.floor.ms``)
+  counts one outlier; a threshold-long consecutive streak trips the
+  breaker — the slow-but-not-failing straggler lane is fenced like a
+  failing one. Baselines are kept PER KERNEL SHAPE (the op kind plus the
+  partition's padded-length bucket — what actually determines the
+  compiled kernel): one mesh-wide median would let a heterogeneous
+  workload mask a straggler (a slow lane's density syncs hide behind
+  everyone's cheap counts) or, worse, fence a healthy lane that merely
+  drew the big partitions;
 * an explicit **cordon** state — operator action via the CLI
   (``geomesa-tpu devices cordon``), the sidecar ``cordon-device``
   action, :func:`cordon` in process, or the ``geomesa.mesh.cordon``
@@ -77,10 +83,17 @@ class DeviceHealthRegistry:
         #: partitions requeued off this device (docs/RESILIENCE.md §6)
         self._reassigned: Dict[int, int] = {}
         self._failures: Dict[int, int] = {}
-        #: trailing mesh-wide sync-latency samples (the outlier baseline)
-        self._lat_recent: "deque" = deque(maxlen=256)
+        #: trailing sync-latency samples PER KERNEL SHAPE (the outlier
+        #: baselines); key None is the shape-less fallback. Insertion-
+        #: ordered, oldest shape evicted past _MAX_SHAPES.
+        self._lat_recent: Dict[Optional[tuple], "deque"] = {}
         self._outlier_streak: Dict[int, int] = {}
         self._gauged: Set[int] = set()
+
+    #: distinct kernel-shape baselines retained (beyond it the least
+    #: recently SEEN shape's samples drop — bounded state, like the 256-
+    #: sample deques themselves)
+    _MAX_SHAPES = 64
 
     # -- breaker plumbing --------------------------------------------------
     def _breaker(self, did: int) -> resilience.CircuitBreaker:
@@ -171,11 +184,15 @@ class DeviceHealthRegistry:
         consecutive-failure count."""
         self._breaker(did).record_success()
 
-    def record_latency(self, did: int, seconds: float) -> None:
-        """One partition-sync latency sample. Consecutive outliers (vs
-        the trailing mesh median, over the floor) trip the device's
-        breaker: the straggler lane the many-core evaluations in PAPERS.md
-        blame for lost headroom gets fenced like a failing one."""
+    def record_latency(self, did: int, seconds: float,
+                       shape: Optional[tuple] = None) -> None:
+        """One partition-sync latency sample for kernel ``shape`` (op kind
+        + padded-length bucket; None = shape-less fallback). Consecutive
+        outliers (vs the trailing median OF THE SAME SHAPE, over the
+        floor) trip the device's breaker: the straggler lane the many-core
+        evaluations in PAPERS.md blame for lost headroom gets fenced like
+        a failing one, and a heterogeneous mix of cheap and expensive
+        kernels can neither mask it nor fake one (RESILIENCE.md §6)."""
         try:
             factor = config.DEVICE_LATENCY_OUTLIER.to_float() or 0.0
         except (TypeError, ValueError):
@@ -184,8 +201,14 @@ class DeviceHealthRegistry:
             return
         floor_s = (config.DEVICE_LATENCY_FLOOR_MS.to_float() or 250.0) / 1e3
         with self._lock:
-            samples = sorted(self._lat_recent)
-            self._lat_recent.append(seconds)
+            dq = self._lat_recent.pop(shape, None)
+            if dq is None:
+                dq = deque(maxlen=256)
+            self._lat_recent[shape] = dq  # re-insert = most recently seen
+            while len(self._lat_recent) > self._MAX_SHAPES:
+                self._lat_recent.pop(next(iter(self._lat_recent)))
+            samples = sorted(dq)
+            dq.append(seconds)
             median = samples[len(samples) // 2] if len(samples) >= 8 else None
             if median is not None \
                     and seconds >= max(floor_s, factor * median):
@@ -197,14 +220,19 @@ class DeviceHealthRegistry:
                 self._outlier_streak[did] = 0
                 self._last_failure[did] = (
                     f"latency outlier: {seconds * 1e3:.1f} ms >= "
-                    f"{factor:g} x mesh median {median * 1e3:.1f} ms "
-                    f"({streak} consecutive)"
+                    f"{factor:g} x median {median * 1e3:.1f} ms for "
+                    f"kernel shape {shape} ({streak} consecutive)"
                 )
             else:
                 self._outlier_streak[did] = 0
                 return
         # trip outside the registry lock (breaker has its own)
         self._breaker(did).trip()
+
+    def latency_baselines(self) -> Dict[str, int]:
+        """Operator view: sample counts per kernel-shape baseline."""
+        with self._lock:
+            return {str(k): len(v) for k, v in self._lat_recent.items()}
 
     def note_reassigned(self, did: int) -> None:
         """One partition requeued OFF this device onto a survivor."""

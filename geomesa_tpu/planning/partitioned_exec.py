@@ -436,7 +436,7 @@ class PartitionedExecutor:
         live: List = list(devs)
 
         def _finish_oldest():
-            fb, fr, fdev = pending.popleft()
+            fb, fr, fdev, fshape = pending.popleft()
             t0 = time.perf_counter()
 
             def _fin():
@@ -460,7 +460,11 @@ class PartitionedExecutor:
             self._scan_part(plan, fb, op, _fin,
                             probe=False, spanned=False)
             if fdev is not None:
-                hreg.record_latency(fdev.id, time.perf_counter() - t0)
+                # baseline keyed by kernel shape (op + padded-length
+                # bucket): heterogeneous ops/partition sizes each compare
+                # against their own trailing median (RESILIENCE.md §6)
+                hreg.record_latency(fdev.id, time.perf_counter() - t0,
+                                    shape=fshape)
 
         tot_scanned = tot_rows = 0
         try:
@@ -484,7 +488,13 @@ class PartitionedExecutor:
                 if dev is not None:
                     metrics.inc(f"{metrics.SCAN_SHARDED_DEVICE}.{dev.id}")
                 if r is not _SKIPPED and r is not None:
-                    pending.append((b, r, dev))
+                    # kernel-shape key: the op plus the partition's padded-
+                    # length bucket (geomesa.partition.shard.bucket rounds
+                    # child tables to multiples, so equal buckets share a
+                    # compiled kernel shape)
+                    lbucket = config.SHARD_LEN_BUCKET.to_int() or 65536
+                    shape = (op, -(-child.count // max(lbucket, 1)))
+                    pending.append((b, r, dev, shape))
                 # dispatched work holds its own buffer references: staged
                 # host arrays and evicted children free safely here even
                 # while the device is still executing

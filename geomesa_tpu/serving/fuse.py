@@ -56,6 +56,10 @@ _UNFUSABLE_HINTS = (
 #: result-affecting request key that fuse.py doesn't know about makes the
 #: query ineligible (fail safe) instead of silently fusing two queries
 #: that differ in it and handing one client another client's answer.
+#: NOTE: a polygon ``region`` is deliberately NOT listed — the sidecar
+#: folds it into the ecql text BEFORE keying (service._fold_region), so
+#: two different polygons key distinctly; a request that somehow still
+#: carries a raw ``region`` falls through this allow-list and never fuses.
 _FUSABLE_KEYS = frozenset(
     ("op", "name", "schema", "ecql", "auths", "exact",
      "bbox", "width", "height", "weight", "level", "stat")

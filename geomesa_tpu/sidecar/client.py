@@ -294,10 +294,15 @@ class GeoFlightClient:
         return self._action("explain", {"name": name, "ecql": ecql})["explain"]
 
     def count(self, name: str, ecql: str = "INCLUDE", exact: bool = True,
-              auths: Optional[Sequence[str]] = None) -> int:
+              auths: Optional[Sequence[str]] = None,
+              region: Optional[str] = None) -> int:
         body = {"name": name, "ecql": ecql, "exact": exact}
         if auths is not None:
             body["auths"] = list(auths)
+        if region is not None:
+            # WKT polygon; the server folds it into the ecql BEFORE fusion
+            # keys are built (docs/CACHE.md polygon regions)
+            body["region"] = region
         return self._action("count", body)["count"]
 
     def audit(self, n: int = 100) -> List[Dict]:
@@ -362,7 +367,8 @@ class GeoFlightClient:
     def density(self, name: str, ecql: str = "INCLUDE", bbox=None,
                 width: int = 256, height: int = 256,
                 weight: Optional[str] = None,
-                auths: Optional[Sequence[str]] = None) -> np.ndarray:
+                auths: Optional[Sequence[str]] = None,
+                region: Optional[str] = None) -> np.ndarray:
         opts = {
             "op": "density", "schema": name, "ecql": ecql,
             "width": width, "height": height,
@@ -373,6 +379,8 @@ class GeoFlightClient:
             opts["weight"] = weight
         if auths is not None:
             opts["auths"] = list(auths)
+        if region is not None:
+            opts["region"] = region  # WKT; folded server-side (CACHE.md)
         return _dense_grid(self._get(opts), (height, width), np.float32)
 
     def density_curve(self, name: str, ecql: str = "INCLUDE", level: int = 9,
@@ -398,10 +406,13 @@ class GeoFlightClient:
         return _dense_grid(t, (ny, nx), np.float64), snapped
 
     def stats(self, name: str, stat_spec: str, ecql: str = "INCLUDE",
-              auths: Optional[Sequence[str]] = None) -> sk.Stat:
+              auths: Optional[Sequence[str]] = None,
+              region: Optional[str] = None) -> sk.Stat:
         opts = {"op": "stats", "schema": name, "ecql": ecql, "stat": stat_spec}
         if auths is not None:
             opts["auths"] = list(auths)
+        if region is not None:
+            opts["region"] = region  # WKT; folded server-side (CACHE.md)
         t = self._get(opts)
         return sk.Stat.from_json(t["value"][0].as_py())
 

@@ -353,13 +353,27 @@ class GeoFlightServer(fl.FlightServerBase):
         self._sched.stop()
         return out
 
+    def _fold_region(self, opts: Dict) -> Dict:
+        """Fold an optional ``region`` polygon (WKT) into the request's
+        ecql — the SAME composition GeoDataset's ``region=`` sugar does —
+        BEFORE fusion keys are built, so two different polygons can never
+        share a fusion key or a cached whole result (docs/SERVING.md,
+        docs/CACHE.md)."""
+        region = opts.pop("region", None)
+        if region:
+            name = opts.get("schema") or opts.get("name")
+            opts["ecql"] = self.dataset._with_region(
+                name, opts.get("ecql", "INCLUDE"), region
+            )
+        return opts
+
     # -- reads -------------------------------------------------------------
     @_spec_errors
     def do_get(self, context, ticket: fl.Ticket) -> fl.RecordBatchStream:
         # parse on the transport thread (cheap, no jax): the op's fusion
         # key must exist BEFORE the ticket queues, or nothing could
         # coalesce with it
-        opts = json.loads(ticket.ticket.decode())
+        opts = self._fold_region(json.loads(ticket.ticket.decode()))
         op = opts.get("op", "query")
         fuse = None
         if op in ("density", "density_curve", "stats"):
@@ -517,6 +531,7 @@ class GeoFlightServer(fl.FlightServerBase):
         except ValueError:
             body = None
         if kind == "count" and body and body.get("name"):
+            body = self._fold_region(body)
             fuse = self._fuse_spec(
                 "count", {**body, "schema": body["name"]}
             )

@@ -39,6 +39,42 @@ from geomesa_tpu import config, metrics
 #: error budget implied by a p99 target: 1% of requests may exceed it
 P99_BUDGET = 0.01
 
+#: breaker state -> slo.breaker.<name> gauge value
+_BREAKER_GAUGE = {"open": 1.0, "half-open": 0.5, "closed": 0.0}
+
+_breaker_gauged: set = set()
+_breaker_lock = threading.Lock()
+
+
+def sync_breaker_gauges() -> Dict[str, str]:
+    """Mirror every named circuit breaker onto the SLO alert surface as a
+    ``slo.breaker.<name>`` gauge (1 open, 0.5 half-open, 0 closed), so a
+    breaker-open transition pages through the SAME scrape the burn gauges
+    ride — an operator watching ``slo.*`` sees "the sidecar breaker is
+    open" next to "density is burning budget" instead of in a separate
+    surface (RESILIENCE.md follow-up). Returns the current state map.
+    Gauges are live callables: registration happens once per breaker
+    name, every scrape reads the breaker's state at scrape time."""
+    from geomesa_tpu import resilience
+
+    states = resilience.breaker_states()
+    for name in states:
+        gname = f"{metrics.SLO_BREAKER_PREFIX}.{name}"
+        if gname in _breaker_gauged:
+            continue
+        with _breaker_lock:
+            if gname in _breaker_gauged:
+                continue
+            metrics.registry().gauge(
+                gname,
+                lambda n=name: _BREAKER_GAUGE.get(
+                    resilience.breaker_states().get(n, "closed"), 0.0
+                ),
+                replace=True,
+            )
+            _breaker_gauged.add(gname)
+    return states
+
 #: injectable clock (tests drive window arithmetic deterministically)
 _clock = time.monotonic
 
@@ -72,6 +108,7 @@ class SloMonitor:
         """Take one snapshot per targeted op (rate-limited to 1/s unless
         forced — gauges and /healthz may poll much faster)."""
         now = _clock()
+        sync_breaker_gauges()  # breaker transitions ride the same surface
         targets = config.slo_targets()
         with self._lock:
             # a target with no snapshot yet (just declared) bypasses the
@@ -181,3 +218,5 @@ def reset() -> None:
     with _lock:
         _monitor = None
     SloMonitor._gauged = set()
+    with _breaker_lock:
+        _breaker_gauged.clear()

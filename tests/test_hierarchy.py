@@ -1,0 +1,533 @@
+"""Hierarchical pre-aggregation + polygon regions (cache/hierarchy.py,
+cache/cells.py decompose_region; docs/CACHE.md).
+
+Tier-1 contracts:
+
+* **zoom-out**: after fine-level queries warm the cells, a coarse query
+  over the same region answers from the hierarchy — ZERO residual device
+  dispatches, zero scanned rows — and is bit-identical to the uncached
+  full-scan result (counts, unweighted density, exact-merge stats,
+  density_curve across zoom levels);
+* **polygon regions**: count/density/stats over a polygon (the ``region``
+  sugar or an explicit INTERSECTS conjunct) match the exact scan
+  bit-for-bit — interior cells from the cache, boundary cells scanned
+  exactly — including points ON cell edges (the half-open ``[x0, x1)``
+  ulp contract) and near polygon edges;
+* **invalidation**: an insert/delete drops every pre-merged subtree with
+  the flat cells (epoch mechanism) — a promoted parent can never serve a
+  stale merge;
+* **property**: seeded random pan/zoom/polygon sequences across epochs
+  stay bit-identical to a cache-disabled oracle.
+"""
+
+import contextlib
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import config, metrics
+from geomesa_tpu.api.dataset import GeoDataset
+from geomesa_tpu.cache import decompose, decompose_region, hierarchy
+from geomesa_tpu.filter import parse_ecql
+from geomesa_tpu.schema.feature_type import FeatureType
+
+
+def _counter(name: str) -> int:
+    return metrics.registry().counter(name).value
+
+
+def _dispatches() -> int:
+    return _counter(metrics.EXEC_DEVICE_DISPATCH)
+
+
+@contextlib.contextmanager
+def _enabled(per_axis=None):
+    """Cache on; optionally coarser decomposition (fewer cells per query)
+    so warming stays cheap in tier-1."""
+    with contextlib.ExitStack() as st:
+        st.enter_context(config.CACHE_ENABLED.scoped("true"))
+        if per_axis is not None:
+            st.enter_context(
+                config.CACHE_CELLS_PER_AXIS.scoped(str(per_axis)))
+        yield
+
+
+#: regional zoom-out shape (per_axis=4): the four 90x45 warm boxes
+#: decompose at level 4 (22.5-deg cells), the containing 180x90 zoom-out
+#: at level 3 (45-deg cells) — exactly one level coarser
+ZOOM = "BBOX(geom, -90, -45, 90, 45)"
+WARM4 = [
+    "BBOX(geom, -90, -45, 0, 0)", "BBOX(geom, 0, -45, 90, 0)",
+    "BBOX(geom, -90, 0, 0, 45)", "BBOX(geom, 0, 0, 90, 45)",
+]
+#: domain-spanning world query (per_axis=4: level 2, no strips — the
+#: closed domain-edge cells own x=180 / y=90)
+WORLD = "BBOX(geom, -180, -90, 180, 90)"
+WORLD_WARM = [
+    "BBOX(geom, -180, -90, 0, 0)", "BBOX(geom, 0, -90, 180, 0)",
+    "BBOX(geom, -180, 0, 0, 90)", "BBOX(geom, 0, 0, 180, 90)",
+]
+
+POLY = "POLYGON((-100 -40, 100 -50, 120 60, -120 55, -100 -40))"
+POLY_Q = f"INTERSECTS(geom, {POLY})"
+
+
+@pytest.fixture()
+def ds(rng):
+    """Seeded global points, including rows exactly on level-4 cell edges
+    (span 22.5 deg) and on the domain edges (x=180, y=90) the closed
+    last-cell contract owns."""
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("pts", "type:String,weight:Float,*geom:Point")
+    r = np.random.default_rng(11)
+    n = 2500
+    edges = np.arange(-90.0, 90.1, 22.5)
+    bx, by = np.meshgrid(edges, edges[:5])
+    x = np.concatenate([r.uniform(-170, 170, n), bx.ravel(),
+                        [180.0, -180.0, 180.0]])
+    y = np.concatenate([r.uniform(-85, 85, n), by.ravel(),
+                        [90.0, -90.0, 0.0]])
+    m = len(x)
+    ds.insert("pts", {
+        "geom__x": x, "geom__y": y,
+        "weight": r.uniform(0, 2, m).astype(np.float32),
+        "type": r.choice(["bus", "car"], m),
+    }, fids=np.arange(m).astype(str))
+    ds.flush("pts")
+    return ds
+
+
+# -- zoom-out: O(visible cells), zero residual ------------------------------
+
+def test_warm_zoomout_zero_dispatch_bit_identical(ds):
+    cold = ds.count("pts", WORLD)
+    with _enabled(per_axis=4):
+        for q in WORLD_WARM:
+            ds.count("pts", q)  # fine-level warm (+ bottom-up rollup)
+        d0 = _dispatches()
+        warm = ds.count("pts", WORLD)
+        assert _dispatches() == d0, "warm zoom-out dispatched to the device"
+        ev = ds.audit.recent(1)[0]
+        assert ev.scanned == 0
+        hits, total = map(int, ev.hints["exec_path"]["cache_cells"].split("/"))
+        assert hits == total > 0
+    assert warm == cold
+
+
+def test_zoomout_assembles_when_rollup_missing(ds):
+    """Lazy on-miss assembly: fine cells populated WITHOUT the hierarchy
+    (no rollup, no promoted parents), then a coarse query with it on —
+    assembly is the only non-scan path and must serve every cell."""
+    cold = ds.count("pts", WORLD)
+    with _enabled(per_axis=4):
+        with config.CACHE_HIERARCHY.scoped("false"):
+            for q in WORLD_WARM:
+                ds.count("pts", q)
+        hh0 = _counter(metrics.CACHE_HIER_HIT)
+        d0 = _dispatches()
+        warm = ds.count("pts", WORLD)
+        assert _dispatches() == d0
+        assert _counter(metrics.CACHE_HIER_HIT) > hh0
+        assert "hierarchy" in ds.audit.recent(1)[0].hints["exec_path"]
+        assert warm == cold
+        assert ds.count("pts", WORLD) == cold  # whole-result repeat
+
+
+def test_zoomout_density_and_stats_bit_identical(ds):
+    # raster decoupled from every filter bbox (dashboard shape), so the
+    # density cells decompose and the zoom-out assembles; the filters are
+    # domain-spanning, so the warm zoom-out has no strips to scan
+    raster = (-120.0, -60.0, 120.0, 60.0)
+    grid_cold = ds.density("pts", WORLD, bbox=raster, width=64, height=32)
+    stat_cold = ds.stats("pts", "Count();MinMax(weight)", WORLD).value()
+    with _enabled(per_axis=4):
+        for q in WORLD_WARM:
+            ds.density("pts", q, bbox=raster, width=64, height=32)
+            ds.stats("pts", "Count();MinMax(weight)", q)
+        d0 = _dispatches()
+        grid_warm = ds.density("pts", WORLD, bbox=raster, width=64, height=32)
+        stat_warm = ds.stats("pts", "Count();MinMax(weight)", WORLD).value()
+        assert _dispatches() == d0
+    assert np.array_equal(grid_cold, grid_warm)
+    assert stat_warm == stat_cold
+
+
+def test_density_curve_cross_level_downsample(ds):
+    """Tile-pyramid zoom-out: level-k curve grids assemble from cached
+    level-(k+1) chunks by downsample-add, bit-identical and dispatch-free."""
+    bbox = (-180.0, -90.0, 180.0, 90.0)
+    cold6, _ = ds.density_curve("pts", "INCLUDE", level=6, bbox=bbox)
+    cold5, _ = ds.density_curve("pts", "INCLUDE", level=5, bbox=bbox)
+    with _enabled():
+        # warm level 6 WITHOUT rollup so the level-5 chunks can only come
+        # from on-miss downsample assembly (the note proves the path; with
+        # rollup on they'd be pre-merged direct hits — also dispatch-free)
+        with config.CACHE_HIERARCHY.scoped("false"):
+            g6, _ = ds.density_curve("pts", "INCLUDE", level=6, bbox=bbox)
+        hh0 = _counter(metrics.CACHE_HIER_HIT)
+        d0 = _dispatches()
+        g5, _ = ds.density_curve("pts", "INCLUDE", level=5, bbox=bbox)
+        assert _dispatches() == d0, "zoom-out level re-scanned"
+        assert _counter(metrics.CACHE_HIER_HIT) > hh0
+        assert "hierarchy" in ds.audit.recent(1)[0].hints["exec_path"]
+        g5b, _ = ds.density_curve("pts", "INCLUDE", level=5, bbox=bbox)
+    assert np.array_equal(cold6, g6)
+    assert np.array_equal(cold5, g5)
+    assert np.array_equal(cold5, g5b)
+
+
+def test_density_curve_chunk_reuse_across_tiles(ds):
+    """Adjacent tiles of one filter share block-space chunks: the second
+    tile partially hits and stays bit-identical."""
+    with _enabled():
+        ds.density_curve("pts", "INCLUDE", level=6,
+                         bbox=(-180.0, -90.0, 0.0, 90.0))
+        p0 = _counter(metrics.CACHE_PARTIAL)
+        g, _ = ds.density_curve("pts", "INCLUDE", level=6,
+                                bbox=(-180.0, -90.0, 90.0, 90.0))
+        assert _counter(metrics.CACHE_PARTIAL) == p0 + 1
+    with config.CACHE_ENABLED.scoped("false"):
+        cold, _ = ds.density_curve("pts", "INCLUDE", level=6,
+                                   bbox=(-180.0, -90.0, 90.0, 90.0))
+    assert np.array_equal(cold, g)
+
+
+def test_density_curve_weighted_stays_whole_result(ds):
+    bbox = (-180.0, -90.0, 180.0, 90.0)
+    cold, _ = ds.density_curve("pts", "INCLUDE", level=5, bbox=bbox,
+                               weight="weight")
+    with _enabled():
+        g1, _ = ds.density_curve("pts", "INCLUDE", level=5, bbox=bbox,
+                                 weight="weight")
+        assert "cache_chunk" not in ds.audit.recent(1)[0].hints["exec_path"]
+        g2, _ = ds.density_curve("pts", "INCLUDE", level=5, bbox=bbox,
+                                 weight="weight")
+    assert np.array_equal(cold, g1) and np.array_equal(cold, g2)
+
+
+# -- polygon regions --------------------------------------------------------
+
+def test_polygon_count_density_stats_bit_identical(ds):
+    cold_n = ds.count("pts", POLY_Q)
+    raster = (-180.0, -90.0, 180.0, 90.0)
+    cold_g = ds.density("pts", POLY_Q, bbox=raster, width=64, height=48)
+    cold_s = ds.stats("pts", "Count();Enumeration(type)", POLY_Q).value()
+    with _enabled():
+        n1 = ds.count("pts", POLY_Q)
+        ev = ds.audit.recent(1)[0]
+        assert ev.hints["exec_path"].get("cache_region") == "polygon"
+        assert ev.hints["exec_path"]["cache_boundary_cells"] > 0
+        g1 = ds.density("pts", POLY_Q, bbox=raster, width=64, height=48)
+        s1 = ds.stats("pts", "Count();Enumeration(type)", POLY_Q).value()
+        n2 = ds.count("pts", POLY_Q)  # whole-result hit
+        assert ds.audit.recent(1)[0].hints["exec_path"]["cache"] == "hit"
+    assert n1 == n2 == cold_n
+    assert np.array_equal(cold_g, g1)
+    assert s1 == cold_s
+
+
+def test_region_parameter_matches_explicit_conjunct(ds):
+    exact = ds.count("pts", POLY_Q)
+    assert ds.count("pts", region=POLY) == exact
+    with _enabled():
+        assert ds.count("pts", region=POLY) == exact
+        assert ds.count("pts", "type = 'bus'", region=POLY) == \
+            ds.count("pts", f"(type = 'bus') AND {POLY_Q}")
+
+
+def test_polygon_cells_shared_with_bbox_queries(ds):
+    """Interior polygon cells reuse cells a bbox query populated (same
+    residual, same level): the polygon query then hits those instead of
+    scanning them."""
+    with _enabled():
+        # a 180x90 box over the polygon's heart decomposes at level 4 —
+        # the same level the polygon picks — and fully covers some of its
+        # interior cells
+        ds.count("pts", "BBOX(geom, -90, -45, 90, 45)")
+        w0 = _counter(metrics.CACHE_HIT)
+        n = ds.count("pts", POLY_Q)
+        ev = ds.audit.recent(1)[0]
+        hits, total = map(int, ev.hints["exec_path"]["cache_cells"].split("/"))
+        assert hits > 0, "no interior polygon cell was served from cache"
+        assert _counter(metrics.CACHE_HIT) == w0  # no whole-result hit
+    assert n == ds.count("pts", POLY_Q)
+
+
+def test_polygon_boundary_exactness_on_cell_edges():
+    """Points ON level cell edges and ON/near the polygon boundary: the
+    decomposed total equals the exact scan (half-open ulp contract +
+    margin classification)."""
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("edge", "type:String,*geom:Point")
+    # polygon aligned exactly with level-4 cell edges (22.5 multiples)
+    poly = "POLYGON((-45 -22.5, 45 -22.5, 45 22.5, -45 22.5, -45 -22.5))"
+    eps = 1e-9
+    xs = [-45.0, 45.0, 0.0, 22.5, -22.5, 45.0 - eps, -45.0 + eps,
+          45.0 + eps, -45.0 - eps, 22.5, 0.0]
+    ys = [0.0, 0.0, 22.5, -22.5, 22.5, 0.0, 0.0, 0.0, 0.0,
+          22.5 - eps, -22.5 + eps]
+    m = len(xs)
+    ds.insert("edge", {"geom__x": np.asarray(xs), "geom__y": np.asarray(ys),
+                       "type": np.array(["a"] * m)},
+              fids=np.arange(m).astype(str))
+    ds.flush("edge")
+    q = f"INTERSECTS(geom, {poly})"
+    cold = ds.count("edge", q)
+    with _enabled():
+        assert ds.count("edge", q) == cold
+        assert ds.count("edge", q) == cold
+
+
+def test_polygon_with_hole_and_multipolygon(ds):
+    holed = ("POLYGON((-120 -60, 120 -60, 120 70, -120 70, -120 -60), "
+             "(-30 -20, 30 -20, 30 25, -30 25, -30 -20))")
+    multi = ("MULTIPOLYGON(((-150 -70, -20 -70, -20 0, -150 0, -150 -70)), "
+             "((20 10, 150 10, 150 80, 20 80, 20 10)))")
+    for wkt in (holed, multi):
+        q = f"INTERSECTS(geom, {wkt})"
+        cold = ds.count("pts", q)
+        with _enabled():
+            assert ds.count("pts", q) == cold
+            assert ds.count("pts", q) == cold
+
+
+def test_polygon_partitioned_store_residual_fans_out(rng):
+    """Boundary scans ride the ordinary planner/executor — on a
+    partitioned store that is the partitioned (and, meshed, sharded)
+    executor — and stay bit-identical."""
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema(
+        "part", "weight:Float,dtg:Date,*geom:Point;geomesa.partition='time'"
+    )
+    r = np.random.default_rng(5)
+    n = 3000
+    lo = np.datetime64("2020-01-01", "ms").astype(np.int64)
+    ds.insert("part", {
+        "geom__x": r.uniform(-60, 60, n), "geom__y": r.uniform(-50, 50, n),
+        "weight": r.uniform(0, 1, n),
+        "dtg": (lo + r.integers(0, 40 * 86_400_000, n)).astype("datetime64[ms]"),
+    }, fids=np.arange(n).astype(str))
+    ds.flush("part")
+    poly = "POLYGON((-50 -40, 50 -45, 55 45, -55 40, -50 -40))"
+    q = f"INTERSECTS(geom, {poly})"
+    cold = ds.count("part", q)
+    with config.CACHE_ENABLED.scoped("true"):
+        assert ds.count("part", q) == cold
+        assert ds.count("part", q) == cold
+        assert ds.audit.recent(1)[0].hints["exec_path"]["cache"] == "hit"
+
+
+# -- invalidation -----------------------------------------------------------
+
+def test_subtree_invalidation_under_insert_delete(ds):
+    with _enabled(per_axis=4):
+        for q in WARM4:
+            ds.count("pts", q)
+        base = ds.count("pts", ZOOM)  # hierarchy-served
+        assert _counter(metrics.CACHE_HIER_PROMOTE) > 0
+        # an insert bumps the epoch: EVERY pre-merged parent must die with
+        # the flat cells it summarizes
+        ds.insert("pts", {
+            "geom__x": [1.0, -80.0], "geom__y": [1.0, 40.0],
+            "weight": [1.0, 1.0], "type": ["bus", "bus"],
+        }, fids=["h1", "h2"])
+        ds.flush("pts")
+        assert ds.count("pts", ZOOM) == base + 2
+        ds.delete_features("pts", "IN ('h1')")
+        assert ds.count("pts", ZOOM) == base + 1
+    assert ds.count("pts", ZOOM) == base + 1  # cache-disabled oracle
+
+
+# -- seeded property test ---------------------------------------------------
+
+def test_random_pan_zoom_polygon_sequence_bit_identical(ds):
+    """Seeded random walk over pans, zooms, polygon counts, density
+    rasters, and epoch bumps: every cached answer equals the cache-
+    disabled oracle bit-for-bit."""
+    r = np.random.default_rng(42)
+    raster = (-180.0, -90.0, 180.0, 90.0)
+
+    def random_query():
+        kind = r.choice(["bbox", "zoom", "poly", "density"])
+        if kind in ("bbox", "zoom", "density"):
+            span = float(r.choice([45.0, 90.0, 180.0]))
+            x0 = float(r.uniform(-180, 180 - span))
+            y0 = float(r.uniform(-90, 90 - min(span, 90)))
+            q = (f"BBOX(geom, {x0}, {y0}, {x0 + span}, "
+                 f"{min(y0 + min(span, 90), 90.0)})")
+            return kind, q
+        k = int(r.integers(3, 7))
+        ang = np.sort(r.uniform(0, 2 * np.pi, k))
+        cxp, cyp = r.uniform(-60, 60), r.uniform(-40, 40)
+        rad = r.uniform(25, 70)
+        pts = [(cxp + rad * np.cos(a), cyp + rad * np.sin(a)) for a in ang]
+        pts = [(float(np.clip(px, -179, 179)), float(np.clip(py, -89, 89)))
+               for px, py in pts]
+        ring = ", ".join(f"{px:.4f} {py:.4f}" for px, py in pts + [pts[0]])
+        return kind, f"INTERSECTS(geom, POLYGON(({ring})))"
+
+    fid = 20_000
+    for step in range(10):
+        kind, q = random_query()
+        if kind == "density":
+            with _enabled(per_axis=4):
+                warm = ds.density("pts", q, bbox=raster, width=32, height=32)
+            cold = ds.density("pts", q, bbox=raster, width=32, height=32)
+            assert np.array_equal(cold, warm), (step, q)
+        else:
+            with _enabled(per_axis=4):
+                warm_n = ds.count("pts", q)
+            assert warm_n == ds.count("pts", q), (step, q)
+        if step % 4 == 3:  # epoch bump mid-sequence
+            ds.insert("pts", {
+                "geom__x": [float(r.uniform(-170, 170))],
+                "geom__y": [float(r.uniform(-85, 85))],
+                "weight": [1.0], "type": ["car"],
+            }, fids=[str(fid)])
+            fid += 1
+            ds.flush("pts")
+
+
+# -- unit: decomposition / hierarchy shapes ---------------------------------
+
+def _pt_ft():
+    return FeatureType.from_spec("t", "type:String,*geom:Point")
+
+
+def test_world_bbox_has_no_strips():
+    d = decompose(parse_ecql(WORLD), _pt_ft())
+    assert d is not None and not d.strips
+    # domain-edge cells close at exactly 180 / 90
+    n = 1 << d.level
+    assert d.cell_boxes[(n - 1, n - 1)][2] == 180.0
+    assert d.cell_boxes[(n - 1, n - 1)][3] == 90.0
+    # interior cells off the domain edge keep the half-open ulp pull
+    assert d.cell_boxes[(0, 0)][2] < -180.0 + 360.0 / n
+
+
+def test_decompose_region_shapes():
+    r = decompose_region(parse_ecql(POLY_Q), _pt_ft())
+    assert r is not None
+    assert r.cells and r.boundary
+    assert r.residual_key == repr(parse_ecql("INCLUDE"))
+    # interior and boundary are disjoint; runs cover exactly the boundary
+    assert not set(r.cells) & set(r.boundary)
+    assert len(r.boundary_boxes) <= len(r.boundary)
+    # polygon under OR / extra spatial conjunct: not decomposable
+    assert decompose_region(parse_ecql(
+        f"{POLY_Q} OR type = 'bus'"), _pt_ft()) is None
+    assert decompose_region(parse_ecql(
+        f"{POLY_Q} AND BBOX(geom, 0, 0, 10, 10)"), _pt_ft()) is None
+    with config.CACHE_POLYGON.scoped("false"):
+        assert decompose_region(parse_ecql(POLY_Q), _pt_ft()) is None
+
+
+def test_hierarchy_child_order_and_rollup():
+    store = {}
+    get = lambda lvl, c: store.get((lvl, c))  # noqa: E731
+    put = lambda lvl, c, v: store.__setitem__((lvl, c), v)  # noqa: E731
+    merge4 = lambda vals: sum(vals)  # noqa: E731
+    assert hierarchy.children((3, 5)) == [(6, 10), (7, 10), (6, 11), (7, 11)]
+    for ch, v in zip(hierarchy.children((0, 0)), (1, 2, 4, 8)):
+        put(5, ch, v)
+    assert hierarchy.assemble(get, put, merge4, 4, (0, 0)) == 15
+    assert store[(4, (0, 0))] == 15  # promoted
+    # rollup: completing a sibling quad writes the parent bottom-up
+    store.clear()
+    for ch, v in zip(hierarchy.children((1, 1)), (1, 1, 1, 1)):
+        put(3, ch, v)
+    assert hierarchy.rollup(get, put, merge4, 3, (2, 2)) == 1
+    assert store[(2, (1, 1))] == 4
+
+
+def test_curve_downsample_exact():
+    g = np.arange(16, dtype=np.float64).reshape(4, 4)
+    d = hierarchy.downsample(g)
+    assert d.shape == (2, 2)
+    assert d[0, 0] == g[0, 0] + g[0, 1] + g[1, 0] + g[1, 1]
+
+
+# -- satellites: fusion keys, shape baselines, slo breakers -----------------
+
+def test_polygon_region_keys_fusion_distinctly():
+    from geomesa_tpu.serving import fuse
+
+    a = fuse.fuse_key("count", "pts", {"ecql": f"({POLY_Q})"})
+    b = fuse.fuse_key(
+        "count", "pts",
+        {"ecql": "(INTERSECTS(geom, POLYGON((0 0, 9 0, 9 9, 0 9, 0 0))))"},
+    )
+    assert a is not None and b is not None and a != b
+    # an unfolded raw region never fuses (allow-list fail-safe)
+    assert fuse.fuse_key("count", "pts",
+                         {"ecql": "INCLUDE", "region": POLY}) is None
+
+
+def test_latency_outlier_baselines_per_kernel_shape():
+    """A slow-but-legitimate kernel shape must not trip a device whose
+    other shapes are fast — and a straggler within one shape still does
+    (carried RESILIENCE.md follow-up)."""
+    from geomesa_tpu import resilience
+    from geomesa_tpu.parallel import health as phealth
+
+    phealth.reset()
+    resilience.reset_breakers()
+    try:
+        with config.DEVICE_LATENCY_OUTLIER.scoped("3"), \
+                config.DEVICE_LATENCY_FLOOR_MS.scoped("1"), \
+                config.DEVICE_BREAKER_THRESHOLD.scoped("3"):
+            reg = phealth.registry()
+            # two shapes with honestly different costs on device 0
+            for _ in range(16):
+                reg.record_latency(0, 0.002, shape=("count", 1))
+                reg.record_latency(0, 0.200, shape=("density", 8))
+            # under ONE mesh-wide baseline the 0.2s density syncs would be
+            # 100x the mixed median and break device 0; per-shape they ARE
+            # the median
+            assert reg.state(0) == phealth.OK
+            assert len(reg.latency_baselines()) == 2
+            # a true straggler inside one shape still trips
+            for _ in range(8):
+                reg.record_latency(1, 0.002, shape=("count", 1))
+            for _ in range(3):
+                reg.record_latency(1, 0.5, shape=("count", 1))
+            assert reg.state(1) == phealth.BROKEN
+    finally:
+        phealth.reset()
+        resilience.reset_breakers()
+
+
+def test_breaker_open_rides_slo_surface():
+    from geomesa_tpu import obs, resilience, slo
+
+    slo.reset()
+    resilience.reset_breakers()
+    try:
+        br = resilience.breaker("hier-test-sink", threshold=1,
+                                reset_ms=60_000)
+        br.record_failure()
+        assert br.state == "open"
+        states = slo.sync_breaker_gauges()
+        assert states.get("hier-test-sink") == "open"
+        report = metrics.registry().report()
+        assert report.get("slo.breaker.hier-test-sink") == 1.0
+        payload = obs.health()
+        assert "hier-test-sink" in payload["open_breakers"]
+        assert "breaker open" in payload.get("breaker_note", "")
+        assert payload["status"] == "degraded"
+    finally:
+        resilience.reset_breakers()
+        slo.reset()
+
+
+def test_explain_hierarchy_section(ds):
+    with _enabled(per_axis=4):
+        for q in WORLD_WARM:
+            ds.count("pts", q)
+        out = ds.explain("pts", WORLD)
+        assert "Hierarchy" in out
+        assert "levels hit" in out
+        assert "residual fraction" in out
+    out2 = ds.explain("pts", "INCLUDE", region=POLY)
+    assert "polygon cover" in out2
+    assert "boundary cells" in out2

@@ -82,6 +82,23 @@ def test_stats_sketch_over_wire(client):
     assert sum(enum.value().values()) == 200
 
 
+def test_polygon_region_over_wire(client):
+    """The ``region`` option folds server-side into the ecql (before
+    fusion keys are built — docs/CACHE.md polygon regions): count/density/
+    stats over a WKT polygon match the explicit INTERSECTS conjunct."""
+    client.create_schema("t", SPEC)
+    client.insert_arrow("t", _feature_table())
+    poly = "POLYGON((-15 -15, 15 -12, 12 14, -14 15, -15 -15))"
+    exact = client.count("t", f"INTERSECTS(geom, {poly})")
+    assert 0 < exact < 200
+    assert client.count("t", region=poly) == exact
+    grid = client.density("t", region=poly, bbox=(-20, -20, 20, 20),
+                          width=32, height=32)
+    assert grid.sum() == pytest.approx(exact)
+    st = client.stats("t", "Count()", region=poly)
+    assert st.value() == exact
+
+
 def test_bin_export_over_wire(client):
     client.create_schema("t", SPEC)
     client.insert_arrow("t", _feature_table())
