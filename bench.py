@@ -624,6 +624,66 @@ def main():
             f"batch_p50={serving_keys['fused_batch_p50']}\n"
         )
 
+        # Query-axis megakernel (docs/SERVING.md "Query-axis batching"):
+        # N=8 DISTINCT-bbox counts, serial vs one batched device pass
+        # through the scheduler's structural fusion. Hard gates (ci.yml):
+        # <= 2 device dispatches for the batch and every member
+        # bit-identical to its serial execution (the cross-member leak
+        # guard). Literals are kernel data — the batch shares one
+        # compiled kernel with the warm path, so recompiles stay 0.
+        dx0, dy0, dx1, dy1 = bbox
+        dw, dh = (dx1 - dx0) / 4.0, (dy1 - dy0) / 4.0
+        dboxes = [
+            (dx0 + (i % 4) * dw * 0.8, dy0 + (i // 4) * dh * 0.9,
+             dx0 + (i % 4) * dw * 0.8 + dw, dy0 + (i // 4) * dh * 0.9 + dh)
+            for i in range(N_FUSE)
+        ]
+        dqueries = [
+            f"BBOX(geom, {b[0]}, {b[1]}, {b[2]}, {b[3]})" for b in dboxes
+        ]
+        distinct_serial = []
+        ds.count("gdelt", dqueries[0])  # warm the template's kernel
+        t0 = time.time()
+        for q in dqueries:
+            distinct_serial.append(ds.count("gdelt", q))
+        distinct_serial_s = time.time() - t0
+        sched = ds.serving.start()
+        gate = _threading.Event()
+        stall = sched.submit(lambda: gate.wait(30), user="warm", op="stall")
+        futs = [
+            sched.submit(
+                (lambda q=q: ds.count("gdelt", q)),
+                user=f"client{i % 4}", op="count",
+                fuse=_fuse.make_spec(ds, "count", "gdelt", {"ecql": q}),
+            )
+            for i, q in enumerate(dqueries)
+        ]
+        d0 = _disp.value
+        t0 = time.time()
+        gate.set()
+        distinct_fused = [f.result(120) for f in futs]
+        distinct_fused_s = time.time() - t0
+        stall.result(30)
+        distinct_dispatches = _disp.value - d0
+        sched.stop()
+        assert distinct_fused == distinct_serial, (
+            f"distinct fusion NOT bit-identical: "
+            f"{distinct_fused[:3]} vs {distinct_serial[:3]}"
+        )
+        serving_keys.update({
+            "distinct_fused_speedup": round(
+                distinct_serial_s / max(distinct_fused_s, 1e-9), 2
+            ),
+            "distinct_fused_dispatches": int(distinct_dispatches),
+            "distinct_fused_bit_identical": True,
+        })
+        sys.stderr.write(
+            f"serving: {N_FUSE} DISTINCT-bbox counts serial="
+            f"{distinct_serial_s * 1e3:.1f}ms batched="
+            f"{distinct_fused_s * 1e3:.1f}ms "
+            f"dispatches={distinct_dispatches}\n"
+        )
+
     # Multi-device scale-out (docs/SCALE.md sharded scan + docs/SERVING.md
     # executor pool): with >= 2 local devices, (a) a time-partitioned
     # spill dataset scans serial-vs-sharded — results must match BIT-
@@ -907,7 +967,13 @@ def main():
         "kernel_recompiles": _metric("kernel.recompiles"),
         "kernel_bucket_hit": _metric("kernel.bucket_hit"),
         "kernel_evict": _metric("kernel.evict"),
+        # recompiles paid for keys the LRU had previously evicted: the
+        # registry-pressure signal (docs/PERF.md "Registry pressure" —
+        # nonzero means geomesa.kernel.cache.size is too small for the
+        # live working set)
+        "eviction_recompiles": _metric("kernel.recompiles.evicted"),
         "kernel_recompile_alerts": _metric("kernel.recompile.alerts"),
+        "serving_fused_distinct": _metric("serving.fused.distinct"),
         "pipeline_prefetch": _metric("pipeline.prefetch"),
         "cache_hit": _metric("cache.hit"),
         "cache_partial": _metric("cache.partial"),

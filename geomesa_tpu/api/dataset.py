@@ -120,7 +120,7 @@ class FeatureCollection:
         return pd.DataFrame(d)
 
 
-def _traced(op: str):
+def _traced(op: str, speculative: Optional[str] = None):
     """Open one ROOT span per public query operation (docs/OBSERVABILITY.md)
     and pass it through serving admission (docs/SERVING.md): the local-path
     analog of the sidecar's admission queue — an op whose deadline budget is
@@ -129,13 +129,35 @@ def _traced(op: str):
     op's wall time lands in the per-user serving ledger that backs both
     fair-share and the /debug/queries rollups. Admission is reentrant
     (nested public ops account once) and a no-op inside a scheduler-
-    dispatched ticket (the ticket already accounts)."""
+    dispatched ticket (the ticket already accounts).
+
+    ``speculative``: name of a method serving the SPECULATIVE degraded
+    answer when admission sheds AND the caller opted in with
+    ``speculative_ok=True`` — the op returns the typed coarse result
+    (host-only, no device work — exactly what shedding protects) instead
+    of raising ``[GM-SHED]`` (docs/SERVING.md)."""
 
     def deco(fn):
         @functools.wraps(fn)
         def wrapper(self, name, *args, **kw):
-            with tracing.start(op, schema=name), self.serving.admit(op):
-                return fn(self, name, *args, **kw)
+            from geomesa_tpu.resilience import DeadlineShedError
+
+            spec_ok = bool(kw.pop("speculative_ok", False))
+            with tracing.start(op, schema=name):
+                # the fallback runs INSIDE the op's root span, so the
+                # speculative audit event carries this trace id — the
+                # degraded answers are exactly the ones operators need
+                # to correlate back to a trace
+                try:
+                    with self.serving.admit(op):
+                        return fn(self, name, *args, **kw)
+                except DeadlineShedError:
+                    # only the ADMISSION gate raises DeadlineShedError
+                    # (a mid-scan expiry is a plain QueryTimeoutError),
+                    # so no device work has happened yet
+                    if not (spec_ok and speculative):
+                        raise
+                    return getattr(self, speculative)(name, *args, **kw)
 
         return wrapper
 
@@ -463,11 +485,18 @@ class GeoDataset:
     def _plan_cache_clear(self, name: str) -> None:
         """Drop cached plans for one schema (lifecycle changes bump the
         store version too, so stale entries could never HIT — this just
-        releases them eagerly)."""
+        releases them eagerly). The fusion layer's structural-template
+        memo rides along: slot eligibility reads the schema's attribute
+        types, which lifecycle changes can alter (docs/SERVING.md
+        "Query-axis batching")."""
         cache = self.__dict__.get("_plan_cache")
         if cache:
             for k in [k for k in cache if k[0] == name]:
                 del cache[k]
+        tcache = self.__dict__.get("_template_key_cache")
+        if tcache:
+            for k in [k for k in tcache if k[0] == name]:
+                del tcache[k]
 
     @staticmethod
     def _plan_audit_extras(plan) -> Dict[str, Any]:
@@ -974,9 +1003,15 @@ class GeoDataset:
         return q if isinstance(query, Query) or not isinstance(combined, str) \
             else combined
 
-    @_traced("count")
+    @_traced("count", speculative="_speculative_count")
     def count(self, name: str, query: "str | Query" = "INCLUDE",
               exact: bool = True, region=None) -> int:
+        """Exact feature count. ``speculative_ok=True`` (kw): under
+        overload, a count this deadline would shed at admission returns
+        the planner's coarse estimate — typed via an audit event carrying
+        ``speculative: true`` — instead of failing ``[GM-SHED]``
+        (docs/SERVING.md; the sidecar's ``speculative_ok`` request flag /
+        ``x-geomesa-speculative-ok`` header ride the same path)."""
         st, q, plan = self._plan(name, self._with_region(name, query, region))
         if not exact:
             return int(plan.est_count)
@@ -985,6 +1020,27 @@ class GeoDataset:
             n = self.cache.count(self, st, q, plan)
         self._audit(name, q, plan, t0, n, op="count")
         return n
+
+    def _speculative_count(self, name: str, query: "str | Query" = "INCLUDE",
+                           exact: bool = True, region=None) -> int:
+        """The speculative degraded count (see :meth:`count`): planner
+        estimate only — host work, zero device time — with its own audit
+        marker so operators can distinguish every coarse answer served
+        under load from the exact counts around it."""
+        st, q, plan = self._plan(name, self._with_region(name, query, region))
+        est = int(plan.est_count)
+        metrics.inc(metrics.SERVING_SPECULATIVE)
+        hints = {"op": "count", "index": plan.index_name,
+                 "speculative": True, "shed": True}
+        tid = tracing.current_trace_id()
+        if tid is not None:
+            hints["trace_id"] = tid
+        self.audit.record(
+            name, plan.ecql, hints,
+            plan.__dict__.get("plan_time_ms", 0.0), 0.0, est,
+            user=self.serving.current_user() or "",
+        )
+        return est
 
     def bounds(self, name: str) -> Optional[Tuple[float, float, float, float]]:
         st = self._store(name)
@@ -1130,37 +1186,219 @@ class GeoDataset:
                         ex.density_curve(plan, level, w, weight)
                         for w in windows
                     ]
-            scan_ms = (time.perf_counter() - t0) * 1e3
-            # one audit event PER MEMBER: fused queries stay individually
-            # attributable (ISSUE acceptance; docs/SERVING.md). The shared
-            # scan cost and execution-path extras are recorded on the
-            # first member; the rest carry 0 so summing scan_time_ms over
-            # events never double-counts.
-            extras = self._plan_audit_extras(plan)
-            for i, g in enumerate(grids):
-                hints: Dict[str, Any] = {
-                    "op": "density_curve", "index": plan.index_name,
-                    "fused": True, "fused_batch": len(grids),
-                    "fused_member": i, "level": level,
-                }
-                m = members[i] if members is not None else {}
-                tid = m.get("trace_id") or tracing.current_trace_id()
-                if tid is not None:
-                    hints["trace_id"] = tid
-                if m.get("user"):
-                    hints["user"] = m["user"]
-                if i == 0:
-                    hints.update(extras)
-                self.audit.record(
-                    name, plan.ecql, hints,
-                    plan.__dict__.get("plan_time_ms", 0.0) if i == 0 else 0.0,
-                    scan_ms if i == 0 else 0.0,
-                    int(np.count_nonzero(g)),
-                    user=m.get("user") or (self.serving.current_user() or ""),
-                    scanned=plan.__dict__.get("scanned_rows", 0) if i == 0 else 0,
-                    table_rows=plan.__dict__.get("table_rows", 0),
-                )
+            # one audit event PER MEMBER via the shared fused-batch audit
+            # helper (fused queries stay individually attributable; the
+            # shared scan cost + extras ride member 0 so sums over events
+            # never double-count). All members share ONE plan here.
+            self._batch_audit(
+                name, "density_curve", [plan],
+                [int(np.count_nonzero(g)) for g in grids], t0, members,
+                extra_hints={"level": level}, distinct=False,
+            )
             return list(zip(grids, snaps))
+
+    # -- query-axis batched aggregates (docs/SERVING.md "Query-axis
+    # batching"): M *distinct* viewports of one structural query shape in
+    # a single device dispatch. These are the fusion layer's distinct-
+    # literal batch executors (serving/fuse.py) and are also directly
+    # callable. Every method returns None when the batch cannot ride the
+    # megakernel — the caller degrades to query-at-a-time execution, so
+    # batching can change latency, never results. Bypasses the aggregate
+    # cache (each member is a fresh viewport; repeats are served by
+    # repeat fusion / the cache on the serial path).
+    def _batch_plans(self, name: str, queries):
+        """Plan every member; returns ``(st, plans, spec)`` with spec None
+        when the members do not share a batchable structural template."""
+        from geomesa_tpu.planning import batch as batchmod
+
+        qs = [Query(ecql=q) if isinstance(q, str) else q for q in queries]
+        auths = self._effective_auths(qs[0])
+        akey = None if auths is None else tuple(auths)
+        st = plans = None
+        triples = []
+        for q in qs:
+            if (None if self._effective_auths(q) is None
+                    else tuple(self._effective_auths(q))) != akey:
+                return None, None, None  # mixed auths never batch
+            triples.append(self._plan(name, q))
+        st = triples[0][0]
+        plans = [t[2] for t in triples]
+        # members near an index cost boundary can split their choice
+        # (say z2 vs z3 for one bbox+time template): the batch needs ONE
+        # table, and any candidate index returns identical results, so
+        # re-plan the minority onto the majority's index
+        names = {p.index_name for p in plans}
+        if len(names) > 1:
+            import dataclasses
+            from collections import Counter
+
+            maj = Counter(
+                p.index_name for p in plans
+            ).most_common(1)[0][0]
+            for i, (q, p) in enumerate(zip(qs, plans)):
+                if p.index_name != maj:
+                    try:
+                        _, _, p2 = self._plan(
+                            name, dataclasses.replace(q, index=maj)
+                        )
+                        plans[i] = p2
+                    except Exception:
+                        return st, plans, None  # index can't serve it
+        spec = batchmod.build_spec(self, st, plans, auths)
+        return st, plans, spec
+
+    def _batch_audit(self, name: str, op: str, plans, hits, t0: float,
+                     members, extra_hints=None,
+                     distinct: bool = True) -> None:
+        """One audit event PER MEMBER of a fused batch: fused queries
+        stay individually attributable; the shared scan cost and
+        execution-path extras ride member 0 so sums over events never
+        double-count. ``plans`` is per-member, or length-1 when every
+        member shares one plan (the density_curve tile batch);
+        ``distinct`` marks query-axis (distinct-literal) batches."""
+        scan_ms = (time.perf_counter() - t0) * 1e3
+        extras = self._plan_audit_extras(plans[0])
+        shared_plan = len(plans) != len(hits)
+        for i in range(len(hits)):
+            plan = plans[0] if shared_plan else plans[i]
+            hints: Dict[str, Any] = {
+                "op": op, "index": plan.index_name, "fused": True,
+                "fused_batch": len(hits), "fused_member": i,
+            }
+            if distinct:
+                hints["distinct"] = True
+            if extra_hints:
+                hints.update(extra_hints)
+            m = members[i] if members is not None else {}
+            tid = m.get("trace_id") or tracing.current_trace_id()
+            if tid is not None:
+                hints["trace_id"] = tid
+            if m.get("user"):
+                hints["user"] = m["user"]
+            if i == 0:
+                hints.update(extras)
+            self.audit.record(
+                name, plan.ecql, hints,
+                plan.__dict__.get("plan_time_ms", 0.0) if i == 0 else 0.0,
+                scan_ms if i == 0 else 0.0,
+                int(hits[i]),
+                user=m.get("user") or (self.serving.current_user() or ""),
+                scanned=plan.__dict__.get("scanned_rows", 0)
+                if i == 0 else 0,
+                table_rows=plan.__dict__.get("table_rows", 0),
+            )
+
+    def count_batch(self, name: str, queries, exact: bool = True,
+                    members: Optional[List[Dict[str, Any]]] = None):
+        """M distinct exact counts in one device dispatch, or None when
+        the members do not share a structural template (the caller runs
+        them query-at-a-time). Each member's value equals its serial
+        :meth:`count` exactly — the CI-gated contract."""
+        if not queries:
+            return []
+        if not exact:
+            return None  # estimates never scan; nothing to batch
+        if members is not None and len(members) != len(queries):
+            raise ValueError("members must align with queries")
+        with tracing.start("count_batch", schema=name,
+                           batch=len(queries)), \
+                self.serving.admit("count"):
+            st, plans, spec = self._batch_plans(name, queries)
+            if spec is None:
+                return None
+            ex = self._executor(st)
+            if not hasattr(ex, "count_batch"):
+                return None
+            t0 = time.perf_counter()
+            with query_deadline(self._timeout_s()):
+                res = ex.count_batch(plans, spec)
+            if res is None:
+                return None
+            metrics.inc(metrics.SERVING_FUSED_DISTINCT, len(res))
+            self._batch_audit(name, "count", plans, res, t0, members)
+            return res
+
+    def density_batch(self, name: str, queries, bboxes=None,
+                      width: int = 256, height: int = 256,
+                      weight: Optional[str] = None,
+                      members: Optional[List[Dict[str, Any]]] = None):
+        """M distinct heatmaps — each over its OWN query + grid bbox — in
+        one device dispatch, or None when ineligible. ``bboxes`` aligns
+        with ``queries`` (None entries use the store bounds, exactly like
+        :meth:`density`)."""
+        if not queries:
+            return []
+        if members is not None and len(members) != len(queries):
+            raise ValueError("members must align with queries")
+        bboxes = list(bboxes) if bboxes is not None \
+            else [None] * len(queries)
+        if len(bboxes) != len(queries):
+            raise ValueError("bboxes must align with queries")
+        with tracing.start("density_batch", schema=name,
+                           batch=len(queries)), \
+                self.serving.admit("density"):
+            st, plans, spec = self._batch_plans(name, queries)
+            if spec is None:
+                return None
+            ex = self._executor(st)
+            if not hasattr(ex, "density_batch"):
+                return None
+            default_bbox = None
+            boxes = []
+            for bb in bboxes:
+                if bb is None:
+                    if default_bbox is None:
+                        default_bbox = (
+                            self.bounds(name) or (-180, -90, 180, 90)
+                        )
+                    bb = default_bbox
+                boxes.append(tuple(bb))
+            t0 = time.perf_counter()
+            with metrics.registry().timer("query.density").time(), \
+                    query_deadline(self._timeout_s()):
+                grids = ex.density_batch(plans, spec, boxes, width,
+                                         height, weight)
+            if grids is None:
+                return None
+            metrics.inc(metrics.SERVING_FUSED_DISTINCT, len(grids))
+            self._batch_audit(
+                name, "density", plans,
+                [int(np.count_nonzero(g)) for g in grids], t0, members,
+            )
+            return grids
+
+    def stats_batch(self, name: str, stat_spec: str, queries,
+                    members: Optional[List[Dict[str, Any]]] = None):
+        """M distinct stats scans of one spec in one device dispatch, or
+        None when ineligible (descriptive leaves, surviving f32 band
+        rows, or a non-batchable template keep query-at-a-time
+        execution). The member Stat objects are freshly parsed here and
+        discarded on fallback, so a partially-absorbed batch can never
+        leak into the serial rerun."""
+        if not queries:
+            return []
+        if members is not None and len(members) != len(queries):
+            raise ValueError("members must align with queries")
+        with tracing.start("stats_batch", schema=name,
+                           batch=len(queries)), \
+                self.serving.admit("stats"):
+            stats = [parse_stat(stat_spec) for _ in queries]
+            st, plans, spec = self._batch_plans(name, queries)
+            if spec is None:
+                return None
+            ex = self._executor(st)
+            if not hasattr(ex, "stats_batch"):
+                return None
+            t0 = time.perf_counter()
+            with metrics.registry().timer("query.stats").time(), \
+                    query_deadline(self._timeout_s()):
+                out = ex.stats_batch(plans, spec, stats)
+            if out is None:
+                return None
+            metrics.inc(metrics.SERVING_FUSED_DISTINCT, len(out))
+            self._batch_audit(name, "stats", plans, [0] * len(out), t0,
+                              members, extra_hints={"stat": stat_spec})
+            return out
 
     @_traced("stats")
     def stats(self, name: str, stat_spec: str,

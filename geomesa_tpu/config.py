@@ -251,7 +251,11 @@ COMPACT_SHARD_BUCKET = SystemProperty("geomesa.compact.shard.bucket", "8192")
 
 #: Capacity of the shared compiled-kernel LRU registry (entries). Evicts
 #: least-recently-used kernels one at a time — never clear-on-overflow.
-KERNEL_CACHE_SIZE = SystemProperty("geomesa.kernel.cache.size", "256")
+#: Raised 256 -> 512 with the query-axis batch kernels (their padded
+#: member axis multiplies the key space ~5x for batch sites; BENCH_r10
+#: measured 615 recompiles / 359 evictions across the full bench at 256
+#: — docs/PERF.md "Registry pressure").
+KERNEL_CACHE_SIZE = SystemProperty("geomesa.kernel.cache.size", "512")
 
 #: Directory for JAX's persistent compilation cache; when set, compiled
 #: XLA executables survive process restarts (warm starts skip compiles).
@@ -582,6 +586,28 @@ SERVING_FUSION = SystemProperty("geomesa.serving.fusion", "true")
 
 #: Max members per fused micro-batch.
 SERVING_FUSION_MAX = SystemProperty("geomesa.serving.fusion.max", "16")
+
+#: Query-axis (distinct-literal) fusion: requests whose ECQL differs ONLY
+#: in BBOX / temporal literals share a structural fuse key and execute as
+#: one batched device pass with the literals as kernel data
+#: (docs/SERVING.md "Query-axis batching"). Off = only identical-key
+#: repeats (and density_curve tile crops) fuse, the pre-megakernel rule.
+SERVING_FUSION_DISTINCT = SystemProperty(
+    "geomesa.serving.fusion.distinct", "true"
+)
+
+#: Pool-aware fusion placement: a fuse-bearing query prefers the executor
+#: slot whose device most recently scanned its schema's columns (they are
+#: still resident there), deferring briefly to that slot when it is idle
+#: instead of binding to whichever slot drains the queue first. The
+#: decision is surfaced on the fused group's trace span.
+SERVING_PLACEMENT = SystemProperty("geomesa.serving.placement", "true")
+
+#: How long (ms) a placement-deferred ticket is reserved for its preferred
+#: slot before any slot may take it (starvation backstop).
+SERVING_PLACEMENT_GRACE_MS = SystemProperty(
+    "geomesa.serving.placement.grace.ms", "50"
+)
 
 #: Per-user fair share: the dispatcher serves the pending user with the
 #: least attained service time instead of global FIFO, so one user's burst

@@ -17,7 +17,7 @@ from __future__ import annotations
 
 import re
 from dataclasses import dataclass
-from typing import Callable, Dict, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -253,6 +253,27 @@ def _point_eq_fn(pts: np.ndarray, xcol: str, ycol: str):
 
 _FALSE = lambda cols, xp: np.False_  # noqa: E731  broadcasts like a scalar
 _TRUE = lambda cols, xp: np.True_  # noqa: E731
+
+
+def during_device_bounds(ft: FeatureType, lo_ms: int,
+                         hi_ms: int) -> Tuple[int, int, int, int]:
+    """Quantize a [lo_ms, hi_ms] interval to the device time representation:
+    ``(lo_bin, lo_off, hi_bin, hi_off)`` against the (bin, scaled-offset)
+    int32 column pair. ONE implementation shared by the baked During
+    compile below and the batched query-template kernels
+    (filter/template.py) — the two must quantize identically or a batched
+    member's time mask could drift a row off its serial execution."""
+    from geomesa_tpu.curves.binned_time import BinnedTime
+
+    bt = BinnedTime(ft.time_period)
+    scale = bt.off_scale
+    CLAMP = 2**45  # ~±1100 years; keeps bins in int32
+    lo = max(min(lo_ms, CLAMP), -CLAMP)
+    hi = max(min(hi_ms, CLAMP), -CLAMP)
+    lo_b, lo_o = (int(v[0]) for v in bt.to_bin_and_offset(np.asarray([lo])))
+    hi_b, hi_o = (int(v[0]) for v in bt.to_bin_and_offset(np.asarray([hi])))
+    # floor-quantize both sides; quantization fuzz is < scale ms
+    return lo_b, lo_o // scale, hi_b, hi_o // scale
 
 
 def _f32_box_fn(xc: str, yc: str, box, neg: bool):
@@ -1479,18 +1500,9 @@ def compile_filter(
                 )
             # Temporal predicates run on the (bin, scaled-offset) int32 pair —
             # the device time representation. Lexicographic pair compare.
-            from geomesa_tpu.curves.binned_time import BinnedTime
-
-            bt = BinnedTime(ft.time_period)
-            scale = bt.off_scale
-            CLAMP = 2**45  # ~±1100 years; keeps bins in int32
-            lo = max(min(node.lo_ms, CLAMP), -CLAMP)
-            hi = max(min(node.hi_ms, CLAMP), -CLAMP)
-            lo_b, lo_o = (int(v[0]) for v in bt.to_bin_and_offset(np.asarray([lo])))
-            hi_b, hi_o = (int(v[0]) for v in bt.to_bin_and_offset(np.asarray([hi])))
-            # floor-quantize both sides; quantization fuzz is < scale ms
-            lo_o //= scale
-            hi_o //= scale
+            lo_b, lo_o, hi_b, hi_o = during_device_bounds(
+                ft, node.lo_ms, node.hi_ms
+            )
             cb, co = node.prop + "__bin", node.prop + "__off"
             need(cb, co)
 

@@ -1,0 +1,253 @@
+"""Query templates: split viewport literals out of a predicate tree.
+
+The query-axis megakernel (docs/SERVING.md "Query-axis batching") serves M
+*distinct* viewports in one device dispatch by promoting bbox / time-window
+literals from trace-baked constants to kernel **data**. This module is the
+filter-layer half of that contract:
+
+* :func:`split_literals` — partition a parsed filter tree into literal
+  SLOTS (BBOX over a point-geometry column, DURING over a date column —
+  the two predicates real map traffic varies per client) and a RESIDUAL
+  tree (everything else, kept verbatim). Two queries share a *structural*
+  template — and therefore a compiled kernel — iff their slot layout and
+  residual repr match; only the slot literal VALUES differ.
+* :func:`compile_batched` — compile one template into a literal-
+  parameterized mask kernel ``fn(cols, xp, lits_f, lits_i)`` whose f32 /
+  int32 comparisons are op-for-op the ones :func:`compile_filter` bakes,
+  so each member's batched mask selects EXACTLY the rows its serial
+  compiled predicate would (the bit-identity contract the fusion layer
+  CI-gates).
+
+Slots are recognized only in *positive conjunctive* position (top-level
+AND, arbitrarily nested, no NOT/OR above the slot): that is the shape
+panning/zooming viewport traffic has, and it keeps the f32 rounding
+polarity of the batched compare identical to the serial compile (which
+flips inclusive/strict under odd NOT-nesting).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.filter import ir
+from geomesa_tpu.filter.compile import CompiledFilter, during_device_bounds
+from geomesa_tpu.schema.feature_type import FeatureType
+
+
+@dataclass(frozen=True)
+class Slot:
+    """One literal slot: ``kind`` ("bbox" | "during"), the property it
+    constrains, and its offset into the float / int literal vectors."""
+
+    kind: str
+    prop: str
+    f_off: int
+    i_off: int
+
+
+@dataclass
+class QueryTemplate:
+    """One query's structural template + its literal values.
+
+    ``key`` is the structural identity: equal keys mean the queries
+    compile to the same batched kernel and may ride one device dispatch
+    (the fusion layer folds it into the fuse-compatibility key in place
+    of the raw ECQL text). ``lits_f`` / ``lits_i`` are THIS query's slot
+    literal values, laid out per ``slots``.
+    """
+
+    key: tuple
+    slots: Tuple[Slot, ...]
+    residual: ir.Filter
+    lits_f: np.ndarray  # [nf] float32
+    lits_i: np.ndarray  # [ni] int32
+
+
+def _flatten_and(f: ir.Filter) -> List[ir.Filter]:
+    if isinstance(f, ir.And):
+        out: List[ir.Filter] = []
+        for c in f.children:
+            out.extend(_flatten_and(c))
+        return out
+    return [f]
+
+
+def _is_point_geom(ft: FeatureType, prop: str) -> bool:
+    try:
+        a = ft.attr(prop)
+    except Exception:
+        return False
+    return bool(getattr(a, "is_geom", False) and getattr(a, "is_point", False))
+
+
+def _is_date(ft: FeatureType, prop) -> bool:
+    if not isinstance(prop, str):
+        return False
+    try:
+        a = ft.attr(prop)
+    except Exception:
+        return False
+    return a.type == "date"
+
+
+def split_literals(f: ir.Filter, ft: FeatureType) -> Optional[QueryTemplate]:
+    """Extract the viewport-literal template of ``f``, or None when the
+    tree has no batchable slot (nothing to promote to kernel data).
+
+    Only top-level conjuncts slot: a BBOX under OR/NOT keeps its baked
+    compile (the residual carries it verbatim, so such queries still fuse
+    as identical-text repeats)."""
+    conjuncts = _flatten_and(f)
+    slots: List[Slot] = []
+    slot_descr: List[tuple] = []
+    residual: List[ir.Filter] = []
+    lits_f: List[float] = []
+    lits_i: List[int] = []
+    for node in conjuncts:
+        if isinstance(node, ir.BBox) and _is_point_geom(ft, node.prop):
+            slots.append(Slot("bbox", node.prop, len(lits_f), len(lits_i)))
+            slot_descr.append(("bbox", node.prop))
+            # f32 images of the bounds — exactly the values
+            # compile._f32_box_fn bakes (x0/y0/x1/y1 order)
+            lits_f.extend(
+                float(np.float32(v))
+                for v in (node.xmin, node.ymin, node.xmax, node.ymax)
+            )
+        elif isinstance(node, ir.During) and _is_date(ft, node.prop):
+            slots.append(Slot("during", node.prop, len(lits_f), len(lits_i)))
+            slot_descr.append(("during", node.prop))
+            # quantized (bin, offset) bounds — the same host quantization
+            # the serial compile bakes (compile.during_device_bounds)
+            lits_i.extend(during_device_bounds(ft, node.lo_ms, node.hi_ms))
+        else:
+            residual.append(node)
+    if not slots:
+        return None
+    res: ir.Filter = (
+        ir.Include() if not residual
+        else residual[0] if len(residual) == 1
+        else ir.And(tuple(residual))
+    )
+    key = ("qtpl.v1", tuple(slot_descr), repr(res))
+    return QueryTemplate(
+        key=key, slots=tuple(slots), residual=res,
+        lits_f=np.asarray(lits_f, np.float32),
+        lits_i=np.asarray(lits_i, np.int32),
+    )
+
+
+@dataclass
+class BatchedFilter:
+    """A literal-parameterized compiled mask kernel for one template.
+
+    ``fn(cols, xp, lf, li)`` — the member mask with that member's literal
+    vectors traced in; ``band(cols, xp, lf, li)`` — the member's f32-
+    uncertainty band (None when no compare can collide at f32);
+    ``columns`` — every column the mask reads. The residual sub-filter is
+    compiled by the ordinary :func:`compile_filter` (literals baked —
+    they are structural, identical across members by construction).
+    """
+
+    fn: Callable
+    band: Optional[Callable]
+    columns: List[str]
+    #: True when the residual is device-exact (no host refinement beyond
+    #: the band fallback) — the executor's batch-eligibility gate
+    device_exact: bool
+
+
+def _bbox_slot_fn(ft: FeatureType, slot: Slot):
+    a = ft.attr(slot.prop)  # noqa: F841 — validated by split_literals
+    xc, yc = slot.prop + "__x", slot.prop + "__y"
+    o = slot.f_off
+
+    def fn(cols, xp, lf, li):
+        # op-for-op the serial _f32_box_fn (inclusive, even polarity)
+        x = xp.asarray(cols[xc]).astype(xp.float32)
+        y = xp.asarray(cols[yc]).astype(xp.float32)
+        return (x >= lf[o]) & (x <= lf[o + 2]) \
+            & (y >= lf[o + 1]) & (y <= lf[o + 3])
+
+    def band(cols, xp, lf, li):
+        # f32-collision band: union of the four bound collisions — the
+        # same row set compile.band_eq registers (dedup is immaterial
+        # for a boolean union)
+        x = xp.asarray(cols[xc]).astype(xp.float32)
+        y = xp.asarray(cols[yc]).astype(xp.float32)
+        return (x == lf[o]) | (x == lf[o + 2]) \
+            | (y == lf[o + 1]) | (y == lf[o + 3])
+
+    return fn, band, [xc, yc]
+
+
+def _during_slot_fn(slot: Slot):
+    cb, co = slot.prop + "__bin", slot.prop + "__off"
+    o = slot.i_off
+
+    def fn(cols, xp, lf, li):
+        # lexicographic (bin, offset) pair compare — the serial During
+        # kernel with the quantized bounds traced instead of baked
+        b, off = cols[cb], cols[co]
+        ge = (b > li[o]) | ((b == li[o]) & (off >= li[o + 1]))
+        le = (b < li[o + 2]) | ((b == li[o + 2]) & (off <= li[o + 3]))
+        return ge & le
+
+    return fn, None, [cb, co]
+
+
+def compile_batched(tpl: QueryTemplate, ft: FeatureType,
+                    residual_compiled: CompiledFilter) -> BatchedFilter:
+    """Assemble the batched mask kernel for one template.
+
+    ``residual_compiled`` is the compiled residual filter — built by the
+    caller via the ordinary :func:`compile_filter` (and visibility-wrapped
+    there when auths apply), so string-code resolution, f32 band
+    registration and dictionary fingerprints keep their one
+    implementation. Conjunct order differs from the serial compile
+    (residual first, then slots) — boolean AND over exact masks is
+    order-independent, so the member row set is unchanged."""
+    slot_fns: List[Callable] = []
+    slot_bands: List[Callable] = []
+    columns = list(residual_compiled.columns)
+    for slot in tpl.slots:
+        if slot.kind == "bbox":
+            fn, band, cols = _bbox_slot_fn(ft, slot)
+        else:
+            fn, band, cols = _during_slot_fn(slot)
+        slot_fns.append(fn)
+        if band is not None:
+            slot_bands.append(band)
+        for c in cols:
+            if c not in columns:
+                columns.append(c)
+    res_fn = residual_compiled.fn
+    res_band = residual_compiled.band
+
+    def fn(cols, xp, lf, li):
+        m = res_fn(cols, xp)
+        for sfn in slot_fns:
+            m = m & sfn(cols, xp, lf, li)
+        return m
+
+    band = None
+    if slot_bands or res_band is not None:
+
+        def band(cols, xp, lf, li):  # noqa: F811
+            m = None
+            if res_band is not None:
+                m = res_band(cols, xp)
+            for sb in slot_bands:
+                b = sb(cols, xp, lf, li)
+                m = b if m is None else (m | b)
+            return m
+
+    device_exact = (
+        residual_compiled.refine is None
+        or residual_compiled.refine_only_if_band
+    )
+    return BatchedFilter(
+        fn=fn, band=band, columns=columns, device_exact=device_exact,
+    )

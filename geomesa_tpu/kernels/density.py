@@ -18,14 +18,41 @@ def density_grid(x, y, mask, bbox, width: int, height: int, weight=None, xp=None
     ``x``/``y``/``mask`` may be [S, L] or flat; backend-generic (np or jnp).
     Cells follow the reference's RenderingGrid convention: row 0 = ymin edge.
     """
+    xmin, ymin, xmax, ymax = bbox
+    # spans computed HERE (host f64 for baked bboxes) so the query-axis
+    # batched kernel can pass the f32 images of the SAME span values as
+    # traced scalars and reproduce the pixel mapping bit-for-bit — an
+    # f32 (xmax - xmin) recomputed in-kernel could differ by an ulp
+    return density_grid_at(
+        x, y, mask, xmin, ymin, xmax - xmin, ymax - ymin,
+        width, height, weight, xp,
+    )
+
+
+def grid_params(bbox) -> np.ndarray:
+    """The traced-parameter form of a density bbox for the batched kernel:
+    ``[x0, y0, dx, dy]`` as the f32 images of the host-f64 origin/span —
+    exactly the scalar values the baked :func:`density_grid` closes over
+    after jax's weak-type f32 conversion."""
+    xmin, ymin, xmax, ymax = (float(v) for v in bbox)
+    return np.asarray(
+        [xmin, ymin, xmax - xmin, ymax - ymin], np.float32
+    )
+
+
+def density_grid_at(x, y, mask, x0, y0, dx, dy, width: int, height: int,
+                    weight=None, xp=None):
+    """:func:`density_grid` against an origin/span parameterization.
+    ``x0``/``y0``/``dx``/``dy`` may be python floats (baked — the classic
+    path) or traced f32 scalars (the query-axis batched path, one compiled
+    kernel serving every viewport)."""
     if xp is None:
         xp = np
-    xmin, ymin, xmax, ymax = bbox
     fx = x.reshape(-1)
     fy = y.reshape(-1)
     fm = mask.reshape(-1)
-    px = xp.clip(((fx - xmin) / (xmax - xmin) * width).astype(xp.int32), 0, width - 1)
-    py = xp.clip(((fy - ymin) / (ymax - ymin) * height).astype(xp.int32), 0, height - 1)
+    px = xp.clip(((fx - x0) / dx * width).astype(xp.int32), 0, width - 1)
+    py = xp.clip(((fy - y0) / dy * height).astype(xp.int32), 0, height - 1)
     w = fm.astype(xp.float32) if weight is None else xp.where(
         fm, weight.reshape(-1).astype(xp.float32), xp.float32(0)
     )

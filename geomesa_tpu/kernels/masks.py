@@ -51,6 +51,17 @@ def window_mask(starts, ends, counts, L: int):
     return wm & (iota[None, :] < counts[:, None])
 
 
+def window_mask_batch(starts, ends, counts, L: int, member: int):
+    """One member's [S, L] mask out of query-axis-stacked [M, S, K] window
+    arrays (docs/SERVING.md "Query-axis batching"). ``member`` is a trace-
+    time python int — the batched kernel unrolls its member loop so each
+    member's mask is op-for-op the serial :func:`window_mask`, which is
+    what makes the de-interleaved results bit-identical to serial
+    execution. Members padded to the batch bucket carry all-(0, 0)
+    windows and mask to False everywhere."""
+    return window_mask(starts[member], ends[member], counts, L)
+
+
 def window_mask_np(starts, ends, counts, L: int) -> np.ndarray:
     """Host twin of :func:`window_mask` (numpy)."""
     S = starts.shape[0]

@@ -9,7 +9,10 @@ is the executor's warm-path substrate (docs/PERF.md):
   cell queries (one registry per parent store / partitioned executor).
   Entries evict one at a time, least-recently-used first — never the
   clear-on-overflow wipe the per-site dicts used to do, which threw away
-  63 hot kernels to admit the 65th.
+  63 hot kernels to admit the 65th. Default capacity 512
+  (``geomesa.kernel.cache.size``; raised from 256 when the query-axis
+  batch kernels widened the key space with the padded member axis —
+  docs/PERF.md records the BENCH_r10 eviction pressure behind the raise).
 * **version-stable keys** — kernel cache keys carry NO store version: the
   compiled function is structure-only (shapes + predicate closure), so a
   store mutation must not recompile anything. What CAN invalidate a
@@ -59,6 +62,8 @@ KERNEL_EVICT = metrics.KERNEL_EVICT
 # ---------------------------------------------------------------------------
 
 _query_window = threading.local()
+
+_MISSING = object()  # OrderedDict.pop sentinel (None is a valid value)
 
 #: how long a trip stays visible on the gauge (covers realistic scrape
 #: intervals; the kernel.recompile.alerts counter is the durable record)
@@ -156,11 +161,23 @@ class KernelRegistry:
         self._lock = threading.Lock()
         #: site label -> fresh-trace count (puts, not hits)
         self._traces: Dict[Any, int] = {}
+        #: site label -> entries evicted (kernel.evict.<site> twin, kept
+        #: here so explain/tests can read per-registry pressure directly)
+        self._evicts: Dict[Any, int] = {}
+        #: keys evicted and not since re-admitted (bounded FIFO set): a
+        #: put() whose key is in here is an EVICTION-CAUSED recompile —
+        #: the LRU was too small for the live working set, the thrash
+        #: signal docs/PERF.md's registry-pressure check watches
+        #: (kernel.recompiles.evicted + the bench eviction_recompiles key)
+        self._evicted_keys: "OrderedDict[Hashable, None]" = OrderedDict()
+        self._evicted_recompiles = 0
+
+    _EVICTED_KEYS_MAX = 4096
 
     def _cap(self) -> int:
         if self._capacity is not None:
             return self._capacity
-        return config.KERNEL_CACHE_SIZE.to_int() or 256
+        return config.KERNEL_CACHE_SIZE.to_int() or 512
 
     @staticmethod
     def _site(key: Hashable) -> Any:
@@ -183,20 +200,36 @@ class KernelRegistry:
     def put(self, key: Hashable, fn) -> None:
         """Admit one freshly-traced kernel, evicting LRU entries over
         capacity (one at a time — the clear-on-overflow this replaces
-        wiped every hot kernel to admit one)."""
+        wiped every hot kernel to admit one). Evictions account per SITE
+        (``kernel.evict.<site>``), and re-tracing a previously-evicted
+        key counts as an eviction-caused recompile
+        (``kernel.recompiles.evicted``) — the LRU-pressure signals the
+        docs/PERF.md registry check reads."""
         with self._lock:
             self._entries[key] = fn
             self._entries.move_to_end(key)
             site = self._site(key)
             self._traces[site] = self._traces.get(site, 0) + 1
-            evicted = 0
+            evicted_from = self._evicted_keys.pop(key, _MISSING)
+            if evicted_from is not _MISSING:
+                self._evicted_recompiles += 1
+            evicted_sites = []
             cap = max(self._cap(), 1)
             while len(self._entries) > cap:
-                self._entries.popitem(last=False)
-                evicted += 1
+                ekey, _ = self._entries.popitem(last=False)
+                esite = self._site(ekey)
+                self._evicts[esite] = self._evicts.get(esite, 0) + 1
+                evicted_sites.append(esite)
+                self._evicted_keys[ekey] = None
+                while len(self._evicted_keys) > self._EVICTED_KEYS_MAX:
+                    self._evicted_keys.popitem(last=False)
         _note_recompile(site)
-        if evicted:
-            metrics.inc(KERNEL_EVICT, evicted)
+        if evicted_from is not _MISSING:
+            metrics.inc(metrics.KERNEL_RECOMPILE_EVICTED)
+        if evicted_sites:
+            metrics.inc(KERNEL_EVICT, len(evicted_sites))
+            for esite in evicted_sites:
+                metrics.inc(f"{KERNEL_EVICT}.{_site_slug(esite)}")
 
     def __len__(self) -> int:
         with self._lock:
@@ -208,6 +241,21 @@ class KernelRegistry:
             if site is not None:
                 return self._traces.get(site, 0)
             return dict(self._traces)
+
+    def evicts(self, site=None):
+        """LRU evictions per jit site (or one site's count) — the
+        per-registry twin of the kernel.evict.<site> metrics."""
+        with self._lock:
+            if site is not None:
+                return self._evicts.get(site, 0)
+            return dict(self._evicts)
+
+    def evicted_recompiles(self) -> int:
+        """Fresh traces paid for keys the LRU had previously evicted —
+        nonzero means the working set exceeds the capacity
+        (geomesa.kernel.cache.size; docs/PERF.md)."""
+        with self._lock:
+            return self._evicted_recompiles
 
     def clear(self) -> None:
         with self._lock:

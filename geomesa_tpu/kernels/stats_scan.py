@@ -39,6 +39,21 @@ def device_supported(stat: sk.Stat, host_only_cols) -> bool:
     return True
 
 
+def batch_supported(stat: sk.Stat) -> bool:
+    """May this stat tree ride the query-axis batched kernel
+    (docs/SERVING.md "Query-axis batching")? Everything the device
+    supports EXCEPT descriptive stats: count/minmax/histogram/enumeration/
+    topk reduce in exact integer (or order-independent min/max)
+    arithmetic, so a batched member's partial is bit-identical to its
+    serial scan regardless of layout; descriptive s1/s2 are f32 sums whose
+    bits depend on the reduction layout (the serial path may compact),
+    so they keep query-at-a-time execution."""
+    return all(
+        leaf.kind in (DEVICE_KINDS - {"descriptive"})
+        for leaf in _leaf_stats(stat)
+    )
+
+
 def device_update(stat: sk.Stat, cols: Dict, mask, xp, vocab_sizes: Dict[str, int]):
     """Compute the masked partial state arrays for every leaf sketch.
 

@@ -403,6 +403,12 @@ SERVING_SLOT_RESPAWN = "serving.slot.respawn"
 #   trace.slow                 queries that exceeded geomesa.trace.slow.ms
 KERNEL_RECOMPILE_ALERT = "kernel.recompile.alert"
 KERNEL_RECOMPILE_ALERTS = "kernel.recompile.alerts"
+#   kernel.evict.<site>        per-jit-site LRU evictions (suffix = site)
+#   kernel.recompiles.evicted  fresh traces paid for keys the LRU had
+#                              previously evicted — the registry-thrash
+#                              signal (docs/PERF.md "Registry pressure";
+#                              the bench eviction_recompiles key reads it)
+KERNEL_RECOMPILE_EVICTED = "kernel.recompiles.evicted"
 # Trace export + tail sampling (tracing_export.py; docs/OBSERVABILITY.md):
 #   trace.export.exported   traces handed to a sink (after sampling)
 #   trace.export.sampled    healthy traces dropped by the sample rate
@@ -452,7 +458,21 @@ SERVING_SHED_QUEUE_FULL = "serving.shed.queue_full"
 #   serving.executor.dispatch.<slot>  groups executed per pool slot (the
 #                           pool-actually-parallel bench/CI gate reads
 #                           these; docs/SERVING.md)
+#   serving.fused.distinct  members served via a DISTINCT-literal batched
+#                           pass (query-axis megakernel; docs/SERVING.md
+#                           "Query-axis batching")
+#   serving.speculative     deadline-shed counts answered with the typed
+#                           coarse estimate instead of [GM-SHED] (client
+#                           opted in via speculative_ok; docs/SERVING.md)
+#   serving.placement.bound fused groups that executed on their preferred
+#                           (column-hot) slot after a placement deferral
+#   serving.placement.defer fuse-bearing tickets deferred toward their
+#                           preferred slot (docs/SERVING.md §5c)
 SERVING_FUSED = "serving.fused"
+SERVING_FUSED_DISTINCT = "serving.fused.distinct"
+SERVING_SPECULATIVE = "serving.speculative"
+SERVING_PLACEMENT_BOUND = "serving.placement.bound"
+SERVING_PLACEMENT_DEFER = "serving.placement.defer"
 SERVING_FUSION_BATCH = "serving.fusion.batch"
 SERVING_EXECUTOR_DISPATCH = "serving.executor.dispatch"
 EXEC_DEVICE_DISPATCH = "exec.device.dispatch"

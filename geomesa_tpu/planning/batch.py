@@ -1,0 +1,90 @@
+"""Batch specs for query-axis megakernels (docs/SERVING.md "Query-axis
+batching").
+
+A :class:`BatchSpec` packages everything the executor's ``*_batch`` entry
+points need to serve M *distinct* viewports in one device dispatch: the
+shared structural template (filter/template.py), the literal-parameterized
+compiled mask, and the member literal vectors padded to the registry
+batch bucket. :func:`build_spec` is the eligibility gate — it returns
+None unless every member plan proves it compiles to the SAME kernel
+structure, so the serving layer can always degrade to query-at-a-time
+execution without changing any result.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.filter import template as ftpl
+from geomesa_tpu.filter.compile import compile_filter
+from geomesa_tpu.kernels.registry import bucket_batch
+
+
+@dataclass
+class BatchSpec:
+    """One fused group's batched-kernel inputs (see module docstring)."""
+
+    #: structural identity (template key + auths): equal keys <=> one
+    #: compiled kernel serves both batches
+    key: tuple
+    #: version-stable kernel-token component (folded into the registry
+    #: key next to shapes + the dictionary fingerprint)
+    token: tuple
+    #: the literal-parameterized compiled mask
+    bf: "ftpl.BatchedFilter"
+    #: member literal vectors, padded to the batch bucket
+    lits_f: np.ndarray  # [Mp, nf] float32
+    lits_i: np.ndarray  # [Mp, ni] int32
+    M: int
+    Mp: int
+
+
+def _auths_token(auths) -> Optional[Tuple[str, ...]]:
+    return None if auths is None else tuple(auths)
+
+
+def build_spec(ds, st, plans: List, auths=None) -> Optional[BatchSpec]:
+    """Assemble the batch spec for ``plans`` (all over store ``st``), or
+    None when they do not share a structural template / cannot ride the
+    batched device kernel. ``ds`` supplies the visibility wrap so the
+    batched residual enforces exactly the auths each member's serial
+    compiled predicate does."""
+    if not plans:
+        return None
+    tpls = []
+    for p in plans:
+        t = ftpl.split_literals(p.filter, st.ft)
+        if t is None:
+            return None
+        tpls.append(t)
+    t0 = tpls[0]
+    if any(t.key != t0.key for t in tpls[1:]):
+        return None
+    if any(p.index_name != plans[0].index_name for p in plans[1:]):
+        return None
+    # residual compiled once (literals in it are structural — identical
+    # across members by key equality), visibility-wrapped like _plan does
+    residual = compile_filter(t0.residual, st.ft, st.dicts)
+    residual = ds._vis_wrap(st, residual, auths)
+    bf = ftpl.compile_batched(t0, st.ft, residual)
+    if not bf.device_exact:
+        return None
+    M = len(plans)
+    Mp = bucket_batch(M)
+    nf, ni = len(t0.lits_f), len(t0.lits_i)
+    lits_f = np.zeros((Mp, nf), np.float32)
+    lits_i = np.zeros((Mp, ni), np.int32)
+    for m, t in enumerate(tpls):
+        lits_f[m] = t.lits_f
+        lits_i[m] = t.lits_i
+    akey = _auths_token(auths)
+    return BatchSpec(
+        key=("batch",) + t0.key + (akey,),
+        # the FULL template key (not a hash): registry keys must never
+        # collide across templates — equality is the correctness contract
+        token=("qtpl", t0.key, akey),
+        bf=bf, lits_f=lits_f, lits_i=lits_i, M=M, Mp=Mp,
+    )

@@ -1775,6 +1775,365 @@ class Executor:
             self.density_curve_batch_raw(plan, level, block_windows, weight)
         )
 
+    # -- query-axis batched aggregates (docs/SERVING.md "Query-axis
+    # batching"): M *distinct* viewports in ONE device dispatch. The
+    # batched kernel bakes the predicate SHAPE (the structural template's
+    # residual + slot layout) but not the viewport literals — those ride
+    # as [Mp, nf]/[Mp, ni] traced arrays — and the member axis pads to its
+    # registry bucket (registry.bucket_batch), so batch sizes 3, 5, 7
+    # share one compiled kernel at Mp=8 and a panning client never
+    # recompiles. Each member's mask is op-for-op its serial kernel
+    # (unrolled member loop, batched window_mask + literal-parameterized
+    # compare with the identical f32/int32 values), so de-interleaved
+    # results are bit-identical to query-at-a-time execution — the
+    # CI-gated contract.
+    def _batch_setups(self, plans, spec, agg_cols=()):
+        """Per-member scan setups + stacked windows for one batch, or
+        None when the batch cannot ride the device kernel (caller falls
+        back to per-member serial execution). ``spec`` is the
+        planning/batch.BatchSpec the API layer built."""
+        if self.mesh is not None or not self.prefer_device:
+            return None
+        setups = []
+        table = None
+        for plan in plans:
+            if plan.hints.sampling or plan.hints.sample_by:
+                return None
+            su = self._scan_setup(plan, agg_cols)
+            if su is None:
+                # empty member (disjoint key plan) or empty table: zero
+                # windows, zero partial — uniform with serial zeros
+                plan.__dict__.setdefault("scanned_rows", 0)
+                plan.__dict__.setdefault("table_rows", 0)
+                setups.append(None)
+                continue
+            if not su["use_device"] or su["sb_mode"] is not None:
+                return None
+            t = su["table"]
+            if table is None:
+                table = t
+            elif t is not table:
+                return None
+            setups.append(su)
+        if table is None:  # every member empty
+            return {"empty": True, "setups": setups}
+        if any(p.__dict__.get("cache_token") is None for p in plans):
+            return None
+        from geomesa_tpu.kernels.registry import bucket_batch
+
+        S, L = table.n_shards, table.shard_len
+        K = max(
+            (su["starts"].shape[1] for su in setups if su is not None),
+            default=1,
+        )
+        Mp = bucket_batch(len(plans))
+        starts = np.zeros((Mp, S, K), np.int32)
+        ends = np.zeros((Mp, S, K), np.int32)
+        for m, su in enumerate(setups):
+            if su is None:
+                continue
+            k = su["starts"].shape[1]
+            starts[m, :, :k] = su["starts"]
+            ends[m, :, :k] = su["ends"]
+        counts = np.diff(table.shard_bounds).astype(np.int32)
+        return {
+            "empty": False, "setups": setups, "table": table, "L": L,
+            "K": K, "Mp": Mp, "starts": starts, "ends": ends,
+            "counts": counts,
+        }
+
+    def _batch_band_corrs(self, plans, bs, agg_fn_host, agg_cols,
+                          extras=None):
+        """Per-member exact f32-band corrections (None = member clean).
+        The batched device kernel excises each member's band rows exactly
+        like the serial kernel; this is the serial host-side correction,
+        run per member off its own plan's compiled band."""
+        corrs = []
+        for m, (plan, su) in enumerate(zip(plans, bs["setups"])):
+            if su is None or plan.compiled.band is None:
+                corrs.append(None)
+                continue
+            info = self._band_info(plan, su)
+            if info is None or len(info) == 0:
+                corrs.append(None)
+                continue
+            extra = () if extras is None else extras[m]
+            corrs.append(self._band_correction(
+                plan, su, info, agg_fn_host, agg_cols, extra
+            ))
+        return corrs
+
+    def _batch_device_agg(self, plans, spec, bs, member_agg, agg_cols,
+                          site, key_extras=(), extra_arrays=()):
+        """Mask + per-member aggregation in ONE jit over the stacked
+        query axis. ``member_agg(m, cols, mm, xp, *extra_arrays)`` builds
+        member ``m``'s partial from its mask (the loop unrolls at trace
+        time — Mp is part of the kernel shape). Returns the UNSYNCED
+        tuple of Mp partials."""
+        import jax
+        import jax.numpy as jnp
+
+        table, L, K, Mp = bs["table"], bs["L"], bs["K"], bs["Mp"]
+        bfn, bband = spec.bf.fn, spec.bf.band
+        names = tuple(dict.fromkeys(
+            list(spec.bf.columns) + list(agg_cols)
+        ))
+        fn_cache = self.kernel_registry()
+        fn_key = ((site,) + tuple(key_extras), L, K, Mp, spec.token,
+                  plans[0].index_name, self._dict_fp())
+        go = fn_cache.get(fn_key)
+        if go is None:
+
+            @jax.jit
+            def go(cols, starts, ends, counts, lf, li, extra):
+                outs = []
+                for m in range(Mp):
+                    wm = kmasks.window_mask_batch(starts, ends, counts,
+                                                  L, m)
+                    mm = wm & bfn(cols, jnp, lf[m], li[m])
+                    if bband is not None:
+                        mm = mm & ~bband(cols, jnp, lf[m], li[m])
+                    outs.append(member_agg(m, cols, mm, jnp, *extra))
+                return tuple(outs)
+
+            fn_cache.put(fn_key, go)
+            for p in plans:
+                self._note(p, kernel="trace")
+        else:
+            for p in plans:
+                self._note(p, kernel="hit")
+        with tracing.span("scan.device_put", batch=len(plans)):
+            dev_cols = table.device_columns(names, self._sharding())
+        wcache = self.store.__dict__.setdefault("_win_cache", {})
+        # keyed by the window BYTES, not their hash: a collision here
+        # would silently serve another batch's scan ranges, and equality
+        # is the correctness contract (the [Mp, S, K] arrays are far
+        # smaller than the device windows the 64-entry cache holds)
+        wkey = ("batch_win", site, self.store.uid, self.store.version,
+                K, Mp, bs["starts"].tobytes(), bs["ends"].tobytes(),
+                self._devkey())
+        win = wcache.get(wkey)
+        if win is None:
+            win = (self._put(bs["starts"]), self._put(bs["ends"]),
+                   self._put(bs["counts"]))
+            if len(wcache) >= 64:
+                wcache.clear()
+            wcache[wkey] = win
+        for p in plans:
+            self._note(p, scan="device-batch", batch=len(plans))
+        with tracing.span("scan.kernel", site=site, batch=len(plans)), \
+                utilization.device_busy(self._devkey() or 0):
+            # ONE observable unit of device work for the whole batch —
+            # the distinct-fusion bench/CI gate counts these
+            metrics.inc(metrics.EXEC_DEVICE_DISPATCH)
+            return go(dev_cols, *win, spec.lits_f, spec.lits_i,
+                      tuple(extra_arrays))
+
+    def count_batch_partial(self, plans, spec):
+        """Unsynced batched count: ``(partials_or_None, corrs)`` — one
+        device scalar per member plus each member's exact band-row
+        correction — or None when the batch is ineligible here (caller
+        degrades to query-at-a-time)."""
+        check_deadline()
+        bs = self._batch_setups(plans, spec)
+        if bs is None:
+            return None
+        corrs = [None] * len(plans)
+        if bs["empty"]:
+            return (None, corrs)
+        corrs = self._batch_band_corrs(
+            plans, bs, lambda cols, m, xp: m.sum(), ()
+        )
+        out = self._batch_device_agg(
+            plans, spec, bs,
+            lambda m, cols, mm, xp: mm.sum(),
+            (), "count_batch",
+        )
+        return (out, corrs)
+
+    def count_batch(self, plans, spec):
+        """M distinct counts in one device dispatch (None = ineligible).
+        Each member's value equals its serial :meth:`count` exactly."""
+        got = self.count_batch_partial(plans, spec)
+        if got is None:
+            return None
+        return self.decode_count_batch(got, len(plans))
+
+    @staticmethod
+    def decode_count_batch(got, n: int):
+        """One :meth:`count_batch_partial` result as per-member host ints
+        (the per-partition decode of the partitioned merge)."""
+        out, corrs = got
+        totals = []
+        arr = None if out is None else [np.asarray(o) for o in out]
+        for m in range(n):
+            v = 0 if arr is None else int(arr[m])
+            if corrs[m] is not None:
+                v += int(corrs[m])
+            totals.append(v)
+        return totals
+
+    def density_batch_partial(self, plans, spec, bboxes, width: int,
+                              height: int, weight=None):
+        """Unsynced batched density: ``(grids_or_None, corrs)`` — one
+        device [height, width] f32 grid per member over that member's OWN
+        bbox (traced grid parameters: one compiled kernel serves every
+        viewport) — or None when ineligible."""
+        check_deadline()
+        geom = self.store.ft.geom_field
+        xc, yc = geom + "__x", geom + "__y"
+        agg_cols = [xc, yc] + ([weight] if weight else [])
+        bs = self._batch_setups(plans, spec, agg_cols)
+        if bs is None:
+            return None
+        corrs = [None] * len(plans)
+        if bs["empty"]:
+            return (None, corrs)
+        Mp = bs["Mp"]
+        gp = np.zeros((Mp, 4), np.float32)
+        gp[:, 2:] = 1.0  # padded members: benign nonzero spans
+        for m, bb in enumerate(bboxes):
+            gp[m] = kdensity.grid_params(bb)
+
+        def host_agg(m):
+            def agg(cols, msk, xp):
+                w = cols.get(weight) if weight else None
+                return kdensity.density_grid(
+                    cols[xc], cols[yc], msk, tuple(bboxes[m]),
+                    width, height, w, xp,
+                )
+
+            return agg
+
+        corrs = self._batch_band_corrs(
+            plans, bs,
+            # the member index rides through extras so each band
+            # correction rasterizes into ITS member's grid
+            lambda cols, msk, xp, m: host_agg(m)(cols, msk, xp),
+            agg_cols,
+            extras=[(m,) for m in range(len(plans))],
+        )
+
+        def member_agg(m, cols, mm, xp, gp_):
+            w = cols.get(weight) if weight else None
+            return kdensity.density_grid_at(
+                cols[xc], cols[yc], mm,
+                gp_[m, 0], gp_[m, 1], gp_[m, 2], gp_[m, 3],
+                width, height, w, xp,
+            )
+
+        out = self._batch_device_agg(
+            plans, spec, bs, member_agg, agg_cols, "density_batch",
+            key_extras=(width, height, weight), extra_arrays=(gp,),
+        )
+        return (out, corrs)
+
+    def density_batch(self, plans, spec, bboxes, width: int, height: int,
+                      weight=None):
+        """M distinct heatmaps in one device dispatch (None = ineligible).
+        Unweighted grids are bit-identical to serial :meth:`density` (the
+        cell values are exact integer counts); weighted grids match the
+        serial padded-scatter path op-for-op."""
+        got = self.density_batch_partial(plans, spec, bboxes, width,
+                                         height, weight)
+        if got is None:
+            return None
+        return self.decode_density_batch(got, len(plans), width, height)
+
+    @staticmethod
+    def decode_density_batch(got, n: int, width: int, height: int):
+        """One :meth:`density_batch_partial` result as per-member host
+        f32 grids."""
+        out, corrs = got
+        grids = []
+        for m in range(n):
+            g = (np.zeros((height, width), np.float32) if out is None
+                 else np.asarray(out[m]))
+            if corrs[m] is not None:
+                g = g + np.asarray(corrs[m], np.float32)
+            grids.append(g)
+        return grids
+
+    def stats_batch_partials(self, plans, spec, stats):
+        """Unsynced batched stats partials: one
+        :func:`~geomesa_tpu.kernels.stats_scan.device_update` pytree list
+        per member — or None when ineligible. Stats never take additive
+        band corrections (the serial path reroutes band-bearing scans to
+        the host), so ANY member with surviving band rows makes the batch
+        ineligible here; descriptive leaves are excluded by
+        :func:`~geomesa_tpu.kernels.stats_scan.batch_supported`."""
+        check_deadline()
+        if any(not kstats.batch_supported(s) for s in stats):
+            return None
+        bundle = self._stats_bundle(plans[0], stats[0])
+        if bundle is None:
+            return None
+        agg_cols, vocab_sizes = bundle
+        bs = self._batch_setups(plans, spec, agg_cols)
+        if bs is None:
+            return None
+        if bs["empty"]:
+            return (None,)
+        for plan, su in zip(plans, bs["setups"]):
+            if su is None or plan.compiled.band is None:
+                continue
+            info = self._band_info(plan, su)
+            if info is not None and len(info):
+                return None  # serial would run this member on host
+
+        def member_agg(m, cols, mm, xp):
+            # padded members reuse member 0's structure (same spec text)
+            st = stats[m] if m < len(stats) else stats[0]
+            return kstats.device_update(st, cols, mm, xp, vocab_sizes)
+
+        out = self._batch_device_agg(
+            plans, spec, bs, member_agg, agg_cols, "stats_batch",
+            # the stat STRUCTURE is baked into the traced update (leaf
+            # kinds, bins, attributes): it must key the kernel, or a
+            # Count() batch and a MinMax() batch of one template would
+            # collide on one compiled kernel
+            key_extras=(self._stat_signature(stats[0]),),
+        )
+        return (out,)
+
+    @staticmethod
+    def _stat_signature(stat: sk.Stat) -> tuple:
+        """Trace-shape signature of a stat tree: everything
+        :func:`~geomesa_tpu.kernels.stats_scan.device_update` bakes."""
+        sig = []
+        for leaf in kstats._leaf_stats(stat):
+            if isinstance(leaf, sk.DescriptiveStats):
+                attrs = tuple(leaf.attributes)
+            else:
+                attrs = (getattr(leaf, "attribute", None),)
+            extra = ()
+            if leaf.kind == "histogram":
+                extra = (leaf.bins, leaf.lo, leaf.hi)
+            elif leaf.kind == "topk":
+                extra = (getattr(leaf, "k", None),)
+            sig.append((leaf.kind, attrs, extra))
+        return tuple(sig)
+
+    def stats_batch(self, plans, spec, stats):
+        """M distinct stats scans in one device dispatch (None =
+        ineligible). Mutates and returns ``stats`` in member order."""
+        got = self.stats_batch_partials(plans, spec, stats)
+        if got is None:
+            return None
+        self.absorb_stats_batch(got, stats, self.store.dicts)
+        return stats
+
+    @staticmethod
+    def absorb_stats_batch(got, stats, dicts) -> None:
+        """Fold one :meth:`stats_batch_partials` result into the member
+        Stat objects (the per-partition absorb of the partitioned merge,
+        in member order)."""
+        (out,) = got
+        if out is None:
+            return
+        for m, st in enumerate(stats):
+            kstats.absorb_partials(st, out[m], dicts)
+
     def _stats_bundle(self, plan: QueryPlan, stat: sk.Stat):
         """(agg_cols, vocab_sizes) when every leaf of ``stat`` can update
         on device over this table, else None (the gather path serves)."""

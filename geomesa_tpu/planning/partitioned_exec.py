@@ -149,10 +149,14 @@ class PartitionedExecutor:
                 tracing.add_cost("bytes_staged", float(staged))
             metrics.inc(metrics.PIPELINE_PREFETCH)
 
-    def _children(self, plan: QueryPlan):
+    def _children(self, plan: QueryPlan, bins: Optional[List[int]] = None):
         """(bin, child) over pruned partitions through the serial
-        (one-staging-slot) prefetch pipeline — see :meth:`_pipeline`."""
-        for _i, b, child in self._pipeline(plan, self.prune(plan)):
+        (one-staging-slot) prefetch pipeline — see :meth:`_pipeline`.
+        ``bins`` overrides the plan's own pruning (the query-axis batch
+        path scans the UNION of its members' pruned bins)."""
+        if bins is None:
+            bins = self.prune(plan)
+        for _i, b, child in self._pipeline(plan, bins):
             yield b, child
 
     def _stage_device(self, child, plan: QueryPlan, dev) -> None:
@@ -521,7 +525,7 @@ class PartitionedExecutor:
         )
 
     def _additive_scan(self, plan: QueryPlan, op: str, dispatch,
-                       finish) -> None:
+                       finish, bins: Optional[List[int]] = None) -> None:
         """Drive one additive op over the pruned partitions, delivering
         each partition's partial to ``finish(bin, partial, merge_device)``
         in pruned-bin order. The sharded fan-out serves when it engages
@@ -532,25 +536,28 @@ class PartitionedExecutor:
         paths guard finish with the _scan_part degradation contract, so
         a device failure surfacing at sync time skips that partition
         with exact survivor totals instead of failing the query under
-        ``allow_partial()``."""
+        ``allow_partial()``. ``bins`` overrides the plan's pruning (the
+        query-axis batch path scans its members' pruned-bin UNION)."""
         devs = self._scan_devices()
         if devs is not None:
-            bins = self.prune(plan)
+            if bins is None:
+                bins = self.prune(plan)
             if len(bins) >= 2:
                 self._sharded_scan(plan, op, dispatch, finish, devs, bins)
                 return
-        for b, ex in self._each(plan):
+        for b, ex in self._each(plan, bins=bins):
             r = self._scan_part(plan, b, op, lambda: dispatch(ex))
             if r is not _SKIPPED and r is not None:
                 self._scan_part(plan, b, op, lambda: finish(b, r, None),
                                 probe=False, spanned=False)
 
-    def _each(self, plan: QueryPlan) -> Iterator[Tuple[int, Executor]]:
+    def _each(self, plan: QueryPlan,
+              bins: Optional[List[int]] = None) -> Iterator[Tuple[int, Executor]]:
         """Stream (bin, executor) over pruned partitions under the residency
         budget; accumulates the selectivity counters across partitions."""
         tot_scanned = tot_rows = 0
         try:
-            for b, child in self._children(plan):
+            for b, child in self._children(plan, bins):
                 check_deadline()
                 if child is None or child.count == 0:
                     continue
@@ -710,6 +717,147 @@ class PartitionedExecutor:
                 g = np.zeros((iy1 - iy0 + 1, ix1 - ix0 + 1), np.float64)
             outs.append(g)
         return outs
+
+    # -- query-axis batched aggregates (docs/SERVING.md "Query-axis
+    # batching"): each pruned partition executes ONE stacked device pass
+    # for every member viewport, and per-member partials accumulate
+    # through the SAME pruned-bin tree-merge order the serial and sharded
+    # paths share — so the batch composes with the device mesh and a
+    # degraded partition skips for every member alike (exact per-member
+    # survivor totals).
+    def _union_bins(self, plans: List[QueryPlan]) -> List[int]:
+        """Members' pruned-bin UNION, in store partition order. A member
+        whose own pruning excludes a bin contributes an all-empty window
+        set there — a zero partial, which is the additive identity, so
+        per-member results equal their serial (member-pruned) runs."""
+        sel = set()
+        for p in plans:
+            sel.update(self.prune(p))
+        return [b for b in self.store.partition_bins() if b in sel]
+
+    def _batch_ok(self, plans: List[QueryPlan], spec, bins: List[int],
+                  agg_cols=()) -> bool:
+        """Partition-invariant batch eligibility, decided once from the
+        first non-empty pruned partition (children share the schema,
+        dictionaries, and column layout). ``bins`` is the caller's
+        already-computed union (pruning M plans is not free — compute it
+        once, probe and scan with the same list); ``agg_cols`` must be
+        the op's aggregation columns — a host-only weight column flips
+        ``use_device`` off, and the probe must see it or the per-
+        partition dispatches would fail where the caller expects the
+        None degrade."""
+        if self.mesh is not None or not self.prefer_device:
+            return False
+        for b in bins:
+            child = self.store.child(b)
+            if child is None or child.count == 0:
+                continue
+            ex = self._executor_for(b, child)
+            bs = ex._batch_setups(plans, spec, agg_cols)
+            return bs is not None
+        return True  # nothing to scan: zeros for everyone
+
+    def count_batch(self, plans: List[QueryPlan], spec):
+        """M distinct counts over the partitioned store in one device
+        dispatch per pruned partition (None = ineligible)."""
+        bins = self._union_bins(plans)
+        if not self._batch_ok(plans, spec, bins):
+            return None
+        M = len(plans)
+        totals = [0] * M
+        carrier = plans[0]
+
+        def finish(b, p, mdev):
+            for m, v in enumerate(Executor.decode_count_batch(p, M)):
+                totals[m] += v
+
+        def dispatch(ex):
+            r = ex.count_batch_partial(plans, spec)
+            if r is None:
+                # eligibility is partition-invariant (checked up front):
+                # a None here is a bug, and returning it would silently
+                # DROP this partition's contribution — fail loudly into
+                # the degradation contract instead
+                raise RuntimeError("batched count ineligible mid-scan")
+            return r
+
+        self._additive_scan(
+            carrier, "count", dispatch,
+            finish, bins=bins,
+        )
+        return totals
+
+    def density_batch(self, plans: List[QueryPlan], spec, bboxes,
+                      width: int, height: int, weight=None):
+        """M distinct heatmaps over the partitioned store (None =
+        ineligible). Per-member grids reduce across partitions in the
+        shared tree-merge order; a member's extra (member-pruned-away)
+        partitions contribute exact-zero grids — the additive identity."""
+        geom = self.store.ft.geom_field
+        agg_cols = [geom + "__x", geom + "__y"] \
+            + ([weight] if weight else [])
+        bins = self._union_bins(plans)
+        if not self._batch_ok(plans, spec, bins, agg_cols):
+            return None
+        M = len(plans)
+        red = pdev.TreeReducer(lambda A, B: [a + b for a, b in zip(A, B)])
+
+        def finish(b, p, mdev):
+            red.push(Executor.decode_density_batch(p, M, width, height))
+
+        def dispatch(ex):
+            r = ex.density_batch_partial(plans, spec, bboxes, width,
+                                         height, weight)
+            if r is None:  # see count_batch: never drop silently
+                raise RuntimeError("batched density ineligible mid-scan")
+            return r
+
+        self._additive_scan(
+            plans[0], "density", dispatch,
+            finish, bins=bins,
+        )
+        merged = red.result()
+        if merged is None:
+            return [np.zeros((height, width), np.float32)
+                    for _ in range(M)]
+        return merged
+
+    def stats_batch(self, plans: List[QueryPlan], spec, stats):
+        """M distinct stats scans over the partitioned store (None =
+        ineligible). Per-member partials absorb in pruned-bin order —
+        the exact absorb sequence each member's serial scan performs."""
+        if any(not kstats.batch_supported(s) for s in stats):
+            return None
+        bins = self._union_bins(plans)
+        if not self._batch_ok(plans, spec, bins):
+            return None
+        saw_ineligible = [False]
+
+        def finish(b, p, mdev):
+            Executor.absorb_stats_batch(p, stats, self.store.dicts)
+
+        def dispatch(ex):
+            if saw_ineligible[0]:
+                # the batch is already doomed to the query-at-a-time
+                # fallback: don't burn device passes on partitions whose
+                # partials will be discarded
+                return None
+            r = ex.stats_batch_partials(plans, spec, stats)
+            if r is None:
+                # a partition whose band rows force the host path: the
+                # whole batch must degrade to query-at-a-time (raising
+                # here would only skip the partition under allow_partial)
+                saw_ineligible[0] = True
+                return None
+            return r
+
+        self._additive_scan(
+            plans[0], "stats", dispatch, finish,
+            bins=bins,
+        )
+        if saw_ineligible[0]:
+            return None
+        return stats
 
     def _stats_device_ok(self, plan: QueryPlan, stat: sk.Stat) -> bool:
         """Can every leaf of ``stat`` update on device? Decided once from

@@ -40,7 +40,7 @@ from __future__ import annotations
 
 from typing import Any, Dict, List, Optional
 
-from geomesa_tpu import tracing
+from geomesa_tpu import config, tracing
 from geomesa_tpu.serving.scheduler import FusedMemberError, FuseSpec, Ticket
 
 #: opts keys that make a query ineligible for fusion (they change the
@@ -60,11 +60,15 @@ _UNFUSABLE_HINTS = (
 #: folds it into the ecql text BEFORE keying (service._fold_region), so
 #: two different polygons key distinctly; a request that somehow still
 #: carries a raw ``region`` falls through this allow-list and never fuses.
+#: ``speculative_ok`` (docs/SERVING.md speculative counts) never changes
+#: a successful result, so carrying it keeps a query fusable.
 _FUSABLE_KEYS = frozenset(
-    ("op", "name", "schema", "ecql", "auths", "exact",
+    ("op", "name", "schema", "ecql", "auths", "exact", "speculative_ok",
      "bbox", "width", "height", "weight", "level", "stat")
     + _UNFUSABLE_HINTS
 )
+
+_MISS = object()
 
 
 def _auths_key(opts: Dict[str, Any]):
@@ -72,11 +76,49 @@ def _auths_key(opts: Dict[str, Any]):
     return None if a is None else tuple(a)
 
 
-def fuse_key(op: str, schema: str, opts: Dict[str, Any]) -> Optional[tuple]:
+def _structural_key(ds, schema: str, ecql: str) -> Optional[tuple]:
+    """The query's structural-template key (filter/template.py), or None
+    when it has no batchable viewport slot. Memoized per (schema, ecql)
+    on the dataset — this runs on the transport thread, before queueing,
+    so the parse must be paid at most once per distinct query text. The
+    memo is dropped with the plan cache on schema lifecycle changes."""
+    if ds is None or not config.SERVING_FUSION_DISTINCT.to_bool():
+        return None
+    cache = ds.__dict__.setdefault("_template_key_cache", {})
+    ck = (schema, ecql)
+    hit = cache.get(ck, _MISS)
+    if hit is not _MISS:
+        return hit
+    out = None
+    try:
+        from geomesa_tpu.filter import parse_ecql
+        from geomesa_tpu.filter import template as ftpl
+
+        st = ds._store(schema)
+        t = ftpl.split_literals(parse_ecql(ecql), st.ft)
+        out = t.key if t is not None else None
+    except Exception:
+        out = None
+    if len(cache) >= 1024:
+        cache.clear()
+    cache[ck] = out
+    return out
+
+
+def fuse_key(op: str, schema: str, opts: Dict[str, Any],
+             ds=None) -> Optional[tuple]:
     """The fusion-compatibility key for one request, or None when the
     request is ineligible. Equal keys => the members share a compiled
     kernel (the same inputs determine the executor's version-stable
-    token) and may coalesce into one device pass."""
+    token) and may coalesce into one device pass.
+
+    With ``ds`` given and ``geomesa.serving.fusion.distinct`` on, a
+    count / density / stats request whose ECQL carries batchable viewport
+    literals keys on its STRUCTURAL template instead of the literal text
+    (docs/SERVING.md "Query-axis batching"): requests differing only in
+    BBOX / temporal literals (and, for density, the grid bbox) share a
+    key and ride one batched device pass, each member's literals carried
+    as payload and de-interleaved bit-identically."""
     if any(opts.get(k) for k in _UNFUSABLE_HINTS):
         return None
     if any(v is not None and v is not False and k not in _FUSABLE_KEYS
@@ -85,9 +127,24 @@ def fuse_key(op: str, schema: str, opts: Dict[str, Any]) -> Optional[tuple]:
     ecql = opts.get("ecql", "INCLUDE")
     auths = _auths_key(opts)
     if op == "count":
-        return ("count", schema, ecql, auths, bool(opts.get("exact", True)))
+        exact = bool(opts.get("exact", True))
+        skel = _structural_key(ds, schema, ecql) if exact else None
+        return ("count", schema,
+                ("skel",) + skel if skel is not None else ecql,
+                auths, exact)
     if op == "density":
         bbox = opts.get("bbox")
+        # distinct-literal density batches only unweighted grids: their
+        # cells are exact integer counts, so the batched pass is bit-
+        # identical to ANY serial layout (weighted grids stay on the
+        # literal-identical repeat path)
+        skel = (_structural_key(ds, schema, ecql)
+                if opts.get("weight") is None else None)
+        if skel is not None:
+            # the grid bbox becomes member payload, like the ecql literals
+            return ("density", schema, ("skel",) + skel, auths, None,
+                    int(opts.get("width", 256)),
+                    int(opts.get("height", 256)), None)
         return ("density", schema, ecql, auths,
                 tuple(bbox) if bbox is not None else None,
                 int(opts.get("width", 256)), int(opts.get("height", 256)),
@@ -98,7 +155,10 @@ def fuse_key(op: str, schema: str, opts: Dict[str, Any]) -> Optional[tuple]:
         return ("density_curve", schema, ecql, auths,
                 int(opts.get("level", 9)), opts.get("weight"))
     if op == "stats":
-        return ("stats", schema, ecql, auths, opts.get("stat"))
+        skel = _structural_key(ds, schema, ecql)
+        return ("stats", schema,
+                ("skel",) + skel if skel is not None else ecql,
+                auths, opts.get("stat"))
     return None
 
 
@@ -107,13 +167,14 @@ def make_spec(ds, op: str, schema: str,
     """A :class:`FuseSpec` whose batch executor returns RAW results (ints,
     grids, stats). The sidecar wraps these into wire frames; local callers
     (bench, tests) consume them directly."""
-    key = fuse_key(op, schema, opts)
+    key = fuse_key(op, schema, opts, ds=ds)
     if key is None:
         return None
     return FuseSpec(
         key=("local", op, schema) + key,
         payload=dict(opts),
         batch=lambda tickets: run_batch(ds, op, schema, tickets),
+        schema=schema,
     )
 
 
@@ -154,11 +215,25 @@ def _member_record(ds, schema: str, t: Ticket, op: str, ecql: str,
     ds.audit.record(schema, ecql, hints, 0.0, 0.0, hits, user=t.user)
 
 
+def _placement_attrs(primary: Ticket) -> Dict[str, Any]:
+    """The scheduler's pool-aware placement decision for this group (when
+    one was made), surfaced as span attributes (docs/SERVING.md §5c)."""
+    p = getattr(primary.fuse, "placement", None)
+    if not p:
+        return {}
+    return {f"placement_{k}": v for k, v in p.items()}
+
+
 def run_batch(ds, op: str, schema: str, tickets: List[Ticket]) -> List[Any]:
     """Execute one fused group, returning one raw result per ticket (in
     order). The primary member runs the full audited public path under its
     own trace; non-primary members record their spans/audits via
-    :func:`_member_record`."""
+    :func:`_member_record`.
+
+    Members may be *repeats* (identical payload: one execution, shared
+    result) or *distinct viewports* of one structural template (the
+    query-axis megakernel: one batched device pass, per-member literals
+    as kernel data — docs/SERVING.md "Query-axis batching")."""
     primary = tickets[0]
     opts = primary.fuse.payload
     ecql = opts.get("ecql", "INCLUDE")
@@ -167,11 +242,24 @@ def run_batch(ds, op: str, schema: str, tickets: List[Ticket]) -> List[Any]:
     if op == "density_curve":
         return _density_curve_batch(ds, schema, tickets)
 
+    if n_batch > 1 and op in ("count", "density", "stats"):
+        distinct = any(
+            t.fuse.payload.get("ecql", "INCLUDE") != ecql
+            for t in tickets[1:]
+        )
+        if op == "density" and not distinct:
+            bb0 = opts.get("bbox")
+            distinct = any(
+                t.fuse.payload.get("bbox") != bb0 for t in tickets[1:]
+            )
+        if distinct:
+            return _run_distinct(ds, op, schema, tickets)
+
     # repeat fusion: one execution, shared result (bit-identical by
     # construction — it IS the serial execution, run once)
     with tracing.start(f"fused.{op}", trace_id=primary.trace_id,
                        force=primary.trace_id is not None,
-                       fused_batch=n_batch):
+                       fused_batch=n_batch, **_placement_attrs(primary)):
         q = _query_from(opts)
         if op == "count":
             result = ds.count(schema, q, exact=bool(opts.get("exact", True)))
@@ -205,6 +293,84 @@ def run_batch(ds, op: str, schema: str, tickets: List[Ticket]) -> List[Any]:
             out.append(_own_copy(result))
         except Exception as e:
             out.append(FusedMemberError(e))
+    return out
+
+
+def _query_member(ds, opts: Dict[str, Any]):
+    from geomesa_tpu.api.dataset import Query
+
+    return Query(ecql=opts.get("ecql", "INCLUDE"), auths=opts.get("auths"))
+
+
+def _run_distinct(ds, op: str, schema: str,
+                  tickets: List[Ticket]) -> List[Any]:
+    """Distinct-viewport fusion: one batched device pass serving every
+    member's OWN literals (docs/SERVING.md "Query-axis batching"). The
+    dataset's ``*_batch`` entry writes one audit event per member; member
+    spans open here. When the batch is ineligible (template mismatch a
+    key collision can't cause, host-path members, descriptive stats, f32
+    band survivors) every member runs query-at-a-time under its own
+    trace — fusion changes latency, never results."""
+    primary = tickets[0]
+    opts = primary.fuse.payload
+    n_batch = len(tickets)
+    queries = [_query_member(ds, t.fuse.payload) for t in tickets]
+    meta = [{"trace_id": t.trace_id, "user": t.user} for t in tickets]
+    with tracing.start(f"fused.{op}.distinct", trace_id=primary.trace_id,
+                       force=primary.trace_id is not None,
+                       fused_batch=n_batch, distinct=True,
+                       **_placement_attrs(primary)):
+        if op == "count":
+            out = ds.count_batch(
+                schema, queries, exact=bool(opts.get("exact", True)),
+                members=meta,
+            )
+        elif op == "density":
+            out = ds.density_batch(
+                schema, queries,
+                bboxes=[t.fuse.payload.get("bbox") for t in tickets],
+                width=int(opts.get("width", 256)),
+                height=int(opts.get("height", 256)),
+                weight=None, members=meta,
+            )
+        else:
+            out = ds.stats_batch(schema, opts["stat"], queries,
+                                 members=meta)
+    if out is None:
+        # ineligible: query-at-a-time under each member's own trace —
+        # every member keeps its full serial path (audit included)
+        out = []
+        for t, q in zip(tickets, queries):
+            try:
+                # each member's serial run must audit under ITS user, not
+                # the dispatch thread's (= the primary's) — the
+                # individually-attributable contract
+                with ds.serving.member_user(t.user), \
+                        tracing.start(f"fused.{op}.serial",
+                                      trace_id=t.trace_id,
+                                      force=t.trace_id is not None):
+                    if op == "count":
+                        r = ds.count(schema, q,
+                                     exact=bool(opts.get("exact", True)))
+                    elif op == "density":
+                        r = ds.density(
+                            schema, q, bbox=t.fuse.payload.get("bbox"),
+                            width=int(opts.get("width", 256)),
+                            height=int(opts.get("height", 256)),
+                        )
+                    else:
+                        r = ds.stats(schema, opts["stat"], q)
+                out.append(r)
+            except Exception as e:
+                out.append(FusedMemberError(e))
+        return out
+    # member spans for non-primary members (audits were written by the
+    # batch entry); span failures stay per-member — the batch already ran
+    for i, t in enumerate(tickets[1:], start=1):
+        try:
+            _member_span(t, op, n_batch)
+        except Exception as e:
+            out[i] = FusedMemberError(e)
     return out
 
 

@@ -97,6 +97,12 @@ class FuseSpec:
     key: tuple
     payload: Any = None
     batch: Optional[Callable[[List["Ticket"]], List[Any]]] = None
+    #: schema the group scans — the pool-aware placement policy keys its
+    #: column-heat table on it (docs/SERVING.md §5c)
+    schema: Optional[str] = None
+    #: the placement decision the dispatcher made for this group (set at
+    #: defer/execute time; serving/fuse.py surfaces it on the group span)
+    placement: Optional[Dict[str, Any]] = None
 
 
 class FusedMemberError:
@@ -137,6 +143,15 @@ class Ticket:
     #: dispatch thread so a scoped knob resolves identically in queue and
     #: inline modes (the partition prefetcher crosses threads the same way)
     overrides: Dict[str, str] = field(default_factory=dict)
+    #: speculative fallback (docs/SERVING.md): a cheap HOST-ONLY callable
+    #: producing the typed coarse answer — when set, a deadline shed
+    #: returns this instead of failing [GM-SHED] (the client opted in)
+    speculative: Optional[Callable[[], Any]] = None
+    #: pool-aware placement (docs/SERVING.md §5c): slot this fuse-bearing
+    #: ticket was deferred toward (its schema's column-hot device), and
+    #: when — other slots skip it for the placement grace window only
+    defer_slot: Optional[int] = None
+    defer_at: float = 0.0
 
     def _order_key(self):
         # deadline-aware ordering within a user: earliest deadline first,
@@ -265,6 +280,14 @@ class QueryScheduler:
         #: for (docs/RESILIENCE.md §6: streams re-open, not resume)
         self._slot_gen: Dict[int, int] = {}
         self._last_supervise = 0.0
+        #: pool-aware fusion placement (docs/SERVING.md §5c, guarded by
+        #: _cv): schema -> the slot whose device most recently scanned
+        #: that schema's columns (they are still resident there), and the
+        #: set of slots currently blocked in the dispatch wait (only an
+        #: IDLE preferred slot is worth deferring a group toward — a busy
+        #: one would serialize the pool for a column re-upload it saves)
+        self._schema_heat: Dict[str, int] = {}
+        self._idle: set = set()
         self._tls = threading.local()
 
     @staticmethod
@@ -402,7 +425,8 @@ class QueryScheduler:
                trace_id: Optional[str] = None,
                continuation: bool = False,
                slot: Optional[int] = None,
-               slot_gen: Optional[int] = None) -> Future:
+               slot_gen: Optional[int] = None,
+               speculative: Optional[Callable[[], Any]] = None) -> Future:
         """Admit one request to the dispatch queue (requires :meth:`start`).
         Raises :class:`AdmissionRejectedError` when the bounded queue is
         full and :class:`DeadlineShedError` when the budget provably cannot
@@ -412,7 +436,15 @@ class QueryScheduler:
         ``slot_gen`` is the slot GENERATION the stream opened under — a
         mismatch (the slot died/drained and was respawned since) fails
         typed [GM-DRAINING], because the respawned dispatcher cannot
-        vouch for the dead one's in-flight device work."""
+        vouch for the dead one's in-flight device work.
+
+        ``speculative``: host-only fallback producing the TYPED coarse
+        answer (docs/SERVING.md speculative counts) — a request that
+        would be deadline-shed (here at admission, or at dispatch after
+        queueing) resolves to ``speculative()`` instead of [GM-SHED].
+        Still accounted as shed (the exact answer WAS refused); the
+        fallback runs outside the scheduler lock and burns no device
+        time — exactly what shedding protects."""
         user = user or _default_user()
         # supervision rides the submit path (docs/RESILIENCE.md §6): a
         # dead slot respawns — and a cordoned-out width re-clamps —
@@ -451,6 +483,7 @@ class QueryScheduler:
             led.submitted += 1
             led.last_ts = time.time()
             led.weight = config.user_weight(user)
+            shed_speculative = False
             if not continuation:
                 cap = config.SERVING_QUEUE_DEPTH.to_int()
                 cap = 256 if cap is None else cap
@@ -462,26 +495,52 @@ class QueryScheduler:
                 if shed_msg is not None:
                     led.shed += 1
                     metrics.inc(metrics.SERVING_SHED_DEADLINE)
-                    raise DeadlineShedError(shed_msg)
-            self._seq += 1
-            t = Ticket(
-                seq=self._seq, user=user, op=op, fn=fn, future=fut,
-                deadline=deadline, submitted_at=time.perf_counter(),
-                fuse=fuse if config.SERVING_FUSION.to_bool() else None,
-                trace_id=trace_id, continuation=continuation,
-                overrides=config.snapshot_overrides(),
-                slot=slot if continuation else None,
-            )
-            if continuation:
-                self._continuations.append(t)
-            else:
-                self._queues.setdefault(user, []).append(t)
-            self._pending += 1
-            metrics.inc(metrics.SERVING_ADMITTED)
-            # notify_all: with a pool, a slot-pinned continuation must wake
-            # ITS slot's thread, whichever of the waiters that is
-            self._cv.notify_all()
+                    if speculative is None:
+                        raise DeadlineShedError(shed_msg)
+                    # client opted into the typed coarse answer: resolve
+                    # OUTSIDE the lock (below) instead of raising
+                    shed_speculative = True
+            if not shed_speculative:
+                self._seq += 1
+                t = Ticket(
+                    seq=self._seq, user=user, op=op, fn=fn, future=fut,
+                    deadline=deadline, submitted_at=time.perf_counter(),
+                    fuse=fuse if config.SERVING_FUSION.to_bool() else None,
+                    trace_id=trace_id, continuation=continuation,
+                    overrides=config.snapshot_overrides(),
+                    slot=slot if continuation else None,
+                    speculative=speculative,
+                )
+                if continuation:
+                    self._continuations.append(t)
+                else:
+                    self._queues.setdefault(user, []).append(t)
+                self._pending += 1
+                metrics.inc(metrics.SERVING_ADMITTED)
+                # notify_all: with a pool, a slot-pinned continuation must
+                # wake ITS slot's thread, whichever of the waiters that is
+                self._cv.notify_all()
+        if shed_speculative:
+            self._resolve_speculative(fut, speculative)
         return fut
+
+    @staticmethod
+    def _resolve_speculative(fut: Future, speculative: Callable) -> None:
+        """Resolve a shed request with its typed coarse answer
+        (docs/SERVING.md speculative counts). Host-only by contract —
+        never called under the scheduler lock; a fallback failure
+        surfaces as the shed it replaced."""
+        try:
+            # the SERVING_SPECULATIVE metric and the distinct audit
+            # marker are written by the fallback itself
+            # (GeoDataset._speculative_count) — one owner, no double count
+            out = speculative()
+        except Exception as e:
+            fut.set_exception(DeadlineShedError(
+                f"query shed (speculative fallback failed: {e!r})"
+            ))
+            return
+        fut.set_result(out)
 
     def _admission_shed_locked(self, deadline: Deadline) -> Optional[str]:
         """Reject-before-work check: a deadline that is already expired, or
@@ -846,7 +905,13 @@ class QueryScheduler:
                         while not self._stopped \
                                 and slot not in self._draining \
                                 and not self._has_work_locked(slot):
-                            self._cv.wait()
+                            # placement reads _idle: only a slot blocked
+                            # HERE is worth deferring a fused group to
+                            self._idle.add(slot)
+                            try:
+                                self._cv.wait()
+                            finally:
+                                self._idle.discard(slot)
                             # the WAITING dispatcher's chaos-kill point:
                             # an idle slot that loses the race for a
                             # ticket re-waits without reaching the
@@ -879,6 +944,13 @@ class QueryScheduler:
                             return
                         if drained is None:
                             self._next_group_locked(group, slot)
+                            if not group and self._has_work_locked(slot):
+                                # everything queued is placement-reserved
+                                # for another slot within its grace
+                                # window: sleep until a notify or the
+                                # window lapses (never busy-spin)
+                                self._cv.wait(self._placement_grace_s())
+                            self._note_heat_locked(group, slot)
                             self._active_users[slot] = \
                                 {t.user for t in group}
                     if drained is not None:
@@ -1017,26 +1089,102 @@ class QueryScheduler:
 
             pdev.unregister_pool(self)
 
-    def _pick_user_locked(self) -> Optional[str]:
+    def _users_by_share_locked(self) -> List[str]:
+        """Users with pending work in dispatch-preference order (the
+        fair-share pick, generalized to a ranking so a slot can fall
+        through past a user whose queue is placement-reserved)."""
         users = [u for u, q in self._queues.items() if q]
         if not users:
-            return None
+            return users
         if not config.SERVING_FAIR_SHARE.to_bool():
             # strict FIFO across users
-            return min(users, key=lambda u: min(t.seq for t in self._queues[u]))
+            return sorted(
+                users, key=lambda u: min(t.seq for t in self._queues[u])
+            )
         # least attained WEIGHTED service first (service_s / weight, so a
         # weight-4 user earns ~4x the service of a weight-1 user under
         # contention — geomesa.serving.user.weight.<user>, captured into
         # the ledger on the submitting thread so scoped overrides apply);
         # FIFO head seq breaks ties so two fresh users interleave in
         # arrival order
-        return min(
+        return sorted(
             users,
             key=lambda u: (
                 self._led(u).service_s / (self._led(u).weight or 1.0),
                 min(t.seq for t in self._queues[u]),
             ),
         )
+
+    @contextlib.contextmanager
+    def member_user(self, user: Optional[str]):
+        """Temporarily attribute work on THIS thread to ``user`` —
+        the distinct-fusion query-at-a-time fallback runs each member's
+        full public path on the dispatch thread, whose thread-local user
+        is the group PRIMARY's; without this, every member's audit event
+        would land on the primary's name (serving/fuse.py)."""
+        prev = getattr(self._tls, "user", None)
+        self._tls.user = user
+        try:
+            yield
+        finally:
+            self._tls.user = prev
+
+    # -- pool-aware fusion placement (docs/SERVING.md §5c) -----------------
+    def _placement_grace_s(self) -> float:
+        g = config.SERVING_PLACEMENT_GRACE_MS.to_int()
+        return (50 if g is None else max(g, 0)) / 1e3
+
+    def _defer_ok_locked(self, t: Ticket, slot: int, now: float) -> bool:
+        """May THIS slot dispatch ticket ``t``? A placement-deferred
+        ticket is reserved for its preferred slot only within the grace
+        window — after that, anyone takes it (starvation backstop)."""
+        if t.defer_slot is None or t.defer_slot == slot:
+            return True
+        if t.defer_slot not in self._threads:
+            return True  # preferred slot died/drained: anyone serves
+        return (now - t.defer_at) > self._placement_grace_s()
+
+    def _defer_for_placement_locked(self, head: Ticket, slot: int,
+                                    now: float) -> bool:
+        """Defer a fuse-bearing head toward the slot whose device most
+        recently scanned its schema's columns — they are still resident
+        there, so the fused group's device_put is a cache hit instead of
+        a re-upload. Only defers ONCE per ticket, only when the preferred
+        slot is alive and IDLE (deferring to a busy slot would serialize
+        the pool to save one transfer), and records the decision on the
+        FuseSpec for the group span (serving/fuse.py)."""
+        if (head.fuse is None or head.fuse.schema is None
+                or head.continuation or head.defer_slot is not None
+                or len(self._threads) <= 1
+                or not config.SERVING_PLACEMENT.to_bool()):
+            return False
+        pref = self._schema_heat.get(head.fuse.schema)
+        if pref is None or pref == slot or pref not in self._threads \
+                or pref not in self._idle:
+            return False
+        head.defer_slot = pref
+        head.defer_at = now
+        head.fuse.placement = {
+            "preferred": pref, "deferred_from": slot,
+            "reason": "column-heat",
+        }
+        metrics.inc(metrics.SERVING_PLACEMENT_DEFER)
+        self._cv.notify_all()  # wake the preferred (idle) slot
+        return True
+
+    def _note_heat_locked(self, group: List[Ticket], slot: int) -> None:
+        """Record which slot's device just scanned each fused schema —
+        the placement policy's column-heat table."""
+        for t in group:
+            if t.fuse is not None and t.fuse.schema is not None:
+                self._schema_heat[t.fuse.schema] = slot
+                if t.fuse.placement is not None \
+                        and "slot" not in t.fuse.placement:
+                    t.fuse.placement["slot"] = slot
+                    bound = t.fuse.placement.get("preferred") == slot
+                    t.fuse.placement["bound"] = bound
+                    if bound:
+                        metrics.inc(metrics.SERVING_PLACEMENT_BOUND)
 
     def _next_group_locked(self, group: List[Ticket],
                            slot: int = 0) -> List[Ticket]:
@@ -1051,11 +1199,31 @@ class QueryScheduler:
                 self._pending -= 1
                 group.append(t)
                 return group
-        user = self._pick_user_locked()
-        if user is None:
-            return group
+        now = time.perf_counter()
+        while True:
+            head = None
+            for user in self._users_by_share_locked():
+                eligible = [
+                    t for t in self._queues[user]
+                    if self._defer_ok_locked(t, slot, now)
+                ]
+                if eligible:
+                    head = min(eligible, key=Ticket._order_key)
+                    break
+                # this user's queue is fully placement-reserved for
+                # other (idle, column-hot) slots within the grace window
+                # — fall through to the next user in fair-share order
+                # rather than stalling THIS slot behind another slot's
+                # reservation
+            if head is None:
+                return group
+            if not self._defer_for_placement_locked(head, slot, now):
+                break
+            # head stays queued toward its column-hot slot (it now
+            # carries defer_slot, so this slot skips it); loop — not
+            # recurse: a deep fuse-bearing backlog must never push the
+            # pick past the interpreter's recursion limit
         q = self._queues[user]
-        head = min(q, key=Ticket._order_key)
         q.remove(head)
         self._pending -= 1
         group.append(head)
@@ -1095,6 +1263,11 @@ class QueryScheduler:
         with self._cv:
             self._led(t.user).shed += 1
         metrics.inc(metrics.SERVING_SHED_DEADLINE)
+        if t.speculative is not None:
+            # the client opted into the typed coarse answer: resolve with
+            # it instead of [GM-SHED] (docs/SERVING.md speculative counts)
+            self._resolve_speculative(t.future, t.speculative)
+            return
         t.future.set_exception(DeadlineShedError(
             f"query shed before dispatch: deadline expired after "
             f"{t.wait_s * 1e3:.0f} ms queued (no device work was done)"

@@ -95,6 +95,10 @@ class GeoFlightClient:
     breaker shared across client instances (a dead sidecar fails fast
     instead of paying the timeout on every call)."""
 
+    #: whether the most recent :meth:`count` on this client was served as
+    #: a speculative (coarse-estimate) answer under server overload
+    last_count_speculative: bool = False
+
     def __init__(self, location: str, retry_seed: Optional[int] = None, **kw):
         self.location = location
         self._kw = kw
@@ -295,7 +299,14 @@ class GeoFlightClient:
 
     def count(self, name: str, ecql: str = "INCLUDE", exact: bool = True,
               auths: Optional[Sequence[str]] = None,
-              region: Optional[str] = None) -> int:
+              region: Optional[str] = None,
+              speculative_ok: bool = False) -> int:
+        """Feature count. ``speculative_ok=True`` opts into the typed
+        DEGRADED answer under server overload (docs/SERVING.md): a count
+        the server would deadline-shed returns the planner's coarse
+        estimate instead of failing ``[GM-SHED]``;
+        :attr:`last_count_speculative` reports whether the most recent
+        count on this client was served speculatively."""
         body = {"name": name, "ecql": ecql, "exact": exact}
         if auths is not None:
             body["auths"] = list(auths)
@@ -303,7 +314,11 @@ class GeoFlightClient:
             # WKT polygon; the server folds it into the ecql BEFORE fusion
             # keys are built (docs/CACHE.md polygon regions)
             body["region"] = region
-        return self._action("count", body)["count"]
+        if speculative_ok:
+            body["speculative_ok"] = True
+        out = self._action("count", body)
+        self.last_count_speculative = bool(out.get("speculative", False))
+        return out["count"]
 
     def audit(self, n: int = 100) -> List[Dict]:
         return self._action("audit", {"n": n})["events"]

@@ -48,6 +48,10 @@ PROTOCOL_VERSION = 1
 _TRACE_HEADER = "x-geomesa-trace-id"
 _USER_HEADER = "x-geomesa-user"
 _DEADLINE_HEADER = "x-geomesa-deadline-ms"
+#: opt-in to the typed speculative (coarse-estimate) answer when the
+#: server would deadline-shed a count (docs/SERVING.md); the request-body
+#: ``speculative_ok`` flag is the equivalent hint
+_SPECULATIVE_HEADER = "x-geomesa-speculative-ok"
 
 
 class _CallHeaders(fl.ServerMiddleware):
@@ -55,10 +59,11 @@ class _CallHeaders(fl.ServerMiddleware):
     Flight headers by the factory; the handlers fetch it via context)."""
 
     def __init__(self, trace_id: Optional[str], user: Optional[str],
-                 budget_s: Optional[float]):
+                 budget_s: Optional[float], speculative: bool = False):
         self.trace_id = trace_id
         self.user = user
         self.budget_s = budget_s
+        self.speculative = speculative
 
 
 _TRACE_ID_RE = re.compile(r"^[0-9A-Za-z_-]{1,64}$")
@@ -96,9 +101,14 @@ class _TraceMiddlewareFactory(fl.ServerMiddlewareFactory):
                 budget_s = max(float(raw) / 1000.0, 0.0)
             except ValueError:
                 pass
-        if tid is None and user is None and budget_s is None:
+        spec = _header(headers, _SPECULATIVE_HEADER)
+        speculative = spec is not None and spec.strip().lower() in (
+            "1", "true", "yes"
+        )
+        if tid is None and user is None and budget_s is None \
+                and not speculative:
             return None
-        return _CallHeaders(tid, user, budget_s)
+        return _CallHeaders(tid, user, budget_s, speculative)
 
 
 def _call_headers(context) -> _CallHeaders:
@@ -106,7 +116,7 @@ def _call_headers(context) -> _CallHeaders:
         mw = context.get_middleware("geomesa-trace")
     except Exception:
         mw = None
-    return mw if mw is not None else _CallHeaders(None, None, None)
+    return mw if mw is not None else _CallHeaders(None, None, None, False)
 
 
 def _lib_version() -> str:
@@ -243,7 +253,7 @@ class GeoFlightServer(fl.FlightServerBase):
         self._sched = self.dataset.serving.start()
 
     def _serve(self, context, name: str, fn, op: Optional[str] = None,
-               fuse=None, continuation: bool = False):
+               fuse=None, continuation: bool = False, speculative=None):
         """Admit ``fn`` to the dispatch queue and wait. Execution runs
         under a server-side root span that ADOPTS the client's trace id
         from the Flight header (so the server audit event and any
@@ -273,6 +283,7 @@ class GeoFlightServer(fl.FlightServerBase):
         return self._sched.submit(
             go, user=h.user, op=op or name, fuse=fuse,
             budget_s=h.budget_s, trace_id=tid, continuation=continuation,
+            speculative=speculative,
         ).result()
 
     def _fuse_spec(self, op: str, opts: Dict):
@@ -285,7 +296,7 @@ class GeoFlightServer(fl.FlightServerBase):
         name = opts.get("schema")
         if not name:
             return None
-        key = fusemod.fuse_key(op, name, opts)
+        key = fusemod.fuse_key(op, name, opts, ds=self.dataset)
         if key is None:
             return None
 
@@ -314,7 +325,7 @@ class GeoFlightServer(fl.FlightServerBase):
         # "wire" prefix: wire tickets return Flight frames — they must
         # never coalesce with raw local tickets of the same query
         return FuseSpec(key=("wire", op, name) + key, payload=dict(opts),
-                        batch=batch)
+                        batch=batch, schema=name)
 
     def _wrap_fused(self, op: str, opts: Dict, raw):
         """One member's raw fused result -> the op's wire frame (identical
@@ -530,16 +541,46 @@ class GeoFlightServer(fl.FlightServerBase):
                 if action.body else {}
         except ValueError:
             body = None
+        speculative = None
         if kind == "count" and body and body.get("name"):
             body = self._fold_region(body)
             fuse = self._fuse_spec(
                 "count", {**body, "schema": body["name"]}
             )
+            h = _call_headers(context)
+            if body.get("speculative_ok") or h.speculative:
+                # opted-in degraded answer under overload: a deadline
+                # shed (admission or dispatch) resolves to the typed
+                # coarse estimate instead of [GM-SHED]. Host-only work —
+                # planning without any device scan (docs/SERVING.md).
+                # The client's trace id rides along so the speculative
+                # audit event stays trace-correlated.
+                speculative = (
+                    lambda tid=h.trace_id:
+                        self._speculative_count_frame(body, tid)
+                )
         return self._serve(
             context, "sidecar.do_action",
             lambda: self._do_action(action, body),
-            op=f"action:{kind}", fuse=fuse,
+            op=f"action:{kind}", fuse=fuse, speculative=speculative,
         )
+
+    def _speculative_count_frame(self, body: Dict,
+                                 trace_id: Optional[str] = None
+                                 ) -> Iterator[fl.Result]:
+        """The speculative count's wire frame: the coarse estimate plus
+        the ``speculative`` marker (clients surface it typed). Runs under
+        the CLIENT's trace id (admission sheds resolve on the transport
+        thread, where no server span is active) so the audit marker
+        correlates to the caller's trace."""
+        with tracing.start("count.speculative", trace_id=trace_id,
+                           force=trace_id is not None):
+            n = self.dataset._speculative_count(
+                body["name"], _query_from(body)
+            )
+        return iter([fl.Result(
+            json.dumps({"count": int(n), "speculative": True}).encode()
+        )])
 
     def _do_action(self, action: fl.Action,
                    body: Optional[Dict] = None) -> Iterator[fl.Result]:

@@ -1,0 +1,619 @@
+"""Query-axis megakernel tests (docs/SERVING.md "Query-axis batching"):
+M *distinct* viewports through one device dispatch.
+
+The load-bearing guarantee is the cross-member leak guard: every member
+of a batched count/density/stats pass must be BIT-IDENTICAL to its own
+serial execution — seeded property tests assert it at M ∈ {2, 5, 8} on
+both the plain single-store path and the partitioned path over the
+8-virtual-device mesh (conftest forces 8 CPU devices). Around the
+tentpole: structural fuse keys (literal-differing ECQL fuses, residual-
+differing never does), the ≤2-dispatch fusion proof, kernel reuse across
+batches (literals are DATA — a new viewport set never recompiles),
+registry eviction accounting, speculative counts, and pool-aware
+placement."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, config, metrics, resilience, tracing
+from geomesa_tpu.api.dataset import Query
+from geomesa_tpu.filter import parse_ecql
+from geomesa_tpu.filter import template as ftpl
+from geomesa_tpu.kernels.registry import KernelRegistry, bucket_batch
+from geomesa_tpu.serving import fuse as fusemod
+
+
+def _bbox_ecql(b, extra="speed > 20"):
+    base = f"BBOX(geom, {b[0]}, {b[1]}, {b[2]}, {b[3]})"
+    return f"{base} AND {extra}" if extra else base
+
+
+def _rand_boxes(rng, m):
+    out = []
+    for _ in range(m):
+        x0 = float(rng.uniform(-70, 30))
+        y0 = float(rng.uniform(-35, 15))
+        out.append((x0, y0, x0 + float(rng.uniform(5, 60)),
+                    y0 + float(rng.uniform(5, 30))))
+    return out
+
+
+@pytest.fixture(scope="module")
+def ds():
+    ds = GeoDataset(n_shards=4)
+    ds.create_schema("pts", "speed:Float,kind:String,dtg:Date,*geom:Point")
+    rng = np.random.default_rng(11)
+    n = 3000
+    t0 = np.datetime64("2024-01-01T00:00:00") \
+        .astype("datetime64[ms]").astype(np.int64)
+    ds.insert("pts", {
+        "speed": rng.uniform(0, 100, n),
+        "kind": rng.choice(["a", "b", "c"], n),
+        "dtg": (t0 + rng.integers(0, 90 * 86400 * 1000, n))
+        .astype("datetime64[ms]"),
+        "geom": list(zip(rng.uniform(-80, 80, n),
+                         rng.uniform(-40, 40, n))),
+    }, fids=np.arange(n).astype(str))
+    ds.flush("pts")
+    return ds
+
+
+@pytest.fixture(scope="module")
+def pds():
+    """Time-partitioned twin: the sharded fan-out engages on the
+    8-virtual-device mesh (conftest). Kept SMALL (a handful of weekly
+    bins) — per-partition dispatch overhead on the virtual mesh
+    dominates tier-1 wall time, and the bit-identity contract is
+    partition-count-independent."""
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema(
+        "ppts", "speed:Float,dtg:Date,*geom:Point;geomesa.partition='time'"
+    )
+    rng = np.random.default_rng(13)
+    n = 2200
+    t0 = np.datetime64("2024-01-01T00:00:00") \
+        .astype("datetime64[ms]").astype(np.int64)
+    ds.insert("ppts", {
+        "speed": rng.uniform(0, 100, n),
+        "dtg": (t0 + rng.integers(0, 30 * 86400 * 1000, n))
+        .astype("datetime64[ms]"),
+        "geom": list(zip(rng.uniform(-80, 80, n),
+                         rng.uniform(-40, 40, n))),
+    }, fids=np.arange(n).astype(str))
+    ds.flush("ppts")
+    return ds
+
+
+# ---------------------------------------------------------------------------
+# structural templates (filter/template.py)
+# ---------------------------------------------------------------------------
+
+
+def test_template_literals_split_and_keys(ds):
+    st = ds._store("pts")
+    a = ftpl.split_literals(
+        parse_ecql(_bbox_ecql((-10, -10, 10, 10))), st.ft)
+    b = ftpl.split_literals(
+        parse_ecql(_bbox_ecql((3, -7, 40, 12))), st.ft)
+    assert a is not None and b is not None
+    # same structure, different literals: one kernel
+    assert a.key == b.key
+    assert not np.array_equal(a.lits_f, b.lits_f)
+    # a different residual is a different kernel
+    c = ftpl.split_literals(
+        parse_ecql(_bbox_ecql((-10, -10, 10, 10), "speed > 30")), st.ft)
+    assert c is not None and c.key != a.key
+
+
+def test_template_during_slots(ds):
+    st = ds._store("pts")
+    q1 = ("BBOX(geom, -10, -10, 10, 10) AND dtg DURING "
+          "2024-01-01T00:00:00Z/2024-02-01T00:00:00Z")
+    q2 = ("BBOX(geom, -5, -2, 30, 20) AND dtg DURING "
+          "2024-02-10T00:00:00Z/2024-03-01T00:00:00Z")
+    a = ftpl.split_literals(parse_ecql(q1), st.ft)
+    b = ftpl.split_literals(parse_ecql(q2), st.ft)
+    assert a is not None and a.key == b.key
+    assert [s.kind for s in a.slots] == ["bbox", "during"]
+    assert len(a.lits_f) == 4 and len(a.lits_i) == 4
+    assert not np.array_equal(a.lits_i, b.lits_i)
+
+
+def test_template_no_slot_or_shielded(ds):
+    st = ds._store("pts")
+    # no viewport literal at all
+    assert ftpl.split_literals(parse_ecql("speed > 5"), st.ft) is None
+    # a bbox under OR is NOT slotted (polarity shield): it stays in the
+    # residual, so the two queries key apart
+    a = ftpl.split_literals(parse_ecql(
+        "BBOX(geom, 0, 0, 5, 5) AND "
+        "(BBOX(geom, -9, -9, -1, -1) OR speed > 50)"), st.ft)
+    b = ftpl.split_literals(parse_ecql(
+        "BBOX(geom, 0, 0, 5, 5) AND "
+        "(BBOX(geom, -8, -8, -2, -2) OR speed > 50)"), st.ft)
+    assert a is not None and b is not None
+    assert len(a.slots) == 1
+    assert a.key != b.key
+
+
+# ---------------------------------------------------------------------------
+# the cross-member leak guard: batched == serial, bit-identical,
+# at M ∈ {2, 5, 8}, plain AND partitioned/8-virtual-device paths
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("m", [2, 5, 8])
+def test_count_batch_bit_identical_plain(ds, m):
+    rng = np.random.default_rng(100 + m)
+    queries = [_bbox_ecql(b) for b in _rand_boxes(rng, m)]
+    serial = [ds.count("pts", q) for q in queries]
+    disp = metrics.registry().counter(metrics.EXEC_DEVICE_DISPATCH)
+    d0 = disp.value
+    batched = ds.count_batch("pts", queries)
+    assert batched is not None
+    assert batched == serial
+    assert disp.value - d0 == 1  # ONE dispatch for the whole batch
+
+
+@pytest.mark.parametrize("m", [2, 5, 8])
+def test_density_batch_bit_identical_plain(ds, m):
+    rng = np.random.default_rng(200 + m)
+    boxes = _rand_boxes(rng, m)
+    queries = [_bbox_ecql(b) for b in boxes]
+    serial = [
+        ds.density("pts", q, bbox=b, width=32, height=32)
+        for q, b in zip(queries, boxes)
+    ]
+    batched = ds.density_batch("pts", queries, bboxes=boxes,
+                               width=32, height=32)
+    assert batched is not None
+    for s, b in zip(serial, batched):
+        assert np.array_equal(s, b)
+
+
+def test_stats_batch_bit_identical_plain(ds):
+    rng = np.random.default_rng(17)
+    queries = [_bbox_ecql(b) for b in _rand_boxes(rng, 3)]
+    for spec in ("Count()", "MinMax(speed)", "Histogram(speed,12,0,100)",
+                 "Enumeration(kind)"):
+        serial = [ds.stats("pts", spec, q).to_json() for q in queries]
+        batched = ds.stats_batch("pts", spec, queries)
+        assert batched is not None, spec
+        assert [s.to_json() for s in batched] == serial, spec
+
+
+def test_stats_batch_descriptive_falls_back(ds):
+    rng = np.random.default_rng(19)
+    queries = [_bbox_ecql(b) for b in _rand_boxes(rng, 3)]
+    # descriptive leaves are layout-sensitive f32 sums: never batched
+    assert ds.stats_batch("pts", "DescriptiveStats(speed)", queries) is None
+
+
+@pytest.mark.slow  # gated by the sharded-8dev-smoke CI job, not tier-1
+@pytest.mark.parametrize("m", [2, 5, 8])
+def test_batch_bit_identical_partitioned_8dev(pds, m):
+    """Count + density + stats, batched vs serial, over the partitioned
+    store on the 8-virtual-device mesh — one test per M so the serial
+    baselines (the dominant cost) run once each. The serial per-member
+    partitioned scans make this the priciest invariant in the repo, so
+    it rides the dedicated 8-device CI job (ci.yml sharded-8dev-smoke)
+    instead of tier-1; the plain-path M sweep above stays in tier-1."""
+    import jax
+
+    assert len(jax.devices()) == 8  # conftest's virtual mesh
+    rng = np.random.default_rng(300 + m)
+    boxes = _rand_boxes(rng, m)
+    windows = ["2024-01-01T00:00:00Z/2024-01-12T00:00:00Z",
+               "2024-01-08T00:00:00Z/2024-01-25T00:00:00Z",
+               "2024-01-05T00:00:00Z/2024-01-30T00:00:00Z"]
+    queries = [
+        f"{_bbox_ecql(b, extra=None)} AND dtg DURING {windows[i % 3]}"
+        for i, b in enumerate(boxes)
+    ]
+    serial = [pds.count("ppts", q) for q in queries]
+    batched = pds.count_batch("ppts", queries)
+    assert batched is not None
+    assert batched == serial
+    # the serial (mesh-off) path produces the same members too
+    with config.MESH_DEVICES.scoped("off"):
+        batched_off = pds.count_batch("ppts", queries)
+    assert batched_off == serial
+    g_serial = [
+        pds.density("ppts", q, bbox=b, width=12, height=12)
+        for q, b in zip(queries, boxes)
+    ]
+    g_batched = pds.density_batch("ppts", queries, bboxes=boxes,
+                                  width=12, height=12)
+    assert g_batched is not None
+    for s, b in zip(g_serial, g_batched):
+        assert np.array_equal(s, b)
+    s_serial = [pds.stats("ppts", "MinMax(speed)", q).to_json()
+                for q in queries]
+    s_batched = pds.stats_batch("ppts", "MinMax(speed)", queries)
+    assert s_batched is not None
+    assert [s.to_json() for s in s_batched] == s_serial
+
+
+def test_density_batch_weighted_bit_identical_small(ds):
+    # weighted members: the batched scatter is op-for-op the serial
+    # padded path (small table — compaction never engages here)
+    rng = np.random.default_rng(23)
+    boxes = _rand_boxes(rng, 3)
+    queries = [_bbox_ecql(b) for b in boxes]
+    serial = [
+        ds.density("pts", q, bbox=b, width=16, height=16, weight="speed")
+        for q, b in zip(queries, boxes)
+    ]
+    batched = ds.density_batch("pts", queries, bboxes=boxes,
+                               width=16, height=16, weight="speed")
+    assert batched is not None
+    for s, b in zip(serial, batched):
+        assert np.array_equal(s, b)
+
+
+def test_empty_and_disjoint_members(ds):
+    # a member whose bbox is fully outside the data (disjoint key plan)
+    # must come back 0 / zero-grid, exactly like its serial run
+    queries = [_bbox_ecql((-10, -10, 10, 10)),
+               _bbox_ecql((160, 80, 170, 85))]
+    serial = [ds.count("pts", q) for q in queries]
+    batched = ds.count_batch("pts", queries)
+    assert batched == serial
+    assert batched[1] == 0
+
+
+def test_batch_kernel_shared_across_literal_sets(ds):
+    """Literals are kernel DATA: a fresh viewport set (and any batch size
+    within one bucket) reuses the compiled kernel — zero recompiles."""
+    rng = np.random.default_rng(29)
+    reg = ds._executor(ds._store("pts")).kernel_registry()
+    q1 = [_bbox_ecql(b) for b in _rand_boxes(rng, 3)]
+    assert ds.count_batch("pts", q1) is not None
+    t0 = reg.traces("count_batch")
+    assert t0 >= 1
+    # new literals, same structure, batch size in the same bucket (4 -> 4)
+    q2 = [_bbox_ecql(b) for b in _rand_boxes(rng, 4)]
+    assert ds.count_batch("pts", q2) is not None
+    assert reg.traces("count_batch") == t0  # no retrace
+    assert bucket_batch(3) == bucket_batch(4) == 4
+
+
+def test_batch_audit_events_per_member(ds):
+    rng = np.random.default_rng(31)
+    queries = [_bbox_ecql(b) for b in _rand_boxes(rng, 3)]
+    n0 = len(ds.audit.recent(500))
+    out = ds.count_batch(
+        "pts", queries,
+        members=[{"user": f"u{i}"} for i in range(3)],
+    )
+    assert out is not None
+    evs = ds.audit.recent(500)[n0:]
+    mine = [e for e in evs if e.hints.get("distinct")]
+    assert len(mine) == 3
+    assert all(e.hints.get("fused") and e.hints.get("fused_batch") == 3
+               for e in mine)
+    assert sorted(e.user for e in mine) == ["u0", "u1", "u2"]
+
+
+# ---------------------------------------------------------------------------
+# structural fusion keys + the scheduler integration
+# ---------------------------------------------------------------------------
+
+
+def test_fuse_key_structural_equality(ds):
+    k1 = fusemod.fuse_key(
+        "count", "pts", {"ecql": _bbox_ecql((-10, -10, 10, 10))}, ds=ds)
+    k2 = fusemod.fuse_key(
+        "count", "pts", {"ecql": _bbox_ecql((5, -3, 25, 9))}, ds=ds)
+    assert k1 is not None and k1 == k2
+    # residual drift keys apart
+    k3 = fusemod.fuse_key(
+        "count", "pts",
+        {"ecql": _bbox_ecql((-10, -10, 10, 10), "speed > 30")}, ds=ds)
+    assert k3 != k1
+    # the knob reverts to literal-text keys
+    with config.SERVING_FUSION_DISTINCT.scoped(False):
+        ka = fusemod.fuse_key(
+            "count", "pts", {"ecql": _bbox_ecql((-10, -10, 10, 10))},
+            ds=ds)
+        kb = fusemod.fuse_key(
+            "count", "pts", {"ecql": _bbox_ecql((5, -3, 25, 9))}, ds=ds)
+    assert ka != kb
+    # speculative_ok never blocks fusion
+    ks = fusemod.fuse_key(
+        "count", "pts",
+        {"ecql": _bbox_ecql((-10, -10, 10, 10)), "speculative_ok": True},
+        ds=ds)
+    assert ks == k1
+
+
+def test_fuse_key_density_distinct_unweighted_only(ds):
+    base = {"ecql": _bbox_ecql((-10, -10, 10, 10)),
+            "width": 64, "height": 64}
+    k1 = fusemod.fuse_key(
+        "density", "pts", {**base, "bbox": (-10, -10, 10, 10)}, ds=ds)
+    k2 = fusemod.fuse_key(
+        "density", "pts",
+        {"ecql": _bbox_ecql((0, 0, 30, 20)), "width": 64, "height": 64,
+         "bbox": (0, 0, 30, 20)}, ds=ds)
+    assert k1 == k2  # distinct grid bboxes share the structural key
+    # weighted grids keep the literal-identical rule
+    kw1 = fusemod.fuse_key(
+        "density", "pts",
+        {**base, "bbox": (-10, -10, 10, 10), "weight": "speed"}, ds=ds)
+    kw2 = fusemod.fuse_key(
+        "density", "pts",
+        {"ecql": _bbox_ecql((0, 0, 30, 20)), "width": 64, "height": 64,
+         "bbox": (0, 0, 30, 20), "weight": "speed"}, ds=ds)
+    assert kw1 != kw2
+
+
+def _stalled_sched(ds):
+    sched = ds.serving.start()
+    gate = threading.Event()
+    started = threading.Event()
+
+    def stall():
+        started.set()
+        return gate.wait(30)
+
+    fut = sched.submit(stall, user="stall", op="stall")
+    assert started.wait(10)
+    return sched, gate, fut
+
+
+def test_distinct_bbox_counts_fuse_into_two_dispatches(ds):
+    """THE acceptance gate shape: N=8 distinct-bbox counts through the
+    scheduler execute in ≤ 2 device dispatches, every member bit-
+    identical to its serial run."""
+    rng = np.random.default_rng(37)
+    queries = [_bbox_ecql(b) for b in _rand_boxes(rng, 8)]
+    serial = [ds.count("pts", q) for q in queries]
+    sched, gate, fut = _stalled_sched(ds)
+    try:
+        disp = metrics.registry().counter(metrics.EXEC_DEVICE_DISPATCH)
+        futs = [
+            sched.submit(
+                (lambda q=q: ds.count("pts", q)), user=f"c{i % 3}",
+                op="count",
+                fuse=fusemod.make_spec(ds, "count", "pts", {"ecql": q}),
+            )
+            for i, q in enumerate(queries)
+        ]
+        d0 = disp.value
+        gate.set()
+        got = [f.result(60) for f in futs]
+        dispatches = disp.value - d0
+    finally:
+        gate.set()
+        fut.result(5)
+        sched.stop()
+    assert got == serial
+    assert dispatches <= 2, f"{dispatches} dispatches for 8 distinct counts"
+
+
+def test_distinct_fusion_falls_back_serially_when_ineligible(ds):
+    """Members sharing a structural key whose batch cannot ride the
+    megakernel still get correct per-member answers (query-at-a-time
+    fallback inside the group)."""
+    rng = np.random.default_rng(41)
+    queries = [_bbox_ecql(b) for b in _rand_boxes(rng, 3)]
+    serial = [ds.count("pts", q) for q in queries]
+    # force ineligibility: the dataset-level batch entry declines, so the
+    # fused group must degrade to query-at-a-time INSIDE the group
+    ds.count_batch_orig = ds.count_batch
+    ds.count_batch = lambda *a, **kw: None
+    sched, gate, fut = _stalled_sched(ds)
+    try:
+        futs = [
+            sched.submit(
+                (lambda q=q: ds.count("pts", q)), user="u", op="count",
+                fuse=fusemod.make_spec(ds, "count", "pts", {"ecql": q}),
+            )
+            for q in queries
+        ]
+        gate.set()
+        got = [f.result(60) for f in futs]
+    finally:
+        gate.set()
+        fut.result(5)
+        sched.stop()
+        ds.count_batch = ds.count_batch_orig
+        del ds.count_batch_orig
+    assert got == serial
+
+
+# ---------------------------------------------------------------------------
+# registry LRU pressure satellite
+# ---------------------------------------------------------------------------
+
+
+def test_registry_eviction_accounting():
+    reg = KernelRegistry(capacity=2)
+    reg.put(("siteA", 1), "k1")
+    reg.put(("siteA", 2), "k2")
+    reg.put(("siteB", 3), "k3")  # evicts ("siteA", 1)
+    assert reg.evicts("siteA") == 1
+    assert reg.evicted_recompiles() == 0
+    reg.put(("siteA", 1), "k1b")  # re-trace of an evicted key
+    assert reg.evicted_recompiles() == 1
+    ev = metrics.registry().counter(f"{metrics.KERNEL_EVICT}.siteA")
+    assert ev.value >= 1
+    evr = metrics.registry().counter(metrics.KERNEL_RECOMPILE_EVICTED)
+    assert evr.value >= 1
+
+
+def test_registry_default_capacity_raised():
+    assert (config.KERNEL_CACHE_SIZE.to_int() or 0) >= 512
+
+
+# ---------------------------------------------------------------------------
+# speculative counts satellite
+# ---------------------------------------------------------------------------
+
+
+def test_speculative_count_inline(ds):
+    q = _bbox_ecql((-10, -10, 10, 10))
+    exact = ds.count("pts", q)
+    with resilience.deadline_scope(0.0):
+        with pytest.raises(resilience.DeadlineShedError):
+            ds.count("pts", q)
+    n0 = len(ds.audit.recent(500))
+    spec = metrics.registry().counter(metrics.SERVING_SPECULATIVE)
+    s0 = spec.value
+    with resilience.deadline_scope(0.0):
+        est = ds.count("pts", q, speculative_ok=True)
+    assert isinstance(est, int)
+    assert spec.value == s0 + 1
+    evs = ds.audit.recent(500)[n0:]
+    marked = [e for e in evs if e.hints.get("speculative")]
+    assert len(marked) == 1
+    # a healthy deadline still returns the exact count
+    with resilience.deadline_scope(30.0):
+        assert ds.count("pts", q, speculative_ok=True) == exact
+
+
+def test_speculative_count_queue_path(ds):
+    """A queued count shed at dispatch resolves speculatively when the
+    ticket carries the fallback."""
+    q = _bbox_ecql((-10, -10, 10, 10))
+    sched, gate, fut = _stalled_sched(ds)
+    try:
+        f = sched.submit(
+            lambda: ds.count("pts", q), user="u", op="count",
+            budget_s=0.001,
+            speculative=lambda: ds._speculative_count("pts", q),
+        )
+        import time as _t
+
+        _t.sleep(0.05)  # let the budget lapse while queued
+        gate.set()
+        est = f.result(30)
+        assert isinstance(est, int)
+    finally:
+        gate.set()
+        fut.result(5)
+        sched.stop()
+
+
+def test_speculative_count_wire():
+    """Full wire contract: the x-geomesa-speculative-ok header turns an
+    admission-time [GM-SHED] into the typed coarse frame."""
+    fl = pytest.importorskip("pyarrow.flight")
+    import json
+
+    from geomesa_tpu.sidecar.service import GeoFlightServer
+
+    wds = GeoDataset(n_shards=2)
+    wds.create_schema("w", "a:Integer,dtg:Date,*geom:Point")
+    rng = np.random.default_rng(5)
+    n = 500
+    wds.insert("w", {
+        "geom__x": rng.uniform(-10, 10, n),
+        "geom__y": rng.uniform(-10, 10, n),
+        "dtg": rng.integers(0, 10**10, n).astype("datetime64[ms]"),
+        "a": rng.integers(0, 5, n).astype(np.int32),
+    }, fids=np.arange(n).astype(str))
+    wds.flush("w")
+    srv = GeoFlightServer(wds, "grpc+tcp://127.0.0.1:0")
+    try:
+        cli = fl.FlightClient(f"grpc+tcp://127.0.0.1:{srv.port}")
+        body = json.dumps(
+            {"name": "w", "ecql": "BBOX(geom, -5, -5, 5, 5)"}
+        ).encode()
+        out = list(cli.do_action(fl.Action("count", body),
+                   fl.FlightCallOptions(headers=[
+                       (b"x-geomesa-deadline-ms", b"0"),
+                       (b"x-geomesa-speculative-ok", b"1"),
+                   ])))
+        resp = json.loads(out[0].body.to_pybytes().decode())
+        assert resp.get("speculative") is True and "count" in resp
+        # without the opt-in the same budget fails typed [GM-SHED]
+        with pytest.raises(fl.FlightTimedOutError, match="GM-SHED"):
+            list(cli.do_action(fl.Action("count", body),
+                 fl.FlightCallOptions(headers=[
+                     (b"x-geomesa-deadline-ms", b"0"),
+                 ])))
+        assert any(e.hints.get("speculative")
+                   for e in wds.audit.recent(20))
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# pool-aware placement satellite
+# ---------------------------------------------------------------------------
+
+
+def test_placement_defers_to_column_hot_idle_slot(ds):
+    sched = ds.serving
+    import time as _t
+
+    class _T:
+        pass
+
+    spec = fusemod.make_spec(
+        ds, "count", "pts", {"ecql": _bbox_ecql((-1, -1, 1, 1))})
+    t = _T()
+    t.fuse = spec
+    t.continuation = False
+    t.defer_slot = None
+    t.defer_at = 0.0
+    with sched._cv:
+        sched._threads = {0: threading.current_thread(),
+                          1: threading.current_thread()}
+        try:
+            sched._schema_heat["pts"] = 1
+            sched._idle.add(1)
+            now = _t.perf_counter()
+            assert sched._defer_for_placement_locked(t, 0, now)
+            assert t.defer_slot == 1
+            assert spec.placement["preferred"] == 1
+            assert spec.placement["reason"] == "column-heat"
+            # slot 0 must skip it within the grace window...
+            assert not sched._defer_ok_locked(t, 0, now)
+            # ...slot 1 takes it immediately...
+            assert sched._defer_ok_locked(t, 1, now)
+            # ...and anyone takes it after the grace window
+            assert sched._defer_ok_locked(
+                t, 0, now + sched._placement_grace_s() + 0.01)
+            # a BUSY preferred slot never defers
+            t2 = _T()
+            t2.fuse = fusemod.make_spec(
+                ds, "count", "pts", {"ecql": _bbox_ecql((-2, -2, 2, 2))})
+            t2.continuation = False
+            t2.defer_slot = None
+            t2.defer_at = 0.0
+            sched._idle.discard(1)
+            assert not sched._defer_for_placement_locked(
+                t2, 0, _t.perf_counter())
+        finally:
+            sched._threads = {}
+            sched._schema_heat.clear()
+            sched._idle.clear()
+
+
+def test_placement_surfaced_on_group_span(ds):
+    """The fused group's span carries the placement decision."""
+    rng = np.random.default_rng(43)
+    queries = [_bbox_ecql(b) for b in _rand_boxes(rng, 2)]
+    sched, gate, fut = _stalled_sched(ds)
+    try:
+        futs = [
+            sched.submit(
+                (lambda q=q: ds.count("pts", q)), user="u", op="count",
+                fuse=fusemod.make_spec(ds, "count", "pts", {"ecql": q}),
+            )
+            for q in queries
+        ]
+        gate.set()
+        [f.result(60) for f in futs]
+        # heat recorded for the schema at dispatch
+        with sched._cv:
+            assert sched._schema_heat.get("pts") is not None
+    finally:
+        gate.set()
+        fut.result(5)
+        sched.stop()
