@@ -938,6 +938,96 @@ def main():
             f"warm={poly_warm*1e3:.1f}ms (exact n={poly_exact})\n"
         )
 
+    # TPU-native spatial join (docs/JOIN.md): cold/warm latency, the
+    # candidate-pair pruning fraction on a clustered synthetic (CI gates
+    # < 0.2), brute-force bit-identity (hard-asserted HERE, before the
+    # line prints), and the recompile-free repeat proof over fresh data.
+    # Device baseline note: like every key since BENCH_r04 (rounds 4+),
+    # these are CPU(-fallback/mesh) numbers whenever device_unreachable /
+    # parallel_headroom_limited apply — the join's accelerator baseline
+    # is part of the same open device-baseline gap (ROADMAP bench infra).
+    join_keys = {}
+    if os.environ.get("GEOMESA_BENCH_JOIN", "1") != "0":
+        from geomesa_tpu.kernels import join as _kj
+        from geomesa_tpu.planning import join_exec as _jx
+
+        jn = 12_000 if smoke else 30_000
+        jm = 10_000 if smoke else 25_000
+        _jrng = np.random.default_rng(23)
+        _jcx = _jrng.uniform(-150, 150, 24)
+        _jcy = _jrng.uniform(-70, 70, 24)
+
+        def _jpts(k):
+            _k = _jrng.integers(0, 24, k)
+            return (np.clip(_jcx[_k] + _jrng.normal(0, 0.5, k), -179, 179),
+                    np.clip(_jcy[_k] + _jrng.normal(0, 0.5, k), -89, 89))
+
+        def _jds_make():
+            jds = GeoDataset()
+            jds.create_schema("jl", "*geom:Point")
+            jds.create_schema("jr", "*geom:Point")
+            _lx, _ly = _jpts(jn)
+            _rx, _ry = _jpts(jm)
+            jds.insert("jl", {"geom": list(zip(_lx, _ly))})
+            jds.insert("jr", {"geom": list(zip(_rx, _ry))})
+            jds.flush()
+            return jds
+
+        _jd = 0.25
+        jds = _jds_make()
+        t0 = time.perf_counter()
+        jres = jds.join("jl", "jr", predicate="dwithin", distance=_jd)
+        join_cold_s = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        jds.join("jl", "jr", predicate="dwithin", distance=_jd)
+        join_warm_s = time.perf_counter() - t0
+        # bit-identity vs the numpy N*M reference, on the SCANNED row
+        # order the join saw (hard assert — the key below records it)
+        _p0, _p1 = _kj.pair_params("dwithin", distance=_jd)
+        _lb = jds.query("jl").batch
+        _rb = jds.query("jr").batch
+        _jref = _kj.brute_force_pairs(
+            _lb.columns["geom__x"], _lb.columns["geom__y"],
+            _rb.columns["geom__x"], _rb.columns["geom__y"],
+            "dwithin", _p0, _p1,
+        )
+        assert jres.count == len(_jref) \
+            and np.array_equal(jres.pairs, _jref), \
+            "join != brute-force reference"
+        # recompile-free repeats: fresh data, same sizes, zero new traces
+        _jreg = _jx.join_registry()
+        _jt0 = sum(_jreg.traces().values())
+        for _ in range(2):
+            _jds2 = _jds_make()
+            _jds2.join_count("jl", "jr", predicate="dwithin",
+                             distance=_jd)
+        join_recompiles = sum(_jreg.traces().values()) - _jt0
+        join_keys = {
+            "join_cold_ms": round(join_cold_s * 1e3, 2),
+            "join_warm_ms": round(join_warm_s * 1e3, 2),
+            "join_candidate_fraction": round(
+                jres.stats.candidate_fraction, 4
+            ),
+            "join_bit_identical": True,
+            "join_recompiles": int(join_recompiles),
+            "join_matched": int(jres.count),
+            "join_devices": int(jres.stats.devices),
+        }
+        if cpu_backend or annotations.get("device_unreachable") \
+                or sharded_keys.get("parallel_headroom_limited"):
+            join_keys["join_device_baseline"] = (
+                "cpu-fallback (parallel_headroom_limited)"
+                if sharded_keys.get("parallel_headroom_limited")
+                else "cpu-fallback"
+            )
+        sys.stderr.write(
+            f"join: cold={join_cold_s*1e3:.1f}ms "
+            f"warm={join_warm_s*1e3:.1f}ms "
+            f"matched={jres.count} "
+            f"cand_frac={jres.stats.candidate_fraction:.4f} "
+            f"recompiles={join_recompiles}\n"
+        )
+
     # Observability snapshot (docs/OBSERVABILITY.md): the perf trajectory
     # carries the registry's warm-path/cache/pipeline counters and the
     # query-stage latency distribution, so a regression in ANY of them is
@@ -1034,6 +1124,7 @@ def main():
         **serving_keys,
         **sharded_keys,
         **cache_keys,
+        **join_keys,
         **annotations,
     }))
 

@@ -120,6 +120,54 @@ class FeatureCollection:
         return pd.DataFrame(d)
 
 
+class SpatialJoinResult:
+    """Result of a co-partitioned spatial join (docs/JOIN.md): the exact
+    matched-pair total plus a streaming matched-pair view. ``count`` is
+    exact over completed tiles (equal to the full answer unless
+    ``stats.skipped`` is non-empty — the ``allow_partial()`` degradation
+    account). ``batches()`` streams matched pairs as ColumnBatches of at
+    most ``geomesa.join.batch.rows`` rows: left columns verbatim, right
+    columns prefixed ``right.`` (the attribute equi-join's convention)."""
+
+    def __init__(self, lst, lbatch: ColumnBatch, rst, rbatch: ColumnBatch,
+                 pairs, count: int, stats):
+        self._lst, self._lbatch = lst, lbatch
+        self._rst, self._rbatch = rst, rbatch
+        #: matched (left, right) row positions, int64 [K, 2], row-major
+        self.pairs = pairs
+        self.count = int(count)
+        self.stats = stats
+
+    @property
+    def degraded(self) -> bool:
+        return bool(self.stats.skipped)
+
+    def batches(self, batch_rows: Optional[int] = None):
+        """Yield matched-pair ColumnBatches (chunked: peak memory is one
+        chunk's gathered columns, never the whole pair set)."""
+        if self.pairs is None:
+            raise ValueError("join_count result carries no pairs; use "
+                             "join_spatial for the streaming form")
+        if batch_rows is None:
+            batch_rows = config.JOIN_BATCH_ROWS.to_int() or 65536
+        batch_rows = max(int(batch_rows), 1)
+        for lo in range(0, len(self.pairs), batch_rows):
+            chunk = self.pairs[lo: lo + batch_rows]
+            li, rj = chunk[:, 0], chunk[:, 1]
+            cols = {k: v[li] for k, v in self._lbatch.columns.items()}
+            for k, v in self._rbatch.columns.items():
+                cols["right." + k] = v[rj]
+            yield ColumnBatch(cols, len(chunk))
+
+    def __iter__(self):
+        return self.batches()
+
+    def to_batch(self) -> ColumnBatch:
+        """The whole pair set as one ColumnBatch (small joins / tests)."""
+        out = list(self.batches(batch_rows=max(len(self.pairs), 1)))
+        return out[0] if out else ColumnBatch({}, 0)
+
+
 def _traced(op: str, speculative: Optional[str] = None):
     """Open one ROOT span per public query operation (docs/OBSERVABILITY.md)
     and pass it through serving admission (docs/SERVING.md): the local-path
@@ -1051,7 +1099,7 @@ class GeoDataset:
         return (mm.lo[0], mm.lo[1], mm.hi[0], mm.hi[1])
 
     # -- analytics (geomesa-process parity) --------------------------------
-    @_traced("density")
+    @_traced("density", speculative="_speculative_density")
     def density(self, name: str, query: "str | Query" = "INCLUDE",
                 bbox=None, width: int = 256, height: int = 256,
                 weight: Optional[str] = None, region=None) -> np.ndarray:
@@ -1059,7 +1107,11 @@ class GeoDataset:
         optional polygon (WKT or geometry) clipping the aggregate — folded
         in as an INTERSECTS conjunct; with the cache enabled the interior
         decomposes over hierarchy cells and only the polygon boundary
-        scans (docs/CACHE.md)."""
+        scans (docs/CACHE.md). ``speculative_ok=True`` (kw): under
+        overload, a density this deadline would shed at admission returns
+        the coarse cache/hierarchy-served estimate grid — typed via an
+        audit event carrying ``speculative: true`` — instead of failing
+        ``[GM-SHED]`` (docs/SERVING.md)."""
         st, q, plan = self._plan(name, self._with_region(name, query, region))
         if bbox is None:
             bbox = self.bounds(name) or (-180, -90, 180, 90)
@@ -1073,6 +1125,94 @@ class GeoDataset:
                 self, st, q, plan, bbox, width, height, weight
             )
         self._audit(name, q, plan, t0, int(np.count_nonzero(grid)), op="density")
+        return grid
+
+    def _speculative_audit(self, name: str, plan, op: str, hits: int,
+                           extra: Optional[Dict[str, Any]] = None) -> None:
+        """Shared audit marker for every speculative degraded answer
+        (docs/SERVING.md): ``speculative: true`` + ``shed: true`` so
+        operators can distinguish each coarse answer served under load."""
+        metrics.inc(metrics.SERVING_SPECULATIVE)
+        hints: Dict[str, Any] = {"op": op, "index": plan.index_name,
+                                 "speculative": True, "shed": True}
+        if extra:
+            hints.update(extra)
+        tid = tracing.current_trace_id()
+        if tid is not None:
+            hints["trace_id"] = tid
+        self.audit.record(
+            name, plan.ecql, hints,
+            plan.__dict__.get("plan_time_ms", 0.0), 0.0, hits,
+            user=self.serving.current_user() or "",
+        )
+
+    def _speculative_density(self, name: str,
+                             query: "str | Query" = "INCLUDE",
+                             bbox=None, width: int = 256, height: int = 256,
+                             weight: Optional[str] = None,
+                             region=None) -> np.ndarray:
+        """The speculative degraded density (see :meth:`density`): a
+        coarse estimate grid assembled from RESIDENT cache/hierarchy
+        count cells — host reads only, zero device work (exactly what
+        shedding protects). Resident cells splat their exact counts
+        uniformly over their footprint; unresident coverage splats the
+        planner-estimate remainder; a non-decomposable query splats the
+        whole estimate. Typed + audited like speculative counts.
+        Weighted grids never serve speculatively — the resident cells
+        hold row COUNTS, and a count splatted into a weight-sum grid
+        would be a silent unit change — so a weighted shed stays
+        ``[GM-SHED]``."""
+        if weight is not None:
+            from geomesa_tpu.resilience import DeadlineShedError
+
+            raise DeadlineShedError(
+                "[GM-SHED] weighted density has no speculative form "
+                "(resident cells hold counts, not weight sums)"
+            )
+        st, q, plan = self._plan(name, self._with_region(name, query, region))
+        if bbox is None:
+            bbox = self.bounds(name) or (-180, -90, 180, 90)
+        bbox = tuple(float(v) for v in bbox)
+        grid = np.zeros((height, width), np.float32)
+        est = float(plan.est_count)
+        got = self.cache.speculative_cells(self, st, q, plan)
+
+        def splat(box, value):
+            # uniform splat of `value` over box ∩ render bbox, in pixels
+            x0, y0, x1, y1 = box
+            sx = width / max(bbox[2] - bbox[0], 1e-12)
+            sy = height / max(bbox[3] - bbox[1], 1e-12)
+            c0 = int(np.clip(np.floor((x0 - bbox[0]) * sx), 0, width))
+            c1 = int(np.clip(np.ceil((x1 - bbox[0]) * sx), 0, width))
+            r0 = int(np.clip(np.floor((y0 - bbox[1]) * sy), 0, height))
+            r1 = int(np.clip(np.ceil((y1 - bbox[1]) * sy), 0, height))
+            if c1 > c0 and r1 > r0 and value > 0:
+                grid[r0:r1, c0:c1] += np.float32(
+                    value / ((r1 - r0) * (c1 - c0))
+                )
+
+        resident_cells = 0
+        if got is not None:
+            decomp, resident, missing = got
+            from geomesa_tpu.cache.cells import cell_box
+
+            resident_cells = len(resident)
+            served = 0
+            for cell, n in resident:
+                splat(cell_box(decomp.level, *cell), float(n))
+                served += n
+            remainder = max(est - served, 0.0)
+            uncovered = len(missing) + decomp.residual_count()
+            if uncovered and remainder > 0:
+                for cell in missing:
+                    splat(cell_box(decomp.level, *cell),
+                          remainder / uncovered)
+        else:
+            splat(bbox, est)
+        self._speculative_audit(
+            name, plan, "density", int(np.count_nonzero(grid)),
+            {"resident_cells": resident_cells},
+        )
         return grid
 
     @_traced("density_curve")
@@ -1194,6 +1334,72 @@ class GeoDataset:
                 name, "density_curve", [plan],
                 [int(np.count_nonzero(g)) for g in grids], t0, members,
                 extra_hints={"level": level}, distinct=False,
+            )
+            return list(zip(grids, snaps))
+
+    def density_curve_filter_batch(self, name: str, queries, level: int = 9,
+                                   bboxes=None, weight: Optional[str] = None,
+                                   members: Optional[List[Dict[str, Any]]] = None):
+        """M curve-aligned density crops with DISTINCT filters — each
+        member its own viewport literals AND its own crop window — in one
+        device dispatch, or None when the members do not share a
+        batchable structural template (docs/SERVING.md "Query-axis
+        batching", extended to the curve path). Returns
+        ``[(grid, snapped_bbox), ...]`` in member order, each grid
+        bit-identical to its serial :meth:`density_curve`."""
+        if not 0 < level <= 15:
+            raise ValueError("level must be in 1..15 (grid = 4^level blocks)")
+        if not queries:
+            return []
+        if members is not None and len(members) != len(queries):
+            raise ValueError("members must align with queries")
+        bboxes = list(bboxes) if bboxes is not None \
+            else [None] * len(queries)
+        if len(bboxes) != len(queries):
+            raise ValueError("bboxes must align with queries")
+        import dataclasses
+
+        qs = [
+            dataclasses.replace(
+                Query(ecql=q) if isinstance(q, str) else q, index="z2"
+            )
+            for q in queries
+        ]
+        with tracing.start("density_curve_filter_batch", schema=name,
+                           batch=len(qs)), \
+                self.serving.admit("density_curve"):
+            st, plans, spec = self._batch_plans(name, qs)
+            if spec is None:
+                return None
+            ex = self._executor(st)
+            if not hasattr(ex, "density_curve_filter_batch"):
+                return None
+            default_bbox = None
+            windows, snaps = [], []
+            for bb in bboxes:
+                if bb is None:
+                    if default_bbox is None:
+                        default_bbox = (
+                            self.bounds(name)
+                            or (-180.0, -90.0, 180.0, 90.0)
+                        )
+                    bb = default_bbox
+                w, s = self._snap_blocks(bb, level)
+                windows.append(w)
+                snaps.append(s)
+            t0 = time.perf_counter()
+            with metrics.registry().timer("query.density").time(), \
+                    query_deadline(self._timeout_s()):
+                grids = ex.density_curve_filter_batch(
+                    plans, spec, level, windows, weight
+                )
+            if grids is None:
+                return None
+            metrics.inc(metrics.SERVING_FUSED_DISTINCT, len(grids))
+            self._batch_audit(
+                name, "density_curve", plans,
+                [int(np.count_nonzero(g)) for g in grids], t0, members,
+                extra_hints={"level": level},
             )
             return list(zip(grids, snaps))
 
@@ -1400,12 +1606,15 @@ class GeoDataset:
                               members, extra_hints={"stat": stat_spec})
             return out
 
-    @_traced("stats")
+    @_traced("stats", speculative="_speculative_stats")
     def stats(self, name: str, stat_spec: str,
               query: "str | Query" = "INCLUDE", region=None) -> sk.Stat:
         """Exact stats over matching features (StatsProcess/StatsScan
         analog). ``region``: optional polygon (WKT or geometry) — see
-        :meth:`density`."""
+        :meth:`density`. ``speculative_ok=True`` (kw): under overload, a
+        shed stats call returns the coarse write-time-sketch-served
+        estimate — typed ``speculative: true`` in the audit —
+        instead of failing ``[GM-SHED]`` (docs/SERVING.md)."""
         st, q, plan = self._plan(name, self._with_region(name, query, region))
         parse_stat(stat_spec)  # validate the spec before any timing/scan
         t0 = time.perf_counter()
@@ -1414,6 +1623,43 @@ class GeoDataset:
             out = self.cache.stats(self, st, q, plan, stat_spec)
         self._audit(name, q, plan, t0, 0, op="stats")
         return out
+
+    def _speculative_stats(self, name: str, stat_spec: str,
+                           query: "str | Query" = "INCLUDE",
+                           region=None) -> sk.Stat:
+        """The speculative degraded stats (see :meth:`stats`): served
+        from the PERSISTED write-time sketches — host reads, zero device
+        work. Leaves with a matching persisted sketch (MinMax of an
+        indexed attribute or the dtg field; Count — exact unfiltered,
+        planner-estimated otherwise) return its value; other leaves
+        return empty. The result shape
+        always matches the spec, so typed consumers need no special
+        casing — only the audit marker distinguishes it."""
+        st, q, plan = self._plan(name, self._with_region(name, query, region))
+        stat = parse_stat(stat_spec)
+        leaves = stat.stats if isinstance(stat, sk.SeqStat) else [stat]
+        served = 0
+        for leaf in leaves:
+            if isinstance(leaf, sk.CountStat):
+                # unfiltered count is exact from the store; a filtered
+                # one degrades to the planner estimate
+                f = plan.filter
+                leaf.count = int(
+                    st.count if isinstance(f, ir.Include)
+                    else plan.est_count
+                )
+                served += 1
+            elif isinstance(leaf, sk.MinMax):
+                mm = st.stats.get(f"minmax-{leaf.attribute}")
+                if mm is None and leaf.attribute == st.ft.dtg_field:
+                    mm = st.stats.get("time-bounds")
+                if isinstance(mm, sk.MinMax) and not mm.is_empty:
+                    leaf.merge(mm)
+                    served += 1
+        self._speculative_audit(name, plan, "stats", 0,
+                                {"stat": stat_spec,
+                                 "served_leaves": served})
+        return stat
 
     def unique(self, name: str, attribute: str,
                query: "str | Query" = "INCLUDE") -> List:
@@ -1633,14 +1879,199 @@ class GeoDataset:
 
         return processes.spatial_join(self, points, polygons, query, weight)
 
-    def join(self, left: str, right: str, left_attr: str, right_attr: str,
+    def join(self, left: str, right: str, left_attr: Optional[str] = None,
+             right_attr: Optional[str] = None,
              left_query: "str | Query" = "INCLUDE",
-             right_query: "str | Query" = "INCLUDE"):
-        from geomesa_tpu import processes
+             right_query: "str | Query" = "INCLUDE", *,
+             predicate: Optional[str] = None, distance=None,
+             dx=None, dy=None, level: Optional[int] = None):
+        """Join two schemas. With ``left_attr``/``right_attr``: the
+        attribute equi-join (JoinProcess analog, unchanged). With
+        ``predicate``: the TPU-native SPATIAL join between two
+        point-schema datasets (docs/JOIN.md) — ``"bbox"`` (envelopes of
+        half-widths ``dx``/``dy`` intersect) or ``"dwithin"`` (planar
+        degree ``distance``) — SFC-cell co-partitioned so candidate work
+        is O(pairs-in-same-cell), returning a streaming
+        :class:`SpatialJoinResult`."""
+        if predicate is None:
+            if left_attr is None or right_attr is None:
+                raise ValueError(
+                    "join needs left_attr/right_attr (equi-join) or "
+                    "predicate= (spatial join)"
+                )
+            from geomesa_tpu import processes
 
-        return processes.join(
-            self, left, right, left_attr, right_attr, left_query, right_query
+            return processes.join(
+                self, left, right, left_attr, right_attr,
+                left_query, right_query,
+            )
+        return self.join_spatial(
+            left, right, predicate=predicate, distance=distance, dx=dx,
+            dy=dy, left_query=left_query, right_query=right_query,
+            level=level,
         )
+
+    def _join_sides(self, left: str, right: str,
+                    left_query: "str | Query", right_query: "str | Query"):
+        """Plan + scan both join sides (each under its own filter /
+        visibility), validating the point-schema contract."""
+        lst, lq, lplan = self._plan(left, left_query)
+        rst, rq, rplan = self._plan(right, right_query)
+        for st_, nm in ((lst, left), (rst, right)):
+            g = st_.ft.geom_field
+            if g is None or not st_.ft.attr(g).is_point:
+                raise ValueError(
+                    f"[GM-ARG] spatial join requires a POINT geometry "
+                    f"on schema {nm!r}"
+                )
+        with tracing.span("scan.join.sides"):
+            lbatch = self._executor(lst).features(lplan)
+            rbatch = self._executor(rst).features(rplan)
+        return lst, lplan, lbatch, rst, rplan, rbatch
+
+    @staticmethod
+    def _side_xy(st: FeatureStore, batch: ColumnBatch):
+        g = st.ft.geom_field
+        z = np.zeros(0, np.float64)
+        return (batch.columns.get(g + "__x", z),
+                batch.columns.get(g + "__y", z))
+
+    def _join_run(self, left: str, right: str, predicate: str, distance,
+                  dx, dy, left_query, right_query, level,
+                  want_pairs: bool):
+        """The shared spatial-join body: sides scan -> co-partition ->
+        bucketed pairwise kernel over the device mesh -> audit."""
+        from geomesa_tpu.planning import join_exec
+
+        t0 = time.perf_counter()
+        metrics.inc(metrics.JOIN_QUERIES)
+        with query_deadline(self._timeout_s()):
+            lst, lplan, lbatch, rst, rplan, rbatch = self._join_sides(
+                left, right, left_query, right_query
+            )
+            lx, ly = self._side_xy(lst, lbatch)
+            rx, ry = self._side_xy(rst, rbatch)
+            pairs, total, stats = join_exec.run_join(
+                lx, ly, rx, ry, predicate, distance=distance, dx=dx,
+                dy=dy, level=level,
+                prefer_device=self.prefer_device and self.mesh is None,
+                want_pairs=want_pairs,
+            )
+        hints = {
+            "op": "join", "index": lplan.index_name, "right": right,
+            "predicate": predicate, "level": stats.level,
+            "cells_joint": stats.cells_joint,
+            "candidate_pairs": stats.candidate_pairs,
+            "naive_pairs": stats.naive_pairs,
+            "strip_fraction": round(stats.strip_fraction, 4),
+        }
+        if stats.skipped:
+            hints["degraded"] = list(stats.skipped)
+        tid = tracing.current_trace_id()
+        if tid is not None:
+            hints["trace_id"] = tid
+        hints.update(self._plan_audit_extras(lplan))
+        self.audit.record(
+            left, lplan.ecql, hints,
+            lplan.__dict__.get("plan_time_ms", 0.0),
+            (time.perf_counter() - t0) * 1e3, total,
+            user=self.serving.current_user() or "",
+            scanned=lplan.__dict__.get("scanned_rows", 0),
+            table_rows=lplan.__dict__.get("table_rows", 0),
+        )
+        return SpatialJoinResult(
+            lst, lbatch, rst, rbatch, pairs, total, stats
+        )
+
+    @_traced("join")
+    def join_spatial(self, left: str, right: str, *, predicate: str,
+                     distance=None, dx=None, dy=None,
+                     left_query: "str | Query" = "INCLUDE",
+                     right_query: "str | Query" = "INCLUDE",
+                     level: Optional[int] = None) -> "SpatialJoinResult":
+        """Spatial join of two point schemas (docs/JOIN.md): matched
+        pairs stream as ColumnBatches (``SpatialJoinResult.batches()``,
+        right columns prefixed ``right.``). Runs through serving
+        admission / deadlines like every public op; under
+        ``resilience.allow_partial()`` per-tile-slice failures degrade
+        with exact survivor totals (``result.stats.skipped``)."""
+        return self._join_run(left, right, predicate, distance, dx, dy,
+                              left_query, right_query, level,
+                              want_pairs=True)
+
+    @_traced("join")
+    def join_count(self, left: str, right: str, *, predicate: str,
+                   distance=None, dx=None, dy=None,
+                   left_query: "str | Query" = "INCLUDE",
+                   right_query: "str | Query" = "INCLUDE",
+                   level: Optional[int] = None) -> int:
+        """The join's aggregate form: exact matched-pair count without
+        materializing pairs (the [C, B, P] verdict mask never leaves the
+        device — only per-tile counts transfer). Slots into the serving
+        batch/fusion path as a repeat-fusable op (docs/SERVING.md)."""
+        res = self._join_run(left, right, predicate, distance, dx, dy,
+                             left_query, right_query, level,
+                             want_pairs=False)
+        return res.count
+
+    def explain_join(self, left: str, right: str, *, predicate: str,
+                     distance=None, dx=None, dy=None,
+                     left_query: "str | Query" = "INCLUDE",
+                     right_query: "str | Query" = "INCLUDE",
+                     level: Optional[int] = None,
+                     analyze: bool = False) -> str:
+        """Join plan explain (docs/JOIN.md): the co-partition's pruning
+        account — cells, candidate pairs vs naive N*M, boundary-strip
+        fraction — plus (``analyze=True``) the executed match count."""
+        from geomesa_tpu.kernels import join as kjoin
+        from geomesa_tpu.planning import join_exec
+
+        exp = Explainer(enabled=True)
+        with tracing.start("explain_join", schema=left), \
+                self.serving.admit("explain"):
+            lst, lplan, lbatch, rst, rplan, rbatch = self._join_sides(
+                left, right, left_query, right_query
+            )
+            lx, ly = self._side_xy(lst, lbatch)
+            rx, ry = self._side_xy(rst, rbatch)
+            p0, p1 = kjoin.pair_params(predicate, distance=distance,
+                                       dx=dx, dy=dy)
+            if predicate == kjoin.JOIN_BBOX:
+                reach_x, reach_y = float(p0), float(p1)
+            else:
+                reach_x = reach_y = float(distance)
+            plan = join_exec.co_partition(
+                lx, ly, rx, ry, predicate, reach_x, reach_y, level=level,
+                p0=p0, p1=p1,
+            )
+            st = plan.stats
+            exp.push("Join")
+            exp.kv("predicate", predicate)
+            exp.kv("sides", f"{left} ({st.n_left} rows) x "
+                   f"{right} ({st.n_right} rows)")
+            exp.kv("co-partition level", st.level)
+            exp.kv("cells", f"{st.cells_left} build, {st.cells_right} "
+                   f"probe, {st.cells_joint} joint (dispatched)")
+            exp.kv("candidate pairs",
+                   f"{st.candidate_pairs} of {st.naive_pairs} naive "
+                   f"({st.candidate_fraction:.4f})")
+            exp.kv("boundary-strip fraction",
+                   round(st.strip_fraction, 4))
+            exp.kv("tiles", f"{st.tiles} ({plan.Bp} x {plan.Pp} padded)")
+            if analyze:
+                t0 = time.perf_counter()
+                _, total = join_exec.execute(
+                    plan, lx, ly, rx, ry,
+                    prefer_device=self.prefer_device and self.mesh is None,
+                    want_pairs=False,
+                )
+                exp.kv("matched (analyze)", total)
+                exp.kv("pairwise ms",
+                       round((time.perf_counter() - t0) * 1e3, 3))
+                if st.skipped:
+                    exp.kv("degraded", ", ".join(st.skipped))
+            exp.pop()
+        return str(exp)
 
     def sample(self, name: str, one_in_n: int,
                query: "str | Query" = "INCLUDE") -> FeatureCollection:

@@ -406,6 +406,23 @@ CACHE_HIERARCHY_DEPTH = SystemProperty("geomesa.cache.hierarchy.depth", "2")
 CACHE_POLYGON = SystemProperty("geomesa.cache.polygon", "true")
 
 # ---------------------------------------------------------------------------
+# TPU-native spatial joins (planning/join_exec.py; docs/JOIN.md): SFC-cell
+# co-partitioned build/probe with a bucketed pairwise kernel.
+# ---------------------------------------------------------------------------
+
+#: Pairwise-kernel tile edge: per-cell build/probe blocks chunk into tiles
+#: of at most this many rows per side (pow2-bucketed below it), so skewed
+#: cells split into more tiles instead of inflating every cell's padding.
+JOIN_TILE = SystemProperty("geomesa.join.tile", "64")
+
+#: Finest SFC cell level the join co-partition may choose (cells are the
+#: same 2^level x 2^level lon/lat grid the aggregate cache decomposes to).
+JOIN_MAX_LEVEL = SystemProperty("geomesa.join.max.level", "12")
+
+#: Matched-pair ColumnBatch chunk size for the streaming join result.
+JOIN_BATCH_ROWS = SystemProperty("geomesa.join.batch.rows", "65536")
+
+# ---------------------------------------------------------------------------
 # Resilience layer (resilience.py; docs/RESILIENCE.md). Retry defaults track
 # the reference's tablet-server client retry posture; the breaker fences a
 # dead sidecar so calls fail fast instead of paying the timeout each time.

@@ -142,6 +142,81 @@ def classify_cells(boxes: np.ndarray, g, margin: float) -> np.ndarray:
     return codes
 
 
+# ---------------------------------------------------------------------------
+# Pairwise point-point join predicates (docs/JOIN.md): the exact test the
+# co-partitioned build/probe runs on same-cell (+ boundary-strip) candidate
+# pairs. One function serves BOTH the device kernel (xp = jax.numpy) and
+# the numpy brute-force reference, in the SAME f32 arithmetic and op
+# order, so the co-partitioned join is bit-identical to the N*M reference
+# by construction — the cells only decide WHICH pairs are tested, never
+# how a tested pair decides.
+# ---------------------------------------------------------------------------
+
+#: pairwise predicate kinds
+JOIN_BBOX, JOIN_DWITHIN = "bbox", "dwithin"
+
+
+def pair_params(predicate: str, distance=None, dx=None, dy=None):
+    """Canonical f32 parameter pair ``(p0, p1)`` for one predicate:
+    ``bbox`` -> (dx, dy) half-widths; ``dwithin`` -> (d^2, 0) with the
+    square computed in f32 on the host, so device and reference compare
+    against the identical value."""
+    if predicate == JOIN_BBOX:
+        if dx is None or dy is None:
+            raise ValueError("bbox join needs dx and dy half-widths")
+        return np.float32(dx), np.float32(dy)
+    if predicate == JOIN_DWITHIN:
+        if distance is None:
+            raise ValueError("dwithin join needs a distance")
+        d = np.float32(distance)
+        return np.float32(d * d), np.float32(0.0)
+    raise ValueError(f"unknown join predicate {predicate!r} "
+                     f"(have: {JOIN_BBOX}, {JOIN_DWITHIN})")
+
+
+def pair_mask(lx, ly, rx, ry, predicate: str, p0, p1, xp):
+    """Pairwise predicate verdicts under broadcasting (f32, inclusive
+    edges). ``bbox``: the two points' (p0, p1)-half-width envelopes
+    intersect, i.e. |lx-rx| <= p0 and |ly-ry| <= p1. ``dwithin``: planar
+    degree distance with p0 = d^2 (the sum-of-squares form keeps one
+    compare and no sqrt — exact for the <= verdict in f32 given both
+    sides compute it identically, which they do: this function IS both
+    sides)."""
+    ddx = lx.astype(xp.float32) - rx.astype(xp.float32)
+    ddy = ly.astype(xp.float32) - ry.astype(xp.float32)
+    if predicate == JOIN_BBOX:
+        return (xp.abs(ddx) <= p0) & (xp.abs(ddy) <= p1)
+    if predicate == JOIN_DWITHIN:
+        return ddx * ddx + ddy * ddy <= p0
+    raise ValueError(f"unknown join predicate {predicate!r}")
+
+
+def brute_force_pairs(lx, ly, rx, ry, predicate: str, p0, p1,
+                      chunk: int = 4096):
+    """The naive N*M reference (numpy, chunked): matched (left, right)
+    row-index pairs in row-major order — int64 [K, 2]. The bench/CI
+    bit-identity gates compare the co-partitioned device join against
+    exactly this."""
+    lx = np.asarray(lx, np.float32)
+    ly = np.asarray(ly, np.float32)
+    rx = np.asarray(rx, np.float32)
+    ry = np.asarray(ry, np.float32)
+    out = []
+    for lo in range(0, len(lx), chunk):
+        hi = min(lo + chunk, len(lx))
+        m = pair_mask(
+            lx[lo:hi, None], ly[lo:hi, None], rx[None, :], ry[None, :],
+            predicate, p0, p1, np,
+        )
+        li, rj = np.nonzero(m)
+        if len(li):
+            out.append(np.stack([li.astype(np.int64) + lo,
+                                 rj.astype(np.int64)], axis=1))
+    if not out:
+        return np.zeros((0, 2), np.int64)
+    return np.concatenate(out, axis=0)
+
+
 def pip_counts(px, py, mask, edges, weights, xp):
     """Per-polygon masked point (or weight) totals: float32 [P]."""
     P = int(edges["n_polys"])

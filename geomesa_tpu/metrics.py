@@ -492,3 +492,19 @@ CACHE_EVICT = "cache.evict"
 CACHE_INVALIDATE = "cache.invalidate"
 CACHE_BYTES = "cache.bytes"
 CACHE_ENTRIES = "cache.entries"
+# Spatial joins (planning/join_exec.py; docs/JOIN.md):
+#   join.queries          spatial joins executed (count + pair forms)
+#   join.cells            co-partition cells that held rows on BOTH sides
+#   join.candidate.pairs  pairwise tests actually dispatched (same-cell +
+#                         boundary-strip pairs — the O(pairs-in-cell)
+#                         account vs the naive N*M)
+#   join.pairs            matched pairs emitted
+JOIN_QUERIES = "join.queries"
+JOIN_CELLS = "join.cells"
+JOIN_CANDIDATE_PAIRS = "join.candidate.pairs"
+JOIN_PAIRS = "join.pairs"
+#   compact.desc.shared   compact-scan descriptors served from the
+#                         content-addressed share (a rebuild avoided:
+#                         another site/query resolved the same windows —
+#                         docs/PERF.md "Shared descriptors")
+COMPACT_DESC_SHARED = "compact.desc.shared"

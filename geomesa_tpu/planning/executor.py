@@ -357,6 +357,24 @@ class Executor:
             return
         starts, ends, B, lens = chosen
         S, K = starts.shape
+        # content-addressed descriptor share (docs/PERF.md "Shared
+        # descriptors"): the built descriptor is pure in (resolved window
+        # BYTES, B bucket, padded layout), so any other jit site / query
+        # text / plan token that resolves the same windows reuses the
+        # ~1.5 ms argsort/repeat build instead of duplicating it. Keyed
+        # by the bytes, never their hash — a collision would silently
+        # scan another query's rows, and equality is the correctness
+        # contract (the arrays are small next to the slabs they index).
+        share = self.store.__dict__.setdefault("_desc_share", {})
+        skey = ("flat", B, S, L, starts.tobytes(), ends.tobytes())
+        shit = share.get(skey)
+        if shit is not None:
+            metrics.inc(metrics.COMPACT_DESC_SHARED)
+            if len(ccache) >= 64:
+                ccache.clear()
+            ccache[ckey] = shit
+            setup["compact"] = shit or None
+            return
         flat_lens = lens.reshape(-1)
         nc = -(-flat_lens // B)
         C = int(nc.sum())
@@ -366,6 +384,9 @@ class Executor:
             if len(ccache) >= 64:
                 ccache.clear()
             ccache[ckey] = False
+            if len(share) >= 64:
+                share.clear()
+            share[skey] = False
             return
         win = np.repeat(np.arange(S * K), nc)
         j = np.arange(C) - np.repeat(np.cumsum(nc) - nc, nc)
@@ -400,6 +421,9 @@ class Executor:
         if len(ccache) >= 64:
             ccache.clear()
         ccache[ckey] = desc
+        if len(share) >= 64:
+            share.clear()
+        share[skey] = desc
         setup["compact"] = desc
 
     # -- mesh-sharded window compaction -----------------------------------
@@ -434,9 +458,24 @@ class Executor:
         L = setup["L"]
         chosen = self._compact_candidates(plan, setup)
         out = False
+        share = self.store.__dict__.setdefault("_desc_share", {})
+        skey = None
         if chosen is not None:
             starts, ends, B, lens = chosen
             S, K = starts.shape
+            # content-addressed share, bucket-aware (docs/PERF.md "Shared
+            # descriptors"): same resolved windows + same (B, S, D)
+            # layout => same [D, Cp] descriptor, whatever site/plan asked
+            # (keyed by the window BYTES — equality is the correctness
+            # contract; S pins the (S, K) factorization of those bytes)
+            skey = ("mesh", B, D, S, L, starts.tobytes(), ends.tobytes())
+            shit = share.get(skey)
+            if shit is not None:
+                metrics.inc(metrics.COMPACT_DESC_SHARED)
+                if len(cache) >= 64:
+                    cache.clear()
+                cache[ckey] = shit
+                return shit or None
             Sd = S // D
             flat_lens = lens.reshape(-1)
             nc = -(-flat_lens // B)
@@ -477,6 +516,10 @@ class Executor:
         if len(cache) >= 64:
             cache.clear()
         cache[ckey] = out
+        if skey is not None:
+            if len(share) >= 64:
+                share.clear()
+            share[skey] = out
         return out or None
 
     def _compact_mesh_run(self, plan: QueryPlan, setup, agg_fn, agg_cols,
@@ -1774,6 +1817,102 @@ class Executor:
         return self.decode_curve_batch(
             self.density_curve_batch_raw(plan, level, block_windows, weight)
         )
+
+    def density_curve_filter_batch_raw(self, plans, spec, level: int,
+                                       block_windows,
+                                       weight: Optional[str] = None):
+        """M DISTINCT-filter curve crops of one structural template in a
+        single device dispatch (docs/SERVING.md "Query-axis batching",
+        extended to the curve path): each member carries its OWN viewport
+        literals (kernel data via ``spec``) AND its own crop window
+        (stacked CDF gather positions). Unlike :meth:`density_curve_batch`
+        — which shares one mask + cumsum across crops of ONE filter —
+        every member here pays its own masked cumsum, but all M ride one
+        kernel launch and one column residency. Per-member math is
+        op-for-op the serial :meth:`density_curve` kernel (batched
+        window_mask + literal-parameterized compare, then the identical
+        int32/f32 cumsum + 2-gather CDF), so de-interleaved grids are
+        bit-identical to query-at-a-time execution. Returns the unsynced
+        ``(partials_or_None, infos)`` pair, or None when ineligible
+        (caller degrades to per-member serial execution); members with
+        surviving f32 band rows keep the serial path (band corrections
+        are per-block additive host work the batch does not carry)."""
+        check_deadline()
+        agg_cols = [weight] if weight else []
+        bs = self._batch_setups(plans, spec, agg_cols)
+        if bs is None:
+            return None
+        infos = [
+            self._curve_positions(plans[0], level, bw)
+            for bw in block_windows
+        ]
+        if bs["empty"]:
+            return (None, infos)
+        # any member with SURVIVING f32 band rows keeps the serial path:
+        # its correction is per-block additive host work this batch does
+        # not carry (same posture as stats_batch)
+        for plan, su in zip(plans, bs["setups"]):
+            if su is None or plan.compiled.band is None:
+                continue
+            info = self._band_info(plan, su)
+            if info is not None and len(info):
+                return None
+        P = max(len(i[0]) for i in infos)
+        Mp = bs["Mp"]
+        p0s = np.zeros((Mp, P), np.int32)
+        p1s = np.zeros((Mp, P), np.int32)
+        for m, (p0, p1, _B, _nx, _ny) in enumerate(infos):
+            p0s[m, : len(p0)] = p0
+            p1s[m, : len(p1)] = p1
+
+        def member_agg(m, cols, mm, xp, p0_, p1_):
+            if weight is None:
+                w = mm.reshape(-1).astype(xp.int32)
+            else:
+                w = xp.where(
+                    mm.reshape(-1),
+                    cols[weight].reshape(-1).astype(xp.float32),
+                    xp.float32(0),
+                )
+            # per-member cumsum (distinct masks), same exactness contract
+            # as the serial density_curve kernel
+            c = xp.concatenate([xp.zeros(1, w.dtype), xp.cumsum(w)])
+            return c[p1_[m]] - c[p0_[m]]
+
+        out = self._batch_device_agg(
+            plans, spec, bs, member_agg, agg_cols,
+            "density_curve_filter_batch", key_extras=(level, P, weight),
+            extra_arrays=(p0s, p1s),
+        )
+        return (out, infos)
+
+    @staticmethod
+    def decode_curve_filter_batch(raw):
+        """One :meth:`density_curve_filter_batch_raw` partial as
+        per-member host f64 grids (the partitioned merge's decode)."""
+        got, infos = raw
+        results = []
+        for m, (_p0, _p1, B, nx, ny) in enumerate(infos):
+            if got is None:
+                results.append(np.zeros((ny, nx), np.float64))
+            else:
+                results.append(
+                    np.asarray(got[m])[:B].astype(np.float64).reshape(ny, nx)
+                )
+        return results
+
+    def density_curve_filter_batch(self, plans, spec, level: int,
+                                   block_windows,
+                                   weight: Optional[str] = None):
+        """M distinct-filter curve grids in one device dispatch (None =
+        ineligible). Each member's grid equals its serial
+        :meth:`density_curve` exactly — the CI-gated contract."""
+        got = self.density_curve_filter_batch_raw(
+            plans, spec, level, block_windows, weight
+        )
+        if got is None:
+            return None
+        return self.decode_curve_filter_batch(got)
 
     # -- query-axis batched aggregates (docs/SERVING.md "Query-axis
     # batching"): M *distinct* viewports in ONE device dispatch. The

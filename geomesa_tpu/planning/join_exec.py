@@ -1,0 +1,491 @@
+"""SFC co-partitioned spatial-join executor (docs/JOIN.md).
+
+The device analog of the reference's grid-partitioned Spark join
+(GeoMesaJoinRelation + RelationUtils.gridPartition) in the shape "Adaptive
+Geospatial Joins for Modern Hardware" (PAPERS.md) shows wins on throughput
+hardware: a cheap grid filter prunes candidate pairs, then an exact test
+runs on the survivors. Both join sides co-partition by SFC cell — the same
+2^level x 2^level lon/lat grid the aggregate cache decomposes to
+(cache/cells.py; a cell's identity is its z2 prefix via ``interleave2``) —
+so only same-cell (plus boundary-strip) pairs ever reach the device:
+candidate work is O(pairs-in-same-cell), never O(N*M).
+
+Build/probe contract:
+
+* the **build** (left) side lands in exactly one cell — the one containing
+  its point;
+* the **probe** (right) side replicates into every cell its predicate
+  reach box ``point ± (reach + margin)`` touches (the *boundary strip*;
+  the margin is ``cache.cells.CLASSIFY_MARGIN``, the same f32-safety
+  machinery ``classify_cells`` uses, so an f32-rounded pair that passes
+  the exact predicate can never hide in an unprobed neighbor cell);
+* a candidate pair is tested iff the build row's cell is among the probe
+  row's covered cells — each surviving pair is tested exactly ONCE,
+  because the build cell is unique. No dedup pass exists or is needed.
+
+Device execution: per-cell blocks chunk into **tiles** of at most
+``geomesa.join.tile`` rows per side, both tile axes pow2-bucketed and the
+tile count bucketed per dispatch, so the bucketed pairwise kernel's
+registry key — ``(site, Bp, Pp, Cp, predicate)``, predicate *parameters*
+ride as traced f32 scalars — is version-stable: repeated joins over fresh
+data of similar size NEVER recompile (CI-gated recompiles==0).
+
+Sharded fan-out: the tile axis splits into one contiguous slice per
+usable device (``parallel.devices.scan_devices``); counts merge via the
+documented :func:`~geomesa_tpu.parallel.devices.tree_merge` order and
+pair blocks concatenate in slice order, so the sharded join is
+bit-identical to the single-device (and numpy brute-force) result by
+construction. Per-slice failures degrade under
+``resilience.allow_partial()`` with exact survivor totals (the skipped
+tile ranges are recorded; completed tiles' pairs/counts are exact).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu import config, metrics, tracing, utilization
+from geomesa_tpu.cache.cells import CLASSIFY_MARGIN
+from geomesa_tpu.kernels import join as kjoin
+from geomesa_tpu.kernels.registry import KernelRegistry
+from geomesa_tpu.resilience import check_deadline, partial_allowed, record_skip
+
+#: one process-wide registry for join kernels: the pairwise kernel is pure
+#: in (shapes, predicate kind) — no store, no dictionary — so it is
+#: version-stable trivially and shared across every dataset in the process
+_REGISTRY: Optional[KernelRegistry] = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def join_registry() -> KernelRegistry:
+    """The process-wide join-kernel registry (recompile accounting for the
+    bench/CI ``join_recompiles`` gate reads ``.traces('join.pairs')``)."""
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        if _REGISTRY is None:
+            _REGISTRY = KernelRegistry()
+        return _REGISTRY
+
+
+def _pow2(n: int) -> int:
+    return 1 << max(n - 1, 0).bit_length()
+
+
+def _tile() -> int:
+    t = config.JOIN_TILE.to_int()
+    return 64 if t is None else max(int(t), 8)
+
+
+@dataclass
+class JoinStats:
+    """The explain/audit account of one co-partitioned join (docs/JOIN.md):
+    how much the grid filter pruned vs the naive N*M."""
+
+    level: int = 0
+    n_left: int = 0
+    n_right: int = 0
+    cells_left: int = 0
+    cells_right: int = 0
+    #: cells populated on BOTH sides (only these dispatch)
+    cells_joint: int = 0
+    #: exact pairwise tests dispatched (same-cell + strip candidates)
+    candidate_pairs: int = 0
+    #: probe rows replicated beyond their home cell (the boundary strip)
+    strip_entries: int = 0
+    tiles: int = 0
+    matched: int = 0
+    devices: int = 1
+    #: tile ranges skipped under allow_partial (exact survivor totals)
+    skipped: List[str] = field(default_factory=list)
+
+    @property
+    def naive_pairs(self) -> int:
+        return self.n_left * self.n_right
+
+    @property
+    def candidate_fraction(self) -> float:
+        return self.candidate_pairs / max(self.naive_pairs, 1)
+
+    @property
+    def strip_fraction(self) -> float:
+        """Fraction of probe-side cell memberships that are strip
+        replicas (0 = every probe row stayed in its home cell)."""
+        total = self.n_right + self.strip_entries
+        return self.strip_entries / max(total, 1)
+
+
+def choose_level(n_left: int, n_right: int, reach: float,
+                 bounds: Optional[Tuple[float, float, float, float]]) -> int:
+    """Adaptive co-partition level: fine enough that the denser side
+    averages ~tile rows per occupied cell over its extent, coarse enough
+    that a probe reach box spans at most 2 cells per axis (cell span >=
+    2 * reach keeps the boundary strip at most one neighbor ring)."""
+    tile = _tile()
+    max_level = config.JOIN_MAX_LEVEL.to_int() or 12
+    if bounds is None:
+        span = 360.0
+    else:
+        span = max(bounds[2] - bounds[0], (bounds[3] - bounds[1]) * 2, 1e-6)
+    target_axis = float(np.sqrt(max(n_left, n_right, 1) / tile))
+    target_axis = min(max(target_axis, 1.0), 1024.0)
+    want_span = max(span / target_axis, 1e-9)
+    level_data = int(np.ceil(np.log2(360.0 / want_span)))
+    reach = max(float(reach), 0.0) + CLASSIFY_MARGIN
+    level_reach = int(np.floor(np.log2(360.0 / max(2.0 * reach, 1e-9))))
+    return int(np.clip(min(level_data, level_reach), 1, max_level))
+
+
+def _cell_ids(ix: np.ndarray, iy: np.ndarray) -> np.ndarray:
+    """Absolute cell identity: the z2 curve prefix (interleave2), the same
+    identity the aggregate cache keys cells by (cache/cells.cell_prefix)."""
+    from geomesa_tpu.curves.zorder import interleave2
+
+    return interleave2(ix.astype(np.uint64), iy.astype(np.uint64))
+
+
+@dataclass
+class JoinPlan:
+    """Host-side co-partition product: padded tile blocks ready for the
+    bucketed pairwise kernel. All index arrays are int32 positions into
+    the caller's left/right row sets."""
+
+    predicate: str
+    p0: np.float32
+    p1: np.float32
+    stats: JoinStats
+    #: [C, Bp] / [C, Pp] global row positions (0-padded; valid counts mask)
+    l_rows: np.ndarray = None  # type: ignore[assignment]
+    r_rows: np.ndarray = None  # type: ignore[assignment]
+    l_valid: np.ndarray = None  # type: ignore[assignment]  # [C] int32
+    r_valid: np.ndarray = None  # type: ignore[assignment]  # [C] int32
+    Bp: int = 0
+    Pp: int = 0
+
+    @property
+    def n_tiles(self) -> int:
+        return 0 if self.l_rows is None else len(self.l_rows)
+
+
+def co_partition(lx, ly, rx, ry, predicate: str, reach_x: float,
+                 reach_y: float, level: Optional[int] = None,
+                 p0=None, p1=None) -> JoinPlan:
+    """Group both sides by SFC cell at ``level`` (adaptive when None) and
+    chunk joint cells into padded tile blocks. Pure host numpy — the
+    grouping is two argsorts plus a bounded neighbor expansion."""
+    lx = np.asarray(lx, np.float64)
+    ly = np.asarray(ly, np.float64)
+    rx = np.asarray(rx, np.float64)
+    ry = np.asarray(ry, np.float64)
+    reach = max(float(reach_x), float(reach_y))
+    if level is None:
+        n_l, n_r = len(lx), len(rx)
+        bounds = None
+        if n_l and n_r:
+            bounds = (
+                min(lx.min(), rx.min()), min(ly.min(), ry.min()),
+                max(lx.max(), rx.max()), max(ly.max(), ry.max()),
+            )
+        level = choose_level(n_l, n_r, reach, bounds)
+    stats = JoinStats(level=level, n_left=len(lx), n_right=len(rx))
+    plan = JoinPlan(predicate=predicate, p0=p0, p1=p1, stats=stats)
+    if not len(lx) or not len(rx):
+        return plan
+    n = 1 << level
+    sx, sy = 360.0 / n, 180.0 / n
+
+    def cell_of(x, y):
+        ix = np.clip(np.floor((x + 180.0) / sx), 0, n - 1).astype(np.int64)
+        iy = np.clip(np.floor((y + 90.0) / sy), 0, n - 1).astype(np.int64)
+        return ix, iy
+
+    lix, liy = cell_of(lx, ly)
+    lcell = _cell_ids(lix, liy)
+    stats.cells_left = len(np.unique(lcell))
+
+    # probe reach box, inflated by the classify margin (module docstring):
+    # every cell the box touches gets a membership
+    mx = float(reach_x) + CLASSIFY_MARGIN
+    my = float(reach_y) + CLASSIFY_MARGIN
+    ix0 = np.clip(np.floor((rx - mx + 180.0) / sx), 0, n - 1).astype(np.int64)
+    ix1 = np.clip(np.floor((rx + mx + 180.0) / sx), 0, n - 1).astype(np.int64)
+    iy0 = np.clip(np.floor((ry - my + 90.0) / sy), 0, n - 1).astype(np.int64)
+    iy1 = np.clip(np.floor((ry + my + 90.0) / sy), 0, n - 1).astype(np.int64)
+    wx = (ix1 - ix0 + 1).astype(np.int64)
+    wy = (iy1 - iy0 + 1).astype(np.int64)
+    w = wx * wy
+    rid = np.repeat(np.arange(len(rx), dtype=np.int64), w)
+    # per-membership (dx, dy) offsets within each row's window, row-major
+    off = np.arange(int(w.sum()), dtype=np.int64) - np.repeat(
+        np.cumsum(w) - w, w
+    )
+    gx = ix0[rid] + off % wx[rid]
+    gy = iy0[rid] + off // wx[rid]
+    rcell = _cell_ids(gx, gy)
+    rhome = _cell_ids(*cell_of(rx, ry))
+    stats.cells_right = len(np.unique(rhome))
+
+    # keep only memberships whose cell holds build rows (the joint cells)
+    ucell, linv = np.unique(lcell, return_inverse=True)
+    pos = np.searchsorted(ucell, rcell)
+    pos_c = np.minimum(pos, len(ucell) - 1)
+    keep = ucell[pos_c] == rcell
+    rid, rcell_k, pos_c = rid[keep], rcell[keep], pos_c[keep]
+    stats.strip_entries = int((rhome[rid] != rcell_k).sum())
+    if not len(rid):
+        return plan
+
+    # group both sides by joint-cell index (stable order: row order within
+    # a cell, cells in ucell order — deterministic for any input)
+    lorder = np.argsort(linv, kind="stable")
+    lsorted = lorder.astype(np.int32)
+    lcounts = np.bincount(linv, minlength=len(ucell))
+    rorder = np.argsort(pos_c, kind="stable")
+    rsorted = rid[rorder].astype(np.int32)
+    rcounts = np.bincount(pos_c, minlength=len(ucell))
+    joint = (lcounts > 0) & (rcounts > 0)
+    stats.cells_joint = int(joint.sum())
+    stats.candidate_pairs = int(
+        (lcounts[joint].astype(np.int64) * rcounts[joint]).sum()
+    )
+    lstart = np.concatenate(([0], np.cumsum(lcounts)))
+    rstart = np.concatenate(([0], np.cumsum(rcounts)))
+
+    # tile chunking: skewed cells split into ceil(nb/T) x ceil(np/T)
+    # tile pairs instead of inflating every cell's padding
+    T = _tile()
+    tl_rows: List[np.ndarray] = []
+    tr_rows: List[np.ndarray] = []
+    tl_valid: List[int] = []
+    tr_valid: List[int] = []
+    max_b = max_p = 1
+    for c in np.nonzero(joint)[0]:
+        lrows = lsorted[lstart[c]: lstart[c + 1]]
+        rrows = rsorted[rstart[c]: rstart[c + 1]]
+        for bl in range(0, len(lrows), T):
+            lchunk = lrows[bl: bl + T]
+            for pl in range(0, len(rrows), T):
+                rchunk = rrows[pl: pl + T]
+                tl_rows.append(lchunk)
+                tr_rows.append(rchunk)
+                tl_valid.append(len(lchunk))
+                tr_valid.append(len(rchunk))
+                max_b = max(max_b, len(lchunk))
+                max_p = max(max_p, len(rchunk))
+    C = len(tl_rows)
+    stats.tiles = C
+    Bp, Pp = _pow2(max_b), _pow2(max_p)
+    l_rows = np.zeros((C, Bp), np.int32)
+    r_rows = np.zeros((C, Pp), np.int32)
+    for i in range(C):
+        l_rows[i, : tl_valid[i]] = tl_rows[i]
+        r_rows[i, : tr_valid[i]] = tr_rows[i]
+    plan.l_rows, plan.r_rows = l_rows, r_rows
+    plan.l_valid = np.asarray(tl_valid, np.int32)
+    plan.r_valid = np.asarray(tr_valid, np.int32)
+    plan.Bp, plan.Pp = Bp, Pp
+    return plan
+
+
+# ---------------------------------------------------------------------------
+# Bucketed pairwise kernels (the version-stable registry half)
+# ---------------------------------------------------------------------------
+
+def _pairs_kernel(Bp: int, Pp: int, Cp: int, predicate: str):
+    """Registry-cached jitted kernel: [Cp, Bp, Pp] bool verdict mask plus
+    [Cp] int32 per-tile match counts. Predicate parameters are traced f32
+    scalars (kernel data), so distances never recompile."""
+    reg = join_registry()
+    key = ("join.pairs", Bp, Pp, Cp, predicate)
+    go = reg.get(key)
+    if go is not None:
+        return go
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def go(lxb, lyb, rxb, ryb, lvalid, rvalid, p0, p1):
+        m = kjoin.pair_mask(
+            lxb[:, :, None], lyb[:, :, None],
+            rxb[:, None, :], ryb[:, None, :],
+            predicate, p0, p1, jnp,
+        )
+        iota_b = jnp.arange(Bp, dtype=jnp.int32)[None, :, None]
+        iota_p = jnp.arange(Pp, dtype=jnp.int32)[None, None, :]
+        m = m & (iota_b < lvalid[:, None, None]) \
+              & (iota_p < rvalid[:, None, None])
+        return m, m.sum(axis=(1, 2), dtype=jnp.int32)
+
+    reg.put(key, go)
+    return go
+
+
+def _devices(prefer_device: bool):
+    """Devices for the join tile fan-out (same stand-down rules as the
+    sharded partitioned scan), or None for the single default device."""
+    if not prefer_device:
+        return None
+    from geomesa_tpu.parallel import devices as pdev
+
+    return pdev.scan_devices()
+
+
+def _pad_tiles(plan: JoinPlan, lo: int, hi: int, lx32, ly32, rx32, ry32):
+    """One device slice's padded kernel operands: tile rows [Cp, Bp/Pp]
+    gathered into coordinate blocks, Cp = pow2 bucket of the slice."""
+    C = hi - lo
+    Cp = _pow2(C)
+    lrows = np.zeros((Cp, plan.Bp), np.int32)
+    rrows = np.zeros((Cp, plan.Pp), np.int32)
+    lval = np.zeros(Cp, np.int32)
+    rval = np.zeros(Cp, np.int32)
+    lrows[:C] = plan.l_rows[lo:hi]
+    rrows[:C] = plan.r_rows[lo:hi]
+    lval[:C] = plan.l_valid[lo:hi]
+    rval[:C] = plan.r_valid[lo:hi]
+    return (lx32[lrows], ly32[lrows], rx32[rrows], ry32[rrows],
+            lval, rval, Cp, C)
+
+
+def execute(plan: JoinPlan, lx, ly, rx, ry, prefer_device: bool = True,
+            want_pairs: bool = True):
+    """Run the bucketed pairwise kernel over the plan's tiles, sharded
+    over the device mesh. Returns ``(pairs, total)``: matched global
+    (left, right) row positions as int64 [K, 2] sorted row-major (None
+    when ``want_pairs`` is False) and the exact match total over
+    completed tiles. Per-slice failures degrade under
+    ``resilience.allow_partial()`` (recorded in ``plan.stats.skipped``);
+    totals stay exact over survivors."""
+    stats = plan.stats
+    if plan.n_tiles == 0:
+        return (np.zeros((0, 2), np.int64) if want_pairs else None), 0
+    lx32 = np.asarray(lx, np.float32)
+    ly32 = np.asarray(ly, np.float32)
+    rx32 = np.asarray(rx, np.float32)
+    ry32 = np.asarray(ry, np.float32)
+    use_device = prefer_device and _jax_ok()
+    devs = _devices(prefer_device) if use_device else None
+    n_dev = len(devs) if devs else 1
+    stats.devices = n_dev
+    # contiguous tile slices, one per device (bit-identity: slice order ==
+    # tile order; counts tree-merge in slice order)
+    edges = np.linspace(0, plan.n_tiles, n_dev + 1).astype(int)
+    slices = [(int(a), int(b)) for a, b in zip(edges[:-1], edges[1:])
+              if b > a]
+    partials = []
+    for i, (lo, hi) in enumerate(slices):
+        check_deadline()
+        dev = devs[i % len(devs)] if devs else None
+        try:
+            partials.append(
+                _run_slice(plan, lo, hi, lx32, ly32, rx32, ry32,
+                           use_device, dev, want_pairs)
+            )
+        except BaseException as e:
+            from geomesa_tpu.resilience import QueryTimeoutError
+
+            if isinstance(e, QueryTimeoutError) or not partial_allowed():
+                raise
+            record_skip("join", f"tiles[{lo}:{hi}]", e, phase="pairs")
+            stats.skipped.append(f"tiles[{lo}:{hi}]")
+            partials.append(None)
+    from geomesa_tpu.parallel.devices import tree_merge
+
+    total = tree_merge(
+        [None if p is None else p[1] for p in partials],
+        lambda a, b: a + b,
+    )
+    total = int(total or 0)
+    stats.matched = total
+    if not want_pairs:
+        return None, total
+    blocks = [p[0] for p in partials if p is not None and len(p[0])]
+    if not blocks:
+        return np.zeros((0, 2), np.int64), total
+    pairs = np.concatenate(blocks, axis=0)
+    # canonical row-major order == the brute-force reference's nonzero
+    # order: the bit-identity contract is on the SET, surfaced sorted
+    order = np.lexsort((pairs[:, 1], pairs[:, 0]))
+    return pairs[order], total
+
+
+def _run_slice(plan: JoinPlan, lo: int, hi: int, lx32, ly32, rx32, ry32,
+               use_device: bool, dev, want_pairs: bool):
+    """One tile slice: (pairs int64 [k, 2] in tile order, match count)."""
+    (lxb, lyb, rxb, ryb, lval, rval, Cp, C) = _pad_tiles(
+        plan, lo, hi, lx32, ly32, rx32, ry32
+    )
+    if use_device:
+        import jax
+
+        go = _pairs_kernel(plan.Bp, plan.Pp, Cp, plan.predicate)
+        ops = (lxb, lyb, rxb, ryb, lval, rval,
+               np.float32(plan.p0), np.float32(plan.p1))
+        if dev is not None:
+            ops = tuple(jax.device_put(o, dev) for o in ops)
+        with tracing.span("scan.join.pairs", tiles=C, device=getattr(
+                dev, "id", None)), \
+                utilization.device_busy(getattr(dev, "id", 0) or 0):
+            metrics.inc(metrics.EXEC_DEVICE_DISPATCH)
+            m, counts = go(*ops)
+        m = np.asarray(m)
+        counts = np.asarray(counts)
+    else:
+        m = kjoin.pair_mask(
+            lxb[:, :, None], lyb[:, :, None],
+            rxb[:, None, :], ryb[:, None, :],
+            plan.predicate, plan.p0, plan.p1, np,
+        )
+        iota_b = np.arange(plan.Bp, dtype=np.int32)[None, :, None]
+        iota_p = np.arange(plan.Pp, dtype=np.int32)[None, None, :]
+        m = m & (iota_b < lval[:, None, None]) & (iota_p < rval[:, None, None])
+        counts = m.sum(axis=(1, 2), dtype=np.int32)
+    n = int(counts[:C].sum())
+    if not want_pairs:
+        return np.zeros((0, 2), np.int64), n
+    c, b, p = np.nonzero(m[:C])
+    lrows = plan.l_rows[lo:hi]
+    rrows = plan.r_rows[lo:hi]
+    pairs = np.stack([
+        lrows[c, b].astype(np.int64), rrows[c, p].astype(np.int64)
+    ], axis=1)
+    return pairs, n
+
+
+def _jax_ok() -> bool:
+    try:
+        import jax  # noqa: F401
+
+        return True
+    except Exception:  # pragma: no cover — jax is baked into the image
+        return False
+
+
+def run_join(lx, ly, rx, ry, predicate: str, distance=None, dx=None,
+             dy=None, level: Optional[int] = None,
+             prefer_device: bool = True, want_pairs: bool = True):
+    """Full co-partitioned join: plan + execute. Returns
+    ``(pairs, total, stats)``. ``predicate``: ``"bbox"`` (half-widths
+    ``dx``/``dy``) or ``"dwithin"`` (planar degree ``distance``) — see
+    :func:`geomesa_tpu.kernels.join.pair_mask` for the exact semantics."""
+    p0, p1 = kjoin.pair_params(predicate, distance=distance, dx=dx, dy=dy)
+    if predicate == kjoin.JOIN_BBOX:
+        reach_x, reach_y = float(p0), float(p1)
+    else:
+        reach_x = reach_y = float(distance)
+    with tracing.span("scan.join.partition"):
+        plan = co_partition(lx, ly, rx, ry, predicate, reach_x, reach_y,
+                            level=level, p0=p0, p1=p1)
+    st = plan.stats
+    metrics.inc(metrics.JOIN_CELLS, st.cells_joint)
+    metrics.inc(metrics.JOIN_CANDIDATE_PAIRS, st.candidate_pairs)
+    tracing.add_cost("join_cells", float(st.cells_joint))
+    tracing.add_cost("join_candidate_pairs", float(st.candidate_pairs))
+    pairs, total = execute(plan, lx, ly, rx, ry,
+                           prefer_device=prefer_device,
+                           want_pairs=want_pairs)
+    metrics.inc(metrics.JOIN_PAIRS, total)
+    return pairs, total, st

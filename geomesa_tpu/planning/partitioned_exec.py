@@ -718,6 +718,57 @@ class PartitionedExecutor:
             outs.append(g)
         return outs
 
+    def density_curve_filter_batch(self, plans: List[QueryPlan], spec,
+                                   level: int, block_windows, weight=None):
+        """M DISTINCT-filter curve crops over the partitioned store in
+        one stacked device pass per pruned partition (None = ineligible;
+        docs/SERVING.md "Query-axis batching", curve extension). Members'
+        pruned-bin UNION scans once; per-member grids tree-merge across
+        partitions exactly like :meth:`density_curve_batch`."""
+        if spec is None:
+            return None
+        agg_cols = [weight] if weight else []
+        bins = self._union_bins(plans)
+        if not self._batch_ok(plans, spec, bins, agg_cols):
+            return None
+        red = pdev.TreeReducer(
+            lambda A, B: [a + b for a, b in zip(A, B)]
+        )
+
+        def dispatch(ex):
+            r = ex.density_curve_filter_batch_raw(
+                plans, spec, level, block_windows, weight
+            )
+            if r is None:
+                # partition-local ineligibility (e.g. surviving f32 band
+                # rows in THIS partition): degrade this partition to
+                # per-member serial curves — exact, never dropped — while
+                # the other partitions keep the batched pass
+                return ("serial", [
+                    Executor.decode_curve(
+                        ex.density_curve_raw(p, level, bw, weight)
+                    )
+                    for p, bw in zip(plans, block_windows)
+                ])
+            return r
+
+        def finish(b, p, mdev):
+            if isinstance(p, tuple) and len(p) == 2 and p[0] == "serial":
+                red.push(p[1])
+            else:
+                red.push(Executor.decode_curve_filter_batch(p))
+
+        self._additive_scan(plans[0], "density_curve", dispatch, finish,
+                            bins=bins)
+        merged = red.result()
+        outs = []
+        for i, (ix0, iy0, ix1, iy1) in enumerate(block_windows):
+            g = merged[i] if merged is not None else None
+            if g is None:
+                g = np.zeros((iy1 - iy0 + 1, ix1 - ix0 + 1), np.float64)
+            outs.append(g)
+        return outs
+
     # -- query-axis batched aggregates (docs/SERVING.md "Query-axis
     # batching"): each pruned partition executes ONE stacked device pass
     # for every member viewport, and per-member partials accumulate

@@ -64,7 +64,9 @@ _UNFUSABLE_HINTS = (
 #: a successful result, so carrying it keeps a query fusable.
 _FUSABLE_KEYS = frozenset(
     ("op", "name", "schema", "ecql", "auths", "exact", "speculative_ok",
-     "bbox", "width", "height", "weight", "level", "stat")
+     "bbox", "width", "height", "weight", "level", "stat",
+     # join_count parameters (repeat fusion only — docs/JOIN.md)
+     "right", "predicate", "distance", "dx", "dy", "right_ecql")
     + _UNFUSABLE_HINTS
 )
 
@@ -151,9 +153,23 @@ def fuse_key(op: str, schema: str, opts: Dict[str, Any],
                 opts.get("weight"))
     if op == "density_curve":
         # bbox deliberately NOT in the key: different crops stack into one
-        # pass (the tile-fusion path)
-        return ("density_curve", schema, ecql, auths,
-                int(opts.get("level", 9)), opts.get("weight"))
+        # pass (the tile-fusion path). With a batchable structural
+        # template, requests differing only in viewport LITERALS also
+        # share the key (docs/SERVING.md "Query-axis batching", curve
+        # extension): the group detects distinct members at execution and
+        # rides Executor.density_curve_filter_batch, each member's
+        # literals AND crop window as kernel data.
+        skel = _structural_key(ds, schema, ecql)
+        return ("density_curve", schema,
+                ("skel",) + skel if skel is not None else ecql,
+                auths, int(opts.get("level", 9)), opts.get("weight"))
+    if op == "join_count":
+        # repeat fusion only: one co-partitioned join serves every
+        # identical concurrent request (docs/JOIN.md)
+        return ("join_count", schema, opts.get("right"),
+                opts.get("predicate"), opts.get("distance"),
+                opts.get("dx"), opts.get("dy"), ecql,
+                opts.get("right_ecql", "INCLUDE"), auths)
     if op == "stats":
         skel = _structural_key(ds, schema, ecql)
         return ("stats", schema,
@@ -263,6 +279,20 @@ def run_batch(ds, op: str, schema: str, tickets: List[Ticket]) -> List[Any]:
         q = _query_from(opts)
         if op == "count":
             result = ds.count(schema, q, exact=bool(opts.get("exact", True)))
+            hits = int(result)
+        elif op == "join_count":
+            from geomesa_tpu.api.dataset import Query as _Query
+
+            result = ds.join_count(
+                schema, opts["right"], predicate=opts["predicate"],
+                distance=opts.get("distance"), dx=opts.get("dx"),
+                dy=opts.get("dy"), left_query=q,
+                # the request's auths must filter BOTH sides' scans
+                right_query=_Query(
+                    ecql=opts.get("right_ecql", "INCLUDE"),
+                    auths=opts.get("auths"),
+                ),
+            )
             hits = int(result)
         elif op == "density":
             import numpy as np
@@ -392,16 +422,29 @@ def _own_copy(result):
 
 
 def _density_curve_batch(ds, schema: str, tickets: List[Ticket]) -> List[Any]:
-    """Tile fusion: one device pass over stacked per-member crops."""
+    """Tile fusion: one device pass over stacked per-member crops. With
+    the structural curve key (docs/SERVING.md "Query-axis batching"),
+    members whose ECQL texts DIFFER (same template, distinct viewport
+    literals) ride the distinct-filter curve megakernel instead; when
+    that batch is ineligible every member runs serially under its own
+    trace — fusion changes latency, never results."""
     primary = tickets[0]
     opts = primary.fuse.payload
     level = int(opts.get("level", 9))
     weight = opts.get("weight")
+    ecql0 = opts.get("ecql", "INCLUDE")
     members = [
         {"bbox": t.fuse.payload.get("bbox"), "trace_id": t.trace_id,
          "user": t.user}
         for t in tickets
     ]
+    distinct = any(
+        t.fuse.payload.get("ecql", "INCLUDE") != ecql0
+        for t in tickets[1:]
+    )
+    if distinct:
+        return _density_curve_distinct(ds, schema, tickets, level, weight,
+                                       members)
     with tracing.start("fused.density_curve", trace_id=primary.trace_id,
                        force=primary.trace_id is not None,
                        fused_batch=len(tickets)):
@@ -414,6 +457,48 @@ def _density_curve_batch(ds, schema: str, tickets: List[Ticket]) -> List[Any]:
             members=members,
         )
     # span failures stay per-member (see run_batch): the batch already ran
+    for i, t in enumerate(tickets[1:], start=1):
+        try:
+            _member_span(t, "density_curve", len(tickets))
+        except Exception as e:
+            out[i] = FusedMemberError(e)
+    return out
+
+
+def _density_curve_distinct(ds, schema: str, tickets: List[Ticket],
+                            level: int, weight, members) -> List[Any]:
+    """Distinct-filter curve fusion: each member's OWN viewport literals
+    and crop window in one batched device pass
+    (``GeoDataset.density_curve_filter_batch``); serial per-member
+    fallback when ineligible."""
+    primary = tickets[0]
+    queries = [_query_member(ds, t.fuse.payload) for t in tickets]
+    meta = [{"trace_id": t.trace_id, "user": t.user} for t in tickets]
+    with tracing.start("fused.density_curve.distinct",
+                       trace_id=primary.trace_id,
+                       force=primary.trace_id is not None,
+                       fused_batch=len(tickets), distinct=True,
+                       **_placement_attrs(primary)):
+        out = ds.density_curve_filter_batch(
+            schema, queries, level=level,
+            bboxes=[m["bbox"] for m in members], weight=weight,
+            members=meta,
+        )
+    if out is None:
+        out = []
+        for t, q in zip(tickets, queries):
+            try:
+                with ds.serving.member_user(t.user), \
+                        tracing.start("fused.density_curve.serial",
+                                      trace_id=t.trace_id,
+                                      force=t.trace_id is not None):
+                    out.append(ds.density_curve(
+                        schema, q, level=level,
+                        bbox=t.fuse.payload.get("bbox"), weight=weight,
+                    ))
+            except Exception as e:
+                out.append(FusedMemberError(e))
+        return out
     for i, t in enumerate(tickets[1:], start=1):
         try:
             _member_span(t, "density_curve", len(tickets))

@@ -11,7 +11,7 @@ from __future__ import annotations
 import json
 import re
 import threading
-from typing import Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
 import pyarrow as pa
@@ -319,6 +319,55 @@ class GeoFlightClient:
         out = self._action("count", body)
         self.last_count_speculative = bool(out.get("speculative", False))
         return out["count"]
+
+    def _join_body(self, left: str, right: str, predicate: str,
+                   distance, dx, dy, ecql: str, right_ecql: str,
+                   level, auths) -> Dict:
+        body: Dict[str, Any] = {
+            "left": left, "right": right, "predicate": predicate,
+            "ecql": ecql, "right_ecql": right_ecql,
+        }
+        if distance is not None:
+            body["distance"] = float(distance)
+        if dx is not None:
+            body["dx"] = float(dx)
+        if dy is not None:
+            body["dy"] = float(dy)
+        if level is not None:
+            body["level"] = int(level)
+        if auths is not None:
+            body["auths"] = list(auths)
+        return body
+
+    def join_count(self, left: str, right: str, *, predicate: str,
+                   distance=None, dx=None, dy=None,
+                   ecql: str = "INCLUDE", right_ecql: str = "INCLUDE",
+                   level: Optional[int] = None,
+                   auths: Optional[Sequence[str]] = None) -> int:
+        """Spatial-join matched-pair count (docs/JOIN.md; PROTOCOL
+        "join-count"): ``predicate`` is ``"bbox"`` (half-widths
+        ``dx``/``dy``) or ``"dwithin"`` (planar degree ``distance``).
+        ``auths`` filter BOTH sides' scans. Identical concurrent
+        requests fuse into one co-partitioned join on the server."""
+        out = self._action("join-count", self._join_body(
+            left, right, predicate, distance, dx, dy, ecql, right_ecql,
+            level, auths,
+        ))
+        return out["count"]
+
+    def join_explain(self, left: str, right: str, *, predicate: str,
+                     distance=None, dx=None, dy=None,
+                     ecql: str = "INCLUDE", right_ecql: str = "INCLUDE",
+                     level: Optional[int] = None,
+                     auths: Optional[Sequence[str]] = None,
+                     analyze: bool = False) -> str:
+        """Spatial-join plan explain: the co-partition pruning account
+        (cells, candidate pairs vs naive N*M, strip fraction)."""
+        body = self._join_body(left, right, predicate, distance, dx, dy,
+                               ecql, right_ecql, level, auths)
+        if analyze:
+            body["analyze"] = True
+        return self._action("join-explain", body)["explain"]
 
     def audit(self, n: int = 100) -> List[Dict]:
         return self._action("audit", {"n": n})["events"]

@@ -352,6 +352,10 @@ class GeoFlightServer(fl.FlightServerBase):
                 names=["stat", "value"],
             )
             return fl.RecordBatchStream(pa.Table.from_batches([batch]))
+        if op == "join_count":
+            return iter([fl.Result(
+                json.dumps({"count": int(raw)}).encode()
+            )])
         raise ValueError(f"unfusable op {op!r}")
 
     def shutdown(self, *a, **kw):
@@ -389,10 +393,44 @@ class GeoFlightServer(fl.FlightServerBase):
         fuse = None
         if op in ("density", "density_curve", "stats"):
             fuse = self._fuse_spec(op, opts)
+        # speculative degraded answers (docs/SERVING.md): the request
+        # flag or the x-geomesa-speculative-ok header opts density/stats
+        # into the typed coarse fallback when admission sheds — the same
+        # contract the count action carries
+        speculative = None
+        h = _call_headers(context)
+        if op in ("density", "stats") and opts.get("schema") and (
+                opts.get("speculative_ok") or h.speculative):
+            tid = h.trace_id
+            speculative = (
+                lambda: self._speculative_get_frame(op, opts, tid)
+            )
         return self._serve(
             context, "sidecar.do_get", lambda: self._do_get(opts),
-            op=f"get:{op}", fuse=fuse,
+            op=f"get:{op}", fuse=fuse, speculative=speculative,
         )
+
+    def _speculative_get_frame(self, op: str, opts: Dict,
+                               trace_id: Optional[str]):
+        """The speculative density/stats wire frame: the coarse
+        host-served estimate in the op's NORMAL frame (the ``speculative``
+        marker rides the audit event, exactly like speculative counts).
+        Runs under the dispatch thread via the scheduler's fallback."""
+        ds = self.dataset
+        name = opts["schema"]
+        q = _query_from(opts)
+        with tracing.start(f"{op}.speculative", trace_id=trace_id,
+                           force=trace_id is not None, speculative=True):
+            if op == "density":
+                grid = ds._speculative_density(
+                    name, q, bbox=opts.get("bbox"),
+                    width=opts.get("width", 256),
+                    height=opts.get("height", 256),
+                    weight=opts.get("weight"),
+                )
+                return self._wrap_fused("density", opts, grid)
+            stat = ds._speculative_stats(name, opts["stat"], q)
+            return self._wrap_fused("stats", opts, stat)
 
     def _do_get(self, opts: Dict) -> fl.RecordBatchStream:
         op = opts.get("op", "query")
@@ -542,6 +580,18 @@ class GeoFlightServer(fl.FlightServerBase):
         except ValueError:
             body = None
         speculative = None
+        if kind == "join-count" and body and body.get("left"):
+            # repeat fusion: identical concurrent join-count requests
+            # share one co-partitioned join (docs/JOIN.md)
+            fuse = self._fuse_spec("join_count", {
+                "schema": body["left"], "right": body.get("right"),
+                "predicate": body.get("predicate"),
+                "distance": body.get("distance"),
+                "dx": body.get("dx"), "dy": body.get("dy"),
+                "ecql": body.get("ecql", "INCLUDE"),
+                "right_ecql": body.get("right_ecql", "INCLUDE"),
+                "auths": body.get("auths"),
+            })
         if kind == "count" and body and body.get("name"):
             body = self._fold_region(body)
             fuse = self._fuse_spec(
@@ -611,6 +661,42 @@ class GeoFlightServer(fl.FlightServerBase):
             n = ds.count(body["name"], _query_from(body),
                          exact=body.get("exact", True))
             return self._wrap_fused("count", body, n)
+        if kind == "join-count":
+            # the spatial join's aggregate form (docs/JOIN.md; PROTOCOL
+            # "join-count"): exact matched-pair count, co-partitioned.
+            # Request auths apply to BOTH sides (Query objects, not raw
+            # text — visibility must filter each side's scan)
+            from geomesa_tpu.api.dataset import Query as _Q
+
+            auths = body.get("auths")
+            n = ds.join_count(
+                body["left"], body["right"],
+                predicate=body["predicate"],
+                distance=body.get("distance"),
+                dx=body.get("dx"), dy=body.get("dy"),
+                left_query=_Q(ecql=body.get("ecql", "INCLUDE"),
+                              auths=auths),
+                right_query=_Q(ecql=body.get("right_ecql", "INCLUDE"),
+                               auths=auths),
+                level=body.get("level"),
+            )
+            return self._wrap_fused("join_count", body, n)
+        if kind == "join-explain":
+            from geomesa_tpu.api.dataset import Query as _Q
+
+            auths = body.get("auths")
+            return ok({"explain": ds.explain_join(
+                body["left"], body["right"],
+                predicate=body["predicate"],
+                distance=body.get("distance"),
+                dx=body.get("dx"), dy=body.get("dy"),
+                left_query=_Q(ecql=body.get("ecql", "INCLUDE"),
+                              auths=auths),
+                right_query=_Q(ecql=body.get("right_ecql", "INCLUDE"),
+                               auths=auths),
+                level=body.get("level"),
+                analyze=bool(body.get("analyze")),
+            )})
         if kind == "audit":
             evs = ds.audit.recent(body.get("n", 100))
             return ok({"events": [json.loads(e.to_json()) for e in evs]})
@@ -673,6 +759,11 @@ class GeoFlightServer(fl.FlightServerBase):
             ("describe", "schema details: {name}"),
             ("explain", "query plan: {name, ecql}"),
             ("count", "feature count: {name, ecql, exact}"),
+            ("join-count", "spatial-join matched-pair count: {left, "
+                           "right, predicate, distance|dx+dy, ecql, "
+                           "right_ecql, level}"),
+            ("join-explain", "spatial-join plan: {left, right, predicate, "
+                             "distance|dx+dy, ecql, right_ecql, analyze}"),
             ("audit", "recent query events: {n}"),
             ("metrics", "metrics registry snapshot"),
             ("cache-stats", "aggregate cache residency + hit counters"),

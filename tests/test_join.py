@@ -1,0 +1,544 @@
+"""TPU-native spatial joins (docs/JOIN.md): SFC co-partitioned
+build/probe with a bucketed pairwise kernel.
+
+The load-bearing guarantee is BIT-IDENTITY against the naive numpy N*M
+reference (``kernels.join.brute_force_pairs``): the co-partition decides
+only WHICH pairs are tested, never how a tested pair decides — both
+sides run the identical f32 ``pair_mask`` arithmetic. Covered: both
+predicates (incl. cell-edge / inclusive-equality pairs, empty cells,
+strip-only matches), a seeded property walk across store epochs, the
+sharded 8-virtual-device path (conftest forces 8 CPU devices),
+degradation with exact survivor totals, the recompile-free repeat proof,
+and the explain/audit shapes.
+
+Satellite coverage rides along (one PR, one file): distinct-filter
+density_curve batching, speculative density/stats answers, join_count
+repeat fusion, and the content-addressed compact-descriptor share.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, config, metrics, resilience
+from geomesa_tpu.kernels import join as kjoin
+from geomesa_tpu.planning import join_exec
+
+
+def _clustered(rng, n, n_hot=12, spread=0.4, lo=-60, hi=60):
+    cx = rng.uniform(lo, hi, n_hot)
+    cy = rng.uniform(lo / 2, hi / 2, n_hot)
+    k = rng.integers(0, n_hot, n)
+    return (np.clip(cx[k] + rng.normal(0, spread, n), -179, 179),
+            np.clip(cy[k] + rng.normal(0, spread, n), -89, 89))
+
+
+def _mkds(seed=7, na=1500, nb=1200):
+    ds = GeoDataset()
+    ds.create_schema("a", "name:String,*geom:Point")
+    ds.create_schema("b", "tag:String,*geom:Point")
+    rng = np.random.default_rng(seed)
+    ax, ay = _clustered(rng, na)
+    bx, by = _clustered(rng, nb)
+    ds.insert("a", {"name": [f"n{i % 5}" for i in range(na)],
+                    "geom": list(zip(ax, ay))})
+    ds.insert("b", {"tag": [f"t{i % 3}" for i in range(nb)],
+                    "geom": list(zip(bx, by))})
+    ds.flush()
+    return ds
+
+
+def _ref(ds, predicate, left="a", right="b", lq="INCLUDE", rq="INCLUDE",
+         **kw):
+    p0, p1 = kjoin.pair_params(predicate, **kw)
+    lfc, rfc = ds.query(left, lq), ds.query(right, rq)
+    return kjoin.brute_force_pairs(
+        lfc.batch.columns["geom__x"], lfc.batch.columns["geom__y"],
+        rfc.batch.columns["geom__x"], rfc.batch.columns["geom__y"],
+        predicate, p0, p1,
+    )
+
+
+# ---------------------------------------------------------------------------
+# bit-identity vs brute force
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("predicate,kw", [
+    ("dwithin", {"distance": 0.35}),
+    ("bbox", {"dx": 0.25, "dy": 0.15}),
+])
+def test_join_bit_identical_vs_brute_force(predicate, kw):
+    ds = _mkds()
+    res = ds.join("a", "b", predicate=predicate, **kw)
+    ref = _ref(ds, predicate, **kw)
+    assert res.count == len(ref)
+    assert np.array_equal(res.pairs, ref)
+    assert ds.join_count("a", "b", predicate=predicate, **kw) == len(ref)
+    # the grid filter actually pruned on the clustered layout
+    assert res.stats.candidate_fraction < 0.2
+    assert res.stats.cells_joint > 0
+
+
+def test_join_device_matches_host_path():
+    ds_dev = _mkds(seed=21)
+    ds_host = _mkds(seed=21)
+    ds_host.prefer_device = False
+    for predicate, kw in (("dwithin", {"distance": 0.3}),
+                          ("bbox", {"dx": 0.2, "dy": 0.2})):
+        a = ds_dev.join("a", "b", predicate=predicate, **kw)
+        b = ds_host.join("a", "b", predicate=predicate, **kw)
+        assert a.count == b.count
+        assert np.array_equal(a.pairs, b.pairs)
+
+
+def test_join_cell_edge_and_inclusive_equality_pairs():
+    """Pairs straddling SFC cell edges (strip-only matches) and pairs at
+    EXACTLY the predicate distance (inclusive <=) must decide like the
+    reference."""
+    ds = GeoDataset()
+    ds.create_schema("a", "*geom:Point")
+    ds.create_schema("b", "*geom:Point")
+    # level-whatever cell edges sit at dyadic lon/lat values: 11.25 is an
+    # edge at level 5 (360/32), 0.0 at every level. d = 0.25 is exact in
+    # f32, so dist == d pairs exercise the inclusive boundary.
+    d = 0.25
+    left = [(11.25 - 0.01, 5.0), (0.0, 0.0), (-45.0, -22.5), (170.0, 80.0)]
+    right = [(11.25 + 0.01, 5.0),           # strip-only: cells differ
+             (d, 0.0),                      # exactly d away (inclusive)
+             (-45.0 + d, -22.5),            # exactly d, across an edge
+             (10.0, 10.0)]                  # matches nothing
+    ds.insert("a", {"geom": left})
+    ds.insert("b", {"geom": right})
+    res = ds.join("a", "b", predicate="dwithin", distance=d)
+    ref = _ref(ds, "dwithin", distance=d)
+    assert np.array_equal(res.pairs, ref)
+    assert res.count == len(ref) >= 3
+    # the strip actually carried a match: the (0) pair's cells differ
+    assert res.stats.strip_entries > 0
+
+
+def test_join_empty_cells_and_disjoint_sides():
+    ds = GeoDataset()
+    ds.create_schema("a", "*geom:Point")
+    ds.create_schema("b", "*geom:Point")
+    rng = np.random.default_rng(3)
+    ds.insert("a", {"geom": list(zip(rng.uniform(-60, -40, 300),
+                                     rng.uniform(-30, -10, 300)))})
+    ds.insert("b", {"geom": list(zip(rng.uniform(40, 60, 300),
+                                     rng.uniform(10, 30, 300)))})
+    res = ds.join("a", "b", predicate="dwithin", distance=0.5)
+    assert res.count == 0 and len(res.pairs) == 0
+    assert res.stats.cells_joint == 0
+    assert res.stats.candidate_pairs == 0
+
+
+def test_join_filtered_sides_and_streaming_batches():
+    ds = _mkds(seed=9)
+    lq = "BBOX(geom, -60, -30, 20, 30)"
+    res = ds.join_spatial("a", "b", predicate="bbox", dx=0.3, dy=0.3,
+                          left_query=lq)
+    ref = _ref(ds, "bbox", lq=lq, dx=0.3, dy=0.3)
+    assert res.count == len(ref)
+    assert np.array_equal(res.pairs, ref)
+    # streaming: chunks tile the pair set in order, right cols prefixed
+    rows = 0
+    for b in res.batches(batch_rows=97):
+        assert b.n <= 97
+        assert "right.geom__x" in b.columns and "geom__x" in b.columns
+        rows += b.n
+    assert rows == res.count
+
+
+def test_join_rejects_non_point_and_missing_params():
+    ds = GeoDataset()
+    ds.create_schema("pt", "*geom:Point")
+    ds.create_schema("ln", "*geom:LineString")
+    with pytest.raises(ValueError, match="POINT"):
+        ds.join("pt", "ln", predicate="dwithin", distance=1.0)
+    with pytest.raises(ValueError):
+        ds.join("pt", "pt", predicate="dwithin")  # no distance
+    with pytest.raises(ValueError):
+        ds.join("pt", "pt", predicate="nope", distance=1.0)
+    with pytest.raises(ValueError):
+        ds.join("pt", "pt")  # neither attrs nor predicate
+
+
+# ---------------------------------------------------------------------------
+# seeded property walk across epochs + recompile-free repeats
+# ---------------------------------------------------------------------------
+
+
+def test_join_property_walk_across_epochs_recompile_free():
+    """Mutate the store across epochs (appends of the same batch size);
+    every epoch's join must match brute force AND pay zero fresh traces
+    after the first epoch warmed the shape buckets."""
+    ds = _mkds(seed=31, na=900, nb=800)
+    rng = np.random.default_rng(77)
+    reg = join_exec.join_registry()
+    ds.join_count("a", "b", predicate="dwithin", distance=0.3)  # warm
+    warm = sum(reg.traces().values())
+    for epoch in range(3):
+        nx, ny = _clustered(rng, 100)
+        ds.insert("a", {"name": ["m"] * 100, "geom": list(zip(nx, ny))})
+        nx, ny = _clustered(rng, 100)
+        ds.insert("b", {"tag": ["m"] * 100, "geom": list(zip(nx, ny))})
+        ds.flush()
+        for predicate, kw in (("dwithin", {"distance": 0.3}),
+                              ("bbox", {"dx": 0.2, "dy": 0.25})):
+            res = ds.join("a", "b", predicate=predicate, **kw)
+            ref = _ref(ds, predicate, **kw)
+            assert res.count == len(ref), (epoch, predicate)
+            assert np.array_equal(res.pairs, ref), (epoch, predicate)
+    # pow2/ladder bucketing: fresh data of similar size re-lands on the
+    # warmed kernel shapes (the CI-gated recompiles==0 contract). The
+    # bbox predicate pays its own first-trace on epoch 0.
+    ds.join_count("a", "b", predicate="dwithin", distance=0.3)
+    ds.join_count("a", "b", predicate="bbox", dx=0.2, dy=0.25)
+    grew = sum(reg.traces().values()) - warm
+    assert grew <= 2, f"{grew} fresh traces beyond the per-predicate warmup"
+
+
+def test_join_repeat_zero_recompiles_mutated_values():
+    """Same sizes, fresh coordinate values: strictly zero recompiles."""
+    ds = _mkds(seed=41, na=600, nb=500)
+    reg = join_exec.join_registry()
+    ds.join_count("a", "b", predicate="dwithin", distance=0.3)
+    before = sum(reg.traces().values())
+    for s in range(3):
+        ds2 = _mkds(seed=100 + s, na=600, nb=500)
+        ref = _ref(ds2, "dwithin", distance=0.3)
+        assert ds2.join_count("a", "b", predicate="dwithin",
+                              distance=0.3) == len(ref)
+    assert sum(reg.traces().values()) == before, "warm join recompiled"
+
+
+# ---------------------------------------------------------------------------
+# sharded 8-virtual-device bit-identity + degradation
+# ---------------------------------------------------------------------------
+
+
+def test_join_sharded_8dev_bit_identical():
+    """conftest forces 8 CPU devices: the tile fan-out engages and the
+    result must match both the single-device and brute-force answers."""
+    import jax
+
+    ds = _mkds(seed=51, na=2000, nb=1800)
+    res = ds.join("a", "b", predicate="dwithin", distance=0.3)
+    ref = _ref(ds, "dwithin", distance=0.3)
+    assert np.array_equal(res.pairs, ref)
+    if len(jax.devices()) >= 2:
+        assert res.stats.devices >= 2  # the fan-out actually engaged
+    # forced single-device: identical
+    with config.MESH_DEVICES.scoped("1"):
+        res1 = ds.join("a", "b", predicate="dwithin", distance=0.3)
+    assert res1.stats.devices == 1
+    assert np.array_equal(res1.pairs, res.pairs)
+
+
+def test_join_degradation_exact_survivor_totals(monkeypatch):
+    ds = _mkds(seed=61, na=1500, nb=1300)
+    ref = _ref(ds, "dwithin", distance=0.3)
+    real = join_exec._run_slice
+    fail_first = {"armed": True}
+
+    def flaky(plan, lo, hi, *a, **kw):
+        if fail_first["armed"] and lo == 0:
+            fail_first["armed"] = False
+            raise RuntimeError("injected device fault")
+        return real(plan, lo, hi, *a, **kw)
+
+    monkeypatch.setattr(join_exec, "_run_slice", flaky)
+    # strict mode: the failure surfaces
+    with pytest.raises(RuntimeError, match="injected"):
+        ds.join("a", "b", predicate="dwithin", distance=0.3)
+    # degraded mode: skipped tile range recorded, survivors exact
+    fail_first["armed"] = True
+    with resilience.allow_partial() as partial:
+        res = ds.join("a", "b", predicate="dwithin", distance=0.3)
+    assert res.degraded and res.stats.skipped
+    assert partial.skipped and partial.skipped[0].source == "join"
+    assert res.count == len(res.pairs) <= len(ref)
+    ref_set = {tuple(p) for p in ref}
+    assert all(tuple(p) in ref_set for p in res.pairs)
+    # audit carries the degradation account
+    ev = [e for e in ds.audit.recent(10) if e.hints.get("op") == "join"][-1]
+    assert ev.hints.get("degraded")
+
+
+# ---------------------------------------------------------------------------
+# explain / audit / serving shapes
+# ---------------------------------------------------------------------------
+
+
+def test_join_explain_and_audit_shape():
+    ds = _mkds(seed=71)
+    n = ds.join_count("a", "b", predicate="dwithin", distance=0.3)
+    ev = [e for e in ds.audit.recent(10) if e.hints.get("op") == "join"][-1]
+    assert ev.hints["predicate"] == "dwithin"
+    assert ev.hints["right"] == "b"
+    assert ev.hints["candidate_pairs"] > 0
+    assert ev.hints["naive_pairs"] == 1500 * 1200
+    assert 0.0 <= ev.hints["strip_fraction"] <= 1.0
+    assert ev.hits == n
+    exp = ds.explain_join("a", "b", predicate="dwithin", distance=0.3,
+                          analyze=True)
+    for marker in ("Join", "candidate pairs", "boundary-strip fraction",
+                   "co-partition level", "matched (analyze)"):
+        assert marker in exp, exp
+
+
+def test_join_admission_shed_and_metrics():
+    ds = _mkds(seed=81, na=300, nb=300)
+    with resilience.deadline_scope(0.0):
+        with pytest.raises(resilience.DeadlineShedError):
+            ds.join_count("a", "b", predicate="dwithin", distance=0.3)
+    c0 = metrics.registry().counter(metrics.JOIN_QUERIES).value
+    ds.join_count("a", "b", predicate="dwithin", distance=0.3)
+    assert metrics.registry().counter(metrics.JOIN_QUERIES).value == c0 + 1
+
+
+def test_join_count_repeat_fusion_key():
+    from geomesa_tpu.serving import fuse as fusemod
+
+    opts = {"right": "b", "predicate": "dwithin", "distance": 0.3,
+            "ecql": "INCLUDE", "right_ecql": "INCLUDE"}
+    k1 = fusemod.fuse_key("join_count", "a", dict(opts))
+    k2 = fusemod.fuse_key("join_count", "a", dict(opts))
+    assert k1 is not None and k1 == k2
+    k3 = fusemod.fuse_key("join_count", "a", {**opts, "distance": 0.4})
+    assert k3 != k1
+    k4 = fusemod.fuse_key("join_count", "a", {**opts, "right": "c"})
+    assert k4 != k1
+
+
+def test_join_sidecar_round_trip():
+    from geomesa_tpu.sidecar.client import GeoFlightClient
+    from geomesa_tpu.sidecar.service import GeoFlightServer
+
+    ds = _mkds(seed=91, na=400, nb=350)
+    srv = GeoFlightServer(ds, "grpc+tcp://127.0.0.1:0")
+    try:
+        cl = GeoFlightClient(f"grpc+tcp://127.0.0.1:{srv.port}")
+        local = ds.join_count("a", "b", predicate="bbox", dx=0.2, dy=0.2)
+        assert cl.join_count("a", "b", predicate="bbox",
+                             dx=0.2, dy=0.2) == local
+        exp = cl.join_explain("a", "b", predicate="bbox", dx=0.2, dy=0.2)
+        assert "candidate pairs" in exp
+        cl.close()
+    finally:
+        srv.shutdown()
+
+
+def test_join_sidecar_auths_filter_both_sides():
+    """Request auths must filter BOTH join sides' scans — a restricted
+    caller can never count pairs its auths cannot see."""
+    from geomesa_tpu.sidecar.client import GeoFlightClient
+    from geomesa_tpu.sidecar.service import GeoFlightServer
+
+    ds = GeoDataset()
+    ds.create_schema("a", "*geom:Point")
+    ds.create_schema("b", "*geom:Point")
+    # two coincident points per side: one open, one secret
+    ds.insert("a", {"geom": [(0.0, 0.0), (0.01, 0.0)]},
+              visibilities=["", "secret"])
+    ds.insert("b", {"geom": [(0.0, 0.01), (0.01, 0.01)]},
+              visibilities=["", "secret"])
+    srv = GeoFlightServer(ds, "grpc+tcp://127.0.0.1:0")
+    try:
+        cl = GeoFlightClient(f"grpc+tcp://127.0.0.1:{srv.port}")
+        full = cl.join_count("a", "b", predicate="dwithin", distance=0.5,
+                             auths=["secret"])
+        restricted = cl.join_count("a", "b", predicate="dwithin",
+                                   distance=0.5, auths=[])
+        assert full == 4 and restricted == 1, (full, restricted)
+        cl.close()
+    finally:
+        srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# satellite: distinct-filter density_curve batching
+# ---------------------------------------------------------------------------
+
+
+def _curve_ds(seed=5, n=8000):
+    ds = GeoDataset()
+    ds.create_schema("p", "w:Double,*geom:Point")
+    rng = np.random.default_rng(seed)
+    ds.insert("p", {"w": rng.uniform(0, 1, n),
+                    "geom": list(zip(rng.uniform(-60, 60, n),
+                                     rng.uniform(-30, 30, n)))})
+    ds.flush()
+    return ds
+
+
+def test_density_curve_filter_batch_bit_identical():
+    ds = _curve_ds()
+    queries = [f"BBOX(geom, {x0}, -20, {x0 + 30}, 20)"
+               for x0 in (-50, -30, -10, 10, 25)]
+    bboxes = [(x0, -20, x0 + 30, 20) for x0 in (-50, -30, -10, 10, 25)]
+    out = ds.density_curve_filter_batch("p", queries, level=6,
+                                        bboxes=bboxes)
+    assert out is not None
+    for (g, snap), q, bb in zip(out, queries, bboxes):
+        gs, ss = ds.density_curve("p", q, level=6, bbox=bb)
+        assert ss == snap
+        assert np.array_equal(g, gs)
+
+
+def test_density_curve_filter_batch_weighted_and_fuse_key():
+    ds = _curve_ds(seed=6)
+    queries = ["BBOX(geom, -50, -20, -20, 20)", "BBOX(geom, -30, -20, 0, 20)"]
+    bboxes = [(-50, -20, -20, 20), (-30, -20, 0, 20)]
+    out = ds.density_curve_filter_batch("p", queries, level=6,
+                                        bboxes=bboxes, weight="w")
+    assert out is not None
+    for (g, _), q, bb in zip(out, queries, bboxes):
+        gs, _ = ds.density_curve("p", q, level=6, bbox=bb, weight="w")
+        assert np.array_equal(g, gs)
+    # structural fuse key: distinct bbox literals share one curve key
+    from geomesa_tpu.serving import fuse as fusemod
+
+    with config.SERVING_FUSION_DISTINCT.scoped("true"):
+        k1 = fusemod.fuse_key("density_curve", "p",
+                              {"ecql": queries[0], "level": 6}, ds=ds)
+        k2 = fusemod.fuse_key("density_curve", "p",
+                              {"ecql": queries[1], "level": 6}, ds=ds)
+    assert k1 is not None and k1 == k2
+    assert k1[2][0] == "skel"
+
+
+def test_density_curve_distinct_fusion_through_scheduler():
+    """Distinct-filter curve requests queued together fuse through the
+    structural key and de-interleave bit-identically to serial runs."""
+    import threading
+
+    from geomesa_tpu.serving import fuse as fusemod
+
+    ds = _curve_ds(seed=7)
+    queries = [f"BBOX(geom, {x0}, -20, {x0 + 30}, 20)"
+               for x0 in (-50, -30, -10)]
+    bboxes = [(x0, -20, x0 + 30, 20) for x0 in (-50, -30, -10)]
+    serial = [ds.density_curve("p", q, level=6, bbox=bb)
+              for q, bb in zip(queries, bboxes)]
+    with config.SERVING_FUSION_DISTINCT.scoped("true"):
+        sched = ds.serving.start()
+        gate = threading.Event()
+        started = threading.Event()
+
+        def stall():
+            started.set()
+            return gate.wait(30)
+
+        stall_fut = sched.submit(stall, user="stall", op="stall")
+        assert started.wait(10)
+        try:
+            futs = [
+                sched.submit(
+                    (lambda q=q, bb=bb:
+                     ds.density_curve("p", q, level=6, bbox=bb)),
+                    user=f"u{i}", op="density_curve",
+                    fuse=fusemod.make_spec(
+                        ds, "density_curve", "p",
+                        {"ecql": q, "level": 6, "bbox": bb},
+                    ),
+                )
+                for i, (q, bb) in enumerate(zip(queries, bboxes))
+            ]
+            gate.set()
+            got = [f.result(timeout=60) for f in futs]
+        finally:
+            gate.set()
+            sched.stop()
+    for (g, snap), (gs, ss) in zip(got, serial):
+        assert snap == ss
+        assert np.array_equal(g, gs)
+
+
+def test_density_curve_filter_batch_fallback_none_for_mixed_templates():
+    ds = _curve_ds(seed=8)
+    out = ds.density_curve_filter_batch(
+        "p", ["BBOX(geom, -50, -20, -20, 20)", "w > 0.5"], level=6,
+        bboxes=[(-50, -20, -20, 20), None],
+    )
+    assert out is None  # caller degrades to per-member serial
+
+
+# ---------------------------------------------------------------------------
+# satellite: speculative density / stats
+# ---------------------------------------------------------------------------
+
+
+def test_speculative_density_inline():
+    ds = _curve_ds(seed=10)
+    q = "BBOX(geom, -30, -15, 30, 15)"
+    with resilience.deadline_scope(0.0):
+        with pytest.raises(resilience.DeadlineShedError):
+            ds.density("p", q, bbox=(-30, -15, 30, 15))
+    spec = metrics.registry().counter(metrics.SERVING_SPECULATIVE)
+    s0 = spec.value
+    with resilience.deadline_scope(0.0):
+        g = ds.density("p", q, bbox=(-30, -15, 30, 15), width=64,
+                       height=32, speculative_ok=True)
+    assert g.shape == (32, 64) and float(g.sum()) > 0
+    assert spec.value == s0 + 1
+    ev = [e for e in ds.audit.recent(10) if e.hints.get("speculative")][-1]
+    assert ev.hints["op"] == "density" and ev.hints["shed"] is True
+    # healthy deadline: the exact grid still serves
+    with resilience.deadline_scope(30.0):
+        exact = ds.density("p", q, bbox=(-30, -15, 30, 15), width=64,
+                           height=32, speculative_ok=True)
+    assert float(exact.sum()) == ds.count("p", q)
+
+
+def test_speculative_stats_inline():
+    ds = GeoDataset()
+    ds.create_schema("s", "v:Double:index=true,*geom:Point")
+    rng = np.random.default_rng(12)
+    n = 1000
+    ds.insert("s", {"v": rng.uniform(5, 9, n),
+                    "geom": list(zip(rng.uniform(-10, 10, n),
+                                     rng.uniform(-10, 10, n)))})
+    ds.flush()
+    with resilience.deadline_scope(0.0):
+        with pytest.raises(resilience.DeadlineShedError):
+            ds.stats("s", "MinMax(v);Count()")
+        out = ds.stats("s", "MinMax(v);Count()", speculative_ok=True)
+    mm, cnt = out.stats
+    assert cnt.count == n  # unfiltered count: exact from the store
+    assert mm.value()["min"] is not None  # persisted write-time sketch
+    ev = [e for e in ds.audit.recent(10) if e.hints.get("speculative")][-1]
+    assert ev.hints["op"] == "stats" and ev.hints["served_leaves"] == 2
+
+
+# ---------------------------------------------------------------------------
+# satellite: content-addressed compact-descriptor share
+# ---------------------------------------------------------------------------
+
+
+def test_compact_descriptor_share_across_query_texts():
+    """Two query TEXTS (distinct plans / window tokens) resolving the
+    SAME scan windows share one built descriptor instead of each paying
+    the argsort/repeat rebuild (docs/PERF.md "Shared descriptors");
+    results stay identical."""
+    ds = GeoDataset()
+    ds.create_schema("c", "w:Double,*geom:Point")
+    rng = np.random.default_rng(15)
+    n = 60_000
+    ds.insert("c", {"w": rng.uniform(0, 1, n),
+                    "geom": list(zip(rng.uniform(-60, 60, n),
+                                     rng.uniform(-30, 30, n)))})
+    ds.flush()
+    q1 = "BBOX(geom, -10, -5, 10, 5)"
+    # different text + residual => different plan/window token, but the
+    # KEY plan (the bbox) resolves the identical windows
+    q2 = f"{q1} AND w >= 0"
+    ctr = metrics.registry().counter(metrics.COMPACT_DESC_SHARED)
+    with config.CACHE_ENABLED.scoped("false"), \
+            config.COMPACT_MIN_ROWS.scoped("1"):
+        n1 = ds.count("c", q1)
+        before = ctr.value
+        n2 = ds.count("c", q2)
+        after = ctr.value
+    assert n1 == n2
+    assert after > before, "descriptor rebuilt instead of shared"
