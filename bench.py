@@ -1028,6 +1028,164 @@ def main():
             f"recompiles={join_recompiles}\n"
         )
 
+    # Columnar geo-lake tier (docs/LAKE.md): lake-vs-npz scan
+    # bit-identity (hard-asserted before the keys print), the selective
+    # cold-scan pushdown fraction (CI gates < 0.3), the lake-backed warm
+    # path's recompile count (CI gates 0), and the cache
+    # persist/restore round trip (restore must answer a warm zoom-out
+    # with ZERO device dispatches).
+    lake_keys = {}
+    if os.environ.get("GEOMESA_BENCH_LAKE", "1") != "0":
+        import shutil as _shutil
+        import tempfile as _tempfile
+
+        from geomesa_tpu import config as _cfg
+        from geomesa_tpu import metrics as _metrics
+        from geomesa_tpu.lake.snapshot import PartitionSnapshot as _PSnap
+
+        _lspec = ("name:String,weight:Double,dtg:Date,*geom:Point"
+                  ";geomesa.partition='time'")
+        _ln = 30_000 if smoke else 150_000
+        _lrng = np.random.default_rng(29)
+        _lcx = _lrng.uniform(-115, -75, 10)
+        _lcy = _lrng.uniform(28, 47, 10)
+        _lk = _lrng.integers(0, 10, _ln)
+        _lo = np.datetime64("2020-01-01", "ms").astype(np.int64)
+        _ldata = {
+            "name": [f"a{i % 20}" for i in range(_ln)],
+            "weight": _lrng.uniform(0, 10, _ln),
+            "dtg": (_lo + _lrng.integers(0, 31 * 86_400_000, _ln)
+                    ).astype("datetime64[ms]"),
+            "geom__x": np.clip(
+                _lcx[_lk] + _lrng.normal(0, 0.25, _ln), -120, -70),
+            "geom__y": np.clip(
+                _lcy[_lk] + _lrng.normal(0, 0.25, _ln), 25, 50),
+        }
+        _lake_dir = _tempfile.mkdtemp(prefix="gm-lake-bench-")
+
+        def _lds_make(lake_on):
+            with _cfg.LAKE_ENABLED.scoped("true" if lake_on else "false"), \
+                    _cfg.LAKE_ROWGROUP_ROWS.scoped("512"):
+                lds = GeoDataset(n_shards=4)
+                lds.create_schema("lt", _lspec)
+                lst = lds._store("lt")
+                lst._spill_dir = os.path.join(
+                    _lake_dir, "lake" if lake_on else "npz")
+                lds.insert("lt", _ldata,
+                           fids=np.arange(_ln).astype(str))
+                lds.flush()
+                lst.spill_all()
+            return lds, lst
+
+        _lds, _lst = _lds_make(True)
+        _nds, _nst = _lds_make(False)
+        _hx = float(_ldata["geom__x"][0])
+        _hy = float(_ldata["geom__y"][0])
+        _lsel = (f"BBOX(geom, {_hx - 0.4}, {_hy - 0.4}, "
+                 f"{_hx + 0.4}, {_hy + 0.4})")
+        _lbt = (f"BBOX(geom, {_hx - 2}, {_hy - 2}, {_hx + 2}, {_hy + 2})"
+                " AND dtg DURING "
+                "2020-01-05T00:00:00Z/2020-01-20T00:00:00Z")
+        with _cfg.LAKE_ENABLED.scoped("true"):
+            # bit-identity: every additive op, npz vs lake (hard assert)
+            for _q in (_lsel, _lbt, "INCLUDE"):
+                assert _lds.count("lt", _q) == _nds.count("lt", _q), \
+                    f"lake != npz count for {_q!r}"
+            _lbox = (-120, 25, -70, 50)
+            assert np.array_equal(
+                _lds.density("lt", _lbt, _lbox, 64, 32),
+                _nds.density("lt", _lbt, _lbox, 64, 32),
+            ), "lake != npz density"
+            _lcv = _lds.density_curve("lt", _lbt, level=6)
+            _ncv = _nds.density_curve("lt", _lbt, level=6)
+            assert np.array_equal(_lcv[0], _ncv[0]), "lake != npz curve"
+            assert (_lds.stats("lt", "MinMax(weight)", _lbt).to_json()
+                    == _nds.stats("lt", "MinMax(weight)", _lbt).to_json()
+                    ), "lake != npz stats"
+
+            # selective cold scan: pushdown fraction + latency (total
+            # AFTER spill_all — the identity queries above re-admitted
+            # partitions to residency, emptying the spilled map)
+            _lst.spill_all()
+            _ltotal = sum(_PSnap(d).payload_bytes(None)
+                          for d in _lst.spilled.values()) or 1
+            _skip0 = _metrics.registry().counter(
+                "lake.bytes.skipped").value
+            t0 = time.perf_counter()
+            _lds.count("lt", _lsel)
+            lake_cold_selective_s = time.perf_counter() - t0
+            _lskip = _metrics.registry().counter(
+                "lake.bytes.skipped").value - _skip0
+            lake_fraction = 1.0 - _lskip / _ltotal
+
+            # lake-backed warm path: re-loading spilled lake partitions
+            # and re-running the same query must compile NOTHING new
+            _lst.spill_all()
+            _rc0 = _metrics.registry().counter("kernel.recompiles").value
+            _lds.count("lt", _lsel)
+            lake_recompiles = int(
+                _metrics.registry().counter("kernel.recompiles").value
+                - _rc0)
+
+        # cache persistence: warm zoom-out -> persist -> fresh process
+        # (load) -> restore -> the warm zoom answers with ZERO dispatches
+        with _cfg.CACHE_ENABLED.scoped("true"), \
+                _cfg.CACHE_CELLS_PER_AXIS.scoped("4"):
+            _cds = GeoDataset(n_shards=2)
+            _cds.create_schema("ct", "weight:Double,dtg:Date,*geom:Point")
+            _cn = 6_000
+            _cds.insert("ct", {
+                "weight": _lrng.uniform(0, 2, _cn),
+                "dtg": np.full(_cn, _lo).astype("datetime64[ms]"),
+                "geom__x": _lrng.uniform(-170, 170, _cn),
+                "geom__y": _lrng.uniform(-80, 80, _cn),
+            }, fids=np.arange(_cn).astype(str))
+            _cds.flush()
+            for _q in ("BBOX(geom, -90, -45, 0, 0)",
+                       "BBOX(geom, 0, -45, 90, 0)",
+                       "BBOX(geom, -90, 0, 0, 45)",
+                       "BBOX(geom, 0, 0, 90, 45)"):
+                _cds.count("ct", _q)
+            _zoom = "BBOX(geom, -90, -45, 90, 45)"
+            _zref = _cds.count("ct", _zoom)
+            _ckpt = os.path.join(_lake_dir, "ckpt")
+            _cpath = os.path.join(_lake_dir, "cache.lake")
+            _cds.save(_ckpt)
+            t0 = time.perf_counter()
+            _cds.persist_cache(_cpath)
+            _cds2 = GeoDataset.load(_ckpt)
+            _rsum = _cds2.restore_cache(_cpath)
+            cache_persist_restore_s = time.perf_counter() - t0
+            assert _rsum["ct"].get("restored", 0) > 0, \
+                "cache restore admitted nothing"
+            _d0 = _metrics.registry().counter(
+                "exec.device.dispatch").value
+            assert _cds2.count("ct", _zoom) == _zref, \
+                "restored zoom-out != warm answer"
+            cache_restore_dispatches = int(
+                _metrics.registry().counter(
+                    "exec.device.dispatch").value - _d0)
+            assert cache_restore_dispatches == 0, \
+                "restored warm zoom-out dispatched to the device"
+
+        _shutil.rmtree(_lake_dir, ignore_errors=True)
+        lake_keys = {
+            "lake_cold_selective_ms": round(
+                lake_cold_selective_s * 1e3, 2),
+            "lake_bytes_loaded_fraction": round(lake_fraction, 4),
+            "lake_bit_identical": True,
+            "lake_warm_recompiles": lake_recompiles,
+            "cache_persist_restore_ms": round(
+                cache_persist_restore_s * 1e3, 2),
+            "cache_restore_dispatches": cache_restore_dispatches,
+        }
+        sys.stderr.write(
+            f"lake: selective_cold={lake_cold_selective_s*1e3:.1f}ms "
+            f"bytes_loaded_fraction={lake_fraction:.4f} "
+            f"warm_recompiles={lake_recompiles} "
+            f"persist_restore={cache_persist_restore_s*1e3:.1f}ms\n"
+        )
+
     # Observability snapshot (docs/OBSERVABILITY.md): the perf trajectory
     # carries the registry's warm-path/cache/pipeline counters and the
     # query-stage latency distribution, so a regression in ANY of them is
@@ -1125,6 +1283,7 @@ def main():
         **sharded_keys,
         **cache_keys,
         **join_keys,
+        **lake_keys,
         **annotations,
     }))
 

@@ -236,6 +236,7 @@ class GeoDataset:
         from geomesa_tpu.serving import QueryScheduler
 
         self.serving = QueryScheduler()
+        self.serving.set_residency_probe(self._residency_bytes)
         self._stores: Dict[str, FeatureStore] = {}
         self._executors: Dict[str, Executor] = {}
         self.metadata: Dict[str, Dict[str, str]] = {}
@@ -564,6 +565,11 @@ class GeoDataset:
             extras["device_coarse_ms"] = round(
                 plan.__dict__["device_coarse_ms"], 3
             )
+        acct = plan.__dict__.pop("lake_acct", None)
+        if acct:
+            # pruned-vs-loaded row groups and bytes for THIS execution
+            # (docs/LAKE.md; popped like degraded — cached plans re-run)
+            extras["lake"] = dict(acct)
         degraded = plan.__dict__.pop("degraded", None)
         if degraded:
             extras["degraded"] = [
@@ -810,6 +816,40 @@ class GeoDataset:
         for k in [k for k in self._executors
                   if k == name or (isinstance(k, tuple) and k[0] == name)]:
             del self._executors[k]
+
+    def _residency_bytes(self, schema: str, slot: int) -> int:
+        """One schema's device-resident column bytes on serving slot
+        ``slot``'s device RIGHT NOW — the scheduler's placement-ranking
+        probe (docs/SERVING.md §5c: rank candidate slots by ACTUAL
+        residency, not by who dispatched last). A cheap metadata walk
+        over the stores' device-column caches (no jit, no locks, no
+        device sync — it runs under the scheduler lock). Meshed datasets
+        shard every column across all devices, so residency is uniform
+        and the probe abstains."""
+        if self.mesh is not None:
+            return 0
+        st = self._stores.get(schema)
+        if st is None:
+            return 0
+        try:
+            from geomesa_tpu.parallel.devices import slot_device
+
+            dev = slot_device(slot)
+        except Exception:
+            return 0
+        total = 0
+        children = (list(st.partitions.values())
+                    if hasattr(st, "partitions") else [st])
+        for child in children:
+            for t in getattr(child, "tables", {}).values():
+                for cached in list(t._device_cache.values()):
+                    for arr in list(cached.values()):
+                        try:
+                            if dev in arr.devices():
+                                total += int(arr.nbytes)
+                        except Exception:
+                            continue  # a mid-walk eviction never fails
+        return total
 
     # -- reads -------------------------------------------------------------
     @staticmethod
@@ -1889,9 +1929,10 @@ class GeoDataset:
         attribute equi-join (JoinProcess analog, unchanged). With
         ``predicate``: the TPU-native SPATIAL join between two
         point-schema datasets (docs/JOIN.md) — ``"bbox"`` (envelopes of
-        half-widths ``dx``/``dy`` intersect) or ``"dwithin"`` (planar
-        degree ``distance``) — SFC-cell co-partitioned so candidate work
-        is O(pairs-in-same-cell), returning a streaming
+        half-widths ``dx``/``dy`` intersect), ``"dwithin"`` (planar
+        degree ``distance``), or ``"dwithin_meters"`` (haversine
+        great-circle ``distance`` meters) — SFC-cell co-partitioned so
+        candidate work is O(pairs-in-same-cell), returning a streaming
         :class:`SpatialJoinResult`."""
         if predicate is None:
             if left_attr is None or right_attr is None:
@@ -2036,13 +2077,19 @@ class GeoDataset:
             rx, ry = self._side_xy(rst, rbatch)
             p0, p1 = kjoin.pair_params(predicate, distance=distance,
                                        dx=dx, dy=dy)
+            wrap_x = False
             if predicate == kjoin.JOIN_BBOX:
                 reach_x, reach_y = float(p0), float(p1)
+            elif predicate == kjoin.JOIN_DWITHIN_METERS:
+                reach_x, reach_y = join_exec.meters_reach_deg(
+                    float(distance), ry
+                )
+                wrap_x = True
             else:
                 reach_x = reach_y = float(distance)
             plan = join_exec.co_partition(
                 lx, ly, rx, ry, predicate, reach_x, reach_y, level=level,
-                p0=p0, p1=p1,
+                p0=p0, p1=p1, wrap_x=wrap_x,
             )
             st = plan.stats
             exp.push("Join")
@@ -2060,8 +2107,8 @@ class GeoDataset:
             exp.kv("tiles", f"{st.tiles} ({plan.Bp} x {plan.Pp} padded)")
             if analyze:
                 t0 = time.perf_counter()
-                _, total = join_exec.execute(
-                    plan, lx, ly, rx, ry,
+                _, total = join_exec.execute_predicate(
+                    plan, lx, ly, rx, ry, predicate,
                     prefer_device=self.prefer_device and self.mesh is None,
                     want_pairs=False,
                 )
@@ -2195,6 +2242,28 @@ class GeoDataset:
             np.savez_compressed(os.path.join(path, fname), **cols)
             chunks.append(fname)
         return {"chunks": chunks, "rows": n, "epoch": st.mutation_epoch}
+
+    # -- aggregate-cache persistence (docs/CACHE.md, docs/LAKE.md) ---------
+    def persist_cache(self, path: str) -> Dict[str, Any]:
+        """Write the aggregate cache's warm entries (flat cells,
+        hierarchy nodes, curve chunks, whole results) to one lake-tier
+        file, so a restarted process can :meth:`restore_cache` them and
+        answer warm zoom-outs with zero device dispatches. Entries are
+        only persisted while their epoch matches the store (a snapshot in
+        time); returns a per-schema entry-count summary."""
+        from geomesa_tpu.lake import persist as lake_persist
+
+        return lake_persist.save_cache(self, path)
+
+    def restore_cache(self, path: str) -> Dict[str, Any]:
+        """Re-admit persisted cache entries for every schema whose data
+        still matches the persisted guard (row count + spec) — typically
+        right after :meth:`load` of the checkpoint the cache was warmed
+        against. Imports ride the normal LRU budget and the store's
+        CURRENT epoch, so later mutations invalidate as usual."""
+        from geomesa_tpu.lake import persist as lake_persist
+
+        return lake_persist.restore_cache(self, path)
 
     def save(self, path: str):
         from geomesa_tpu.index.partitioned import PartitionedFeatureStore

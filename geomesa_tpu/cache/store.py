@@ -131,6 +131,32 @@ class CacheStore:
                 metrics.inc(metrics.CACHE_EVICT)
             return True
 
+    # -- persistence (geomesa_tpu/lake/persist.py; docs/CACHE.md) ----------
+    def export_uid(self, uid: int) -> Tuple[Optional[int], list]:
+        """Snapshot one dataset's entries for persistence: ``(epoch,
+        [(key, value), ...])`` in LRU order (coldest first, so a budget-
+        capped restore keeps the hottest). Values are shared references —
+        callers must treat them read-only."""
+        with self._lock:
+            d = self._data.get(uid)
+            epoch = self._epoch.get(uid)
+            if not d:
+                return epoch, []
+            return epoch, [(k, v[0]) for k, v in d.items()]
+
+    def import_entries(self, uid: int, epoch: int, items) -> int:
+        """Restore persisted entries under ``(uid, epoch)`` — the live
+        store's CURRENT epoch, so normal invalidation keeps guarding
+        later mutations. Budget applies exactly as for fresh puts.
+        Returns the number of entries admitted."""
+        n = 0
+        for key, value in items:
+            if self.put(uid, epoch, key, value):
+                n += 1
+        if n:
+            metrics.inc(metrics.CACHE_PERSIST_RESTORED, n)
+        return n
+
     def invalidate(self, uid: Optional[int] = None) -> None:
         """Explicit drop — all datasets, or one."""
         with self._lock:

@@ -208,6 +208,30 @@ MAX_RESIDENT_PARTITIONS = SystemProperty("geomesa.partition.max.resident", "4")
 SHARD_LEN_BUCKET = SystemProperty("geomesa.partition.shard.bucket", "65536")
 
 # ---------------------------------------------------------------------------
+# Columnar geo-lake tier (docs/LAKE.md): the Spatial-Parquet-style spill
+# format with per-row-group statistics and file-level pushdown.
+# ---------------------------------------------------------------------------
+
+#: Spill partitions as footer-indexed lake snapshots (off = the legacy
+#: np.savez snapshots; either format always LOADS).
+LAKE_ENABLED = SystemProperty("geomesa.lake.enabled", "true")
+
+#: Rows per lake row group — the pruning granule. Smaller groups prune
+#: tighter but cost more footer entries and per-group decode calls.
+LAKE_ROWGROUP_ROWS = SystemProperty("geomesa.lake.rowgroup.rows", "16384")
+
+#: Statistics-pruned partial loads for additive cold scans (count /
+#: unweighted density / unweighted density_curve / stats): only the row
+#: groups whose bbox/time statistics intersect the query load. Off =
+#: every cold scan loads whole partitions (the pre-lake behavior).
+LAKE_PUSHDOWN = SystemProperty("geomesa.lake.pushdown", "true")
+
+#: Degrees added around a query bbox before it prunes row groups, so the
+#: scan kernel's f32 edge arithmetic can never match a row whose group
+#: was pruned away (the same safety family as cache.cells.CLASSIFY_MARGIN).
+LAKE_PRUNE_MARGIN = SystemProperty("geomesa.lake.prune.margin", "1e-3")
+
+# ---------------------------------------------------------------------------
 # Compacted-scan + MXU density kernel tunables (r4; docs/SCALE.md cost
 # model). Env names follow the standard mapping, e.g.
 # geomesa.compact.min.rows -> GEOMESA_COMPACT_MIN_ROWS.

@@ -321,6 +321,15 @@ class FileSystemStorage:
         self._quarantine: Dict[str, str] = {}
         _instances.add(self)  # /healthz exposes each live instance's map
 
+    def _guarded_io(self, fn):
+        """Run one root I/O under the per-root ``fs.root:<abspath>``
+        breaker (docs/RESILIENCE.md; the remote-root arc of the lake
+        tier, docs/LAKE.md): open-circuit fences fast, transient
+        failures charge the breaker, success resets it. Only
+        ``OSError``s feed it — per-file corruption is the quarantine's
+        business, not the root's."""
+        return resilience.guarded_root_io(self.root, fn)
+
     # -- metadata ----------------------------------------------------------
     def _meta_path(self, name: str) -> str:
         return os.path.join(self.root, name, "metadata.json")
@@ -463,9 +472,9 @@ class FileSystemStorage:
                 pdir = os.path.join(self.root, name, "data", str(p))
                 os.makedirs(pdir, exist_ok=True)
                 fname = uuid.uuid4().hex[:16] + ext
-                self._write_file(
+                self._guarded_io(lambda: self._write_file(
                     pa.Table.from_batches([rb]), os.path.join(pdir, fname)
-                )
+                ))
                 meta["partitions"].setdefault(str(p), []).append(fname)
             meta["count"] = meta.get("count", 0) + batch.n
             self._save_meta(name, meta)
@@ -505,18 +514,19 @@ class FileSystemStorage:
             raise err
         try:
             policy = resilience.RetryPolicy.from_config()
-            return policy.call(
+            return self._guarded_io(lambda: policy.call(
                 lambda: self._read_file(path, columns=columns),
                 # a missing file will not heal by retrying; other OSErrors
                 # (EMFILE, ESTALE, EIO on network mounts) often do
                 retryable=lambda e: isinstance(e, OSError)
                 and not isinstance(e, FileNotFoundError),
                 deadline=resilience.current_deadline(),
-            )
+            ))
         except KeyError:
             raise  # requested-but-missing column: the strict §schema contract
-        except OSError as e:
-            # transient path — recorded/raised but NOT quarantined
+        except (OSError, resilience.CircuitOpenError) as e:
+            # transient path (incl. a fenced root) — recorded/raised but
+            # NOT quarantined: the root healing re-admits every file
             if resilience.partial_allowed():
                 resilience.record_skip("fs.read_partition", path, e, phase=part)
                 return None

@@ -41,6 +41,67 @@ def is_partitioned_schema(ft: FeatureType) -> bool:
     return v in ("time", "true")
 
 
+class _LakeLazyCols(dict):
+    """Master-column mapping over a lake snapshot: a member decodes its
+    (surviving) row groups on first access — the same ColumnGroups
+    contract as :class:`_LazyCols`, now at row-group granularity
+    (docs/LAKE.md): a projected query on a statistics-pruned partial load
+    touches only the column chunks it needs."""
+
+    def __init__(self, snap, zkeys: Dict[str, str], groups=None,
+                 on_corrupt=None):
+        super().__init__()
+        self._snap = snap
+        self._zkeys = dict(zkeys)  # column name -> prefixed snapshot name
+        self._groups = groups      # None = every row group
+        #: corruption hook: a crc/decode failure during a LAZY column read
+        #: surfaces mid-scan, after the load committed — the owning
+        #: partitioned store quarantines the bin here so the next query
+        #: fails fast instead of re-parsing a corrupt chunk
+        self._on_corrupt = on_corrupt
+
+    def __missing__(self, k):
+        from geomesa_tpu.lake.format import LakeCorruptError
+
+        zk = self._zkeys.get(k)
+        if zk is None:
+            raise KeyError(k)
+        try:
+            v = self._snap.read_column(zk, self._groups)
+        except LakeCorruptError as e:
+            if self._on_corrupt is not None:
+                self._on_corrupt(e)
+            raise
+        self[k] = v
+        return v
+
+    def __contains__(self, k):
+        return super().__contains__(k) or k in self._zkeys
+
+    def get(self, k, default=None):
+        try:
+            return self[k]
+        except KeyError:
+            return default
+
+    def __iter__(self):
+        seen = dict.fromkeys(self._zkeys)
+        seen.update(dict.fromkeys(super().keys()))
+        return iter(seen)
+
+    def keys(self):
+        return list(iter(self))
+
+    def items(self):
+        return [(k, self[k]) for k in self]
+
+    def values(self):
+        return [self[k] for k in self]
+
+    def __len__(self):
+        return len(set(self._zkeys) | set(super().keys()))
+
+
 class _LazyCols(dict):
     """Master-column mapping that loads snapshot members on first access —
     the ColumnGroups analog (reference conf/ColumnGroups.scala:28: scans
@@ -238,6 +299,21 @@ class PartitionedFeatureStore(FeatureStore):
     def _write_snapshot(self, st: FeatureStore, d: str):
         tmp = d + ".tmp"
         os.makedirs(tmp, exist_ok=True)
+        if config.LAKE_ENABLED.to_bool():
+            # columnar lake snapshot (docs/LAKE.md): footer-indexed row
+            # groups with per-group statistics; same tmp-then-replace
+            # atomicity as the npz writer below
+            from geomesa_tpu.lake import snapshot as lake_snapshot
+
+            try:
+                lake_snapshot.write_snapshot(st, self.ft, tmp)
+            except BaseException:
+                shutil.rmtree(tmp, ignore_errors=True)
+                raise
+            if os.path.exists(d):
+                shutil.rmtree(d)
+            os.replace(tmp, d)
+            return
         arrs: Dict[str, np.ndarray] = {}
         if st._all is not None:
             for k, v in st._all.columns.items():
@@ -328,7 +404,14 @@ class PartitionedFeatureStore(FeatureStore):
 
     def _load_snapshot(self, b: int, d: str) -> FeatureStore:
         """Parse one snapshot dir into a fresh child store — pure read,
-        no partition-map mutation (:meth:`_load` commits on success)."""
+        no partition-map mutation (:meth:`_load` commits on success).
+        Dispatches on the snapshot's format: lake (``part.lake``,
+        docs/LAKE.md) or the legacy npz layout — either always loads,
+        so a store written before the lake tier reloads unchanged."""
+        from geomesa_tpu.lake.snapshot import SNAPSHOT_FILE
+
+        if os.path.exists(os.path.join(d, SNAPSHOT_FILE)):
+            return self._load_lake_snapshot(b, d)
         st = self._new_child()
         with open(os.path.join(d, "meta.json")) as fh:
             meta = json.load(fh)
@@ -367,6 +450,234 @@ class PartitionedFeatureStore(FeatureStore):
                 ).astype(np.int64)
         self._upgrade_loaded(st, master)
         return st
+
+    def _load_lake_snapshot(self, b: int, d: str) -> FeatureStore:
+        """Full (every-row-group) load of a lake snapshot: the lake twin
+        of the npz branch above — key columns and sort permutations load
+        eagerly, master/attribute columns lazily per column."""
+        from geomesa_tpu.lake.snapshot import PartitionSnapshot
+
+        snap = PartitionSnapshot(d)
+        st = self._new_child()
+        meta = snap.meta
+        st.stats = {k: sk.Stat.from_json(v)
+                    for k, v in meta["stats"].items()}
+        n = int(meta["n"])
+        corrupt = self._quarantiner(b)
+        master = _LakeLazyCols(snap, {c[2:]: c for c in snap.columns},
+                               on_corrupt=corrupt)
+        cols = _LakeLazyCols(
+            snap, {c[2:]: c for c in snap.columns if c.startswith("c/")},
+            on_corrupt=corrupt,
+        )
+        st._key_cols = {
+            c[2:]: snap.read_column(c)
+            for c in snap.columns if c.startswith("k/")
+        }
+        master.update(st._key_cols)
+        st._all = ColumnBatch(cols, n)
+        for name, t in st.tables.items():
+            ent = snap.tables.get(name)
+            if ent is None:
+                continue  # snapshot predates this index: rebuilt on load
+            order = snap.table_order(name)
+            t.order = (np.arange(n, dtype=np.int64)
+                       if order is None else order)
+            t.key_columns = snap.table_keys(name)
+            vocab = snap.table_vocab(name)
+            if vocab is not None:
+                t._rank_vocab = vocab.astype(object)
+            sh = meta["shifts"].get(name)
+            t.key_shifts = ({k: int(v) for k, v in sh.items()}
+                            if sh else None)
+            t._master = master
+            t.n = len(t.order)
+            t.shard_bounds = np.linspace(
+                0, t.n, t.n_shards + 1
+            ).astype(np.int64)
+        self._upgrade_loaded(st, master)
+        return st
+
+    def _quarantiner(self, b: int):
+        """Corruption hook for lazily-decoded lake columns: quarantine the
+        bin on the first structural failure (same contract as a corrupt
+        load — :meth:`clear_spill_quarantine` re-admits after repair)."""
+
+        def mark(e: BaseException) -> None:
+            with self._part_lock:
+                if b in self._spill_quarantine:
+                    return
+                self._spill_quarantine[b] = repr(e)[:300]
+            metrics.inc("index.spill.quarantined")
+
+        return mark
+
+    # -- statistics-pruned partial loads (docs/LAKE.md) --------------------
+    def scan_child(self, b: int,
+                   window: Optional[Dict] = None) -> Optional[FeatureStore]:
+        """Child for one ADDITIVE scan: residents serve as-is; a spilled
+        lake partition whose footer statistics prune row groups against
+        ``window`` loads an EPHEMERAL pruned child (never entered into the
+        resident map — a later query must not see a partial partition),
+        decoding only the surviving groups' bytes. Falls back to the
+        ordinary :meth:`child` load when there is no window, the snapshot
+        predates the lake format, the plan's index is not the snapshot's
+        primary sort order, or nothing prunes (a full resident load is
+        then strictly better — it caches).
+
+        ``window``: ``{"index": plan index name, "boxes": [...] | None,
+        "times": [...] | None}`` (see ``partitioned_exec._push_window``).
+        Quarantine semantics match :meth:`_load`: transient ``OSError``
+        retries and never quarantines; a corrupt footer or row group
+        (crc mismatch, torn encoding) quarantines the bin until
+        :meth:`clear_spill_quarantine` re-admits it."""
+        from geomesa_tpu.lake.format import LakeCorruptError  # noqa: F401
+        from geomesa_tpu.lake.snapshot import (
+            SNAPSHOT_FILE, PartitionSnapshot,
+        )
+
+        with self._part_lock:
+            st = self.partitions.get(b)
+            if st is not None:
+                self._touch(b)
+                return st
+            if b not in self.spilled:
+                return None
+            q = self._spill_quarantine.get(b)
+            if q is not None:
+                raise ValueError(
+                    f"partition {b} snapshot quarantined: {q} "
+                    "(clear_spill_quarantine() re-admits after repair)"
+                )
+            d = self.spilled[b]
+        if window is None \
+                or not os.path.exists(os.path.join(d, SNAPSHOT_FILE)):
+            return self.child(b)
+        requested = window.get("index")
+        ks = next((k for k in self.keyspaces if k.name == requested), None)
+        if ks is None:
+            return self.child(b)
+        policy = resilience.RetryPolicy.from_config(seed=int(b))
+        try:
+            snap = policy.call(lambda: PartitionSnapshot(d),
+                               retryable=resilience.transient_os_error)
+            groups = snap.prune(window.get("boxes"), window.get("times"))
+            have = set(snap.columns)
+            buildable = requested == snap.primary or all(
+                ("k/" + kc) in have or ("c/" + kc) in have
+                for kc in ks.key_cols
+            )
+            if (snap.primary is None
+                    or snap.primary not in snap.tables
+                    or not buildable
+                    or len(groups) == len(snap.groups)):
+                return self.child(b)  # nothing prunes: full load caches
+
+            def attempt():
+                resilience.fault_point("index.spill.load", bin=int(b),
+                                       path=d)
+                return self._load_pruned(b, snap, groups, ks)
+
+            return policy.call(attempt,
+                               retryable=resilience.transient_os_error)
+        except OSError:
+            raise  # transient: never quarantined, the next read retries
+        except Exception as e:
+            with self._part_lock:
+                self._spill_quarantine[b] = repr(e)[:300]
+            metrics.inc("index.spill.quarantined")
+            raise ValueError(
+                f"corrupt partition snapshot for bin {b}: {e!r}"
+            ) from e
+
+    def _load_pruned(self, b: int, snap, groups: List[int],
+                     ks) -> FeatureStore:
+        """Assemble the ephemeral pruned child over the surviving row
+        groups. When the plan's index IS the snapshot's primary sort
+        order, the groups are SFC-contiguous slices of it — order is the
+        identity, key columns are the groups' chunks, nothing re-sorts.
+        Any other index rebuilds its permutation from the subset's cached
+        key columns (a host sort of only the LOADED rows; window
+        resolution then admits a possibly-different candidate superset,
+        but the compiled predicate decides matches — results stay exact).
+        Only the requested index table exists on the child."""
+        from geomesa_tpu.schema.columns import null_columns
+
+        primary = snap.primary
+        requested = ks.name
+        st = self._new_child()
+        meta = snap.meta
+        st.stats = {k: sk.Stat.from_json(v)
+                    for k, v in meta["stats"].items()}
+        nsel = snap.group_rows(groups)
+        corrupt = self._quarantiner(b)
+        master = _LakeLazyCols(snap, {c[2:]: c for c in snap.columns},
+                               groups, on_corrupt=corrupt)
+        cols = _LakeLazyCols(
+            snap, {c[2:]: c for c in snap.columns if c.startswith("c/")},
+            groups, on_corrupt=corrupt,
+        )
+        st._key_cols = {}
+        st._all = ColumnBatch(cols, nsel)
+        t = st.tables[requested]
+        st.tables = {requested: t}
+        st.keyspaces = [k for k in st.keyspaces if k.name == requested]
+        if nsel == 0:
+            # everything pruned: a zero-row child — every consumer skips
+            # it on ``child.count == 0`` before any window resolution, so
+            # the table only needs a coherent empty shape (decoding zero
+            # groups cannot recover the key columns' true dtypes)
+            t.order = np.zeros(0, np.int64)
+            t.n = 0
+            t._master = master
+            t.shard_bounds = np.zeros(t.n_shards + 1, np.int64)
+        elif requested == primary:
+            t.order = np.arange(nsel, dtype=np.int64)
+            t.key_columns = snap.table_keys(primary, groups)
+            vocab = snap.table_vocab(primary)
+            if vocab is not None:
+                t._rank_vocab = vocab.astype(object)
+            sh = meta["shifts"].get(primary)
+            t.key_shifts = ({k: int(v) for k, v in sh.items()}
+                            if sh else None)
+            t._master = master
+            t.n = nsel
+            t.shard_bounds = np.linspace(
+                0, nsel, t.n_shards + 1
+            ).astype(np.int64)
+        else:
+            needed: Dict[str, np.ndarray] = {}
+            for kc in ks.key_cols:
+                needed[kc] = master[kc]  # decodes the subset chunks
+            if isinstance(ks, AttributeKeySpace):
+                needed[ks.attr] = master[ks.attr]
+            t.rebuild(needed, self.dicts)
+            for k2, v2 in list(t._master.items()):
+                if k2 not in master:
+                    master[k2] = v2
+            t._master = master
+        # schema upgrades WITHOUT index rebuilds (the pruned child serves
+        # one plan on one index): null-fill attributes the snapshot
+        # predates, adopt the current feature type
+        missing = [a for a in self.ft.attributes
+                   if not a.is_geom and a.name not in master]
+        if missing and nsel:
+            cc = null_columns(self.ft, missing, nsel, self.dicts)
+            master.update(cc)
+            st._all.columns.update(cc)
+        st.ft = self.ft
+        t.ft = self.ft
+        st.__dict__["_lake_note"] = snap.account(groups)
+        return st
+
+    def spill_all(self) -> List[int]:
+        """Spill every resident partition to its snapshot (operators /
+        benchmarks forcing a fully-cold store). Returns the bins spilled."""
+        with self._part_lock:
+            out = list(self.partitions)
+            for b in out:
+                self._spill(b)
+            return out
 
     # -- write path --------------------------------------------------------
     def flush(self):
@@ -573,12 +884,20 @@ class PartitionedFeatureStore(FeatureStore):
         self._merged_stats = None
 
     def wkt_geoms(self) -> List[str]:
+        from geomesa_tpu.lake.snapshot import SNAPSHOT_FILE, PartitionSnapshot
+
         for st in self.partitions.values():
             return st.wkt_geoms()
         for d in self.spilled.values():
             try:
-                with np.load(os.path.join(d, "data.npz"), allow_pickle=False) as z:
-                    names = set(z.files)
+                if os.path.exists(os.path.join(d, SNAPSHOT_FILE)):
+                    # lake snapshots answer from the footer column list —
+                    # no payload bytes load (docs/LAKE.md)
+                    names = set(PartitionSnapshot(d).columns)
+                else:
+                    with np.load(os.path.join(d, "data.npz"),
+                                 allow_pickle=False) as z:
+                        names = set(z.files)
                 return [
                     a.name for a in self.ft.attributes
                     if a.is_geom and "c/" + a.name + "__wkt" in names

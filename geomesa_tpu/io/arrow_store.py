@@ -61,7 +61,13 @@ class ArrowDataStore:
         (``io.arrow.read_ipc``) and transient ``OSError``s (fd pressure,
         an NFS blip) retry in place via the standard ``geomesa.retry.*``
         RetryPolicy — a missing file or real corruption raises
-        immediately (retrying cannot heal either)."""
+        immediately (retrying cannot heal either). The file's directory
+        carries a circuit breaker (the remote-root treatment the lake
+        tier standardized, docs/LAKE.md): a dead mount fences fast after
+        repeated transient failures instead of re-walking the retry
+        ladder on every open."""
+        import os as _os
+
         from geomesa_tpu import resilience
         from geomesa_tpu.io import arrow_io
 
@@ -69,11 +75,14 @@ class ArrowDataStore:
             resilience.fault_point("io.arrow.read_ipc", path=self.path)
             return arrow_io.read_ipc(self.path)
 
-        return resilience.RetryPolicy.from_config().call(
-            attempt,
-            retryable=lambda e: isinstance(e, OSError)
-            and not isinstance(e, FileNotFoundError),
-            deadline=resilience.current_deadline(),
+        return resilience.guarded_root_io(
+            _os.path.dirname(self.path),
+            lambda: resilience.RetryPolicy.from_config().call(
+                attempt,
+                retryable=lambda e: isinstance(e, OSError)
+                and not isinstance(e, FileNotFoundError),
+                deadline=resilience.current_deadline(),
+            ),
         )
 
     def _dataset(self):

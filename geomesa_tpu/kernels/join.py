@@ -154,13 +154,40 @@ def classify_cells(boxes: np.ndarray, g, margin: float) -> np.ndarray:
 
 #: pairwise predicate kinds
 JOIN_BBOX, JOIN_DWITHIN = "bbox", "dwithin"
+JOIN_DWITHIN_METERS = "dwithin_meters"
+
+#: mean earth radius (meters) — the haversine sphere every
+#: ``dwithin_meters`` computation shares (IUGG mean radius R1)
+EARTH_RADIUS_M = 6371008.8
+
+
+def unit_vectors(lon, lat):
+    """Points as f32 unit-sphere 3-vectors ``(ux, uy, uz)``. The trig
+    runs ONCE, on the host, in f64 (then rounds to f32) — both the
+    device kernel and the numpy brute-force reference consume these SAME
+    f32 arrays, so the ``dwithin_meters`` predicate stays bit-identical
+    by construction even though libm/XLA trig differ in the last ulp:
+    the pairwise test itself (:func:`pair_mask`) is pure exactly-rounded
+    arithmetic (subtract/multiply/add/compare) on these vectors."""
+    lam = np.deg2rad(np.asarray(lon, np.float64))
+    phi = np.deg2rad(np.asarray(lat, np.float64))
+    cphi = np.cos(phi)
+    return (
+        (cphi * np.cos(lam)).astype(np.float32),
+        (cphi * np.sin(lam)).astype(np.float32),
+        np.sin(phi).astype(np.float32),
+    )
 
 
 def pair_params(predicate: str, distance=None, dx=None, dy=None):
     """Canonical f32 parameter pair ``(p0, p1)`` for one predicate:
     ``bbox`` -> (dx, dy) half-widths; ``dwithin`` -> (d^2, 0) with the
     square computed in f32 on the host, so device and reference compare
-    against the identical value."""
+    against the identical value; ``dwithin_meters`` -> (c^2, 0) where
+    ``c = 2 sin(d / 2R)`` is the unit-sphere CHORD length of great-circle
+    distance ``d`` meters — ``|u_l - u_r|^2 <= c^2`` is exactly the
+    haversine ``<= d`` verdict, with the one trig evaluation on the host
+    in f64 (rounded to f32 once, shared by kernel and reference)."""
     if predicate == JOIN_BBOX:
         if dx is None or dy is None:
             raise ValueError("bbox join needs dx and dy half-widths")
@@ -170,43 +197,67 @@ def pair_params(predicate: str, distance=None, dx=None, dy=None):
             raise ValueError("dwithin join needs a distance")
         d = np.float32(distance)
         return np.float32(d * d), np.float32(0.0)
+    if predicate == JOIN_DWITHIN_METERS:
+        if distance is None:
+            raise ValueError("dwithin_meters join needs a distance "
+                             "(meters)")
+        half = min(float(distance) / (2.0 * EARTH_RADIUS_M), np.pi / 2)
+        c = np.float32(2.0 * np.sin(half))  # chord of the antipode = 2
+        return np.float32(c * c), np.float32(0.0)
     raise ValueError(f"unknown join predicate {predicate!r} "
-                     f"(have: {JOIN_BBOX}, {JOIN_DWITHIN})")
+                     f"(have: {JOIN_BBOX}, {JOIN_DWITHIN}, "
+                     f"{JOIN_DWITHIN_METERS})")
 
 
-def pair_mask(lx, ly, rx, ry, predicate: str, p0, p1, xp):
+def pair_mask(lx, ly, rx, ry, predicate: str, p0, p1, xp,
+              lz=None, rz=None):
     """Pairwise predicate verdicts under broadcasting (f32, inclusive
     edges). ``bbox``: the two points' (p0, p1)-half-width envelopes
     intersect, i.e. |lx-rx| <= p0 and |ly-ry| <= p1. ``dwithin``: planar
     degree distance with p0 = d^2 (the sum-of-squares form keeps one
     compare and no sqrt — exact for the <= verdict in f32 given both
     sides compute it identically, which they do: this function IS both
-    sides)."""
+    sides). ``dwithin_meters``: haversine meters via the unit-sphere
+    chord — operands are :func:`unit_vectors` components (x, y, z per
+    side), p0 = chord^2 from :func:`pair_params`; wholly trig-free here,
+    so it wraps the antimeridian and the poles for free and stays
+    bit-identical between numpy and the device kernel."""
     ddx = lx.astype(xp.float32) - rx.astype(xp.float32)
     ddy = ly.astype(xp.float32) - ry.astype(xp.float32)
     if predicate == JOIN_BBOX:
         return (xp.abs(ddx) <= p0) & (xp.abs(ddy) <= p1)
     if predicate == JOIN_DWITHIN:
         return ddx * ddx + ddy * ddy <= p0
+    if predicate == JOIN_DWITHIN_METERS:
+        if lz is None or rz is None:
+            raise ValueError("dwithin_meters needs unit-vector z "
+                             "operands (lz, rz)")
+        ddz = lz.astype(xp.float32) - rz.astype(xp.float32)
+        return ddx * ddx + ddy * ddy + ddz * ddz <= p0
     raise ValueError(f"unknown join predicate {predicate!r}")
 
 
 def brute_force_pairs(lx, ly, rx, ry, predicate: str, p0, p1,
-                      chunk: int = 4096):
+                      chunk: int = 4096, lz=None, rz=None):
     """The naive N*M reference (numpy, chunked): matched (left, right)
     row-index pairs in row-major order — int64 [K, 2]. The bench/CI
     bit-identity gates compare the co-partitioned device join against
-    exactly this."""
+    exactly this. For ``dwithin_meters``, pass the sides'
+    :func:`unit_vectors` components as (lx, ly, lz) / (rx, ry, rz)."""
     lx = np.asarray(lx, np.float32)
     ly = np.asarray(ly, np.float32)
     rx = np.asarray(rx, np.float32)
     ry = np.asarray(ry, np.float32)
+    lz = None if lz is None else np.asarray(lz, np.float32)
+    rz = None if rz is None else np.asarray(rz, np.float32)
     out = []
     for lo in range(0, len(lx), chunk):
         hi = min(lo + chunk, len(lx))
         m = pair_mask(
             lx[lo:hi, None], ly[lo:hi, None], rx[None, :], ry[None, :],
             predicate, p0, p1, np,
+            lz=None if lz is None else lz[lo:hi, None],
+            rz=None if rz is None else rz[None, :],
         )
         li, rj = np.nonzero(m)
         if len(li):

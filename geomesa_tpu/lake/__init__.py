@@ -1,0 +1,29 @@
+"""Columnar geo-lake tier (docs/LAKE.md).
+
+The Spatial-Parquet-shaped storage boundary (PAPERS.md: "Spatial Parquet:
+A Column File Format for Geospatial Data Lakes"): footer-indexed files of
+row groups with lightweight (delta/bit-packed) lossless column encoding
+and per-row-group spatial/temporal/SFC statistics, so pruning happens at
+file/row-group granularity BEFORE any payload bytes load. Three layers:
+
+* :mod:`~geomesa_tpu.lake.format` — the container: blobs + JSON footer +
+  crc, range-read-friendly (a reader touches the footer plus exactly the
+  blobs it wants), ``lake.read``/``lake.write`` fault points, ``lake.*``
+  byte/row-group metrics;
+* :mod:`~geomesa_tpu.lake.snapshot` — partition spill snapshots on the
+  container (the np.savez replacement in ``index/partitioned.py``):
+  master rows re-ordered to the primary SFC sort so row groups are
+  SFC-contiguous, statistics-pruned partial loads that decode straight
+  into the scan pipeline;
+* :mod:`~geomesa_tpu.lake.persist` — aggregate-cache persistence
+  (docs/CACHE.md): hot flat cells / hierarchy nodes / curve chunks
+  written through the same tier, so a restarted process re-serves warm
+  aggregates without a rescan.
+"""
+
+from geomesa_tpu.lake.format import (  # noqa: F401
+    LakeFile, LakeWriter, decode_array, encode_array,
+)
+from geomesa_tpu.lake.snapshot import (  # noqa: F401
+    PartitionSnapshot, SNAPSHOT_FILE, write_snapshot,
+)

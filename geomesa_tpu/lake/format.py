@@ -1,0 +1,303 @@
+"""Footer-indexed blob container + lossless lightweight column encoding.
+
+The on-disk grammar every lake file speaks::
+
+    [8B magic "GMLAKE01"]
+    [blob 0][blob 1]...[blob B-1]          # raw encoded bytes, contiguous
+    [footer: JSON, utf-8]
+    [8B footer length, little-endian][8B magic]
+
+The footer carries a blob table (offset, length, crc32 per blob) plus
+whatever structure the layer above wants (row groups, statistics, cache
+sections). A reader seeks the 16-byte tail, range-reads the footer, then
+range-reads exactly the blobs it decides to load — the object-store-
+friendly shape: one tail read + one footer read + one read per surviving
+blob, never the whole file (docs/LAKE.md).
+
+Column encoding (:func:`encode_array` / :func:`decode_array`) is LOSSLESS
+and self-describing — the Spatial-Parquet "lightweight coordinate
+encoding" shape without the lossy option:
+
+* integer/datetime columns: zigzag(delta) bit-packed at the minimal width
+  (sorted SFC keys and epoch timestamps pack to a few bits/row);
+* float columns: the raw IEEE bits delta-encode the same way (bit-exact
+  by construction — spatially sorted coordinate columns share exponent/
+  mantissa prefixes, so deltas of the bit patterns stay narrow);
+* bool: packbits; strings (U/S) ride the npy fallback.
+
+Fault posture (docs/RESILIENCE.md): every payload read passes the
+``lake.read`` fault point and verifies its crc32 (a flipped byte raises
+``LakeCorruptError`` — the caller's quarantine contract distinguishes a
+corrupt blob from a transient ``OSError``, which is retried and never
+quarantined). Writes pass ``lake.write`` and go through the caller's
+tmp-then-rename dance. ``lake.bytes.{read,skipped}`` and
+``lake.rowgroups.{loaded,pruned}`` metrics are maintained here and by the
+snapshot layer.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import os
+import threading
+import zlib
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu import metrics, resilience
+
+MAGIC = b"GMLAKE01"
+_TAIL = len(MAGIC) + 8
+
+
+class LakeCorruptError(ValueError):
+    """A structural failure (bad magic, torn footer, crc mismatch) — the
+    quarantine-eligible kind, never raised for transient OS errors."""
+
+
+# ---------------------------------------------------------------------------
+# bit packing
+# ---------------------------------------------------------------------------
+
+def _pack_u64(values: np.ndarray, width: int) -> bytes:
+    """Little-endian bit-pack ``values`` (uint64) to ``width`` bits each."""
+    if width == 0 or not len(values):
+        return b""
+    bits = np.unpackbits(
+        values.astype("<u8").view(np.uint8).reshape(-1, 8),
+        axis=1, bitorder="little",
+    )[:, :width]
+    return np.packbits(bits.ravel(), bitorder="little").tobytes()
+
+
+def _unpack_u64(buf: bytes, width: int, n: int) -> np.ndarray:
+    """Inverse of :func:`_pack_u64` — uint64 [n]."""
+    if width == 0 or n == 0:
+        return np.zeros(n, np.uint64)
+    bits = np.unpackbits(
+        np.frombuffer(buf, np.uint8), bitorder="little"
+    )[: n * width].reshape(n, width)
+    full = np.zeros((n, 64), np.uint8)
+    full[:, :width] = bits
+    return np.packbits(full, axis=1, bitorder="little").view("<u8").ravel()
+
+
+def _zigzag(d: np.ndarray) -> np.ndarray:
+    """int64 -> uint64 zigzag (small magnitudes -> small codes)."""
+    return ((d.astype(np.int64) << np.int64(1))
+            ^ (d.astype(np.int64) >> np.int64(63))).view(np.uint64)
+
+
+def _unzigzag(z: np.ndarray) -> np.ndarray:
+    z = z.view(np.int64)
+    return (z >> np.int64(1)) ^ -(z & np.int64(1))
+
+
+# ---------------------------------------------------------------------------
+# array encoding
+# ---------------------------------------------------------------------------
+
+def encode_array(a: np.ndarray) -> Tuple[Dict[str, Any], bytes]:
+    """Encode one column chunk losslessly: ``(meta, payload)``. ``meta``
+    is JSON-able and sufficient for :func:`decode_array`."""
+    a = np.ascontiguousarray(a)
+    kind = a.dtype.kind
+    if a.ndim == 1 and kind in "iufM" and a.dtype.itemsize in (1, 2, 4, 8):
+        # view as int64 bit patterns (wrapping delta arithmetic is exact
+        # and self-inverse regardless of signedness or float layout)
+        if kind == "f":
+            bits = a.view(f"u{a.dtype.itemsize}").astype(np.uint64)
+        elif kind == "M":
+            bits = a.view(np.int64).view(np.uint64)
+        else:
+            bits = a.astype(np.int64, copy=False).view(np.uint64) \
+                if kind == "i" else a.astype(np.uint64, copy=False)
+        d = np.empty_like(bits, dtype=np.uint64)
+        if len(bits):
+            d[0] = bits[0]
+            np.subtract(bits[1:], bits[:-1], out=d[1:])  # wrapping
+        zz = _zigzag(d.view(np.int64))
+        width = int(zz.max()).bit_length() if len(zz) and int(zz.max()) \
+            else (1 if len(zz) else 0)
+        payload = _pack_u64(zz, width)
+        # the npy fallback is smaller for incompressible data — take it
+        raw = a.tobytes()
+        if len(payload) < len(raw):
+            return (
+                {"enc": "delta", "dtype": str(a.dtype), "n": len(a),
+                 "width": width},
+                payload,
+            )
+        return ({"enc": "raw", "dtype": str(a.dtype), "n": len(a)}, raw)
+    if a.ndim == 1 and kind == "b":
+        return (
+            {"enc": "bits", "dtype": "bool", "n": len(a)},
+            np.packbits(a.view(np.uint8), bitorder="little").tobytes(),
+        )
+    # strings / structured / multi-dim: npy container (no pickle)
+    if kind == "O":
+        a = a.astype("U")
+    buf = io.BytesIO()
+    np.save(buf, a, allow_pickle=False)
+    return ({"enc": "npy"}, buf.getvalue())
+
+
+def decode_array(meta: Dict[str, Any], payload: bytes) -> np.ndarray:
+    enc = meta["enc"]
+    if enc == "delta":
+        n, width = int(meta["n"]), int(meta["width"])
+        d = _unzigzag(_unpack_u64(payload, width, n)).view(np.uint64)
+        bits = np.cumsum(d, dtype=np.uint64)  # wrapping inverse of diff
+        dt = np.dtype(meta["dtype"])
+        if dt.kind == "f":
+            return bits.astype(f"u{dt.itemsize}").view(dt) \
+                if dt.itemsize != 8 else bits.view(dt)
+        if dt.kind == "M":
+            return bits.view(np.int64).astype(np.int64).view(dt)
+        if dt.kind == "i":
+            return bits.view(np.int64).astype(dt)
+        return bits.astype(dt)
+    if enc == "raw":
+        return np.frombuffer(payload, np.dtype(meta["dtype"])).copy()
+    if enc == "bits":
+        n = int(meta["n"])
+        return np.unpackbits(
+            np.frombuffer(payload, np.uint8), bitorder="little"
+        )[:n].astype(bool)
+    if enc == "npy":
+        return np.load(io.BytesIO(payload), allow_pickle=False)
+    raise LakeCorruptError(f"unknown lake encoding {enc!r}")
+
+
+# ---------------------------------------------------------------------------
+# container
+# ---------------------------------------------------------------------------
+
+class LakeWriter:
+    """Streaming writer: blobs append in call order; :meth:`finish` seals
+    footer + tail. The caller owns tmp-path/rename atomicity."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._fh = open(path, "wb")
+        self._fh.write(MAGIC)
+        self._off = len(MAGIC)
+        #: blob table rows: [offset, length, crc32]
+        self.blobs: List[List[int]] = []
+
+    def add_blob(self, payload: bytes) -> int:
+        """Append one blob; returns its blob-table index (the ``ref``
+        footer structures point at)."""
+        resilience.fault_point("lake.write", path=self.path,
+                              blob=len(self.blobs))
+        self._fh.write(payload)
+        self.blobs.append([self._off, len(payload),
+                           zlib.crc32(payload) & 0xFFFFFFFF])
+        self._off += len(payload)
+        return len(self.blobs) - 1
+
+    def add_array(self, a: np.ndarray) -> Dict[str, Any]:
+        """Encode + append one column chunk; returns the JSON-able ref
+        (``{"b": blob_index, ...encoding meta}``)."""
+        meta, payload = encode_array(a)
+        meta["b"] = self.add_blob(payload)
+        meta["nbytes"] = len(payload)
+        return meta
+
+    def finish(self, footer: Dict[str, Any]) -> None:
+        footer = dict(footer)
+        footer["blobs"] = self.blobs
+        raw = json.dumps(footer, separators=(",", ":")).encode()
+        self._fh.write(raw)
+        self._fh.write(len(raw).to_bytes(8, "little"))
+        self._fh.write(MAGIC)
+        self._fh.flush()
+        os.fsync(self._fh.fileno())
+        self._fh.close()
+
+    def abort(self) -> None:
+        try:
+            self._fh.close()
+        finally:
+            try:
+                os.remove(self.path)
+            except OSError:
+                pass
+
+
+class LakeFile:
+    """Range reader over one lake file. Opening parses ONLY the tail +
+    footer; payload bytes load per-blob on demand (with crc verification
+    and the ``lake.read`` fault point), so statistics-pruned readers pay
+    for exactly the blobs that survive.
+
+    The handle opened here is HELD for the reader's lifetime and every
+    blob read goes through it: lazy decodes (an ephemeral pruned child's
+    ``_LakeLazyCols``) can land long after open, racing a concurrent
+    re-spill's ``os.replace`` of the same path — reopening by path would
+    read the NEW file against the OLD footer's offsets, a crc mismatch
+    that falsely quarantines a healthy partition. An unlinked-but-open
+    fd keeps serving the footer's own bytes."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        fh = self._fh = open(path, "rb")
+        try:
+            fh.seek(0, os.SEEK_END)
+            size = fh.tell()
+            if size < len(MAGIC) + _TAIL:
+                raise LakeCorruptError(f"{path}: truncated lake file")
+            fh.seek(size - _TAIL)
+            tail = fh.read(_TAIL)
+            if tail[8:] != MAGIC:
+                raise LakeCorruptError(f"{path}: bad tail magic")
+            flen = int.from_bytes(tail[:8], "little")
+            foot_at = size - _TAIL - flen
+            if flen <= 0 or foot_at < len(MAGIC):
+                raise LakeCorruptError(f"{path}: bad footer length {flen}")
+            fh.seek(0)
+            if fh.read(len(MAGIC)) != MAGIC:
+                raise LakeCorruptError(f"{path}: bad head magic")
+            fh.seek(foot_at)
+            try:
+                self.footer: Dict[str, Any] = json.loads(fh.read(flen))
+            except ValueError as e:
+                raise LakeCorruptError(f"{path}: torn footer: {e}") from e
+        except BaseException:
+            fh.close()
+            raise
+        self.blobs: List[List[int]] = self.footer.get("blobs", [])
+        metrics.inc(metrics.LAKE_BYTES_READ, flen + _TAIL)
+
+    def close(self) -> None:
+        self._fh.close()
+
+    # -- payload -----------------------------------------------------------
+    def read_blob(self, ref: int) -> bytes:
+        off, length, crc = self.blobs[ref]
+        resilience.fault_point("lake.read", path=self.path, blob=ref)
+        with self._lock:
+            self._fh.seek(off)
+            payload = self._fh.read(length)
+        if len(payload) != length:
+            raise LakeCorruptError(
+                f"{self.path}: blob {ref} truncated "
+                f"({len(payload)}/{length} bytes)"
+            )
+        if (zlib.crc32(payload) & 0xFFFFFFFF) != crc:
+            raise LakeCorruptError(
+                f"{self.path}: blob {ref} crc mismatch"
+            )
+        metrics.inc(metrics.LAKE_BYTES_READ, length)
+        return payload
+
+    def read_array(self, ref_meta: Dict[str, Any]) -> np.ndarray:
+        return decode_array(ref_meta, self.read_blob(int(ref_meta["b"])))
+
+    def blob_nbytes(self, ref_meta: Optional[Dict[str, Any]]) -> int:
+        if ref_meta is None:
+            return 0
+        return int(self.blobs[int(ref_meta["b"])][1])

@@ -1,0 +1,153 @@
+"""Aggregate-cache persistence through the lake tier (docs/CACHE.md).
+
+The warm flat-cell / hierarchy / curve-chunk entries of a dataset's
+:class:`~geomesa_tpu.cache.store.CacheStore` die with the process today;
+this module writes them through the same footer-indexed container the
+partition snapshots use, so a restarted process re-serves warm aggregates
+without a rescan — a fully-warm zoom-out answers with ZERO device
+dispatches right after restore (the bench/CI ``cache_persist_restore``
+gate).
+
+Contract: a persisted entry is only valid against the same logical data
+snapshot it was computed from. Each schema's section carries a **guard**
+(row count + schema spec); restore imports a section only when the live
+store matches its guard, and imports under the live store's CURRENT
+epoch, so the normal epoch invalidation keeps protecting every later
+mutation. Persisting is snapshot-in-time: entries whose stored epoch no
+longer matches the store's version are skipped at save.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any, Dict, Tuple
+
+import numpy as np
+
+from geomesa_tpu import metrics
+from geomesa_tpu.lake.format import LakeCorruptError, LakeFile, LakeWriter
+
+
+def _enc_value(w: LakeWriter, v: Any) -> Dict[str, Any]:
+    if isinstance(v, bool):
+        return {"t": "bool", "v": bool(v)}
+    if isinstance(v, (int, np.integer)):
+        return {"t": "int", "v": int(v)}
+    if isinstance(v, (float, np.floating)):
+        return {"t": "float", "v": float(v)}
+    if isinstance(v, str):
+        return {"t": "str",
+                "r": w.add_array(np.frombuffer(v.encode(), np.uint8))}
+    if isinstance(v, bytes):
+        return {"t": "bytes", "r": w.add_array(np.frombuffer(v, np.uint8))}
+    if isinstance(v, np.ndarray):
+        # ravel through the delta encoder (integer-valued grids pack to a
+        # few bits/cell); the shape restores on decode
+        return {"t": "arr", "r": w.add_array(np.ascontiguousarray(v).ravel()),
+                "shape": list(v.shape), "dtype": str(v.dtype)}
+    if isinstance(v, tuple):
+        return {"t": "tuple", "items": [_enc_value(w, i) for i in v]}
+    raise TypeError(f"unpersistable cache value type {type(v).__name__}")
+
+
+def _dec_value(f: LakeFile, d: Dict[str, Any]) -> Any:
+    t = d["t"]
+    if t in ("bool", "int", "float"):
+        return d["v"]
+    if t == "str":
+        return f.read_array(d["r"]).tobytes().decode()
+    if t == "bytes":
+        return f.read_array(d["r"]).tobytes()
+    if t == "arr":
+        a = f.read_array(d["r"]).astype(np.dtype(d["dtype"]), copy=False)
+        return a.reshape(d["shape"])
+    if t == "tuple":
+        return tuple(_dec_value(f, i) for i in d["items"])
+    raise ValueError(f"unknown persisted value type {t!r}")
+
+
+def save_cache(ds, path: str) -> Dict[str, Any]:
+    """Write every schema's current-epoch cache entries to ``path``
+    (atomic tmp-then-rename). Returns a per-schema entry-count summary."""
+    tmp = path + ".tmp"
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    w = LakeWriter(tmp)
+    summary: Dict[str, Any] = {}
+    try:
+        datasets: Dict[str, Any] = {}
+        for name, st in ds._stores.items():
+            epoch, items = ds.cache.store.export_uid(st.uid)
+            if epoch is None or epoch != st.version:
+                # the cache predates (or outlived) this store's state:
+                # nothing here is provably valid to persist
+                summary[name] = 0
+                continue
+            entries = []
+            for key, value in items:
+                kr = repr(key)
+                try:
+                    # a key must survive the repr -> literal_eval round
+                    # trip (a leaked numpy scalar reprs as np.int64(5) on
+                    # numpy>=2 and would poison the whole restore file)
+                    if _literal_key(kr) != key:
+                        continue
+                except (ValueError, SyntaxError):
+                    continue  # non-literal key: skip this entry, not all
+                try:
+                    entries.append([kr, _enc_value(w, value)])
+                except TypeError:
+                    continue  # unpersistable value kind: skip, not fail
+            datasets[name] = {
+                "epoch": int(epoch),
+                "guard": {"count": int(st.count), "spec": st.ft.spec()},
+                "entries": entries,
+            }
+            summary[name] = len(entries)
+        w.finish({"kind": "cache", "datasets": datasets})
+    except BaseException:
+        w.abort()
+        raise
+    os.replace(tmp, path)
+    return summary
+
+
+def restore_cache(ds, path: str) -> Dict[str, Any]:
+    """Import persisted cache sections whose guard matches the live
+    store, under the live store's current epoch. Returns per-schema
+    ``{"restored": n}`` / ``{"skipped": reason}``."""
+    f = LakeFile(path)
+    if f.footer.get("kind") != "cache":
+        raise LakeCorruptError(f"{path}: not a cache persistence file")
+    out: Dict[str, Any] = {}
+    for name, section in f.footer.get("datasets", {}).items():
+        st = ds._stores.get(name)
+        if st is None:
+            out[name] = {"skipped": "no such schema"}
+            continue
+        guard = section.get("guard", {})
+        if int(guard.get("count", -1)) != int(st.count):
+            out[name] = {"skipped": "row count changed"}
+            continue
+        if guard.get("spec") != st.ft.spec():
+            out[name] = {"skipped": "schema changed"}
+            continue
+        items = []
+        skipped = 0
+        for key_repr, vd in section.get("entries", []):
+            try:
+                items.append((_literal_key(key_repr), _dec_value(f, vd)))
+            except LakeCorruptError:
+                raise  # on-disk corruption is never a benign skip
+            except (ValueError, SyntaxError):
+                skipped += 1  # one bad entry must not fail the restore
+        n = ds.cache.store.import_entries(st.uid, st.version, items)
+        out[name] = ({"restored": n, "skipped_entries": skipped}
+                     if skipped else {"restored": n})
+    return out
+
+
+def _literal_key(key_repr: str) -> Tuple:
+    """Keys are tuples of str/int/float/None/tuples — exactly the
+    ``ast.literal_eval``-safe subset — built by the cache layer itself."""
+    return ast.literal_eval(key_repr)

@@ -1,0 +1,322 @@
+"""Partition spill snapshots on the lake container (docs/LAKE.md).
+
+The np.savez replacement for :mod:`geomesa_tpu.index.partitioned`: one
+``part.lake`` file per spilled partition, holding
+
+* the master/attribute columns (``c/`` prefix) and cached index-key
+  columns (``k/`` prefix) — **re-ordered to the primary SFC index's sort
+  order** and chunked into row groups, so each group covers a contiguous
+  slice of the space-filling curve;
+* per-row-group statistics: point-geometry bbox, time range, and the
+  primary sort key's SFC range — the footer a reader consults to prune
+  groups BEFORE any payload bytes load;
+* every index table's sort permutation + sorted key columns (the primary
+  table's permutation is the identity after the re-order, so its key
+  columns chunk 1:1 with the row groups and a pruned subset of groups is
+  STILL sorted — a statistics-pruned partial load rebuilds nothing).
+
+The re-order is observationally invisible: each table's ``order`` array
+is remapped through the inverse permutation, so every sorted gather
+produces byte-identical columns — the npz-vs-lake bit-identity contract
+the bench and CI gate. ``meta.json`` (row count, key shifts, sketch
+stats) is still written alongside for the readers that never touch
+column data (merged stats, ``attach_snapshots``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu import config, metrics
+from geomesa_tpu.lake.format import LakeFile, LakeWriter
+
+SNAPSHOT_FILE = "part.lake"
+
+#: preferred canonical row orders. z2 first: a pure-spatial sort gives
+#: every row group a TIGHT bbox statistic (the pruning axis that matters
+#: inside a time-partition bin — the bin already is a time range), where
+#: z3's time-major interleave spreads each group across the whole extent.
+_PRIMARY_PREFERENCE = ("z2", "z3")
+
+
+def _primary_table(st) -> Optional[str]:
+    """The snapshot's canonical row order: the spatial SFC index when one
+    exists (its sorted runs make row-group statistics tight)."""
+    for name in _PRIMARY_PREFERENCE:
+        t = st.tables.get(name)
+        if t is not None and t.n:
+            return name
+    return None
+
+
+def _rowgroup_rows() -> int:
+    r = config.LAKE_ROWGROUP_ROWS.to_int()
+    return max(int(r) if r else 16384, 256)
+
+
+def _group_stats(ft, cols: Dict[str, np.ndarray], lo: int, hi: int,
+                 primary_key: Optional[np.ndarray]) -> Dict[str, Any]:
+    """Footer statistics for rows [lo, hi) of the re-ordered master."""
+    out: Dict[str, Any] = {"rows": hi - lo}
+    g = ft.geom_field
+    if g is not None:
+        gx, gy = cols.get(g + "__x"), cols.get(g + "__y")
+        if gx is not None and gy is not None:
+            sx, sy = gx[lo:hi], gy[lo:hi]
+            if len(sx):
+                out["bbox"] = [float(np.min(sx)), float(np.min(sy)),
+                               float(np.max(sx)), float(np.max(sy))]
+    d = ft.dtg_field
+    if d is not None:
+        dc = cols.get(d)
+        if dc is not None and dc.dtype.kind in "iuM" and hi > lo:
+            dv = dc[lo:hi].astype(np.int64, copy=False) \
+                if dc.dtype.kind != "M" else dc[lo:hi].view(np.int64)
+            out["time"] = [int(dv.min()), int(dv.max())]
+    if primary_key is not None and hi > lo:
+        # the primary key column is sorted, so the group's SFC range is
+        # its first/last entry
+        out["sfc"] = [int(primary_key[lo]), int(primary_key[hi - 1])]
+    return out
+
+
+def write_snapshot(st, ft, d: str) -> None:
+    """Write partition store ``st``'s lake snapshot into directory ``d``
+    (the caller owns the tmp-dir/atomic-rename dance, exactly as the npz
+    writer did). Produces ``d/part.lake`` + ``d/meta.json``."""
+    os.makedirs(d, exist_ok=True)
+    n = st._all.n if st._all is not None else 0
+    master: Dict[str, np.ndarray] = {}
+    if st._all is not None:
+        for k, v in st._all.columns.items():
+            master["c/" + k] = v.astype("U") if v.dtype.kind == "O" else v
+    for k, v in st._key_cols.items():
+        master["k/" + k] = v
+
+    primary = _primary_table(st)
+    inv = None
+    if primary is not None and n:
+        if st.tables[primary].n != n:
+            primary = None  # inconsistent table: no canonical re-order
+        else:
+            perm = np.asarray(st.tables[primary].order, np.int64)
+            inv = np.empty(n, np.int64)
+            inv[perm] = np.arange(n, dtype=np.int64)
+            master = {k: np.asarray(v)[perm] for k, v in master.items()}
+
+    pt = st.tables.get(primary) if primary is not None else None
+    primary_key = None
+    if pt is not None and pt.key_columns:
+        # the FIRST key column is the table's major sort key (the SFC key)
+        primary_key = next(iter(pt.key_columns.values()))
+
+    rows = _rowgroup_rows()
+    if n:
+        bounds = list(range(0, n, rows)) + [n]
+        cut_pairs = list(zip(bounds[:-1], bounds[1:]))
+    else:
+        # one empty group preserves every column's dtype across reload
+        cut_pairs = [(0, 0)] if master else []
+    path = os.path.join(d, SNAPSHOT_FILE)
+    w = LakeWriter(path)
+    try:
+        groups: List[Dict[str, Any]] = []
+        plain = {k[2:]: v for k, v in master.items() if k.startswith("c/")}
+        for lo, hi in cut_pairs:
+            cols = {k: w.add_array(v[lo:hi]) for k, v in master.items()}
+            groups.append({
+                "cols": cols,
+                "stats": _group_stats(ft, plain, lo, hi, primary_key),
+            })
+        shifts: Dict[str, Dict[str, int]] = {}
+        tables: Dict[str, Dict[str, Any]] = {}
+        for name, t in st.tables.items():
+            if not t.n and n:
+                continue  # snapshot predates this index: rebuilt on load
+            order = np.asarray(t.order, np.int64)
+            if inv is not None:
+                order = inv[order]
+            ent: Dict[str, Any] = {"n": int(t.n)}
+            if name == primary:
+                ent["order"] = None  # identity by construction
+                # the primary's sorted key columns chunk 1:1 with the row
+                # groups, so a pruned load slices them with the groups
+                ent["keys"] = {
+                    k: [w.add_array(v[lo:hi]) for lo, hi in cut_pairs]
+                    for k, v in t.key_columns.items()
+                }
+            else:
+                ent["order"] = w.add_array(order)
+                ent["keys"] = {k: w.add_array(v)
+                               for k, v in t.key_columns.items()}
+            if t._rank_vocab is not None:
+                ent["vocab"] = w.add_array(t._rank_vocab.astype("U"))
+            if t.key_shifts is not None:
+                shifts[name] = dict(t.key_shifts)
+            tables[name] = ent
+        meta = {
+            "n": n,
+            "shifts": shifts,
+            "stats": {k: v.to_json() for k, v in st.stats.items()},
+        }
+        w.finish({
+            "kind": "partition",
+            "n": n,
+            "primary": primary,
+            "columns": sorted(master),
+            "groups": groups,
+            "tables": tables,
+            "meta": meta,
+        })
+    except BaseException:
+        w.abort()
+        raise
+    with open(os.path.join(d, "meta.json"), "w") as fh:
+        json.dump(meta, fh)
+
+
+class PartitionSnapshot:
+    """Reader over one partition's ``part.lake``: footer-only on open;
+    column payloads decode per row group on demand, with a pruning query
+    over the footer statistics."""
+
+    def __init__(self, d: str):
+        self.dir = d
+        self.file = LakeFile(os.path.join(d, SNAPSHOT_FILE))
+        f = self.file.footer
+        if f.get("kind") != "partition":
+            from geomesa_tpu.lake.format import LakeCorruptError
+
+            raise LakeCorruptError(f"{d}: not a partition snapshot")
+        self.n: int = int(f["n"])
+        self.primary: Optional[str] = f.get("primary")
+        self.columns: List[str] = list(f.get("columns", []))
+        self.groups: List[Dict[str, Any]] = f.get("groups", [])
+        self.tables: Dict[str, Dict[str, Any]] = f.get("tables", {})
+        self.meta: Dict[str, Any] = f["meta"]
+
+    # -- statistics pruning ------------------------------------------------
+    def group_rows(self, groups: Optional[Sequence[int]] = None) -> int:
+        idx = range(len(self.groups)) if groups is None else groups
+        return int(sum(self.groups[i]["stats"]["rows"] for i in idx))
+
+    def payload_bytes(self, groups: Optional[Sequence[int]] = None) -> int:
+        """Encoded payload bytes of the listed groups (all when None)."""
+        idx = range(len(self.groups)) if groups is None else groups
+        total = 0
+        for i in idx:
+            for ref in self.groups[i]["cols"].values():
+                total += self.file.blob_nbytes(ref)
+        return total
+
+    def prune(self, boxes: Optional[List[Tuple[float, float, float, float]]],
+              times: Optional[List[Tuple[float, float]]],
+              margin: Optional[float] = None) -> List[int]:
+        """Row groups that may hold matching rows. ``boxes``/``times`` are
+        the query's extracted spatial/temporal bounds (None = that axis is
+        unconstrained; an empty list = provably disjoint). Spatial checks
+        inflate the group bbox by ``margin`` degrees so the scan kernel's
+        f32 edge arithmetic can never match a row in a pruned group."""
+        if margin is None:
+            m = config.LAKE_PRUNE_MARGIN.to_float()
+            margin = 1e-3 if m is None else float(m)
+        out: List[int] = []
+        for i, g in enumerate(self.groups):
+            s = g["stats"]
+            keep = True
+            if boxes is not None:
+                bb = s.get("bbox")
+                if bb is None:
+                    keep = bool(boxes)  # no stats: only disjoint prunes
+                    if not boxes:
+                        keep = False
+                else:
+                    x0, y0, x1, y1 = (bb[0] - margin, bb[1] - margin,
+                                      bb[2] + margin, bb[3] + margin)
+                    keep = any(
+                        q[0] <= x1 and q[2] >= x0
+                        and q[1] <= y1 and q[3] >= y0
+                        for q in boxes
+                    )
+            if keep and times is not None:
+                tt = s.get("time")
+                if tt is None:
+                    keep = bool(times)
+                    if not times:
+                        keep = False
+                else:
+                    keep = any(q[0] <= tt[1] and q[1] >= tt[0]
+                               for q in times)
+            if keep:
+                out.append(i)
+        return out
+
+    def account(self, loaded: Sequence[int]) -> Dict[str, int]:
+        """Metrics + audit numbers for a pruned load, and increments the
+        process counters (docs/OBSERVABILITY.md ``lake.*``)."""
+        total = len(self.groups)
+        read_b = self.payload_bytes(loaded)
+        all_b = self.payload_bytes(None)
+        acct = {
+            "groups_total": total,
+            "groups_loaded": len(loaded),
+            "groups_pruned": total - len(loaded),
+            "bytes_payload": all_b,
+            "bytes_loaded": read_b,
+            "bytes_skipped": all_b - read_b,
+        }
+        metrics.inc(metrics.LAKE_ROWGROUPS_LOADED, len(loaded))
+        metrics.inc(metrics.LAKE_ROWGROUPS_PRUNED, total - len(loaded))
+        metrics.inc(metrics.LAKE_BYTES_SKIPPED, all_b - read_b)
+        return acct
+
+    # -- column decode -----------------------------------------------------
+    def read_column(self, name: str,
+                    groups: Optional[Sequence[int]] = None) -> np.ndarray:
+        """Decode one prefixed column (``c/attr`` / ``k/__z3``) over the
+        listed row groups (all when None), concatenated in group order."""
+        idx = list(range(len(self.groups))) if groups is None else list(groups)
+        parts = []
+        for i in idx:
+            ref = self.groups[i]["cols"].get(name)
+            if ref is None:
+                raise KeyError(name)
+            parts.append(self.file.read_array(ref))
+        if not parts:
+            # zero groups (empty partition / everything pruned): derive an
+            # empty array of the right dtype from the encoding of nothing
+            return np.zeros(0, np.float64 if name.startswith("c/")
+                            else np.int64)
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    def table_order(self, name: str) -> Optional[np.ndarray]:
+        ent = self.tables[name]
+        if ent.get("order") is None:
+            return None  # identity (the primary)
+        return self.file.read_array(ent["order"])
+
+    def table_keys(self, name: str,
+                   groups: Optional[Sequence[int]] = None
+                   ) -> Dict[str, np.ndarray]:
+        ent = self.tables[name]
+        out: Dict[str, np.ndarray] = {}
+        for k, refs in ent.get("keys", {}).items():
+            if isinstance(refs, list):  # primary: per-group chunks
+                idx = (list(range(len(self.groups)))
+                       if groups is None else list(groups))
+                parts = [self.file.read_array(refs[i]) for i in idx]
+                out[k] = (parts[0] if len(parts) == 1
+                          else np.concatenate(parts)) if parts \
+                    else np.zeros(0, np.int64)
+            else:
+                out[k] = self.file.read_array(refs)
+        return out
+
+    def table_vocab(self, name: str) -> Optional[np.ndarray]:
+        ent = self.tables[name]
+        v = ent.get("vocab")
+        return None if v is None else self.file.read_array(v)

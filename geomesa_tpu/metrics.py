@@ -503,6 +503,19 @@ JOIN_QUERIES = "join.queries"
 JOIN_CELLS = "join.cells"
 JOIN_CANDIDATE_PAIRS = "join.candidate.pairs"
 JOIN_PAIRS = "join.pairs"
+# Columnar geo-lake tier (geomesa_tpu/lake/; docs/LAKE.md):
+#   lake.bytes.read        payload + footer bytes actually read
+#   lake.bytes.skipped     payload bytes statistics-pruning never touched
+#   lake.rowgroups.loaded  row groups decoded for scans
+#   lake.rowgroups.pruned  row groups excluded by footer statistics
+#   lake.pushdown.scans    partition scans served by a pruned partial load
+#   cache.persist.restored cache entries re-served from a persisted tier
+LAKE_BYTES_READ = "lake.bytes.read"
+LAKE_BYTES_SKIPPED = "lake.bytes.skipped"
+LAKE_ROWGROUPS_LOADED = "lake.rowgroups.loaded"
+LAKE_ROWGROUPS_PRUNED = "lake.rowgroups.pruned"
+LAKE_PUSHDOWN_SCANS = "lake.pushdown.scans"
+CACHE_PERSIST_RESTORED = "cache.persist.restored"
 #   compact.desc.shared   compact-scan descriptors served from the
 #                         content-addressed share (a rebuild avoided:
 #                         another site/query resolved the same windows —

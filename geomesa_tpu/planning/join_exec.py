@@ -170,17 +170,28 @@ class JoinPlan:
         return 0 if self.l_rows is None else len(self.l_rows)
 
 
-def co_partition(lx, ly, rx, ry, predicate: str, reach_x: float,
+def co_partition(lx, ly, rx, ry, predicate: str, reach_x,
                  reach_y: float, level: Optional[int] = None,
-                 p0=None, p1=None) -> JoinPlan:
+                 p0=None, p1=None, wrap_x: bool = False) -> JoinPlan:
     """Group both sides by SFC cell at ``level`` (adaptive when None) and
     chunk joint cells into padded tile blocks. Pure host numpy — the
-    grouping is two argsorts plus a bounded neighbor expansion."""
+    grouping is two argsorts plus a bounded neighbor expansion.
+
+    ``reach_x`` may be a per-probe-row array (``dwithin_meters``: the lon
+    reach needed for ``d`` meters grows with |latitude|). ``wrap_x``
+    wraps the probe reach box across the antimeridian (modular lon
+    cells) — a great-circle predicate matches across lon ±180, so its
+    strip must too; the planar predicates keep the clipped grid."""
     lx = np.asarray(lx, np.float64)
     ly = np.asarray(ly, np.float64)
     rx = np.asarray(rx, np.float64)
     ry = np.asarray(ry, np.float64)
-    reach = max(float(reach_x), float(reach_y))
+    # level choice uses the TYPICAL reach (per-row reach_x arrays rank by
+    # their minimum — high-latitude rows widen their own windows instead
+    # of coarsening every cell)
+    rx_typ = (float(np.min(reach_x)) if np.ndim(reach_x) and len(reach_x)
+              else float(reach_x) if not np.ndim(reach_x) else 0.0)
+    reach = max(rx_typ, float(reach_y))
     if level is None:
         n_l, n_r = len(lx), len(rx)
         bounds = None
@@ -208,13 +219,20 @@ def co_partition(lx, ly, rx, ry, predicate: str, reach_x: float,
 
     # probe reach box, inflated by the classify margin (module docstring):
     # every cell the box touches gets a membership
-    mx = float(reach_x) + CLASSIFY_MARGIN
+    mx = np.asarray(reach_x, np.float64) + CLASSIFY_MARGIN
     my = float(reach_y) + CLASSIFY_MARGIN
-    ix0 = np.clip(np.floor((rx - mx + 180.0) / sx), 0, n - 1).astype(np.int64)
-    ix1 = np.clip(np.floor((rx + mx + 180.0) / sx), 0, n - 1).astype(np.int64)
+    if wrap_x:
+        # modular lon: the window spans [ix0, ix1] mod n, capped at one
+        # full wrap (a reach past 180° of longitude covers every column)
+        ix0 = np.floor((rx - mx + 180.0) / sx).astype(np.int64)
+        ix1 = np.floor((rx + mx + 180.0) / sx).astype(np.int64)
+        wx = np.minimum(ix1 - ix0 + 1, n).astype(np.int64)
+    else:
+        ix0 = np.clip(np.floor((rx - mx + 180.0) / sx), 0, n - 1).astype(np.int64)
+        ix1 = np.clip(np.floor((rx + mx + 180.0) / sx), 0, n - 1).astype(np.int64)
+        wx = (ix1 - ix0 + 1).astype(np.int64)
     iy0 = np.clip(np.floor((ry - my + 90.0) / sy), 0, n - 1).astype(np.int64)
     iy1 = np.clip(np.floor((ry + my + 90.0) / sy), 0, n - 1).astype(np.int64)
-    wx = (ix1 - ix0 + 1).astype(np.int64)
     wy = (iy1 - iy0 + 1).astype(np.int64)
     w = wx * wy
     rid = np.repeat(np.arange(len(rx), dtype=np.int64), w)
@@ -223,6 +241,8 @@ def co_partition(lx, ly, rx, ry, predicate: str, reach_x: float,
         np.cumsum(w) - w, w
     )
     gx = ix0[rid] + off % wx[rid]
+    if wrap_x:
+        gx %= n  # python modulo: non-negative for ix0 < 0
     gy = iy0[rid] + off // wx[rid]
     rcell = _cell_ids(gx, gy)
     rhome = _cell_ids(*cell_of(rx, ry))
@@ -306,18 +326,33 @@ def _pairs_kernel(Bp: int, Pp: int, Cp: int, predicate: str):
     import jax
     import jax.numpy as jnp
 
-    @jax.jit
-    def go(lxb, lyb, rxb, ryb, lvalid, rvalid, p0, p1):
-        m = kjoin.pair_mask(
-            lxb[:, :, None], lyb[:, :, None],
-            rxb[:, None, :], ryb[:, None, :],
-            predicate, p0, p1, jnp,
-        )
+    def _mask(m, lvalid, rvalid):
         iota_b = jnp.arange(Bp, dtype=jnp.int32)[None, :, None]
         iota_p = jnp.arange(Pp, dtype=jnp.int32)[None, None, :]
         m = m & (iota_b < lvalid[:, None, None]) \
               & (iota_p < rvalid[:, None, None])
         return m, m.sum(axis=(1, 2), dtype=jnp.int32)
+
+    if predicate == kjoin.JOIN_DWITHIN_METERS:
+        # unit-vector operands: three coordinate planes per side
+        @jax.jit
+        def go(lxb, lyb, lzb, rxb, ryb, rzb, lvalid, rvalid, p0, p1):
+            m = kjoin.pair_mask(
+                lxb[:, :, None], lyb[:, :, None],
+                rxb[:, None, :], ryb[:, None, :],
+                predicate, p0, p1, jnp,
+                lz=lzb[:, :, None], rz=rzb[:, None, :],
+            )
+            return _mask(m, lvalid, rvalid)
+    else:
+        @jax.jit
+        def go(lxb, lyb, rxb, ryb, lvalid, rvalid, p0, p1):
+            m = kjoin.pair_mask(
+                lxb[:, :, None], lyb[:, :, None],
+                rxb[:, None, :], ryb[:, None, :],
+                predicate, p0, p1, jnp,
+            )
+            return _mask(m, lvalid, rvalid)
 
     reg.put(key, go)
     return go
@@ -333,9 +368,11 @@ def _devices(prefer_device: bool):
     return pdev.scan_devices()
 
 
-def _pad_tiles(plan: JoinPlan, lo: int, hi: int, lx32, ly32, rx32, ry32):
+def _pad_tiles(plan: JoinPlan, lo: int, hi: int, lx32, ly32, rx32, ry32,
+               lz32=None, rz32=None):
     """One device slice's padded kernel operands: tile rows [Cp, Bp/Pp]
-    gathered into coordinate blocks, Cp = pow2 bucket of the slice."""
+    gathered into coordinate blocks, Cp = pow2 bucket of the slice.
+    ``lz32``/``rz32`` (dwithin_meters unit vectors) gather to z blocks."""
     C = hi - lo
     Cp = _pow2(C)
     lrows = np.zeros((Cp, plan.Bp), np.int32)
@@ -346,19 +383,23 @@ def _pad_tiles(plan: JoinPlan, lo: int, hi: int, lx32, ly32, rx32, ry32):
     rrows[:C] = plan.r_rows[lo:hi]
     lval[:C] = plan.l_valid[lo:hi]
     rval[:C] = plan.r_valid[lo:hi]
+    lzb = None if lz32 is None else lz32[lrows]
+    rzb = None if rz32 is None else rz32[rrows]
     return (lx32[lrows], ly32[lrows], rx32[rrows], ry32[rrows],
-            lval, rval, Cp, C)
+            lval, rval, Cp, C, lzb, rzb)
 
 
 def execute(plan: JoinPlan, lx, ly, rx, ry, prefer_device: bool = True,
-            want_pairs: bool = True):
+            want_pairs: bool = True, lz=None, rz=None):
     """Run the bucketed pairwise kernel over the plan's tiles, sharded
     over the device mesh. Returns ``(pairs, total)``: matched global
     (left, right) row positions as int64 [K, 2] sorted row-major (None
     when ``want_pairs`` is False) and the exact match total over
     completed tiles. Per-slice failures degrade under
     ``resilience.allow_partial()`` (recorded in ``plan.stats.skipped``);
-    totals stay exact over survivors."""
+    totals stay exact over survivors. For ``dwithin_meters``, the
+    coordinate operands are the sides' precomputed f32 unit vectors
+    ((lx, ly, lz) / (rx, ry, rz) — kernels.join.unit_vectors)."""
     stats = plan.stats
     if plan.n_tiles == 0:
         return (np.zeros((0, 2), np.int64) if want_pairs else None), 0
@@ -366,6 +407,8 @@ def execute(plan: JoinPlan, lx, ly, rx, ry, prefer_device: bool = True,
     ly32 = np.asarray(ly, np.float32)
     rx32 = np.asarray(rx, np.float32)
     ry32 = np.asarray(ry, np.float32)
+    lz32 = None if lz is None else np.asarray(lz, np.float32)
+    rz32 = None if rz is None else np.asarray(rz, np.float32)
     use_device = prefer_device and _jax_ok()
     devs = _devices(prefer_device) if use_device else None
     n_dev = len(devs) if devs else 1
@@ -382,7 +425,8 @@ def execute(plan: JoinPlan, lx, ly, rx, ry, prefer_device: bool = True,
         try:
             partials.append(
                 _run_slice(plan, lo, hi, lx32, ly32, rx32, ry32,
-                           use_device, dev, want_pairs)
+                           use_device, dev, want_pairs,
+                           lz32=lz32, rz32=rz32)
             )
         except BaseException as e:
             from geomesa_tpu.resilience import QueryTimeoutError
@@ -413,17 +457,22 @@ def execute(plan: JoinPlan, lx, ly, rx, ry, prefer_device: bool = True,
 
 
 def _run_slice(plan: JoinPlan, lo: int, hi: int, lx32, ly32, rx32, ry32,
-               use_device: bool, dev, want_pairs: bool):
+               use_device: bool, dev, want_pairs: bool,
+               lz32=None, rz32=None):
     """One tile slice: (pairs int64 [k, 2] in tile order, match count)."""
-    (lxb, lyb, rxb, ryb, lval, rval, Cp, C) = _pad_tiles(
-        plan, lo, hi, lx32, ly32, rx32, ry32
+    (lxb, lyb, rxb, ryb, lval, rval, Cp, C, lzb, rzb) = _pad_tiles(
+        plan, lo, hi, lx32, ly32, rx32, ry32, lz32, rz32
     )
     if use_device:
         import jax
 
         go = _pairs_kernel(plan.Bp, plan.Pp, Cp, plan.predicate)
-        ops = (lxb, lyb, rxb, ryb, lval, rval,
-               np.float32(plan.p0), np.float32(plan.p1))
+        if plan.predicate == kjoin.JOIN_DWITHIN_METERS:
+            ops = (lxb, lyb, lzb, rxb, ryb, rzb, lval, rval,
+                   np.float32(plan.p0), np.float32(plan.p1))
+        else:
+            ops = (lxb, lyb, rxb, ryb, lval, rval,
+                   np.float32(plan.p0), np.float32(plan.p1))
         if dev is not None:
             ops = tuple(jax.device_put(o, dev) for o in ops)
         with tracing.span("scan.join.pairs", tiles=C, device=getattr(
@@ -438,6 +487,8 @@ def _run_slice(plan: JoinPlan, lo: int, hi: int, lx32, ly32, rx32, ry32,
             lxb[:, :, None], lyb[:, :, None],
             rxb[:, None, :], ryb[:, None, :],
             plan.predicate, plan.p0, plan.p1, np,
+            lz=None if lzb is None else lzb[:, :, None],
+            rz=None if rzb is None else rzb[:, None, :],
         )
         iota_b = np.arange(plan.Bp, dtype=np.int32)[None, :, None]
         iota_p = np.arange(plan.Pp, dtype=np.int32)[None, None, :]
@@ -464,28 +515,78 @@ def _jax_ok() -> bool:
         return False
 
 
+def meters_reach_deg(distance_m: float, lat) -> Tuple[np.ndarray, float]:
+    """Conservative lon/lat reach (degrees) of ``distance_m`` meters of
+    great-circle distance around probe rows at latitudes ``lat`` —
+    ``(reach_x [per-row], reach_y)`` for the dwithin_meters strip
+    (docs/JOIN.md §10: latitude-dependent lon reach). The lat reach is
+    the central angle exactly; the lon reach is the maximal longitude
+    span of the spherical circle, ``arcsin(sin θ / cos φ)``, going full
+    wrap (360°) where the circle reaches a pole (sin θ >= cos φ) — the
+    only regime where a partner's longitude is unconstrained."""
+    theta = float(distance_m) / kjoin.EARTH_RADIUS_M  # central angle, rad
+    reach_y = float(np.degrees(theta))
+    if theta >= np.pi / 2:
+        return np.full(np.shape(lat), 360.0), reach_y
+    cphi = np.cos(np.deg2rad(np.asarray(lat, np.float64)))
+    s = np.sin(theta)
+    safe = s < cphi
+    reach_x = np.where(
+        safe,
+        np.degrees(np.arcsin(np.minimum(s / np.maximum(cphi, 1e-300), 1.0))),
+        360.0,
+    )
+    return reach_x, reach_y
+
+
 def run_join(lx, ly, rx, ry, predicate: str, distance=None, dx=None,
              dy=None, level: Optional[int] = None,
              prefer_device: bool = True, want_pairs: bool = True):
     """Full co-partitioned join: plan + execute. Returns
     ``(pairs, total, stats)``. ``predicate``: ``"bbox"`` (half-widths
-    ``dx``/``dy``) or ``"dwithin"`` (planar degree ``distance``) — see
-    :func:`geomesa_tpu.kernels.join.pair_mask` for the exact semantics."""
+    ``dx``/``dy``), ``"dwithin"`` (planar degree ``distance``), or
+    ``"dwithin_meters"`` (haversine great-circle ``distance`` meters) —
+    see :func:`geomesa_tpu.kernels.join.pair_mask` for the exact
+    semantics."""
     p0, p1 = kjoin.pair_params(predicate, distance=distance, dx=dx, dy=dy)
+    wrap_x = False
     if predicate == kjoin.JOIN_BBOX:
         reach_x, reach_y = float(p0), float(p1)
+    elif predicate == kjoin.JOIN_DWITHIN_METERS:
+        # latitude-dependent lon reach; the great circle wraps the
+        # antimeridian, so the strip does too
+        reach_x, reach_y = meters_reach_deg(float(distance), ry)
+        wrap_x = True
     else:
         reach_x = reach_y = float(distance)
     with tracing.span("scan.join.partition"):
         plan = co_partition(lx, ly, rx, ry, predicate, reach_x, reach_y,
-                            level=level, p0=p0, p1=p1)
+                            level=level, p0=p0, p1=p1, wrap_x=wrap_x)
     st = plan.stats
     metrics.inc(metrics.JOIN_CELLS, st.cells_joint)
     metrics.inc(metrics.JOIN_CANDIDATE_PAIRS, st.candidate_pairs)
     tracing.add_cost("join_cells", float(st.cells_joint))
     tracing.add_cost("join_candidate_pairs", float(st.candidate_pairs))
-    pairs, total = execute(plan, lx, ly, rx, ry,
-                           prefer_device=prefer_device,
-                           want_pairs=want_pairs)
+    pairs, total = execute_predicate(plan, lx, ly, rx, ry, predicate,
+                                     prefer_device=prefer_device,
+                                     want_pairs=want_pairs)
     metrics.inc(metrics.JOIN_PAIRS, total)
     return pairs, total, st
+
+
+def execute_predicate(plan: JoinPlan, lx, ly, rx, ry, predicate: str,
+                      prefer_device: bool = True, want_pairs: bool = True):
+    """:func:`execute` with the predicate's operand convention applied:
+    ``dwithin_meters`` runs on precomputed f32 unit vectors — host trig
+    once, shared by kernel and reference (kernels.join.unit_vectors) —
+    every other predicate passes lon/lat straight through. The one
+    dispatch both :func:`run_join` and ``explain_join(analyze=True)``
+    share, so they cannot drift."""
+    if predicate == kjoin.JOIN_DWITHIN_METERS:
+        lux, luy, luz = kjoin.unit_vectors(lx, ly)
+        rux, ruy, ruz = kjoin.unit_vectors(rx, ry)
+        return execute(plan, lux, luy, rux, ruy,
+                       prefer_device=prefer_device,
+                       want_pairs=want_pairs, lz=luz, rz=ruz)
+    return execute(plan, lx, ly, rx, ry, prefer_device=prefer_device,
+                   want_pairs=want_pairs)

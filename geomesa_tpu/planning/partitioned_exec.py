@@ -149,14 +149,83 @@ class PartitionedExecutor:
                 tracing.add_cost("bytes_staged", float(staged))
             metrics.inc(metrics.PIPELINE_PREFETCH)
 
-    def _children(self, plan: QueryPlan, bins: Optional[List[int]] = None):
+    # -- lake row-group pushdown (docs/LAKE.md) ----------------------------
+    def _push_window(self, plan: QueryPlan) -> Optional[Dict]:
+        """The plan's conservative spatial/temporal bounds as a lake
+        pruning window, or None when pushdown cannot engage (disabled,
+        sampling hints — the 1-in-n counter is row-set dependent — or a
+        filter that constrains neither axis). Extraction reuses the same
+        ``ir.extract_*`` machinery partition/file pruning already trusts:
+        a row group whose statistics are disjoint from every extracted
+        bound provably holds no matching row."""
+        if not config.LAKE_PUSHDOWN.to_bool():
+            return None
+        h = plan.hints
+        if h.sampling is not None or h.sample_by is not None:
+            return None
+        ft = self.store.ft
+        boxes = times = None
+        geom = ft.geom_field
+        if geom is not None and ft.attr(geom).is_point:
+            fv = ir.extract_geometries(plan.filter, geom)
+            if fv.disjoint:
+                boxes = []
+            elif not fv.is_empty:
+                boxes = [tuple(float(v) for v in g.bounds())
+                         for g in fv.values]
+        dtg = ft.dtg_field
+        if dtg is not None:
+            iv = ir.extract_intervals(plan.filter, dtg)
+            if iv.disjoint:
+                times = []
+            elif not iv.is_empty:
+                inf = float("inf")
+                times = [
+                    (-inf if lo is None else float(lo),
+                     inf if hi is None else float(hi))
+                    for lo, hi in iv.values
+                ]
+        if boxes is None and times is None:
+            return None
+        return {"index": plan.index_name, "boxes": boxes, "times": times}
+
+    def _get_child(self, b: int, window: Optional[Dict]):
+        """Load one partition for the scan: statistics-pruned ephemeral
+        child when a window is pushed down, the ordinary resident load
+        otherwise (and always on plain FeatureStore children)."""
+        if window is not None:
+            sc = getattr(self.store, "scan_child", None)
+            if sc is not None:
+                return sc(b, window)
+        return self.store.child(b)
+
+    def _note_lake(self, plan: QueryPlan, note: Dict) -> None:
+        """Fold one pruned partial load's account into the plan (explain
+        ``exec_path``, the audit event, and the per-query cost ledger)."""
+        acct = plan.__dict__.setdefault("lake_acct", {
+            "groups_total": 0, "groups_loaded": 0, "groups_pruned": 0,
+            "bytes_payload": 0, "bytes_loaded": 0, "bytes_skipped": 0,
+        })
+        for k in acct:
+            acct[k] += int(note.get(k, 0))
+        plan.__dict__.setdefault("exec_path", {})["lake"] = (
+            f"{acct['groups_loaded']}/{acct['groups_total']} rowgroups, "
+            f"{acct['bytes_loaded']}/{acct['bytes_payload']} bytes"
+        )
+        tracing.add_cost("lake_bytes_read", float(note["bytes_loaded"]))
+        tracing.add_cost("lake_bytes_skipped",
+                         float(note["bytes_skipped"]))
+        metrics.inc(metrics.LAKE_PUSHDOWN_SCANS)
+
+    def _children(self, plan: QueryPlan, bins: Optional[List[int]] = None,
+                  window: Optional[Dict] = None):
         """(bin, child) over pruned partitions through the serial
         (one-staging-slot) prefetch pipeline — see :meth:`_pipeline`.
         ``bins`` overrides the plan's own pruning (the query-axis batch
         path scans the UNION of its members' pruned bins)."""
         if bins is None:
             bins = self.prune(plan)
-        for _i, b, child in self._pipeline(plan, bins):
+        for _i, b, child in self._pipeline(plan, bins, window=window):
             yield b, child
 
     def _stage_device(self, child, plan: QueryPlan, dev) -> None:
@@ -179,7 +248,8 @@ class PartitionedExecutor:
         t.device_columns(tuple(names), pdev.device_sharding(dev))
         metrics.inc(metrics.PIPELINE_DEVICE_PUT)
 
-    def _pipeline(self, plan: QueryPlan, bins: List[int], devs=None):
+    def _pipeline(self, plan: QueryPlan, bins: List[int], devs=None,
+                  window: Optional[Dict] = None):
         """(i, bin, child) over pruned partitions — THE prefetch
         pipeline, serial and sharded in one body. With
         ``geomesa.pipeline.prefetch`` (default on), a single worker
@@ -207,10 +277,14 @@ class PartitionedExecutor:
         if len(bins) < 2 or not config.PIPELINE_PREFETCH.to_bool():
             for i, b in enumerate(bins):
                 try:
-                    child = self.store.child(b)
+                    child = self._get_child(b, window)
                 except BaseException as e:
                     self._contain_load(plan, b, e)
                     continue
+                if child is not None:
+                    note = child.__dict__.get("_lake_note")
+                    if note is not None:
+                        self._note_lake(plan, note)
                 yield i, b, child
             return
         out: "queue.Queue" = queue.Queue()
@@ -238,7 +312,7 @@ class PartitionedExecutor:
                         attrs["device"] = int(dev.id)
                     child = err = None
                     try:
-                        child = self.store.child(b)
+                        child = self._get_child(b, window)
                     except BaseException as e:
                         err = e  # a LOAD failure: _contain_load decides
                     if err is None and child is not None:
@@ -283,6 +357,13 @@ class PartitionedExecutor:
                 if err is not None:
                     self._contain_load(plan, b, err)
                     continue
+                if child is not None:
+                    # lake accounting folds on the CONSUMER thread — the
+                    # plan dict is single-thread-mutated like every other
+                    # counter (the worker only loads)
+                    note = child.__dict__.get("_lake_note")
+                    if note is not None:
+                        self._note_lake(plan, note)
                 yield i, b, child
         finally:
             stop.set()
@@ -413,7 +494,8 @@ class PartitionedExecutor:
         plan.__dict__.setdefault("degraded", []).append(rec)
 
     def _sharded_scan(self, plan: QueryPlan, op: str, dispatch, finish,
-                      devs, bins: List[int]) -> None:
+                      devs, bins: List[int],
+                      window: Optional[Dict] = None) -> None:
         """Round-robin fan-out of one additive op over ``devs``:
         ``dispatch(ex)`` runs per pruned partition against an executor
         pinned to the partition's device (it must return WITHOUT forcing
@@ -472,7 +554,8 @@ class PartitionedExecutor:
 
         tot_scanned = tot_rows = 0
         try:
-            for i, b, child in self._pipeline(plan, bins, devs):
+            for i, b, child in self._pipeline(plan, bins, devs,
+                                              window=window):
                 check_deadline()
                 if child is None or child.count == 0:
                     continue
@@ -525,7 +608,8 @@ class PartitionedExecutor:
         )
 
     def _additive_scan(self, plan: QueryPlan, op: str, dispatch,
-                       finish, bins: Optional[List[int]] = None) -> None:
+                       finish, bins: Optional[List[int]] = None,
+                       push: bool = False) -> None:
         """Drive one additive op over the pruned partitions, delivering
         each partition's partial to ``finish(bin, partial, merge_device)``
         in pruned-bin order. The sharded fan-out serves when it engages
@@ -537,27 +621,38 @@ class PartitionedExecutor:
         a device failure surfacing at sync time skips that partition
         with exact survivor totals instead of failing the query under
         ``allow_partial()``. ``bins`` overrides the plan's pruning (the
-        query-axis batch path scans its members' pruned-bin UNION)."""
+        query-axis batch path scans its members' pruned-bin UNION).
+
+        ``push=True``: the op's partial merge is exact over any superset
+        of the matching rows (count / unweighted density / unweighted
+        density_curve / stats), so spilled lake partitions may serve a
+        statistics-pruned PARTIAL load (docs/LAKE.md) — row groups whose
+        bbox/time statistics are disjoint from the plan's bounds never
+        leave disk, and the surviving groups decode into the same
+        prefetch pipeline bit-identically."""
+        window = self._push_window(plan) if push else None
         devs = self._scan_devices()
         if devs is not None:
             if bins is None:
                 bins = self.prune(plan)
             if len(bins) >= 2:
-                self._sharded_scan(plan, op, dispatch, finish, devs, bins)
+                self._sharded_scan(plan, op, dispatch, finish, devs, bins,
+                                   window=window)
                 return
-        for b, ex in self._each(plan, bins=bins):
+        for b, ex in self._each(plan, bins=bins, window=window):
             r = self._scan_part(plan, b, op, lambda: dispatch(ex))
             if r is not _SKIPPED and r is not None:
                 self._scan_part(plan, b, op, lambda: finish(b, r, None),
                                 probe=False, spanned=False)
 
     def _each(self, plan: QueryPlan,
-              bins: Optional[List[int]] = None) -> Iterator[Tuple[int, Executor]]:
+              bins: Optional[List[int]] = None,
+              window: Optional[Dict] = None) -> Iterator[Tuple[int, Executor]]:
         """Stream (bin, executor) over pruned partitions under the residency
         budget; accumulates the selectivity counters across partitions."""
         tot_scanned = tot_rows = 0
         try:
-            for b, child in self._children(plan, bins):
+            for b, child in self._children(plan, bins, window=window):
                 check_deadline()
                 if child is None or child.count == 0:
                     continue
@@ -639,6 +734,7 @@ class PartitionedExecutor:
         self._additive_scan(
             plan, "count", lambda ex: ex.count_partial(plan),
             lambda b, p, mdev: totals.append(int(p)),
+            push=True,
         )
         return sum(totals)
 
@@ -664,6 +760,10 @@ class PartitionedExecutor:
             lambda ex: ex.density(plan, bbox, width, height, weight,
                                   as_numpy=False),
             finish,
+            # unweighted grids are integer-valued (exact adds); weighted
+            # grids keep full loads — a NaN/-0.0 weight on a pruned-away
+            # non-matching row could still perturb the masked scatter
+            push=weight is None,
         )
         out = red.result()
         if out is None:
@@ -682,6 +782,7 @@ class PartitionedExecutor:
             lambda ex: ex.density_curve_raw(plan, level, block_window,
                                             weight),
             lambda b, p, mdev: red.push(Executor.decode_curve(p)),
+            push=weight is None,  # see density: integer block counts only
         )
         out = red.result()
         if out is None:
@@ -934,9 +1035,10 @@ class PartitionedExecutor:
                 lambda b, p, mdev: kstats.absorb_partials(
                     stat, p, self.store.dicts
                 ),
+                push=True,  # sketches observe only matching rows
             )
             return stat
-        for b, ex in self._each(plan):
+        for b, ex in self._each(plan, window=self._push_window(plan)):
             self._scan_part(plan, b, "stats", lambda: ex.stats(plan, stat))
         return stat
 

@@ -425,6 +425,28 @@ def breaker(name: str, **kw) -> CircuitBreaker:
         return b
 
 
+def guarded_root_io(root: str, fn):
+    """Run one storage-root I/O under the root's ``fs.root:<abspath>``
+    circuit breaker (docs/RESILIENCE.md; the remote-root treatment of
+    the lake tier, docs/LAKE.md): an open circuit fences fast, transient
+    ``OSError``s charge the breaker, success resets it. A
+    ``FileNotFoundError`` is the caller's business (a missing file says
+    nothing about the mount) and never charges. THE one copy of this
+    sequence — fs/storage.py and io/arrow_store.py both route here."""
+    import os as _os
+
+    br = breaker("fs.root:" + _os.path.abspath(root))
+    br.allow()  # raises CircuitOpenError while the root is fenced
+    try:
+        out = fn()
+    except OSError as e:
+        if not isinstance(e, FileNotFoundError):
+            br.record_failure()
+        raise
+    br.record_success()
+    return out
+
+
 def reset_breakers() -> None:
     """Drop all registered breakers (test isolation)."""
     with _breakers_lock:

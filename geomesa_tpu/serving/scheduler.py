@@ -281,12 +281,17 @@ class QueryScheduler:
         self._slot_gen: Dict[int, int] = {}
         self._last_supervise = 0.0
         #: pool-aware fusion placement (docs/SERVING.md §5c, guarded by
-        #: _cv): schema -> the slot whose device most recently scanned
-        #: that schema's columns (they are still resident there), and the
-        #: set of slots currently blocked in the dispatch wait (only an
-        #: IDLE preferred slot is worth deferring a group toward — a busy
-        #: one would serialize the pool for a column re-upload it saves)
-        self._schema_heat: Dict[str, int] = {}
+        #: _cv): schema -> {slot -> last dispatch time} — every slot that
+        #: ever scanned the schema, ranked at defer time by ACTUAL column
+        #: residency (the probe below) with recency as the tiebreak — and
+        #: the set of slots currently blocked in the dispatch wait (only
+        #: an IDLE preferred slot is worth deferring a group toward — a
+        #: busy one would serialize the pool for a transfer it saves)
+        self._schema_heat: Dict[str, Dict[int, float]] = {}
+        #: residency probe (GeoDataset wires one): (schema, slot) ->
+        #: device-resident column bytes for that schema on that slot's
+        #: device RIGHT NOW. None falls back to pure recency ranking.
+        self._residency_probe: Optional[Callable[[str, int], int]] = None
         self._idle: set = set()
         self._tls = threading.local()
 
@@ -1130,6 +1135,53 @@ class QueryScheduler:
             self._tls.user = prev
 
     # -- pool-aware fusion placement (docs/SERVING.md §5c) -----------------
+    def set_residency_probe(self, fn: Optional[Callable[[str, int], int]]
+                            ) -> None:
+        """Install the column-residency probe the placement ranking
+        consults: ``fn(schema, slot)`` returns the schema's device-
+        resident column bytes on that slot's device *right now*.
+        GeoDataset wires one over its stores' device caches; without a
+        probe the ranking degrades to pure recency (the pre-residency
+        "last slot that dispatched the schema" behavior). The probe runs
+        under the scheduler lock on dispatch threads — it must be cheap
+        metadata reads only (no jit, no locks, no device sync)."""
+        with self._cv:
+            self._residency_probe = fn
+
+    def _rank_slot_locked(self, schema: str, slot: int) -> Optional[int]:
+        """Best candidate slot for ``schema`` — ranked by ACTUAL column
+        residency (probe bytes), recency breaking ties — or None when no
+        candidate beats dispatching on ``slot`` itself. Candidates are
+        the slots that ever scanned the schema; dead slots fall out.
+        On wide pools a schema's columns routinely survive on a slot
+        that was NOT the last dispatcher (another schema's group ran
+        there since) — the probe finds them where recency cannot
+        (docs/SERVING.md §9 residency ranking)."""
+        heat = self._schema_heat.get(schema)
+        if not heat:
+            return None
+        probe = self._residency_probe
+        alive = [s for s in heat if s in self._threads]
+        if not alive:
+            return None
+
+        # one probe call per candidate (the probe walks device-column
+        # caches under the scheduler lock — never re-walk inside max())
+        def score(s: int):
+            res = 0
+            if probe is not None:
+                try:
+                    res = int(probe(schema, s))
+                except Exception:
+                    res = 0  # a torn cache walk must never fail dispatch
+            return (res, heat.get(s, float("-inf")))
+
+        scores = {s: score(s) for s in set(alive) | {slot}}
+        best = max(alive, key=scores.__getitem__)
+        if best == slot or scores[best] <= scores[slot]:
+            return None  # this slot is already the best (or tied) home
+        return best
+
     def _placement_grace_s(self) -> float:
         g = config.SERVING_PLACEMENT_GRACE_MS.to_int()
         return (50 if g is None else max(g, 0)) / 1e3
@@ -1146,27 +1198,29 @@ class QueryScheduler:
 
     def _defer_for_placement_locked(self, head: Ticket, slot: int,
                                     now: float) -> bool:
-        """Defer a fuse-bearing head toward the slot whose device most
-        recently scanned its schema's columns — they are still resident
-        there, so the fused group's device_put is a cache hit instead of
-        a re-upload. Only defers ONCE per ticket, only when the preferred
-        slot is alive and IDLE (deferring to a busy slot would serialize
-        the pool to save one transfer), and records the decision on the
-        FuseSpec for the group span (serving/fuse.py)."""
+        """Defer a fuse-bearing head toward the slot whose device holds
+        the most of its schema's columns RIGHT NOW (residency-ranked via
+        the probe, recency as tiebreak) — the fused group's device_put is
+        then a cache hit instead of a re-upload. Only defers ONCE per
+        ticket, only when the preferred slot is alive and IDLE (deferring
+        to a busy slot would serialize the pool to save one transfer),
+        and records the decision on the FuseSpec for the group span
+        (serving/fuse.py)."""
         if (head.fuse is None or head.fuse.schema is None
                 or head.continuation or head.defer_slot is not None
                 or len(self._threads) <= 1
                 or not config.SERVING_PLACEMENT.to_bool()):
             return False
-        pref = self._schema_heat.get(head.fuse.schema)
-        if pref is None or pref == slot or pref not in self._threads \
-                or pref not in self._idle:
+        pref = self._rank_slot_locked(head.fuse.schema, slot)
+        if pref is None or pref not in self._idle:
             return False
         head.defer_slot = pref
         head.defer_at = now
         head.fuse.placement = {
             "preferred": pref, "deferred_from": slot,
-            "reason": "column-heat",
+            "reason": ("column-residency"
+                       if self._residency_probe is not None
+                       else "column-heat"),
         }
         metrics.inc(metrics.SERVING_PLACEMENT_DEFER)
         self._cv.notify_all()  # wake the preferred (idle) slot
@@ -1174,10 +1228,13 @@ class QueryScheduler:
 
     def _note_heat_locked(self, group: List[Ticket], slot: int) -> None:
         """Record which slot's device just scanned each fused schema —
-        the placement policy's column-heat table."""
+        the candidate set (and recency tiebreak) of the residency-ranked
+        placement table."""
         for t in group:
             if t.fuse is not None and t.fuse.schema is not None:
-                self._schema_heat[t.fuse.schema] = slot
+                self._schema_heat.setdefault(
+                    t.fuse.schema, {}
+                )[slot] = time.perf_counter()
                 if t.fuse.placement is not None \
                         and "slot" not in t.fuse.placement:
                     t.fuse.placement["slot"] = slot

@@ -122,6 +122,7 @@ def test_epoch_invalidation_insert_delete(ds):
 
 # -- density ---------------------------------------------------------------
 
+@pytest.mark.slow  # compile-heavy sweep: gated by the lake-smoke CI job
 def test_density_unweighted_bit_identical(ds):
     bbox = (-22.5, -22.5, 22.5, 22.5)
     cold = ds.density("pts", Q1, bbox=bbox, width=96, height=64)
@@ -136,6 +137,7 @@ def test_density_unweighted_bit_identical(ds):
     )
 
 
+@pytest.mark.slow  # compile-heavy sweep: gated by the lake-smoke CI job
 def test_density_partial_reuse_under_fixed_raster(ds):
     """A raster decoupled from the filter bbox (dashboard/WMS-overview
     shape) decomposes; overlapping filters then reuse cells."""
@@ -217,6 +219,7 @@ def test_density_curve_whole_result_cache(ds):
 
 # -- stats -----------------------------------------------------------------
 
+@pytest.mark.slow  # compile-heavy sweep: gated by the lake-smoke CI job
 def test_stats_exact_merge_kinds_identical(ds):
     spec = "Count();MinMax(weight);Enumeration(type)"
     cold = ds.stats("pts", spec, Q1).value()
@@ -385,6 +388,7 @@ def test_explain_reports_cache_participation(ds):
 
 # -- partitioned stores -----------------------------------------------------
 
+@pytest.mark.slow  # compile-heavy sweep: gated by the lake-smoke CI job
 def test_partitioned_store_cache(rng):
     ds = GeoDataset(n_shards=2)
     ds.create_schema(

@@ -510,7 +510,15 @@ def test_cordon_drains_excess_slots_and_rejects_their_streams():
             for did in range(1, 8):
                 reg.cordon(did, reason="shrink")
             out = s.supervise()
-            assert 1 in out["draining"]
+            # a dispatcher wake-up's own supervision round (they run on
+            # every wake-up) may have drained slot 1 before this explicit
+            # call — or the drained slot may already have exited entirely
+            # (it removes itself from _threads and _draining). Either
+            # way: draining now, or already gone.
+            with s._cv:
+                draining = set(out["draining"]) | set(s._draining)
+                gone = 1 not in s._threads
+            assert 1 in draining or gone
             for _ in range(200):
                 if s.snapshot()["executors"] == 1:
                     break

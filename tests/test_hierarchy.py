@@ -133,6 +133,7 @@ def test_zoomout_assembles_when_rollup_missing(ds):
         assert ds.count("pts", WORLD) == cold  # whole-result repeat
 
 
+@pytest.mark.slow  # compile-heavy sweep: gated by the lake-smoke CI job
 def test_zoomout_density_and_stats_bit_identical(ds):
     # raster decoupled from every filter bbox (dashboard shape), so the
     # density cells decompose and the zoom-out assembles; the filters are
@@ -291,6 +292,7 @@ def test_polygon_with_hole_and_multipolygon(ds):
             assert ds.count("pts", q) == cold
 
 
+@pytest.mark.slow  # compile-heavy sweep: gated by the lake-smoke CI job
 def test_polygon_partitioned_store_residual_fans_out(rng):
     """Boundary scans ride the ordinary planner/executor — on a
     partitioned store that is the partitioned (and, meshed, sharded)

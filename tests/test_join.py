@@ -51,11 +51,15 @@ def _ref(ds, predicate, left="a", right="b", lq="INCLUDE", rq="INCLUDE",
          **kw):
     p0, p1 = kjoin.pair_params(predicate, **kw)
     lfc, rfc = ds.query(left, lq), ds.query(right, rq)
-    return kjoin.brute_force_pairs(
-        lfc.batch.columns["geom__x"], lfc.batch.columns["geom__y"],
-        rfc.batch.columns["geom__x"], rfc.batch.columns["geom__y"],
-        predicate, p0, p1,
-    )
+    lx, ly = lfc.batch.columns["geom__x"], lfc.batch.columns["geom__y"]
+    rx, ry = rfc.batch.columns["geom__x"], rfc.batch.columns["geom__y"]
+    if predicate == kjoin.JOIN_DWITHIN_METERS:
+        lux, luy, luz = kjoin.unit_vectors(lx, ly)
+        rux, ruy, ruz = kjoin.unit_vectors(rx, ry)
+        return kjoin.brute_force_pairs(
+            lux, luy, rux, ruy, predicate, p0, p1, lz=luz, rz=ruz,
+        )
+    return kjoin.brute_force_pairs(lx, ly, rx, ry, predicate, p0, p1)
 
 
 # ---------------------------------------------------------------------------
@@ -66,6 +70,7 @@ def _ref(ds, predicate, left="a", right="b", lq="INCLUDE", rq="INCLUDE",
 @pytest.mark.parametrize("predicate,kw", [
     ("dwithin", {"distance": 0.35}),
     ("bbox", {"dx": 0.25, "dy": 0.15}),
+    ("dwithin_meters", {"distance": 30_000.0}),
 ])
 def test_join_bit_identical_vs_brute_force(predicate, kw):
     ds = _mkds()
@@ -84,11 +89,81 @@ def test_join_device_matches_host_path():
     ds_host = _mkds(seed=21)
     ds_host.prefer_device = False
     for predicate, kw in (("dwithin", {"distance": 0.3}),
-                          ("bbox", {"dx": 0.2, "dy": 0.2})):
+                          ("bbox", {"dx": 0.2, "dy": 0.2}),
+                          ("dwithin_meters", {"distance": 25_000.0})):
         a = ds_dev.join("a", "b", predicate=predicate, **kw)
         b = ds_host.join("a", "b", predicate=predicate, **kw)
         assert a.count == b.count
         assert np.array_equal(a.pairs, b.pairs)
+
+
+def test_join_dwithin_meters_antimeridian_and_pole():
+    """The great-circle predicate matches across lon ±180 and over the
+    pole — the strip machinery's modular lon windows and full-wrap
+    high-latitude reach must probe those cells (planar predicates never
+    face this: |lx-rx| does not wrap)."""
+    ds = GeoDataset()
+    ds.create_schema("a", "name:String,*geom:Point")
+    ds.create_schema("b", "tag:String,*geom:Point")
+    rng = np.random.default_rng(11)
+    n = 400
+    # half the rows hug the antimeridian (both signs), a band sits near
+    # the north pole, the rest scatter mid-latitudes
+    def side(seed):
+        r = np.random.default_rng(seed)
+        lon = np.concatenate([
+            r.uniform(179.0, 180.0, n // 4),
+            r.uniform(-180.0, -179.0, n // 4),
+            r.uniform(-170.0, 170.0, n // 4),
+            r.uniform(-180.0, 180.0, n - 3 * (n // 4)),
+        ])
+        lat = np.concatenate([
+            r.uniform(55.0, 60.0, n // 4),
+            r.uniform(55.0, 60.0, n // 4),
+            r.uniform(-45.0, 45.0, n // 4),
+            r.uniform(88.5, 90.0, n - 3 * (n // 4)),
+        ])
+        return lon, lat
+    ax, ay = side(1)
+    bx, by = side(2)
+    ds.insert("a", {"name": ["x"] * n, "geom": list(zip(ax, ay))})
+    ds.insert("b", {"tag": ["y"] * n, "geom": list(zip(bx, by))})
+    ds.flush()
+    for d in (20_000.0, 150_000.0):
+        res = ds.join("a", "b", predicate="dwithin_meters", distance=d)
+        ref = _ref(ds, "dwithin_meters", distance=d)
+        assert res.count == len(ref)
+        assert np.array_equal(res.pairs, ref)
+        # cross-antimeridian pairs actually exist in this layout (the
+        # test would vacuously pass without them)
+        lons = ax[ref[:, 0]], bx[ref[:, 1]]
+        assert (np.abs(lons[0] - lons[1]) > 300).any()
+    # explain_join(analyze=True) shares run_join's operand dispatch —
+    # dwithin_meters analyzes on unit vectors, counting identically
+    ex = ds.explain_join("a", "b", predicate="dwithin_meters",
+                         distance=150_000.0, analyze=True)
+    want = ds.join_count("a", "b", predicate="dwithin_meters",
+                         distance=150_000.0)
+    assert f"matched (analyze): {want}" in ex
+
+
+def test_join_dwithin_meters_inclusive_edge_exact():
+    """A pair at EXACTLY the f32 chord threshold decides inclusively —
+    and identically — in kernel and reference (the <= contract)."""
+    # two points d meters apart along the equator: arc == lon delta
+    d = 10_000.0
+    ddeg = np.degrees(d / kjoin.EARTH_RADIUS_M)
+    ds = GeoDataset()
+    ds.create_schema("a", "name:String,*geom:Point")
+    ds.create_schema("b", "tag:String,*geom:Point")
+    ds.insert("a", {"name": ["p"], "geom": [(10.0, 0.0)]})
+    ds.insert("b", {"tag": ["q", "r"],
+                    "geom": [(10.0 + ddeg, 0.0), (10.0 + 3 * ddeg, 0.0)]})
+    ds.flush()
+    res = ds.join("a", "b", predicate="dwithin_meters", distance=d)
+    ref = _ref(ds, "dwithin_meters", distance=d)
+    assert np.array_equal(res.pairs, ref)
+    assert res.count == len(ref) <= 1  # the 3d point never matches
 
 
 def test_join_cell_edge_and_inclusive_equality_pairs():

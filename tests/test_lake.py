@@ -1,0 +1,458 @@
+"""Columnar geo-lake tier (geomesa_tpu/lake/; docs/LAKE.md).
+
+Tier-1 contracts:
+
+* **format**: encode/decode round-trips are BIT-IDENTICAL for every
+  column dtype the store spills (seeded property walk); the container
+  detects truncation, torn footers, and flipped payload bytes as
+  ``LakeCorruptError`` (never as garbage data);
+* **scan identity**: a lake-backed partitioned scan is bit-identical to
+  the legacy npz-backed scan for count/density/density_curve/stats —
+  same filters, same 8-virtual-device mesh;
+* **pushdown**: a selective bbox over spilled lake partitions loads
+  < 30% of the payload bytes (row-group statistics pruning), still
+  bit-identical to the full load;
+* **quarantine**: a corrupt footer and a corrupt row group both
+  quarantine exactly the damaged bin (transient OSErrors never do), and
+  ``clear_spill_quarantine`` re-admits after repair;
+* **cache persistence**: persisted flat-cell/hierarchy entries restore
+  into a freshly loaded process and answer a warm zoom-out with ZERO
+  device dispatches;
+* **fs resilience**: a repeatedly failing storage root trips its
+  circuit breaker (fenced fast) and heals on success.
+"""
+
+import contextlib
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import config, metrics, resilience
+from geomesa_tpu.api.dataset import GeoDataset, Query
+from geomesa_tpu.filter.ecql import parse_iso_ms
+from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+from geomesa_tpu.lake.format import (
+    LakeCorruptError, LakeFile, LakeWriter, decode_array, encode_array,
+)
+from geomesa_tpu.lake.snapshot import SNAPSHOT_FILE, PartitionSnapshot
+
+SPEC = "name:String:index=true,weight:Double,dtg:Date,*geom:Point"
+PSPEC = SPEC + ";geomesa.partition='time'"
+
+
+def _counter(name: str) -> int:
+    return metrics.registry().counter(name).value
+
+
+def _data(n, seed=11, clustered=False):
+    rng = np.random.default_rng(seed)
+    if clustered:
+        # hotspots: selective bboxes prune most row groups
+        cx = rng.uniform(-115, -75, 10)
+        cy = rng.uniform(28, 47, 10)
+        k = rng.integers(0, 10, n)
+        x = np.clip(cx[k] + rng.normal(0, 0.25, n), -120, -70)
+        y = np.clip(cy[k] + rng.normal(0, 0.25, n), 25, 50)
+    else:
+        x = rng.uniform(-120, -70, n)
+        y = rng.uniform(25, 50, n)
+    return {
+        "name": [f"actor{i % 20}" for i in range(n)],
+        "weight": rng.uniform(0, 10, n),
+        "dtg": rng.integers(
+            parse_iso_ms("2020-01-01"), parse_iso_ms("2020-02-01"), n
+        ).astype("datetime64[ms]"),
+        "geom__x": x,
+        "geom__y": y,
+    }
+
+
+def _mkpart(tmp_path, n=20_000, seed=11, clustered=False, lake=True,
+            rowgroup=2048):
+    """A partitioned dataset with every partition spilled to disk."""
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(
+            config.LAKE_ENABLED.scoped("true" if lake else "false"))
+        stack.enter_context(
+            config.LAKE_ROWGROUP_ROWS.scoped(str(rowgroup)))
+        ds = GeoDataset(n_shards=4)
+        ds.create_schema("t", PSPEC)
+        st = ds._store("t")
+        assert isinstance(st, PartitionedFeatureStore)
+        st._spill_dir = str(tmp_path / ("lake" if lake else "npz"))
+        ds.insert("t", _data(n, seed, clustered),
+                  fids=np.arange(n).astype(str))
+        ds.flush()
+        st.spill_all()
+    return ds, st
+
+
+# ---------------------------------------------------------------------------
+# format: encode/decode property walk + container integrity
+# ---------------------------------------------------------------------------
+
+
+def test_encode_decode_property_walk():
+    """Seeded walk over every spillable dtype x shape: bit-identical."""
+    rng = np.random.default_rng(3)
+    cases = []
+    for n in (0, 1, 7, 1000):
+        cases += [
+            np.sort(rng.integers(-(2**62), 2**62, n)),          # sorted i64
+            rng.integers(0, 2**31, n).astype(np.int32),
+            rng.integers(0, 255, n).astype(np.uint8),
+            rng.uniform(-1e9, 1e9, n),                           # f64
+            np.sort(rng.uniform(-180, 180, n)).astype(np.float32),
+            rng.uniform(0, 1, n) < 0.5,                          # bool
+            rng.integers(0, 10**12, n).astype("datetime64[ms]"),
+            np.asarray([f"s{i % 13}" for i in range(n)]),        # unicode
+            np.full(n, 42, np.int64),                            # constant
+        ]
+    # adversarial float payloads: NaN, inf, -0.0 must round-trip bits
+    cases.append(np.asarray([np.nan, np.inf, -np.inf, -0.0, 0.0, 1e-300]))
+    for a in cases:
+        meta, payload = encode_array(a)
+        b = decode_array(meta, payload)
+        assert b.dtype == a.dtype, meta
+        assert a.tobytes() == b.tobytes(), meta  # BIT identity incl NaN
+
+
+def test_container_round_trip_and_corruption_detection(tmp_path):
+    p = str(tmp_path / "x.lake")
+    w = LakeWriter(p)
+    refs = [w.add_array(np.arange(100, dtype=np.int64) * k)
+            for k in (1, 3, 7)]
+    w.finish({"kind": "test"})
+    f = LakeFile(p)
+    for k, r in zip((1, 3, 7), refs):
+        assert np.array_equal(f.read_array(r), np.arange(100) * k)
+    raw = open(p, "rb").read()
+    # truncation (lost tail) and a torn footer both fail structurally
+    open(p, "wb").write(raw[: len(raw) // 2])
+    with pytest.raises(LakeCorruptError):
+        LakeFile(p)
+    open(p, "wb").write(raw[:1] + b"X" + raw[2:])  # head magic
+    with pytest.raises(LakeCorruptError):
+        LakeFile(p)
+    # a flipped PAYLOAD byte passes open (footer intact) but fails the
+    # blob's crc at read time
+    off = len(b"GMLAKE01") + 5
+    open(p, "wb").write(raw[:off] + bytes([raw[off] ^ 0xFF])
+                        + raw[off + 1:])
+    f = LakeFile(p)
+    with pytest.raises(LakeCorruptError):
+        f.read_array(refs[0])
+
+
+# ---------------------------------------------------------------------------
+# scan identity: lake vs npz, all additive ops, sharded mesh included
+# ---------------------------------------------------------------------------
+
+SEL = ("BBOX(geom, -100, 30, -90, 40) AND "
+       "dtg DURING 2020-01-05T00:00:00Z/2020-01-20T00:00:00Z")
+
+
+@pytest.mark.slow  # gated in the lake-smoke CI job (runs unfiltered)
+def test_lake_vs_npz_scan_bit_identity(tmp_path):
+    lake, lst = _mkpart(tmp_path, lake=True)
+    npz, nst = _mkpart(tmp_path, lake=False)
+    assert glob.glob(str(tmp_path / "lake" / "*" / SNAPSHOT_FILE))
+    assert glob.glob(str(tmp_path / "npz" / "*" / "data.npz"))
+    for q in ("INCLUDE", SEL, "BBOX(geom, -95, 33, -88, 39)"):
+        with config.LAKE_ENABLED.scoped("true"):
+            assert lake.count("t", q) == npz.count("t", q)
+            dl = lake.density("t", q, (-120, 25, -70, 50), 64, 32)
+            dn = npz.density("t", q, (-120, 25, -70, 50), 64, 32)
+            assert np.array_equal(dl, dn)
+            cl = lake.density_curve("t", q, level=6)
+            cn = npz.density_curve("t", q, level=6)
+            assert np.array_equal(cl[0], cn[0])
+            assert np.array_equal(cl[1], cn[1])
+            sl = lake.stats("t", "MinMax(weight)", q)
+            sn = npz.stats("t", "MinMax(weight)", q)
+            assert sl.to_json() == sn.to_json()
+
+
+@pytest.mark.slow  # gated in the lake-smoke CI job (runs unfiltered)
+def test_lake_pushdown_loads_under_30pct_and_stays_exact(tmp_path):
+    """The acceptance gate: a selective bbox over clustered lake
+    partitions loads < 30% of total payload bytes, bit-identically."""
+    n = 24_000
+    lake, lst = _mkpart(tmp_path, n=n, clustered=True, lake=True,
+                        rowgroup=384)
+    npz, _ = _mkpart(tmp_path, n=n, clustered=True, lake=False)
+    total = sum(
+        PartitionSnapshot(d).payload_bytes(None)
+        for d in lst.spilled.values()
+    )
+    assert total > 0
+    # a tight box around one hotspot
+    hot = _data(n, seed=11, clustered=True)
+    hx, hy = hot["geom__x"][0], hot["geom__y"][0]
+    q = f"BBOX(geom, {hx - 0.4}, {hy - 0.4}, {hx + 0.4}, {hy + 0.4})"
+    before_skip = _counter(metrics.LAKE_BYTES_SKIPPED)
+    before_scans = _counter(metrics.LAKE_PUSHDOWN_SCANS)
+    with config.LAKE_ENABLED.scoped("true"):
+        got = lake.count("t", q)
+    assert got == npz.count("t", q)
+    assert _counter(metrics.LAKE_PUSHDOWN_SCANS) > before_scans
+    skipped = _counter(metrics.LAKE_BYTES_SKIPPED) - before_skip
+    fraction = 1.0 - skipped / total
+    assert fraction < 0.30, f"loaded {fraction:.2%} of payload bytes"
+
+
+def test_lake_pushdown_partial_load_never_cached_as_resident(tmp_path):
+    """A pruned partial load is EPHEMERAL: the next unwindowed query
+    must see the whole partition, not a pruned residue."""
+    lake, lst = _mkpart(tmp_path, n=8_000)
+    with config.LAKE_ENABLED.scoped("true"):
+        lake.count("t", "BBOX(geom, -100, 30, -99, 31)")
+        assert lake.count("t", "INCLUDE") == 8_000
+
+
+def test_lake_open_snapshot_survives_concurrent_respill(tmp_path):
+    """Lazy blob reads go through the handle the footer was parsed from:
+    a concurrent re-spill's rmtree + os.replace of the snapshot must not
+    turn an in-flight pruned read into a crc mismatch (which would
+    falsely quarantine a healthy partition). POSIX: the unlinked-but-
+    open fd keeps serving the old file's bytes."""
+    ds, st = _mkpart(tmp_path, n=4_000)
+    b = next(iter(st.spilled))
+    d = st.spilled[b]
+    snap = PartitionSnapshot(d)
+    want = {c: snap.read_column(c, [0]) for c in snap.columns[:1]}
+    # simulate the re-spill racing later lazy reads: the dir is rebuilt
+    import shutil as _sh
+    _sh.rmtree(d)
+    os.makedirs(d)
+    with open(os.path.join(d, SNAPSHOT_FILE), "wb") as fh:
+        fh.write(b"GMLAKE01" + b"\x00" * 64)  # different bytes entirely
+    for c, v in want.items():
+        got = snap.read_column(c, [0])  # still the OLD file's data
+        assert np.array_equal(got, v)
+    assert b not in st.spill_quarantine()
+
+
+def test_lake_fully_pruned_nonprimary_never_quarantines(tmp_path):
+    """A window that prunes EVERY row group on a non-primary index must
+    yield an empty ephemeral child — decoding zero groups cannot recover
+    key-column dtypes, and guessing used to crash the index rebuild and
+    falsely quarantine a HEALTHY partition."""
+    ds, st = _mkpart(tmp_path, n=6_000)
+    b = next(iter(st.spilled))
+    child = st.scan_child(b, {"index": "attr:name",
+                              "boxes": [(100.0, 80.0, 101.0, 81.0)],
+                              "times": None})
+    assert child is not None and child.count == 0
+    assert b not in st.spill_quarantine()
+    note = child.__dict__["_lake_note"]
+    assert note["groups_loaded"] == 0 and note["bytes_skipped"] > 0
+    # the bin still serves a full load afterwards
+    assert ds.count("t", "INCLUDE") == 6_000
+
+
+# ---------------------------------------------------------------------------
+# round-trip edge cases: null fills, empty partitions
+# ---------------------------------------------------------------------------
+
+
+def test_lake_snapshot_null_fills_new_attribute(tmp_path):
+    """A lake snapshot written BEFORE a schema update null-fills the new
+    attribute on reload (schema_null_fills contract), full and pruned."""
+    ds, st = _mkpart(tmp_path, n=5_000)
+    ds.update_schema("t", "speed:Double")
+    with config.LAKE_ENABLED.scoped("true"):
+        fc = ds.query("t", Query("INCLUDE", properties=["name", "speed"]))
+        cols = fc.batch.columns
+        assert "speed" in cols
+        assert len(cols["speed"]) == 5_000
+        assert np.isnan(np.asarray(cols["speed"], np.float64)).all()
+        # the pruned path null-fills too
+        assert ds.count("t", "BBOX(geom, -100, 30, -95, 35)") >= 0
+
+
+def test_lake_empty_partition_round_trip(tmp_path):
+    ds, st = _mkpart(tmp_path, n=200)
+    with config.LAKE_ENABLED.scoped("true"):
+        ds.delete_features("t", "INCLUDE")
+        ds.flush()
+        st.spill_all()
+        assert ds.count("t", "INCLUDE") == 0
+        # schema + dtypes survive an empty reload
+        fc = ds.query("t", "INCLUDE")
+        assert fc.batch.n == 0
+
+
+# ---------------------------------------------------------------------------
+# quarantine: corrupt footer vs corrupt row group vs transient OSError
+# ---------------------------------------------------------------------------
+
+
+def _one_spilled_dir(st):
+    b = sorted(st.spilled)[0]
+    return b, st.spilled[b]
+
+
+def test_corrupt_footer_quarantines_and_readmits(tmp_path):
+    ds, st = _mkpart(tmp_path, n=4_000)
+    b, d = _one_spilled_dir(st)
+    p = os.path.join(d, SNAPSHOT_FILE)
+    raw = open(p, "rb").read()
+    open(p, "wb").write(raw[:-4] + b"XXXX")  # torn tail
+    with config.LAKE_ENABLED.scoped("true"):
+        with pytest.raises(ValueError, match="quarantine"):
+            st.child(b)
+        # fails FAST now (no re-parse)
+        with pytest.raises(ValueError, match="quarantined"):
+            st.child(b)
+        open(p, "wb").write(raw)  # repair
+        assert st.clear_spill_quarantine() == [b]
+        assert st.child(b).count > 0
+
+
+def test_corrupt_row_group_quarantines_and_readmits(tmp_path):
+    """A flipped byte inside one LAZY column's row-group blob passes
+    open (footer + eager key columns intact) and surfaces at first
+    column decode mid-scan — the bin still quarantines (the lazy-column
+    corruption hook), and a repair + clear re-admits it."""
+    ds, st = _mkpart(tmp_path, n=4_000)
+    b, d = _one_spilled_dir(st)
+    p = os.path.join(d, SNAPSHOT_FILE)
+    snap = PartitionSnapshot(d)
+    ref = snap.groups[0]["cols"]["c/weight"]  # lazy attribute column
+    off, length, _crc = snap.file.blobs[int(ref["b"])]
+    raw = open(p, "rb").read()
+    open(p, "wb").write(raw[:off] + bytes([raw[off] ^ 0xFF])
+                        + raw[off + 1:])
+    with config.LAKE_ENABLED.scoped("true"):
+        child = st.child(b)  # opens fine: only the footer + keys read
+        with pytest.raises(LakeCorruptError):
+            child._all.columns["weight"]
+        assert b in st._spill_quarantine
+        open(p, "wb").write(raw)
+        assert st.clear_spill_quarantine() == [b]
+        # evict the half-poisoned resident and reload clean
+        st.partitions.pop(b, None)
+        st.spilled[b] = d
+        fresh = st.child(b)
+        assert len(fresh._all.columns["weight"]) == fresh.count
+
+
+def test_transient_oserror_retries_never_quarantines(tmp_path):
+    ds, st = _mkpart(tmp_path, n=2_000)
+    b, d = _one_spilled_dir(st)
+    # two transient failures then success: the retry ladder absorbs them
+    with config.LAKE_ENABLED.scoped("true"), \
+            config.FAULT_INJECTION.scoped("true"), \
+            resilience.inject_faults(seed=1) as inj:
+        inj.fail("index.spill.load", OSError(5, "EIO"), times=2)
+        assert st.child(b).count > 0
+    assert b not in st._spill_quarantine
+
+
+# ---------------------------------------------------------------------------
+# cache persistence: restart -> restore -> warm zoom-out, zero dispatches
+# ---------------------------------------------------------------------------
+
+
+def test_cache_persist_restore_zero_dispatch_zoom_out(tmp_path, rng):
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(config.CACHE_ENABLED.scoped("true"))
+        stack.enter_context(config.CACHE_CELLS_PER_AXIS.scoped("4"))
+        ds = GeoDataset(n_shards=2)
+        ds.create_schema("pts", SPEC)
+        n = 4_000
+        r = np.random.default_rng(5)
+        ds.insert("pts", {
+            "name": ["a"] * n,
+            "weight": r.uniform(0, 2, n),
+            "dtg": np.full(n, parse_iso_ms("2020-01-01")
+                           ).astype("datetime64[ms]"),
+            "geom__x": r.uniform(-170, 170, n),
+            "geom__y": r.uniform(-80, 80, n),
+        }, fids=np.arange(n).astype(str))
+        ds.flush()
+        warm = ["BBOX(geom, -90, -45, 0, 0)", "BBOX(geom, 0, -45, 90, 0)",
+                "BBOX(geom, -90, 0, 0, 45)", "BBOX(geom, 0, 0, 90, 45)"]
+        for q in warm:
+            ds.count("pts", q)
+        zoom = "BBOX(geom, -90, -45, 90, 45)"
+        expect = ds.count("pts", zoom)  # promotes the hierarchy parent
+        ckpt = str(tmp_path / "ckpt")
+        cpath = str(tmp_path / "cache.lake")
+        ds.save(ckpt)
+        summary = ds.persist_cache(cpath)
+        assert summary.get("pts", 0) > 0
+
+        # "restart": a fresh dataset from the checkpoint + restored cache
+        ds2 = GeoDataset.load(ckpt)
+        out = ds2.restore_cache(cpath)
+        assert out["pts"].get("restored", 0) > 0
+        before = _counter(metrics.EXEC_DEVICE_DISPATCH)
+        assert ds2.count("pts", zoom) == expect
+        assert _counter(metrics.EXEC_DEVICE_DISPATCH) == before, \
+            "warm zoom-out after restore must not dispatch"
+
+        # guard: a restore against CHANGED data is refused
+        ds2.insert("pts", {
+            "name": ["x"], "weight": np.asarray([1.0]),
+            "dtg": np.asarray([parse_iso_ms("2020-01-02")]
+                              ).astype("datetime64[ms]"),
+            "geom__x": np.asarray([1.0]), "geom__y": np.asarray([2.0]),
+        }, fids=np.asarray(["zz"]))
+        ds2.flush()
+        out2 = ds2.restore_cache(cpath)
+        assert "skipped" in out2["pts"]
+
+
+# ---------------------------------------------------------------------------
+# fs root circuit breaker (the lake tier's remote-root treatment)
+# ---------------------------------------------------------------------------
+
+
+def test_fs_root_breaker_fences_and_heals(tmp_path, monkeypatch):
+    from geomesa_tpu.fs import DateTimeScheme, FileSystemStorage
+    from geomesa_tpu.schema.feature_type import FeatureType
+
+    root = str(tmp_path / "fsroot")
+    fs = FileSystemStorage(root)
+    ft = FeatureType.from_spec("t", "name:String,dtg:Date,*geom:Point")
+    fs.create(ft, DateTimeScheme("day"))
+    fs.write("t", {
+        "name": ["a", "b"],
+        "dtg": np.array(["2020-01-05"] * 2, "datetime64[ms]"),
+        "geom__x": [1.0, 2.0], "geom__y": [1.0, 2.0],
+    })
+    part = fs.partitions("t")[0]
+
+    boom = {"on": True}
+    real = fs._read_file
+
+    def flaky(path, columns=None):
+        if boom["on"]:
+            raise OSError(5, "EIO: dead mount")
+        return real(path, columns=columns)
+
+    monkeypatch.setattr(fs, "_read_file", flaky)
+    resilience.reset_breakers()
+    try:
+        with config.RETRY_ATTEMPTS.scoped("1"), \
+                config.BREAKER_THRESHOLD.scoped("3"):
+            for _ in range(3):
+                with pytest.raises(OSError):
+                    fs.read_partition("t", part)
+            # breaker open: fenced fast, typed — no disk attempt at all
+            with pytest.raises(resilience.CircuitOpenError):
+                fs.read_partition("t", part)
+            # under allow_partial the fenced root degrades, not fails:
+            # the fenced file skips, leaving an empty partition table
+            with resilience.allow_partial():
+                assert fs.read_partition("t", part).num_rows == 0
+            # the mount heals: breaker reset re-admits every file
+            boom["on"] = False
+            resilience.reset_breakers()
+            assert fs.read_partition("t", part) is not None
+    finally:
+        resilience.reset_breakers()

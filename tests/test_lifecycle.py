@@ -139,8 +139,16 @@ def test_update_schema_partitioned_lazy_upgrade(tmp_path):
     ds.insert("t", data, fids=np.arange(6_000).astype(str))
     ds.flush()
     st.evict(keep=1)
+    def _snap(d):
+        # lake snapshot (part.lake) since PR 13; data.npz for legacy spills
+        for name in ("part.lake", "data.npz"):
+            p = os.path.join(d, name)
+            if os.path.exists(p):
+                return p
+        raise AssertionError(f"no snapshot file in {d}")
+
     snaps = {
-        d: os.path.getmtime(os.path.join(d, "data.npz"))
+        d: os.path.getmtime(_snap(d))
         for d in (os.path.join(st._spill_dir, f) for f in
                   os.listdir(st._spill_dir))
         if os.path.isdir(d)
@@ -148,7 +156,7 @@ def test_update_schema_partitioned_lazy_upgrade(tmp_path):
     assert len(snaps) >= 2
     ds.update_schema("t", "extra:Integer,tag:String")
     for d, m in snaps.items():
-        assert os.path.getmtime(os.path.join(d, "data.npz")) == m
+        assert os.path.getmtime(_snap(d)) == m
     assert ds.count("t", "extra = 0") == 6_000  # loads + null-fills lazily
 
 

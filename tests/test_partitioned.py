@@ -176,8 +176,16 @@ def test_incremental_checkpoint_touches_only_dirty(tmp_path):
     p = str(tmp_path / "ckpt")
     ds.save(p)
     st = ds._store("t")
+    def _snap_mtime(d):
+        # format-agnostic: lake snapshots write part.lake, legacy data.npz
+        for f in ("part.lake", "data.npz"):
+            fp = os.path.join(d, f)
+            if os.path.exists(fp):
+                return os.path.getmtime(fp)
+        raise AssertionError(f"no snapshot file in {d}")
+
     snap1 = {
-        b: os.path.getmtime(os.path.join(d, "data.npz"))
+        b: _snap_mtime(d)
         for b, d in st.checkpoint_into(p + "/t_parts").items()
     }
     # touch exactly one partition: a single row inside one period
@@ -190,7 +198,7 @@ def test_incremental_checkpoint_touches_only_dirty(tmp_path):
     ds.save(p)
     touched = []
     for b, d in st.checkpoint_into(p + "/t_parts").items():
-        m = os.path.getmtime(os.path.join(d, "data.npz"))
+        m = _snap_mtime(d)
         if m != snap1.get(b):
             touched.append(b)
     target_bin = st.binned.bin_of(parse_iso_ms("2020-01-08"))

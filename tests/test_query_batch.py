@@ -564,8 +564,13 @@ def test_placement_defers_to_column_hot_idle_slot(ds):
     with sched._cv:
         sched._threads = {0: threading.current_thread(),
                           1: threading.current_thread()}
+        probe = sched._residency_probe
         try:
-            sched._schema_heat["pts"] = 1
+            # recency-only mode: this test exercises the defer MECHANICS
+            # with a seeded heat table; the residency-ranked policy has
+            # its own coverage (test_serving.py residency tests)
+            sched._residency_probe = None
+            sched._schema_heat["pts"] = {1: _t.perf_counter()}
             sched._idle.add(1)
             now = _t.perf_counter()
             assert sched._defer_for_placement_locked(t, 0, now)
@@ -593,6 +598,7 @@ def test_placement_defers_to_column_hot_idle_slot(ds):
             sched._threads = {}
             sched._schema_heat.clear()
             sched._idle.clear()
+            sched._residency_probe = probe
 
 
 def test_placement_surfaced_on_group_span(ds):

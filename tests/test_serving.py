@@ -575,3 +575,50 @@ def test_arrow_store_read_fault_point_retries(tmp_path):
                 reopened.flush()
         reopened.flush()  # old file intact, re-flush succeeds
     assert ArrowDataStore(path).count() == 3
+
+
+def test_placement_residency_ranking():
+    """docs/SERVING.md §5c residency ranking: candidate slots rank by
+    ACTUAL device-resident column bytes (probe), recency only breaks
+    ties — so on wide pools a schema finds the slot still holding its
+    columns even when another schema dispatched there since."""
+    s = QueryScheduler()
+    s._threads = {0: object(), 1: object(), 2: object()}
+    s._schema_heat["pts"] = {2: 10.0, 1: 20.0}
+    # no probe: pure recency — the most recent dispatcher (slot 1) wins
+    assert s._rank_slot_locked("pts", 0) == 1
+    # probe: slot 2 actually holds the columns, outranking recency
+    s.set_residency_probe(lambda schema, slot: {2: 1 << 20}.get(slot, 0))
+    assert s._rank_slot_locked("pts", 0) == 2
+    # the current slot is already the best home: no defer
+    assert s._rank_slot_locked("pts", 2) is None
+    # a dead preferred slot falls out of the candidate set
+    s._threads = {0: object(), 1: object()}
+    assert s._rank_slot_locked("pts", 0) == 1
+    # a torn probe degrades to recency — dispatch must never fail on it
+    def boom(schema, slot):
+        raise RuntimeError("torn cache walk")
+    s.set_residency_probe(boom)
+    assert s._rank_slot_locked("pts", 0) == 1
+    # unknown schema: no candidates, no defer
+    assert s._rank_slot_locked("other", 0) is None
+
+
+def test_dataset_wires_residency_probe():
+    """GeoDataset installs a live probe over its stores' device-column
+    caches; after a device scan the scanned schema's columns are
+    measurably resident on slot 0's device."""
+    ds = GeoDataset(n_shards=2)
+    assert ds.serving._residency_probe is not None
+    ds.create_schema("pts", "name:String,*geom:Point")
+    r = np.random.default_rng(4)
+    n = 2000
+    ds.insert("pts", {"name": ["a"] * n,
+                      "geom__x": r.uniform(-10, 10, n),
+                      "geom__y": r.uniform(-10, 10, n)})
+    ds.flush()
+    assert ds._residency_bytes("pts", 0) == 0  # nothing uploaded yet
+    ds.count("pts", "BBOX(geom, -5, -5, 5, 5)")
+    if ds.prefer_device:
+        assert ds._residency_bytes("pts", 0) > 0
+    assert ds._residency_bytes("nope", 0) == 0
