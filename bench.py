@@ -294,9 +294,291 @@ def run_chaos():
     }))
 
 
+def _free_port() -> int:
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _spawn_replica(root: str, rid: str, port: int):
+    """One replica sidecar SUBPROCESS over the shared root (the CLI
+    ``fleet replica`` entry — a real separate process, not a thread)."""
+    import subprocess
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["GEOMESA_CACHE_ENABLED"] = "true"
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.Popen(
+        [sys.executable, "-m", "geomesa_tpu.cli", "fleet", "replica",
+         "--root", root, "--replica-id", rid, "--port", str(port)],
+        env=env, cwd=here,
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+
+
+def _wait_replica(port: int, timeout_s: float = 60.0):
+    from geomesa_tpu.sidecar import GeoFlightClient
+
+    deadline = time.time() + timeout_s
+    last = None
+    while time.time() < deadline:
+        try:
+            with GeoFlightClient(f"grpc+tcp://127.0.0.1:{port}") as c:
+                c.version()
+            return
+        except Exception as e:
+            last = e
+            time.sleep(0.25)
+    raise RuntimeError(f"replica on :{port} never came up: {last!r}")
+
+
+def run_fleet():
+    """``--fleet``: the fleet-smoke harness (docs/RESILIENCE.md §7) —
+    router + 2 replica SUBPROCESSES on localhost over one shared root,
+    gating: (1) routed-vs-single-process bit-identity across the mixed
+    aggregate workload; (2) cell-affinity warm-hit ratio beats random
+    routing; (3) SIGKILL of one replica mid-run — every query completes
+    via failover within the retry budget, zero hangs, zero partials;
+    (4) fleet_qps_scaleup (router+2 replicas vs the same router shape
+    over 1 replica). One JSON line, like --smoke. CPU numbers — the
+    device-baseline annotation rides along (the BENCH_r04+ precedent)."""
+    import tempfile
+    import threading
+
+    _arm_watchdog()
+    _force_cpu(0)
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from geomesa_tpu import GeoDataset, config, resilience
+    from geomesa_tpu.fleet import FleetRouter
+    from geomesa_tpu.sidecar import GeoFlightClient
+
+    seed = int(os.environ.get("GEOMESA_BENCH_FLEET_SEED", 7))
+    n = int(os.environ.get("GEOMESA_BENCH_N", 60_000))
+    rng = np.random.default_rng(seed)
+    root = tempfile.mkdtemp(prefix="geomesa-fleet-")
+    # default (device) execution path, SAME as the replica subprocesses
+    # run: routed-vs-single-process bit-identity is device-vs-device
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("t", "name:String:index=true,dtg:Date,*geom:Point")
+    t0 = time.time()
+    ds.insert("t", {
+        "name": [f"n{i % 8}" for i in range(n)],
+        "dtg": (np.datetime64("2024-04-01", "ms")
+                + rng.integers(0, 30 * 86_400_000, n)),
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+    }, fids=np.arange(n).astype(str))
+    ds.flush("t")
+    ds.save(root)
+    ingest_s = time.time() - t0
+
+    # the mixed warm workload: distinct viewports, revisited — affinity
+    # keeps each one's whole-result entry hot on ONE replica
+    vrng = np.random.default_rng(seed + 1)
+    views = []
+    for _ in range(6):
+        x0 = float(vrng.uniform(-118, -90))
+        y0 = float(vrng.uniform(26, 40))
+        views.append((f"BBOX(geom, {x0}, {y0}, {x0 + 14}, {y0 + 7})",
+                      (x0, y0, x0 + 14, y0 + 7)))
+    oracle = {
+        e: {"count": ds.count("t", e),
+            "density": ds.density("t", e, bbox=b, width=48, height=48),
+            "stats": ds.stats("t", "MinMax(dtg)", e).to_json()}
+        for e, b in views
+    }
+
+    def _hit_ratio(clients) -> float:
+        hit = miss = 0
+        for c in clients:
+            m = c.metrics()
+            hit += m.get("cache.hit", 0) + m.get("cache.partial", 0)
+            miss += m.get("cache.miss", 0)
+        return hit / max(hit + miss, 1)
+
+    def _mixed(run_count, run_density, run_stats, rounds=3):
+        for _ in range(rounds):
+            for e, b in views:
+                assert run_count(e) == oracle[e]["count"], e
+                got = run_density(e, b)
+                assert np.array_equal(got, oracle[e]["density"]), e
+                assert run_stats(e) == oracle[e]["stats"], e
+
+    # -- phase R: RANDOM routing baseline (fresh replicas) -----------------
+    ports_r = [_free_port(), _free_port()]
+    procs_r = [_spawn_replica(root, f"x{i}", p)
+               for i, p in enumerate(ports_r)]
+    random_ratio = 0.0
+    try:
+        for p in ports_r:
+            _wait_replica(p)
+        clients_r = [GeoFlightClient(f"grpc+tcp://127.0.0.1:{p}")
+                     for p in ports_r]
+        pick = np.random.default_rng(seed + 2)
+        _mixed(
+            lambda e: clients_r[pick.integers(2)].count("t", e),
+            lambda e, b: clients_r[pick.integers(2)].density(
+                "t", e, bbox=b, width=48, height=48),
+            lambda e: clients_r[pick.integers(2)].stats(
+                "t", "MinMax(dtg)", e).to_json(),
+        )
+        random_ratio = _hit_ratio(clients_r)
+        for c in clients_r:
+            c.close()
+    finally:
+        for p in procs_r:
+            p.kill()
+    resilience.reset_breakers()
+
+    # -- phase F: the fleet (router + 2 fresh replica subprocesses) --------
+    ports = [_free_port(), _free_port()]
+    procs = [_spawn_replica(root, f"r{i + 1}", p)
+             for i, p in enumerate(ports)]
+    try:
+        for p in ports:
+            _wait_replica(p)
+        router = FleetRouter({
+            f"r{i + 1}": f"grpc+tcp://127.0.0.1:{p}"
+            for i, p in enumerate(ports)
+        })
+        router1 = FleetRouter({"r1": f"grpc+tcp://127.0.0.1:{ports[0]}"})
+        # warm mixed workload through cell-affinity routing
+        _mixed(
+            lambda e: router.count("t", e),
+            lambda e, b: router.density("t", e, bbox=b, width=48,
+                                        height=48),
+            lambda e: router.stats("t", "MinMax(dtg)", e).to_json(),
+        )
+        affinity_clients = [router._client(r)
+                            for r in router.registry.members()]
+        affinity_ratio = _hit_ratio(affinity_clients)
+
+        # qps scale-up: same router code path, 1 vs 2 replicas, FRESH
+        # (uncached) viewports so the replicas do real scan work
+        def _qps(r, tag, threads=4, per=6):
+            qrng = np.random.default_rng(seed + 3)
+            batches = []
+            for t in range(threads):
+                mine = []
+                for k in range(per):
+                    x0 = float(qrng.uniform(-118, -90))
+                    y0 = float(qrng.uniform(26, 40))
+                    mine.append(
+                        f"(name = 'n{(t + k) % 8}') AND BBOX(geom, "
+                        f"{x0}, {y0}, {x0 + 11}, {y0 + 6})"
+                    )
+                batches.append(mine)
+            errs = []
+
+            def work(mine):
+                try:
+                    for e in mine:
+                        r.count("t", e + f" AND name <> '{tag}'")
+                except Exception as exc:  # pragma: no cover
+                    errs.append(exc)
+
+            ths = [threading.Thread(target=work, args=(m,))
+                   for m in batches]
+            t1 = time.perf_counter()
+            for th in ths:
+                th.start()
+            for th in ths:
+                th.join(timeout=300)
+            assert not errs, errs
+            return threads * per / (time.perf_counter() - t1)
+
+        qps1 = _qps(router1, "q1")
+        qps2 = _qps(router, "q2")
+        scaleup = qps2 / max(qps1, 1e-9)
+
+        # SIGKILL one replica mid-run: the chaos half of the gate
+        victim = router.ring.owner(f"schema:t")
+        procs[int(victim[1]) - 1].kill()
+        failover_ms = 0.0
+        hung = 0
+        from geomesa_tpu.resilience import QueryTimeoutError
+
+        with config.RETRY_ATTEMPTS.scoped("2"):
+            for e, b in views:
+                t1 = time.perf_counter()
+                try:
+                    with resilience.deadline_scope(30.0):
+                        got = router.count("t", e)
+                        g = router.density("t", e, bbox=b, width=48,
+                                           height=48)
+                except QueryTimeoutError:
+                    # MEASURED, not assumed: a post-kill query that
+                    # burned its whole 30 s budget counts as hung (the
+                    # deadline is what turned the hang into an error)
+                    hung += 1
+                    continue
+                dt = (time.perf_counter() - t1) * 1e3
+                failover_ms = max(failover_ms, dt)
+                assert got == oracle[e]["count"], (
+                    f"post-kill count wrong for {e}: {got}"
+                )
+                assert np.array_equal(g, oracle[e]["density"]), e
+        assert hung == 0, f"{hung} post-kill queries burned their budget"
+        snap = router.snapshot()
+        assert snap["counters"]["failover"] >= 1, snap["counters"]
+        partials = snap["counters"]["partial"]
+        assert partials == 0, snap["counters"]
+        router.close()
+        router1.close()
+    finally:
+        for p in procs:
+            try:
+                p.kill()
+            except Exception:
+                pass
+
+    import multiprocessing
+
+    cores = multiprocessing.cpu_count()
+    out = {
+        "metric": "fleet_suite",
+        "fleet": True,
+        "seed": seed,
+        "n_rows": n,
+        "ingest_s": round(ingest_s, 2),
+        "fleet_bit_identical": True,  # hard-asserted above, per query
+        "fleet_hung_queries": hung,
+        "fleet_partials": int(partials),
+        "fleet_failover_ms": round(failover_ms, 1),
+        "fleet_affinity_hit_ratio": round(affinity_ratio, 3),
+        "fleet_random_hit_ratio": round(random_ratio, 3),
+        "fleet_qps_1replica": round(qps1, 1),
+        "fleet_qps_2replicas": round(qps2, 1),
+        "fleet_qps_scaleup": round(scaleup, 2),
+        "fleet_counters": snap["counters"],
+        # CPU numbers: the device-baseline gap annotation carried
+        # forward from the main bench (BENCH_r04+ precedent)
+        "device_unreachable": True,
+        "probe_skipped": True,
+    }
+    if cores < 4:
+        # router + 2 replica processes + client threads cannot express
+        # real parallelism below ~4 cores: the scale-up gate conditions
+        # on this, exactly like the sharded/pool gates
+        out["parallel_headroom_limited"] = True
+    assert affinity_ratio > random_ratio, (
+        f"affinity routing ({affinity_ratio:.3f}) did not beat random "
+        f"routing ({random_ratio:.3f})"
+    )
+    print(json.dumps(out))
+
+
 def main():
     if "--chaos" in sys.argv[1:]:
         return run_chaos()
+    if "--fleet" in sys.argv[1:]:
+        return run_fleet()
     smoke = "--smoke" in sys.argv[1:]
     n = int(os.environ.get("GEOMESA_BENCH_N", 200_000 if smoke else 20_000_000))
     iters = int(os.environ.get("GEOMESA_BENCH_ITERS", 2 if smoke else 10))

@@ -18,6 +18,7 @@ _LAZY = {
     "Query": "geomesa_tpu.api.dataset",
     "ArrowDataStore": "geomesa_tpu.io.arrow_store",
     "QueryScheduler": "geomesa_tpu.serving",
+    "FleetRouter": "geomesa_tpu.fleet",
 }
 
 

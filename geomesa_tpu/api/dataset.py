@@ -1258,7 +1258,7 @@ class GeoDataset:
     @_traced("density_curve")
     def density_curve(self, name: str, query: "str | Query" = "INCLUDE",
                       level: int = 9, bbox=None,
-                      weight: Optional[str] = None):
+                      weight: Optional[str] = None, region=None):
         """Exact density over the morton-block grid at ``level`` (a global
         2^level x 2^level partition of lon/lat — the EPSG:4326 tile pyramid
         aligns with it by construction). Returns ``(grid, snapped_bbox)``
@@ -1269,9 +1269,16 @@ class GeoDataset:
         differences over the z2-sorted scan — no scatter — so it runs at
         memory bandwidth where the per-pixel scatter path pays ~6.7 ns per
         scanned row (docs/SCALE.md). Use it for tile rendering; use
-        :meth:`density` when the grid must align to an arbitrary bbox."""
+        :meth:`density` when the grid must align to an arbitrary bbox.
+
+        ``region``: optional polygon (WKT or geometry) folded in as an
+        INTERSECTS conjunct; the cache's block-chunk loop classifies each
+        chunk against it — interior chunks share residual-keyed entries
+        with non-region pyramids and outside chunks never scan
+        (docs/CACHE.md "Polygon curve chunks")."""
         if not 0 < level <= 15:
             raise ValueError("level must be in 1..15 (grid = 4^level blocks)")
+        query = self._with_region(name, query, region)
         q = Query(ecql=query) if isinstance(query, str) else query
         import dataclasses
 
@@ -2265,7 +2272,13 @@ class GeoDataset:
 
         return lake_persist.restore_cache(self, path)
 
-    def save(self, path: str):
+    def save(self, path: str, names: Optional[Sequence[str]] = None):
+        """Checkpoint to ``path``. ``names`` restricts the save to those
+        schemas — other schemas' manifest entries (and files) carry over
+        VERBATIM from the existing checkpoint, so a fleet write commit
+        (docs/RESILIENCE.md §7) costs the mutated schema, not the whole
+        dataset. A named schema that no longer exists locally is REMOVED
+        from the manifest (the delete path)."""
         from geomesa_tpu.index.partitioned import PartitionedFeatureStore
 
         os.makedirs(path, exist_ok=True)
@@ -2275,7 +2288,14 @@ class GeoDataset:
             with open(mpath) as fh:
                 prev_manifest = json.load(fh).get("schemas", {})
         manifest = {"version": 2, "schemas": {}}
+        if names is not None:
+            keep = set(names)
+            manifest["schemas"] = {
+                k: v for k, v in prev_manifest.items() if k not in keep
+            }
         for name, st in self._stores.items():
+            if names is not None and name not in names:
+                continue
             st.flush()
             entry = {
                 "spec": st.ft.spec(),
@@ -2302,57 +2322,102 @@ class GeoDataset:
             manifest = json.load(fh)
         ds = GeoDataset(mesh=mesh, prefer_device=prefer_device)
         for name, meta in manifest["schemas"].items():
-            ft = FeatureType.from_spec(name, meta["spec"])
-            ds.n_shards = meta["n_shards"]
-            ds.create_schema(ft)
-            st = ds._store(name)
-            st.dicts = {
-                k: DictionaryEncoder(v) for k, v in meta["dicts"].items()
-            }
-            st.stats = {k: sk.Stat.from_json(v) for k, v in meta["stats"].items()}
-            if "partitions" in meta:
-                st.attach_snapshots({
-                    int(b): os.path.join(path, rel)
-                    for b, rel in meta["partitions"].items()
-                })
-                continue
-            # v2 chunked layout, with the v1 single-npz fallback
-            chunk_files = meta.get("chunks")
-            if chunk_files is None:
-                npz_path = os.path.join(path, f"{name}.npz")
-                chunk_files = ([os.path.relpath(npz_path, path)]
-                               if os.path.exists(npz_path) else [])
-            parts = []
-            for rel in chunk_files:
-                with np.load(os.path.join(path, rel),
-                             allow_pickle=False) as z:
-                    cols = {}
-                    for k in z.files:
-                        v = z[k]
-                        cols[k] = (v.astype(object) if v.dtype.kind == "U"
-                                   else v)
-                    if cols:
-                        parts.append(ColumnBatch(
-                            cols, len(next(iter(cols.values())))))
-            if parts:
-                from geomesa_tpu.schema.columns import schema_null_fills
-
-                # schema-derived fills: mixed-vintage chunks (e.g. saved
-                # before a column existed) null-fill per the layout's
-                # convention, not a dtype guess
-                st._all = (parts[0] if len(parts) == 1
-                           else ColumnBatch.concat(
-                               parts, fills=schema_null_fills(ft)))
-                if "epoch" in meta:
-                    st.mutation_epoch = meta["epoch"]
-                key_cols = dict(st._all.columns)
-                for ks in st.keyspaces:
-                    key_cols.update(ks.index_keys(ft, st._all))
-                    st.tables[ks.name].rebuild(key_cols, st.dicts)
-                # seed the key cache so the next flush appends incrementally
-                st._key_cols = {
-                    k: v for k, v in key_cols.items()
-                    if k not in st._all.columns
-                }
+            ds._attach_schema_entry(path, name, meta)
         ds.n_shards = None
         return ds
+
+    def _attach_schema_entry(self, path: str, name: str, meta: Dict) -> None:
+        """Create + populate ONE schema's store from a checkpoint manifest
+        entry (the per-schema half of :meth:`load`; also the fleet epoch
+        refresh path — docs/RESILIENCE.md §7)."""
+        prev_shards = self.n_shards
+        ft = FeatureType.from_spec(name, meta["spec"])
+        self.n_shards = meta["n_shards"]
+        try:
+            self.create_schema(ft)
+        finally:
+            self.n_shards = prev_shards
+        st = self._store(name)
+        st.dicts = {
+            k: DictionaryEncoder(v) for k, v in meta["dicts"].items()
+        }
+        st.stats = {k: sk.Stat.from_json(v) for k, v in meta["stats"].items()}
+        if "partitions" in meta:
+            st.attach_snapshots({
+                int(b): os.path.join(path, rel)
+                for b, rel in meta["partitions"].items()
+            })
+            return
+        # v2 chunked layout, with the v1 single-npz fallback
+        chunk_files = meta.get("chunks")
+        if chunk_files is None:
+            npz_path = os.path.join(path, f"{name}.npz")
+            chunk_files = ([os.path.relpath(npz_path, path)]
+                           if os.path.exists(npz_path) else [])
+        parts = []
+        for rel in chunk_files:
+            with np.load(os.path.join(path, rel),
+                         allow_pickle=False) as z:
+                cols = {}
+                for k in z.files:
+                    v = z[k]
+                    cols[k] = (v.astype(object) if v.dtype.kind == "U"
+                               else v)
+                if cols:
+                    parts.append(ColumnBatch(
+                        cols, len(next(iter(cols.values())))))
+        if parts:
+            from geomesa_tpu.schema.columns import schema_null_fills
+
+            # schema-derived fills: mixed-vintage chunks (e.g. saved
+            # before a column existed) null-fill per the layout's
+            # convention, not a dtype guess
+            st._all = (parts[0] if len(parts) == 1
+                       else ColumnBatch.concat(
+                           parts, fills=schema_null_fills(ft)))
+            if "epoch" in meta:
+                st.mutation_epoch = meta["epoch"]
+            key_cols = dict(st._all.columns)
+            for ks in st.keyspaces:
+                key_cols.update(ks.index_keys(ft, st._all))
+                st.tables[ks.name].rebuild(key_cols, st.dicts)
+            # seed the key cache so the next flush appends incrementally
+            st._key_cols = {
+                k: v for k, v in key_cols.items()
+                if k not in st._all.columns
+            }
+
+    def refresh_schema(self, name: str, path: str) -> bool:
+        """Replace schema ``name``'s in-memory state with what the shared
+        checkpoint at ``path`` holds — the replica-side half of fleet
+        epoch propagation (docs/RESILIENCE.md §7): a replica whose known
+        fleet epoch trails an incoming request's re-reads the schema from
+        the shared root BEFORE serving, so a restarted or failed-over
+        replica can never answer from a pre-mutation store or cache
+        (the replaced store's covers drop with its uid, exactly like a
+        local mutation epoch bump). Handles remote creates (schema in the
+        manifest but not here), remote deletes (here but gone from the
+        manifest), and plain data changes. Returns True when anything
+        changed."""
+        mpath = os.path.join(path, "manifest.json")
+        schemas: Dict[str, Any] = {}
+        if os.path.exists(mpath):
+            with open(mpath) as fh:
+                schemas = json.load(fh).get("schemas", {})
+        meta = schemas.get(name)
+        old = self._stores.get(name)
+        if meta is None:
+            if old is None:
+                return False
+            self.delete_schema(name)  # invalidates the old uid's covers
+            self._plan_cache_clear(name)
+            self._drop_executors(name)
+            return True
+        if old is not None:
+            self.cache.store.invalidate(old.uid)
+            del self._stores[name]
+            self.metadata.pop(name, None)
+            self._plan_cache_clear(name)
+            self._drop_executors(name)
+        self._attach_schema_entry(path, name, meta)
+        return True

@@ -502,7 +502,15 @@ class AggregateCache:
         fused ``density_curve_batch`` dispatch when the executor has it),
         and the hierarchy serves a zoom-out by downsample-adding the
         chunk's level-(k+1) projection. Tile pyramids over one filter
-        share chunks across tiles AND across zoom levels."""
+        share chunks across tiles AND across zoom levels.
+
+        Polygon-region filters additionally split the chunk loop into
+        FAMILIES (docs/CACHE.md "Polygon curve chunks"): interior chunks
+        key on the residual alone and scan without the polygon predicate
+        (shared with non-region pyramids over the same residual), outside
+        chunks contribute zeros with no scan, and only boundary chunks
+        pay the polygon — warm polygon tile pyramids stop over-scanning
+        their boundary."""
         uid, epoch = st.uid, st.version
         akey = self._auth_key(ds, q)
         wkey = ("whole",) + op.fingerprint + (repr(plan.filter), akey)
@@ -531,35 +539,95 @@ class AggregateCache:
 
         base = ("curve",) + (repr(plan.filter), akey)
 
-        def chunk_get(lvl: int, side: int, kx: int, ky: int):
-            return self.store.get(uid, epoch, base + (lvl, side, kx, ky))
+        # Polygon-region chunk families (docs/CACHE.md "Polygon curve
+        # chunks"): when the filter is `polygon ∧ residual` on a point
+        # column, classify each chunk's geographic box against the
+        # polygon with CLASSIFY_MARGIN room — INTERIOR chunks hold
+        # residual-only counts (the polygon conjunct is a tautology over
+        # them, by the same margin argument decompose_region makes), so
+        # they key on the RESIDUAL alone and share cached grids with
+        # non-region queries over the same filter; OUTSIDE chunks
+        # contribute zeros without any scan; only BOUNDARY chunks key on
+        # (and scan under) the polygon literal.
+        region_split = None
+        geomf = st.ft.geom_field
+        if (config.CACHE_POLYGON.to_bool() and geomf is not None
+                and st.ft.attr(geomf).is_point):
+            region_split = cellmod.split_region_conjunct(plan.filter, geomf)
+        codes = None
+        base_plain = base
+        if region_split is not None:
+            from geomesa_tpu.kernels import join as jk
 
-        def chunk_put(lvl: int, side: int, kx: int, ky: int, g):
+            spatial, residual = region_split
+            base_plain = ("curve",) + (repr(residual), akey)
+            n_side = 1 << level
+            bsx, bsy = 360.0 / n_side, 180.0 / n_side
+            coords = [(kx, ky) for ky in range(cy0, cy1 + 1)
+                      for kx in range(cx0, cx1 + 1)]
+            cboxes = np.asarray([
+                (kx * c * bsx - 180.0, ky * c * bsy - 90.0,
+                 (kx + 1) * c * bsx - 180.0, (ky + 1) * c * bsy - 90.0)
+                for kx, ky in coords
+            ], np.float64)
+            kcodes = jk.classify_cells(cboxes, spatial.geom,
+                                       cellmod.CLASSIFY_MARGIN)
+            codes = dict(zip(coords, (int(v) for v in kcodes)))
+            metrics.inc(metrics.CACHE_CURVE_REGION)
+
+        def _get(fam_base, lvl: int, side: int, kx: int, ky: int):
+            return self.store.get(uid, epoch,
+                                  fam_base + (lvl, side, kx, ky))
+
+        def _put(fam_base, lvl: int, side: int, kx: int, ky: int, g):
             return self.store.put(
-                uid, epoch, base + (lvl, side, kx, ky),
+                uid, epoch, fam_base + (lvl, side, kx, ky),
                 np.ascontiguousarray(g),
             )
+
+        def _family(fam_base):
+            return (lambda *a: _get(fam_base, *a),
+                    lambda *a: _put(fam_base, *a))
+
+        chunk_get, chunk_put = _family(base)
+        plain_get, plain_put = _family(base_plain)
 
         use_hier = hierarchy.enabled()
         hstats: dict = {}
         out = np.zeros((ny, nx), np.float64)
-        hits = hier_hits = 0
-        misses = []  # (sub_window, out-slice, full-chunk coords or None)
+        hits = hier_hits = n_outside = 0
+        #: (sub_window, out-slice, full-chunk coords or None, plain?)
+        misses = []
         with tracing.span("cache.cells", total=n_chunks, level=level,
                           kind="curve", chunk=c) as cells_span:
             for ky in range(cy0, cy1 + 1):
                 for kx in range(cx0, cx1 + 1):
+                    plain = False
+                    if codes is not None:
+                        from geomesa_tpu.kernels import join as jk
+
+                        code = codes[(kx, ky)]
+                        if code == jk.CELL_OUTSIDE:
+                            # wholly outside the polygon (with margin):
+                            # the output slice stays zero, no scan, no
+                            # cache entry — the over-scan this family
+                            # split exists to stop
+                            n_outside += 1
+                            continue
+                        plain = code == jk.CELL_INTERIOR
+                    get_, put_ = ((plain_get, plain_put) if plain
+                                  else (chunk_get, chunk_put))
                     bx0, by0 = kx * c, ky * c
                     bx1, by1 = bx0 + c - 1, by0 + c - 1
                     sx0, sy0 = max(bx0, ix0), max(by0, iy0)
                     sx1, sy1 = min(bx1, ix1), min(by1, iy1)
                     full = (sx0, sy0, sx1, sy1) == (bx0, by0, bx1, by1)
                     with tracing.span("cache.lookup", key="chunk"):
-                        g = chunk_get(level, c, kx, ky)
+                        g = get_(level, c, kx, ky)
                     if g is None and use_hier:
                         with tracing.span("cache.hierarchy", level=level):
                             g = hierarchy.assemble_curve(
-                                chunk_get, chunk_put, level, c, kx, ky,
+                                get_, put_, level, c, kx, ky,
                                 stats=hstats,
                             )
                         if g is not None:
@@ -577,50 +645,79 @@ class AggregateCache:
                     else:
                         misses.append((
                             (sx0, sy0, sx1, sy1), dst,
-                            (kx, ky) if full else None,
+                            (kx, ky) if full else None, plain,
                         ))
-            cells_span.set(hits=hits, assembled=hier_hits)
+            cells_span.set(hits=hits, assembled=hier_hits,
+                           outside=n_outside)
 
         all_cacheable = True
         if misses:
-            windows = [m[0] for m in misses]
-            deg0 = len(plan.__dict__.get("degraded") or ())
             scan_acc = [0, 0]  # executed [scanned_rows, table_rows]
+            deg0 = len(plan.__dict__.get("degraded") or ())
 
-            def _fold_scan():
-                # each execution overwrites the plan counters: fold them
-                # into the accumulator so the audit reports ALL executed
-                # work, matching the generic cell path's accounting
-                scan_acc[0] += plan.__dict__.pop("scanned_rows", 0)
-                scan_acc[1] = max(scan_acc[1],
-                                  plan.__dict__.pop("table_rows", 0))
+            def _exec_windows(p, windows):
+                """Execute missing sub-windows under plan ``p``, folding
+                its per-execution counters (and any degradation) into
+                the OUTER plan's accounting."""
 
-            with tracing.span("cache.cell.scan", n=len(windows)):
-                if len(windows) > 1 and hasattr(ex, "density_curve_batch"):
-                    grids = ex.density_curve_batch(plan, level, windows,
-                                                   None)
-                    _fold_scan()
-                else:
-                    grids = []
-                    for w in windows:
-                        grids.append(np.asarray(
-                            ex.density_curve(plan, level, w, None)))
-                        _fold_scan()
+                def _fold():
+                    scan_acc[0] += p.__dict__.pop("scanned_rows", 0)
+                    scan_acc[1] = max(scan_acc[1],
+                                      p.__dict__.pop("table_rows", 0))
+
+                with tracing.span("cache.cell.scan", n=len(windows)):
+                    if len(windows) > 1 \
+                            and hasattr(ex, "density_curve_batch"):
+                        grids = ex.density_curve_batch(p, level, windows,
+                                                       None)
+                        _fold()
+                    else:
+                        grids = []
+                        for w in windows:
+                            grids.append(np.asarray(
+                                ex.density_curve(p, level, w, None)))
+                            _fold()
+                if p is not plan:
+                    deg = p.__dict__.pop("degraded", None)
+                    if deg:
+                        plan.__dict__.setdefault(
+                            "degraded", []).extend(deg)
+                return grids
+
+            poly_misses = [m for m in misses if not m[3]]
+            plain_misses = [m for m in misses if m[3]]
+            grids_by: dict = {}
+            if poly_misses:
+                for m, g in zip(poly_misses, _exec_windows(
+                        plan, [m[0] for m in poly_misses])):
+                    grids_by[id(m)] = g
+            if plain_misses:
+                # interior chunks scan under the RESIDUAL alone — the
+                # polygon predicate is a tautology over them, and the
+                # residual-only plan's kernels/grids are the ones plain
+                # (non-region) curve queries share
+                plan_plain = self._sub_plan(ds, st, q, region_split[1])
+                for m, g in zip(plain_misses, _exec_windows(
+                        plan_plain, [m[0] for m in plain_misses])):
+                    grids_by[id(m)] = g
             plan.__dict__["scanned_rows"] = scan_acc[0]
             plan.__dict__["table_rows"] = scan_acc[1]
             if len(plan.__dict__.get("degraded") or ()) > deg0:
                 # a partition was skipped somewhere in the fresh scans:
                 # none of them may become a permanently-cached lie
                 all_cacheable = False
-            for (win, dst, full_at), g in zip(misses, grids):
-                g = np.asarray(g, np.float64)
+            for m in misses:
+                win, dst, full_at, plain = m
+                g = np.asarray(grids_by[id(m)], np.float64)
                 out[dst] = g
                 if full_at is not None and all_cacheable:
                     kx, ky = full_at
-                    chunk_put(level, c, kx, ky, g)
+                    get_, put_ = ((plain_get, plain_put) if plain
+                                  else (chunk_get, chunk_put))
+                    put_(level, c, kx, ky, g)
                     if use_hier:
                         hierarchy.rollup_curve(
-                            chunk_get, chunk_put, level, c, kx, ky, g
+                            get_, put_, level, c, kx, ky, g
                         )
         else:
             # fully chunk-warm: nothing executed, the audit must say so
@@ -640,6 +737,20 @@ class AggregateCache:
             cache_level=level,
             cache_chunk=c,
         )
+        if codes is not None:
+            from geomesa_tpu.kernels import join as jk
+
+            n_int = sum(1 for v in codes.values()
+                        if v == jk.CELL_INTERIOR)
+            n_bnd = sum(1 for v in codes.values()
+                        if v == jk.CELL_BOUNDARY)
+            self._note(
+                plan, cache_region="polygon-chunks",
+                cache_region_chunks=(
+                    f"{n_int} interior (residual-keyed) / {n_bnd} "
+                    f"boundary / {n_outside} outside (unscanned)"
+                ),
+            )
         if hier_hits:
             self._note(
                 plan,

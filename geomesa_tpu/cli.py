@@ -503,6 +503,88 @@ def cmd_devices(args):
                      default=str))
 
 
+def _parse_replicas(spec: str):
+    """``id=host:port,id=host:port`` -> {id: flight location}."""
+    out = {}
+    for tok in (spec or "").split(","):
+        tok = tok.strip()
+        if not tok:
+            continue
+        if "=" not in tok:
+            raise ValueError(
+                f"bad --replicas entry {tok!r} (want id=host:port)"
+            )
+        rid, addr = tok.split("=", 1)
+        if not addr.startswith("grpc"):
+            addr = f"grpc+tcp://{addr}"
+        out[rid.strip()] = addr
+    if not out:
+        raise ValueError("--replicas is empty")
+    return out
+
+
+def cmd_fleet(args):
+    """``fleet`` subcommands (docs/RESILIENCE.md §7):
+
+    * ``fleet replica`` — run ONE replica sidecar over the shared fleet
+      root: loads the catalog, serves Flight with the replica id + epoch
+      headers, honors stamped writes (apply + save + epoch advance);
+    * ``fleet status`` — probe every replica (replica-status action):
+      identity, drain flag, epochs, serving snapshot;
+    * ``fleet drain`` / ``fleet undrain`` — replica-side drain: new
+      non-admin requests answer [GM-DRAINING] until undrained, so every
+      router fails the traffic over;
+    * ``fleet count`` — route one count through an ad-hoc router (smoke/
+      operator sanity check of affinity + failover).
+    """
+    if args.fleet_cmd == "replica":
+        from geomesa_tpu import GeoDataset
+        from geomesa_tpu.sidecar import GeoFlightServer
+
+        ds = (GeoDataset.load(args.root)
+              if os.path.exists(os.path.join(args.root, "manifest.json"))
+              else GeoDataset())
+        srv = GeoFlightServer(
+            ds, f"grpc+tcp://{args.host}:{args.port}",
+            replica_id=args.replica_id, fleet_root=args.root,
+        )
+        print(f"geomesa-tpu fleet replica {args.replica_id!r} listening on "
+              f"grpc+tcp://{args.host}:{srv.port} (root {args.root})",
+              flush=True)
+        try:
+            srv.serve()
+        except KeyboardInterrupt:
+            pass
+        return 0
+    if args.fleet_cmd == "status":
+        from geomesa_tpu.fleet import FleetRouter
+
+        with FleetRouter(_parse_replicas(args.replicas)) as router:
+            out = {"probes": router.probe_all(), "fleet": router.snapshot()}
+        print(json.dumps(out, indent=2, sort_keys=True, default=str))
+        return 0
+    if args.fleet_cmd in ("drain", "undrain"):
+        from geomesa_tpu.sidecar import GeoFlightClient
+
+        with GeoFlightClient(f"grpc+tcp://{args.host}:{args.port}") as c:
+            out = (c.drain(reason=args.reason)
+                   if args.fleet_cmd == "drain" else c.undrain())
+        print(json.dumps(out, indent=2, sort_keys=True, default=str))
+        return 0
+    if args.fleet_cmd == "count":
+        from geomesa_tpu.fleet import FleetRouter
+
+        with FleetRouter(_parse_replicas(args.replicas)) as router:
+            n = router.count(args.feature_name, args.cql)
+            snap = router.snapshot()
+        print(json.dumps({"count": int(n), "counters": snap["counters"],
+                          "replicas": snap["replicas"]},
+                         indent=2, sort_keys=True, default=str))
+        return 0
+    print(f"unknown fleet command {args.fleet_cmd!r}", file=sys.stderr)
+    return 2
+
+
 def cmd_version(args):
     print(f"geomesa-tpu {__version__}")
 
@@ -782,6 +864,40 @@ def build_parser() -> argparse.ArgumentParser:
                     help="apply cordon/uncordon on a running sidecar")
     sp.add_argument("--port", dest="sidecar_port", type=int)
     sp.set_defaults(fn=cmd_devices)
+
+    sp = sub.add_parser("fleet", help="replica-fleet operations: run a "
+                        "replica, probe status, drain/undrain, routed "
+                        "count (docs/RESILIENCE.md §7)")
+    fsub = sp.add_subparsers(dest="fleet_cmd", required=True)
+    fp = fsub.add_parser("replica", help="run one replica sidecar over "
+                         "the shared fleet root")
+    fp.add_argument("--root", required=True,
+                    help="shared storage root (GeoDataset.save layout)")
+    fp.add_argument("--replica-id", required=True)
+    fp.add_argument("--host", default="127.0.0.1")
+    fp.add_argument("--port", type=int, default=0)
+    fp.set_defaults(fn=cmd_fleet)
+    fp = fsub.add_parser("status", help="probe every replica")
+    fp.add_argument("--replicas", required=True,
+                    help="id=host:port,id=host:port")
+    fp.set_defaults(fn=cmd_fleet)
+    fp = fsub.add_parser("drain", help="drain one replica (new requests "
+                         "answer [GM-DRAINING] until undrain)")
+    fp.add_argument("--host", default="127.0.0.1")
+    fp.add_argument("--port", type=int, default=8815)
+    fp.add_argument("--reason")
+    fp.set_defaults(fn=cmd_fleet)
+    fp = fsub.add_parser("undrain", help="re-admit a drained replica")
+    fp.add_argument("--host", default="127.0.0.1")
+    fp.add_argument("--port", type=int, default=8815)
+    fp.set_defaults(fn=cmd_fleet)
+    fp = fsub.add_parser("count", help="route one count through an "
+                         "ad-hoc fleet router")
+    fp.add_argument("--replicas", required=True,
+                    help="id=host:port,id=host:port")
+    fp.add_argument("-f", "--feature-name", required=True)
+    fp.add_argument("-q", "--cql", default="INCLUDE")
+    fp.set_defaults(fn=cmd_fleet)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

@@ -674,6 +674,67 @@ SERVING_EXECUTORS = SystemProperty("geomesa.serving.executors", "1")
 #: x-geomesa-user Flight header; unset = "anonymous").
 USER = SystemProperty("geomesa.user", None)
 
+# ---------------------------------------------------------------------------
+# Replica fleet (fleet/; docs/RESILIENCE.md §7). A front-end router plus N
+# replica sidecars over one shared storage root: consistent-hash CELL
+# affinity routing, per-replica breakers + failover, and mutation-epoch
+# propagation so no replica ever serves a pre-mutation aggregate.
+# ---------------------------------------------------------------------------
+
+#: This process's replica identity in a fleet (stamped into every response
+#: as the x-geomesa-replica-id header; "replica:<id>" names its breaker on
+#: routers). Unset = not a fleet replica.
+FLEET_REPLICA_ID = SystemProperty("geomesa.fleet.replica.id", None)
+
+#: Shared storage root the fleet's replicas load from / persist to
+#: (GeoDataset.save/load layout). A replica whose known fleet epoch for a
+#: schema trails an incoming request's epoch refreshes that schema from
+#: here BEFORE serving; a replica applying a router-stamped write saves
+#: here before acknowledging. Unset = no cross-replica refresh.
+FLEET_ROOT = SystemProperty("geomesa.fleet.root", None)
+
+#: SFC cell level the router derives affinity keys at: a query's bbox
+#: center quantizes to one 2^level x 2^level cell, and the rendezvous
+#: ring hashes (schema, cell prefix) to pick the owner replica — nearby
+#: viewports land on the same replica, keeping its cell cache hot.
+FLEET_ROUTING_LEVEL = SystemProperty("geomesa.fleet.routing.level", "3")
+
+#: Scatter decomposable exact counts across replicas by cell ownership
+#: (each owner group scans only its cells; integer partials add exactly).
+#: Off = every query routes whole to one replica.
+FLEET_SCATTER = SystemProperty("geomesa.fleet.scatter", "true")
+
+#: Fleet-level admission bound on the router: concurrent in-flight routed
+#: queries beyond this are rejected typed [GM-OVERLOADED] before any RPC
+#: (the same _UserLedger-backed policy the serving scheduler runs).
+FLEET_MAX_INFLIGHT = SystemProperty("geomesa.fleet.max.inflight", "256")
+
+#: Consecutive connect/dispatch failures that BREAK a replica (open its
+#: ``replica:<id>`` breaker, removing it from routing until the half-open
+#: trial succeeds). Fed by routed-call failures, failed /healthz-style
+#: probes, and latency-outlier streaks.
+FLEET_BREAKER_THRESHOLD = SystemProperty("geomesa.fleet.breaker.threshold", "3")
+
+#: Broken-replica reset window (ms): after it, ONE trial call is admitted.
+FLEET_BREAKER_RESET_MS = SystemProperty(
+    "geomesa.fleet.breaker.reset.ms", "30000"
+)
+
+#: Latency-outlier factor for routed calls: a replica's call slower than
+#: factor x the trailing fleet-wide median for the same op (and over the
+#: floor below) counts one outlier; a threshold-long consecutive streak
+#: trips the replica's breaker. "0" disables.
+FLEET_LATENCY_OUTLIER = SystemProperty("geomesa.fleet.latency.outlier", "20")
+
+#: Absolute floor (ms) below which a routed call is never an outlier.
+FLEET_LATENCY_FLOOR_MS = SystemProperty(
+    "geomesa.fleet.latency.floor.ms", "250"
+)
+
+#: Replicas cordoned out of routing, comma-separated ids — the config-knob
+#: face of FleetRouter.cordon()/uncordon() (explicit API on the router).
+FLEET_CORDON = SystemProperty("geomesa.fleet.cordon", None)
+
 #: Per-user fair-share weight prefix: ``geomesa.serving.user.weight.<user>``
 #: scales a user's attained-service debt (the dispatcher picks the user
 #: minimizing service_s / weight), so weight 4 earns ~4x the service of

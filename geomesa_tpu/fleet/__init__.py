@@ -1,0 +1,21 @@
+"""Replica fleet (docs/RESILIENCE.md §7): a front-end router plus N
+replica sidecar processes over one shared storage root.
+
+Routing is consistent-hash **cell affinity**: a query's SFC cell cover
+(the same cell family the aggregate cache decomposes to, cache/cells.py)
+picks the replica whose flat+hierarchy cache owns that slice of the
+world, through a rendezvous-hash ring that rebalances minimally when
+membership changes. Robustness generalizes RESILIENCE.md §6 from devices
+to replicas: per-replica circuit breakers, probe- and latency-fed health,
+cordon/drain, deadline-aware failover to the next ring owner, typed
+``[GM-FLEET-PARTIAL]`` degradation with exact survivor accounting, and
+mutation-epoch propagation so a restarted or failed-over replica never
+serves a pre-mutation aggregate.
+"""
+
+from geomesa_tpu.fleet.registry import ReplicaRegistry
+from geomesa_tpu.fleet.ring import RendezvousRing
+from geomesa_tpu.fleet.router import FleetRouter, debug_fleet
+
+__all__ = ["FleetRouter", "RendezvousRing", "ReplicaRegistry",
+           "debug_fleet"]

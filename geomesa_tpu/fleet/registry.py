@@ -1,0 +1,267 @@
+"""Per-replica health for the fleet router (docs/RESILIENCE.md §7).
+
+The device-health registry (parallel/health.py, §6) generalized from
+local jax devices to remote replica processes: every replica carries
+
+* a **circuit breaker** (``resilience.breaker("replica:<id>")``) fed by
+  routed-call connect/dispatch failures, failed health probes, and
+  latency-outlier streaks (``geomesa.fleet.breaker.{threshold,reset.ms}``);
+* a **latency-outlier detector** — a routed call slower than
+  ``geomesa.fleet.latency.outlier`` x the trailing fleet-wide median FOR
+  ITS OP (over ``geomesa.fleet.latency.floor.ms``) counts one outlier; a
+  threshold-long consecutive streak trips the breaker, fencing the
+  slow-but-not-failing replica like a failing one;
+* an explicit **cordon** state (router API / ``geomesa.fleet.cordon``)
+  and a **draining** state learned from the replica itself (its ``drain``
+  admin action answers ``[GM-DRAINING]``; probes read it back) — either
+  removes the replica from routing without touching its breaker.
+
+States surface as ``fleet.replica.health.<id>`` gauges and the
+``/debug/fleet`` payload; the router's failover walks ring owners
+filtered through :meth:`usable`.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Set
+
+from geomesa_tpu import config, metrics, resilience
+
+OK, CORDONED, DRAINING, BROKEN = "ok", "cordoned", "draining", "broken"
+_GAUGE_VALUE = {OK: 1.0, CORDONED: 0.0, DRAINING: 0.0, BROKEN: -1.0}
+
+
+def _cordon_config_ids() -> Set[str]:
+    raw = (config.FLEET_CORDON.get() or "").strip()
+    if not raw:
+        return set()
+    return {tok.strip() for tok in raw.split(",") if tok.strip()}
+
+
+class ReplicaRegistry:
+    """Fleet-membership + health state for one router. Thread-safe;
+    replica ids are operator-chosen short tokens (bounded cardinality —
+    one breaker, one gauge per replica)."""
+
+    #: distinct per-op latency baselines retained (least recently seen
+    #: op's samples drop beyond this — bounded state)
+    _MAX_OPS = 64
+
+    def __init__(self, replicas: Dict[str, str]):
+        self._lock = threading.Lock()
+        #: id -> Flight location ("grpc+tcp://host:port")
+        self._members: Dict[str, str] = dict(replicas)
+        self._cordoned: Dict[str, str] = {}
+        self._draining: Set[str] = set()
+        self._failures: Dict[str, int] = {}
+        self._last_failure: Dict[str, str] = {}
+        #: queries re-routed OFF this replica onto a later ring owner
+        self._failed_over: Dict[str, int] = {}
+        self._lat_recent: Dict[str, "deque"] = {}
+        self._outlier_streak: Dict[str, int] = {}
+        self._gauged: Set[str] = set()
+
+    # -- membership --------------------------------------------------------
+    def members(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def location(self, rid: str) -> str:
+        with self._lock:
+            loc = self._members.get(rid)
+        if loc is None:
+            raise KeyError(f"unknown replica {rid!r}")
+        return loc
+
+    def add(self, rid: str, location: str) -> None:
+        with self._lock:
+            self._members[rid] = location
+
+    def remove(self, rid: str) -> None:
+        with self._lock:
+            self._members.pop(rid, None)
+            self._draining.discard(rid)
+            self._cordoned.pop(rid, None)
+
+    # -- breaker plumbing --------------------------------------------------
+    def breaker(self, rid: str) -> resilience.CircuitBreaker:
+        return resilience.breaker(
+            f"replica:{rid}",
+            threshold=config.FLEET_BREAKER_THRESHOLD.to_int() or 3,
+            reset_ms=config.FLEET_BREAKER_RESET_MS.to_float() or 30_000.0,
+        )
+
+    def _ensure_gauge(self, rid: str) -> None:
+        if rid in self._gauged:
+            return
+        with self._lock:
+            if rid in self._gauged:
+                return
+            self._gauged.add(rid)
+        metrics.registry().gauge(
+            f"{metrics.FLEET_REPLICA_HEALTH_PREFIX}.{rid}",
+            lambda r=rid: _GAUGE_VALUE[self.state(r)],
+            replace=True,
+        )
+
+    # -- state -------------------------------------------------------------
+    def cordon_reason(self, rid: str) -> Optional[str]:
+        with self._lock:
+            reason = self._cordoned.get(rid)
+        if reason is not None:
+            return reason
+        if rid in _cordon_config_ids():
+            return "geomesa.fleet.cordon"
+        return None
+
+    def state(self, rid: str) -> str:
+        if self.cordon_reason(rid) is not None:
+            return CORDONED
+        with self._lock:
+            draining = rid in self._draining
+        if draining:
+            return DRAINING
+        if self.breaker(rid).state != resilience.CircuitBreaker.CLOSED:
+            return BROKEN
+        return OK
+
+    def usable(self, rid: str) -> bool:
+        """May the router place a query on this replica? Cordoned /
+        draining: no. Open breaker: no. Half-open: yes — the next routed
+        call IS the trial (a pure state read, never ``allow()``, so a
+        status poll can never consume the trial slot)."""
+        self._ensure_gauge(rid)
+        if self.cordon_reason(rid) is not None:
+            return False
+        with self._lock:
+            if rid in self._draining:
+                return False
+        return self.breaker(rid).state != resilience.CircuitBreaker.OPEN
+
+    # -- operator surface --------------------------------------------------
+    def cordon(self, rid: str, reason: str = "operator") -> None:
+        self._ensure_gauge(rid)
+        with self._lock:
+            self._cordoned[str(rid)] = str(reason)
+
+    def uncordon(self, rid: str) -> bool:
+        with self._lock:
+            return self._cordoned.pop(str(rid), None) is not None
+
+    def set_draining(self, rid: str, draining: bool) -> None:
+        """Record the replica's OWN drain state (learned from a
+        ``[GM-DRAINING]`` answer or a probe) — distinct from cordon: the
+        replica asked to be drained, the router just obeys."""
+        self._ensure_gauge(rid)
+        with self._lock:
+            if draining:
+                self._draining.add(rid)
+            else:
+                self._draining.discard(rid)
+
+    # -- fault bookkeeping -------------------------------------------------
+    def record_failure(self, rid: str, error: BaseException) -> None:
+        self._ensure_gauge(rid)
+        self.breaker(rid).record_failure()
+        with self._lock:
+            self._failures[rid] = self._failures.get(rid, 0) + 1
+            self._last_failure[rid] = repr(error)[:300]
+
+    def record_success(self, rid: str) -> None:
+        # NOT the place to reset the outlier streak: a latency outlier
+        # is still a successful call (record_success always follows
+        # record_latency on that path), so clearing here would cap the
+        # streak at 1 and the straggler detector could never trip —
+        # record_latency itself zeroes the streak on non-outlier samples
+        self.breaker(rid).record_success()
+
+    def note_failed_over(self, rid: str) -> None:
+        with self._lock:
+            self._failed_over[rid] = self._failed_over.get(rid, 0) + 1
+
+    def record_latency(self, rid: str, seconds: float, op: str) -> None:
+        """One routed-call latency sample for ``op``. Consecutive outliers
+        vs the trailing fleet-wide median OF THE SAME OP (over the floor)
+        trip the replica's breaker — the §6 straggler-lane rule, with the
+        op standing in for the kernel shape (what actually determines a
+        call's expected cost on the wire)."""
+        try:
+            factor = config.FLEET_LATENCY_OUTLIER.to_float() or 0.0
+        except (TypeError, ValueError):
+            factor = 0.0
+        if factor <= 0:
+            return
+        floor_s = (config.FLEET_LATENCY_FLOOR_MS.to_float() or 250.0) / 1e3
+        with self._lock:
+            dq = self._lat_recent.pop(op, None)
+            if dq is None:
+                dq = deque(maxlen=256)
+            self._lat_recent[op] = dq  # re-insert = most recently seen
+            while len(self._lat_recent) > self._MAX_OPS:
+                self._lat_recent.pop(next(iter(self._lat_recent)))
+            samples = sorted(dq)
+            dq.append(seconds)
+            median = samples[len(samples) // 2] if len(samples) >= 8 else None
+            if median is not None \
+                    and seconds >= max(floor_s, factor * median):
+                streak = self._outlier_streak.get(rid, 0) + 1
+                self._outlier_streak[rid] = streak
+                threshold = config.FLEET_BREAKER_THRESHOLD.to_int() or 3
+                if streak < threshold:
+                    return
+                self._outlier_streak[rid] = 0
+                self._last_failure[rid] = (
+                    f"latency outlier: {seconds * 1e3:.1f} ms >= "
+                    f"{factor:g} x median {median * 1e3:.1f} ms for "
+                    f"op {op!r} ({streak} consecutive)"
+                )
+            else:
+                self._outlier_streak[rid] = 0
+                return
+        # trip outside the registry lock (breaker has its own)
+        self.breaker(rid).trip()
+
+    # -- operator payloads -------------------------------------------------
+    def snapshot(self) -> Dict[str, Dict[str, Any]]:
+        """Per-replica health payload (/debug/fleet, the CLI ``fleet
+        status`` command)."""
+        with self._lock:
+            members = dict(self._members)
+            cordons = dict(self._cordoned)
+            failures = dict(self._failures)
+            failed_over = dict(self._failed_over)
+            last = dict(self._last_failure)
+        out: Dict[str, Dict[str, Any]] = {}
+        for rid in sorted(members):
+            entry: Dict[str, Any] = {
+                "location": members[rid],
+                "state": self.state(rid),
+                "breaker": self.breaker(rid).state,
+                "failures": failures.get(rid, 0),
+                "failed_over": failed_over.get(rid, 0),
+            }
+            reason = cordons.get(rid) or (
+                "geomesa.fleet.cordon" if rid in _cordon_config_ids()
+                else None
+            )
+            if reason is not None:
+                entry["cordon_reason"] = reason
+            if rid in last:
+                entry["last_failure"] = last[rid]
+            out[rid] = entry
+        return out
+
+    def summary(self) -> Dict[str, Any]:
+        members = self.members()
+        states = {rid: self.state(rid) for rid in members}
+        return {
+            "total": len(members),
+            "usable": sum(1 for rid in members if self.usable(rid)),
+            "cordoned": sorted(r for r, s in states.items()
+                               if s == CORDONED),
+            "draining": sorted(r for r, s in states.items()
+                               if s == DRAINING),
+            "broken": sorted(r for r, s in states.items() if s == BROKEN),
+        }

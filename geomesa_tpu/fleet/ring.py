@@ -1,0 +1,58 @@
+"""Rendezvous (highest-random-weight) hash ring for replica membership.
+
+Chosen over a vnode consistent-hash ring because HRW gives the two
+properties the fleet cares about with no tuning surface:
+
+* **minimal movement** — removing a member re-homes ONLY the keys that
+  member owned (each key's other candidates keep their relative order),
+  and adding one steals exactly the keys it now wins; a replica bounce
+  never reshuffles the rest of the fleet's warm caches;
+* **an ordered owner list per key** — the failover path IS the ranking:
+  the first live member in ``owners(key)`` serves, the next one is the
+  natural fallback, identical on every router instance (the hash is
+  keyed only by member id and key bytes, never process state).
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Iterable, List, Optional, Tuple
+
+
+def _score(member: str, key: bytes) -> int:
+    """HRW weight of ``member`` for ``key`` — a keyed blake2b digest, so
+    scores are stable across processes and python hash randomization."""
+    h = hashlib.blake2b(key, digest_size=8, key=member.encode()[:64])
+    return int.from_bytes(h.digest(), "big")
+
+
+class RendezvousRing:
+    """Immutable-membership rendezvous ring. Rebuild on membership change
+    (:meth:`with_members`) — construction is O(members)."""
+
+    def __init__(self, members: Iterable[str]):
+        self.members: Tuple[str, ...] = tuple(sorted(set(members)))
+        if not self.members:
+            raise ValueError("ring needs at least one member")
+
+    def with_members(self, members: Iterable[str]) -> "RendezvousRing":
+        return RendezvousRing(members)
+
+    @staticmethod
+    def _key_bytes(key) -> bytes:
+        if isinstance(key, bytes):
+            return key
+        return str(key).encode()
+
+    def owners(self, key, n: Optional[int] = None) -> List[str]:
+        """Members ranked by HRW weight for ``key`` (highest first): the
+        affinity owner, then the failover order. ``n`` truncates."""
+        kb = self._key_bytes(key)
+        ranked = sorted(
+            self.members, key=lambda m: _score(m, kb), reverse=True
+        )
+        return ranked if n is None else ranked[:n]
+
+    def owner(self, key) -> str:
+        kb = self._key_bytes(key)
+        return max(self.members, key=lambda m: _score(m, kb))

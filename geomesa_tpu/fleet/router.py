@@ -1,0 +1,740 @@
+"""Cell-affinity fleet router (docs/RESILIENCE.md §7).
+
+A :class:`FleetRouter` fronts N replica sidecars over one shared storage
+root with a GeoDataset-shaped remote API. Per query it:
+
+1. derives an **affinity key** from the query's SFC cell cover (the same
+   cell family the aggregate cache decomposes to, cache/cells.py): the
+   bbox center's cell at ``geomesa.fleet.routing.level`` — so nearby
+   viewports land on the same replica and its flat+hierarchy cache stays
+   hot for its slice of the world, making fleet cache capacity additive;
+2. ranks replicas on the **rendezvous ring** (fleet/ring.py) and serves
+   from the first USABLE owner (registry-filtered: cordoned / draining /
+   open-breaker replicas are skipped);
+3. **fails over** to the next ring owner when a call fails retryably —
+   deadline-aware (an expired budget stops the walk typed), with the
+   replica's breaker charged for transport/internal failures and its
+   latency fed to the outlier detector;
+4. when EVERY owner is down, **degrades typed**: under ``allow_partial()``
+   additive aggregates return the survivor total with the skip recorded
+   (``[GM-FLEET-PARTIAL]`` accounting, resilience §3 generalized from
+   partitions to replicas); strict mode raises
+   :class:`~geomesa_tpu.resilience.FleetPartialError`;
+5. **scatters** decomposable exact counts across owner groups
+   (``geomesa.fleet.scatter``): each replica scans only its own cells —
+   integer partials add exactly, so the scatter is bit-identical to the
+   single-process scan by the cache's cell-partition argument — and a
+   dead owner degrades with EXACT survivor totals (the surviving groups'
+   sum plus a per-group skip record);
+6. stamps **mutation epochs** onto writes and requires them on reads
+   (sidecar fleet headers), so a restarted or failed-over replica
+   refreshes from the shared root before it can serve a pre-mutation
+   aggregate.
+
+Admission/fair-share rides the same ``_UserLedger``-backed scheduler the
+serving layer uses (inline mode + the ``geomesa.fleet.max.inflight``
+bound), so ``/debug/fleet`` rollups and shed decisions share one
+accounting with every other surface.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+import weakref
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from geomesa_tpu import config, metrics, resilience, tracing
+from geomesa_tpu.cache import cells as cellmod
+from geomesa_tpu.fleet.registry import ReplicaRegistry
+from geomesa_tpu.fleet.ring import RendezvousRing
+from geomesa_tpu.resilience import (
+    AdmissionRejectedError, CircuitOpenError, DeviceDrainError,
+    FleetPartialError, QueryTimeoutError, Skipped,
+)
+
+#: routers alive in this process (weak — /debug/fleet reads them)
+_ROUTERS: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def debug_fleet() -> Dict[str, Any]:
+    """The /debug/fleet payload: one snapshot per live router in this
+    process (obs.py mounts it; docs/RESILIENCE.md §7)."""
+    routers = [r.snapshot() for r in list(_ROUTERS)]
+    return {"routers": routers}
+
+
+class _Exhausted(Exception):
+    """Internal: every candidate replica failed; carries the last error."""
+
+    def __init__(self, last: Optional[BaseException]):
+        super().__init__(repr(last))
+        self.last = last
+
+
+class FleetRouter:
+    """See the module docstring. Thread-safe; one per front-end process."""
+
+    def __init__(self, replicas: Dict[str, str],
+                 retry_seed: Optional[int] = None,
+                 name: str = "geomesa-fleet-router"):
+        from geomesa_tpu.serving import QueryScheduler
+
+        self.name = name
+        self.registry = ReplicaRegistry(replicas)
+        self.ring = RendezvousRing(replicas)
+        self._retry_seed = retry_seed
+        self._clients: Dict[str, Any] = {}
+        self._clients_lock = threading.Lock()
+        #: authoritative per-schema fleet epochs (router-stamped writes
+        #: bump them; probes adopt newer ones learned from replicas)
+        self._epochs: Dict[str, int] = {}
+        self._epoch_lock = threading.Lock()
+        #: per-thread active write stamp ({schema: epoch}) — read by the
+        #: clients' header provider while the stamped call is in flight
+        self._tls = threading.local()
+        #: fleet-level admission + per-user ledger: the same policy/
+        #: accounting object the serving scheduler runs (docs/SERVING.md)
+        self.serving = QueryScheduler(name)
+        self._fts: Dict[str, Any] = {}
+        self._ft_lock = threading.Lock()
+        self._counters = {"affinity": 0, "failover": 0, "scatter": 0,
+                          "partial": 0}
+        self._counter_lock = threading.Lock()
+        _ROUTERS.add(self)
+
+    # -- membership --------------------------------------------------------
+    def add_replica(self, rid: str, location: str) -> None:
+        """Add (or re-home) a replica. A cached client to the id's OLD
+        location is dropped — a restarted replica usually comes back on
+        a fresh port."""
+        self.registry.add(rid, location)
+        self.ring = RendezvousRing(set(self.ring.members) | {rid})
+        with self._clients_lock:
+            c = self._clients.pop(rid, None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    def remove_replica(self, rid: str) -> None:
+        self.registry.remove(rid)
+        members = [m for m in self.ring.members if m != rid]
+        self.ring = RendezvousRing(members)
+        with self._clients_lock:
+            c = self._clients.pop(rid, None)
+        if c is not None:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    # -- admin -------------------------------------------------------------
+    def cordon(self, rid: str, reason: str = "operator") -> None:
+        """Router-side cordon: stop ROUTING to the replica (the replica
+        itself keeps serving anyone else)."""
+        self.registry.cordon(rid, reason)
+
+    def uncordon(self, rid: str) -> bool:
+        return self.registry.uncordon(rid)
+
+    def drain_replica(self, rid: str, reason: Optional[str] = None) -> Dict:
+        """Replica-side drain via the admin action: the replica answers
+        every router's traffic ``[GM-DRAINING]`` until undrained."""
+        out = self._client(rid).drain(reason=reason)
+        self.registry.set_draining(rid, True)
+        return out
+
+    def undrain_replica(self, rid: str) -> Dict:
+        out = self._client(rid).undrain()
+        self.registry.set_draining(rid, False)
+        return out
+
+    def probe(self, rid: str) -> Dict[str, Any]:
+        """One health probe (the /healthz analog over Flight): reads the
+        replica's status, adopts its drain flag and any NEWER epochs it
+        knows (a fresh router learns fleet state from its replicas), and
+        feeds the breaker — a failed probe is failure evidence exactly
+        like a failed routed call."""
+        try:
+            st = self._client(rid).replica_status()
+        except Exception as e:
+            self.registry.record_failure(rid, e)
+            return {"replica": rid, "ok": False, "error": repr(e)[:300]}
+        self.registry.record_success(rid)
+        self.registry.set_draining(rid, bool(st.get("draining")))
+        with self._epoch_lock:
+            for name, e in (st.get("epochs") or {}).items():
+                if self._epochs.get(name, 0) < int(e):
+                    self._epochs[name] = int(e)
+        return {"replica": rid, "ok": True, **st}
+
+    def probe_all(self) -> Dict[str, Dict[str, Any]]:
+        return {rid: self.probe(rid) for rid in self.registry.members()}
+
+    def snapshot(self) -> Dict[str, Any]:
+        """The /debug/fleet payload for this router."""
+        with self._counter_lock:
+            counters = dict(self._counters)
+        with self._epoch_lock:
+            epochs = dict(self._epochs)
+        return {
+            "name": self.name,
+            "ring": list(self.ring.members),
+            "replicas": self.registry.snapshot(),
+            "summary": self.registry.summary(),
+            "epochs": epochs,
+            "counters": counters,
+            "serving": self.serving.snapshot(),
+            "users": self.serving.user_rollups(),
+        }
+
+    def close(self) -> None:
+        _ROUTERS.discard(self)  # a closed router leaves /debug/fleet
+        with self._clients_lock:
+            clients, self._clients = list(self._clients.values()), {}
+        for c in clients:
+            try:
+                c.close()
+            except Exception:
+                pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- plumbing ----------------------------------------------------------
+    def _client(self, rid: str):
+        with self._clients_lock:
+            c = self._clients.get(rid)
+            if c is None:
+                from geomesa_tpu.sidecar.client import GeoFlightClient
+
+                c = self._clients[rid] = GeoFlightClient(
+                    self.registry.location(rid),
+                    retry_seed=self._retry_seed,
+                    header_provider=self._fleet_headers,
+                )
+        return c
+
+    def _fleet_headers(self) -> List[Tuple[bytes, bytes]]:
+        """Per-call fleet headers: the epochs every replica must be AT
+        before serving, plus — inside a write's stamp scope — the epoch
+        this mutation establishes (the stamped schema's required read
+        epoch is E-1: E's data is what the write is creating)."""
+        import json as _json
+
+        from geomesa_tpu.sidecar.client import (
+            FLEET_EPOCHS_HEADER, FLEET_STAMP_HEADER,
+        )
+
+        with self._epoch_lock:
+            epochs = dict(self._epochs)
+        stamp = getattr(self._tls, "stamp", None)
+        out = []
+        if stamp:
+            for name, e in stamp.items():
+                epochs[name] = int(e) - 1
+            out.append((FLEET_STAMP_HEADER.encode(),
+                        _json.dumps(stamp).encode()))
+        epochs = {k: v for k, v in epochs.items() if v > 0}
+        if epochs:
+            out.append((FLEET_EPOCHS_HEADER.encode(),
+                        _json.dumps(epochs).encode()))
+        return out
+
+    @contextlib.contextmanager
+    def _stamp(self, name: str):
+        """Mutation-epoch stamp scope: bumps the schema's fleet epoch and
+        exposes the stamp to the header provider for the duration of the
+        write. The bump is monotonic and survives a failed write — the
+        worst case is one redundant refresh on each replica, never a
+        stale serve."""
+        with self._epoch_lock:
+            e = self._epochs.get(name, 0) + 1
+            self._epochs[name] = e
+        metrics.inc(metrics.FLEET_EPOCH_BUMP)
+        self._tls.stamp = {name: e}
+        try:
+            yield e
+        finally:
+            self._tls.stamp = None
+        with self._ft_lock:
+            self._fts.pop(name, None)  # spec may have changed
+
+    def _count(self, key: str) -> None:
+        with self._counter_lock:
+            self._counters[key] += 1
+
+    @contextlib.contextmanager
+    def _admit(self, op: str, user: Optional[str] = None):
+        cap = config.FLEET_MAX_INFLIGHT.to_int()
+        with self.serving.admit(f"fleet.{op}", user=user,
+                                inflight_cap=256 if cap is None else cap):
+            yield
+
+    # -- affinity ----------------------------------------------------------
+    def _ft(self, name: str):
+        """The schema's FeatureType, fetched once (describe's additive
+        ``spec`` field) and cached until a mutation stamp drops it. None
+        when no replica can answer — affinity then degrades to the
+        filter-hash key, routing still works."""
+        with self._ft_lock:
+            ft = self._fts.get(name)
+        if ft is not None:
+            return ft
+        from geomesa_tpu.schema.feature_type import FeatureType
+
+        try:
+            spec, _rid = self._call(
+                name, f"schema:{name}", "describe",
+                lambda c: c.schema_spec(name),
+            )
+            ft = FeatureType.from_spec(name, spec)
+        except Exception:
+            return None
+        with self._ft_lock:
+            self._fts[name] = ft
+        return ft
+
+    def _parse(self, name: str, ecql: str):
+        """(ir filter, FeatureType) for affinity derivation; (None, ft)
+        when the text doesn't parse (the replica will raise the typed
+        error — affinity just needs a stable key)."""
+        ft = self._ft(name)
+        try:
+            from geomesa_tpu.filter.ecql import parse_ecql
+
+            f = parse_ecql(ecql)
+        except Exception:
+            f = None
+        return f, ft
+
+    @staticmethod
+    def _routing_level() -> int:
+        lvl = config.FLEET_ROUTING_LEVEL.to_int()
+        return 3 if lvl is None else max(1, min(int(lvl), 15))
+
+    def _affinity_key(self, name: str, f, ft) -> str:
+        """The query's ring key: the bbox center's SFC cell at the
+        routing level (pan/zoom neighbors share it — and share the cell
+        prefixes the replica's cache keys on), else a stable hash of the
+        canonical filter so exact repeats stay warm on one replica."""
+        if f is not None and ft is not None and ft.geom_field is not None:
+            split = cellmod.split_bbox_conjunct(f, ft.geom_field)
+            if split is not None:
+                box = split[0]
+                lvl = self._routing_level()
+                n = 1 << lvl
+                cx = (box.xmin + box.xmax) / 2.0
+                cy = (box.ymin + box.ymax) / 2.0
+                ix = int(np.clip((cx + 180.0) / 360.0 * n, 0, n - 1))
+                iy = int(np.clip((cy + 90.0) / 180.0 * n, 0, n - 1))
+                prefix = cellmod.cell_prefix(lvl, (ix, iy))
+                return f"{name}:z{lvl}:{prefix}"
+        return f"{name}:f:{repr(f)}" if f is not None else f"schema:{name}"
+
+    def _owners(self, key: str) -> List[str]:
+        """Ring owner order for ``key``, usable replicas first. The
+        unusable tail stays appended: when NOTHING is usable, half-open
+        breakers still admit a trial through the client path, which is
+        how a recovered fleet heals."""
+        ranked = self.ring.owners(key)
+        usable = [r for r in ranked if self.registry.usable(r)]
+        rest = [r for r in ranked if r not in usable]
+        return usable + rest
+
+    # -- routed call core --------------------------------------------------
+    def _classify(self, rid: str, e: BaseException, write: bool) -> str:
+        """``raise`` (the caller's own error — propagate), ``skip``
+        (candidate unusable, no breaker charge), or ``fail`` (replica
+        failure evidence: charge + fail over)."""
+        from geomesa_tpu.sidecar.client import error_code
+
+        if isinstance(e, QueryTimeoutError):
+            # the QUERY's budget (deadline expiry or a shed) — says
+            # nothing about replica health, and another replica cannot
+            # beat the same expired budget
+            return "raise"
+        if isinstance(e, CircuitOpenError):
+            return "skip"  # already fenced; the breaker said so
+        if isinstance(e, DeviceDrainError):
+            # a REPLICA-level drain is sticky (the replica asked; probes
+            # clear it on undrain); a slot-level [GM-DRAINING] (one
+            # dispatcher died and respawned) is transient — skip this
+            # attempt without writing the whole replica off
+            msg = str(e).lower()
+            if "replica" in msg and "draining" in msg:
+                self.registry.set_draining(rid, True)
+            return "skip"
+        code = error_code(e)
+        if code == "GM-ARG":
+            return "raise"  # the same request fails the same way anywhere
+        if code == "GM-OVERLOADED":
+            # healthy but saturated: fail over without breaker charge
+            return "skip"
+        if write:
+            import pyarrow.flight as fl
+
+            if code is None and isinstance(e, fl.FlightUnavailableError) \
+                    and "connect" in str(e).lower():
+                # connection never established: nothing was sent, so a
+                # WRITE is safe to fail over (a dead owner must not make
+                # ingest unavailable while survivors hold the root)
+                self.registry.record_failure(rid, e)
+                return "fail"
+            # ANY other write failure — uncoded transport (lost ack) or
+            # coded GM-INTERNAL (the server may have applied the rows
+            # and failed only at persist/ack time) — must never
+            # blind-resend on another replica: it would double-apply
+            self.registry.record_failure(rid, e)
+            return "raise"
+        self.registry.record_failure(rid, e)
+        return "fail"
+
+    def _call(self, name: Optional[str], key: str, op: str,
+              fn: Callable[[Any], Any], write: bool = False,
+              owners: Optional[List[str]] = None):
+        """One routed call with ring-owner failover. Returns
+        ``(value, rid)``; raises :class:`_Exhausted` when every candidate
+        failed (callers decide degrade-vs-typed). ``owners`` overrides
+        the candidate ORDER (the scatter path pins each group's owner
+        first); usability filtering still applies."""
+        if owners is None:
+            owners = self._owners(key)
+        else:
+            usable = [r for r in owners if self.registry.usable(r)]
+            owners = usable + [r for r in owners if r not in usable]
+        last: Optional[BaseException] = None
+        failed_over = False
+        t_first = time.perf_counter()
+        for i, rid in enumerate(owners):
+            if resilience.current_deadline().expired:
+                raise QueryTimeoutError(
+                    "query deadline expired during fleet routing"
+                )
+            try:
+                with tracing.span("fleet.route", replica=rid, attempt=i,
+                                  schema=name or "", op=op):
+                    t0 = time.perf_counter()
+                    out = fn(self._client(rid))
+                    dt = time.perf_counter() - t0
+            except Exception as e:
+                kind = self._classify(rid, e, write)
+                if kind == "raise":
+                    raise
+                last = e
+                failed_over = True
+                self.registry.note_failed_over(rid)
+                continue
+            self.registry.record_latency(rid, dt, op)
+            self.registry.record_success(rid)
+            if failed_over:
+                self._count("failover")
+                metrics.inc(metrics.FLEET_ROUTE_FAILOVER)
+                # the failover COST: everything since the first attempt
+                # (failed dials + backoffs + the surviving call)
+                metrics.observe("fleet.failover",
+                                time.perf_counter() - t_first)
+            else:
+                self._count("affinity")
+                metrics.inc(metrics.FLEET_ROUTE_AFFINITY)
+            return out, rid
+        raise _Exhausted(last)
+
+    def _route(self, name: str, key: str, op: str,
+               fn: Callable[[Any], Any],
+               degrade: Optional[Callable[[], Any]] = None,
+               user: Optional[str] = None, write: bool = False):
+        """Admission + routed call + the typed degradation contract."""
+        with self._admit(op, user=user), \
+                tracing.start(f"fleet.{op}", schema=name):
+            try:
+                out, _rid = self._call(name, key, op, fn, write=write)
+                return out
+            except _Exhausted as ex:
+                return self._degrade(name, op, ex.last, degrade)
+
+    def _degrade(self, name: str, op: str, last: Optional[BaseException],
+                 degrade: Optional[Callable[[], Any]]):
+        err = last if last is not None else RuntimeError(
+            "no usable replica in the fleet"
+        )
+        self._count("partial")
+        metrics.inc(metrics.FLEET_ROUTE_PARTIAL)
+        if degrade is not None and resilience.partial_allowed():
+            resilience.record_skip(
+                "fleet.route", part=f"{name}:{op}", error=err
+            )
+            return degrade()
+        raise FleetPartialError(
+            f"every ring owner of {op} on {name!r} is down "
+            f"(last: {err!r})",
+            value=None, ok=0, total=1,
+            skipped=[Skipped(source="fleet.route", part=f"{name}:{op}",
+                             error=repr(err))],
+        ) from last
+
+    # -- scatter counts ----------------------------------------------------
+    @staticmethod
+    def _bbox_ecql(geom: str, boxes: Sequence[Tuple[float, float, float,
+                                                    float]]) -> str:
+        parts = [
+            f"BBOX({geom}, {b[0]!r}, {b[1]!r}, {b[2]!r}, {b[3]!r})"
+            for b in boxes
+        ]
+        return parts[0] if len(parts) == 1 else "(" + " OR ".join(parts) + ")"
+
+    @staticmethod
+    def _and_ecql(ecql: str, conjunct: str) -> str:
+        if ecql.strip().upper() == "INCLUDE":
+            return conjunct
+        return f"({ecql}) AND {conjunct}"
+
+    def _scatter_groups(self, name: str, decomp) -> Dict[str, List[Tuple[
+            int, int]]]:
+        """Group the decomposition's interior cells by ring owner: each
+        cell's ROUTING-level ancestor keys the ring (the same key family
+        single-query affinity uses, so a scattered group lands exactly
+        where the undecomposed queries for that slice of the world warm
+        their caches)."""
+        lvl = self._routing_level()
+        groups: Dict[str, List[Tuple[int, int]]] = {}
+        for (ix, iy) in decomp.cells:
+            if decomp.level >= lvl:
+                anc = (ix >> (decomp.level - lvl), iy >> (decomp.level - lvl))
+                alvl = lvl
+            else:
+                anc, alvl = (ix, iy), decomp.level
+            key = f"{name}:z{alvl}:{cellmod.cell_prefix(alvl, anc)}"
+            groups.setdefault(self.ring.owner(key), []).append((ix, iy))
+        return groups
+
+    def _scatter_count(self, name: str, ecql: str, decomp, ft,
+                       call_kw: Dict[str, Any],
+                       user: Optional[str]) -> int:
+        """Exact count scattered by cell ownership: one sub-count per
+        owner group over ``orig ∧ (its cells)`` plus the boundary strips
+        on the affinity owner — disjoint boxes, integer partials, so the
+        sum is bit-identical to the whole-query count. A group whose
+        every candidate fails degrades with EXACT survivor totals under
+        ``allow_partial()`` and raises typed otherwise."""
+        geom = ft.geom_field
+        groups = self._scatter_groups(name, decomp)
+        jobs: List[Tuple[str, str, str]] = []  # (owner, sub_ecql, label)
+        for owner, cells in sorted(groups.items()):
+            boxes = [decomp.cell_boxes[c] for c in cells]
+            jobs.append((
+                owner,
+                self._and_ecql(ecql, self._bbox_ecql(geom, boxes)),
+                f"cells[{len(cells)}@z{decomp.level}]",
+            ))
+        if decomp.strips:
+            # boundary strips ride the schema-affinity owner
+            jobs.append((
+                self.ring.owner(f"schema:{name}"),
+                self._and_ecql(ecql, self._bbox_ecql(geom, decomp.strips)),
+                f"strips[{len(decomp.strips)}]",
+            ))
+        self._count("scatter")
+        metrics.inc(metrics.FLEET_ROUTE_SCATTER)
+        total = 0
+        ok = 0
+        skipped: List[Skipped] = []
+        with self._admit("count", user=user), \
+                tracing.start("fleet.count", schema=name, scatter=True):
+            for owner, sub_ecql, label in jobs:
+                # owner-first order, then the ring's ranking for failover
+                # (any replica can serve any cells — shared storage)
+                order = [owner] + [
+                    r for r in self.ring.owners(f"schema:{name}")
+                    if r != owner
+                ]
+                try:
+                    n, _rid = self._call(
+                        name, f"{name}:owner:{owner}", "count",
+                        lambda c, e=sub_ecql: c.count(name, e, **call_kw),
+                        owners=order,
+                    )
+                except _Exhausted as ex:
+                    err = ex.last or RuntimeError("no usable replica")
+                    # phase carries the group's sub-query verbatim: the
+                    # EXACT rows the degraded total is missing — a
+                    # consumer (or test) can re-run it once the fleet
+                    # heals and reconcile to the full answer. Surviving
+                    # groups keep executing in BOTH modes, so the
+                    # accounting is always complete: strict mode raises
+                    # at the end with the full survivor total.
+                    rec = Skipped(source="fleet.route",
+                                  part=f"{name}:{label}", error=repr(err),
+                                  phase=sub_ecql)
+                    if resilience.partial_allowed():
+                        resilience.record_skip(
+                            "fleet.route", part=f"{name}:{label}",
+                            error=err, phase=sub_ecql,
+                        )
+                    skipped.append(rec)
+                    self._count("partial")
+                    metrics.inc(metrics.FLEET_ROUTE_PARTIAL)
+                    continue
+                total += int(n)
+                ok += 1
+        if skipped and not resilience.partial_allowed():
+            raise FleetPartialError(
+                f"{len(skipped)} owner group(s) of count on {name!r} are "
+                f"down (survivors: {ok}/{len(jobs)} groups, "
+                f"count {total})",
+                value=total, ok=ok, total=len(jobs), skipped=skipped,
+            )
+        return total
+
+    # -- public API (GeoDataset-shaped) ------------------------------------
+    def count(self, name: str, ecql: str = "INCLUDE", exact: bool = True,
+              auths: Optional[Sequence[str]] = None,
+              region: Optional[str] = None,
+              speculative_ok: bool = False,
+              user: Optional[str] = None) -> int:
+        call_kw: Dict[str, Any] = {"exact": exact}
+        if auths is not None:
+            call_kw["auths"] = list(auths)
+        if region is not None:
+            call_kw["region"] = region
+        if speculative_ok:
+            call_kw["speculative_ok"] = True
+        f, ft = self._parse(name, ecql)
+        if (exact and region is None and f is not None and ft is not None
+                and config.FLEET_SCATTER.to_bool()
+                and sum(1 for r in self.registry.members()
+                        if self.registry.usable(r)) > 1):
+            decomp = cellmod.decompose(f, ft)
+            if decomp is not None and len(decomp.cells) > 1:
+                groups = self._scatter_groups(name, decomp)
+                if len(groups) > 1:
+                    return self._scatter_count(
+                        name, ecql, decomp, ft, call_kw, user
+                    )
+        key = self._affinity_key(name, f, ft)
+        return self._route(
+            name, key, "count",
+            lambda c: c.count(name, ecql, **call_kw),
+            degrade=lambda: 0, user=user,
+        )
+
+    def density(self, name: str, ecql: str = "INCLUDE", bbox=None,
+                width: int = 256, height: int = 256,
+                weight: Optional[str] = None,
+                auths: Optional[Sequence[str]] = None,
+                region: Optional[str] = None,
+                user: Optional[str] = None) -> np.ndarray:
+        f, ft = self._parse(name, ecql)
+        key = self._affinity_key(name, f, ft)
+        return self._route(
+            name, key, "density",
+            lambda c: c.density(name, ecql, bbox=bbox, width=width,
+                                height=height, weight=weight, auths=auths,
+                                region=region),
+            degrade=lambda: np.zeros((height, width), np.float32),
+            user=user,
+        )
+
+    def density_curve(self, name: str, ecql: str = "INCLUDE",
+                      level: int = 9, bbox=None,
+                      weight: Optional[str] = None,
+                      auths: Optional[Sequence[str]] = None,
+                      user: Optional[str] = None):
+        f, ft = self._parse(name, ecql)
+        key = self._affinity_key(name, f, ft)
+        return self._route(
+            name, key, "density_curve",
+            lambda c: c.density_curve(name, ecql, level=level, bbox=bbox,
+                                      weight=weight, auths=auths),
+            user=user,
+        )
+
+    def stats(self, name: str, stat_spec: str, ecql: str = "INCLUDE",
+              auths: Optional[Sequence[str]] = None,
+              region: Optional[str] = None,
+              user: Optional[str] = None):
+        from geomesa_tpu.stats import parse_stat
+
+        f, ft = self._parse(name, ecql)
+        key = self._affinity_key(name, f, ft)
+        return self._route(
+            name, key, "stats",
+            lambda c: c.stats(name, stat_spec, ecql, auths=auths,
+                              region=region),
+            degrade=lambda: parse_stat(stat_spec), user=user,
+        )
+
+    def query(self, name: str, ecql: str = "INCLUDE",
+              user: Optional[str] = None, **kw):
+        f, ft = self._parse(name, ecql)
+        key = self._affinity_key(name, f, ft)
+        return self._route(
+            name, key, "query",
+            lambda c: c.query(name, ecql, **kw), user=user,
+        )
+
+    def explain(self, name: str, ecql: str = "INCLUDE",
+                user: Optional[str] = None) -> str:
+        f, ft = self._parse(name, ecql)
+        key = self._affinity_key(name, f, ft)
+        return self._route(
+            name, key, "explain", lambda c: c.explain(name, ecql),
+            user=user,
+        )
+
+    def list_schemas(self, user: Optional[str] = None) -> List[str]:
+        return self._route(
+            "", "schemas", "list-schemas", lambda c: c.list_schemas(),
+            user=user,
+        )
+
+    # -- writes (router-stamped epochs) ------------------------------------
+    def create_schema(self, name: str, spec: str,
+                      user: Optional[str] = None) -> str:
+        with self._stamp(name):
+            return self._route(
+                name, f"schema:{name}", "create-schema",
+                lambda c: c.create_schema(name, spec),
+                user=user, write=True,
+            )
+
+    def delete_schema(self, name: str, user: Optional[str] = None) -> None:
+        with self._stamp(name):
+            self._route(
+                name, f"schema:{name}", "delete-schema",
+                lambda c: c.delete_schema(name), user=user, write=True,
+            )
+
+    def insert_arrow(self, name: str, table,
+                     user: Optional[str] = None) -> None:
+        """Stamped ingest: the receiving replica applies the rows, saves
+        the shared root, and advances to the stamped epoch; every other
+        replica refreshes before its next serve of this schema."""
+        with self._stamp(name):
+            self._route(
+                name, f"schema:{name}", "insert",
+                lambda c: c.insert_arrow(name, table),
+                user=user, write=True,
+            )
+
+    # -- fleet-wide views --------------------------------------------------
+    def replica_metrics(self) -> Dict[str, Dict]:
+        """Per-replica /metrics snapshots (best effort; a down replica
+        reports its error instead) — the bench's affinity-hit-ratio
+        source."""
+        out: Dict[str, Dict] = {}
+        for rid in self.registry.members():
+            try:
+                out[rid] = self._client(rid).metrics()
+            except Exception as e:
+                out[rid] = {"error": repr(e)[:200]}
+        return out

@@ -512,6 +512,18 @@ class PartitionedFeatureStore(FeatureStore):
 
         return mark
 
+    @staticmethod
+    def _pushdown_fallback(b: int, window: Optional[Dict],
+                           reason: str) -> None:
+        """One pushdown request this snapshot could not serve pruned
+        (docs/LAKE.md §10): counted in ``lake.pushdown.fallback`` and
+        recorded on the window dict so the executor folds it into the
+        explain/audit ``exec_path`` — a silent full load must never read
+        as "pushdown covered everything"."""
+        metrics.inc("lake.pushdown.fallback")
+        if isinstance(window, dict):
+            window.setdefault("fallbacks", []).append((int(b), reason))
+
     # -- statistics-pruned partial loads (docs/LAKE.md) --------------------
     def scan_child(self, b: int,
                    window: Optional[Dict] = None) -> Optional[FeatureStore]:
@@ -550,12 +562,20 @@ class PartitionedFeatureStore(FeatureStore):
                     "(clear_spill_quarantine() re-admits after repair)"
                 )
             d = self.spilled[b]
-        if window is None \
-                or not os.path.exists(os.path.join(d, SNAPSHOT_FILE)):
+        if window is None:
+            return self.child(b)
+        if not os.path.exists(os.path.join(d, SNAPSHOT_FILE)):
+            # pre-lake npz snapshot: statistics don't exist, pushdown
+            # CANNOT engage — count it so the full load never reads as
+            # "pushdown covered everything" (docs/LAKE.md §10)
+            self._pushdown_fallback(b, window, "legacy-snapshot")
             return self.child(b)
         requested = window.get("index")
         ks = next((k for k in self.keyspaces if k.name == requested), None)
         if ks is None:
+            # exotic keyspace: the plan's index is not one this store
+            # carries statistics for (docs/LAKE.md §10)
+            self._pushdown_fallback(b, window, "unknown-keyspace")
             return self.child(b)
         policy = resilience.RetryPolicy.from_config(seed=int(b))
         try:
@@ -567,11 +587,20 @@ class PartitionedFeatureStore(FeatureStore):
                 ("k/" + kc) in have or ("c/" + kc) in have
                 for kc in ks.key_cols
             )
-            if (snap.primary is None
-                    or snap.primary not in snap.tables
-                    or not buildable
-                    or len(groups) == len(snap.groups)):
-                return self.child(b)  # nothing prunes: full load caches
+            if snap.primary is None or snap.primary not in snap.tables:
+                self._pushdown_fallback(b, window, "no-primary-order")
+                return self.child(b)
+            if not buildable:
+                # the requested keyspace's key columns aren't in the
+                # snapshot: a pruned subset couldn't rebuild its
+                # permutation — the exotic-keyspace full-load fallback
+                self._pushdown_fallback(b, window, "keyspace-not-buildable")
+                return self.child(b)
+            if len(groups) == len(snap.groups):
+                # nothing prunes: the full resident load is strictly
+                # better (it caches) — a DELIBERATE full load, not a
+                # fallback, so it stays out of the fallback accounting
+                return self.child(b)
 
             def attempt():
                 resilience.fault_point("index.spill.load", bin=int(b),

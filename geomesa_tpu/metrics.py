@@ -357,6 +357,11 @@ CACHE_HIER_HIT = "cache.hierarchy.hit"
 CACHE_HIER_PROMOTE = "cache.hierarchy.promote"
 CACHE_HIER_RESIDUAL = "cache.hierarchy.residual"
 CACHE_POLYGON = "cache.polygon"
+#   cache.curve.region    density_curve queries whose block-chunk loop
+#                         split into polygon families (interior chunks
+#                         residual-keyed, outside chunks unscanned —
+#                         docs/CACHE.md "Polygon curve chunks")
+CACHE_CURVE_REGION = "cache.curve.region"
 # Warm-path executor metrics (kernels/registry.py, planning/executor.py,
 # planning/partitioned_exec.py; docs/PERF.md):
 #   kernel.recompiles   fresh jit traces admitted to the kernel registry
@@ -515,7 +520,29 @@ LAKE_BYTES_SKIPPED = "lake.bytes.skipped"
 LAKE_ROWGROUPS_LOADED = "lake.rowgroups.loaded"
 LAKE_ROWGROUPS_PRUNED = "lake.rowgroups.pruned"
 LAKE_PUSHDOWN_SCANS = "lake.pushdown.scans"
+#   lake.pushdown.fallback  pushdown asked for, but the snapshot could not
+#                           serve a pruned load (exotic/unbuildable
+#                           keyspace, pre-lake snapshot) and fell back to
+#                           the full resident load — docs/LAKE.md §10
+LAKE_PUSHDOWN_FALLBACK = "lake.pushdown.fallback"
 CACHE_PERSIST_RESTORED = "cache.persist.restored"
+# Replica fleet (geomesa_tpu/fleet/; docs/RESILIENCE.md §7):
+#   fleet.route.affinity   queries served by their ring-owner replica
+#   fleet.route.failover   queries re-routed to a later ring owner after
+#                          the preferred owner failed/was fenced
+#   fleet.route.scatter    decomposable counts split across owner groups
+#   fleet.route.partial    queries degraded typed [GM-FLEET-PARTIAL]
+#   fleet.epoch.bump       router-stamped mutations
+#   fleet.epoch.refresh    replica-side schema refreshes forced by an
+#                          incoming request's newer fleet epoch
+#   fleet.replica.health.<id>  1 ok / 0 cordoned|draining / -1 broken
+FLEET_ROUTE_AFFINITY = "fleet.route.affinity"
+FLEET_ROUTE_FAILOVER = "fleet.route.failover"
+FLEET_ROUTE_SCATTER = "fleet.route.scatter"
+FLEET_ROUTE_PARTIAL = "fleet.route.partial"
+FLEET_EPOCH_BUMP = "fleet.epoch.bump"
+FLEET_EPOCH_REFRESH = "fleet.epoch.refresh"
+FLEET_REPLICA_HEALTH_PREFIX = "fleet.replica.health"
 #   compact.desc.shared   compact-scan descriptors served from the
 #                         content-addressed share (a rebuild avoided:
 #                         another site/query resolved the same windows —

@@ -24,6 +24,10 @@ the Dropwizard-reporter role of the reference's geomesa-metrics module
                         serving slot occupancy + the pool supervision
                         digest, the queue-wait vs device-time breakdown,
                         and the SLO burn summary (utilization.py, slo.py)
+    GET /debug/fleet    JSON: every live fleet router's ring membership,
+                        per-replica health + breaker states, fleet
+                        epochs, and routing counters (fleet/router.py,
+                        docs/RESILIENCE.md §7)
 
 ``web.py`` mounts the same routes on the REST server, so a process
 already serving the API needs no second port; :func:`serve` runs a
@@ -246,6 +250,23 @@ def debug_queries(dataset=None, n: int = 50, user: Optional[str] = None,
     }
 
 
+def debug_fleet() -> Dict[str, Any]:
+    """The /debug/fleet payload (docs/RESILIENCE.md §7): every live
+    router's ring membership, per-replica health (state, breaker,
+    failure/failover counts), fleet epochs, routing counters, and the
+    router's serving ledger rollups. Empty ``routers`` when this process
+    runs no router. Imported lazily — the fleet module needs pyarrow."""
+    import sys
+
+    mod = sys.modules.get("geomesa_tpu.fleet.router")
+    if mod is None:
+        return {"routers": []}
+    try:
+        return mod.debug_fleet()
+    except Exception:  # pragma: no cover — defensive
+        return {"routers": []}
+
+
 def debug_devices(dataset=None) -> Dict[str, Any]:
     """The /debug/devices payload: per-device utilization, pool slot
     occupancy, the queue-wait vs device-time breakdown, the SLO burn
@@ -302,6 +323,9 @@ def handle(path: str, dataset=None, accept: Optional[str] = None):
     if route == "/debug/devices":
         return (200, "application/json",
                 json.dumps(debug_devices(dataset), default=str).encode())
+    if route == "/debug/fleet":
+        return (200, "application/json",
+                json.dumps(debug_fleet(), default=str).encode())
     return None
 
 

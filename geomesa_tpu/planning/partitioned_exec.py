@@ -631,19 +631,42 @@ class PartitionedExecutor:
         leave disk, and the surviving groups decode into the same
         prefetch pipeline bit-identically."""
         window = self._push_window(plan) if push else None
-        devs = self._scan_devices()
-        if devs is not None:
-            if bins is None:
-                bins = self.prune(plan)
-            if len(bins) >= 2:
-                self._sharded_scan(plan, op, dispatch, finish, devs, bins,
-                                   window=window)
-                return
-        for b, ex in self._each(plan, bins=bins, window=window):
-            r = self._scan_part(plan, b, op, lambda: dispatch(ex))
-            if r is not _SKIPPED and r is not None:
-                self._scan_part(plan, b, op, lambda: finish(b, r, None),
-                                probe=False, spanned=False)
+        try:
+            devs = self._scan_devices()
+            if devs is not None:
+                if bins is None:
+                    bins = self.prune(plan)
+                if len(bins) >= 2:
+                    self._sharded_scan(plan, op, dispatch, finish, devs,
+                                       bins, window=window)
+                    return
+            for b, ex in self._each(plan, bins=bins, window=window):
+                r = self._scan_part(plan, b, op, lambda: dispatch(ex))
+                if r is not _SKIPPED and r is not None:
+                    self._scan_part(plan, b, op,
+                                    lambda: finish(b, r, None),
+                                    probe=False, spanned=False)
+        finally:
+            self._note_pushdown_fallbacks(plan, window)
+
+    @staticmethod
+    def _note_pushdown_fallbacks(plan: QueryPlan,
+                                 window: Optional[Dict]) -> None:
+        """Fold the partitions pushdown could NOT serve pruned (exotic /
+        unbuildable keyspace, pre-lake snapshot — recorded on the window
+        by ``scan_child``) into explain/audit ``exec_path``, so a full
+        load never reads as "pushdown covered everything"
+        (docs/LAKE.md §10)."""
+        fallbacks = (window or {}).get("fallbacks") if window else None
+        if not fallbacks:
+            return
+        reasons: Dict[str, int] = {}
+        for _b, reason in fallbacks:
+            reasons[reason] = reasons.get(reason, 0) + 1
+        plan.__dict__.setdefault("exec_path", {})["lake_fallback"] = (
+            f"{len(fallbacks)} partition(s) full-loaded: "
+            + ", ".join(f"{r} x{n}" for r, n in sorted(reasons.items()))
+        )
 
     def _each(self, plan: QueryPlan,
               bins: Optional[List[int]] = None,
@@ -1038,8 +1061,13 @@ class PartitionedExecutor:
                 push=True,  # sketches observe only matching rows
             )
             return stat
-        for b, ex in self._each(plan, window=self._push_window(plan)):
-            self._scan_part(plan, b, "stats", lambda: ex.stats(plan, stat))
+        window = self._push_window(plan)
+        try:
+            for b, ex in self._each(plan, window=window):
+                self._scan_part(plan, b, "stats",
+                                lambda: ex.stats(plan, stat))
+        finally:
+            self._note_pushdown_fallbacks(plan, window)
         return stat
 
     def features_iter(self, plan: QueryPlan, batch_rows: Optional[int] = None):

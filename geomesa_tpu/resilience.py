@@ -108,6 +108,26 @@ class DeviceDrainError(RuntimeError):
     committed, so streams must re-open, not resume)."""
 
 
+class FleetPartialError(RuntimeError):
+    """Raised by the fleet router (docs/RESILIENCE.md §7) when every ring
+    owner of some cell range is down and strict mode forbids degrading:
+    the message leads with the typed ``[GM-FLEET-PARTIAL]`` code and the
+    error carries EXACT survivor accounting — the aggregate over the
+    cell groups that DID complete (``value`` over ``ok`` of ``total``
+    groups) plus the :class:`Skipped` records for the rest. Under
+    ``allow_partial()`` the router returns the survivor aggregate and
+    records the same skips instead of raising (the §3 degradation
+    contract, generalized from partitions to replicas)."""
+
+    def __init__(self, msg: str, value: Any = None, ok: int = 0,
+                 total: int = 0, skipped: Optional[List["Skipped"]] = None):
+        super().__init__(f"[GM-FLEET-PARTIAL] {msg}")
+        self.value = value
+        self.ok = ok
+        self.total = total
+        self.skipped = list(skipped or ())
+
+
 class CircuitOpenError(RuntimeError):
     """Raised by :meth:`CircuitBreaker.allow` while the breaker is open:
     the callee has failed repeatedly and calls are being fenced off until
@@ -707,7 +727,8 @@ def record_skip(source: str, part: str, error: BaseException,
 
 __all__ = [
     "QueryTimeoutError", "DeadlineShedError", "AdmissionRejectedError",
-    "CircuitOpenError", "DeviceDrainError", "InjectedFault",
+    "CircuitOpenError", "DeviceDrainError", "FleetPartialError",
+    "InjectedFault",
     "RetryPolicy", "Deadline", "UNLIMITED", "current_deadline",
     "deadline_scope", "check_deadline",
     "CircuitBreaker", "breaker", "reset_breakers",

@@ -636,12 +636,19 @@ class QueryScheduler:
             yield item
 
     @contextlib.contextmanager
-    def admit(self, op: str, user: Optional[str] = None):
+    def admit(self, op: str, user: Optional[str] = None,
+              inflight_cap: Optional[int] = None):
         """Local-path admission: wrap one public dataset op. Sheds (typed)
         when the caller's ambient deadline is expired or provably
         unmeetable, and accounts the op into the shared ledger. Reentrant
         (nested public ops account once) and a no-op inside a dispatched
-        ticket (the ticket already accounts)."""
+        ticket (the ticket already accounts).
+
+        ``inflight_cap`` bounds CONCURRENT inline admissions (the fleet
+        router's admission bound, ``geomesa.fleet.max.inflight`` —
+        docs/RESILIENCE.md §7): beyond it the op is rejected typed
+        :class:`AdmissionRejectedError` (``[GM-OVERLOADED]``) before any
+        work, the inline analog of the bounded dispatch queue."""
         depth = getattr(self._tls, "admit_depth", 0)
         if depth or getattr(self._tls, "in_dispatch", False):
             self._tls.admit_depth = depth + 1
@@ -667,6 +674,7 @@ class QueryScheduler:
                 "query shed at admission: deadline already expired before "
                 "any work"
             )
+        rejected = None
         with self._cv:
             led = self._led(user)
             led.submitted += 1
@@ -674,9 +682,20 @@ class QueryScheduler:
             led.weight = config.user_weight(user)
             if shed is not None:
                 led.shed += 1
+            elif inflight_cap is not None and (
+                sum(self._inline_users.values()) >= inflight_cap
+            ):
+                # checked AND rejected under the SAME lock acquisition
+                # as the increment below: two racing admissions at the
+                # cap boundary must not both squeeze past it
+                led.rejected += 1
+                rejected = sum(self._inline_users.values())
             else:
                 self._inline_users[user] = \
                     self._inline_users.get(user, 0) + 1
+        if rejected is not None:
+            metrics.inc(metrics.SERVING_SHED_QUEUE_FULL)
+            raise AdmissionRejectedError(rejected)
         if shed is not None:
             metrics.inc(metrics.SERVING_SHED_DEADLINE)
             raise DeadlineShedError(shed)

@@ -34,6 +34,49 @@ TRACE_HEADER = "x-geomesa-trace-id"
 USER_HEADER = "x-geomesa-user"
 DEADLINE_HEADER = "x-geomesa-deadline-ms"
 
+#: Fleet headers (docs/RESILIENCE.md §7). Responses from a fleet replica
+#: carry its identity and per-schema fleet-epoch map (the gossip channel);
+#: requests from a router carry the epochs the replica must be AT before
+#: serving (``x-geomesa-fleet-epochs``) and, on mutations, the epoch the
+#: write ESTABLISHES (``x-geomesa-fleet-stamp``).
+REPLICA_HEADER = "x-geomesa-replica-id"
+FLEET_EPOCHS_HEADER = "x-geomesa-fleet-epochs"
+FLEET_STAMP_HEADER = "x-geomesa-fleet-stamp"
+
+
+class _FleetHeaderMiddleware(fl.ClientMiddleware):
+    """Captures the replica's response headers (id + epoch gossip) into
+    the owning client — docs/RESILIENCE.md §7 epoch propagation."""
+
+    def __init__(self, sink: "GeoFlightClient"):
+        self._sink = sink
+
+    def received_headers(self, headers):
+        try:
+            vals = headers.get(REPLICA_HEADER) or ()
+            rid = vals[0] if vals else None
+            if isinstance(rid, bytes):
+                rid = rid.decode(errors="replace")
+            evals = headers.get(FLEET_EPOCHS_HEADER) or ()
+            epochs = None
+            if evals:
+                raw = evals[0]
+                if isinstance(raw, bytes):
+                    raw = raw.decode(errors="replace")
+                epochs = {str(k): int(v)
+                          for k, v in json.loads(raw).items()}
+        except Exception:
+            return  # malformed gossip must never fail a healthy call
+        self._sink._note_fleet_headers(rid, epochs)
+
+
+class _FleetHeaderFactory(fl.ClientMiddlewareFactory):
+    def __init__(self, sink: "GeoFlightClient"):
+        self._sink = sink
+
+    def start_call(self, info):
+        return _FleetHeaderMiddleware(self._sink)
+
 #: structured error-code prefix on Flight error messages (PROTOCOL.md §7.1):
 #: "[GM-ARG] unknown schema 'x'" — lets clients classify retryable vs fatal
 #: without string-matching free-form text.
@@ -99,13 +142,37 @@ class GeoFlightClient:
     #: a speculative (coarse-estimate) answer under server overload
     last_count_speculative: bool = False
 
-    def __init__(self, location: str, retry_seed: Optional[int] = None, **kw):
+    def __init__(self, location: str, retry_seed: Optional[int] = None,
+                 header_provider=None, **kw):
         self.location = location
+        #: extra-request-header hook (docs/RESILIENCE.md §7): a zero-arg
+        #: callable returning ``[(name-bytes, value-bytes), ...]`` merged
+        #: into every call's headers — the fleet router injects its
+        #: per-schema epoch requirements and write stamps through it
+        self.header_provider = header_provider
+        #: last replica identity / per-schema fleet-epoch map gossiped
+        #: back by the server (None until a fleet replica answers)
+        self.last_replica_id: Optional[str] = None
+        self.last_epochs: Optional[Dict[str, int]] = None
+        kw = dict(kw)
+        kw["middleware"] = list(kw.get("middleware") or ()) + [
+            _FleetHeaderFactory(self)
+        ]
         self._kw = kw
         self._client = fl.FlightClient(location, **kw)
         self._lock = threading.Lock()
         self._retry = resilience.RetryPolicy.from_config(seed=retry_seed)
         self._breaker = resilience.breaker(f"sidecar:{location}")
+
+    def _note_fleet_headers(self, rid: Optional[str],
+                            epochs: Optional[Dict[str, int]]) -> None:
+        if rid is None and epochs is None:
+            return
+        with self._lock:
+            if rid is not None:
+                self.last_replica_id = rid
+            if epochs is not None:
+                self.last_epochs = epochs
 
     def close(self):
         self._client.close()
@@ -148,6 +215,11 @@ class GeoFlightClient:
         user = config.USER.get()
         if user:
             headers.append((USER_HEADER.encode(), user.encode()))
+        if self.header_provider is not None:
+            try:
+                headers.extend(self.header_provider())
+            except Exception:
+                pass  # a torn provider must never fail a healthy call
         if headers:
             kw["headers"] = headers
         return fl.FlightCallOptions(**kw) if kw else None
@@ -293,6 +365,30 @@ class GeoFlightClient:
 
     def describe(self, name: str) -> str:
         return self._action("describe", {"name": name})["describe"]
+
+    def schema_spec(self, name: str) -> str:
+        """The schema's machine-readable spec string (the fleet router
+        rebuilds the FeatureType locally from it for cell-affinity
+        decomposition — docs/RESILIENCE.md §7)."""
+        return self._action("describe", {"name": name})["spec"]
+
+    def replica_status(self) -> Dict:
+        """Fleet-replica status: identity, draining flag, per-schema
+        fleet epochs, and the serving snapshot (docs/RESILIENCE.md §7)."""
+        return self._action("replica-status")
+
+    def drain(self, reason: Optional[str] = None) -> Dict:
+        """Put the replica into DRAINING: every subsequent non-admin
+        request answers ``[GM-DRAINING]`` (retryable — routers fail the
+        traffic over to other ring owners) until :meth:`undrain`."""
+        body: Dict = {}
+        if reason:
+            body["reason"] = str(reason)
+        return self._action("drain", body)
+
+    def undrain(self) -> Dict:
+        """Re-admit a drained replica to serving."""
+        return self._action("undrain")
 
     def explain(self, name: str, ecql: str = "INCLUDE") -> str:
         return self._action("explain", {"name": name, "ecql": ecql})["explain"]

@@ -25,6 +25,7 @@ sparse DensityScan encoding, DensityScan.scala:95-106).
 from __future__ import annotations
 
 import json
+import os
 import re
 import threading
 from typing import Dict, Iterator, Optional
@@ -52,18 +53,43 @@ _DEADLINE_HEADER = "x-geomesa-deadline-ms"
 #: server would deadline-shed a count (docs/SERVING.md); the request-body
 #: ``speculative_ok`` flag is the equivalent hint
 _SPECULATIVE_HEADER = "x-geomesa-speculative-ok"
+#: fleet epoch propagation (docs/RESILIENCE.md §7): the router's required
+#: per-schema fleet epochs (serve only after catching up), the epoch a
+#: stamped WRITE establishes, and — outbound — this replica's identity +
+#: epoch map gossiped back on every response
+_FLEET_EPOCHS_HEADER = "x-geomesa-fleet-epochs"
+_FLEET_STAMP_HEADER = "x-geomesa-fleet-stamp"
+_REPLICA_HEADER = "x-geomesa-replica-id"
 
 
 class _CallHeaders(fl.ServerMiddleware):
     """Per-call carrier of the client's serving headers (read from the
-    Flight headers by the factory; the handlers fetch it via context)."""
+    Flight headers by the factory; the handlers fetch it via context).
+    On a fleet replica it is also the response-header gossip channel:
+    :meth:`sending_headers` stamps the replica id and its per-schema
+    fleet-epoch map onto every response (docs/RESILIENCE.md §7)."""
 
     def __init__(self, trace_id: Optional[str], user: Optional[str],
-                 budget_s: Optional[float], speculative: bool = False):
+                 budget_s: Optional[float], speculative: bool = False,
+                 epochs: Optional[Dict[str, int]] = None,
+                 stamp: Optional[Dict[str, int]] = None,
+                 server: "Optional[GeoFlightServer]" = None):
         self.trace_id = trace_id
         self.user = user
         self.budget_s = budget_s
         self.speculative = speculative
+        self.epochs = epochs
+        self.stamp = stamp
+        self.server = server
+
+    def sending_headers(self):
+        srv = self.server
+        if srv is None or srv.replica_id is None:
+            return {}
+        return {
+            _REPLICA_HEADER: str(srv.replica_id),
+            _FLEET_EPOCHS_HEADER: json.dumps(srv.fleet_epochs()),
+        }
 
 
 _TRACE_ID_RE = re.compile(r"^[0-9A-Za-z_-]{1,64}$")
@@ -84,6 +110,22 @@ def _header(headers, name: str) -> Optional[str]:
 
 
 class _TraceMiddlewareFactory(fl.ServerMiddlewareFactory):
+    def __init__(self, server: "Optional[GeoFlightServer]" = None):
+        # weak-ish backref for the fleet gossip headers; None keeps the
+        # pre-fleet behavior (no outbound headers)
+        self.server = server
+
+    @staticmethod
+    def _epoch_map(headers, name: str) -> Optional[Dict[str, int]]:
+        raw = _header(headers, name)
+        if raw is None:
+            return None
+        try:
+            out = {str(k): int(v) for k, v in json.loads(raw).items()}
+        except Exception:
+            return None  # malformed gossip never fails a healthy call
+        return out or None
+
     def start_call(self, info, headers):
         # the ids flow verbatim into audit hints and slow-trace JSONL:
         # refuse anything that isn't a short token (log-injection /
@@ -105,10 +147,16 @@ class _TraceMiddlewareFactory(fl.ServerMiddlewareFactory):
         speculative = spec is not None and spec.strip().lower() in (
             "1", "true", "yes"
         )
+        epochs = self._epoch_map(headers, _FLEET_EPOCHS_HEADER)
+        stamp = self._epoch_map(headers, _FLEET_STAMP_HEADER)
+        fleet = self.server is not None \
+            and self.server.replica_id is not None
         if tid is None and user is None and budget_s is None \
-                and not speculative:
+                and not speculative and epochs is None and stamp is None \
+                and not fleet:
             return None
-        return _CallHeaders(tid, user, budget_s, speculative)
+        return _CallHeaders(tid, user, budget_s, speculative,
+                            epochs=epochs, stamp=stamp, server=self.server)
 
 
 def _call_headers(context) -> _CallHeaders:
@@ -227,6 +275,25 @@ def _spec_errors(fn):
     return wrapped
 
 
+def _coded_stream(it):
+    """Code SCHEDULER failures that surface between stream chunks: a
+    slot that dies/drains mid-stream raises from the continuation ticket
+    inside ``QueryScheduler.iterate`` — OUTSIDE both the ``_spec_errors``
+    decorator (do_get already returned) and the handler's own coded
+    generator (the failure is in the driver, not the body) — so without
+    this wrapper a drained stream crossed the wire as an UNCODED internal
+    error the client could not classify as retryable. PROTOCOL §7.1:
+    streams answer ``[GM-DRAINING]`` typed and RE-OPEN, never resume."""
+    from geomesa_tpu.resilience import DeviceDrainError, QueryTimeoutError
+
+    try:
+        yield from it
+    except DeviceDrainError as e:
+        raise fl.FlightUnavailableError(f"[GM-DRAINING] {e}") from e
+    except QueryTimeoutError as e:
+        raise fl.FlightTimedOutError(f"[GM-TIMEOUT] {e}") from e
+
+
 class GeoFlightServer(fl.FlightServerBase):
     """Flight server over a GeoDataset. Every dataset operation runs on
     the serving scheduler's dispatch-thread POOL (docs/SERVING.md;
@@ -242,9 +309,25 @@ class GeoFlightServer(fl.FlightServerBase):
     execution fanned across slots."""
 
     def __init__(self, dataset: Optional[GeoDataset] = None,
-                 location: str = "grpc+tcp://127.0.0.1:0", **kw):
+                 location: str = "grpc+tcp://127.0.0.1:0",
+                 replica_id: Optional[str] = None,
+                 fleet_root: Optional[str] = None, **kw):
+        from geomesa_tpu import config
+
+        #: fleet identity (docs/RESILIENCE.md §7): set (kwarg or
+        #: geomesa.fleet.replica.id) makes this sidecar a fleet REPLICA —
+        #: responses gossip the id + per-schema epoch map, stamped writes
+        #: persist to the shared root, and the drain action is honored
+        self.replica_id = replica_id if replica_id is not None \
+            else config.FLEET_REPLICA_ID.get()
+        self.fleet_root = fleet_root if fleet_root is not None \
+            else config.FLEET_ROOT.get()
+        self._fleet_lock = threading.Lock()
+        self._fleet_epochs: Dict[str, int] = {}
+        self._draining = False
+        self._drain_reason: Optional[str] = None
         mw = dict(kw.pop("middleware", None) or {})
-        mw.setdefault("geomesa-trace", _TraceMiddlewareFactory())
+        mw.setdefault("geomesa-trace", _TraceMiddlewareFactory(self))
         super().__init__(location, middleware=mw, **kw)
         self.dataset = dataset if dataset is not None else GeoDataset()
         self._lock = threading.Lock()
@@ -252,8 +335,114 @@ class GeoFlightServer(fl.FlightServerBase):
         # ops and Flight ops share one ledger and one fair-share domain
         self._sched = self.dataset.serving.start()
 
+    # -- fleet epoch propagation (docs/RESILIENCE.md §7) -------------------
+    def fleet_epochs(self) -> Dict[str, int]:
+        with self._fleet_lock:
+            return dict(self._fleet_epochs)
+
+    #: root-side epoch marker (docs/RESILIENCE.md §7): written atomically
+    #: by every stamped-write commit, read back after every refresh — a
+    #: replica may only claim epoch E once the root PROVABLY contains E
+    _FLEET_EPOCH_FILE = "fleet-epochs.json"
+
+    def _root_epochs(self) -> Dict[str, int]:
+        if not self.fleet_root:
+            return {}
+        path = os.path.join(self.fleet_root, self._FLEET_EPOCH_FILE)
+        try:
+            with open(path) as fh:
+                return {str(k): int(v) for k, v in json.load(fh).items()}
+        except (OSError, ValueError):
+            return {}
+
+    def _fleet_require(self, name: str, epoch: int) -> None:
+        """Bring schema ``name`` up to fleet epoch ``epoch``: when the
+        local epoch trails, re-read the schema from the shared root
+        (dropping its covers with the replaced store) BEFORE serving —
+        a restarted or failed-over replica can never answer from a
+        pre-mutation store or cache. Runs on the dispatch thread, so the
+        refresh serializes with this replica's queries.
+
+        The local epoch advances only to what the root's epoch marker
+        PROVES is present: a read stamped E that races the write
+        establishing E (still applying on another replica) refreshes to
+        the pre-E root and latches at the root's recorded epoch, so the
+        NEXT request re-refreshes — it can never latch E over stale data
+        and silently serve pre-mutation aggregates forever."""
+        if epoch <= 0:
+            return
+        with self._fleet_lock:
+            if self._fleet_epochs.get(name, 0) >= epoch:
+                return
+        from geomesa_tpu import metrics as metrics_mod
+
+        with self._lock:
+            # re-check under the dataset lock: a concurrent request may
+            # have refreshed past us while we waited
+            with self._fleet_lock:
+                if self._fleet_epochs.get(name, 0) >= epoch:
+                    return
+            if self.fleet_root:
+                self.dataset.refresh_schema(name, self.fleet_root)
+                proven = self._root_epochs().get(name, 0)
+            else:
+                # no shared root to refresh from: drop the schema's
+                # covers so nothing pre-mutation is ever served, and
+                # take the requester's word for the epoch (there is no
+                # root state to race against)
+                proven = epoch
+                try:
+                    st = self.dataset._store(name)
+                except KeyError:
+                    pass
+                else:
+                    self.dataset.cache.store.invalidate(st.uid)
+            latch = min(epoch, proven)
+            with self._fleet_lock:
+                if self._fleet_epochs.get(name, 0) < latch:
+                    self._fleet_epochs[name] = latch
+        metrics_mod.inc(metrics_mod.FLEET_EPOCH_REFRESH)
+
+    def _fleet_before(self, h: "_CallHeaders") -> None:
+        """Pre-op epoch sync: required read epochs catch all the way up;
+        a write stamp establishing epoch E catches up to E-1 first (E's
+        data is what THIS op is about to create)."""
+        for name, e in sorted((h.epochs or {}).items()):
+            self._fleet_require(name, int(e))
+        for name, e in sorted((h.stamp or {}).items()):
+            self._fleet_require(name, int(e) - 1)
+
+    def _fleet_commit(self, stamp: Dict[str, int]) -> None:
+        """Post-mutation commit for a router-stamped write: persist the
+        STAMPED schemas to the shared root (so every other replica's
+        refresh sees them — per-schema, never the whole dataset), record
+        the new epochs in the root's marker file (atomic replace; what
+        `_fleet_require` trusts), then advance the local epochs."""
+        if self.fleet_root:
+            with self._lock:
+                self.dataset.save(self.fleet_root, names=list(stamp))
+                marker = self._root_epochs()
+                for name, e in stamp.items():
+                    if marker.get(name, 0) < int(e):
+                        marker[name] = int(e)
+                path = os.path.join(self.fleet_root,
+                                    self._FLEET_EPOCH_FILE)
+                # concurrent commits on DIFFERENT replicas can race this
+                # read-modify-replace; a lost entry only UNDER-states the
+                # root's epoch, which costs redundant refreshes — never a
+                # stale serve (the safe direction of the marker contract)
+                tmp = path + f".tmp.{os.getpid()}"
+                with open(tmp, "w") as fh:
+                    json.dump(marker, fh)
+                os.replace(tmp, path)
+        with self._fleet_lock:
+            for name, e in stamp.items():
+                if self._fleet_epochs.get(name, 0) < int(e):
+                    self._fleet_epochs[name] = int(e)
+
     def _serve(self, context, name: str, fn, op: Optional[str] = None,
-               fuse=None, continuation: bool = False, speculative=None):
+               fuse=None, continuation: bool = False, speculative=None,
+               admin: bool = False):
         """Admit ``fn`` to the dispatch queue and wait. Execution runs
         under a server-side root span that ADOPTS the client's trace id
         from the Flight header (so the server audit event and any
@@ -261,9 +450,21 @@ class GeoFlightServer(fl.FlightServerBase):
         incoming header is honored even when this process's own tracing
         knob is off — the client already opted in. The client's
         ``x-geomesa-user`` header keys fair share; its
-        ``x-geomesa-deadline-ms`` budget drives admission shedding."""
+        ``x-geomesa-deadline-ms`` budget drives admission shedding.
+        ``admin`` ops (drain/undrain/status/version/observability) are
+        served even while the replica is DRAINING — everything else
+        answers typed ``[GM-DRAINING]`` so routers fail the traffic over
+        (docs/RESILIENCE.md §7)."""
+        from geomesa_tpu.resilience import DeviceDrainError
+
         h = _call_headers(context)
         tid = h.trace_id
+        if self._draining and not admin and not continuation:
+            raise DeviceDrainError(
+                f"replica {self.replica_id or '?'} is draining"
+                + (f" ({self._drain_reason})" if self._drain_reason else "")
+                + "; route to another replica"
+            )
 
         def go():
             with tracing.start(name, trace_id=tid, force=tid is not None,
@@ -275,7 +476,15 @@ class GeoFlightServer(fl.FlightServerBase):
                     slot = self._sched.current_slot()
                     if slot:  # pool mode: which executor/device served
                         root.set(executor_slot=int(slot))
-                return fn()
+                    if self.replica_id is not None:
+                        root.set(replica=str(self.replica_id))
+                # fleet epoch sync BEFORE the op, commit AFTER a stamped
+                # mutation succeeds (docs/RESILIENCE.md §7)
+                self._fleet_before(h)
+                out = fn()
+                if h.stamp:
+                    self._fleet_commit(h.stamp)
+                return out
 
         # submit (never inline): after shutdown the scheduler raises here,
         # exactly like the stopped query thread did — a straggler RPC must
@@ -494,8 +703,10 @@ class GeoFlightServer(fl.FlightServerBase):
             # hide its load under "anonymous" and beat fair share.
             owner = self._sched.current_user()
             return fl.GeneratorStream(
-                wire, self._sched.iterate(gen(), user=owner,
-                                          op="get:query:stream")
+                wire, _coded_stream(
+                    self._sched.iterate(gen(), user=owner,
+                                        op="get:query:stream")
+                )
             )
         # serial framing delegates to _wrap_fused so the serial and fused
         # wire frames are the SAME code — they can never drift apart
@@ -613,7 +824,16 @@ class GeoFlightServer(fl.FlightServerBase):
             context, "sidecar.do_action",
             lambda: self._do_action(action, body),
             op=f"action:{kind}", fuse=fuse, speculative=speculative,
+            admin=kind in self._ADMIN_ACTIONS,
         )
+
+    #: actions served even while DRAINING (docs/RESILIENCE.md §7): the
+    #: drain lifecycle itself, plus the observability surface an operator
+    #: needs to watch a drain complete
+    _ADMIN_ACTIONS = frozenset({
+        "drain", "undrain", "replica-status", "version", "metrics",
+        "serving-stats", "cache-stats", "device-health", "audit",
+    })
 
     def _speculative_count_frame(self, body: Dict,
                                  trace_id: Optional[str] = None
@@ -654,7 +874,10 @@ class GeoFlightServer(fl.FlightServerBase):
         if kind == "list-schemas":
             return ok({"schemas": ds.list_schemas()})
         if kind == "describe":
-            return ok({"describe": ds.describe(body["name"])})
+            # "spec" is additive (PROTOCOL §4): the fleet router rebuilds
+            # the FeatureType locally for cell-affinity decomposition
+            return ok({"describe": ds.describe(body["name"]),
+                       "spec": ds.get_schema(body["name"]).spec()})
         if kind == "explain":
             return ok({"explain": ds.explain(body["name"], _query_from(body))})
         if kind == "count":
@@ -741,6 +964,29 @@ class GeoFlightServer(fl.FlightServerBase):
             self._sched.supervise()
             return ok({"uncordoned": did, "was_cordoned": bool(cleared),
                        "devices": phealth.registry().snapshot()})
+        if kind == "drain":
+            # replica-side drain (docs/RESILIENCE.md §7): every new
+            # non-admin request answers [GM-DRAINING] (retryable — the
+            # router fails the traffic over to other ring owners);
+            # in-flight work completes normally
+            self._draining = True
+            self._drain_reason = str(body.get("reason") or "operator")
+            return ok({"draining": True, "reason": self._drain_reason,
+                       "replica": self.replica_id})
+        if kind == "undrain":
+            self._draining = False
+            self._drain_reason = None
+            return ok({"draining": False, "replica": self.replica_id})
+        if kind == "replica-status":
+            return ok({
+                "replica": self.replica_id,
+                "draining": self._draining,
+                "drain_reason": self._drain_reason,
+                "epochs": self.fleet_epochs(),
+                "fleet_root": self.fleet_root,
+                "serving": self._sched.snapshot(),
+                "schemas": ds.list_schemas(),
+            })
         if kind == "version":
             # the distributed-version handshake (GeoMesaDataStore.scala:
             # 498-503, 615-667: client checks the server-side iterator
@@ -772,6 +1018,11 @@ class GeoFlightServer(fl.FlightServerBase):
             ("cordon-device", "drain a device from scheduling: "
                               "{device, reason}"),
             ("uncordon-device", "re-admit a cordoned device: {device}"),
+            ("drain", "drain this replica: new non-admin requests answer "
+                      "[GM-DRAINING] until undrain: {reason}"),
+            ("undrain", "re-admit a drained replica to serving"),
+            ("replica-status", "fleet-replica identity, drain state, and "
+                               "per-schema fleet epochs"),
         ]
 
     # -- discovery ---------------------------------------------------------

@@ -206,6 +206,17 @@ class Span:
                 f"trace.{self.name}.device.{dev}", self.duration_ms / 1e3,
                 trace_id=self.trace.trace_id,
             )
+        # per-REPLICA attribution (docs/RESILIENCE.md §7): the fleet
+        # router's route spans — and a replica server's root spans — carry
+        # a ``replica`` attr, feeding replica-suffixed histograms so
+        # /metrics shows which replica of the fleet is the straggler.
+        # Cardinality is bounded by the fleet's membership.
+        rep = self.attrs.get("replica") if self.attrs else None
+        if rep is not None and isinstance(rep, str) and len(rep) <= 64:
+            metrics.observe(
+                f"trace.{self.name}.replica.{rep}", self.duration_ms / 1e3,
+                trace_id=self.trace.trace_id,
+            )
         if self.parent is None:
             _finish_trace(self.trace)
         elif self.trace.finished:

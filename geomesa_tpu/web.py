@@ -25,6 +25,7 @@ standalone obs server exposes, mounted here so one port serves both):
     GET /debug/queries  -> recent audits + degradations + slow traces
                            (?n=/?user=/?op= filters)
     GET /debug/devices  -> device utilization + slot occupancy + SLO burn
+    GET /debug/fleet    -> fleet router ring/health/epoch state (§7)
 
 Write surface (the JVM DataStore's zero-dependency transport; the
 reference's DataStore mutates through the same catalog the servlets read):

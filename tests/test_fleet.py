@@ -1,0 +1,576 @@
+"""Replica-fleet chaos suite (docs/RESILIENCE.md §7).
+
+Cross-process-shaped (each "replica" is its own GeoFlightServer +
+GeoDataset over one shared storage root — separate caches, separate
+schedulers, Flight in between) chaos scenarios for the cell-affinity
+router:
+
+* affinity routing is deterministic and bit-identical to the
+  single-process answer; scattered counts add exactly;
+* a replica killed / drained / wedged mid-workload never hangs or
+  corrupts a query: every admitted query completes bit-identical via
+  failover or returns typed ``[GM-FLEET-PARTIAL]`` with EXACT survivor
+  totals (re-running the skip records' sub-queries reconciles to the
+  full answer);
+* a mutation routed through the fleet invalidates every replica's
+  covering cache entries before any replica answers from them
+  (interleaved write/read schedule, restarted-replica case included).
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, config, metrics, obs, resilience
+from geomesa_tpu.fleet import FleetRouter, RendezvousRing
+from geomesa_tpu.resilience import (
+    AdmissionRejectedError, FleetPartialError, allow_partial, inject_faults,
+)
+
+SPEC = "name:String:index=true,speed:Float,dtg:Date,*geom:Point"
+N = 900
+
+VIEWPORTS = [
+    "BBOX(geom, -30, -20, 10, 20)",
+    "BBOX(geom, 0, 0, 40, 25)",
+    "BBOX(geom, -45, -28, -5, 5)",
+    "BBOX(geom, 5, -25, 45, 15)",
+]
+
+
+def _data(n=N, seed=5):
+    rng = np.random.default_rng(seed)
+    xs = rng.uniform(-45, 45, n)
+    ys = rng.uniform(-28, 28, n)
+    # pin some rows to exact routing-cell edges: the scatter's disjoint
+    # half-open cells must place each edge row in exactly one sub-query
+    for i, v in enumerate((-45.0, 0.0, 22.5, 45.0)):
+        xs[i], ys[i] = v, 0.0
+    return {
+        "name": [f"n{i % 4}" for i in range(n)],
+        "speed": rng.uniform(0, 30, n).astype(np.float32),
+        "dtg": (np.datetime64("2024-05-01", "ms")
+                + rng.integers(0, 20 * 86_400_000, n)),
+        "geom": [(float(x), float(y)) for x, y in zip(xs, ys)],
+    }
+
+
+@pytest.fixture(autouse=True)
+def _fresh_breakers():
+    """The ``replica:<id>`` breakers live in the process-wide named
+    registry: reset them between tests so one scenario's opened circuit
+    never fences the next scenario's fresh replicas."""
+    resilience.reset_breakers()
+    yield
+    resilience.reset_breakers()
+
+
+@pytest.fixture(scope="module")
+def root(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("fleet_root"))
+    seed = GeoDataset(n_shards=1, prefer_device=False)
+    seed.create_schema("t", SPEC)
+    seed.insert("t", _data(), fids=[f"f{i}" for i in range(N)])
+    seed.flush("t")
+    seed.save(path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def oracle(root):
+    return GeoDataset.load(root, prefer_device=False)
+
+
+def _replica(root, rid):
+    from geomesa_tpu.sidecar import GeoFlightServer
+
+    return GeoFlightServer(
+        GeoDataset.load(root, prefer_device=False),
+        replica_id=rid, fleet_root=root,
+    )
+
+
+def _router(servers):
+    return FleetRouter({
+        rid: f"grpc+tcp://127.0.0.1:{srv.port}"
+        for rid, srv in servers.items()
+    })
+
+
+@pytest.fixture()
+def fleet(root):
+    servers = {rid: _replica(root, rid) for rid in ("r1", "r2", "r3")}
+    router = _router(servers)
+    yield servers, router
+    router.close()
+    for srv in servers.values():
+        try:
+            srv.shutdown()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# ring
+# ---------------------------------------------------------------------------
+
+
+def test_ring_minimal_rebalance():
+    """Removing a member re-homes ONLY that member's keys (the HRW
+    property the warm-cache story rests on); adding it back restores the
+    original assignment exactly."""
+    ring3 = RendezvousRing(["a", "b", "c"])
+    keys = [f"t:z3:{i}" for i in range(200)]
+    before = {k: ring3.owner(k) for k in keys}
+    ring2 = RendezvousRing(["a", "b"])
+    moved = [k for k in keys if before[k] != ring2.owner(k)]
+    assert all(before[k] == "c" for k in moved)  # only c's keys moved
+    # and c's keys moved to their SECOND choice on the old ring
+    for k in moved:
+        assert ring2.owner(k) == ring3.owners(k)[1]
+    ring3b = RendezvousRing(["b", "c", "a"])
+    assert {k: ring3b.owner(k) for k in keys} == before  # order-free
+
+
+def test_ring_owner_order_is_failover_path():
+    ring = RendezvousRing(["a", "b", "c"])
+    for k in ("x", "y", "z"):
+        owners = ring.owners(k)
+        assert sorted(owners) == ["a", "b", "c"]
+        assert owners[0] == ring.owner(k)
+
+
+# ---------------------------------------------------------------------------
+# routing + scatter
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_routing_bit_identical(fleet, oracle):
+    """Every viewport routes to its stable ring owner (affinity, no
+    failover on a healthy fleet) and the routed answers equal the
+    single-process oracle exactly — scattered counts included."""
+    servers, router = fleet
+    for ecql in VIEWPORTS:
+        assert router.count("t", ecql) == oracle.count("t", ecql)
+    snap = router.snapshot()
+    assert snap["counters"]["failover"] == 0
+    assert snap["counters"]["partial"] == 0
+    assert snap["counters"]["affinity"] > 0
+    # repeats keep routing to the same owners: affinity grows, still no
+    # failover — the warm-cache precondition
+    for ecql in VIEWPORTS:
+        assert router.count("t", ecql) == oracle.count("t", ecql)
+    assert router.snapshot()["counters"]["failover"] == 0
+
+
+def test_scatter_engages_and_adds_exactly(fleet, oracle):
+    servers, router = fleet
+    ecql = "BBOX(geom, -44, -27, 44, 27)"
+    n0 = router.snapshot()["counters"]["scatter"]
+    assert router.count("t", ecql) == oracle.count("t", ecql)
+    assert router.snapshot()["counters"]["scatter"] > n0
+    # scatter off routes whole — same answer
+    with config.FLEET_SCATTER.scoped("false"):
+        assert router.count("t", ecql) == oracle.count("t", ecql)
+
+
+def test_density_and_stats_route_bit_identical(fleet, oracle):
+    servers, router = fleet
+    ecql = VIEWPORTS[0]
+    grid = router.density("t", ecql, bbox=(-45, -28, 45, 28),
+                          width=64, height=32)
+    want = oracle.density("t", ecql, bbox=(-45, -28, 45, 28),
+                          width=64, height=32)
+    assert np.array_equal(grid, want)
+    s = router.stats("t", "MinMax(speed)", ecql)
+    assert s.to_json() == oracle.stats("t", "MinMax(speed)", ecql).to_json()
+    g1, sn1 = router.density_curve("t", ecql, level=6,
+                                   bbox=(-45, -28, 45, 28))
+    g0, sn0 = oracle.density_curve("t", ecql, level=6,
+                                   bbox=(-45, -28, 45, 28))
+    assert sn1 == sn0 and np.array_equal(g1, g0)
+
+
+# ---------------------------------------------------------------------------
+# failover / kill / drain / wedge
+# ---------------------------------------------------------------------------
+
+
+def test_kill_one_replica_mid_workload_failover(fleet, oracle):
+    """SIGKILL-shaped loss of one replica (server shutdown, no goodbye):
+    every query still answers bit-identically via the next ring owner,
+    within the query's own budget — zero hangs, zero partials."""
+    servers, router = fleet
+    expected = {e: oracle.count("t", e) for e in VIEWPORTS}
+    for e in VIEWPORTS:  # warm routing
+        assert router.count("t", e) == expected[e]
+    servers.pop("r1").shutdown()
+    results = {}
+    errors = []
+
+    def run(e):
+        try:
+            with resilience.deadline_scope(30.0):
+                results[e] = router.count("t", e)
+        except Exception as exc:  # pragma: no cover - the assert reports
+            errors.append((e, exc))
+
+    threads = [threading.Thread(target=run, args=(e,)) for e in VIEWPORTS]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+        assert not t.is_alive(), "fleet query hung after replica kill"
+    assert not errors, errors
+    assert results == expected
+    snap = router.snapshot()
+    assert snap["counters"]["partial"] == 0
+    # the dead replica's breaker opened: later routing skips it outright
+    assert snap["counters"]["failover"] >= 1
+
+
+def test_drain_via_admin_then_undrain(fleet, oracle):
+    servers, router = fleet
+    ecql = VIEWPORTS[1]
+    out = router.drain_replica("r2", reason="maintenance")
+    assert out["draining"] is True and out["replica"] == "r2"
+    assert router.registry.state("r2") == "draining"
+    # a direct client to the drained replica is refused typed+retryable
+    from geomesa_tpu.resilience import DeviceDrainError
+    from geomesa_tpu.sidecar import GeoFlightClient
+    from geomesa_tpu.sidecar.client import is_retryable
+
+    with GeoFlightClient(
+        f"grpc+tcp://127.0.0.1:{servers['r2'].port}"
+    ) as c, config.RETRY_ATTEMPTS.scoped("1"):
+        with pytest.raises(DeviceDrainError) as ei:
+            c.count("t", ecql)
+        assert is_retryable(ei.value)
+    # routed traffic keeps working (owners exclude the drained replica)
+    assert router.count("t", ecql) == oracle.count("t", ecql)
+    status = router.probe("r2")
+    assert status["draining"] is True
+    router.undrain_replica("r2")
+    assert router.registry.state("r2") == "ok"
+    assert router.count("t", ecql) == oracle.count("t", ecql)
+
+
+def test_wedged_replica_bounded_by_deadline_failover(fleet, oracle):
+    """A wedged replica (accepts the call, stalls, then errors) costs
+    its delay, not the query: under a live deadline the router fails
+    over and completes inside the budget."""
+    servers, router = fleet
+    ecql = VIEWPORTS[2]
+    want = oracle.count("t", ecql)
+    assert router.count("t", ecql) == want  # warm the route + schema
+    import time as _time
+
+    with config.FAULT_INJECTION.scoped("true"), \
+            config.RETRY_ATTEMPTS.scoped("1"), \
+            config.FLEET_SCATTER.scoped("false"), \
+            inject_faults(seed=3) as inj:
+        inj.fail("sidecar.do_action", times=1, delay_s=0.2)
+        t0 = _time.perf_counter()
+        with resilience.deadline_scope(20.0):
+            assert router.count("t", ecql) == want
+        assert _time.perf_counter() - t0 < 20.0
+    assert router.snapshot()["counters"]["failover"] >= 1
+
+
+def test_all_owners_down_degrades_typed(root, oracle):
+    servers = {rid: _replica(root, rid) for rid in ("ra", "rb")}
+    router = _router(servers)
+    try:
+        ecql = VIEWPORTS[0]
+        assert router.count("t", ecql) == oracle.count("t", ecql)
+        for srv in servers.values():
+            srv.shutdown()
+        with config.RETRY_ATTEMPTS.scoped("1"), \
+                config.FLEET_SCATTER.scoped("false"):
+            # strict mode: typed [GM-FLEET-PARTIAL], never a hang
+            with resilience.deadline_scope(30.0), \
+                    pytest.raises(FleetPartialError, match="GM-FLEET"):
+                router.count("t", ecql)
+            # degraded mode: the survivor total (zero survivors -> 0)
+            # with the skip recorded — the §3 contract over replicas
+            with resilience.deadline_scope(30.0), allow_partial() as p:
+                assert router.count("t", ecql) == 0
+            assert len(p.skipped) == 1
+            assert p.skipped[0].source == "fleet.route"
+    finally:
+        router.close()
+
+
+def test_scatter_partial_has_exact_survivor_totals(root, oracle):
+    """One owner group failing on EVERY candidate degrades the scattered
+    count with EXACT survivor accounting: the returned total plus the
+    oracle's answers to the skip records' sub-queries (carried verbatim
+    in ``Skipped.phase``) reconstructs the full count exactly."""
+    servers = {rid: _replica(root, rid) for rid in ("ra", "rb")}
+    router = _router(servers)
+    try:
+        ecql = "BBOX(geom, -44, -27, 44, 27)"
+        want = oracle.count("t", ecql)
+        assert router.count("t", ecql) == want  # warm schema + routes
+        with config.FAULT_INJECTION.scoped("true"), \
+                config.RETRY_ATTEMPTS.scoped("1"), \
+                inject_faults(seed=11) as inj:
+            # fail the FIRST scattered group on its owner AND the
+            # failover candidate (2 candidates in a 2-replica fleet)
+            inj.fail("sidecar.do_action", times=2)
+            with allow_partial() as p:
+                got = router.count("t", ecql)
+        assert p.skipped, "no group was skipped"
+        missing = sum(
+            oracle.count("t", rec.phase) for rec in p.skipped
+        )
+        assert got + missing == want
+        assert got < want  # something really was skipped
+        # strict mode raises typed instead, with the same accounting
+        with config.FAULT_INJECTION.scoped("true"), \
+                config.RETRY_ATTEMPTS.scoped("1"), \
+                inject_faults(seed=12) as inj:
+            inj.fail("sidecar.do_action", times=2)
+            with pytest.raises(FleetPartialError) as ei:
+                router.count("t", ecql)
+        err = ei.value
+        assert "[GM-FLEET-PARTIAL]" in str(err)
+        missing = sum(oracle.count("t", rec.phase) for rec in err.skipped)
+        assert err.value + missing == want
+        assert err.ok == err.total - len(err.skipped)
+    finally:
+        router.close()
+        for srv in servers.values():
+            srv.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# epoch propagation
+# ---------------------------------------------------------------------------
+
+
+def _one_row(x=0.5, y=0.5):
+    tmp = GeoDataset(n_shards=1, prefer_device=False)
+    tmp.create_schema("t", SPEC)
+    tmp.insert("t", {
+        "name": ["fresh"],
+        "speed": np.array([1.0], np.float32),
+        "dtg": np.array([np.datetime64("2024-05-21", "ms")]),
+        "geom": [(x, y)],
+    }, fids=["fresh1"])
+    return tmp.to_arrow("t")
+
+
+def test_epoch_interleaved_write_read_no_stale_cache(root, oracle,
+                                                     monkeypatch):
+    """The acceptance regression (ISSUE): an interleaved write/read
+    schedule across replicas with WARM aggregate caches — a write routed
+    through the fleet invalidates every replica's covering entries
+    before any replica answers from them. The cache knob is set via env
+    (thread-local scopes never reach the replicas' dispatch threads)."""
+    monkeypatch.setenv("GEOMESA_CACHE_ENABLED", "true")
+    servers = {rid: _replica(root, rid) for rid in ("ra", "rb")}
+    router = _router(servers)
+    try:
+        ecql = "BBOX(geom, -10, -10, 10, 10)"
+        with config.FLEET_SCATTER.scoped("false"):
+            before = oracle.count("t", ecql)
+            # warm BOTH replicas' covering caches for this viewport
+            for rid in ("ra", "rb"):
+                order = [rid] + [r for r in ("ra", "rb") if r != rid]
+                n, _ = router._call("t", "k", "count",
+                                    lambda c: c.count("t", ecql),
+                                    owners=order)
+                assert n == before
+            # write through the router (stamped epoch)
+            router.insert_arrow("t", _one_row(0.5, 0.5))
+            # read from EACH replica explicitly: both must reflect the
+            # write — neither may serve its warm pre-mutation cover
+            for rid in ("ra", "rb"):
+                order = [rid] + [r for r in ("ra", "rb") if r != rid]
+                n, _ = router._call("t", "k", "count",
+                                    lambda c: c.count("t", ecql),
+                                    owners=order)
+                assert n == before + 1, f"stale cache served by {rid}"
+    finally:
+        router.close()
+        for srv in servers.values():
+            srv.shutdown()
+
+
+def test_epoch_restarted_replica_refreshes_before_serving(root, oracle):
+    """A replica that MISSED a fleet write (down while it landed, then
+    rejoined with pre-mutation in-memory state and fleet epoch 0) must
+    refresh from the shared root — forced by the router's epoch header —
+    before it may answer anything for that schema."""
+    servers = {rid: _replica(root, rid) for rid in ("ra", "rb")}
+    router = _router(servers)
+    try:
+        ecql = "BBOX(geom, -10, -10, 10, 10)"
+        with config.FLEET_SCATTER.scoped("false"):
+            before = router.count("t", ecql)
+            # rb goes down; the write lands (on ra, persisted to root)
+            servers["rb"].shutdown()
+            router.insert_arrow("t", _one_row(0.2, 0.2))
+            assert router.count("t", ecql) == before + 1
+            # rb restarts FROM THE ROOT AS OF ITS LAST BOOT? No — a
+            # restarted process loads current root, so simulate a STALE
+            # replica instead: a server whose dataset predates the write
+            stale = GeoDataset(n_shards=1, prefer_device=False)
+            stale.create_schema("t", SPEC)
+            stale.insert("t", _data(), fids=[f"f{i}" for i in range(N)])
+            stale.flush("t")
+            from geomesa_tpu.sidecar import GeoFlightServer
+
+            servers["rb"] = GeoFlightServer(
+                stale, replica_id="rb", fleet_root=root,
+            )
+            router.add_replica(
+                "rb", f"grpc+tcp://127.0.0.1:{servers['rb'].port}"
+            )
+            # force the read onto the stale replica: the router's epoch
+            # header makes it refresh from the shared root FIRST
+            n, _ = router._call("t", "k", "count",
+                                lambda c: c.count("t", ecql),
+                                owners=["rb", "ra"])
+            assert n == before + 1, "stale replica served pre-write data"
+    finally:
+        router.close()
+        for srv in servers.values():
+            try:
+                srv.shutdown()
+            except Exception:
+                pass
+
+
+def test_epoch_latch_requires_root_proof(tmp_path):
+    """The write/read race regression: a read stamped epoch E that
+    arrives BEFORE the write establishing E landed in the shared root
+    must NOT latch E over the stale refresh — the replica latches only
+    what the root's epoch marker proves, so the next request refreshes
+    again and picks the write up the moment it commits."""
+    import json as _json
+
+    from geomesa_tpu.sidecar import GeoFlightClient, GeoFlightServer
+
+    root = str(tmp_path / "race_root")
+    seed = GeoDataset(n_shards=1, prefer_device=False)
+    seed.create_schema("t", SPEC)
+    seed.insert("t", _data(200), fids=[f"f{i}" for i in range(200)])
+    seed.flush("t")
+    seed.save(root)
+    ecql = "BBOX(geom, -10, -10, 10, 10)"
+    before = seed.count("t", ecql)
+    srv = _replica(root, "ra")
+    hdr = [(b"x-geomesa-fleet-epochs", _json.dumps({"t": 3}).encode())]
+    try:
+        with GeoFlightClient(
+            f"grpc+tcp://127.0.0.1:{srv.port}",
+            header_provider=lambda: hdr,
+        ) as c:
+            # the claimed epoch 3 has NOT committed: the replica
+            # refreshes (pre-E root — same data) and must latch below 3
+            assert c.count("t", ecql) == before
+            assert c.replica_status()["epochs"].get("t", 0) < 3
+            # the write "lands": fresh data + the root marker at 3
+            seed.insert("t", {
+                "name": ["late"],
+                "speed": np.array([1.0], np.float32),
+                "dtg": np.array([np.datetime64("2024-05-21", "ms")]),
+                "geom": [(0.0, 0.0)],
+            }, fids=["late1"])
+            seed.flush("t")
+            seed.save(root, names=["t"])
+            marker = str(
+                tmp_path / "race_root" / GeoFlightServer._FLEET_EPOCH_FILE
+            )
+            with open(marker, "w") as fh:
+                _json.dump({"t": 3}, fh)
+            # the un-latched replica re-refreshes and serves the write
+            assert c.count("t", ecql) == before + 1
+            assert c.replica_status()["epochs"]["t"] == 3
+    finally:
+        srv.shutdown()
+
+
+def test_create_schema_propagates(fleet):
+    servers, router = fleet
+    router.create_schema("t2", SPEC)
+    router.insert_arrow("t2", _one_row(1.0, 1.0))
+    # every replica serves the new schema (refresh-on-epoch)
+    for rid in servers:
+        order = [rid] + [r for r in servers if r != rid]
+        n, _ = router._call("t2", "k", "count",
+                            lambda c: c.count("t2", "INCLUDE"),
+                            owners=order)
+        assert n == 1
+    router.delete_schema("t2")
+    assert "t2" not in router.list_schemas()
+
+
+# ---------------------------------------------------------------------------
+# admission + observability
+# ---------------------------------------------------------------------------
+
+
+def test_router_admission_bound_rejects_typed(fleet):
+    servers, router = fleet
+    with config.FLEET_MAX_INFLIGHT.scoped("0"):
+        with pytest.raises(AdmissionRejectedError):
+            router.count("t", VIEWPORTS[0])
+    # the rejection landed in the shared ledger (same _UserLedger policy)
+    rollups = router.serving.user_rollups()
+    assert any(r["rejected"] >= 1 for r in rollups.values())
+
+
+def test_debug_fleet_endpoint(fleet):
+    servers, router = fleet
+    router.count("t", VIEWPORTS[0])
+    out = obs.handle("/debug/fleet")
+    assert out is not None
+    code, ctype, body = out
+    assert code == 200
+    payload = json.loads(body)
+    snap = next(s for s in payload["routers"]
+                if set(s["replicas"]) == {"r1", "r2", "r3"})
+    assert snap["counters"]["affinity"] >= 1
+    assert "users" in snap and "summary" in snap
+
+
+def test_replica_gossip_headers_round_trip(fleet):
+    """Responses carry the replica id + epoch map; the client captures
+    them (the router's membership-discovery channel)."""
+    servers, router = fleet
+    router.insert_arrow("t", _one_row(3.0, 3.0))
+    router.count("t", VIEWPORTS[0])
+    seen = set()
+    for rid in servers:
+        c = router._client(rid)
+        if c.last_replica_id is not None:
+            seen.add(c.last_replica_id)
+            assert c.last_epochs is not None
+            assert c.last_epochs.get("t", 0) >= 1
+    assert seen, "no replica gossiped its identity back"
+
+
+def test_replica_breaker_fences_dead_replica(root):
+    servers = {rid: _replica(root, rid) for rid in ("ra", "rb")}
+    router = _router(servers)
+    try:
+        servers["ra"].shutdown()
+        with config.FLEET_BREAKER_THRESHOLD.scoped("2"), \
+                config.RETRY_ATTEMPTS.scoped("1"), \
+                config.FLEET_SCATTER.scoped("false"):
+            for e in VIEWPORTS:
+                router.count("t", e)  # failures feed ra's breaker
+            assert router.registry.state("ra") == "broken"
+            assert not router.registry.usable("ra")
+            # fenced: routing now skips ra entirely (pure affinity on rb)
+            f0 = router.snapshot()["counters"]["failover"]
+            router.count("t", VIEWPORTS[0])
+            assert router.snapshot()["counters"]["failover"] == f0
+    finally:
+        router.close()
+        servers["rb"].shutdown()

@@ -206,6 +206,59 @@ def test_density_curve_weighted_stays_whole_result(ds):
     assert np.array_equal(cold, g1) and np.array_equal(cold, g2)
 
 
+def test_polygon_curve_chunks_share_and_skip_outside(ds):
+    """Polygon density_curve chunk families (docs/CACHE.md "Polygon
+    curve chunks"): interior chunks are served from the RESIDUAL-keyed
+    family a plain (non-region) pyramid already warmed, outside chunks
+    contribute zeros without scanning, and the assembled grid stays
+    bit-identical to the undecomposed polygon scan."""
+    bbox = (-180.0, -90.0, 180.0, 90.0)
+    level = 6
+    cold, snap0 = ds.density_curve("pts", level=level, bbox=bbox,
+                                   region=POLY)
+    with _enabled():
+        # plain pyramid warms the residual-keyed chunk family
+        plain, _ = ds.density_curve("pts", level=level, bbox=bbox)
+        r0 = _counter(metrics.CACHE_CURVE_REGION)
+        g, snap = ds.density_curve("pts", level=level, bbox=bbox,
+                                   region=POLY)
+        assert snap == snap0
+        assert np.array_equal(g, cold), \
+            "polygon curve chunk families broke bit-identity"
+        assert _counter(metrics.CACHE_CURVE_REGION) == r0 + 1
+        ev = ds.audit.recent(1)[0]
+        path = ev.hints["exec_path"]
+        note = path["cache_region_chunks"]
+        assert "outside" in note and "interior" in note
+        # interior chunks HIT the plain family the warm-up populated —
+        # the over-scan the families exist to stop
+        hits, total = map(int, path["cache_cells"].split("/"))
+        assert hits > 0, (note, path)
+        # and the polygon result is a strict subset of the plain pyramid
+        assert g.sum() <= plain.sum()
+        # fully warm repeat: whole-result hit, still bit-identical
+        g2, _ = ds.density_curve("pts", level=level, bbox=bbox,
+                                 region=POLY)
+        assert np.array_equal(g2, cold)
+
+
+def test_polygon_curve_warms_plain_family_for_later_queries(ds):
+    """The sharing runs BOTH ways: a region pyramid's interior scans
+    populate the residual-keyed family, so a later plain pyramid over
+    the same residual reuses them."""
+    bbox = (-180.0, -90.0, 180.0, 90.0)
+    level = 6
+    plain_cold, _ = ds.density_curve("pts", level=level, bbox=bbox)
+    with _enabled():
+        ds.density_curve("pts", level=level, bbox=bbox, region=POLY)
+        g, _ = ds.density_curve("pts", level=level, bbox=bbox)
+        ev = ds.audit.recent(1)[0]
+        hits, total = map(
+            int, ev.hints["exec_path"]["cache_cells"].split("/"))
+        assert hits > 0, "plain pyramid reused nothing from the region run"
+        assert np.array_equal(g, plain_cold)
+
+
 # -- polygon regions --------------------------------------------------------
 
 def test_polygon_count_density_stats_bit_identical(ds):

@@ -253,6 +253,36 @@ def test_lake_fully_pruned_nonprimary_never_quarantines(tmp_path):
     assert ds.count("t", "INCLUDE") == 6_000
 
 
+def test_pushdown_fallback_counted_and_noted(tmp_path):
+    """docs/LAKE.md §10: a pushdown request the snapshot cannot serve
+    pruned (exotic keyspace / pre-lake npz snapshot) counts in
+    ``lake.pushdown.fallback`` and says so in the explain/audit
+    exec_path — the full load must never read as "pushdown covered
+    everything"."""
+    # exotic keyspace: a window naming an index the snapshot can't build
+    ds, st = _mkpart(tmp_path, n=4_000, seed=13)
+    b = next(iter(st.spilled))
+    f0 = _counter("lake.pushdown.fallback")
+    w = {"index": "bogus-keyspace",
+         "boxes": [(-116.0, 27.0, -112.0, 31.0)], "times": None}
+    child = st.scan_child(b, w)
+    assert child is not None  # full load still serves the scan
+    assert _counter("lake.pushdown.fallback") == f0 + 1
+    assert w["fallbacks"] == [(int(b), "unknown-keyspace")]
+
+    # pre-lake npz snapshots: every pushdown-eligible count falls back,
+    # counted once per spilled bin and noted on the audit event
+    ds2, st2 = _mkpart(tmp_path, n=4_000, seed=13, lake=False)
+    f1 = _counter("lake.pushdown.fallback")
+    n = ds2.count("t", "BBOX(geom, -116, 27, -112, 31)")
+    assert n == ds2.count("t", "BBOX(geom, -116, 27, -112, 31)")
+    assert _counter("lake.pushdown.fallback") > f1
+    ev = ds2.audit.recent(2)[0]  # the FIRST (cold) count's event
+    note = ev.hints["exec_path"].get("lake_fallback", "")
+    assert "legacy-snapshot" in note, ev.hints["exec_path"]
+    assert "full-loaded" in note
+
+
 # ---------------------------------------------------------------------------
 # round-trip edge cases: null fills, empty partitions
 # ---------------------------------------------------------------------------

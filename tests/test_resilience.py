@@ -718,6 +718,86 @@ def test_query_deadline_multishard_single_store(monkeypatch):
 
 
 # ---------------------------------------------------------------------------
+# drained mid-stream continuation -> re-open on a healthy replica
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.filterwarnings(
+    "ignore::pytest.PytestUnhandledThreadExceptionWarning")
+def test_drained_stream_reopens_on_healthy_replica():
+    """The fleet-failover building block (docs/RESILIENCE.md §6/§7): a
+    sidecar stream whose serving slot dies mid-stream fails typed
+    ``[GM-DRAINING]`` — RETRYABLE, never resumed — and a fresh open of
+    the same query on a HEALTHY replica returns the complete, identical
+    result set. The client already re-raises the drain as retryable;
+    this proves the re-open actually works."""
+    from geomesa_tpu.resilience import DeviceDrainError
+    from geomesa_tpu.sidecar import GeoFlightClient, GeoFlightServer
+    from geomesa_tpu.sidecar.client import is_retryable
+
+    def mkds():
+        ds = GeoDataset(n_shards=2, prefer_device=False)
+        ds.create_schema("t", SPEC + ";geomesa.partition='time'")
+        ds.insert("t", _data(3000), fids=np.arange(3000).astype(str))
+        ds.flush("t")
+        return ds
+
+    oracle = mkds()
+    want = sorted(
+        str(v) for v in oracle.query("t", "name = 'actor1'")
+        .to_dict()["name"]
+    )
+    n_want = oracle.count("t", "name = 'actor1'")
+    assert n_want > 0
+
+    srv_a = GeoFlightServer(mkds())
+    hits = {"n": 0}
+
+    def after_chunks(ctx):
+        # let the stream OPEN and serve at least one chunk before the
+        # dispatcher dies (hit 1 = the opening do_get ticket)
+        hits["n"] += 1
+        return hits["n"] > 2
+
+    try:
+        with config.FAULT_INJECTION.scoped("true"), \
+                config.RETRY_ATTEMPTS.scoped("1"), \
+                inject_faults(seed=21) as inj:
+            inj.fail("serving.slot.loop", SystemExit("chaos kill"),
+                     times=1, where=after_chunks)
+            with GeoFlightClient(
+                f"grpc+tcp://127.0.0.1:{srv_a.port}"
+            ) as ca:
+                with pytest.raises(Exception) as ei:
+                    ca.query("t", "name = 'actor1'")
+        # typed + retryable: the caller's cue to RE-OPEN, never resume
+        err = ei.value
+        assert isinstance(err, DeviceDrainError) \
+            or "GM-DRAINING" in str(err), repr(err)
+        assert is_retryable(err), repr(err)
+        # re-open on a healthy replica: complete and identical
+        srv_b = GeoFlightServer(mkds())
+        try:
+            with GeoFlightClient(
+                f"grpc+tcp://127.0.0.1:{srv_b.port}"
+            ) as cb:
+                got = cb.query("t", "name = 'actor1'")
+            assert got.num_rows == n_want
+            assert sorted(got["name"].to_pylist()) == want
+        finally:
+            srv_b.shutdown()
+        # and the DRAINED server heals too (supervisor respawned the
+        # slot): a re-open there also completes — failover never had to
+        # write the replica off permanently
+        with GeoFlightClient(
+            f"grpc+tcp://127.0.0.1:{srv_a.port}"
+        ) as ca2:
+            assert ca2.query("t", "name = 'actor1'").num_rows == n_want
+    finally:
+        srv_a.shutdown()
+
+
+# ---------------------------------------------------------------------------
 # disabled-path guarantees
 # ---------------------------------------------------------------------------
 
