@@ -497,6 +497,62 @@ def run_fleet():
         qps2 = _qps(router, "q2")
         scaleup = qps2 / max(qps1, 1e-9)
 
+        # -- phase S: scatter-vs-whole on cold fleet-wide aggregates ----
+        # (ISSUE 15): density / stats / curve / count scattered across
+        # both owners vs routed whole to one. Fresh name-residuals dodge
+        # every cache (same rows scanned either way); a warmup pass per
+        # mode pays kernel compiles outside the timed window; two timed
+        # rounds with mode order swapped, min per mode.
+        wide = "BBOX(geom, -119.5, 25.5, -70.5, 49.5)"
+        wide_bbox = (-120.0, 25.0, -70.0, 50.0)
+
+        def _cold(tag):
+            return f"(name <> 'zz{tag}') AND {wide}"
+
+        e_bi = _cold("bi")
+        g_sc = router.density("t", e_bi, bbox=wide_bbox, width=96,
+                              height=64)
+        g_ds = ds.density("t", e_bi, bbox=wide_bbox, width=96, height=64)
+        scatter_bit = bool(np.array_equal(g_sc, g_ds))
+        scatter_bit &= (
+            router.stats("t", "MinMax(dtg)", e_bi).to_json()
+            == ds.stats("t", "MinMax(dtg)", e_bi).to_json()
+        )
+        gc, snc = router.density_curve("t", e_bi, level=6, bbox=wide_bbox)
+        gd, snd = ds.density_curve("t", e_bi, level=6, bbox=wide_bbox)
+        scatter_bit &= bool(tuple(snc) == tuple(snd)
+                            and np.array_equal(gc, gd))
+        scatter_bit &= router.count("t", e_bi) == ds.count("t", e_bi)
+        assert scatter_bit, "scattered aggregate diverged from oracle"
+        snap_s = router.snapshot()
+        assert snap_s["counters"]["scatter"] >= 4, snap_s["counters"]
+
+        def _run_kind(kind, e):
+            if kind == "density":
+                router.density("t", e, bbox=wide_bbox, width=96,
+                               height=64)
+            else:
+                router.stats("t", "MinMax(dtg)", e)
+
+        def _timed(kind, scatter_on, tag):
+            knob = "true" if scatter_on else "false"
+            with config.FLEET_SCATTER.scoped(knob):
+                _run_kind(kind, _cold(f"w{tag}"))  # warmup: compiles
+                t1 = time.perf_counter()
+                _run_kind(kind, _cold(tag))
+                return time.perf_counter() - t1
+
+        speedup = {}
+        for kind in ("density", "stats"):
+            times = {True: [], False: []}
+            for rnd in range(2):
+                order = [True, False] if rnd % 2 == 0 else [False, True]
+                for mode in order:
+                    times[mode].append(
+                        _timed(kind, mode, f"{kind[0]}{rnd}{int(mode)}")
+                    )
+            speedup[kind] = min(times[False]) / max(min(times[True]), 1e-9)
+
         # SIGKILL one replica mid-run: the chaos half of the gate
         victim = router.ring.owner(f"schema:t")
         procs[int(victim[1]) - 1].kill()
@@ -556,6 +612,12 @@ def run_fleet():
         "fleet_qps_1replica": round(qps1, 1),
         "fleet_qps_2replicas": round(qps2, 1),
         "fleet_qps_scaleup": round(scaleup, 2),
+        # scatter-gather (ISSUE 15): cold fleet-wide mergeable aggregates
+        # split across owner groups vs routed whole to one replica —
+        # bit-identity hard-asserted above across all four kinds
+        "fleet_scatter_bit_identical": scatter_bit,
+        "fleet_scatter_density_speedup": round(speedup["density"], 2),
+        "fleet_scatter_stats_speedup": round(speedup["stats"], 2),
         "fleet_counters": snap["counters"],
         # CPU numbers: the device-baseline gap annotation carried
         # forward from the main bench (BENCH_r04+ precedent)

@@ -66,6 +66,56 @@ EXACT_MERGE_KINDS = {
 }
 
 
+def stats_exact_merge(stat) -> bool:
+    """True when every leaf sketch of ``stat`` merges exactly — THE
+    eligibility test shared by cache decomposition here and the fleet
+    router's stats scatter (docs/RESILIENCE.md §7): an aggregate may be
+    split over disjoint row sets iff its partial merge is exact."""
+    from geomesa_tpu.kernels.stats_scan import _leaf_stats
+
+    return all(leaf.kind in EXACT_MERGE_KINDS for leaf in _leaf_stats(stat))
+
+
+def merge_bundle(kind: str, *, shape=None, stat_spec: Optional[str] = None):
+    """The partial-merge table (docs/CACHE.md "Exactness"): ``(zero,
+    merge)`` for every aggregate kind whose partial composition over
+    DISJOINT row sets is exact, or None for kinds that must stay whole.
+    One table, two consumers — the cache's ``_Op`` bundles below and the
+    fleet router's scatter-gather (fleet/router.py) — so scatter
+    eligibility can never drift from cache-decomposition eligibility.
+
+    * ``count``: integer addition;
+    * ``density`` (unweighted; ``shape=(h, w)``): f32 grids hold integer
+      counts (exact to 2^24), cell-partition grid addition is bit-exact;
+    * ``stats`` (``stat_spec``; only when :func:`stats_exact_merge`):
+      sketch merge through :meth:`Stat.merge` — integer/extremum algebra;
+    * ``curve``: f64 block-count grids add exactly (integers to 2^53);
+      the ROUTER composes curve partials by disjoint block slices, the
+      cache by chunk families (_serve_curve) — both exact by blocks.
+    """
+    if kind == "count":
+        return (lambda: 0), (lambda a, b: a + int(b))
+    if kind == "density":
+        h, w = int(shape[0]), int(shape[1])
+        return (lambda: np.zeros((h, w), np.float32)), (
+            lambda a, b: a + np.asarray(b, np.float32)
+        )
+    if kind == "stats":
+        from geomesa_tpu.stats import parse_stat
+
+        if not stats_exact_merge(parse_stat(stat_spec)):
+            return None
+
+        def merge(acc: sk.Stat, piece: sk.Stat) -> sk.Stat:
+            acc.merge(piece)
+            return acc
+
+        return (lambda: parse_stat(stat_spec)), merge
+    if kind == "curve":
+        return (lambda: None), (lambda a, b: b if a is None else a + b)
+    return None
+
+
 class _Op:
     """Per-aggregate behavior bundle for the generic serve loop."""
 
@@ -413,11 +463,12 @@ class AggregateCache:
     # -- ops ----------------------------------------------------------------
     def count(self, ds, st, q, plan) -> int:
         ex = ds._executor(st)
+        zero, merge = merge_bundle("count")
         op = _Op(
             fingerprint=("count",),
             run=lambda p: int(ex.count(p)),
-            zero=lambda: 0,
-            merge=lambda a, b: a + int(b),
+            zero=zero,
+            merge=merge,
             pack=int,
             unpack=int,
             decomposable=True,
@@ -447,11 +498,12 @@ class AggregateCache:
             b = split[0]
             return (b.xmin, b.ymin, b.xmax, b.ymax) != render
 
+        zero, merge = merge_bundle("density", shape=(height, width))
         op = _Op(
             fingerprint=("density", render, int(width), int(height), weight),
             run=run,
-            zero=lambda: np.zeros((height, width), np.float32),
-            merge=lambda a, b: a + np.asarray(b, np.float32),
+            zero=zero,
+            merge=merge,
             pack=lambda v: np.asarray(v, np.float32).copy(),
             unpack=lambda v: v.copy(),
             # unweighted grids are integer-valued f32: cell addition is
@@ -465,14 +517,15 @@ class AggregateCache:
     def density_curve(self, ds, st, q, plan, level: int, block_window,
                       weight: Optional[str]) -> np.ndarray:
         ex = ds._executor(st)
+        zero, merge = merge_bundle("curve")
         op = _Op(
             fingerprint=("density_curve", int(level),
                          tuple(int(v) for v in block_window), weight),
             run=lambda p: np.asarray(
                 ex.density_curve(p, level, block_window, weight)
             ),
-            zero=lambda: None,
-            merge=lambda a, b: b if a is None else a + b,
+            zero=zero,
+            merge=merge,
             pack=lambda v: v.copy(),
             unpack=lambda v: v.copy(),
             # coordinate-space cells can't reproduce SFC block membership,
@@ -760,23 +813,26 @@ class AggregateCache:
         return out
 
     def stats(self, ds, st, q, plan, stat_spec: str) -> sk.Stat:
-        from geomesa_tpu.kernels.stats_scan import _leaf_stats
         from geomesa_tpu.stats import parse_stat
 
         ex = ds._executor(st)
-        probe = parse_stat(stat_spec)
-        exact_merge = all(
-            leaf.kind in EXACT_MERGE_KINDS for leaf in _leaf_stats(probe)
-        )
+        bundle = merge_bundle("stats", stat_spec=stat_spec)
+        exact_merge = bundle is not None
 
-        def merge(acc: sk.Stat, piece: sk.Stat) -> sk.Stat:
+        def _sketch_merge(acc: sk.Stat, piece: sk.Stat) -> sk.Stat:
             acc.merge(piece)
             return acc
+
+        # non-exact specs keep a working (but inexact) merge for safety;
+        # decomposable=False below means _serve never actually calls it
+        zero, merge = bundle if exact_merge else (
+            (lambda: parse_stat(stat_spec)), _sketch_merge,
+        )
 
         op = _Op(
             fingerprint=("stats", stat_spec),
             run=lambda p: ex.stats(p, parse_stat(stat_spec)),
-            zero=lambda: parse_stat(stat_spec),
+            zero=zero,
             merge=merge,
             # serialized snapshots: the caller's (mutable) Stat object can
             # never alias a cache entry

@@ -132,17 +132,23 @@ class CacheStore:
             return True
 
     # -- persistence (geomesa_tpu/lake/persist.py; docs/CACHE.md) ----------
-    def export_uid(self, uid: int) -> Tuple[Optional[int], list]:
+    def export_uid(self, uid: int,
+                   limit: Optional[int] = None) -> Tuple[Optional[int], list]:
         """Snapshot one dataset's entries for persistence: ``(epoch,
         [(key, value), ...])`` in LRU order (coldest first, so a budget-
-        capped restore keeps the hottest). Values are shared references —
+        capped restore keeps the hottest). ``limit`` keeps only the
+        HOTTEST ``limit`` entries (the warm-handoff drain's per-schema
+        cap — docs/RESILIENCE.md §7). Values are shared references —
         callers must treat them read-only."""
         with self._lock:
             d = self._data.get(uid)
             epoch = self._epoch.get(uid)
             if not d:
                 return epoch, []
-            return epoch, [(k, v[0]) for k, v in d.items()]
+            items = [(k, v[0]) for k, v in d.items()]
+        if limit is not None and len(items) > limit:
+            items = items[-limit:]  # LRU order: the tail is the hottest
+        return epoch, items
 
     def import_entries(self, uid: int, epoch: int, items) -> int:
         """Restore persisted entries under ``(uid, epoch)`` — the live
@@ -173,6 +179,48 @@ class CacheStore:
             if dropped:
                 metrics.inc(metrics.CACHE_INVALIDATE, dropped)
 
+    def export_wire(self, uid: int,
+                    limit: Optional[int] = None) -> Tuple[Optional[int],
+                                                          list]:
+        """:meth:`export_uid` in the JSON-safe wire shape the fleet's
+        warm-handoff drain ships over Flight (sidecar ``cache-export`` /
+        ``cache-import`` actions — docs/RESILIENCE.md §7): ``(epoch,
+        [[key_repr, encoded_value], ...])`` hottest-last. Entries whose
+        key does not survive the repr round trip, or whose value kind
+        has no wire encoding, are skipped entry-by-entry (the
+        lake-persistence rule)."""
+        import ast
+
+        epoch, items = self.export_uid(uid, limit=limit)
+        out = []
+        for key, value in items:
+            kr = repr(key)
+            try:
+                if ast.literal_eval(kr) != key:
+                    continue
+            except (ValueError, SyntaxError):
+                continue
+            enc = encode_wire_value(value)
+            if enc is not None:
+                out.append([kr, enc])
+        return epoch, out
+
+    def import_wire(self, uid: int, epoch: int, entries) -> int:
+        """Admit ``cache-export`` wire entries under ``(uid, epoch)`` —
+        the receiving store's CURRENT epoch, exactly like
+        :meth:`import_entries` (normal invalidation keeps guarding later
+        mutations; budget applies as for fresh puts)."""
+        items = []
+        import ast
+
+        for key_repr, enc in entries:
+            try:
+                items.append((ast.literal_eval(key_repr),
+                              decode_wire_value(enc)))
+            except (ValueError, SyntaxError, KeyError, TypeError):
+                continue  # one bad entry must not fail the handoff
+        return self.import_entries(uid, epoch, items)
+
     def snapshot(self) -> Dict[str, Any]:
         """Operator-facing stats (sidecar ``cache-stats`` action)."""
         reg = metrics.registry().report()
@@ -190,3 +238,53 @@ class CacheStore:
                 k: v for k, v in reg.items() if k.startswith("cache.")
             },
         }
+
+
+# -- wire value codec (fleet warm handoff; docs/RESILIENCE.md §7) ----------
+# The JSON-embeddable sibling of lake/persist.py's container codec: cache
+# VALUES are ints / floats / strs (stat JSON) / ndarrays / tuples thereof.
+# Arrays ride base64 with dtype+shape — a handoff is a few hundred hot
+# entries, not a lake snapshot, so the container's delta encoder would be
+# overkill on the action channel.
+
+def encode_wire_value(v: Any):
+    import base64
+
+    import numpy as np
+
+    if isinstance(v, bool):
+        return {"t": "bool", "v": bool(v)}
+    if isinstance(v, (int, np.integer)):
+        return {"t": "int", "v": int(v)}
+    if isinstance(v, (float, np.floating)):
+        return {"t": "float", "v": float(v)}
+    if isinstance(v, str):
+        return {"t": "str", "v": v}
+    if isinstance(v, np.ndarray):
+        raw = np.ascontiguousarray(v)
+        return {"t": "arr", "dtype": str(raw.dtype),
+                "shape": list(raw.shape),
+                "b64": base64.b64encode(raw.tobytes()).decode()}
+    if isinstance(v, tuple):
+        items = [encode_wire_value(i) for i in v]
+        if any(i is None for i in items):
+            return None
+        return {"t": "tuple", "items": items}
+    return None  # unencodable kind: the caller skips the entry
+
+
+def decode_wire_value(d) -> Any:
+    import base64
+
+    import numpy as np
+
+    t = d["t"]
+    if t in ("bool", "int", "float", "str"):
+        return d["v"]
+    if t == "arr":
+        a = np.frombuffer(base64.b64decode(d["b64"]),
+                          dtype=np.dtype(d["dtype"]))
+        return a.reshape(d["shape"]).copy()  # frombuffer is read-only
+    if t == "tuple":
+        return tuple(decode_wire_value(i) for i in d["items"])
+    raise ValueError(f"unknown wire value type {t!r}")

@@ -535,7 +535,13 @@ def cmd_fleet(args):
       non-admin requests answer [GM-DRAINING] until undrained, so every
       router fails the traffic over;
     * ``fleet count`` — route one count through an ad-hoc router (smoke/
-      operator sanity check of affinity + failover).
+      operator sanity check of affinity + failover);
+    * ``fleet leave`` — warm-handoff drain through an ad-hoc router:
+      drain the replica, push its hottest cache entries to the new ring
+      owners (cache-export/cache-import), report the handoff summary;
+    * ``fleet handoff`` — operator-driven direct handoff: export one
+      replica's hottest entries for a schema and import them into
+      another (no router involved).
     """
     if args.fleet_cmd == "replica":
         from geomesa_tpu import GeoDataset
@@ -578,8 +584,35 @@ def cmd_fleet(args):
             n = router.count(args.feature_name, args.cql)
             snap = router.snapshot()
         print(json.dumps({"count": int(n), "counters": snap["counters"],
+                          "scatter": snap["scatter"],
                           "replicas": snap["replicas"]},
                          indent=2, sort_keys=True, default=str))
+        return 0
+    if args.fleet_cmd == "leave":
+        from geomesa_tpu.fleet import FleetRouter
+
+        with FleetRouter(_parse_replicas(args.replicas)) as router:
+            out = router.deregister_replica(
+                args.replica_id, handoff=not args.no_handoff
+            )
+        print(json.dumps(out, indent=2, sort_keys=True, default=str))
+        return 0
+    if args.fleet_cmd == "handoff":
+        from geomesa_tpu.sidecar import GeoFlightClient
+
+        with GeoFlightClient(args.source) as src, \
+                GeoFlightClient(args.dest) as dst:
+            exported = src.cache_export(args.feature_name,
+                                        limit=args.limit)
+            got = dst.cache_import(
+                args.feature_name, exported.get("guard") or {},
+                exported.get("entries") or [],
+            )
+        print(json.dumps({
+            "exported": len(exported.get("entries") or []),
+            "restored": got.get("restored", 0),
+            **({"skipped": got["skipped"]} if got.get("skipped") else {}),
+        }, indent=2, sort_keys=True))
         return 0
     print(f"unknown fleet command {args.fleet_cmd!r}", file=sys.stderr)
     return 2
@@ -867,7 +900,8 @@ def build_parser() -> argparse.ArgumentParser:
 
     sp = sub.add_parser("fleet", help="replica-fleet operations: run a "
                         "replica, probe status, drain/undrain, routed "
-                        "count (docs/RESILIENCE.md §7)")
+                        "count, warm-handoff leave, direct cache handoff "
+                        "(docs/RESILIENCE.md §7)")
     fsub = sp.add_subparsers(dest="fleet_cmd", required=True)
     fp = fsub.add_parser("replica", help="run one replica sidecar over "
                          "the shared fleet root")
@@ -897,6 +931,28 @@ def build_parser() -> argparse.ArgumentParser:
                     help="id=host:port,id=host:port")
     fp.add_argument("-f", "--feature-name", required=True)
     fp.add_argument("-q", "--cql", default="INCLUDE")
+    fp.set_defaults(fn=cmd_fleet)
+    fp = fsub.add_parser("leave", help="warm-handoff drain: drain the "
+                         "replica, push its hottest cache entries to the "
+                         "new ring owners, remove it from the ring")
+    fp.add_argument("--replicas", required=True,
+                    help="id=host:port,... (must include the leaver)")
+    fp.add_argument("--replica-id", required=True,
+                    help="the replica to drain and remove")
+    fp.add_argument("--no-handoff", action="store_true",
+                    help="skip the cache handoff (plain drain + remove)")
+    fp.set_defaults(fn=cmd_fleet)
+    fp = fsub.add_parser("handoff", help="direct cache handoff between "
+                         "two replicas: export one's hottest entries for "
+                         "a schema, import into the other")
+    fp.add_argument("--source", required=True,
+                    help="grpc+tcp://host:port of the exporting replica")
+    fp.add_argument("--dest", required=True,
+                    help="grpc+tcp://host:port of the importing replica")
+    fp.add_argument("-f", "--feature-name", required=True)
+    fp.add_argument("--limit", type=int, default=None,
+                    help="hottest-entry cap (default: all current-epoch "
+                    "entries)")
     fp.set_defaults(fn=cmd_fleet)
 
     sp = sub.add_parser("version", help="print version")

@@ -699,10 +699,28 @@ FLEET_ROOT = SystemProperty("geomesa.fleet.root", None)
 #: viewports land on the same replica, keeping its cell cache hot.
 FLEET_ROUTING_LEVEL = SystemProperty("geomesa.fleet.routing.level", "3")
 
-#: Scatter decomposable exact counts across replicas by cell ownership
-#: (each owner group scans only its cells; integer partials add exactly).
-#: Off = every query routes whole to one replica.
+#: Scatter decomposable MERGEABLE aggregates across replicas by cell
+#: ownership (each owner group scans only its cells; partials compose
+#: exactly — counts add, unweighted grids add, exact-merge sketches
+#: merge, curve chunks slot by block). Off = every query routes whole
+#: to one replica.
 FLEET_SCATTER = SystemProperty("geomesa.fleet.scatter", "true")
+
+#: Concurrent owner-group dispatches per scattered query (the router's
+#: fan-out thread bound). "1" serializes the groups (still scattered,
+#: no parallel wall-clock win).
+FLEET_SCATTER_FANOUT = SystemProperty("geomesa.fleet.scatter.fanout", "8")
+
+#: Consecutive SUCCESSFUL probes after which the router automatically
+#: un-cordons a replica it cordoned (router-side cordons only — the
+#: geomesa.fleet.cordon config list stays operator-owned). "0" disables
+#: auto-uncordon (the pre-PR-15 manual-exit behavior).
+FLEET_UNCORDON_PROBES = SystemProperty("geomesa.fleet.uncordon.probes", "3")
+
+#: Hottest cache entries a draining replica pushes to the new ring owner
+#: during a warm-handoff drain (per schema, LRU-hottest first).
+FLEET_HANDOFF_ENTRIES = SystemProperty("geomesa.fleet.handoff.entries",
+                                       "256")
 
 #: Fleet-level admission bound on the router: concurrent in-flight routed
 #: queries beyond this are rejected typed [GM-OVERLOADED] before any RPC

@@ -61,6 +61,8 @@ class ReplicaRegistry:
         self._failed_over: Dict[str, int] = {}
         self._lat_recent: Dict[str, "deque"] = {}
         self._outlier_streak: Dict[str, int] = {}
+        #: consecutive successful probes per replica (auto-uncordon)
+        self._probe_streak: Dict[str, int] = {}
         self._gauged: Set[str] = set()
 
     # -- membership --------------------------------------------------------
@@ -84,6 +86,7 @@ class ReplicaRegistry:
             self._members.pop(rid, None)
             self._draining.discard(rid)
             self._cordoned.pop(rid, None)
+            self._probe_streak.pop(rid, None)
 
     # -- breaker plumbing --------------------------------------------------
     def breaker(self, rid: str) -> resilience.CircuitBreaker:
@@ -145,10 +148,38 @@ class ReplicaRegistry:
         self._ensure_gauge(rid)
         with self._lock:
             self._cordoned[str(rid)] = str(reason)
+            # auto-uncordon counts only probes AFTER the cordon
+            self._probe_streak.pop(str(rid), None)
 
     def uncordon(self, rid: str) -> bool:
         with self._lock:
             return self._cordoned.pop(str(rid), None) is not None
+
+    def note_probe(self, rid: str, ok: bool) -> bool:
+        """Probe-result bookkeeping for **auto-uncordon** (docs/
+        RESILIENCE.md §7): ``geomesa.fleet.uncordon.probes`` (default 3)
+        consecutive SUCCESSFUL probes *while cordoned* clear a
+        ROUTER-SIDE cordon — returns True when this probe un-cordoned
+        the replica. The streak only accumulates on a cordoned replica
+        (successes before the cordon must not pre-pay the exit, so
+        :meth:`cordon` always starts from zero). Config-list cordons
+        (``geomesa.fleet.cordon``) stay operator-owned: the streak never
+        touches them, so a deliberately fenced replica can never probe
+        its way back in. A failed probe zeroes the streak."""
+        with self._lock:
+            if not ok or rid not in self._cordoned:
+                self._probe_streak.pop(rid, None)
+                return False
+            streak = self._probe_streak.get(rid, 0) + 1
+            self._probe_streak[rid] = streak
+            k = config.FLEET_UNCORDON_PROBES.to_int()
+            k = 3 if k is None else int(k)
+            if k <= 0 or streak < k:
+                return False  # k <= 0: auto-uncordon disabled
+            self._cordoned.pop(rid, None)
+            self._probe_streak.pop(rid, None)
+        metrics.inc(metrics.FLEET_UNCORDON)
+        return True
 
     def set_draining(self, rid: str, draining: bool) -> None:
         """Record the replica's OWN drain state (learned from a

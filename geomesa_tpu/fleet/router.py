@@ -49,6 +49,7 @@ import numpy as np
 
 from geomesa_tpu import config, metrics, resilience, tracing
 from geomesa_tpu.cache import cells as cellmod
+from geomesa_tpu.cache import service as cache_service
 from geomesa_tpu.fleet.registry import ReplicaRegistry
 from geomesa_tpu.fleet.ring import RendezvousRing
 from geomesa_tpu.resilience import (
@@ -102,7 +103,11 @@ class FleetRouter:
         self._fts: Dict[str, Any] = {}
         self._ft_lock = threading.Lock()
         self._counters = {"affinity": 0, "failover": 0, "scatter": 0,
-                          "partial": 0}
+                          "partial": 0, "uncordoned": 0, "joined": 0,
+                          "left": 0}
+        #: per-owner scatter rows for /debug/fleet (groups served /
+        #: cells covered / groups+cells skipped — survivor accounting)
+        self._scatter_stats: Dict[str, Dict[str, int]] = {}
         self._counter_lock = threading.Lock()
         _ROUTERS.add(self)
 
@@ -132,6 +137,169 @@ class FleetRouter:
                 c.close()
             except Exception:
                 pass
+
+    # -- dynamic membership (docs/RESILIENCE.md §7) ------------------------
+    def register_replica(self, location: str) -> str:
+        """Runtime JOIN: probe ``location``, learn the replica's identity
+        from the gossip channel (the ``x-geomesa-replica-id`` response
+        header every fleet replica stamps; the replica-status body is the
+        fallback), adopt any newer epochs it knows, and admit it to the
+        registry + ring — it starts receiving its HRW share of the key
+        space on the next routed query, no router restart. Returns the
+        learned replica id; raises if the endpoint is not a fleet
+        replica (no identity — admitting it would orphan its keys)."""
+        from geomesa_tpu.sidecar.client import GeoFlightClient
+
+        c = GeoFlightClient(location, retry_seed=self._retry_seed,
+                            header_provider=self._fleet_headers)
+        try:
+            st = c.replica_status()
+            rid = c.last_replica_id or st.get("replica")
+            if not rid:
+                raise ValueError(
+                    f"{location} did not gossip a replica identity "
+                    "(geomesa.fleet.replica.id unset?) — not a fleet "
+                    "replica"
+                )
+            rid = str(rid)
+            with self._epoch_lock:
+                for sname, e in (st.get("epochs") or {}).items():
+                    if self._epochs.get(sname, 0) < int(e):
+                        self._epochs[sname] = int(e)
+        except Exception:
+            c.close()
+            raise
+        self.add_replica(rid, location)
+        self.registry.set_draining(rid, bool(st.get("draining")))
+        # keep the already-dialed client (add_replica dropped any OLD one)
+        with self._clients_lock:
+            self._clients[rid] = c
+        self._count("joined")
+        metrics.inc(metrics.FLEET_MEMBER_JOIN)
+        return rid
+
+    def deregister_replica(self, rid: str, handoff: bool = True) -> Dict:
+        """Runtime LEAVE with an optional **warm handoff**: drain the
+        replica (new traffic fails over immediately), push its hottest
+        per-schema cache entries to each schema's NEW ring owner (the
+        post-removal ring — ``cache-export``/``cache-import``, guarded by
+        the row-count + spec data check), then remove it from the
+        registry + ring. The drained replica's warmest cells keep
+        answering from cache on the new owner instead of dying with the
+        process. Returns the handoff summary."""
+        out: Dict[str, Any] = {"replica": rid, "handoff": {}}
+        try:
+            self.drain_replica(rid, reason="deregister")
+        except Exception as e:
+            # already down: nothing to drain OR hand off — just remove
+            out["drain_error"] = repr(e)[:200]
+            self.remove_replica(rid)
+            self._count("left")
+            metrics.inc(metrics.FLEET_MEMBER_LEAVE)
+            return out
+        if handoff:
+            out["handoff"] = self._warm_handoff(rid)
+        self.remove_replica(rid)
+        self._count("left")
+        metrics.inc(metrics.FLEET_MEMBER_LEAVE)
+        return out
+
+    def _handoff_dest(self, name: str, key, ring_after) -> Optional[str]:
+        """The surviving replica a handed-off entry belongs to: the
+        post-removal ring owner of the entry's AFFINITY key. Cell entries
+        (``("cell", ..., level, prefix)``) and curve chunks (``("curve",
+        ..., level, side, kx, ky)``) carry their cell identity — their
+        routing-level ancestor keys the ring exactly as scattered /
+        affinity queries will look them up. Whole-result entries (opaque
+        filter reprs) return None: the caller broadcasts them, since ANY
+        survivor may now own that viewport's key."""
+        lvl = self._routing_level()
+        try:
+            if key[0] == "cell":
+                level, prefix = int(key[-2]), int(key[-1])
+            elif key[0] == "curve":
+                level, side = int(key[-4]), int(key[-3])
+                bx, by = int(key[-2]) * side, int(key[-1]) * side
+                prefix = cellmod.cell_prefix(level, (bx, by))
+            else:
+                return None
+        except (TypeError, ValueError, IndexError):
+            return None
+        if level >= lvl:
+            alvl, aprefix = lvl, prefix >> (2 * (level - lvl))
+        else:
+            alvl, aprefix = level, prefix
+        return ring_after.owner(f"{name}:z{alvl}:{aprefix}")
+
+    def _warm_handoff(self, rid: str) -> Dict[str, Any]:
+        """Push the draining replica's hottest cache entries to the NEW
+        ring owners (best effort: a failed schema's handoff is reported,
+        never fatal — the entries would simply recompute): cell-
+        addressable entries go to their own cell's post-removal owner,
+        whole-result entries broadcast to every survivor (bounded by
+        ``geomesa.fleet.handoff.entries``) — whichever replica now owns
+        the drained replica's hottest viewport answers it from cache."""
+        import ast
+
+        summary: Dict[str, Any] = {}
+        survivors = [m for m in self.ring.members if m != rid]
+        if not survivors:
+            return {"skipped": "no surviving replica to hand off to"}
+        ring_after = self.ring.with_members(survivors)
+        limit = config.FLEET_HANDOFF_ENTRIES.to_int() or 256
+        src = self._client(rid)
+        try:
+            schemas = src.replica_status().get("schemas") or []
+        except Exception as e:
+            return {"error": repr(e)[:200]}
+        for sname in schemas:
+            try:
+                exported = src.cache_export(sname, limit=limit)
+                entries = exported.get("entries") or []
+                if not entries:
+                    summary[sname] = {"entries": 0}
+                    continue
+                by_dest: Dict[str, list] = {}
+                for ent in entries:
+                    try:
+                        dest = self._handoff_dest(
+                            sname, ast.literal_eval(ent[0]), ring_after
+                        )
+                    except (ValueError, SyntaxError):
+                        continue
+                    for d in ([dest] if dest is not None else survivors):
+                        by_dest.setdefault(d, []).append(ent)
+                guard = exported.get("guard") or {}
+                restored = 0
+                for dest in survivors:  # ring order: deterministic report
+                    batch = by_dest.get(dest)
+                    if not batch:
+                        continue
+                    # per-destination isolation: one unreachable/draining
+                    # survivor must not void the other destinations'
+                    # (possibly already landed) imports
+                    try:
+                        got = self._client(dest).cache_import(
+                            sname, guard, batch
+                        )
+                    except Exception as e:
+                        summary.setdefault(sname, {}).setdefault(
+                            "errors", {})[dest] = repr(e)[:200]
+                        continue
+                    restored += int(got.get("restored", 0))
+                    if got.get("skipped"):
+                        summary.setdefault(sname, {}).setdefault(
+                            "skipped", {})[dest] = got["skipped"]
+                row = summary.setdefault(sname, {})
+                row.update({
+                    "entries": len(entries), "restored": restored,
+                    "to": sorted(by_dest),
+                })
+                if restored:
+                    metrics.inc(metrics.FLEET_HANDOFF_ENTRIES, restored)
+            except Exception as e:
+                summary[sname] = {"error": repr(e)[:200]}
+        return summary
 
     # -- admin -------------------------------------------------------------
     def cordon(self, rid: str, reason: str = "operator") -> None:
@@ -164,14 +332,23 @@ class FleetRouter:
             st = self._client(rid).replica_status()
         except Exception as e:
             self.registry.record_failure(rid, e)
+            self.registry.note_probe(rid, False)
             return {"replica": rid, "ok": False, "error": repr(e)[:300]}
         self.registry.record_success(rid)
         self.registry.set_draining(rid, bool(st.get("draining")))
+        # auto-uncordon (docs/RESILIENCE.md §7): K consecutive successful
+        # probes clear a router-side cordon (geomesa.fleet.uncordon.probes)
+        uncordoned = self.registry.note_probe(rid, True)
+        if uncordoned:
+            self._count("uncordoned")
         with self._epoch_lock:
             for name, e in (st.get("epochs") or {}).items():
                 if self._epochs.get(name, 0) < int(e):
                     self._epochs[name] = int(e)
-        return {"replica": rid, "ok": True, **st}
+        out = {"replica": rid, "ok": True, **st}
+        if uncordoned:
+            out["uncordoned"] = True
+        return out
 
     def probe_all(self) -> Dict[str, Dict[str, Any]]:
         return {rid: self.probe(rid) for rid in self.registry.members()}
@@ -180,6 +357,8 @@ class FleetRouter:
         """The /debug/fleet payload for this router."""
         with self._counter_lock:
             counters = dict(self._counters)
+            scatter = {o: dict(row)
+                       for o, row in sorted(self._scatter_stats.items())}
         with self._epoch_lock:
             epochs = dict(self._epochs)
         return {
@@ -189,6 +368,9 @@ class FleetRouter:
             "summary": self.registry.summary(),
             "epochs": epochs,
             "counters": counters,
+            # per-owner-group scatter survivor rows (docs/OBSERVABILITY.md):
+            # groups/cells served vs skipped, keyed by owner replica
+            "scatter": scatter,
             "serving": self.serving.snapshot(),
             "users": self.serving.user_rollups(),
         }
@@ -482,7 +664,17 @@ class FleetRouter:
                              error=repr(err))],
         ) from last
 
-    # -- scatter counts ----------------------------------------------------
+    # -- scatter-gather for mergeable aggregates ---------------------------
+    # (docs/RESILIENCE.md §7 "Scatter-gather for every mergeable
+    # aggregate"): counts, unweighted density grids, exact-merge stats
+    # sketches, and density-curve block windows split across owner groups;
+    # each group scans only its owned cells; the router composes partials
+    # with a FIXED-ORDER merge (tree merge in job order for fold kinds,
+    # disjoint block slices for curve) so scattered results are
+    # bit-identical to the single-replica answer. Eligibility is the
+    # cache's partial-merge table (cache/service.merge_bundle) — what the
+    # cache may decompose, the fleet may scatter; everything else routes
+    # whole on affinity.
     @staticmethod
     def _bbox_ecql(geom: str, boxes: Sequence[Tuple[float, float, float,
                                                     float]]) -> str:
@@ -498,13 +690,21 @@ class FleetRouter:
             return conjunct
         return f"({ecql}) AND {conjunct}"
 
-    def _scatter_groups(self, name: str, decomp) -> Dict[str, List[Tuple[
-            int, int]]]:
+    def _scatter_groups(self, name: str, decomp) -> List[Tuple[
+            str, List[Tuple[int, int]]]]:
         """Group the decomposition's interior cells by ring owner: each
         cell's ROUTING-level ancestor keys the ring (the same key family
         single-query affinity uses, so a scattered group lands exactly
         where the undecomposed queries for that slice of the world warm
-        their caches)."""
+        their caches).
+
+        Owner order is pinned to RING order (``ring.members`` is a sorted
+        tuple — identical on every router instance regardless of the
+        order replicas registered), never dict-insertion order: the
+        partials enter a fixed-order merge, and structure-sensitive
+        outputs (survivor group lists, skip records, /debug/fleet rows)
+        must be deterministic across router restarts
+        (regression-tested)."""
         lvl = self._routing_level()
         groups: Dict[str, List[Tuple[int, int]]] = {}
         for (ix, iy) in decomp.cells:
@@ -515,85 +715,389 @@ class FleetRouter:
                 anc, alvl = (ix, iy), decomp.level
             key = f"{name}:z{alvl}:{cellmod.cell_prefix(alvl, anc)}"
             groups.setdefault(self.ring.owner(key), []).append((ix, iy))
-        return groups
+        return [(o, groups[o]) for o in self.ring.members if o in groups]
 
-    def _scatter_count(self, name: str, ecql: str, decomp, ft,
-                       call_kw: Dict[str, Any],
-                       user: Optional[str]) -> int:
-        """Exact count scattered by cell ownership: one sub-count per
-        owner group over ``orig ∧ (its cells)`` plus the boundary strips
-        on the affinity owner — disjoint boxes, integer partials, so the
-        sum is bit-identical to the whole-query count. A group whose
-        every candidate fails degrades with EXACT survivor totals under
-        ``allow_partial()`` and raises typed otherwise."""
-        geom = ft.geom_field
+    def _usable_count(self) -> int:
+        return sum(1 for r in self.registry.members()
+                   if self.registry.usable(r))
+
+    def _scatter_eligible(self, name: str, f, ft):
+        """The common scatter gate: knob on, >1 usable replica, the
+        filter decomposes to >1 interior cells landing on >1 owners.
+        Returns ``(decomp, groups)`` or None (route whole)."""
+        if f is None or ft is None or not config.FLEET_SCATTER.to_bool():
+            return None
+        if self._usable_count() <= 1:
+            return None
+        decomp = cellmod.decompose(f, ft)
+        if decomp is None or len(decomp.cells) <= 1:
+            return None
         groups = self._scatter_groups(name, decomp)
-        jobs: List[Tuple[str, str, str]] = []  # (owner, sub_ecql, label)
-        for owner, cells in sorted(groups.items()):
-            boxes = [decomp.cell_boxes[c] for c in cells]
-            jobs.append((
-                owner,
-                self._and_ecql(ecql, self._bbox_ecql(geom, boxes)),
-                f"cells[{len(cells)}@z{decomp.level}]",
-            ))
+        if len(groups) <= 1:
+            return None
+        return decomp, groups
+
+    def _cell_jobs(self, name: str, ecql: str, decomp, groups, ft,
+                   call) -> List[Dict[str, Any]]:
+        """One job per owner group over ``orig ∧ (its cells)`` plus the
+        boundary strips on the schema-affinity owner — disjoint boxes
+        covering the query exactly, so partials compose exactly.
+        ``call(sub_ecql)`` builds the per-group client call."""
+        geom = ft.geom_field
+        jobs: List[Dict[str, Any]] = []
+        for owner, cells in groups:
+            sub = self._and_ecql(
+                ecql, self._bbox_ecql(
+                    geom, [decomp.cell_boxes[c] for c in cells]
+                )
+            )
+            jobs.append({
+                "owner": owner, "phase": sub, "call": call(sub),
+                "cells": len(cells),
+                "label": f"{owner}:cells[{len(cells)}@z{decomp.level}]",
+            })
         if decomp.strips:
             # boundary strips ride the schema-affinity owner
-            jobs.append((
-                self.ring.owner(f"schema:{name}"),
-                self._and_ecql(ecql, self._bbox_ecql(geom, decomp.strips)),
-                f"strips[{len(decomp.strips)}]",
-            ))
+            owner = self.ring.owner(f"schema:{name}")
+            sub = self._and_ecql(
+                ecql, self._bbox_ecql(geom, decomp.strips)
+            )
+            jobs.append({
+                "owner": owner, "phase": sub, "call": call(sub),
+                "cells": 0,
+                "label": f"{owner}:strips[{len(decomp.strips)}]",
+            })
+        return jobs
+
+    def _scatter_dispatch(self, name: str, op: str,
+                          jobs: List[Dict[str, Any]]):
+        """Fan the owner-group jobs out over a bounded thread pool
+        (``geomesa.fleet.scatter.fanout``; 1 = serial) — each job pins
+        its group's owner first, then fails over along the schema's ring
+        ranking (any replica can serve any cells: shared storage).
+        Workers adopt the caller's deadline, config overrides, and span
+        context (the partition-prefetch snapshot/adopt discipline), so
+        budgets and fault-injection scopes bound every branch. Returns
+        ``(results, failed)`` — per-job one-tuples (survivors) and
+        exhaustion errors; a non-retryable error (deadline expiry,
+        GM-ARG) aborts the whole scatter and re-raises."""
+        results: List[Optional[Tuple[Any]]] = [None] * len(jobs)
+        failed: List[Optional[BaseException]] = [None] * len(jobs)
+        fatal: List[BaseException] = []
+        schema_owners = self.ring.owners(f"schema:{name}")
+
+        def run_one(i: int) -> None:
+            job = jobs[i]
+            order = [job["owner"]] + [
+                r for r in schema_owners if r != job["owner"]
+            ]
+            try:
+                out, _rid = self._call(
+                    name, f"{name}:owner:{job['owner']}", op, job["call"],
+                    owners=order,
+                )
+                results[i] = (out,)
+            except _Exhausted as ex:
+                failed[i] = ex.last or RuntimeError("no usable replica")
+
+        fanout = config.FLEET_SCATTER_FANOUT.to_int()
+        fanout = 8 if fanout is None else int(fanout)  # "0" = serial
+        width = max(1, min(len(jobs), fanout))
+        if width == 1:
+            for i in range(len(jobs)):
+                run_one(i)
+            return results, failed
+
+        it = iter(range(len(jobs)))
+        it_lock = threading.Lock()
+        ov = config.snapshot_overrides()
+        tspan = tracing.snapshot()
+        dl = resilience.current_deadline()
+
+        def worker() -> None:
+            config.adopt_overrides(ov)
+            tracing.adopt(tspan)
+            with resilience.adopt_deadline(dl):
+                while not fatal:
+                    with it_lock:
+                        i = next(it, None)
+                    if i is None:
+                        return
+                    try:
+                        run_one(i)
+                    except BaseException as e:
+                        fatal.append(e)
+                        return
+
+        threads = [
+            threading.Thread(target=worker, daemon=True,
+                             name=f"fleet-scatter-{self.name}-{k}")
+            for k in range(width)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        if fatal:
+            raise fatal[0]
+        return results, failed
+
+    def _scatter_finish(self, name: str, kind: str, op: str,
+                        jobs: List[Dict[str, Any]], results, failed):
+        """Per-owner-group survivor accounting shared by every scattered
+        kind: skip records carry each missing group's sub-query verbatim
+        (``Skipped.phase`` — re-runnable once the fleet heals, so the
+        degraded answer reconciles to the full one exactly), the
+        ``/debug/fleet`` scatter rows update, and partial metrics bump
+        per skipped group. Returns the skip records; the caller merges
+        survivors and applies the strict-vs-degraded contract."""
+        skipped: List[Skipped] = []
+        with self._counter_lock:
+            for i, job in enumerate(jobs):
+                row = self._scatter_stats.setdefault(job["owner"], {
+                    "groups": 0, "cells": 0,
+                    "skipped_groups": 0, "skipped_cells": 0,
+                })
+                if failed[i] is None:
+                    row["groups"] += 1
+                    row["cells"] += job["cells"]
+                else:
+                    row["skipped_groups"] += 1
+                    row["skipped_cells"] += job["cells"]
+        for i, job in enumerate(jobs):
+            err = failed[i]
+            if err is None:
+                continue
+            rec = Skipped(source="fleet.route",
+                          part=f"{name}:{job['label']}", error=repr(err),
+                          phase=job["phase"])
+            if resilience.partial_allowed():
+                resilience.record_skip(
+                    "fleet.route", part=f"{name}:{job['label']}",
+                    error=err, phase=job["phase"],
+                )
+            skipped.append(rec)
+            self._count("partial")
+            metrics.inc(metrics.FLEET_ROUTE_PARTIAL)
+        return skipped
+
+    #: merge-cost histogram shape (ms): router-side merges are host-light
+    _MERGE_BUCKETS_MS = (0.1, 0.5, 1.0, 5.0, 20.0, 100.0, 500.0)
+
+    def _observe_merge(self, seconds: float) -> None:
+        metrics.registry().histogram(
+            metrics.FLEET_SCATTER_MERGE_MS,
+            buckets=self._MERGE_BUCKETS_MS, unit="ms",
+        ).observe(seconds * 1e3)
+
+    def _scatter_fold(self, name: str, kind: str, op: str,
+                      jobs: List[Dict[str, Any]], zero, merge,
+                      user: Optional[str]):
+        """The fold-merge scatter body (count / density / stats): admit
+        once, dispatch the owner groups, tree-merge survivors in FIXED
+        job order (the docs/SCALE.md sharded-scan merge argument lifted
+        to replicas — the order depends only on the ring-pinned group
+        order, never on completion timing), and apply the §3 degradation
+        contract with exact per-owner-group survivor accounting."""
+        from geomesa_tpu.parallel.devices import tree_merge
+
         self._count("scatter")
         metrics.inc(metrics.FLEET_ROUTE_SCATTER)
-        total = 0
-        ok = 0
-        skipped: List[Skipped] = []
-        with self._admit("count", user=user), \
-                tracing.start("fleet.count", schema=name, scatter=True):
-            for owner, sub_ecql, label in jobs:
-                # owner-first order, then the ring's ranking for failover
-                # (any replica can serve any cells — shared storage)
-                order = [owner] + [
-                    r for r in self.ring.owners(f"schema:{name}")
-                    if r != owner
-                ]
-                try:
-                    n, _rid = self._call(
-                        name, f"{name}:owner:{owner}", "count",
-                        lambda c, e=sub_ecql: c.count(name, e, **call_kw),
-                        owners=order,
-                    )
-                except _Exhausted as ex:
-                    err = ex.last or RuntimeError("no usable replica")
-                    # phase carries the group's sub-query verbatim: the
-                    # EXACT rows the degraded total is missing — a
-                    # consumer (or test) can re-run it once the fleet
-                    # heals and reconcile to the full answer. Surviving
-                    # groups keep executing in BOTH modes, so the
-                    # accounting is always complete: strict mode raises
-                    # at the end with the full survivor total.
-                    rec = Skipped(source="fleet.route",
-                                  part=f"{name}:{label}", error=repr(err),
-                                  phase=sub_ecql)
-                    if resilience.partial_allowed():
-                        resilience.record_skip(
-                            "fleet.route", part=f"{name}:{label}",
-                            error=err, phase=sub_ecql,
-                        )
-                    skipped.append(rec)
-                    self._count("partial")
-                    metrics.inc(metrics.FLEET_ROUTE_PARTIAL)
-                    continue
-                total += int(n)
-                ok += 1
+        metrics.inc(f"{metrics.FLEET_SCATTER_KIND_PREFIX}.{kind}")
+        with self._admit(op, user=user), \
+                tracing.start(f"fleet.{op}", schema=name, scatter=True,
+                              groups=len(jobs)):
+            results, failed = self._scatter_dispatch(name, op, jobs)
+            skipped = self._scatter_finish(
+                name, kind, op, jobs, results, failed
+            )
+            t0 = time.perf_counter()
+            merged = tree_merge(
+                [r[0] for r in results if r is not None], merge
+            )
+            self._observe_merge(time.perf_counter() - t0)
+        if merged is None:
+            merged = zero()
+        ok = len(jobs) - len(skipped)
         if skipped and not resilience.partial_allowed():
             raise FleetPartialError(
-                f"{len(skipped)} owner group(s) of count on {name!r} are "
-                f"down (survivors: {ok}/{len(jobs)} groups, "
-                f"count {total})",
-                value=total, ok=ok, total=len(jobs), skipped=skipped,
+                f"{len(skipped)} owner group(s) of {kind} on {name!r} are "
+                f"down (survivors: {ok}/{len(jobs)} groups: "
+                f"{[r.part for r in skipped]} missing)",
+                value=merged, ok=ok, total=len(jobs), skipped=skipped,
             )
-        return total
+        return merged
+
+    def _scatter_count(self, name: str, ecql: str, decomp, groups, ft,
+                       call_kw: Dict[str, Any],
+                       user: Optional[str]) -> int:
+        """Exact count scattered by cell ownership: integer partials over
+        disjoint boxes add exactly, so the sum is bit-identical to the
+        whole-query count."""
+        zero, merge = cache_service.merge_bundle("count")
+        jobs = self._cell_jobs(
+            name, ecql, decomp, groups, ft,
+            lambda sub: (lambda c, e=sub: c.count(name, e, **call_kw)),
+        )
+        total = self._scatter_fold(
+            name, "count", "count", jobs, zero, merge, user
+        )
+        return int(total)
+
+    def _scatter_density(self, name: str, ecql: str, decomp, groups, ft,
+                         bbox, width: int, height: int, auths,
+                         user: Optional[str]) -> np.ndarray:
+        """Unweighted density scattered by cell ownership: every row
+        lands in exactly one disjoint sub-query, each +1 is exact in f32
+        (integer counts to 2^24), so per-group grid addition reproduces
+        the single-replica raster bit-for-bit — the cache's cell-
+        partition argument (docs/CACHE.md "Exactness") over replicas.
+        The render raster (``bbox`` x ``width`` x ``height``) is FIXED
+        across every sub-call; only the filter splits."""
+        zero, merge = cache_service.merge_bundle(
+            "density", shape=(height, width)
+        )
+        jobs = self._cell_jobs(
+            name, ecql, decomp, groups, ft,
+            lambda sub: (lambda c, e=sub: c.density(
+                name, e, bbox=bbox, width=width, height=height,
+                weight=None, auths=auths,
+            )),
+        )
+        return self._scatter_fold(
+            name, "density", "density", jobs, zero, merge, user
+        )
+
+    def _scatter_stats_agg(self, name: str, stat_spec: str, ecql: str,
+                           decomp, groups, ft, auths,
+                           user: Optional[str]):
+        """Exact-merge stats scattered by cell ownership: eligibility and
+        merge come from the cache's partial-merge table
+        (cache/service.merge_bundle — EXACT_MERGE_KINDS only: integer /
+        extremum algebra, order-independent), so the fixed-order sketch
+        merge equals the single-replica scan exactly."""
+        bundle = cache_service.merge_bundle("stats", stat_spec=stat_spec)
+        assert bundle is not None  # caller gated on eligibility
+        zero, merge = bundle
+        jobs = self._cell_jobs(
+            name, ecql, decomp, groups, ft,
+            lambda sub: (lambda c, e=sub: c.stats(
+                name, stat_spec, e, auths=auths,
+            )),
+        )
+        return self._scatter_fold(
+            name, "stats", "stats", jobs, zero, merge, user
+        )
+
+    def _scatter_curve(self, name: str, ecql: str, ft, level: int, bbox,
+                       auths, user: Optional[str]):
+        """Density-curve scattered by BLOCK windows (not coordinate
+        cells — block membership is an SFC quantization no coordinate
+        predicate reproduces at block edges, the reason the cache keeps
+        curve whole in coordinate space): the query's snapped block
+        window splits into routing-level-aligned sub-windows grouped by
+        ring owner; each sub-call asks for EXACTLY its blocks (the bbox
+        passed is the sub-window's block-center box, so the replica's
+        outward snap lands on precisely those blocks) with the filter
+        narrowed to a one-block-widened cover of the sub-window (rows in
+        the margin quantize to out-of-window blocks and crop away — a
+        row of the window can never be lost). Block counts are window-
+        independent (CDF differences over the z2-sorted scan), so the
+        disjoint sub-grids COMPOSE BY BLOCK into the full grid
+        bit-identically. Returns ``(grid, snapped_bbox)``."""
+        import json as _json
+
+        from geomesa_tpu.api.dataset import GeoDataset
+
+        geom = ft.geom_field
+        (ix0, iy0, ix1, iy1), snapped = GeoDataset._snap_blocks(
+            bbox, level
+        )
+        lvl = min(self._routing_level(), level)
+        # coarsen the grouping level until the job count is bounded: a
+        # world-scale window at the routing level would mean one RPC per
+        # routing cell — per-call overhead would eat the scatter win
+        while lvl > 1:
+            sh = level - lvl
+            n_jobs = (((ix1 >> sh) - (ix0 >> sh) + 1)
+                      * ((iy1 >> sh) - (iy0 >> sh) + 1))
+            if n_jobs <= 16:
+                break
+            lvl -= 1
+        shift = level - lvl
+        n_side = 1 << level
+        bsx, bsy = 360.0 / n_side, 180.0 / n_side
+        subs = []  # (owner, (sx0, sy0, sx1, sy1)) block sub-windows
+        for ay in range(iy0 >> shift, (iy1 >> shift) + 1):
+            for ax in range(ix0 >> shift, (ix1 >> shift) + 1):
+                sx0, sx1 = max(ax << shift, ix0), \
+                    min(((ax + 1) << shift) - 1, ix1)
+                sy0, sy1 = max(ay << shift, iy0), \
+                    min(((ay + 1) << shift) - 1, iy1)
+                key = f"{name}:z{lvl}:{cellmod.cell_prefix(lvl, (ax, ay))}"
+                subs.append((self.ring.owner(key), (sx0, sy0, sx1, sy1)))
+        if len(subs) <= 1 or len({o for o, _ in subs}) <= 1:
+            return None  # one owner would serve it all: route whole
+        # ring-pinned job order (the _scatter_groups determinism rule)
+        order_of = {o: i for i, o in enumerate(self.ring.members)}
+        subs.sort(key=lambda s: (order_of[s[0]], s[1][1], s[1][0]))
+
+        jobs: List[Dict[str, Any]] = []
+        for owner, (sx0, sy0, sx1, sy1) in subs:
+            # block-center box: snaps back to exactly [sx0..sx1]x[sy0..sy1]
+            sub_bbox = ((sx0 + 0.5) * bsx - 180.0,
+                        (sy0 + 0.5) * bsy - 90.0,
+                        (sx1 + 0.5) * bsx - 180.0,
+                        (sy1 + 0.5) * bsy - 90.0)
+            # filter cover widened a full block each side: conservative
+            # against float edge error, exact by the crop argument above
+            cover = (max(sx0 * bsx - 180.0 - bsx, -180.0),
+                     max(sy0 * bsy - 90.0 - bsy, -90.0),
+                     min((sx1 + 1) * bsx - 180.0 + bsx, 180.0),
+                     min((sy1 + 1) * bsy - 90.0 + bsy, 90.0))
+            sub_ecql = self._and_ecql(ecql, self._bbox_ecql(geom, [cover]))
+            jobs.append({
+                "owner": owner,
+                "phase": _json.dumps({"ecql": ecql, "level": int(level),
+                                      "bbox": list(sub_bbox)}),
+                "call": (lambda c, e=sub_ecql, b=sub_bbox: c.density_curve(
+                    name, e, level=level, bbox=b, auths=auths,
+                )),
+                "cells": (sx1 - sx0 + 1) * (sy1 - sy0 + 1),
+                "label": (f"{owner}:blocks[{sx0},{sy0}..{sx1},{sy1}"
+                          f"@z{level}]"),
+            })
+        self._count("scatter")
+        metrics.inc(metrics.FLEET_ROUTE_SCATTER)
+        metrics.inc(f"{metrics.FLEET_SCATTER_KIND_PREFIX}.curve")
+        out = np.zeros((iy1 - iy0 + 1, ix1 - ix0 + 1), np.float64)
+        with self._admit("density_curve", user=user), \
+                tracing.start("fleet.density_curve", schema=name,
+                              scatter=True, groups=len(jobs)):
+            results, failed = self._scatter_dispatch(
+                name, "density_curve", jobs
+            )
+            skipped = self._scatter_finish(
+                name, "curve", "density_curve", jobs, results, failed
+            )
+            t0 = time.perf_counter()
+            for res, (_o, (sx0, sy0, sx1, sy1)) in zip(results, subs):
+                if res is None:
+                    continue
+                grid, _sn = res[0]
+                out[sy0 - iy0: sy1 - iy0 + 1,
+                    sx0 - ix0: sx1 - ix0 + 1] = grid
+            self._observe_merge(time.perf_counter() - t0)
+        ok = len(jobs) - len(skipped)
+        if skipped and not resilience.partial_allowed():
+            raise FleetPartialError(
+                f"{len(skipped)} owner group(s) of curve on {name!r} are "
+                f"down (survivors: {ok}/{len(jobs)} groups: "
+                f"{[r.part for r in skipped]} missing)",
+                value=(out, snapped), ok=ok, total=len(jobs),
+                skipped=skipped,
+            )
+        return out, snapped
 
     # -- public API (GeoDataset-shaped) ------------------------------------
     def count(self, name: str, ecql: str = "INCLUDE", exact: bool = True,
@@ -609,17 +1113,15 @@ class FleetRouter:
         if speculative_ok:
             call_kw["speculative_ok"] = True
         f, ft = self._parse(name, ecql)
-        if (exact and region is None and f is not None and ft is not None
-                and config.FLEET_SCATTER.to_bool()
-                and sum(1 for r in self.registry.members()
-                        if self.registry.usable(r)) > 1):
-            decomp = cellmod.decompose(f, ft)
-            if decomp is not None and len(decomp.cells) > 1:
-                groups = self._scatter_groups(name, decomp)
-                if len(groups) > 1:
-                    return self._scatter_count(
-                        name, ecql, decomp, ft, call_kw, user
-                    )
+        # speculative_ok never scatters: one overloaded owner group could
+        # answer its sub-count with the planner's coarse estimate, and the
+        # sum would present an estimate as the exact scattered total
+        if exact and region is None and not speculative_ok:
+            el = self._scatter_eligible(name, f, ft)
+            if el is not None:
+                return self._scatter_count(
+                    name, ecql, el[0], el[1], ft, call_kw, user
+                )
         key = self._affinity_key(name, f, ft)
         return self._route(
             name, key, "count",
@@ -634,6 +1136,15 @@ class FleetRouter:
                 region: Optional[str] = None,
                 user: Optional[str] = None) -> np.ndarray:
         f, ft = self._parse(name, ecql)
+        if weight is None and region is None and bbox is not None:
+            # unweighted grids add bit-exactly cell-by-cell (weighted
+            # f32 rounding is order-dependent: whole-route only)
+            el = self._scatter_eligible(name, f, ft)
+            if el is not None:
+                return self._scatter_density(
+                    name, ecql, el[0], el[1], ft, bbox, width, height,
+                    auths, user,
+                )
         key = self._affinity_key(name, f, ft)
         return self._route(
             name, key, "density",
@@ -650,6 +1161,18 @@ class FleetRouter:
                       auths: Optional[Sequence[str]] = None,
                       user: Optional[str] = None):
         f, ft = self._parse(name, ecql)
+        if (weight is None and bbox is not None and f is not None
+                and ft is not None and ft.geom_field is not None
+                and config.FLEET_SCATTER.to_bool()
+                and self._usable_count() > 1):
+            # block-window scatter: chunks compose by block (exact f64
+            # integer counts) — see _scatter_curve for the bbox snapping
+            # and filter-cover argument
+            out = self._scatter_curve(
+                name, ecql, ft, int(level), bbox, auths, user
+            )
+            if out is not None:
+                return out
         key = self._affinity_key(name, f, ft)
         return self._route(
             name, key, "density_curve",
@@ -665,6 +1188,22 @@ class FleetRouter:
         from geomesa_tpu.stats import parse_stat
 
         f, ft = self._parse(name, ecql)
+        if region is None:
+            # eligibility IS the cache's partial-merge table: only specs
+            # whose every leaf sketch merges exactly may scatter
+            try:
+                mergeable = cache_service.merge_bundle(
+                    "stats", stat_spec=stat_spec
+                ) is not None
+            except Exception:
+                mergeable = False  # unparseable spec: the replica raises
+            if mergeable:
+                el = self._scatter_eligible(name, f, ft)
+                if el is not None:
+                    return self._scatter_stats_agg(
+                        name, stat_spec, ecql, el[0], el[1], ft, auths,
+                        user,
+                    )
         key = self._affinity_key(name, f, ft)
         return self._route(
             name, key, "stats",

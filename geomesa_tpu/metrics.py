@@ -543,6 +543,24 @@ FLEET_ROUTE_PARTIAL = "fleet.route.partial"
 FLEET_EPOCH_BUMP = "fleet.epoch.bump"
 FLEET_EPOCH_REFRESH = "fleet.epoch.refresh"
 FLEET_REPLICA_HEALTH_PREFIX = "fleet.replica.health"
+#   fleet.scatter.<kind>   scattered queries by aggregate kind (count /
+#                          density / stats / curve — docs/RESILIENCE.md
+#                          §7 "Scatter-gather for every mergeable
+#                          aggregate")
+#   fleet.scatter.merge_ms router-side fixed-order merge cost of one
+#                          scattered query's partials (histogram)
+#   fleet.uncordon         replicas auto-uncordoned after K consecutive
+#                          successful probes (geomesa.fleet.uncordon.probes)
+#   fleet.member.join      replicas registered with a router at runtime
+#   fleet.member.leave     replicas deregistered at runtime
+#   fleet.handoff.entries  cache entries pushed to the new ring owner by
+#                          warm-handoff drains
+FLEET_SCATTER_KIND_PREFIX = "fleet.scatter"
+FLEET_SCATTER_MERGE_MS = "fleet.scatter.merge_ms"
+FLEET_UNCORDON = "fleet.uncordon"
+FLEET_MEMBER_JOIN = "fleet.member.join"
+FLEET_MEMBER_LEAVE = "fleet.member.leave"
+FLEET_HANDOFF_ENTRIES = "fleet.handoff.entries"
 #   compact.desc.shared   compact-scan descriptors served from the
 #                         content-addressed share (a rebuild avoided:
 #                         another site/query resolved the same windows —

@@ -49,6 +49,45 @@ _SKIPPED = object()  # sentinel: partition degraded away (fn may return None)
 _UNSET = object()
 
 
+def _coalesce_boxes(boxes: List[Tuple[float, float, float, float]]
+                    ) -> List[Tuple[float, float, float, float]]:
+    """Coalesce exactly-tiling boxes into a compact cover — the
+    group-scoped plan-bounds pass for fleet-scattered sub-queries
+    (docs/RESILIENCE.md §7): a scatter group's filter carries one BBOX
+    per owned SFC cell (dozens of boxes in row-major runs), and every
+    lake row group would otherwise test disjointness against each one.
+    Two boxes merge only when their union is (up to one float ulp) a
+    box: identical y-span and x-ranges that touch, overlap, or are one
+    ulp apart — cell boxes are CLOSED realizations of half-open cells,
+    so adjacent cells sit exactly one ulp apart — then the transpose
+    pass for columns of identical x-span. Closing an ulp seam can only
+    WIDEN the cover, which is always safe for pruning (a row group is
+    dropped only when disjoint from every box; a wider box never drops
+    more). Adjacent cell boxes in a row collapse to one strip, stacked
+    strips to one window."""
+    def _pass(bs, flip):
+        def key(b):
+            return (b[1], b[3], b[0]) if not flip else (b[0], b[2], b[1])
+
+        bs = sorted(bs, key=key)
+        out = [bs[0]]
+        for b in bs[1:]:
+            p = out[-1]
+            if not flip and p[1] == b[1] and p[3] == b[3] \
+                    and b[0] <= np.nextafter(p[2], np.inf):
+                out[-1] = (p[0], p[1], max(p[2], b[2]), p[3])
+            elif flip and p[0] == b[0] and p[2] == b[2] \
+                    and b[1] <= np.nextafter(p[3], np.inf):
+                out[-1] = (p[0], p[1], p[2], max(p[3], b[3]))
+            else:
+                out.append(b)
+        return out
+
+    if len(boxes) < 2:
+        return boxes
+    return _pass(_pass(boxes, flip=False), flip=True)
+
+
 class PartitionedExecutor:
     def __init__(self, store: PartitionedFeatureStore, mesh=None,
                  prefer_device: bool = True, device=None):
@@ -171,8 +210,10 @@ class PartitionedExecutor:
             if fv.disjoint:
                 boxes = []
             elif not fv.is_empty:
-                boxes = [tuple(float(v) for v in g.bounds())
-                         for g in fv.values]
+                boxes = _coalesce_boxes([
+                    tuple(float(v) for v in g.bounds())
+                    for g in fv.values
+                ])
         dtg = ft.dtg_field
         if dtg is not None:
             iv = ir.extract_intervals(plan.filter, dtg)

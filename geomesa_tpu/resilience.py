@@ -303,6 +303,16 @@ def deadline_scope(timeout_s: Optional[float]) -> _DeadlineScope:
     return _DeadlineScope(Deadline.after(timeout_s))
 
 
+def adopt_deadline(deadline: Deadline) -> _DeadlineScope:
+    """Install an EXISTING deadline as this thread's innermost scope —
+    the cross-thread half of deadline propagation (the
+    ``tracing.snapshot``/``adopt`` analog): a worker fanning out on
+    behalf of a query captures ``current_deadline()`` on the caller and
+    re-enters it here, so the same wall-clock budget bounds every
+    branch (fleet scatter dispatch uses this)."""
+    return _DeadlineScope(deadline)
+
+
 def check_deadline(what: str = "query") -> None:
     """Raise :class:`QueryTimeoutError` if the innermost deadline passed.
     Called between per-shard host passes, around device dispatches, and per

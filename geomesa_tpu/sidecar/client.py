@@ -390,6 +390,26 @@ class GeoFlightClient:
         """Re-admit a drained replica to serving."""
         return self._action("undrain")
 
+    def cache_export(self, name: str,
+                     limit: Optional[int] = None) -> Dict:
+        """Warm-handoff source (docs/RESILIENCE.md §7): the replica's
+        hottest current-epoch cache entries for ``name`` (wire-encoded)
+        plus the data guard ``cache_import`` verifies. Served even while
+        the replica is DRAINING — the handoff runs mid-drain."""
+        body: Dict = {"name": name}
+        if limit is not None:
+            body["limit"] = int(limit)
+        return self._action("cache-export", body)
+
+    def cache_import(self, name: str, guard: Dict, entries) -> Dict:
+        """Warm-handoff sink: admit ``cache_export`` entries under the
+        replica's live epoch iff ``guard`` (row count + spec) matches its
+        store — a drained replica's warm cells move to the new ring owner
+        instead of dying with the process."""
+        return self._action("cache-import", {
+            "name": name, "guard": guard, "entries": entries,
+        })
+
     def explain(self, name: str, ecql: str = "INCLUDE") -> str:
         return self._action("explain", {"name": name, "ecql": ecql})["explain"]
 

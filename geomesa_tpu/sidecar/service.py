@@ -833,6 +833,9 @@ class GeoFlightServer(fl.FlightServerBase):
     _ADMIN_ACTIONS = frozenset({
         "drain", "undrain", "replica-status", "version", "metrics",
         "serving-stats", "cache-stats", "device-health", "audit",
+        # a DRAINING replica must still export its hot entries: the warm
+        # handoff runs after drain (docs/RESILIENCE.md §7)
+        "cache-export",
     })
 
     def _speculative_count_frame(self, body: Dict,
@@ -932,6 +935,43 @@ class GeoFlightServer(fl.FlightServerBase):
             # of this sidecar shares it; this is the operator's view of
             # residency + hit rates (docs/CACHE.md)
             return ok({"cache": ds.cache.store.snapshot()})
+        if kind == "cache-export":
+            # warm-handoff source (docs/RESILIENCE.md §7): this replica's
+            # hottest current-epoch entries for one schema, wire-encoded,
+            # plus the data guard the importer must verify. Admin —
+            # exports keep working mid-drain, which is exactly when the
+            # handoff runs.
+            name = body["name"]
+            st = ds._store(name)
+            limit = body.get("limit")
+            epoch, entries = ds.cache.store.export_wire(
+                st.uid, limit=None if limit is None else int(limit)
+            )
+            if epoch is None or epoch != st.version:
+                # the cache predates/outlived this store's state: nothing
+                # here is provably valid to hand off (the persist.py rule)
+                entries = []
+            return ok({
+                "name": name, "entries": entries,
+                "guard": {"count": int(st.count), "spec": st.ft.spec()},
+            })
+        if kind == "cache-import":
+            # warm-handoff sink: admit exported entries under the LIVE
+            # store's current epoch iff the guard proves both replicas
+            # see the same logical data (count + spec — the same check
+            # lake cache restore applies), so normal epoch invalidation
+            # keeps protecting every later mutation.
+            name = body["name"]
+            st = ds._store(name)
+            guard = body.get("guard") or {}
+            if (int(guard.get("count", -1)) != int(st.count)
+                    or guard.get("spec") != st.ft.spec()):
+                return ok({"name": name, "restored": 0,
+                           "skipped": "guard mismatch"})
+            n = ds.cache.store.import_wire(
+                st.uid, st.version, body.get("entries") or []
+            )
+            return ok({"name": name, "restored": n})
         if kind == "serving-stats":
             # queue depth + per-user ledger (docs/SERVING.md; the same
             # rollup /debug/queries exposes)
@@ -1021,6 +1061,11 @@ class GeoFlightServer(fl.FlightServerBase):
             ("drain", "drain this replica: new non-admin requests answer "
                       "[GM-DRAINING] until undrain: {reason}"),
             ("undrain", "re-admit a drained replica to serving"),
+            ("cache-export", "warm-handoff source: hottest current-epoch "
+                             "cache entries + data guard: {name, limit}"),
+            ("cache-import", "warm-handoff sink: admit exported entries "
+                             "under the live epoch iff the guard matches: "
+                             "{name, guard, entries}"),
             ("replica-status", "fleet-replica identity, drain state, and "
                                "per-schema fleet epochs"),
         ]

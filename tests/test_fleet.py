@@ -574,3 +574,289 @@ def test_replica_breaker_fences_dead_replica(root):
     finally:
         router.close()
         servers["rb"].shutdown()
+
+
+# ---------------------------------------------------------------------------
+# scatter-gather for every mergeable aggregate (ISSUE 15)
+
+
+def _oracle_now(root):
+    """A single-process oracle over the root's CURRENT contents — the
+    module-scoped ``oracle`` predates the epoch tests' fleet writes, so
+    scatter bit-identity must compare against a fresh load."""
+    return GeoDataset.load(root, prefer_device=False)
+
+
+WIDE = "BBOX(geom, -44, -27, 44, 27)"
+WIDE_BBOX = (-45.0, -28.0, 45.0, 28.0)
+
+
+def test_scatter_all_kinds_bit_identical(fleet, root):
+    """The tentpole contract: density grids, exact-merge stats, and
+    density-curve windows SCATTER across owner groups and compose
+    bit-identically to the single-process oracle; per-kind scatter
+    counters and the merge histogram record each one."""
+    servers, router = fleet
+    oracle = _oracle_now(root)
+    n0 = router.snapshot()["counters"]["scatter"]
+    m0 = metrics.registry().report()
+
+    grid = router.density("t", WIDE, bbox=WIDE_BBOX, width=64, height=32)
+    want = oracle.density("t", WIDE, bbox=WIDE_BBOX, width=64, height=32)
+    assert np.array_equal(grid, want)
+
+    for spec in ("MinMax(speed)", "Histogram(speed,10,0,30)"):
+        s = router.stats("t", spec, WIDE)
+        assert s.to_json() == oracle.stats("t", spec, WIDE).to_json()
+
+    g1, sn1 = router.density_curve("t", WIDE, level=6, bbox=WIDE_BBOX)
+    g0, sn0 = oracle.density_curve("t", WIDE, level=6, bbox=WIDE_BBOX)
+    assert sn1 == sn0
+    assert np.array_equal(g1, g0)
+
+    assert router.count("t", WIDE) == oracle.count("t", WIDE)
+
+    snap = router.snapshot()
+    assert snap["counters"]["scatter"] >= n0 + 5
+    m1 = metrics.registry().report()
+    for kind in ("density", "stats", "curve", "count"):
+        key = f"fleet.scatter.{kind}"
+        assert m1.get(key, 0) > m0.get(key, 0), key
+    merge_h = m1.get("fleet.scatter.merge_ms")
+    assert merge_h and merge_h["count"] >= 5
+    # per-owner-group survivor rows ride /debug/fleet
+    assert snap["scatter"], "no per-owner scatter rows"
+    assert all(row["skipped_groups"] == 0
+               for row in snap["scatter"].values())
+    # non-mergeable kinds still route whole: weighted density never
+    # scatters (f32 rounding is order-dependent)
+    n1 = snap["counters"]["scatter"]
+    gw = router.density("t", WIDE, bbox=WIDE_BBOX, width=32, height=16,
+                        weight="speed")
+    ww = oracle.density("t", WIDE, bbox=WIDE_BBOX, width=32, height=16,
+                        weight="speed")
+    assert np.array_equal(gw, ww)
+    assert router.snapshot()["counters"]["scatter"] == n1
+
+
+def test_scatter_groups_pinned_to_ring_order(root):
+    """The merge-order regression (ISSUE 15 satellite): owner-group
+    order comes from the RING (sorted member tuple), never from dict
+    insertion or replica registration order — two routers built with
+    the same members in different orders produce IDENTICAL group lists,
+    so the fixed-order merge (and survivor group lists) is deterministic
+    across router restarts."""
+    from geomesa_tpu.filter.ecql import parse_ecql
+    from geomesa_tpu.cache import cells as cellmod
+
+    ft = _oracle_now(root).get_schema("t")
+    decomp = cellmod.decompose(parse_ecql(WIDE), ft)
+    assert decomp is not None and len(decomp.cells) > 1
+    locs = {"ra": "grpc+tcp://127.0.0.1:1", "rb": "grpc+tcp://127.0.0.1:2",
+            "rc": "grpc+tcp://127.0.0.1:3"}
+    r1 = FleetRouter(dict(locs))
+    r2 = FleetRouter({k: locs[k] for k in ("rc", "ra", "rb")})
+    try:
+        g1 = r1._scatter_groups("t", decomp)
+        g2 = r2._scatter_groups("t", decomp)
+        assert isinstance(g1, list) and g1 == g2
+        owners = [o for o, _ in g1]
+        ring_order = [m for m in r1.ring.members if m in set(owners)]
+        assert owners == ring_order
+    finally:
+        r1.close()
+        r2.close()
+
+
+def test_density_scatter_partial_exact_survivor_groups(root):
+    """The chaos gate (ISSUE 15): one owner group of a scattered density
+    failing on EVERY candidate degrades typed with EXACT per-owner-group
+    survivor accounting — the returned grid plus the oracle's grids for
+    the skip records' sub-queries (carried verbatim in ``Skipped.phase``)
+    reconstructs the full raster bit-exactly; strict mode raises
+    ``[GM-FLEET-PARTIAL]`` naming the missing groups. Serial fan-out
+    (fanout=1) pins which group the injected faults land on."""
+    oracle = _oracle_now(root)
+    servers = {rid: _replica(root, rid) for rid in ("ra", "rb")}
+    router = _router(servers)
+    kw = dict(bbox=WIDE_BBOX, width=48, height=24)
+    try:
+        want = oracle.density("t", WIDE, **kw)
+        assert np.array_equal(router.density("t", WIDE, **kw), want)
+        with config.FAULT_INJECTION.scoped("true"), \
+                config.RETRY_ATTEMPTS.scoped("1"), \
+                config.FLEET_SCATTER_FANOUT.scoped("1"), \
+                inject_faults(seed=21) as inj:
+            # fail the FIRST scattered group on its owner AND the only
+            # failover candidate (2 candidates in a 2-replica fleet)
+            inj.fail("sidecar.do_get", times=2)
+            with resilience.deadline_scope(30.0), allow_partial() as p:
+                got = router.density("t", WIDE, **kw)
+        assert p.skipped, "no group was skipped"
+        missing = np.zeros_like(want)
+        for rec in p.skipped:
+            assert "cells[" in rec.part or "strips[" in rec.part
+            missing = missing + oracle.density("t", rec.phase, **kw)
+        assert np.array_equal(got + missing, want)
+        assert not np.array_equal(got, want)  # something really skipped
+        # per-owner-group rows account the skip
+        snap = router.snapshot()
+        assert any(row["skipped_groups"] >= 1
+                   for row in snap["scatter"].values())
+        # strict mode raises typed instead, same accounting
+        with config.FAULT_INJECTION.scoped("true"), \
+                config.RETRY_ATTEMPTS.scoped("1"), \
+                config.FLEET_SCATTER_FANOUT.scoped("1"), \
+                inject_faults(seed=22) as inj:
+            inj.fail("sidecar.do_get", times=2)
+            with resilience.deadline_scope(30.0), \
+                    pytest.raises(FleetPartialError) as ei:
+                router.density("t", WIDE, **kw)
+        err = ei.value
+        assert "[GM-FLEET-PARTIAL]" in str(err)
+        assert err.ok == err.total - len(err.skipped)
+        missing = np.zeros_like(want)
+        for rec in err.skipped:
+            missing = missing + oracle.density("t", rec.phase, **kw)
+        assert np.array_equal(err.value + missing, want)
+    finally:
+        router.close()
+        for srv in servers.values():
+            srv.shutdown()
+
+
+def test_scatter_kill_owner_mid_workload_fails_over(fleet, root):
+    """SIGKILL-shaped loss of one replica under a scattered workload:
+    its owner groups fail over to surviving ring candidates — scattered
+    density/stats stay bit-identical, zero partials, no hang."""
+    servers, router = fleet
+    oracle = _oracle_now(root)
+    kw = dict(bbox=WIDE_BBOX, width=48, height=24)
+    want = oracle.density("t", WIDE, **kw)
+    assert np.array_equal(router.density("t", WIDE, **kw), want)
+    servers.pop("r2").shutdown()
+    with resilience.deadline_scope(30.0):
+        got = router.density("t", WIDE, **kw)
+        s = router.stats("t", "MinMax(speed)", WIDE)
+    assert np.array_equal(got, want)
+    assert s.to_json() == oracle.stats("t", "MinMax(speed)", WIDE).to_json()
+    assert router.snapshot()["counters"]["partial"] == 0
+
+
+# ---------------------------------------------------------------------------
+# dynamic membership + warm handoff + auto-uncordon (ISSUE 15)
+# ---------------------------------------------------------------------------
+
+
+def test_register_replica_runtime_join(root):
+    """A replica joining at RUNTIME (identity learned from the gossip
+    headers) starts receiving its ring share without a router restart."""
+    oracle = _oracle_now(root)
+    servers = {rid: _replica(root, rid) for rid in ("ra", "rb")}
+    router = FleetRouter({
+        "ra": f"grpc+tcp://127.0.0.1:{servers['ra'].port}"
+    })
+    try:
+        assert router.count("t", VIEWPORTS[0]) == oracle.count(
+            "t", VIEWPORTS[0])
+        rid = router.register_replica(
+            f"grpc+tcp://127.0.0.1:{servers['rb'].port}"
+        )
+        assert rid == "rb"
+        assert "rb" in router.ring.members
+        assert "rb" in router.registry.members()
+        # the joiner owns ITS HRW share of the key space immediately
+        keys = [f"t:z3:{i}" for i in range(64)]
+        assert any(router.ring.owner(k) == "rb" for k in keys)
+        # routed traffic reaches it (route a count pinned to rb)
+        n, served = router._call("t", "k", "count",
+                                 lambda c: c.count("t", VIEWPORTS[0]),
+                                 owners=["rb", "ra"])
+        assert served == "rb"
+        assert n == oracle.count("t", VIEWPORTS[0])
+        assert router.snapshot()["counters"]["joined"] == 1
+    finally:
+        router.close()
+        for srv in servers.values():
+            srv.shutdown()
+
+
+def test_deregister_warm_handoff_new_owner_serves_from_cache(
+        root, monkeypatch):
+    """The acceptance gate (ISSUE 15): a warm-handoff drain pushes the
+    leaver's hottest entries to the new ring owners — the new owner
+    answers the drained replica's hottest viewport FROM CACHE (zero
+    scans: cache.hit increments, cache.miss does not)."""
+    monkeypatch.setenv("GEOMESA_CACHE_ENABLED", "true")
+    oracle = _oracle_now(root)
+    servers = {rid: _replica(root, rid) for rid in ("ra", "rb", "rc")}
+    router = _router(servers)
+    try:
+        vp = VIEWPORTS[0]
+        f, ft = router._parse("t", vp)
+        owner = router.ring.owner(router._affinity_key("t", f, ft))
+        with config.FLEET_SCATTER.scoped("false"):
+            want = router.count("t", vp)  # warms the owner's cache
+            out = router.deregister_replica(owner, handoff=True)
+            assert out["handoff"]["t"]["restored"] >= 1
+            assert owner not in router.ring.members
+            new_owner = router.ring.owner(router._affinity_key("t", f, ft))
+            c = router._client(new_owner)
+            # the in-process replicas share one metrics registry with
+            # any LOCAL dataset: keep the oracle's own count outside the
+            # measurement window
+            assert want == oracle.count("t", vp)
+            m0 = c.metrics()
+            assert router.count("t", vp) == want
+            m1 = c.metrics()
+        assert m1.get("cache.hit", 0) - m0.get("cache.hit", 0) >= 1, \
+            "new owner did not serve the handed-off viewport from cache"
+        assert m1.get("cache.miss", 0) == m0.get("cache.miss", 0), \
+            "new owner paid a scan despite the warm handoff"
+        assert router.snapshot()["counters"]["left"] == 1
+    finally:
+        router.close()
+        for srv in servers.values():
+            try:
+                srv.shutdown()
+            except Exception:
+                pass
+
+
+def test_auto_uncordon_after_k_successful_probes(fleet):
+    """ISSUE 15 satellite: a router-side cordon clears after K
+    consecutive successful probes (geomesa.fleet.uncordon.probes), with
+    the fleet.uncordon counter bumped; a failed probe resets the streak;
+    config-list cordons (geomesa.fleet.cordon) never auto-clear."""
+    servers, router = fleet
+    m0 = metrics.registry().report().get("fleet.uncordon", 0)
+    # successes BEFORE the cordon must not pre-pay the exit: only probes
+    # made while cordoned count toward the streak
+    for _ in range(3):
+        assert router.probe("r2")["ok"]
+    router.cordon("r2", reason="flapping")
+    assert router.registry.state("r2") == "cordoned"
+    with config.FLEET_UNCORDON_PROBES.scoped("3"):
+        router.probe("r2")
+        router.probe("r2")
+        assert router.registry.state("r2") == "cordoned"  # streak 2 < 3
+        out = router.probe("r2")
+    assert out.get("uncordoned") is True
+    assert router.registry.state("r2") == "ok"
+    assert metrics.registry().report().get("fleet.uncordon", 0) == m0 + 1
+    assert router.snapshot()["counters"]["uncordoned"] == 1
+    # a failed probe resets the streak
+    router.cordon("r2", reason="again")
+    with config.FLEET_UNCORDON_PROBES.scoped("2"):
+        router.probe("r2")
+        router.registry.note_probe("r2", False)  # the reset
+        router.probe("r2")
+        assert router.registry.state("r2") == "cordoned"
+        router.probe("r2")
+    assert router.registry.state("r2") == "ok"
+    # config-list cordons stay operator-owned
+    with config.FLEET_CORDON.scoped("r3"), \
+            config.FLEET_UNCORDON_PROBES.scoped("1"):
+        assert router.registry.state("r3") == "cordoned"
+        router.probe("r3")
+        assert router.registry.state("r3") == "cordoned"

@@ -486,3 +486,42 @@ def test_fs_root_breaker_fences_and_heals(tmp_path, monkeypatch):
             assert fs.read_partition("t", part) is not None
     finally:
         resilience.reset_breakers()
+
+
+def test_push_window_coalesces_scatter_group_boxes(tmp_path):
+    """Group-scoped plan bounds (ISSUE 15): a fleet-scattered sub-query
+    carries one BBOX per owned cell (an OR of exactly-tiling half-open
+    realizations) — the pushdown window coalesces the runs into a
+    compact cover (never narrower: closing the one-ulp seams only
+    widens), and the pruned scan stays bit-identical."""
+    from geomesa_tpu.planning.partitioned_exec import _coalesce_boxes
+
+    def prev(v):
+        return float(np.nextafter(v, -np.inf))
+
+    # a 4x2 run of half-open cell realizations (the decompose shape)
+    cells = []
+    for iy in range(2):
+        for ix in range(4):
+            x0, y0 = ix * 11.25, iy * 11.25
+            cells.append((x0, y0, prev(x0 + 11.25), prev(y0 + 11.25)))
+    out = _coalesce_boxes(list(cells))
+    assert len(out) == 1
+    x0, y0, x1, y1 = out[0]
+    for b in cells:  # cover, never narrower
+        assert x0 <= b[0] and y0 <= b[1] and x1 >= b[2] and y1 >= b[3]
+    # disjoint islands stay separate
+    assert len(_coalesce_boxes([(0, 0, 1, 1), (5, 5, 6, 6)])) == 2
+    # and a scatter-shaped OR filter over the lake prunes bit-identically
+    n = 24_000
+    lake, _lst = _mkpart(tmp_path, n=n, clustered=True, lake=True,
+                         rowgroup=384)
+    npz, _ = _mkpart(tmp_path, n=n, clustered=True, lake=False)
+    ors = " OR ".join(
+        f"BBOX(geom, {x}, 10.0, {prev(x + 11.25)}, {prev(21.25)})"
+        for x in (-45.0, -33.75, -22.5)
+    )
+    q = f"(name <> 'zz') AND ({ors})"
+    with config.LAKE_ENABLED.scoped("true"):
+        got = lake.count("t", q)
+    assert got == npz.count("t", q)
