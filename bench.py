@@ -636,11 +636,172 @@ def run_fleet():
     print(json.dumps(out))
 
 
+def run_crash():
+    """``--crash``: the CI crash-durability harness (docs/RESILIENCE.md
+    §8) — gating the journal's three promises on the forced-CPU backend:
+    (1) ``journal_acked_lost == 0`` — a writer subprocess is SIGKILLed
+    mid-ingest and every insert it acked (journal append returned) must
+    survive recovery; (2) ``journal_insert_overhead_pct`` — group-commit
+    durability stays within budget of the non-durable insert path under
+    the design-point load of a few concurrent writers (the commit
+    leader's fsync releases the GIL, so followers encode while it
+    syncs and ride the next leader's batch); (3)
+    ``journal_recovery_ms`` — replay cost of an un-checkpointed tail.
+    One JSON line, like --chaos."""
+    import shutil
+    import subprocess
+    import tempfile
+    import threading
+
+    _arm_watchdog()
+    _force_cpu(int(os.environ.get("GEOMESA_BENCH_DEVICES", 8)))
+    here = os.path.dirname(os.path.abspath(__file__))
+    sys.path.insert(0, here)
+    from geomesa_tpu import GeoDataset
+    from geomesa_tpu.filter.ecql import parse_iso_ms
+
+    seed = int(os.environ.get("GEOMESA_BENCH_CRASH_SEED", 42))
+    n = int(os.environ.get("GEOMESA_BENCH_N", 131_072))
+    batch = 4_096
+    writers = int(os.environ.get("GEOMESA_BENCH_CRASH_WRITERS", 4))
+    lo = parse_iso_ms("2020-01-01")
+    hi = parse_iso_ms("2020-03-01")
+    spec = "name:String,weight:Float,dtg:Date,*geom:Point"
+    schemas = [f"t{w}" for w in range(writers)]
+
+    def _batches(w, nw):
+        rng = np.random.default_rng(seed + w)
+        for s in range(0, nw, batch):
+            m = min(batch, nw - s)
+            yield {
+                "name": [f"w{w}r{s + i}" for i in range(m)],
+                "weight": rng.uniform(0, 1, m).astype(np.float32),
+                "dtg": rng.integers(lo, hi, m).astype("datetime64[ms]"),
+                "geom__x": rng.uniform(-125, -66, m),
+                "geom__y": rng.uniform(24, 49, m),
+            }
+
+    def _ingest(journal_root):
+        # one writer thread per schema (insert touches only per-schema
+        # store state; the journal itself is thread-safe) — identical
+        # shape for the plain and journaled runs, so the delta is pure
+        # durability cost
+        ds = GeoDataset(prefer_device=False)
+        if journal_root is not None:
+            ds.attach_journal(journal_root)
+        for nm in schemas:
+            ds.create_schema(nm, spec)
+        errs = []
+
+        def _writer(w):
+            try:
+                for data in _batches(w, n // writers):
+                    ds.insert(schemas[w], data)
+            except BaseException as e:  # surface, don't hang the join
+                errs.append(e)
+
+        t0 = time.time()
+        ts = [threading.Thread(target=_writer, args=(w,))
+              for w in range(writers)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        ds.flush()
+        if errs:
+            raise errs[0]
+        return ds, time.time() - t0
+
+    work = tempfile.mkdtemp(prefix="gm-crash-")
+    try:
+        # (2) insert overhead: non-durable baseline vs journaled (warmup
+        # pass first so jit/alloc costs don't ride either side)
+        _ingest(None)
+        _, t_plain = _ingest(None)
+        jroot = os.path.join(work, "journaled")
+        os.makedirs(jroot)
+        ds_j, t_journal = _ingest(jroot)
+        overhead_pct = (t_journal - t_plain) / t_plain * 100.0
+
+        # (3) recovery: load the root with its whole ingest un-checkpointed
+        t0 = time.time()
+        ds_r = GeoDataset.load(jroot, prefer_device=False)
+        recovery_ms = (time.time() - t0) * 1000.0
+        replayed = ds_r._journal_replayed
+        assert sum(ds_r.count(nm) for nm in schemas) == \
+            sum(ds_j.count(nm) for nm in schemas), "recovery lost rows"
+
+        # (1) SIGKILL a writer subprocess mid-ingest; every acked insert
+        # must survive recovery (ack = the mutation call returned)
+        kroot = os.path.join(work, "killed")
+        os.makedirs(kroot)
+        child_src = (
+            "import os, sys\n"
+            f"sys.path.insert(0, {here!r})\n"
+            "os.environ.setdefault('JAX_PLATFORMS', 'cpu')\n"
+            "import numpy as np\n"
+            "from geomesa_tpu import GeoDataset\n"
+            f"root = {kroot!r}\n"
+            "ds = GeoDataset(prefer_device=False)\n"
+            "ds.attach_journal(root)\n"
+            "ds.create_schema('t', "
+            f"{spec!r})\n"
+            "ack = open(os.path.join(root, 'acked.log'), 'a')\n"
+            "i = 0\n"
+            "print('READY', flush=True)\n"
+            "while True:\n"
+            "    ds.insert('t', {'name': [f'k{i}'], 'weight': [0.5],\n"
+            "                    'dtg': np.array([1577836800000],\n"
+            "                                    'datetime64[ms]'),\n"
+            "                    'geom__x': [0.0], 'geom__y': [0.0]})\n"
+            "    ack.write(f'k{i}\\n'); ack.flush()\n"
+            "    os.fsync(ack.fileno())\n"
+            "    i += 1\n"
+        )
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-c", child_src], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        )
+        assert proc.stdout.readline().strip() == "READY"
+        time.sleep(2.0)  # let it ack a pile of inserts
+        proc.kill()
+        proc.wait()
+        with open(os.path.join(kroot, "acked.log")) as fh:
+            acked = set(fh.read().split())
+        ds_k = GeoDataset.load(kroot, prefer_device=False)
+        got = set(
+            "" if v is None else str(v)
+            for v in ds_k.to_arrow("t").column("name").to_pylist()
+        )
+        lost = sorted(acked - got)
+        assert not lost, f"SIGKILL lost {len(lost)} acked inserts: {lost[:5]}"
+    finally:
+        shutil.rmtree(work, ignore_errors=True)
+
+    print(json.dumps({
+        "metric": "crash_suite",
+        "crash": True,
+        "seed": seed,
+        "n_rows": n,
+        "journal_insert_overhead_pct": round(overhead_pct, 1),
+        "journal_recovery_ms": round(recovery_ms, 1),
+        "journal_replayed_records": int(replayed),
+        "journal_acked_lost": len(lost),
+        "killed_acked_inserts": len(acked),
+        "killed_recovered_inserts": len(got),
+        "device_unreachable": True,
+        "probe_skipped": True,
+    }))
+
+
 def main():
     if "--chaos" in sys.argv[1:]:
         return run_chaos()
     if "--fleet" in sys.argv[1:]:
         return run_fleet()
+    if "--crash" in sys.argv[1:]:
+        return run_crash()
     smoke = "--smoke" in sys.argv[1:]
     n = int(os.environ.get("GEOMESA_BENCH_N", 200_000 if smoke else 20_000_000))
     iters = int(os.environ.get("GEOMESA_BENCH_ITERS", 2 if smoke else 10))

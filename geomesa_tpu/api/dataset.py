@@ -13,17 +13,20 @@ pushdown scans.
 
 from __future__ import annotations
 
+import contextlib
 import functools
 import json
 import os
 import shutil
 import time
+import uuid
+import zlib
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from geomesa_tpu import config, metrics, security, tracing
+from geomesa_tpu import config, metrics, resilience, security, tracing
 from geomesa_tpu.audit import AuditWriter
 from geomesa_tpu.cache import AggregateCache
 from geomesa_tpu.filter import ir, parse_ecql
@@ -240,6 +243,27 @@ class GeoDataset:
         self._stores: Dict[str, FeatureStore] = {}
         self._executors: Dict[str, Executor] = {}
         self.metadata: Dict[str, Dict[str, str]] = {}
+        #: durable mutation journal (fs/journal.py; docs/RESILIENCE.md §8).
+        #: Attached by load()/attach_journal(); None keeps the
+        #: in-memory-only semantics (acked mutations live until the next
+        #: explicit save). With it attached, every mutation edge appends a
+        #: typed record BEFORE applying and blocks until it is on disk.
+        self._journal = None
+        #: replay guard: mutations applied FROM the journal or a checkpoint
+        #: attach must not re-journal themselves
+        self._replaying = False
+        #: per-schema high-water mark of journal records applied locally —
+        #: lets a fleet replica catch up incrementally from the shared
+        #: journal instead of re-attaching the whole schema snapshot
+        self._applied_seq: Dict[str, int] = {}
+        #: fingerprint of the manifest entry each schema was attached from —
+        #: the incremental journal catch-up is only valid while the root's
+        #: manifest entry is unchanged (journal-only growth); an entry
+        #: rewritten out-of-band (e.g. a non-journaled save) forces the
+        #: full re-attach path
+        self._ckpt_fp: Dict[str, int] = {}
+        #: records re-applied by the last load()/replay (CLI/bench surface)
+        self._journal_replayed = 0
 
     # -- schema CRUD (MetadataBackedDataStore analog) ----------------------
     def create_schema(self, name_or_ft, spec: Optional[str] = None) -> FeatureType:
@@ -249,6 +273,11 @@ class GeoDataset:
             ft = FeatureType.from_spec(name_or_ft, spec)
         if ft.name in self._stores:
             raise ValueError(f"schema {ft.name!r} already exists")
+        # schema-create records carry the spec so recovery is self-contained
+        # (a schema created after the last checkpoint rebuilds from the
+        # journal alone)
+        self._journal_rec("schema-create", ft.name, spec=ft.spec(),
+                          n_shards=self.n_shards)
         from geomesa_tpu.index.partitioned import (
             PartitionedFeatureStore, is_partitioned_schema,
         )
@@ -268,11 +297,17 @@ class GeoDataset:
 
     def delete_schema(self, name: str):
         st = self._store(name)  # raise if missing
+        # tombstone FIRST: if we crash between the in-memory drop and the
+        # next checkpoint, replay must not resurrect the schema from its
+        # still-on-disk files
+        self._journal_rec("delete-schema", name)
         # drop the schema's cached aggregates: its uid is never accessed
         # again, so neither epoch sync nor the per-uid LRU could reclaim them
         self.cache.store.invalidate(st.uid)
         del self._stores[name]
         del self.metadata[name]
+        self._applied_seq.pop(name, None)
+        self._ckpt_fp.pop(name, None)
 
     def describe(self, name: str) -> str:
         st = self._store(name)
@@ -288,6 +323,121 @@ class GeoDataset:
             )
         return st
 
+    # -- durable mutation journal (docs/RESILIENCE.md §8) ------------------
+    def attach_journal(self, path: str):
+        """Attach (or create) the write-ahead mutation journal under
+        ``path``: from here on, every mutation edge appends a typed,
+        crc-framed record and blocks until it is group-committed to disk —
+        **ack = durable**. ``load()`` attaches automatically when the root
+        has a journal; ``save()`` attaches on first checkpoint. No-op when
+        ``geomesa.journal.enabled`` is false or a journal is already
+        attached. Returns the journal (or None when disabled)."""
+        if not config.JOURNAL_ENABLED.to_bool():
+            return None
+        if self._journal is None:
+            from geomesa_tpu.fs.journal import MutationJournal
+
+            self._journal = MutationJournal(path)
+        return self._journal
+
+    @contextlib.contextmanager
+    def _replay_scope(self):
+        prev = self._replaying
+        self._replaying = True
+        try:
+            yield
+        finally:
+            self._replaying = prev
+
+    def _journal_rec(self, kind: str, name: Optional[str],
+                     blobs=None, **payload) -> None:
+        """Append one typed mutation record (WAL discipline: BEFORE the
+        mutation applies) and block until durable. A journal failure
+        raises — the mutation is never acked non-durable. ``blobs`` is
+        the raw-bytes sink filled by the caller's enc_columns pass."""
+        j = self._journal
+        if j is None or self._replaying:
+            return
+        rec = {"kind": kind, "schema": name}
+        rec.update(payload)
+        seq = j.append(rec, blobs=blobs)
+        if name is not None:
+            self._applied_seq[name] = seq
+
+    def _apply_record(self, rec: Dict[str, Any]) -> bool:
+        """Re-apply one journal record through the normal mutation edges
+        (under :meth:`_replay_scope`, so nothing re-journals). Returns
+        False for unknown kinds."""
+        from geomesa_tpu.fs import journal as _jr
+
+        kind, name = rec.get("kind"), rec.get("schema")
+        if kind == "schema-create":
+            prev = self.n_shards
+            self.n_shards = rec.get("n_shards", prev)
+            try:
+                self.create_schema(FeatureType.from_spec(name, rec["spec"]))
+            finally:
+                self.n_shards = prev
+        elif kind == "delete-schema":
+            # tombstone: replay must never resurrect a dropped schema whose
+            # files outlived the crash
+            if name in self._stores:
+                self.delete_schema(name)
+                self._plan_cache_clear(name)
+                self._drop_executors(name)
+        elif kind == "insert":
+            self.insert(name, _jr.dec_columns(rec["data"]),
+                        _jr.dec_value(rec.get("fids")),
+                        _jr.dec_value(rec.get("vis")))
+        elif kind == "delete-features":
+            self.delete_features(name, rec["ecql"],
+                                 _jr.dec_value(rec.get("auths")))
+        elif kind == "update-schema":
+            self.update_schema(name, rec["add_spec"])
+        elif kind == "age-off":
+            self.age_off(name, int(rec["older_than_ms"]))
+        elif kind == "add-index":
+            self.add_attribute_index(name, rec["attr"])
+        elif kind == "remove-index":
+            self.remove_attribute_index(name, rec["attr"])
+        else:
+            return False
+        return True
+
+    def _journal_replay(self, ckpt_seq: Dict[str, int],
+                        schema: Optional[str] = None,
+                        truncate: bool = False) -> int:
+        """Replay journal records past each schema's checkpointed position
+        (``ckpt_seq``), in global sequence order. A record that fails to
+        apply is recorded through the degradation trail and skipped — a
+        poisoned record must not fail the whole root. Returns #applied."""
+        j = self._journal
+        if j is None:
+            return 0
+        applied = 0
+        with self._replay_scope():
+            for rec in j.records(schema=schema, truncate=truncate):
+                name = rec.get("schema")
+                seq = int(rec.get("seq", 0))
+                if seq <= ckpt_seq.get(name, 0):
+                    continue
+                if seq <= self._applied_seq.get(name, 0):
+                    continue  # already applied live / by a prior replay
+                try:
+                    if not self._apply_record(rec):
+                        continue
+                except Exception as e:
+                    resilience.record_skip(
+                        "journal.replay", f"{name}@{seq}", e, phase="apply")
+                    continue
+                if name is not None:
+                    self._applied_seq[name] = seq
+                applied += 1
+        if applied:
+            metrics.registry().counter(metrics.JOURNAL_REPLAYED).inc(applied)
+        self._journal_replayed = applied
+        return applied
+
     # -- writes ------------------------------------------------------------
     def insert(self, name: str, data: Dict[str, Any], fids=None,
                visibilities=None) -> int:
@@ -295,7 +445,18 @@ class GeoDataset:
 
         ``visibilities``: per-feature visibility expression(s) (one string or
         a sequence), enforced at query time against ``Query.auths``."""
-        n = self._store(name).append(data, fids, visibilities)
+        st = self._store(name)
+        if self._journal is not None and not self._replaying:
+            from geomesa_tpu.fs import journal as _jr
+
+            sink: list = []
+            self._journal_rec(
+                "insert", name, blobs=sink,
+                data=_jr.enc_columns(data, sink),
+                fids=None if fids is None else _jr.enc_value(fids, sink),
+                vis=None if visibilities is None
+                else _jr.enc_value(visibilities, sink))
+        n = st.append(data, fids, visibilities)
         metrics.registry().counter("ingest.features").inc(n)
         return n
 
@@ -345,6 +506,7 @@ class GeoDataset:
         for a in added:
             if a.is_geom:
                 raise ValueError("cannot add geometry attributes to a schema")
+        self._journal_rec("update-schema", name, add_spec=add_spec)
         st.add_columns(new_ft, added)
         self._drop_executors(name)
         self._plan_cache_clear(name)
@@ -360,6 +522,7 @@ class GeoDataset:
         (GeoMesaDataStore.scala:288-336)."""
         st = self._store(name)
         a = st.ft.attr(attr)
+        self._journal_rec("add-index", name, attr=attr)
         st.add_attribute_index(attr)
         a.options["index"] = "true"  # so spec()/save()/load round-trips
         # an explicit geomesa.indices list overrides the option-derived
@@ -378,6 +541,7 @@ class GeoDataset:
     def remove_attribute_index(self, name: str, attr: str) -> None:
         """Drop an attribute index (permutation + sketch); data untouched."""
         st = self._store(name)
+        self._journal_rec("remove-index", name, attr=attr)
         st.remove_attribute_index(attr)
         st.ft.attr(attr).options.pop("index", None)
         self._drop_executors(name)
@@ -400,6 +564,9 @@ class GeoDataset:
             cutoff = int(older_than.astype("datetime64[ms]").astype(np.int64))
         else:
             cutoff = int(older_than)
+        # the RESOLVED cutoff is journaled, so replay is deterministic even
+        # for callers that passed a relative/now-derived value
+        self._journal_rec("age-off", name, older_than_ms=cutoff)
         st.flush()
         return st.delete(lambda cols: cols[dtg] < cutoff)
 
@@ -409,6 +576,8 @@ class GeoDataset:
         delete rows their auths permit them to see."""
         st = self._store(name)
         f = parse_ecql(ecql)
+        self._journal_rec("delete-features", name, ecql=ecql,
+                          auths=None if auths is None else list(auths))
         from geomesa_tpu.filter.compile import compile_filter
 
         cf = compile_filter(f, st.ft, st.dicts)
@@ -2221,7 +2390,6 @@ class GeoDataset:
         rows and leaves every existing chunk file untouched. Deletes /
         column adds change the epoch and force a full rewrite."""
         n = st._all.n if st._all is not None else 0
-        cdir = os.path.join(path, f"{name}_chunks")
         prev = prev_entry.get("chunks") if prev_entry else None
         incremental = (
             prev is not None
@@ -2230,23 +2398,33 @@ class GeoDataset:
             and all(os.path.exists(os.path.join(path, f)) for f in prev)
         )
         if not incremental:
-            if os.path.isdir(cdir):
-                shutil.rmtree(cdir)
-            legacy = os.path.join(path, f"{name}.npz")  # v1 layout
-            if os.path.exists(legacy):
-                os.remove(legacy)
             chunks, lo = [], 0
         else:
             chunks, lo = list(prev), int(prev_entry["rows"])
-        os.makedirs(cdir, exist_ok=True)
+        cdir_rel = f"{name}_chunks"
+        os.makedirs(os.path.join(path, cdir_rel), exist_ok=True)
         if n > lo:
-            fname = f"{name}_chunks/chunk-{len(chunks):05d}-{lo}-{n}.npz"
+            # uuid-suffixed chunk name: a full rewrite NEVER overwrites a
+            # chunk the previous (still-live) manifest references — every
+            # old file stays untouched until the new manifest is durably
+            # published, so a crash at any point mid-save leaves the old
+            # checkpoint + its files fully consistent (and the journal
+            # still holds everything past it). save() sweeps the
+            # unreferenced files after the manifest replace; legacy v1
+            # ``{name}.npz`` files sweep the same way.
+            fname = (f"{cdir_rel}/chunk-{len(chunks):05d}-{lo}-{n}"
+                     f"-{uuid.uuid4().hex[:8]}.npz")
+            resilience.fault_point("fs.save.chunk", schema=name, file=fname)
             cols = {
                 k: (v[lo:n].astype("U") if v.dtype.kind == "O"
                     else v[lo:n])
                 for k, v in st._all.columns.items()
             }
-            np.savez_compressed(os.path.join(path, fname), **cols)
+            with open(os.path.join(path, fname), "wb") as fh:
+                np.savez_compressed(fh, **cols)
+                fh.flush()
+                os.fsync(fh.fileno())
+            resilience.fsync_dir(os.path.join(path, cdir_rel))
             chunks.append(fname)
         return {"chunks": chunks, "rows": n, "epoch": st.mutation_epoch}
 
@@ -2278,7 +2456,16 @@ class GeoDataset:
         VERBATIM from the existing checkpoint, so a fleet write commit
         (docs/RESILIENCE.md §7) costs the mutated schema, not the whole
         dataset. A named schema that no longer exists locally is REMOVED
-        from the manifest (the delete path)."""
+        from the manifest (the delete path).
+
+        With the journal attached (docs/RESILIENCE.md §8), save is the
+        CHECKPOINT, not the commit: each saved schema's entry is stamped
+        with the journal position it captures (``journal_seq``), the
+        manifest publishes durably (tmp + fsync + rename + dir fsync),
+        and journal segments every schema has checkpointed past are
+        truncated. Attachment stays explicit (attach_journal / load) —
+        saving to a scratch path must not bind this dataset's
+        durability to it."""
         from geomesa_tpu.index.partitioned import PartitionedFeatureStore
 
         os.makedirs(path, exist_ok=True)
@@ -2287,6 +2474,10 @@ class GeoDataset:
         if os.path.exists(mpath):
             with open(mpath) as fh:
                 prev_manifest = json.load(fh).get("schemas", {})
+        j = self._journal
+        if j is not None and os.path.abspath(j.root) != os.path.abspath(path):
+            j = None  # saving elsewhere must not stamp/truncate OUR journal
+        jpos = j.last_seq() if j is not None else None
         manifest = {"version": 2, "schemas": {}}
         if names is not None:
             keep = set(names)
@@ -2303,6 +2494,8 @@ class GeoDataset:
                 "dicts": {k: d.to_list() for k, d in st.dicts.items()},
                 "stats": {k: v.to_json() for k, v in st.stats.items()},
             }
+            if jpos is not None:
+                entry["journal_seq"] = jpos
             if isinstance(st, PartitionedFeatureStore):
                 # incremental: only dirty partitions rewrite their snapshot
                 parts = st.checkpoint_into(os.path.join(path, f"{name}_parts"))
@@ -2313,28 +2506,127 @@ class GeoDataset:
                 entry.update(self._save_flat_chunks(
                     path, name, st, prev_manifest.get(name)))
             manifest["schemas"][name] = entry
-        with open(mpath, "w") as fh:
-            json.dump(manifest, fh, indent=2)
+            # our own checkpoint moved the entry; record it so the next
+            # refresh_schema against this root stays incremental
+            self._ckpt_fp[name] = self._entry_fp(entry)
+        resilience.fault_point("fs.save.manifest", path=mpath)
+        resilience.durable_write_json(mpath, manifest, indent=2)
+        self._sweep_orphan_chunks(path, manifest["schemas"], names)
+        if j is not None:
+            # truncate segments every schema in the (new) manifest has
+            # checkpointed past; a carried-over entry without a stamp pins
+            # the whole journal (safe: replay is idempotent-ordered)
+            resilience.fault_point("journal.checkpoint", root=path)
+            upto = min((int(e.get("journal_seq", 0))
+                        for e in manifest["schemas"].values()),
+                       default=jpos)
+            j.checkpoint(min(upto, jpos))
+            for name in list(self._applied_seq):
+                if names is None or name in set(names):
+                    self._applied_seq[name] = max(
+                        self._applied_seq.get(name, 0), jpos)
+
+    def _sweep_orphan_chunks(self, path: str, schemas: Dict[str, Any],
+                             names: Optional[Sequence[str]]) -> None:
+        """Remove chunk dirs / legacy npz no longer referenced by the
+        just-published manifest — the deferred half of the crash-consistent
+        save (old files outlive the save until the new manifest is durable;
+        only then do they become sweepable orphans). Restricted to the
+        schemas this save touched."""
+        ref_dirs = set()
+        ref_files = set()
+        for entry in schemas.values():
+            for rel in entry.get("chunks") or []:
+                ref_files.add(rel)
+                d = os.path.dirname(rel)
+                if d:
+                    ref_dirs.add(d)
+        swept = set(self._stores) if names is None else set(names)
+        try:
+            listing = os.listdir(path)
+        except OSError:
+            return
+        for name in swept:
+            for fn in listing:
+                full = os.path.join(path, fn)
+                if fn.startswith(f"{name}_chunks") and os.path.isdir(full):
+                    if fn not in ref_dirs:
+                        shutil.rmtree(full, ignore_errors=True)
+                        continue
+                    # referenced dir: sweep the chunk FILES a full rewrite
+                    # orphaned (uuid-named, so the live ones were never
+                    # overwritten)
+                    for cf in os.listdir(full):
+                        if f"{fn}/{cf}" not in ref_files:
+                            try:
+                                os.remove(os.path.join(full, cf))
+                            except OSError:
+                                pass
+                elif fn == f"{name}.npz" and fn not in ref_files:
+                    entry = schemas.get(name)
+                    # a carried-over v1 entry without "chunks" still loads
+                    # through the npz fallback — never sweep that
+                    if entry is not None and not entry.get("chunks"):
+                        continue
+                    try:
+                        os.remove(full)
+                    except OSError:
+                        pass
 
     @staticmethod
     def load(path: str, mesh=None, prefer_device: bool = True) -> "GeoDataset":
-        with open(os.path.join(path, "manifest.json")) as fh:
-            manifest = json.load(fh)
+        from geomesa_tpu.fs import journal as _jr
+
+        mpath = os.path.join(path, "manifest.json")
+        has_journal = (config.JOURNAL_ENABLED.to_bool()
+                       and _jr.journal_exists(path))
+        manifest: Dict[str, Any] = {"schemas": {}}
+        if os.path.exists(mpath):
+            with open(mpath) as fh:
+                manifest = json.load(fh)
+        elif not has_journal:
+            # keep the pre-journal contract: loading a root with neither a
+            # manifest nor a journal is an error
+            with open(mpath) as fh:  # raises FileNotFoundError
+                manifest = json.load(fh)
         ds = GeoDataset(mesh=mesh, prefer_device=prefer_device)
+        ckpt: Dict[str, int] = {}
         for name, meta in manifest["schemas"].items():
             ds._attach_schema_entry(path, name, meta)
+            ckpt[name] = int(meta.get("journal_seq", 0))
+            ds._applied_seq[name] = ckpt[name]
         ds.n_shards = None
+        if config.JOURNAL_ENABLED.to_bool():
+            ds.attach_journal(path)
+            if has_journal:
+                # recovery: re-apply records past each schema's checkpointed
+                # position, in order; torn tails truncate cleanly here
+                if ds._journal_replay(ckpt, truncate=True):
+                    ds.flush()
         return ds
+
+    @staticmethod
+    def _entry_fp(meta: Dict) -> int:
+        """Fingerprint of a manifest schema entry, stable across the JSON
+        round trip — what :meth:`refresh_schema` compares to decide whether
+        the root's checkpoint moved underneath the journal."""
+        return zlib.crc32(json.dumps(
+            meta, sort_keys=True, separators=(",", ":"),
+            default=str).encode()) & 0xFFFFFFFF
 
     def _attach_schema_entry(self, path: str, name: str, meta: Dict) -> None:
         """Create + populate ONE schema's store from a checkpoint manifest
         entry (the per-schema half of :meth:`load`; also the fleet epoch
         refresh path — docs/RESILIENCE.md §7)."""
+        self._ckpt_fp[name] = self._entry_fp(meta)
         prev_shards = self.n_shards
         ft = FeatureType.from_spec(name, meta["spec"])
         self.n_shards = meta["n_shards"]
         try:
-            self.create_schema(ft)
+            # attaching FROM a checkpoint is not a new mutation: it must
+            # not journal a schema-create record
+            with self._replay_scope():
+                self.create_schema(ft)
         finally:
             self.n_shards = prev_shards
         st = self._store(name)
@@ -2406,10 +2698,50 @@ class GeoDataset:
                 schemas = json.load(fh).get("schemas", {})
         meta = schemas.get(name)
         old = self._stores.get(name)
+        j = self._journal
+        use_journal = (
+            j is not None
+            and os.path.abspath(j.root) == os.path.abspath(path)
+        )
+        ckpt = int(meta.get("journal_seq", 0)) if meta is not None else 0
+        if use_journal:
+            have = self._applied_seq.get(name)
+            # the incremental shortcut is valid only while the root's
+            # manifest entry is the one we attached (journal-only growth):
+            # an entry rewritten out-of-band — a writer checkpointing
+            # without the journal — must force the full re-attach below
+            # or the rewrite is never observed
+            unmoved = (meta is None
+                       or self._ckpt_fp.get(name) == self._entry_fp(meta))
+            if old is not None and have is not None and have >= ckpt \
+                    and unmoved:
+                # incremental catch-up (docs/RESILIENCE.md §8): this replica
+                # already holds the schema at journal position ``have`` —
+                # re-apply only the shared journal's records past it. A
+                # one-row fleet insert costs one record here, never a full
+                # schema re-attach; version bumps invalidate covers exactly
+                # like a local mutation.
+                applied = self._journal_replay({name: have}, schema=name)
+                if applied and name in self._stores:
+                    self.flush(name)
+                return applied > 0
+            if meta is None:
+                # schema not checkpointed yet: it exists (if at all) only in
+                # the journal — rebuild it from records alone
+                if old is not None:
+                    with self._replay_scope():
+                        self.delete_schema(name)
+                    self._plan_cache_clear(name)
+                    self._drop_executors(name)
+                applied = self._journal_replay({name: 0}, schema=name)
+                if applied and name in self._stores:
+                    self.flush(name)
+                return applied > 0 or old is not None
         if meta is None:
             if old is None:
                 return False
-            self.delete_schema(name)  # invalidates the old uid's covers
+            with self._replay_scope():
+                self.delete_schema(name)  # invalidates the old uid's covers
             self._plan_cache_clear(name)
             self._drop_executors(name)
             return True
@@ -2420,4 +2752,11 @@ class GeoDataset:
             self._plan_cache_clear(name)
             self._drop_executors(name)
         self._attach_schema_entry(path, name, meta)
+        self._applied_seq[name] = ckpt
+        if use_journal:
+            # replay the journal's records past the checkpoint this entry
+            # captured (the trailing-replica recovery half of §8)
+            if self._journal_replay({name: ckpt}, schema=name):
+                if name in self._stores:
+                    self.flush(name)
         return True

@@ -36,6 +36,12 @@ def _load(catalog: str):
 
     if os.path.exists(os.path.join(catalog, "manifest.json")):
         return GeoDataset.load(catalog)
+    from geomesa_tpu.fs import journal as journal_mod
+
+    if journal_mod.journal_exists(catalog):
+        # a crash before the first checkpoint leaves a journal-only root:
+        # still a loadable catalog (docs/RESILIENCE.md §8)
+        return GeoDataset.load(catalog)
     return GeoDataset()
 
 
@@ -618,6 +624,54 @@ def cmd_fleet(args):
     return 2
 
 
+def cmd_journal(args):
+    """``journal`` subcommands (docs/RESILIENCE.md §8):
+
+    * ``journal status`` — the catalog's mutation-journal summary:
+      segments, sequence range, per-schema checkpointed positions,
+      torn bytes, pending frames;
+    * ``journal replay`` — recover the catalog (load replays records
+      past each schema's checkpoint, truncating any torn tail), report
+      how many records re-applied, and checkpoint via ``save`` so the
+      next load starts clean.
+    """
+    from geomesa_tpu.fs import journal as journal_mod
+
+    if args.journal_cmd == "status":
+        out: dict = {"root": args.catalog,
+                     "journal": journal_mod.journal_exists(args.catalog)}
+        if out["journal"]:
+            j = journal_mod.MutationJournal(args.catalog)
+            try:
+                out.update(j.status())
+            finally:
+                j.close()
+        mpath = os.path.join(args.catalog, "manifest.json")
+        if os.path.exists(mpath):
+            with open(mpath) as fh:
+                out["checkpoints"] = {
+                    name: int(meta.get("journal_seq", 0))
+                    for name, meta in
+                    json.load(fh).get("schemas", {}).items()
+                }
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
+    if args.journal_cmd == "replay":
+        from geomesa_tpu import GeoDataset
+
+        ds = GeoDataset.load(args.catalog)
+        replayed = ds._journal_replayed
+        ds.save(args.catalog)
+        print(json.dumps({
+            "root": args.catalog, "replayed": int(replayed),
+            "schemas": sorted(ds._stores),
+            "checkpointed": True,
+        }, indent=2, sort_keys=True))
+        return 0
+    print(f"unknown journal command {args.journal_cmd!r}", file=sys.stderr)
+    return 2
+
+
 def cmd_version(args):
     print(f"geomesa-tpu {__version__}")
 
@@ -954,6 +1008,18 @@ def build_parser() -> argparse.ArgumentParser:
                     help="hottest-entry cap (default: all current-epoch "
                     "entries)")
     fp.set_defaults(fn=cmd_fleet)
+
+    sp = sub.add_parser("journal", help="durable mutation journal: "
+                        "status + crash recovery (docs/RESILIENCE.md §8)")
+    jsub = sp.add_subparsers(dest="journal_cmd", required=True)
+    jp = jsub.add_parser("status", help="segments, sequence range, "
+                         "per-schema checkpoints, pending frames")
+    jp.add_argument("catalog")
+    jp.set_defaults(fn=cmd_journal)
+    jp = jsub.add_parser("replay", help="recover: replay records past "
+                         "each checkpoint, then checkpoint via save")
+    jp.add_argument("catalog")
+    jp.set_defaults(fn=cmd_journal)
 
     sp = sub.add_parser("version", help="print version")
     sp.set_defaults(fn=cmd_version)

@@ -753,6 +753,43 @@ FLEET_LATENCY_FLOOR_MS = SystemProperty(
 #: face of FleetRouter.cordon()/uncordon() (explicit API on the router).
 FLEET_CORDON = SystemProperty("geomesa.fleet.cordon", None)
 
+# ---------------------------------------------------------------------------
+# Durable mutation journal (fs/journal.py; docs/RESILIENCE.md §8): per-root
+# crc-framed write-ahead log with group commit. With it attached, an acked
+# mutation is ON DISK before the call returns; load() replays records past
+# each schema's checkpointed position, and save() checkpoints then truncates
+# the journal segment-wise.
+# ---------------------------------------------------------------------------
+
+#: Master switch: with it false, attach_journal() is a no-op and every root
+#: keeps the pre-journal semantics (acked mutations live until the next
+#: explicit save()).
+JOURNAL_ENABLED = SystemProperty("geomesa.journal.enabled", "true")
+
+#: Group-commit window (ms): after the first pending append wakes the
+#: committer, it waits this long for concurrent appenders to join the
+#: batch, then writes + fsyncs ONCE for all of them. "0" commits each
+#: drain immediately — concurrent writers still batch naturally because
+#: appends arriving during an fsync join the next drain (commit
+#: pipelining); positive values trade single-writer append latency for
+#: wider groups under concurrency.
+JOURNAL_GROUP_MS = SystemProperty("geomesa.journal.group.ms", "2")
+
+#: Segment roll threshold (bytes): the active segment closes and a new one
+#: starts past this size, bounding both the torn-tail blast radius and the
+#: granularity at which checkpoints reclaim space.
+JOURNAL_SEGMENT_BYTES = SystemProperty(
+    "geomesa.journal.segment.bytes", str(8 << 20)
+)
+
+#: Fleet-replica checkpoint cadence: a replica serving stamped writes from
+#: a shared root runs a full ``save()`` (checkpoint + journal truncation)
+#: every this-many commits — between checkpoints a one-row insert costs one
+#: journal append + marker advance, never a schema snapshot rewrite.
+JOURNAL_CHECKPOINT_WRITES = SystemProperty(
+    "geomesa.journal.checkpoint.writes", "256"
+)
+
 #: Per-user fair-share weight prefix: ``geomesa.serving.user.weight.<user>``
 #: scales a user's attained-service debt (the dispatcher picks the user
 #: minimizing service_s / weight), so weight 4 earns ~4x the service of

@@ -355,21 +355,15 @@ class FileSystemStorage:
                 json.dump(meta, fh, indent=2)
                 fh.flush()
                 os.fsync(fh.fileno())
-            os.replace(tmp, path)
+            # durable_replace = atomic rename + parent-dir fsync (the shared
+            # publish sequence; filesystems refusing dir fsync stay atomic)
+            resilience.durable_replace(tmp, path)
         except BaseException:
             try:
                 os.remove(tmp)
             except OSError:
                 pass
             raise
-        try:
-            dirfd = os.open(os.path.dirname(path), os.O_RDONLY)
-            try:
-                os.fsync(dirfd)
-            finally:
-                os.close(dirfd)
-        except OSError:
-            pass  # some filesystems refuse directory fsync; replace still atomic
 
     def list_types(self) -> List[str]:
         out = []

@@ -313,6 +313,7 @@ class PartitionedFeatureStore(FeatureStore):
             if os.path.exists(d):
                 shutil.rmtree(d)
             os.replace(tmp, d)
+            resilience.fsync_dir(os.path.dirname(os.path.abspath(d)))
             return
         arrs: Dict[str, np.ndarray] = {}
         if st._all is not None:
@@ -340,6 +341,7 @@ class PartitionedFeatureStore(FeatureStore):
         if os.path.exists(d):
             shutil.rmtree(d)
         os.replace(tmp, d)
+        resilience.fsync_dir(os.path.dirname(os.path.abspath(d)))
 
     def _load(self, b: int) -> FeatureStore:
         """Reload a spilled partition (``index.spill.load`` fault edge;

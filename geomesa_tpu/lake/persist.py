@@ -25,7 +25,7 @@ from typing import Any, Dict, Tuple
 
 import numpy as np
 
-from geomesa_tpu import metrics
+from geomesa_tpu import metrics, resilience
 from geomesa_tpu.lake.format import LakeCorruptError, LakeFile, LakeWriter
 
 
@@ -108,7 +108,9 @@ def save_cache(ds, path: str) -> Dict[str, Any]:
     except BaseException:
         w.abort()
         raise
-    os.replace(tmp, path)
+    # the lake writer fsyncs the FILE; the rename is only durable once the
+    # parent directory is synced too (docs/RESILIENCE.md §8)
+    resilience.durable_replace(tmp, path)
     return summary
 
 

@@ -561,6 +561,33 @@ FLEET_UNCORDON = "fleet.uncordon"
 FLEET_MEMBER_JOIN = "fleet.member.join"
 FLEET_MEMBER_LEAVE = "fleet.member.leave"
 FLEET_HANDOFF_ENTRIES = "fleet.handoff.entries"
+#   fleet.epoch.marker.quarantined  corrupt fleet-epochs.json markers moved
+#                          aside (crc mismatch / unparsable — read as empty,
+#                          the safe direction: a redundant refresh, never a
+#                          stale serve; docs/RESILIENCE.md §8)
+FLEET_EPOCH_MARKER_QUARANTINED = "fleet.epoch.marker.quarantined"
+# Durable mutation journal (fs/journal.py; docs/RESILIENCE.md §8):
+#   journal.appends         records made durable (acked appends)
+#   journal.group.size      histogram: appends per group-commit fsync
+#   journal.fsync_ms        histogram: group-commit write+fsync latency (ms)
+#   journal.replayed        records re-applied by recovery/refresh replay
+#   journal.truncated_bytes bytes reclaimed (checkpoints) or clipped
+#                           (torn tails)
+#   journal.torn_tails      torn segment tails truncated at open/replay
+#   journal.lag             gauge: appended-but-not-yet-durable records
+#                           (also the /healthz journal section)
+JOURNAL_APPENDS = "journal.appends"
+JOURNAL_GROUP_SIZE = "journal.group.size"
+JOURNAL_FSYNC_MS = "journal.fsync_ms"
+JOURNAL_REPLAYED = "journal.replayed"
+JOURNAL_TRUNCATED_BYTES = "journal.truncated_bytes"
+JOURNAL_TORN_TAILS = "journal.torn_tails"
+JOURNAL_LAG = "journal.lag"
+#: group-commit batch-width buckets (appends per fsync)
+JOURNAL_GROUP_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
+#: group-commit fsync latency buckets (milliseconds)
+JOURNAL_FSYNC_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
+                            50.0, 100.0, 250.0)
 #   compact.desc.shared   compact-scan descriptors served from the
 #                         content-addressed share (a rebuild avoided:
 #                         another site/query resolved the same windows —

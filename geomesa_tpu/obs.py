@@ -128,6 +128,22 @@ def _fs_quarantine() -> Dict[str, Dict[str, str]]:
         return {}
 
 
+def _journal_lag() -> Dict[str, int]:
+    """Per-root pending (appended, not yet fsynced) journal frames —
+    nonzero sustained means the group committer is behind its writers
+    (docs/RESILIENCE.md §8). Imported lazily like the fs quarantine map:
+    /healthz must work in a process that never touched a journal."""
+    import sys
+
+    mod = sys.modules.get("geomesa_tpu.fs.journal")
+    if mod is None:
+        return {}
+    try:
+        return mod.lag_snapshot()
+    except Exception:  # pragma: no cover — defensive
+        return {}
+
+
 def health() -> Dict[str, Any]:
     """The /healthz payload. ``status`` is ``ok`` unless a circuit breaker
     is open, an SLO's fast window burns past geomesa.slo.burn.threshold,
@@ -171,6 +187,7 @@ def health() -> Dict[str, Any]:
         "open_breakers": open_breakers,
         "quarantine": quarantine,
         "fs_quarantine": _fs_quarantine(),
+        "journal": _journal_lag(),
         "device": dev,
         "mesh": mesh,
         "tracing": tracing.enabled(),

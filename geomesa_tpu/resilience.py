@@ -735,6 +735,68 @@ def record_skip(source: str, part: str, error: BaseException,
     return rec
 
 
+# ---------------------------------------------------------------------------
+# Durable tmp-then-rename publish (THE one copy — ISSUE 16 satellite)
+# ---------------------------------------------------------------------------
+
+
+def fsync_dir(path: str) -> None:
+    """fsync a DIRECTORY so a rename/create inside it is durable — on many
+    filesystems ``os.replace`` orders but does not persist the directory
+    entry until the directory itself is synced. Filesystems that refuse
+    directory fsync (some network mounts) keep the rename atomic, just
+    not provably durable; the refusal is swallowed (the pre-existing
+    behavior). THE one copy of this sequence — fs/storage.py metadata,
+    the lake writer's publish, the fleet epoch marker, and journal
+    segment creation all route here."""
+    import os as _os
+
+    try:
+        dirfd = _os.open(path, _os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        _os.fsync(dirfd)
+    except OSError:
+        pass
+    finally:
+        _os.close(dirfd)
+
+
+def durable_replace(tmp: str, path: str) -> None:
+    """``os.replace`` + parent-directory fsync: the durable half of every
+    tmp-then-rename publish in the tree. The tmp file itself must already
+    be written + fsynced by the caller."""
+    import os as _os
+
+    _os.replace(tmp, path)
+    fsync_dir(_os.path.dirname(_os.path.abspath(path)))
+
+
+def durable_write_json(path: str, obj: Any, indent: Optional[int] = None
+                       ) -> None:
+    """Crash-safe JSON publish: same-directory tmp, write, flush, file
+    fsync, atomic replace, directory fsync — a crash at ANY point leaves
+    either the old complete file or the new complete file, never torn
+    JSON."""
+    import json as _json
+    import os as _os
+
+    tmp = path + f".tmp.{_os.getpid()}"
+    try:
+        with open(tmp, "w") as fh:
+            _json.dump(obj, fh, indent=indent)
+            fh.flush()
+            _os.fsync(fh.fileno())
+        durable_replace(tmp, path)
+    except BaseException:
+        try:
+            _os.remove(tmp)
+        except OSError:
+            pass
+        raise
+
+
 __all__ = [
     "QueryTimeoutError", "DeadlineShedError", "AdmissionRejectedError",
     "CircuitOpenError", "DeviceDrainError", "FleetPartialError",
@@ -745,4 +807,5 @@ __all__ = [
     "FaultInjector", "fault_point", "inject_faults",
     "Skipped", "PartialResult", "DegradationCollector", "allow_partial",
     "partial_allowed", "record_skip",
+    "fsync_dir", "durable_replace", "durable_write_json",
 ]

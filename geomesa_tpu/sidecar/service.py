@@ -331,6 +331,14 @@ class GeoFlightServer(fl.FlightServerBase):
         super().__init__(location, middleware=mw, **kw)
         self.dataset = dataset if dataset is not None else GeoDataset()
         self._lock = threading.Lock()
+        #: stamped-commit counter (docs/RESILIENCE.md §8): with the shared
+        #: root's journal attached, each commit appends a delta record and
+        #: advances the epoch marker; only every
+        #: geomesa.journal.checkpoint.writes-th commit pays a full
+        #: checkpoint save of the stamped schemas
+        self._commit_count = 0
+        if self.fleet_root:
+            self.dataset.attach_journal(self.fleet_root)
         # the DATASET's scheduler, promoted to dispatch-thread mode: local
         # ops and Flight ops share one ledger and one fair-share domain
         self._sched = self.dataset.serving.start()
@@ -348,12 +356,13 @@ class GeoFlightServer(fl.FlightServerBase):
     def _root_epochs(self) -> Dict[str, int]:
         if not self.fleet_root:
             return {}
-        path = os.path.join(self.fleet_root, self._FLEET_EPOCH_FILE)
-        try:
-            with open(path) as fh:
-                return {str(k): int(v) for k, v in json.load(fh).items()}
-        except (OSError, ValueError):
-            return {}
+        from geomesa_tpu.fs import journal as journal_mod
+
+        # crc-framed v2 marker (v1 legacy accepted; corruption quarantines
+        # to `.quarantine` and reads as {} — the safe direction: redundant
+        # refreshes, never a stale serve)
+        epochs, _seq = journal_mod.read_epoch_marker(self.fleet_root)
+        return epochs
 
     def _fleet_require(self, name: str, epoch: int) -> None:
         """Bring schema ``name`` up to fleet epoch ``epoch``: when the
@@ -413,28 +422,47 @@ class GeoFlightServer(fl.FlightServerBase):
             self._fleet_require(name, int(e) - 1)
 
     def _fleet_commit(self, stamp: Dict[str, int]) -> None:
-        """Post-mutation commit for a router-stamped write: persist the
-        STAMPED schemas to the shared root (so every other replica's
-        refresh sees them — per-schema, never the whole dataset), record
-        the new epochs in the root's marker file (atomic replace; what
-        `_fleet_require` trusts), then advance the local epochs."""
+        """Post-mutation commit for a router-stamped write: make the
+        mutation durable at the shared root, record the new epochs in the
+        root's marker file (what `_fleet_require` trusts), then advance
+        the local epochs.
+
+        With the root's journal attached (docs/RESILIENCE.md §8) the
+        mutation is ALREADY durable — the dataset's mutation edges
+        journaled it before applying, and the group-commit ack means it
+        fsynced. The commit therefore only advances the marker (carrying
+        the journal position) and pays a full checkpoint ``save`` every
+        geomesa.journal.checkpoint.writes commits — the snapshot becomes
+        the CHECKPOINT, not the commit, so a one-row stamped insert no
+        longer rewrites the schema's whole chunk set. Trailing replicas
+        recover via `refresh_schema`'s journal catch-up."""
         if self.fleet_root:
+            from geomesa_tpu import config
+            from geomesa_tpu.fs import journal as journal_mod
+
             with self._lock:
-                self.dataset.save(self.fleet_root, names=list(stamp))
-                marker = self._root_epochs()
+                j = self.dataset._journal
+                if j is not None:
+                    self._commit_count += 1
+                    every = config.JOURNAL_CHECKPOINT_WRITES.to_int() or 256
+                    if self._commit_count % every == 0:
+                        # periodic checkpoint: bound replay length and
+                        # journal size without paying a snapshot per write
+                        self.dataset.save(self.fleet_root)
+                else:
+                    # journal disabled: legacy per-write snapshot commit
+                    self.dataset.save(self.fleet_root, names=list(stamp))
+                marker, _ = journal_mod.read_epoch_marker(self.fleet_root)
                 for name, e in stamp.items():
                     if marker.get(name, 0) < int(e):
                         marker[name] = int(e)
-                path = os.path.join(self.fleet_root,
-                                    self._FLEET_EPOCH_FILE)
                 # concurrent commits on DIFFERENT replicas can race this
                 # read-modify-replace; a lost entry only UNDER-states the
                 # root's epoch, which costs redundant refreshes — never a
                 # stale serve (the safe direction of the marker contract)
-                tmp = path + f".tmp.{os.getpid()}"
-                with open(tmp, "w") as fh:
-                    json.dump(marker, fh)
-                os.replace(tmp, path)
+                journal_mod.write_epoch_marker(
+                    self.fleet_root, marker,
+                    journal_seq=j.last_seq() if j is not None else 0)
         with self._fleet_lock:
             for name, e in stamp.items():
                 if self._fleet_epochs.get(name, 0) < int(e):

@@ -194,7 +194,8 @@ def attach_confluent(sds, name: str, registry: SchemaRegistry):
     lag_gauge_schema = metrics.registry().gauge(f"{metrics.STREAM_LAG}.{name}")
 
     def ingest(data: Optional[bytes], fid: Optional[str] = None,
-               ts_ms: Optional[int] = None) -> str:
+               ts_ms: Optional[int] = None,
+               offset: Optional[int] = None) -> str:
         with tracing.span("stream.apply", schema=name, edge="confluent") \
                 as sp, apply_timer.time():
             try:
@@ -213,6 +214,17 @@ def attach_confluent(sds, name: str, registry: SchemaRegistry):
                 )
                 sp.set(quarantined=True, error=type(e).__name__)
                 return ""
+        if offset is not None and getattr(sds, "_journal", None) is not None:
+            # durable broker-offset high-water mark (docs/RESILIENCE.md §8,
+            # docs/PROTOCOL.md stream resume): once this record is down, a
+            # restarted consumer resumes PAST this broker offset via
+            # confluent_resume_offset — the acked record can never be lost
+            # (the feature data itself rides the stream-batch records
+            # journaled by StreamingDataset.poll)
+            sds._journal.append({
+                "kind": "confluent-offset", "schema": name,
+                "offset": int(offset), "fid": out,
+            })
         return out
 
     def _ingest(data: Optional[bytes], fid: Optional[str],
@@ -263,3 +275,19 @@ def attach_confluent(sds, name: str, registry: SchemaRegistry):
         return rid
 
     return ser, ingest
+
+
+def confluent_resume_offset(sds, name: str) -> int:
+    """Highest broker offset journaled for ``name``'s Confluent edge, or
+    ``-1`` when none was recorded — seek the external consumer to
+    ``resume + 1`` after a restart and no acked record replays twice
+    (docs/PROTOCOL.md stream-offset resume)."""
+    j = getattr(sds, "_journal", None)
+    if j is None:
+        return -1
+    hi = -1
+    for rec in j.records():
+        if (rec.get("kind") == "confluent-offset"
+                and rec.get("schema") == name):
+            hi = max(hi, int(rec.get("offset", -1)))
+    return hi

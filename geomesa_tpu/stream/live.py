@@ -262,6 +262,82 @@ class StreamingDataset:
         #: a message that fails to decode or apply is counted + recorded and
         #: skipped — it can never kill the consumer loop
         self.quarantined: Dict[str, int] = {}
+        #: durable mutation journal (docs/RESILIENCE.md §8). When attached,
+        #: every applied poll batch is journaled WITH its source offsets, so
+        #: a restarted consumer resumes exactly where the crashed one acked.
+        self._journal = None
+        self._replaying = False
+
+    # -- durability --------------------------------------------------------
+    def attach_journal(self, root: str) -> None:
+        """Journal applied batches under ``root`` (docs/RESILIENCE.md §8).
+
+        The record goes down AFTER the batch applies — the live cache is
+        idempotent under event-time ordering (re-putting a feature at the
+        same ts is a no-op state-wise), so a crash in the journal-after-
+        apply gap re-consumes at most one batch from the topic, never
+        loses an acked one."""
+        from geomesa_tpu import config
+        from geomesa_tpu.fs.journal import MutationJournal
+
+        if self._journal is not None or not config.JOURNAL_ENABLED.to_bool():
+            return
+        self._journal = MutationJournal(root)
+
+    def recover(self) -> int:
+        """Replay the attached journal: recreate journaled schemas, restore
+        the live caches from applied batches, and restore consumer offsets
+        so the next :meth:`poll` resumes past everything already applied.
+        Returns the number of records replayed."""
+        if self._journal is None:
+            return 0
+        applied = 0
+        self._replaying = True
+        try:
+            applied = self._recover_records()
+        finally:
+            self._replaying = False
+        return applied
+
+    def _recover_records(self) -> int:
+        from geomesa_tpu import metrics, resilience
+
+        applied = 0
+        for rec in self._journal.records():
+            kind = rec.get("kind")
+            nm = rec.get("schema", "")
+            seq = int(rec.get("seq", 0))
+            try:
+                if kind == "stream-create":
+                    if nm not in self._schemas:
+                        self.create_schema(
+                            FeatureType.from_spec(nm, rec["spec"]))
+                elif kind == "stream-batch":
+                    cache = self._caches.get(nm)
+                    if cache is None:
+                        continue  # schema dropped since: batch is moot
+                    for mk, fid, payload, ts_ms in rec.get("msgs", []):
+                        if mk == CHANGE:
+                            cache.put(fid, payload or {}, int(ts_ms))
+                        elif mk == DELETE:
+                            cache.remove(fid)
+                        elif mk == CLEAR:
+                            cache.clear()
+                    offs = rec.get("offsets")
+                    if offs and nm in self._offsets:
+                        self._offsets[nm] = [
+                            max(a, int(b))
+                            for a, b in zip(self._offsets[nm], offs)
+                        ]
+                else:
+                    continue
+                applied += 1
+                metrics.inc(metrics.JOURNAL_REPLAYED)
+            except Exception as e:
+                # one bad record must not fail the whole recovery
+                resilience.record_skip(
+                    "journal.replay", f"{nm}@{seq}", e, phase="stream")
+        return applied
 
     # -- schema CRUD -------------------------------------------------------
     def create_schema(self, name_or_ft, spec: Optional[str] = None) -> FeatureType:
@@ -276,6 +352,11 @@ class StreamingDataset:
         self._caches[ft.name] = LiveFeatureCache(ft, self.expiry_ms)
         self._offsets[ft.name] = [0] * self.partitions
         self._listeners[ft.name] = []
+        if self._journal is not None and not self._replaying:
+            self._journal.append({
+                "kind": "stream-create", "schema": ft.name,
+                "spec": ft.spec(),
+            })
         return ft
 
     def get_schema(self, name: str) -> FeatureType:
@@ -374,6 +455,7 @@ class StreamingDataset:
                 cache.expire()
                 continue
             applied_ts: Optional[int] = None
+            applied_msgs: List[Tuple[int, str, Any, int]] = []
             with tracing.span("stream.apply", schema=nm,
                               messages=len(msgs)) as sp, \
                     metrics.registry().timer(metrics.STREAM_APPLY).time():
@@ -392,6 +474,9 @@ class StreamingDataset:
                         self._quarantine(nm, m.fid or m.kind, e, "apply")
                         continue
                     applied_ts = m.ts_ms
+                    if self._journal is not None:
+                        applied_msgs.append(
+                            (m.kind, m.fid, m.payload, m.ts_ms))
                     for fn in listeners:
                         try:
                             fn(m)
@@ -413,6 +498,16 @@ class StreamingDataset:
                     metrics.registry().gauge(
                         f"{metrics.STREAM_LAG}.{nm}"
                     ).set(lag_ms)
+            if applied_msgs and self._journal is not None:
+                # journaled WITH the post-batch source offsets: recovery
+                # replays the batch into the cache, then resumes the topic
+                # consumer past it — exactly-once for acked batches
+                # (docs/RESILIENCE.md §8, docs/PROTOCOL.md stream resume)
+                self._journal.append({
+                    "kind": "stream-batch", "schema": nm,
+                    "offsets": list(self._offsets[nm]),
+                    "msgs": [list(t) for t in applied_msgs],
+                })
             cache.expire()
         return total
 

@@ -1,0 +1,499 @@
+"""Crash-consistency suite for the durable mutation journal
+(docs/RESILIENCE.md §8).
+
+The core contract under test: **ack = durable**. Once a mutation call
+returns, a SIGKILL at ANY later point — including inside the save/
+checkpoint machinery, via the injected ``journal.*`` / ``fs.*`` fault
+points — must leave a root that recovers to a state containing every
+acked mutation. The kill-point walk runs a fixed op script in a child
+process, kills it at each recorded fault-point hit, and checks the
+recovered dataset is bit-identical to a never-crashed control built from
+some op prefix that covers everything the child acked (durable-but-
+unacked tail ops are allowed; an acked-but-lost op is the failure).
+
+Also here: group-commit concurrency (no acked append may vanish on
+reopen), torn-tail truncation, the delete-schema tombstone, stream
+offset resume, and the crc-framed fleet epoch marker's corruption
+quarantine.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, config, metrics
+from geomesa_tpu.fs import journal as journal_mod
+from geomesa_tpu.fs.journal import MutationJournal
+
+SPEC = "name:String,weight:Double,dtg:Date,*geom:Point"
+
+
+def _data(n, seed=11, tag="op"):
+    rng = np.random.default_rng(seed)
+    return {
+        "name": [f"{tag}{seed}_{i}" for i in range(n)],
+        "weight": rng.uniform(0, 10, n),
+        "dtg": rng.integers(1577836800000, 1583020800000, n)
+        .astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+    }
+
+
+# ---------------------------------------------------------------------------
+# the kill-point walk
+# ---------------------------------------------------------------------------
+
+# The op script both the child and the control run. Each mutation op acks
+# by appending its index to acked.log AFTER the call returns — exactly the
+# caller's view of durability.
+_CHILD = r"""
+import json, os, signal, sys
+sys.path.insert(0, {repo!r})
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import numpy as np
+from geomesa_tpu import GeoDataset, resilience
+
+root = {root!r}
+mode = {mode!r}          # "record" | "kill"
+kill_site = {kill_site!r}
+kill_hit = {kill_hit}
+hits = {{}}
+
+_real = resilience.fault_point
+def hooked(site, **ctx):
+    if site.startswith("journal.") or site.startswith("fs."):
+        hits[site] = hits.get(site, 0) + 1
+        if mode == "kill" and site == kill_site and hits[site] == kill_hit:
+            os.kill(os.getpid(), signal.SIGKILL)
+    return _real(site, **ctx)
+resilience.fault_point = hooked
+
+ack_fh = open(os.path.join(root, "acked.log"), "a")
+def ack(i):
+    ack_fh.write(f"{{i}}\n")
+    ack_fh.flush()
+    os.fsync(ack_fh.fileno())
+
+def _data(n, seed, tag="op"):
+    rng = np.random.default_rng(seed)
+    return {{
+        "name": [f"{{tag}}{{seed}}_{{i}}" for i in range(n)],
+        "weight": rng.uniform(0, 10, n),
+        "dtg": rng.integers(1577836800000, 1583020800000, n)
+        .astype("datetime64[ms]"),
+        "geom__x": rng.uniform(-120, -70, n),
+        "geom__y": rng.uniform(25, 50, n),
+    }}
+
+SPEC = {spec!r}
+ds = GeoDataset(prefer_device=False)
+ds.attach_journal(root)
+ops = [
+    lambda: ds.create_schema("t", SPEC),
+    lambda: ds.insert("t", _data(8, 1), fids=[f"a{{i}}" for i in range(8)]),
+    lambda: ds.insert("t", _data(8, 2), fids=[f"b{{i}}" for i in range(8)]),
+    lambda: (ds.flush(), ds.save(root)),
+    lambda: ds.insert("t", _data(8, 3), fids=[f"c{{i}}" for i in range(8)]),
+    lambda: ds.delete_features("t", "weight > 9"),
+    lambda: ds.update_schema("t", "extra:Integer"),
+    lambda: ds.insert(
+        "t", dict(_data(8, 4), extra=np.arange(8, dtype=np.int64)),
+        fids=[f"d{{i}}" for i in range(8)]),
+    lambda: (ds.flush(), ds.save(root)),
+]
+stop_at = {stop_at}
+for i, op in enumerate(ops[: stop_at if stop_at >= 0 else len(ops)]):
+    op()
+    ack(i)
+if mode == "record":
+    with open(os.path.join(root, "hits.json"), "w") as fh:
+        json.dump(hits, fh)
+print("DONE")
+"""
+
+
+def _run_child(tmp_path, root, mode, kill_site="", kill_hit=0, stop_at=-1):
+    script = _CHILD.format(
+        repo=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        root=root, mode=mode, kill_site=kill_site, kill_hit=kill_hit,
+        spec=SPEC, stop_at=stop_at,
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "-c", script], env=env,
+        capture_output=True, text=True, timeout=300,
+    )
+
+
+def _state(ds):
+    """Comparable snapshot of schema 't' (absent -> None). Names go
+    through to_arrow so dictionary codes decode to the REAL strings —
+    comparing raw codes would mask a resurrected/lost row whose code
+    happens to collide."""
+    if "t" not in ds._stores:
+        return None
+    names = sorted(
+        "" if v is None else str(v)
+        for v in ds.to_arrow("t").column("name").to_pylist()
+    )
+    return {
+        "spec": ds.get_schema("t").spec(),
+        "count": int(ds.count("t")),
+        "names": names,
+    }
+
+
+def _acked(root):
+    try:
+        with open(os.path.join(root, "acked.log")) as fh:
+            return [int(x) for x in fh.read().split()]
+    except FileNotFoundError:
+        return []
+
+
+def _walk_kill_points(tmp_path, points):
+    """Kill the op script at each (site, hit); recovery must reproduce a
+    never-crashed control covering every acked op."""
+    # never-crashed controls for every op prefix (built once, in-process)
+    controls = {}
+    for p in range(10):
+        croot = str(tmp_path / f"control{p}")
+        os.makedirs(croot)
+        r = _run_child(tmp_path, croot, "record", stop_at=p)
+        assert r.returncode == 0, r.stderr[-2000:]
+        try:
+            controls[p] = _state(GeoDataset.load(croot, prefer_device=False))
+        except FileNotFoundError:
+            controls[p] = None
+
+    lost = []
+    for n, (site, hit) in enumerate(points):
+        root = str(tmp_path / f"kill{n}")
+        os.makedirs(root)
+        r = _run_child(tmp_path, root, "kill", kill_site=site, kill_hit=hit)
+        if r.returncode == 0:
+            continue  # walk raced past the point (e.g. committer batching)
+        assert r.returncode == -signal.SIGKILL
+        acked = _acked(root)
+        n_acked = len(acked)
+        try:
+            got = _state(GeoDataset.load(root, prefer_device=False))
+        except FileNotFoundError:
+            got = None
+        # prefix consistency: recovered state == control(p) for some
+        # p >= n_acked (durable-but-unacked tail allowed, acked-lost not)
+        ok = any(got == controls[p] for p in range(n_acked, 10))
+        if not ok:
+            lost.append((site, hit, n_acked, got))
+    assert not lost, f"acked mutations lost at kill points: {lost}"
+
+
+def _recorded_points(tmp_path):
+    root = str(tmp_path / "record")
+    os.makedirs(root)
+    r = _run_child(tmp_path, root, "record")
+    assert r.returncode == 0, r.stderr[-2000:]
+    with open(os.path.join(root, "hits.json")) as fh:
+        hits = json.load(fh)
+    assert any(s.startswith("journal.") for s in hits), hits
+    return [(site, h) for site, n in sorted(hits.items())
+            for h in range(1, n + 1)]
+
+
+@pytest.mark.slow
+def test_kill_point_walk_full(tmp_path):
+    """SIGKILL at EVERY recorded ``journal.*`` / ``fs.*`` fault-point hit:
+    zero acked mutations lost (the ISSUE's acceptance sweep)."""
+    _walk_kill_points(tmp_path, _recorded_points(tmp_path))
+
+
+def test_kill_point_walk_smoke(tmp_path):
+    """Non-slow slice of the walk: one kill inside the journal fsync and
+    one inside the checkpoint's manifest publish — the two windows where
+    a naive implementation loses acked data."""
+    points = _recorded_points(tmp_path)
+    picked = []
+    for prefer in ("journal.fsync", "fs.save.manifest"):
+        got = [pt for pt in points if pt[0] == prefer]
+        if got:
+            picked.append(got[len(got) // 2])
+    assert picked, f"no usable kill points recorded: {points}"
+    _walk_kill_points(tmp_path, picked)
+
+
+# ---------------------------------------------------------------------------
+# group commit
+# ---------------------------------------------------------------------------
+
+
+def test_group_commit_concurrent_appends_all_durable(tmp_path):
+    """N writer threads appending concurrently: every acked seq must be
+    present after reopen, exactly once, and batches actually grouped."""
+    root = str(tmp_path)
+    with config.JOURNAL_GROUP_MS.scoped("5"):
+        j = MutationJournal(root)
+        acked = []
+        lock = threading.Lock()
+
+        def writer(t):
+            for i in range(25):
+                seq = j.append({"kind": "noop", "schema": "t",
+                                "writer": t, "i": i})
+                with lock:
+                    acked.append(seq)
+
+        threads = [threading.Thread(target=writer, args=(t,))
+                   for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        j.close()
+    assert len(acked) == 200 and len(set(acked)) == 200
+    j2 = MutationJournal(root)
+    seqs = [int(r["seq"]) for r in j2.records()]
+    assert sorted(seqs) == sorted(acked)
+    j2.close()
+
+
+# ---------------------------------------------------------------------------
+# torn tails
+# ---------------------------------------------------------------------------
+
+
+def _seg_paths(root):
+    d = os.path.join(root, journal_mod.JOURNAL_DIR)
+    return [os.path.join(d, f) for f in sorted(os.listdir(d))
+            if f.endswith(".gmj")]
+
+
+def test_torn_tail_truncates_cleanly(tmp_path):
+    root = str(tmp_path)
+    j = MutationJournal(root)
+    for i in range(5):
+        j.append({"kind": "noop", "schema": "t", "i": i})
+    j.close()
+    seg = _seg_paths(root)[-1]
+    size = os.path.getsize(seg)
+    # torn write: the last frame went down partially
+    with open(seg, "r+b") as fh:
+        fh.truncate(size - 7)
+    before = metrics.registry().counter(metrics.JOURNAL_TORN_TAILS).value
+    j2 = MutationJournal(root)
+    recs = j2.records()
+    assert [int(r["i"]) for r in recs] == [0, 1, 2, 3]  # valid prefix only
+    assert metrics.registry().counter(
+        metrics.JOURNAL_TORN_TAILS).value > before
+    # the tail is REPAIRED on disk: appends sequence after the survivors
+    seq = j2.append({"kind": "noop", "schema": "t", "i": 99})
+    assert seq == max(int(r["seq"]) for r in recs) + 1
+    j2.close()
+
+
+def test_corrupt_frame_crc_stops_replay_at_last_valid(tmp_path):
+    root = str(tmp_path)
+    j = MutationJournal(root)
+    for i in range(3):
+        j.append({"kind": "noop", "schema": "t", "i": i})
+    j.close()
+    seg = _seg_paths(root)[-1]
+    with open(seg, "r+b") as fh:
+        fh.seek(-3, os.SEEK_END)  # flip a payload byte of the last frame
+        b = fh.read(1)
+        fh.seek(-3, os.SEEK_END)
+        fh.write(bytes([b[0] ^ 0xFF]))
+    j2 = MutationJournal(root)
+    assert [int(r["i"]) for r in j2.records()] == [0, 1]
+    j2.close()
+
+
+# ---------------------------------------------------------------------------
+# tombstones
+# ---------------------------------------------------------------------------
+
+
+def test_delete_schema_tombstone_survives_replay(tmp_path):
+    """create -> insert -> drop, all journaled past the checkpoint: replay
+    must NOT resurrect the dropped schema from its earlier records."""
+    root = str(tmp_path)
+    ds = GeoDataset(prefer_device=False)
+    ds.attach_journal(root)
+    ds.create_schema("t", SPEC)
+    ds.insert("t", _data(8, 1), fids=[f"a{i}" for i in range(8)])
+    ds.delete_schema("t")
+    ds2 = GeoDataset.load(root, prefer_device=False)
+    assert "t" not in ds2._stores
+
+
+def test_delete_schema_tombstone_after_checkpoint(tmp_path):
+    """Checkpointed schema files still on disk + a journaled tombstone:
+    the drop wins over the checkpoint attach."""
+    root = str(tmp_path)
+    ds = GeoDataset(prefer_device=False)
+    ds.attach_journal(root)
+    ds.create_schema("t", SPEC)
+    ds.insert("t", _data(8, 1), fids=[f"a{i}" for i in range(8)])
+    ds.flush()
+    ds.save(root)
+    ds.delete_schema("t")  # journaled, NOT yet checkpointed
+    ds2 = GeoDataset.load(root, prefer_device=False)
+    assert "t" not in ds2._stores
+    # and the next checkpoint makes the drop durable standalone
+    ds2.save(root)
+    ds3 = GeoDataset.load(root, prefer_device=False)
+    assert "t" not in ds3._stores
+
+
+# ---------------------------------------------------------------------------
+# stream resume
+# ---------------------------------------------------------------------------
+
+
+def test_stream_journal_resume_exactly_once(tmp_path):
+    from geomesa_tpu.stream.live import StreamingDataset
+    from geomesa_tpu.stream.messages import MessageBus
+
+    root = str(tmp_path)
+    bus = MessageBus()
+    sds = StreamingDataset(bus=bus, partitions=2)
+    sds.attach_journal(root)
+    sds.create_schema("t", SPEC)
+    sds.write(
+        "t",
+        {"name": ["x", "y"], "weight": [1.0, 2.0],
+         "dtg": [1577836800000, 1577836800001],
+         "geom": [(0.0, 0.0), (1.0, 1.0)]},
+        ["f1", "f2"], ts_ms=[10, 11],
+    )
+    assert sds.poll("t") == 2
+    offsets = list(sds._offsets["t"])
+    sds._journal.close()
+
+    # restart: same broker (topic retention), fresh consumer + journal
+    sds2 = StreamingDataset(bus=bus, partitions=2)
+    sds2.attach_journal(root)
+    assert sds2.recover() >= 2  # stream-create + stream-batch
+    assert "t" in sds2._schemas
+    assert len(sds2.cache("t")) == 2
+    assert sds2._offsets["t"] == offsets
+    # exactly-once: nothing replays twice out of the topic
+    assert sds2.poll("t") == 0
+    assert len(sds2.cache("t")) == 2
+
+
+def test_confluent_offset_resume(tmp_path):
+    from geomesa_tpu.stream.confluent import (
+        SchemaRegistry, attach_confluent, confluent_resume_offset,
+    )
+    from geomesa_tpu.stream.live import StreamingDataset
+    from geomesa_tpu.stream.messages import MessageBus
+
+    root = str(tmp_path)
+    bus = MessageBus()
+    sds = StreamingDataset(bus=bus, partitions=1)
+    sds.attach_journal(root)
+    sds.create_schema("t", SPEC)
+    reg = SchemaRegistry()
+    ser, ingest = attach_confluent(sds, "t", reg)
+    for off in range(3):
+        payload = ser.serialize(f"f{off}", {
+            "name": f"n{off}", "weight": 1.0, "dtg": 1577836800000 + off,
+            "geom": "POINT (0 0)",
+        })
+        assert ingest(payload, ts_ms=1577836800000 + off, offset=off)
+    assert confluent_resume_offset(sds, "t") == 2
+    sds._journal.close()
+
+    sds2 = StreamingDataset(bus=bus, partitions=1)
+    sds2.attach_journal(root)
+    sds2.recover()
+    # the restarted broker consumer seeks past every acked record
+    assert confluent_resume_offset(sds2, "t") == 2
+
+
+# ---------------------------------------------------------------------------
+# fleet epoch marker framing
+# ---------------------------------------------------------------------------
+
+
+def test_epoch_marker_roundtrip_and_legacy(tmp_path):
+    root = str(tmp_path)
+    journal_mod.write_epoch_marker(root, {"t": 3, "u": 7}, journal_seq=41)
+    epochs, seq = journal_mod.read_epoch_marker(root)
+    assert epochs == {"t": 3, "u": 7} and seq == 41
+    # v1 legacy flat dict still reads
+    with open(os.path.join(root, journal_mod.EPOCH_MARKER_FILE), "w") as fh:
+        json.dump({"t": 9}, fh)
+    epochs, seq = journal_mod.read_epoch_marker(root)
+    assert epochs == {"t": 9} and seq == 0
+
+
+def test_epoch_marker_corruption_quarantines(tmp_path):
+    root = str(tmp_path)
+    journal_mod.write_epoch_marker(root, {"t": 5}, journal_seq=1)
+    path = os.path.join(root, journal_mod.EPOCH_MARKER_FILE)
+    with open(path, "r+b") as fh:
+        fh.seek(10)
+        fh.write(b"\xff\xfe")
+    before = metrics.registry().counter(
+        metrics.FLEET_EPOCH_MARKER_QUARANTINED).value
+    epochs, seq = journal_mod.read_epoch_marker(root)
+    # safe direction: unreadable marker reads as empty (replicas refresh
+    # redundantly, never serve stale), and the evidence is kept aside
+    assert epochs == {} and seq == 0
+    assert not os.path.exists(path)
+    assert os.path.exists(path + ".quarantine")
+    assert metrics.registry().counter(
+        metrics.FLEET_EPOCH_MARKER_QUARANTINED).value > before
+
+
+# ---------------------------------------------------------------------------
+# recovery interop
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_truncates_journal_segments(tmp_path):
+    root = str(tmp_path)
+    ds = GeoDataset(prefer_device=False)
+    ds.attach_journal(root)
+    ds.create_schema("t", SPEC)
+    for s in range(4):
+        ds.insert("t", _data(64, s), fids=[f"s{s}_{i}" for i in range(64)])
+    ds.flush()
+    before = sum(os.path.getsize(p) for p in _seg_paths(root))
+    ds.save(root)
+    after = sum(os.path.getsize(p) for p in _seg_paths(root))
+    assert after < before  # checkpoint reclaimed covered segments
+    # and nothing replays on the next load
+    ds2 = GeoDataset.load(root, prefer_device=False)
+    assert ds2._journal_replayed == 0
+    assert ds2.count("t") == ds.count("t")
+
+
+def test_replay_bit_identical_values(tmp_path):
+    """Journal replay must reproduce the exact column values (the tagged
+    codec round-trips dates, floats, and strings losslessly)."""
+    root = str(tmp_path)
+    data = _data(32, 7)
+    ds = GeoDataset(prefer_device=False)
+    ds.attach_journal(root)
+    ds.create_schema("t", SPEC)
+    ds.insert("t", data, fids=[f"f{i}" for i in range(32)])
+    ds.flush()
+    ds2 = GeoDataset.load(root, prefer_device=False)
+    a = ds.query("t", "INCLUDE").batch
+    b = ds2.query("t", "INCLUDE").batch
+    assert a.n == b.n
+    for k, col in a.columns.items():
+        got = b.columns[k]
+        if getattr(col, "dtype", None) is not None and col.dtype.kind == "f":
+            np.testing.assert_array_equal(col, got)  # bit-identical, NaN-safe
+        else:
+            assert list(map(str, col)) == list(map(str, got)), k
