@@ -264,6 +264,10 @@ class GeoDataset:
         self._ckpt_fp: Dict[str, int] = {}
         #: records re-applied by the last load()/replay (CLI/bench surface)
         self._journal_replayed = 0
+        #: standing-query engine (geomesa_tpu/subscribe/; docs/STANDING.md)
+        #: — created lazily on the first subscribe() so datasets that never
+        #: register a viewport pay nothing on the ingest path
+        self.standing = None
 
     # -- schema CRUD (MetadataBackedDataStore analog) ----------------------
     def create_schema(self, name_or_ft, spec: Optional[str] = None) -> FeatureType:
@@ -301,6 +305,8 @@ class GeoDataset:
         # next checkpoint, replay must not resurrect the schema from its
         # still-on-disk files
         self._journal_rec("delete-schema", name)
+        if self.standing is not None:
+            self.standing.drop_schema(name)
         # drop the schema's cached aggregates: its uid is never accessed
         # again, so neither epoch sync nor the per-uid LRU could reclaim them
         self.cache.store.invalidate(st.uid)
@@ -456,7 +462,15 @@ class GeoDataset:
                 fids=None if fids is None else _jr.enc_value(fids, sink),
                 vis=None if visibilities is None
                 else _jr.enc_value(visibilities, sink))
-        n = st.append(data, fids, visibilities)
+        # standing-query delta hook (docs/STANDING.md): the observer sees
+        # the ENCODED batch inside append — the same columns a re-scan of
+        # the window reads — so delta evaluation is race-free and fires on
+        # journal replay too (fleet catch-up advances standing results
+        # through this same edge)
+        obs = None
+        if self.standing is not None and self.standing.active(name):
+            obs = lambda b: self.standing.on_batch(name, b.columns, b.n)
+        n = st.append(data, fids, visibilities, observer=obs)
         metrics.registry().counter("ingest.features").inc(n)
         return n
 
@@ -568,7 +582,12 @@ class GeoDataset:
         # for callers that passed a relative/now-derived value
         self._journal_rec("age-off", name, older_than_ms=cutoff)
         st.flush()
-        return st.delete(lambda cols: cols[dtg] < cutoff)
+        pred = lambda cols: cols[dtg] < cutoff
+        bounds = self._standing_dirty_bounds(name, st, pred)
+        n = st.delete(pred)
+        if n and self.standing is not None and self.standing.active(name):
+            self.standing.on_dirty(name, bounds)
+        return n
 
     def delete_features(self, name: str, ecql: str,
                         auths: Optional[Sequence[str]] = None) -> int:
@@ -584,9 +603,85 @@ class GeoDataset:
         cf = self._vis_wrap(st, cf, self._effective_auths(Query(auths=auths)))
         # exact_mask applies the extent-geometry refinement pass — deletes
         # must never act on the coarse bbox superset
-        return st.delete(
-            lambda cols: cf.exact_mask(cols, len(cols["__fid__"]))
+        pred = lambda cols: cf.exact_mask(cols, len(cols["__fid__"]))
+        bounds = self._standing_dirty_bounds(name, st, pred)
+        n = st.delete(pred)
+        if n and self.standing is not None and self.standing.active(name):
+            # deletes are non-additive: standing groups intersecting the
+            # removed rows' bounds re-scan; disjoint groups are untouched
+            self.standing.on_dirty(name, bounds)
+        return n
+
+    def _standing_dirty_bounds(self, name: str, st: FeatureStore, pred):
+        """BBox of the rows ``pred`` is about to remove — the dirty extent
+        a non-additive mutation scopes standing re-scans to (docs/
+        STANDING.md). None = no standing groups, or unknown extent."""
+        if self.standing is None or not self.standing.active(name):
+            return None
+        st.flush()
+        if st._all is None or not st._all.n:
+            return None
+        g = st.ft.geom_field
+        cols = st._all.columns
+        if g is None or g + "__x" not in cols:
+            return None
+        try:
+            m = np.asarray(pred(cols)).astype(bool)
+        except Exception:
+            return None
+        xs = cols[g + "__x"][m]
+        ys = cols[g + "__y"][m]
+        ok = np.isfinite(xs) & np.isfinite(ys)
+        if not ok.any():
+            return None
+        xs, ys = xs[ok], ys[ok]
+        return (float(xs.min()), float(ys.min()),
+                float(xs.max()), float(ys.max()))
+
+    # -- standing queries (geomesa_tpu/subscribe/; docs/STANDING.md) -------
+    def _standing_engine(self):
+        if self.standing is None:
+            from geomesa_tpu.subscribe import (
+                StandingQueryEngine, StoreWindow,
+            )
+
+            self.standing = StandingQueryEngine(
+                lambda nm: StoreWindow(self, nm)
+            )
+        return self.standing
+
+    def subscribe(self, name: str, aggregate: str, bbox=None, region=None,
+                  width: int = 256, height: int = 256,
+                  levels: Optional[int] = None,
+                  stat_spec: Optional[str] = None,
+                  sub_id: Optional[str] = None) -> str:
+        """Register a standing viewport: every applied ingest batch then
+        updates the result incrementally instead of re-scanning (docs/
+        STANDING.md). Same-viewport subscribers fuse into one standing
+        group. Returns the subscription id (its prefix is the fleet ring
+        route key). NOTE: standing results are visibility-unrestricted —
+        they aggregate every row of the window."""
+        from geomesa_tpu.subscribe import spec as subspec
+
+        sp = subspec.make_spec(
+            name, aggregate, bbox=bbox, region=region, width=width,
+            height=height, levels=levels, stat_spec=stat_spec,
         )
+        self._store(name)  # raise on unknown schema before registering
+        return self._standing_engine().register(sp, sub_id=sub_id)
+
+    def unsubscribe(self, sub_id: str) -> bool:
+        if self.standing is None:
+            return False
+        return self.standing.unregister(sub_id)
+
+    def subscription_poll(self, sub_id: str, cursor: int = 0):
+        """Current standing result + update records past ``cursor``."""
+        from geomesa_tpu.subscribe import UnknownSubscription
+
+        if self.standing is None:
+            raise UnknownSubscription(sub_id)
+        return self.standing.poll(sub_id, cursor)
 
     # -- planning ----------------------------------------------------------
     def _effective_auths(self, q: Query) -> Optional[List[str]]:
@@ -2678,6 +2773,13 @@ class GeoDataset:
                 k: v for k, v in key_cols.items()
                 if k not in st._all.columns
             }
+        self._standing_reattach(name)
+
+    def _standing_reattach(self, name: str) -> None:
+        if self.standing is not None and self.standing.active(name):
+            # the store object was swapped under the standing groups:
+            # recompile viewports against the fresh dicts and re-scan
+            self.standing.reattach(name)
 
     def refresh_schema(self, name: str, path: str) -> bool:
         """Replace schema ``name``'s in-memory state with what the shared

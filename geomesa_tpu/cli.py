@@ -624,6 +624,107 @@ def cmd_fleet(args):
     return 2
 
 
+def _summarize_standing(spec_agg, encoded):
+    """Human-readable one-liner for a wire-encoded standing result."""
+    from geomesa_tpu.cache.store import decode_wire_value
+
+    try:
+        val = decode_wire_value(encoded)
+    except Exception:
+        return str(encoded)[:120]
+    if spec_agg == "count":
+        return f"count={int(val)}"
+    if spec_agg == "density":
+        return (f"density sum={float(val.sum()):.0f} "
+                f"nonzero={int((val > 0).sum())} shape={val.shape}")
+    if spec_agg == "pyramid":
+        grids = val if isinstance(val, tuple) else (val,)
+        return (f"pyramid levels={len(grids)} "
+                f"leaf_sum={float(grids[0].sum()):.0f}")
+    return f"stats={str(val)[:160]}"
+
+
+def cmd_subscribe(args):
+    """``subscribe`` — register a standing viewport against a sidecar
+    (or a fleet of replicas via an ad-hoc router) and stream its update
+    records: the server maintains the aggregate incrementally per
+    applied ingest batch (docs/STANDING.md; PROTOCOL §5 v1.6), so each
+    poll carries only the update records past the client's cursor."""
+    import time as _time
+
+    bbox = None
+    if args.bbox:
+        bbox = [float(v) for v in args.bbox.split(",")]
+        if len(bbox) != 4:
+            raise SystemExit("--bbox wants xmin,ymin,xmax,ymax")
+
+    if args.replicas:
+        from geomesa_tpu.fleet import FleetRouter
+
+        target = FleetRouter(_parse_replicas(args.replicas))
+
+        def register():
+            return target.subscribe(
+                args.feature_name, args.aggregate, bbox=bbox,
+                region=args.region, width=args.width, height=args.height,
+                levels=args.levels, stat_spec=args.stat,
+            )
+
+        poll = target.subscription_poll
+        unsub = target.unsubscribe
+    else:
+        from geomesa_tpu.sidecar import GeoFlightClient
+
+        target = GeoFlightClient(
+            f"grpc+tcp://{args.host}:{args.port}"
+        )
+
+        def register():
+            return target.subscribe(
+                args.feature_name, args.aggregate, bbox=bbox,
+                region=args.region, width=args.width, height=args.height,
+                levels=args.levels, stat_spec=args.stat,
+            )
+
+        poll = target.subscribe_poll
+        unsub = target.unsubscribe
+    try:
+        sub_id = register()
+        got = poll(sub_id, 0)
+        cursor = int(got["version"])
+        print(json.dumps({
+            "sub_id": sub_id, "version": cursor,
+            "epoch": got.get("epoch"),
+            "subscribers": got.get("subscribers"),
+            "result": _summarize_standing(args.aggregate, got["result"]),
+        }, sort_keys=True), flush=True)
+        if args.once:
+            return 0
+        seen = 0
+        while args.max_updates is None or seen < args.max_updates:
+            _time.sleep(args.poll_interval)
+            got = poll(sub_id, cursor)
+            for u in got.get("updates") or []:
+                print(json.dumps({
+                    "version": u["version"], "kind": u["kind"],
+                    "rows": u.get("rows"), "epoch": u.get("epoch"),
+                    "result": _summarize_standing(
+                        args.aggregate, got["result"]
+                    ),
+                }, sort_keys=True), flush=True)
+                seen += 1
+            cursor = int(got["version"])
+        return 0
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        try:
+            unsub(sub_id)
+        except Exception:
+            pass
+        target.close()
+
+
 def cmd_journal(args):
     """``journal`` subcommands (docs/RESILIENCE.md §8):
 
@@ -1008,6 +1109,33 @@ def build_parser() -> argparse.ArgumentParser:
                     help="hottest-entry cap (default: all current-epoch "
                     "entries)")
     fp.set_defaults(fn=cmd_fleet)
+
+    sp = sub.add_parser("subscribe", help="register a standing viewport "
+                        "on a sidecar (or fleet) and stream its "
+                        "incremental updates (docs/STANDING.md)")
+    sp.add_argument("-f", "--feature-name", required=True)
+    sp.add_argument("--aggregate", default="count",
+                    choices=["count", "density", "pyramid", "stats"])
+    sp.add_argument("--bbox", help="xmin,ymin,xmax,ymax viewport")
+    sp.add_argument("--region", help="WKT polygon viewport (exact "
+                    "membership, like region= queries)")
+    sp.add_argument("--width", type=int, default=256)
+    sp.add_argument("--height", type=int, default=256)
+    sp.add_argument("--levels", type=int, default=None,
+                    help="pyramid depth (aggregate=pyramid)")
+    sp.add_argument("--stat", help="stats spec, e.g. Count() "
+                    "(aggregate=stats; exact-merge sketches only)")
+    sp.add_argument("--host", default="127.0.0.1")
+    sp.add_argument("--port", type=int, default=8815)
+    sp.add_argument("--replicas", help="id=host:port,... — route via an "
+                    "ad-hoc fleet router instead of one sidecar")
+    sp.add_argument("--poll-interval", type=float, default=1.0)
+    sp.add_argument("--max-updates", type=int, default=None,
+                    help="exit after N update records (default: stream "
+                    "until interrupted)")
+    sp.add_argument("--once", action="store_true",
+                    help="print the registration snapshot and exit")
+    sp.set_defaults(fn=cmd_subscribe)
 
     sp = sub.add_parser("journal", help="durable mutation journal: "
                         "status + crash recovery (docs/RESILIENCE.md §8)")

@@ -706,6 +706,35 @@ FLEET_ROUTING_LEVEL = SystemProperty("geomesa.fleet.routing.level", "3")
 #: to one replica.
 FLEET_SCATTER = SystemProperty("geomesa.fleet.scatter", "true")
 
+# -- standing queries (geomesa_tpu/subscribe/; docs/STANDING.md) -----------
+
+#: Master switch for the subscription subsystem: off, registrations raise
+#: and mutation hooks are no-ops (zero ingest-path overhead).
+SUBSCRIBE_ENABLED = SystemProperty("geomesa.subscribe.enabled", "true")
+
+#: Hard-assert every incremental (delta-applied) standing result against a
+#: from-scratch re-scan at the same epoch after EVERY settle — the
+#: bit-identity contract, paid as a full re-scan per update. On in tests
+#: and the standing-smoke CI gate; off in production serving.
+SUBSCRIBE_VERIFY = SystemProperty("geomesa.subscribe.verify", "false")
+
+#: Maximum DISTINCT standing groups per schema (fused subscribers share a
+#: group, so 10k watchers of one hot viewport cost one slot). Registration
+#: past the cap answers a typed [GM-SUB-LIMIT] error.
+SUBSCRIBE_MAX_GROUPS = SystemProperty("geomesa.subscribe.max.groups", "256")
+
+#: Update-ring depth per group: how many per-batch update records a slow
+#: poller may lag before the ring truncates (a truncated poller sees a
+#: version gap and should re-anchor on the carried full result).
+SUBSCRIBE_UPDATES_RING = SystemProperty("geomesa.subscribe.updates.ring",
+                                        "256")
+
+#: Quadtree-rollup pyramid depth: the leaf grid is 2^levels x 2^levels
+#: and downsample-adds up to the 1x1 root (cache/hierarchy.downsample,
+#: fixed SW/SE/NW/NE order).
+SUBSCRIBE_PYRAMID_LEVELS = SystemProperty("geomesa.subscribe.pyramid.levels",
+                                          "5")
+
 #: Concurrent owner-group dispatches per scattered query (the router's
 #: fan-out thread bound). "1" serializes the groups (still scattered,
 #: no parallel wall-clock win).

@@ -42,6 +42,7 @@ from __future__ import annotations
 import contextlib
 import threading
 import time
+import uuid
 import weakref
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -174,6 +175,9 @@ class FleetRouter:
         # keep the already-dialed client (add_replica dropped any OLD one)
         with self._clients_lock:
             self._clients[rid] = c
+        # JOIN migration (docs/STANDING.md): standing groups whose route
+        # key the new member now owns move over before it serves polls
+        self._pull_subscriptions(rid)
         self._count("joined")
         metrics.inc(metrics.FLEET_MEMBER_JOIN)
         return rid
@@ -299,6 +303,10 @@ class FleetRouter:
                     metrics.inc(metrics.FLEET_HANDOFF_ENTRIES, restored)
             except Exception as e:
                 summary[sname] = {"error": repr(e)[:200]}
+        # standing subscriptions migrate with the cache (docs/STANDING.md)
+        summary["subscriptions"] = self._subscription_handoff(
+            src, survivors, ring_after
+        )
         return summary
 
     # -- admin -------------------------------------------------------------
@@ -1264,6 +1272,149 @@ class FleetRouter:
                 lambda c: c.insert_arrow(name, table),
                 user=user, write=True,
             )
+
+    # -- standing subscriptions (docs/STANDING.md; PROTOCOL §5 v1.6) -------
+    def subscribe(self, name: str, aggregate: str, bbox=None,
+                  region: Optional[str] = None, width: int = 256,
+                  height: int = 256, levels: Optional[int] = None,
+                  stat_spec: Optional[str] = None,
+                  user: Optional[str] = None) -> str:
+        """Register a standing viewport on its RING OWNER: the sub_id is
+        minted router-side from the viewport's route key (the center
+        cell at the routing level — the same key family the cache and
+        scatter paths use), so every later poll/unsubscribe re-derives
+        the owner from the id alone, across membership changes."""
+        from geomesa_tpu.subscribe import spec as subspec
+
+        sp = subspec.make_spec(
+            name, aggregate, bbox=bbox, region=region, width=width,
+            height=height, levels=levels, stat_spec=stat_spec,
+        )
+        key = sp.route_key(self._routing_level())
+        sub_id = f"{key}:{uuid.uuid4().hex[:12]}"
+        return self._route(
+            name, key, "subscribe",
+            lambda c: c.subscribe(
+                name, aggregate, bbox=list(sp.bbox), region=sp.region,
+                width=sp.width, height=sp.height, levels=sp.levels,
+                stat_spec=sp.stat_spec, sub_id=sub_id,
+            ),
+            user=user,
+        )
+
+    def _route_subscription(self, sub_id: str, op: str,
+                            fn: Callable[[Any], Any],
+                            user: Optional[str] = None):
+        """Owner-order failover keyed by the sub_id's EMBEDDED route key.
+        ``[GM-SUB-UNKNOWN]`` is not failure evidence — the replica is
+        healthy, the subscription just lives elsewhere after a
+        membership change — so it walks to the next ring owner without
+        charging the breaker; real failures classify as usual."""
+        from geomesa_tpu.subscribe import route_key_of
+
+        key = route_key_of(sub_id)
+        name = sub_id.split(":", 1)[0]
+        with self._admit(op, user=user), \
+                tracing.start(f"fleet.{op}", schema=name):
+            last: Optional[BaseException] = None
+            unknown = False
+            for rid in self._owners(key):
+                try:
+                    out = fn(self._client(rid))
+                except Exception as e:
+                    if "[GM-SUB-UNKNOWN]" in str(e):
+                        last, unknown = e, True
+                        continue
+                    kind = self._classify(rid, e, write=False)
+                    if kind == "raise":
+                        raise
+                    last = e
+                    continue
+                self.registry.record_success(rid)
+                return out
+            if unknown:
+                raise KeyError(
+                    f"[GM-SUB-UNKNOWN] no ring owner holds subscription "
+                    f"{sub_id!r}"
+                )
+            return self._degrade(name, op, last, None)
+
+    def subscription_poll(self, sub_id: str, cursor: int = 0,
+                          user: Optional[str] = None) -> Dict:
+        """Current standing result + updates past ``cursor`` from
+        whichever ring owner holds the subscription."""
+        return self._route_subscription(
+            sub_id, "subscribe-poll",
+            lambda c: c.subscribe_poll(sub_id, cursor=cursor), user=user,
+        )
+
+    def unsubscribe(self, sub_id: str,
+                    user: Optional[str] = None) -> bool:
+        return bool(self._route_subscription(
+            sub_id, "unsubscribe", lambda c: c.unsubscribe(sub_id),
+            user=user,
+        ))
+
+    def _subscription_handoff(self, src, survivors: List[str],
+                              ring_after) -> Dict[str, Any]:
+        """LEAVE half of standing-query migration: export every standing
+        group from the drained replica (subscribe-export is admin — it
+        answers mid-drain, like cache-export) and import each group on
+        its route key's POST-REMOVAL ring owner. A matching guard adopts
+        results + update rings verbatim (zero missed, zero duplicated
+        updates); a mismatch re-scans on the new owner (``resync``)."""
+        try:
+            exported = src.subscribe_export()
+        except Exception as e:
+            return {"error": repr(e)[:200]}
+        groups = exported.get("groups") or []
+        if not groups:
+            return {"groups": 0}
+        guards = exported.get("guards") or {}
+        by_dest: Dict[str, list] = {}
+        for g in groups:
+            by_dest.setdefault(
+                ring_after.owner(g["route_key"]), []
+            ).append(g)
+        out: Dict[str, Any] = {"groups": len(groups),
+                               "adopted": 0, "resynced": 0,
+                               "to": sorted(by_dest)}
+        for dest in sorted(by_dest):
+            try:
+                got = self._client(dest).subscribe_import(
+                    {"groups": by_dest[dest], "guards": guards}
+                )
+            except Exception as e:
+                out.setdefault("errors", {})[dest] = repr(e)[:200]
+                continue
+            out["adopted"] += int(got.get("adopted", 0))
+            out["resynced"] += int(got.get("resynced", 0))
+        return out
+
+    def _pull_subscriptions(self, rid: str) -> None:
+        """JOIN half of standing-query migration: route keys the NEW
+        replica now owns move from their previous owners — export with
+        ``remove=True`` (the source drops them atomically under its
+        engine lock) then import here. Best effort: a failed move leaves
+        the group where it was, and polls still find it because the old
+        owner stays on the key's ring walk."""
+        for src in list(self.ring.members):
+            if src == rid:
+                continue
+            try:
+                c = self._client(src)
+                snap = c.subscribe_export()
+                keys = sorted({
+                    g["route_key"] for g in snap.get("groups") or []
+                    if self.ring.owner(g["route_key"]) == rid
+                })
+                if not keys:
+                    continue
+                moved = c.subscribe_export(keys=keys, remove=True)
+                if moved.get("groups"):
+                    self._client(rid).subscribe_import(moved)
+            except Exception:
+                continue
 
     # -- fleet-wide views --------------------------------------------------
     def replica_metrics(self) -> Dict[str, Dict]:

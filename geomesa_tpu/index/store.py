@@ -479,12 +479,17 @@ class FeatureStore:
         return out
 
     # -- write path --------------------------------------------------------
-    def append(self, data: Dict, fids=None, visibilities=None) -> int:
+    def append(self, data: Dict, fids=None, visibilities=None,
+               observer=None) -> int:
         """Buffer an ingest batch (encoded immediately; keys at flush).
 
         ``visibilities``: per-feature visibility expression(s) — one string
         for the whole batch or a sequence per feature (geomesa-security
-        analog; dictionary-encoded into the ``__vis__`` code column)."""
+        analog; dictionary-encoded into the ``__vis__`` code column).
+
+        ``observer``: optional callable handed the ENCODED ColumnBatch
+        after it buffers — the standing-query delta hook (docs/
+        STANDING.md) reads the exact columns a window re-scan would."""
         from geomesa_tpu.security import VIS_COLUMN, parse_visibility
 
         batch = encode_batch(self.ft, data, self.dicts, fids)
@@ -503,6 +508,8 @@ class FeatureStore:
         batch.columns[VIS_COLUMN] = vis
         with self._lock:
             self._buffer.append(batch)
+        if observer is not None:
+            observer(batch)
         return batch.n
 
     @property

@@ -490,6 +490,41 @@ FUSION_BATCH_BUCKETS = (1.0, 2.0, 3.0, 4.0, 6.0, 8.0, 12.0, 16.0, 24.0, 32.0)
 #   stream.apply        histogram: per-poll apply-phase latency
 STREAM_LAG = "stream.lag"
 STREAM_APPLY = "stream.apply"
+#   stream.epoch.<schema>   gauge: the live window's mutation epoch — the
+#                           staleness anchor standing subscriptions and
+#                           window-aggregate caches key on (stream/live.py)
+#   stream.poll.batches     counter: applied (non-empty) poll batches
+STREAM_EPOCH = "stream.epoch"
+STREAM_POLL_BATCHES = "stream.poll.batches"
+# Standing subscriptions (geomesa_tpu/subscribe/; docs/STANDING.md):
+#   subscribe.groups            gauge: distinct standing groups resident
+#   subscribe.subscribers       gauge: registered subscribers (all groups)
+#   subscribe.update.dispatches counter: delta evaluation passes — ONE per
+#                               applied ingest batch per schema, however
+#                               many fused subscribers watch (the CI-gated
+#                               one-dispatch contract)
+#   subscribe.updates           counter: update records emitted to rings
+#   subscribe.rescans           counter: dirty-scoped from-scratch rescans
+#                               (deletes, age-off, guard-mismatch imports)
+#   subscribe.fused             counter: registrations absorbed into an
+#                               existing group (serving-fusion analog)
+#   subscribe.verify            counter: delta-vs-rescan bit-identity
+#                               assertions run (geomesa.subscribe.verify)
+#   subscribe.handoff.exported  counter: groups exported for warm handoff
+#   subscribe.handoff.imported  counter: groups adopted verbatim (guard
+#                               matched) on import
+#   subscribe.handoff.resync    counter: groups re-scanned on import
+#                               (guard mismatch -> resync update)
+SUBSCRIBE_GROUPS = "subscribe.groups"
+SUBSCRIBE_SUBSCRIBERS = "subscribe.subscribers"
+SUBSCRIBE_DISPATCHES = "subscribe.update.dispatches"
+SUBSCRIBE_UPDATES = "subscribe.updates"
+SUBSCRIBE_RESCANS = "subscribe.rescans"
+SUBSCRIBE_FUSED = "subscribe.fused"
+SUBSCRIBE_VERIFY = "subscribe.verify"
+SUBSCRIBE_HANDOFF_EXPORTED = "subscribe.handoff.exported"
+SUBSCRIBE_HANDOFF_IMPORTED = "subscribe.handoff.imported"
+SUBSCRIBE_HANDOFF_RESYNC = "subscribe.handoff.resync"
 CACHE_PARTIAL = "cache.partial"
 CACHE_MISS = "cache.miss"
 CACHE_PUT = "cache.put"

@@ -258,12 +258,21 @@ def debug_queries(dataset=None, n: int = 50, user: Optional[str] = None,
         slow = [s for s in slow if s.get("tree", {}).get("name") == op]
     if user is not None:
         slow = [s for s in slow if s.get("trace_id") in (user_tids or ())]
+    subscriptions: Dict[str, Any] = {"groups": [], "subscribers": 0}
+    eng = getattr(dataset, "standing", None) if dataset is not None else None
+    if eng is not None:
+        # standing-group residency + versions (docs/STANDING.md): with
+        # the stream.epoch.<schema> gauges in /metrics, this is the
+        # subscription-staleness view — a group whose epoch trails its
+        # schema's gauge has updates it hasn't settled yet
+        subscriptions = eng.snapshot()
     return {
         "queries": events,
         "degradations": degraded,
         "slow_traces": slow[-n:],
         "users": users,
         "serving": serving,
+        "subscriptions": subscriptions,
     }
 
 

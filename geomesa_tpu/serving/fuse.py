@@ -178,6 +178,24 @@ def fuse_key(op: str, schema: str, opts: Dict[str, Any],
     return None
 
 
+def subscription_key(spec) -> tuple:
+    """The standing-subscriber fusion identity (docs/STANDING.md,
+    docs/SERVING.md "Subscriber fusion"): subscribers whose specs share
+    this key ride ONE standing group — one result, one update ring, one
+    delta evaluation per ingest batch, however many watchers. The same
+    allow-list philosophy as :func:`fuse_key`: every result-affecting
+    spec field is IN the key (viewport bbox as exact float reprs, region
+    WKT text, grid dims, pyramid depth, stat spec), so two subscriptions
+    fuse iff their results are provably byte-identical forever."""
+    return (
+        "standing", spec.schema, spec.aggregate,
+        tuple(repr(float(v)) for v in spec.bbox),
+        spec.region,
+        int(spec.width), int(spec.height), int(spec.levels),
+        spec.stat_spec,
+    )
+
+
 def make_spec(ds, op: str, schema: str,
               opts: Dict[str, Any]) -> Optional[FuseSpec]:
     """A :class:`FuseSpec` whose batch executor returns RAW results (ints,

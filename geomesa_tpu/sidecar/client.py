@@ -410,6 +410,65 @@ class GeoFlightClient:
             "name": name, "guard": guard, "entries": entries,
         })
 
+    def subscribe(self, name: str, aggregate: str, bbox=None,
+                  region: Optional[str] = None, width: int = 256,
+                  height: int = 256, levels: Optional[int] = None,
+                  stat_spec: Optional[str] = None,
+                  sub_id: Optional[str] = None) -> str:
+        """Register a standing viewport on the sidecar (docs/STANDING.md;
+        PROTOCOL §5 v1.6): every applied ingest batch then updates the
+        result incrementally. Returns the subscription id."""
+        body: Dict = {"name": name, "aggregate": aggregate,
+                      "width": int(width), "height": int(height)}
+        if bbox is not None:
+            body["bbox"] = [float(v) for v in bbox]
+        if region is not None:
+            body["region"] = region
+        if levels is not None:
+            body["levels"] = int(levels)
+        if stat_spec is not None:
+            body["stat_spec"] = stat_spec
+        if sub_id is not None:
+            body["sub_id"] = sub_id
+        return self._action("subscribe", body)["sub_id"]
+
+    def unsubscribe(self, sub_id: str) -> bool:
+        return bool(self._action("unsubscribe",
+                                 {"sub_id": sub_id})["removed"])
+
+    def subscribe_poll(self, sub_id: str, cursor: int = 0) -> Dict:
+        """Current standing result (wire-encoded) plus every update
+        record past ``cursor``. ``[GM-SUB-UNKNOWN]`` means this replica
+        does not own the subscription (it migrated) — fleet routers fail
+        over to the next ring owner."""
+        return self._action("subscribe-poll",
+                            {"sub_id": sub_id, "cursor": int(cursor)})
+
+    def subscribe_stats(self) -> Dict:
+        """Standing-query groups + subscriber counts (operator view)."""
+        return self._action("subscribe-stats")["subscriptions"]
+
+    def subscribe_export(self, name: Optional[str] = None,
+                         keys: Optional[Sequence[str]] = None,
+                         remove: bool = False) -> Dict:
+        """Warm-handoff source for standing results (docs/STANDING.md):
+        wire-encoded groups + per-schema guards. Served mid-drain, like
+        ``cache_export``. ``remove=True`` drops the exported groups from
+        the source (the leaver's half of a migration)."""
+        body: Dict = {}
+        if name is not None:
+            body["name"] = name
+        if keys is not None:
+            body["keys"] = list(keys)
+        if remove:
+            body["remove"] = True
+        return self._action("subscribe-export", body)
+
+    def subscribe_import(self, payload: Dict) -> Dict:
+        """Warm-handoff sink: adopt exported standing groups verbatim iff
+        the per-schema guard matches, else re-scan locally (``resync``)."""
+        return self._action("subscribe-import", payload)
+
     def explain(self, name: str, ecql: str = "INCLUDE") -> str:
         return self._action("explain", {"name": name, "ecql": ecql})["explain"]
 

@@ -864,6 +864,9 @@ class GeoFlightServer(fl.FlightServerBase):
         # a DRAINING replica must still export its hot entries: the warm
         # handoff runs after drain (docs/RESILIENCE.md §7)
         "cache-export",
+        # same rule for standing-query migration (docs/STANDING.md):
+        # subscriptions leave a drained replica via subscribe-export
+        "subscribe-export", "subscribe-stats",
     })
 
     def _speculative_count_frame(self, body: Dict,
@@ -1000,6 +1003,61 @@ class GeoFlightServer(fl.FlightServerBase):
                 st.uid, st.version, body.get("entries") or []
             )
             return ok({"name": name, "restored": n})
+        if kind == "subscribe":
+            # standing viewport registration (docs/STANDING.md; PROTOCOL
+            # §5 v1.6). The router pre-computes the sub_id so the route
+            # key is decided fleet-side; direct clients omit it and the
+            # engine derives one from the viewport's center cell.
+            sid = ds.subscribe(
+                body["name"], body["aggregate"],
+                bbox=body.get("bbox"), region=body.get("region"),
+                width=int(body.get("width", 256)),
+                height=int(body.get("height", 256)),
+                levels=body.get("levels"),
+                stat_spec=body.get("stat_spec"),
+                sub_id=body.get("sub_id"),
+            )
+            return ok({"sub_id": sid})
+        if kind == "unsubscribe":
+            return ok({"sub_id": body["sub_id"],
+                       "removed": ds.unsubscribe(body["sub_id"])})
+        if kind == "subscribe-poll":
+            from geomesa_tpu.subscribe import UnknownSubscription
+
+            try:
+                out = ds.subscription_poll(
+                    body["sub_id"], cursor=int(body.get("cursor", 0))
+                )
+            except UnknownSubscription as e:
+                # typed so the fleet router fails over to the next ring
+                # owner instead of surfacing a fatal GM-ARG: after a
+                # membership change the subscription lives elsewhere
+                raise fl.FlightServerError(
+                    f"[GM-SUB-UNKNOWN] {e.args[0] if e.args else e}"
+                ) from e
+            return ok(out)
+        if kind == "subscribe-stats":
+            eng = getattr(ds, "standing", None)
+            snap = (eng.snapshot() if eng is not None
+                    else {"groups": [], "subscribers": 0})
+            return ok({"subscriptions": snap})
+        if kind == "subscribe-export":
+            # warm-handoff source for STANDING results (docs/STANDING.md,
+            # RESILIENCE.md §7): like cache-export, admin — the migration
+            # runs after drain. Unregistered engines export nothing.
+            eng = getattr(ds, "standing", None)
+            if eng is None:
+                return ok({"groups": [], "guards": {}})
+            return ok(eng.export_groups(
+                schema=body.get("name"), keys=body.get("keys"),
+                remove=bool(body.get("remove")),
+            ))
+        if kind == "subscribe-import":
+            # warm-handoff sink: adopt exported standing groups verbatim
+            # iff the per-schema {count, spec} guard matches (the
+            # cache-import rule); otherwise re-scan locally ("resync")
+            out = ds._standing_engine().import_groups(body)
+            return ok(out)
         if kind == "serving-stats":
             # queue depth + per-user ledger (docs/SERVING.md; the same
             # rollup /debug/queries exposes)
@@ -1096,6 +1154,18 @@ class GeoFlightServer(fl.FlightServerBase):
                              "{name, guard, entries}"),
             ("replica-status", "fleet-replica identity, drain state, and "
                                "per-schema fleet epochs"),
+            ("subscribe", "register a standing viewport: {name, aggregate, "
+                          "bbox|region, width, height, levels, stat_spec, "
+                          "sub_id?} -> {sub_id}"),
+            ("unsubscribe", "drop a standing subscription: {sub_id}"),
+            ("subscribe-poll", "current standing result + updates past "
+                               "cursor: {sub_id, cursor}"),
+            ("subscribe-stats", "standing-query groups + subscriber counts"),
+            ("subscribe-export", "warm-handoff source: standing groups + "
+                                 "per-schema guards: {name?, keys?, remove?}"),
+            ("subscribe-import", "warm-handoff sink: adopt exported groups "
+                                 "iff the guard matches, else resync: "
+                                 "{groups, guards}"),
         ]
 
     # -- discovery ---------------------------------------------------------

@@ -65,6 +65,11 @@ class LiveFeatureCache:
         #: — the invalidation key for anything caching aggregates over the
         #: live window (same contract as FeatureStore.version; docs/CACHE.md)
         self.epoch = 0
+        #: standing-query event hook (docs/STANDING.md): called as
+        #: ``observer(event, fid, old_attrs, new_attrs)`` for every APPLIED
+        #: mutation (stale-dropped puts don't fire) — the subscribe
+        #: engine's delta feed. None = no subscriptions, zero overhead.
+        self.observer: Optional[Callable] = None
 
     def __len__(self):
         return len(self._state)
@@ -101,16 +106,24 @@ class LiveFeatureCache:
                 return  # event-time ordering: drop stale update
             self._state[fid] = (ts_ms, attrs)
             self._invalidate()
+        if self.observer is not None:
+            # old attrs distinguish a MOVE (delta -old/+new) from an add
+            self.observer("put", fid, cur[1] if cur else None, attrs)
 
     def remove(self, fid: str):
         with self._lock:
-            if self._state.pop(fid, None) is not None:
+            old = self._state.pop(fid, None)
+            if old is not None:
                 self._invalidate()
+        if old is not None and self.observer is not None:
+            self.observer("remove", fid, old[1], None)
 
     def clear(self):
         with self._lock:
             self._state.clear()
             self._invalidate()
+        if self.observer is not None:
+            self.observer("clear", None, None, None)
 
     def expire(self, now_ms: Optional[int] = None) -> int:
         """Drop features older than the event-time expiry. Returns #dropped."""
@@ -119,11 +132,16 @@ class LiveFeatureCache:
         now_ms = int(time.time() * 1000) if now_ms is None else now_ms
         cutoff = now_ms - self.expiry_ms
         with self._lock:
-            stale = [f for f, (ts, _) in self._state.items() if ts < cutoff]
-            for f in stale:
+            stale = [(f, self._state[f][1]) for f, (ts, _)
+                     in self._state.items() if ts < cutoff]
+            for f, _ in stale:
                 del self._state[f]
             if stale:
                 self._invalidate()
+        if stale and self.observer is not None:
+            # expiry is the stream's age-off: non-additive, dirty-scoped
+            for f, old in stale:
+                self.observer("remove", f, old, None)
         return len(stale)
 
     def _invalidate(self):
@@ -267,6 +285,9 @@ class StreamingDataset:
         #: a restarted consumer resumes exactly where the crashed one acked.
         self._journal = None
         self._replaying = False
+        #: standing-query engine over the live windows (docs/STANDING.md);
+        #: created lazily on the first subscribe()
+        self.standing = None
 
     # -- durability --------------------------------------------------------
     def attach_journal(self, root: str) -> None:
@@ -371,6 +392,53 @@ class StreamingDataset:
     def add_listener(self, name: str, fn: Callable[[GeoMessage], None]):
         self._listeners[name].append(fn)
 
+    # -- standing queries (geomesa_tpu/subscribe/; docs/STANDING.md) -------
+    def _standing_engine(self):
+        if self.standing is None:
+            from geomesa_tpu.subscribe import (
+                LiveWindow, StandingQueryEngine,
+            )
+
+            self.standing = StandingQueryEngine(
+                lambda nm: LiveWindow(self, nm)
+            )
+        return self.standing
+
+    def subscribe(self, name: str, aggregate: str, bbox=None, region=None,
+                  width: int = 256, height: int = 256,
+                  levels: Optional[int] = None,
+                  stat_spec: Optional[str] = None,
+                  sub_id: Optional[str] = None) -> str:
+        """Register a standing viewport over the live window: each applied
+        poll batch updates the result incrementally — moves delta-apply
+        (-old, +new), deletes/expiry re-scan only intersecting groups."""
+        from geomesa_tpu.subscribe import spec as subspec
+
+        sp = subspec.make_spec(
+            name, aggregate, bbox=bbox, region=region, width=width,
+            height=height, levels=levels, stat_spec=stat_spec,
+        )
+        cache = self._caches[name]  # raise on unknown schema
+        eng = self._standing_engine()
+        sid = eng.register(sp, sub_id=sub_id)
+        if cache.observer is None:
+            cache.observer = eng.live_observer(name)
+        return sid
+
+    def unsubscribe(self, sub_id: str) -> bool:
+        return (self.standing is not None
+                and self.standing.unregister(sub_id))
+
+    def subscription_poll(self, sub_id: str, cursor: int = 0):
+        """Drain pending stream messages, then return the standing result
+        + update records past ``cursor``."""
+        from geomesa_tpu.subscribe import UnknownSubscription
+
+        if self.standing is None:
+            raise UnknownSubscription(sub_id)
+        self.poll()
+        return self.standing.poll(sub_id, cursor)
+
     # -- producer ----------------------------------------------------------
     def write(self, name: str, data: Dict[str, Sequence], fids: Sequence[str],
               ts_ms: Optional[Sequence[int]] = None):
@@ -453,6 +521,7 @@ class StreamingDataset:
                 # samples and collapse its histogram quantiles exactly
                 # when an operator investigates apply latency
                 cache.expire()
+                self._settle_standing(nm, cache)
                 continue
             applied_ts: Optional[int] = None
             applied_msgs: List[Tuple[int, str, Any, int]] = []
@@ -508,8 +577,29 @@ class StreamingDataset:
                     "offsets": list(self._offsets[nm]),
                     "msgs": [list(t) for t in applied_msgs],
                 })
+            if applied_ts is not None:
+                # per-poll applied-batch counter (docs/OBSERVABILITY.md):
+                # with the epoch gauge below, the subscription-staleness
+                # pair /metrics and /debug/queries expose
+                metrics.inc(metrics.STREAM_POLL_BATCHES)
+                metrics.inc(f"{metrics.STREAM_POLL_BATCHES}.{nm}")
             cache.expire()
+            self._settle_standing(nm, cache)
         return total
+
+    def _settle_standing(self, nm: str, cache: LiveFeatureCache) -> None:
+        """Post-apply bookkeeping for one schema's poll round: export the
+        window's mutation epoch as a gauge (``stream.epoch.<schema>`` —
+        the staleness anchor standing results are versioned against) and
+        fold any buffered cache events into the standing groups (ONE
+        delta pass per applied batch, docs/STANDING.md)."""
+        from geomesa_tpu import metrics
+
+        metrics.registry().gauge(f"{metrics.STREAM_EPOCH}.{nm}").set(
+            cache.epoch
+        )
+        if self.standing is not None:
+            self.standing.settle(nm)
 
     # -- local query runner (KafkaQueryRunner analog) ----------------------
     def _masked(self, name: str, ecql: "str | ir.Filter"):

@@ -1,0 +1,11 @@
+"""Standing queries: fleet-wide continuous viewports over moving objects
+(docs/STANDING.md; PAPERS.md 1411.3212 — index the standing queries,
+stream the points through them)."""
+
+from geomesa_tpu.subscribe.engine import (  # noqa: F401
+    LiveWindow, StandingGroup, StandingQueryEngine, StoreWindow,
+    UnknownSubscription, route_key_of,
+)
+from geomesa_tpu.subscribe.spec import (  # noqa: F401
+    AGGREGATES, StandingSpec, make_spec,
+)

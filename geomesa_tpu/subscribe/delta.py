@@ -1,0 +1,219 @@
+"""The standing-query evaluator (docs/STANDING.md "Bit-identity").
+
+ONE evaluation routine serves both halves of the incremental contract:
+
+* the **delta path** runs it over just an applied ingest batch's rows and
+  adds (or, for a moved feature's old position, subtracts) the result
+  into the standing aggregate;
+* the **re-scan path** runs the SAME routine over the full window from a
+  zero aggregate.
+
+Every supported aggregate is integer-valued exact algebra — counts are
+ints, unweighted f32 density cells hold integers (exact to 2^24), f64
+pyramid cells hold integers (exact to 2^53), and stats sketches are
+gated to :func:`~geomesa_tpu.cache.service.stats_exact_merge` kinds — so
+add/subtract/downsample compose associatively WITHOUT rounding, and a
+delta-accumulated result is bit-identical to the from-scratch re-scan at
+the same epoch. That identity is not hoped for: the engine hard-asserts
+it under ``geomesa.subscribe.verify`` and the standing-smoke CI gate.
+
+The membership oracle is the compiled viewport mask
+(filter/compile.py — the same vectorized kernel the query path uses),
+evaluated host-side over the batch's encoded columns: the megakernel
+batch shape (docs/SERVING.md "Query-axis batching") on the numpy
+backend, one pass over the rows however many fused groups watch.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu.cache import hierarchy
+from geomesa_tpu.kernels import density as kdensity
+
+
+def compile_viewport(spec, ft, dicts):
+    """Compile the spec's membership predicate against a schema. The
+    returned mask kernel is the ONLY membership decision in the
+    subsystem — delta and re-scan can't disagree on who's inside."""
+    from geomesa_tpu.filter import parse_ecql
+    from geomesa_tpu.filter.compile import compile_filter
+
+    geom = ft.geom_field
+    if geom is None:
+        raise ValueError(
+            f"[GM-ARG] schema {spec.schema!r} has no geometry field"
+        )
+    return compile_filter(parse_ecql(spec.ecql(geom)), ft, dicts)
+
+
+def member_mask(cf, ft, cols: Dict[str, np.ndarray], n: int) -> np.ndarray:
+    """Exact viewport membership over ``n`` rows, with the live-window
+    validity rule folded in (null/NaN geometry is invisible — the same
+    mask ``StreamingDataset._masked`` applies)."""
+    if n == 0:
+        return np.zeros(0, dtype=bool)
+    m = cf.exact_mask(cols, n)
+    g = ft.geom_field
+    gx = cols.get(g + "__x") if g is not None else None
+    if gx is not None:
+        m = m & np.isfinite(gx)
+    return m
+
+
+def zero_result(spec):
+    if spec.aggregate == "count":
+        return 0
+    if spec.aggregate == "density":
+        return np.zeros((spec.height, spec.width), np.float32)
+    if spec.aggregate == "pyramid":
+        side = 1 << spec.levels
+        out = []
+        while side >= 1:
+            out.append(np.zeros((side, side), np.float64))
+            side >>= 1
+        return out
+    if spec.aggregate == "stats":
+        from geomesa_tpu.stats import parse_stat
+
+        return parse_stat(spec.stat_spec)
+    raise ValueError(spec.aggregate)
+
+
+def _pyramid_leaf(spec, xs, ys, mask) -> np.ndarray:
+    """Leaf-level f64 count grid over the viewport bbox (side 2^levels).
+    Same clip-cast binning as the density kernel's numpy path, f64
+    accumulation for 2^53 integer headroom."""
+    side = 1 << spec.levels
+    x0, y0, x1, y1 = spec.bbox
+    dx, dy = x1 - x0, y1 - y0
+    px = np.clip(((xs - x0) / dx * side).astype(np.int32), 0, side - 1)
+    py = np.clip(((ys - y0) / dy * side).astype(np.int32), 0, side - 1)
+    grid = np.zeros(side * side, np.float64)
+    np.add.at(grid, py[mask] * side + px[mask], 1.0)
+    return grid.reshape(side, side)
+
+
+def eval_rows(spec, cf, ft, cols: Dict[str, np.ndarray], n: int,
+              dicts=None):
+    """Evaluate the spec's aggregate over ``n`` rows: returns
+    ``(partial_result, rows_matched)``. THE shared routine — a delta is
+    this over a batch, a re-scan is this over the window. ``dicts``
+    decodes enumeration/topk sketch keys from dictionary codes to their
+    string values (stats_scan.decode_enum_keys — the same mapping the
+    query path applies), so a standing sketch reads like ``ds.stats``
+    and merges consistently across batches."""
+    mask = member_mask(cf, ft, cols, n)
+    matched = int(mask.sum())
+    g = ft.geom_field
+    if spec.aggregate == "count":
+        return matched, matched
+    if spec.aggregate == "density":
+        if n == 0:
+            return np.zeros((spec.height, spec.width), np.float32), 0
+        grid = kdensity.density_grid(
+            cols[g + "__x"], cols[g + "__y"], mask, spec.bbox,
+            spec.width, spec.height, None, np,
+        )
+        return np.asarray(grid), matched
+    if spec.aggregate == "pyramid":
+        if n == 0:
+            return zero_result(spec), 0
+        # leaf delta, then downsample-added up the ancestor chain in the
+        # fixed SW/SE/NW/NE order (cache/hierarchy.downsample) — the
+        # quadtree-rollup contract: a level-k cell is exactly the sum of
+        # its four level-(k+1) children
+        d = _pyramid_leaf(spec, cols[g + "__x"], cols[g + "__y"], mask)
+        out = [d]
+        while d.shape[0] > 1:
+            d = hierarchy.downsample(d)
+            out.append(d)
+        return out, matched
+    if spec.aggregate == "stats":
+        from geomesa_tpu.kernels.stats_scan import decode_enum_keys
+
+        stat = zero_result(spec)
+        if matched:
+            stat.observe(cols, mask)
+            if dicts is not None:
+                decode_enum_keys(stat, dicts)
+        return stat, matched
+    raise ValueError(spec.aggregate)
+
+
+def apply_delta(spec, result, delta, sign: int = 1):
+    """Fold a partial into the standing result, in place where the result
+    is array-backed. ``sign=-1`` subtracts (a moved feature's old
+    position) — additive aggregates only; stats callers re-scan
+    instead (sketches cannot unobserve)."""
+    if spec.aggregate == "count":
+        return result + sign * delta
+    if spec.aggregate == "density":
+        if sign >= 0:
+            result += delta
+        else:
+            result -= delta
+        return result
+    if spec.aggregate == "pyramid":
+        for lvl, d in zip(result, delta):
+            if sign >= 0:
+                lvl += d
+            else:
+                lvl -= d
+        return result
+    if spec.aggregate == "stats":
+        if sign < 0:
+            raise ValueError("stats aggregates cannot subtract")
+        result.merge(delta)
+        return result
+    raise ValueError(spec.aggregate)
+
+
+def results_equal(spec, a, b) -> bool:
+    """Bit-identity comparison between two results of one spec."""
+    if spec.aggregate == "count":
+        return int(a) == int(b)
+    if spec.aggregate == "density":
+        return (a.dtype == b.dtype and a.shape == b.shape
+                and np.array_equal(a, b))
+    if spec.aggregate == "pyramid":
+        return (len(a) == len(b)
+                and all(np.array_equal(x, y) for x, y in zip(a, b)))
+    if spec.aggregate == "stats":
+        return a.to_json() == b.to_json()
+    raise ValueError(spec.aggregate)
+
+
+# -- wire codec (PROTOCOL §5 v1.6; rides subscribe-poll + warm handoff) ----
+
+def encode_result(spec, result):
+    from geomesa_tpu.cache.store import encode_wire_value
+
+    if spec.aggregate == "count":
+        return encode_wire_value(int(result))
+    if spec.aggregate == "density":
+        return encode_wire_value(np.asarray(result, np.float32))
+    if spec.aggregate == "pyramid":
+        return encode_wire_value(tuple(np.asarray(g) for g in result))
+    if spec.aggregate == "stats":
+        return encode_wire_value(result.to_json())
+    raise ValueError(spec.aggregate)
+
+
+def decode_result(spec, d):
+    from geomesa_tpu.cache.store import decode_wire_value
+
+    v = decode_wire_value(d)
+    if spec.aggregate == "count":
+        return int(v)
+    if spec.aggregate == "density":
+        return np.asarray(v, np.float32)
+    if spec.aggregate == "pyramid":
+        return [np.asarray(g, np.float64) for g in v]
+    if spec.aggregate == "stats":
+        from geomesa_tpu.stats import sketches as sk
+
+        return sk.Stat.from_json(v)
+    raise ValueError(spec.aggregate)

@@ -860,3 +860,73 @@ def test_auto_uncordon_after_k_successful_probes(fleet):
         assert router.registry.state("r3") == "cordoned"
         router.probe("r3")
         assert router.registry.state("r3") == "cordoned"
+
+
+def test_standing_subscription_survives_join_and_drained_leave(root):
+    """ISSUE 17 satellite: a fleet membership change — a runtime JOIN
+    and a drained LEAVE of the subscription's owner — migrates standing
+    results with ZERO missed and ZERO duplicated updates: the version
+    sequence a poller observes stays contiguous across both events, and
+    every polled result equals the routed from-scratch count at the same
+    point in the schedule."""
+    servers = {rid: _replica(root, rid) for rid in ("sa", "sb", "sc")}
+    router = _router(servers)
+    bbox = (-30.0, -20.0, 10.0, 20.0)
+    vp = "BBOX(geom, -30, -20, 10, 20)"
+    try:
+        sub_id = router.subscribe("t", "count", bbox=bbox)
+        from geomesa_tpu.subscribe import route_key_of
+
+        seen = []  # every update record the poller ever observes
+
+        def poll(cursor):
+            got = router.subscription_poll(sub_id, cursor=cursor)
+            seen.extend(got["updates"])
+            assert got["result"]["v"] == router.count("t", vp)
+            return int(got["version"])
+
+        cursor = poll(0)
+        assert [u["kind"] for u in seen] == ["snapshot"]
+
+        # ingest through the router: the standing result advances by a
+        # delta wherever the subscription lives
+        router.insert_arrow("t", _one_row(0.5, 0.5))
+        cursor = poll(cursor)
+        assert seen[-1]["kind"] == "delta"
+
+        # runtime JOIN: if the new member takes the route key, the group
+        # must move to it (export remove=True + import) — either way the
+        # poller must not observe a gap or a repeat
+        extra = _replica(root, "sd")
+        servers["sd"] = extra
+        router.register_replica(f"grpc+tcp://127.0.0.1:{extra.port}")
+        cursor = poll(cursor)
+        router.insert_arrow("t", _one_row(0.6, 0.6))
+        cursor = poll(cursor)
+
+        # drained LEAVE of the CURRENT owner: subscribe-export answers
+        # mid-drain (admin), the post-removal ring owner adopts the
+        # group verbatim under the {count, spec} guard
+        owner = router._owners(route_key_of(sub_id))[0]
+        out = router.deregister_replica(owner, handoff=True)
+        subs = out["handoff"].get("subscriptions") or {}
+        assert subs.get("adopted", 0) + subs.get("resynced", 0) >= 1
+        cursor = poll(cursor)
+        router.insert_arrow("t", _one_row(0.7, 0.7))
+        cursor = poll(cursor)
+
+        # zero missed, zero duplicated: one snapshot, then a contiguous
+        # version walk with no repeats
+        versions = [u["version"] for u in seen]
+        assert versions == sorted(set(versions))
+        assert versions == list(range(1, versions[-1] + 1))
+        kinds = [u["kind"] for u in seen]
+        assert kinds[0] == "snapshot"
+        assert kinds.count("delta") >= 3
+    finally:
+        router.close()
+        for srv in servers.values():
+            try:
+                srv.shutdown()
+            except Exception:
+                pass

@@ -1,0 +1,323 @@
+"""Standing-query suite (geomesa_tpu/subscribe/; docs/STANDING.md).
+
+The contract under test everywhere: the incrementally-maintained result
+of a registered viewport is BIT-IDENTICAL to a from-scratch evaluation
+of the same viewport at the same epoch. ``geomesa.subscribe.verify``
+stays ON for the whole module, so every applied batch re-scans and
+hard-asserts inside the engine — a passing test here proves the delta
+algebra, not just the final numbers.
+"""
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import GeoDataset, config, metrics
+from geomesa_tpu.filter.ecql import parse_iso_ms
+from geomesa_tpu.stream import StreamingDataset
+from geomesa_tpu.subscribe import UnknownSubscription, make_spec, route_key_of
+
+SPEC = "name:String,speed:Float,dtg:Date,*geom:Point"
+VIEW = (-30.0, -20.0, 10.0, 20.0)
+VIEW_ECQL = "BBOX(geom, -30, -20, 10, 20)"
+
+
+@pytest.fixture(autouse=True)
+def _verify_on():
+    with config.SUBSCRIBE_VERIFY.scoped("true"):
+        yield
+
+
+def _data(n=120, seed=7, lo=-45.0, hi=45.0):
+    rng = np.random.default_rng(seed)
+    return {
+        "name": [f"n{i % 3}" for i in range(n)],
+        "speed": rng.uniform(0, 30, n).astype(np.float32),
+        "dtg": (np.datetime64("2024-05-01", "ms")
+                + rng.integers(0, 86_400_000, n)),
+        "geom": [(float(x), float(y)) for x, y in
+                 zip(rng.uniform(lo, hi, n), rng.uniform(-28, 28, n))],
+    }
+
+
+@pytest.fixture()
+def ds():
+    out = GeoDataset(n_shards=1, prefer_device=False)
+    out.create_schema("t", SPEC)
+    out.insert("t", _data(), fids=[f"f{i}" for i in range(120)])
+    return out
+
+
+def _result(ds, sub_id, cursor=0):
+    from geomesa_tpu.subscribe import delta as dl
+
+    got = ds.subscription_poll(sub_id, cursor)
+    spec = ds.standing._groups[got["schema"]][
+        ds.standing._subs[sub_id][1]].spec
+    return got, dl.decode_result(spec, got["result"])
+
+
+def test_count_delta_and_dirty_rescan_bit_identical(ds):
+    sid = ds.subscribe("t", "count", bbox=VIEW)
+    got, val = _result(ds, sid)
+    assert val == ds.count("t", VIEW_ECQL)
+    assert got["version"] == 1
+
+    # adds apply as a delta (no rescan), still exact
+    ds.insert("t", _data(60, seed=11), fids=[f"g{i}" for i in range(60)])
+    got, val = _result(ds, sid)
+    assert val == ds.count("t", VIEW_ECQL)
+    assert [u["kind"] for u in got["updates"]] == ["snapshot", "delta"]
+
+    # deletes re-scan only the dirty bounds (non-additive mutation)
+    ds.delete_features("t", "speed > 20")
+    got, val = _result(ds, sid)
+    assert val == ds.count("t", VIEW_ECQL)
+    assert got["updates"][-1]["kind"] == "rescan"
+
+    # age-off is the other non-additive edge
+    ds.age_off("t", "2024-05-01T12:00:00Z")
+    _, val = _result(ds, sid)
+    assert val == ds.count("t", VIEW_ECQL)
+
+
+def test_density_grid_bit_identical(ds):
+    sid = ds.subscribe("t", "density", bbox=VIEW, width=64, height=64)
+    ds.insert("t", _data(80, seed=13), fids=[f"h{i}" for i in range(80)])
+    _, grid = _result(ds, sid)
+    ref = ds.density("t", VIEW_ECQL, bbox=VIEW, width=64, height=64)
+    assert grid.dtype == ref.dtype and np.array_equal(grid, ref)
+
+
+def test_pyramid_rollup_downsample_chain(ds):
+    sid = ds.subscribe("t", "pyramid", bbox=VIEW, levels=5)
+    ds.insert("t", _data(90, seed=17), fids=[f"p{i}" for i in range(90)])
+    _, grids = _result(ds, sid)
+    # leaf side 2^levels, downsampled to the 1x1 root: levels+1 grids
+    assert len(grids) == 6
+    assert grids[0].shape == (32, 32) and grids[-1].shape == (1, 1)
+    total = ds.count("t", VIEW_ECQL)
+    # every level is an exact rollup of the leaf: integer-valued f64
+    for g in grids:
+        assert float(g.sum()) == float(total)
+    # fixed SW/SE/NW/NE downsample order: parent == 2x2 child sum
+    from geomesa_tpu.cache import hierarchy
+
+    for child, parent in zip(grids, grids[1:]):
+        assert np.array_equal(hierarchy.downsample(child), parent)
+
+
+def test_stats_exact_merge_only(ds):
+    sid = ds.subscribe("t", "stats", bbox=VIEW, stat_spec="Enumeration(name)")
+    ds.insert("t", _data(40, seed=19), fids=[f"s{i}" for i in range(40)])
+    got, stat = _result(ds, sid)
+    ref = ds.stats("t", "Enumeration(name)", VIEW_ECQL)
+    assert stat.to_json() == ref.to_json()
+    # sketches outside EXACT_MERGE_KINDS (f64-sum order sensitivity) are
+    # refused at registration: a standing result must merge exactly
+    with pytest.raises(ValueError, match=r"\[GM-SUB\]"):
+        ds.subscribe("t", "stats", bbox=VIEW,
+                     stat_spec="DescriptiveStats(speed)")
+
+
+def test_fusion_one_group_one_dispatch(ds):
+    sids = [ds.subscribe("t", "count", bbox=VIEW) for _ in range(10)]
+    # ten subscribers, one standing group: same spec fuses
+    assert len({ds.standing._subs[s][1] for s in sids}) == 1
+    snap = ds.standing.snapshot()
+    assert snap["subscribers"] == 10
+    assert sum(g["subscribers"] for g in snap["groups"]) == 10
+
+    before = metrics.registry().counter(metrics.SUBSCRIBE_DISPATCHES).value
+    ds.insert("t", _data(30, seed=23), fids=[f"q{i}" for i in range(30)])
+    after = metrics.registry().counter(metrics.SUBSCRIBE_DISPATCHES).value
+    # ONE applied batch -> exactly ONE standing evaluation dispatch,
+    # regardless of subscriber count (the issue's hot-viewport contract)
+    assert after - before == 1
+    ref = ds.count("t", VIEW_ECQL)
+    for s in sids:
+        _, val = _result(ds, s)
+        assert val == ref
+
+
+def test_dirty_scoping_leaves_disjoint_groups_untouched(ds):
+    west = ds.subscribe("t", "count", bbox=(-45.0, -28.0, -1.0, 28.0))
+    east = ds.subscribe("t", "count", bbox=(1.0, -28.0, 45.0, 28.0))
+    ds.insert("t", _data(40, seed=29, lo=5.0, hi=40.0),
+              fids=[f"e{i}" for i in range(40)])
+    got_w, _ = _result(ds, west)
+    v_west = got_w["version"]
+    # delete only eastern rows: the dirty bounds never intersect the
+    # western viewport, so its group must not re-scan (no new update)
+    ds.delete_features("t", "BBOX(geom, 5, -28, 45, 28)")
+    got_e, val_e = _result(ds, east)
+    assert got_e["updates"][-1]["kind"] == "rescan"
+    assert val_e == ds.count("t", "BBOX(geom, 1, -28, 45, 28)")
+    got_w, val_w = _result(ds, west)
+    assert got_w["version"] == v_west
+    assert val_w == ds.count("t", "BBOX(geom, -45, -28, -1, 28)")
+
+
+def test_region_polygon_viewport(ds):
+    poly = "POLYGON((-20 -15, 15 -15, 15 12, -20 12, -20 -15))"
+    sid = ds.subscribe("t", "count", region=poly)
+    ds.insert("t", _data(50, seed=31), fids=[f"r{i}" for i in range(50)])
+    _, val = _result(ds, sid)
+    assert val == ds.count("t", f"INTERSECTS(geom, {poly})")
+
+
+def test_updates_ring_and_cursor(ds):
+    sid = ds.subscribe("t", "count", bbox=VIEW)
+    with config.SUBSCRIBE_UPDATES_RING.scoped("4"):
+        for i in range(6):
+            ds.insert("t", {"name": ["x"], "speed": [1.0],
+                            "dtg": [np.datetime64("2024-05-02", "ms")],
+                            "geom": [(0.0, 0.0)]}, fids=[f"u{i}"])
+    got = ds.subscription_poll(sid, cursor=0)
+    assert got["version"] == 7
+    # ring capped: a cursor older than the ring re-anchors on the full
+    # result carried with every poll
+    assert got["updates"][0]["version"] > 1
+    got2 = ds.subscription_poll(sid, cursor=got["version"])
+    assert got2["updates"] == []
+
+
+def test_unsubscribe_and_unknown(ds):
+    sid = ds.subscribe("t", "count", bbox=VIEW)
+    assert ds.unsubscribe(sid) is True
+    assert ds.unsubscribe(sid) is False
+    with pytest.raises(UnknownSubscription):
+        ds.subscription_poll(sid)
+
+
+def test_route_key_embeds_ring_identity(ds):
+    sid = ds.subscribe("t", "count", bbox=VIEW)
+    spec = make_spec("t", "count", bbox=VIEW)
+    lvl = 3
+    assert route_key_of(sid) == spec.route_key(lvl)
+    assert sid.startswith("t:z3:")
+
+
+def test_export_import_guard_adopt_and_resync(ds):
+    sid = ds.subscribe("t", "count", bbox=VIEW)
+    ds.insert("t", _data(20, seed=37), fids=[f"x{i}" for i in range(20)])
+    got, ref = _result(ds, sid)
+    exported = ds.standing.export_groups()
+    assert len(exported["groups"]) == 1
+    assert "t" in exported["guards"]
+
+    # identical window -> guard matches -> adopted verbatim (same
+    # version, same update ring, zero missed / zero duplicated updates)
+    twin = GeoDataset(n_shards=1, prefer_device=False)
+    twin.create_schema("t", SPEC)
+    twin.insert("t", _data(), fids=[f"f{i}" for i in range(120)])
+    twin.insert("t", _data(20, seed=37), fids=[f"x{i}" for i in range(20)])
+    out = twin._standing_engine().import_groups(exported)
+    assert out == {"adopted": 1, "resynced": 0}
+    got2, val2 = _result(twin, sid)
+    assert val2 == ref and got2["version"] == got["version"]
+    assert [u["version"] for u in got2["updates"]] == \
+        [u["version"] for u in got["updates"]]
+
+    # diverged window -> guard mismatch -> local re-scan, version stays
+    # contiguous and the result reflects the LOCAL window
+    other = GeoDataset(n_shards=1, prefer_device=False)
+    other.create_schema("t", SPEC)
+    other.insert("t", _data(80, seed=41), fids=[f"y{i}" for i in range(80)])
+    out = other._standing_engine().import_groups(exported)
+    assert out == {"adopted": 0, "resynced": 1}
+    got3, val3 = _result(other, sid)
+    assert val3 == other.count("t", VIEW_ECQL)
+    assert got3["version"] == got["version"] + 1
+    assert got3["updates"][-1]["kind"] == "resync"
+
+    # export with remove=True is the leaver's half: the source forgets
+    exported2 = ds.standing.export_groups(remove=True)
+    assert len(exported2["groups"]) == 1
+    with pytest.raises(UnknownSubscription):
+        ds.subscription_poll(sid)
+
+
+def test_partitioned_store_rejected():
+    ds = GeoDataset(n_shards=1, prefer_device=False)
+    ds.create_schema("p", "name:String,dtg:Date,*geom:Point;"
+                          "geomesa.partition='time'")
+    with pytest.raises(ValueError, match=r"\[GM-SUB\]"):
+        ds.subscribe("p", "count", bbox=VIEW)
+
+
+def test_debug_queries_exposes_subscriptions(ds):
+    from geomesa_tpu import obs
+
+    ds.subscribe("t", "count", bbox=VIEW)
+    dq = obs.debug_queries(ds)
+    assert dq["subscriptions"]["subscribers"] == 1
+    assert dq["subscriptions"]["groups"][0]["schema"] == "t"
+
+
+# ---------------------------------------------------------------------------
+# streaming window: moves, expiry, epoch gauges
+# ---------------------------------------------------------------------------
+
+
+def _stream_ds():
+    sds = StreamingDataset()
+    sds.create_schema("v", SPEC)
+    return sds
+
+
+def _write(sds, fids, pts, t0, names=None):
+    ts = [t0 + i for i in range(len(fids))]
+    sds.write("v", {
+        "name": names or ["m"] * len(fids),
+        "speed": [1.0] * len(fids),
+        "dtg": ts,
+        "geom": pts,
+    }, fids, ts_ms=ts)
+
+
+def test_stream_moves_delta_and_epoch_gauge():
+    sds = _stream_ds()
+    t0 = parse_iso_ms("2024-05-01")
+    _write(sds, [f"f{i}" for i in range(40)],
+           [(float(i - 20), 0.0) for i in range(40)], t0)
+    sid = sds.subscribe("v", "count", bbox=(-10.0, -5.0, 10.0, 5.0))
+    got = sds.subscription_poll(sid)
+    ref = sds.count("v", "BBOX(geom, -10, -5, 10, 5)")
+    assert got["result"]["v"] == ref
+
+    # a CHANGE on a live fid is a MOVE: -old +new, still one delta batch
+    _write(sds, ["f0", "f1"], [(0.5, 0.5), (0.6, 0.6)], t0 + 10_000)
+    got = sds.subscription_poll(sid, cursor=got["version"])
+    assert got["result"]["v"] == sds.count("v", "BBOX(geom, -10, -5, 10, 5)")
+    assert got["updates"][-1]["kind"] == "delta"
+
+    # live deletes re-scan dirty bounds
+    sds.delete("v", "f0")
+    got = sds.subscription_poll(sid, cursor=got["version"])
+    assert got["result"]["v"] == sds.count("v", "BBOX(geom, -10, -5, 10, 5)")
+
+    g = metrics.registry().gauge(f"{metrics.STREAM_EPOCH}.v").value
+    assert g == sds.cache("v").epoch
+    assert metrics.registry().counter(
+        f"{metrics.STREAM_POLL_BATCHES}.v").value >= 1
+
+
+def test_stream_clear_and_fused_stream_subscribers():
+    sds = _stream_ds()
+    t0 = parse_iso_ms("2024-05-01")
+    _write(sds, [f"f{i}" for i in range(30)],
+           [(float(i % 10), float(i % 5)) for i in range(30)], t0)
+    a = sds.subscribe("v", "density", bbox=(-1.0, -1.0, 11.0, 6.0),
+                      width=32, height=32)
+    b = sds.subscribe("v", "density", bbox=(-1.0, -1.0, 11.0, 6.0),
+                      width=32, height=32)
+    assert route_key_of(a) == route_key_of(b)
+    eng = sds.standing
+    assert len(eng._groups["v"]) == 1
+    sds.clear("v")
+    got = sds.subscription_poll(a)
+    from geomesa_tpu.subscribe import delta as dl
+
+    spec = eng._groups["v"][eng._subs[a][1]].spec
+    grid = dl.decode_result(spec, got["result"])
+    assert float(grid.sum()) == 0.0
