@@ -406,6 +406,14 @@ class GeoDataset:
             self.add_attribute_index(name, rec["attr"])
         elif kind == "remove-index":
             self.remove_attribute_index(name, rec["attr"])
+        elif kind == "subscribe":
+            from geomesa_tpu.subscribe.spec import StandingSpec
+
+            self._standing_engine().register(
+                StandingSpec.from_dict(rec["spec"]), sub_id=rec["sub_id"])
+        elif kind == "unsubscribe":
+            if self.standing is not None:
+                self.standing.unregister(rec["sub_id"])
         else:
             return False
         return True
@@ -668,11 +676,22 @@ class GeoDataset:
             height=height, levels=levels, stat_spec=stat_spec,
         )
         self._store(name)  # raise on unknown schema before registering
-        return self._standing_engine().register(sp, sub_id=sub_id)
+        eng = self._standing_engine()
+        if sub_id is None:
+            # WAL discipline: the journal record carries the id the
+            # register will use, so crash replay rebuilds the SAME
+            # subscription id the caller was handed (docs/STANDING.md §7)
+            sub_id = eng.make_sub_id(sp)
+        self._journal_rec("subscribe", name, spec=sp.to_dict(),
+                          sub_id=sub_id)
+        return eng.register(sp, sub_id=sub_id)
 
     def unsubscribe(self, sub_id: str) -> bool:
         if self.standing is None:
             return False
+        schema = self.standing.schema_of(sub_id)
+        if schema is not None:
+            self._journal_rec("unsubscribe", schema, sub_id=sub_id)
         return self.standing.unregister(sub_id)
 
     def subscription_poll(self, sub_id: str, cursor: int = 0):
@@ -2224,14 +2243,25 @@ class GeoDataset:
         )
 
     def _join_sides(self, left: str, right: str,
-                    left_query: "str | Query", right_query: "str | Query"):
+                    left_query: "str | Query", right_query: "str | Query",
+                    right_polygon: bool = False):
         """Plan + scan both join sides (each under its own filter /
-        visibility), validating the point-schema contract."""
+        visibility), validating the geometry contract: both sides POINT,
+        except polygon-predicate joins (``right_polygon``) where the
+        right side must be a POLYGON/MULTIPOLYGON schema."""
         lst, lq, lplan = self._plan(left, left_query)
         rst, rq, rplan = self._plan(right, right_query)
-        for st_, nm in ((lst, left), (rst, right)):
+        for st_, nm, poly in ((lst, left, False), (rst, right,
+                                                   right_polygon)):
             g = st_.ft.geom_field
-            if g is None or not st_.ft.attr(g).is_point:
+            a = None if g is None else st_.ft.attr(g)
+            if poly:
+                if a is None or a.type not in ("polygon", "multipolygon"):
+                    raise ValueError(
+                        f"[GM-ARG] polygon join requires a POLYGON "
+                        f"geometry on schema {nm!r}"
+                    )
+            elif a is None or not a.is_point:
                 raise ValueError(
                     f"[GM-ARG] spatial join requires a POINT geometry "
                     f"on schema {nm!r}"
@@ -2248,27 +2278,65 @@ class GeoDataset:
         return (batch.columns.get(g + "__x", z),
                 batch.columns.get(g + "__y", z))
 
+    @staticmethod
+    def _side_polygons(st: FeatureStore, batch: ColumnBatch):
+        """The polygon side's geometries, parsed from the schema's host
+        WKT column (row order == batch order, so pair indices line up)."""
+        from geomesa_tpu.utils import geometry as geo
+
+        g = st.ft.geom_field
+        col = batch.columns.get(g + "__wkt")
+        if col is None:
+            return []
+        return [geo.parse_wkt(w) for w in col]
+
     def _join_run(self, left: str, right: str, predicate: str, distance,
                   dx, dy, left_query, right_query, level,
                   want_pairs: bool):
         """The shared spatial-join body: sides scan -> co-partition ->
-        bucketed pairwise kernel over the device mesh -> audit."""
+        per-cell strategy routing -> kernels over the device mesh ->
+        audit. Polygon predicates route through the classify-cells
+        wholesale/boundary engine; count-only joins over a partitioned
+        right side stream it through window-pushdown side scans
+        (docs/JOIN.md §6) instead of materializing it whole."""
+        from geomesa_tpu.kernels import join as kjoin
         from geomesa_tpu.planning import join_exec
 
         t0 = time.perf_counter()
         metrics.inc(metrics.JOIN_QUERIES)
+        prefer = self.prefer_device and self.mesh is None
         with query_deadline(self._timeout_s()):
-            lst, lplan, lbatch, rst, rplan, rbatch = self._join_sides(
-                left, right, left_query, right_query
-            )
-            lx, ly = self._side_xy(lst, lbatch)
-            rx, ry = self._side_xy(rst, rbatch)
-            pairs, total, stats = join_exec.run_join(
-                lx, ly, rx, ry, predicate, distance=distance, dx=dx,
-                dy=dy, level=level,
-                prefer_device=self.prefer_device and self.mesh is None,
-                want_pairs=want_pairs,
-            )
+            if predicate in kjoin.POLYGON_PREDICATES:
+                lst, lplan, lbatch, rst, rplan, rbatch = self._join_sides(
+                    left, right, left_query, right_query,
+                    right_polygon=True,
+                )
+                lx, ly = self._side_xy(lst, lbatch)
+                geoms = self._side_polygons(rst, rbatch)
+                pairs, total, stats = join_exec.run_polygon_join(
+                    lx, ly, geoms, predicate, level=level,
+                    prefer_device=prefer, want_pairs=want_pairs,
+                )
+            elif not want_pairs and self._join_pushdown_ready(
+                    right, predicate, right_query):
+                (lst, lplan, lbatch, rst,
+                 total, stats) = self._join_pushdown_count(
+                    left, right, predicate, distance, dx, dy,
+                    left_query, right_query, level, prefer,
+                )
+                rbatch = ColumnBatch({}, 0)
+                pairs = None
+            else:
+                lst, lplan, lbatch, rst, rplan, rbatch = self._join_sides(
+                    left, right, left_query, right_query
+                )
+                lx, ly = self._side_xy(lst, lbatch)
+                rx, ry = self._side_xy(rst, rbatch)
+                pairs, total, stats = join_exec.run_join(
+                    lx, ly, rx, ry, predicate, distance=distance, dx=dx,
+                    dy=dy, level=level, prefer_device=prefer,
+                    want_pairs=want_pairs,
+                )
         hints = {
             "op": "join", "index": lplan.index_name, "right": right,
             "predicate": predicate, "level": stats.level,
@@ -2276,7 +2344,15 @@ class GeoDataset:
             "candidate_pairs": stats.candidate_pairs,
             "naive_pairs": stats.naive_pairs,
             "strip_fraction": round(stats.strip_fraction, 4),
+            "adaptive": stats.adaptive,
         }
+        if stats.strategy_cells:
+            # the decision trail: joint cells per strategy (docs/JOIN.md §5)
+            hints["strategies"] = dict(stats.strategy_cells)
+        if stats.wholesale_pairs:
+            hints["wholesale_pairs"] = stats.wholesale_pairs
+        if stats.pushdown:
+            hints["pushdown"] = dict(stats.pushdown)
         if stats.skipped:
             hints["degraded"] = list(stats.skipped)
         tid = tracing.current_trace_id()
@@ -2294,6 +2370,206 @@ class GeoDataset:
         return SpatialJoinResult(
             lst, lbatch, rst, rbatch, pairs, total, stats
         )
+
+    def _join_pushdown_ready(self, right: str, predicate: str,
+                             right_query: "str | Query") -> bool:
+        """Whether the count-only join can stream the right side through
+        lake window-pushdown side scans (docs/JOIN.md §6): planar
+        predicate (``dwithin_meters`` needs per-row latitude-dependent
+        reach plus antimeridian wrap — its windows are not OR-of-bbox),
+        a plain right query (row-set-dependent hints fall back), and a
+        partitioned right store that can serve statistics-pruned
+        children."""
+        from geomesa_tpu.kernels import join as kjoin
+
+        if predicate not in (kjoin.JOIN_BBOX, kjoin.JOIN_DWITHIN):
+            return False
+        on = config.JOIN_PUSHDOWN.to_bool()
+        if not (True if on is None else bool(on)):
+            return False
+        if isinstance(right_query, Query) and (
+                right_query.max_features is not None
+                or right_query.sampling is not None
+                or right_query.sample_by is not None
+                or right_query.sort_by or right_query.properties):
+            return False
+        try:
+            st = self._store(right)
+        except KeyError:
+            return False
+        from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+
+        g = st.ft.geom_field
+        return (isinstance(st, PartitionedFeatureStore)
+                and g is not None and st.ft.attr(g).is_point)
+
+    def _join_pushdown_count(self, left: str, right: str, predicate: str,
+                             distance, dx, dy, left_query, right_query,
+                             level, prefer: bool):
+        """Count-only join with window-pushdown side scans: the LEFT
+        side's occupied cells chunk into groups of
+        ``geomesa.join.pushdown.cells``; each chunk re-plans the right
+        side under ``(right_query) AND (OR of chunk cell boxes inflated
+        by reach + 2 margins)`` and streams it through the partitioned
+        executor's lake window (footer-pruned per-cell ranged reads) —
+        the right side is never materialized whole on the host.
+
+        Exactly-once accounting: a left row's cell lives in exactly one
+        chunk, and any right row whose reach box touches a chunk cell
+        lies inside that chunk's inflated window with a full
+        CLASSIFY_MARGIN to spare (one margin funds the strip contract,
+        the second funds the scan filter kernel's f32 edge uncertainty,
+        and the window bounds round OUTWARD to fixed-point ECQL), so
+        chunk counts partition the pair set."""
+        from dataclasses import replace as _dc_replace
+
+        from geomesa_tpu.cache import cells as gcells
+        from geomesa_tpu.cache.cells import CLASSIFY_MARGIN
+        from geomesa_tpu.kernels import join as kjoin
+        from geomesa_tpu.planning import join_exec
+
+        lst, lq, lplan = self._plan(left, left_query)
+        g = lst.ft.geom_field
+        if g is None or not lst.ft.attr(g).is_point:
+            raise ValueError(
+                f"[GM-ARG] spatial join requires a POINT geometry "
+                f"on schema {left!r}"
+            )
+        rst = self._store(right)
+        rgeom = rst.ft.geom_field
+        with tracing.span("scan.join.sides"):
+            lbatch = self._executor(lst).features(lplan)
+        lx, ly = self._side_xy(lst, lbatch)
+        lx = np.asarray(lx, np.float64)
+        ly = np.asarray(ly, np.float64)
+        p0, p1 = kjoin.pair_params(predicate, distance=distance, dx=dx,
+                                   dy=dy)
+        if predicate == kjoin.JOIN_BBOX:
+            reach_x, reach_y = float(p0), float(p1)
+        else:
+            reach_x = reach_y = float(distance)
+        if level is None:
+            # level votes from the LEFT side only — the right side is
+            # never whole on the host, so its density cannot vote
+            bounds = None
+            if len(lx):
+                bounds = (float(lx.min()), float(ly.min()),
+                          float(lx.max()), float(ly.max()))
+            level = join_exec.choose_level(
+                len(lx), len(lx), max(reach_x, reach_y), bounds
+            )
+        stats = join_exec.JoinStats(level=level, n_left=len(lx))
+        if not len(lx):
+            return lst, lplan, lbatch, rst, 0, stats
+        # the WINDOW grid is finer than the join grid: the join level
+        # optimizes pairwise tile occupancy (cells can span many
+        # degrees), but pruning power needs boxes comparable to a row
+        # group's footprint — size window cells to the reach (the pad is
+        # then a fraction of the cell, not a multiple). Exactness never
+        # depends on this choice: each chunk's inflated windows are a
+        # provable superset of its left rows' matches at ANY level.
+        wlevel = int(np.clip(int(np.floor(np.log2(
+            360.0 / max(2.0 * (max(reach_x, reach_y) + CLASSIFY_MARGIN),
+                        1e-9)))), level, 15))
+        ix, iy = gcells.point_cells(lx, ly, wlevel)
+        cell = join_exec._cell_ids(ix, iy)
+        order = np.argsort(cell, kind="stable")
+        ucell, starts = np.unique(cell[order], return_index=True)
+        ends = np.concatenate([starts[1:], [len(order)]])
+        uix = ix[order][starts]
+        uiy = iy[order][starts]
+        stats.cells_left = len(ucell)
+        per = config.JOIN_PUSHDOWN_CELLS.to_int() or 256
+        per = max(int(per), 1)
+        base = right_query.ecql if isinstance(right_query, Query) \
+            else right_query
+        rq_base = right_query if isinstance(right_query, Query) \
+            else Query(ecql=right_query)
+        pad_x = reach_x + 2.0 * CLASSIFY_MARGIN
+        pad_y = reach_y + 2.0 * CLASSIFY_MARGIN
+
+        def _lo(v):
+            return f"{np.floor(v * 1e9) / 1e9:.9f}"
+
+        def _hi(v):
+            return f"{np.ceil(v * 1e9) / 1e9:.9f}"
+
+        total = 0
+        bytes_loaded = groups_loaded = 0
+        bytes_side = groups_side = 0
+        chunks = 0
+        for clo in range(0, len(ucell), per):
+            chi = min(clo + per, len(ucell))
+            chunks += 1
+            boxes = gcells.cell_boxes(wlevel, uix[clo:chi], uiy[clo:chi])
+            clause = " OR ".join(
+                f"BBOX({rgeom}, {_lo(b[0] - pad_x)}, {_lo(b[1] - pad_y)},"
+                f" {_hi(b[2] + pad_x)}, {_hi(b[3] + pad_y)})"
+                for b in boxes
+            )
+            ecql = clause if base.strip().upper() == "INCLUDE" \
+                else f"({base}) AND ({clause})"
+            rst2, _rq2, rplan2 = self._plan(
+                right, _dc_replace(rq_base, ecql=ecql)
+            )
+            ex = self._executor(rst2)
+            scan = getattr(ex, "features_pushdown", None) or ex.features
+            with tracing.span("scan.join.side.window", chunk=chunks):
+                rb = scan(rplan2)
+            rx, ry = self._side_xy(rst2, rb)
+            stats.n_right += len(rx)
+            sel = order[starts[clo]: ends[chi - 1]]
+            plan = join_exec.co_partition(
+                lx[sel], ly[sel], rx, ry, predicate, reach_x, reach_y,
+                level=level, p0=p0, p1=p1,
+            )
+            _, cnt = join_exec.execute_predicate(
+                plan, lx[sel], ly[sel], rx, ry, predicate,
+                prefer_device=prefer, want_pairs=False,
+            )
+            total += cnt
+            cst = plan.stats
+            stats.cells_joint += cst.cells_joint
+            stats.candidate_pairs += cst.candidate_pairs
+            stats.strip_entries += cst.strip_entries
+            stats.tiles += cst.tiles
+            stats.devices = max(stats.devices, cst.devices)
+            stats.adaptive = cst.adaptive
+            for k, v in cst.strategy_cells.items():
+                stats.strategy_cells[k] = stats.strategy_cells.get(k, 0) + v
+            for k, v in cst.est_pairs.items():
+                stats.est_pairs[k] = stats.est_pairs.get(k, 0) + v
+            for k, v in cst.dispatched_pairs.items():
+                stats.dispatched_pairs[k] = \
+                    stats.dispatched_pairs.get(k, 0) + v
+            stats.skipped.extend(
+                f"chunk{chunks - 1}:{s}" for s in cst.skipped
+            )
+            acct = rplan2.__dict__.get("lake_acct") or {}
+            bytes_loaded += int(acct.get("bytes_loaded", 0))
+            groups_loaded += int(acct.get("groups_loaded", 0))
+            # one chunk's payload/groups_total IS the whole side (every
+            # chunk scan sees every row group's footer): the honest
+            # full-materialization baseline for the fraction
+            bytes_side = max(bytes_side, int(acct.get("bytes_payload", 0)))
+            groups_side = max(groups_side, int(acct.get("groups_total", 0)))
+        stats.matched = total
+        stats.pushdown = {
+            "chunks": chunks, "cells": len(ucell),
+            "bytes_loaded": bytes_loaded, "bytes_side": bytes_side,
+            "groups_loaded": groups_loaded, "groups_side": groups_side,
+        }
+        metrics.inc(metrics.JOIN_CELLS, stats.cells_joint)
+        metrics.inc(metrics.JOIN_CANDIDATE_PAIRS, stats.candidate_pairs)
+        for s, k in stats.strategy_cells.items():
+            metrics.inc(metrics.JOIN_CELLS_STRATEGY + s, k)
+        metrics.inc(metrics.JOIN_PAIRS, total)
+        metrics.inc(metrics.JOIN_PUSHDOWN_BYTES, bytes_loaded)
+        tracing.add_cost("join_pushdown_bytes", float(bytes_loaded))
+        tracing.add_cost("join_cells", float(stats.cells_joint))
+        tracing.add_cost("join_candidate_pairs",
+                         float(stats.candidate_pairs))
+        return lst, lplan, lbatch, rst, total, stats
 
     @_traced("join")
     def join_spatial(self, left: str, right: str, *, predicate: str,
@@ -2341,6 +2617,46 @@ class GeoDataset:
         exp = Explainer(enabled=True)
         with tracing.start("explain_join", schema=left), \
                 self.serving.admit("explain"):
+            if predicate in kjoin.POLYGON_PREDICATES:
+                lst, lplan, lbatch, rst, rplan, rbatch = self._join_sides(
+                    left, right, left_query, right_query,
+                    right_polygon=True,
+                )
+                lx, ly = self._side_xy(lst, lbatch)
+                geoms = self._side_polygons(rst, rbatch)
+                t0 = time.perf_counter()
+                _, total, st = join_exec.run_polygon_join(
+                    lx, ly, geoms, predicate, level=level,
+                    prefer_device=analyze and self.prefer_device
+                    and self.mesh is None,
+                    want_pairs=False,
+                )
+                exp.push("Join")
+                exp.kv("predicate", predicate)
+                exp.kv("sides", f"{left} ({st.n_left} rows) x "
+                       f"{right} ({st.n_right} polygons)")
+                exp.kv("cell level", st.level)
+                exp.kv("cells", f"{st.cells_left} occupied point cells")
+                exp.pop()
+                exp.push("Adaptive")
+                exp.kv("cells[interior]",
+                       f"{st.strategy_cells.get('interior', 0)} "
+                       f"(wholesale: {st.wholesale_pairs} pairs, zero "
+                       f"kernel work)")
+                exp.kv("cells[boundary]",
+                       f"{st.strategy_cells.get('boundary', 0)} "
+                       f"(kernel: {st.candidate_pairs} candidate pairs)")
+                exp.kv("statistics read",
+                       "classify_cells(cell box, polygon, "
+                       "CLASSIFY_MARGIN) per candidate cell")
+                if analyze:
+                    exp.kv("matched (analyze)", total)
+                    exp.kv("kernel ms",
+                           round((time.perf_counter() - t0) * 1e3, 3))
+                    if st.skipped:
+                        exp.kv("degraded", ", ".join(st.skipped))
+                exp.pop()
+                return str(exp)
             lst, lplan, lbatch, rst, rplan, rbatch = self._join_sides(
                 left, right, left_query, right_query
             )
@@ -2375,7 +2691,27 @@ class GeoDataset:
                    f"({st.candidate_fraction:.4f})")
             exp.kv("boundary-strip fraction",
                    round(st.strip_fraction, 4))
-            exp.kv("tiles", f"{st.tiles} ({plan.Bp} x {plan.Pp} padded)")
+            exp.kv("tiles", f"{st.tiles} ({plan.Bp} x {plan.Pp} padded, "
+                   f"{len(plan.sections)} section(s))")
+            exp.pop()
+            # the adaptive decision trail (docs/JOIN.md §5): what each
+            # joint cell's routing read and what it chose
+            exp.push("Adaptive")
+            exp.kv("enabled", str(bool(st.adaptive)).lower())
+            for strat in ("pairwise", "brute", "split.l", "split.r"):
+                if strat not in st.strategy_cells:
+                    continue
+                exp.kv(f"cells[{strat}]",
+                       f"{st.strategy_cells[strat]} "
+                       f"(est {st.est_pairs.get(strat, 0)} pairs, "
+                       f"dispatched {st.dispatched_pairs.get(strat, 0)} "
+                       f"slots)")
+            exp.kv("statistics read",
+                   "per-cell (n_build, n_probe); thresholds: brute <= "
+                   f"{config.JOIN_ADAPTIVE_BRUTE_PAIRS.to_int() or 256} "
+                   "pairs, skew >= "
+                   f"{config.JOIN_ADAPTIVE_SKEW_RATIO.to_int() or 8}:1 "
+                   "over tile")
             if analyze:
                 t0 = time.perf_counter()
                 _, total = join_exec.execute_predicate(
@@ -2591,6 +2927,14 @@ class GeoDataset:
             }
             if jpos is not None:
                 entry["journal_seq"] = jpos
+            if self.standing is not None:
+                # standing subscriptions checkpoint WITH the schema: the
+                # save truncates their journal records, so the manifest
+                # must carry them for load to re-register
+                # (docs/STANDING.md §7)
+                standing = self.standing.subscriptions(name)
+                if standing:
+                    entry["standing"] = standing
             if isinstance(st, PartitionedFeatureStore):
                 # incremental: only dirty partitions rewrite their snapshot
                 parts = st.checkpoint_into(os.path.join(path, f"{name}_parts"))
@@ -2734,6 +3078,7 @@ class GeoDataset:
                 int(b): os.path.join(path, rel)
                 for b, rel in meta["partitions"].items()
             })
+            self._standing_restore(name, meta)
             return
         # v2 chunked layout, with the v1 single-npz fallback
         chunk_files = meta.get("chunks")
@@ -2774,6 +3119,29 @@ class GeoDataset:
                 if k not in st._all.columns
             }
         self._standing_reattach(name)
+        self._standing_restore(name, meta)
+
+    def _standing_restore(self, name: str, meta: Dict) -> None:
+        """Re-register the checkpoint's standing subscriptions (manifest
+        ``entry["standing"]``, written by :meth:`save`) under their
+        ORIGINAL ids — each snapshot anchor re-evaluates against the
+        freshly attached store (docs/STANDING.md §7). A spec that no
+        longer validates (schema drift since the checkpoint) degrades
+        through the skip trail instead of failing the load."""
+        recs = meta.get("standing") or []
+        if not recs:
+            return
+        from geomesa_tpu.subscribe.spec import StandingSpec
+
+        for rec in recs:
+            try:
+                self._standing_engine().register(
+                    StandingSpec.from_dict(rec["spec"]),
+                    sub_id=rec["sub_id"])
+            except Exception as e:
+                resilience.record_skip(
+                    "standing.restore", f"{name}:{rec.get('sub_id')}", e,
+                    phase="load")
 
     def _standing_reattach(self, name: str) -> None:
         if self.standing is not None and self.standing.active(name):

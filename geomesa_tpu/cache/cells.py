@@ -103,6 +103,36 @@ def cell_prefix(level: int, cell: Tuple[int, int]) -> int:
     )[0])
 
 
+def point_cells(x, y, level: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Vectorized cell assignment at ``level``: int64 ``(ix, iy)`` per
+    point, clipped to the grid — every consumer of the cell family (the
+    cache decomposition, the join co-partition, the pushdown side scan)
+    derives the SAME cell for the same f64 coordinate, which is what lets
+    join cell groups key footer windows and cache statistics."""
+    n = 1 << level
+    sx, sy = 360.0 / n, 180.0 / n
+    x = np.asarray(x, np.float64)
+    y = np.asarray(y, np.float64)
+    ix = np.clip(np.floor((x + 180.0) / sx), 0, n - 1).astype(np.int64)
+    iy = np.clip(np.floor((y + 90.0) / sy), 0, n - 1).astype(np.int64)
+    return ix, iy
+
+
+def cell_boxes(level: int, ix: np.ndarray, iy: np.ndarray) -> np.ndarray:
+    """Vectorized :func:`cell_box`: f64 [C, 4] closed boxes (open edges
+    one ulp inward, domain-edge column/row closed) for cell index arrays
+    — the geometry ``classify_cells`` runs on for polygon joins."""
+    n = 1 << level
+    sx, sy = 360.0 / n, 180.0 / n
+    ix = np.asarray(ix, np.int64)
+    iy = np.asarray(iy, np.int64)
+    xmax = np.nextafter((ix + 1) * sx - 180.0, -np.inf)
+    ymax = np.nextafter((iy + 1) * sy - 90.0, -np.inf)
+    xmax = np.where(ix == n - 1, 180.0, xmax)
+    ymax = np.where(iy == n - 1, 90.0, ymax)
+    return np.stack([ix * sx - 180.0, iy * sy - 90.0, xmax, ymax], axis=1)
+
+
 @dataclass
 class _CellCover:
     """Shared shape of a partial-cover plan: the interior cells (served

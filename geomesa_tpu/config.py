@@ -446,6 +446,41 @@ JOIN_MAX_LEVEL = SystemProperty("geomesa.join.max.level", "12")
 #: Matched-pair ColumnBatch chunk size for the streaming join result.
 JOIN_BATCH_ROWS = SystemProperty("geomesa.join.batch.rows", "65536")
 
+#: Adaptive per-cell strategy selection (docs/JOIN.md §5): classify each
+#: joint cell from its build/probe counts and route it to the cheapest
+#: executor — dense balanced cells keep the bucketed pairwise kernel,
+#: sparse cells take the flat brute-force path (no tile padding), skewed
+#: cells split along the longer side with their own narrow buckets. OFF
+#: forces the single-strategy path everywhere (the A/B switch; results
+#: are bit-identical either way — only dispatch shapes change).
+JOIN_ADAPTIVE = SystemProperty("geomesa.join.adaptive", "true")
+
+#: A joint cell whose n_build * n_probe candidate product is at most this
+#: goes to the flat brute-force strategy (gathered 1-D pair list, no
+#: [B, P] tile padding).
+JOIN_ADAPTIVE_BRUTE_PAIRS = SystemProperty(
+    "geomesa.join.adaptive.brute.pairs", "256")
+
+#: A joint cell whose longer side holds at least this many times the
+#: shorter side's rows is SKEWED: its tiles dispatch in a separate
+#: section whose short-side bucket stays narrow instead of inflating to
+#: the dense cells' padding.
+JOIN_ADAPTIVE_SKEW_RATIO = SystemProperty(
+    "geomesa.join.adaptive.skew.ratio", "8")
+
+#: Window-pushdown join side scans (docs/JOIN.md §8, docs/LAKE.md): for
+#: ``join_count`` with the probe side on a partitioned store, stream the
+#: probe side per cell group through footer-pruned ranged reads instead
+#: of materializing the whole filtered side on the host.
+JOIN_PUSHDOWN = SystemProperty("geomesa.join.pushdown", "true")
+
+#: Cell-group size for the pushdown side scan: each probe-side ranged
+#: read covers at most this many occupied build cells. Smaller groups
+#: bound per-chunk host memory; larger groups amortize the footer pass
+#: and avoid re-decoding row groups that straddle chunk boundaries
+#: (adjacent chunks' inflated windows overlap by the reach).
+JOIN_PUSHDOWN_CELLS = SystemProperty("geomesa.join.pushdown.cells", "256")
+
 # ---------------------------------------------------------------------------
 # Resilience layer (resilience.py; docs/RESILIENCE.md). Retry defaults track
 # the reference's tablet-server client retry posture; the breaker fences a

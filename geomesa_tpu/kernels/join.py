@@ -268,6 +268,143 @@ def brute_force_pairs(lx, ly, rx, ry, predicate: str, p0, p1,
     return np.concatenate(out, axis=0)
 
 
+# ---------------------------------------------------------------------------
+# Polygon-dataset join predicates (docs/JOIN.md §7): one side of the join is
+# a POLYGON schema. Same contract as pair_mask: ONE function serves the
+# device kernel (xp = jax.numpy) and the numpy N*M reference, in the same
+# f32 arithmetic and op order, so the cell-classified polygon join is
+# bit-identical to the reference by construction — classify_cells only
+# decides WHICH (point, row) pairs reach the kernel (boundary cells) or
+# match wholesale (interior cells, with CLASSIFY_MARGIN to spare), never
+# how a tested pair decides.
+# ---------------------------------------------------------------------------
+
+#: polygon-side predicate kinds: ``pip`` — the point's even-odd crossing
+#: parity against the row's (multi)polygon (holes ride their polygon's
+#: parity; multipolygon parts OR) — and ``poly_bbox`` — the point lies in
+#: the row's bounds (inclusive edges)
+JOIN_PIP, JOIN_POLY_BBOX = "pip", "poly_bbox"
+POLYGON_PREDICATES = (JOIN_PIP, JOIN_POLY_BBOX)
+
+
+def polygon_tables(geoms, pad_edges=None, pad_parts=None, pad_rows=None):
+    """Flattened f32 tables for a polygon join side (one (multi)polygon
+    per right row): ``x1/y1/x2/y2`` [E] ring segments (shells AND holes —
+    parity per part handles holes), int32 ``part_id`` [E] (flat part per
+    edge; a part is one Polygon with its holes), int32 ``part_row`` [Pf]
+    (right row per flat part), f32 ``boxes`` [R, 4] per-row bounds, plus
+    the static counts. Optional pow2 padding for the bucketed device
+    kernel: padded edges are degenerate (1e30 — never straddle), padded
+    parts map to row 0 with no edges (parity never true), padded rows
+    carry impossible boxes (min > max)."""
+    from geomesa_tpu.utils import geometry as geo
+
+    x1s, y1s, x2s, y2s, pids = [], [], [], [], []
+    part_rows: "list[int]" = []
+    boxes = []
+    for j, g in enumerate(geoms):
+        boxes.append(g.bounds())
+        polys = g.polygons if isinstance(g, geo.MultiPolygon) else (g,)
+        for p in polys:
+            pid = len(part_rows)
+            part_rows.append(j)
+            for r in p.rings():
+                x1s.append(r[:-1, 0]); y1s.append(r[:-1, 1])
+                x2s.append(r[1:, 0]); y2s.append(r[1:, 1])
+                pids.append(np.full(len(r) - 1, pid, np.int32))
+    t = {
+        "x1": np.concatenate(x1s).astype(np.float32),
+        "y1": np.concatenate(y1s).astype(np.float32),
+        "x2": np.concatenate(x2s).astype(np.float32),
+        "y2": np.concatenate(y2s).astype(np.float32),
+        "part_id": np.concatenate(pids),
+        "part_row": np.asarray(part_rows, np.int32),
+        "boxes": np.asarray(boxes, np.float32),
+        "n_edges": len(np.concatenate(pids)),
+        "n_parts": len(part_rows),
+        "n_rows": len(geoms),
+    }
+    e, pf, r = t["n_edges"], t["n_parts"], t["n_rows"]
+    ep = max(pad_edges or e, e)
+    pp = max(pad_parts or pf, pf)
+    rp = max(pad_rows or r, r)
+    if ep > e:
+        for k in ("x1", "y1", "x2", "y2"):
+            t[k] = np.concatenate([t[k], np.full(ep - e, 1e30, np.float32)])
+        t["part_id"] = np.concatenate(
+            [t["part_id"], np.zeros(ep - e, np.int32)])
+    if pp > pf:
+        t["part_row"] = np.concatenate(
+            [t["part_row"], np.zeros(pp - pf, np.int32)])
+    if rp > r:
+        dead = np.empty((rp - r, 4), np.float32)
+        dead[:, :2], dead[:, 2:] = 1e30, -1e30
+        t["boxes"] = np.concatenate([t["boxes"], dead])
+    t["n_parts_padded"], t["n_rows_padded"] = pp, rp
+    return t
+
+
+def polygon_mask(px, py, t, predicate: str, xp):
+    """[N, R] polygon-join verdict matrix (f32). ``pip``: per-part
+    even-odd crossing parity via :func:`crossing_matrix`, OR over each
+    row's parts (the multipolygon semantic :func:`classify_cells`
+    matches; a polygon's holes share its part, so parity subtracts them).
+    ``poly_bbox``: inclusive-edge containment in the row's f32 bounds.
+    Pure exactly-rounded f32 arithmetic on the shared tables — the same
+    function IS the brute-force reference."""
+    px = px.astype(xp.float32)
+    py = py.astype(xp.float32)
+    if predicate == JOIN_POLY_BBOX:
+        b = t["boxes"]
+        return (
+            (px[:, None] >= b[None, :, 0]) & (py[:, None] >= b[None, :, 1])
+            & (px[:, None] <= b[None, :, 2]) & (py[:, None] <= b[None, :, 3])
+        )
+    if predicate != JOIN_PIP:
+        raise ValueError(f"unknown polygon join predicate {predicate!r}")
+    cross = crossing_matrix(
+        px, py, t["x1"], t["y1"], t["x2"], t["y2"], xp
+    ).astype(xp.int32)  # [N, E]
+    P = int(t["n_parts_padded"])
+    R = int(t["n_rows_padded"])
+    if xp is np:
+        counts = np.zeros((P, cross.shape[0]), np.int32)
+        np.add.at(counts, t["part_id"], cross.T)
+        inside = (counts % 2) == 1  # [P, N]
+        hits = np.zeros((R, cross.shape[0]), np.int32)
+        np.add.at(hits, t["part_row"], inside.astype(np.int32))
+    else:
+        import jax
+
+        counts = jax.ops.segment_sum(cross.T, t["part_id"], num_segments=P)
+        inside = (counts % 2) == 1
+        hits = jax.ops.segment_sum(
+            inside.astype(xp.int32), t["part_row"], num_segments=R
+        )
+    return (hits > 0).T  # [N, R]
+
+
+def polygon_brute_force(px, py, geoms, predicate: str, chunk: int = 2048):
+    """The naive N*M polygon-join reference (numpy, chunked): matched
+    (point, right-row) pairs in row-major order — int64 [K, 2]. The
+    bench/CI bit-identity gates compare the cell-classified polygon join
+    against exactly this (same :func:`polygon_mask`, same tables)."""
+    t = polygon_tables(geoms)
+    px = np.asarray(px, np.float32)
+    py = np.asarray(py, np.float32)
+    out = []
+    for lo in range(0, len(px), chunk):
+        hi = min(lo + chunk, len(px))
+        m = polygon_mask(px[lo:hi], py[lo:hi], t, predicate, np)
+        li, rj = np.nonzero(m)
+        if len(li):
+            out.append(np.stack([li.astype(np.int64) + lo,
+                                 rj.astype(np.int64)], axis=1))
+    if not out:
+        return np.zeros((0, 2), np.int64)
+    return np.concatenate(out, axis=0)
+
+
 def pip_counts(px, py, mask, edges, weights, xp):
     """Per-polygon masked point (or weight) totals: float32 [P]."""
     P = int(edges["n_polys"])

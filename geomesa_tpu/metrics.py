@@ -543,6 +543,15 @@ JOIN_QUERIES = "join.queries"
 JOIN_CELLS = "join.cells"
 JOIN_CANDIDATE_PAIRS = "join.candidate.pairs"
 JOIN_PAIRS = "join.pairs"
+# Adaptive strategy decision trail (docs/JOIN.md §5): per-strategy joint-
+# cell routing counts — join.cells.pairwise / .brute / .split, plus
+# join.cells.interior for polygon-join cells matched wholesale with zero
+# pairwise work. The prefix is the ledger contract; suffixes come from
+# JoinStats.strategy_cells.
+JOIN_CELLS_STRATEGY = "join.cells."
+#   join.pushdown.bytes   probe-side payload bytes actually read by the
+#                         window-pushdown join side scan (vs skipped)
+JOIN_PUSHDOWN_BYTES = "join.pushdown.bytes"
 # Columnar geo-lake tier (geomesa_tpu/lake/; docs/LAKE.md):
 #   lake.bytes.read        payload + footer bytes actually read
 #   lake.bytes.skipped     payload bytes statistics-pruning never touched

@@ -23,21 +23,54 @@ Build/probe contract:
   row's covered cells — each surviving pair is tested exactly ONCE,
   because the build cell is unique. No dedup pass exists or is needed.
 
+Adaptive strategy selection (docs/JOIN.md §5): after co-partitioning, each
+joint cell routes to the cheapest executor from its own (n_left, n_right)
+statistics — the shape "Adaptive Geospatial Joins for Modern Hardware"
+picks per-cell:
+
+* **pairwise** — dense, balanced cells chunk into tiles for the bucketed
+  [Cp, Bp, Pp] pairwise kernel (the only strategy when
+  ``geomesa.join.adaptive`` is off);
+* **brute** — sparse cells (``n_left * n_right`` at most
+  ``geomesa.join.adaptive.brute.pairs``) gather into ONE flat 1-D
+  candidate-pair list and skip tile padding entirely;
+* **split.l / split.r** — skewed cells (one side ≫ the other) land in an
+  orientation-specific section whose short-axis padding buckets
+  independently, so a 3 x 500 cell pads to (4, tile) instead of the dense
+  section's (Bp, Pp).
+
+Strategy routing only decides WHICH executor tests a candidate pair —
+every executor runs the SAME ``kernels.join.pair_mask`` f32 arithmetic and
+the merged pair set surfaces in canonical row-major order, so the adaptive
+join is bit-identical to the single-strategy path and to the numpy N*M
+reference by construction (CI-gated).
+
 Device execution: per-cell blocks chunk into **tiles** of at most
 ``geomesa.join.tile`` rows per side, both tile axes pow2-bucketed and the
 tile count bucketed per dispatch, so the bucketed pairwise kernel's
 registry key — ``(site, Bp, Pp, Cp, predicate)``, predicate *parameters*
 ride as traced f32 scalars — is version-stable: repeated joins over fresh
-data of similar size NEVER recompile (CI-gated recompiles==0).
+data of similar size NEVER recompile (CI-gated recompiles==0). The
+strategy lives in the key's ``site`` ("join.pairs" / "join.pairs.split" /
+"join.brute" / "join.poly"), never in traced data, so strategy mixes
+cannot recompile each other.
 
-Sharded fan-out: the tile axis splits into one contiguous slice per
-usable device (``parallel.devices.scan_devices``); counts merge via the
-documented :func:`~geomesa_tpu.parallel.devices.tree_merge` order and
-pair blocks concatenate in slice order, so the sharded join is
-bit-identical to the single-device (and numpy brute-force) result by
-construction. Per-slice failures degrade under
-``resilience.allow_partial()`` with exact survivor totals (the skipped
-tile ranges are recorded; completed tiles' pairs/counts are exact).
+Sharded fan-out: each section's tile axis splits into one contiguous
+slice per usable device (``parallel.devices.scan_devices``); counts merge
+via the documented :func:`~geomesa_tpu.parallel.devices.tree_merge` order
+and pair blocks concatenate in slice order before the canonical row-major
+sort, so the sharded join is bit-identical to the single-device (and
+numpy brute-force) result by construction. Per-slice failures degrade
+under ``resilience.allow_partial()`` with exact survivor totals (the
+skipped tile ranges are recorded; completed tiles' pairs/counts are
+exact).
+
+Polygon-dataset joins (docs/JOIN.md §7): :func:`run_polygon_join` joins a
+point side against a POLYGON dataset side by classifying each occupied
+point cell against each candidate polygon row with
+``kernels.join.classify_cells`` + ``CLASSIFY_MARGIN`` — interior cells
+match wholesale with ZERO pairwise work, outside cells are skipped, and
+only boundary cells pay the polygon kernel.
 """
 
 from __future__ import annotations
@@ -60,6 +93,12 @@ from geomesa_tpu.resilience import check_deadline, partial_allowed, record_skip
 _REGISTRY: Optional[KernelRegistry] = None
 _REGISTRY_LOCK = threading.Lock()
 
+#: fixed section order — part of the bit-identity contract: sections
+#: execute in this order, pairs concatenate in section/slice order, and
+#: the canonical row-major sort at the end makes the surfaced set
+#: independent of the routing anyway
+SECTION_ORDER = ("pairwise", "split.l", "split.r")
+
 
 def join_registry() -> KernelRegistry:
     """The process-wide join-kernel registry (recompile accounting for the
@@ -80,10 +119,21 @@ def _tile() -> int:
     return 64 if t is None else max(int(t), 8)
 
 
+def _brute_max() -> int:
+    v = config.JOIN_ADAPTIVE_BRUTE_PAIRS.to_int()
+    return 256 if v is None else max(int(v), 0)
+
+
+def _skew_ratio() -> int:
+    v = config.JOIN_ADAPTIVE_SKEW_RATIO.to_int()
+    return 8 if v is None else max(int(v), 2)
+
+
 @dataclass
 class JoinStats:
     """The explain/audit account of one co-partitioned join (docs/JOIN.md):
-    how much the grid filter pruned vs the naive N*M."""
+    how much the grid filter pruned vs the naive N*M, and which strategy
+    each joint cell routed to."""
 
     level: int = 0
     n_left: int = 0
@@ -101,6 +151,23 @@ class JoinStats:
     devices: int = 1
     #: tile ranges skipped under allow_partial (exact survivor totals)
     skipped: List[str] = field(default_factory=list)
+    #: whether per-cell strategy selection ran (vs the single-strategy A/B)
+    adaptive: bool = False
+    #: adaptive decision trail: joint cells per strategy (pairwise / brute
+    #: / split.l / split.r; polygon joins: interior / boundary incidences)
+    strategy_cells: Dict[str, int] = field(default_factory=dict)
+    #: candidate pairs per strategy as estimated at classification time
+    #: (the statistic each routing decision read)
+    est_pairs: Dict[str, int] = field(default_factory=dict)
+    #: pair slots actually dispatched per strategy AFTER padding — the
+    #: estimated-vs-actual gap is exactly the padding the routing saved
+    dispatched_pairs: Dict[str, int] = field(default_factory=dict)
+    #: polygon-join pairs matched wholesale from INTERIOR cells — zero
+    #: pairwise kernel work, by the CLASSIFY_MARGIN contract
+    wholesale_pairs: int = 0
+    #: lake window-pushdown side-scan account (api.dataset join pushdown):
+    #: groups/bytes loaded vs skipped by per-cell footer pruning
+    pushdown: Dict[str, int] = field(default_factory=dict)
 
     @property
     def naive_pairs(self) -> int:
@@ -148,34 +215,74 @@ def _cell_ids(ix: np.ndarray, iy: np.ndarray) -> np.ndarray:
 
 
 @dataclass
+class TileSection:
+    """One strategy's padded tile blocks: [C, Bp] / [C, Pp] global row
+    positions (0-padded; valid counts mask), pow2-bucketed independently
+    of every other section — the skew win is exactly that a split
+    section's short axis pads to ITS OWN maximum, not the dense
+    section's."""
+
+    strategy: str  # "pairwise" | "split.l" | "split.r"
+    site: str  # kernel registry site ("join.pairs" / "join.pairs.split")
+    l_rows: np.ndarray
+    r_rows: np.ndarray
+    l_valid: np.ndarray  # [C] int32
+    r_valid: np.ndarray  # [C] int32
+    Bp: int
+    Pp: int
+
+    @property
+    def n_tiles(self) -> int:
+        return len(self.l_rows)
+
+
+@dataclass
 class JoinPlan:
-    """Host-side co-partition product: padded tile blocks ready for the
-    bucketed pairwise kernel. All index arrays are int32 positions into
-    the caller's left/right row sets."""
+    """Host-side co-partition product: per-strategy tile sections ready
+    for the bucketed pairwise kernel, plus the flat brute-force candidate
+    list for sparse cells. All index arrays are int32 positions into the
+    caller's left/right row sets."""
 
     predicate: str
     p0: np.float32
     p1: np.float32
     stats: JoinStats
-    #: [C, Bp] / [C, Pp] global row positions (0-padded; valid counts mask)
-    l_rows: np.ndarray = None  # type: ignore[assignment]
-    r_rows: np.ndarray = None  # type: ignore[assignment]
-    l_valid: np.ndarray = None  # type: ignore[assignment]  # [C] int32
-    r_valid: np.ndarray = None  # type: ignore[assignment]  # [C] int32
-    Bp: int = 0
-    Pp: int = 0
+    sections: List[TileSection] = field(default_factory=list)
+    #: flat sparse-cell candidate pairs (global row positions, aligned)
+    brute_l: Optional[np.ndarray] = None
+    brute_r: Optional[np.ndarray] = None
 
     @property
     def n_tiles(self) -> int:
-        return 0 if self.l_rows is None else len(self.l_rows)
+        return sum(s.n_tiles for s in self.sections)
+
+    @property
+    def n_brute(self) -> int:
+        return 0 if self.brute_l is None else len(self.brute_l)
+
+    @property
+    def Bp(self) -> int:
+        return max((s.Bp for s in self.sections), default=0)
+
+    @property
+    def Pp(self) -> int:
+        return max((s.Pp for s in self.sections), default=0)
 
 
 def co_partition(lx, ly, rx, ry, predicate: str, reach_x,
                  reach_y: float, level: Optional[int] = None,
-                 p0=None, p1=None, wrap_x: bool = False) -> JoinPlan:
-    """Group both sides by SFC cell at ``level`` (adaptive when None) and
-    chunk joint cells into padded tile blocks. Pure host numpy — the
-    grouping is two argsorts plus a bounded neighbor expansion.
+                 p0=None, p1=None, wrap_x: bool = False,
+                 adaptive: Optional[bool] = None) -> JoinPlan:
+    """Group both sides by SFC cell at ``level`` (adaptive when None),
+    classify each joint cell's strategy from its (n_left, n_right), and
+    chunk into per-strategy padded tile sections plus the flat brute
+    list. Pure host numpy — the grouping is two argsorts plus a bounded
+    neighbor expansion.
+
+    ``adaptive`` None reads ``geomesa.join.adaptive``; False forces every
+    joint cell through the single "pairwise" section — exactly the
+    pre-adaptive plan, the A/B baseline the CI speedup gate compares
+    against.
 
     ``reach_x`` may be a per-probe-row array (``dwithin_meters``: the lon
     reach needed for ``d`` meters grows with |latitude|). ``wrap_x``
@@ -201,7 +308,11 @@ def co_partition(lx, ly, rx, ry, predicate: str, reach_x,
                 max(lx.max(), rx.max()), max(ly.max(), ry.max()),
             )
         level = choose_level(n_l, n_r, reach, bounds)
-    stats = JoinStats(level=level, n_left=len(lx), n_right=len(rx))
+    if adaptive is None:
+        adaptive = config.JOIN_ADAPTIVE.to_bool()
+        adaptive = True if adaptive is None else bool(adaptive)
+    stats = JoinStats(level=level, n_left=len(lx), n_right=len(rx),
+                      adaptive=bool(adaptive))
     plan = JoinPlan(predicate=predicate, p0=p0, p1=p1, stats=stats)
     if not len(lx) or not len(rx):
         return plan
@@ -274,39 +385,97 @@ def co_partition(lx, ly, rx, ry, predicate: str, reach_x,
     lstart = np.concatenate(([0], np.cumsum(lcounts)))
     rstart = np.concatenate(([0], np.cumsum(rcounts)))
 
-    # tile chunking: skewed cells split into ceil(nb/T) x ceil(np/T)
-    # tile pairs instead of inflating every cell's padding
+    # per-cell strategy classification (module docstring): sparse cells
+    # gather flat, skewed cells bucket in their own orientation section so
+    # the short axis pads narrow, dense balanced cells tile as before.
+    # Adaptive-mode tile shapes are STATIC per strategy — (Tp, Tp),
+    # (Tp, SPLIT_SHORT), (SPLIT_SHORT, Tp) — never derived from data
+    # maxima, so fresh data of any distribution re-lands on the warmed
+    # kernels (the recompiles==0 contract holds across strategy mixes);
+    # single-strategy mode keeps the legacy exact-maxima padding — it IS
+    # the A/B baseline and must stay byte-for-byte the old plan
     T = _tile()
-    tl_rows: List[np.ndarray] = []
-    tr_rows: List[np.ndarray] = []
-    tl_valid: List[int] = []
-    tr_valid: List[int] = []
-    max_b = max_p = 1
+    Tp = _pow2(T)
+    brute_max = _brute_max() if adaptive else 0
+    skew = _skew_ratio()
+    # fixed short-axis chunk for split sections: skewed cells chunk their
+    # SHORT side at this step too, so the section pads to exactly
+    # (Tp, SPLIT_SHORT) — ~Tp/SPLIT_SHORT x less padded work than the
+    # dense section would spend on the same cell
+    split_short = min(8, Tp)
+    bl_list: List[np.ndarray] = []
+    br_list: List[np.ndarray] = []
+    # strategy -> [tl_rows, tr_rows, tl_valid, tr_valid, max_b, max_p]
+    buckets: Dict[str, list] = {}
     for c in np.nonzero(joint)[0]:
         lrows = lsorted[lstart[c]: lstart[c + 1]]
         rrows = rsorted[rstart[c]: rstart[c + 1]]
-        for bl in range(0, len(lrows), T):
-            lchunk = lrows[bl: bl + T]
-            for pl in range(0, len(rrows), T):
-                rchunk = rrows[pl: pl + T]
+        nl, nr = len(lrows), len(rrows)
+        if adaptive and nl * nr <= brute_max:
+            strat = "brute"
+            # flat candidate list, left-major (matches the reference's
+            # row-major nonzero order; the global sort re-establishes it
+            # across strategies anyway)
+            bl_list.append(np.repeat(lrows, nr))
+            br_list.append(np.tile(rrows, nl))
+        elif adaptive and max(nl, nr) >= skew * max(min(nl, nr), 1) \
+                and max(nl, nr) > T:
+            strat = "split.l" if nl >= nr else "split.r"
+        else:
+            strat = "pairwise"
+        stats.strategy_cells[strat] = stats.strategy_cells.get(strat, 0) + 1
+        stats.est_pairs[strat] = stats.est_pairs.get(strat, 0) + nl * nr
+        if strat == "brute":
+            continue
+        if strat == "split.l":
+            tb, tp = T, split_short
+        elif strat == "split.r":
+            tb, tp = split_short, T
+        else:
+            tb = tp = T
+        bucket = buckets.setdefault(strat, [[], [], [], [], 1, 1])
+        tl_rows, tr_rows, tl_valid, tr_valid = bucket[0], bucket[1], \
+            bucket[2], bucket[3]
+        for bl in range(0, nl, tb):
+            lchunk = lrows[bl: bl + tb]
+            for pl in range(0, nr, tp):
+                rchunk = rrows[pl: pl + tp]
                 tl_rows.append(lchunk)
                 tr_rows.append(rchunk)
                 tl_valid.append(len(lchunk))
                 tr_valid.append(len(rchunk))
-                max_b = max(max_b, len(lchunk))
-                max_p = max(max_p, len(rchunk))
-    C = len(tl_rows)
-    stats.tiles = C
-    Bp, Pp = _pow2(max_b), _pow2(max_p)
-    l_rows = np.zeros((C, Bp), np.int32)
-    r_rows = np.zeros((C, Pp), np.int32)
-    for i in range(C):
-        l_rows[i, : tl_valid[i]] = tl_rows[i]
-        r_rows[i, : tr_valid[i]] = tr_rows[i]
-    plan.l_rows, plan.r_rows = l_rows, r_rows
-    plan.l_valid = np.asarray(tl_valid, np.int32)
-    plan.r_valid = np.asarray(tr_valid, np.int32)
-    plan.Bp, plan.Pp = Bp, Pp
+                bucket[4] = max(bucket[4], len(lchunk))
+                bucket[5] = max(bucket[5], len(rchunk))
+    for strat in SECTION_ORDER:
+        if strat not in buckets:
+            continue
+        tl_rows, tr_rows, tl_valid, tr_valid, max_b, max_p = buckets[strat]
+        C = len(tl_rows)
+        if not adaptive:
+            Bp, Pp = _pow2(max_b), _pow2(max_p)  # legacy exact padding
+        elif strat == "split.l":
+            Bp, Pp = Tp, split_short
+        elif strat == "split.r":
+            Bp, Pp = split_short, Tp
+        else:
+            Bp = Pp = Tp
+        l_rows = np.zeros((C, Bp), np.int32)
+        r_rows = np.zeros((C, Pp), np.int32)
+        for i in range(C):
+            l_rows[i, : tl_valid[i]] = tl_rows[i]
+            r_rows[i, : tr_valid[i]] = tr_rows[i]
+        site = "join.pairs" if strat == "pairwise" else "join.pairs.split"
+        plan.sections.append(TileSection(
+            strategy=strat, site=site, l_rows=l_rows, r_rows=r_rows,
+            l_valid=np.asarray(tl_valid, np.int32),
+            r_valid=np.asarray(tr_valid, np.int32), Bp=Bp, Pp=Pp,
+        ))
+        stats.tiles += C
+        stats.dispatched_pairs[strat] = C * Bp * Pp
+    if bl_list:
+        plan.brute_l = np.concatenate(bl_list)
+        plan.brute_r = np.concatenate(br_list)
+        stats.dispatched_pairs["brute"] = len(plan.brute_l)
     return plan
 
 
@@ -314,12 +483,14 @@ def co_partition(lx, ly, rx, ry, predicate: str, reach_x,
 # Bucketed pairwise kernels (the version-stable registry half)
 # ---------------------------------------------------------------------------
 
-def _pairs_kernel(Bp: int, Pp: int, Cp: int, predicate: str):
+def _pairs_kernel(site: str, Bp: int, Pp: int, Cp: int, predicate: str):
     """Registry-cached jitted kernel: [Cp, Bp, Pp] bool verdict mask plus
     [Cp] int32 per-tile match counts. Predicate parameters are traced f32
-    scalars (kernel data), so distances never recompile."""
+    scalars (kernel data), so distances never recompile. ``site`` is the
+    strategy's registry site ("join.pairs" / "join.pairs.split") — the
+    strategy lives in the KEY, so mixing strategies never recompiles."""
     reg = join_registry()
-    key = ("join.pairs", Bp, Pp, Cp, predicate)
+    key = (site, Bp, Pp, Cp, predicate)
     go = reg.get(key)
     if go is not None:
         return go
@@ -358,6 +529,39 @@ def _pairs_kernel(Bp: int, Pp: int, Cp: int, predicate: str):
     return go
 
 
+def _brute_kernel(Kp: int, predicate: str):
+    """Registry-cached jitted kernel for the flat sparse-cell strategy:
+    1-D [Kp] gathered candidate pairs, bool verdict + int32 match count.
+    Same ``pair_mask`` f32 arithmetic as the tiled kernel — elementwise
+    instead of broadcast, so each tested pair decides identically."""
+    reg = join_registry()
+    key = ("join.brute", Kp, predicate)
+    go = reg.get(key)
+    if go is not None:
+        return go
+    import jax
+    import jax.numpy as jnp
+
+    def _mask(m, kvalid):
+        m = m & (jnp.arange(Kp, dtype=jnp.int32) < kvalid)
+        return m, m.sum(dtype=jnp.int32)
+
+    if predicate == kjoin.JOIN_DWITHIN_METERS:
+        @jax.jit
+        def go(lxv, lyv, lzv, rxv, ryv, rzv, kvalid, p0, p1):
+            m = kjoin.pair_mask(lxv, lyv, rxv, ryv, predicate, p0, p1,
+                                jnp, lz=lzv, rz=rzv)
+            return _mask(m, kvalid)
+    else:
+        @jax.jit
+        def go(lxv, lyv, rxv, ryv, kvalid, p0, p1):
+            m = kjoin.pair_mask(lxv, lyv, rxv, ryv, predicate, p0, p1, jnp)
+            return _mask(m, kvalid)
+
+    reg.put(key, go)
+    return go
+
+
 def _devices(prefer_device: bool):
     """Devices for the join tile fan-out (same stand-down rules as the
     sharded partitioned scan), or None for the single default device."""
@@ -368,40 +572,46 @@ def _devices(prefer_device: bool):
     return pdev.scan_devices()
 
 
-def _pad_tiles(plan: JoinPlan, lo: int, hi: int, lx32, ly32, rx32, ry32,
+def _pad_tiles(sec: TileSection, lo: int, hi: int, lx32, ly32, rx32, ry32,
                lz32=None, rz32=None):
     """One device slice's padded kernel operands: tile rows [Cp, Bp/Pp]
     gathered into coordinate blocks, Cp = pow2 bucket of the slice.
     ``lz32``/``rz32`` (dwithin_meters unit vectors) gather to z blocks."""
     C = hi - lo
     Cp = _pow2(C)
-    lrows = np.zeros((Cp, plan.Bp), np.int32)
-    rrows = np.zeros((Cp, plan.Pp), np.int32)
+    lrows = np.zeros((Cp, sec.Bp), np.int32)
+    rrows = np.zeros((Cp, sec.Pp), np.int32)
     lval = np.zeros(Cp, np.int32)
     rval = np.zeros(Cp, np.int32)
-    lrows[:C] = plan.l_rows[lo:hi]
-    rrows[:C] = plan.r_rows[lo:hi]
-    lval[:C] = plan.l_valid[lo:hi]
-    rval[:C] = plan.r_valid[lo:hi]
+    lrows[:C] = sec.l_rows[lo:hi]
+    rrows[:C] = sec.r_rows[lo:hi]
+    lval[:C] = sec.l_valid[lo:hi]
+    rval[:C] = sec.r_valid[lo:hi]
     lzb = None if lz32 is None else lz32[lrows]
     rzb = None if rz32 is None else rz32[rrows]
     return (lx32[lrows], ly32[lrows], rx32[rrows], ry32[rrows],
             lval, rval, Cp, C, lzb, rzb)
 
 
+def _slices(n: int, n_dev: int) -> List[Tuple[int, int]]:
+    edges = np.linspace(0, n, n_dev + 1).astype(int)
+    return [(int(a), int(b)) for a, b in zip(edges[:-1], edges[1:])
+            if b > a]
+
+
 def execute(plan: JoinPlan, lx, ly, rx, ry, prefer_device: bool = True,
             want_pairs: bool = True, lz=None, rz=None):
-    """Run the bucketed pairwise kernel over the plan's tiles, sharded
-    over the device mesh. Returns ``(pairs, total)``: matched global
-    (left, right) row positions as int64 [K, 2] sorted row-major (None
-    when ``want_pairs`` is False) and the exact match total over
-    completed tiles. Per-slice failures degrade under
-    ``resilience.allow_partial()`` (recorded in ``plan.stats.skipped``);
-    totals stay exact over survivors. For ``dwithin_meters``, the
-    coordinate operands are the sides' precomputed f32 unit vectors
-    ((lx, ly, lz) / (rx, ry, rz) — kernels.join.unit_vectors)."""
+    """Run every strategy section (and the flat brute list) over the
+    device mesh. Returns ``(pairs, total)``: matched global (left, right)
+    row positions as int64 [K, 2] sorted row-major (None when
+    ``want_pairs`` is False) and the exact match total over completed
+    work. Per-slice failures degrade under ``resilience.allow_partial()``
+    (recorded in ``plan.stats.skipped``); totals stay exact over
+    survivors. For ``dwithin_meters``, the coordinate operands are the
+    sides' precomputed f32 unit vectors ((lx, ly, lz) / (rx, ry, rz) —
+    kernels.join.unit_vectors)."""
     stats = plan.stats
-    if plan.n_tiles == 0:
+    if plan.n_tiles == 0 and plan.n_brute == 0:
         return (np.zeros((0, 2), np.int64) if want_pairs else None), 0
     lx32 = np.asarray(lx, np.float32)
     ly32 = np.asarray(ly, np.float32)
@@ -413,29 +623,83 @@ def execute(plan: JoinPlan, lx, ly, rx, ry, prefer_device: bool = True,
     devs = _devices(prefer_device) if use_device else None
     n_dev = len(devs) if devs else 1
     stats.devices = n_dev
-    # contiguous tile slices, one per device (bit-identity: slice order ==
-    # tile order; counts tree-merge in slice order)
-    edges = np.linspace(0, plan.n_tiles, n_dev + 1).astype(int)
-    slices = [(int(a), int(b)) for a, b in zip(edges[:-1], edges[1:])
-              if b > a]
-    partials = []
-    for i, (lo, hi) in enumerate(slices):
-        check_deadline()
-        dev = devs[i % len(devs)] if devs else None
-        try:
-            partials.append(
-                _run_slice(plan, lo, hi, lx32, ly32, rx32, ry32,
-                           use_device, dev, want_pairs,
-                           lz32=lz32, rz32=rz32)
-            )
-        except BaseException as e:
-            from geomesa_tpu.resilience import QueryTimeoutError
+    from geomesa_tpu.resilience import QueryTimeoutError
 
-            if isinstance(e, QueryTimeoutError) or not partial_allowed():
-                raise
-            record_skip("join", f"tiles[{lo}:{hi}]", e, phase="pairs")
-            stats.skipped.append(f"tiles[{lo}:{hi}]")
-            partials.append(None)
+    # contiguous tile slices per section, one per device (bit-identity:
+    # pairs concat in section/slice order, then the canonical sort; counts
+    # tree-merge in the same order)
+    import functools
+
+    jobs = []
+    di = 0
+    # fan each section out proportionally to its tile share: a full
+    # n_dev split of every section multiplies launch count by the
+    # number of strategies, and per-launch overhead — not slot math —
+    # is what the sparse/skewed strategies are saving. Single-section
+    # plans (adaptive off) keep the exact n_dev split.
+    total_tiles = sum(s.n_tiles for s in plan.sections)
+    for sec in plan.sections:
+        fan = max(1, round(n_dev * sec.n_tiles / total_tiles)) \
+            if total_tiles else 1
+        for lo, hi in _slices(sec.n_tiles, fan):
+            dev = devs[di % len(devs)] if devs else None
+            di += 1
+            jobs.append((f"tiles[{lo}:{hi}]", functools.partial(
+                _run_slice, plan, lo, hi, lx32, ly32, rx32, ry32,
+                use_device, dev, want_pairs, lz32=lz32, rz32=rz32,
+                sec=sec)))
+    if plan.n_brute:
+        # fixed-size brute chunks: every dispatch (including the final
+        # partial one) pads to the SAME pow2 length — four dense tiles'
+        # worth of slots — so the registry holds exactly one
+        # ("join.brute", Kp, predicate) entry no matter how many sparse
+        # pairs fresh data produces (the recompiles==0 contract). The
+        # chunk is sized so launch overhead, not padding, sets the cost:
+        # a 16k-slot flat kernel is still far cheaper than one tile.
+        bchunk = 4 * _pow2(_tile()) ** 2
+        for lo in range(0, plan.n_brute, bchunk):
+            hi = min(lo + bchunk, plan.n_brute)
+            dev = devs[di % len(devs)] if devs else None
+            di += 1
+            jobs.append((f"brute[{lo}:{hi}]", functools.partial(
+                _run_brute_slice, plan, lo, hi, lx32, ly32, rx32, ry32,
+                use_device, dev, want_pairs, lz32=lz32, rz32=rz32,
+                Kp=bchunk)))
+    # multi-device: overlap the per-slice dispatch+fetch across worker
+    # threads (each slice blocks on its own device; serializing them
+    # leaves n_dev-1 devices idle per launch). Deadline checks and
+    # partial-degradation accounting stay on THIS thread — both are
+    # thread-local scopes — by collecting results in submission order,
+    # which is also what keeps pairs/count merge order deterministic.
+    partials = []
+    if use_device and n_dev > 1 and len(jobs) > 1:
+        from concurrent.futures import ThreadPoolExecutor
+
+        with ThreadPoolExecutor(max_workers=n_dev,
+                                thread_name_prefix="geomesa-join") as pool:
+            futs = [(label, pool.submit(fn)) for label, fn in jobs]
+            for label, fut in futs:
+                try:
+                    check_deadline()
+                    partials.append(fut.result())
+                except BaseException as e:
+                    if isinstance(e, QueryTimeoutError) \
+                            or not partial_allowed():
+                        raise
+                    record_skip("join", label, e, phase="pairs")
+                    stats.skipped.append(label)
+                    partials.append(None)
+    else:
+        for label, fn in jobs:
+            try:
+                check_deadline()
+                partials.append(fn())
+            except BaseException as e:
+                if isinstance(e, QueryTimeoutError) or not partial_allowed():
+                    raise
+                record_skip("join", label, e, phase="pairs")
+                stats.skipped.append(label)
+                partials.append(None)
     from geomesa_tpu.parallel.devices import tree_merge
 
     total = tree_merge(
@@ -451,22 +715,25 @@ def execute(plan: JoinPlan, lx, ly, rx, ry, prefer_device: bool = True,
         return np.zeros((0, 2), np.int64), total
     pairs = np.concatenate(blocks, axis=0)
     # canonical row-major order == the brute-force reference's nonzero
-    # order: the bit-identity contract is on the SET, surfaced sorted
+    # order: the bit-identity contract is on the SET, surfaced sorted —
+    # this is also what makes the adaptive routing invisible in results
     order = np.lexsort((pairs[:, 1], pairs[:, 0]))
     return pairs[order], total
 
 
 def _run_slice(plan: JoinPlan, lo: int, hi: int, lx32, ly32, rx32, ry32,
                use_device: bool, dev, want_pairs: bool,
-               lz32=None, rz32=None):
+               lz32=None, rz32=None, sec: Optional[TileSection] = None):
     """One tile slice: (pairs int64 [k, 2] in tile order, match count)."""
+    if sec is None:
+        sec = plan.sections[0]
     (lxb, lyb, rxb, ryb, lval, rval, Cp, C, lzb, rzb) = _pad_tiles(
-        plan, lo, hi, lx32, ly32, rx32, ry32, lz32, rz32
+        sec, lo, hi, lx32, ly32, rx32, ry32, lz32, rz32
     )
     if use_device:
         import jax
 
-        go = _pairs_kernel(plan.Bp, plan.Pp, Cp, plan.predicate)
+        go = _pairs_kernel(sec.site, sec.Bp, sec.Pp, Cp, plan.predicate)
         if plan.predicate == kjoin.JOIN_DWITHIN_METERS:
             ops = (lxb, lyb, lzb, rxb, ryb, rzb, lval, rval,
                    np.float32(plan.p0), np.float32(plan.p1))
@@ -490,19 +757,71 @@ def _run_slice(plan: JoinPlan, lo: int, hi: int, lx32, ly32, rx32, ry32,
             lz=None if lzb is None else lzb[:, :, None],
             rz=None if rzb is None else rzb[:, None, :],
         )
-        iota_b = np.arange(plan.Bp, dtype=np.int32)[None, :, None]
-        iota_p = np.arange(plan.Pp, dtype=np.int32)[None, None, :]
+        iota_b = np.arange(sec.Bp, dtype=np.int32)[None, :, None]
+        iota_p = np.arange(sec.Pp, dtype=np.int32)[None, None, :]
         m = m & (iota_b < lval[:, None, None]) & (iota_p < rval[:, None, None])
         counts = m.sum(axis=(1, 2), dtype=np.int32)
     n = int(counts[:C].sum())
     if not want_pairs:
         return np.zeros((0, 2), np.int64), n
     c, b, p = np.nonzero(m[:C])
-    lrows = plan.l_rows[lo:hi]
-    rrows = plan.r_rows[lo:hi]
+    lrows = sec.l_rows[lo:hi]
+    rrows = sec.r_rows[lo:hi]
     pairs = np.stack([
         lrows[c, b].astype(np.int64), rrows[c, p].astype(np.int64)
     ], axis=1)
+    return pairs, n
+
+
+def _run_brute_slice(plan: JoinPlan, lo: int, hi: int, lx32, ly32,
+                     rx32, ry32, use_device: bool, dev, want_pairs: bool,
+                     lz32=None, rz32=None, Kp: Optional[int] = None):
+    """One flat brute-force slice: the sparse-cell candidate pairs
+    [lo:hi) gathered into 1-D operands — no tile padding at all, just a
+    fixed length bucket (``Kp``, from the caller's chunking; pow2 of the
+    slice length when not given). Returns (pairs int64 [k, 2], count)."""
+    bl = plan.brute_l[lo:hi]
+    br = plan.brute_r[lo:hi]
+    K = hi - lo
+    if Kp is None:
+        Kp = _pow2(K)
+    lidx = np.zeros(Kp, np.int32)
+    ridx = np.zeros(Kp, np.int32)
+    lidx[:K] = bl
+    ridx[:K] = br
+    lxv, lyv = lx32[lidx], ly32[lidx]
+    rxv, ryv = rx32[ridx], ry32[ridx]
+    lzv = None if lz32 is None else lz32[lidx]
+    rzv = None if rz32 is None else rz32[ridx]
+    if use_device:
+        import jax
+
+        go = _brute_kernel(Kp, plan.predicate)
+        if plan.predicate == kjoin.JOIN_DWITHIN_METERS:
+            ops = (lxv, lyv, lzv, rxv, ryv, rzv, np.int32(K),
+                   np.float32(plan.p0), np.float32(plan.p1))
+        else:
+            ops = (lxv, lyv, rxv, ryv, np.int32(K),
+                   np.float32(plan.p0), np.float32(plan.p1))
+        if dev is not None:
+            ops = tuple(jax.device_put(o, dev) for o in ops)
+        with tracing.span("scan.join.brute", pairs=K, device=getattr(
+                dev, "id", None)), \
+                utilization.device_busy(getattr(dev, "id", 0) or 0):
+            metrics.inc(metrics.EXEC_DEVICE_DISPATCH)
+            m, n = go(*ops)
+        m = np.asarray(m)
+        n = int(n)
+    else:
+        m = kjoin.pair_mask(lxv, lyv, rxv, ryv, plan.predicate,
+                            plan.p0, plan.p1, np, lz=lzv, rz=rzv)
+        m = m & (np.arange(Kp, dtype=np.int32) < K)
+        n = int(m.sum())
+    if not want_pairs:
+        return np.zeros((0, 2), np.int64), n
+    k = np.nonzero(m[:K])[0]
+    pairs = np.stack([bl[k].astype(np.int64), br[k].astype(np.int64)],
+                     axis=1)
     return pairs, n
 
 
@@ -541,13 +860,15 @@ def meters_reach_deg(distance_m: float, lat) -> Tuple[np.ndarray, float]:
 
 def run_join(lx, ly, rx, ry, predicate: str, distance=None, dx=None,
              dy=None, level: Optional[int] = None,
-             prefer_device: bool = True, want_pairs: bool = True):
+             prefer_device: bool = True, want_pairs: bool = True,
+             adaptive: Optional[bool] = None):
     """Full co-partitioned join: plan + execute. Returns
     ``(pairs, total, stats)``. ``predicate``: ``"bbox"`` (half-widths
     ``dx``/``dy``), ``"dwithin"`` (planar degree ``distance``), or
     ``"dwithin_meters"`` (haversine great-circle ``distance`` meters) —
     see :func:`geomesa_tpu.kernels.join.pair_mask` for the exact
-    semantics."""
+    semantics. ``adaptive`` None reads ``geomesa.join.adaptive``; False
+    is the single-strategy A/B baseline (bit-identical results)."""
     p0, p1 = kjoin.pair_params(predicate, distance=distance, dx=dx, dy=dy)
     wrap_x = False
     if predicate == kjoin.JOIN_BBOX:
@@ -561,12 +882,15 @@ def run_join(lx, ly, rx, ry, predicate: str, distance=None, dx=None,
         reach_x = reach_y = float(distance)
     with tracing.span("scan.join.partition"):
         plan = co_partition(lx, ly, rx, ry, predicate, reach_x, reach_y,
-                            level=level, p0=p0, p1=p1, wrap_x=wrap_x)
+                            level=level, p0=p0, p1=p1, wrap_x=wrap_x,
+                            adaptive=adaptive)
     st = plan.stats
     metrics.inc(metrics.JOIN_CELLS, st.cells_joint)
     metrics.inc(metrics.JOIN_CANDIDATE_PAIRS, st.candidate_pairs)
     tracing.add_cost("join_cells", float(st.cells_joint))
     tracing.add_cost("join_candidate_pairs", float(st.candidate_pairs))
+    for s, k in st.strategy_cells.items():
+        metrics.inc(metrics.JOIN_CELLS_STRATEGY + s, k)
     pairs, total = execute_predicate(plan, lx, ly, rx, ry, predicate,
                                      prefer_device=prefer_device,
                                      want_pairs=want_pairs)
@@ -590,3 +914,231 @@ def execute_predicate(plan: JoinPlan, lx, ly, rx, ry, predicate: str,
                        want_pairs=want_pairs, lz=luz, rz=ruz)
     return execute(plan, lx, ly, rx, ry, prefer_device=prefer_device,
                    want_pairs=want_pairs)
+
+
+# ---------------------------------------------------------------------------
+# Polygon-dataset joins (docs/JOIN.md §7): point side x POLYGON side
+# ---------------------------------------------------------------------------
+
+def _polygon_level(n_points: int, bnds: np.ndarray) -> int:
+    """Cell level for a polygon join: the median polygon should span a
+    few cells per axis — fine enough that INTERIOR cells exist (the
+    wholesale win), coarse enough that per-polygon candidate cell counts
+    stay bounded."""
+    max_level = config.JOIN_MAX_LEVEL.to_int() or 12
+    spans = np.maximum(
+        np.maximum(bnds[:, 2] - bnds[:, 0], (bnds[:, 3] - bnds[:, 1]) * 2.0),
+        1e-9,
+    )
+    med = float(np.median(spans))
+    level = int(np.round(np.log2(360.0 / max(med / 4.0, 1e-9))))
+    return int(np.clip(level, 1, max_level))
+
+
+def _poly_kernel(Np: int, Ep: int, Pfp: int, Rp: int, predicate: str):
+    """Registry-cached jitted polygon-join kernel: [Np, Rp] bool verdict
+    matrix for a slice of boundary-cell points against the padded polygon
+    tables (kernels.join.polygon_tables/polygon_mask). Every axis is a
+    pow2 bucket in the key; the tables ride as traced operands."""
+    reg = join_registry()
+    key = ("join.poly", Np, Ep, Pfp, Rp, predicate)
+    go = reg.get(key)
+    if go is not None:
+        return go
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def go(pxv, pyv, x1, y1, x2, y2, part_id, part_row, boxes):
+        t = {"x1": x1, "y1": y1, "x2": x2, "y2": y2,
+             "part_id": part_id, "part_row": part_row, "boxes": boxes,
+             "n_parts_padded": Pfp, "n_rows_padded": Rp}
+        return kjoin.polygon_mask(pxv, pyv, t, predicate, jnp)
+
+    reg.put(key, go)
+    return go
+
+
+def run_polygon_join(px, py, geoms, predicate: str,
+                     level: Optional[int] = None,
+                     prefer_device: bool = True, want_pairs: bool = True):
+    """Join a point side against a polygon-dataset side. Returns
+    ``(pairs, total, stats)``: matched (point_row, polygon_row) positions
+    in canonical row-major order, bit-identical to
+    :func:`kernels.join.polygon_brute_force` by construction.
+
+    The adaptive core: occupied point cells classify against each
+    candidate polygon via ``classify_cells`` + ``CLASSIFY_MARGIN`` —
+
+    * INTERIOR cells match **wholesale**: every point in the cell is at
+      least the margin inside (exact f64), so the f32 kernel verdict is
+      True for all of them — zero pairwise work dispatched;
+    * OUTSIDE cells are skipped for the symmetric reason;
+    * BOUNDARY cells pay the polygon kernel (the same
+      ``polygon_mask`` f32 arithmetic as the reference), so near-edge
+      points decide exactly as the reference decides them.
+
+    ``predicate``: ``"pip"`` (even-odd point-in-polygon; holes and
+    multipolygon parts per ``polygon_mask``) or ``"poly_bbox"`` (point in
+    the row's bounds, inclusive edges — classification runs against the
+    bounds rectangle)."""
+    from geomesa_tpu.cache import cells as gcells
+    from geomesa_tpu.utils import geometry as geo
+
+    px = np.asarray(px, np.float64)
+    py = np.asarray(py, np.float64)
+    geoms = list(geoms)
+    stats = JoinStats(n_left=len(px), n_right=len(geoms), adaptive=True)
+    empty = np.zeros((0, 2), np.int64)
+    if not len(px) or not len(geoms):
+        return (empty if want_pairs else None), 0, stats
+    bnds = np.asarray([g.bounds() for g in geoms], np.float64)  # [R, 4]
+    if level is None:
+        level = _polygon_level(len(px), bnds)
+    stats.level = level
+    ix, iy = gcells.point_cells(px, py, level)
+    cell = _cell_ids(ix, iy)
+    order = np.argsort(cell, kind="stable")
+    sorted_cells = cell[order]
+    ucell, starts = np.unique(sorted_cells, return_index=True)
+    ends = np.concatenate([starts[1:], [len(order)]])
+    stats.cells_left = len(ucell)
+    stats.cells_right = len(geoms)
+    boxes = gcells.cell_boxes(level, ix[order][starts], iy[order][starts])
+    m = CLASSIFY_MARGIN
+
+    wholesale_blocks: List[np.ndarray] = []
+    R = len(geoms)
+    boundary_pts = np.zeros(len(px), bool)
+    # per-polygon boundary cell lists (classified lazily into the mask
+    # AFTER the boundary point set is known)
+    boundary_cells: List[np.ndarray] = []
+    interior_cells = boundary_count = 0
+    for j, g in enumerate(geoms):
+        bx0, by0, bx1, by1 = bnds[j]
+        cand = np.nonzero(
+            (boxes[:, 0] <= bx1 + m) & (boxes[:, 2] >= bx0 - m)
+            & (boxes[:, 1] <= by1 + m) & (boxes[:, 3] >= by0 - m)
+        )[0]
+        if not len(cand):
+            boundary_cells.append(cand)
+            continue
+        stats.cells_joint += len(cand)
+        target = g if predicate == kjoin.JOIN_PIP \
+            else geo.bbox_polygon(bx0, by0, bx1, by1)
+        cls = kjoin.classify_cells(boxes[cand], target, CLASSIFY_MARGIN)
+        interior = cand[cls == kjoin.CELL_INTERIOR]
+        boundary = cand[cls == kjoin.CELL_BOUNDARY]
+        interior_cells += len(interior)
+        boundary_count += len(boundary)
+        for u in interior:
+            rows = order[starts[u]: ends[u]]
+            wholesale_blocks.append(np.stack([
+                rows.astype(np.int64),
+                np.full(len(rows), j, np.int64),
+            ], axis=1))
+        for u in boundary:
+            boundary_pts[order[starts[u]: ends[u]]] = True
+        boundary_cells.append(boundary)
+    stats.strategy_cells["interior"] = interior_cells
+    stats.strategy_cells["boundary"] = boundary_count
+    wholesale = (np.concatenate(wholesale_blocks, axis=0)
+                 if wholesale_blocks else empty)
+    stats.wholesale_pairs = len(wholesale)
+
+    # boundary phase: unique boundary points x candidate polygons through
+    # the polygon kernel (the only pairwise work in the whole join)
+    brows = np.nonzero(boundary_pts)[0]
+    matched_blocks: List[np.ndarray] = []
+    kernel_total = 0
+    if len(brows):
+        # candmask[b, j]: point b's cell is a boundary cell of polygon j —
+        # interior cells are EXCLUDED (already matched wholesale)
+        bpos = np.full(len(px), -1, np.int64)
+        bpos[brows] = np.arange(len(brows))
+        candmask = np.zeros((len(brows), R), bool)
+        for j, bcells in enumerate(boundary_cells):
+            for u in bcells:
+                rows = order[starts[u]: ends[u]]
+                candmask[bpos[rows], j] = True
+        stats.candidate_pairs = int(candmask.sum())
+        tables = kjoin.polygon_tables(geoms)
+        Ep = _pow2(tables["n_edges"])
+        Pfp = _pow2(tables["n_parts"])
+        Rp = _pow2(tables["n_rows"])
+        tables = kjoin.polygon_tables(geoms, pad_edges=Ep, pad_parts=Pfp,
+                                      pad_rows=Rp)
+        px32 = px.astype(np.float32)
+        py32 = py.astype(np.float32)
+        use_device = prefer_device and _jax_ok()
+        devs = _devices(prefer_device) if use_device else None
+        n_dev = len(devs) if devs else 1
+        stats.devices = n_dev
+        from geomesa_tpu.resilience import QueryTimeoutError
+
+        for i, (lo, hi) in enumerate(_slices(len(brows), n_dev)):
+            check_deadline()
+            dev = devs[i % len(devs)] if devs else None
+            try:
+                verdict = _run_poly_slice(
+                    brows[lo:hi], px32, py32, tables, predicate,
+                    use_device, dev, Ep, Pfp, Rp,
+                )
+                hit = verdict[:, :R] & candmask[lo:hi]
+                kernel_total += int(hit.sum())
+                b, j = np.nonzero(hit)
+                if len(b):
+                    matched_blocks.append(np.stack([
+                        brows[lo:hi][b].astype(np.int64),
+                        j.astype(np.int64),
+                    ], axis=1))
+            except BaseException as e:
+                if isinstance(e, QueryTimeoutError) or not partial_allowed():
+                    raise
+                record_skip("join", f"poly[{lo}:{hi}]", e, phase="pairs")
+                stats.skipped.append(f"poly[{lo}:{hi}]")
+    total = len(wholesale) + kernel_total
+    stats.matched = total
+    metrics.inc(metrics.JOIN_CELLS, stats.cells_joint)
+    metrics.inc(metrics.JOIN_CANDIDATE_PAIRS, stats.candidate_pairs)
+    for s, k in stats.strategy_cells.items():
+        metrics.inc(metrics.JOIN_CELLS_STRATEGY + s, k)
+    metrics.inc(metrics.JOIN_PAIRS, total)
+    if not want_pairs:
+        return None, total, stats
+    blocks = [b for b in ([wholesale] + matched_blocks) if len(b)]
+    if not blocks:
+        return empty, total, stats
+    pairs = np.concatenate(blocks, axis=0)
+    order2 = np.lexsort((pairs[:, 1], pairs[:, 0]))
+    return pairs[order2], total, stats
+
+
+def _run_poly_slice(rows: np.ndarray, px32, py32, tables, predicate: str,
+                    use_device: bool, dev, Ep: int, Pfp: int, Rp: int):
+    """One boundary-point slice: [len(rows) padded to Np, Rp] verdicts
+    from the polygon kernel (device) or the same ``polygon_mask`` on the
+    host — identical f32 arithmetic either way."""
+    K = len(rows)
+    Np = _pow2(K)
+    idx = np.zeros(Np, np.int64)
+    idx[:K] = rows
+    pxv = px32[idx]
+    pyv = py32[idx]
+    if use_device:
+        import jax
+
+        go = _poly_kernel(Np, Ep, Pfp, Rp, predicate)
+        ops = (pxv, pyv, tables["x1"], tables["y1"], tables["x2"],
+               tables["y2"], tables["part_id"], tables["part_row"],
+               tables["boxes"])
+        if dev is not None:
+            ops = tuple(jax.device_put(o, dev) for o in ops)
+        with tracing.span("scan.join.poly", points=K, device=getattr(
+                dev, "id", None)), \
+                utilization.device_busy(getattr(dev, "id", 0) or 0):
+            metrics.inc(metrics.EXEC_DEVICE_DISPATCH)
+            verdict = np.asarray(go(*ops))
+    else:
+        verdict = kjoin.polygon_mask(pxv, pyv, tables, predicate, np)
+    return verdict[:K]

@@ -1111,13 +1111,19 @@ class PartitionedExecutor:
             self._note_pushdown_fallbacks(plan, window)
         return stat
 
-    def features_iter(self, plan: QueryPlan, batch_rows: Optional[int] = None):
+    def features_iter(self, plan: QueryPlan, batch_rows: Optional[int] = None,
+                      window: Optional[Dict] = None):
         """Stream matching rows partition-at-a-time: peak memory is one
         partition's gather, never the whole result (AbstractBatchScan /
-        ArrowScan streaming contract)."""
+        ArrowScan streaming contract). ``window``: an optional lake
+        pruning window (``_push_window``) — spilled partitions then load
+        only the row groups whose footer statistics intersect it; the
+        residual filter still runs on every loaded row, so the yielded
+        rows are exactly the plan's matches (``features_pushdown`` is
+        the materializing wrapper that builds the window)."""
         got = 0
         limit = plan.hints.max_features if not plan.hints.sort_by else None
-        for b, ex in self._each(plan):
+        for b, ex in self._each(plan, window=window):
             if resilience.partial_allowed():
                 # degraded mode: materialize the partition before any yield,
                 # so a failing partition drops WHOLE — never half-streamed
@@ -1154,6 +1160,27 @@ class PartitionedExecutor:
 
     def features(self, plan: QueryPlan) -> ColumnBatch:
         batches = list(self.features_iter(plan))
+        return ColumnBatch.concat(batches) if batches else ColumnBatch({}, 0)
+
+    def features_pushdown(self, plan: QueryPlan) -> ColumnBatch:
+        """Materialize matching rows with the lake statistics window
+        engaged: spilled partitions load only the row groups whose
+        footer bbox/time statistics intersect the plan's extracted
+        bounds (docs/LAKE.md). EXACT for row retrieval — a pruned
+        group's statistics prove it holds no row inside the plan's
+        bounds, so the surviving groups contain every matching row and
+        the residual filter runs bit-identically on the loaded subset.
+        Falls back to the plain full load whenever the window cannot
+        engage (``_push_window`` returns None) or a partition cannot
+        serve pruned (``_note_pushdown_fallbacks`` records those). The
+        adaptive join's side scan streams the probe side through this
+        per cell-group window instead of materializing it whole
+        (docs/JOIN.md §10)."""
+        window = self._push_window(plan)
+        try:
+            batches = list(self.features_iter(plan, window=window))
+        finally:
+            self._note_pushdown_fallbacks(plan, window)
         return ColumnBatch.concat(batches) if batches else ColumnBatch({}, 0)
 
     def top_batch(self, plan: QueryPlan, attr: str, descending: bool,

@@ -252,12 +252,45 @@ class StandingQueryEngine:
                 # result — no extra scan, no extra per-batch work
                 metrics.inc(metrics.SUBSCRIBE_FUSED)
             if sub_id is None:
-                lvl = self._routing_level()
-                sub_id = f"{spec.route_key(lvl)}:{uuid.uuid4().hex[:12]}"
+                sub_id = self.make_sub_id(spec)
             grp.subscribers.add(sub_id)
             self._subs[sub_id] = (spec.schema, key)
             self._set_gauges()
             return sub_id
+
+    def make_sub_id(self, spec: StandingSpec) -> str:
+        """Pre-generate a routable subscription id for ``spec``. The
+        durability path journals (sub_id, spec) BEFORE registering so a
+        crash between the WAL append and the register replays into the
+        SAME id (docs/STANDING.md §7) — register() then accepts it
+        verbatim."""
+        lvl = self._routing_level()
+        return f"{spec.route_key(lvl)}:{uuid.uuid4().hex[:12]}"
+
+    def schema_of(self, sub_id: str) -> Optional[str]:
+        """The schema a live subscription is registered on (None when
+        unknown) — the unsubscribe journal record needs it to land in
+        the right schema's WAL (docs/STANDING.md §7)."""
+        with self._lock:
+            got = self._subs.get(sub_id)
+            return got[0] if got else None
+
+    def subscriptions(self, schema: str) -> List[Dict[str, Any]]:
+        """Live subscriptions on ``schema`` as durable records —
+        ``[{"sub_id", "spec"}]`` sorted by id. This is what ``save()``
+        persists in the manifest entry and ``_attach_schema_entry``
+        replays through ``register(spec, sub_id=...)`` on load
+        (docs/STANDING.md §7)."""
+        with self._lock:
+            out = []
+            for sid, (sch, key) in self._subs.items():
+                if sch != schema:
+                    continue
+                grp = self._groups.get(sch, {}).get(key)
+                if grp is None:  # pragma: no cover — _subs implies group
+                    continue
+                out.append({"sub_id": sid, "spec": grp.spec.to_dict()})
+            return sorted(out, key=lambda r: r["sub_id"])
 
     def unregister(self, sub_id: str) -> bool:
         with self._lock:

@@ -251,6 +251,7 @@ def test_join_property_walk_across_epochs_recompile_free():
     rng = np.random.default_rng(77)
     reg = join_exec.join_registry()
     ds.join_count("a", "b", predicate="dwithin", distance=0.3)  # warm
+    ds.join_count("a", "b", predicate="bbox", dx=0.2, dy=0.25)  # warm
     warm = sum(reg.traces().values())
     for epoch in range(3):
         nx, ny = _clustered(rng, 100)
@@ -265,12 +266,14 @@ def test_join_property_walk_across_epochs_recompile_free():
             assert res.count == len(ref), (epoch, predicate)
             assert np.array_equal(res.pairs, ref), (epoch, predicate)
     # pow2/ladder bucketing: fresh data of similar size re-lands on the
-    # warmed kernel shapes (the CI-gated recompiles==0 contract). The
-    # bbox predicate pays its own first-trace on epoch 0.
+    # warmed kernel shapes (the CI-gated recompiles==0 contract). Both
+    # predicates warmed every adaptive site above; the only growth
+    # allowed is one tile-count ladder crossing per predicate as the
+    # store grows past a pow2 boundary.
     ds.join_count("a", "b", predicate="dwithin", distance=0.3)
     ds.join_count("a", "b", predicate="bbox", dx=0.2, dy=0.25)
     grew = sum(reg.traces().values()) - warm
-    assert grew <= 2, f"{grew} fresh traces beyond the per-predicate warmup"
+    assert grew <= 2, f"{grew} fresh traces beyond the warmed shape buckets"
 
 
 def test_join_repeat_zero_recompiles_mutated_values():
@@ -617,3 +620,292 @@ def test_compact_descriptor_share_across_query_texts():
         after = ctr.value
     assert n1 == n2
     assert after > before, "descriptor rebuilt instead of shared"
+
+
+# ---------------------------------------------------------------------------
+# adaptive strategy selection (docs/JOIN.md §10)
+# ---------------------------------------------------------------------------
+
+
+def _shaped(rng, shape, n):
+    """Coordinate sets engineered per distribution shape: dense balanced
+    hotspots, sparse wide scatter (tiny per-cell counts), skewed (one
+    side's hotspots dwarf the other), or all three mixed."""
+    if shape == "dense":
+        return _clustered(rng, n, n_hot=4, spread=0.25)
+    if shape == "sparse":
+        return (rng.uniform(-170, 170, n // 4),
+                rng.uniform(-85, 85, n // 4))
+    if shape == "skewed":
+        # a handful of hotspots; the caller makes one side heavy
+        return _clustered(rng, n, n_hot=3, spread=0.15)
+    dx, dy = _clustered(rng, n // 2, n_hot=4, spread=0.25)
+    sx, sy = rng.uniform(-170, 170, n // 4), rng.uniform(-85, 85, n // 4)
+    return np.concatenate([dx, sx]), np.concatenate([dy, sy])
+
+
+def _shaped_ds(shape, seed):
+    rng = np.random.default_rng(seed)
+    na, nb = (1200, 90) if shape == "skewed" else (900, 800)
+    ax, ay = _shaped(rng, shape, na)
+    bx, by = _shaped(rng, shape, nb)
+    ds = GeoDataset()
+    ds.create_schema("a", "name:String,*geom:Point")
+    ds.create_schema("b", "tag:String,*geom:Point")
+    ds.insert("a", {"name": ["n"] * len(ax), "geom": list(zip(ax, ay))})
+    ds.insert("b", {"tag": ["t"] * len(bx), "geom": list(zip(bx, by))})
+    ds.flush()
+    return ds
+
+
+@pytest.mark.parametrize("shape", ["dense", "sparse", "skewed", "mixed"])
+def test_join_adaptive_bit_identical_across_strategies(shape):
+    """The load-bearing adaptive contract: per-cell routing (brute /
+    split / pairwise) decides only WHICH kernel tests a pair, never how
+    a tested pair decides — adaptive, single-strategy (the A/B
+    baseline), and the numpy N*M reference return the IDENTICAL pair
+    set on the 8-virtual-device path."""
+    ds = _shaped_ds(shape, seed={"dense": 21, "sparse": 22,
+                                 "skewed": 23, "mixed": 24}[shape])
+    for predicate, kw in (("dwithin", {"distance": 0.3}),
+                          ("bbox", {"dx": 0.2, "dy": 0.25})):
+        ref = _ref(ds, predicate, **kw)
+        res = ds.join("a", "b", predicate=predicate, **kw)
+        assert np.array_equal(res.pairs, ref), (shape, predicate)
+        assert res.count == len(ref)
+        with config.JOIN_ADAPTIVE.scoped("false"):
+            single = ds.join("a", "b", predicate=predicate, **kw)
+        assert np.array_equal(single.pairs, ref), (shape, predicate)
+        # the off-switch really is the pre-adaptive plan
+        assert list(single.stats.strategy_cells) in ([], ["pairwise"])
+
+
+def test_join_adaptive_host_path_bit_identical():
+    """Same contract on the host (no-device) path."""
+    ds = _shaped_ds("mixed", seed=25)
+    ds.prefer_device = False
+    for predicate, kw in (("dwithin", {"distance": 0.3}),
+                          ("bbox", {"dx": 0.2, "dy": 0.25})):
+        ref = _ref(ds, predicate, **kw)
+        res = ds.join("a", "b", predicate=predicate, **kw)
+        assert np.array_equal(res.pairs, ref), predicate
+        assert res.stats.devices == 1
+
+
+def test_join_adaptive_each_strategy_fires_with_decision_trail():
+    """A mixed distribution routes cells to EVERY strategy, and the
+    decision trail surfaces it: JoinStats histograms, the
+    join.cells.<strategy> counters, and the explain Adaptive section."""
+    ds = _shaped_ds("mixed", seed=26)
+    # make a couple of cells skewed: one heavy left hotspot vs few rights
+    rng = np.random.default_rng(27)
+    hx = np.full(500, 12.345) + rng.normal(0, 0.02, 500)
+    hy = np.full(500, 7.89) + rng.normal(0, 0.02, 500)
+    ds.insert("a", {"name": ["h"] * 500, "geom": list(zip(hx, hy))})
+    ds.insert("b", {"tag": ["h"] * 4,
+                    "geom": [(12.345, 7.89)] * 4})
+    ds.flush()
+    before = {
+        s: metrics.registry().counter(
+            metrics.JOIN_CELLS_STRATEGY + s).value
+        for s in ("pairwise", "brute", "split.l")
+    }
+    ref = _ref(ds, "dwithin", distance=0.3)
+    res = ds.join("a", "b", predicate="dwithin", distance=0.3)
+    assert np.array_equal(res.pairs, ref)
+    st = res.stats
+    assert st.adaptive
+    assert st.strategy_cells.get("brute", 0) > 0
+    assert st.strategy_cells.get("pairwise", 0) > 0
+    assert st.strategy_cells.get("split.l", 0) > 0
+    # estimated pairs cover every candidate; dispatched slots recorded
+    assert sum(st.est_pairs.values()) == st.candidate_pairs
+    assert set(st.dispatched_pairs) >= {"brute", "pairwise", "split.l"}
+    for s in ("pairwise", "brute", "split.l"):
+        after = metrics.registry().counter(
+            metrics.JOIN_CELLS_STRATEGY + s).value
+        assert after - before[s] == st.strategy_cells[s]
+    exp = ds.explain_join("a", "b", predicate="dwithin", distance=0.3)
+    assert "Adaptive" in exp
+    assert "cells[brute]" in exp and "cells[split.l]" in exp
+    assert "statistics read" in exp
+
+
+def test_join_adaptive_skew_dispatches_fewer_slots():
+    """Skewed cells in a split section pad the short axis narrow: the
+    dispatched slot count must undercut the single-strategy plan's (the
+    perf contract behind join_adaptive_speedup)."""
+    ds = _shaped_ds("skewed", seed=28)
+    res = ds.join("a", "b", predicate="dwithin", distance=0.3)
+    with config.JOIN_ADAPTIVE.scoped("false"):
+        single = ds.join("a", "b", predicate="dwithin", distance=0.3)
+    assert np.array_equal(res.pairs, single.pairs)
+    adaptive_slots = sum(res.stats.dispatched_pairs.values())
+    single_slots = sum(single.stats.dispatched_pairs.values())
+    assert adaptive_slots < single_slots, (
+        res.stats.dispatched_pairs, single.stats.dispatched_pairs)
+
+
+# ---------------------------------------------------------------------------
+# polygon-dataset joins (docs/JOIN.md §10)
+# ---------------------------------------------------------------------------
+
+_POLYS = [
+    # donut: hole must exclude interior points
+    "POLYGON ((0 0, 8 0, 8 8, 0 8, 0 0), (3 3, 5 3, 5 5, 3 5, 3 3))",
+    # large polygon spanning several co-partition cells: interior cells
+    # must match WHOLESALE (zero pairwise work)
+    "POLYGON ((20 -20, 60 -20, 60 20, 20 20, 20 -20))",
+    # multipolygon: row matches if inside ANY part
+    ("MULTIPOLYGON (((-30 -10, -25 -10, -25 -5, -30 -5, -30 -10)), "
+     "((-20 -10, -15 -10, -15 -5, -20 -5, -20 -10)))"),
+    # sliver far away
+    "POLYGON ((100 40, 101 40, 101 41, 100 41, 100 40))",
+]
+
+
+def _poly_ds(seed=33, n=4000):
+    from geomesa_tpu.utils import geometry as geo
+
+    ds = GeoDataset()
+    ds.create_schema("pts", "name:String,*geom:Point")
+    ds.create_schema("polys", "kind:String,*geom:Polygon")
+    rng = np.random.default_rng(seed)
+    px = rng.uniform(-40, 70, n)
+    py = rng.uniform(-30, 45, n)
+    # pin points onto edges / vertices / hole boundary (inclusive-edge
+    # f32 arithmetic must agree between kernel and reference exactly)
+    edge = np.array([(0.0, 0.0), (8.0, 4.0), (3.0, 3.0), (5.0, 5.0),
+                     (40.0, 20.0), (20.0, 0.0), (60.0, -20.0),
+                     (-25.0, -7.5), (4.0, 4.0), (40.0, 0.0)])
+    px = np.concatenate([px, edge[:, 0]])
+    py = np.concatenate([py, edge[:, 1]])
+    ds.insert("pts", {"name": ["p"] * len(px),
+                      "geom": list(zip(px, py))})
+    ds.insert("polys", {"kind": [f"k{i}" for i in range(len(_POLYS))],
+                        "geom": np.array(_POLYS, object)})
+    ds.flush()
+    # pairs carry STORE row positions (the index sorts on insert): the
+    # reference must read both sides back in store order, like _ref
+    fc = ds.query("pts", "INCLUDE")
+    px = fc.batch.columns["geom__x"]
+    py = fc.batch.columns["geom__y"]
+    wkts = ds.query("polys", "INCLUDE").batch.columns["geom__wkt"]
+    geoms = [geo.parse_wkt(str(w)) for w in wkts]
+    return ds, px, py, geoms
+
+
+@pytest.mark.parametrize("predicate", ["pip", "poly_bbox"])
+def test_join_polygon_bit_identical(predicate):
+    """Polygon joins (holes, multipolygon, cell-edge points) are
+    bit-identical to the N*M reference; the count path agrees."""
+    ds, px, py, geoms = _poly_ds()
+    ref = kjoin.polygon_brute_force(px, py, geoms, predicate)
+    res = ds.join("pts", "polys", predicate=predicate)
+    assert np.array_equal(res.pairs, ref), predicate
+    assert res.count == len(ref)
+    assert ds.join_count("pts", "polys", predicate=predicate) == len(ref)
+
+
+def test_join_polygon_interior_cells_match_wholesale():
+    """Cells classified INTERIOR contribute their rows with ZERO
+    pairwise kernel work: wholesale pairs are non-zero for the large
+    polygon and the kernel only sees boundary-cell candidates."""
+    ds, px, py, geoms = _poly_ds()
+    res = ds.join("pts", "polys", predicate="pip")
+    st = res.stats
+    assert st.wholesale_pairs > 0
+    assert st.strategy_cells.get("interior", 0) > 0
+    assert st.strategy_cells.get("boundary", 0) > 0
+    # every kernel-tested candidate comes from a boundary cell, so the
+    # candidate count is strictly under the full N*R cross product
+    assert 0 < st.candidate_pairs < len(px) * len(geoms)
+    exp = ds.explain_join("pts", "polys", predicate="pip")
+    assert "Adaptive" in exp and "wholesale" in exp
+    assert "classify_cells" in exp
+
+
+def test_join_polygon_fuse_key_distinct_per_predicate():
+    from geomesa_tpu.serving import fuse as fusemod
+
+    opts = {"right": "polys", "ecql": "INCLUDE", "right_ecql": "INCLUDE"}
+    keys = {
+        fusemod.fuse_key("join_count", "pts",
+                         {**opts, "predicate": p})
+        for p in ("dwithin", "pip", "poly_bbox")
+    }
+    assert None not in keys
+    assert len(keys) == 3
+
+
+# ---------------------------------------------------------------------------
+# window-pushdown side scans (docs/JOIN.md §10, docs/LAKE.md)
+# ---------------------------------------------------------------------------
+
+
+def test_join_pushdown_side_scan_exact_and_cheaper(tmp_path):
+    """Count-only joins over a spilled partitioned right side stream the
+    side per cell-group window: the total is EXACT (equal to the full
+    materialized join) while loading strictly fewer side bytes than any
+    full materialization would."""
+    import contextlib
+
+    from geomesa_tpu.api.dataset import Query
+    from geomesa_tpu.filter.ecql import parse_iso_ms
+    from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(config.LAKE_ENABLED.scoped("true"))
+        stack.enter_context(config.LAKE_ROWGROUP_ROWS.scoped("512"))
+        ds = GeoDataset(n_shards=4)
+        ds.create_schema(
+            "t", "name:String,dtg:Date,*geom:Point;geomesa.partition='time'")
+        st = ds._store("t")
+        assert isinstance(st, PartitionedFeatureStore)
+        st._spill_dir = str(tmp_path / "lake")
+        rng = np.random.default_rng(44)
+        n = 20_000
+        cx = rng.uniform(-115, -75, 10)
+        cy = rng.uniform(28, 47, 10)
+        k = rng.integers(0, 10, n)
+        x = np.clip(cx[k] + rng.normal(0, 0.25, n), -120, -70)
+        y = np.clip(cy[k] + rng.normal(0, 0.25, n), 25, 50)
+        ds.insert("t", {
+            "name": [f"r{i % 9}" for i in range(n)],
+            "dtg": rng.integers(parse_iso_ms("2020-01-01"),
+                                parse_iso_ms("2020-02-01"),
+                                n).astype("datetime64[ms]"),
+            "geom__x": x, "geom__y": y,
+        })
+        ds.flush()
+        st.spill_all()
+    ds.create_schema("pts", "name:String,*geom:Point")
+    # the left viewport covers a SUBSET of the side's hotspots: the
+    # footer statistics must prune the groups holding only the rest
+    k = rng.integers(0, 4, 600)
+    lx = np.clip(cx[k] + rng.normal(0, 0.2, 600), -120, -70)
+    ly = np.clip(cy[k] + rng.normal(0, 0.2, 600), 25, 50)
+    ds.insert("pts", {"name": ["p"] * 600, "geom": list(zip(lx, ly))})
+    ds.flush()
+
+    ctr = metrics.registry().counter(metrics.JOIN_PUSHDOWN_BYTES)
+    before = ctr.value
+    pushed = ds.join_count("pts", "t", predicate="dwithin", distance=0.1)
+    assert ctr.value > before, "pushdown path did not engage"
+    with config.JOIN_PUSHDOWN.scoped("false"):
+        plain = ds.join_count("pts", "t", predicate="dwithin", distance=0.1)
+    full = ds.join("pts", "t", predicate="dwithin", distance=0.1)
+    assert pushed == plain == full.count
+
+    _, _, _, _, total, stats = ds._join_pushdown_count(
+        "pts", "t", "dwithin", 0.1, None, None, Query(), Query(),
+        None, False)
+    assert total == pushed
+    pd = stats.pushdown
+    assert pd["bytes_loaded"] < pd["bytes_side"], pd
+    assert pd["groups_loaded"] < pd["groups_side"] * pd["chunks"], pd
+
+    # bbox predicate rides the same window path
+    pb = ds.join_count("pts", "t", predicate="bbox", dx=0.1, dy=0.1)
+    fb = ds.join("pts", "t", predicate="bbox", dx=0.1, dy=0.1)
+    assert pb == fb.count

@@ -321,3 +321,60 @@ def test_stream_clear_and_fused_stream_subscribers():
     spec = eng._groups["v"][eng._subs[a][1]].spec
     grid = dl.decode_result(spec, got["result"])
     assert float(grid.sum()) == 0.0
+
+
+# ---------------------------------------------------------------------------
+# durability: subscriptions survive the journal and the checkpoint
+# (docs/STANDING.md §7)
+# ---------------------------------------------------------------------------
+
+
+def test_standing_durable_across_journal_replay(tmp_path):
+    """Crash before any checkpoint: journal replay rebuilds the live
+    subscriptions (same ids, same results) and honors a journaled
+    unsubscribe."""
+    root = str(tmp_path)
+    ds = GeoDataset(prefer_device=False)
+    ds.attach_journal(root)
+    ds.create_schema("t", SPEC)
+    ds.insert("t", _data(seed=3))
+    ds.flush()
+    sid = ds.subscribe("t", "count", bbox=VIEW)
+    gone = ds.subscribe("t", "count", bbox=(-2.0, -2.0, 2.0, 2.0))
+    assert ds.unsubscribe(gone)
+    want = _result(ds, sid)[1]
+
+    ds2 = GeoDataset.load(root, prefer_device=False)
+    assert _result(ds2, sid)[1] == want
+    with pytest.raises(UnknownSubscription):
+        ds2.subscription_poll(gone)
+    # replayed registration is live, not a husk: new ingest flows
+    ds2.insert("t", _data(n=40, seed=9, lo=-25.0, hi=5.0))
+    ds2.flush()
+    after = _result(ds2, sid)[1]
+    assert after > want
+
+
+def test_standing_durable_across_checkpoint(tmp_path):
+    """save() truncates the journal, so the manifest must carry the
+    live subscriptions: load() re-registers them under their original
+    ids with a fresh snapshot anchor."""
+    root = str(tmp_path)
+    ds = GeoDataset(prefer_device=False)
+    ds.attach_journal(root)
+    ds.create_schema("t", SPEC)
+    ds.insert("t", _data(seed=4))
+    ds.flush()
+    sid = ds.subscribe("t", "count", bbox=VIEW)
+    want = _result(ds, sid)[1]
+    ds.save(root)
+
+    ds2 = GeoDataset.load(root, prefer_device=False)
+    assert _result(ds2, sid)[1] == want
+    ds2.insert("t", _data(n=40, seed=10, lo=-25.0, hi=5.0))
+    ds2.flush()
+    assert _result(ds2, sid)[1] > want
+    # a second checkpoint cycle keeps carrying them
+    ds2.save(root)
+    ds3 = GeoDataset.load(root, prefer_device=False)
+    assert _result(ds3, sid)[1] == _result(ds2, sid)[1]
