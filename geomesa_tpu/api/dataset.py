@@ -2498,6 +2498,12 @@ class GeoDataset:
         bytes_loaded = groups_loaded = 0
         bytes_side = groups_side = 0
         chunks = 0
+        # one residency cache spans the whole chunk loop: adjacent chunks'
+        # reach-inflated windows overlap, so boundary row groups surviving
+        # pruning in both chunks decode once (docs/JOIN.md §11)
+        from geomesa_tpu.lake.residency import GroupResidencyCache
+
+        residency = GroupResidencyCache.from_config()
         for clo in range(0, len(ucell), per):
             chi = min(clo + per, len(ucell))
             chunks += 1
@@ -2512,6 +2518,8 @@ class GeoDataset:
             rst2, _rq2, rplan2 = self._plan(
                 right, _dc_replace(rq_base, ecql=ecql)
             )
+            if residency is not None:
+                rplan2.__dict__["residency"] = residency
             ex = self._executor(rst2)
             scan = getattr(ex, "features_pushdown", None) or ex.features
             with tracing.span("scan.join.side.window", chunk=chunks):
@@ -2554,11 +2562,17 @@ class GeoDataset:
             bytes_side = max(bytes_side, int(acct.get("bytes_payload", 0)))
             groups_side = max(groups_side, int(acct.get("groups_total", 0)))
         stats.matched = total
+        res_hits = residency.hits if residency is not None else 0
+        res_saved = residency.bytes_saved if residency is not None else 0
         stats.pushdown = {
             "chunks": chunks, "cells": len(ucell),
             "bytes_loaded": bytes_loaded, "bytes_side": bytes_side,
             "groups_loaded": groups_loaded, "groups_side": groups_side,
+            "residency_hits": res_hits,
+            "bytes_saved_residency": res_saved,
         }
+        metrics.inc(metrics.JOIN_PUSHDOWN_RESIDENCY_HITS, res_hits)
+        metrics.inc(metrics.JOIN_PUSHDOWN_RESIDENCY_BYTES, res_saved)
         metrics.inc(metrics.JOIN_CELLS, stats.cells_joint)
         metrics.inc(metrics.JOIN_CANDIDATE_PAIRS, stats.candidate_pairs)
         for s, k in stats.strategy_cells.items():

@@ -49,11 +49,12 @@ dependent and not decomposable).
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, List, Optional, Tuple
 
 import numpy as np
 
-from geomesa_tpu import config, metrics, tracing
+from geomesa_tpu import config, heat, metrics, tracing
 from geomesa_tpu.cache import cells as cellmod
 from geomesa_tpu.cache import hierarchy
 from geomesa_tpu.cache.store import CacheStore
@@ -275,6 +276,7 @@ class AggregateCache:
                           level=decomp.level, kind=decomp.kind) as cells_span:
             for cell in decomp.cells:
                 ckey = cell_key(decomp.level, cell)
+                cprefix = cellmod.cell_prefix(decomp.level, cell)
                 with tracing.span("cache.lookup", key="cell"):
                     got = self.store.get(uid, epoch, ckey)
                 if got is None and use_hier:
@@ -293,13 +295,23 @@ class AggregateCache:
                 if got is not None:
                     hits += 1
                     tracing.add_cost("cache_hits", 1.0)
+                    # cell-heat telemetry (docs/OBSERVABILITY.md §9): a
+                    # hit is a touch with zero attributed cost
+                    heat.record(st.ft.name, decomp.level, cprefix, hit=1)
                     acc = op.merge(acc, op.unpack(got))
                     continue
+                t_cell = time.perf_counter()
                 with tracing.span("cache.cell.scan"):
                     value, cacheable = self._run_sub(
                         ds, st, q, decomp.cell_filter(cell, geom), op, plan,
                         scan_acc,
                     )
+                # a miss carries the scan's wall-clock ms — the cost-
+                # ledger attribution for this cell's slice of the world
+                heat.record(
+                    st.ft.name, decomp.level, cprefix, miss=1,
+                    device_ms=(time.perf_counter() - t_cell) * 1e3,
+                )
                 if cacheable:
                     self.store.put(uid, epoch, ckey, op.pack(value))
                     if use_hier:

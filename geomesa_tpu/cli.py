@@ -547,7 +547,12 @@ def cmd_fleet(args):
       owners (cache-export/cache-import), report the handoff summary;
     * ``fleet handoff`` — operator-driven direct handoff: export one
       replica's hottest entries for a schema and import them into
-      another (no router involved).
+      another (no router involved);
+    * ``fleet heat`` — the fleet cell-heat table: per-(schema, SFC cell)
+      hits/misses/device-ms merged across replicas
+      (docs/OBSERVABILITY.md §9);
+    * ``fleet trace`` — one trace id's retained span tree(s) from every
+      replica (the stitcher's raw inputs).
     """
     if args.fleet_cmd == "replica":
         from geomesa_tpu import GeoDataset
@@ -601,6 +606,38 @@ def cmd_fleet(args):
             out = router.deregister_replica(
                 args.replica_id, handoff=not args.no_handoff
             )
+        print(json.dumps(out, indent=2, sort_keys=True, default=str))
+        return 0
+    if args.fleet_cmd == "heat":
+        from geomesa_tpu.fleet import FleetRouter
+
+        with FleetRouter(_parse_replicas(args.replicas)) as router:
+            out = router.observability().fleet_heat(top=args.top)
+        if args.json:
+            print(json.dumps(out, indent=2, sort_keys=True, default=str))
+            return 0
+        for schema in sorted(out["schemas"]):
+            print(f"schema {schema}:")
+            print(f"  {'cell':<16} {'touches':>8} {'hits':>8} "
+                  f"{'misses':>8} {'device_ms':>10}  replicas")
+            for row in out["schemas"][schema]:
+                split = ",".join(
+                    f"{r}={n}" for r, n in sorted(row["replicas"].items())
+                )
+                print(f"  {row['cell']:<16} {row['touches']:>8} "
+                      f"{row['hits']:>8} {row['misses']:>8} "
+                      f"{row['device_ms']:>10.3f}  {split}")
+        if out.get("errors"):
+            print(f"federation errors: {out['errors']}", file=sys.stderr)
+        return 0
+    if args.fleet_cmd == "trace":
+        from geomesa_tpu.fleet import FleetRouter
+
+        with FleetRouter(_parse_replicas(args.replicas)) as router:
+            out = {
+                rid: router._client(rid).trace_fetch(args.trace_id)
+                for rid in router.registry.members()
+            }
         print(json.dumps(out, indent=2, sort_keys=True, default=str))
         return 0
     if args.fleet_cmd == "handoff":
@@ -1096,6 +1133,25 @@ def build_parser() -> argparse.ArgumentParser:
                     help="the replica to drain and remove")
     fp.add_argument("--no-handoff", action="store_true",
                     help="skip the cache handoff (plain drain + remove)")
+    fp.set_defaults(fn=cmd_fleet)
+    fp = fsub.add_parser("heat", help="fleet cell-heat table: per-"
+                         "(schema, SFC cell) hits/misses/device-ms "
+                         "merged across replicas with per-replica touch "
+                         "splits (docs/OBSERVABILITY.md §9)")
+    fp.add_argument("--replicas", required=True,
+                    help="id=host:port,id=host:port")
+    fp.add_argument("--top", type=int, default=None,
+                    help="hottest rows per schema (default "
+                    "geomesa.heat.top)")
+    fp.add_argument("--json", action="store_true",
+                    help="raw JSON instead of the table rendering")
+    fp.set_defaults(fn=cmd_fleet)
+    fp = fsub.add_parser("trace", help="fetch one trace id's retained "
+                         "span tree(s) from every replica (the stitcher's "
+                         "raw inputs; docs/OBSERVABILITY.md §9)")
+    fp.add_argument("--replicas", required=True,
+                    help="id=host:port,id=host:port")
+    fp.add_argument("trace_id")
     fp.set_defaults(fn=cmd_fleet)
     fp = fsub.add_parser("handoff", help="direct cache handoff between "
                          "two replicas: export one's hottest entries for "

@@ -818,6 +818,62 @@ FLEET_LATENCY_FLOOR_MS = SystemProperty(
 FLEET_CORDON = SystemProperty("geomesa.fleet.cordon", None)
 
 # ---------------------------------------------------------------------------
+# Fleet observability plane (fleet/obs.py; docs/OBSERVABILITY.md §9):
+# metrics federation, cross-replica trace stitching, cell-heat telemetry,
+# and the replica anomaly watchdog. All pull/async: nothing here runs on
+# the routed-query path.
+# ---------------------------------------------------------------------------
+
+#: Federation snapshot TTL (ms): a fleet /metrics, /healthz, or /debug/heat
+#: read within this window of the last sweep reuses the cached merge
+#: instead of re-pulling every replica. "0" re-pulls on every read.
+FLEET_OBS_TTL_MS = SystemProperty("geomesa.fleet.obs.ttl.ms", "2000")
+
+#: Per-replica metrics-export / trace-fetch pull timeout (seconds).
+FLEET_OBS_TIMEOUT_S = SystemProperty("geomesa.fleet.obs.timeout.s", "5")
+
+#: Master switch for the async trace stitcher: with it false, scattered
+#: queries export their router-local trace only (pre-PR-19 behavior).
+FLEET_STITCH = SystemProperty("geomesa.fleet.stitch", "true")
+
+#: Completed scattered queries the stitcher queues for assembly; overflow
+#: drops the oldest pending id (counted fleet.trace.stitch.failed) — the
+#: same non-blocking contract as the trace export queue.
+FLEET_STITCH_QUEUE = SystemProperty("geomesa.fleet.stitch.queue", "256")
+
+#: Settle delay (ms) between a scattered query finishing and its stitch
+#: pull: replica root spans must FINISH (late children re-finish the
+#: trace) before trace-fetch can see their subtree.
+FLEET_STITCH_DELAY_MS = SystemProperty("geomesa.fleet.stitch.delay.ms",
+                                       "100")
+
+#: Anomaly-watchdog flag factor: a replica whose recent per-op latency
+#: median is >= factor x the fleet median for that op (both over >= 8
+#: samples) is flagged in fleet.anomaly.<id> and the /debug/fleet advice
+#: row. Observation only — no cordon. "0" disables the watchdog.
+FLEET_ANOMALY_FACTOR = SystemProperty("geomesa.fleet.anomaly.factor", "4")
+
+#: Distinct (schema, cell) rows the process heat table retains (coldest
+#: rows evict first). "0" disables heat recording.
+HEAT_CELLS_MAX = SystemProperty("geomesa.heat.cells", "4096")
+
+#: Hottest rows a heat snapshot ships per schema (metrics-export payload
+#: and /debug/heat bound).
+HEAT_TOP = SystemProperty("geomesa.heat.top", "256")
+
+#: Finished traces retained BY ID for /debug/queries?trace= and the
+#: trace-fetch action (a bounded ring; the slow-trace ring is separate).
+TRACE_RETAIN = SystemProperty("geomesa.trace.retain", "256")
+
+#: Cross-chunk row-group residency budget (MiB) for window-pushdown join
+#: side scans (docs/JOIN.md §11): decoded column chunks of row groups
+#: straddling adjacent pushdown chunks are kept across chunk scans so the
+#: boundary groups stop decoding twice. "0" disables the cache.
+JOIN_PUSHDOWN_RESIDENCY_MB = SystemProperty(
+    "geomesa.join.pushdown.residency.mb", "64"
+)
+
+# ---------------------------------------------------------------------------
 # Durable mutation journal (fs/journal.py; docs/RESILIENCE.md §8): per-root
 # crc-framed write-ahead log with group commit. With it attached, an acked
 # mutation is ON DISK before the call returns; load() replays records past

@@ -1,0 +1,389 @@
+"""Fleet observability plane (docs/OBSERVABILITY.md §9).
+
+One :class:`FleetObservability` hangs off each :class:`FleetRouter` and
+gives operators a single pane over the whole fleet, built from four
+pieces — all of them PULL or ASYNC, so the query path never pays for any
+of it:
+
+* **Metrics federation** — each replica's ``metrics-export`` sidecar
+  action returns its STRUCTURED registry snapshot (counters, gauges,
+  histogram buckets — not rendered text); the router merges them
+  (counters add, histograms merge bucket-wise on identical ladders,
+  gauges keep per-replica labels) and renders one fleet-level
+  ``/metrics/fleet`` exposition (classic + OpenMetrics, ``replica``
+  label on per-replica series). Snapshots are TTL-cached
+  (``geomesa.fleet.obs.ttl.ms``) and pulled only when a scrape or debug
+  read asks.
+* **Fleet SLO burn** — a second :class:`~geomesa_tpu.slo.SloMonitor`
+  runs the exact same dual-window differencing over the MERGED
+  ``trace.<op>`` histograms, publishing ``slo.burn.fleet.<op>`` gauges:
+  "density is burning budget fleet-wide" even when no single replica
+  crosses the threshold alone.
+* **Cross-replica trace stitching** — scatter completions enqueue their
+  trace id; a daemon stitcher waits ``geomesa.fleet.stitch.delay.ms``,
+  pulls each surviving replica's subtrees over ``trace-fetch``, and
+  grafts them under the router span whose ``span_token`` matches each
+  subtree root's ``parent_span`` (the header handshake in
+  sidecar/client.py + service.py). The result is ONE stitched span tree
+  per scattered query — exported through the existing OTLP/JSONL sinks
+  (``tracing_export.export_stitched``) and visible at
+  ``/debug/queries?trace=<id>``.
+* **Replica anomaly watchdog** — the registry's per-(replica, op)
+  latency samples vs the fleet median (fleet/registry.py
+  ``anomaly_report``), surfaced as ``fleet.anomaly.<id>`` gauges and a
+  ``/debug/fleet`` advice row. Observation only: it never cordons.
+
+``fleet_health`` composes the fleet ``/healthz/fleet``: HARD degradation
+(503) only when NO capacity remains (zero usable replicas) or the fleet
+SLO burns; everything else that is wrong-but-survivable — cordoned or
+draining members, a replica's own hard-degraded local health, open
+replica breakers, journal lag on some member, anomaly flags — degrades
+SOFT (200, ``soft: true``), because the registry says capacity remains.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from collections import OrderedDict, deque
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from geomesa_tpu import config, heat, metrics, slo, tracing, tracing_export
+
+#: stitched records kept for /debug/queries?trace=<id> lookups
+_STITCHED_KEEP = 64
+
+
+class FleetObservability:
+    """See the module docstring. Created lazily by
+    :meth:`FleetRouter.observability`; thread-safe."""
+
+    def __init__(self, router):
+        #: weak: the plane must not keep a closed router alive (the
+        #: /debug/fleet WeakSet is the liveness authority)
+        self._router = weakref.ref(router)
+        self._lock = threading.Lock()
+        #: federation TTL cache: (monotonic stamp, payload)
+        self._fed_at = 0.0
+        self._fed: Optional[Dict[str, Any]] = None
+        #: newest merged export (the fleet SLO monitor's source)
+        self._merged: Optional[Dict[str, Any]] = None
+        #: fleet-level SLO burn over the MERGED trace.<op> histograms —
+        #: same dual-window differencing, distinct gauge namespace
+        self.slo = slo.SloMonitor(
+            source=self._merged_trace_hist,
+            gauge_prefix=f"{metrics.SLO_BURN_PREFIX}.fleet",
+        )
+        # -- stitcher (async half) ----------------------------------------
+        self._queue: "deque" = deque()
+        self._wake = threading.Event()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._stitched: "OrderedDict[str, Dict[str, Any]]" = OrderedDict()
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        self._stop.set()
+        self._wake.set()
+        t = self._thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout=2.0)
+        self._thread = None
+
+    def _alive_router(self):
+        r = self._router()
+        if r is None:
+            raise RuntimeError("fleet router is gone")
+        return r
+
+    # -- metrics federation ------------------------------------------------
+    def federate(self, force: bool = False) -> Dict[str, Any]:
+        """Pull one ``metrics-export`` per registry member (best effort:
+        a down replica contributes an error row, never a failure) and
+        merge. TTL-cached — scrape-driven polling shares one fleet pull
+        per ``geomesa.fleet.obs.ttl.ms`` window. Never called from the
+        query path."""
+        ttl_ms = config.FLEET_OBS_TTL_MS.to_int()
+        ttl_s = (2000 if ttl_ms is None else int(ttl_ms)) / 1e3
+        with self._lock:
+            if not force and self._fed is not None \
+                    and time.monotonic() - self._fed_at < ttl_s:
+                return self._fed
+        router = self._alive_router()
+        metrics.inc(metrics.FLEET_FEDERATION_SCRAPES)
+        exports: Dict[str, Dict] = {}
+        heats: Dict[str, Dict] = {}
+        healths: Dict[str, Dict] = {}
+        errors: Dict[str, str] = {}
+        for rid in router.registry.members():
+            try:
+                payload = router._client(rid).metrics_export()
+            except Exception as e:
+                errors[rid] = repr(e)[:200]
+                metrics.inc(metrics.FLEET_FEDERATION_ERRORS)
+                continue
+            exports[rid] = payload.get("metrics") or {}
+            heats[rid] = payload.get("heat") or {}
+            healths[rid] = payload.get("health") or {}
+        merged = metrics.merge_exports(exports)
+        out = {
+            "replicas": sorted(exports),
+            "errors": errors,
+            "merged": merged,
+            "heat": heats,
+            "health": healths,
+        }
+        with self._lock:
+            self._fed = out
+            self._fed_at = time.monotonic()
+            self._merged = merged
+        return out
+
+    def _merged_trace_hist(self, op: str) -> Optional[Dict[str, Any]]:
+        """Fleet SLO source: the merged ``trace.<op>`` histogram snapshot
+        from the newest federation pull (None before the first pull —
+        the monitor just skips the op)."""
+        with self._lock:
+            merged = self._merged
+        if merged is None:
+            return None
+        return (merged.get("histograms") or {}).get(f"trace.{op}")
+
+    def fleet_metrics_text(self, openmetrics: bool = False) -> str:
+        """The ``/metrics/fleet`` exposition: merged counters/histograms
+        plain, gauges with a ``replica`` label per member. Refreshes the
+        federation cache and ticks the fleet SLO monitor (its
+        ``slo.burn.fleet.<op>`` gauges live in the ROUTER's registry, on
+        the router's own ``/metrics``)."""
+        fed = self.federate()
+        self.slo.evaluate()
+        return metrics.render_fleet(fed["merged"], openmetrics=openmetrics)
+
+    # -- fleet health ------------------------------------------------------
+    def fleet_health(self) -> Dict[str, Any]:
+        """The ``/healthz/fleet`` payload. HARD (503) only when no
+        usable replica remains or the fleet SLO burns past threshold;
+        every survivable defect — cordoned/draining/broken members with
+        capacity left, a member's own degraded local health, journal lag
+        on some member, anomaly flags — is SOFT (200, ``soft: true``)."""
+        router = self._alive_router()
+        fed = self.federate()
+        summary = router.registry.summary()
+        anomalies = router.registry.anomaly_report()
+        slo_status = self.slo.status()
+        slo_hot = {op: s for op, s in slo_status.items() if s["hot"]}
+        reasons: List[str] = []
+        if summary["usable"] <= 0 and summary["total"] > 0:
+            reasons.append("hard: no usable replica")
+        for op in sorted(slo_hot):
+            reasons.append(f"hard: fleet SLO burning on {op}")
+        hard = bool(reasons)
+        if summary["cordoned"]:
+            reasons.append(f"soft: {summary['cordoned']} cordoned")
+        if summary["draining"]:
+            reasons.append(f"soft: {summary['draining']} draining")
+        if summary["broken"]:
+            reasons.append(f"soft: {summary['broken']} breaker-open")
+        for rid in sorted(fed["errors"]):
+            reasons.append(f"soft: {rid} unreachable for federation")
+        for rid in sorted(fed["health"]):
+            h = fed["health"][rid] or {}
+            if h.get("status") not in (None, "ok"):
+                kind = "soft" if h.get("soft") else "replica-hard"
+                # a member's own HARD degradation is still fleet-SOFT
+                # while other replicas carry its keys
+                reasons.append(f"soft: {rid} local health {kind}")
+            lag = h.get("journal") or {}
+            if any(int(v) > 0 for v in lag.values()):
+                reasons.append(f"soft: {rid} journal lag")
+        for rid in sorted(anomalies):
+            reasons.append(f"soft: {rid} latency anomaly")
+        degraded = hard or any(r.startswith("soft:") for r in reasons)
+        return {
+            "status": "degraded" if degraded else "ok",
+            "soft": bool(degraded and not hard),
+            "reasons": reasons,
+            "summary": summary,
+            "replicas": router.registry.snapshot(),
+            "health": fed["health"],
+            "federation_errors": fed["errors"],
+            "anomalies": anomalies,
+            "slo": slo_status,
+        }
+
+    # -- cell heat ---------------------------------------------------------
+    def fleet_heat(self, top: Optional[int] = None) -> Dict[str, Any]:
+        """The fleet heat table (``/debug/heat``, ``geomesa-tpu fleet
+        heat``): per-replica ``metrics-export`` heat rows merged by
+        (schema, cell), each merged row carrying its per-replica touch
+        split — the placement signal the autoscaling arc consumes."""
+        fed = self.federate()
+        return {
+            "schemas": heat.merge_snapshots(fed["heat"], top=top),
+            "replicas": sorted(fed["heat"]),
+            "errors": fed["errors"],
+        }
+
+    # -- anomaly watchdog --------------------------------------------------
+    def anomalies(self) -> Dict[str, Dict[str, float]]:
+        """Per-replica per-op latency ratios vs the fleet median that
+        cross ``geomesa.fleet.anomaly.factor`` (observation only — the
+        outlier-streak breaker in the registry stays the enforcement
+        path). Publishes the ``fleet.anomaly.<id>`` gauges."""
+        return self._alive_router().registry.anomaly_report()
+
+    # -- trace stitching (async half) --------------------------------------
+    def note_scatter(self, trace_id: Optional[str],
+                     owners: Sequence[str]) -> None:
+        """Scatter-completion hook (called by the router WITH the query
+        still on the caller's thread): one bounded deque append + event
+        set — never blocks, never RPCs. The stitcher thread does the
+        pulls after ``geomesa.fleet.stitch.delay.ms``."""
+        if trace_id is None or not owners:
+            return
+        if not config.FLEET_STITCH.to_bool():
+            return
+        cap = config.FLEET_STITCH_QUEUE.to_int()
+        cap = 256 if cap is None else int(cap)
+        with self._lock:
+            if len(self._queue) >= cap:
+                self._queue.popleft()  # oldest out: stitching is advisory
+            self._queue.append(
+                (trace_id, tuple(dict.fromkeys(owners)), time.monotonic())
+            )
+        self._wake.set()
+        self._ensure_thread()
+
+    def stitched(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        """A stitched record by trace id (``/debug/queries?trace=``), or
+        None when the id never stitched here (or aged out)."""
+        with self._lock:
+            return self._stitched.get(trace_id)
+
+    def _ensure_thread(self) -> None:
+        t = self._thread
+        if t is not None and t.is_alive():
+            return
+        with self._lock:
+            t = self._thread
+            if t is not None and t.is_alive():
+                return
+            self._stop.clear()
+            t = threading.Thread(target=self._loop, daemon=True,
+                                 name="geomesa-fleet-stitch")
+            self._thread = t
+            t.start()
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            self._wake.wait(timeout=0.5)
+            self._wake.clear()
+            while not self._stop.is_set():
+                delay_ms = config.FLEET_STITCH_DELAY_MS.to_int()
+                delay_s = (100 if delay_ms is None else int(delay_ms)) / 1e3
+                with self._lock:
+                    item = self._queue[0] if self._queue else None
+                if item is None:
+                    break
+                # wait out the settle delay so every replica has closed
+                # (and retained) its root spans before the pulls
+                remain = item[2] + delay_s - time.monotonic()
+                if remain > 0:
+                    if self._stop.wait(timeout=remain):
+                        return
+                with self._lock:
+                    if not self._queue or self._queue[0] is not item:
+                        continue
+                    self._queue.popleft()
+                try:
+                    self._stitch(item[0], item[1])
+                except Exception:  # pragma: no cover — defensive
+                    metrics.inc(metrics.FLEET_TRACE_STITCH_FAILED)
+
+    def _stitch(self, trace_id: str,
+                owners: Tuple[str, ...]) -> Optional[Dict[str, Any]]:
+        """Assemble ONE stitched span tree: the router's local finished
+        trace plus each surviving replica's ``trace-fetch``ed subtrees,
+        grafted under the router span whose ``span_token`` matches each
+        subtree root's ``parent_span`` attribute. Exports the result
+        through the configured sinks and retains it for
+        ``/debug/queries?trace=``."""
+        router = self._router()
+        if router is None:
+            return None
+        local = tracing.finished_trace(trace_id)
+        if local is None:
+            metrics.inc(metrics.FLEET_TRACE_STITCH_FAILED)
+            return None
+        tree = local["tree"]
+        # span_token -> grafting point (the sidecar.call span that made
+        # the RPC; to_dict() is a fresh dict tree, so grafting into it
+        # never mutates the retained trace)
+        points: Dict[str, Dict[str, Any]] = {}
+
+        def index(node: Dict[str, Any]) -> None:
+            token = (node.get("attrs") or {}).get("span_token")
+            if token:
+                points[str(token)] = node
+            for c in node.get("children") or ():
+                index(c)
+
+        index(tree)
+        grafted = 0
+        seen_tokens: set = set()
+        replicas: set = set()
+        for rid in owners:
+            try:
+                fetched = router._client(rid).trace_fetch(trace_id)
+            except Exception:
+                metrics.inc(metrics.FLEET_TRACE_STITCH_FAILED)
+                continue
+            for rec in fetched.get("traces") or ():
+                sub = (rec or {}).get("tree")
+                if not sub:
+                    continue
+                attrs = sub.setdefault("attrs", {})
+                token = attrs.get("parent_span")
+                if not token or str(token) in seen_tokens:
+                    # no span token: not a child of this scatter (e.g.
+                    # the router's own retained root when replicas share
+                    # a process). Seen token: another member's fetch
+                    # already delivered this subtree — grafting is
+                    # idempotent, one subtree per sidecar call.
+                    continue
+                seen_tokens.add(str(token))
+                attrs.setdefault("replica", rid)
+                target = points.get(str(token))
+                if target is None:
+                    # no matching router span (dropped past the span
+                    # budget): keep the subtree under the root rather
+                    # than losing it
+                    target = tree
+                    attrs["stitch_orphan"] = True
+                target.setdefault("children", []).append(sub)
+                grafted += 1
+                replicas.add(str(attrs.get("replica") or rid))
+        record = {
+            "trace_id": trace_id,
+            "total_ms": local["total_ms"],
+            "stitched": True,
+            "replicas": sorted(replicas),
+            "subtrees": grafted,
+            "tree": tree,
+        }
+        with self._lock:
+            self._stitched[trace_id] = record
+            self._stitched.move_to_end(trace_id)
+            while len(self._stitched) > _STITCHED_KEEP:
+                self._stitched.popitem(last=False)
+        tracing_export.export_stitched(trace_id, tree)
+        metrics.inc(metrics.FLEET_TRACE_STITCHED)
+        return record
+
+    def stitch_now(self, trace_id: str,
+                   owners: Sequence[str]) -> Optional[Dict[str, Any]]:
+        """Synchronous stitch (tests, CLI): same assembly, caller's
+        thread, no settle delay."""
+        return self._stitch(trace_id, tuple(dict.fromkeys(owners)))

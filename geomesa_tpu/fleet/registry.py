@@ -60,6 +60,10 @@ class ReplicaRegistry:
         #: queries re-routed OFF this replica onto a later ring owner
         self._failed_over: Dict[str, int] = {}
         self._lat_recent: Dict[str, "deque"] = {}
+        #: (rid, op) -> recent samples — the anomaly watchdog's per-replica
+        #: baseline (the fleet-wide deque above cannot say WHICH replica
+        #: drags the median; docs/OBSERVABILITY.md §9)
+        self._lat_replica: Dict[tuple, "deque"] = {}
         self._outlier_streak: Dict[str, int] = {}
         #: consecutive successful probes per replica (auto-uncordon)
         self._probe_streak: Dict[str, int] = {}
@@ -232,6 +236,13 @@ class ReplicaRegistry:
             self._lat_recent[op] = dq  # re-insert = most recently seen
             while len(self._lat_recent) > self._MAX_OPS:
                 self._lat_recent.pop(next(iter(self._lat_recent)))
+            rdq = self._lat_replica.pop((rid, op), None)
+            if rdq is None:
+                rdq = deque(maxlen=64)
+            self._lat_replica[(rid, op)] = rdq
+            rdq.append(seconds)
+            while len(self._lat_replica) > self._MAX_OPS * 4:
+                self._lat_replica.pop(next(iter(self._lat_replica)))
             samples = sorted(dq)
             dq.append(seconds)
             median = samples[len(samples) // 2] if len(samples) >= 8 else None
@@ -253,6 +264,45 @@ class ReplicaRegistry:
                 return
         # trip outside the registry lock (breaker has its own)
         self.breaker(rid).trip()
+
+    # -- anomaly watchdog (docs/OBSERVABILITY.md §9) -----------------------
+    def anomaly_report(self) -> Dict[str, Dict[str, float]]:
+        """Per-replica latency anomalies vs the fleet: for every (replica,
+        op) with >= 8 recent samples whose fleet-wide op baseline also has
+        >= 8, the ratio of the replica's recent median to the fleet
+        median. Replicas with any op at or past
+        ``geomesa.fleet.anomaly.factor`` are flagged — surfaced as
+        ``fleet.anomaly.<id>`` gauges (worst ratio) and a /debug/fleet
+        advice row. OBSERVATION ONLY: nothing here cordons or trips a
+        breaker (the outlier-streak machinery above owns fencing).
+        Returns ``{rid: {op: ratio, ...}}`` for flagged replicas."""
+        try:
+            factor = config.FLEET_ANOMALY_FACTOR.to_float() or 0.0
+        except (TypeError, ValueError):
+            factor = 0.0
+        with self._lock:
+            fleet = {op: sorted(dq) for op, dq in self._lat_recent.items()
+                     if len(dq) >= 8}
+            per = {k: sorted(dq) for k, dq in self._lat_replica.items()
+                   if len(dq) >= 8}
+        worst: Dict[str, float] = {}
+        flagged: Dict[str, Dict[str, float]] = {}
+        for (rid, op), samples in per.items():
+            base = fleet.get(op)
+            if base is None:
+                continue
+            fleet_med = base[len(base) // 2]
+            if fleet_med <= 0:
+                continue
+            ratio = samples[len(samples) // 2] / fleet_med
+            worst[rid] = max(worst.get(rid, 0.0), ratio)
+            if factor > 0 and ratio >= factor:
+                flagged.setdefault(rid, {})[op] = round(ratio, 2)
+        reg = metrics.registry()
+        for rid, ratio in worst.items():
+            reg.gauge(f"{metrics.FLEET_ANOMALY_PREFIX}.{rid}").set(
+                round(ratio, 3))
+        return flagged
 
     # -- operator payloads -------------------------------------------------
     def snapshot(self) -> Dict[str, Dict[str, Any]]:

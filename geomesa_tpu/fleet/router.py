@@ -110,7 +110,24 @@ class FleetRouter:
         #: cells covered / groups+cells skipped — survivor accounting)
         self._scatter_stats: Dict[str, Dict[str, int]] = {}
         self._counter_lock = threading.Lock()
+        #: fleet observability plane (fleet/obs.py) — created lazily so
+        #: a router that never scatters or scrapes never starts it
+        self._obs = None
+        self._obs_lock = threading.Lock()
         _ROUTERS.add(self)
+
+    def observability(self):
+        """The router's :class:`~geomesa_tpu.fleet.obs.FleetObservability`
+        (docs/OBSERVABILITY.md §9), created on first use."""
+        obs = self._obs
+        if obs is None:
+            from geomesa_tpu.fleet.obs import FleetObservability
+
+            with self._obs_lock:
+                obs = self._obs
+                if obs is None:
+                    obs = self._obs = FleetObservability(self)
+        return obs
 
     # -- membership --------------------------------------------------------
     def add_replica(self, rid: str, location: str) -> None:
@@ -381,10 +398,20 @@ class FleetRouter:
             "scatter": scatter,
             "serving": self.serving.snapshot(),
             "users": self.serving.user_rollups(),
+            # anomaly watchdog advice row (docs/OBSERVABILITY.md §9):
+            # {rid: {op: ratio-to-fleet-median}} past the anomaly factor —
+            # observation only, the registry never cordons on it
+            "anomalies": self.registry.anomaly_report(),
         }
 
     def close(self) -> None:
         _ROUTERS.discard(self)  # a closed router leaves /debug/fleet
+        obs, self._obs = self._obs, None
+        if obs is not None:
+            try:
+                obs.close()
+            except Exception:
+                pass
         with self._clients_lock:
             clients, self._clients = list(self._clients.values()), {}
         for c in clients:
@@ -786,11 +813,16 @@ class FleetRouter:
         Workers adopt the caller's deadline, config overrides, and span
         context (the partition-prefetch snapshot/adopt discipline), so
         budgets and fault-injection scopes bound every branch. Returns
-        ``(results, failed)`` — per-job one-tuples (survivors) and
-        exhaustion errors; a non-retryable error (deadline expiry,
-        GM-ARG) aborts the whole scatter and re-raises."""
+        ``(results, failed, served)`` — per-job one-tuples (survivors),
+        exhaustion errors, and the replica id that actually answered
+        each surviving job (the trace stitcher's fetch list); a
+        non-retryable error (deadline expiry, GM-ARG) aborts the whole
+        scatter and re-raises."""
         results: List[Optional[Tuple[Any]]] = [None] * len(jobs)
         failed: List[Optional[BaseException]] = [None] * len(jobs)
+        #: per-job replica that actually answered (failover may move a
+        #: job off its pinned owner) — the stitcher's fetch list
+        served: List[Optional[str]] = [None] * len(jobs)
         fatal: List[BaseException] = []
         schema_owners = self.ring.owners(f"schema:{name}")
 
@@ -800,11 +832,12 @@ class FleetRouter:
                 r for r in schema_owners if r != job["owner"]
             ]
             try:
-                out, _rid = self._call(
+                out, rid = self._call(
                     name, f"{name}:owner:{job['owner']}", op, job["call"],
                     owners=order,
                 )
                 results[i] = (out,)
+                served[i] = rid
             except _Exhausted as ex:
                 failed[i] = ex.last or RuntimeError("no usable replica")
 
@@ -814,7 +847,7 @@ class FleetRouter:
         if width == 1:
             for i in range(len(jobs)):
                 run_one(i)
-            return results, failed
+            return results, failed, served
 
         it = iter(range(len(jobs)))
         it_lock = threading.Lock()
@@ -848,7 +881,7 @@ class FleetRouter:
             t.join()
         if fatal:
             raise fatal[0]
-        return results, failed
+        return results, failed, served
 
     def _scatter_finish(self, name: str, kind: str, op: str,
                         jobs: List[Dict[str, Any]], results, failed):
@@ -889,6 +922,21 @@ class FleetRouter:
             metrics.inc(metrics.FLEET_ROUTE_PARTIAL)
         return skipped
 
+    def _note_stitch(self, served: List[Optional[str]]) -> None:
+        """Scatter-completion stitch hook (docs/OBSERVABILITY.md §9): one
+        bounded enqueue of (trace id, serving replicas) for the async
+        stitcher — ZERO added blocking work on the query path. Gated on
+        the stitch knob BEFORE touching the observability plane, so a
+        stitch-off fleet never even constructs it."""
+        if not config.FLEET_STITCH.to_bool():
+            return
+        tid = tracing.current_trace_id()
+        if tid is None:
+            return
+        owners = [r for r in served if r is not None]
+        if owners:
+            self.observability().note_scatter(tid, owners)
+
     #: merge-cost histogram shape (ms): router-side merges are host-light
     _MERGE_BUCKETS_MS = (0.1, 0.5, 1.0, 5.0, 20.0, 100.0, 500.0)
 
@@ -915,7 +963,7 @@ class FleetRouter:
         with self._admit(op, user=user), \
                 tracing.start(f"fleet.{op}", schema=name, scatter=True,
                               groups=len(jobs)):
-            results, failed = self._scatter_dispatch(name, op, jobs)
+            results, failed, served = self._scatter_dispatch(name, op, jobs)
             skipped = self._scatter_finish(
                 name, kind, op, jobs, results, failed
             )
@@ -924,6 +972,7 @@ class FleetRouter:
                 [r[0] for r in results if r is not None], merge
             )
             self._observe_merge(time.perf_counter() - t0)
+            self._note_stitch(served)
         if merged is None:
             merged = zero()
         ok = len(jobs) - len(skipped)
@@ -1082,7 +1131,7 @@ class FleetRouter:
         with self._admit("density_curve", user=user), \
                 tracing.start("fleet.density_curve", schema=name,
                               scatter=True, groups=len(jobs)):
-            results, failed = self._scatter_dispatch(
+            results, failed, served = self._scatter_dispatch(
                 name, "density_curve", jobs
             )
             skipped = self._scatter_finish(
@@ -1096,6 +1145,7 @@ class FleetRouter:
                 out[sy0 - iy0: sy1 - iy0 + 1,
                     sx0 - ix0: sx1 - ix0 + 1] = grid
             self._observe_merge(time.perf_counter() - t0)
+            self._note_stitch(served)
         ok = len(jobs) - len(skipped)
         if skipped and not resilience.partial_allowed():
             raise FleetPartialError(

@@ -1,0 +1,144 @@
+"""Cell-heat telemetry (docs/OBSERVABILITY.md §9).
+
+A process-wide table of per-(schema, SFC cell) access heat fed by the
+aggregate cache's decomposition loop (cache/service.py): every cell-level
+lookup records a hit or a miss, and a miss carries the scan's wall-clock
+milliseconds — the cost-ledger attribution for that cell. The fleet
+router federates per-replica snapshots into one fleet heat table
+(`/debug/heat`, ``geomesa-tpu fleet heat``) — the placement signal the
+autoscaling/rebalancing arc consumes (ROADMAP: "the hottest cells from
+the cache heat table and cost ledger"; GeoBlocks, PAPERS.md 1908.07753).
+
+Bounded and lock-cheap: the table holds at most ``geomesa.heat.cells``
+rows (coldest-by-touches evict first, counted in ``heat.evicted``), and a
+snapshot ships only the ``geomesa.heat.top`` hottest rows per schema.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from geomesa_tpu import config, metrics
+
+#: (schema, "z<level>:<prefix>") -> [hits, misses, device_ms, touches]
+_Key = Tuple[str, str]
+
+
+class HeatTable:
+    def __init__(self, max_cells: Optional[int] = None):
+        self._rows: Dict[_Key, List[float]] = {}
+        self._lock = threading.Lock()
+        self._max = max_cells
+
+    def _cap(self) -> int:
+        if self._max is not None:
+            return self._max
+        v = config.HEAT_CELLS_MAX.to_int()
+        return 4096 if v is None else int(v)
+
+    def record(self, schema: str, level: int, prefix: str,
+               hit: int = 0, miss: int = 0,
+               device_ms: float = 0.0) -> None:
+        cap = self._cap()
+        if cap <= 0:
+            return
+        key = (schema, f"z{int(level)}:{prefix}")
+        with self._lock:
+            row = self._rows.get(key)
+            if row is None:
+                if len(self._rows) >= cap:
+                    # evict the coldest row by touches — one scan, only on
+                    # the (rare) insert past the bound
+                    coldest = min(self._rows, key=lambda k: self._rows[k][3])
+                    del self._rows[coldest]
+                    metrics.inc(metrics.HEAT_EVICTED)
+                row = self._rows[key] = [0, 0, 0.0, 0]
+            row[0] += hit
+            row[1] += miss
+            row[2] += device_ms
+            row[3] += 1
+            metrics.registry().gauge(metrics.HEAT_CELLS).set(len(self._rows))
+
+    def snapshot(self, top: Optional[int] = None) -> Dict[str, List[dict]]:
+        """Per-schema hottest rows, heat-descending. Heat orders by
+        touches (hits + misses): a cell everyone reads is hot whether or
+        not the cache absorbs it; ``device_ms`` carries the cost weight."""
+        if top is None:
+            t = config.HEAT_TOP.to_int()
+            top = 256 if t is None else int(t)
+        with self._lock:
+            items = [(k, list(v)) for k, v in self._rows.items()]
+        out: Dict[str, List[dict]] = {}
+        for (schema, cell), (hits, misses, dev_ms, touches) in items:
+            out.setdefault(schema, []).append({
+                "cell": cell, "hits": int(hits), "misses": int(misses),
+                "device_ms": round(float(dev_ms), 3),
+                "touches": int(touches),
+            })
+        for schema in out:
+            out[schema].sort(key=lambda r: (-r["touches"], r["cell"]))
+            if top > 0:
+                del out[schema][top:]
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._rows.clear()
+
+
+def merge_snapshots(snaps: Dict[str, Dict[str, List[dict]]],
+                    top: Optional[int] = None) -> Dict[str, List[dict]]:
+    """Merge per-replica :meth:`HeatTable.snapshot` payloads by
+    (schema, cell): counters add, and each merged row carries the per-
+    replica touch split (``replicas: {rid: touches}``) so an operator can
+    see WHERE a hot cell's load lands — the rebalancer's input shape."""
+    if top is None:
+        t = config.HEAT_TOP.to_int()
+        top = 256 if t is None else int(t)
+    acc: Dict[Tuple[str, str], dict] = {}
+    for rid in sorted(snaps):
+        for schema, rows in (snaps[rid] or {}).items():
+            for r in rows:
+                key = (schema, r["cell"])
+                m = acc.get(key)
+                if m is None:
+                    m = acc[key] = {"cell": r["cell"], "hits": 0,
+                                    "misses": 0, "device_ms": 0.0,
+                                    "touches": 0, "replicas": {}}
+                m["hits"] += int(r["hits"])
+                m["misses"] += int(r["misses"])
+                m["device_ms"] = round(
+                    m["device_ms"] + float(r["device_ms"]), 3)
+                m["touches"] += int(r["touches"])
+                m["replicas"][rid] = (m["replicas"].get(rid, 0)
+                                      + int(r["touches"]))
+    out: Dict[str, List[dict]] = {}
+    for (schema, _cell), row in acc.items():
+        out.setdefault(schema, []).append(row)
+    for schema in out:
+        out[schema].sort(key=lambda r: (-r["touches"], r["cell"]))
+        if top > 0:
+            del out[schema][top:]
+    return out
+
+
+_TABLE = HeatTable()
+
+
+def table() -> HeatTable:
+    return _TABLE
+
+
+def record(schema: str, level: int, prefix: str, hit: int = 0,
+           miss: int = 0, device_ms: float = 0.0) -> None:
+    _TABLE.record(schema, level, prefix, hit=hit, miss=miss,
+                  device_ms=device_ms)
+
+
+def snapshot(top: Optional[int] = None) -> Dict[str, Any]:
+    return _TABLE.snapshot(top)
+
+
+def reset() -> None:
+    _TABLE.reset()

@@ -49,11 +49,12 @@ class _LakeLazyCols(dict):
     touches only the column chunks it needs."""
 
     def __init__(self, snap, zkeys: Dict[str, str], groups=None,
-                 on_corrupt=None):
+                 on_corrupt=None, cache=None):
         super().__init__()
         self._snap = snap
         self._zkeys = dict(zkeys)  # column name -> prefixed snapshot name
         self._groups = groups      # None = every row group
+        self._cache = cache        # cross-chunk residency (docs/JOIN.md §11)
         #: corruption hook: a crc/decode failure during a LAZY column read
         #: surfaces mid-scan, after the load committed — the owning
         #: partitioned store quarantines the bin here so the next query
@@ -67,7 +68,8 @@ class _LakeLazyCols(dict):
         if zk is None:
             raise KeyError(k)
         try:
-            v = self._snap.read_column(zk, self._groups)
+            v = self._snap.read_column(zk, self._groups,
+                                       cache=self._cache)
         except LakeCorruptError as e:
             if self._on_corrupt is not None:
                 self._on_corrupt(e)
@@ -607,7 +609,8 @@ class PartitionedFeatureStore(FeatureStore):
             def attempt():
                 resilience.fault_point("index.spill.load", bin=int(b),
                                        path=d)
-                return self._load_pruned(b, snap, groups, ks)
+                return self._load_pruned(b, snap, groups, ks,
+                                         cache=window.get("residency"))
 
             return policy.call(attempt,
                                retryable=resilience.transient_os_error)
@@ -622,7 +625,7 @@ class PartitionedFeatureStore(FeatureStore):
             ) from e
 
     def _load_pruned(self, b: int, snap, groups: List[int],
-                     ks) -> FeatureStore:
+                     ks, cache=None) -> FeatureStore:
         """Assemble the ephemeral pruned child over the surviving row
         groups. When the plan's index IS the snapshot's primary sort
         order, the groups are SFC-contiguous slices of it — order is the
@@ -643,10 +646,10 @@ class PartitionedFeatureStore(FeatureStore):
         nsel = snap.group_rows(groups)
         corrupt = self._quarantiner(b)
         master = _LakeLazyCols(snap, {c[2:]: c for c in snap.columns},
-                               groups, on_corrupt=corrupt)
+                               groups, on_corrupt=corrupt, cache=cache)
         cols = _LakeLazyCols(
             snap, {c[2:]: c for c in snap.columns if c.startswith("c/")},
-            groups, on_corrupt=corrupt,
+            groups, on_corrupt=corrupt, cache=cache,
         )
         st._key_cols = {}
         st._all = ColumnBatch(cols, nsel)
@@ -664,7 +667,7 @@ class PartitionedFeatureStore(FeatureStore):
             t.shard_bounds = np.zeros(t.n_shards + 1, np.int64)
         elif requested == primary:
             t.order = np.arange(nsel, dtype=np.int64)
-            t.key_columns = snap.table_keys(primary, groups)
+            t.key_columns = snap.table_keys(primary, groups, cache=cache)
             vocab = snap.table_vocab(primary)
             if vocab is not None:
                 t._rank_vocab = vocab.astype(object)

@@ -1,0 +1,100 @@
+"""Cross-chunk row-group residency cache (docs/JOIN.md §11).
+
+Window-pushdown join side scans chunk the LEFT side's cells and re-scan
+the RIGHT side once per chunk; adjacent chunks' inflated windows overlap
+by the join reach, so the row groups straddling a chunk boundary survive
+pruning in BOTH chunks and decode twice. A :class:`GroupResidencyCache`
+rides the whole chunk loop (one per join, threaded plan → window →
+``scan_child`` → ``PartitionSnapshot.read_column``): a decoded column
+chunk keyed ``(snapshot dir, prefixed column, row group)`` is served from
+memory on its second touch instead of re-reading + re-decoding the blob.
+
+The cache is strictly an accelerator — a hit returns the SAME bytes a
+fresh decode would (the lake file is immutable per snapshot dir and the
+join holds its plans for the loop's duration), so join counts stay
+bit-identical with the cache on, off, or thrashing. Cached arrays are
+marked read-only; a consumer that tried to mutate a shared chunk fails
+loudly instead of corrupting later chunks.
+
+Budget is ``geomesa.join.pushdown.residency.mb`` (decoded bytes, LRU
+evict; "0" disables). Hit/saved-bytes totals surface in
+``stats.pushdown`` (``residency_hits`` / ``bytes_saved_residency``) and
+the ``join.pushdown.residency.*`` counters.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import numpy as np
+
+from geomesa_tpu import config
+
+_Key = Tuple[str, str, int]
+
+
+class GroupResidencyCache:
+    """LRU over decoded per-group arrays, bounded by decoded bytes.
+
+    One instance spans one join's chunk loop. Thread-safe: the pushdown
+    executor may fan a chunk's partitions over worker threads.
+    """
+
+    def __init__(self, budget_bytes: int):
+        self.budget = int(budget_bytes)
+        self._rows: "OrderedDict[_Key, np.ndarray]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.held_bytes = 0
+        #: times a group chunk was served from memory
+        self.hits = 0
+        self.misses = 0
+        #: encoded blob bytes NOT re-read thanks to hits — the honest
+        #: "saved" figure (decode cost scales with the encoded payload)
+        self.bytes_saved = 0
+        self.evictions = 0
+
+    @classmethod
+    def from_config(cls) -> Optional["GroupResidencyCache"]:
+        mb = config.JOIN_PUSHDOWN_RESIDENCY_MB.to_int()
+        mb = 64 if mb is None else int(mb)
+        if mb <= 0:
+            return None
+        return cls(mb << 20)
+
+    def fetch(self, dir_: str, name: str, gi: int, ref,
+              file) -> np.ndarray:
+        """The decoded array for blob ``ref`` of group ``gi``, from cache
+        or via ``file.read_array`` (then cached, read-only)."""
+        key = (dir_, name, int(gi))
+        with self._lock:
+            arr = self._rows.get(key)
+            if arr is not None:
+                self._rows.move_to_end(key)
+                self.hits += 1
+                self.bytes_saved += int(file.blob_nbytes(ref))
+                return arr
+        arr = file.read_array(ref)
+        arr.setflags(write=False)
+        with self._lock:
+            self.misses += 1
+            if key not in self._rows:
+                self._rows[key] = arr
+                self.held_bytes += int(arr.nbytes)
+                while self.held_bytes > self.budget and len(self._rows) > 1:
+                    _, old = self._rows.popitem(last=False)
+                    self.held_bytes -= int(old.nbytes)
+                    self.evictions += 1
+        return arr
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "hits": self.hits,
+                "misses": self.misses,
+                "bytes_saved": self.bytes_saved,
+                "held_bytes": self.held_bytes,
+                "entries": len(self._rows),
+                "evictions": self.evictions,
+            }

@@ -276,16 +276,23 @@ class PartitionSnapshot:
 
     # -- column decode -----------------------------------------------------
     def read_column(self, name: str,
-                    groups: Optional[Sequence[int]] = None) -> np.ndarray:
+                    groups: Optional[Sequence[int]] = None,
+                    cache=None) -> np.ndarray:
         """Decode one prefixed column (``c/attr`` / ``k/__z3``) over the
-        listed row groups (all when None), concatenated in group order."""
+        listed row groups (all when None), concatenated in group order.
+        ``cache`` is an optional :class:`~geomesa_tpu.lake.residency.
+        GroupResidencyCache`: per-group chunks then come from / land in
+        the cross-chunk residency cache (docs/JOIN.md §11)."""
         idx = list(range(len(self.groups))) if groups is None else list(groups)
         parts = []
         for i in idx:
             ref = self.groups[i]["cols"].get(name)
             if ref is None:
                 raise KeyError(name)
-            parts.append(self.file.read_array(ref))
+            if cache is not None:
+                parts.append(cache.fetch(self.dir, name, i, ref, self.file))
+            else:
+                parts.append(self.file.read_array(ref))
         if not parts:
             # zero groups (empty partition / everything pruned): derive an
             # empty array of the right dtype from the encoding of nothing
@@ -300,15 +307,20 @@ class PartitionSnapshot:
         return self.file.read_array(ent["order"])
 
     def table_keys(self, name: str,
-                   groups: Optional[Sequence[int]] = None
-                   ) -> Dict[str, np.ndarray]:
+                   groups: Optional[Sequence[int]] = None,
+                   cache=None) -> Dict[str, np.ndarray]:
         ent = self.tables[name]
         out: Dict[str, np.ndarray] = {}
         for k, refs in ent.get("keys", {}).items():
             if isinstance(refs, list):  # primary: per-group chunks
                 idx = (list(range(len(self.groups)))
                        if groups is None else list(groups))
-                parts = [self.file.read_array(refs[i]) for i in idx]
+                parts = [
+                    cache.fetch(self.dir, f"tk/{name}/{k}", i, refs[i],
+                                self.file)
+                    if cache is not None else self.file.read_array(refs[i])
+                    for i in idx
+                ]
                 out[k] = (parts[0] if len(parts) == 1
                           else np.concatenate(parts)) if parts \
                     else np.zeros(0, np.int64)

@@ -244,16 +244,17 @@ class MetricRegistry:
         return out
 
     @staticmethod
-    def _prom_hist_lines(metric: str, h: Histogram,
+    def _prom_hist_lines(metric: str, snap: Dict[str, object],
                          exemplars: bool = False) -> List[str]:
-        """Cumulative prometheus histogram lines for one Histogram. With
+        """Cumulative prometheus histogram lines for one histogram
+        SNAPSHOT (``Histogram.snapshot()`` shape — the fleet federation
+        renders merged snapshot dicts through the same code). With
         ``exemplars`` (OpenMetrics exposition ONLY — the `#` suffix is a
         parse error under the classic text format, so callers must
         negotiate the content type first), buckets holding an exemplar
         render it in OpenMetrics exemplar syntax
         (`... # {trace_id="…"} value timestamp`), linking the bucket to a
         concrete trace (docs/OBSERVABILITY.md)."""
-        snap = h.snapshot()
         ex = (snap.get("exemplars") or {}) if exemplars else {}
 
         def _ex(i: int) -> str:
@@ -297,19 +298,156 @@ class MetricRegistry:
                 lines.append(f"{metric}_count {m.count}")
                 lines.append(f"{metric}_seconds_total {m.total_s:.6f}")
                 lines.append(f"{metric}_seconds_max {m.max_s:.6f}")
-                lines.extend(self._prom_hist_lines(metric + "_seconds",
-                                                   m.hist, exemplars))
+                lines.extend(self._prom_hist_lines(
+                    metric + "_seconds", m.hist.snapshot(), exemplars))
             elif isinstance(m, Histogram):
                 suffix = "_seconds" if m.unit == "s" else ""
-                lines.extend(self._prom_hist_lines(metric + suffix, m,
-                                                   exemplars))
+                lines.extend(self._prom_hist_lines(
+                    metric + suffix, m.snapshot(), exemplars))
             elif isinstance(m, (Counter, Gauge)):
                 lines.append(f"{metric} {m.value}")
         return "\n".join(lines) + "\n"
 
+    def export_snapshot(self) -> Dict[str, object]:
+        """STRUCTURED export for metrics federation (docs/OBSERVABILITY.md
+        §9): raw counters, sampled gauges, and full histogram bucket
+        vectors — NOT the quantile summaries :meth:`report` collapses to.
+        The fleet router merges these exactly: counters add, histogram
+        ``counts`` add bucket-wise (ladders are compared, never assumed),
+        gauges keep per-replica identity. Exemplars are deliberately
+        omitted: they are per-process pointers into per-process trace
+        retention and do not survive a merge."""
+        counters: Dict[str, int] = {}
+        gauges: Dict[str, float] = {}
+        hists: Dict[str, object] = {}
+        timers: Dict[str, object] = {}
+        with self._lock:
+            items = list(self._metrics.items())
+        for name, m in items:
+            if isinstance(m, Counter):
+                counters[name] = m.value
+            elif isinstance(m, Gauge):
+                try:
+                    gauges[name] = float(m.value)
+                except Exception:
+                    continue  # a dead callable backing must not kill export
+            elif isinstance(m, Timer):
+                snap = m.hist.snapshot()
+                snap.pop("exemplars", None)
+                snap["unit"] = m.hist.unit
+                timers[name] = {"count": m.count, "total_s": m.total_s,
+                                "max_s": m.max_s, "hist": snap}
+            elif isinstance(m, Histogram):
+                snap = m.snapshot()
+                snap.pop("exemplars", None)
+                snap["unit"] = m.unit
+                hists[name] = snap
+        return {"counters": counters, "gauges": gauges,
+                "histograms": hists, "timers": timers}
+
     def clear(self):
         with self._lock:
             self._metrics.clear()
+
+
+def _merge_hist(acc: Optional[Dict[str, object]],
+                snap: Dict[str, object]) -> Tuple[Dict[str, object], bool]:
+    """Merge one histogram snapshot into the accumulator. Returns
+    ``(acc, ok)``; ``ok`` is False when the bucket ladders differ (custom
+    ladders — FUSION_BATCH_BUCKETS, JOURNAL_*_BUCKETS, the router's merge
+    buckets — only merge with themselves; a mismatched snapshot is counted
+    as skew, never silently re-binned)."""
+    if acc is None:
+        return ({"buckets": list(snap["buckets"]),
+                 "counts": list(snap["counts"]),
+                 "count": int(snap["count"]),
+                 "sum_s": float(snap["sum_s"]),
+                 "unit": snap.get("unit", "s")}, True)
+    if list(acc["buckets"]) != list(snap["buckets"]):
+        return acc, False
+    acc["counts"] = [a + b for a, b in zip(acc["counts"], snap["counts"])]
+    acc["count"] = int(acc["count"]) + int(snap["count"])
+    acc["sum_s"] = float(acc["sum_s"]) + float(snap["sum_s"])
+    return acc, True
+
+
+def merge_exports(exports: Dict[str, Dict[str, object]]) -> Dict[str, object]:
+    """Merge per-replica :meth:`MetricRegistry.export_snapshot` payloads
+    into ONE fleet view: counters and histogram bucket vectors add exactly,
+    timers add (max of maxes), gauges stay per-replica keyed by replica id.
+    ``bucket_skew`` counts (name -> snapshots dropped) histogram snapshots
+    whose ladder disagreed with the first replica's — exactness over
+    silent re-binning."""
+    counters: Dict[str, int] = {}
+    gauges: Dict[str, Dict[str, float]] = {}
+    hists: Dict[str, Dict[str, object]] = {}
+    timers: Dict[str, Dict[str, object]] = {}
+    skew: Dict[str, int] = {}
+    for rid in sorted(exports):
+        snap = exports[rid] or {}
+        for name, v in (snap.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0) + int(v)
+        for name, v in (snap.get("gauges") or {}).items():
+            gauges.setdefault(name, {})[rid] = float(v)
+        for name, h in (snap.get("histograms") or {}).items():
+            merged, ok = _merge_hist(hists.get(name), h)
+            hists[name] = merged
+            if not ok:
+                skew[name] = skew.get(name, 0) + 1
+        for name, t in (snap.get("timers") or {}).items():
+            acc = timers.get(name)
+            if acc is None:
+                timers[name] = {"count": int(t["count"]),
+                                "total_s": float(t["total_s"]),
+                                "max_s": float(t["max_s"]),
+                                "hist": dict(t["hist"])}
+                timers[name]["hist"]["buckets"] = list(t["hist"]["buckets"])
+                timers[name]["hist"]["counts"] = list(t["hist"]["counts"])
+                continue
+            acc["count"] += int(t["count"])
+            acc["total_s"] += float(t["total_s"])
+            acc["max_s"] = max(acc["max_s"], float(t["max_s"]))
+            merged, ok = _merge_hist(acc["hist"], t["hist"])
+            acc["hist"] = merged
+            if not ok:
+                skew[name] = skew.get(name, 0) + 1
+    return {"replicas": sorted(exports), "counters": counters,
+            "gauges": gauges, "histograms": hists, "timers": timers,
+            "bucket_skew": skew}
+
+
+def render_fleet(merged: Dict[str, object], prefix: str = "geomesa",
+                 openmetrics: bool = False) -> str:
+    """Prometheus text exposition of one :func:`merge_exports` result.
+    Fleet-level series (summed counters, bucket-wise-merged histograms,
+    added timers) render exactly like a single process's; gauges render
+    one line per replica with a ``replica`` label — a gauge is a sampled
+    per-process fact and summing it would lie. ``openmetrics`` changes
+    nothing here (merged snapshots carry no exemplars) but is accepted so
+    the caller can negotiate content types uniformly."""
+    del openmetrics  # merged snapshots are exemplar-free by construction
+
+    def mangle(name: str) -> str:
+        return f"{prefix}_{name}".replace(".", "_").replace("-", "_")
+
+    lines: List[str] = []
+    for name, v in sorted((merged.get("counters") or {}).items()):
+        lines.append(f"{mangle(name)} {v}")
+    for name, per in sorted((merged.get("gauges") or {}).items()):
+        for rid, v in sorted(per.items()):
+            lines.append(f'{mangle(name)}{{replica="{rid}"}} {v}')
+    for name, h in sorted((merged.get("histograms") or {}).items()):
+        suffix = "_seconds" if h.get("unit") == "s" else ""
+        lines.extend(MetricRegistry._prom_hist_lines(
+            mangle(name) + suffix, h))
+    for name, t in sorted((merged.get("timers") or {}).items()):
+        metric = mangle(name)
+        lines.append(f"{metric}_count {t['count']}")
+        lines.append(f"{metric}_seconds_total {t['total_s']:.6f}")
+        lines.append(f"{metric}_seconds_max {t['max_s']:.6f}")
+        lines.extend(MetricRegistry._prom_hist_lines(
+            metric + "_seconds", t["hist"]))
+    return "\n".join(lines) + "\n"
 
 
 _REGISTRY = MetricRegistry()
@@ -632,6 +770,37 @@ JOURNAL_GROUP_BUCKETS = (1.0, 2.0, 4.0, 8.0, 16.0, 32.0, 64.0, 128.0)
 #: group-commit fsync latency buckets (milliseconds)
 JOURNAL_FSYNC_BUCKETS_MS = (0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
                             50.0, 100.0, 250.0)
+# Fleet observability plane (fleet/obs.py; docs/OBSERVABILITY.md §9):
+#   fleet.federation.scrapes   metrics-export federation sweeps completed
+#   fleet.federation.errors    replica snapshots a sweep failed to pull
+#                              (the merge proceeds over the survivors)
+#   fleet.trace.stitched       stitched cross-replica traces assembled
+#   fleet.trace.stitch.failed  scattered traces the stitcher could not
+#                              assemble (replica retention expired, fetch
+#                              failed) — exported unstitched, counted
+#   fleet.anomaly.<id>         gauge: per-replica latency anomaly factor —
+#                              worst per-op recent-median ratio vs the
+#                              fleet median (1.0 = at median; ≥ the
+#                              geomesa.fleet.anomaly.factor threshold is
+#                              flagged in /debug/fleet). Observation only.
+FLEET_FEDERATION_SCRAPES = "fleet.federation.scrapes"
+FLEET_FEDERATION_ERRORS = "fleet.federation.errors"
+FLEET_TRACE_STITCHED = "fleet.trace.stitched"
+FLEET_TRACE_STITCH_FAILED = "fleet.trace.stitch.failed"
+FLEET_ANOMALY_PREFIX = "fleet.anomaly"
+# Cell-heat telemetry (heat.py, cache/service.py; docs/OBSERVABILITY.md §9):
+#   heat.cells        gauge: distinct (schema, cell) rows resident in the
+#                     process heat table
+#   heat.evicted      heat rows dropped by the table's size bound
+HEAT_CELLS = "heat.cells"
+HEAT_EVICTED = "heat.evicted"
+#   join.pushdown.residency.hits   chunk-boundary row-group column chunks
+#                                  served from the cross-chunk residency
+#                                  cache instead of a re-decode
+#   join.pushdown.residency.bytes  encoded payload bytes that re-decode
+#                                  would have re-read (docs/JOIN.md §11)
+JOIN_PUSHDOWN_RESIDENCY_HITS = "join.pushdown.residency.hits"
+JOIN_PUSHDOWN_RESIDENCY_BYTES = "join.pushdown.residency.bytes"
 #   compact.desc.shared   compact-scan descriptors served from the
 #                         content-addressed share (a rebuild avoided:
 #                         another site/query resolved the same windows —

@@ -26,8 +26,26 @@ the Dropwizard-reporter role of the reference's geomesa-metrics module
                         and the SLO burn summary (utilization.py, slo.py)
     GET /debug/fleet    JSON: every live fleet router's ring membership,
                         per-replica health + breaker states, fleet
-                        epochs, and routing counters (fleet/router.py,
+                        epochs, routing counters, and the anomaly-
+                        watchdog advice row (fleet/router.py,
                         docs/RESILIENCE.md §7)
+    GET /metrics/fleet  fleet-level prometheus exposition merged from
+                        every replica's metrics-export snapshot —
+                        counters summed, histograms merged bucket-wise,
+                        gauges labeled per replica (fleet/obs.py,
+                        docs/OBSERVABILITY.md §9); 404 when this process
+                        runs no fleet router
+    GET /healthz/fleet  fleet-composed health: hard (503) only when no
+                        usable replica remains or the fleet SLO burns;
+                        survivable defects degrade soft (200)
+    GET /debug/heat     JSON: per-(schema, SFC cell) access heat — this
+                        process's table, plus the fleet-merged table
+                        (with per-replica touch splits) per live router
+
+``/debug/queries?trace=<id>`` is an exact-match lookup: the full span
+tree behind one trace id — the fleet-STITCHED tree (router spans +
+per-replica subtrees) when a live router stitched it, else the local
+retained trace.
 
 ``web.py`` mounts the same routes on the REST server, so a process
 already serving the API needs no second port; :func:`serve` runs a
@@ -276,6 +294,54 @@ def debug_queries(dataset=None, n: int = 50, user: Optional[str] = None,
     }
 
 
+def _live_routers() -> list:
+    """Live FleetRouter instances in this process (lazily — the fleet
+    module needs pyarrow, and these routes must 404 cleanly without
+    it)."""
+    import sys
+
+    mod = sys.modules.get("geomesa_tpu.fleet.router")
+    if mod is None:
+        return []
+    try:
+        return sorted(mod._ROUTERS, key=lambda r: r.name)
+    except Exception:  # pragma: no cover — defensive
+        return []
+
+
+def trace_lookup(trace_id: str) -> Optional[Dict[str, Any]]:
+    """The /debug/queries?trace=<id> payload: the STITCHED fleet tree
+    when a live router assembled one for the id (replica subtrees
+    grafted under the router spans that called them), else the local
+    retained trace. None when the id is unknown everywhere here."""
+    for r in _live_routers():
+        try:
+            rec = r.observability().stitched(trace_id)
+        except Exception:  # pragma: no cover — defensive
+            continue
+        if rec is not None:
+            return rec
+    return tracing.finished_trace(trace_id)
+
+
+def debug_heat(top: Optional[int] = None) -> Dict[str, Any]:
+    """The /debug/heat payload (docs/OBSERVABILITY.md §9): this
+    process's own heat table plus, per live router, the fleet-merged
+    table with per-replica touch splits — the autoscaler's input."""
+    from geomesa_tpu import heat
+
+    out: Dict[str, Any] = {"local": heat.snapshot(top)}
+    fleet: Dict[str, Any] = {}
+    for r in _live_routers():
+        try:
+            fleet[r.name] = r.observability().fleet_heat(top=top)
+        except Exception as e:  # pragma: no cover — defensive
+            fleet[r.name] = {"error": repr(e)[:200]}
+    if fleet:
+        out["fleet"] = fleet
+    return out
+
+
 def debug_fleet() -> Dict[str, Any]:
     """The /debug/fleet payload (docs/RESILIENCE.md §7): every live
     router's ring membership, per-replica health (state, breaker,
@@ -328,6 +394,35 @@ def handle(path: str, dataset=None, accept: Optional[str] = None):
             return (200, OPENMETRICS_CTYPE,
                     metrics_text(openmetrics=True).encode())
         return 200, "text/plain; version=0.0.4", metrics_text().encode()
+    if route == "/metrics/fleet":
+        routers = _live_routers()
+        if not routers:
+            return (404, "application/json", json.dumps(
+                {"error": "no live fleet router in this process"}
+            ).encode())
+        om = bool(accept and "application/openmetrics-text" in accept)
+        text = routers[0].observability().fleet_metrics_text(openmetrics=om)
+        if om:
+            return 200, OPENMETRICS_CTYPE, (text + "# EOF\n").encode()
+        return 200, "text/plain; version=0.0.4", text.encode()
+    if route == "/healthz/fleet":
+        routers = _live_routers()
+        if not routers:
+            return (404, "application/json", json.dumps(
+                {"error": "no live fleet router in this process"}
+            ).encode())
+        h = routers[0].observability().fleet_health()
+        code = 200 if h["status"] == "ok" or h.get("soft") else 503
+        return code, "application/json", json.dumps(h, default=str).encode()
+    if route == "/debug/heat":
+        try:
+            top = max(1, min(int(q["top"]), 10_000)) if "top" in q else None
+        except ValueError:
+            return (400, "application/json",
+                    json.dumps({"error": "?top= must be an integer"}
+                               ).encode())
+        return (200, "application/json",
+                json.dumps(debug_heat(top), default=str).encode())
     if route == "/healthz":
         h = health()
         # soft (device-cordon with capacity standing) degrades the STATUS
@@ -336,6 +431,15 @@ def handle(path: str, dataset=None, accept: Optional[str] = None):
         code = 200 if h["status"] == "ok" or h.get("soft") else 503
         return code, "application/json", json.dumps(h).encode()
     if route == "/debug/queries":
+        if "trace" in q:
+            # exact-match span-tree lookup (stitched when fleet)
+            rec = trace_lookup(q["trace"])
+            if rec is None:
+                return (404, "application/json", json.dumps(
+                    {"error": f"trace {q['trace']!r} not retained here"}
+                ).encode())
+            return (200, "application/json",
+                    json.dumps(rec, default=str).encode())
         try:
             n = max(1, min(int(q.get("n", "50")), 10_000))
         except ValueError:

@@ -228,7 +228,14 @@ class PartitionedExecutor:
                 ]
         if boxes is None and times is None:
             return None
-        return {"index": plan.index_name, "boxes": boxes, "times": times}
+        window = {"index": plan.index_name, "boxes": boxes, "times": times}
+        # cross-chunk residency cache (docs/JOIN.md §11): the join's chunk
+        # loop plants one cache on each re-planned side plan so boundary
+        # row groups shared by adjacent chunk windows decode once
+        residency = plan.__dict__.get("residency")
+        if residency is not None:
+            window["residency"] = residency
+        return window
 
     def _get_child(self, b: int, window: Optional[Dict]):
         """Load one partition for the scan: statistics-pruned ephemeral

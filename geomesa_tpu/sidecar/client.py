@@ -11,6 +11,7 @@ from __future__ import annotations
 import json
 import re
 import threading
+import uuid
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -42,6 +43,14 @@ DEADLINE_HEADER = "x-geomesa-deadline-ms"
 REPLICA_HEADER = "x-geomesa-replica-id"
 FLEET_EPOCHS_HEADER = "x-geomesa-fleet-epochs"
 FLEET_STAMP_HEADER = "x-geomesa-fleet-stamp"
+
+#: Cross-replica trace stitching (docs/OBSERVABILITY.md §9, PROTOCOL
+#: v1.7): each traced RPC mints a per-call span token, records it on the
+#: local ``sidecar.call`` span (``span_token`` attribute) and sends it in
+#: this header; the server's root span echoes it as ``parent_span``, so
+#: the fleet stitcher can graft the replica's subtree under the exact
+#: client span that made the call.
+PARENT_SPAN_HEADER = "x-geomesa-parent-span"
 
 
 class _FleetHeaderMiddleware(fl.ClientMiddleware):
@@ -212,6 +221,15 @@ class GeoFlightClient:
         tid = tracing.current_trace_id()
         if tid is not None:
             headers.append((TRACE_HEADER.encode(), tid.encode()))
+            span = tracing.current_span()
+            if span is not None and span is not tracing.NOOP:
+                # mint the per-call stitch token (one uuid per attempt:
+                # the surviving attempt's token is the one left on the
+                # span, matching the server tree that actually answered)
+                token = uuid.uuid4().hex[:16]
+                span.set(span_token=token)
+                headers.append((PARENT_SPAN_HEADER.encode(),
+                                token.encode()))
         user = config.USER.get()
         if user:
             headers.append((USER_HEADER.encode(), user.encode()))
@@ -549,6 +567,24 @@ class GeoFlightClient:
 
     def metrics(self) -> Dict:
         return self._action("metrics")["metrics"]
+
+    def metrics_export(self) -> Dict:
+        """Federation source (docs/OBSERVABILITY.md §9, PROTOCOL v1.7):
+        the replica's STRUCTURED metrics snapshot (counters, gauges,
+        histogram buckets), heat-table rows, and local health facts —
+        the payload ``fleet/obs.py`` merges fleet-wide. Admin: served
+        mid-drain."""
+        return self._action("metrics-export")
+
+    def trace_fetch(self, trace_id: str) -> Dict:
+        """The finished trace(s) behind ``trace_id`` from the replica's
+        retention ring (PROTOCOL v1.7): ``{"replica", "trace", "traces"}``
+        where ``traces`` holds EVERY retained root for the id (a replica
+        that served several scatter groups of one query has several) and
+        ``trace`` is the newest, None when unknown/evicted. The fleet
+        stitcher grafts each subtree under the router span whose
+        ``span_token`` matches the subtree root's ``parent_span``."""
+        return self._action("trace-fetch", {"trace_id": str(trace_id)})
 
     def device_health(self) -> Dict:
         """Per-device health map (ok/cordoned/broken, reassignment

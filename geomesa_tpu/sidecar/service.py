@@ -60,6 +60,12 @@ _SPECULATIVE_HEADER = "x-geomesa-speculative-ok"
 _FLEET_EPOCHS_HEADER = "x-geomesa-fleet-epochs"
 _FLEET_STAMP_HEADER = "x-geomesa-fleet-stamp"
 _REPLICA_HEADER = "x-geomesa-replica-id"
+#: cross-replica trace stitching (docs/OBSERVABILITY.md §9): the caller's
+#: per-call span token — the server's ROOT span records it as a
+#: ``parent_span`` attribute, so the fleet stitcher can graft this
+#: replica's subtree under the router span that made the call (v1.7,
+#: additive; same token grammar as trace ids)
+_PARENT_SPAN_HEADER = "x-geomesa-parent-span"
 
 
 class _CallHeaders(fl.ServerMiddleware):
@@ -73,7 +79,8 @@ class _CallHeaders(fl.ServerMiddleware):
                  budget_s: Optional[float], speculative: bool = False,
                  epochs: Optional[Dict[str, int]] = None,
                  stamp: Optional[Dict[str, int]] = None,
-                 server: "Optional[GeoFlightServer]" = None):
+                 server: "Optional[GeoFlightServer]" = None,
+                 parent_span: Optional[str] = None):
         self.trace_id = trace_id
         self.user = user
         self.budget_s = budget_s
@@ -81,6 +88,7 @@ class _CallHeaders(fl.ServerMiddleware):
         self.epochs = epochs
         self.stamp = stamp
         self.server = server
+        self.parent_span = parent_span
 
     def sending_headers(self):
         srv = self.server
@@ -149,14 +157,18 @@ class _TraceMiddlewareFactory(fl.ServerMiddlewareFactory):
         )
         epochs = self._epoch_map(headers, _FLEET_EPOCHS_HEADER)
         stamp = self._epoch_map(headers, _FLEET_STAMP_HEADER)
+        parent = _header(headers, _PARENT_SPAN_HEADER)
+        if parent is not None and not _TRACE_ID_RE.match(parent):
+            parent = None
         fleet = self.server is not None \
             and self.server.replica_id is not None
         if tid is None and user is None and budget_s is None \
                 and not speculative and epochs is None and stamp is None \
-                and not fleet:
+                and parent is None and not fleet:
             return None
         return _CallHeaders(tid, user, budget_s, speculative,
-                            epochs=epochs, stamp=stamp, server=self.server)
+                            epochs=epochs, stamp=stamp, server=self.server,
+                            parent_span=parent)
 
 
 def _call_headers(context) -> _CallHeaders:
@@ -506,6 +518,11 @@ class GeoFlightServer(fl.FlightServerBase):
                         root.set(executor_slot=int(slot))
                     if self.replica_id is not None:
                         root.set(replica=str(self.replica_id))
+                    if h.parent_span is not None:
+                        # the caller's span token: the fleet stitcher
+                        # grafts this replica subtree under the router
+                        # span carrying the matching span_token attr
+                        root.set(parent_span=str(h.parent_span))
                 # fleet epoch sync BEFORE the op, commit AFTER a stamped
                 # mutation succeeds (docs/RESILIENCE.md §7)
                 self._fleet_before(h)
@@ -861,6 +878,10 @@ class GeoFlightServer(fl.FlightServerBase):
     _ADMIN_ACTIONS = frozenset({
         "drain", "undrain", "replica-status", "version", "metrics",
         "serving-stats", "cache-stats", "device-health", "audit",
+        # fleet observability plane (docs/OBSERVABILITY.md §9): federation
+        # scrapes and trace stitching must keep working through a drain —
+        # that is when an operator most needs them
+        "metrics-export", "trace-fetch",
         # a DRAINING replica must still export its hot entries: the warm
         # handoff runs after drain (docs/RESILIENCE.md §7)
         "cache-export",
@@ -961,6 +982,36 @@ class GeoFlightServer(fl.FlightServerBase):
             from geomesa_tpu import metrics
 
             return ok({"metrics": metrics.registry().report()})
+        if kind == "metrics-export":
+            # federation source (PROTOCOL v1.7, docs/OBSERVABILITY.md §9):
+            # the STRUCTURED registry snapshot (counters/gauges/histogram
+            # buckets — not rendered text) the fleet router merges, plus
+            # this replica's heat rows and the local health facts the
+            # fleet /healthz composes
+            from geomesa_tpu import heat, metrics, obs
+
+            try:
+                health = obs.health()
+            except Exception as e:  # pragma: no cover - defensive
+                health = {"status": "unknown", "error": str(e)}
+            return ok({
+                "replica": self.replica_id,
+                "metrics": metrics.registry().export_snapshot(),
+                "heat": heat.snapshot(),
+                "health": health,
+            })
+        if kind == "trace-fetch":
+            # stitching source (PROTOCOL v1.7): the finished trace(s)
+            # behind one id from the retention ring, as span-tree dicts —
+            # a replica that served several scatter groups of one query
+            # retains several roots under the same id, and returns ALL of
+            # them in one round trip. ``trace`` is the newest (simple
+            # clients); empty ``traces`` means unknown/evicted — the
+            # stitcher degrades to a partial tree, never blocks.
+            tid = body["trace_id"]
+            return ok({"replica": self.replica_id,
+                       "trace": tracing.finished_trace(tid),
+                       "traces": tracing.finished_traces(tid)})
         if kind == "cache-stats":
             # the aggregate cache is dataset-scoped, so every Flight query
             # of this sidecar shares it; this is the operator's view of
@@ -1138,6 +1189,10 @@ class GeoFlightServer(fl.FlightServerBase):
                              "distance|dx+dy, ecql, right_ecql, analyze}"),
             ("audit", "recent query events: {n}"),
             ("metrics", "metrics registry snapshot"),
+            ("metrics-export", "structured registry snapshot + heat rows "
+                               "+ local health facts for fleet federation"),
+            ("trace-fetch", "one finished trace's span tree from the "
+                            "retention ring: {trace_id}"),
             ("cache-stats", "aggregate cache residency + hit counters"),
             ("serving-stats", "admission queue depth + per-user rollups"),
             ("device-health", "per-device health map (ok/cordoned/broken)"),

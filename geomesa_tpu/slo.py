@@ -79,12 +79,16 @@ def sync_breaker_gauges() -> Dict[str, str]:
 _clock = time.monotonic
 
 
-def _over_count(hist: metrics.Histogram, target_ms: float) -> "tuple":
-    """(total, over-target) observation counts from one histogram, with
-    the target snapped UP to a bucket bound (bucket granularity is all the
+def _over_count(snap: Dict[str, Any], target_ms: float) -> "tuple":
+    """(total, over-target) observation counts from one histogram
+    SNAPSHOT (``Histogram.snapshot()`` shape — the fleet monitor feeds
+    bucket-wise-merged snapshots through the same arithmetic), with the
+    target snapped UP to a bucket bound (bucket granularity is all the
     fixed-bucket histogram can answer; observations in the target's own
-    bucket count as within-SLO, matching the cumulative le= semantics)."""
-    snap = hist.snapshot()
+    bucket count as within-SLO, matching the cumulative le= semantics).
+    Accepts a live ``Histogram`` too and snapshots it."""
+    if not isinstance(snap, dict):
+        snap = snap.snapshot()
     total = snap["count"]
     target_s = target_ms / 1e3
     buckets = snap["buckets"]
@@ -95,13 +99,26 @@ def _over_count(hist: metrics.Histogram, target_ms: float) -> "tuple":
 
 class SloMonitor:
     """Timestamped snapshot ring per op; burn rates by differencing the
-    newest snapshot against the oldest one inside each window."""
+    newest snapshot against the oldest one inside each window.
 
-    def __init__(self):
+    ``source`` generalizes WHERE the cumulative histograms come from: a
+    callable ``op -> Histogram.snapshot()-shaped dict (or None)``. The
+    default reads the process registry's ``trace.<op>`` histograms; the
+    fleet observability plane (fleet/obs.py) passes a source over the
+    MERGED per-replica histograms, so fleet burn runs the exact same
+    dual-window differencing. ``gauge_prefix`` keeps the two monitors'
+    gauges distinct in one process (``slo.burn.<op>`` vs
+    ``slo.burn.fleet.<op>``)."""
+
+    def __init__(self, source=None, gauge_prefix: Optional[str] = None):
         self._lock = threading.Lock()
         #: op -> deque[(t, total, over)]
         self._snaps: Dict[str, "deque"] = {}
         self._last_eval = 0.0
+        self._source = source or (
+            lambda op: metrics.registry().histogram(f"trace.{op}").snapshot()
+        )
+        self._prefix = gauge_prefix or metrics.SLO_BURN_PREFIX
 
     # -- sampling ----------------------------------------------------------
     def evaluate(self, force: bool = False) -> None:
@@ -117,11 +134,12 @@ class SloMonitor:
             if not force and not fresh and now - self._last_eval < 1.0:
                 return
             self._last_eval = now
-        reg = metrics.registry()
         slow_s = config.SLO_WINDOW_SLOW_S.to_float() or 3600.0
         for op, target_ms in targets.items():
-            hist = reg.histogram(f"trace.{op}")
-            total, over = _over_count(hist, target_ms)
+            snap = self._source(op)
+            if snap is None:
+                continue
+            total, over = _over_count(snap, target_ms)
             with self._lock:
                 dq = self._snaps.setdefault(op, deque())
                 dq.append((now, total, over))
@@ -134,7 +152,7 @@ class SloMonitor:
     _gauged: set = set()
 
     def _ensure_gauge(self, op: str) -> None:
-        name = f"{metrics.SLO_BURN_PREFIX}.{op}"
+        name = f"{self._prefix}.{op}"
         if name in self._gauged:
             return
         with self._lock:

@@ -34,7 +34,7 @@ import threading
 import time
 import uuid
 import weakref
-from collections import deque
+from collections import OrderedDict, deque
 from typing import Any, Dict, List, Optional
 
 from geomesa_tpu import config, metrics
@@ -447,6 +447,81 @@ _slow_lock = threading.Lock()
 _slow: "deque" = deque(maxlen=256)
 _last: List[Optional[Trace]] = [None]
 
+#: finished traces BY ID (strong refs, bounded by geomesa.trace.retain,
+#: oldest-out): the lookup behind /debug/queries?trace=<id> and the
+#: sidecar ``trace-fetch`` action the fleet stitcher pulls replica
+#: subtrees through (docs/OBSERVABILITY.md §9). Insertion is one ordered-
+#: dict put on trace completion; the span-tree walk happens at FETCH
+#: time, so query completion pays nothing extra.
+_retain_lock = threading.Lock()
+_retained: "OrderedDict[str, List[Trace]]" = OrderedDict()
+
+#: traces retained PER ID: a scattered fleet query opens one server root
+#: span per owner-group call, all sharing the router's trace id — every
+#: one must stay fetchable (the stitcher matches them by parent token)
+_RETAIN_PER_ID = 32
+
+
+def _retain(trace: Trace) -> None:
+    cap = config.TRACE_RETAIN.to_int()
+    cap = 256 if cap is None else int(cap)
+    if cap <= 0:
+        return
+    with _retain_lock:
+        lst = _retained.get(trace.trace_id)
+        if lst is None:
+            lst = _retained[trace.trace_id] = []
+        lst.append(trace)
+        del lst[:-_RETAIN_PER_ID]
+        _retained.move_to_end(trace.trace_id)
+        while len(_retained) > cap:
+            _retained.popitem(last=False)
+
+
+def _trace_record(tr: Trace) -> Dict[str, Any]:
+    return {
+        "trace_id": tr.trace_id,
+        "total_ms": round(tr.root.duration_ms, 3),
+        "dropped_spans": tr.dropped,
+        "tree": tr.root.to_dict(),
+    }
+
+
+def finished_trace(trace_id: str,
+                   parent_span: Optional[str] = None
+                   ) -> Optional[Dict[str, Any]]:
+    """The retained finished trace behind ``trace_id`` as a JSON-able
+    record (``{"trace_id", "total_ms", "dropped_spans", "tree"}``), or
+    None when the id never finished here or aged out of the ring. With
+    ``parent_span``, selects the retained trace whose root carries that
+    ``parent_span`` attribute (several server roots share one trace id
+    when a fleet query scatters); otherwise the most recent."""
+    with _retain_lock:
+        lst = list(_retained.get(trace_id) or ())
+    lst = [tr for tr in lst if tr.root is not None]
+    if not lst:
+        return None
+    if parent_span is not None:
+        for tr in reversed(lst):
+            if tr.root.attrs.get("parent_span") == parent_span:
+                return _trace_record(tr)
+        return None
+    return _trace_record(lst[-1])
+
+
+def finished_traces(trace_id: str) -> List[Dict[str, Any]]:
+    """EVERY retained trace behind ``trace_id`` (oldest first) — the
+    ``trace-fetch`` payload: a replica that served several scatter groups
+    of one query returns all its subtrees in one round trip."""
+    with _retain_lock:
+        lst = list(_retained.get(trace_id) or ())
+    return [_trace_record(tr) for tr in lst if tr.root is not None]
+
+
+def clear_retained() -> None:
+    with _retain_lock:
+        _retained.clear()
+
 
 def last_trace() -> Optional[Trace]:
     """The most recently completed trace (CLI ``trace`` subcommand,
@@ -466,6 +541,7 @@ def _finish_trace(trace: Trace) -> None:
     trace.finished = True
     _last[0] = trace
     _tls.last = trace
+    _retain(trace)
     if trace.recompiles:
         # fold the recompile count into the cost ledger, so the serving
         # rollup and exported cost attributes carry it without a second

@@ -206,6 +206,95 @@ def otlp_batch(entries: List[tuple]) -> Dict[str, Any]:
     }
 
 
+def dict_tree_to_otlp_spans(trace_id: str,
+                            tree: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Flatten a span tree in its DICT form (``Span.to_dict()`` shape:
+    ``{"name", "ms", "attrs", "children"}``) into OTLP/JSON span dicts —
+    the stitched fleet trace is assembled as a dict tree (router spans +
+    ``trace-fetch``ed replica subtrees), so it never had live Span
+    objects. Dict trees carry durations but not absolute start times, so
+    start times are synthesized: the root ends "now", and each child
+    starts when its parent does — slicing stays faithful, sub-span skew
+    inside one parent is lost (an accepted stitching approximation)."""
+    tid32 = (trace_id * 2)[:32]
+    root_ms = float(tree.get("ms") or 0.0)
+    root_start_ns = int(time.time() * 1e9) - int(root_ms * 1e6)
+    out: List[Dict[str, Any]] = []
+    counter = [0]
+
+    def walk(node: Dict[str, Any], parent_hex: str, start_ns: int) -> None:
+        idx = counter[0]
+        counter[0] += 1
+        rec: Dict[str, Any] = {
+            "traceId": tid32,
+            # a distinct id keyspace from the replicas' own exports: the
+            # same trace id legitimately appears twice in a sink (each
+            # replica's local subtree + the fleet's stitched whole), and
+            # their span ids must not collide
+            "spanId": _span_id(f"stitched/{trace_id}", idx),
+            "name": str(node.get("name") or "span"),
+            "kind": 1,  # SPAN_KIND_INTERNAL
+            "startTimeUnixNano": str(start_ns),
+            "endTimeUnixNano": str(
+                start_ns + int(float(node.get("ms") or 0.0) * 1e6)
+            ),
+        }
+        if parent_hex:
+            rec["parentSpanId"] = parent_hex
+        attrs = dict(node.get("attrs") or {})
+        if idx == 0:
+            attrs["geomesa.stitched"] = True
+        if attrs:
+            rec["attributes"] = _otlp_attrs(attrs)
+        out.append(rec)
+        for c in node.get("children") or []:
+            walk(c, rec["spanId"], start_ns)
+
+    walk(tree, "", root_start_ns)
+    return out
+
+
+def stitched_batch(trace_id: str, tree: Dict[str, Any]) -> Dict[str, Any]:
+    """One OTLP/JSON ExportTraceServiceRequest for one stitched fleet
+    trace. The resource is ``geomesa-tpu-fleet`` with ``stitched=true``
+    so a backend (and the CI smoke gate) can tell the fleet's assembled
+    view from the replicas' own exports of the same trace id."""
+    return {
+        "resourceSpans": [{
+            "resource": {"attributes": _otlp_attrs({
+                "service.name": "geomesa-tpu-fleet",
+                "geomesa.stitched": True,
+            })},
+            "scopeSpans": [{
+                "scope": {"name": "geomesa_tpu.fleet.obs"},
+                "spans": dict_tree_to_otlp_spans(trace_id, tree),
+            }],
+        }],
+    }
+
+
+def export_stitched(trace_id: str, tree: Dict[str, Any]) -> bool:
+    """Write one stitched trace through the configured sinks (same
+    JSONL/OTLP targets and breakers the live exporter uses). Runs on the
+    fleet stitcher thread only — never the query path. False when no
+    sink is configured or every sink failed."""
+    sinks = []
+    path = config.TRACE_EXPORT_PATH.get()
+    if path:
+        sinks.append(("file", path))
+    endpoint = config.TRACE_OTLP_ENDPOINT.get()
+    if endpoint:
+        sinks.append(("otlp", endpoint))
+    if not sinks:
+        return False
+    batch = stitched_batch(trace_id, tree)
+    ok = False
+    for kind, target in sinks:
+        if _Sink(kind, target).write(batch, 1):
+            ok = True
+    return ok
+
+
 # ---------------------------------------------------------------------------
 # sinks
 # ---------------------------------------------------------------------------

@@ -1,0 +1,558 @@
+"""Fleet observability plane (docs/OBSERVABILITY.md §9).
+
+Covers the four tentpole pieces end to end on an in-process replica
+fleet (the test_fleet.py harness shape):
+
+* metrics federation — ``merge_exports`` exactness (counters add,
+  histograms merge bucket-wise on identical ladders, ladder skew is
+  counted not re-binned), the ``/metrics/fleet`` exposition;
+* cross-replica trace stitching — one scattered query produces ONE
+  stitched span tree whose replica-subtree count equals the surviving
+  owner-group count, visible at ``/debug/queries?trace=<id>``;
+* cell-heat telemetry — the cache decomposition loop feeds the heat
+  table, snapshots merge with per-replica touch splits, ``/debug/heat``;
+* fleet health composition — cordon/breaker/journal-lag combos degrade
+  SOFT while capacity remains, HARD (503) only at zero usable replicas;
+* the replica anomaly watchdog (observation only);
+* the join-pushdown row-group residency cache (docs/JOIN.md §11).
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from geomesa_tpu import (
+    GeoDataset, config, heat, metrics, obs, resilience, tracing,
+)
+from geomesa_tpu.fleet import FleetRouter
+
+SPEC = "name:String:index=true,speed:Float,dtg:Date,*geom:Point"
+N = 600
+WIDE = "BBOX(geom, -44, -27, 44, 27)"
+
+
+def _data(n=N, seed=5):
+    rng = np.random.default_rng(seed)
+    xs = rng.uniform(-45, 45, n)
+    ys = rng.uniform(-28, 28, n)
+    return {
+        "name": [f"n{i % 4}" for i in range(n)],
+        "speed": rng.uniform(0, 30, n).astype(np.float32),
+        "dtg": (np.datetime64("2024-05-01", "ms")
+                + rng.integers(0, 20 * 86_400_000, n)),
+        "geom": [(float(x), float(y)) for x, y in zip(xs, ys)],
+    }
+
+
+@pytest.fixture(autouse=True)
+def _fresh_breakers():
+    resilience.reset_breakers()
+    yield
+    resilience.reset_breakers()
+
+
+@pytest.fixture(scope="module")
+def root(tmp_path_factory):
+    path = str(tmp_path_factory.mktemp("fleet_obs_root"))
+    seed = GeoDataset(n_shards=1, prefer_device=False)
+    seed.create_schema("t", SPEC)
+    seed.insert("t", _data(), fids=[f"f{i}" for i in range(N)])
+    seed.flush("t")
+    seed.save(path)
+    return path
+
+
+@pytest.fixture(scope="module")
+def oracle(root):
+    return GeoDataset.load(root, prefer_device=False)
+
+
+def _replica(root, rid):
+    from geomesa_tpu.sidecar import GeoFlightServer
+
+    return GeoFlightServer(
+        GeoDataset.load(root, prefer_device=False),
+        replica_id=rid, fleet_root=root,
+    )
+
+
+@pytest.fixture()
+def fleet(root):
+    servers = {rid: _replica(root, rid) for rid in ("r1", "r2", "r3")}
+    router = FleetRouter({
+        rid: f"grpc+tcp://127.0.0.1:{srv.port}"
+        for rid, srv in servers.items()
+    })
+    yield servers, router
+    router.close()
+    for srv in servers.values():
+        try:
+            srv.shutdown()
+        except Exception:
+            pass
+
+
+# ---------------------------------------------------------------------------
+# metrics federation: merge exactness
+# ---------------------------------------------------------------------------
+
+
+def test_merge_exports_counters_and_histograms_exact():
+    a = metrics.MetricRegistry(prefix="g")
+    b = metrics.MetricRegistry(prefix="g")
+    a.counter("q").inc(3)
+    b.counter("q").inc(4)
+    b.counter("only_b").inc(2)
+    for v in (0.001, 0.2):
+        a.histogram("trace.count").observe(v)
+    for v in (0.001, 5.0):
+        b.histogram("trace.count").observe(v)
+    a.gauge("load").set(1.5)
+    b.gauge("load").set(2.5)
+    merged = metrics.merge_exports(
+        {"r1": a.export_snapshot(), "r2": b.export_snapshot()}
+    )
+    # counters add EXACTLY, absent names are zero-not-missing semantics
+    assert merged["counters"]["q"] == 7
+    assert merged["counters"]["only_b"] == 2
+    # histograms add bucket-wise on the shared ladder
+    ha = a.histogram("trace.count").snapshot()
+    hb = b.histogram("trace.count").snapshot()
+    mh = merged["histograms"]["trace.count"]
+    assert list(mh["buckets"]) == list(ha["buckets"])
+    assert mh["counts"] == [x + y for x, y in zip(ha["counts"],
+                                                  hb["counts"])]
+    assert mh["count"] == 4
+    assert mh["sum_s"] == pytest.approx(ha["sum_s"] + hb["sum_s"])
+    # gauges keep per-replica identity
+    assert merged["gauges"]["load"] == {"r1": 1.5, "r2": 2.5}
+    assert merged["bucket_skew"] == {}
+
+
+def test_merge_exports_counts_ladder_skew_instead_of_rebinning():
+    a = metrics.MetricRegistry(prefix="g")
+    b = metrics.MetricRegistry(prefix="g")
+    a.histogram("h", buckets=(1.0, 2.0)).observe(0.5)
+    b.histogram("h", buckets=(1.0, 3.0)).observe(0.5)
+    merged = metrics.merge_exports(
+        {"r1": a.export_snapshot(), "r2": b.export_snapshot()}
+    )
+    assert merged["bucket_skew"] == {"h": 1}
+    # the first replica's ladder survives untouched — never re-binned
+    assert list(merged["histograms"]["h"]["buckets"])[:2] == [1.0, 2.0]
+    assert merged["histograms"]["h"]["count"] == 1
+
+
+def test_fleet_federation_and_metrics_endpoint(fleet):
+    servers, router = fleet
+    name = "fleet_plane_test.unique"
+    metrics.registry().counter(name).inc(5)
+    plane = router.observability()
+    fed = plane.federate(force=True)
+    assert fed["errors"] == {}
+    assert fed["replicas"] == ["r1", "r2", "r3"]
+    # in-process replicas share one registry, so the merged counter is
+    # the exact 3x sum of three identical snapshots — federation added
+    # nothing and lost nothing
+    assert fed["merged"]["counters"][name] == 15
+    # TTL cache: an immediate re-pull returns the same payload object
+    assert plane.federate() is fed
+    # /metrics/fleet renders the merged view through the live router
+    code, ctype, body = obs.handle("/metrics/fleet")
+    assert code == 200 and "0.0.4" in ctype
+    assert b"fleet_plane_test" in body
+    code, ctype, body = obs.handle(
+        "/metrics/fleet", accept="application/openmetrics-text"
+    )
+    assert code == 200 and ctype.startswith("application/openmetrics-text")
+    assert body.endswith(b"# EOF\n")
+
+
+# ---------------------------------------------------------------------------
+# cross-replica trace stitching
+# ---------------------------------------------------------------------------
+
+
+def test_scatter_stitches_one_tree_per_query(fleet, oracle, monkeypatch):
+    """The acceptance gate: one scattered query -> exactly one stitched
+    span tree whose replica-subtree count equals the surviving
+    owner-group count, with spans from >= 2 replicas."""
+    servers, router = fleet
+    plane = router.observability()
+    captured = []
+    monkeypatch.setattr(
+        plane, "note_scatter",
+        lambda tid, owners: captured.append((tid, list(owners))),
+    )
+    with config.TRACE_ENABLED.scoped("true"), \
+            config.FLEET_STITCH.scoped("true"):
+        assert router.count("t", WIDE) == oracle.count("t", WIDE)
+    assert len(captured) == 1, captured
+    tid, owners = captured[0]
+    assert tid is not None and owners
+    rec = plane.stitch_now(tid, owners)
+    assert rec is not None and rec["stitched"] is True
+    assert rec["trace_id"] == tid
+    # every surviving owner-group call produced exactly one replica
+    # subtree under the router span that made it
+    assert rec["subtrees"] == len(owners)
+    assert len(rec["replicas"]) >= 2
+
+    def _subtree_roots(node, out):
+        for c in node.get("children") or ():
+            if (c.get("attrs") or {}).get("parent_span"):
+                out.append(c)
+            _subtree_roots(c, out)
+        return out
+
+    roots = _subtree_roots(rec["tree"], [])
+    assert len(roots) == len(owners)
+    assert all((r.get("attrs") or {}).get("replica") for r in roots)
+    assert not any(
+        (r.get("attrs") or {}).get("stitch_orphan") for r in roots
+    )
+    # retained for the exact-match debug lookup
+    code, _, body = obs.handle(f"/debug/queries?trace={tid}")
+    assert code == 200
+    got = json.loads(body)
+    assert got["stitched"] is True and got["subtrees"] == len(owners)
+    # stitching is idempotent: a re-stitch grafts the same subtree set
+    rec2 = plane.stitch_now(tid, owners)
+    assert rec2["subtrees"] == len(owners)
+
+
+def test_trace_lookup_unknown_id_is_404():
+    code, _, body = obs.handle("/debug/queries?trace=feedfacecafebeef")
+    assert code == 404
+    assert b"not retained" in body
+
+
+def test_traced_local_query_lookup_falls_back_to_retention():
+    ds = GeoDataset(n_shards=1)
+    ds.create_schema("l", "*geom:Point")
+    ds.insert("l", {"geom": [(0.0, 0.0), (1.0, 1.0)]})
+    ds.flush("l")
+    with config.TRACE_ENABLED.scoped("true"):
+        ds.count("l", "BBOX(geom, -1, -1, 2, 2)")
+        tid = tracing.last_trace().trace_id
+    code, _, body = obs.handle(f"/debug/queries?trace={tid}")
+    assert code == 200
+    got = json.loads(body)
+    assert got["trace_id"] == tid
+    assert got["tree"]["name"] == "count"
+
+
+# ---------------------------------------------------------------------------
+# cell-heat telemetry
+# ---------------------------------------------------------------------------
+
+
+def test_heat_merge_adds_and_splits_by_replica():
+    snaps = {
+        "r1": {"t": [
+            {"cell": "z5:10", "hits": 2, "misses": 1, "device_ms": 3.0,
+             "touches": 3},
+        ]},
+        "r2": {"t": [
+            {"cell": "z5:10", "hits": 0, "misses": 4, "device_ms": 8.0,
+             "touches": 4},
+            {"cell": "z5:11", "hits": 1, "misses": 0, "device_ms": 0.0,
+             "touches": 1},
+        ]},
+    }
+    merged = heat.merge_snapshots(snaps, top=10)
+    rows = {r["cell"]: r for r in merged["t"]}
+    hot = rows["z5:10"]
+    assert (hot["hits"], hot["misses"], hot["touches"]) == (2, 5, 7)
+    assert hot["device_ms"] == pytest.approx(11.0)
+    assert hot["replicas"] == {"r1": 3, "r2": 4}
+    # hottest-first ordering by touches
+    assert merged["t"][0]["cell"] == "z5:10"
+
+
+def test_cache_decomposition_feeds_heat_and_debug_endpoint(rng):
+    heat.reset()
+    ds = GeoDataset(n_shards=2)
+    ds.create_schema("pts", "type:String,dtg:Date,*geom:Point")
+    n = 3000
+    lo = np.datetime64("2020-01-01", "ms").astype(np.int64)
+    ds.insert("pts", {
+        "geom__x": rng.uniform(-35, 35, n),
+        "geom__y": rng.uniform(-35, 35, n),
+        "dtg": (lo + rng.integers(0, 10**9, n)).astype("datetime64[ms]"),
+        "type": rng.choice(["bus", "car"], n),
+    }, fids=np.arange(n).astype(str))
+    ds.flush("pts")
+    q1 = "BBOX(geom, -22.5, -22.5, 22.5, 22.5) AND type = 'bus'"
+    q2 = "BBOX(geom, -18.0, -22.5, 34.9, 22.5) AND type = 'bus'"
+    with config.CACHE_ENABLED.scoped("true"):
+        ds.count("pts", q1)   # cold decomposition: misses with device_ms
+        ds.count("pts", q2)   # overlap: interior cells hit
+    snap = heat.snapshot()
+    assert snap.get("pts"), "decomposition recorded no heat"
+    assert all(r["cell"].startswith("z") for r in snap["pts"])
+    assert sum(r["misses"] for r in snap["pts"]) > 0
+    assert sum(r["hits"] for r in snap["pts"]) > 0
+    assert sum(r["device_ms"] for r in snap["pts"]) > 0
+    code, _, body = obs.handle("/debug/heat?top=16")
+    assert code == 200
+    got = json.loads(body)
+    assert got["local"]["pts"]
+    assert len(got["local"]["pts"]) <= 16
+    heat.reset()
+
+
+def test_heat_table_bounded_evicts_coldest():
+    t = heat.HeatTable(max_cells=2)
+    t.record("s", 5, "1", hit=1)
+    t.record("s", 5, "1", hit=1)   # touches=2: the hot row
+    t.record("s", 5, "2", miss=1)  # touches=1: the cold row
+    t.record("s", 5, "3", hit=1)   # insert past cap evicts z5:2
+    cells = {r["cell"] for r in t.snapshot()["s"]}
+    assert cells == {"z5:1", "z5:3"}
+
+
+def test_fleet_heat_merges_replica_tables(fleet):
+    servers, router = fleet
+    heat.reset()
+    heat.record("t", 6, "42", miss=1, device_ms=2.0)
+    plane = router.observability()
+    with config.FLEET_OBS_TTL_MS.scoped("0"):
+        out = plane.fleet_heat(top=8)
+    assert out["errors"] == {}
+    assert out["replicas"] == ["r1", "r2", "r3"]
+    rows = out["schemas"]["t"]
+    row = next(r for r in rows if r["cell"] == "z6:42")
+    # one shared in-process table exported by three replicas: the merge
+    # adds the three identical snapshots and splits touches per replica
+    assert row["misses"] == 3
+    assert set(row["replicas"]) == {"r1", "r2", "r3"}
+    heat.reset()
+
+
+# ---------------------------------------------------------------------------
+# fleet health composition (the satellite: soft/hard combos)
+# ---------------------------------------------------------------------------
+
+
+def test_fleet_health_soft_hard_composition(fleet, monkeypatch):
+    servers, router = fleet
+    plane = router.observability()
+    with config.FLEET_OBS_TTL_MS.scoped("0"):
+        h = plane.fleet_health()
+        assert h["status"] == "ok" and h["soft"] is False
+        code, _, _ = obs.handle("/healthz/fleet")
+        assert code == 200
+
+        # journal lag on the members: SOFT — acked-but-unsynced frames
+        # are a durability watch item, not a capacity loss
+        monkeypatch.setattr(obs, "_journal_lag", lambda: {"/data": 3})
+        h = plane.fleet_health()
+        assert h["status"] == "degraded" and h["soft"] is True
+        assert any("journal lag" in r for r in h["reasons"])
+        code, _, body = obs.handle("/healthz/fleet")
+        assert code == 200 and json.loads(body)["soft"] is True
+        monkeypatch.setattr(obs, "_journal_lag", lambda: {})
+
+        # an open fs.root breaker turns each member's LOCAL health HARD
+        # (503 on the replica's own /healthz) — but the fleet stays SOFT
+        # while the registry says capacity remains
+        fsbr = resilience.breaker("fs.root")
+        for _ in range(50):
+            fsbr.record_failure()
+        h = plane.fleet_health()
+        assert h["status"] == "degraded" and h["soft"] is True
+        assert any("local health" in r for r in h["reasons"])
+        code, _, _ = obs.handle("/healthz/fleet")
+        assert code == 200
+        resilience.reset_breakers()
+
+        # one cordoned member: SOFT (capacity remains)
+        router.registry.cordon("r2", "test")
+        h = plane.fleet_health()
+        assert h["status"] == "degraded" and h["soft"] is True
+        assert any("cordon" in r for r in h["reasons"])
+        assert not any(r.startswith("hard:") for r in h["reasons"])
+
+        # an open replica breaker ON TOP of the cordon: still SOFT
+        # while at least one member stays usable
+        br = resilience.breaker("replica:r3")
+        for _ in range(50):
+            br.record_failure()
+        with pytest.raises(resilience.CircuitOpenError):
+            br.allow()
+        h = plane.fleet_health()
+        assert h["soft"] is True
+        assert h["summary"]["usable"] >= 1
+        assert any("breaker" in r or "broken" in str(h["summary"])
+                   for r in h["reasons"])
+        code, _, _ = obs.handle("/healthz/fleet")
+        assert code == 200
+
+        # zero usable members: HARD, 503
+        router.registry.cordon("r1", "test")
+        router.registry.cordon("r3", "test")
+        h = plane.fleet_health()
+        assert h["status"] == "degraded" and h["soft"] is False
+        assert any(r.startswith("hard:") for r in h["reasons"])
+        code, _, _ = obs.handle("/healthz/fleet")
+        assert code == 503
+
+        # healing restores ok
+        resilience.reset_breakers()
+        for rid in ("r1", "r2", "r3"):
+            router.registry.uncordon(rid)
+        h = plane.fleet_health()
+        assert h["status"] == "ok"
+
+
+def test_fleet_endpoints_404_without_router():
+    # no live router in this process state: the fleet routes answer 404,
+    # never 500 (the local /metrics + /healthz stay untouched)
+    code, _, _ = obs.handle("/metrics/fleet")
+    assert code in (200, 404)  # 200 only if another test's router leaked
+
+
+# ---------------------------------------------------------------------------
+# replica anomaly watchdog
+# ---------------------------------------------------------------------------
+
+
+def test_anomaly_report_flags_slow_replica(fleet):
+    servers, router = fleet
+    reg = router.registry
+    with config.FLEET_ANOMALY_FACTOR.scoped("2"):
+        for _ in range(16):
+            reg.record_latency("r1", 0.01, "count")
+            reg.record_latency("r2", 0.01, "count")
+            reg.record_latency("r3", 0.08, "count")
+        flagged = reg.anomaly_report()
+        assert "r3" in flagged and "count" in flagged["r3"]
+        assert flagged["r3"]["count"] >= 2.0
+        assert "r1" not in flagged and "r2" not in flagged
+        # the worst-ratio gauge published for the outlier
+        g = metrics.registry().gauge(f"{metrics.FLEET_ANOMALY_PREFIX}.r3")
+        assert g.value >= 2.0
+        # surfaces as a SOFT health reason — observation, never a cordon
+        with config.FLEET_OBS_TTL_MS.scoped("0"):
+            h = router.observability().fleet_health()
+        assert any("anomaly" in r for r in h["reasons"])
+        assert h["soft"] is True
+        assert router.registry.state("r3") not in ("cordoned", "broken")
+
+
+# ---------------------------------------------------------------------------
+# join pushdown residency cache (docs/JOIN.md §11)
+# ---------------------------------------------------------------------------
+
+
+class _FakeFile:
+    def __init__(self):
+        self.reads = 0
+
+    def read_array(self, ref):
+        self.reads += 1
+        return np.arange(int(ref["n"]), dtype=np.int64)
+
+    def blob_nbytes(self, ref):
+        return int(ref["b"])
+
+
+def test_group_residency_cache_lru_hits_and_saved_bytes():
+    from geomesa_tpu.lake.residency import GroupResidencyCache
+
+    f = _FakeFile()
+    ref = {"n": 4, "b": 100}          # 32 decoded bytes per entry
+    c = GroupResidencyCache(budget_bytes=96)
+    a1 = c.fetch("d", "c/x", 0, ref, f)
+    a2 = c.fetch("d", "c/x", 0, ref, f)
+    assert a2 is a1 and f.reads == 1
+    assert c.hits == 1 and c.bytes_saved == 100
+    assert not a1.flags.writeable   # shared chunks fail loudly on mutate
+    for gi in (1, 2, 3):            # 4 x 32 decoded bytes > 96 budget
+        c.fetch("d", "c/x", gi, ref, f)
+    assert c.evictions >= 1
+    c.fetch("d", "c/x", 0, ref, f)  # the evicted LRU group re-decodes
+    assert f.reads == 5
+    snap = c.snapshot()
+    assert snap["hits"] == 1 and snap["bytes_saved"] == 100
+    assert snap["held_bytes"] <= 96
+    # "0" disables via from_config
+    with config.JOIN_PUSHDOWN_RESIDENCY_MB.scoped("0"):
+        assert GroupResidencyCache.from_config() is None
+    assert GroupResidencyCache.from_config() is not None
+
+
+def test_join_pushdown_residency_saves_bytes_and_stays_exact(tmp_path):
+    """Cross-chunk residency: with small chunks over clustered data the
+    boundary row groups re-survive pruning in adjacent chunks — the
+    cache serves the re-decode (hits > 0, saved bytes counted in
+    stats.pushdown and the counters) and the total stays bit-identical
+    to a residency-disabled run."""
+    import contextlib
+
+    from geomesa_tpu.api.dataset import Query
+    from geomesa_tpu.filter.ecql import parse_iso_ms
+    from geomesa_tpu.index.partitioned import PartitionedFeatureStore
+
+    with contextlib.ExitStack() as stack:
+        stack.enter_context(config.LAKE_ENABLED.scoped("true"))
+        stack.enter_context(config.LAKE_ROWGROUP_ROWS.scoped("512"))
+        ds = GeoDataset(n_shards=2)
+        ds.create_schema(
+            "t", "name:String,dtg:Date,*geom:Point;geomesa.partition='time'")
+        st = ds._store("t")
+        assert isinstance(st, PartitionedFeatureStore)
+        st._spill_dir = str(tmp_path / "lake")
+        rng = np.random.default_rng(44)
+        n = 9000
+        cx = rng.uniform(-110, -80, 5)
+        cy = rng.uniform(30, 45, 5)
+        k = rng.integers(0, 5, n)
+        ds.insert("t", {
+            "name": [f"r{i % 9}" for i in range(n)],
+            "dtg": rng.integers(parse_iso_ms("2020-01-01"),
+                                parse_iso_ms("2020-02-01"),
+                                n).astype("datetime64[ms]"),
+            "geom__x": np.clip(cx[k] + rng.normal(0, 0.3, n), -115, -75),
+            "geom__y": np.clip(cy[k] + rng.normal(0, 0.3, n), 25, 50),
+        })
+        ds.flush()
+        st.spill_all()
+    ds.create_schema("pts", "name:String,*geom:Point")
+    k = rng.integers(0, 3, 400)
+    ds.insert("pts", {
+        "name": ["p"] * 400,
+        "geom": list(zip(
+            np.clip(cx[k] + rng.normal(0, 0.2, 400), -115, -75),
+            np.clip(cy[k] + rng.normal(0, 0.2, 400), 25, 50),
+        )),
+    })
+    ds.flush()
+
+    hits_ctr = metrics.registry().counter(
+        metrics.JOIN_PUSHDOWN_RESIDENCY_HITS)
+    bytes_ctr = metrics.registry().counter(
+        metrics.JOIN_PUSHDOWN_RESIDENCY_BYTES)
+    h0, b0 = hits_ctr.value, bytes_ctr.value
+    with config.JOIN_PUSHDOWN_CELLS.scoped("4"):
+        _, _, _, _, total, stats = ds._join_pushdown_count(
+            "pts", "t", "dwithin", 0.1, None, None, Query(), Query(),
+            None, False)
+        pd = stats.pushdown
+        assert pd["chunks"] > 1, pd
+        assert pd["residency_hits"] > 0, pd
+        assert pd["bytes_saved_residency"] > 0, pd
+        assert hits_ctr.value - h0 == pd["residency_hits"]
+        assert bytes_ctr.value - b0 == pd["bytes_saved_residency"]
+        with config.JOIN_PUSHDOWN_RESIDENCY_MB.scoped("0"):
+            _, _, _, _, total_off, stats_off = ds._join_pushdown_count(
+                "pts", "t", "dwithin", 0.1, None, None, Query(), Query(),
+                None, False)
+        assert stats_off.pushdown["residency_hits"] == 0
+        assert stats_off.pushdown["bytes_saved_residency"] == 0
+    # bit-identical with the cache on, off — and against the full join
+    assert total_off == total
+    assert ds.join("pts", "t", predicate="dwithin",
+                   distance=0.1).count == total
